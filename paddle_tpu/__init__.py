@@ -1,0 +1,115 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+A ground-up re-design of the PaddlePaddle surface (reference:
+/root/reference, see SURVEY.md) for TPU hardware: jax/XLA is the kernel and
+compiler layer, Pallas supplies fused kernels, pjit/shard_map + jax.sharding
+supply distributed execution over ICI/DCN meshes.
+
+Public API mirrors ``import paddle``: tensors, ops, nn, optimizer, autograd,
+amp, jit, io, distributed, vision, metric, profiler.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# Enable 64-bit dtypes: paddle semantics default integer tensors to int64.
+# Compute dtypes stay explicit (float32/bfloat16) throughout the framework,
+# so this does not push float64 onto the TPU MXU path.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+# core types
+from .core.tensor import Tensor, Parameter
+from .core.dtype import (
+    bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+)
+from .core.place import (
+    CPUPlace, TPUPlace, CUDAPlace, CustomPlace, Place,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+)
+from .core import flags as _flags
+from .core.flags import set_flags, get_flags
+from .core.generator import seed, default_generator, get_rng_state_tracker
+
+# ops — star import puts the whole tensor-op surface at top level
+# (paddle.matmul, paddle.reshape, ...), and patches Tensor methods.
+from .ops import *  # noqa: F401,F403
+from . import ops
+
+# autograd
+from .autograd import no_grad, enable_grad, set_grad_enabled, grad
+from . import autograd
+
+# subpackages (lazy-ish: imported on attribute access for heavy ones)
+from . import nn
+from . import optimizer
+from . import io
+from . import amp
+from . import jit
+from . import static
+from . import metric
+from . import device
+from . import incubate
+
+from .framework.io_ import save, load
+from . import framework
+
+import sys as _sys
+
+
+def __getattr__(name):
+    # heavyweight subpackages loaded on demand
+    if name in ("distributed", "vision", "profiler", "hapi"):
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        setattr(_sys.modules[__name__], name, mod)
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def is_grad_enabled():
+    return autograd.is_grad_enabled()
+
+
+def set_default_dtype(d):
+    from .core.dtype import convert_dtype
+
+    set_flags({"default_dtype": convert_dtype(d).name})
+
+
+def get_default_dtype():
+    return _flags.get_flag("default_dtype")
+
+
+def set_device(device_str):
+    from .core import place as _place
+
+    return _place.set_device(device_str)
+
+
+def get_device():
+    from .core import place as _place
+
+    return _place.get_device()
+
+
+def device_count():
+    from .core import place as _place
+
+    return _place.device_count()
+
+
+def in_dynamic_mode():
+    from .jit.trace_state import in_tracing
+
+    return not in_tracing()
+
+
+def synchronize():
+    """Block until all enqueued device work completes (paddle.device.synchronize)."""
+    import jax
+
+    (jax.device_put(0.0) + 0).block_until_ready()

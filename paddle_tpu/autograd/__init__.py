@@ -1,0 +1,96 @@
+"""Autograd public API.
+
+Reference surface: python/paddle/autograd/ — backward, grad (GeneralGrad,
+fluid/eager/general_grad.h), PyLayer (autograd/py_layer.py), no_grad.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from .engine import enable_grad, grad_enabled, no_grad, run_backward, set_grad_enabled
+from .py_layer import PyLayer, PyLayerContext
+
+__all__ = [
+    "backward",
+    "grad",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "PyLayer",
+    "PyLayerContext",
+]
+
+
+def is_grad_enabled():
+    return grad_enabled()
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph: Optional[bool] = None,
+    create_graph: bool = False,
+    only_inputs: bool = True,
+    allow_unused: bool = False,
+    no_grad_vars=None,
+):
+    """paddle.grad parity (reference: python/paddle/autograd/autograd.py,
+    C++ GeneralGrad partial-graph engine). Computes grads of ``outputs``
+    w.r.t. ``inputs`` without touching ``.grad`` fields.
+
+    create_graph (double backward) is not yet supported in the eager tape;
+    use jax-level autodiff via paddle_tpu.incubate.autograd for higher order.
+    """
+    from ..core.tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported by the eager "
+            "tape yet; trace the whole computation with paddle_tpu.jit and "
+            "use functional grad instead"
+        )
+    single = isinstance(inputs, Tensor)
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if single else list(inputs)
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    capture = {}
+    for i, t in enumerate(inputs):
+        if t._node is not None:
+            capture[(id(t._node), t._out_slot)] = i
+        else:
+            capture[(id(t._accum_node()), 0)] = i
+
+    retain = bool(retain_graph) if retain_graph is not None else False
+    captured = run_backward(
+        outputs,
+        grad_outputs,
+        retain_graph=retain,
+        capture=capture,
+        accumulate_leaves=False,
+    )
+    result = []
+    for i, t in enumerate(inputs):
+        g = captured.get(i)
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"input {i} is unreachable from outputs; pass "
+                    "allow_unused=True to return None for it"
+                )
+            result.append(None)
+        else:
+            result.append(Tensor._from_value(g))
+    return result

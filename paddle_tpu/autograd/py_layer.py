@@ -1,0 +1,103 @@
+"""PyLayer: user-defined differentiable ops.
+
+Reference: python/paddle/autograd/py_layer.py (PyLayer/PyLayerContext backed
+by C++ pylayer GradNode, fluid/eager/pylayer/py_layer_node.h).
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+from . import engine
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: List[Any] = []
+        self.materialize_grads = True
+        self._extra = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    # arbitrary attributes allowed (mirrors reference ctx usage)
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class _PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=_PyLayerMeta):
+    """Subclass with @staticmethod forward(ctx, *args) and
+    backward(ctx, *grad_outputs); call via .apply()."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.tensor import Tensor
+        from ..core import dispatch
+
+        ctx = PyLayerContext()
+        with engine.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = isinstance(outs, Tensor)
+        outs_list = [outs] if single else list(outs)
+        out_arrays = [o._value for o in outs_list]
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+
+        prim_name = f"pylayer::{cls.__module__}.{cls.__qualname__}"
+        if prim_name not in dispatch.PRIMITIVES:
+
+            def _vjp(grads_out, saved_ctx, **static):
+                layer_cls, saved_ctx, n_inputs = saved_ctx
+                gts = [Tensor._from_value(g) for g in grads_out]
+                with engine.no_grad():
+                    gin = layer_cls.backward(saved_ctx, *gts)
+                gin = [gin] if isinstance(gin, Tensor) or gin is None else list(gin)
+                res = [
+                    None if g is None else (g._value if isinstance(g, Tensor) else g)
+                    for g in gin
+                ]
+                if len(res) != n_inputs:
+                    raise RuntimeError(
+                        f"{layer_cls.__name__}.backward returned {len(res)} "
+                        f"grads for {n_inputs} tensor inputs"
+                    )
+                return tuple(res)
+
+            dispatch.register_primitive(
+                prim_name, forward=None, vjp=_vjp, jittable=False
+            )
+
+        node = engine.record_op(
+            prim_name,
+            {},
+            (cls, ctx, len(tensor_inputs)),
+            tensor_inputs,
+            out_arrays,
+        )
+        requires = node is not None
+        wrapped = []
+        for i, o in enumerate(out_arrays):
+            t = Tensor._from_value(o, stop_gradient=not requires)
+            if node is not None:
+                t._node = node
+                t._out_slot = i
+            wrapped.append(t)
+        return wrapped[0] if single else tuple(wrapped)
+
+
+LegacyPyLayer = PyLayer

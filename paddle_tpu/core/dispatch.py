@@ -1,0 +1,158 @@
+"""Primitive dispatch.
+
+TPU-native re-design of the reference kernel dispatch stack
+(reference: paddle/phi/core/kernel_factory.h:58,240,316 KernelKey/Kernel/
+KernelFactory; paddle/phi/api/generator/api_base.py:1300-1327 dispatch
+template). On TPU the "kernel" is an XLA executable: each primitive is a pure
+jax function, jit-compiled once per (static-args, input-avals) signature and
+cached — the analog of KernelFactory's per-key kernel map, designed up front
+because per-op dispatch is the eager-mode bottleneck on TPU (SURVEY §7).
+
+The same primitive call works on concrete arrays (eager) and on jax tracers
+(inside ``paddle_tpu.jit.to_static`` capture), which is how the four execution
+modes of the reference collapse into one path.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import flags
+
+
+class Primitive:
+    """One op: pure forward fn + optional explicit VJP.
+
+    forward:  fn(*arrays, **static_kwargs) -> array | tuple[array]
+    vjp:      fn(grads_out, saved, *, **static_kwargs) -> tuple[array|None]
+              where ``saved`` is whatever ``save`` collected at forward time.
+    save:     fn(arrays_in, outs) -> pytree of arrays to keep for backward
+              (defaults to saving inputs — the TensorWrapper analog,
+              reference: fluid/eager/tensor_wrapper.h).
+    If ``vjp`` is None, backward falls back to jax.vjp over the forward
+    (rematerialised inside one fused XLA program, so the extra FLOPs fuse).
+    """
+
+    __slots__ = ("name", "forward", "vjp", "save", "multi_out", "jittable", "nondiff")
+
+    def __init__(
+        self,
+        name: str,
+        forward: Callable,
+        vjp: Optional[Callable] = None,
+        save: Optional[Callable] = None,
+        multi_out: bool = False,
+        jittable: bool = True,
+        nondiff: bool = False,
+    ):
+        self.name = name
+        self.forward = forward
+        self.vjp = vjp
+        self.save = save
+        self.multi_out = multi_out
+        self.jittable = jittable
+        self.nondiff = nondiff
+
+
+# Global registry — the PD_REGISTER_KERNEL analog (kernel_registry.h:196).
+PRIMITIVES: Dict[str, Primitive] = {}
+
+
+def register_primitive(name, forward, **kwargs) -> Primitive:
+    p = Primitive(name, forward, **kwargs)
+    PRIMITIVES[name] = p
+    return p
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+@functools.lru_cache(maxsize=8192)
+def _jitted_forward(name: str, static_items):
+    """Executable cache keyed by (op, static args); jax.jit adds the
+    per-aval level underneath. Analog of KernelFactory::SelectKernelOrThrowError
+    + the autotune cache (phi/kernels/autotune/)."""
+    prim = PRIMITIVES[name]
+    static = dict(static_items)
+    fn = lambda *arrays: prim.forward(*arrays, **static)
+    return jax.jit(fn) if prim.jittable else fn
+
+
+def _check_nan_inf(name: str, outs):
+    level = flags.get_flag("check_nan_inf_level")
+    for o in outs:
+        if isinstance(o, jax.Array) and jnp.issubdtype(o.dtype, jnp.floating):
+            bad = bool(jnp.any(~jnp.isfinite(o)))
+            if bad:
+                msg = f"NaN/Inf detected in output of op '{name}'"
+                if level == 0:
+                    raise FloatingPointError(msg)
+                import warnings
+
+                warnings.warn(msg)
+
+
+def call_primitive(name: str, arrays: Sequence[Any], static: Dict[str, Any]):
+    """Run a primitive's forward. Returns tuple of raw outputs.
+
+    NaN/Inf watchdog (reference: fluid/eager/nan_inf_utils.cc behind
+    FLAGS_check_nan_inf) only fires on concrete values, never on tracers.
+    """
+    prim = PRIMITIVES[name]
+    if flags.get_flag("eager_op_jit") and prim.jittable:
+        fn = _jitted_forward(name, _hashable(static))
+        outs = fn(*arrays)
+    else:
+        outs = prim.forward(*arrays, **static)
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    if flags.get_flag("check_nan_inf") and not any(
+        isinstance(a, jax.core.Tracer) for a in outs
+    ):
+        _check_nan_inf(name, outs)
+    return outs
+
+
+@functools.lru_cache(maxsize=8192)
+def _jitted_vjp_fallback(name: str, static_items):
+    """Generic backward: rematerialise forward inside the grad program.
+    XLA CSE/fusion absorbs the recompute; this is the default path for ops
+    without a hand-written VJP."""
+    prim = PRIMITIVES[name]
+    static = dict(static_items)
+
+    def bwd(grads_out, *arrays):
+        f = lambda *a: prim.forward(*a, **static)
+        outs, vjp_fn = jax.vjp(f, *arrays)
+        if not isinstance(outs, tuple):
+            grads_out = grads_out[0]
+        return vjp_fn(grads_out)
+
+    return jax.jit(bwd) if prim.jittable else bwd
+
+
+def call_vjp(name: str, grads_out, saved, static: Dict[str, Any]):
+    """Run a primitive's backward. grads_out: tuple aligned with outputs
+    (zeros filled in by the engine for unused outputs)."""
+    prim = PRIMITIVES[name]
+    if prim.vjp is not None:
+        grads = prim.vjp(grads_out, saved, **static)
+    else:
+        # fallback saved = the input arrays tuple
+        fn = _jitted_vjp_fallback(name, _hashable(static))
+        grads = fn(tuple(grads_out), *saved)
+    return tuple(grads) if isinstance(grads, (tuple, list)) else (grads,)
+
+
+def dispatch_cache_info():
+    return {
+        "forward": _jitted_forward.cache_info(),
+        "vjp_fallback": _jitted_vjp_fallback.cache_info(),
+    }

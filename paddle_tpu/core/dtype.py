@@ -1,0 +1,100 @@
+"""Dtype system.
+
+TPU-native re-design of the reference dtype surface
+(reference: paddle/phi/common/data_type.h, python/paddle/framework/dtype.py).
+Dtypes are thin aliases of numpy/jax dtypes; bfloat16 is the TPU-preferred
+half precision (MXU-native), float64 is supported but discouraged on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (jnp dtypes so they flow straight into XLA).
+bool = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_STR_TO_DTYPE = {
+    "bool": bool,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    # paddle-compat spellings
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+INTEGER = {uint8, int8, int16, int32, int64}
+COMPLEX = {complex64, complex128}
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str / np.dtype / jnp dtype / paddle_tpu dtype)
+    to a canonical numpy dtype object usable by jax."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return np.dtype(_STR_TO_DTYPE[dtype])
+        except KeyError:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name for a dtype ('float32', 'bfloat16', ...)."""
+    return np.dtype(dtype).name
+
+
+_EXT_FLOATS = tuple(
+    np.dtype(d) for d in (bfloat16, float8_e4m3fn, float8_e5m2)
+)
+
+
+def is_floating_point(dtype):
+    d = np.dtype(dtype)
+    return d.kind == "f" or d in _EXT_FLOATS
+
+
+def is_integer(dtype):
+    return np.dtype(dtype).kind in ("i", "u")
+
+
+def is_complex(dtype):
+    return np.dtype(dtype).kind == "c"
+
+
+# paddle's implicit-promotion table is numpy-style; jax follows the same
+# lattice under jax.numpy with x64 enabled/disabled. We rely on jnp.promote_types.
+promote_types = jnp.promote_types
+
+
+def default_float_dtype():
+    from . import flags
+
+    return convert_dtype(flags.get_flag("default_dtype"))

@@ -1,0 +1,100 @@
+"""Runtime flags registry.
+
+TPU-native equivalent of the reference flag registry
+(reference: paddle/common/flags.cc — 177 PHI_DEFINE_EXPORTED_* flags,
+python/paddle/base/framework.py set_flags/get_flags).
+
+Flags are process-global, overridable via environment variables named
+``FLAGS_<name>`` (checked at first read), and via ``set_flags``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+_LOCK = threading.RLock()
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "doc", "type", "env_checked")
+
+    def __init__(self, name, default, doc, type_):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.doc = doc
+        self.type = type_
+        self.env_checked = False
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _coerce(type_, raw: str):
+    if type_ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return type_(raw)
+
+
+def define_flag(name: str, default: Any, doc: str = "", type_=None):
+    """Register a flag (analog of PHI_DEFINE_EXPORTED_* at common/flags.cc:31)."""
+    with _LOCK:
+        if name in _REGISTRY:
+            return _REGISTRY[name]
+        f = _Flag(name, default, doc, type_ or type(default))
+        _REGISTRY[name] = f
+        return f
+
+
+def get_flag(name: str):
+    with _LOCK:
+        f = _REGISTRY[name]
+        if not f.env_checked:
+            f.env_checked = True
+            raw = os.environ.get("FLAGS_" + name)
+            if raw is not None:
+                f.value = _coerce(f.type, raw)
+        return f.value
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags equivalent."""
+    with _LOCK:
+        for k, v in flags.items():
+            k = k.removeprefix("FLAGS_")
+            if k not in _REGISTRY:
+                raise KeyError(f"Unknown flag: {k}")
+            f = _REGISTRY[k]
+            f.env_checked = True
+            f.value = _coerce(f.type, v) if isinstance(v, str) else f.type(v)
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {"FLAGS_" + n: get_flag(n) for n in names}
+
+
+def all_flags():
+    with _LOCK:
+        return {n: get_flag(n) for n in list(_REGISTRY)}
+
+
+# ---------------------------------------------------------------------------
+# Core flag set (subset of the reference's 177, the ones with TPU meaning).
+# ---------------------------------------------------------------------------
+define_flag("default_dtype", "float32", "default floating dtype for tensor creation")
+define_flag("check_nan_inf", False, "NaN/Inf watchdog on op outputs (flags.cc:72)")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0: warn only (flags.cc:86)")
+define_flag("eager_op_jit", True, "jit-compile per-op eager executions with caching")
+define_flag("deterministic", False, "force deterministic kernels (cudnn_deterministic analog)")
+define_flag("allocator_strategy", "auto_growth", "kept for API parity; XLA/PJRT owns HBM")
+define_flag("use_stride_kernel", True, "views share storage where jax allows aliasing")
+define_flag("embedding_deterministic", 0, "deterministic embedding grad scatter")
+define_flag("flash_attn_version", 2, "flash-attention kernel generation")
+define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|float32|tensorfloat32")
+define_flag("log_level", 0, "VLOG analog verbosity")
+define_flag("benchmark", False, "sync after each op for timing")
+define_flag("stop_check_timeout", 900, "collective watchdog timeout seconds (parallel.py:1133)")
+define_flag("cache_inference_while_scope", False, "parity placeholder")

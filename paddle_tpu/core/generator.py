@@ -1,0 +1,111 @@
+"""RNG seed management.
+
+TPU-native re-design of the reference generator
+(reference: paddle/phi/core/generator.h — Philox counter per device;
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py —
+RNGStatesTracker keeping TP replicas coherent for dropout).
+
+jax uses counter-based threefry keys; the eager layer keeps one root key per
+"state name" and splits a fresh subkey per draw. Under ``jit.to_static``
+tracing the same API yields traced keys, so compiled steps stay functional.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import jax
+
+_DEFAULT = "global_seed"
+
+
+class Generator:
+    """One named RNG stream (generator.h analog: seed + offset counter)."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._offset = 0
+        self._key = jax.random.key(self._seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._offset = 0
+        self._key = jax.random.key(self._seed)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    def get_state(self):
+        return {"seed": self._seed, "offset": self._offset}
+
+    def set_state(self, state):
+        self.manual_seed(state["seed"])
+        # replay the counter so resume is bit-exact
+        for _ in range(state["offset"]):
+            self._key, _ = jax.random.split(self._key)
+        self._offset = state["offset"]
+
+    def next_key(self):
+        """Split off a fresh subkey (the Philox-offset bump analog)."""
+        self._key, sub = jax.random.split(self._key)
+        self._offset += 1
+        return sub
+
+
+class RNGStatesTracker:
+    """Named RNG streams so TP/PP replicas can agree or differ on demand
+    (reference: fleet/meta_parallel/parallel_layers/random.py RNGStatesTracker).
+
+    - 'global_seed'       : identical across model-parallel ranks
+    - 'local_seed'        : differs per rank (dropout inside TP regions)
+    """
+
+    def __init__(self):
+        self._states: Dict[str, Generator] = {}
+        self._lock = threading.RLock()
+
+    def add(self, name: str, seed: int):
+        with self._lock:
+            self._states[name] = Generator(seed)
+
+    def get(self, name: str = _DEFAULT) -> Generator:
+        with self._lock:
+            if name not in self._states:
+                self._states[name] = Generator(0)
+            return self._states[name]
+
+    def get_states(self):
+        with self._lock:
+            return {k: g.get_state() for k, g in self._states.items()}
+
+    def set_states(self, states):
+        with self._lock:
+            for k, s in states.items():
+                self._states.setdefault(k, Generator()).set_state(s)
+
+
+_tracker = RNGStatesTracker()
+
+
+def default_generator() -> Generator:
+    return _tracker.get(_DEFAULT)
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def seed(value: int):
+    """paddle.seed parity: reseed the default stream (and local stream base)."""
+    _tracker.get(_DEFAULT).manual_seed(value)
+    _tracker.get("local_seed").manual_seed(value + 1)
+    return default_generator()
+
+
+def next_key(name: str = _DEFAULT):
+    return _tracker.get(name).next_key()

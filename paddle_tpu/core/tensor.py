@@ -1,0 +1,335 @@
+"""Eager Tensor.
+
+TPU-native re-design of the reference eager Tensor
+(reference: paddle/fluid/pybind/eager.cc Tensor type, eager_method.cc tensor
+methods, phi/core/dense_tensor.h storage). Here a Tensor wraps a jax.Array
+(PJRT buffer on TPU) or a jax Tracer (under ``jit.to_static`` capture) plus
+autograd metadata (AutogradMeta analog: stop_gradient, grad, producing node).
+
+Operator methods (``__add__``, ``matmul``...) are monkey-patched on by the
+ops layer, mirroring python/paddle/base/dygraph/math_op_patch.py.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dispatch, dtype as dtypes, place as places
+from ..autograd import engine
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_node",
+        "_out_slot",
+        "_accum",
+        "_grad_value",
+        "_grad_hooks",
+        "_retain_grads",
+        "name",
+        "persistable",
+        "_dist_attr",
+        "__weakref__",
+    )
+
+    _name_counter = 0
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._node = None
+        self._out_slot = 0
+        self._accum = None
+        self._grad_value = None
+        self._grad_hooks: List = []
+        self._retain_grads = False
+        if name is None:
+            Tensor._name_counter += 1
+            name = f"generated_tensor_{Tensor._name_counter}"
+        self.name = name
+        self.persistable = False
+        self._dist_attr = None  # (ProcessMesh, placements) when distributed
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    @classmethod
+    def _from_value(cls, value, stop_gradient: bool = True) -> "Tensor":
+        return cls(value, stop_gradient=stop_gradient)
+
+    # ------------------------------------------------------------------
+    # metadata
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        v = self._value
+        if isinstance(v, jax.core.Tracer):
+            return places.expected_place()
+        dev = next(iter(v.devices())) if hasattr(v, "devices") else None
+        if dev is None or dev.platform == "cpu":
+            return places.CPUPlace(0)
+        return places.TPUPlace(dev.id)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    def _accum_node(self):
+        if self._accum is None:
+            self._accum = engine.AccumulationNode(self)
+        return self._accum
+
+    # ------------------------------------------------------------------
+    # grad surface
+    # ------------------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        if self._grad_value is None:
+            return None
+        return Tensor._from_value(self._grad_value)
+
+    @grad.setter
+    def grad(self, g):
+        if g is None:
+            self._grad_value = None
+        else:
+            self._grad_value = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+
+    def clear_grad(self):
+        self._grad_value = None
+
+    clear_gradient = clear_grad
+
+    def backward(self, grad_tensor: Optional["Tensor"] = None, retain_graph: bool = False):
+        """loss.backward() (reference: tensor_patch_methods.py:86 →
+        eager_functions.cc:145 run_backward → eager/backward.cc:105)."""
+        engine.run_backward(
+            [self],
+            [grad_tensor] if grad_tensor is not None else None,
+            retain_graph=retain_graph,
+        )
+
+    def register_hook(self, hook):
+        """Grad hook (reference: grad_node_info.h hooks). Returns a removable
+        handle."""
+        if self._node is not None:
+            hooks = self._node.out_hooks.setdefault(self._out_slot, [])
+            hooks.append(hook)
+            return _HookHandle(hooks, hook)
+        self._grad_hooks.append(hook)
+        return _HookHandle(self._grad_hooks, hook)
+
+    def retain_grads(self):
+        if self._node is not None and not self._retain_grads:
+            self._retain_grads = True
+            acc = self._accum_node()
+            node, slot = self._node, self._out_slot
+
+            def _store(g):
+                acc.accumulate(g._value)
+                return None
+
+            self._node.out_hooks.setdefault(slot, []).append(_store)
+
+    # ------------------------------------------------------------------
+    # value access
+    # ------------------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        v = self._value
+        if isinstance(v, jax.core.Tracer):
+            raise RuntimeError(
+                "Tensor.numpy() is not available inside jit.to_static capture"
+            )
+        return np.asarray(v)
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def detach(self) -> "Tensor":
+        t = Tensor._from_value(self._value, stop_gradient=True)
+        t.name = self.name + ".detach"
+        t._dist_attr = self._dist_attr
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..ops import assign
+
+        return assign(self)
+
+    def cpu(self) -> "Tensor":
+        return Tensor._from_value(jax.device_put(self._value, jax.devices("cpu")[0]),
+                                  stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        # to(dtype), to(place), to(device_str)
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, (str,)) and a in ("cpu", "tpu", "gpu"):
+                dev = jax.devices("cpu")[0] if a == "cpu" else places.TPUPlace(0).get_device()
+                out = Tensor._from_value(jax.device_put(out._value, dev), out.stop_gradient)
+            elif isinstance(a, places.Place):
+                out = Tensor._from_value(jax.device_put(out._value, a.get_device()), out.stop_gradient)
+            else:
+                out = out.astype(a)
+        return out
+
+    def astype(self, dt) -> "Tensor":
+        from ..ops import cast
+
+        return cast(self, dt)
+
+    cast = astype
+
+    # value mutation (in-place assign; autograd-invisible like reference
+    # Tensor.set_value, tensor_patch_methods.py set_value)
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        arr = jnp.asarray(value, dtype=self.dtype)
+        if tuple(arr.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {arr.shape} vs {self._value.shape}"
+            )
+        self._value = arr
+
+    def _replace_value(self, value):
+        """In-place op support: rebind storage, drop stale graph link."""
+        self._value = value
+
+    def copy_(self, other, blocking: bool = True):
+        self.set_value(other)
+        return self
+
+    # ------------------------------------------------------------------
+    # python protocol
+    # ------------------------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __repr__(self):
+        v = self._value
+        if isinstance(v, jax.core.Tracer):
+            return f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, traced)"
+        sg = self.stop_gradient
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, "
+            f"place={self.place}, stop_gradient={sg},\n{np.asarray(v)})"
+        )
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.numpy().item(), spec)
+        return format(str(self), spec)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    # __getitem__/__setitem__/arithmetic are patched in by paddle_tpu.ops
+
+
+class _HookHandle:
+    def __init__(self, hooks, hook):
+        self._hooks = hooks
+        self._hook = hook
+
+    def remove(self):
+        try:
+            self._hooks.remove(self._hook)
+        except ValueError:
+            pass
+
+
+# A Parameter is a Tensor with stop_gradient=False + trainable flag
+# (reference: python/paddle/base/framework.py EagerParamBase).
+class Parameter(Tensor):
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+
+    def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+
+    @property
+    def trainable_(self):
+        return self.trainable
+
+
+# --------------------------------------------------------------------------
+# The op-application path: every op in paddle_tpu.ops funnels through here.
+# Analog of the generated *_ad_func bodies (eager_gen.py:321): run kernel,
+# save tensors, create GradNode, wire edges.
+# --------------------------------------------------------------------------
+def apply(prim_name: str, *tensors: Tensor, **static) -> Any:
+    # All positional args must be Tensors (ops convert scalars/None upstream)
+    # so VJP results align 1:1 with recorded edges.
+    prim = dispatch.PRIMITIVES[prim_name]
+    arrays = tuple(t._value for t in tensors)
+    outs = dispatch.call_primitive(prim_name, arrays, static)
+    requires = (not prim.nondiff) and engine.grad_enabled() and any(
+        not t.stop_gradient for t in tensors
+    )
+    node = None
+    if requires:
+        saved = prim.save(arrays, outs) if prim.save else arrays
+        node = engine.record_op(prim_name, static, saved, tensors, outs)
+    result = []
+    for i, o in enumerate(outs):
+        t = Tensor._from_value(o, stop_gradient=not requires)
+        if node is not None:
+            t._node = node
+            t._out_slot = i
+        result.append(t)
+    if prim.multi_out:
+        return tuple(result)
+    return result[0]

@@ -1,0 +1,6 @@
+"""device namespace (paddle.device parity)."""
+from ..core.place import set_device, get_device, device_count, is_compiled_with_cuda
+def synchronize():
+    import jax
+    (jax.device_put(0.0) + 0).block_until_ready()
+

@@ -1,0 +1,1 @@
+from .io_ import save, load

@@ -1,0 +1,71 @@
+"""paddle.save / paddle.load.
+
+Reference: python/paddle/framework/io.py:773 (save) / :1020 (load) — pickles
+nested state dicts of Tensors. Here Tensors serialize as numpy arrays inside
+a pickle, so checkpoints are portable off-TPU.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class _TensorPayload:
+    """Pickle-stable wrapper (bfloat16 etc. stored via raw bytes)."""
+
+    __slots__ = ("bytes", "dtype", "shape")
+
+    def __init__(self, arr: np.ndarray):
+        self.dtype = str(arr.dtype)
+        self.shape = arr.shape
+        self.bytes = arr.tobytes()
+
+    def to_numpy(self) -> np.ndarray:
+        import ml_dtypes  # noqa: F401  (registers bfloat16 etc. with numpy)
+
+        return np.frombuffer(self.bytes, dtype=np.dtype(self.dtype)).reshape(self.shape)
+
+
+def _pack(obj):
+    from ..core.tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value))
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    from ..core.tensor import Tensor
+
+    if isinstance(obj, _TensorPayload):
+        arr = obj.to_numpy()
+        return arr if return_numpy else Tensor._from_value(jnp.asarray(arr))
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

@@ -1,0 +1,21 @@
+"""Tracing-state flag shared between eager code and jit capture."""
+from __future__ import annotations
+
+import threading
+
+_state = threading.local()
+
+
+def in_tracing() -> bool:
+    return getattr(_state, "tracing", False)
+
+
+class tracing_scope:
+    def __enter__(self):
+        self._prev = in_tracing()
+        _state.tracing = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.tracing = self._prev
+        return False

@@ -1,0 +1,180 @@
+"""Op library aggregator + Tensor method patching.
+
+Reference analog: python/paddle/tensor/__init__.py assembling the tensor API
+and python/paddle/base/dygraph/math_op_patch.py monkey-patching operator
+methods onto the eager Tensor type.
+"""
+from __future__ import annotations
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .comparison import *  # noqa: F401,F403
+from .activation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, comparison, activation, linalg
+from .math import (
+    add_, subtract_, multiply_, divide_, clip_, scale_, exp_, sqrt_, rsqrt_,
+    reciprocal_, round_, floor_, ceil_, neg_, abs_, tanh_,
+)
+from .manipulation import _getitem, _setitem
+from ..core.tensor import Tensor
+
+# statistics-style ops built on the above
+from .stat import *  # noqa: F401,F403
+from . import stat
+
+
+def _patch_tensor():
+    import numbers
+
+    from . import math as m, manipulation as mp, comparison as c, linalg as la
+    from . import activation as act, creation as cr, stat as st
+
+    T = Tensor
+    # arithmetic operators
+    T.__add__ = lambda s, o: m.add(s, o)
+    T.__radd__ = lambda s, o: m.add(s, o)
+    T.__sub__ = lambda s, o: m.subtract(s, o)
+    T.__rsub__ = lambda s, o: m.subtract(o, s)
+    T.__mul__ = lambda s, o: m.multiply(s, o)
+    T.__rmul__ = lambda s, o: m.multiply(s, o)
+    T.__truediv__ = lambda s, o: m.divide(s, o)
+    T.__rtruediv__ = lambda s, o: m.divide(o, s)
+    T.__floordiv__ = lambda s, o: m.floor_divide(s, o)
+    T.__rfloordiv__ = lambda s, o: m.floor_divide(o, s)
+    T.__mod__ = lambda s, o: m.mod(s, o)
+    T.__rmod__ = lambda s, o: m.mod(o, s)
+    T.__pow__ = lambda s, o: m.pow(s, o)
+    T.__rpow__ = lambda s, o: m.pow(o, s)
+    T.__neg__ = lambda s: m.neg(s)
+    T.__abs__ = lambda s: m.abs(s)
+    T.__matmul__ = lambda s, o: m.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: m.matmul(o, s)
+    T.__invert__ = lambda s: c.logical_not(s)
+    T.__and__ = lambda s, o: c.bitwise_and(s, o)
+    T.__or__ = lambda s, o: c.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: c.bitwise_xor(s, o)
+    # comparisons
+    T.__eq__ = lambda s, o: c.equal(s, o)
+    T.__ne__ = lambda s, o: c.not_equal(s, o)
+    T.__lt__ = lambda s, o: c.less_than(s, o)
+    T.__le__ = lambda s, o: c.less_equal(s, o)
+    T.__gt__ = lambda s, o: c.greater_than(s, o)
+    T.__ge__ = lambda s, o: c.greater_equal(s, o)
+    # indexing
+    T.__getitem__ = lambda s, idx: _getitem(s, idx)
+    T.__setitem__ = lambda s, idx, v: _setitem(s, idx, v)
+
+    # method surface (subset mirroring python/paddle/tensor/__init__.py
+    # tensor_method_func list)
+    methods = dict(
+        add=m.add, subtract=m.subtract, multiply=m.multiply, divide=m.divide,
+        floor_divide=m.floor_divide, mod=m.mod, remainder=m.mod, pow=m.pow,
+        maximum=m.maximum, minimum=m.minimum, fmax=m.fmax, fmin=m.fmin,
+        scale=m.scale, neg=m.neg, abs=m.abs, sqrt=m.sqrt, rsqrt=m.rsqrt,
+        square=m.square, exp=m.exp, expm1=m.expm1, log=m.log, log2=m.log2,
+        log10=m.log10, log1p=m.log1p, sin=m.sin, cos=m.cos, tan=m.tan,
+        asin=m.asin, acos=m.acos, atan=m.atan, sinh=m.sinh, cosh=m.cosh,
+        tanh=m.tanh, atan2=m.atan2, floor=m.floor, ceil=m.ceil, round=m.round,
+        trunc=m.trunc, frac=m.frac, sign=m.sign, reciprocal=m.reciprocal,
+        clip=m.clip, erf=m.erf, erfinv=m.erfinv, lerp=m.lerp, lgamma=m.lgamma,
+        digamma=m.digamma, cumsum=m.cumsum, cumprod=m.cumprod,
+        logsumexp=m.logsumexp, logcumsumexp=m.logcumsumexp, isnan=m.isnan,
+        isinf=m.isinf, isfinite=m.isfinite, nan_to_num=m.nan_to_num, sum=m.sum,
+        mean=m.mean, max=m.max, min=m.min, amax=m.amax, amin=m.amin,
+        prod=m.prod, all=m.all, any=m.any, matmul=m.matmul, dot=m.dot,
+        mm=m.matmul, bmm=m.bmm, inner=m.inner, outer=m.outer, kron=m.kron,
+        trace=m.trace, nansum=m.nansum, nanmean=m.nanmean,
+        count_nonzero=m.count_nonzero, add_n=m.add_n, stanh=m.stanh,
+        rad2deg=m.rad2deg, deg2rad=m.deg2rad, diff=m.diff, angle=m.angle,
+        conj=m.conj, real=m.real, imag=m.imag, gcd=m.gcd, lcm=m.lcm,
+        divide_no_nan=m.divide_no_nan, cummax=m.cummax, cummin=m.cummin,
+        increment=m.increment,
+        # inplace
+        add_=add_, subtract_=subtract_, multiply_=multiply_, divide_=divide_,
+        clip_=clip_, scale_=scale_, exp_=exp_, sqrt_=sqrt_, rsqrt_=rsqrt_,
+        reciprocal_=reciprocal_, round_=round_, floor_=floor_, ceil_=ceil_,
+        neg_=neg_, abs_=abs_, tanh_=tanh_,
+        # manipulation
+        reshape=mp.reshape, reshape_=mp.reshape_, transpose=mp.transpose,
+        flatten=mp.flatten, squeeze=mp.squeeze, unsqueeze=mp.unsqueeze,
+        squeeze_=mp.squeeze_, unsqueeze_=mp.unsqueeze_, concat=mp.concat,
+        split=mp.split, chunk=mp.chunk, unbind=mp.unbind, tile=mp.tile,
+        expand=mp.expand, expand_as=mp.expand_as, broadcast_to=mp.broadcast_to,
+        flip=mp.flip, roll=mp.roll, gather=mp.gather, gather_nd=mp.gather_nd,
+        scatter=mp.scatter, scatter_nd_add=mp.scatter_nd_add,
+        index_select=mp.index_select, index_sample=mp.index_sample,
+        index_add=mp.index_add, take_along_axis=mp.take_along_axis,
+        put_along_axis=mp.put_along_axis, masked_select=mp.masked_select,
+        masked_fill=mp.masked_fill, where=mp.where, nonzero=mp.nonzero,
+        topk=mp.topk, sort=mp.sort, argsort=mp.argsort, argmax=mp.argmax,
+        argmin=mp.argmin, unique=mp.unique, numel=mp.numel, pad=mp.pad,
+        tensordot=mp.tensordot, moveaxis=mp.moveaxis, swapaxes=mp.swapaxes,
+        repeat_interleave=mp.repeat_interleave, diagonal=mp.diagonal,
+        as_complex=mp.as_complex, as_real=mp.as_real, rot90=mp.rot90,
+        strided_slice=mp.strided_slice, diag_embed=mp.diag_embed,
+        # comparison
+        equal=c.equal, not_equal=c.not_equal, less_than=c.less_than,
+        less_equal=c.less_equal, greater_than=c.greater_than,
+        greater_equal=c.greater_equal, equal_all=c.equal_all,
+        allclose=c.allclose, isclose=c.isclose, logical_and=c.logical_and,
+        logical_or=c.logical_or, logical_xor=c.logical_xor,
+        logical_not=c.logical_not, bitwise_and=c.bitwise_and,
+        bitwise_or=c.bitwise_or, bitwise_xor=c.bitwise_xor,
+        bitwise_not=c.bitwise_not,
+        # activation-ish tensor methods
+        sigmoid=act.sigmoid, softmax=act.softmax, relu=act.relu,
+        # linalg
+        norm=la.norm, cholesky=la.cholesky, inverse=la.inv, solve=la.solve,
+        matrix_power=la.matrix_power, pinv=la.pinv, det=la.det, cross=la.cross,
+        dist=la.dist, histogram=la.histogram, bincount=la.bincount,
+        # stat
+        std=st.std, var=st.var, median=st.median, quantile=st.quantile,
+        nanmedian=st.nanmedian, nanquantile=st.nanquantile, mode=st.mode,
+        kthvalue=st.kthvalue,
+        # creation-ish
+        fill_=cr_fill_, zero_=cr_zero_, uniform_=cr_uniform_,
+        normal_=cr_normal_, tril=cr.tril, triu=cr.triu,
+    )
+    for name, fn in methods.items():
+        setattr(T, name, fn)
+
+    @property
+    def T_prop(self):
+        return mp.transpose(self, list(range(self.ndim))[::-1])
+
+    T.T = T_prop
+
+    @property
+    def mT(self):
+        return mp.t(self)
+
+    T.mT = mT
+
+
+def cr_fill_(x, value):
+    import jax.numpy as jnp
+
+    x._replace_value(jnp.full(x._value.shape, value, x._value.dtype))
+    return x
+
+
+def cr_zero_(x):
+    return cr_fill_(x, 0)
+
+
+def cr_uniform_(x, min=-1.0, max=1.0, seed=0):
+    out = creation.uniform(x.shape, x.dtype, min, max)
+    x._replace_value(out._value)
+    return x
+
+
+def cr_normal_(x, mean=0.0, std=1.0):
+    out = creation.gaussian(x.shape, mean, std, dtype=x.dtype)
+    x._replace_value(out._value)
+    return x
+
+
+_patch_tensor()

@@ -1,0 +1,118 @@
+"""Op-layer helpers: primitive definition + argument normalization.
+
+This is the analog of the reference codegen pipelines (SURVEY §2.2): where
+the reference generates C++ APIs / Python bindings / GradNodes from ops.yaml
+(phi/api/generator/api_gen.py, eager_gen.py), here each op is one
+``defprim`` registration (pure jax forward, optional explicit VJP) plus a
+thin Python wrapper that normalizes arguments — codegen collapses into
+first-class functions because jax IS the kernel language.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.dtype import convert_dtype, is_floating_point
+from ..core.tensor import Parameter, Tensor, apply
+
+__all__ = [
+    "defprim",
+    "apply",
+    "ensure_tensor",
+    "binary_args",
+    "scalar_tensor",
+    "axis_tuple",
+    "Tensor",
+]
+
+
+def defprim(name: str, forward, **kwargs):
+    """Register a primitive; returns a raw caller fn(*tensors, **static)."""
+    dispatch.register_primitive(name, forward, **kwargs)
+
+    def call(*tensors, **static):
+        return apply(name, *tensors, **static)
+
+    call.__name__ = name
+    return call
+
+
+def ensure_tensor(x, dtype=None) -> Tensor:
+    if isinstance(x, Tensor):
+        return x if dtype is None else _maybe_cast(x, dtype)
+    dt = convert_dtype(dtype)
+    if isinstance(x, (numbers.Number, np.bool_)) and dt is None:
+        # weak scalar: default int64/float32/bool like paddle's to_tensor
+        if isinstance(x, (bool, np.bool_)):
+            dt = np.dtype("bool")
+        elif isinstance(x, numbers.Integral):
+            dt = np.dtype("int64")
+        else:
+            dt = np.dtype("float32")
+    return Tensor._from_value(jnp.asarray(x, dtype=dt))
+
+
+def _maybe_cast(t: Tensor, dtype):
+    dt = convert_dtype(dtype)
+    if np.dtype(t.dtype) == dt:
+        return t
+    from .math import cast
+
+    return cast(t, dt)
+
+
+def scalar_tensor(scalar, ref_dtype) -> Tensor:
+    """Convert a python scalar to a Tensor adopting the peer tensor's dtype
+    when compatible (paddle math_op_patch scalar promotion)."""
+    ref = np.dtype(ref_dtype)
+    if isinstance(scalar, (bool, np.bool_)):
+        dt = ref if ref == np.dtype(bool) else np.dtype(bool)
+    elif isinstance(scalar, numbers.Integral):
+        dt = ref if ref.kind in "iuf" or is_floating_point(ref) else np.dtype("int64")
+    else:  # float/complex scalar
+        if is_floating_point(ref) or ref.kind in "fc":
+            dt = ref
+        else:
+            dt = np.dtype("float32")
+    return Tensor._from_value(jnp.asarray(scalar, dtype=dt))
+
+
+def binary_args(x, y):
+    """Normalize (x, y) for a broadcasting binary op: Tensors of a common
+    dtype (numpy-lattice promotion, matching paddle's implicit promotion)."""
+    xt = isinstance(x, Tensor)
+    yt = isinstance(y, Tensor)
+    if xt and not yt:
+        if isinstance(y, numbers.Number):
+            y = scalar_tensor(y, x.dtype)
+        else:
+            y = ensure_tensor(y)
+    elif yt and not xt:
+        if isinstance(x, numbers.Number):
+            x = scalar_tensor(x, y.dtype)
+        else:
+            x = ensure_tensor(x)
+    elif not xt and not yt:
+        x, y = ensure_tensor(x), ensure_tensor(y)
+    if np.dtype(x.dtype) != np.dtype(y.dtype):
+        common = jnp.promote_types(x.dtype, y.dtype)
+        x = _maybe_cast(x, common)
+        y = _maybe_cast(y, common)
+    return x, y
+
+
+def axis_tuple(axis, ndim: int) -> Optional[tuple]:
+    """Normalize an axis spec to a sorted tuple of non-negative ints."""
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (int, np.integer)):
+        axis = (int(axis),)
+    axis = tuple(int(a) % ndim if ndim else int(a) for a in axis)
+    return tuple(sorted(set(axis)))

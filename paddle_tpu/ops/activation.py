@@ -1,0 +1,280 @@
+"""Activation ops.
+
+Reference surface: python/paddle/nn/functional/activation.py over phi
+activation kernels. Explicit VJPs save outputs where cheaper (sigmoid, tanh
+pattern); the rest use the fused fallback.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ._helpers import defprim, ensure_tensor
+
+__all__ = [
+    "relu", "relu6", "relu_", "leaky_relu", "elu", "selu", "celu", "gelu",
+    "silu", "swish", "mish", "sigmoid", "hardsigmoid", "hardswish", "hardtanh",
+    "hardshrink", "softshrink", "tanhshrink", "softplus", "softsign",
+    "log_sigmoid", "softmax", "log_softmax", "prelu", "glu", "maxout",
+    "thresholded_relu", "rrelu", "gumbel_softmax",
+]
+
+defprim(
+    "relu",
+    lambda x: jnp.maximum(x, 0),
+    vjp=lambda g, saved, **kw: (jnp.where(saved[0] > 0, g[0], 0),),
+    save=lambda ins, outs: (outs[0],),
+)
+defprim(
+    "sigmoid",
+    jax.nn.sigmoid,
+    vjp=lambda g, saved, **kw: (g[0] * saved[0] * (1 - saved[0]),),
+    save=lambda ins, outs: (outs[0],),
+)
+defprim("relu6", lambda x: jnp.clip(x, 0, 6))
+defprim("leaky_relu_p", lambda x, *, slope: jax.nn.leaky_relu(x, slope))
+defprim("elu_p", lambda x, *, alpha: jax.nn.elu(x, alpha))
+defprim("selu_p", lambda x, *, scale, alpha: scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)))
+defprim("celu_p", lambda x, *, alpha: jax.nn.celu(x, alpha))
+defprim(
+    "gelu_p", lambda x, *, approximate: jax.nn.gelu(x, approximate=approximate)
+)
+defprim("silu", jax.nn.silu)
+defprim("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+defprim("hardsigmoid", lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+defprim("hardswish", lambda x: x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+defprim("hardtanh_p", lambda x, *, min, max: jnp.clip(x, min, max))
+defprim(
+    "hardshrink_p",
+    lambda x, *, threshold: jnp.where(jnp.abs(x) > threshold, x, 0.0),
+)
+defprim(
+    "softshrink_p",
+    lambda x, *, threshold: jnp.where(
+        x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0)
+    ),
+)
+defprim("tanhshrink", lambda x: x - jnp.tanh(x))
+defprim(
+    "softplus_p",
+    lambda x, *, beta, threshold: jnp.where(
+        x * beta > threshold, x, jax.nn.softplus(x * beta) / beta
+    ),
+)
+defprim("softsign", jax.nn.soft_sign)
+defprim("log_sigmoid", jax.nn.log_sigmoid)
+defprim(
+    "softmax_p",
+    lambda x, *, axis: jax.nn.softmax(x, axis=axis),
+    vjp=lambda g, saved, *, axis: (
+        saved[0] * (g[0] - jnp.sum(g[0] * saved[0], axis=axis, keepdims=True)),
+    ),
+    save=lambda ins, outs: (outs[0],),
+)
+defprim("log_softmax_p", lambda x, *, axis: jax.nn.log_softmax(x, axis=axis))
+defprim(
+    "thresholded_relu_p",
+    lambda x, *, threshold, value: jnp.where(x > threshold, x, value),
+)
+defprim("prelu_p", lambda x, w, *, axis_shape: jnp.where(x > 0, x, x * w.reshape(axis_shape)))
+
+
+def relu(x, name=None):
+    return apply("relu", ensure_tensor(x))
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._replace_value(out._value)
+    x._node, x._out_slot, x.stop_gradient = out._node, out._out_slot, out.stop_gradient
+    return x
+
+
+def relu6(x, name=None):
+    return apply("relu6", ensure_tensor(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu_p", ensure_tensor(x), slope=float(negative_slope))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu_p", ensure_tensor(x), alpha=float(alpha))
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return apply("selu_p", ensure_tensor(x), scale=float(scale), alpha=float(alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu_p", ensure_tensor(x), alpha=float(alpha))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu_p", ensure_tensor(x), approximate=bool(approximate))
+
+
+def silu(x, name=None):
+    return apply("silu", ensure_tensor(x))
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return apply("mish", ensure_tensor(x))
+
+
+def sigmoid(x, name=None):
+    return apply("sigmoid", ensure_tensor(x))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply("hardsigmoid", ensure_tensor(x))
+
+
+def hardswish(x, name=None):
+    return apply("hardswish", ensure_tensor(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh_p", ensure_tensor(x), min=float(min), max=float(max))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink_p", ensure_tensor(x), threshold=float(threshold))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply("softshrink_p", ensure_tensor(x), threshold=float(threshold))
+
+
+def tanhshrink(x, name=None):
+    return apply("tanhshrink", ensure_tensor(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        "softplus_p", ensure_tensor(x), beta=float(beta), threshold=float(threshold)
+    )
+
+
+def softsign(x, name=None):
+    return apply("softsign", ensure_tensor(x))
+
+
+def log_sigmoid(x, name=None):
+    return apply("log_sigmoid", ensure_tensor(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        from .math import cast
+
+        x = cast(x, dtype)
+    return apply("softmax_p", x, axis=int(axis) % x.ndim - x.ndim)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        from .math import cast
+
+        x = cast(x, dtype)
+    return apply("log_softmax_p", x, axis=int(axis) % x.ndim - x.ndim)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(
+        "thresholded_relu_p", ensure_tensor(x), threshold=float(threshold),
+        value=float(value),
+    )
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    n = weight.size
+    shape = [1] * x.ndim
+    if n > 1:
+        ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+        shape[ch_axis] = n
+    return apply("prelu_p", x, weight, axis_shape=tuple(shape))
+
+
+def glu(x, axis=-1, name=None):
+    from .manipulation import split
+
+    a, b = split(ensure_tensor(x), 2, axis)
+    from .math import multiply
+
+    return multiply(a, sigmoid(b))
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = ensure_tensor(x)
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    return apply("maxout_p", x, groups=int(groups), axis=int(axis), channels=c)
+
+
+def _maxout_fwd(x, *, groups, axis, channels):
+    shape = list(x.shape)
+    shape[axis : axis + 1] = [channels // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+defprim("maxout_p", _maxout_fwd)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    if not training:
+        return leaky_relu(x, (lower + upper) / 2)
+    from ..core import generator
+
+    key = Tensor._from_value(generator.next_key("local_seed"))
+    return apply("rrelu_p", ensure_tensor(x), key, lower=float(lower), upper=float(upper))
+
+
+defprim(
+    "rrelu_p",
+    lambda x, key, *, lower, upper: jnp.where(
+        x >= 0, x, x * jax.random.uniform(key, x.shape, x.dtype, lower, upper)
+    ),
+)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..core import generator
+
+    key = Tensor._from_value(generator.next_key())
+    return apply(
+        "gumbel_softmax_p", ensure_tensor(x), key,
+        temperature=float(temperature), hard=bool(hard), axis=int(axis),
+    )
+
+
+def _gumbel_softmax_fwd(x, key, *, temperature, hard, axis):
+    g = jax.random.gumbel(key, x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.zeros_like(y).at[
+            tuple(
+                idx if d == (axis % y.ndim) else jnp.indices(idx.shape)[d]
+                for d in range(y.ndim)
+            )
+        ].set(1.0)
+        y = jax.lax.stop_gradient(onehot - y) + y
+    return y
+
+
+defprim("gumbel_softmax_p", _gumbel_softmax_fwd)

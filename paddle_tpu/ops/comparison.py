@@ -1,0 +1,120 @@
+"""Comparison, logical, and bitwise ops.
+
+Reference surface: python/paddle/tensor/logic.py + phi compare/bitwise kernels.
+All nondiff (bool/int outputs).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ._helpers import binary_args, defprim, ensure_tensor
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_xor", "logical_not", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "bitwise_not", "is_empty", "searchsorted", "bucketize",
+]
+
+
+def _make_cmp(pub_name, prim_name, fn):
+    defprim(prim_name, fn, nondiff=True)
+
+    def op(x, y, name=None):
+        return apply(prim_name, *binary_args(x, y))
+
+    op.__name__ = pub_name
+    return op
+
+
+equal = _make_cmp("equal", "equal_p", jnp.equal)
+not_equal = _make_cmp("not_equal", "not_equal_p", jnp.not_equal)
+less_than = _make_cmp("less_than", "less_than_p", jnp.less)
+less_equal = _make_cmp("less_equal", "less_equal_p", jnp.less_equal)
+greater_than = _make_cmp("greater_than", "greater_than_p", jnp.greater)
+greater_equal = _make_cmp("greater_equal", "greater_equal_p", jnp.greater_equal)
+logical_and = _make_cmp("logical_and", "logical_and_p", jnp.logical_and)
+logical_or = _make_cmp("logical_or", "logical_or_p", jnp.logical_or)
+logical_xor = _make_cmp("logical_xor", "logical_xor_p", jnp.logical_xor)
+bitwise_and = _make_cmp("bitwise_and", "bitwise_and_p", jnp.bitwise_and)
+bitwise_or = _make_cmp("bitwise_or", "bitwise_or_p", jnp.bitwise_or)
+bitwise_xor = _make_cmp("bitwise_xor", "bitwise_xor_p", jnp.bitwise_xor)
+
+defprim("logical_not_p", jnp.logical_not, nondiff=True)
+defprim("bitwise_not_p", jnp.bitwise_not, nondiff=True)
+
+
+def logical_not(x, name=None):
+    return apply("logical_not_p", ensure_tensor(x))
+
+
+def bitwise_not(x, name=None):
+    return apply("bitwise_not_p", ensure_tensor(x))
+
+
+defprim("equal_all_p", lambda x, y: jnp.array_equal(x, y), nondiff=True)
+
+
+def equal_all(x, y, name=None):
+    return apply("equal_all_p", *binary_args(x, y))
+
+
+defprim(
+    "isclose_p",
+    lambda x, y, *, rtol, atol, equal_nan: jnp.isclose(
+        x, y, rtol=rtol, atol=atol, equal_nan=equal_nan
+    ),
+    nondiff=True,
+)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = binary_args(x, y)
+    return apply(
+        "isclose_p", x, y, rtol=float(rtol), atol=float(atol), equal_nan=bool(equal_nan)
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = binary_args(x, y)
+    return apply(
+        "allclose_p", x, y, rtol=float(rtol), atol=float(atol), equal_nan=bool(equal_nan)
+    )
+
+
+defprim(
+    "allclose_p",
+    lambda x, y, *, rtol, atol, equal_nan: jnp.allclose(
+        x, y, rtol=rtol, atol=atol, equal_nan=equal_nan
+    ),
+    nondiff=True,
+)
+
+
+def is_empty(x, name=None):
+    return Tensor._from_value(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+defprim(
+    "searchsorted_p",
+    lambda a, v, *, right: jnp.searchsorted(
+        a, v, side="right" if right else "left"
+    ).astype(jnp.int64),
+    nondiff=True,
+)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    out = apply(
+        "searchsorted_p",
+        ensure_tensor(sorted_sequence),
+        ensure_tensor(values),
+        right=bool(right),
+    )
+    return out.astype("int32") if out_int32 else out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)

@@ -1,0 +1,378 @@
+"""Tensor creation ops.
+
+Reference surface: python/paddle/tensor/creation.py (to_tensor, zeros, ones,
+full, arange, eye, linspace, tril/triu, meshgrid, diag, ...) and
+python/paddle/tensor/random.py (rand/randn/uniform/normal/randint/randperm/
+bernoulli/multinomial). Random ops take an explicit threefry key input
+(core/generator.py) so VJP-fallback recompute and jit capture stay
+deterministic — the Philox seed+offset analog of phi/core/generator.h.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import generator
+from ..core.dtype import convert_dtype
+from ..core.flags import get_flag
+from ..core.tensor import Tensor, apply
+from ._helpers import defprim, ensure_tensor
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "tril", "triu", "diag", "diagflat", "meshgrid", "assign", "clone",
+    "rand", "randn", "uniform", "normal", "standard_normal", "gaussian",
+    "randint", "randperm", "bernoulli", "multinomial", "one_hot", "tril_indices",
+    "triu_indices", "complex",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item() if isinstance(s, Tensor) else s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    if d is None:
+        d = np.dtype(default or get_flag("default_dtype"))
+    return d
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor parity (creation.py:to_tensor)."""
+    if isinstance(data, Tensor):
+        t = data.astype(dtype) if dtype is not None else data.clone()
+        t.stop_gradient = stop_gradient
+        return t
+    t = ensure_tensor(data, dtype)
+    if place is not None:
+        t = t.to(place)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor._from_value(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor._from_value(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, (bool, np.bool_)):
+            dtype = "bool"
+        elif isinstance(fill_value, numbers.Integral):
+            dtype = "int64"
+        else:
+            dtype = get_flag("default_dtype")
+    return Tensor._from_value(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor._from_value(jnp.zeros(x.shape, _dt(dtype, x.dtype)))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor._from_value(jnp.ones(x.shape, _dt(dtype, x.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor._from_value(jnp.full(x.shape, fill_value, _dt(dtype, x.dtype)))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(end, Tensor):
+        end = end.item()
+    if isinstance(step, Tensor):
+        step = step.item()
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            "int64"
+            if all(isinstance(v, numbers.Integral) for v in (start, end, step))
+            else get_flag("default_dtype")
+        )
+    return Tensor._from_value(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(stop, Tensor):
+        stop = stop.item()
+    if isinstance(num, Tensor):
+        num = int(num.item())
+    return Tensor._from_value(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor._from_value(
+        jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor._from_value(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+_tril = defprim("tril", lambda x, *, diagonal: jnp.tril(x, diagonal))
+_triu = defprim("triu", lambda x, *, diagonal: jnp.triu(x, diagonal))
+
+
+def tril(x, diagonal: int = 0, name=None) -> Tensor:
+    return _tril(ensure_tensor(x), diagonal=int(diagonal))
+
+
+def triu(x, diagonal: int = 0, name=None) -> Tensor:
+    return _triu(ensure_tensor(x), diagonal=int(diagonal))
+
+
+_diag = defprim("diag", lambda x, *, offset: jnp.diag(x, offset))
+
+
+def diag(x, offset: int = 0, padding_value: float = 0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    out = _diag(x, offset=int(offset))
+    if padding_value != 0 and x.ndim == 1:
+        from .math import add, multiply
+
+        n = out.shape[0]
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        fill = jnp.where(mask, 0.0, padding_value).astype(out.dtype)
+        return add(out, Tensor._from_value(fill))
+    return out
+
+
+def diagflat(x, offset: int = 0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    from .manipulation import flatten
+
+    return diag(flatten(x), offset)
+
+
+def meshgrid(*args, name=None):
+    args = [ensure_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*[a._value for a in args], indexing="ij")
+    return [Tensor._from_value(o) for o in outs]
+
+
+_assign = defprim("assign", lambda x: jnp.asarray(x))
+
+
+def assign(x, output=None) -> Tensor:
+    x = ensure_tensor(x)
+    out = _assign(x)
+    if output is not None:
+        output._replace_value(out._value)
+        output._node, output._out_slot = out._node, out._out_slot
+        output.stop_gradient = out.stop_gradient
+        return output
+    return out
+
+
+clone = assign
+
+
+def complex(real, imag, name=None) -> Tensor:
+    from ._helpers import binary_args
+
+    real, imag = binary_args(real, imag)
+    return apply("complex_", real, imag)
+
+
+defprim("complex_", lambda r, i: jax.lax.complex(r, i))
+
+
+# ---------------------------------------------------------------------------
+# random creation (keys from core/generator — RNGStatesTracker streams)
+# ---------------------------------------------------------------------------
+def _key_tensor(name="global_seed") -> Tensor:
+    return Tensor._from_value(generator.next_key(name))
+
+
+defprim(
+    "uniform_p",
+    lambda key, *, shape, dtype, min, max: jax.random.uniform(
+        key, shape, jnp.dtype(dtype), min, max
+    ),
+    nondiff=True,
+)
+defprim(
+    "normal_p",
+    lambda key, *, shape, dtype, mean, std: mean
+    + std * jax.random.normal(key, shape, jnp.dtype(dtype)),
+    nondiff=True,
+)
+defprim(
+    "randint_p",
+    lambda key, *, low, high, shape, dtype: jax.random.randint(
+        key, shape, low, high, jnp.dtype(dtype)
+    ),
+    nondiff=True,
+)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    dt = _dt(dtype)
+    return apply(
+        "uniform_p",
+        _key_tensor(),
+        shape=_shape(shape),
+        dtype=dt.name,
+        min=float(min),
+        max=float(max),
+    )
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = ensure_tensor(mean) if not isinstance(mean, Tensor) else mean
+        shape_ = m.shape if shape is None else _shape(shape)
+        noise = apply(
+            "normal_p", _key_tensor(), shape=tuple(shape_),
+            dtype=np.dtype(m.dtype).name, mean=0.0, std=1.0,
+        )
+        from .math import add, multiply
+
+        return add(multiply(noise, ensure_tensor(std)), m)
+    dt = _dt(None)
+    return apply(
+        "normal_p", _key_tensor(), shape=_shape(shape), dtype=dt.name,
+        mean=float(mean), std=float(std),
+    )
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    dt = _dt(dtype)
+    return apply(
+        "normal_p", _key_tensor(), shape=_shape(shape), dtype=dt.name,
+        mean=0.0, std=1.0,
+    )
+
+
+standard_normal = randn
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None) -> Tensor:
+    dt = _dt(dtype)
+    return apply(
+        "normal_p", _key_tensor(), shape=_shape(shape), dtype=dt.name,
+        mean=float(mean), std=float(std),
+    )
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return apply(
+        "randint_p", _key_tensor(), low=int(low), high=int(high),
+        shape=_shape(shape), dtype=np.dtype(convert_dtype(dtype)).name,
+    )
+
+
+defprim(
+    "randperm_p",
+    lambda key, *, n, dtype: jax.random.permutation(key, n).astype(jnp.dtype(dtype)),
+    nondiff=True,
+)
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return apply("randperm_p", _key_tensor(), n=int(n), dtype=np.dtype(convert_dtype(dtype)).name)
+
+
+defprim(
+    "bernoulli_p",
+    lambda x, key: jax.random.bernoulli(key, x).astype(x.dtype),
+    nondiff=True,
+)
+
+
+def bernoulli(x, name=None) -> Tensor:
+    return apply("bernoulli_p", ensure_tensor(x), _key_tensor())
+
+
+defprim(
+    "multinomial_p",
+    lambda x, key, *, num_samples, replacement: jax.random.categorical(
+        key, jnp.log(jnp.maximum(x, 1e-38)), axis=-1,
+        shape=(*x.shape[:-1], num_samples) if x.ndim > 1 else (num_samples,),
+    ).astype(jnp.int64),
+    nondiff=True,
+)
+
+
+def _multinomial_noreplace_fwd(x, key, *, num_samples):
+    # without-replacement via Gumbel top-k (jax idiom)
+    g = jax.random.gumbel(key, x.shape, jnp.float32)
+    scores = jnp.log(jnp.maximum(x.astype(jnp.float32), 1e-38)) + g
+    _, idx = jax.lax.top_k(scores, num_samples)
+    return idx.astype(jnp.int64)
+
+
+defprim("multinomial_noreplace_p", _multinomial_noreplace_fwd, nondiff=True)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if not replacement and num_samples > 1:
+        return apply(
+            "multinomial_noreplace_p", x, _key_tensor(), num_samples=int(num_samples)
+        )
+    return apply(
+        "multinomial_p", x, _key_tensor(),
+        num_samples=int(num_samples), replacement=bool(replacement),
+    )
+
+
+defprim(
+    "one_hot_p",
+    lambda x, *, num_classes: jax.nn.one_hot(x, num_classes, dtype=jnp.float32),
+    nondiff=True,
+)
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    return apply("one_hot_p", ensure_tensor(x), num_classes=int(num_classes))
+
+
+def tril_indices(row, col, offset=0, dtype="int64") -> Tensor:
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor._from_value(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64") -> Tensor:
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor._from_value(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))

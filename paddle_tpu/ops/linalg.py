@@ -1,0 +1,339 @@
+"""Linear algebra ops.
+
+Reference surface: python/paddle/tensor/linalg.py + phi linalg kernels
+(cholesky, qr, svd, inverse, solve, eigh, norm, einsum).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor, apply
+from ._helpers import axis_tuple, binary_args, defprim, ensure_tensor
+
+__all__ = [
+    "norm", "vector_norm", "matrix_norm", "cholesky", "qr", "svd", "inv",
+    "inverse", "solve", "triangular_solve", "cholesky_solve", "lstsq", "eig",
+    "eigh", "eigvals", "eigvalsh", "det", "slogdet", "matrix_power",
+    "matrix_rank", "pinv", "cond", "cov", "corrcoef", "histogram", "bincount",
+    "einsum", "lu", "householder_product", "multi_dot", "cross", "dist",
+]
+
+
+defprim(
+    "p_norm",
+    lambda x, *, p, axis, keepdim: _pnorm(x, p, axis, keepdim),
+)
+
+
+def _pnorm(x, p, axis, keepdim):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    if p == 2:
+        return jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdim))
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p
+    )
+
+
+defprim(
+    "fro_norm",
+    lambda x, *, axis, keepdim: jnp.sqrt(
+        jnp.sum(x * x, axis=axis, keepdims=keepdim)
+    ),
+)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    if p is None:
+        p = "fro" if (axis is None or isinstance(axis, (list, tuple))) else 2
+    if isinstance(p, str):
+        if p == "fro":
+            ax = axis_tuple(axis, x.ndim)
+            return apply("fro_norm", x, axis=ax, keepdim=bool(keepdim))
+        if p == "nuc":
+            return apply("nuc_norm", x, axis=axis_tuple(axis, x.ndim), keepdim=bool(keepdim))
+        raise ValueError(p)
+    ax = axis_tuple(axis, x.ndim)
+    if ax is not None and len(ax) == 1:
+        ax = ax[0]
+    return apply("p_norm", x, p=float(p), axis=ax, keepdim=bool(keepdim))
+
+
+def _nuc_fwd(x, *, axis, keepdim):
+    s = jnp.linalg.svd(x, compute_uv=False)
+    return jnp.sum(s, axis=-1, keepdims=keepdim)
+
+
+defprim("nuc_norm", _nuc_fwd)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=[-2, -1], keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def dist(x, y, p=2, name=None):
+    from .math import subtract
+
+    return norm(subtract(x, y), p=float(p))
+
+
+def _simple(name, fn, multi_out=False, nondiff=False, jittable=True):
+    defprim(name, fn, multi_out=multi_out, nondiff=nondiff, jittable=jittable)
+
+    def op(x, name=None):
+        return apply(name, ensure_tensor(x))
+
+    op.__name__ = name
+    return op
+
+
+cholesky_ = defprim("cholesky_p", lambda x, *, upper: jnp.linalg.cholesky(x) if not upper else jnp.swapaxes(jnp.linalg.cholesky(x), -1, -2).conj())
+
+
+def cholesky(x, upper=False, name=None):
+    return apply("cholesky_p", ensure_tensor(x), upper=bool(upper))
+
+
+defprim(
+    "qr_p",
+    lambda x, *, mode: jnp.linalg.qr(x, mode=mode),
+    multi_out=True,
+)
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        r = jnp.linalg.qr(np.asarray(ensure_tensor(x)._value), mode="r")
+        return Tensor._from_value(r)
+    return apply("qr_p", ensure_tensor(x), mode=mode)
+
+
+defprim(
+    "svd_p",
+    lambda x, *, full_matrices: jnp.linalg.svd(x, full_matrices=full_matrices),
+    multi_out=True,
+)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply("svd_p", ensure_tensor(x), full_matrices=bool(full_matrices))
+
+
+inv = _simple("inverse_p", jnp.linalg.inv)
+inverse = inv
+
+
+def solve(x, y, name=None):
+    return apply("solve_p", *binary_args(x, y))
+
+
+defprim("solve_p", jnp.linalg.solve)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    x, y = binary_args(x, y)
+    return apply(
+        "triangular_solve_p", x, y, upper=bool(upper), transpose=bool(transpose),
+        unitriangular=bool(unitriangular),
+    )
+
+
+defprim(
+    "triangular_solve_p",
+    lambda x, y, *, upper, transpose, unitriangular: jax.scipy.linalg.solve_triangular(
+        x, y, trans=1 if transpose else 0, lower=not upper, unit_diagonal=unitriangular
+    ),
+)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = binary_args(x, y)
+    return apply("cholesky_solve_p", x, y, upper=bool(upper))
+
+
+defprim(
+    "cholesky_solve_p",
+    lambda b, chol, *, upper: jax.scipy.linalg.cho_solve((chol, not upper), b),
+)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = binary_args(x, y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x._value, y._value, rcond=rcond)
+    return (
+        Tensor._from_value(sol),
+        Tensor._from_value(res),
+        Tensor._from_value(rank),
+        Tensor._from_value(sv),
+    )
+
+
+det = _simple("det_p", jnp.linalg.det)
+
+
+def slogdet(x, name=None):
+    return apply("slogdet_p", ensure_tensor(x))
+
+
+defprim(
+    "slogdet_p",
+    lambda x: tuple(jnp.linalg.slogdet(x)),
+    multi_out=True,
+)
+
+
+def eig(x, name=None):
+    xv = np.asarray(ensure_tensor(x)._value)
+    w, v = np.linalg.eig(xv)
+    return Tensor._from_value(jnp.asarray(w)), Tensor._from_value(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    xv = np.asarray(ensure_tensor(x)._value)
+    return Tensor._from_value(jnp.asarray(np.linalg.eigvals(xv)))
+
+
+defprim("eigh_p", lambda x, *, UPLO: jnp.linalg.eigh(x, UPLO=UPLO), multi_out=True)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh_p", ensure_tensor(x), UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh_p", ensure_tensor(x), UPLO=UPLO)
+
+
+defprim("eigvalsh_p", lambda x, *, UPLO: jnp.linalg.eigvalsh(x, UPLO=UPLO))
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power_p", ensure_tensor(x), n=int(n))
+
+
+defprim("matrix_power_p", lambda x, *, n: jnp.linalg.matrix_power(x, n))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor._from_value(
+        jnp.linalg.matrix_rank(ensure_tensor(x)._value, rtol=tol)
+    )
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv_p", ensure_tensor(x), rcond=float(rcond), hermitian=bool(hermitian))
+
+
+defprim(
+    "pinv_p",
+    lambda x, *, rcond, hermitian: jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian),
+)
+
+
+def cond(x, p=None, name=None):
+    return Tensor._from_value(jnp.linalg.cond(ensure_tensor(x)._value, p=p))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = ensure_tensor(x)
+    fw = None if fweights is None else np.asarray(ensure_tensor(fweights)._value)
+    aw = None if aweights is None else np.asarray(ensure_tensor(aweights)._value)
+    return Tensor._from_value(
+        jnp.cov(x._value, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw)
+    )
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor._from_value(jnp.corrcoef(ensure_tensor(x)._value, rowvar=rowvar))
+
+
+defprim(
+    "histogram_p",
+    lambda x, *, bins, min, max: jnp.histogram(
+        x, bins=bins, range=(min, max) if (min != 0 or max != 0) else None
+    )[0].astype(jnp.int64),
+    nondiff=True,
+)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    return apply(
+        "histogram_p", ensure_tensor(input), bins=int(bins), min=float(min), max=float(max)
+    )
+
+
+defprim(
+    "bincount_p",
+    lambda x, *, minlength, length: jnp.bincount(x, minlength=minlength, length=length),
+    nondiff=True,
+)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    n = int(np.asarray(x._value).max()) + 1 if x.size else 0
+    length = max(n, minlength)
+    if weights is not None:
+        w = ensure_tensor(weights)
+        return Tensor._from_value(
+            jnp.bincount(x._value, weights=w._value, length=length)
+        )
+    return apply("bincount_p", x, minlength=int(minlength), length=length)
+
+
+def einsum(equation, *operands, name=None):
+    ts = [ensure_tensor(t) for t in operands]
+    name_p = f"einsum_{len(ts)}"
+    if name_p not in dispatch.PRIMITIVES:
+        dispatch.register_primitive(
+            name_p, lambda *xs, equation: jnp.einsum(equation, *xs)
+        )
+    return apply(name_p, *ts, equation=equation)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(np.asarray(ensure_tensor(x)._value))
+    outs = (Tensor._from_value(jnp.asarray(lu_)), Tensor._from_value(jnp.asarray(piv + 1)))
+    if get_infos:
+        return (*outs, Tensor._from_value(jnp.zeros((), jnp.int32)))
+    return outs
+
+
+def householder_product(x, tau, name=None):
+    xv = np.asarray(ensure_tensor(x)._value)
+    tv = np.asarray(ensure_tensor(tau)._value)
+    import scipy.linalg as sla
+
+    q = sla.lapack.dorgqr(xv.astype(np.float64), tv.astype(np.float64))[0]
+    return Tensor._from_value(jnp.asarray(q.astype(xv.dtype)))
+
+
+def multi_dot(x, name=None):
+    from .math import matmul
+
+    out = x[0]
+    for m in x[1:]:
+        out = matmul(out, m)
+    return out
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = binary_args(x, y)
+    if axis == 9:
+        axis = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+    return apply("cross_p", x, y, axis=int(axis))
+
+
+defprim("cross_p", lambda x, y, *, axis: jnp.cross(x, y, axis=axis))

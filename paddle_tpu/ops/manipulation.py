@@ -1,0 +1,1005 @@
+"""Shape manipulation + indexing ops.
+
+Reference surface: python/paddle/tensor/manipulation.py (reshape, transpose,
+concat, split, gather, scatter, tile, expand, flip, roll, pad, ...) and the
+stride/view kernels (phi/kernels/stride/). jax arrays are immutable, so
+"views" are value-level ops XLA turns into free layout changes; __setitem__
+is functionalized through scatter (the reference's set_value op).
+"""
+from __future__ import annotations
+
+import builtins
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor, apply
+from ._helpers import axis_tuple, binary_args, defprim, ensure_tensor
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "flatten", "squeeze", "unsqueeze",
+    "squeeze_", "unsqueeze_", "concat", "stack", "split", "chunk", "unbind",
+    "tile", "expand", "expand_as", "broadcast_to", "flip", "rot90", "roll",
+    "gather", "gather_nd", "scatter", "scatter_nd_add", "index_select",
+    "index_sample", "index_add", "index_put", "take_along_axis",
+    "put_along_axis", "masked_select", "masked_fill", "where", "nonzero",
+    "topk", "sort", "argsort", "argmax", "argmin", "unique", "unique_consecutive",
+    "numel", "shape", "pad", "strided_slice", "slice", "crop", "tensordot",
+    "moveaxis", "swapaxes", "as_complex", "as_real", "repeat_interleave",
+    "diagonal", "t", "atleast_1d", "atleast_2d", "atleast_3d", "view",
+    "tensor_split", "hsplit", "vsplit", "dsplit", "diag_embed",
+]
+
+
+# ---------------------------------------------------------------------------
+defprim("reshape_p", lambda x, *, shape: jnp.reshape(x, shape))
+
+
+def _infer_shape(x, shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            s = int(s.item())
+        out.append(int(s))
+    # paddle semantics: 0 means "copy this dim from input"
+    for i, s in enumerate(out):
+        if s == 0 and i < x.ndim:
+            out[i] = x.shape[i]
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    return apply("reshape_p", x, shape=_infer_shape(x, shape))
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._replace_value(out._value)
+    x._node, x._out_slot, x.stop_gradient = out._node, out._out_slot, out.stop_gradient
+    return x
+
+
+view = reshape
+
+
+defprim("transpose_p", lambda x, *, perm: jnp.transpose(x, perm))
+
+
+def transpose(x, perm, name=None):
+    x = ensure_tensor(x)
+    perm = tuple(int(p) % x.ndim for p in perm)
+    return apply("transpose_p", x, perm=perm)
+
+
+def t(x, name=None):
+    x = ensure_tensor(x)
+    if x.ndim < 2:
+        return x
+    return transpose(x, list(range(x.ndim - 2)) + [x.ndim - 1, x.ndim - 2])
+
+
+def moveaxis(x, source, destination, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "moveaxis_p",
+        x,
+        source=tuple(np.atleast_1d(source).tolist()),
+        destination=tuple(np.atleast_1d(destination).tolist()),
+    )
+
+
+defprim(
+    "moveaxis_p", lambda x, *, source, destination: jnp.moveaxis(x, source, destination)
+)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    x = ensure_tensor(x)
+    perm = list(range(x.ndim))
+    a1, a2 = axis1 % x.ndim, axis2 % x.ndim
+    perm[a1], perm[a2] = perm[a2], perm[a1]
+    return transpose(x, perm)
+
+
+swapdims = swapaxes
+
+
+defprim(
+    "flatten_p",
+    lambda x, *, start, stop: jnp.reshape(
+        x,
+        x.shape[:start]
+        + (int(np.prod(x.shape[start : stop + 1]) or 1),)
+        + x.shape[stop + 1 :],
+    ),
+)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    if x.ndim == 0:
+        return reshape(x, [1])
+    start = start_axis % x.ndim
+    stop = stop_axis % x.ndim
+    return apply("flatten_p", x, start=start, stop=stop)
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        ax = tuple(i for i, s in enumerate(x.shape) if s == 1)
+    else:
+        ax = axis_tuple(axis, x.ndim)
+        ax = tuple(a for a in ax if x.shape[a] == 1)
+    return apply("squeeze_p", x, axis=ax)
+
+
+defprim("squeeze_p", lambda x, *, axis: jnp.squeeze(x, axis) if axis else x)
+
+
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, (int, np.integer)):
+        axis = [int(axis)]
+    elif isinstance(axis, Tensor):
+        axis = [int(a) for a in axis.tolist()]
+    ndim_out = x.ndim + len(axis)
+    ax = tuple(sorted(int(a) % ndim_out for a in axis))
+    return apply("unsqueeze_p", x, axis=ax)
+
+
+defprim("unsqueeze_p", lambda x, *, axis: jnp.expand_dims(x, axis))
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._replace_value(out._value)
+    x._node, x._out_slot, x.stop_gradient = out._node, out._out_slot, out.stop_gradient
+    return x
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._replace_value(out._value)
+    x._node, x._out_slot, x.stop_gradient = out._node, out._out_slot, out.stop_gradient
+    return x
+
+
+# ---------------------------------------------------------------------------
+# concat / stack / split — variadic prims registered per-arity
+# ---------------------------------------------------------------------------
+def _variadic(base, fn_builder, n, **static):
+    name = f"{base}_{n}"
+    if name not in dispatch.PRIMITIVES:
+        dispatch.register_primitive(name, fn_builder(n))
+    return name
+
+
+def concat(x, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    if len(ts) == 1:
+        return ts[0]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    # promote to common dtype
+    common = ts[0].dtype
+    for t_ in ts[1:]:
+        common = jnp.promote_types(common, t_.dtype)
+    from .math import cast
+
+    ts = [cast(t_, common) for t_ in ts]
+    name_p = _variadic(
+        "concat", lambda n: (lambda *xs, axis: jnp.concatenate(xs, axis=axis)), len(ts)
+    )
+    return apply(name_p, *ts, axis=int(axis) % ts[0].ndim if ts[0].ndim else 0)
+
+
+def stack(x, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    name_p = _variadic(
+        "stack", lambda n: (lambda *xs, axis: jnp.stack(xs, axis=axis)), len(ts)
+    )
+    return apply(name_p, *ts, axis=int(axis))
+
+
+def _split_sections(x, num_or_sections, axis):
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, (int, np.integer)):
+        n = int(num_or_sections)
+        size = dim // n
+        return [size] * n
+    secs = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+    if -1 in secs:
+        known = sum(s for s in secs if s != -1)
+        secs[secs.index(-1)] = dim - known
+    return secs
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis) % x.ndim
+    secs = tuple(_split_sections(x, num_or_sections, axis))
+    name_p = f"split_{len(secs)}"
+    if name_p not in dispatch.PRIMITIVES:
+        n_out = len(secs)
+
+        def fwd(x, *, sections, axis):
+            idx = np.cumsum(sections[:-1]).tolist()
+            return tuple(jnp.split(x, idx, axis=axis))
+
+        dispatch.register_primitive(name_p, fwd, multi_out=True)
+    return list(apply(name_p, x, sections=secs, axis=axis))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = ensure_tensor(x)
+    axis = int(axis) % x.ndim
+    dim = x.shape[axis]
+    if isinstance(num_or_indices, (int, np.integer)):
+        n = int(num_or_indices)
+        base, rem = divmod(dim, n)
+        secs = [base + (1 if i < rem else 0) for i in range(n)]
+    else:
+        idx = [0] + list(num_or_indices) + [dim]
+        secs = [idx[i + 1] - idx[i] for i in range(len(idx) - 1)]
+    return split(x, secs, axis)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if ensure_tensor(x).ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def unbind(x, axis=0, name=None):
+    x = ensure_tensor(x)
+    axis = int(axis) % x.ndim
+    outs = split(x, x.shape[axis], axis)
+    return [squeeze(o, axis) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# broadcast / tile / flip / roll / pad
+# ---------------------------------------------------------------------------
+defprim("tile_p", lambda x, *, reps: jnp.tile(x, reps))
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    return apply(
+        "tile_p", ensure_tensor(x), reps=tuple(int(r) for r in repeat_times)
+    )
+
+
+defprim("broadcast_to_p", lambda x, *, shape: jnp.broadcast_to(x, shape))
+
+
+def broadcast_to(x, shape, name=None):
+    x = ensure_tensor(x)
+    return apply("broadcast_to_p", x, shape=_infer_shape(x, shape))
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    shape = _infer_shape(x, shape)
+    # paddle expand: -1 keeps dim
+    full = []
+    offset = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            full.append(x.shape[i - offset] if i >= offset else 1)
+        else:
+            full.append(s)
+    return apply("broadcast_to_p", x, shape=tuple(full))
+
+
+def expand_as(x, y, name=None):
+    return expand(x, ensure_tensor(y).shape)
+
+
+defprim("flip_p", lambda x, *, axis: jnp.flip(x, axis))
+
+
+def flip(x, axis, name=None):
+    x = ensure_tensor(x)
+    return apply("flip_p", x, axis=axis_tuple(axis, x.ndim))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90_p", ensure_tensor(x), k=int(k), axes=tuple(axes))
+
+
+defprim("rot90_p", lambda x, *, k, axes: jnp.rot90(x, k, axes))
+
+
+defprim("roll_p", lambda x, *, shifts, axis: jnp.roll(x, shifts, axis))
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shifts, Tensor):
+        shifts = shifts.tolist()
+    shifts = tuple(np.atleast_1d(shifts).tolist())
+    ax = axis_tuple(axis, x.ndim) if axis is not None else None
+    if ax is None:
+        return apply(
+            "roll_flat_p", x, shifts=int(np.sum(shifts)), shape=tuple(x.shape)
+        )
+    return apply("roll_p", x, shifts=shifts, axis=ax)
+
+
+defprim(
+    "roll_flat_p",
+    lambda x, *, shifts, shape: jnp.roll(x.reshape(-1), shifts).reshape(shape),
+)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad semantics (nn/functional/common.py:pad)."""
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-form: [before0, after0, before1, after1, ...] paddle uses
+        # per-dim pairs in dim order
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial form applies to trailing spatial dims (NCHW/NCL/NCDHW)
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format.endswith("C"):  # NHWC-style: spatial dims precede C
+            spatial_dims = list(range(1, 1 + n_spatial))
+        else:
+            spatial_dims = list(range(nd - n_spatial, nd))
+        # paddle's flat pad list is reversed-last-dim-first like torch
+        for i, d in enumerate(reversed(spatial_dims)):
+            widths[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return apply(
+        "pad_p", x, widths=tuple(widths), mode=jmode, value=float(value)
+    )
+
+
+def _pad_fwd(x, *, widths, mode, value):
+    if mode == "constant":
+        return jnp.pad(x, widths, mode=mode, constant_values=value)
+    return jnp.pad(x, widths, mode=mode)
+
+
+defprim("pad_p", _pad_fwd)
+
+
+# ---------------------------------------------------------------------------
+# gather/scatter family
+# ---------------------------------------------------------------------------
+defprim(
+    "gather_p",
+    lambda x, index, *, axis: jnp.take(x, index.astype(jnp.int32), axis=axis),
+)
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = squeeze(index, 1)
+    return apply("gather_p", x, index, axis=int(axis) % x.ndim)
+
+
+def _gather_nd_fwd(x, index):
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    return x[idx]
+
+
+defprim("gather_nd_p", _gather_nd_fwd)
+
+
+def gather_nd(x, index, name=None):
+    return apply("gather_nd_p", ensure_tensor(x), ensure_tensor(index))
+
+
+def _scatter_fwd(x, index, updates, *, overwrite):
+    idx = index.astype(jnp.int32)
+    if idx.ndim == 2 and idx.shape[-1] == 1:
+        idx = idx[:, 0]
+    if overwrite:
+        return x.at[idx].set(updates)
+    # paddle: non-overwrite means zero-out then add (accumulate duplicates)
+    zeroed = x.at[idx].set(jnp.zeros_like(updates))
+    return zeroed.at[idx].add(updates)
+
+
+defprim("scatter_p", _scatter_fwd)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return apply(
+        "scatter_p",
+        ensure_tensor(x),
+        ensure_tensor(index),
+        ensure_tensor(updates),
+        overwrite=bool(overwrite),
+    )
+
+
+def _scatter_nd_add_fwd(x, index, updates):
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    return x.at[idx].add(updates)
+
+
+defprim("scatter_nd_add_p", _scatter_nd_add_fwd)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return apply(
+        "scatter_nd_add_p", ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+    )
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    updates = ensure_tensor(updates)
+    return scatter_nd_add(zeros(shape, updates.dtype), index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+defprim(
+    "index_sample_p",
+    lambda x, index: jnp.take_along_axis(x, index.astype(jnp.int32), axis=1),
+)
+
+
+def index_sample(x, index):
+    return apply("index_sample_p", ensure_tensor(x), ensure_tensor(index))
+
+
+def _index_add_fwd(x, index, value, *, axis):
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index.astype(jnp.int32)].add(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+defprim("index_add_p", _index_add_fwd)
+
+
+def index_add(x, index, axis, value, name=None):
+    return apply(
+        "index_add_p", ensure_tensor(x), ensure_tensor(index), ensure_tensor(value),
+        axis=int(axis),
+    )
+
+
+def _index_put_fwd(x, v, *index_arrays, accumulate):
+    idx = tuple(a.astype(jnp.int32) for a in index_arrays)
+    if accumulate:
+        return x.at[idx].add(v.astype(x.dtype))
+    return x.at[idx].set(v.astype(x.dtype))
+
+
+defprim("index_put_p", _index_put_fwd)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    v = ensure_tensor(value, dtype=x.dtype)
+    idx = [ensure_tensor(i) for i in indices]
+    return apply("index_put_p", x, v, *idx, accumulate=bool(accumulate))
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    out = index_put(x, indices, value, accumulate)
+    x._replace_value(out._value)
+    x._node, x._out_slot, x.stop_gradient = out._node, out._out_slot, out.stop_gradient
+    return x
+
+
+defprim(
+    "take_along_axis_p",
+    lambda x, index, *, axis: jnp.take_along_axis(
+        x, index.astype(jnp.int32), axis=axis
+    ),
+)
+
+
+def take_along_axis(x, indices, axis, broadcast=True, name=None):
+    x, indices = ensure_tensor(x), ensure_tensor(indices)
+    return apply("take_along_axis_p", x, indices, axis=int(axis) % x.ndim)
+
+
+def _put_along_axis_fwd(x, index, value, *, axis, reduce):
+    idx = index.astype(jnp.int32)
+    value = jnp.broadcast_to(value, idx.shape).astype(x.dtype)
+    dims = list(range(x.ndim))
+    ii = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    full_idx = tuple(idx if d == axis else ii[d] for d in dims)
+    if reduce == "assign":
+        return x.at[full_idx].set(value)
+    if reduce == "add":
+        return x.at[full_idx].add(value)
+    if reduce == "multiply":
+        return x.at[full_idx].multiply(value)
+    raise ValueError(reduce)
+
+
+defprim("put_along_axis_p", _put_along_axis_fwd)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None, **kw):
+    x = ensure_tensor(x)
+    v = ensure_tensor(values, dtype=x.dtype)
+    return apply(
+        "put_along_axis_p", x, ensure_tensor(indices), v,
+        axis=int(axis) % x.ndim, reduce=reduce,
+    )
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    v = ensure_tensor(value, dtype=x.dtype)
+    return apply("masked_fill_p", x, mask, v)
+
+
+defprim(
+    "masked_fill_p",
+    lambda x, mask, v: jnp.where(mask, v.astype(x.dtype), x),
+)
+
+
+def masked_select(x, mask, name=None):
+    """Dynamic-shape op: returns a 1-D tensor of selected elements. Executes
+    eagerly un-jitted (XLA needs static shapes; reference equivalent is a
+    dynamic-output kernel)."""
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    xv, mv = np.asarray(x._value), np.asarray(mask._value)
+    mv = np.broadcast_to(mv, xv.shape)
+    return Tensor._from_value(jnp.asarray(xv[mv]))
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x, y = binary_args(x, y)
+    return apply("where_p", condition, x, y)
+
+
+defprim(
+    "where_p",
+    lambda c, x, y: jnp.where(c, x, y),
+    vjp=lambda g, saved, **kw: (
+        None,
+        jnp.where(saved[0], g[0], 0).reshape(saved[1]) if False else _where_gx(g[0], saved),
+        _where_gy(g[0], saved),
+    ),
+    save=lambda ins, outs: (ins[0], ins[1].shape, ins[2].shape),
+)
+
+
+def _where_gx(g, saved):
+    from .math import _unbcast
+
+    c, xs, ys = saved
+    return _unbcast(jnp.where(c, g, 0), xs)
+
+
+def _where_gy(g, saved):
+    from .math import _unbcast
+
+    c, xs, ys = saved
+    return _unbcast(jnp.where(c, 0, g), ys)
+
+
+def nonzero(x, as_tuple=False):
+    """Dynamic-shape op — eager only (see masked_select note)."""
+    x = ensure_tensor(x)
+    nz = np.nonzero(np.asarray(x._value))
+    if as_tuple:
+        return tuple(Tensor._from_value(jnp.asarray(i[:, None])) for i in nz)
+    return Tensor._from_value(jnp.asarray(np.stack(nz, axis=1)))
+
+
+# ---------------------------------------------------------------------------
+# search / sort
+# ---------------------------------------------------------------------------
+defprim(
+    "topk_p",
+    lambda x, *, k, axis, largest: _topk_impl(x, k, axis, largest),
+    multi_out=True,
+)
+
+
+def _topk_impl(x, k, axis, largest):
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(xm if largest else -xm, k)
+    if not largest:
+        vals = -vals
+    return (
+        jnp.moveaxis(vals, -1, axis),
+        jnp.moveaxis(idx.astype(jnp.int64), -1, axis),
+    )
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    return apply(
+        "topk_p", x, k=int(k), axis=int(axis) % x.ndim, largest=bool(largest)
+    )
+
+
+defprim(
+    "sort_p",
+    lambda x, *, axis, descending: (
+        -jnp.sort(-x, axis=axis) if descending else jnp.sort(x, axis=axis)
+    ),
+)
+defprim(
+    "argsort_p",
+    lambda x, *, axis, descending: (
+        jnp.argsort(-x, axis=axis) if descending else jnp.argsort(x, axis=axis)
+    ).astype(jnp.int64),
+    nondiff=True,
+)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    x = ensure_tensor(x)
+    return apply("sort_p", x, axis=int(axis) % x.ndim, descending=bool(descending))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    x = ensure_tensor(x)
+    return apply("argsort_p", x, axis=int(axis) % x.ndim, descending=bool(descending))
+
+
+defprim(
+    "argmax_p",
+    lambda x, *, axis, keepdim, dtype: jnp.argmax(x, axis=axis, keepdims=keepdim).astype(
+        jnp.dtype(dtype)
+    ),
+    nondiff=True,
+)
+defprim(
+    "argmin_p",
+    lambda x, *, axis, keepdim, dtype: jnp.argmin(x, axis=axis, keepdims=keepdim).astype(
+        jnp.dtype(dtype)
+    ),
+    nondiff=True,
+)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "argmax_p", x, axis=int(axis) if axis is not None else None,
+        keepdim=bool(keepdim), dtype=np.dtype(dtype).name,
+    )
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "argmin_p", x, axis=int(axis) if axis is not None else None,
+        keepdim=bool(keepdim), dtype=np.dtype(dtype).name,
+    )
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    """Dynamic-shape op — eager only."""
+    x = ensure_tensor(x)
+    res = np.unique(
+        np.asarray(x._value), return_index=return_index,
+        return_inverse=return_inverse, return_counts=return_counts, axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor._from_value(jnp.asarray(res))
+    return tuple(Tensor._from_value(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._value)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+        out = arr[keep]
+        outs = [Tensor._from_value(jnp.asarray(out))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(Tensor._from_value(jnp.asarray(inv)))
+        if return_counts:
+            idx = np.nonzero(keep)[0]
+            counts = np.diff(np.append(idx, arr.size))
+            outs.append(Tensor._from_value(jnp.asarray(counts)))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+def numel(x, name=None):
+    return Tensor._from_value(jnp.asarray(ensure_tensor(x).size, jnp.int64))
+
+
+def shape(x):
+    return Tensor._from_value(jnp.asarray(ensure_tensor(x).shape, jnp.int32))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(
+        "diagonal_p", ensure_tensor(x), offset=int(offset),
+        axis1=int(axis1), axis2=int(axis2),
+    )
+
+
+defprim(
+    "diagonal_p",
+    lambda x, *, offset, axis1, axis2: jnp.diagonal(x, offset, axis1, axis2),
+)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    x = ensure_tensor(x)
+    return apply("diag_embed_p", x, offset=int(offset), dim1=int(dim1), dim2=int(dim2))
+
+
+def _diag_embed_fwd(x, *, offset, dim1, dim2):
+    n = x.shape[-1] + builtins.abs(offset)
+    out = jnp.zeros(x.shape + (n,), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    rows = idx + builtins.max(-offset, 0)
+    cols = idx + builtins.max(offset, 0)
+    out = out.at[..., rows, cols].set(x)
+    # move the two result dims to dim1/dim2
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    rest = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+    order = []
+    src = {d1: nd - 2, d2: nd - 1}
+    it = iter(rest)
+    for i in range(nd):
+        order.append(src[i] if i in src else next(it))
+    return jnp.transpose(out, order)
+
+
+defprim("diag_embed_p", _diag_embed_fwd)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        x = flatten(x)
+        axis = 0
+    if isinstance(repeats, Tensor):
+        return apply(
+            "repeat_interleave_t_p", x, repeats, axis=int(axis) % x.ndim
+        )
+    return apply(
+        "repeat_interleave_p", x, repeats=int(repeats), axis=int(axis) % x.ndim
+    )
+
+
+defprim(
+    "repeat_interleave_p",
+    lambda x, *, repeats, axis: jnp.repeat(x, repeats, axis=axis),
+)
+defprim(
+    "repeat_interleave_t_p",
+    lambda x, r, *, axis: jnp.repeat(
+        x, r, axis=axis, total_repeat_length=int(np.asarray(r).sum())
+    ),
+    jittable=False,
+)
+
+
+def as_complex(x, name=None):
+    return apply("as_complex_p", ensure_tensor(x))
+
+
+defprim(
+    "as_complex_p", lambda x: jax.lax.complex(x[..., 0], x[..., 1])
+)
+
+
+def as_real(x, name=None):
+    return apply("as_real_p", ensure_tensor(x))
+
+
+defprim(
+    "as_real_p", lambda x: jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [reshape(t, [1]) if ensure_tensor(t).ndim == 0 else ensure_tensor(t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = []
+    for t in inputs:
+        t = ensure_tensor(t)
+        while t.ndim < 2:
+            t = unsqueeze(t, 0)
+        outs.append(t)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = []
+    for t in inputs:
+        t = ensure_tensor(t)
+        while t.ndim < 3:
+            t = unsqueeze(t, t.ndim)
+        outs.append(t)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    shape = _infer_shape(x, shape) if shape is not None else tuple(x.shape)
+    offsets = tuple(int(o) for o in (offsets or [0] * x.ndim))
+    slices = tuple(builtins.slice(o, o + s) for o, s in zip(offsets, shape))
+    return _getitem(x, slices)
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = binary_args(x, y)
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else (a,) for a in axes)
+    return apply("tensordot_p", x, y, axes=axes if isinstance(axes, int) else tuple(map(tuple, axes)))
+
+
+defprim("tensordot_p", lambda x, y, *, axes: jnp.tensordot(x, y, axes))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+    idx = [builtins.slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = builtins.slice(int(s), int(e), int(st))
+    return _getitem(x, tuple(idx))
+
+
+def slice(x, axes, starts, ends):
+    x = ensure_tensor(x)
+    idx = [builtins.slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        s = int(s.item()) if isinstance(s, Tensor) else int(s)
+        e = int(e.item()) if isinstance(e, Tensor) else int(e)
+        idx[a] = builtins.slice(s, e)
+    return _getitem(x, tuple(idx))
+
+
+# ---------------------------------------------------------------------------
+# __getitem__ / __setitem__  (reference: pybind slice_utils.h, set_value op)
+# ---------------------------------------------------------------------------
+def _encode_index(idx):
+    """Encode an index tuple into a hashable static key + list of tensor
+    operands. Tensors in the index become operands (advanced indexing)."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    static = []
+    operands = []
+    for it in idx:
+        if isinstance(it, Tensor):
+            static.append(("t", len(operands)))
+            operands.append(it)
+        elif isinstance(it, (np.ndarray, list)):
+            arr = np.asarray(it)
+            if arr.dtype == object:
+                raise TypeError("ragged index")
+            t = Tensor._from_value(jnp.asarray(arr))
+            static.append(("t", len(operands)))
+            operands.append(t)
+        elif isinstance(it, builtins.slice):
+            static.append(("s", it.start, it.stop, it.step))
+        elif it is None:
+            static.append(("n",))
+        elif it is Ellipsis:
+            static.append(("e",))
+        elif isinstance(it, (int, np.integer)):
+            static.append(("i", int(it)))
+        elif isinstance(it, (bool, np.bool_)):
+            static.append(("b", bool(it)))
+        else:
+            raise TypeError(f"unsupported index: {it!r}")
+    return tuple(static), operands
+
+
+def _decode_index(static, arrays):
+    out = []
+    for item in static:
+        kind = item[0]
+        if kind == "t":
+            a = arrays[item[1]]
+            out.append(a.astype(jnp.int32) if jnp.issubdtype(a.dtype, jnp.integer) else a)
+        elif kind == "s":
+            out.append(builtins.slice(item[1], item[2], item[3]))
+        elif kind == "n":
+            out.append(None)
+        elif kind == "e":
+            out.append(Ellipsis)
+        elif kind == "i":
+            out.append(item[1])
+        elif kind == "b":
+            out.append(item[1])
+    return tuple(out)
+
+
+def _getitem_fwd(x, *index_arrays, static_idx):
+    return x[_decode_index(static_idx, index_arrays)]
+
+
+defprim("getitem_p", _getitem_fwd)
+
+
+def _getitem(x, idx):
+    # bool-mask fancy indexing produces dynamic shapes → eager numpy path
+    def _has_bool_mask(i):
+        items = i if isinstance(i, tuple) else (i,)
+        for it in items:
+            if isinstance(it, Tensor) and np.dtype(it.dtype) == np.dtype(bool):
+                return True
+            if isinstance(it, np.ndarray) and it.dtype == np.bool_:
+                return True
+        return False
+
+    if _has_bool_mask(idx):
+        items = idx if isinstance(idx, tuple) else (idx,)
+        np_idx = tuple(
+            np.asarray(it._value) if isinstance(it, Tensor) else it for it in items
+        )
+        return Tensor._from_value(jnp.asarray(np.asarray(x._value)[np_idx]))
+    static, operands = _encode_index(idx)
+    return apply("getitem_p", x, *operands, static_idx=static)
+
+
+def _setitem_fwd(x, v, *index_arrays, static_idx):
+    return x.at[_decode_index(static_idx, index_arrays)].set(v.astype(x.dtype))
+
+
+defprim("setitem_p", _setitem_fwd)
+
+
+def _setitem(x, idx, value):
+    v = ensure_tensor(value, dtype=x.dtype)
+    static, operands = _encode_index(idx)
+    out = apply("setitem_p", x, v, *operands, static_idx=static)
+    x._replace_value(out._value)
+    x._node, x._out_slot, x.stop_gradient = out._node, out._out_slot, out.stop_gradient
+    return x

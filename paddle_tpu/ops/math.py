@@ -1,0 +1,693 @@
+"""Elementwise math, reductions, matmul.
+
+Reference surface: python/paddle/tensor/math.py (~7k LoC of op wrappers over
+PHI kernels phi/kernels/elementwise_*, reduce_*, matmul_kernel). Forward =
+jnp; backward = explicit VJP where saving-inputs beats recompute, else the
+fused jax.vjp fallback (dispatch.py) which XLA DCEs/fuses.
+
+Broadcasting VJP note: binary ops reduce grads back over broadcast axes
+(the reference does this inside elementwise_grad kernels).
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, apply
+from ._helpers import axis_tuple, binary_args, defprim, ensure_tensor
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "float_power", "maximum", "minimum", "fmax", "fmin", "atan2",
+    "scale", "neg", "abs", "sqrt", "rsqrt", "square", "exp", "expm1", "log",
+    "log2", "log10", "log1p", "sin", "cos", "tan", "asin", "acos", "atan",
+    "sinh", "cosh", "asinh", "acosh", "atanh", "floor", "ceil", "round",
+    "trunc", "frac", "sign", "reciprocal", "clip", "erf", "erfinv", "lerp",
+    "lgamma", "digamma", "cast", "add_n", "cumsum", "cumprod", "cummax", "cummin",
+    "logcumsumexp", "isnan", "isinf", "isfinite", "nan_to_num", "sum", "mean",
+    "max", "min", "amax", "amin", "prod", "logsumexp", "all", "any", "matmul",
+    "dot", "mm", "bmm", "inner", "outer", "addmm", "kron", "trace", "nansum",
+    "nanmean", "count_nonzero", "broadcast_shape", "multiply_", "stanh",
+    "rad2deg", "deg2rad", "gcd", "lcm", "diff", "angle", "conj", "real", "imag",
+    "tanh", "increment", "divide_no_nan",
+]
+
+
+# ---------------------------------------------------------------------------
+# broadcasting-aware binary ops with explicit VJPs
+# ---------------------------------------------------------------------------
+def _unbcast(g, shape):
+    """Reduce grad g back to ``shape`` after broadcasting."""
+    if tuple(g.shape) == tuple(shape):
+        return g
+    extra = g.ndim - len(shape)
+    if extra > 0:
+        g = g.sum(axis=tuple(range(extra)))
+    axes = tuple(i for i, s in enumerate(shape) if s == 1 and g.shape[i] != 1)
+    if axes:
+        g = g.sum(axis=axes, keepdims=True)
+    return g.reshape(shape)
+
+
+def _binary_vjp(dx_fn, dy_fn):
+    def vjp(grads_out, saved, **static):
+        (g,) = grads_out
+        x, y = saved
+        gx = _unbcast(dx_fn(g, x, y, **static), x.shape)
+        gy = _unbcast(dy_fn(g, x, y, **static), y.shape)
+        return gx, gy
+
+    return vjp
+
+
+_add = defprim(
+    "add", jnp.add,
+    vjp=_binary_vjp(lambda g, x, y: g, lambda g, x, y: g),
+    save=lambda ins, outs: ins,
+)
+_sub = defprim(
+    "subtract", jnp.subtract,
+    vjp=_binary_vjp(lambda g, x, y: g, lambda g, x, y: -g),
+)
+_mul = defprim(
+    "multiply", jnp.multiply,
+    vjp=_binary_vjp(lambda g, x, y: g * y, lambda g, x, y: g * x),
+)
+_div = defprim(
+    "divide", jnp.divide,
+    vjp=_binary_vjp(
+        lambda g, x, y: g / y, lambda g, x, y: -g * x / (y * y)
+    ),
+)
+_pow_p = defprim("pow_p", jnp.power)
+_maximum = defprim("maximum", jnp.maximum)
+_minimum = defprim("minimum", jnp.minimum)
+_fmax = defprim("fmax", jnp.fmax)
+_fmin = defprim("fmin", jnp.fmin)
+_atan2 = defprim("atan2", jnp.arctan2)
+_floor_divide = defprim("floor_divide", jnp.floor_divide, nondiff=True)
+_mod = defprim("mod", jnp.mod)
+
+
+def add(x, y, name=None):
+    return _add(*binary_args(x, y))
+
+
+def subtract(x, y, name=None):
+    return _sub(*binary_args(x, y))
+
+
+def multiply(x, y, name=None):
+    return _mul(*binary_args(x, y))
+
+
+def divide(x, y, name=None):
+    x, y = binary_args(x, y)
+    if np.dtype(x.dtype).kind in "iub":
+        x = cast(x, "float32")
+        y = cast(y, "float32")
+    return _div(x, y)
+
+
+def floor_divide(x, y, name=None):
+    return _floor_divide(*binary_args(x, y))
+
+
+def mod(x, y, name=None):
+    return _mod(*binary_args(x, y))
+
+
+remainder = mod
+
+
+def pow(x, y, name=None):
+    if isinstance(y, numbers.Number):
+        x = ensure_tensor(x)
+        return apply("scale_pow", x, exponent=float(y))
+    return _pow_p(*binary_args(x, y))
+
+
+defprim(
+    "scale_pow",
+    lambda x, *, exponent: jnp.power(x, jnp.asarray(exponent, x.dtype))
+    if float(exponent) != int(exponent)
+    else jax.lax.integer_pow(x, int(exponent)),
+)
+
+float_power = pow
+
+
+def maximum(x, y, name=None):
+    return _maximum(*binary_args(x, y))
+
+
+def minimum(x, y, name=None):
+    return _minimum(*binary_args(x, y))
+
+
+def fmax(x, y, name=None):
+    return _fmax(*binary_args(x, y))
+
+
+def fmin(x, y, name=None):
+    return _fmin(*binary_args(x, y))
+
+
+def atan2(x, y, name=None):
+    return _atan2(*binary_args(x, y))
+
+
+def divide_no_nan(x, y, name=None):
+    x, y = binary_args(x, y)
+    return apply("divide_no_nan", x, y)
+
+
+defprim(
+    "divide_no_nan",
+    lambda x, y: jnp.where(y == 0, jnp.zeros((), x.dtype), x / jnp.where(y == 0, 1, y)),
+)
+
+
+# ---------------------------------------------------------------------------
+# scale — the workhorse for scalar math (reference: phi scale kernel)
+# ---------------------------------------------------------------------------
+defprim(
+    "scale_p",
+    lambda x, *, scale, bias, bias_after_scale: (
+        x * jnp.asarray(scale, x.dtype) + jnp.asarray(bias, x.dtype)
+        if bias_after_scale
+        else (x + jnp.asarray(bias, x.dtype)) * jnp.asarray(scale, x.dtype)
+    ),
+    vjp=lambda grads_out, saved, *, scale, bias, bias_after_scale: (
+        grads_out[0] * jnp.asarray(scale, grads_out[0].dtype),
+    ),
+    save=lambda ins, outs: (),
+)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    if isinstance(scale, Tensor):
+        out = multiply(x, scale)
+        if bias:
+            out = add(out, bias)
+        return out
+    return apply(
+        "scale_p",
+        ensure_tensor(x),
+        scale=float(scale),
+        bias=float(bias),
+        bias_after_scale=bool(bias_after_scale),
+    )
+
+
+def increment(x, value=1.0, name=None):
+    out = scale(x, 1.0, float(value))
+    x._replace_value(out._value)
+    x._node, x._out_slot, x.stop_gradient = out._node, out._out_slot, out.stop_gradient
+    return x
+
+
+# ---------------------------------------------------------------------------
+# unary ops — one-liner prims, fallback VJP (fused/DCEd by XLA)
+# ---------------------------------------------------------------------------
+def _unary(name, fn, **kw):
+    prim = defprim(name, fn, **kw)
+
+    def op(x, name=None):
+        return prim(ensure_tensor(x))
+
+    op.__name__ = name
+    return op
+
+
+neg = _unary("neg", jnp.negative)
+abs = _unary("abs", jnp.abs)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary(
+    "rsqrt",
+    jax.lax.rsqrt,
+    vjp=lambda g, saved, **kw: (-0.5 * g[0] * saved[0] * saved[0] * saved[0],),
+    save=lambda ins, outs: (outs[0],),
+)
+square = _unary("square", jnp.square)
+exp = _unary(
+    "exp", jnp.exp,
+    vjp=lambda g, saved, **kw: (g[0] * saved[0],),
+    save=lambda ins, outs: (outs[0],),
+)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+tanh = _unary(
+    "tanh", jnp.tanh,
+    vjp=lambda g, saved, **kw: (g[0] * (1 - saved[0] * saved[0]),),
+    save=lambda ins, outs: (outs[0],),
+)
+floor = _unary("floor", jnp.floor, nondiff=True)
+ceil = _unary("ceil", jnp.ceil, nondiff=True)
+round = _unary("round", jnp.round, nondiff=True)
+trunc = _unary("trunc", jnp.trunc, nondiff=True)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+sign = _unary("sign", jnp.sign, nondiff=True)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+isnan = _unary("isnan", jnp.isnan, nondiff=True)
+isinf = _unary("isinf", jnp.isinf, nondiff=True)
+isfinite = _unary("isfinite", jnp.isfinite, nondiff=True)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale(tanh(scale(x, scale_a)), scale_b)
+
+
+defprim(
+    "clip_p",
+    lambda x, *, min, max: jnp.clip(x, min, max),
+)
+
+
+def clip(x, min=None, max=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(min, Tensor) or isinstance(max, Tensor):
+        out = x
+        if min is not None:
+            out = maximum(out, min)
+        if max is not None:
+            out = minimum(out, max)
+        return out
+    return apply(
+        "clip_p",
+        x,
+        min=float(min) if min is not None else None,
+        max=float(max) if max is not None else None,
+    )
+
+
+defprim("lerp_p", lambda x, y, w: x + w * (y - x))
+
+
+def lerp(x, y, weight, name=None):
+    x, y = binary_args(x, y)
+    w = ensure_tensor(weight, dtype=x.dtype) if not isinstance(weight, Tensor) else weight
+    return apply("lerp_p", x, y, w)
+
+
+defprim(
+    "nan_to_num_p",
+    lambda x, *, nan, posinf, neginf: jnp.nan_to_num(
+        x, nan=nan, posinf=posinf, neginf=neginf
+    ),
+)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(
+        "nan_to_num_p", ensure_tensor(x), nan=float(nan),
+        posinf=posinf, neginf=neginf,
+    )
+
+
+defprim("cast_p", lambda x, *, dtype: x.astype(jnp.dtype(dtype)))
+
+
+def cast(x, dtype):
+    x = ensure_tensor(x)
+    dt = convert_dtype(dtype)
+    if np.dtype(x.dtype) == dt:
+        return x
+    return apply("cast_p", x, dtype=dt.name)
+
+
+defprim("gcd_p", lambda x, y: jnp.gcd(x, y), nondiff=True)
+defprim("lcm_p", lambda x, y: jnp.lcm(x, y), nondiff=True)
+
+
+def gcd(x, y, name=None):  # noqa: F811
+    return apply("gcd_p", *binary_args(x, y))
+
+
+def lcm(x, y, name=None):
+    return apply("lcm_p", *binary_args(x, y))
+
+
+# ---------------------------------------------------------------------------
+# multi-input
+# ---------------------------------------------------------------------------
+def add_n(inputs, name=None):
+    """Reference: phi add_n kernel (sum of N tensors)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    ts = [ensure_tensor(t) for t in inputs]
+    name_p = f"add_n_{len(ts)}"
+    from ..core import dispatch
+
+    if name_p not in dispatch.PRIMITIVES:
+        dispatch.register_primitive(
+            name_p,
+            lambda *xs: sum(xs[1:], start=xs[0]),
+            vjp=lambda g, saved, **kw: tuple(g[0] for _ in range(saved[0])),
+            save=lambda ins, outs: (len(ins),),
+        )
+    return apply(name_p, *ts)
+
+
+# ---------------------------------------------------------------------------
+# cumulative
+# ---------------------------------------------------------------------------
+defprim("cumsum_p", lambda x, *, axis: jnp.cumsum(x, axis=axis))
+defprim("cumprod_p", lambda x, *, axis: jnp.cumprod(x, axis=axis))
+defprim(
+    "logcumsumexp_p", lambda x, *, axis: jax.lax.cumlogsumexp(x, axis=axis)
+)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        x = cast(x, dtype)
+    if axis is None:
+        from .manipulation import flatten
+
+        return apply("cumsum_p", flatten(x), axis=0)
+    return apply("cumsum_p", x, axis=int(axis))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        x = cast(x, dtype)
+    return apply("cumprod_p", x, axis=int(dim if dim is not None else 0))
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        from .manipulation import flatten
+
+        return apply("logcumsumexp_p", flatten(x), axis=0)
+    return apply("logcumsumexp_p", x, axis=int(axis))
+
+
+def _cum_arg(x, axis, op):
+    # indices of the running extremum along axis
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == axis else 1 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+
+    def step(carry, xs):
+        best, bi = carry
+        v, i = xs
+        take = op(v, best) == v
+        nb = jnp.where(take, v, best)
+        nbi = jnp.where(take, i, bi)
+        return (nb, nbi), (nb, nbi)
+
+    xm = jnp.moveaxis(x, axis, 0)
+    im = jnp.moveaxis(idx, axis, 0)
+    init = (xm[0], im[0])
+    _, (vals, idxs) = jax.lax.scan(step, init, (xm, im))
+    return jnp.moveaxis(idxs, 0, axis).astype(jnp.int64)
+
+
+defprim(
+    "cummax_p",
+    lambda x, *, axis: (jax.lax.cummax(x, axis=axis), _cum_arg(x, axis, jnp.maximum)),
+    multi_out=True,
+)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        from .manipulation import flatten
+
+        x, axis = flatten(x), 0
+    return apply("cummax_p", x, axis=int(axis))
+
+
+defprim(
+    "cummin_p",
+    lambda x, *, axis: (jax.lax.cummin(x, axis=axis), _cum_arg(x, axis, jnp.minimum)),
+    multi_out=True,
+)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        from .manipulation import flatten
+
+        x, axis = flatten(x), 0
+    return apply("cummin_p", x, axis=int(axis))
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: phi reduce kernels + spmd reduction rules)
+# ---------------------------------------------------------------------------
+def _reduce(prim_name, fn, nondiff=False):
+    defprim(
+        prim_name,
+        lambda x, *, axis, keepdim: fn(x, axis=axis, keepdims=keepdim),
+        nondiff=nondiff,
+    )
+
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        x = ensure_tensor(x)
+        if dtype is not None:
+            x = cast(x, dtype)
+        elif name_needs_upcast(fn, x):
+            x = cast(x, "int64")
+        return apply(prim_name, x, axis=axis_tuple(axis, x.ndim), keepdim=bool(keepdim))
+
+    return op
+
+
+def name_needs_upcast(fn, x):
+    # paddle sums bool/int32 into int64
+    return fn in (jnp.sum, jnp.prod) and np.dtype(x.dtype).kind in "b"
+
+
+sum = _reduce("reduce_sum", jnp.sum)
+mean = _reduce("reduce_mean", jnp.mean)
+prod = _reduce("reduce_prod", jnp.prod)
+amax = _reduce("reduce_amax", jnp.max)
+amin = _reduce("reduce_amin", jnp.min)
+nansum = _reduce("reduce_nansum", jnp.nansum)
+all = _reduce("reduce_all", jnp.all, nondiff=True)
+any = _reduce("reduce_any", jnp.any, nondiff=True)
+
+defprim(
+    "reduce_max",
+    lambda x, *, axis, keepdim: jnp.max(x, axis=axis, keepdims=keepdim),
+)
+defprim(
+    "reduce_min",
+    lambda x, *, axis, keepdim: jnp.min(x, axis=axis, keepdims=keepdim),
+)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply("reduce_max", x, axis=axis_tuple(axis, x.ndim), keepdim=bool(keepdim))
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply("reduce_min", x, axis=axis_tuple(axis, x.ndim), keepdim=bool(keepdim))
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "reduce_nanmean", x, axis=axis_tuple(axis, x.ndim), keepdim=bool(keepdim)
+    )
+
+
+defprim(
+    "reduce_nanmean",
+    lambda x, *, axis, keepdim: jnp.nanmean(x, axis=axis, keepdims=keepdim),
+)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "count_nonzero_p", x, axis=axis_tuple(axis, x.ndim), keepdim=bool(keepdim)
+    )
+
+
+defprim(
+    "count_nonzero_p",
+    lambda x, *, axis, keepdim: jnp.count_nonzero(x, axis=axis, keepdims=keepdim).astype(
+        jnp.int64
+    ),
+    nondiff=True,
+)
+
+
+defprim(
+    "logsumexp_p",
+    lambda x, *, axis, keepdim: jax.scipy.special.logsumexp(
+        x, axis=axis, keepdims=keepdim
+    ),
+)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply("logsumexp_p", x, axis=axis_tuple(axis, x.ndim), keepdim=bool(keepdim))
+
+
+# ---------------------------------------------------------------------------
+# matmul family — the MXU path. bf16-friendly, explicit VJP avoids saving
+# anything beyond the operands (SURVEY §7: keep matmuls large + batched).
+# ---------------------------------------------------------------------------
+def _matmul_fwd(x, y, *, transpose_x, transpose_y):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+defprim("matmul", _matmul_fwd)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = binary_args(x, y)
+    return apply(
+        "matmul", x, y, transpose_x=bool(transpose_x), transpose_y=bool(transpose_y)
+    )
+
+
+mm = matmul
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+defprim("dot_p", lambda x, y: jnp.sum(x * y, axis=-1))
+
+
+def dot(x, y, name=None):
+    return apply("dot_p", *binary_args(x, y))
+
+
+def inner(x, y, name=None):
+    x, y = binary_args(x, y)
+    return apply("inner_p", x, y)
+
+
+defprim("inner_p", lambda x, y: jnp.inner(x, y))
+defprim("outer_p", lambda x, y: jnp.outer(x, y))
+
+
+def outer(x, y, name=None):
+    return apply("outer_p", *binary_args(x, y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return add(scale(input, beta), scale(matmul(x, y), alpha))
+
+
+defprim("kron_p", lambda x, y: jnp.kron(x, y))
+
+
+def kron(x, y, name=None):
+    return apply("kron_p", *binary_args(x, y))
+
+
+defprim(
+    "trace_p",
+    lambda x, *, offset, axis1, axis2: jnp.trace(x, offset, axis1, axis2),
+)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(
+        "trace_p", ensure_tensor(x), offset=int(offset), axis1=int(axis1), axis2=int(axis2)
+    )
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = ensure_tensor(x)
+    arrays = [x]
+    if prepend is not None:
+        arrays.insert(0, ensure_tensor(prepend))
+    if append is not None:
+        arrays.append(ensure_tensor(append))
+    if len(arrays) > 1:
+        from .manipulation import concat
+
+        x = concat(arrays, axis=axis)
+    return apply("diff_p", x, n=int(n), axis=int(axis))
+
+
+defprim("diff_p", lambda x, *, n, axis: jnp.diff(x, n=n, axis=axis))
+
+
+# ---------------------------------------------------------------------------
+# in-place variants (reference: x.add_() etc. — inplace API list in
+# python/paddle/tensor/__init__.py). Functional under the hood: compute,
+# rebind storage + graph link on the same python object.
+# ---------------------------------------------------------------------------
+def _make_inplace(op):
+    def inplace(x, *args, **kwargs):
+        out = op(x, *args, **kwargs)
+        x._replace_value(out._value)
+        x._node, x._out_slot = out._node, out._out_slot
+        x.stop_gradient = out.stop_gradient
+        return x
+
+    inplace.__name__ = op.__name__ + "_"
+    return inplace
+
+
+add_ = _make_inplace(add)
+subtract_ = _make_inplace(subtract)
+multiply_ = _make_inplace(multiply)
+divide_ = _make_inplace(divide)
+clip_ = _make_inplace(clip)
+scale_ = _make_inplace(scale)
+exp_ = _make_inplace(exp)
+sqrt_ = _make_inplace(sqrt)
+rsqrt_ = _make_inplace(rsqrt)
+reciprocal_ = _make_inplace(reciprocal)
+round_ = _make_inplace(round)
+floor_ = _make_inplace(floor)
+ceil_ = _make_inplace(ceil)
+neg_ = _make_inplace(neg)
+abs_ = _make_inplace(abs)
+tanh_ = _make_inplace(tanh)

@@ -1,0 +1,176 @@
+"""Statistics ops.
+
+Reference surface: python/paddle/tensor/stat.py (mean/std/var/median/
+quantile/mode/kthvalue).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ._helpers import axis_tuple, defprim, ensure_tensor
+
+__all__ = [
+    "std", "var", "median", "nanmedian", "quantile", "nanquantile", "mode",
+    "kthvalue",
+]
+
+defprim(
+    "var_p",
+    lambda x, *, axis, unbiased, keepdim: jnp.var(
+        x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim
+    ),
+)
+defprim(
+    "std_p",
+    lambda x, *, axis, unbiased, keepdim: jnp.std(
+        x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim
+    ),
+)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "var_p", x, axis=axis_tuple(axis, x.ndim), unbiased=bool(unbiased),
+        keepdim=bool(keepdim),
+    )
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "std_p", x, axis=axis_tuple(axis, x.ndim), unbiased=bool(unbiased),
+        keepdim=bool(keepdim),
+    )
+
+
+defprim(
+    "median_p",
+    lambda x, *, axis, keepdim, mode: (
+        jnp.median(x, axis=axis, keepdims=keepdim)
+        if mode == "avg"
+        else jnp.quantile(x, 0.5, axis=axis, keepdims=keepdim, method="lower")
+    ),
+)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+    out = apply(
+        "median_p", x, axis=int(axis) if axis is not None else None,
+        keepdim=bool(keepdim), mode=mode,
+    )
+    if mode == "min" and axis is not None:
+        # paddle returns (values, indices) for mode='min' with axis
+        from .manipulation import argsort
+
+        return out, None
+    return out
+
+
+defprim(
+    "nanmedian_p",
+    lambda x, *, axis, keepdim: jnp.nanmedian(x, axis=axis, keepdims=keepdim),
+)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "nanmedian_p", x, axis=axis_tuple(axis, x.ndim), keepdim=bool(keepdim)
+    )
+
+
+defprim(
+    "quantile_p",
+    lambda x, *, q, axis, keepdim, interpolation: jnp.quantile(
+        x, jnp.asarray(q), axis=axis, keepdims=keepdim, method=interpolation
+    ),
+)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = ensure_tensor(x)
+    qv = tuple(np.atleast_1d(q).tolist()) if not isinstance(q, float) else q
+    out = apply(
+        "quantile_p", x, q=qv, axis=int(axis) if axis is not None else None,
+        keepdim=bool(keepdim), interpolation=interpolation,
+    )
+    return out
+
+
+defprim(
+    "nanquantile_p",
+    lambda x, *, q, axis, keepdim, interpolation: jnp.nanquantile(
+        x, jnp.asarray(q), axis=axis, keepdims=keepdim, method=interpolation
+    ),
+)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = ensure_tensor(x)
+    qv = tuple(np.atleast_1d(q).tolist()) if not isinstance(q, float) else q
+    return apply(
+        "nanquantile_p", x, q=qv, axis=int(axis) if axis is not None else None,
+        keepdim=bool(keepdim), interpolation=interpolation,
+    )
+
+
+def _mode_fwd(x, *, axis, keepdim):
+    # most frequent value along axis, ties → smallest (paddle: largest index?
+    # reference kernel returns the last occurrence; we match scipy-style).
+    sorted_x = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+
+    def count_runs(a):
+        # a: 1-d sorted
+        eq = a[:, None] == a[None, :]
+        counts = eq.sum(-1)
+        best = jnp.argmax(counts)
+        return a[best]
+
+    moved = jnp.moveaxis(sorted_x, axis, -1)
+    flat = moved.reshape(-1, n)
+    vals = jax.vmap(count_runs)(flat)
+    vals = vals.reshape(moved.shape[:-1])
+    idx = jnp.argmax(
+        jnp.moveaxis(x, axis, -1).reshape(-1, n) == vals[..., None].reshape(-1, 1),
+        axis=-1,
+    ).reshape(moved.shape[:-1])
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+defprim("mode_p", _mode_fwd, multi_out=True)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply("mode_p", x, axis=int(axis) % x.ndim, keepdim=bool(keepdim))
+
+
+def _kthvalue_fwd(x, *, k, axis, keepdim):
+    moved = jnp.moveaxis(x, axis, -1)
+    sorted_x = jnp.sort(moved, axis=-1)
+    argsorted = jnp.argsort(moved, axis=-1)
+    vals = sorted_x[..., k - 1]
+    idx = argsorted[..., k - 1]
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+defprim("kthvalue_p", _kthvalue_fwd, multi_out=True)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "kthvalue_p", x, k=int(k), axis=int(axis) % x.ndim, keepdim=bool(keepdim)
+    )
