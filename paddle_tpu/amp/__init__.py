@@ -1,1 +1,230 @@
-"""placeholder — filled in during round 1."""
+"""Automatic mixed precision.
+
+Reference: python/paddle/amp/ (auto_cast.py — O1 white/black lists, O2 pure
+half; grad_scaler.py — dynamic loss scaling). TPU design: bfloat16 is the
+native half type (MXU), so the default amp dtype is bf16 and loss scaling is
+a no-op unless float16 is requested (kept for parity).
+
+The op-level cast hook lives here and is consulted by core.tensor.apply.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import debugging  # noqa: F401
+
+_state = threading.local()
+
+# O1 lists (subset of reference auto_cast white/black lists,
+# python/paddle/amp/amp_lists.py): compute-bound ops run in half, numerically
+# sensitive ops stay fp32.
+WHITE_LIST = {
+    "matmul", "linear_p", "linear_nobias_p", "conv_p", "conv_transpose_p",
+    "einsum_1", "einsum_2", "einsum_3", "bilinear_p", "bilinear_nobias_p",
+    "sdpa_p", "sdpa_mask_p", "flash_attention_p",
+}
+BLACK_LIST = {
+    "reduce_sum", "reduce_mean", "softmax_p", "log_softmax_p", "layer_norm_p",
+    "rms_norm_p", "batch_norm_train_p", "batch_norm_infer_p", "exp", "log",
+    "pow_p", "hard_ce_p", "soft_ce_p", "logsumexp_p", "p_norm", "fro_norm",
+    "cumsum_p",
+}
+
+
+class _AmpState:
+    __slots__ = ("enabled", "dtype", "level", "custom_white", "custom_black")
+
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+def _amp_state() -> _AmpState:
+    st = getattr(_state, "amp", None)
+    if st is None:
+        st = _state.amp = _AmpState()
+    return st
+
+
+def amp_cast_inputs(prim_name: str, arrays):
+    """Called from core.tensor.apply for every op when amp is on."""
+    st = _amp_state()
+    if not st.enabled:
+        return arrays
+    in_white = (prim_name in WHITE_LIST or prim_name in st.custom_white) and (
+        prim_name not in st.custom_black
+    )
+    if st.level == "O2":
+        in_white = prim_name not in BLACK_LIST and prim_name not in st.custom_black
+    if not in_white:
+        return arrays
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and a.dtype == jnp.float32:
+            out.append(a.astype(st.dtype))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+def amp_active() -> bool:
+    return _amp_state().enabled
+
+
+from ..core.tensor import _install_amp_hook
+
+_install_amp_hook(amp_cast_inputs)
+
+
+class auto_cast:
+    """paddle.amp.auto_cast parity (auto_cast.py)."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        self.enable = enable
+        self.level = level
+        self.dtype = jnp.float16 if str(dtype) in ("float16", "fp16") else jnp.bfloat16
+        self.white = set(custom_white_list or [])
+        self.black = set(custom_black_list or [])
+
+    def __enter__(self):
+        st = _amp_state()
+        self._prev = (st.enabled, st.dtype, st.level, st.custom_white, st.custom_black)
+        st.enabled = self.enable
+        st.dtype = self.dtype
+        st.level = self.level
+        st.custom_white = self.white
+        st.custom_black = self.black
+        return self
+
+    def __exit__(self, *exc):
+        st = _amp_state()
+        (st.enabled, st.dtype, st.level, st.custom_white, st.custom_black) = self._prev
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate parity: O2 casts model params to half (master
+    weights live in the optimizer)."""
+    from ..nn.layer import Layer
+
+    single = isinstance(models, Layer)
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        dt = "float16" if str(dtype) in ("float16", "fp16") else "bfloat16"
+        for m in model_list:
+            m.to(dtype=dt)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """paddle.amp.GradScaler parity (grad_scaler.py). With bf16 the scale is
+    1 and enable=False is recommended; dynamic scaling is implemented for
+    fp16 parity."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        from ..ops.math import scale as _scale
+
+        return _scale(var, self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p._grad_value is None:
+                continue
+            g = p._grad_value * inv if self._scale != 1.0 else p._grad_value
+            if not bool(jnp.all(jnp.isfinite(g.astype(jnp.float32)))):
+                found = True
+            p._grad_value = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor._from_value(jnp.asarray(self._scale, jnp.float32))
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd["good_steps"]
+        self._bad_steps = sd["bad_steps"]
+
+
+def is_bfloat16_supported(place=None):
+    return True
+
+
+def is_float16_supported(place=None):
+    return True

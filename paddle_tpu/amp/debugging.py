@@ -1,0 +1,36 @@
+"""AMP debugging tools.
+
+Reference: python/paddle/amp/debugging.py (TensorCheckerConfig,
+enable_operator_stats_collection, compare_accuracy). Minimal parity: op
+stats collection over the dispatch cache + nan/inf checking toggles.
+"""
+from __future__ import annotations
+
+from ..core import flags
+
+
+def enable_tensor_checker(checker_config=None):
+    flags.set_flags({"check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    flags.set_flags({"check_nan_inf": False})
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=None, **kw):
+        self.enable = enable
+
+
+def collect_operator_stats():
+    from ..core.dispatch import dispatch_cache_info
+
+    return dispatch_cache_info()
+
+
+def enable_operator_stats_collection():
+    pass
+
+
+def disable_operator_stats_collection():
+    pass

@@ -91,6 +91,40 @@ class RNGStatesTracker:
 
 _tracker = RNGStatesTracker()
 
+# --------------------------------------------------------------------------
+# Trace-mode key source: inside ``jit.to_static`` capture, random draws must
+# come from a traced key input (not the concrete eager key, which would be
+# baked into the compiled program as a constant). The jit layer pushes the
+# per-call key here; next_key() then derives subkeys by fold_in/split.
+# --------------------------------------------------------------------------
+_trace = threading.local()
+
+
+class trace_key_scope:
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        stack = getattr(_trace, "stack", None)
+        if stack is None:
+            stack = _trace.stack = []
+        stack.append([self._key, 0])
+        return self
+
+    def __exit__(self, *exc):
+        _trace.stack.pop()
+        return False
+
+
+def _trace_next_key():
+    stack = getattr(_trace, "stack", None)
+    if not stack:
+        return None
+    entry = stack[-1]
+    entry[0], sub = jax.random.split(entry[0])
+    entry[1] += 1
+    return sub
+
 
 def default_generator() -> Generator:
     return _tracker.get(_DEFAULT)
@@ -108,4 +142,7 @@ def seed(value: int):
 
 
 def next_key(name: str = _DEFAULT):
+    traced = _trace_next_key()
+    if traced is not None:
+        return traced
     return _tracker.get(name).next_key()

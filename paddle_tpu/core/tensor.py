@@ -305,6 +305,15 @@ class Parameter(Tensor):
         return self.trainable
 
 
+# amp cast hook, installed by paddle_tpu.amp at import (avoids circular dep)
+_amp_hook = None
+
+
+def _install_amp_hook(fn):
+    global _amp_hook
+    _amp_hook = fn
+
+
 # --------------------------------------------------------------------------
 # The op-application path: every op in paddle_tpu.ops funnels through here.
 # Analog of the generated *_ad_func bodies (eager_gen.py:321): run kernel,
@@ -315,6 +324,8 @@ def apply(prim_name: str, *tensors: Tensor, **static) -> Any:
     # so VJP results align 1:1 with recorded edges.
     prim = dispatch.PRIMITIVES[prim_name]
     arrays = tuple(t._value for t in tensors)
+    if _amp_hook is not None:
+        arrays = _amp_hook(prim_name, arrays)
     outs = dispatch.call_primitive(prim_name, arrays, static)
     requires = (not prim.nondiff) and engine.grad_enabled() and any(
         not t.stop_gradient for t in tensors

@@ -1,1 +1,2 @@
+"""paddle.framework parity (io + misc)."""
 from .io_ import save, load

@@ -1,1 +1,2 @@
-"""placeholder — filled in during round 1."""
+"""paddle.incubate parity (MoE, fused ops). Reference: python/paddle/incubate."""
+from . import nn

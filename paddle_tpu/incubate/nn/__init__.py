@@ -1,0 +1,2 @@
+"""paddle.incubate.nn parity."""
+from . import functional

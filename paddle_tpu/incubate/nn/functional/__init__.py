@@ -1,0 +1,239 @@
+"""paddle.incubate.nn.functional parity — fused ops.
+
+Reference: python/paddle/incubate/nn/functional/ (fused_rms_norm,
+fused_layer_norm, fused_rotary_position_embedding, fused_ec_moe, swiglu,
+fused_linear...). On TPU these are Pallas kernels or XLA-fused compositions
+registered through the same primitive registry.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor, apply
+from ....ops._helpers import defprim, ensure_tensor
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+    "fused_linear", "swiglu", "fused_bias_act", "fused_dropout_add",
+    "fused_feedforward", "fused_multi_head_attention",
+]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **kw):
+    """Reference: incubate/nn/functional/fused_rms_norm.py (residual-add +
+    RMSNorm fusion, phi fused kernels). Returns (out, residual_out) when a
+    residual is passed, matching the reference."""
+    from ....nn.functional.norm import rms_norm
+    from ....ops.math import add
+
+    if bias is not None:
+        x = add(x, bias)
+    if residual is not None:
+        x = add(x, residual)
+        out = rms_norm(x, norm_weight, epsilon)
+        return out, x
+    return rms_norm(x, norm_weight, epsilon)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=1, bias=None, residual=None, **kw):
+    from ....nn.functional.norm import layer_norm
+    from ....ops.math import add
+
+    if bias is not None:
+        x = add(x, bias)
+    if residual is not None:
+        x = add(x, residual)
+    shape = x.shape[begin_norm_axis:] if begin_norm_axis >= 0 else x.shape[-1:]
+    out = layer_norm(x, list(shape), norm_weight, norm_bias, epsilon)
+    if residual is not None:
+        return out, x
+    return out
+
+
+def _rope_fwd(q, k, cos, sin, *, use_neox):
+    # q,k: [B, S, H, D]; cos/sin broadcastable [1, S, 1, D]
+    def rot(x):
+        if use_neox:
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            return jnp.concatenate([-x2, x1], axis=-1)
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+    q_out = q * cos + rot(q) * sin
+    k_out = k * cos + rot(k) * sin
+    return q_out, k_out
+
+
+defprim("fused_rope_p", _rope_fwd, multi_out=True)
+
+
+def _rope_tables(s, d, base, use_neox, dtype):
+    inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    t = jnp.arange(s, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    if use_neox:
+        emb = jnp.concatenate([freqs, freqs], axis=-1)
+    else:
+        emb = jnp.repeat(freqs, 2, axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """Reference: incubate/nn/functional/fused_rotary_position_embedding.py.
+    Applies RoPE to q (and k); returns (q, k, v)."""
+    q = ensure_tensor(q)
+    b, s, h, d = q.shape
+    if cos is None or sin is None:
+        cos_a, sin_a = _rope_tables(s, d, rotary_emb_base,
+                                    use_neox_rotary_style, q._value.dtype)
+    else:
+        cos_a = ensure_tensor(cos)._value.reshape(-1, d)[:s]
+        sin_a = ensure_tensor(sin)._value.reshape(-1, d)[:s]
+    if position_ids is not None:
+        pos = ensure_tensor(position_ids)._value.astype(jnp.int32)
+        cos_a = jnp.take(cos_a, pos, axis=0)[:, :, None, :]  # [B,S,1,D]
+        sin_a = jnp.take(sin_a, pos, axis=0)[:, :, None, :]
+    else:
+        cos_a = cos_a[None, :, None, :]
+        sin_a = sin_a[None, :, None, :]
+    cos_t = Tensor._from_value(cos_a)
+    sin_t = Tensor._from_value(sin_a)
+    if k is None:
+        qo, _ = apply("fused_rope_p", q, q, cos_t, sin_t,
+                      use_neox=bool(use_neox_rotary_style))
+        return qo, None, v
+    qo, ko = apply("fused_rope_p", q, ensure_tensor(k), cos_t, sin_t,
+                   use_neox=bool(use_neox_rotary_style))
+    return qo, ko, v
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    from ....nn.functional.common import linear
+    from ....ops.manipulation import t as _t
+
+    if transpose_weight:
+        weight = _t(ensure_tensor(weight))
+    return linear(x, weight, bias)
+
+
+defprim("swiglu_p", lambda x, y: jax.nn.silu(x) * y)
+
+
+def swiglu(x, y=None, name=None):
+    """Reference: incubate swiglu (silu(x) * y; single-arg splits last dim)."""
+    x = ensure_tensor(x)
+    if y is None:
+        from ....ops.manipulation import split
+
+        x, y = split(x, 2, axis=-1)
+    return apply("swiglu_p", x, ensure_tensor(y))
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    from ....ops import activation as A
+    from ....ops.math import add
+
+    if bias is not None:
+        x = add(ensure_tensor(x), ensure_tensor(bias))
+    if act_method == "swiglu":
+        return swiglu(x)
+    if act_method == "geglu":
+        from ....ops.manipulation import split
+        from ....ops.math import multiply
+
+        a, b = split(ensure_tensor(x), 2, axis=-1)
+        return multiply(A.gelu(a), b)
+    return {"gelu": A.gelu, "relu": A.relu, "silu": A.silu}[act_method](x)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    from ....nn.functional.common import dropout
+    from ....ops.math import add
+
+    return add(dropout(x, p, training=training, mode=mode), ensure_tensor(y))
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      name=None):
+    """Reference behavior: fluid/operators/fused/fused_feedforward_op.cu
+    (pre/post-LN FFN transformer block)."""
+    from ....nn.functional.common import dropout, linear
+    from ....nn.functional.norm import layer_norm
+    from ....ops import activation as A
+    from ....ops.math import add
+
+    x = ensure_tensor(x)
+    residual = x
+    d = x.shape[-1]
+    if pre_layer_norm:
+        x = layer_norm(x, [d], ln1_scale, ln1_bias, ln1_epsilon)
+    h = linear(x, linear1_weight, linear1_bias)
+    h = {"relu": A.relu, "gelu": A.gelu}[activation](h)
+    h = dropout(h, dropout1_rate, training=training)
+    h = linear(h, linear2_weight, linear2_bias)
+    h = dropout(h, dropout2_rate, training=training)
+    out = add(residual, h)
+    if not pre_layer_norm:
+        out = layer_norm(out, [d], ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None):
+    """Reference behavior: fluid/operators/fused/fused_attention_op.cu
+    (pre/post-LN MHA transformer block)."""
+    from ....nn.functional.attention import scaled_dot_product_attention
+    from ....nn.functional.common import dropout, linear
+    from ....nn.functional.norm import layer_norm
+    from ....ops.manipulation import reshape, unbind
+    from ....ops.math import add, matmul
+
+    x = ensure_tensor(x)
+    residual = x
+    b, s, d = x.shape
+    if pre_layer_norm:
+        x = layer_norm(x, [d], pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    qkv_w = ensure_tensor(qkv_weight)
+    if transpose_qkv_wb:
+        qkv = linear(x, qkv_w, qkv_bias)
+        nh = num_heads
+        hd = d // nh
+        qkv = reshape(qkv, [b, s, 3, nh, hd])
+    else:
+        three, nh, hd, _ = qkv_w.shape
+        w2 = reshape(qkv_w, [3 * nh * hd, d])
+        qkv = matmul(x, w2, transpose_y=True)
+        if qkv_bias is not None:
+            qkv = add(qkv, reshape(ensure_tensor(qkv_bias), [3 * nh * hd]))
+        qkv = reshape(qkv, [b, s, 3, nh, hd])
+    q, k, v = unbind(qkv, 2)
+    out = scaled_dot_product_attention(
+        q, k, v, attn_mask, attn_dropout_rate, False, training
+    )
+    out = reshape(out, [b, s, nh * hd])
+    out = linear(out, linear_weight, linear_bias)
+    out = dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = add(residual, out)
+    if not pre_layer_norm:
+        out = layer_norm(out, [d], ln_scale, ln_bias, ln_epsilon)
+    return out

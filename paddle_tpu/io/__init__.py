@@ -1,1 +1,7 @@
-"""placeholder."""
+"""paddle.io parity surface. Reference: python/paddle/io/__init__.py."""
+from .dataloader import (
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    ConcatDataset, Subset, random_split, Sampler, SequenceSampler,
+    RandomSampler, WeightedRandomSampler, BatchSampler,
+    DistributedBatchSampler, DataLoader, default_collate_fn, get_worker_info,
+)

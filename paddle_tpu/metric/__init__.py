@@ -1,1 +1,177 @@
-"""placeholder — filled in during round 1."""
+"""paddle.metric parity.
+
+Reference: python/paddle/metric/metrics.py (Metric base, Accuracy,
+Precision, Recall, Auc) + paddle.metric.accuracy functional.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        from ..ops.manipulation import argsort
+
+        pv = np.asarray(pred._value) if isinstance(pred, Tensor) else np.asarray(pred)
+        lv = np.asarray(label._value) if isinstance(label, Tensor) else np.asarray(label)
+        if lv.ndim == pv.ndim and lv.shape[-1] == 1:
+            lv = lv[..., 0]
+        top = np.argsort(-pv, axis=-1)[..., : self.maxk]
+        correct = top == lv[..., None]
+        return Tensor._from_value(np.asarray(correct, np.float32))
+
+    def update(self, correct, *args):
+        cv = np.asarray(correct._value) if isinstance(correct, Tensor) else np.asarray(correct)
+        num = cv.shape[0] if cv.ndim > 0 else 1
+        accs = []
+        for i, k in enumerate(self.topk):
+            c = cv[..., :k].sum()
+            self.total[i] += c
+            self.count[i] += num
+            accs.append(c / max(num, 1))
+        return np.asarray(accs[0] if len(accs) == 1 else accs)
+
+    def accumulate(self):
+        res = self.total / np.maximum(self.count, 1)
+        return float(res[0]) if len(self.topk) == 1 else res.tolist()
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        pv = np.asarray(preds._value) if isinstance(preds, Tensor) else np.asarray(preds)
+        lv = np.asarray(labels._value) if isinstance(labels, Tensor) else np.asarray(labels)
+        pred_cls = (pv > 0.5).astype(np.int64).reshape(-1)
+        lv = lv.reshape(-1)
+        self.tp += int(((pred_cls == 1) & (lv == 1)).sum())
+        self.fp += int(((pred_cls == 1) & (lv == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        pv = np.asarray(preds._value) if isinstance(preds, Tensor) else np.asarray(preds)
+        lv = np.asarray(labels._value) if isinstance(labels, Tensor) else np.asarray(labels)
+        pred_cls = (pv > 0.5).astype(np.int64).reshape(-1)
+        lv = lv.reshape(-1)
+        self.tp += int(((pred_cls == 1) & (lv == 1)).sum())
+        self.fn += int(((pred_cls == 0) & (lv == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        pv = np.asarray(preds._value) if isinstance(preds, Tensor) else np.asarray(preds)
+        lv = np.asarray(labels._value) if isinstance(labels, Tensor) else np.asarray(labels)
+        pos_prob = pv[:, 1] if pv.ndim == 2 else pv.reshape(-1)
+        lv = lv.reshape(-1)
+        bins = np.round(pos_prob * self.num_thresholds).astype(np.int64)
+        for b, l in zip(bins, lv):
+            if l:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        area = 0.0
+        pos = neg = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = pos + self._stat_pos[i]
+            new_neg = neg + self._stat_neg[i]
+            area += (new_neg - neg) * (pos + new_pos) / 2.0
+            pos, neg = new_pos, new_neg
+        return area / (tot_pos * tot_neg)
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """paddle.metric.accuracy functional."""
+    import jax.numpy as jnp
+
+    from ..ops._helpers import ensure_tensor
+
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    pv, lv = input._value, label._value
+    if lv.ndim == 2 and lv.shape[1] == 1:
+        lv = lv[:, 0]
+    import jax
+
+    _, topi = jax.lax.top_k(pv, k)
+    correct_ = jnp.any(topi == lv[:, None], axis=1)
+    return Tensor._from_value(jnp.mean(correct_.astype(jnp.float32)))

@@ -1,1 +1,45 @@
-"""placeholder."""
+"""paddle.nn parity surface.
+
+Reference: python/paddle/nn/__init__.py.
+"""
+from __future__ import annotations
+
+from .layer import Layer
+from .param_attr import ParamAttr
+from . import initializer
+from . import functional
+from . import functional as F  # noqa: F401
+
+from .container import Sequential, LayerList, LayerDict, ParameterList
+from .common_layers import (
+    Identity, Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    Flatten, Unflatten, Upsample, UpsamplingNearest2D, UpsamplingBilinear2D,
+    Bilinear, PixelShuffle, PixelUnshuffle, ChannelShuffle, CosineSimilarity,
+    Pad1D, Pad2D, Pad3D, ZeroPad2D,
+)
+from .conv_layers import (
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+)
+from .norm_layers import (
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
+    RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm,
+)
+from .pooling_layers import (
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+)
+from .loss_layers import (
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    KLDivLoss, SmoothL1Loss, MarginRankingLoss, HingeEmbeddingLoss,
+    CosineEmbeddingLoss, TripletMarginLoss, SoftMarginLoss,
+    MultiLabelSoftMarginLoss,
+)
+from .activation_layers import (
+    ReLU, ReLU6, Sigmoid, Tanh, GELU, SiLU, Swish, Mish, Hardswish,
+    Hardsigmoid, Hardtanh, Hardshrink, Softshrink, Tanhshrink, Softplus,
+    Softsign, LogSigmoid, ELU, SELU, CELU, LeakyReLU, ThresholdedReLU, Maxout,
+    Softmax, LogSoftmax, PReLU, RReLU, GLU,
+)
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue

@@ -1,0 +1,112 @@
+"""Gradient clipping.
+
+Reference: python/paddle/nn/clip.py (ClipGradByValue, ClipGradByNorm,
+ClipGradByGlobalNorm — applied by the optimizer before the update step).
+Global-norm clip computes the norm in float32 across all grads (one fused
+XLA reduction on TPU).
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def _dygraph_clip(self, params_grads):
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or getattr(p, "need_clip", True) is False:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor._from_value(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            gv = g._value
+            norm = jnp.sqrt(jnp.sum(jnp.square(gv.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor._from_value((gv.astype(jnp.float32) * scale).astype(gv.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _dygraph_clip(self, params_grads):
+        sq = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            sq.append(jnp.sum(jnp.square(g._value.astype(jnp.float32))))
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            gv = g._value
+            out.append(
+                (p, Tensor._from_value((gv.astype(jnp.float32) * scale).astype(gv.dtype)))
+            )
+        return out
+
+
+# functional forms (paddle.nn.utils.clip_grad_norm_)
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p._grad_value for p in parameters if p._grad_value is not None]
+    if not grads:
+        return Tensor._from_value(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g.astype(jnp.float32)), norm_type)) for g in grads),
+            1.0 / norm_type,
+        )
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p._grad_value is not None:
+            p._grad_value = (p._grad_value.astype(jnp.float32) * clip_coef).astype(
+                p._grad_value.dtype
+            )
+    return Tensor._from_value(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p._grad_value is not None:
+            p._grad_value = jnp.clip(p._grad_value, -clip_value, clip_value)

@@ -1,0 +1,24 @@
+"""paddle.nn.functional parity surface.
+
+Reference: python/paddle/nn/functional/__init__.py — activation, common,
+conv, pooling, norm, loss, input, attention, vision ops.
+"""
+from __future__ import annotations
+
+# activations live in the ops layer (same functions)
+from ...ops.activation import (  # noqa: F401
+    relu, relu6, relu_, leaky_relu, elu, selu, celu, gelu, silu, swish, mish,
+    sigmoid, hardsigmoid, hardswish, hardtanh, hardshrink, softshrink,
+    tanhshrink, softplus, softsign, log_sigmoid, softmax, log_softmax, prelu,
+    glu, maxout, thresholded_relu, rrelu, gumbel_softmax,
+)
+from ...ops.math import tanh  # noqa: F401
+from ...ops.manipulation import pad  # noqa: F401
+
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,128 @@
+"""Attention functional ops.
+
+Reference: python/paddle/nn/functional/flash_attention.py:198 (flash_attention),
+:602 (flash_attn_unpadded), :991 (scaled_dot_product_attention) over the
+flashattn lib (phi/kernels/gpu/flash_attn_kernel.cu:35).
+
+TPU design: a Pallas flash-attention kernel (ops/pallas/flash_attention.py)
+is the fast path on real TPU; a reference XLA composition (fused by the
+compiler, fp32 softmax accumulation) is the fallback and the numerics
+oracle. Layout is paddle's [batch, seqlen, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.flags import define_flag, get_flag
+from ...core.tensor import Tensor, apply
+from ...ops._helpers import defprim, ensure_tensor
+
+__all__ = ["scaled_dot_product_attention", "flash_attention", "sdp_kernel"]
+
+define_flag("use_pallas_flash_attention", True,
+            "use the Pallas flash-attention kernel on TPU backends")
+
+
+def _sdpa_xla(q, k, v, *, causal, scale):
+    # q,k,v: [B, S, H, D] (paddle layout); kv heads may be fewer (GQA)
+    qh, kh = q.shape[2], k.shape[2]
+    if kh != qh:
+        rep = qh // kh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), bool), t - s)
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def _sdpa_mask_xla(q, k, v, mask, *, scale):
+    qh, kh = q.shape[2], k.shape[2]
+    if kh != qh:
+        rep = qh // kh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+    logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+defprim("sdpa_p", _sdpa_xla)
+defprim("sdpa_mask_p", _sdpa_mask_xla)
+
+
+def _use_pallas(q):
+    if not get_flag("use_pallas_flash_attention"):
+        return False
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        if dev.platform == "cpu":
+            return False
+    except Exception:
+        return False
+    # pallas kernel wants MXU-aligned head dims
+    return q.shape[-1] % 128 == 0 and q.shape[1] % 128 == 0
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """paddle.nn.functional.scaled_dot_product_attention parity
+    (flash_attention.py:991). Input layout [B, S, H, D]."""
+    q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    if attn_mask is not None:
+        out = apply("sdpa_mask_p", q, k, v, ensure_tensor(attn_mask), scale=scale)
+    elif _use_pallas(q):
+        from ...ops.pallas.flash_attention import flash_attention_fused
+
+        out = flash_attention_fused(q, k, v, causal=bool(is_causal), scale=scale)
+    else:
+        out = apply("sdpa_p", q, k, v, causal=bool(is_causal), scale=scale)
+    if dropout_p > 0.0 and training:
+        from .common import dropout
+
+        out = dropout(out, dropout_p)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity
+    (flash_attention.py:198). Returns (out, softmax_lse-placeholder)."""
+    out = scaled_dot_product_attention(
+        query, key, value, None, dropout, causal, training
+    )
+    return out, None
+
+
+class sdp_kernel:
+    """Context manager parity with paddle's kernel-dispatch selector."""
+
+    def __init__(self, enable_flash=True, enable_math=True, enable_mem_efficient=True):
+        self.enable_flash = enable_flash
+        self._prev = None
+
+    def __enter__(self):
+        from ...core import flags
+
+        self._prev = flags.get_flag("use_pallas_flash_attention")
+        flags.set_flags({"use_pallas_flash_attention": self.enable_flash})
+        return self
+
+    def __exit__(self, *exc):
+        from ...core import flags
+
+        flags.set_flags({"use_pallas_flash_attention": self._prev})
+        return False

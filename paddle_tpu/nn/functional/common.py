@@ -1,0 +1,430 @@
+"""Common functional ops: linear, dropout, embedding, interpolate, etc.
+
+Reference: python/paddle/nn/functional/common.py + input.py (embedding,
+one_hot) + phi kernels (dropout with Philox seeds → threefry keys here).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import generator
+from ...core.tensor import Tensor, apply
+from ...ops._helpers import defprim, ensure_tensor
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding",
+    "one_hot", "label_smooth", "cosine_similarity", "bilinear", "interpolate",
+    "upsample", "unfold", "fold", "pixel_shuffle", "pixel_unshuffle",
+    "channel_shuffle", "zeropad2d",
+]
+
+
+def _linear_fwd(x, w, b):
+    y = jnp.matmul(x, w)
+    return y + b
+
+
+def _linear_nobias_fwd(x, w):
+    return jnp.matmul(x, w)
+
+
+defprim("linear_p", _linear_fwd)
+defprim("linear_nobias_p", _linear_nobias_fwd)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = xW + b; weight shape [in, out] (reference: functional/common.py
+    linear → phi matmul+add; fused on TPU by XLA)."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    if bias is None:
+        return apply("linear_nobias_p", x, weight)
+    return apply("linear_p", x, weight, ensure_tensor(bias))
+
+
+defprim(
+    "dropout_p",
+    lambda x, key, *, p, upscale: _dropout_fwd(x, key, p, upscale),
+)
+
+
+def _dropout_fwd(x, key, p, upscale):
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if upscale:
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = ensure_tensor(x)
+    p = float(p)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            from ...ops.math import scale
+
+            return scale(x, 1.0 - p)
+        return x
+    if p == 1.0:
+        from ...ops.math import multiply
+        from ...ops.creation import zeros_like
+
+        return multiply(x, zeros_like(x))
+    key = Tensor._from_value(generator.next_key("local_seed"))
+    if axis is not None:
+        ax = (axis,) if isinstance(axis, int) else tuple(axis)
+        return apply(
+            "dropout_axis_p", x, key, p=p, upscale=(mode == "upscale_in_train"),
+            axis=ax,
+        )
+    return apply("dropout_p", x, key, p=p, upscale=(mode == "upscale_in_train"))
+
+
+def _dropout_axis_fwd(x, key, *, p, upscale, axis):
+    shape = tuple(x.shape[i] if i in axis else 1 for i in range(x.ndim))
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    if upscale:
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+defprim("dropout_axis_p", _dropout_axis_fwd)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return ensure_tensor(x)
+    x = ensure_tensor(x)
+    key = Tensor._from_value(generator.next_key("local_seed"))
+    return apply("alpha_dropout_p", x, key, p=float(p))
+
+
+def _alpha_dropout_fwd(x, key, *, p):
+    alpha = 1.6732632423543772
+    scale_ = 1.0507009873554805
+    alpha_p = -alpha * scale_
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    a = ((1 - p) * (1 + p * alpha_p**2)) ** -0.5
+    b = -a * alpha_p * p
+    return a * jnp.where(keep, x, jnp.full((), alpha_p, x.dtype)) + b
+
+
+defprim("alpha_dropout_p", _alpha_dropout_fwd)
+
+
+def _embedding_fwd(w, ids, *, padding_idx):
+    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    if padding_idx is not None:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return out
+
+
+def _embedding_vjp(grads_out, saved, *, padding_idx):
+    (g,) = grads_out
+    w_shape, w_dtype, ids = saved
+    if padding_idx is not None:
+        g = jnp.where((ids == padding_idx)[..., None], 0, g)
+    gw = jnp.zeros(w_shape, jnp.float32 if w_dtype == jnp.bfloat16 else w_dtype)
+    gw = gw.at[ids.astype(jnp.int32)].add(g.astype(gw.dtype))
+    return (gw.astype(w_dtype), None)
+
+
+defprim(
+    "embedding_p",
+    _embedding_fwd,
+    vjp=_embedding_vjp,
+    save=lambda ins, outs: (ins[0].shape, ins[0].dtype, ins[1]),
+)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Reference: nn/functional/input.py embedding (note arg order: ids
+    first). Grad scatter accumulates in f32 when weights are bf16."""
+    ids, w = ensure_tensor(x), ensure_tensor(weight)
+    pi = None
+    if padding_idx is not None:
+        pi = int(padding_idx)
+        if pi < 0:
+            pi += w.shape[0]
+    return apply("embedding_p", w, ids, padding_idx=pi)
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+defprim(
+    "label_smooth_p",
+    lambda label, *, eps: label * (1.0 - eps) + eps / label.shape[-1],
+)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = ensure_tensor(label)
+    if prior_dist is not None:
+        pd = ensure_tensor(prior_dist)
+        from ...ops.math import add, scale, multiply
+
+        return add(scale(label, 1 - epsilon), scale(pd, epsilon))
+    return apply("label_smooth_p", label, eps=float(epsilon))
+
+
+defprim(
+    "cosine_similarity_p",
+    lambda x1, x2, *, axis, eps: jnp.sum(x1 * x2, axis=axis)
+    / jnp.maximum(
+        jnp.linalg.norm(x1, axis=axis) * jnp.linalg.norm(x2, axis=axis), eps
+    ),
+)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    from ...ops._helpers import binary_args
+
+    x1, x2 = binary_args(x1, x2)
+    return apply("cosine_similarity_p", x1, x2, axis=int(axis), eps=float(eps))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+    if bias is None:
+        return apply("bilinear_nobias_p", x1, x2, weight)
+    return apply("bilinear_p", x1, x2, weight, ensure_tensor(bias))
+
+
+defprim(
+    "bilinear_nobias_p",
+    lambda x1, x2, w: jnp.einsum("bi,oij,bj->bo", x1, w, x2),
+)
+defprim(
+    "bilinear_p",
+    lambda x1, x2, w, b: jnp.einsum("bi,oij,bj->bo", x1, w, x2) + b,
+)
+
+
+# ---------------------------------------------------------------------------
+# interpolate / upsample
+# ---------------------------------------------------------------------------
+def _interp_fwd(x, *, size, mode, align_corners, channels_first):
+    if channels_first:
+        spatial = x.shape[2:]
+        n_sp = len(spatial)
+        moved = jnp.moveaxis(x, 1, -1)  # N, *sp, C
+    else:
+        spatial = x.shape[1:-1]
+        n_sp = len(spatial)
+        moved = x
+    jmode = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "linear": "linear",
+        "trilinear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode]
+    out_shape = (moved.shape[0],) + tuple(size) + (moved.shape[-1],)
+    if align_corners and jmode != "nearest":
+        # jax.image.resize has no align_corners; emulate via scale_and_translate
+        out = _align_corners_resize(moved, tuple(size), jmode)
+    else:
+        out = jax.image.resize(moved, out_shape, method=jmode)
+    if channels_first:
+        out = jnp.moveaxis(out, -1, 1)
+    return out
+
+
+def _align_corners_resize(x, size, method):
+    # x: N, *sp, C
+    n_sp = len(size)
+    spatial = x.shape[1 : 1 + n_sp]
+    scale = jnp.array(
+        [(o - 1) / (i - 1) if i > 1 else 1.0 for i, o in zip(spatial, size)],
+        jnp.float32,
+    )
+    translate = jnp.zeros((n_sp,), jnp.float32) + 0.5 * (1 - scale) * 0
+    # align_corners maps pixel centers: out coord j ↔ in coord j*(i-1)/(o-1)
+    scale_ac = jnp.array(
+        [(i - 1) / (o - 1) if o > 1 else 0.0 for i, o in zip(spatial, size)],
+        jnp.float32,
+    )
+    # use scale_and_translate: out = resize with scale = 1/scale_ac
+    inv = jnp.where(scale_ac > 0, 1.0 / jnp.maximum(scale_ac, 1e-12), 1.0)
+    translate = 0.5 * (inv - 1)
+    out_shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    return jax.image.scale_and_translate(
+        x, out_shape, list(range(1, 1 + n_sp)), inv, translate,
+        method={"linear": "linear", "cubic": "cubic"}[method],
+    )
+
+
+defprim("interpolate_p", _interp_fwd)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format=None, name=None):
+    x = ensure_tensor(x)
+    n_sp = x.ndim - 2
+    if data_format is None:
+        data_format = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[n_sp]
+    channels_first = data_format.startswith("NC")
+    spatial = x.shape[2:] if channels_first else x.shape[1:-1]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * n_sp
+        if isinstance(scale_factor, Tensor):
+            scale_factor = scale_factor.tolist()
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    else:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        size = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in size]
+    return apply(
+        "interpolate_p", x, size=tuple(size), mode=mode,
+        align_corners=bool(align_corners), channels_first=channels_first,
+    )
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format=None, name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def _unfold_fwd(x, *, k, s, p, d):
+    n, c = x.shape[0], x.shape[1]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s,
+        padding=tuple((pi, pi) for pi in p), rhs_dilation=d,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    # patches: [N, C*kh*kw, oh, ow] → [N, C*kh*kw, L]
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+defprim("unfold_p", _unfold_fwd)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _ntuple
+
+    return apply(
+        "unfold_p", ensure_tensor(x), k=_ntuple(kernel_sizes, 2),
+        s=_ntuple(strides, 2), p=_ntuple(paddings, 2), d=_ntuple(dilations, 2),
+    )
+
+
+def _fold_fwd(x, *, output_sizes, k, s, p, d):
+    n, ckk, L = x.shape
+    c = ckk // (k[0] * k[1])
+    oh = (output_sizes[0] + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+    ow = (output_sizes[1] + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+    cols = x.reshape(n, c, k[0], k[1], oh, ow)
+    out = jnp.zeros((n, c, output_sizes[0] + 2 * p[0], output_sizes[1] + 2 * p[1]), x.dtype)
+    for i in range(k[0]):
+        for j in range(k[1]):
+            hi = i * d[0]
+            wj = j * d[1]
+            out = out.at[:, :, hi : hi + oh * s[0] : s[0], wj : wj + ow * s[1] : s[1]].add(
+                cols[:, :, i, j]
+            )
+    if p[0] or p[1]:
+        out = out[:, :, p[0] : out.shape[2] - p[0], p[1] : out.shape[3] - p[1]]
+    return out
+
+
+defprim("fold_p", _fold_fwd)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _ntuple
+
+    return apply(
+        "fold_p", ensure_tensor(x), output_sizes=_ntuple(output_sizes, 2),
+        k=_ntuple(kernel_sizes, 2), s=_ntuple(strides, 2), p=_ntuple(paddings, 2),
+        d=_ntuple(dilations, 2),
+    )
+
+
+def _pixel_shuffle_fwd(x, *, factor, channels_first):
+    if not channels_first:
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    r = factor
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // (r * r), h * r, w * r)
+    if not channels_first:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+defprim("pixel_shuffle_p", _pixel_shuffle_fwd)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return apply(
+        "pixel_shuffle_p", ensure_tensor(x), factor=int(upscale_factor),
+        channels_first=data_format.startswith("NC"),
+    )
+
+
+def _pixel_unshuffle_fwd(x, *, factor, channels_first):
+    if not channels_first:
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    r = factor
+    out = x.reshape(n, c, h // r, r, w // r, r)
+    out = out.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r, w // r)
+    if not channels_first:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+defprim("pixel_unshuffle_p", _pixel_unshuffle_fwd)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return apply(
+        "pixel_unshuffle_p", ensure_tensor(x), factor=int(downscale_factor),
+        channels_first=data_format.startswith("NC"),
+    )
+
+
+def _channel_shuffle_fwd(x, *, groups, channels_first):
+    if not channels_first:
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    rest = x.shape[2:]
+    out = x.reshape(n, groups, c // groups, *rest)
+    out = jnp.swapaxes(out, 1, 2).reshape(n, c, *rest)
+    if not channels_first:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+defprim("channel_shuffle_p", _channel_shuffle_fwd)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return apply(
+        "channel_shuffle_p", ensure_tensor(x), groups=int(groups),
+        channels_first=data_format.startswith("NC"),
+    )
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as _pad
+
+    return _pad(x, padding, mode="constant", value=0.0, data_format=data_format)

@@ -1,0 +1,215 @@
+"""Convolution functional ops.
+
+Reference: python/paddle/nn/functional/conv.py over phi conv kernels
+(gpudnn). TPU design: lax.conv_general_dilated — XLA lowers convs onto the
+MXU directly; NHWC is the TPU-preferred layout and both NCHW/NHWC data
+formats are supported (XLA inserts transposes for NCHW).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from ...ops._helpers import defprim, ensure_tensor
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _ntuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _pad_spec(padding, n, data_format):
+    """Normalize paddle padding spec → lax pairs or string."""
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, (int, np.integer)):
+        return tuple((int(padding), int(padding)) for _ in range(n))
+    padding = list(padding)
+    if len(padding) == n and not isinstance(padding[0], (list, tuple)):
+        return tuple((int(p), int(p)) for p in padding)
+    if len(padding) == 2 * n:
+        return tuple(
+            (int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)
+        )
+    # paddle also allows [[0,0],[0,0],[ph,ph],[pw,pw]] including batch/channel
+    pairs = [tuple(int(x) for x in p) for p in padding]
+    if len(pairs) == n + 2:
+        if data_format.startswith("NC"):
+            pairs = pairs[2:]
+        else:
+            pairs = pairs[1:-1]
+    return tuple(pairs)
+
+
+def _conv_fwd(x, w, *, strides, padding, dilations, groups, dn, n):
+    return jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=padding,
+        rhs_dilation=dilations,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+
+
+defprim("conv_p", _conv_fwd)
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    strides = _ntuple(stride, n)
+    dilations = _ntuple(dilation, n)
+    pad = _pad_spec(padding, n, data_format)
+    spatial = "DHW"[3 - n :]
+    if data_format.startswith("NC"):
+        lhs = "NC" + spatial
+        out = "NC" + spatial
+    else:
+        lhs = "N" + spatial + "C"
+        out = "N" + spatial + "C"
+    rhs = "OI" + spatial  # paddle weight layout [out_c, in_c/groups, *k]
+    y = apply(
+        "conv_p", x, weight,
+        strides=strides, padding=pad, dilations=dilations, groups=int(groups),
+        dn=(lhs, rhs, out), n=n,
+    )
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        if data_format.startswith("NC"):
+            shape = [1, bias.shape[0]] + [1] * n
+        else:
+            shape = [1] * (n + 1) + [bias.shape[0]]
+        from ...ops.manipulation import reshape
+        from ...ops.math import add
+
+        y = add(y, reshape(bias, shape))
+    return y
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 "NCW" if data_format == "NCL" else "NWC", 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
+
+
+def _conv_transpose_fwd(x, w, *, strides, padding, output_padding, dilations,
+                        groups, dn, n):
+    # paddle weight layout for transpose conv: [in_c, out_c/groups, *k]
+    if groups > 1:
+        # grouped transposed conv via per-group vmap-free concat
+        in_per = x.shape[dn[0].index("C")] // groups
+        outs = []
+        xs = jnp.split(x, groups, axis=dn[0].index("C"))
+        ws = jnp.split(w, groups, axis=0)
+        for xg, wg in zip(xs, ws):
+            outs.append(
+                _conv_transpose_fwd(
+                    xg, wg, strides=strides, padding=padding,
+                    output_padding=output_padding, dilations=dilations,
+                    groups=1, dn=dn, n=n,
+                )
+            )
+        return jnp.concatenate(outs, axis=dn[2].index("C"))
+    out = jax.lax.conv_transpose(
+        x,
+        jnp.swapaxes(w, 0, 1),  # → [out_c, in_c, *k] then spec IO handles
+        strides=strides,
+        padding=padding,
+        rhs_dilation=dilations,
+        dimension_numbers=dn,
+        transpose_kernel=True,
+    )
+    if any(output_padding):
+        pads = [(0, 0)] * out.ndim
+        spatial_axes = [i for i, c in enumerate(dn[2]) if c not in "NC"]
+        for ax, op_ in zip(spatial_axes, output_padding):
+            pads[ax] = (0, op_)
+        out = jnp.pad(out, pads)
+    return out
+
+
+defprim("conv_transpose_p", _conv_transpose_fwd)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, data_format, n, output_size=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    strides = _ntuple(stride, n)
+    dilations = _ntuple(dilation, n)
+    out_pad = _ntuple(output_padding, n)
+    spatial = "DHW"[3 - n :]
+    if data_format.startswith("NC"):
+        lhs = "NC" + spatial
+    else:
+        lhs = "N" + spatial + "C"
+    dn = (lhs, "OI" + spatial, lhs)
+    pad = _pad_spec(padding, n, data_format)
+    if isinstance(pad, tuple):
+        # lax.conv_transpose interprets padding on the *output*; convert the
+        # paddle "input padding" convention: out_pad_lo = k - 1 - p
+        k = weight.shape[2:]
+        pad = tuple(
+            (
+                dilations[i] * (k[i] - 1) - pad[i][0],
+                dilations[i] * (k[i] - 1) - pad[i][1],
+            )
+            for i in range(n)
+        )
+    y = apply(
+        "conv_transpose_p", x, weight,
+        strides=strides, padding=pad, output_padding=out_pad,
+        dilations=dilations, groups=int(groups), dn=dn, n=n,
+    )
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        from ...ops.manipulation import reshape
+        from ...ops.math import add
+
+        if data_format.startswith("NC"):
+            shape = [1, bias.shape[0]] + [1] * n
+        else:
+            shape = [1] * (n + 1) + [bias.shape[0]]
+        y = add(y, reshape(bias, shape))
+    return y
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups,
+                           "NCW" if data_format == "NCL" else "NWC", 1, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3, output_size)

@@ -1,0 +1,43 @@
+"""paddle.nn.functional.flash_attention submodule parity.
+
+Reference: python/paddle/nn/functional/flash_attention.py (flash_attention
+:198, flash_attn_unpadded :602, scaled_dot_product_attention :991).
+"""
+from .attention import (  # noqa: F401
+    flash_attention, scaled_dot_product_attention, sdp_kernel,
+)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen flash attention. The TPU path currently buckets to the padded
+    dense form (XLA static shapes); a Pallas varlen kernel is the planned
+    fast path."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor
+    from ...ops._helpers import ensure_tensor
+
+    q = ensure_tensor(query)
+    k = ensure_tensor(key)
+    v = ensure_tensor(value)
+    cu_q = [int(i) for i in ensure_tensor(cu_seqlens_q).tolist()]
+    cu_k = [int(i) for i in ensure_tensor(cu_seqlens_k).tolist()]
+    outs = []
+    for i in range(len(cu_q) - 1):
+        qs = q[cu_q[i] : cu_q[i + 1]]
+        ks = k[cu_k[i] : cu_k[i + 1]]
+        vs = v[cu_k[i] : cu_k[i + 1]]
+        from ...ops.manipulation import unsqueeze, squeeze
+
+        o = scaled_dot_product_attention(
+            unsqueeze(qs, 0), unsqueeze(ks, 0), unsqueeze(vs, 0),
+            dropout_p=dropout, is_causal=causal, training=training,
+        )
+        outs.append(squeeze(o, 0))
+    from ...ops.manipulation import concat
+
+    return concat(outs, axis=0), None

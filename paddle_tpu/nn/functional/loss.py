@@ -1,0 +1,411 @@
+"""Loss functional ops.
+
+Reference: python/paddle/nn/functional/loss.py over phi
+softmax_with_cross_entropy etc. cross_entropy keeps the reference's
+combined softmax+CE semantics (soft/hard labels, ignore_index, weights) —
+the log-softmax fusion is numerically stable and XLA-fused on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from ...ops._helpers import binary_args, defprim, ensure_tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "square_error_cost",
+    "sigmoid_focal_loss", "hinge_embedding_loss", "cosine_embedding_loss",
+    "triplet_margin_loss", "soft_margin_loss", "multi_label_soft_margin_loss",
+    "log_loss", "npair_loss",
+]
+
+
+def _reduce_loss(loss, reduction):
+    from ...ops import math as m
+
+    if reduction == "mean":
+        return m.mean(loss)
+    if reduction == "sum":
+        return m.sum(loss)
+    return loss
+
+
+def _hard_ce_general(logits, label, *, axis, ignore_index, use_softmax):
+    axis = axis % logits.ndim
+    logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+        jnp.maximum(logits, 1e-30)
+    )
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    nll = -jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis)
+    nll = jnp.squeeze(nll, axis)
+    return jnp.where(valid, nll, 0.0)
+
+
+defprim("hard_ce_p", _hard_ce_general)
+defprim(
+    "soft_ce_p",
+    lambda logits, label, *, axis, use_softmax: -jnp.sum(
+        label
+        * (
+            jax.nn.log_softmax(logits, axis=axis)
+            if use_softmax
+            else jnp.log(jnp.maximum(logits, 1e-30))
+        ),
+        axis=axis,
+    ),
+)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """Reference: functional/loss.py cross_entropy (soft+hard paths,
+    ignore_index, per-class weight, label smoothing)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    from ...ops import math as m
+
+    if label_smoothing > 0.0:
+        n_classes = input.shape[axis]
+        if not soft_label:
+            from ...ops.creation import one_hot
+
+            if label.ndim == input.ndim and label.shape[axis] == 1:
+                from ...ops.manipulation import squeeze
+
+                label = squeeze(label, axis)
+            label = one_hot(label, n_classes)
+            soft_label = True
+        from .common import label_smooth
+
+        label = label_smooth(label, epsilon=label_smoothing)
+
+    if soft_label:
+        loss = apply(
+            "soft_ce_p", input, label.astype(input.dtype), axis=int(axis),
+            use_softmax=bool(use_softmax),
+        )
+    else:
+        if label.ndim == input.ndim and label.shape[axis] == 1:
+            from ...ops.manipulation import squeeze
+
+            label = squeeze(label, axis)
+        loss = apply(
+            "hard_ce_p", input, label, axis=int(axis),
+            ignore_index=int(ignore_index), use_softmax=bool(use_softmax),
+        )
+        if weight is not None:
+            w = ensure_tensor(weight)
+            from ...ops.manipulation import gather
+
+            wsel = gather(w, label.flatten() if label.ndim > 1 else label, 0)
+            if label.ndim > 1:
+                from ...ops.manipulation import reshape
+
+                wsel = reshape(wsel, label.shape)
+            loss = m.multiply(loss, wsel.astype(loss.dtype))
+            if reduction == "mean":
+                return m.divide(m.sum(loss), m.sum(wsel))
+    return _reduce_loss(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        reduction="none", axis=axis,
+    )
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from ...ops.activation import softmax
+
+        return loss, softmax(logits, axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    input, label = binary_args(input, label)
+    from ...ops import math as m
+
+    return _reduce_loss(m.square(m.subtract(input, label)), reduction)
+
+
+def square_error_cost(input, label):
+    input, label = binary_args(input, label)
+    from ...ops import math as m
+
+    return m.square(m.subtract(input, label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    input, label = binary_args(input, label)
+    from ...ops import math as m
+
+    return _reduce_loss(m.abs(m.subtract(input, label)), reduction)
+
+
+def _nll_fwd(logp, label, *, ignore_index):
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    nll = -jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+    return jnp.where(valid, nll, 0.0)
+
+
+defprim("nll_p", _nll_fwd)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    orig_shape = None
+    if input.ndim > 2:
+        # N,C,d1..dk → N*prod(d),C
+        from ...ops.manipulation import moveaxis, reshape
+
+        c = input.shape[1]
+        orig_shape = label.shape
+        input = reshape(moveaxis(input, 1, -1), [-1, c])
+        label = reshape(label, [-1])
+    loss = apply("nll_p", input, label, ignore_index=int(ignore_index))
+    from ...ops import math as m
+
+    if weight is not None:
+        from ...ops.manipulation import gather
+
+        w = gather(ensure_tensor(weight), label, 0).astype(loss.dtype)
+        loss = m.multiply(loss, w)
+        if reduction == "mean":
+            return m.divide(m.sum(loss), m.sum(w))
+    if orig_shape is not None and reduction == "none":
+        from ...ops.manipulation import reshape
+
+        loss = reshape(loss, list(orig_shape))
+    return _reduce_loss(loss, reduction)
+
+
+defprim(
+    "bce_p",
+    lambda x, y: -(y * jnp.log(jnp.maximum(x, 1e-12))
+                   + (1 - y) * jnp.log(jnp.maximum(1 - x, 1e-12))),
+)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    input, label = binary_args(input, label)
+    loss = apply("bce_p", input, label)
+    from ...ops import math as m
+
+    if weight is not None:
+        loss = m.multiply(loss, ensure_tensor(weight))
+    return _reduce_loss(loss, reduction)
+
+
+defprim(
+    "bce_logits_p",
+    lambda x, y: jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x))),
+)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    logit, label = binary_args(logit, label)
+    from ...ops import math as m
+
+    if pos_weight is not None:
+        pw = ensure_tensor(pos_weight)
+        loss = apply("bce_logits_posw_p", logit, label, pw)
+    else:
+        loss = apply("bce_logits_p", logit, label)
+    if weight is not None:
+        loss = m.multiply(loss, ensure_tensor(weight))
+    return _reduce_loss(loss, reduction)
+
+
+defprim(
+    "bce_logits_posw_p",
+    lambda x, y, pw: (1 - y) * x
+    + (1 + (pw - 1) * y) * (jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(-x, 0)),
+)
+
+
+defprim(
+    "kl_div_p",
+    lambda x, y: y * (jnp.log(jnp.maximum(y, 1e-12)) - x),
+)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    input, label = binary_args(input, label)
+    if log_target:
+        loss = apply("kl_div_logt_p", input, label)
+    else:
+        loss = apply("kl_div_p", input, label)
+    if reduction == "batchmean":
+        from ...ops import math as m
+
+        return m.divide(m.sum(loss), float(input.shape[0]))
+    return _reduce_loss(loss, reduction)
+
+
+defprim("kl_div_logt_p", lambda x, y: jnp.exp(y) * (y - x))
+
+
+defprim(
+    "smooth_l1_p",
+    lambda x, y, *, delta: jnp.where(
+        jnp.abs(x - y) < delta,
+        0.5 * (x - y) ** 2 / delta,
+        jnp.abs(x - y) - 0.5 * delta,
+    ),
+)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    input, label = binary_args(input, label)
+    loss = apply("smooth_l1_p", input, label, delta=float(delta))
+    return _reduce_loss(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    input, other = binary_args(input, other)
+    label = ensure_tensor(label)
+    from ...ops import math as m
+
+    loss = m.clip(
+        m.add(m.multiply(m.neg(label), m.subtract(input, other)), margin), min=0.0
+    )
+    return _reduce_loss(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    logit, label = binary_args(logit, label)
+    loss = apply("focal_p", logit, label, alpha=float(alpha), gamma=float(gamma))
+    if normalizer is not None:
+        from ...ops import math as m
+
+        loss = m.divide(loss, ensure_tensor(normalizer))
+    return _reduce_loss(loss, reduction)
+
+
+def _focal_fwd(x, y, *, alpha, gamma):
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * y + (1 - p) * (1 - y)
+    a_t = alpha * y + (1 - alpha) * (1 - y)
+    return a_t * ce * jnp.power(1 - p_t, gamma)
+
+
+defprim("focal_p", _focal_fwd)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    input, label = binary_args(input, label)
+    from ...ops import math as m
+
+    from ...ops.manipulation import where
+
+    loss = where(
+        ensure_tensor(label) == 1.0, input, m.clip(m.subtract(float(margin), input), min=0.0)
+    )
+    return _reduce_loss(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    from .common import cosine_similarity
+    from ...ops import math as m
+    from ...ops.manipulation import where
+
+    sim = cosine_similarity(input1, input2, axis=-1, eps=1e-12)
+    label = ensure_tensor(label)
+    loss = where(
+        label == 1.0, m.subtract(1.0, sim), m.clip(m.subtract(sim, float(margin)), min=0.0)
+    )
+    return _reduce_loss(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    from ...ops import math as m
+    from ...ops.linalg import norm
+
+    input, positive = binary_args(input, positive)
+    negative = ensure_tensor(negative)
+    d_pos = norm(m.subtract(input, positive), p=p, axis=-1)
+    d_neg = norm(m.subtract(input, negative), p=p, axis=-1)
+    if swap:
+        d_neg2 = norm(m.subtract(positive, negative), p=p, axis=-1)
+        d_neg = m.minimum(d_neg, d_neg2)
+    loss = m.clip(m.add(m.subtract(d_pos, d_neg), float(margin)), min=0.0)
+    return _reduce_loss(loss, reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    input, label = binary_args(input, label)
+    loss = apply("soft_margin_p", input, label)
+    return _reduce_loss(loss, reduction)
+
+
+defprim("soft_margin_p", lambda x, y: jnp.log1p(jnp.exp(-y * x)))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    input, label = binary_args(input, label)
+    from ...ops import math as m
+
+    loss = apply("ml_soft_margin_p", input, label)
+    if weight is not None:
+        loss = m.multiply(loss, ensure_tensor(weight))
+    loss = m.mean(loss, axis=-1)
+    return _reduce_loss(loss, reduction)
+
+
+defprim(
+    "ml_soft_margin_p",
+    lambda x, y: -(
+        y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x)
+    ),
+)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    input, label = binary_args(input, label)
+    return apply("log_loss_p", input, label, eps=float(epsilon))
+
+
+defprim(
+    "log_loss_p",
+    lambda x, y, *, eps: -y * jnp.log(x + eps) - (1 - y) * jnp.log(1 - x + eps),
+)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    from ...ops import math as m
+    from ...ops.manipulation import reshape
+
+    anchor, positive = binary_args(anchor, positive)
+    labels = ensure_tensor(labels)
+    batch = anchor.shape[0]
+    sim = m.matmul(anchor, positive, transpose_y=True)
+    lbl = reshape(labels, [batch, 1])
+    from ...ops.comparison import equal
+
+    target = equal(lbl, reshape(labels, [1, batch])).astype(anchor.dtype)
+    target = m.divide(target, m.sum(target, axis=1, keepdim=True))
+    ce = cross_entropy(sim, target, soft_label=True, reduction="mean")
+    reg = m.scale(
+        m.add(m.sum(m.square(anchor)), m.sum(m.square(positive))),
+        l2_reg / anchor.shape[0],
+    )
+    return m.add(ce, reg)

@@ -1,0 +1,191 @@
+"""Pooling functional ops.
+
+Reference: python/paddle/nn/functional/pooling.py over phi pool kernels.
+lax.reduce_window maps pooling straight onto the VPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from ...ops._helpers import defprim, ensure_tensor
+from .conv import _ntuple
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _window(kernel, stride, padding, n, channels_first, ceil_mode):
+    dims = (1, 1) + kernel if channels_first else (1,) + kernel + (1,)
+    strides = (1, 1) + stride if channels_first else (1,) + stride + (1,)
+    if isinstance(padding, str):
+        pads = padding.upper()
+    else:
+        p = _ntuple(padding, n) if not isinstance(padding, (list, tuple)) or len(padding) != 2 * n else None
+        if p is not None:
+            pairs = tuple((pi, pi) for pi in p)
+        else:
+            pairs = tuple((int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n))
+        z = ((0, 0), (0, 0)) if channels_first else ((0, 0),)
+        pads = ((0, 0), (0, 0)) + pairs if channels_first else ((0, 0),) + pairs + ((0, 0),)
+    return dims, strides, pads
+
+
+def _pool_fwd(x, *, kind, dims, strides, pads, exclusive, ceil_mode):
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, pads)
+    # avg
+    ones = jnp.ones_like(x)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
+    if exclusive and pads != "VALID":
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pads)
+        return s / cnt
+    denom = float(np.prod([d for d in dims]))
+    return s / denom
+
+
+defprim("pool_p", _pool_fwd)
+
+
+def _pool(x, kind, kernel_size, stride, padding, n, data_format, exclusive=True,
+          ceil_mode=False):
+    x = ensure_tensor(x)
+    channels_first = data_format.startswith("NC")
+    kernel = _ntuple(kernel_size, n)
+    stride = _ntuple(stride if stride is not None else kernel_size, n)
+    dims, strides, pads = _window(kernel, stride, padding, n, channels_first, ceil_mode)
+    return apply(
+        "pool_p", x, kind=kind, dims=dims, strides=strides, pads=pads,
+        exclusive=bool(exclusive), ceil_mode=bool(ceil_mode),
+    )
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format == "NCL" else "NWC"
+    return _pool(x, "max", kernel_size, stride, padding, 1, df, ceil_mode=ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, 2, data_format,
+                 ceil_mode=ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, 3, data_format,
+                 ceil_mode=ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format == "NCL" else "NWC"
+    return _pool(x, "avg", kernel_size, stride, padding, 1, df, exclusive, ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, 2, data_format,
+                 exclusive, ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, 3, data_format,
+                 exclusive, ceil_mode)
+
+
+def _adaptive_pool_fwd(x, *, kind, out_sizes, channels_first, n):
+    spatial_off = 2 if channels_first else 1
+    out = x
+    for i, os in enumerate(out_sizes):
+        ax = spatial_off + i
+        in_size = out.shape[ax]
+        # split into os nearly-equal windows (paddle adaptive semantics:
+        # start = floor(i*in/out), end = ceil((i+1)*in/out))
+        starts = [int(np.floor(j * in_size / os)) for j in range(os)]
+        ends = [int(np.ceil((j + 1) * in_size / os)) for j in range(os)]
+        if len(set(np.array(ends) - np.array(starts))) == 1:
+            w = ends[0] - starts[0]
+            stride = starts[1] - starts[0] if os > 1 else 1
+            windows = [1] * out.ndim
+            strides = [1] * out.ndim
+            windows[ax] = w
+            strides[ax] = stride
+            if kind == "max":
+                out = jax.lax.reduce_window(
+                    out, -jnp.inf, jax.lax.max, tuple(windows), tuple(strides), "VALID"
+                )
+            else:
+                out = (
+                    jax.lax.reduce_window(
+                        out, 0.0, jax.lax.add, tuple(windows), tuple(strides), "VALID"
+                    )
+                    / w
+                )
+        else:
+            pieces = []
+            for s, e in zip(starts, ends):
+                sl = [slice(None)] * out.ndim
+                sl[ax] = slice(s, e)
+                seg = out[tuple(sl)]
+                red = jnp.max(seg, axis=ax, keepdims=True) if kind == "max" else jnp.mean(
+                    seg, axis=ax, keepdims=True
+                )
+                pieces.append(red)
+            out = jnp.concatenate(pieces, axis=ax)
+    return out
+
+
+defprim("adaptive_pool_p", _adaptive_pool_fwd)
+
+
+def _adaptive(x, kind, output_size, n, data_format):
+    x = ensure_tensor(x)
+    channels_first = data_format.startswith("NC")
+    if isinstance(output_size, (int, np.integer)):
+        out_sizes = (int(output_size),) * n
+    else:
+        spatial_off = 2 if channels_first else 1
+        out_sizes = tuple(
+            int(o) if o is not None else x.shape[spatial_off + i]
+            for i, o in enumerate(output_size)
+        )
+    return apply(
+        "adaptive_pool_p", x, kind=kind, out_sizes=out_sizes,
+        channels_first=channels_first, n=n,
+    )
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, "avg", output_size, 1, "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, "avg", output_size, 2, data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, "avg", output_size, 3, data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, "max", output_size, 1, "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, "max", output_size, 2, "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, "max", output_size, 3, "NCDHW")

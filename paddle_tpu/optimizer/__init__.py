@@ -1,1 +1,6 @@
-"""placeholder."""
+"""paddle.optimizer parity surface. Reference: python/paddle/optimizer/."""
+from .optimizer import Optimizer
+from .optimizers import (
+    SGD, Momentum, Adam, AdamW, RMSProp, Adagrad, Adadelta, Adamax, Lamb,
+)
+from . import lr

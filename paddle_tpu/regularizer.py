@@ -1,0 +1,31 @@
+"""Weight regularizers.
+
+Reference: python/paddle/regularizer.py (L1Decay, L2Decay — applied to grads
+by the optimizer when the param has no own regularizer).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    def _apply(self, param, grad):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def _apply(self, param, grad):
+        return grad + jnp.asarray(self.coeff, grad.dtype) * param.astype(grad.dtype)
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def _apply(self, param, grad):
+        return grad + jnp.asarray(self.coeff, grad.dtype) * jnp.sign(
+            param.astype(grad.dtype)
+        )

@@ -1,1 +1,49 @@
-"""placeholder — filled in during round 1."""
+"""paddle.static parity (thin).
+
+Reference: python/paddle/static/ — the reference's separate static-graph
+mode (Program/Executor) collapses into jit.to_static on this framework
+(SURVEY §7 design stance): InputSpec describes traced inputs, and the
+Executor/Program surface is kept as a compatibility veneer over compiled
+functions for code being ported.
+"""
+from __future__ import annotations
+
+from ..jit import InputSpec  # noqa: F401
+
+
+class Program:
+    """Placeholder for ported code; real capture goes through jit.to_static."""
+
+    def __init__(self):
+        self._ops = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kw):
+        raise NotImplementedError(
+            "static Executor is not part of the TPU framework; decorate the "
+            "model with paddle_tpu.jit.to_static instead (SURVEY §7)"
+        )
+
+
+def name_scope(name):
+    import contextlib
+
+    return contextlib.nullcontext()
