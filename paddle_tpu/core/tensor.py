@@ -251,10 +251,10 @@ class Tensor:
         return bool(self.numpy())
 
     def __int__(self):
-        return int(self.numpy())
+        return int(self.numpy().reshape(-1)[0]) if self.size == 1 else int(self.numpy())
 
     def __float__(self):
-        return float(self.numpy())
+        return float(self.numpy().reshape(-1)[0]) if self.size == 1 else float(self.numpy())
 
     def __format__(self, spec):
         if self.ndim == 0:

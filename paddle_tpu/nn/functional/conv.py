@@ -130,9 +130,12 @@ def _conv_transpose_fwd(x, w, *, strides, padding, output_padding, dilations,
                 )
             )
         return jnp.concatenate(outs, axis=dn[2].index("C"))
+    # paddle transpose-conv weight layout is [in_c, out_c/groups, *k]; with
+    # transpose_kernel=True lax expects exactly the forward-conv kernel
+    # ("OIHW" where O = this op's input channels), i.e. paddle's layout as-is.
     out = jax.lax.conv_transpose(
         x,
-        jnp.swapaxes(w, 0, 1),  # → [out_c, in_c, *k] then spec IO handles
+        w,
         strides=strides,
         padding=padding,
         rhs_dilation=dilations,

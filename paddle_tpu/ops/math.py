@@ -369,9 +369,11 @@ def add_n(inputs, name=None):
     from ..core import dispatch
 
     if name_p not in dispatch.PRIMITIVES:
+        import functools as _ft
+
         dispatch.register_primitive(
             name_p,
-            lambda *xs: sum(xs[1:], start=xs[0]),
+            lambda *xs: _ft.reduce(jnp.add, xs),
             vjp=lambda g, saved, **kw: tuple(g[0] for _ in range(saved[0])),
             save=lambda ins, outs: (len(ins),),
         )

@@ -1,0 +1,33 @@
+"""Test harness configuration.
+
+Mirrors the reference test strategy (SURVEY §4): XLA-CPU stands in for TPU
+(the custom_cpu fake-device pattern, test/custom_runtime/), with an 8-device
+virtual mesh for distributed/sharding tests
+(xla_force_host_platform_device_count).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# the axon site hook forces jax_platforms=axon,cpu; override back to CPU so
+# CI runs on the virtual 8-device host mesh (no TPU needed)
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

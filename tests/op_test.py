@@ -1,0 +1,86 @@
+"""OpTest harness — numpy-oracle forward checks + numeric-gradient backward
+checks.
+
+Reference: test/legacy_test/op_test.py:418 (OpTest with check_output at
+:2905 and check_grad at :3109 comparing analytic grads against
+finite-difference numeric grads, get_numeric_gradient at :148).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(op_fn, np_fn, inputs: Sequence[np.ndarray], atol=1e-5,
+                 rtol=1e-5, kwargs: Optional[dict] = None):
+    """Run op_fn on Tensors and np_fn on arrays; compare."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(i) for i in inputs]
+    got = op_fn(*tensors, **kwargs)
+    want = np_fn(*inputs, **kwargs)
+    if isinstance(got, (list, tuple)):
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(
+                np.asarray(g.numpy(), np.float64), np.asarray(w, np.float64),
+                atol=atol, rtol=rtol,
+            )
+    else:
+        np.testing.assert_allclose(
+            np.asarray(got.numpy(), np.float64), np.asarray(want, np.float64),
+            atol=atol, rtol=rtol,
+        )
+
+
+def numeric_grad(fn, inputs: List[np.ndarray], wrt: int, delta=1e-3,
+                 kwargs: Optional[dict] = None) -> np.ndarray:
+    """Central finite differences of sum(fn(inputs)) w.r.t. inputs[wrt]
+    (reference: op_test.py:148 get_numeric_gradient)."""
+    kwargs = kwargs or {}
+
+    def f(x):
+        args = list(inputs)
+        args[wrt] = x
+        out = fn(*[paddle.to_tensor(a) for a in args], **kwargs)
+        if isinstance(out, (list, tuple)):
+            return sum(float(o.sum().numpy()) for o in out)
+        return float(out.sum().numpy())
+
+    x0 = inputs[wrt].astype(np.float64)
+    grad = np.zeros_like(x0)
+    flat = x0.reshape(-1)
+    gflat = grad.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        fp = f(x0.reshape(inputs[wrt].shape).astype(inputs[wrt].dtype))
+        flat[i] = orig - delta
+        fm = f(x0.reshape(inputs[wrt].shape).astype(inputs[wrt].dtype))
+        flat[i] = orig
+        gflat[i] = (fp - fm) / (2 * delta)
+    return grad
+
+
+def check_grad(op_fn, inputs: Sequence[np.ndarray], wrt: Sequence[int] = (0,),
+               atol=1e-2, rtol=1e-2, delta=1e-3, kwargs: Optional[dict] = None):
+    """Compare tape-autograd gradients against finite differences."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(i, stop_gradient=(idx not in wrt))
+               for idx, i in enumerate(inputs)]
+    out = op_fn(*tensors, **kwargs)
+    if isinstance(out, (list, tuple)):
+        total = None
+        for o in out:
+            s = o.sum()
+            total = s if total is None else total + s
+        total.backward()
+    else:
+        out.sum().backward()
+    for idx in wrt:
+        analytic = tensors[idx].grad.numpy().astype(np.float64)
+        numeric = numeric_grad(op_fn, list(inputs), idx, delta, kwargs)
+        np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol,
+                                   err_msg=f"grad mismatch wrt input {idx}")

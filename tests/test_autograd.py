@@ -1,0 +1,178 @@
+"""Autograd engine tests (reference: test/legacy_test/test_imperative_*.py,
+test/cpp/eager/ node tests, test_autograd_functional_*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _r(*shape):
+    return np.random.randn(*shape).astype("float32")
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x * 3).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 6 * x.numpy(), rtol=1e-6)
+
+    def test_branching_accumulation(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        a = x * 2
+        b = x * 3
+        (a + b).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_grad_accumulates_across_backwards(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor(_r(3), stop_gradient=False)
+        y = paddle.to_tensor(_r(3), stop_gradient=True)
+        (x * y).sum().backward()
+        assert x.grad is not None
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor(_r(3), stop_gradient=False)
+        d = (x * 2).detach()
+        assert d.stop_gradient
+        z = x * 2 + d
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_double_backward_raises_without_retain(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * x
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_backward_with_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 3
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+    def test_multi_output_op(self):
+        x = paddle.to_tensor(_r(6), stop_gradient=False)
+        parts = paddle.split(x, 3)
+        (parts[0].sum() * 2 + parts[2].sum()).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2, 2, 0, 0, 1, 1])
+
+    def test_no_grad(self):
+        x = paddle.to_tensor(_r(3), stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._node is None
+
+
+class TestGradAPI:
+    def test_grad_wrt_leaf(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = (x**3).sum()
+        (g,) = paddle.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), 3 * x.numpy() ** 2, rtol=1e-5)
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_grad_wrt_intermediate(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        h = x * 2
+        y = (h * h).sum()
+        (g,) = paddle.grad(y, h)
+        np.testing.assert_allclose(g.numpy(), 2 * h.numpy())
+
+    def test_allow_unused(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        z = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).sum()
+        gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+        assert gz is None
+        with pytest.raises(RuntimeError):
+            paddle.grad((x * 2).sum(), [z], allow_unused=False)
+
+
+class TestHooks:
+    def test_tensor_hook(self):
+        x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+        h = x * 2
+        h.register_hook(lambda g: g * 10)
+        h.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20.0, 20.0])
+
+    def test_leaf_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy()))
+        (x * 3).backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [3.0])
+
+    def test_hook_removal(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        handle = x.register_hook(lambda g: g * 100)
+        handle.remove()
+        (x * 2).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class CubeLayer(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * 3 * x * x
+
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = CubeLayer.apply(x)
+        y.backward()
+        np.testing.assert_allclose(y.numpy(), [8.0])
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+    def test_multi_io(self):
+        class MulAdd(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a * b, a + b
+
+            @staticmethod
+            def backward(ctx, ga, gb):
+                a, b = ctx.saved_tensor()
+                return ga * b + gb, ga * a + gb
+
+        a = paddle.to_tensor([2.0], stop_gradient=False)
+        b = paddle.to_tensor([3.0], stop_gradient=False)
+        m, s = MulAdd.apply(a, b)
+        (m + s).backward()
+        np.testing.assert_allclose(a.grad.numpy(), [4.0])
+        np.testing.assert_allclose(b.grad.numpy(), [3.0])
+
+
+class TestRetainGrads:
+    def test_non_leaf_grad(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        h = x * 2
+        h.retain_grads()
+        (h * 3).sum().backward()
+        np.testing.assert_allclose(h.grad.numpy(), [3.0, 3.0])

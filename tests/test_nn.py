@@ -1,0 +1,252 @@
+"""nn layer tests (reference: test/legacy_test/test_layers.py,
+test_linear.py, test_conv2d_op.py, test_layer_norm_op.py, ...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _r(*shape):
+    return np.random.randn(*shape).astype("float32")
+
+
+class TestLinear:
+    def test_forward(self):
+        lin = nn.Linear(8, 3)
+        x = _r(4, 8)
+        got = lin(paddle.to_tensor(x))
+        want = x @ np.asarray(lin.weight.numpy()) + lin.bias.numpy()
+        np.testing.assert_allclose(got.numpy(), want, atol=1e-5)
+
+    def test_no_bias(self):
+        lin = nn.Linear(8, 3, bias_attr=False)
+        assert lin.bias is None
+        assert lin(paddle.to_tensor(_r(2, 8))).shape == [2, 3]
+
+    def test_grad_flow(self):
+        lin = nn.Linear(4, 2)
+        out = lin(paddle.to_tensor(_r(3, 4)))
+        out.sum().backward()
+        assert lin.weight.grad is not None
+        assert lin.weight.grad.shape == [4, 2]
+        assert lin.bias.grad is not None
+
+
+class TestConvPool:
+    def test_conv2d_shape_and_oracle(self):
+        conv = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+        x = _r(2, 3, 16, 16)
+        y = conv(paddle.to_tensor(x))
+        assert y.shape == [2, 8, 16, 16]
+        # oracle vs scipy-style direct conv on one output position
+        w = conv.weight.numpy()
+        b = conv.bias.numpy()
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        want00 = (xp[0, :, 0:3, 0:3] * w[0]).sum() + b[0]
+        np.testing.assert_allclose(y.numpy()[0, 0, 0, 0], want00, atol=1e-4)
+
+    def test_conv2d_stride_groups(self):
+        conv = nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2)
+        y = conv(paddle.to_tensor(_r(1, 4, 8, 8)))
+        assert y.shape == [1, 8, 4, 4]
+
+    def test_conv_transpose(self):
+        deconv = nn.Conv2DTranspose(4, 2, 2, stride=2)
+        y = deconv(paddle.to_tensor(_r(1, 4, 5, 5)))
+        assert y.shape == [1, 2, 10, 10]
+
+    def test_pools(self):
+        x = paddle.to_tensor(_r(1, 2, 8, 8))
+        assert F.max_pool2d(x, 2).shape == [1, 2, 4, 4]
+        assert F.avg_pool2d(x, 2, stride=1).shape == [1, 2, 7, 7]
+        assert F.adaptive_avg_pool2d(x, 1).shape == [1, 2, 1, 1]
+        xn = x.numpy()
+        np.testing.assert_allclose(
+            F.adaptive_avg_pool2d(x, 1).numpy()[..., 0, 0], xn.mean((2, 3)),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            F.max_pool2d(x, 8).numpy()[..., 0, 0], xn.max((2, 3)), rtol=1e-6
+        )
+
+
+class TestNorms:
+    def test_layer_norm_oracle(self):
+        ln = nn.LayerNorm(16)
+        x = _r(4, 16)
+        got = ln(paddle.to_tensor(x)).numpy()
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        want = (x - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_rms_norm_oracle(self):
+        rms = nn.RMSNorm(16)
+        x = _r(2, 5, 16)
+        got = rms(paddle.to_tensor(x)).numpy()
+        want = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_batch_norm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = _r(4, 3, 5, 5)
+        y = bn(paddle.to_tensor(x)).numpy()
+        # per-channel normalized batch stats
+        np.testing.assert_allclose(y.mean((0, 2, 3)), 0.0, atol=1e-5)
+        np.testing.assert_allclose(y.std((0, 2, 3)), 1.0, atol=1e-3)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        bn.eval()
+        y2 = bn(paddle.to_tensor(x))
+        assert y2.shape == [4, 3, 5, 5]
+
+    def test_group_norm(self):
+        gn = nn.GroupNorm(2, 4)
+        y = gn(paddle.to_tensor(_r(2, 4, 3, 3))).numpy()
+        g = y.reshape(2, 2, 2 * 3 * 3)
+        np.testing.assert_allclose(g.mean(-1), 0.0, atol=1e-5)
+
+
+class TestDropoutEmbedding:
+    def test_dropout_train_eval(self):
+        drop = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        y = drop(x).numpy()
+        frac = (y == 0).mean()
+        assert 0.3 < frac < 0.7
+        np.testing.assert_allclose(y[y != 0], 2.0, rtol=1e-6)  # upscale
+        drop.eval()
+        np.testing.assert_allclose(drop(x).numpy(), 1.0)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor(np.array([[1, 2], [3, 1]], np.int64))
+        out = emb(idx)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+    def test_embedding_grad_scatter(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor(np.array([1, 1, 3], np.int64))
+        emb(idx).sum().backward()
+        g = emb.weight.grad.numpy()
+        np.testing.assert_allclose(g[1], 2.0)
+        np.testing.assert_allclose(g[3], 1.0)
+        np.testing.assert_allclose(g[0], 0.0)
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        idx = paddle.to_tensor(np.array([0, 1], np.int64))
+        out = emb(idx)
+        np.testing.assert_allclose(out.numpy()[0], 0.0)
+
+
+class TestActivationsLosses:
+    def test_softmax_ce_matches_manual(self):
+        logits = _r(8, 5)
+        labels = np.random.randint(0, 5, (8,)).astype(np.int64)
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        # manual
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[np.arange(8), labels]).mean()
+        np.testing.assert_allclose(float(loss), want, rtol=1e-5)
+
+    def test_ce_ignore_index(self):
+        logits = _r(4, 3)
+        labels = np.array([0, -100, 2, -100], np.int64)
+        loss = F.cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(labels), reduction="sum",
+            ignore_index=-100,
+        )
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -(np.log(p[0, 0]) + np.log(p[2, 2]))
+        np.testing.assert_allclose(float(loss), want, rtol=1e-5)
+
+    def test_soft_label_ce(self):
+        logits = _r(4, 3)
+        soft = np.random.rand(4, 3).astype(np.float32)
+        soft /= soft.sum(-1, keepdims=True)
+        loss = F.cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(soft), soft_label=True
+        )
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        logp = np.log(e / e.sum(-1, keepdims=True))
+        np.testing.assert_allclose(float(loss), -(soft * logp).sum(-1).mean(), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        x, y = _r(6), (np.random.rand(6) > 0.5).astype(np.float32)
+        got = F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(x), paddle.to_tensor(y)
+        )
+        p = 1 / (1 + np.exp(-x))
+        want = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(float(got), want, rtol=1e-4)
+
+    def test_activations(self):
+        x = _r(3, 4)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(
+            F.sigmoid(t).numpy(), 1 / (1 + np.exp(-x)), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            F.softmax(t).numpy().sum(-1), 1.0, rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            F.gelu(t).numpy(),
+            0.5 * x * (1 + np.vectorize(np.math.erf if hasattr(np, 'math') else __import__('math').erf)(x / np.sqrt(2))),
+            atol=1e-5,
+        )
+
+
+class TestContainersStateDict:
+    def test_sequential_layerlist(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        assert len(m) == 3
+        assert m(paddle.to_tensor(_r(5, 4))).shape == [5, 2]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(list(ll.parameters())) == 6
+
+    def test_state_dict_roundtrip(self, tmp_path):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = m.state_dict()
+        assert len(sd) == 4
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(sd, path)
+        loaded = paddle.load(path)
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict(loaded)
+        x = paddle.to_tensor(_r(3, 4))
+        np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), atol=1e-6)
+
+    def test_named_parameters_buffers(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(2, 2)
+                self.bn = nn.BatchNorm1D(2)
+
+        m = M()
+        names = dict(m.named_parameters())
+        assert "fc.weight" in names and "bn.weight" in names
+        buffers = dict(m.named_buffers())
+        assert "bn._mean" in buffers
+
+    def test_train_eval_propagation(self):
+        m = nn.Sequential(nn.Dropout(0.5), nn.Linear(2, 2))
+        m.eval()
+        assert not m[0].training
+        m.train()
+        assert m[0].training
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        lin.register_forward_post_hook(lambda l, i, o: calls.append("post"))
+        lin.register_forward_pre_hook(lambda l, i: calls.append("pre"))
+        lin(paddle.to_tensor(_r(1, 2)))
+        assert calls == ["pre", "post"]

@@ -1,0 +1,211 @@
+"""Op tests: shape manipulation + indexing (reference
+test/legacy_test/test_reshape_op.py, test_concat_op.py, test_gather_op.py,
+test_set_value_op.py...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+def _r(*shape):
+    return np.random.randn(*shape).astype("float32")
+
+
+class TestShapes:
+    def test_reshape(self):
+        x = _r(2, 3, 4)
+        got = paddle.reshape(paddle.to_tensor(x), [6, 4])
+        np.testing.assert_allclose(got.numpy(), x.reshape(6, 4))
+        got = paddle.reshape(paddle.to_tensor(x), [-1, 2])
+        assert got.shape == [12, 2]
+        # 0 copies the input dim (paddle semantics)
+        got = paddle.reshape(paddle.to_tensor(x), [0, 12])
+        assert got.shape == [2, 12]
+        check_grad(lambda t: paddle.reshape(t, [6, 4]), [x])
+
+    def test_transpose_t(self):
+        x = _r(2, 3, 4)
+        got = paddle.transpose(paddle.to_tensor(x), [2, 0, 1])
+        np.testing.assert_allclose(got.numpy(), x.transpose(2, 0, 1))
+        assert paddle.to_tensor(_r(3, 5)).T.shape == [5, 3]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = _r(1, 3, 1, 4)
+        assert paddle.squeeze(paddle.to_tensor(x)).shape == [3, 4]
+        assert paddle.squeeze(paddle.to_tensor(x), axis=0).shape == [3, 1, 4]
+        assert paddle.unsqueeze(paddle.to_tensor(_r(3, 4)), [0, 2]).shape == [1, 3, 1, 4]
+        assert paddle.flatten(paddle.to_tensor(x), 1, 2).shape == [1, 3, 4]
+
+    def test_concat_stack_split(self):
+        a, b = _r(2, 3), _r(2, 3)
+        got = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1)
+        np.testing.assert_allclose(got.numpy(), np.concatenate([a, b], 1))
+        got = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(got.numpy(), np.stack([a, b], 0))
+        parts = paddle.split(paddle.to_tensor(_r(6, 4)), 3, axis=0)
+        assert len(parts) == 3 and parts[0].shape == [2, 4]
+        parts = paddle.split(paddle.to_tensor(_r(7, 4)), [2, 5], axis=0)
+        assert parts[1].shape == [5, 4]
+        parts = paddle.split(paddle.to_tensor(_r(7, 4)), [2, -1], axis=0)
+        assert parts[1].shape == [5, 4]
+
+    def test_concat_grad(self):
+        a, b = _r(2, 3), _r(4, 3)
+        check_grad(
+            lambda x, y: paddle.concat([x, y], axis=0), [a, b], wrt=(0, 1)
+        )
+
+    def test_tile_expand(self):
+        x = _r(2, 3)
+        np.testing.assert_allclose(
+            paddle.tile(paddle.to_tensor(x), [2, 2]).numpy(), np.tile(x, (2, 2))
+        )
+        assert paddle.expand(paddle.to_tensor(_r(1, 3)), [5, 3]).shape == [5, 3]
+        assert paddle.broadcast_to(paddle.to_tensor(_r(3)), [2, 3]).shape == [2, 3]
+
+    def test_flip_roll_pad(self):
+        x = _r(3, 4)
+        np.testing.assert_allclose(
+            paddle.flip(paddle.to_tensor(x), [0]).numpy(), np.flip(x, 0)
+        )
+        np.testing.assert_allclose(
+            paddle.roll(paddle.to_tensor(x), 1, 0).numpy(), np.roll(x, 1, 0)
+        )
+        got = paddle.nn.functional.pad(
+            paddle.to_tensor(_r(1, 1, 3, 3)), [1, 1, 2, 2]
+        )
+        assert got.shape == [1, 1, 7, 5]
+
+
+class TestIndexing:
+    def test_basic_getitem(self):
+        x = _r(4, 5, 6)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t[1].numpy(), x[1])
+        np.testing.assert_allclose(t[1:3, ::2].numpy(), x[1:3, ::2])
+        np.testing.assert_allclose(t[..., -1].numpy(), x[..., -1])
+        np.testing.assert_allclose(t[None, 0].numpy(), x[None, 0])
+
+    def test_advanced_getitem(self):
+        x = _r(5, 6)
+        t = paddle.to_tensor(x)
+        idx = np.array([0, 2, 4])
+        np.testing.assert_allclose(t[paddle.to_tensor(idx)].numpy(), x[idx])
+        mask = x[:, 0] > 0
+        np.testing.assert_allclose(t[paddle.to_tensor(mask)].numpy(), x[mask])
+
+    def test_setitem(self):
+        x = _r(4, 4)
+        t = paddle.to_tensor(x.copy())
+        t[1, 2] = 7.0
+        x[1, 2] = 7.0
+        np.testing.assert_allclose(t.numpy(), x)
+        t[0] = 0.0
+        x[0] = 0.0
+        np.testing.assert_allclose(t.numpy(), x)
+
+    def test_getitem_grad(self):
+        x = _r(4, 5)
+        check_grad(lambda t: t[1:3], [x])
+
+    def test_gather_scatter(self):
+        x = _r(5, 3)
+        idx = np.array([0, 2], dtype=np.int64)
+        got = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(got.numpy(), x[idx])
+        upd = _r(2, 3)
+        got = paddle.scatter(
+            paddle.to_tensor(x), paddle.to_tensor(idx), paddle.to_tensor(upd)
+        )
+        want = x.copy()
+        want[idx] = upd
+        np.testing.assert_allclose(got.numpy(), want)
+
+    def test_gather_nd(self):
+        x = _r(3, 4, 5)
+        idx = np.array([[0, 1], [2, 3]], dtype=np.int64)
+        got = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(got.numpy(), x[[0, 2], [1, 3]])
+
+    def test_take_put_along_axis(self):
+        x = _r(3, 5)
+        idx = np.argsort(x, axis=1)[:, :2].astype(np.int64)
+        got = paddle.take_along_axis(
+            paddle.to_tensor(x), paddle.to_tensor(idx), axis=1
+        )
+        np.testing.assert_allclose(got.numpy(), np.take_along_axis(x, idx, 1))
+
+    def test_index_select_embedding_grad(self):
+        w = _r(10, 4)
+        idx = np.array([1, 3, 3, 7], dtype=np.int64)
+        check_grad(
+            lambda t: paddle.index_select(t, paddle.to_tensor(idx)), [w]
+        )
+
+    def test_where_masked_fill(self):
+        c = np.random.rand(3, 4) > 0.5
+        a, b = _r(3, 4), _r(3, 4)
+        got = paddle.where(paddle.to_tensor(c), paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(got.numpy(), np.where(c, a, b))
+        got = paddle.masked_fill(paddle.to_tensor(a), paddle.to_tensor(c), -1.0)
+        np.testing.assert_allclose(got.numpy(), np.where(c, -1.0, a))
+
+
+class TestSearchSort:
+    def test_topk(self):
+        x = _r(3, 10)
+        v, i = paddle.topk(paddle.to_tensor(x), 4, axis=1)
+        want = np.sort(x, 1)[:, ::-1][:, :4]
+        np.testing.assert_allclose(v.numpy(), want, rtol=1e-6)
+        np.testing.assert_array_equal(
+            np.take_along_axis(x, i.numpy().astype(np.int64), 1), v.numpy()
+        )
+
+    def test_sort_argsort(self):
+        x = _r(4, 6)
+        np.testing.assert_allclose(
+            paddle.sort(paddle.to_tensor(x), 1).numpy(), np.sort(x, 1)
+        )
+        np.testing.assert_array_equal(
+            paddle.argsort(paddle.to_tensor(x), 1).numpy(), np.argsort(x, 1)
+        )
+
+    def test_argmax_argmin(self):
+        x = _r(4, 6)
+        np.testing.assert_array_equal(
+            paddle.argmax(paddle.to_tensor(x), axis=1).numpy(), np.argmax(x, 1)
+        )
+        np.testing.assert_array_equal(
+            paddle.argmin(paddle.to_tensor(x)).numpy(), np.argmin(x)
+        )
+
+    def test_unique_nonzero(self):
+        x = np.array([1, 3, 1, 2, 3], np.int64)
+        got = paddle.unique(paddle.to_tensor(x))
+        np.testing.assert_array_equal(got.numpy(), [1, 2, 3])
+        y = np.array([[1, 0], [0, 2]], np.float32)
+        nz = paddle.nonzero(paddle.to_tensor(y))
+        np.testing.assert_array_equal(nz.numpy(), [[0, 0], [1, 1]])
+
+
+class TestComparison:
+    def test_compare_ops(self):
+        a, b = _r(3, 4), _r(3, 4)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_array_equal((ta > tb).numpy(), a > b)
+        np.testing.assert_array_equal((ta <= tb).numpy(), a <= b)
+        np.testing.assert_array_equal(paddle.equal(ta, ta).numpy(), a == a)
+        assert bool(paddle.allclose(ta, ta))
+        assert not bool(paddle.allclose(ta, tb))
+
+    def test_logical(self):
+        a = np.random.rand(4) > 0.5
+        b = np.random.rand(4) > 0.5
+        np.testing.assert_array_equal(
+            paddle.logical_and(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            a & b,
+        )
+        np.testing.assert_array_equal(
+            paddle.logical_not(paddle.to_tensor(a)).numpy(), ~a
+        )

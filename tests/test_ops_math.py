@@ -1,0 +1,184 @@
+"""Op tests: math/reduction/matmul (OpTest-style, reference
+test/legacy_test/test_elementwise_*_op.py, test_matmul_v2_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+def _r(*shape):
+    return np.random.randn(*shape).astype("float32")
+
+
+class TestElementwise:
+    def test_add_broadcast(self):
+        check_output(paddle.add, np.add, [_r(3, 4), _r(4)])
+        check_grad(paddle.add, [_r(3, 4), _r(4)], wrt=(0, 1))
+
+    def test_sub_mul_div(self):
+        a, b = _r(2, 5), _r(2, 5) + 2.0
+        check_output(paddle.subtract, np.subtract, [a, b])
+        check_output(paddle.multiply, np.multiply, [a, b])
+        check_output(paddle.divide, np.divide, [a, b])
+        check_grad(paddle.multiply, [a, b], wrt=(0, 1))
+        check_grad(paddle.divide, [a, b], wrt=(0, 1))
+
+    def test_scalar_ops(self):
+        x = paddle.to_tensor(_r(3, 3))
+        np.testing.assert_allclose((x + 1).numpy(), x.numpy() + 1, rtol=1e-6)
+        np.testing.assert_allclose((2 * x).numpy(), 2 * x.numpy(), rtol=1e-6)
+        np.testing.assert_allclose((1 - x).numpy(), 1 - x.numpy(), rtol=1e-6)
+        np.testing.assert_allclose((x / 2).numpy(), x.numpy() / 2, rtol=1e-6)
+        assert (x**2).numpy() == pytest.approx(x.numpy() ** 2, rel=1e-5)
+
+    def test_pow_mod_floor_divide(self):
+        a = np.abs(_r(4, 4)) + 0.5
+        b = np.abs(_r(4, 4)) + 0.5
+        check_output(paddle.pow, np.power, [a, b])
+        ia = np.random.randint(1, 10, (5,)).astype("int64")
+        ib = np.random.randint(1, 5, (5,)).astype("int64")
+        check_output(paddle.mod, np.mod, [ia, ib])
+        check_output(paddle.floor_divide, np.floor_divide, [ia, ib])
+
+    def test_unary(self):
+        x = np.abs(_r(3, 4)) + 0.1
+        for pfn, nfn in [
+            (paddle.sqrt, np.sqrt), (paddle.exp, np.exp), (paddle.log, np.log),
+            (paddle.abs, np.abs), (paddle.tanh, np.tanh),
+            (paddle.floor, np.floor), (paddle.ceil, np.ceil),
+            (paddle.square, np.square),
+        ]:
+            check_output(pfn, nfn, [x], atol=1e-4, rtol=1e-4)
+        check_grad(paddle.tanh, [x])
+        check_grad(paddle.sqrt, [x])
+        check_grad(paddle.exp, [x])
+
+    def test_clip_lerp(self):
+        x = _r(4, 4)
+        check_output(
+            paddle.clip, lambda a, min, max: np.clip(a, min, max), [x],
+            kwargs={"min": -0.5, "max": 0.5},
+        )
+        check_grad(paddle.clip, [x], kwargs={"min": -0.5, "max": 0.5})
+
+    def test_maximum_minimum(self):
+        a, b = _r(3, 3), _r(3, 3)
+        check_output(paddle.maximum, np.maximum, [a, b])
+        check_output(paddle.minimum, np.minimum, [a, b])
+
+    def test_add_n(self):
+        xs = [_r(2, 3) for _ in range(4)]
+        got = paddle.add_n([paddle.to_tensor(x) for x in xs])
+        np.testing.assert_allclose(got.numpy(), sum(xs), rtol=1e-6)
+
+    def test_cumsum_cumprod(self):
+        x = _r(3, 5)
+        check_output(
+            paddle.cumsum, lambda a, axis: np.cumsum(a, axis), [x],
+            kwargs={"axis": 1},
+        )
+        check_grad(paddle.cumsum, [x], kwargs={"axis": 1})
+        xp = np.abs(_r(3, 4)) + 0.5
+        check_output(
+            paddle.cumprod, lambda a, dim: np.cumprod(a, dim), [xp],
+            kwargs={"dim": 1}, atol=1e-4,
+        )
+
+
+class TestReduction:
+    def test_sum_mean(self):
+        x = _r(3, 4, 5)
+        check_output(
+            paddle.sum, lambda a, axis, keepdim: np.sum(a, axis, keepdims=keepdim),
+            [x], kwargs={"axis": 1, "keepdim": False},
+        )
+        check_output(
+            paddle.mean, lambda a, axis, keepdim: np.mean(a, axis, keepdims=keepdim),
+            [x], kwargs={"axis": (0, 2), "keepdim": True},
+        )
+        check_grad(paddle.sum, [x], kwargs={"axis": 1, "keepdim": False})
+        check_grad(paddle.mean, [_r(3, 4)], kwargs={"axis": 0, "keepdim": False})
+
+    def test_max_min_prod(self):
+        x = _r(4, 5)
+        check_output(
+            paddle.max, lambda a, axis: np.max(a, axis), [x], kwargs={"axis": 1}
+        )
+        check_output(
+            paddle.min, lambda a, axis: np.min(a, axis), [x], kwargs={"axis": 0}
+        )
+        check_output(
+            paddle.prod, lambda a, axis: np.prod(a, axis), [x * 0.5],
+            kwargs={"axis": 1}, atol=1e-4,
+        )
+        check_grad(paddle.max, [x], kwargs={"axis": 1})
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as np_lse
+
+        x = _r(3, 4)
+        check_output(
+            paddle.logsumexp, lambda a, axis: np_lse(a, axis=axis), [x],
+            kwargs={"axis": 1},
+        )
+
+    def test_all_any(self):
+        x = np.random.rand(3, 4) > 0.5
+        got = paddle.all(paddle.to_tensor(x), axis=1)
+        np.testing.assert_array_equal(got.numpy(), np.all(x, 1))
+        got = paddle.any(paddle.to_tensor(x), axis=0)
+        np.testing.assert_array_equal(got.numpy(), np.any(x, 0))
+
+
+class TestMatmul:
+    def test_matmul_2d(self):
+        a, b = _r(4, 8), _r(8, 3)
+        check_output(paddle.matmul, np.matmul, [a, b], atol=1e-4)
+        check_grad(paddle.matmul, [a, b], wrt=(0, 1))
+
+    def test_matmul_transpose(self):
+        a, b = _r(8, 4), _r(8, 3)
+        got = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True)
+        np.testing.assert_allclose(got.numpy(), a.T @ b, atol=1e-4)
+        a2, b2 = _r(4, 8), _r(3, 8)
+        got = paddle.matmul(paddle.to_tensor(a2), paddle.to_tensor(b2),
+                            transpose_y=True)
+        np.testing.assert_allclose(got.numpy(), a2 @ b2.T, atol=1e-4)
+
+    def test_batched(self):
+        a, b = _r(5, 4, 8), _r(5, 8, 3)
+        check_output(paddle.bmm, np.matmul, [a, b], atol=1e-4)
+
+    def test_einsum(self):
+        a, b = _r(3, 4), _r(4, 5)
+        got = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(got.numpy(), a @ b, atol=1e-4)
+
+    def test_dot_outer(self):
+        a, b = _r(7), _r(7)
+        check_output(paddle.dot, lambda x, y: np.dot(x, y), [a, b])
+        check_output(paddle.outer, np.outer, [a, b])
+
+
+class TestStat:
+    def test_std_var(self):
+        x = _r(4, 6)
+        np.testing.assert_allclose(
+            paddle.std(paddle.to_tensor(x)).numpy(), np.std(x, ddof=1), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            paddle.var(paddle.to_tensor(x), axis=1).numpy(),
+            np.var(x, axis=1, ddof=1), rtol=1e-5,
+        )
+
+    def test_median_quantile(self):
+        x = _r(5, 7)
+        np.testing.assert_allclose(
+            paddle.median(paddle.to_tensor(x)).numpy(), np.median(x), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            paddle.quantile(paddle.to_tensor(x), 0.3, axis=1).numpy(),
+            np.quantile(x, 0.3, axis=1), rtol=1e-5,
+        )
