@@ -1,0 +1,38 @@
+"""paddle.distributed parity surface.
+
+Reference: python/paddle/distributed/__init__.py. See SURVEY §2.3/§2.4 for
+the strategy inventory; the TPU mapping is mesh+GSPMD throughout.
+"""
+from __future__ import annotations
+
+from .env import (
+    barrier, get_backend, get_rank, get_world_size, init_parallel_env,
+    is_initialized,
+)
+from .communication import (  # noqa: F401
+    ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    all_to_all_single, broadcast, broadcast_object_list, destroy_process_group,
+    gather, get_group, irecv, isend, new_group, recv, reduce, reduce_scatter,
+    scatter, send, wait, P2POp, batch_isend_irecv,
+)
+from .auto_parallel.placement import (
+    Partial, Placement, ProcessMesh, Replicate, Shard,
+)
+from .auto_parallel.api import (
+    ShardingStage1, ShardingStage2, ShardingStage3, dtensor_from_fn, reshard,
+    shard_layer, shard_optimizer, shard_tensor, unshard_dtensor,
+)
+from .parallel_wrapper import DataParallel
+from . import fleet
+from . import auto_parallel
+from . import checkpoint
+from .launch_utils import spawn, launch
+
+# paddle.distributed.parallel compat namespace
+parallel = __import__(__name__ + ".env", fromlist=["env"])
+
+
+def get_device_count():
+    from . import env as _env
+
+    return _env.device_count()

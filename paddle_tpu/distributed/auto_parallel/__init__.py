@@ -1,0 +1,6 @@
+"""Auto-parallel namespace (reference: python/paddle/distributed/auto_parallel/)."""
+from .placement import Partial, Placement, ProcessMesh, Replicate, Shard
+from .api import (
+    ShardingStage1, ShardingStage2, ShardingStage3, dtensor_from_fn, reshard,
+    shard_layer, shard_optimizer, shard_tensor, unshard_dtensor,
+)

@@ -1,0 +1,184 @@
+"""Semi-auto parallel API: shard_tensor / reshard / shard_layer /
+shard_optimizer / dtensor_from_fn.
+
+Reference: python/paddle/distributed/auto_parallel/api.py (shard_tensor
+:132, dtensor_from_fn :580, reshard :679, shard_layer :1351, shard_optimizer
+:1112-1259, to_static :2348, shard_dataloader :2854) and the C++ DistTensor
+(phi/core/distributed/auto_parallel/dist_tensor.h) + 15 reshard functions
+(auto_parallel/reshard/).
+
+TPU re-design: a DistTensor is a Tensor whose jax.Array carries a
+NamedSharding over the ProcessMesh's jax Mesh. The 93 SPMD rules + reshard
+engine collapse into GSPMD: eager reshard = jax.device_put to the target
+NamedSharding (XLA emits the ICI collective program); traced reshard =
+with_sharding_constraint. Partial→Replicate materializes psum.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+from ...core.tensor import Parameter, Tensor
+from .placement import (
+    Partial, Placement, ProcessMesh, Replicate, Shard, placements_to_spec,
+)
+
+__all__ = [
+    "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+    "shard_optimizer", "ShardingStage0", "ShardingStage1", "ShardingStage2",
+    "ShardingStage3", "unshard_dtensor",
+]
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """Reference: auto_parallel/api.py:132. Returns a DistTensor-like Tensor
+    whose storage is laid out across the mesh per ``placements``."""
+    from ...ops._helpers import ensure_tensor
+
+    t = data if isinstance(data, Tensor) else ensure_tensor(data, dtype)
+    sharding = mesh.sharding(placements, t.ndim)
+    if _is_tracer(t._value):
+        val = jax.lax.with_sharding_constraint(t._value, sharding)
+        out = Tensor._from_value(val, stop_gradient=t.stop_gradient)
+    else:
+        val = jax.device_put(t._value, sharding)
+        if isinstance(t, (Parameter,)):
+            # shard in place so optimizers/layers keep their identity
+            t._replace_value(val)
+            out = t
+        else:
+            out = Tensor._from_value(val, stop_gradient=t.stop_gradient)
+            out.name = t.name
+    out._dist_attr = (mesh, tuple(placements))
+    if stop_gradient is not None:
+        out.stop_gradient = stop_gradient
+    return out
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh,
+                    placements: Sequence[Placement], *args, **kwargs) -> Tensor:
+    """Reference: api.py:580 — build the tensor then shard it (XLA will
+    fold the broadcast into the sharded initialization)."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements: Sequence[Placement]) -> Tensor:
+    """Reference: api.py:679 + the 15 C++ reshard functions. All pairwise
+    conversions (r→s, s→r, s→s', p→r, cross-mesh) become one device_put /
+    sharding constraint — GSPMD picks all_gather/reduce_scatter/ppermute."""
+    has_partial = any(isinstance(p, Partial) for p in placements)
+    sharding = mesh.sharding(placements, x.ndim)
+    if _is_tracer(x._value):
+        out = Tensor._from_value(
+            jax.lax.with_sharding_constraint(x._value, sharding),
+            stop_gradient=x.stop_gradient,
+        )
+    else:
+        out = Tensor._from_value(
+            jax.device_put(x._value, sharding), stop_gradient=x.stop_gradient
+        )
+    # keep autograd chain: reshard is identity w.r.t. values
+    out._node, out._out_slot = x._node, x._out_slot
+    out._dist_attr = (mesh, tuple(placements))
+    return out
+
+
+def unshard_dtensor(x: Tensor) -> Tensor:
+    if x._dist_attr is None:
+        return x
+    mesh, _ = x._dist_attr
+    return reshard(x, mesh, [Replicate() for _ in range(mesh.ndim)])
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn: Callable = None,
+                input_fn: Callable = None, output_fn: Callable = None):
+    """Reference: api.py:1351 — apply shard_fn(name, layer, mesh) to every
+    sublayer (default: replicate params onto the mesh)."""
+
+    def default_shard_fn(name, sublayer, mesh):
+        for pname, p in list(sublayer._parameters.items()):
+            if p is None or p._dist_attr is not None:
+                continue
+            shard_tensor(p, mesh, [Replicate() for _ in range(mesh.ndim)])
+
+    fn = shard_fn or default_shard_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inputs: input_fn(inputs, process_mesh)
+        )
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inputs, outputs: output_fn(outputs, process_mesh)
+        )
+    return layer
+
+
+class ShardingStage0:
+    """No optimizer-state sharding (pure DP)."""
+
+    def __init__(self, mesh_dim=None, mesh=None):
+        self.mesh_dim = mesh_dim
+
+
+class ShardingStage1:
+    """ZeRO-1: optimizer states sharded along the data axis
+    (reference: api.py:1112 ShardingStage1 / GroupSharded stage-1)."""
+
+    def __init__(self, mesh_dim="dp", mesh=None):
+        self.mesh_dim = mesh_dim
+
+
+class ShardingStage2(ShardingStage1):
+    """ZeRO-2 (states+grads). Under GSPMD grads are transient inside the
+    compiled step, so this is stage-1 with reduce-scattered grad layout —
+    XLA already emits reduce_scatter when outputs are sharded."""
+
+
+class ShardingStage3(ShardingStage1):
+    """ZeRO-3: parameters also sharded along the data axis."""
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Reference: api.py:1259 shard_optimizer. Shards accumulators to match
+    each parameter's sharding (and per shard_fn stage policy: stage1/2 shard
+    moments along the dp axis, stage3 also params)."""
+    opt = optimizer
+    opt._ensure_accumulators()
+    stage = shard_fn if shard_fn is not None else ShardingStage0()
+
+    for p in opt._parameter_list:
+        if p._dist_attr is None:
+            continue
+        mesh, placements = p._dist_attr
+        placements = list(placements)
+        if isinstance(stage, (ShardingStage1, ShardingStage2, ShardingStage3)):
+            # shard states on the dp mesh axis over the param's dim 0 when
+            # it is not already sharded there
+            try:
+                dp_idx = mesh.dim_names.index(stage.mesh_dim)
+            except ValueError:
+                dp_idx = None
+            if dp_idx is not None and isinstance(placements[dp_idx], Replicate):
+                if p.ndim > 0 and p._value.shape[0] % mesh.shape[dp_idx] == 0:
+                    placements[dp_idx] = Shard(0)
+        sharding = mesh.sharding(placements, p.ndim)
+        for store in opt._accumulators.values():
+            if id(p) in store:
+                store[id(p)] = jax.device_put(store[id(p)], sharding)
+        if id(p) in opt._master_weights:
+            opt._master_weights[id(p)] = jax.device_put(
+                opt._master_weights[id(p)], sharding
+            )
+        if isinstance(stage, ShardingStage3):
+            shard_tensor(p, mesh, placements)
+    return opt

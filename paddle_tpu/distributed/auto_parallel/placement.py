@@ -1,0 +1,209 @@
+"""Placements + ProcessMesh.
+
+Reference: paddle/phi/core/distributed/auto_parallel/placement_types.h
+(Shard/Replicate/Partial), process_mesh.h, python/paddle/distributed/
+auto_parallel/process_mesh.py.
+
+TPU mapping: ProcessMesh ≙ jax.sharding.Mesh over the device grid;
+placements per tensor dim ≙ jax.sharding.PartitionSpec entries. Partial
+(pending-reduce) state exists only transiently inside XLA; the API keeps it
+for parity and materializes it as replicate-after-psum.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh as JaxMesh
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+
+class ProcessMesh:
+    """N-D logical device mesh (reference: process_mesh.py ProcessMesh(mesh,
+    dim_names)). Backed by a jax.sharding.Mesh so GSPMD/pjit consume it
+    directly; collectives ride ICI along mesh axes."""
+
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._shape = list(arr.shape)
+        self._dim_names = list(dim_names)
+        self._process_ids = arr.reshape(-1).tolist()
+        devices = jax.devices()
+        try:
+            grid = np.asarray([devices[i] for i in arr.reshape(-1)]).reshape(arr.shape)
+        except IndexError:
+            raise ValueError(
+                f"mesh references device ids {arr.reshape(-1).tolist()} but only "
+                f"{len(devices)} devices are visible"
+            )
+        self._jax_mesh = JaxMesh(grid, tuple(self._dim_names))
+
+    # -- paddle parity surface ------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return list(self._process_ids)
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    def get_dim_size(self, name: str) -> int:
+        return self._shape[self._dim_names.index(name)]
+
+    def get_rank_by_dim_and_process_id(self, dim, pid):
+        idx = self._process_ids.index(pid)
+        coord = np.unravel_index(idx, self._shape)
+        return coord[self._dim_names.index(dim) if isinstance(dim, str) else dim]
+
+    # -- jax bridge ------------------------------------------------------
+    @property
+    def jax_mesh(self) -> JaxMesh:
+        return self._jax_mesh
+
+    def sharding(self, placements: Sequence[Placement], ndim: int) -> NamedSharding:
+        return NamedSharding(self._jax_mesh, placements_to_spec(placements, self, ndim))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._shape == other._shape
+            and self._dim_names == other._dim_names
+            and self._process_ids == other._process_ids
+        )
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._dim_names), tuple(self._process_ids)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+    def __enter__(self):
+        _mesh_stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _mesh_stack.pop()
+        return False
+
+
+_mesh_stack: List[ProcessMesh] = []
+
+
+def get_current_mesh() -> Optional[ProcessMesh]:
+    return _mesh_stack[-1] if _mesh_stack else None
+
+
+def auto_mesh(*dim_sizes, dim_names=None) -> ProcessMesh:
+    """Build a mesh over the first prod(dim_sizes) visible devices."""
+    n = int(np.prod(dim_sizes))
+    ids = np.arange(n).reshape(dim_sizes)
+    return ProcessMesh(ids, dim_names)
+
+
+def placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
+                       ndim: int) -> PartitionSpec:
+    """[Shard(0), Replicate()] over mesh dims → PartitionSpec per TENSOR dim.
+
+    paddle's placements list is indexed by MESH dim (placements[i] says what
+    mesh dim i does); PartitionSpec is indexed by TENSOR dim. Convert."""
+    entries: List[Optional[tuple]] = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim
+            if entries[d] is None:
+                entries[d] = (mesh.dim_names[mesh_dim],)
+            else:
+                entries[d] = entries[d] + (mesh.dim_names[mesh_dim],)
+    spec = [e if e is None else (e[0] if len(e) == 1 else e) for e in entries]
+    while spec and spec[-1] is None:
+        spec.pop()
+    return PartitionSpec(*spec)
+
+
+def spec_to_placements(spec: PartitionSpec, mesh: ProcessMesh, ndim: int):
+    placements = [Replicate() for _ in range(mesh.ndim)]
+    for tensor_dim, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            placements[mesh.dim_names.index(name)] = Shard(tensor_dim)
+    return placements

@@ -1,0 +1,225 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/communication/ (all_reduce.py,
+all_gather.py, all_to_all.py, reduce_scatter.py, broadcast.py, scatter.py,
+send/recv, stream variants) over ProcessGroupNCCL
+(fluid/distributed/collective/process_group_nccl.cc).
+
+TPU re-design — two execution contexts, one API:
+
+1. **Inside shard_map capture** (fleet TP layers, custom kernels): the mesh
+   axis is live, so ops lower directly to lax.psum / all_gather /
+   ppermute / all_to_all — XLA schedules them on ICI.
+2. **Eager on DistTensors**: the collective is expressed as a resharding of
+   the global array (e.g. all_reduce of a Partial tensor → Replicate;
+   all_gather of a Shard(i) tensor → Replicate) via jax.device_put, and XLA
+   emits the collective program. A plain single-process tensor is its own
+   world (world_size 1) → identity, matching the reference's behavior when
+   the group has one rank.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..auto_parallel.placement import Partial, Replicate, Shard
+from .group import (  # noqa: F401
+    Group, destroy_process_group, get_backend, get_group, is_initialized,
+    new_group,
+)
+
+__all__ = [
+    "all_reduce", "all_gather", "all_gather_object", "all_to_all",
+    "all_to_all_single", "reduce_scatter", "broadcast", "reduce", "scatter",
+    "gather", "send", "recv", "isend", "irecv", "batch_isend_irecv",
+    "P2POp", "ReduceOp", "new_group", "get_group", "wait", "barrier",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _reduce_fn(op):
+    return {
+        ReduceOp.SUM: jax.lax.psum,
+        ReduceOp.MAX: jax.lax.pmax,
+        ReduceOp.MIN: jax.lax.pmin,
+        ReduceOp.AVG: lambda x, n: jax.lax.pmean(x, n),
+    }[op]
+
+
+def _is_tracer(t: Tensor):
+    return isinstance(t._value, jax.core.Tracer)
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op=True):
+    """paddle.distributed.all_reduce parity (communication/all_reduce.py).
+    In-place on ``tensor``."""
+    if _is_tracer(tensor):
+        # inside shard_map capture: reduce over the group's mesh axis
+        axis = group.axis_name if group is not None and group.axis_name else None
+        if axis is not None:
+            tensor._replace_value(_reduce_fn(op)(tensor._value, axis))
+        return tensor
+    if tensor._dist_attr is not None:
+        mesh, placements = tensor._dist_attr
+        if any(isinstance(p, Partial) for p in placements):
+            new_pl = [
+                Replicate() if isinstance(p, Partial) else p for p in placements
+            ]
+            from ..auto_parallel.api import reshard
+
+            out = reshard(tensor, mesh, new_pl)
+            tensor._replace_value(out._value)
+            tensor._dist_attr = out._dist_attr
+        return tensor
+    # single-rank world: identity
+    return tensor
+
+
+def all_gather(tensor_list: List[Tensor], tensor: Tensor,
+               group: Optional[Group] = None, sync_op=True):
+    """paddle.distributed.all_gather parity: fills tensor_list with each
+    rank's shard. Eager DistTensor: unshard then split."""
+    if tensor._dist_attr is not None:
+        mesh, placements = tensor._dist_attr
+        from ..auto_parallel.api import unshard_dtensor
+
+        full = unshard_dtensor(tensor)
+        # split along the sharded dim per mesh axis of the group
+        shard_dims = [p.get_dim() for p in placements if isinstance(p, Shard)]
+        n = group.nranks if group else (
+            mesh.shape[0] if mesh.ndim else 1
+        )
+        if shard_dims:
+            parts = jnp.split(full._value, n, axis=shard_dims[0])
+        else:
+            parts = [full._value for _ in range(n)]
+        tensor_list.clear()
+        tensor_list.extend(Tensor._from_value(p) for p in parts)
+        return tensor_list
+    tensor_list.clear()
+    tensor_list.append(tensor.clone())
+    return tensor_list
+
+
+def all_gather_object(object_list: List, obj, group=None):
+    object_list.clear()
+    object_list.append(obj)
+    return object_list
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    """Eager parity path: concat-and-keep-local-shard."""
+    if isinstance(tensor_or_tensor_list, (list, tuple)):
+        from ...ops.manipulation import concat
+
+        src = concat(list(tensor_or_tensor_list), axis=0)
+    else:
+        src = tensor_or_tensor_list
+    tensor._replace_value(src._value[: tensor._value.shape[0]])
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Single-process world: identity permutation."""
+    out_tensor_list.clear()
+    out_tensor_list.extend(t.clone() for t in in_tensor_list)
+    return out_tensor_list
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
+                      in_split_sizes=None, group=None, sync_op=True):
+    out_tensor._replace_value(in_tensor._value)
+    return out_tensor
+
+
+def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None,
+           sync_op=True):
+    return all_reduce(tensor, op, group)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor._replace_value(tensor_list[0]._value)
+    return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    if gather_list is not None:
+        gather_list.append(tensor.clone())
+    return gather_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv across hosts uses the pipeline-parallel "
+        "ppermute path (fleet.meta_parallel) on TPU, not raw send/recv"
+    )
+
+
+recv = send
+isend = send
+irecv = send
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+
+
+def batch_isend_irecv(p2p_op_list):
+    raise NotImplementedError("see send/recv note")
+
+
+class _Task:
+    def wait(self):
+        pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if not isinstance(tensor._value, jax.core.Tracer):
+        jax.block_until_ready(tensor._value)
+
+
+def barrier(group=None):
+    from .. import env
+
+    env.barrier(group)
+
+
+# in-trace collective helpers for shard_map code (fleet layers use these)
+def psum(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def all_gather_in_trace(x, axis_name, axis=0, tiled=True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def ppermute(x, axis_name, perm):
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all_in_trace(x, axis_name, split_axis, concat_axis):
+    return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=True)

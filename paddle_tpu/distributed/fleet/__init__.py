@@ -1,0 +1,155 @@
+"""paddle.distributed.fleet parity.
+
+Reference: python/paddle/distributed/fleet/ (fleet.py:166 init,
+distributed_model at model.py:32, distributed_optimizer at fleet.py:1325,
+DistributedStrategy from distributed_strategy.proto).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from . import topology as _topology
+from .topology import (
+    CommunicateTopology, HybridCommunicateGroup, get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from .mp_layers import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from . import sequence_parallel
+from .sequence_parallel import (
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear, ScatterOp,
+    GatherOp, AllGatherOp, ReduceScatterOp,
+    mark_as_sequence_parallel_parameter,
+    register_sequence_parallel_allreduce_hooks,
+)
+
+__all__ = [
+    "init", "DistributedStrategy", "distributed_model",
+    "distributed_optimizer", "get_hybrid_communicate_group",
+    "HybridCommunicateGroup", "CommunicateTopology", "worker_index",
+    "worker_num", "is_first_worker", "barrier_worker",
+]
+
+
+class DistributedStrategy:
+    """Reference: fluid/framework/distributed_strategy.proto surfaced as
+    fleet.DistributedStrategy — hybrid degrees + feature toggles."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.lamb = False
+        self.dgc = False
+        self.find_unused_parameters = False
+        self.heter_ccl_mode = False
+        self.without_graph_optimization = True
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+_fleet_state = {"initialized": False, "strategy": None}
+
+
+def init(role_maker=None, is_collective: bool = False, strategy=None, log_level="INFO"):
+    """fleet.init parity (fleet/fleet.py:166): build the hybrid topology
+    mesh from strategy.hybrid_configs."""
+    from .. import env
+
+    env.init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    dims = [
+        hc.get("pp_degree", 1),
+        hc.get("dp_degree", 1),
+        hc.get("sharding_degree", 1),
+        hc.get("sep_degree", 1),
+        hc.get("mp_degree", 1),
+    ]
+    topo = CommunicateTopology(["pp", "dp", "sharding", "sep", "mp"], dims)
+    hcg = HybridCommunicateGroup(topo)
+    set_hybrid_communicate_group(hcg)
+    _fleet_state["initialized"] = True
+    _fleet_state["strategy"] = strategy
+    return hcg
+
+
+def distributed_model(model):
+    """fleet.distributed_model parity (fleet/model.py:32,141-160). With
+    GSPMD the wrapper's job (param broadcast, grad allreduce hooks) is done
+    by sharding layouts, so this marks DP-replicated params and returns the
+    model."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return model
+    from ..auto_parallel.api import shard_tensor
+    from ..auto_parallel.placement import Replicate
+
+    mesh = hcg.mesh
+    for p in model.parameters():
+        if p._dist_attr is None:
+            shard_tensor(p, mesh, [Replicate() for _ in range(mesh.ndim)])
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """fleet.distributed_optimizer parity (fleet/fleet.py:1325 →
+    HybridParallelOptimizer). Grad allreduce/clip-across-groups is implied by
+    GSPMD layouts; sharding stages come from shard_optimizer."""
+    strategy = strategy or _fleet_state.get("strategy")
+    if strategy is not None and strategy.hybrid_configs.get("sharding_degree", 1) > 1:
+        from ..auto_parallel.api import ShardingStage1, shard_optimizer
+
+        return shard_optimizer(optimizer, ShardingStage1("sharding"))
+    return optimizer
+
+
+def worker_index():
+    from .. import env
+
+    return env.get_rank()
+
+
+def worker_num():
+    from .. import env
+
+    return env.get_world_size()
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from .. import env
+
+    env.barrier()
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, *a, **k):
+        pass
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, *a, **k):
+        pass

@@ -1,0 +1,137 @@
+"""Megatron-style sequence parallelism.
+
+Reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+(ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers :85-137,
+ColumnSequenceParallelLinear :427, RowSequenceParallelLinear,
+mark_as_sequence_parallel_parameter :148).
+
+TPU re-design: activations between TP regions carry Shard(seq_dim) on the
+mp axis; the scatter/gather PyLayers become reshard (sharding-constraint)
+ops and XLA emits the all_gather/reduce_scatter pairs, overlapping them with
+the matmuls (the hand-written SPInnerOverlapLinear :255 overlap is what the
+XLA latency-hiding scheduler does automatically on ICI).
+"""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from ..auto_parallel.api import reshard
+from ..auto_parallel.placement import Replicate, Shard
+from .mp_layers import _mp_axis_index, _mp_mesh, _replicate_param, _shard_param
+
+SEQ_DIM = 1  # paddle sequence_parallel uses [b, s, h]; shard dim 1
+
+
+def _seq_placements(mesh, ndim, seq_dim=SEQ_DIM):
+    placements = [Replicate() for _ in range(mesh.ndim)]
+    placements[_mp_axis_index(mesh)] = Shard(seq_dim)
+    return placements
+
+
+def ScatterOp(x, axis=SEQ_DIM):
+    """Split along seq dim across mp (sequence_parallel_utils.py:85)."""
+    mesh, d = _mp_mesh()
+    if mesh is None:
+        return x
+    return reshard(x, mesh, _seq_placements(mesh, x.ndim, axis))
+
+
+def GatherOp(x, axis=SEQ_DIM):
+    """All-gather along seq dim (sequence_parallel_utils.py:~110)."""
+    mesh, d = _mp_mesh()
+    if mesh is None:
+        return x
+    return reshard(x, mesh, [Replicate() for _ in range(mesh.ndim)])
+
+
+AllGatherOp = GatherOp
+
+
+def ReduceScatterOp(x, axis=SEQ_DIM):
+    mesh, d = _mp_mesh()
+    if mesh is None:
+        return x
+    return reshard(x, mesh, _seq_placements(mesh, x.ndim, axis))
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.is_sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "is_sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, fuse_sequence_parallel_allreduce=False):
+    """Reference :148/:192 — grads of sequence-parallel params need an mp
+    allreduce; under GSPMD the grad layout is derived from the param layout,
+    so the hook is a no-op kept for API parity."""
+    return model
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column TP linear whose input arrives seq-sharded
+    (sequence_parallel_utils.py:427): all-gather seq → matmul → out sharded
+    on features."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        self.bias = (
+            self.create_parameter([out_features], is_bias=True)
+            if has_bias in (None, True)
+            else None
+        )
+        self.gather_output = gather_output
+        mesh, d = _mp_mesh()
+        self._mesh = mesh
+        if mesh is not None:
+            _shard_param(self.weight, mesh, 1)
+            if self.bias is not None:
+                _shard_param(self.bias, mesh, 0)
+
+    def forward(self, x):
+        if self._mesh is not None:
+            x = GatherOp(x)  # seq all-gather into the TP region
+        out = F.linear(x, self.weight, self.bias)
+        if self._mesh is not None and not self.gather_output:
+            placements = [Replicate() for _ in range(self._mesh.ndim)]
+            placements[_mp_axis_index(self._mesh)] = Shard(out.ndim - 1)
+            out = reshard(out, self._mesh, placements)
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row TP linear that returns seq-sharded output via reduce-scatter
+    (the allreduce+scatter fusion the reference hand-writes)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        self.bias = (
+            self.create_parameter([out_features], is_bias=True) if has_bias else None
+        )
+        mesh, d = _mp_mesh()
+        self._mesh = mesh
+        if mesh is not None:
+            _shard_param(self.weight, mesh, 0)
+            if self.bias is not None:
+                _replicate_param(self.bias, mesh)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self._mesh is not None:
+            # reduce-scatter: partial-sum contraction + seq shard on output
+            out = reshard(out, self._mesh, _seq_placements(self._mesh, out.ndim))
+        return out

@@ -1,0 +1,161 @@
+"""Hybrid-parallel topology.
+
+Reference: python/paddle/distributed/fleet/base/topology.py
+(CommunicateTopology :65, HybridCommunicateGroup :178 — builds
+pp/dp/sharding/sep/mp process groups from an N-D rank topology at :335).
+
+TPU re-design: the topology IS a ProcessMesh with axes
+(pp, dp, sharding, sep, mp) over the visible devices; each "communicate
+group" is a mesh axis — collectives over it are XLA collectives along that
+axis, no communicator setup required.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+
+from ..auto_parallel.placement import ProcessMesh
+from ..communication.group import Group, axis_group
+
+# paddle's canonical hybrid order (topology.py:188)
+HYBRID_ORDER = ["pp", "dp", "sharding", "sep", "mp"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names or HYBRID_ORDER)
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        self._world = np.arange(int(np.prod(self._dims))).reshape(self._dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(self._world.size)
+
+    def get_rank(self, **kwargs):
+        coord = [kwargs[n] for n in self._parallel_names]
+        return int(self._world[tuple(coord)])
+
+    def get_coord(self, rank):
+        return tuple(int(c) for c in np.argwhere(self._world == rank)[0])
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[axis] = index
+        return sorted(self._world[tuple(sl)].reshape(-1).tolist())
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._world, axis, -1)
+        return moved.reshape(-1, self._dims[axis]).tolist()
+
+
+class HybridCommunicateGroup:
+    """Reference: topology.py:178. Holds the mesh + per-axis groups."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        dims = [topology.get_dim(n) for n in topology.get_hybrid_group_names()]
+        names = topology.get_hybrid_group_names()
+        n_needed = int(np.prod(dims))
+        n_avail = len(jax.devices())
+        if n_needed > n_avail:
+            raise ValueError(
+                f"hybrid topology needs {n_needed} devices, {n_avail} visible"
+            )
+        ids = np.arange(n_needed).reshape(dims)
+        self._mesh = ProcessMesh(ids, names)
+        self._groups: Dict[str, Group] = {
+            n: axis_group(self._mesh, n) for n in names
+        }
+        self.global_rank = 0
+
+    @property
+    def topology(self):
+        return self._topo
+
+    @property
+    def mesh(self) -> ProcessMesh:
+        return self._mesh
+
+    # --- world sizes ----------------------------------------------------
+    def get_model_parallel_world_size(self) -> int:
+        return self._topo.get_dim("mp")
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._topo.get_dim("dp")
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._topo.get_dim("pp")
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._topo.get_dim("sharding")
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._topo.get_dim("sep") if "sep" in self._topo.get_hybrid_group_names() else 1
+
+    # --- ranks (SPMD single-controller: logical rank 0 per axis) --------
+    def get_model_parallel_rank(self) -> int:
+        return 0
+
+    def get_data_parallel_rank(self) -> int:
+        return 0
+
+    def get_stage_id(self) -> int:
+        return 0
+
+    def get_sharding_parallel_rank(self) -> int:
+        return 0
+
+    def get_sep_parallel_rank(self) -> int:
+        return 0
+
+    # --- groups ---------------------------------------------------------
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, *a, **k) -> Group:
+        return self._groups["mp"]
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology_order(self):
+        return self._topo.get_hybrid_group_names()
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

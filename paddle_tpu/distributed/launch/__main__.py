@@ -1,0 +1,28 @@
+"""CLI: python -m paddle_tpu.distributed.launch train.py [args...]
+
+Reference: python/paddle/distributed/launch/__main__.py + main.py.
+"""
+import argparse
+import sys
+
+from ..launch_utils import launch
+
+
+def main():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--master", type=str, default=None)
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--max_restarts", type=int, default=0)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    a = p.parse_args()
+    sys.exit(
+        launch(a.training_script, a.training_script_args, a.nnodes, a.node_rank,
+               a.master, a.log_dir, a.max_restarts)
+    )
+
+
+if __name__ == "__main__":
+    main()

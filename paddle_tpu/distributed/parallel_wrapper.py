@@ -1,0 +1,82 @@
+"""DataParallel wrapper.
+
+Reference: python/paddle/distributed/parallel.py:218 DataParallel + C++
+EagerReducer (fluid/distributed/collective/reducer.cc:543-951 — bucketed
+grad allreduce overlapped with backward).
+
+TPU re-design: DP is batch sharding over the 'dp' mesh axis. Params are
+replicated; inputs sharded on dim 0; under a compiled train step XLA emits
+ONE fused gradient all-reduce schedule overlapped with backward compute —
+the reducer's bucketing/overlap machinery is the compiler's job on TPU.
+Eager single-chip falls back to plain execution.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .auto_parallel.api import shard_tensor
+from .auto_parallel.placement import ProcessMesh, Replicate, Shard
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size_MB: int = 25,
+                 last_comm_buffer_size_MB: int = 1, find_unused_parameters=False,
+                 group=None, mesh: Optional[ProcessMesh] = None):
+        super().__init__()
+        self._layers = layers
+        self._mesh = mesh
+        if mesh is None:
+            from .fleet.topology import get_hybrid_communicate_group
+
+            hcg = get_hybrid_communicate_group()
+            if hcg is not None and hcg.get_data_parallel_world_size() > 1:
+                self._mesh = hcg.mesh
+        if self._mesh is not None:
+            repl = [Replicate() for _ in range(self._mesh.ndim)]
+            for p in layers.parameters():
+                if p._dist_attr is None:
+                    shard_tensor(p, self._mesh, repl)
+
+    def _shard_input(self, x):
+        if self._mesh is None or not isinstance(x, Tensor):
+            return x
+        try:
+            dp_axis = self._mesh.dim_names.index("dp")
+        except ValueError:
+            dp_axis = 0
+        placements = [Replicate() for _ in range(self._mesh.ndim)]
+        if x.ndim > 0 and x.shape[0] % self._mesh.shape[dp_axis] == 0:
+            placements[dp_axis] = Shard(0)
+        from .auto_parallel.api import reshard
+
+        return reshard(x, self._mesh, placements)
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_input(x) for x in inputs)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    # passthrough surface
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    @property
+    def _layers_attr(self):
+        return self._layers
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass

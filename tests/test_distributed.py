@@ -1,0 +1,214 @@
+"""Distributed tests on the 8-device virtual CPU mesh (reference pattern:
+test/auto_parallel/ + test/collective/ run on local devices;
+here the mesh axes stand in for process groups)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+import jax
+
+
+def _r(*shape):
+    return np.random.randn(*shape).astype("float32")
+
+
+@pytest.fixture(autouse=True)
+def _reset_fleet():
+    yield
+    dist.fleet.set_hybrid_communicate_group(None)
+
+
+class TestMeshPlacement:
+    def test_mesh_basics(self):
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        assert mesh.shape == [2, 4]
+        assert mesh.get_dim_size("mp") == 4
+        assert mesh.dim_names == ["dp", "mp"]
+
+    def test_shard_tensor_layout(self):
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["x", "y"])
+        t = dist.shard_tensor(_r(8, 12), mesh, [dist.Shard(0), dist.Shard(1)])
+        v = t._value
+        assert len(v.sharding.device_set) == 8
+        # each shard is 4x3
+        shard = v.addressable_shards[0]
+        assert shard.data.shape == (4, 3)
+        np.testing.assert_allclose(np.asarray(v), t.numpy())
+
+    def test_replicate(self):
+        mesh = dist.ProcessMesh(np.arange(8), ["x"])
+        t = dist.shard_tensor(_r(4, 4), mesh, [dist.Replicate()])
+        assert t._value.addressable_shards[0].data.shape == (4, 4)
+
+    def test_reshard(self):
+        mesh = dist.ProcessMesh(np.arange(8), ["x"])
+        t = dist.shard_tensor(_r(8, 16), mesh, [dist.Shard(0)])
+        r = dist.reshard(t, mesh, [dist.Shard(1)])
+        assert r._value.addressable_shards[0].data.shape == (8, 2)
+        np.testing.assert_allclose(r.numpy(), t.numpy())
+
+    def test_reshard_keeps_grad_chain(self):
+        mesh = dist.ProcessMesh(np.arange(8), ["x"])
+        x = paddle.to_tensor(_r(8, 4), stop_gradient=False)
+        y = x * 2
+        ys = dist.reshard(y, mesh, [dist.Shard(0)])
+        ys.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2.0)
+
+
+class TestCollectives:
+    def test_all_reduce_partial_noop_and_groups(self):
+        g = dist.new_group(list(range(4)))
+        assert g.nranks == 4
+        t = paddle.to_tensor(_r(4))
+        out = dist.all_reduce(t)  # single-rank world → identity
+        np.testing.assert_allclose(out.numpy(), t.numpy())
+
+    def test_all_gather_dist_tensor(self):
+        mesh = dist.ProcessMesh(np.arange(8), ["x"])
+        t = dist.shard_tensor(_r(8, 2), mesh, [dist.Shard(0)])
+        parts = []
+        dist.all_gather(parts, t)
+        assert len(parts) == 8
+        np.testing.assert_allclose(
+            np.concatenate([p.numpy() for p in parts]), t.numpy()
+        )
+
+
+class TestShardedTraining:
+    def test_dp_sharded_batch_training(self):
+        """Data parallel: batch sharded over 8 devices, params replicated —
+        one compiled step, XLA handles grad allreduce."""
+        mesh = dist.ProcessMesh(np.arange(8), ["dp"])
+        paddle.seed(5)
+        model = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 1))
+        repl = [dist.Replicate()]
+        for p in model.parameters():
+            dist.shard_tensor(p, mesh, repl)
+        o = opt.AdamW(0.01, parameters=model.parameters())
+        loss_fn = nn.MSELoss()
+
+        @paddle.jit.to_static
+        def step(x, y):
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            return loss
+
+        X, Y = _r(64, 16), _r(64, 1)
+        losses = []
+        for _ in range(30):
+            xb = dist.shard_tensor(X, mesh, [dist.Shard(0)])
+            yb = dist.shard_tensor(Y, mesh, [dist.Shard(0)])
+            losses.append(float(step(xb, yb)))
+        assert losses[-1] < losses[0] * 0.5
+        # params stayed replicated
+        w = model[0].weight._value
+        assert len(w.sharding.device_set) == 8
+
+    def test_tp_layers_forward_and_training(self):
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        hcg = dist.fleet.get_hybrid_communicate_group()
+        assert hcg.get_model_parallel_world_size() == 4
+        assert hcg.get_data_parallel_world_size() == 2
+
+        col = dist.fleet.ColumnParallelLinear(16, 32, gather_output=False)
+        row = dist.fleet.RowParallelLinear(32, 16, input_is_parallel=True)
+        # weight layouts: col sharded on dim1, row on dim0 over mp axis
+        col_spec = col.weight._value.sharding.spec
+        assert "mp" in str(col_spec)
+
+        x = paddle.to_tensor(_r(8, 16), stop_gradient=False)
+        h = col(x)
+        y = row(h)
+        assert y.shape == [8, 16]
+        # numerics match a dense mlp with identical weights
+        h_np = x.numpy() @ col.weight.numpy() + col.bias.numpy()
+        want = h_np @ row.weight.numpy() + row.bias.numpy()
+        np.testing.assert_allclose(y.numpy(), want, atol=1e-4)
+        y.sum().backward()
+        assert col.weight.grad is not None
+
+    def test_vocab_parallel_embedding(self):
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 8, "pp_degree": 1}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        emb = dist.fleet.VocabParallelEmbedding(64, 16)
+        idx = paddle.to_tensor(np.random.randint(0, 64, (4, 10)).astype("int64"))
+        out = emb(idx)
+        assert out.shape == [4, 10, 16]
+        np.testing.assert_allclose(
+            out.numpy()[0, 0], emb.weight.numpy()[int(idx.numpy()[0, 0])], atol=1e-6
+        )
+
+    def test_parallel_cross_entropy(self):
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 8, "pp_degree": 1}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        pce = dist.fleet.ParallelCrossEntropy()
+        logits = paddle.to_tensor(_r(4, 64))
+        labels = paddle.to_tensor(np.random.randint(0, 64, (4,)).astype("int64"))
+        loss = pce(logits, labels)
+        assert loss.shape == [4, 1]
+        want = paddle.nn.functional.cross_entropy(
+            logits, labels, reduction="none"
+        ).numpy()
+        np.testing.assert_allclose(loss.numpy()[:, 0], want, atol=1e-5)
+
+    def test_shard_optimizer_states(self):
+        mesh = dist.ProcessMesh(np.arange(8), ["dp"])
+        lin = nn.Linear(16, 16)
+        for p in lin.parameters():
+            dist.shard_tensor(p, mesh, [dist.Replicate()])
+        o = opt.AdamW(0.01, parameters=lin.parameters())
+        dist.shard_optimizer(o, dist.ShardingStage1("dp"))
+        m1 = o._accumulators["moment1"][id(lin.weight)]
+        # moment sharded along dim0 over dp
+        assert m1.addressable_shards[0].data.shape[0] == 2  # 16/8
+
+    def test_sequence_parallel_ops(self):
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 8, "pp_degree": 1}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        from paddle_tpu.distributed.fleet import ScatterOp, GatherOp
+
+        x = paddle.to_tensor(_r(2, 16, 8))
+        xs = ScatterOp(x)
+        assert xs._value.addressable_shards[0].data.shape == (2, 2, 8)
+        xg = GatherOp(xs)
+        np.testing.assert_allclose(xg.numpy(), x.numpy())
+
+
+class TestDistributedCheckpoint:
+    def test_save_load_reshard(self, tmp_path):
+        mesh = dist.ProcessMesh(np.arange(8), ["x"])
+        w = _r(16, 8)
+        t = dist.shard_tensor(w.copy(), mesh, [dist.Shard(0)])
+        sd = {"w": t, "meta": {"step": 3}}
+        path = str(tmp_path / "ckpt")
+        dist.checkpoint.save_state_dict(sd, path)
+        # load into a DIFFERENTLY sharded target
+        t2 = dist.shard_tensor(np.zeros_like(w), mesh, [dist.Shard(1)])
+        out = {"w": t2, "meta": None}
+        dist.checkpoint.load_state_dict(out, path)
+        np.testing.assert_allclose(out["w"].numpy(), w)
+        assert out["w"]._value.addressable_shards[0].data.shape == (16, 1)
+        assert out["meta"]["step"] == 3
+
+
+class TestDataParallelWrapper:
+    def test_wrapper_shards_inputs(self):
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        model = dist.DataParallel(nn.Linear(4, 2))
+        x = paddle.to_tensor(_r(16, 4))
+        y = model(x)
+        assert y.shape == [16, 2]
