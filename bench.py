@@ -1,0 +1,101 @@
+"""Benchmark: Llama decoder pretraining throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config mirrors BASELINE.json's north-star family (Llama pretraining,
+tokens/sec/chip). The reference publishes no in-tree numbers (BASELINE.md),
+so ``vs_baseline`` reports our measured MFU divided by 0.40 — the well-known
+Megatron-LM A100 MFU for Llama-class pretraining that the north star asks us
+to match (">= A100-NCCL MFU").
+
+Run: python bench.py  (uses the real TPU chip; falls back to CPU with a
+smaller config when no accelerator is present).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+
+    if on_tpu:
+        # ~350M-param decoder: big enough to exercise MXU/HBM realistically,
+        # small enough for one v5e chip with AdamW fp32 state.
+        config = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=24, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            recompute=True,
+        )
+        batch, seq = 8, 2048
+        steps, warmup = 20, 3
+        peak_flops = 197e12  # TPU v5e bf16 peak
+    else:
+        config = LlamaConfig.tiny()
+        batch, seq = 4, 128
+        steps, warmup = 5, 2
+        peak_flops = 1e12
+
+    model = LlamaForCausalLM(config)
+    n_params = model.num_parameters()
+    if on_tpu:
+        model.bfloat16()
+    optimizer = opt.AdamW(
+        learning_rate=3e-4, parameters=model.parameters(),
+        multi_precision=on_tpu,
+    )
+
+    @paddle.jit.to_static
+    def train_step(ids, labels):
+        loss, _ = model(ids, labels=labels)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        return loss
+
+    ids_np = np.random.randint(0, config.vocab_size, (batch, seq)).astype("int64")
+    labels_np = np.roll(ids_np, -1, axis=1)
+    ids = paddle.to_tensor(ids_np)
+    labels = paddle.to_tensor(labels_np)
+
+    for _ in range(warmup):
+        loss = train_step(ids, labels)
+    float(loss)  # full sync (block_until_ready is a no-op on tunneled backends)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train_step(ids, labels)
+    final_loss = float(loss)  # waits on the whole step chain via data dep
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * steps / dt
+
+    # training FLOPs/token ≈ 6P + 12·L·H·S (attention score/AV terms)
+    attn_flops = 12 * config.num_hidden_layers * config.hidden_size * seq
+    flops_per_token = 6 * n_params + attn_flops
+    mfu = tok_s * flops_per_token / peak_flops
+
+    print(json.dumps({
+        "metric": f"llama-{n_params/1e6:.0f}M pretrain tokens/sec/chip "
+                  f"(bs={batch} seq={seq}, loss={final_loss:.3f}, mfu={mfu:.3f})",
+        "value": round(tok_s, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu / 0.40, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -126,6 +126,23 @@ def _trace_next_key():
     return sub
 
 
+def _snapshot_keys():
+    """Capture the current key source (for recompute replay): the top traced
+    key under jit capture, else the eager local stream's key."""
+    stack = getattr(_trace, "stack", None)
+    if stack:
+        return stack[-1][0]
+    return _tracker.get("local_seed")._key
+
+
+class _restore_keys_scope(trace_key_scope):
+    """Replay draws from a snapshotted key (recompute backward). Reuses the
+    trace-key stack so it works identically eager and under capture."""
+
+    def __init__(self, snapshot_key):
+        super().__init__(snapshot_key)
+
+
 def default_generator() -> Generator:
     return _tracker.get(_DEFAULT)
 

@@ -1,0 +1,127 @@
+"""fleet.utils: recompute (activation checkpointing) + helpers.
+
+Reference: python/paddle/distributed/fleet/utils/__init__.py recompute →
+fleet/recompute/recompute.py (RecomputeFunction PyLayer: forward under
+no_grad saving inputs + RNG state; backward replays forward and backprops).
+
+TPU note: under ``jit.to_static`` the replay is traced into the compiled
+program, so XLA sees the classic remat pattern (trade FLOPs for HBM) —
+equivalent to jax.checkpoint but driven by the same tape engine that serves
+eager mode.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ....autograd import engine
+from ....core import generator
+from ....core.tensor import Tensor
+
+__all__ = ["recompute"]
+
+
+class _RecomputeNodePlaceholder:
+    pass
+
+
+def recompute(function, *args, **kwargs):
+    """paddle.distributed.fleet.utils.recompute parity.
+
+    Runs ``function`` without storing intermediate activations; backward
+    replays it (with the same RNG stream state) and differentiates the
+    replay.
+    """
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+
+    if not engine.grad_enabled():
+        return function(*args, **kwargs)
+
+    from ....core import dispatch
+
+    tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+    rng_snapshot = None
+    if preserve_rng_state:
+        # capture the local dropout stream state so the replay sees the
+        # same masks (reference: recompute.py swap_rng_state). Under trace
+        # the stream is a traced key held by trace_key_scope; snapshot it.
+        rng_snapshot = generator._snapshot_keys()
+
+    with engine.no_grad():
+        outputs = function(*args, **kwargs)
+
+    single = isinstance(outputs, Tensor)
+    outs_list = [outputs] if single else [
+        o for o in outputs if isinstance(o, Tensor)
+    ]
+    out_arrays = [o._value for o in outs_list]
+
+    prim_name = "recompute::replay"
+    if prim_name not in dispatch.PRIMITIVES:
+
+        def _vjp(grads_out, saved, **static):
+            fn, s_args, s_kwargs, n_inputs, rng_key = saved
+            if rng_key is not None:
+                ctx = generator._restore_keys_scope(rng_key)
+            else:
+                import contextlib
+
+                ctx = contextlib.nullcontext()
+            # replay with grad enabled on detached inputs. The optimization
+            # barrier stops XLA from CSE-ing the replay against the original
+            # forward (which would silently resurrect the saved activations
+            # and defeat remat — same trick as jax.checkpoint's remat prim).
+            import jax as _jax
+
+            replay_args = []
+            grad_inputs = []
+            for a in s_args:
+                if isinstance(a, Tensor):
+                    v = _jax.lax.optimization_barrier(a._value)
+                    d = Tensor._from_value(v, stop_gradient=False)
+                    replay_args.append(d)
+                    grad_inputs.append(d)
+                else:
+                    replay_args.append(a)
+            with engine.enable_grad(), ctx:
+                replay_out = fn(*replay_args, **s_kwargs)
+            r_list = [replay_out] if isinstance(replay_out, Tensor) else [
+                o for o in replay_out if isinstance(o, Tensor)
+            ]
+            # run the replay's backward with leaf accumulation ON so the
+            # PARAMETERS inside the block receive their grads (the outer
+            # tape only edges to the block's tensor inputs), while grads
+            # w.r.t. the block inputs are captured and returned upstream.
+            capture = {}
+            for i, t in enumerate(grad_inputs):
+                capture[(id(t._accum_node()), 0)] = i
+            captured = engine.run_backward(
+                r_list,
+                [Tensor._from_value(g) for g in grads_out],
+                retain_graph=False,
+                capture=capture,
+                accumulate_leaves=True,
+            )
+            return tuple(captured.get(i) for i in range(len(grad_inputs)))
+
+        dispatch.register_primitive(prim_name, forward=None, vjp=_vjp,
+                                    jittable=False)
+
+    node = engine.record_op(
+        prim_name,
+        {},
+        (function, args, kwargs, len(tensor_inputs), rng_snapshot),
+        tensor_inputs,
+        out_arrays,
+    )
+    requires = node is not None
+    wrapped = []
+    for i, a in enumerate(out_arrays):
+        t = Tensor._from_value(a, stop_gradient=not requires)
+        if node is not None:
+            t._node = node
+            t._out_slot = i
+        wrapped.append(t)
+    if single:
+        return wrapped[0]
+    return tuple(wrapped)

@@ -1,0 +1,11 @@
+"""Model zoo.
+
+Reference: the auto-parallel Llama fixture
+(test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py) and
+paddle.vision.models. The LLM families live here; vision models under
+paddle_tpu.vision.models.
+"""
+from .llama import (
+    LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaDecoderLayer,
+    LlamaAttention, LlamaMLP, llama_shard_plan,
+)

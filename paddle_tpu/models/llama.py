@@ -1,0 +1,236 @@
+"""Llama model family — the flagship LLM.
+
+Reference: test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py
+(LlamaAttentionAuto :94, LlamaMLPAuto :305, LlamaRMSNorm, LlamaForCausalLMAuto
+:809) — the reference's own fixture for exercising dp/mp/pp combos.
+
+TPU design highlights:
+- bf16-friendly: params fp32 (or bf16 with master weights), RMSNorm/softmax
+  accumulate fp32.
+- attention through scaled_dot_product_attention → Pallas flash kernel on
+  TPU, XLA composition elsewhere; GQA via num_key_value_heads.
+- RoPE via incubate.fused_rotary_position_embedding.
+- ``llama_shard_plan(model, mesh)`` applies the Megatron TP layout +
+  sequence-parallel activations over a (dp, mp) mesh — matching the
+  placements the reference fixture assigns via shard_tensor.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+import paddle_tpu as paddle
+from .. import nn
+from ..core.tensor import Tensor
+from ..incubate.nn.functional import fused_rotary_position_embedding, swiglu
+from ..nn import functional as F
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    use_flash_attention: bool = True
+    tie_word_embeddings: bool = False
+    recompute: bool = False  # activation checkpointing per decoder layer
+    dtype: str = "float32"
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=8, max_position_embeddings=8192,
+            rope_theta=500000.0,
+        )
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        base = dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+        )
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+class LlamaRMSNorm(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [config.hidden_size],
+            default_initializer=nn.initializer.Constant(1.0),
+        )
+        self.eps = config.rms_norm_eps
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.eps)
+
+
+class LlamaAttention(nn.Layer):
+    """GQA attention (reference fixture LlamaAttentionAuto:94)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        h = config.hidden_size
+        kv = self.num_kv_heads * self.head_dim
+        self.q_proj = nn.Linear(h, h, bias_attr=False)
+        self.k_proj = nn.Linear(h, kv, bias_attr=False)
+        self.v_proj = nn.Linear(h, kv, bias_attr=False)
+        self.o_proj = nn.Linear(h, h, bias_attr=False)
+
+    def forward(self, hidden_states, position_ids=None, attention_mask=None):
+        b, s, h = hidden_states.shape
+        q = self.q_proj(hidden_states).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(hidden_states).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(hidden_states).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q, k, v = fused_rotary_position_embedding(
+            q, k, v, position_ids=position_ids,
+            use_neox_rotary_style=True, rotary_emb_base=self.config.rope_theta,
+        )
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attention_mask,
+            is_causal=attention_mask is None,
+        )
+        return self.o_proj(out.reshape([b, s, h]))
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU MLP (reference fixture LlamaMLPAuto:305)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.gate_proj = nn.Linear(config.hidden_size, config.intermediate_size,
+                                   bias_attr=False)
+        self.up_proj = nn.Linear(config.hidden_size, config.intermediate_size,
+                                 bias_attr=False)
+        self.down_proj = nn.Linear(config.intermediate_size, config.hidden_size,
+                                   bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = LlamaRMSNorm(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config)
+
+    def forward(self, hidden_states, position_ids=None, attention_mask=None):
+        residual = hidden_states
+        hidden_states = self.input_layernorm(hidden_states)
+        hidden_states = self.self_attn(hidden_states, position_ids, attention_mask)
+        hidden_states = residual + hidden_states
+        residual = hidden_states
+        hidden_states = self.post_attention_layernorm(hidden_states)
+        hidden_states = self.mlp(hidden_states)
+        return residual + hidden_states
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        )
+        self.norm = LlamaRMSNorm(config)
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None):
+        hidden_states = self.embed_tokens(input_ids)
+        if self.config.recompute:
+            from ..distributed.fleet.utils import recompute
+
+            for layer in self.layers:
+                hidden_states = recompute(
+                    layer, hidden_states, position_ids, attention_mask
+                )
+        else:
+            for layer in self.layers:
+                hidden_states = layer(hidden_states, position_ids, attention_mask)
+        return self.norm(hidden_states)
+
+
+class LlamaForCausalLM(nn.Layer):
+    """Reference fixture LlamaForCausalLMAuto:809."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                 bias_attr=False)
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None,
+                labels=None):
+        hidden_states = self.llama(input_ids, position_ids, attention_mask)
+        logits = self.lm_head(hidden_states)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]),
+                ignore_index=-100,
+            )
+            return loss, logits
+        return logits
+
+    def num_parameters(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+
+# ---------------------------------------------------------------------------
+# Sharding plan — the semi-auto placements the reference fixture assigns
+# (semi_auto_parallel_llama_model.py shard_tensor calls), expressed once.
+# ---------------------------------------------------------------------------
+def llama_shard_plan(model: LlamaForCausalLM, mesh, dp_axis="dp", mp_axis="mp"):
+    """Apply Megatron TP + replicated-DP layout over ``mesh``:
+
+    - embed_tokens.weight:    Shard(0) on mp (vocab parallel)
+    - q/k/v/gate/up:          Shard(1) on mp (column parallel)
+    - o_proj/down_proj:       Shard(0) on mp (row parallel)
+    - lm_head.weight:         Shard(1) on mp
+    - norms:                  replicated
+    """
+    import paddle_tpu.distributed as dist
+
+    mp = mesh.dim_names.index(mp_axis)
+
+    def place(p, tensor_dim=None):
+        placements = [dist.Replicate() for _ in range(mesh.ndim)]
+        if tensor_dim is not None:
+            placements[mp] = dist.Shard(tensor_dim)
+        dist.shard_tensor(p, mesh, placements)
+
+    place(model.llama.embed_tokens.weight, 0)
+    for layer in model.llama.layers:
+        place(layer.self_attn.q_proj.weight, 1)
+        place(layer.self_attn.k_proj.weight, 1)
+        place(layer.self_attn.v_proj.weight, 1)
+        place(layer.self_attn.o_proj.weight, 0)
+        place(layer.mlp.gate_proj.weight, 1)
+        place(layer.mlp.up_proj.weight, 1)
+        place(layer.mlp.down_proj.weight, 0)
+        place(layer.input_layernorm.weight)
+        place(layer.post_attention_layernorm.weight)
+    place(model.llama.norm.weight)
+    place(model.lm_head.weight, 1)
+    return model
