@@ -30,7 +30,8 @@ WHITE_LIST = {
 }
 BLACK_LIST = {
     "reduce_sum", "reduce_mean", "softmax_p", "log_softmax_p", "layer_norm_p",
-    "rms_norm_p", "batch_norm_train_p", "batch_norm_infer_p", "exp", "log",
+    "rms_norm_p", "rms_norm_pallas_p", "batch_norm_train_p",
+    "batch_norm_infer_p", "exp", "log",
     "pow_p", "hard_ce_p", "soft_ce_p", "logsumexp_p", "p_norm", "fro_norm",
     "cumsum_p",
 }

@@ -98,3 +98,10 @@ define_flag("log_level", 0, "VLOG analog verbosity")
 define_flag("benchmark", False, "sync after each op for timing")
 define_flag("stop_check_timeout", 900, "collective watchdog timeout seconds (parallel.py:1133)")
 define_flag("cache_inference_while_scope", False, "parity placeholder")
+define_flag("use_pallas_flash_attention", True,
+            "use the Pallas flash-attention kernel on TPU backends")
+define_flag("use_pallas_rms_norm", True,
+            "use the Pallas fused RMSNorm kernel when shapes are lane-aligned")
+define_flag("pallas_force_interpret", False,
+            "run Pallas kernels in interpret mode on non-TPU backends "
+            "(testing only — the interpreter is orders slower than XLA)")

@@ -23,10 +23,6 @@ from ...ops._helpers import defprim, ensure_tensor
 
 __all__ = ["scaled_dot_product_attention", "flash_attention", "sdp_kernel"]
 
-define_flag("use_pallas_flash_attention", True,
-            "use the Pallas flash-attention kernel on TPU backends")
-
-
 def _sdpa_xla(q, k, v, *, causal, scale):
     # q,k,v: [B, S, H, D] (paddle layout); kv heads may be fewer (GQA)
     qh, kh = q.shape[2], k.shape[2]
@@ -59,19 +55,15 @@ defprim("sdpa_p", _sdpa_xla)
 defprim("sdpa_mask_p", _sdpa_mask_xla)
 
 
-def _use_pallas(q):
+def _use_pallas(q, k):
     if not get_flag("use_pallas_flash_attention"):
         return False
-    try:
-        import jax
-
-        dev = jax.devices()[0]
-        if dev.platform == "cpu":
-            return False
-    except Exception:
+    if (jax.default_backend() != "tpu"
+            and not get_flag("pallas_force_interpret")):
         return False
-    # pallas kernel wants MXU-aligned head dims
-    return q.shape[-1] % 128 == 0 and q.shape[1] % 128 == 0
+    # lane-aligned seqlens, MXU-friendly head dim, divisible GQA groups
+    return (q.shape[-1] % 64 == 0 and q.shape[1] % 128 == 0
+            and k.shape[1] % 128 == 0 and q.shape[2] % k.shape[2] == 0)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -83,7 +75,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     scale = 1.0 / float(np.sqrt(q.shape[-1]))
     if attn_mask is not None:
         out = apply("sdpa_mask_p", q, k, v, ensure_tensor(attn_mask), scale=scale)
-    elif _use_pallas(q):
+    elif _use_pallas(q, k):
         from ...ops.pallas.flash_attention import flash_attention_fused
 
         out = flash_attention_fused(q, k, v, causal=bool(is_causal), scale=scale)

@@ -59,10 +59,33 @@ def _rms_norm_fwd(x, w, *, eps):
 defprim("rms_norm_p", _rms_norm_fwd)
 
 
+def _use_pallas_rms(x) -> bool:
+    # mirror of ops/pallas/rms_norm.use_pallas_rms_norm, duplicated so the
+    # XLA fallback path never imports the pallas stack
+    from ...core.flags import get_flag
+
+    if not get_flag("use_pallas_rms_norm"):
+        return False
+    if jax.default_backend() != "tpu" and not get_flag("pallas_force_interpret"):
+        return False
+    hidden = x.shape[-1]
+    rows = 1
+    for s in x.shape[:-1]:
+        rows *= s
+    return hidden % 128 == 0 and rows % 8 == 0
+
+
 def rms_norm(x, weight, epsilon=1e-6, name=None):
     """RMSNorm (reference: paddle.incubate.nn.functional.fused_rms_norm,
-    phi/kernels/gpu/rms_norm_kernel.cu)."""
-    return apply("rms_norm_p", ensure_tensor(x), ensure_tensor(weight), eps=float(epsilon))
+    phi/kernels/gpu/rms_norm_kernel.cu). Pallas fused kernel on TPU when the
+    hidden dim is lane-aligned; XLA composition otherwise."""
+    x = ensure_tensor(x)
+    w = ensure_tensor(weight)
+    if _use_pallas_rms(x):
+        from ...ops.pallas import rms_norm as _  # registers the primitive
+
+        return apply("rms_norm_pallas_p", x, w, eps=float(epsilon))
+    return apply("rms_norm_p", x, w, eps=float(epsilon))
 
 
 def _batch_norm_train_fwd(x, w, b, *, eps, ch_axis):

@@ -1,0 +1,10 @@
+"""Pallas TPU kernel library.
+
+TPU-native analog of the reference fused-kernel libraries
+(paddle/phi/kernels/fusion/, paddle/fluid/operators/fused/, and the
+third_party/flashattn integration at phi/kernels/gpu/flash_attn_kernel.cu:35).
+Where the reference hand-writes CUDA, here the hot ops are Pallas kernels
+tiled for MXU/VMEM; every kernel has an interpret-mode path so the numerics
+are testable on the XLA-CPU virtual backend.
+"""
+from . import flash_attention, rms_norm  # noqa: F401

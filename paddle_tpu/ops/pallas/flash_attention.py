@@ -1,0 +1,384 @@
+"""Pallas flash attention (forward + backward) for TPU.
+
+TPU-native replacement for the reference's CUDA flashattn integration
+(reference: phi/kernels/gpu/flash_attn_kernel.cu:35, Python surface
+python/paddle/nn/functional/flash_attention.py:198,991). Design: classic
+flash-attention online-softmax over a (batch, q_head, q_block, k_block)
+sequential grid — the k_block axis is innermost so VMEM scratch carries the
+running (max, sum, accumulator) across k blocks; backward recomputes P from
+the saved logsumexp (no O(S^2) residuals). GQA is expressed in the BlockSpec
+index maps (kv head = q head // group), so grouped KV blocks are fetched
+once per q head without materialising the repeat.
+
+Layouts: public API uses paddle's [B, S, H, D]; kernels run [B, H, S, D].
+Compute is fp32 on the MXU (`preferred_element_type`), outputs cast back.
+
+On non-TPU backends the same kernels run under `interpret=True`, which is
+how the OpTest suite checks them against the XLA composition oracle.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...core import dispatch
+
+NEG_INF = float("-inf")
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(n: int, target: int = 512) -> int:
+    """Largest power-of-two divisor of n, capped at target (>=128 when
+    possible so blocks tile the lane dimension)."""
+    b = min(n, target)
+    while b > 1 and n % b:
+        b //= 2
+    return max(b, 1)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k, nk, offset):
+    # offset = Sk - Sq: bottom-right-aligned causal mask (query i attends
+    # keys <= i + offset), matching paddle/XLA semantics for Sq != Sk
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + offset >= cols, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # rows may be fully masked inside a partially-causal block; keep the
+        # exp args finite so those rows stay exactly zero instead of NaN
+        m_eff = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        alpha = jnp.exp(m_prev - m_eff)  # exp(-inf)=0 for first visit
+        p = jnp.exp(s - m_eff)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # skip blocks strictly above the (offset) diagonal
+        @pl.when(j * block_k <= i * block_q + (block_q - 1) + offset)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        m = m_scr[:, :1]
+        lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+        lse_ref[0, 0] = lse[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale"))
+def _flash_fwd_bhsd(q, k, v, *, causal, scale):
+    """q: [B,H,Sq,D]; k,v: [B,Hkv,Sk,D] -> (out [B,H,Sq,D], lse [B,H,Sq])."""
+    B, H, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    g = H // Hkv
+    block_q = _pick_block(Sq)
+    block_k = _pick_block(Sk)
+    nq, nk = Sq // block_q, Sk // block_k
+    grid = (B, H, nq, nk)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nk=nk, offset=Sk - Sq)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h // g, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * H * Sq * Sk * D,
+            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
+            transcendentals=B * H * Sq * Sk,
+        ),
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, block_q, block_k, nk, offset):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + offset >= cols, s, NEG_INF)
+        lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
+        p = jnp.exp(s - lse_safe)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(j * block_k <= i * block_q + (block_q - 1) + offset)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, block_q, block_k, nq, offset):
+    j = pl.program_id(2)  # k block
+    i = pl.program_id(3)  # q block (innermost: accumulate over q)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + offset >= cols, s, NEG_INF)
+        lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
+        p = jnp.exp(s - lse_safe)
+        # dV += P^T dO
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        # dK += dS^T Q
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(i * block_q + (block_q - 1) + offset >= j * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale"))
+def _flash_bwd_bhsd(q, k, v, out, lse, do, *, causal, scale):
+    B, H, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    g = H // Hkv
+    block_q = _pick_block(Sq)
+    block_k = _pick_block(Sk)
+    nq, nk = Sq // block_q, Sk // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nk=nk, offset=Sk - Sq)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nq=nq, offset=Sk - Sq)
+    # dK/dV computed per q-head ([B,H,Sk,D]) then group-reduced to kv heads
+    dk_h, dv_h = pl.pallas_call(
+        dkv_kernel,
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, j, i: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, j, i: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    if g > 1:
+        dk = dk_h.reshape(B, Hkv, g, Sk, D).sum(axis=2).astype(k.dtype)
+        dv = dv_h.reshape(B, Hkv, g, Sk, D).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# array-level API (paddle [B, S, H, D] layout) + primitive registration
+# ---------------------------------------------------------------------------
+def flash_attention_bshd(q, k, v, *, causal=False, scale=None):
+    """Array-level flash attention in paddle layout. Returns (out, lse)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out, lse = _flash_fwd_bhsd(qt, kt, vt, causal=causal, scale=float(scale))
+    return jnp.swapaxes(out, 1, 2), lse
+
+
+def _flash_vjp(grads_out, saved, *, causal, scale):
+    q, k, v, out, lse = saved
+    do = grads_out[0]
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    ot, dot = jnp.swapaxes(out, 1, 2), jnp.swapaxes(do, 1, 2)
+    dq, dk, dv = _flash_bwd_bhsd(qt, kt, vt, ot, lse, dot,
+                                 causal=causal, scale=float(scale))
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
+
+
+dispatch.register_primitive(
+    "flash_attention_p",
+    flash_attention_bshd,
+    vjp=_flash_vjp,
+    save=lambda arrays, outs: (*arrays, outs[0], outs[1]),
+    multi_out=True,
+    jittable=False,  # already jitted internally; pallas_call dislikes re-trace
+)
+
+
+def flash_attention_fused(q, k, v, *, causal=False, scale=None):
+    """Tensor-level entry used by nn.functional.scaled_dot_product_attention.
+    Returns the attention output Tensor (lse is kept for backward only)."""
+    from ...core.tensor import apply
+
+    out, _lse = apply("flash_attention_p", q, k, v,
+                      causal=bool(causal),
+                      scale=float(scale) if scale is not None
+                      else 1.0 / math.sqrt(q.shape[-1]))
+    return out

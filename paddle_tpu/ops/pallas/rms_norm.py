@@ -1,0 +1,134 @@
+"""Pallas fused RMSNorm (forward + backward) for TPU.
+
+TPU-native analog of the reference fused kernel
+(reference: phi/kernels/gpu/rms_norm_kernel.cu, surfaced as
+paddle.incubate.nn.functional.fused_rms_norm). One pass per row block:
+fp32 mean-of-squares on the VPU, scaled write-back. Backward recomputes the
+inverse RMS from the saved input (cheaper than storing a residual) and
+accumulates the weight gradient across row blocks in VMEM scratch — the grid
+is sequential on TPU so the accumulator carries without atomics.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...core import dispatch
+from .flash_attention import _interpret, _pick_block
+
+
+def _fwd_kernel(x_ref, w_ref, y_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    invr = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    y_ref[:] = (x * invr * w[None, :]).astype(y_ref.dtype)
+
+
+def _bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dw_ref, dw_scr, *, eps, nr):
+    r = pl.program_id(0)
+
+    @pl.when(r == 0)
+    def _init():
+        dw_scr[:] = jnp.zeros(dw_scr.shape, jnp.float32)
+
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    invr = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    gw = g * w[None, :]
+    c = jnp.mean(gw * x, axis=-1, keepdims=True) * invr * invr * invr
+    dx_ref[:] = (gw * invr - x * c).astype(dx_ref.dtype)
+    dw_scr[:] += jnp.sum(g * x * invr, axis=0)
+
+    @pl.when(r == nr - 1)
+    def _finalize():
+        dw_ref[:] = dw_scr[:].astype(dw_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def _rms_fwd(x, w, *, eps):
+    hidden = x.shape[-1]
+    x2 = x.reshape(-1, hidden)
+    rows = x2.shape[0]
+    block_r = _pick_block(rows, 256)
+    y = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=(rows // block_r,),
+        in_specs=[
+            pl.BlockSpec((block_r, hidden), lambda r: (r, 0)),
+            pl.BlockSpec((hidden,), lambda r: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_r, hidden), lambda r: (r, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, hidden), x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=_interpret(),
+    )(x2, w)
+    return y.reshape(x.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def _rms_bwd(x, w, g, *, eps):
+    hidden = x.shape[-1]
+    x2 = x.reshape(-1, hidden)
+    g2 = g.reshape(-1, hidden)
+    rows = x2.shape[0]
+    block_r = _pick_block(rows, 256)
+    nr = rows // block_r
+    dx, dw = pl.pallas_call(
+        functools.partial(_bwd_kernel, eps=eps, nr=nr),
+        grid=(nr,),
+        in_specs=[
+            pl.BlockSpec((block_r, hidden), lambda r: (r, 0)),
+            pl.BlockSpec((hidden,), lambda r: (0,)),
+            pl.BlockSpec((block_r, hidden), lambda r: (r, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_r, hidden), lambda r: (r, 0)),
+            pl.BlockSpec((hidden,), lambda r: (0,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, hidden), x.dtype),
+            jax.ShapeDtypeStruct((hidden,), w.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((hidden,), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_interpret(),
+    )(x2, w, g2)
+    return dx.reshape(x.shape), dw
+
+
+def _vjp(grads_out, saved, *, eps):
+    x, w = saved
+    return _rms_bwd(x, w, grads_out[0], eps=eps)
+
+
+dispatch.register_primitive(
+    "rms_norm_pallas_p",
+    lambda x, w, *, eps: _rms_fwd(x, w, eps=eps),
+    vjp=_vjp,
+    save=lambda arrays, outs: arrays,
+    jittable=False,  # jitted internally
+)
+
+
+def use_pallas_rms_norm(x) -> bool:
+    """Gate: TPU backend (or interpret-forced), lane-aligned hidden dim.
+    Duplicated logic lives in nn/functional/norm.py so the XLA fallback
+    never has to import the pallas stack; keep the two in sync."""
+    from ...core.flags import get_flag
+
+    if not get_flag("use_pallas_rms_norm"):
+        return False
+    if _interpret() and not get_flag("pallas_force_interpret"):
+        return False
+    hidden = x.shape[-1]
+    rows = 1
+    for s in x.shape[:-1]:
+        rows *= s
+    return hidden % 128 == 0 and rows % 8 == 0

@@ -1,0 +1,170 @@
+"""Pallas kernel numerics vs. the XLA composition oracle.
+
+Runs the TPU kernels in interpret mode on the CPU backend (SURVEY §4: the
+fake-device pattern) and checks forward values and analytic gradients against
+the dense reference implementation.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def _t(a, stop_gradient=False):
+    t = paddle.to_tensor(a)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _dense_attention(q, k, v, causal):
+    # numpy oracle, fp32, GQA by repeat
+    qh, kh = q.shape[2], k.shape[2]
+    if kh != qh:
+        rep = qh // kh
+        k = np.repeat(k, rep, axis=2)
+        v = np.repeat(v, rep, axis=2)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = np.einsum("bshd,bthd->bhst", q, k).astype(np.float64) * scale
+    if causal:
+        s, t = logits.shape[-2:]
+        mask = np.tril(np.ones((s, t), bool), t - s)
+        logits = np.where(mask, logits, -np.inf)
+    logits -= logits.max(-1, keepdims=True)
+    p = np.exp(logits)
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhst,bthd->bshd", p, v).astype(np.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("kv_heads", [4, 2])
+def test_flash_attention_forward(causal, kv_heads):
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_fused
+
+    B, S, H, D = 2, 256, 4, 64
+    q = np.random.randn(B, S, H, D).astype(np.float32) * 0.5
+    k = np.random.randn(B, S, kv_heads, D).astype(np.float32) * 0.5
+    v = np.random.randn(B, S, kv_heads, D).astype(np.float32) * 0.5
+    out = flash_attention_fused(_t(q), _t(k), _t(v), causal=causal)
+    ref = _dense_attention(q, k, v, causal)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grads(causal):
+    from paddle_tpu.nn.functional.attention import scaled_dot_product_attention
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_fused
+
+    B, S, H, D = 1, 128, 2, 64
+    qn = np.random.randn(B, S, H, D).astype(np.float32) * 0.3
+    kn = np.random.randn(B, S, H, D).astype(np.float32) * 0.3
+    vn = np.random.randn(B, S, H, D).astype(np.float32) * 0.3
+
+    # pallas path
+    q1, k1, v1 = _t(qn), _t(kn), _t(vn)
+    out = flash_attention_fused(q1, k1, v1, causal=causal)
+    out.backward(_t(np.ones_like(qn), stop_gradient=True))
+
+    # XLA oracle path (sdpa_p primitive, jax.vjp fallback backward)
+    q2, k2, v2 = _t(qn), _t(kn), _t(vn)
+    with paddle.no_grad():
+        pass
+    ref = scaled_dot_product_attention(q2, k2, v2, is_causal=causal)
+    ref.backward(_t(np.ones_like(qn), stop_gradient=True))
+
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-4, atol=2e-4)
+    for a, b in ((q1, q2), (k1, k2), (v1, v2)):
+        np.testing.assert_allclose(a.grad.numpy(), b.grad.numpy(),
+                                   rtol=3e-3, atol=3e-3)
+
+
+def test_flash_attention_gqa_grads():
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_fused
+
+    B, S, H, Hkv, D = 1, 128, 4, 2, 64
+    qn = np.random.randn(B, S, H, D).astype(np.float32) * 0.3
+    kn = np.random.randn(B, S, Hkv, D).astype(np.float32) * 0.3
+    vn = np.random.randn(B, S, Hkv, D).astype(np.float32) * 0.3
+
+    q1, k1, v1 = _t(qn), _t(kn), _t(vn)
+    out = flash_attention_fused(q1, k1, v1, causal=True)
+    loss = (out * out).sum()
+    loss.backward()
+
+    # oracle: repeat kv, dense softmax via the registered sdpa primitive
+    from paddle_tpu.nn.functional.attention import scaled_dot_product_attention
+
+    q2, k2, v2 = _t(qn), _t(kn), _t(vn)
+    ref = scaled_dot_product_attention(q2, k2, v2, is_causal=True)
+    (ref * ref).sum().backward()
+
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(q1.grad.numpy(), q2.grad.numpy(), rtol=3e-3, atol=3e-3)
+    np.testing.assert_allclose(k1.grad.numpy(), k2.grad.numpy(), rtol=3e-3, atol=3e-3)
+    np.testing.assert_allclose(v1.grad.numpy(), v2.grad.numpy(), rtol=3e-3, atol=3e-3)
+
+
+def test_flash_attention_causal_cross_length():
+    """Sq != Sk causal (KV-cache decode shape): the kernel's bottom-right
+    aligned mask must match the XLA fallback's tril(offset=Sk-Sq)."""
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_fused
+
+    B, Sq, Sk, H, D = 1, 128, 256, 2, 64
+    q = np.random.randn(B, Sq, H, D).astype(np.float32) * 0.3
+    k = np.random.randn(B, Sk, H, D).astype(np.float32) * 0.3
+    v = np.random.randn(B, Sk, H, D).astype(np.float32) * 0.3
+    out = flash_attention_fused(_t(q), _t(k), _t(v), causal=True)
+    ref = _dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_rms_norm_pallas_matches_xla():
+    from paddle_tpu.core import flags
+
+    R, Hd = 64, 256
+    xn = np.random.randn(R, Hd).astype(np.float32)
+    wn = np.random.randn(Hd).astype(np.float32)
+
+    import paddle_tpu.nn.functional as F
+
+    # pallas path (gate passes: hidden%128==0, rows%8==0, CPU interpret)
+    flags.set_flags({"use_pallas_rms_norm": True,
+                     "pallas_force_interpret": True})
+    x1, w1 = _t(xn), _t(wn)
+    y1 = F.rms_norm(x1, w1)
+    (y1 * y1).sum().backward()
+
+    flags.set_flags({"use_pallas_rms_norm": False})
+    x2, w2 = _t(xn), _t(wn)
+    y2 = F.rms_norm(x2, w2)
+    (y2 * y2).sum().backward()
+    flags.set_flags({"use_pallas_rms_norm": True,
+                     "pallas_force_interpret": False})
+
+    np.testing.assert_allclose(y1.numpy(), y2.numpy(), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(x1.grad.numpy(), x2.grad.numpy(), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(w1.grad.numpy(), w2.grad.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_rms_norm_pallas_3d_bf16():
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import flags
+
+    B, S, Hd = 2, 16, 128
+    xn = np.random.randn(B, S, Hd).astype(np.float32)
+    wn = np.ones(Hd, np.float32)
+    import paddle_tpu.nn.functional as F
+
+    flags.set_flags({"pallas_force_interpret": True})
+    try:
+        x = _t(xn.astype(np.float32))
+        x = x.astype("bfloat16")
+        w = _t(wn).astype("bfloat16")
+        y = F.rms_norm(x, w)
+        assert y.dtype == jnp.bfloat16.dtype or str(y.dtype) == "bfloat16"
+        ref = xn / np.sqrt((xn ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(y.astype("float32").numpy(), ref,
+                                   rtol=3e-2, atol=3e-2)
+    finally:
+        flags.set_flags({"pallas_force_interpret": False})
