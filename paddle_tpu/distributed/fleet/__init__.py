@@ -97,10 +97,16 @@ def distributed_model(model):
     """fleet.distributed_model parity (fleet/model.py:32,141-160). With
     GSPMD the wrapper's job (param broadcast, grad allreduce hooks) is done
     by sharding layouts, so this marks DP-replicated params and returns the
-    model."""
+    model; a PipelineLayer gets the PipelineParallel schedule wrapper
+    (model.py:146)."""
     hcg = get_hybrid_communicate_group()
     if hcg is None:
         return model
+    from .meta_parallel import PipelineLayer, PipelineParallel
+
+    if isinstance(model, PipelineLayer) and hcg.get_pipe_parallel_world_size() > 1:
+        model = PipelineParallel(model, hcg=hcg,
+                                 strategy=_fleet_state.get("strategy"))
     from ..auto_parallel.api import shard_tensor
     from ..auto_parallel.placement import Replicate
 

@@ -1,0 +1,16 @@
+"""fleet.meta_parallel parity.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/ (PipelineLayer at
+pp_layers.py:257, PipelineParallel at pipeline_parallel.py:229, TensorParallel
+wrapper, sharding stages). The TP/sharding wrappers collapse into GSPMD
+layouts (see fleet.distributed_model); pipeline keeps an explicit schedule.
+"""
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
+from .pipeline_parallel import PipelineParallel
+from ..sequence_parallel import *  # noqa: F401,F403
+from ..pipeline_spmd import pipeline_spmd_apply
+
+__all__ = [
+    "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
+    "pipeline_spmd_apply",
+]

@@ -1,0 +1,150 @@
+"""Pipeline-parallel schedule runtime.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py
+(PipelineParallel :229 — F-then-B :545, 1F1B steady state, interleaved/VPP
+:1136) over NCCL P2P (pp_utils/p2p_communication.py).
+
+TPU re-design: under a single-controller SPMD program there is no rank-local
+stage and no P2P transport — every stage is resident, so a schedule is an
+*ordering* of microbatch forward/backward work items. The orderings (FThenB,
+1F1B) are preserved for API and memory-shape parity: 1F1B bounds the number
+of live forward activations to num_stages, which matters once stages are
+placed on different chips via the compiled ppermute pipeline
+(pipeline_spmd.py) — that path is where the transport lives (ICI
+collective_permute inside one XLA program, SURVEY §7 "PP on TPU").
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ....core.tensor import Tensor
+from ....nn.layer import Layer
+from .pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("The Layer should be a derived class of PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = getattr(strategy, "pipeline_configs", {}) if strategy else {}
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self.schedule_mode = str(cfg.get("schedule_mode", "1F1B"))
+        self.num_stages = layers.num_stages
+        self.total_loss = None
+
+    # ------------------------------------------------------------------
+    def _split_micro(self, data):
+        """Split a batch (Tensor or [inputs, labels] pair) into
+        accumulate_steps microbatches along dim 0."""
+        m = self.accumulate_steps
+
+        def split_one(t):
+            n = t.shape[0]
+            if n % m:
+                raise ValueError(
+                    f"batch dim {n} not divisible by accumulate_steps {m}")
+            sz = n // m
+            return [t[i * sz:(i + 1) * sz] for i in range(m)]
+
+        if isinstance(data, (tuple, list)):
+            parts = [split_one(t) for t in data]
+            return list(zip(*parts))
+        return [(x,) for x in split_one(data)]
+
+    def _forward_micro(self, micro):
+        *inputs, label = micro if len(micro) > 1 else (micro[0], None)
+        out = self._layers(*inputs)
+        if self._layers._loss_fn is not None and label is not None:
+            return self._layers._loss_fn(out, label)
+        return out
+
+    # ------------------------------------------------------------------
+    def forward_backward_pipeline(self, data, scaler=None):
+        """Run one global batch through the schedule; returns mean loss.
+        (reference: pipeline_parallel.py:545 forward_backward_pipeline)"""
+        micros = self._split_micro(data)
+        m = len(micros)
+        losses: List[Tensor] = []
+
+        if self.schedule_mode.upper() in ("FTHENB", "F-THEN-B"):
+            # all forwards, then all backwards (reference FThenB pass)
+            for micro in micros:
+                losses.append(self._forward_micro(micro))
+            for loss in losses:
+                self._backward_one(loss, m, scaler)
+        else:
+            # 1F1B: warmup fwds, steady 1F1B, cooldown bwds
+            # (reference: pipeline_parallel.py:229 — warmup = stages-1)
+            warmup = min(self.num_stages - 1, m)
+            pending: List[Tensor] = []
+            for i in range(warmup):
+                pending.append(self._forward_micro(micros[i]))
+            for i in range(warmup, m):
+                pending.append(self._forward_micro(micros[i]))
+                loss = pending.pop(0)
+                losses.append(loss)
+                self._backward_one(loss, m, scaler)
+            while pending:
+                loss = pending.pop(0)
+                losses.append(loss)
+                self._backward_one(loss, m, scaler)
+
+        from ....ops.math import add_n, scale
+
+        total = add_n(losses)
+        return scale(total.detach(), 1.0 / m)
+
+    def _backward_one(self, loss, m, scaler):
+        from ....ops.math import scale as _scale
+
+        scaled = _scale(loss, 1.0 / m)
+        if scaler is not None:
+            scaler.scale(scaled).backward()
+        else:
+            scaled.backward()
+
+    # ------------------------------------------------------------------
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """reference: pipeline_parallel.py train_batch — schedule + step."""
+        self._layers.train()
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        self._layers.eval()
+        import paddle_tpu as paddle
+
+        micros = self._split_micro(data)
+        losses = []
+        with paddle.no_grad():
+            for micro in micros:
+                losses.append(self._forward_micro(micro))
+        from ....ops.math import add_n, scale
+
+        return scale(add_n(losses), 1.0 / len(losses))
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

@@ -1,0 +1,80 @@
+"""Compiled SPMD pipeline: GPipe schedule over ICI collective_permute.
+
+TPU-native transport for pipeline parallelism (SURVEY §7 "PP on TPU": no
+NCCL-style P2P — the schedule must map onto collective_permute inside one
+compiled step). Reference semantics: fleet/meta_parallel/pipeline_parallel.py
+micro-batch schedules + pp_utils/p2p_communication.py transport.
+
+Design: stage parameters are stacked on a leading axis sharded over the mesh
+"pp" axis; one `lax.scan` runs M + S - 1 ticks. Each tick every stage
+processes its resident microbatch and `ppermute`s the activation to the next
+stage, so all stages compute concurrently once the pipeline fills (the same
+steady state 1F1B reaches; autodiff through the scan replays the ticks in
+reverse, turning the forward ppermutes into backward ones automatically).
+The whole schedule is one XLA program — transfers ride ICI and overlap with
+compute via XLA's latency-hiding scheduler.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline_spmd_apply", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of S per-stage pytrees (identical structure) into one
+    pytree with leading dim S — the layout `pipeline_spmd_apply` consumes;
+    shard the leading dim over the pp axis."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_stage_params)
+
+
+def pipeline_spmd_apply(stage_fn: Callable, stacked_params: Any, micro_inputs,
+                        *, mesh, axis: str = "pp"):
+    """Run M microbatches through an S-stage pipeline on `mesh` axis `axis`.
+
+    stage_fn(params, x) -> y must be shape-preserving (x and y same
+    shape/dtype — the activation ppermuted between stages).
+    stacked_params: pytree, every leaf [S, ...] (sharded on the pp axis).
+    micro_inputs:  [M, micro_batch, ...] (replicated).
+    Returns [M, micro_batch, ...]: final-stage outputs, replicated.
+    """
+    S = mesh.shape[axis]
+    M = micro_inputs.shape[0]
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    in_specs = (
+        jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+        P(),
+    )
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=P(), check_vma=False)
+    def run(params, xs):
+        local = jax.tree_util.tree_map(lambda a: a[0], params)
+        s_idx = lax.axis_index(axis)
+
+        def tick(state, t):
+            # stage 0 ingests microbatch t (clipped during drain ticks);
+            # other stages consume the activation received last tick
+            x0 = lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+            x = jnp.where(s_idx == 0, x0, state)
+            y = stage_fn(local, x)
+            nxt = lax.ppermute(y, axis, perm)
+            return nxt, y
+
+        _, ys = lax.scan(tick, jnp.zeros_like(xs[0]), jnp.arange(M + S - 1))
+        # the final stage emits microbatch t at tick t + (S-1); broadcast its
+        # slice to every device so the result is replicated
+        outs = ys[S - 1:]
+        outs = jnp.where(s_idx == S - 1, outs, jnp.zeros_like(outs))
+        return lax.psum(outs, axis)
+
+    return run(stacked_params, micro_inputs)

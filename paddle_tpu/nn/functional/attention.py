@@ -17,13 +17,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...core.flags import define_flag, get_flag
+from ...core.flags import get_flag
 from ...core.tensor import Tensor, apply
 from ...ops._helpers import defprim, ensure_tensor
 
 __all__ = ["scaled_dot_product_attention", "flash_attention", "sdp_kernel"]
 
-def _sdpa_xla(q, k, v, *, causal, scale):
+def _attn_dropout(probs, key, dropout_p):
+    # reference semantics: dropout on the attention WEIGHTS (softmax output),
+    # not the output activations (flash_attention.py:991 attn_dropout)
+    if dropout_p > 0.0:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros((), probs.dtype))
+    return probs
+
+
+def _sdpa_xla(q, k, v, key, *, causal, scale, dropout_p):
     # q,k,v: [B, S, H, D] (paddle layout); kv heads may be fewer (GQA)
     qh, kh = q.shape[2], k.shape[2]
     if kh != qh:
@@ -36,10 +46,11 @@ def _sdpa_xla(q, k, v, *, causal, scale):
         mask = jnp.tril(jnp.ones((s, t), bool), t - s)
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    probs = _attn_dropout(probs, key, dropout_p)
     return jnp.einsum("bhst,bthd->bshd", probs, v)
 
 
-def _sdpa_mask_xla(q, k, v, mask, *, scale):
+def _sdpa_mask_xla(q, k, v, mask, key, *, scale, dropout_p):
     qh, kh = q.shape[2], k.shape[2]
     if kh != qh:
         rep = qh // kh
@@ -48,6 +59,7 @@ def _sdpa_mask_xla(q, k, v, mask, *, scale):
     logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
     logits = logits + mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    probs = _attn_dropout(probs, key, dropout_p)
     return jnp.einsum("bhst,bthd->bshd", probs, v)
 
 
@@ -70,21 +82,25 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
     """paddle.nn.functional.scaled_dot_product_attention parity
-    (flash_attention.py:991). Input layout [B, S, H, D]."""
+    (flash_attention.py:991). Input layout [B, S, H, D]. Dropout applies to
+    the attention weights, matching the reference; a nonzero rate routes to
+    the XLA path (the Pallas kernel has no RNG plumbing yet)."""
+    from ...core import generator
+
     q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
     scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    p = float(dropout_p) if training else 0.0
+    rng = Tensor._from_value(generator.next_key("local_seed"))
     if attn_mask is not None:
-        out = apply("sdpa_mask_p", q, k, v, ensure_tensor(attn_mask), scale=scale)
-    elif _use_pallas(q, k):
+        out = apply("sdpa_mask_p", q, k, v, ensure_tensor(attn_mask), rng,
+                    scale=scale, dropout_p=p)
+    elif _use_pallas(q, k) and p == 0.0:
         from ...ops.pallas.flash_attention import flash_attention_fused
 
         out = flash_attention_fused(q, k, v, causal=bool(is_causal), scale=scale)
     else:
-        out = apply("sdpa_p", q, k, v, causal=bool(is_causal), scale=scale)
-    if dropout_p > 0.0 and training:
-        from .common import dropout
-
-        out = dropout(out, dropout_p)
+        out = apply("sdpa_p", q, k, v, rng, causal=bool(is_causal),
+                    scale=scale, dropout_p=p)
     return out
 
 

@@ -117,18 +117,5 @@ dispatch.register_primitive(
 )
 
 
-def use_pallas_rms_norm(x) -> bool:
-    """Gate: TPU backend (or interpret-forced), lane-aligned hidden dim.
-    Duplicated logic lives in nn/functional/norm.py so the XLA fallback
-    never has to import the pallas stack; keep the two in sync."""
-    from ...core.flags import get_flag
-
-    if not get_flag("use_pallas_rms_norm"):
-        return False
-    if _interpret() and not get_flag("pallas_force_interpret"):
-        return False
-    hidden = x.shape[-1]
-    rows = 1
-    for s in x.shape[:-1]:
-        rows *= s
-    return hidden % 128 == 0 and rows % 8 == 0
+# NOTE: the dispatch gate lives in nn/functional/norm.py (_use_pallas_rms)
+# so the XLA fallback path never imports this module.

@@ -67,8 +67,6 @@ def test_flash_attention_grads(causal):
 
     # XLA oracle path (sdpa_p primitive, jax.vjp fallback backward)
     q2, k2, v2 = _t(qn), _t(kn), _t(vn)
-    with paddle.no_grad():
-        pass
     ref = scaled_dot_product_attention(q2, k2, v2, is_causal=causal)
     ref.backward(_t(np.ones_like(qn), stop_gradient=True))
 
@@ -116,6 +114,20 @@ def test_flash_attention_causal_cross_length():
     out = flash_attention_fused(_t(q), _t(k), _t(v), causal=True)
     ref = _dense_attention(q, k, v, causal=True)
     np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_sdpa_dropout_on_weights():
+    """Dropout must hit the attention weights (reference flash_attention.py
+    :991), not the output: p=1 zeroes the output entirely, p=0 is identity,
+    and eval mode ignores p."""
+    from paddle_tpu.nn.functional.attention import scaled_dot_product_attention
+
+    q = _t(np.random.randn(1, 16, 2, 8).astype(np.float32), stop_gradient=True)
+    full = scaled_dot_product_attention(q, q, q, dropout_p=0.0)
+    dropped = scaled_dot_product_attention(q, q, q, dropout_p=1.0, training=True)
+    np.testing.assert_allclose(dropped.numpy(), np.zeros_like(dropped.numpy()))
+    evaled = scaled_dot_product_attention(q, q, q, dropout_p=0.7, training=False)
+    np.testing.assert_allclose(evaled.numpy(), full.numpy(), rtol=1e-6)
 
 
 def test_rms_norm_pallas_matches_xla():
