@@ -24,6 +24,7 @@ from .auto_parallel.api import (
 )
 from .parallel_wrapper import DataParallel
 from . import fleet
+from . import utils
 from . import auto_parallel
 from . import checkpoint
 from .launch_utils import spawn, launch

@@ -1,0 +1,53 @@
+"""global_scatter / global_gather parity.
+
+Reference: python/paddle/distributed/utils/moe_utils.py:20 (global_scatter)
+and :153 (global_gather) — NCCL alltoall moving variable-length groups of
+rows between ranks according to (local_count, global_count).
+
+TPU note: variable split sizes are shape-dynamic and hostile to XLA, so the
+framework's MoE layers route with static-capacity dense dispatch instead
+(see incubate/.../moe/moe_layer.py) and GSPMD emits the all-to-all. These
+functions are kept for API parity and for code being ported: they implement
+the exact row-movement semantics for the world_size==1 (single-process
+SPMD) case, where scatter/gather degenerate to a stable reorder of rows
+grouped by expert.
+"""
+from __future__ import annotations
+
+from ...core.tensor import Tensor, apply
+from ...ops._helpers import defprim, ensure_tensor
+
+# With one rank the alltoall is the identity permutation over the
+# concatenated per-expert row groups.
+defprim("global_scatter_p", lambda x, local_count: x)
+defprim("global_gather_p", lambda x, local_count: x)
+
+
+def _check_single_rank(group, op):
+    if group is None:
+        from ..communication.group import _get_or_create_default_group
+
+        group = _get_or_create_default_group()
+    if getattr(group, "nranks", 1) > 1:
+        raise NotImplementedError(
+            f"{op} over a {group.nranks}-rank group: variable-split alltoall "
+            "is shape-dynamic and not expressible on TPU/XLA — use the MoE "
+            "layers' dense dispatch (GSPMD all-to-all) instead")
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True) -> Tensor:
+    """Reference: moe_utils.py:20. Single-process path: identity over rows
+    (groups already contiguous); multi-device routing goes through the MoE
+    layers' dense dispatch + GSPMD all-to-all."""
+    _check_single_rank(group, "global_scatter")
+    x = ensure_tensor(x)
+    return apply("global_scatter_p", x, ensure_tensor(local_count))
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True) -> Tensor:
+    """Reference: moe_utils.py:153 — inverse permutation of global_scatter."""
+    _check_single_rank(group, "global_gather")
+    x = ensure_tensor(x)
+    return apply("global_gather_p", x, ensure_tensor(local_count))

@@ -1,2 +1,2 @@
 """paddle.incubate parity (MoE, fused ops). Reference: python/paddle/incubate."""
-from . import nn
+from . import distributed, nn

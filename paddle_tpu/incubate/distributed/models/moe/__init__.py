@@ -1,0 +1,14 @@
+"""paddle.incubate.distributed.models.moe parity.
+
+Reference: python/paddle/incubate/distributed/models/moe/__init__.py
+(exports MoELayer + gates). TPU design notes in moe_layer.py / gate.py.
+"""
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .moe_layer import (  # noqa: F401
+    ExpertsFFN, FusedMoELayer, MoELayer,
+)
+
+__all__ = [
+    "MoELayer", "FusedMoELayer", "ExpertsFFN",
+    "BaseGate", "NaiveGate", "GShardGate", "SwitchGate",
+]

@@ -1,0 +1,198 @@
+"""MoE gates — TPU-native dense-dispatch formulation.
+
+Reference: python/paddle/incubate/distributed/models/moe/gate/
+(base_gate.py, naive_gate.py, gshard_gate.py, switch_gate.py). The
+reference gates emit per-token expert *indices* consumed by index-based
+scatter/gather CUDA kernels. On TPU, index scatter is hostile to the MXU
+and to static shapes, so gates here emit the GShard-paper dense dispatch
+tensors instead:
+
+    combine_weights : [N, E, C] float — gradient-carrying mixture weights
+    dispatch_mask   : [N, E, C] float — 0/1 routing mask (stop-gradient)
+
+with a static per-expert capacity C, so the whole MoE layer is three
+einsums that tile straight onto the MXU and shard over the EP mesh axis.
+Aux (load-balance) losses match the reference formulas.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor, apply
+from .....nn import functional as F  # noqa: F401  (parity import)
+from .....nn import initializer as I
+from .....nn.layer import Layer
+from .....ops._helpers import defprim, ensure_tensor
+
+
+def _dispatch_from_probs(probs, *, k, capacity, normalize, random2, key):
+    """Build [N,E,C] combine/dispatch from [N,E] probs (GShard Algorithm 1).
+
+    Position-in-expert comes from a cumsum over the token dim — the same
+    ordering the reference's index kernels produce (first-come priority).
+    """
+    n, e = probs.shape
+    c = capacity
+    top_vals, top_idx = jax.lax.top_k(probs, k)  # [N,k]
+    if random2 and k >= 2:
+        # GShardGate random routing (gshard_gate.py random_routing):
+        # keep the 2nd expert iff rand < 2 * topk_value[:, 1]
+        u = jax.random.uniform(key, (n,))
+        keep2 = u < 2.0 * top_vals[:, 1]
+        top_vals = top_vals.at[:, 1].set(jnp.where(keep2, top_vals[:, 1], 0.0))
+    if normalize:
+        denom = jnp.sum(top_vals, axis=1, keepdims=True)
+        top_vals = top_vals / jnp.maximum(denom, 1e-9)
+
+    combine = jnp.zeros((n, e, c), probs.dtype)
+    dispatch = jnp.zeros((n, e, c), probs.dtype)
+    # running token count per expert, accumulated across the k passes so
+    # second-choice tokens queue behind first-choice ones (GShard semantics)
+    prior = jnp.zeros((e,), jnp.int32)
+    for j in range(k):
+        mask = jax.nn.one_hot(top_idx[:, j], e, dtype=jnp.int32)  # [N,E]
+        # tokens zeroed by random routing must not consume capacity slots
+        # (reference sets their index to -1 before the position count)
+        mask = mask * (top_vals[:, j] > 0).astype(jnp.int32)[:, None]
+        pos = jnp.cumsum(mask, axis=0) - mask + prior[None, :]    # [N,E]
+        prior = prior + jnp.sum(mask, axis=0)
+        pos_j = jnp.sum(pos * mask, axis=1)                       # [N]
+        keep = (pos_j < c) & (top_vals[:, j] > 0)
+        w = jnp.where(keep, top_vals[:, j], 0.0)
+        onehot_pos = jax.nn.one_hot(pos_j, c, dtype=probs.dtype)  # [N,C]
+        sel = mask.astype(probs.dtype)
+        combine = combine + w[:, None, None] * sel[:, :, None] * onehot_pos[:, None, :]
+        dispatch = dispatch + jnp.where(keep, 1.0, 0.0)[:, None, None] \
+            * sel[:, :, None] * onehot_pos[:, None, :]
+    return combine, jax.lax.stop_gradient(dispatch)
+
+
+defprim(
+    "moe_dispatch_p",
+    lambda probs, key, *, k, capacity, normalize, random2:
+        _dispatch_from_probs(probs, k=k, capacity=capacity,
+                             normalize=normalize, random2=random2, key=key),
+    multi_out=True,
+)
+
+
+class BaseGate(Layer):
+    """Reference: gate/base_gate.py — tracks (num_expert, world_size) and a
+    settable aux loss retrieved by the trainer."""
+
+    def __init__(self, num_expert: int, world_size: int):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = num_expert * world_size
+        self.loss = None
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+
+def _capacity(num_tokens: int, num_experts: int, k: int, factor: float) -> int:
+    return max(4, int(math.ceil(k * num_tokens / num_experts * factor)))
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax gate, no balance loss (reference: gate/naive_gate.py).
+
+    Dense form uses a generous capacity (2× even share) since the reference
+    naive gate never drops tokens.
+    """
+
+    def __init__(self, d_model, num_expert, world_size, topk=2,
+                 capacity_factor=2.0):
+        super().__init__(num_expert, world_size)
+        self.d_model = d_model
+        self.topk = topk
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter(
+            [d_model, self.tot_expert], default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter(
+            [self.tot_expert], is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self._normalize = True
+        self._random2 = False
+        self._loss_kind = None
+
+    def _train_factor(self):
+        return self.capacity_factor
+
+    def forward(self, x):
+        """x: [N, d_model] → (combine [N,E,C], dispatch [N,E,C])."""
+        from .....core import generator
+
+        logits = x.matmul(self.weight) + self.bias
+        probs = F.softmax(logits, axis=-1)
+        n = int(x.shape[0])
+        cap = _capacity(n, self.tot_expert, self.topk, self._train_factor())
+        # trace-aware draw: under jit the key comes from the traced key
+        # stream (generator.py next_key), not a baked-in constant
+        key = generator.next_key()
+        combine, dispatch = apply(
+            "moe_dispatch_p", probs, Tensor._from_value(key),
+            k=self.topk, capacity=cap, normalize=self._normalize,
+            random2=self._random2 and self.training,
+        )
+        if self._loss_kind is not None:
+            self.set_loss(self._balance_loss(probs))
+        return combine, dispatch
+
+    def _balance_loss(self, probs):
+        # l_aux = E * Σ_e mean_tokens(prob_e) * frac_tokens(top1==e)
+        # (gshard_gate.py / switch_gate.py formula)
+        me = probs.mean(axis=0)
+        top1 = probs.argmax(axis=-1)
+        ce = apply("one_hot_p", ensure_tensor(top1),
+                   num_classes=self.tot_expert).mean(axis=0)
+        return (me * ce).sum() * float(self.tot_expert)
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with capacity + balance loss + random second-expert
+    routing (reference: gate/gshard_gate.py; capacity=(1.2, 2.4))."""
+
+    def __init__(self, d_model, num_expert, world_size, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        super().__init__(d_model, num_expert, world_size, topk=topk)
+        self.capacity = capacity
+        self._random2 = random_routing
+        self._loss_kind = "gshard"
+
+    def _train_factor(self):
+        return self.capacity[0] if self.training else self.capacity[1]
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 switch gate with jitter noise + switch loss
+    (reference: gate/switch_gate.py; topk=1, capacity=(1.2, 2.4))."""
+
+    def __init__(self, d_model, num_expert, world_size, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+        self._normalize = False
+        self._loss_kind = "switch"
+
+    def _train_factor(self):
+        return self.capacity[0] if self.training else self.capacity[1]
+
+    def forward(self, x):
+        if self.training and self.switch_eps > 0:
+            from .....ops import creation
+
+            noise = creation.rand(x.shape, dtype=x.dtype)
+            x = x * (noise * (2 * self.switch_eps) + (1.0 - self.switch_eps))
+        return super().forward(x)

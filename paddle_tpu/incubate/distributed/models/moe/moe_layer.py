@@ -1,0 +1,191 @@
+"""Mixture-of-Experts layer — TPU-native dense dispatch + EP sharding.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py
+(MoELayer:263 — gate → global_scatter all-to-all → per-expert FFN →
+global_gather all-to-all → combine). The reference moves *rows* between
+ranks with index-based NCCL alltoall (`global_scatter`:119 /
+`global_gather`:140).
+
+TPU re-design: routing is three einsums over dense [N, E, C] dispatch
+tensors (GShard formulation, see gate.py) —
+
+    dispatched = einsum('nec,nd->ecd', dispatch_mask, x)
+    expert_out = expert_e(dispatched[e])            # batched FFN on MXU
+    out        = einsum('nec,ecd->nd', combine, expert_out)
+
+Expert parallelism = Shard(0) of the E dim of `dispatched` (and of stacked
+expert weights) over the mesh's ep/mp axis; GSPMD lowers the two einsums
+to the same all-to-all pair the reference hand-codes, scheduled on ICI.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .....core.tensor import Tensor
+from .....nn import initializer as I
+from .....nn.container import LayerList
+from .....nn.layer import Layer
+from .....ops.linalg import einsum
+from .....ops.manipulation import concat, reshape, split, squeeze, stack
+from .....distributed.auto_parallel.api import shard_tensor
+from .....distributed.auto_parallel.placement import Replicate, Shard
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+
+def _ep_mesh(moe_group, num_expert: int):
+    """The mesh axis expert weights/activations shard over, if any.
+
+    An explicit moe_group is an opt-in; the hybrid-topology fallback only
+    picks an axis whose degree divides num_expert (an mp-only model adding
+    a 6-expert MoE under mp=8 must not crash in device_put).
+    """
+    if moe_group is not None and getattr(moe_group, "mesh", None) is not None:
+        return moe_group.mesh, moe_group.axis_name
+    from .....distributed.fleet.topology import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return None, None
+    for axis in ("ep", "mp"):
+        if axis in hcg.mesh.dim_names:
+            degree = hcg.mesh.get_dim_size(axis)
+            if degree > 1 and num_expert % degree == 0:
+                return hcg.mesh, axis
+    return None, None
+
+
+def _shard_expert_dim(t: Tensor, mesh, axis_name: str, dim: int = 0) -> Tensor:
+    placements = [Replicate() for _ in range(mesh.ndim)]
+    placements[mesh.dim_names.index(axis_name)] = Shard(dim)
+    return shard_tensor(t, mesh, placements)
+
+
+def _make_gate(gate, d_model: int, num_expert: int) -> BaseGate:
+    if isinstance(gate, BaseGate):
+        return gate
+    if isinstance(gate, (dict, str)):
+        cfg = {"type": gate} if isinstance(gate, str) else dict(gate)
+        kind = cfg.pop("type", "gshard")
+        cls = {"gshard": GShardGate, "switch": SwitchGate,
+               "naive": NaiveGate}[kind]
+        return cls(d_model, num_expert, 1, **cfg)
+    raise TypeError(f"unsupported gate spec: {gate!r}")
+
+
+class MoELayer(Layer):
+    """Reference-parity MoE wrapper (moe_layer.py:263).
+
+    Args mirror the reference: ``d_model``, ``experts`` (list of Layers, one
+    per expert), ``gate`` (dict config / BaseGate / name), ``moe_group``
+    (expert-parallel group), ``recompute_interval``.
+    """
+
+    def __init__(self, d_model: int, experts: Sequence[Layer],
+                 gate=None, moe_group=None, mp_group=None,
+                 recompute_interval: int = 0, **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = LayerList(list(experts))
+        self.num_expert = len(self.experts)
+        self.recompute_interval = recompute_interval
+        self.moe_group = moe_group
+        self._mesh, self._ep_axis = _ep_mesh(moe_group, self.num_expert)
+        self.gate = _make_gate(gate or {"type": "gshard"}, d_model,
+                               self.num_expert)
+
+    def forward(self, inp: Tensor) -> Tensor:
+        orig_shape = list(inp.shape)
+        x = reshape(inp, [-1, self.d_model])
+        combine, dispatch = self.gate(x)
+        # [N,E,C] x [N,d] -> [E,C,d]; under EP the E dim is sharded and
+        # GSPMD emits the scatter all-to-all here (reference global_scatter)
+        dispatched = einsum("nec,nd->ecd", dispatch, x)
+        if self._mesh is not None:
+            dispatched = _shard_expert_dim(dispatched, self._mesh, self._ep_axis)
+        parts = split(dispatched, self.num_expert, axis=0)
+        expert_outs = []
+        for e, expert in enumerate(self.experts):
+            xe = squeeze(parts[e], axis=0)
+            if self.recompute_interval > 0 and not xe.stop_gradient:
+                from .....distributed.fleet.utils import recompute
+
+                expert_outs.append(recompute(expert, xe))
+            else:
+                expert_outs.append(expert(xe))
+        y = stack(expert_outs, axis=0)  # [E,C,d]
+        if self._mesh is not None:
+            y = _shard_expert_dim(y, self._mesh, self._ep_axis)
+        # combine all-to-all back (reference global_gather)
+        out = einsum("nec,ecd->nd", combine, y)
+        return reshape(out, orig_shape[:-1] + [out.shape[-1]])
+
+
+class ExpertsFFN(Layer):
+    """Stacked-weight expert bank — the MXU fast path.
+
+    All experts' FFN weights live in single [E, ...] tensors so the expert
+    compute is ONE batched einsum (no python loop), and EP sharding of the
+    weights' dim 0 rides the same all-to-all as the activations. This is
+    the layout `fused_ec_moe` (reference incubate/nn/functional/
+    fused_ec_moe.py) assumes.
+    """
+
+    def __init__(self, num_expert: int, d_model: int, d_hidden: int,
+                 activation: str = "gelu", moe_group=None):
+        super().__init__()
+        self.num_expert = num_expert
+        self.activation = activation
+        self.w0 = self.create_parameter(
+            [num_expert, d_model, d_hidden],
+            default_initializer=I.XavierUniform())
+        self.b0 = self.create_parameter(
+            [num_expert, 1, d_hidden], is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.w1 = self.create_parameter(
+            [num_expert, d_hidden, d_model],
+            default_initializer=I.XavierUniform())
+        self.b1 = self.create_parameter(
+            [num_expert, 1, d_model], is_bias=True,
+            default_initializer=I.Constant(0.0))
+        mesh, axis = _ep_mesh(moe_group, num_expert)
+        if mesh is not None:
+            for p in (self.w0, self.b0, self.w1, self.b1):
+                _shard_expert_dim(p, mesh, axis)
+
+    def forward(self, dispatched: Tensor) -> Tensor:
+        """[E, C, d] → [E, C, d]: two batched GEMMs over the expert dim."""
+        from .....nn import functional as F
+
+        h = einsum("ecd,edh->ech", dispatched, self.w0) + self.b0
+        h = getattr(F, self.activation)(h)
+        return einsum("ech,ehd->ecd", h, self.w1) + self.b1
+
+
+class FusedMoELayer(Layer):
+    """MoE with a stacked `ExpertsFFN` bank — what large models should use.
+
+    Same routing as `MoELayer`, but expert compute is a single batched
+    einsum pair, so the whole layer is 4 MXU einsums + gate. EP shards both
+    weights and dispatched activations on the expert dim.
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_expert: int,
+                 gate=None, activation: str = "gelu", moe_group=None):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = ExpertsFFN(num_expert, d_model, d_hidden,
+                                  activation, moe_group)
+        self.num_expert = num_expert
+        self._mesh, self._ep_axis = _ep_mesh(moe_group, num_expert)
+        self.gate = _make_gate(gate or {"type": "gshard"}, d_model, num_expert)
+
+    def forward(self, inp: Tensor) -> Tensor:
+        orig_shape = list(inp.shape)
+        x = reshape(inp, [-1, self.d_model])
+        combine, dispatch = self.gate(x)
+        dispatched = einsum("nec,nd->ecd", dispatch, x)
+        if self._mesh is not None:
+            dispatched = _shard_expert_dim(dispatched, self._mesh, self._ep_axis)
+        y = self.experts(dispatched)
+        out = einsum("nec,ecd->nd", combine, y)
+        return reshape(out, orig_shape[:-1] + [self.d_model])

@@ -237,3 +237,38 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
     if not pre_layer_norm:
         out = layer_norm(out, [d], ln_scale, ln_bias, ln_epsilon)
     return out
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu"):
+    """Expert-Choice-style fused MoE FFN.
+
+    Reference: incubate/nn/functional/fused_ec_moe.py (phi fused_moe
+    kernel): x [B,S,d], gate logits [B,S,E], stacked expert weights
+    bmm0 [E,d,h] / bmm1 [E,h,d]. TPU form: softmax-weighted sum of all
+    experts' FFNs — two batched einsums, fully on the MXU, expert dim
+    shardable over the ep axis.
+    """
+    from ....ops.activation import gelu, relu, softmax
+    from ....ops.linalg import einsum
+
+    if act_type not in ("gelu", "relu"):
+        raise ValueError(f"fused_ec_moe: unsupported act_type {act_type!r}")
+    x = ensure_tensor(x)
+    gate = ensure_tensor(gate)
+    probs = softmax(gate, axis=-1)                      # [B,S,E]
+    from ....ops.manipulation import reshape as _rs
+
+    h = einsum("bsd,edh->bseh", x, ensure_tensor(bmm0_weight))
+    if bmm0_bias is not None:
+        b0 = ensure_tensor(bmm0_bias)
+        h = h + _rs(b0, [b0.shape[0], b0.shape[-1]])    # [E,h] broadcasts
+    h = gelu(h) if act_type == "gelu" else relu(h)
+    y = einsum("bseh,ehd->bsed", h, ensure_tensor(bmm1_weight))
+    if bmm1_bias is not None:
+        b1 = ensure_tensor(bmm1_bias)
+        y = y + _rs(b1, [b1.shape[0], b1.shape[-1]])
+    return einsum("bse,bsed->bsd", probs, y)
+
+
+__all__.append("fused_ec_moe")
