@@ -17,7 +17,8 @@ from .mp_layers import (
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
     VocabParallelEmbedding,
 )
-from . import sequence_parallel
+from . import context_parallel, sequence_parallel
+from .context_parallel import ring_attention, ulysses_attention
 from .sequence_parallel import (
     ColumnSequenceParallelLinear, RowSequenceParallelLinear, ScatterOp,
     GatherOp, AllGatherOp, ReduceScatterOp,

@@ -1,0 +1,49 @@
+"""Segment parallelism wrapper (the reference's "sep" axis).
+
+Reference: python/paddle/distributed/fleet/meta_parallel/segment_parallel.py:26
+(SegmentParallel — broadcasts inputs in the sep group so each rank works on
+its sequence segment; topology axis at fleet/base/topology.py:188).
+
+TPU re-design: the wrapper pins the input's sequence dim to Shard over the
+sep mesh axis; attention inside the model must be ring/Ulysses
+(fleet.context_parallel) so the sharded sequence is still attended
+globally. Everything else (LN, FFN, embeddings) is pointwise over the
+sequence and needs no change — GSPMD keeps it local.
+"""
+from __future__ import annotations
+
+from ....core.tensor import Tensor
+from ....nn.layer import Layer
+from ...auto_parallel.api import shard_tensor
+from ...auto_parallel.placement import Replicate, Shard
+from ..topology import get_hybrid_communicate_group
+
+
+class SegmentParallel(Layer):
+    """Wrap a model so batch inputs arrive sequence-sharded on sep.
+
+    ``seq_axis`` is the dim of each input tensor holding the sequence
+    (default 1: [batch, seq, ...]).
+    """
+
+    def __init__(self, layers: Layer, hcg=None, seq_axis: int = 1, **kwargs):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._seq_axis = seq_axis
+
+    def _shard_input(self, t):
+        if not isinstance(t, Tensor):
+            return t
+        hcg = self._hcg
+        if hcg is None or hcg.get_sep_parallel_world_size() <= 1:
+            return t
+        mesh = hcg.mesh
+        placements = [Replicate() for _ in range(mesh.ndim)]
+        placements[mesh.dim_names.index("sep")] = Shard(self._seq_axis)
+        return shard_tensor(t, mesh, placements)
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_input(t) for t in inputs)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)

@@ -44,6 +44,18 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     recompute: bool = False  # activation checkpointing per decoder layer
     dtype: str = "float32"
+    # context parallelism: "ring" | "ulysses" | None. When set, attention
+    # runs over the sequence sharded on cp_mesh_axis (fleet.context_parallel
+    # — capability the reference lacks, SURVEY §5.7). Sequences longer than
+    # one chip's HBM shard across the sep axis of the active mesh.
+    context_parallel: Optional[str] = None
+    cp_mesh_axis: str = "sep"
+
+    def __post_init__(self):
+        if self.context_parallel not in (None, "ring", "ulysses"):
+            raise ValueError(
+                f"context_parallel must be None, 'ring' or 'ulysses', "
+                f"got {self.context_parallel!r}")
 
     @staticmethod
     def llama3_8b() -> "LlamaConfig":
@@ -103,11 +115,33 @@ class LlamaAttention(nn.Layer):
             q, k, v, position_ids=position_ids,
             use_neox_rotary_style=True, rotary_emb_base=self.config.rope_theta,
         )
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attention_mask,
-            is_causal=attention_mask is None,
-        )
+        if self.config.context_parallel:
+            if attention_mask is not None:
+                raise NotImplementedError(
+                    "context_parallel attention is causal-only; custom "
+                    "attention_mask is not supported under ring/ulysses")
+            out = self._cp_attention(q, k, v)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attention_mask,
+                is_causal=attention_mask is None,
+            )
         return self.o_proj(out.reshape([b, s, h]))
+
+    def _cp_attention(self, q, k, v):
+        """Ring/Ulysses attention over the sequence-sharded sep axis."""
+        from ..distributed.fleet.context_parallel import (
+            ring_attention, ulysses_attention,
+        )
+        from ..ops.manipulation import repeat_interleave
+
+        if self.num_kv_heads != self.num_heads:  # GQA: expand kv heads
+            rep = self.num_heads // self.num_kv_heads
+            k = repeat_interleave(k, rep, axis=2)
+            v = repeat_interleave(v, rep, axis=2)
+        fn = {"ring": ring_attention, "ulysses": ulysses_attention}[
+            self.config.context_parallel]
+        return fn(q, k, v, axis=self.config.cp_mesh_axis, causal=True)
 
 
 class LlamaMLP(nn.Layer):
