@@ -61,12 +61,18 @@ import sys as _sys
 
 def __getattr__(name):
     # heavyweight subpackages loaded on demand
-    if name in ("distributed", "vision", "profiler", "hapi"):
+    if name in ("distributed", "vision", "profiler", "hapi", "callbacks"):
         import importlib
 
         mod = importlib.import_module(f".{name}", __name__)
         setattr(_sys.modules[__name__], name, mod)
         return mod
+    if name in ("Model", "summary"):
+        from . import hapi as _hapi
+
+        val = getattr(_hapi, name)
+        setattr(_sys.modules[__name__], name, val)
+        return val
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 

@@ -1,0 +1,5 @@
+"""paddle.callbacks namespace parity (re-exports hapi callbacks)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    VisualDL,
+)

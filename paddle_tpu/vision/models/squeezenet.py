@@ -1,0 +1,82 @@
+"""SqueezeNet. Reference: python/paddle/vision/models/squeezenet.py
+(fire modules, versions 1.0/1.1)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class MakeFire(nn.Layer):
+    def __init__(self, in_channels, squeeze_channels, expand1x1_channels,
+                 expand3x3_channels):
+        super().__init__()
+        self._conv = nn.Conv2D(in_channels, squeeze_channels, 1)
+        self._conv_path1 = nn.Conv2D(squeeze_channels, expand1x1_channels, 1)
+        self._conv_path2 = nn.Conv2D(squeeze_channels, expand3x3_channels, 3,
+                                     padding=1)
+        self._relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self._relu(self._conv(x))
+        x1 = self._relu(self._conv_path1(x))
+        x2 = self._relu(self._conv_path2(x))
+        return concat([x1, x2], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.version = version
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self._conv = nn.Conv2D(3, 96, 7, stride=2)
+            fires = [(96, 16, 64, 64), (128, 16, 64, 64), (128, 32, 128, 128),
+                     (256, 32, 128, 128), (256, 48, 192, 192),
+                     (384, 48, 192, 192), (384, 64, 256, 256),
+                     (512, 64, 256, 256)]
+            self._pool_after = {0: True, 3: True, 7: True}
+        elif version == "1.1":
+            self._conv = nn.Conv2D(3, 64, 3, stride=2, padding=1)
+            fires = [(64, 16, 64, 64), (128, 16, 64, 64), (128, 32, 128, 128),
+                     (256, 32, 128, 128), (256, 48, 192, 192),
+                     (384, 48, 192, 192), (384, 64, 256, 256),
+                     (512, 64, 256, 256)]
+            self._pool_after = {1: True, 3: True}
+        else:
+            raise ValueError(f"unsupported SqueezeNet version {version}")
+        self._fires = nn.LayerList([MakeFire(*f) for f in fires])
+        self._relu = nn.ReLU()
+        self._max_pool = nn.MaxPool2D(3, 2)
+        if num_classes > 0:
+            self._drop = nn.Dropout(0.5)
+            self._conv2 = nn.Conv2D(512, num_classes, 1)
+        if with_pool:
+            self._avg_pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self._max_pool(self._relu(self._conv(x)))
+        for i, fire in enumerate(self._fires):
+            x = fire(x)
+            if self._pool_after.get(i):
+                x = self._max_pool(x)
+        if self.num_classes > 0:
+            x = self._relu(self._conv2(self._drop(x)))
+        if self.with_pool:
+            x = self._avg_pool(x)
+            x = x.flatten(1)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("squeezenet1_0: pretrained unavailable")
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("squeezenet1_1: pretrained unavailable")
+    return SqueezeNet("1.1", **kwargs)

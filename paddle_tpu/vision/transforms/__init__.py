@@ -1,0 +1,233 @@
+"""paddle.vision.transforms parity (core set).
+
+Reference: python/paddle/vision/transforms/transforms.py (+functional.py).
+Transforms accept PIL images, numpy HWC arrays, or Tensors; ToTensor
+produces CHW float32 in [0,1] like the reference.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import Sequence
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Pad", "Transpose",
+    "to_tensor", "normalize", "resize", "center_crop", "hflip", "vflip",
+]
+
+
+def _to_numpy_hwc(img):
+    try:
+        from PIL import Image
+
+        if isinstance(img, Image.Image):
+            arr = np.asarray(img)
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+            return arr
+    except ImportError:
+        pass
+    if isinstance(img, Tensor):
+        img = np.asarray(img._value)
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def to_tensor(pic, data_format="CHW") -> Tensor:
+    raw = _to_numpy_hwc(pic)
+    arr = raw.astype(np.float32)
+    if raw.dtype == np.uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor._from_value(np.ascontiguousarray(arr))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    if isinstance(img, Tensor):
+        arr = np.asarray(img._value)
+    else:
+        arr = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        mean, std = mean.reshape(-1, 1, 1), std.reshape(-1, 1, 1)
+    out = (arr - mean) / std
+    return Tensor._from_value(out) if isinstance(img, Tensor) else out
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _to_numpy_hwc(img)
+    if isinstance(size, numbers.Number):
+        h, w = arr.shape[:2]
+        if h <= w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    from PIL import Image
+
+    resample = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+                "bicubic": Image.BICUBIC}[interpolation]
+    # PIL can't build multi-channel float images; resize per-channel in fp32
+    if arr.dtype != np.uint8:
+        chans = [np.asarray(Image.fromarray(arr[:, :, c].astype(np.float32),
+                                            mode="F")
+                            .resize((size[1], size[0]), resample))
+                 for c in range(arr.shape[-1])]
+        return np.stack(chans, axis=-1)
+    pil = Image.fromarray(arr.squeeze(-1) if arr.shape[-1] == 1 else arr)
+    out = np.asarray(pil.resize((size[1], size[0]), resample))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return out
+
+
+def center_crop(img, output_size):
+    arr = _to_numpy_hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    i = max(0, (h - th) // 2)
+    j = max(0, (w - tw) // 2)
+    return arr[i:i + th, j:j + tw]
+
+
+def hflip(img):
+    return _to_numpy_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _to_numpy_hwc(img)[::-1]
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        super().__init__(keys)
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _to_numpy_hwc(img)
+        if self.padding:
+            p = self.padding
+            if isinstance(p, numbers.Number):
+                p = (p, p)
+            arr = np.pad(arr, ((p[0], p[0]), (p[1], p[1]), (0, 0)))
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = random.randint(0, max(0, h - th))
+        j = random.randint(0, max(0, w - tw))
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else _to_numpy_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else _to_numpy_hwc(img)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        if isinstance(padding, numbers.Number):
+            padding = (padding, padding)
+        self.padding = padding
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _to_numpy_hwc(img)
+        p = self.padding
+        return np.pad(arr, ((p[0], p[0]), (p[1], p[1]), (0, 0)),
+                      constant_values=self.fill)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return _to_numpy_hwc(img).transpose(self.order)

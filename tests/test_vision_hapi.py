@@ -1,0 +1,148 @@
+"""Vision model zoo + hapi Model API tests (reference pattern:
+test/legacy_test/test_vision_models.py + test_model.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import (
+    LeNet, alexnet, densenet121, mobilenet_v1, mobilenet_v2,
+    mobilenet_v3_small, resnet18, resnet50, resnext50_32x4d, shufflenet_v2_x0_25,
+    squeezenet1_1, vgg11, wide_resnet50_2,
+)
+
+
+class TestVisionModels:
+    @pytest.mark.parametrize("factory", [
+        lambda: resnet18(num_classes=7),
+        lambda: mobilenet_v2(scale=0.25, num_classes=7),
+        lambda: squeezenet1_1(num_classes=7),
+        lambda: shufflenet_v2_x0_25(num_classes=7),
+    ], ids=["resnet18", "mobilenetv2", "squeezenet", "shufflenet"])
+    def test_forward_shape(self, factory):
+        m = factory()
+        m.eval()
+        y = m(paddle.randn([2, 3, 64, 64]))
+        assert y.shape == [2, 7]
+
+    def test_lenet_train_step(self):
+        m = LeNet()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        x = paddle.randn([4, 1, 28, 28])
+        y = paddle.to_tensor(np.random.randint(0, 10, (4,)))
+        loss = nn.functional.cross_entropy(m(x), y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        assert np.isfinite(float(loss._value))
+
+    def test_resnet50_structure(self):
+        m = resnet50(num_classes=0, with_pool=True)  # headless
+        n_params = sum(int(np.prod(p.shape)) for p in m.parameters())
+        # reference resnet50 backbone ≈ 23.5M params
+        assert 23_000_000 < n_params < 24_000_000
+
+    def test_resnext_groups(self):
+        m = resnext50_32x4d(num_classes=4)
+        assert m(paddle.randn([1, 3, 64, 64])).shape == [1, 4]
+
+    def test_pretrained_raises(self):
+        with pytest.raises(NotImplementedError):
+            resnet18(pretrained=True)
+
+
+class TestTransforms:
+    def test_compose_pipeline(self):
+        from paddle_tpu.vision import transforms as T
+
+        tr = T.Compose([T.Resize(32), T.CenterCrop(28), T.ToTensor(),
+                        T.Normalize(mean=[0.5], std=[0.5])])
+        img = (np.random.rand(40, 48, 3) * 255).astype("uint8")
+        out = tr(img)
+        assert list(out.shape) == [3, 28, 28]
+        v = np.asarray(out._value)
+        assert v.min() >= -1.01 and v.max() <= 1.01
+
+    def test_random_flip(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+        flipped = T.RandomHorizontalFlip(prob=1.0)(img)
+        np.testing.assert_allclose(flipped, img[:, ::-1])
+
+
+class TestFakeData:
+    def test_deterministic(self):
+        ds = FakeData(size=8, image_shape=(1, 8, 8), num_classes=3)
+        x1, y1 = ds[0]
+        x2, y2 = ds[0]
+        np.testing.assert_allclose(x1, x2)
+        assert len(ds) == 8
+
+
+class TestHapiModel:
+    def _model(self):
+        net = LeNet()
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=1e-3,
+                                            parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(),
+            metrics=paddle.metric.Accuracy(),
+        )
+        return model
+
+    def test_fit_evaluate_predict(self, capsys):
+        model = self._model()
+        data = FakeData(size=16, image_shape=(1, 28, 28), num_classes=10)
+        model.fit(data, epochs=1, batch_size=8, verbose=2, log_freq=1)
+        out = capsys.readouterr().out
+        assert "loss" in out
+        logs = model.evaluate(data, batch_size=8, verbose=0)
+        assert "acc" in logs or "loss" in logs
+        preds = model.predict(data, batch_size=8, stack_outputs=True)
+        assert preds[0].shape == (16, 10)
+
+    def test_fit_loss_decreases(self):
+        model = self._model()
+        data = FakeData(size=32, image_shape=(1, 28, 28), num_classes=10)
+        losses = []
+
+        class Rec(paddle.callbacks.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                losses.append(logs["loss"])
+
+        model.fit(data, epochs=3, batch_size=16, verbose=0, callbacks=[Rec()])
+        assert np.mean(losses[-2:]) < np.mean(losses[:2])
+
+    def test_save_load(self, tmp_path):
+        model = self._model()
+        path = str(tmp_path / "ckpt" / "model")
+        model.save(path)
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+        model2 = self._model()
+        model2.load(path)
+        w1 = np.asarray(model.network.parameters()[0]._value)
+        w2 = np.asarray(model2.network.parameters()[0]._value)
+        np.testing.assert_allclose(w1, w2)
+
+    def test_summary(self, capsys):
+        model = self._model()
+        info = model.summary()
+        assert info["total_params"] > 0
+        assert "Total params" in capsys.readouterr().out
+
+    def test_early_stopping(self):
+        model = self._model()
+        data = FakeData(size=16, image_shape=(1, 28, 28), num_classes=10)
+        es = paddle.callbacks.EarlyStopping(monitor="loss", patience=0,
+                                            save_best_model=False, verbose=0)
+        model.fit(data, eval_data=data, epochs=5, batch_size=8, verbose=0,
+                  callbacks=[es])
+        # with patience=0 and a noisy tiny set, training stops before 5 epochs
+        assert model.stop_training or es.best_value is not None
