@@ -1,0 +1,61 @@
+"""Device memory statistics — the allocator-facade's stats surface.
+
+Reference parity: paddle/fluid/memory/allocation/allocator_facade.h:45 and
+stats (memory/stats.h); Python surface paddle.device.cuda.memory_allocated
+etc. On TPU the allocator is PJRT's (BFC arena inside the runtime); we
+surface its live statistics via ``Device.memory_stats()`` rather than
+re-implementing an arena the runtime already owns.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+def _device(device_id: Optional[int] = None):
+    import jax
+    devs = jax.local_devices()
+    return devs[device_id or 0]
+
+
+def memory_stats(device_id: Optional[int] = None) -> Dict[str, int]:
+    d = _device(device_id)
+    try:
+        return dict(d.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device_id: Optional[int] = None) -> int:
+    return memory_stats(device_id).get("bytes_in_use", 0)
+
+
+def max_memory_allocated(device_id: Optional[int] = None) -> int:
+    s = memory_stats(device_id)
+    return s.get("peak_bytes_in_use", s.get("bytes_in_use", 0))
+
+
+def memory_reserved(device_id: Optional[int] = None) -> int:
+    s = memory_stats(device_id)
+    return s.get("bytes_reserved", s.get("pool_bytes", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device_id: Optional[int] = None) -> int:
+    s = memory_stats(device_id)
+    return s.get("peak_bytes_reserved", max_memory_allocated(device_id))
+
+
+def empty_cache() -> None:
+    """Free cached device buffers held by dead Python references."""
+    import gc
+    gc.collect()
+
+
+def get_device_properties(device_id: Optional[int] = None):
+    d = _device(device_id)
+    s = memory_stats(device_id)
+    return {
+        "name": getattr(d, "device_kind", str(d)),
+        "platform": d.platform,
+        "id": d.id,
+        "total_memory": s.get("bytes_limit", 0),
+    }

@@ -117,6 +117,10 @@ class Model:
                    drop_last=False):
         if data is None or isinstance(data, DataLoader):
             return data
+        if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
+            # already an iterable of batches (generator-style loader), not a
+            # map-style Dataset — use as-is
+            return data
         return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                           num_workers=num_workers, drop_last=drop_last)
 
@@ -148,6 +152,7 @@ class Model:
             cbks.on_epoch_begin(epoch)
             it = 0
             logs = {}
+            pending_update = False
             n_batches = len(loader) if hasattr(loader, "__len__") else None
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step)
@@ -160,11 +165,16 @@ class Model:
                 res = self.train_batch(inputs, labels, update=update,
                                        loss_scale=1.0 / accumulate_grad_batches
                                        if accumulate_grad_batches > 1 else 1.0)
+                pending_update = not update
                 logs = self._logs(res, batch_size=self._batch_len(inputs))
                 cbks.on_train_batch_end(step, logs)
                 it += 1
                 if num_iters is not None and it >= num_iters:
                     break
+            if pending_update and self._optimizer is not None:
+                # loaders without __len__ can end mid-group: flush the tail
+                self._optimizer.step()
+                self._optimizer.clear_grad()
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self._run_eval(eval_loader, cbks)

@@ -1,0 +1,17 @@
+"""paddle_tpu.profiler — tracing/profiling subsystem.
+
+Reference parity: python/paddle/profiler/__init__.py:28 (__all__ surface).
+Host spans via HostTracer; device tracing via XLA/jax.profiler (xplane).
+"""
+from .host_tracer import TracerEventType
+from .profiler import (Profiler, ProfilerState, ProfilerTarget, SummaryView,
+                       export_chrome_tracing, export_protobuf, get_profiler,
+                       make_scheduler)
+from .utils import RecordEvent, in_profiler_mode, load_profiler_result
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "SummaryView",
+    "TracerEventType", "RecordEvent", "make_scheduler",
+    "export_chrome_tracing", "export_protobuf", "load_profiler_result",
+    "in_profiler_mode", "get_profiler",
+]

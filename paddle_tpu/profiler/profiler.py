@@ -1,0 +1,286 @@
+"""Profiler facade: host spans + XLA device trace (xplane) + chrome export.
+
+Reference parity: python/paddle/profiler/profiler.py —
+ProfilerState (:79), ProfilerTarget (:99), make_scheduler (:117),
+export_chrome_tracing (:215), Profiler (:346).
+
+TPU-native design: the reference stitches a CUPTI device tracer and a host
+tracer into one event tree. On TPU the device side is owned by XLA's
+profiler — ``jax.profiler.start_trace`` captures xplane/perfetto data
+(MXU/HBM utilisation, per-HLO timing) which TensorBoard renders. We run
+both: our HostTracer records the Python-side spans (exportable as
+chrome-trace), and when ``ProfilerTarget.TPU`` is requested the XLA trace
+is captured into the same log dir.
+"""
+from __future__ import annotations
+
+import json
+import os
+from enum import Enum
+from typing import Callable, Iterable, Optional, Union
+
+from .host_tracer import TracerEventType, get_host_tracer
+from .statistic import summary_table
+from .utils import RecordEvent, _set_profiler_mode
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Window scheduler: skip_first → [closed → ready → record]*repeat."""
+    num_steps = closed + ready + record
+
+    def getter(step: int) -> ProfilerState:
+        assert step >= 0
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        period = step // num_steps
+        if repeat > 0 and period >= repeat:
+            return ProfilerState.CLOSED
+        pos = step % num_steps
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == num_steps - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    assert closed >= 0 and ready >= 0 and record > 0 and repeat >= 0
+    return getter
+
+
+def _default_state_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str,
+                          worker_name: Optional[str] = None) -> Callable:
+    """on_trace_ready callback writing chrome-trace json into dir_name."""
+
+    def handle(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{prof._span_idx}.json")
+        prof.export(path, format="json")
+
+    return handle
+
+
+def export_protobuf(dir_name: str,
+                    worker_name: Optional[str] = None) -> Callable:
+    # No protobuf schema of our own; the XLA xplane capture in log_dir is the
+    # binary artifact. Host spans still get a chrome-trace dump.
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+def _get_supported_targets() -> Iterable[ProfilerTarget]:
+    targets = [ProfilerTarget.CPU]
+    try:
+        import jax
+        if any(d.platform == "tpu" for d in jax.devices()):
+            targets.append(ProfilerTarget.TPU)
+    except Exception:
+        pass
+    return targets
+
+
+class Profiler:
+    """Collect host spans and (on TPU) an XLA device trace over scheduled
+    step windows.
+
+    Usage::
+
+        p = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                              scheduler=(2, 5))
+        p.start()
+        for it, batch in enumerate(loader):
+            train_step(batch)
+            p.step()
+        p.stop()
+        p.summary()
+    """
+
+    def __init__(self, *, targets: Optional[Iterable[ProfilerTarget]] = None,
+                 scheduler: Union[Callable, tuple, None] = None,
+                 on_trace_ready: Optional[Callable] = None,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 timer_only: bool = False, emit_nvtx: bool = False,
+                 custom_device_types: list = []):
+        self.targets = list(targets) if targets else list(_get_supported_targets())
+        if callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(start - 1, 0), ready=min(start, 1),
+                record=end - start, repeat=1)
+        else:
+            self._scheduler = _default_state_scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.record_shapes = record_shapes
+        self.profile_memory = profile_memory
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._span_idx = 0
+        self._events = []
+        self._device_tracing = False
+        self._record_step_event: Optional[RecordEvent] = None
+        self.log_dir = os.environ.get("PADDLE_TPU_PROFILER_DIR",
+                                      "./profiler_log")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        from .timer import benchmark
+        benchmark().step()
+        if self.timer_only:
+            return
+        self.current_state = self._scheduler(self.step_num)
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._start_record()
+        self._open_step_span()
+
+    def stop(self):
+        from .timer import benchmark
+        benchmark().step()
+        if self.timer_only:
+            return
+        self._close_step_span()
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._stop_record()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+            self._span_idx += 1
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        from .timer import benchmark
+        benchmark().step(num_samples or 0)
+        if self.timer_only:
+            self.step_num += 1
+            return
+        self._close_step_span()
+        prev = self.current_state
+        self.step_num += 1
+        self.current_state = self._scheduler(self.step_num)
+        recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        # RECORD_AND_RETURN always ends its window, even when the next window
+        # starts immediately (closed=0, ready=0, repeat>1 back-to-back case)
+        window_closed = prev == ProfilerState.RECORD_AND_RETURN or (
+            prev in recording and self.current_state not in recording)
+        if window_closed:
+            self._stop_record()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+            self._span_idx += 1
+            if self.current_state in recording:
+                self._start_record()
+        elif prev not in recording and self.current_state in recording:
+            self._start_record()
+        self._open_step_span()
+
+    def step_info(self, unit: str = "samples") -> str:
+        from .timer import benchmark
+        return benchmark().step_info(unit)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- internals ---------------------------------------------------------
+    def _open_step_span(self):
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._record_step_event = RecordEvent(
+                f"ProfileStep#{self.step_num}", TracerEventType.ProfileStep)
+            self._record_step_event.begin()
+
+    def _close_step_span(self):
+        if self._record_step_event is not None:
+            self._record_step_event.end()
+            self._record_step_event = None
+
+    def _start_record(self):
+        get_host_tracer().start()
+        _set_profiler_mode(True)
+        if ProfilerTarget.TPU in self.targets or ProfilerTarget.GPU in self.targets:
+            try:
+                import jax.profiler as jp
+                os.makedirs(self.log_dir, exist_ok=True)
+                jp.start_trace(self.log_dir)
+                self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+
+    def _stop_record(self):
+        _set_profiler_mode(False)
+        if self._device_tracing:
+            try:
+                import jax.profiler as jp
+                jp.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+        self._events = get_host_tracer().stop()
+
+    # -- results -----------------------------------------------------------
+    def export(self, path: str, format: str = "json"):
+        """Write collected host spans as a chrome trace."""
+        from .host_tracer import flatten_events
+        traces = []
+        for ev in flatten_events(self._events):
+            traces.append({
+                "name": ev.name, "ph": "X", "cat": ev.type,
+                "ts": ev.start_ns / 1e3, "dur": ev.duration_ns / 1e3,
+                "pid": os.getpid(), "tid": ev.thread_id,
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": traces,
+                       "displayTimeUnit": "ms"}, f)
+
+    def summary(self, sorted_by=SummaryView.OverView, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms"):
+        table = summary_table(self._events, time_unit=time_unit)
+        print(table)
+        return table
+
+    def get_summary(self) -> str:
+        return summary_table(self._events)
+
+
+def get_profiler(config_path: Optional[str] = None) -> Profiler:
+    return Profiler()

@@ -1,0 +1,61 @@
+"""Summary statistics over collected host events.
+
+Reference parity: python/paddle/profiler/profiler_statistic.py (summary
+tables by event type / name: calls, total, avg, max, min, ratio).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .host_tracer import HostEvent, flatten_events
+
+
+class _Item:
+    __slots__ = ("name", "calls", "total_ns", "max_ns", "min_ns")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.calls = 0
+        self.total_ns = 0
+        self.max_ns = 0
+        self.min_ns = None
+
+    def add(self, ns: int):
+        self.calls += 1
+        self.total_ns += ns
+        self.max_ns = max(self.max_ns, ns)
+        self.min_ns = ns if self.min_ns is None else min(self.min_ns, ns)
+
+    @property
+    def avg_ns(self):
+        return self.total_ns / self.calls if self.calls else 0.0
+
+
+def collect_statistic(roots: List[HostEvent]) -> Dict[str, _Item]:
+    items: Dict[str, _Item] = {}
+    for ev in flatten_events(roots):
+        it = items.setdefault(ev.name, _Item(ev.name))
+        it.add(ev.duration_ns)
+    return items
+
+
+def _fmt_ms(ns) -> str:
+    return f"{ns / 1e6:.3f}"
+
+
+def summary_table(roots: List[HostEvent], sorted_by: str = "total",
+                  time_unit: str = "ms") -> str:
+    items = sorted(collect_statistic(roots).values(),
+                   key=lambda it: -it.total_ns if sorted_by == "total"
+                   else -it.avg_ns)
+    wall = sum(r.duration_ns for r in roots) or 1
+    header = (f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
+              f"{'Max(ms)':>10}{'Min(ms)':>10}{'Ratio(%)':>10}")
+    lines = ["-" * len(header), header, "-" * len(header)]
+    for it in items:
+        lines.append(
+            f"{it.name[:39]:<40}{it.calls:>8}{_fmt_ms(it.total_ns):>12}"
+            f"{_fmt_ms(it.avg_ns):>10}{_fmt_ms(it.max_ns):>10}"
+            f"{_fmt_ms(it.min_ns or 0):>10}{100.0 * it.total_ns / wall:>10.2f}")
+    lines.append("-" * len(header))
+    return "\n".join(lines)

@@ -1,0 +1,86 @@
+"""RecordEvent and profiler-mode helpers.
+
+Reference parity: python/paddle/profiler/utils.py:43 (RecordEvent),
+:153 (load_profiler_result), :182 (in_profiler_mode). TPU-native twist:
+while a device trace is active, each span is also emitted as a
+jax.profiler.TraceAnnotation so host spans line up with XLA device
+activity in the xplane/perfetto view.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from .host_tracer import TracerEventType, get_host_tracer
+
+_profiler_active = False
+
+
+def _set_profiler_mode(on: bool):
+    global _profiler_active
+    _profiler_active = on
+
+
+def in_profiler_mode() -> bool:
+    return _profiler_active
+
+
+class RecordEvent:
+    """Context-manager/decorator marking a named host span.
+
+    Usage::
+
+        with profiler.RecordEvent("forward"):
+            loss = model(x)
+    """
+
+    def __init__(self, name: str,
+                 event_type: str = TracerEventType.PythonUserDefined):
+        self.name = name
+        self.event_type = event_type
+        self._ev = None
+        self._jax_ann = None
+
+    def begin(self):
+        tracer = get_host_tracer()
+        if tracer.enabled:
+            self._ev = tracer.push(self.name, self.event_type)
+        if in_profiler_mode():
+            try:
+                import jax.profiler as jp
+                self._jax_ann = jp.TraceAnnotation(self.name)
+                self._jax_ann.__enter__()
+            except Exception:
+                self._jax_ann = None
+
+    def end(self):
+        if self._jax_ann is not None:
+            self._jax_ann.__exit__(None, None, None)
+            self._jax_ann = None
+        if self._ev is not None:
+            get_host_tracer().pop(self._ev)
+            self._ev = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with RecordEvent(self.name, self.event_type):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def load_profiler_result(filename: str) -> Any:
+    """Load a chrome-trace json previously exported by the profiler."""
+    with open(filename) as f:
+        return json.load(f)

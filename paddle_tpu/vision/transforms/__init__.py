@@ -169,6 +169,26 @@ class CenterCrop(BaseTransform):
         return center_crop(img, self.size)
 
 
+def _pad_spec(padding):
+    """Paddle padding contract → np.pad spec for an HWC array.
+
+    int p → all sides p; (lr, tb) → left/right=lr, top/bottom=tb;
+    (l, t, r, b) → per-side. (reference: python/paddle/vision/transforms/
+    functional_cv2.py pad semantics)
+    """
+    if isinstance(padding, numbers.Number):
+        l = t = r = b = int(padding)
+    elif len(padding) == 2:
+        l = r = int(padding[0])
+        t = b = int(padding[1])
+    elif len(padding) == 4:
+        l, t, r, b = (int(v) for v in padding)
+    else:
+        raise ValueError(f"padding must be int, 2-tuple or 4-tuple, got "
+                         f"{padding!r}")
+    return ((t, b), (l, r), (0, 0))
+
+
 class RandomCrop(BaseTransform):
     def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
         super().__init__(keys)
@@ -176,16 +196,20 @@ class RandomCrop(BaseTransform):
             size = (int(size), int(size))
         self.size = size
         self.padding = padding
+        self.pad_if_needed = pad_if_needed
 
     def _apply_image(self, img):
         arr = _to_numpy_hwc(img)
-        if self.padding:
-            p = self.padding
-            if isinstance(p, numbers.Number):
-                p = (p, p)
-            arr = np.pad(arr, ((p[0], p[0]), (p[1], p[1]), (0, 0)))
-        h, w = arr.shape[:2]
+        if self.padding is not None:
+            arr = np.pad(arr, _pad_spec(self.padding))
         th, tw = self.size
+        if self.pad_if_needed:
+            h, w = arr.shape[:2]
+            if h < th or w < tw:
+                ph, pw = max(0, th - h), max(0, tw - w)
+                arr = np.pad(arr, ((ph // 2, ph - ph // 2),
+                                   (pw // 2, pw - pw // 2), (0, 0)))
+        h, w = arr.shape[:2]
         i = random.randint(0, max(0, h - th))
         j = random.randint(0, max(0, w - tw))
         return arr[i:i + th, j:j + tw]
@@ -212,16 +236,18 @@ class RandomVerticalFlip(BaseTransform):
 class Pad(BaseTransform):
     def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
         super().__init__(keys)
-        if isinstance(padding, numbers.Number):
-            padding = (padding, padding)
         self.padding = padding
         self.fill = fill
+        self.padding_mode = padding_mode
 
     def _apply_image(self, img):
         arr = _to_numpy_hwc(img)
-        p = self.padding
-        return np.pad(arr, ((p[0], p[0]), (p[1], p[1]), (0, 0)),
-                      constant_values=self.fill)
+        spec = _pad_spec(self.padding)
+        if self.padding_mode == "constant":
+            return np.pad(arr, spec, constant_values=self.fill)
+        mode = {"reflect": "reflect", "edge": "edge",
+                "symmetric": "symmetric"}[self.padding_mode]
+        return np.pad(arr, spec, mode=mode)
 
 
 class Transpose(BaseTransform):

@@ -1,0 +1,157 @@
+"""Tests: profiler subsystem (SURVEY §5.1) + device management."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import (Profiler, ProfilerState, ProfilerTarget,
+                                 RecordEvent, make_scheduler)
+
+
+class TestScheduler:
+    def test_make_scheduler_windows(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                               skip_first=1)
+        states = [sched(i) for i in range(6)]
+        assert states[0] == ProfilerState.CLOSED          # skip_first
+        assert states[1] == ProfilerState.CLOSED          # closed
+        assert states[2] == ProfilerState.READY
+        assert states[3] == ProfilerState.RECORD
+        assert states[4] == ProfilerState.RECORD_AND_RETURN
+        assert states[5] == ProfilerState.CLOSED          # repeat exhausted
+
+    def test_default_always_record(self):
+        p = Profiler(targets=[ProfilerTarget.CPU])
+        assert p._scheduler(0) == ProfilerState.RECORD
+        assert p._scheduler(100) == ProfilerState.RECORD
+
+
+class TestRecordEvent:
+    def test_nested_spans_and_summary(self):
+        p = Profiler(targets=[ProfilerTarget.CPU])
+        p.start()
+        with RecordEvent("outer"):
+            with RecordEvent("inner"):
+                _ = (paddle.ones([8, 8]) * 2).numpy()
+        p.stop()
+        names = [e.name for e in _flatten(p._events)]
+        assert "outer" in names and "inner" in names
+        table = p.get_summary()
+        assert "outer" in table and "Calls" in table
+
+    def test_decorator(self):
+        @RecordEvent("decorated_fn")
+        def f(x):
+            return x + 1
+
+        p = Profiler(targets=[ProfilerTarget.CPU])
+        p.start()
+        assert f(1) == 2
+        p.stop()
+        assert any(e.name == "decorated_fn" for e in _flatten(p._events))
+
+    def test_chrome_export(self, tmp_path):
+        p = Profiler(targets=[ProfilerTarget.CPU])
+        p.start()
+        with RecordEvent("span"):
+            pass
+        p.stop()
+        path = str(tmp_path / "trace.json")
+        p.export(path)
+        data = profiler.load_profiler_result(path)
+        assert any(ev["name"] == "span" for ev in data["traceEvents"])
+
+    def test_scheduled_steps_with_on_trace_ready(self, tmp_path):
+        done = []
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     scheduler=make_scheduler(closed=1, ready=0, record=2,
+                                              repeat=1),
+                     on_trace_ready=lambda prof: done.append(prof.step_num))
+        p.start()
+        for _ in range(5):
+            with RecordEvent("work"):
+                pass
+            p.step()
+        p.stop()
+        assert done  # trace-ready fired when the record window closed
+
+    def test_back_to_back_record_windows(self):
+        # closed=0/ready=0/repeat=3: every period ends in RECORD_AND_RETURN
+        # and must fire on_trace_ready once per window, not once at the end
+        fired = []
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     scheduler=make_scheduler(closed=0, ready=0, record=2,
+                                              repeat=3),
+                     on_trace_ready=lambda prof: fired.append(prof._span_idx))
+        p.start()
+        for _ in range(6):
+            with RecordEvent("w"):
+                pass
+            p.step()
+        p.stop()
+        assert len(fired) == 3
+        assert fired == [0, 1, 2]
+
+    def test_stop_bumps_span_idx(self, tmp_path):
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     on_trace_ready=profiler.export_chrome_tracing(
+                         str(tmp_path), worker_name="w"))
+        for _ in range(2):
+            p.start()
+            with RecordEvent("s"):
+                pass
+            p.stop()
+        assert sorted(os.listdir(tmp_path)) == ["w_time_0.json",
+                                                "w_time_1.json"]
+
+    def test_timer_only_step_info(self):
+        p = Profiler(timer_only=True)
+        p.start()
+        for _ in range(3):
+            p.step(num_samples=4)
+        info = p.step_info()
+        p.stop()
+        assert "avg_batch_cost" in info
+
+
+class TestDevice:
+    def test_device_queries(self):
+        import paddle_tpu.device as device
+        types = device.get_all_device_type()
+        assert "cpu" in types
+        assert device.get_available_device()
+        device.synchronize()
+
+    def test_memory_stats(self):
+        import paddle_tpu.device as device
+        _ = paddle.ones([64, 64]).numpy()
+        stats = device.memory_stats()
+        assert isinstance(stats, dict)
+        assert device.memory_allocated() >= 0
+        assert device.max_memory_allocated() >= device.memory_allocated() or \
+            device.max_memory_allocated() == 0
+
+    def test_stream_event_ordering(self):
+        import paddle_tpu.device as device
+        s = device.Stream()
+        x = paddle.ones([32, 32])
+        y = x.matmul(x)
+        s.track(y._value)
+        ev = s.record_event()
+        ev.synchronize()
+        assert ev.query()
+        s.synchronize()
+        assert s.query()
+
+    def test_stream_guard(self):
+        import paddle_tpu.device as device
+        s = device.Stream()
+        with device.stream_guard(s) as cur:
+            assert device.current_stream() is s
+        assert device.current_stream() is not s
+
+
+from paddle_tpu.profiler.host_tracer import flatten_events as _flatten  # noqa: E402

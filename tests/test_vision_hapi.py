@@ -74,6 +74,25 @@ class TestTransforms:
         flipped = T.RandomHorizontalFlip(prob=1.0)(img)
         np.testing.assert_allclose(flipped, img[:, ::-1])
 
+    def test_pad_semantics(self):
+        # paddle contract: (lr, tb) 2-tuple; (l, t, r, b) 4-tuple
+        from paddle_tpu.vision import transforms as T
+
+        img = np.zeros((4, 6, 3), np.float32)
+        assert T.Pad((1, 0))(img).shape == (4, 8, 3)   # left/right only
+        assert T.Pad((0, 2))(img).shape == (8, 6, 3)   # top/bottom only
+        assert T.Pad((1, 2, 3, 4))(img).shape == (4 + 2 + 4, 6 + 1 + 3, 3)
+        assert T.Pad(2)(img).shape == (8, 10, 3)
+
+    def test_random_crop_pad_if_needed(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.zeros((28, 28, 3), np.float32)
+        out = T.RandomCrop(32, pad_if_needed=True)(img)
+        assert out.shape == (32, 32, 3)
+        out2 = T.RandomCrop(16, padding=(2, 2))(img)
+        assert out2.shape == (16, 16, 3)
+
 
 class TestFakeData:
     def test_deterministic(self):
