@@ -1,0 +1,90 @@
+// C ABI for the paddle_tpu native runtime (libpaddle_tpu.so).
+//
+// TPU-native re-design of the reference's native runtime surface:
+//   - flags registry      (reference: paddle/common/flags.cc PHI_DEFINE_EXPORTED_*)
+//   - DDim helpers        (reference: paddle/common/ddim.h)
+//   - TCPStore rendezvous (reference: paddle/phi/core/distributed/store/tcp_store.h:121)
+//   - host tracer         (reference: paddle/fluid/platform/profiler/host_tracer.h:26)
+//   - blocking queue      (reference: paddle/fluid/framework/blocking_queue.h, used by
+//                          the data feed pipeline data_feed.cc)
+//
+// Bound from Python via ctypes (no pybind11 in the image).
+#pragma once
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ---------------------------------------------------------------- versioning
+const char* ptpu_version();
+
+// Free any buffer returned by a ptpu_* function.
+void ptpu_free(void* p);
+
+// -------------------------------------------------------------------- flags
+// Values are stored as strings; typing/coercion lives in the Python facade.
+// Environment variables FLAGS_<name> override the default at first read.
+int ptpu_flag_define(const char* name, const char* default_val, const char* doc);
+// Returns malloc'd value string, or NULL if the flag is unknown.
+char* ptpu_flag_get(const char* name);
+int ptpu_flag_set(const char* name, const char* value);
+// JSON object {name: {"value":..., "doc":...}, ...}; malloc'd.
+char* ptpu_flags_list_json();
+
+// --------------------------------------------------------------------- ddim
+int64_t ptpu_ddim_product(const int64_t* dims, int n);
+// Row-major contiguous strides (in elements).
+void ptpu_ddim_strides(const int64_t* dims, int n, int64_t* out);
+// NumPy broadcast of two shapes. Returns 0 on success, -1 on mismatch.
+// out must hold max(na, nb) entries; *nout receives the rank.
+int ptpu_ddim_broadcast(const int64_t* a, int na, const int64_t* b, int nb,
+                        int64_t* out, int* nout);
+
+// ----------------------------------------------------------------- tcpstore
+// Server: accepts SET/GET/ADD/WAIT over a tiny length-prefixed protocol.
+// Returns NULL on bind failure. port 0 picks an ephemeral port.
+void* ptpu_store_server_start(uint16_t port);
+uint16_t ptpu_store_server_port(void* server);
+void ptpu_store_server_stop(void* server);
+
+// Client: connects (retrying until timeout_ms) to host:port.
+void* ptpu_store_client_new(const char* host, uint16_t port, int timeout_ms);
+void ptpu_store_client_free(void* client);
+// All return 0 on success, -1 on timeout/error (errno-style; no exceptions
+// cross the ABI).
+int ptpu_store_set(void* client, const char* key, const uint8_t* val,
+                   uint32_t n);
+// Blocks server-side until the key exists or timeout. *out is malloc'd.
+int ptpu_store_get(void* client, const char* key, uint8_t** out, uint32_t* n,
+                   int timeout_ms);
+// Atomic counter add; creates the key at 0. Returns new value via *result.
+int ptpu_store_add(void* client, const char* key, int64_t delta,
+                   int64_t* result);
+int ptpu_store_wait(void* client, const char* key, int timeout_ms);
+
+// ------------------------------------------------------------------- tracer
+void ptpu_trace_enable(int on);
+int ptpu_trace_enabled();
+int64_t ptpu_trace_now_ns();
+void ptpu_trace_begin(const char* name, const char* category);
+void ptpu_trace_end();
+void ptpu_trace_instant(const char* name, const char* category);
+void ptpu_trace_counter(const char* name, double value);
+// Chrome-trace "traceEvents" JSON array; malloc'd.
+char* ptpu_trace_export_json();
+void ptpu_trace_clear();
+
+// ------------------------------------------------------------ blockingqueue
+// Bounded MPMC queue of byte buffers (dataloader prefetch ring).
+void* ptpu_queue_new(uint32_t capacity);
+// 0 ok, -1 timeout, -2 closed.
+int ptpu_queue_push(void* q, const uint8_t* data, uint64_t n, int timeout_ms);
+int ptpu_queue_pop(void* q, uint8_t** out, uint64_t* n, int timeout_ms);
+void ptpu_queue_close(void* q);
+uint32_t ptpu_queue_size(void* q);
+void ptpu_queue_free(void* q);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif

@@ -1,0 +1,53 @@
+// DDim helpers — native shape arithmetic.
+//
+// Reference: paddle/common/ddim.h (product, stride computation) and the
+// broadcast rules applied across phi/infermeta. The Python tensor layer
+// calls these for hot shape math on the host side.
+#include "ptpu_c_api.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+const char* ptpu_version() { return "0.2.0"; }
+
+void ptpu_free(void* p) { std::free(p); }
+
+int64_t ptpu_ddim_product(const int64_t* dims, int n) {
+  int64_t p = 1;
+  for (int i = 0; i < n; ++i) p *= dims[i];
+  return p;
+}
+
+void ptpu_ddim_strides(const int64_t* dims, int n, int64_t* out) {
+  int64_t stride = 1;
+  for (int i = n - 1; i >= 0; --i) {
+    out[i] = stride;
+    stride *= dims[i];
+  }
+}
+
+int ptpu_ddim_broadcast(const int64_t* a, int na, const int64_t* b, int nb,
+                        int64_t* out, int* nout) {
+  int n = std::max(na, nb);
+  for (int i = 0; i < n; ++i) {
+    // align from the trailing dimension
+    int64_t da = i < na ? a[na - 1 - i] : 1;
+    int64_t db = i < nb ? b[nb - 1 - i] : 1;
+    int64_t d;
+    if (da == db || db == 1) {
+      d = da;
+    } else if (da == 1) {
+      d = db;
+    } else {
+      return -1;
+    }
+    out[n - 1 - i] = d;
+  }
+  *nout = n;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,92 @@
+// Runtime flags registry — native backing for paddle_tpu.core.flags.
+//
+// Reference behavior: paddle/common/flags.cc keeps one process-global
+// registry of exported flags, overridable via FLAGS_* environment variables
+// and paddle.set_flags. We keep the same semantics: env wins over the
+// default at first read; explicit set wins over everything after.
+#include "ptpu_c_api.h"
+#include "ptpu_util.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+using ptpu::dup_string;
+using ptpu::json_escape;
+
+struct Flag {
+  std::string value;
+  std::string doc;
+  bool env_checked = false;
+};
+
+std::mutex g_mu;
+std::map<std::string, Flag> g_flags;
+
+}  // namespace
+
+extern "C" {
+
+int ptpu_flag_define(const char* name, const char* default_val,
+                     const char* doc) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_flags.find(name);
+  if (it != g_flags.end()) return 1;  // already defined: keep current value
+  Flag f;
+  f.value = default_val ? default_val : "";
+  f.doc = doc ? doc : "";
+  g_flags.emplace(name, std::move(f));
+  return 0;
+}
+
+char* ptpu_flag_get(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_flags.find(name);
+  if (it == g_flags.end()) return nullptr;
+  Flag& f = it->second;
+  if (!f.env_checked) {
+    f.env_checked = true;
+    std::string env_name = std::string("FLAGS_") + name;
+    if (const char* raw = std::getenv(env_name.c_str())) f.value = raw;
+  }
+  return dup_string(f.value);
+}
+
+int ptpu_flag_set(const char* name, const char* value) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_flags.find(name);
+  if (it == g_flags.end()) return -1;
+  it->second.env_checked = true;  // explicit set beats env
+  it->second.value = value ? value : "";
+  return 0;
+}
+
+char* ptpu_flags_list_json() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  std::string out = "{";
+  bool first = true;
+  for (auto& [name, f] : g_flags) {
+    if (!f.env_checked) {
+      f.env_checked = true;
+      std::string env_name = std::string("FLAGS_") + name;
+      if (const char* raw = std::getenv(env_name.c_str())) f.value = raw;
+    }
+    if (!first) out += ",";
+    first = false;
+    out += "\"";
+    json_escape(name, &out);
+    out += "\":{\"value\":\"";
+    json_escape(f.value, &out);
+    out += "\",\"doc\":\"";
+    json_escape(f.doc, &out);
+    out += "\"}";
+  }
+  out += "}";
+  return dup_string(out);
+}
+
+}  // extern "C"

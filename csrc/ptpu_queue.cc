@@ -1,0 +1,97 @@
+// Bounded MPMC blocking queue of byte buffers.
+//
+// Reference: paddle/fluid/framework/blocking_queue.h and the data-feed
+// pipeline (framework/data_feed.cc) that shuttles batches from reader
+// workers to the trainer. Here it is the prefetch ring between dataloader
+// worker threads/processes and the host step loop: workers push pickled
+// batches without holding the GIL; the trainer pops.
+#include "ptpu_c_api.h"
+
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Queue {
+  explicit Queue(uint32_t cap) : capacity(cap) {}
+  uint32_t capacity;
+  std::mutex mu;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+  std::deque<std::vector<uint8_t>> items;
+  bool closed = false;
+};
+
+template <typename Pred>
+bool wait_on(std::condition_variable& cv, std::unique_lock<std::mutex>& lk,
+             int timeout_ms, Pred pred) {
+  if (timeout_ms < 0) {
+    cv.wait(lk, pred);
+    return true;
+  }
+  return cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptpu_queue_new(uint32_t capacity) {
+  return new Queue(capacity ? capacity : 1);
+}
+
+int ptpu_queue_push(void* q_, const uint8_t* data, uint64_t n,
+                    int timeout_ms) {
+  auto* q = static_cast<Queue*>(q_);
+  std::unique_lock<std::mutex> lk(q->mu);
+  bool ok = wait_on(q->not_full, lk, timeout_ms, [&] {
+    return q->items.size() < q->capacity || q->closed;
+  });
+  if (q->closed) return -2;
+  if (!ok || q->items.size() >= q->capacity) return -1;
+  q->items.emplace_back(data, data + n);
+  lk.unlock();
+  q->not_empty.notify_one();
+  return 0;
+}
+
+int ptpu_queue_pop(void* q_, uint8_t** out, uint64_t* n, int timeout_ms) {
+  auto* q = static_cast<Queue*>(q_);
+  std::unique_lock<std::mutex> lk(q->mu);
+  wait_on(q->not_empty, lk, timeout_ms,
+          [&] { return !q->items.empty() || q->closed; });
+  if (q->items.empty()) return q->closed ? -2 : -1;
+  std::vector<uint8_t> item = std::move(q->items.front());
+  q->items.pop_front();
+  lk.unlock();
+  q->not_full.notify_one();
+  uint8_t* buf = static_cast<uint8_t*>(std::malloc(item.size() ? item.size() : 1));
+  std::memcpy(buf, item.data(), item.size());
+  *out = buf;
+  *n = item.size();
+  return 0;
+}
+
+void ptpu_queue_close(void* q_) {
+  auto* q = static_cast<Queue*>(q_);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->closed = true;
+  }
+  q->not_full.notify_all();
+  q->not_empty.notify_all();
+}
+
+uint32_t ptpu_queue_size(void* q_) {
+  auto* q = static_cast<Queue*>(q_);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return static_cast<uint32_t>(q->items.size());
+}
+
+void ptpu_queue_free(void* q_) { delete static_cast<Queue*>(q_); }
+
+}  // extern "C"

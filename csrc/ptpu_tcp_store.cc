@@ -1,0 +1,350 @@
+// TCPStore — key/value rendezvous for distributed bring-up.
+//
+// Reference semantics: paddle/phi/core/distributed/store/tcp_store.h:121 and
+// tcp_utils.cc — a master rank runs the server; every rank connects as a
+// client; set/get/add/wait with blocking get. Used by init_parallel_env to
+// exchange bootstrap info (reference: python/paddle/distributed/parallel.py:1134).
+//
+// Protocol (little-endian):
+//   request : u8 cmd | u32 klen | key bytes | payload
+//     SET (1): u32 vlen | value
+//     GET (2): i32 timeout_ms
+//     ADD (3): i64 delta
+//     WAIT(4): i32 timeout_ms
+//   response: u8 status (0 ok, 1 timeout) | payload
+//     GET ok : u32 vlen | value
+//     ADD ok : i64 new_value
+//
+// One detached thread per connection: rendezvous-scale (≤ thousands of
+// ranks), not a data plane.
+#include "ptpu_c_api.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+enum Cmd : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kWait = 4 };
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  int listen_fd = -1;
+  uint16_t port = 0;
+  std::atomic<bool> stopping{false};
+  std::thread accept_thread;
+
+  // Live connection handlers — joined in stop() so no handler can touch
+  // server state after the Server is freed.
+  std::mutex conns_mu;
+  std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;
+
+  std::mutex mu;
+  std::condition_variable cv;  // notified on every mutation
+  std::map<std::string, std::vector<uint8_t>> data;
+
+  // Waits (under mu) until key exists or deadline. True if present.
+  bool wait_key(const std::string& key, int timeout_ms,
+                std::unique_lock<std::mutex>* lk) {
+    auto pred = [&] { return data.count(key) > 0 || stopping.load(); };
+    if (timeout_ms < 0) {
+      cv.wait(*lk, pred);
+    } else {
+      cv.wait_for(*lk, std::chrono::milliseconds(timeout_ms), pred);
+    }
+    return data.count(key) > 0;
+  }
+
+  void handle_conn(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t cmd;
+      uint32_t klen;
+      if (!recv_all(fd, &cmd, 1) || !recv_all(fd, &klen, 4)) break;
+      if (klen > (1u << 20)) break;
+      std::string key(klen, '\0');
+      if (!recv_all(fd, key.data(), klen)) break;
+
+      if (cmd == kSet) {
+        uint32_t vlen;
+        if (!recv_all(fd, &vlen, 4)) break;
+        if (vlen > (1u << 28)) break;
+        std::vector<uint8_t> val(vlen);
+        if (vlen && !recv_all(fd, val.data(), vlen)) break;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          data[key] = std::move(val);
+        }
+        cv.notify_all();
+        uint8_t ok = 0;
+        if (!send_all(fd, &ok, 1)) break;
+      } else if (cmd == kGet) {
+        int32_t timeout_ms;
+        if (!recv_all(fd, &timeout_ms, 4)) break;
+        std::unique_lock<std::mutex> lk(mu);
+        bool found = wait_key(key, timeout_ms, &lk);
+        if (found) {
+          std::vector<uint8_t> val = data[key];
+          lk.unlock();
+          uint8_t ok = 0;
+          uint32_t vlen = static_cast<uint32_t>(val.size());
+          if (!send_all(fd, &ok, 1) || !send_all(fd, &vlen, 4)) break;
+          if (vlen && !send_all(fd, val.data(), vlen)) break;
+        } else {
+          lk.unlock();
+          uint8_t to = 1;
+          if (!send_all(fd, &to, 1)) break;
+        }
+      } else if (cmd == kAdd) {
+        int64_t delta;
+        if (!recv_all(fd, &delta, 8)) break;
+        int64_t result;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          auto& val = data[key];
+          int64_t cur = 0;
+          if (!val.empty()) {
+            cur = std::strtoll(
+                std::string(val.begin(), val.end()).c_str(), nullptr, 10);
+          }
+          result = cur + delta;
+          std::string s = std::to_string(result);
+          val.assign(s.begin(), s.end());
+        }
+        cv.notify_all();
+        uint8_t ok = 0;
+        if (!send_all(fd, &ok, 1) || !send_all(fd, &result, 8)) break;
+      } else if (cmd == kWait) {
+        int32_t timeout_ms;
+        if (!recv_all(fd, &timeout_ms, 4)) break;
+        std::unique_lock<std::mutex> lk(mu);
+        bool found = wait_key(key, timeout_ms, &lk);
+        lk.unlock();
+        uint8_t status = found ? 0 : 1;
+        if (!send_all(fd, &status, 1)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping.load()) return;
+        continue;
+      }
+      std::lock_guard<std::mutex> lk(conns_mu);
+      if (stopping.load()) {
+        ::close(fd);
+        return;
+      }
+      conn_fds.push_back(fd);
+      conn_threads.emplace_back(&Server::handle_conn, this, fd);
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // serialize request/response pairs
+};
+
+int connect_with_retry(const char* host, uint16_t port, int timeout_ms) {
+  auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    struct addrinfo hints = {};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string port_s = std::to_string(port);
+    int fd = -1;
+    if (::getaddrinfo(host, port_s.c_str(), &hints, &res) == 0) {
+      for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+        fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) continue;
+        if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+        ::close(fd);
+        fd = -1;
+      }
+      ::freeaddrinfo(res);
+    }
+    if (fd >= 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    if (Clock::now() >= deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptpu_store_server_start(uint16_t port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr = {};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (::bind(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      ::listen(fd, 512) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<struct sockaddr*>(&addr), &len);
+  auto* s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread(&Server::accept_loop, s);
+  return s;
+}
+
+uint16_t ptpu_store_server_port(void* server) {
+  return static_cast<Server*>(server)->port;
+}
+
+void ptpu_store_server_stop(void* server) {
+  auto* s = static_cast<Server*>(server);
+  s->stopping.store(true);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  s->cv.notify_all();  // wake handlers blocked in wait_key
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // Wake handlers blocked in recv/send, then join every handler so none
+    // can touch server state after the delete below.
+    std::lock_guard<std::mutex> lk(s->conns_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->conn_threads) {
+    if (t.joinable()) t.join();
+  }
+  delete s;
+}
+
+void* ptpu_store_client_new(const char* host, uint16_t port, int timeout_ms) {
+  int fd = connect_with_retry(host, port, timeout_ms);
+  if (fd < 0) return nullptr;
+  auto* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+void ptpu_store_client_free(void* client) {
+  auto* c = static_cast<Client*>(client);
+  ::close(c->fd);
+  delete c;
+}
+
+static bool send_req_header(Client* c, uint8_t cmd, const char* key) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  return send_all(c->fd, &cmd, 1) && send_all(c->fd, &klen, 4) &&
+         send_all(c->fd, key, klen);
+}
+
+int ptpu_store_set(void* client, const char* key, const uint8_t* val,
+                   uint32_t n) {
+  auto* c = static_cast<Client*>(client);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!send_req_header(c, kSet, key)) return -1;
+  if (!send_all(c->fd, &n, 4)) return -1;
+  if (n && !send_all(c->fd, val, n)) return -1;
+  uint8_t status;
+  if (!recv_all(c->fd, &status, 1)) return -1;
+  return status == 0 ? 0 : -1;
+}
+
+int ptpu_store_get(void* client, const char* key, uint8_t** out, uint32_t* n,
+                   int timeout_ms) {
+  auto* c = static_cast<Client*>(client);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!send_req_header(c, kGet, key)) return -1;
+  int32_t t = timeout_ms;
+  if (!send_all(c->fd, &t, 4)) return -1;
+  uint8_t status;
+  if (!recv_all(c->fd, &status, 1)) return -1;
+  if (status != 0) return -1;
+  uint32_t vlen;
+  if (!recv_all(c->fd, &vlen, 4)) return -1;
+  uint8_t* buf = static_cast<uint8_t*>(std::malloc(vlen ? vlen : 1));
+  if (vlen && !recv_all(c->fd, buf, vlen)) {
+    std::free(buf);
+    return -1;
+  }
+  *out = buf;
+  *n = vlen;
+  return 0;
+}
+
+int ptpu_store_add(void* client, const char* key, int64_t delta,
+                   int64_t* result) {
+  auto* c = static_cast<Client*>(client);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!send_req_header(c, kAdd, key)) return -1;
+  if (!send_all(c->fd, &delta, 8)) return -1;
+  uint8_t status;
+  if (!recv_all(c->fd, &status, 1) || status != 0) return -1;
+  if (!recv_all(c->fd, result, 8)) return -1;
+  return 0;
+}
+
+int ptpu_store_wait(void* client, const char* key, int timeout_ms) {
+  auto* c = static_cast<Client*>(client);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!send_req_header(c, kWait, key)) return -1;
+  int32_t t = timeout_ms;
+  if (!send_all(c->fd, &t, 4)) return -1;
+  uint8_t status;
+  if (!recv_all(c->fd, &status, 1)) return -1;
+  return status == 0 ? 0 : -1;
+}
+
+}  // extern "C"

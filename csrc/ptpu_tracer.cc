@@ -1,0 +1,153 @@
+// Host tracer — nanosecond span recording with Chrome-trace export.
+//
+// Reference: paddle/fluid/platform/profiler/host_tracer.h:26 (RecordEvent
+// spans merged into an event tree, exported via chrometracing_logger.h:32).
+// TPU twist: device-side timing comes from the XLA/JAX profiler (xplane);
+// this native tracer covers the host side (op dispatch, dataloader, comm
+// setup) with negligible overhead — one clock read + an append per edge.
+#include "ptpu_c_api.h"
+#include "ptpu_util.h"
+
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+using ptpu::dup_string;
+using ptpu::json_escape;
+
+std::atomic<bool> g_enabled{false};
+
+struct Event {
+  std::string name;
+  std::string cat;
+  char ph;  // 'B', 'E', 'i', 'C'
+  int64_t ts_ns;
+  int64_t tid;
+  double value;  // for counters
+};
+
+struct ThreadBuf {
+  // Guards events: the owning thread appends while export/clear (any
+  // thread) iterate. Uncontended lock cost is noise next to the clock read.
+  std::mutex mu;
+  std::vector<Event> events;
+  int64_t tid;
+};
+
+std::mutex g_bufs_mu;
+std::vector<ThreadBuf*> g_bufs;  // never freed until clear; tracer-scale data
+
+ThreadBuf* tls_buf() {
+  thread_local ThreadBuf* buf = [] {
+    auto* b = new ThreadBuf();
+    b->tid = static_cast<int64_t>(::syscall(SYS_gettid));
+    std::lock_guard<std::mutex> lk(g_bufs_mu);
+    g_bufs.push_back(b);
+    return b;
+  }();
+  return buf;
+}
+
+int64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000000LL + ts.tv_nsec;
+}
+
+}  // namespace
+
+extern "C" {
+
+void ptpu_trace_enable(int on) { g_enabled.store(on != 0); }
+int ptpu_trace_enabled() { return g_enabled.load() ? 1 : 0; }
+int64_t ptpu_trace_now_ns() { return now_ns(); }
+
+void ptpu_trace_begin(const char* name, const char* category) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  auto* b = tls_buf();
+  std::lock_guard<std::mutex> lk(b->mu);
+  b->events.push_back(
+      {name ? name : "", category ? category : "", 'B', now_ns(), b->tid, 0});
+}
+
+void ptpu_trace_end() {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  auto* b = tls_buf();
+  std::lock_guard<std::mutex> lk(b->mu);
+  b->events.push_back({"", "", 'E', now_ns(), b->tid, 0});
+}
+
+void ptpu_trace_instant(const char* name, const char* category) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  auto* b = tls_buf();
+  std::lock_guard<std::mutex> lk(b->mu);
+  b->events.push_back(
+      {name ? name : "", category ? category : "", 'i', now_ns(), b->tid, 0});
+}
+
+void ptpu_trace_counter(const char* name, double value) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  auto* b = tls_buf();
+  std::lock_guard<std::mutex> lk(b->mu);
+  b->events.push_back({name ? name : "", "counter", 'C', now_ns(), b->tid,
+                       value});
+}
+
+char* ptpu_trace_export_json() {
+  std::lock_guard<std::mutex> lk(g_bufs_mu);
+  std::string out = "[";
+  bool first = true;
+  int64_t pid = static_cast<int64_t>(::getpid());
+  for (ThreadBuf* b : g_bufs) {
+    std::lock_guard<std::mutex> blk(b->mu);
+    for (const Event& e : b->events) {
+      if (!first) out += ",";
+      first = false;
+      char head[160];
+      // Chrome trace wants microseconds; keep ns precision as a fraction.
+      std::snprintf(head, sizeof(head),
+                    "{\"ph\":\"%c\",\"ts\":%.3f,\"pid\":%lld,\"tid\":%lld",
+                    e.ph, e.ts_ns / 1000.0, static_cast<long long>(pid),
+                    static_cast<long long>(e.tid));
+      out += head;
+      if (e.ph != 'E') {
+        out += ",\"name\":\"";
+        json_escape(e.name, &out);
+        out += "\"";
+      }
+      if (e.ph == 'B' || e.ph == 'i') {
+        out += ",\"cat\":\"";
+        json_escape(e.cat, &out);
+        out += "\"";
+      }
+      if (e.ph == 'C') {
+        char args[64];
+        std::snprintf(args, sizeof(args), ",\"args\":{\"value\":%g}", e.value);
+        out += args;
+      }
+      if (e.ph == 'i') out += ",\"s\":\"t\"";
+      out += "}";
+    }
+  }
+  out += "]";
+  return dup_string(out);
+}
+
+void ptpu_trace_clear() {
+  std::lock_guard<std::mutex> lk(g_bufs_mu);
+  for (ThreadBuf* b : g_bufs) {
+    std::lock_guard<std::mutex> blk(b->mu);
+    b->events.clear();
+  }
+}
+
+}  // extern "C"

@@ -6,6 +6,11 @@ python/paddle/base/framework.py set_flags/get_flags).
 
 Flags are process-global, overridable via environment variables named
 ``FLAGS_<name>`` (checked at first read), and via ``set_flags``.
+
+When the native runtime (csrc/ptpu_flags.cc) is available, the C++
+registry is the source of truth — values written from either side are
+visible to both, mirroring how the reference shares one gflags registry
+between C++ and Python (core.globals()).
 """
 from __future__ import annotations
 
@@ -14,6 +19,46 @@ import threading
 from typing import Any, Dict
 
 _LOCK = threading.RLock()
+
+
+def _native():
+    """The native module if its library is ALREADY loaded, else None.
+
+    Deliberately never triggers a build: flags are touched on `import
+    paddle_tpu`, and the first import must not block on a g++ link. When
+    some other component loads the library, _on_native_loaded() below syncs
+    this registry into the native one and subsequent calls delegate.
+    """
+    global _NATIVE_MOD
+    if _NATIVE_MOD is None:
+        try:
+            from paddle_tpu import native
+
+            _NATIVE_MOD = native
+        except Exception:
+            return None
+    return _NATIVE_MOD if _NATIVE_MOD.loaded() else None
+
+
+_NATIVE_MOD = None
+
+
+def _flag_str(value) -> str:
+    return str(int(value)) if isinstance(value, bool) else str(value)
+
+
+def _on_native_loaded(lib=None):
+    """Called by paddle_tpu.native right after the C++ library loads:
+    mirror every Python-registered flag (and any explicit overrides) into
+    the native registry so C++ and Python share one flag state."""
+    from paddle_tpu import native
+
+    with _LOCK:
+        for name, f in _REGISTRY.items():
+            native.flag_define(name, _flag_str(f.default), f.doc)
+            if f.env_checked:
+                # Python already resolved env/explicit sets; push the result.
+                native.flag_set(name, _flag_str(f.value))
 
 
 class _Flag:
@@ -44,12 +89,21 @@ def define_flag(name: str, default: Any, doc: str = "", type_=None):
             return _REGISTRY[name]
         f = _Flag(name, default, doc, type_ or type(default))
         _REGISTRY[name] = f
+        nat = _native()
+        if nat is not None:
+            sd = str(int(default)) if isinstance(default, bool) else str(default)
+            nat.flag_define(name, sd, doc)
         return f
 
 
 def get_flag(name: str):
     with _LOCK:
         f = _REGISTRY[name]
+        nat = _native()
+        if nat is not None:
+            raw = nat.flag_get(name)
+            if raw is not None:
+                return _coerce(f.type, raw)
         if not f.env_checked:
             f.env_checked = True
             raw = os.environ.get("FLAGS_" + name)
@@ -68,6 +122,11 @@ def set_flags(flags: Dict[str, Any]):
             f = _REGISTRY[k]
             f.env_checked = True
             f.value = _coerce(f.type, v) if isinstance(v, str) else f.type(v)
+            nat = _native()
+            if nat is not None:
+                sv = str(int(f.value)) if isinstance(f.value, bool) \
+                    else str(f.value)
+                nat.flag_set(k, sv)
 
 
 def get_flags(names):

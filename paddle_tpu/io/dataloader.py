@@ -254,34 +254,74 @@ class DistributedBatchSampler(BatchSampler):
         return (self.num_samples + self.batch_size - 1) // self.batch_size
 
 
-def default_collate_fn(batch):
-    """Stack samples → numpy batches → Tensors (reference:
-    io/dataloader/collate.py default_collate_fn)."""
+def _numpy_collate(batch):
+    """Stack samples into host numpy batches (worker-side half of collate)."""
     sample = batch[0]
     if isinstance(sample, Tensor):
-        import jax.numpy as jnp
-
-        return Tensor._from_value(jnp.stack([s._value for s in batch]))
+        return np.stack([np.asarray(s._value) for s in batch])
     if isinstance(sample, np.ndarray):
-        return Tensor._from_value(np.stack(batch))
+        return np.stack(batch)
     if isinstance(sample, (int, np.integer)):
-        return Tensor._from_value(np.asarray(batch, np.int64))
+        return np.asarray(batch, np.int64)
     if isinstance(sample, (float, np.floating)):
-        return Tensor._from_value(np.asarray(batch, np.float32))
+        return np.asarray(batch, np.float32)
     if isinstance(sample, (str, bytes)):
         return list(batch)
     if isinstance(sample, dict):
-        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+        return {k: _numpy_collate([d[k] for d in batch]) for k in sample}
     if isinstance(sample, (list, tuple)):
-        return type(sample)(default_collate_fn(list(fields)) for fields in zip(*batch))
+        return type(sample)(
+            _numpy_collate(list(fields)) for fields in zip(*batch)
+        )
     raise TypeError(f"cannot collate {type(sample)}")
+
+
+def _tensorize(obj):
+    """Consumer-side half: wrap numpy payloads into Tensors."""
+    if isinstance(obj, np.ndarray):
+        return Tensor._from_value(obj)
+    if isinstance(obj, dict):
+        return {k: _tensorize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)) and obj and \
+            not isinstance(obj[0], (str, bytes)):
+        return type(obj)(_tensorize(v) for v in obj)
+    return obj
+
+
+def default_collate_fn(batch):
+    """Stack samples → numpy batches → Tensors (reference:
+    io/dataloader/collate.py default_collate_fn)."""
+    return _tensorize(_numpy_collate(batch))
+
+
+def _native_queue(capacity: int):
+    """Native C++ prefetch ring (csrc/ptpu_queue.cc), or None.
+
+    TPU-native analog of the reference's buffered reader / blocking queue
+    between data-feed workers and the trainer (framework/data_feed.cc):
+    workers push pickled numpy batches, the step loop pops and tensorizes.
+    """
+    try:
+        from paddle_tpu import native
+
+        if native.is_available():
+            return native.BlockingQueue(capacity)
+    except Exception:
+        pass
+    return None
 
 
 class _PrefetchIter:
     def __init__(self, loader, index_iter):
         self.loader = loader
         self.index_iter = index_iter
-        self.q: "queue.Queue" = queue.Queue(maxsize=max(2, loader.prefetch_factor))
+        cap = max(2, loader.prefetch_factor)
+        # Native ring only carries picklable payloads, i.e. the default
+        # (numpy) collate path; custom collate_fns stay on the Python queue.
+        self.nq = _native_queue(cap) if loader.collate_fn is None and \
+            getattr(loader, "use_buffer_reader", True) else None
+        self.q: "queue.Queue" = queue.Queue(maxsize=cap) \
+            if self.nq is None else None
         self.done = object()
         self.workers: List[threading.Thread] = []
         n = max(1, loader.num_workers)
@@ -289,6 +329,8 @@ class _PrefetchIter:
         self._launch(n)
 
     def _launch(self, n):
+        import pickle
+
         def work():
             while True:
                 with self.lock:
@@ -297,9 +339,24 @@ class _PrefetchIter:
                     except StopIteration:
                         break
                 batch = [self.loader.dataset[i] for i in idxs]
-                collate = self.loader.collate_fn or default_collate_fn
-                self.q.put(collate(batch))
-            self.q.put(self.done)
+                if self.nq is not None:
+                    payload = pickle.dumps(
+                        _numpy_collate(batch), pickle.HIGHEST_PROTOCOL
+                    )
+                    try:
+                        self.nq.push(b"B" + payload)
+                    except RuntimeError:  # consumer closed early
+                        return
+                else:
+                    collate = self.loader.collate_fn or default_collate_fn
+                    self.q.put(collate(batch))
+            if self.nq is not None:
+                try:
+                    self.nq.push(b"D")
+                except RuntimeError:
+                    pass
+            else:
+                self.q.put(self.done)
 
         for _ in range(1):  # single prefetch thread preserves batch order
             t = threading.Thread(target=work, daemon=True)
@@ -310,10 +367,24 @@ class _PrefetchIter:
         return self
 
     def __next__(self):
+        if self.nq is not None:
+            import pickle
+
+            item = self.nq.pop()
+            if item is None or item[:1] == b"D":
+                raise StopIteration
+            return _tensorize(pickle.loads(item[1:]))
         item = self.q.get()
         if item is self.done:
             raise StopIteration
         return item
+
+    def __del__(self):
+        try:
+            if self.nq is not None:
+                self.nq.close()
+        except Exception:
+            pass
 
 
 class DataLoader:
@@ -329,6 +400,7 @@ class DataLoader:
         self.collate_fn = collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_buffer_reader = use_buffer_reader
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler

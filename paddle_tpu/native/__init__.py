@@ -1,0 +1,373 @@
+"""paddle_tpu.native — ctypes bindings for the C++ runtime (csrc/).
+
+Native components (TPU-native re-designs of the reference's C++ runtime):
+
+- flags registry   (reference: paddle/common/flags.cc)
+- DDim helpers     (reference: paddle/common/ddim.h)
+- TCPStore         (reference: phi/core/distributed/store/tcp_store.h:121)
+- HostTracer       (reference: fluid/platform/profiler/host_tracer.h:26)
+- BlockingQueue    (reference: fluid/framework/blocking_queue.h)
+
+Everything degrades gracefully: ``is_available()`` is False when the
+toolchain is missing and pure-Python fallbacks take over.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+from ._build import ensure_built
+
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    path = ensure_built()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+
+    lib.ptpu_version.restype = ctypes.c_char_p
+    lib.ptpu_free.argtypes = [ctypes.c_void_p]
+
+    lib.ptpu_flag_define.argtypes = [ctypes.c_char_p] * 3
+    lib.ptpu_flag_define.restype = ctypes.c_int
+    lib.ptpu_flag_get.argtypes = [ctypes.c_char_p]
+    lib.ptpu_flag_get.restype = ctypes.c_void_p  # manual free
+    lib.ptpu_flag_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.ptpu_flag_set.restype = ctypes.c_int
+    lib.ptpu_flags_list_json.restype = ctypes.c_void_p
+
+    lib.ptpu_ddim_product.argtypes = [i64p, ctypes.c_int]
+    lib.ptpu_ddim_product.restype = ctypes.c_int64
+    lib.ptpu_ddim_strides.argtypes = [i64p, ctypes.c_int, i64p]
+    lib.ptpu_ddim_broadcast.argtypes = [
+        i64p, ctypes.c_int, i64p, ctypes.c_int, i64p,
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.ptpu_ddim_broadcast.restype = ctypes.c_int
+
+    lib.ptpu_store_server_start.argtypes = [ctypes.c_uint16]
+    lib.ptpu_store_server_start.restype = ctypes.c_void_p
+    lib.ptpu_store_server_port.argtypes = [ctypes.c_void_p]
+    lib.ptpu_store_server_port.restype = ctypes.c_uint16
+    lib.ptpu_store_server_stop.argtypes = [ctypes.c_void_p]
+    lib.ptpu_store_client_new.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int
+    ]
+    lib.ptpu_store_client_new.restype = ctypes.c_void_p
+    lib.ptpu_store_client_free.argtypes = [ctypes.c_void_p]
+    lib.ptpu_store_set.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, u8p, ctypes.c_uint32
+    ]
+    lib.ptpu_store_set.restype = ctypes.c_int
+    lib.ptpu_store_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(u8p),
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_int,
+    ]
+    lib.ptpu_store_get.restype = ctypes.c_int
+    lib.ptpu_store_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, i64p
+    ]
+    lib.ptpu_store_add.restype = ctypes.c_int
+    lib.ptpu_store_wait.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
+    ]
+    lib.ptpu_store_wait.restype = ctypes.c_int
+
+    lib.ptpu_trace_enable.argtypes = [ctypes.c_int]
+    lib.ptpu_trace_enabled.restype = ctypes.c_int
+    lib.ptpu_trace_now_ns.restype = ctypes.c_int64
+    lib.ptpu_trace_begin.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.ptpu_trace_instant.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.ptpu_trace_counter.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.ptpu_trace_export_json.restype = ctypes.c_void_p
+
+    lib.ptpu_queue_new.argtypes = [ctypes.c_uint32]
+    lib.ptpu_queue_new.restype = ctypes.c_void_p
+    lib.ptpu_queue_push.argtypes = [
+        ctypes.c_void_p, u8p, ctypes.c_uint64, ctypes.c_int
+    ]
+    lib.ptpu_queue_push.restype = ctypes.c_int
+    lib.ptpu_queue_pop.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(u8p),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+    ]
+    lib.ptpu_queue_pop.restype = ctypes.c_int
+    lib.ptpu_queue_close.argtypes = [ctypes.c_void_p]
+    lib.ptpu_queue_size.argtypes = [ctypes.c_void_p]
+    lib.ptpu_queue_size.restype = ctypes.c_uint32
+    lib.ptpu_queue_free.argtypes = [ctypes.c_void_p]
+
+    _LIB = lib
+    # Mirror the Python flag registry into the freshly loaded native one so
+    # both sides observe a single flag state from here on.
+    try:
+        from paddle_tpu.core import flags as _flags
+
+        _flags._on_native_loaded(lib=None)
+    except Exception:
+        pass
+    return lib
+
+
+def is_available() -> bool:
+    return _load() is not None
+
+
+def loaded() -> bool:
+    """True iff the library is already loaded in this process.
+
+    Unlike is_available() this never triggers a build — callers on import
+    paths use it so `import paddle_tpu` stays compile-free.
+    """
+    return _LIB is not None
+
+
+def lib() -> ctypes.CDLL:
+    l = _load()
+    if l is None:
+        raise RuntimeError("paddle_tpu native library is not available")
+    return l
+
+
+def _take_string(ptr: int) -> str:
+    """Copy a malloc'd C string into Python and free it."""
+    l = lib()
+    try:
+        return ctypes.cast(ptr, ctypes.c_char_p).value.decode()
+    finally:
+        l.ptpu_free(ptr)
+
+
+# ---------------------------------------------------------------- TCPStore
+class TCPStore:
+    """Rendezvous KV store (reference: tcp_store.h:121 semantics).
+
+    ``is_master=True`` starts the in-process server thread; every rank
+    (including the master) talks through a client connection.
+    """
+
+    def __init__(self, host: str, port: int, *, is_master: bool = False,
+                 timeout_s: float = 120.0):
+        l = lib()
+        self._server = None
+        if is_master:
+            self._server = l.ptpu_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = l.ptpu_store_server_port(self._server)
+        self.host, self.port = host, port
+        self._client = l.ptpu_store_client_new(
+            host.encode(), port, int(timeout_s * 1000)
+        )
+        if not self._client:
+            if self._server:
+                l.ptpu_store_server_stop(self._server)
+            raise TimeoutError(f"TCPStore: cannot connect to {host}:{port}")
+        self._default_timeout_ms = int(timeout_s * 1000)
+
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data \
+            else None
+        rc = lib().ptpu_store_set(
+            self._client, key.encode(), buf, len(data)
+        )
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed")
+
+    def get(self, key: str, timeout_s: float | None = None) -> bytes:
+        l = lib()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_uint32()
+        t = self._default_timeout_ms if timeout_s is None \
+            else int(timeout_s * 1000)
+        rc = l.ptpu_store_get(
+            self._client, key.encode(), ctypes.byref(out), ctypes.byref(n), t
+        )
+        if rc != 0:
+            raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+        try:
+            return ctypes.string_at(out, n.value)
+        finally:
+            l.ptpu_free(out)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        result = ctypes.c_int64()
+        rc = lib().ptpu_store_add(
+            self._client, key.encode(), delta, ctypes.byref(result)
+        )
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.add({key!r}) failed")
+        return result.value
+
+    def wait(self, keys, timeout_s: float | None = None) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        t = self._default_timeout_ms if timeout_s is None \
+            else int(timeout_s * 1000)
+        for key in keys:
+            rc = lib().ptpu_store_wait(self._client, key.encode(), t)
+            if rc != 0:
+                raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
+
+    def close(self) -> None:
+        l = lib()
+        if self._client:
+            l.ptpu_store_client_free(self._client)
+            self._client = None
+        if self._server:
+            l.ptpu_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ----------------------------------------------------------- BlockingQueue
+class BlockingQueue:
+    """Bounded MPMC byte-buffer queue (dataloader prefetch ring)."""
+
+    def __init__(self, capacity: int):
+        self._q = lib().ptpu_queue_new(capacity)
+
+    def push(self, data: bytes, timeout_s: float | None = None) -> bool:
+        t = -1 if timeout_s is None else int(timeout_s * 1000)
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data \
+            else None
+        rc = lib().ptpu_queue_push(self._q, buf, len(data), t)
+        if rc == -2:
+            raise RuntimeError("queue closed")
+        return rc == 0
+
+    def pop(self, timeout_s: float | None = None) -> bytes | None:
+        l = lib()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_uint64()
+        t = -1 if timeout_s is None else int(timeout_s * 1000)
+        rc = l.ptpu_queue_pop(
+            self._q, ctypes.byref(out), ctypes.byref(n), t
+        )
+        if rc == -2:
+            return None  # closed and drained
+        if rc != 0:
+            raise TimeoutError("queue pop timed out")
+        try:
+            return ctypes.string_at(out, n.value)
+        finally:
+            l.ptpu_free(out)
+
+    def close(self) -> None:
+        if self._q:
+            lib().ptpu_queue_close(self._q)
+
+    def __len__(self) -> int:
+        return lib().ptpu_queue_size(self._q)
+
+    def __del__(self):
+        try:
+            if self._q:
+                lib().ptpu_queue_close(self._q)
+                lib().ptpu_queue_free(self._q)
+                self._q = None
+        except Exception:
+            pass
+
+
+# ----------------------------------------------------------------- tracer
+class NativeTracer:
+    """Thin facade over the C++ host tracer."""
+
+    @staticmethod
+    def enable(on: bool = True) -> None:
+        lib().ptpu_trace_enable(1 if on else 0)
+
+    @staticmethod
+    def enabled() -> bool:
+        return bool(lib().ptpu_trace_enabled())
+
+    @staticmethod
+    def begin(name: str, category: str = "op") -> None:
+        lib().ptpu_trace_begin(name.encode(), category.encode())
+
+    @staticmethod
+    def end() -> None:
+        lib().ptpu_trace_end()
+
+    @staticmethod
+    def instant(name: str, category: str = "instant") -> None:
+        lib().ptpu_trace_instant(name.encode(), category.encode())
+
+    @staticmethod
+    def counter(name: str, value: float) -> None:
+        lib().ptpu_trace_counter(name.encode(), float(value))
+
+    @staticmethod
+    def export_json() -> str:
+        return _take_string(lib().ptpu_trace_export_json())
+
+    @staticmethod
+    def clear() -> None:
+        lib().ptpu_trace_clear()
+
+
+# ------------------------------------------------------------------- ddim
+def ddim_product(dims) -> int:
+    arr = (ctypes.c_int64 * len(dims))(*dims)
+    return lib().ptpu_ddim_product(arr, len(dims))
+
+
+def ddim_strides(dims) -> list:
+    arr = (ctypes.c_int64 * len(dims))(*dims)
+    out = (ctypes.c_int64 * len(dims))()
+    lib().ptpu_ddim_strides(arr, len(dims), out)
+    return list(out)
+
+
+def ddim_broadcast(a, b) -> list:
+    n = max(len(a), len(b))
+    aa = (ctypes.c_int64 * len(a))(*a)
+    bb = (ctypes.c_int64 * len(b))(*b)
+    out = (ctypes.c_int64 * n)()
+    nout = ctypes.c_int()
+    rc = lib().ptpu_ddim_broadcast(
+        aa, len(a), bb, len(b), out, ctypes.byref(nout)
+    )
+    if rc != 0:
+        raise ValueError(f"shapes {tuple(a)} and {tuple(b)} not broadcastable")
+    return list(out[: nout.value])
+
+
+# ------------------------------------------------------------------ flags
+def flag_define(name: str, default: str, doc: str = "") -> None:
+    lib().ptpu_flag_define(name.encode(), str(default).encode(), doc.encode())
+
+
+def flag_get(name: str) -> str | None:
+    ptr = lib().ptpu_flag_get(name.encode())
+    if not ptr:
+        return None
+    return _take_string(ptr)
+
+
+def flag_set(name: str, value: str) -> None:
+    rc = lib().ptpu_flag_set(name.encode(), str(value).encode())
+    if rc != 0:
+        raise KeyError(f"Unknown native flag: {name}")
+
+
+def version() -> str:
+    return lib().ptpu_version().decode()

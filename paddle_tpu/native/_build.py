@@ -1,0 +1,90 @@
+"""Lazy builder for libpaddle_tpu.so.
+
+Compiles csrc/*.cc with the system g++ on first import if the shared
+library is missing or older than the sources. Uses a lock file so that
+concurrent interpreter startups (distributed launch spawns N workers)
+build exactly once.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_CSRC = os.path.join(_REPO, "csrc")
+LIB_PATH = os.path.join(_HERE, "libpaddle_tpu.so")
+
+_SOURCES = [
+    "ptpu_ddim.cc",
+    "ptpu_flags.cc",
+    "ptpu_tcp_store.cc",
+    "ptpu_tracer.cc",
+    "ptpu_queue.cc",
+]
+
+
+def _stale() -> bool:
+    if not os.path.exists(LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(LIB_PATH)
+    deps = [os.path.join(_CSRC, s) for s in _SOURCES]
+    deps.append(os.path.join(_CSRC, "ptpu_c_api.h"))
+    deps.append(os.path.join(_CSRC, "ptpu_util.h"))
+    return any(
+        os.path.exists(d) and os.path.getmtime(d) > lib_mtime for d in deps
+    )
+
+
+def ensure_built(timeout_s: float = 120.0) -> str | None:
+    """Return the lib path, building it if needed; None if unbuildable."""
+    if not os.path.isdir(_CSRC):
+        return LIB_PATH if os.path.exists(LIB_PATH) else None
+    if not _stale():
+        return LIB_PATH
+
+    lock = LIB_PATH + ".lock"
+    try:
+        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        # Another process is building; wait for it.
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if not os.path.exists(lock) and not _stale():
+                return LIB_PATH
+            time.sleep(0.2)
+        return LIB_PATH if os.path.exists(LIB_PATH) else None
+    else:
+        os.close(fd)
+
+    try:
+        # Link to a temp path and rename: readers either see the old complete
+        # library or the new complete one, never a half-written file.
+        tmp_out = LIB_PATH + f".tmp.{os.getpid()}"
+        cmd = [
+            os.environ.get("CXX", "g++"),
+            "-O2", "-g", "-fPIC", "-std=c++17", "-Wall",
+            *(os.path.join(_CSRC, s) for s in _SOURCES),
+            "-shared", "-lpthread", "-o", tmp_out,
+        ]
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s
+        )
+        if proc.returncode != 0:
+            import warnings
+
+            warnings.warn(
+                "paddle_tpu native build failed; using Python fallbacks:\n"
+                + proc.stderr[-2000:]
+            )
+            return None
+        os.replace(tmp_out, LIB_PATH)
+        return LIB_PATH
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    finally:
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass

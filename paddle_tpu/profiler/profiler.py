@@ -237,6 +237,11 @@ class Profiler:
     def _start_record(self):
         get_host_tracer().start()
         _set_profiler_mode(True)
+        from .utils import _native_tracer
+        nat = _native_tracer()
+        if nat is not None:
+            nat.clear()
+            nat.enable(True)
         if ProfilerTarget.TPU in self.targets or ProfilerTarget.GPU in self.targets:
             try:
                 import jax.profiler as jp
@@ -248,6 +253,11 @@ class Profiler:
 
     def _stop_record(self):
         _set_profiler_mode(False)
+        from .utils import _native_tracer
+        nat = _native_tracer()
+        if nat is not None:
+            nat.enable(False)
+            self._native_events = json.loads(nat.export_json())
         if self._device_tracing:
             try:
                 import jax.profiler as jp
@@ -268,6 +278,28 @@ class Profiler:
                 "ts": ev.start_ns / 1e3, "dur": ev.duration_ns / 1e3,
                 "pid": os.getpid(), "tid": ev.thread_id,
             })
+        # Merge spans recorded by the native (C++) tracer — e.g. dataloader
+        # worker threads and counters. RecordEvent mirrors its spans into the
+        # native tracer too (so pure-C consumers see them); skip those here
+        # to avoid duplicating what the host tracer already exported.
+        py_cats = {v for k, v in vars(TracerEventType).items()
+                   if not k.startswith("_")}
+        open_stack: dict = {}  # tid -> [was_mirrored_span, ...] (LIFO)
+        for ev in getattr(self, "_native_events", []):
+            tid = ev.get("tid")
+            ph = ev.get("ph")
+            if ph == "B":
+                mirrored = ev.get("cat") in py_cats
+                open_stack.setdefault(tid, []).append(mirrored)
+                if mirrored:
+                    continue
+            elif ph == "E":
+                stack = open_stack.get(tid) or [False]
+                if stack.pop():
+                    continue
+            ev = dict(ev)
+            ev.setdefault("cat", "native")
+            traces.append(ev)
         with open(path, "w") as f:
             json.dump({"traceEvents": traces,
                        "displayTimeUnit": "ms"}, f)

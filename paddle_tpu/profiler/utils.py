@@ -16,6 +16,23 @@ from .host_tracer import TracerEventType, get_host_tracer
 _profiler_active = False
 
 
+def _native_tracer():
+    """Native C++ tracer class, or None (lazy; see csrc/ptpu_tracer.cc)."""
+    global _NATIVE_TRACER
+    if _NATIVE_TRACER is False:
+        try:
+            from paddle_tpu import native
+
+            _NATIVE_TRACER = native.NativeTracer if native.is_available() \
+                else None
+        except Exception:
+            _NATIVE_TRACER = None
+    return _NATIVE_TRACER
+
+
+_NATIVE_TRACER: Any = False
+
+
 def _set_profiler_mode(on: bool):
     global _profiler_active
     _profiler_active = on
@@ -45,6 +62,10 @@ class RecordEvent:
         tracer = get_host_tracer()
         if tracer.enabled:
             self._ev = tracer.push(self.name, self.event_type)
+            nat = _native_tracer()
+            if nat is not None and nat.enabled():
+                nat.begin(self.name, self.event_type)
+                self._nat_open = True
         if in_profiler_mode():
             try:
                 import jax.profiler as jp
@@ -60,6 +81,11 @@ class RecordEvent:
         if self._ev is not None:
             get_host_tracer().pop(self._ev)
             self._ev = None
+            if getattr(self, "_nat_open", False):
+                self._nat_open = False
+                nat = _native_tracer()
+                if nat is not None:
+                    nat.end()
 
     def __enter__(self):
         self.begin()

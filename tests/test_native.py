@@ -1,0 +1,244 @@
+"""Native C++ runtime tests (csrc/ → paddle_tpu.native).
+
+Covers the native analogs of the reference's runtime surface: flags
+registry (common/flags.cc), DDim helpers (common/ddim.h), TCPStore
+rendezvous (phi/core/distributed/store/tcp_store.h), host tracer
+(fluid/platform/profiler/host_tracer.h), and the dataloader blocking
+queue (framework/blocking_queue.h).
+"""
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.native as native
+
+pytestmark = pytest.mark.skipif(
+    not native.is_available(), reason="native lib not built"
+)
+
+
+class TestDDim:
+    def test_product(self):
+        assert native.ddim_product([2, 3, 4]) == 24
+        assert native.ddim_product([]) == 1
+
+    def test_strides(self):
+        assert native.ddim_strides([2, 3, 4]) == [12, 4, 1]
+
+    def test_broadcast(self):
+        assert native.ddim_broadcast([2, 1, 4], [3, 1]) == [2, 3, 4]
+        assert native.ddim_broadcast([5], [3, 1]) == [3, 5]
+        with pytest.raises(ValueError):
+            native.ddim_broadcast([2, 3], [4])
+
+
+class TestNativeFlags:
+    def test_define_get_set(self):
+        native.flag_define("t_native_flag", "7", "test flag")
+        assert native.flag_get("t_native_flag") == "7"
+        native.flag_set("t_native_flag", "11")
+        assert native.flag_get("t_native_flag") == "11"
+        assert native.flag_get("no_such_flag_xyz") is None
+
+    def test_python_facade_mirrors_native(self):
+        """core.flags delegates storage to the native registry."""
+        from paddle_tpu.core import flags
+
+        flags.define_flag("t_mirror_flag", 3, "mirror test")
+        assert flags.get_flag("t_mirror_flag") == 3
+        # mutate through native; Python read must observe it
+        native.flag_set("t_mirror_flag", "9")
+        assert flags.get_flag("t_mirror_flag") == 9
+        # mutate through Python; native read must observe it
+        flags.set_flags({"t_mirror_flag": 4})
+        assert native.flag_get("t_mirror_flag") == "4"
+
+    def test_set_flags_bool_roundtrip(self):
+        from paddle_tpu.core import flags
+
+        val = flags.get_flag("check_nan_inf")
+        flags.set_flags({"FLAGS_check_nan_inf": True})
+        assert flags.get_flag("check_nan_inf") is True
+        flags.set_flags({"check_nan_inf": val})
+
+
+class TestTCPStore:
+    def test_set_get_add_wait(self):
+        master = native.TCPStore("127.0.0.1", 0, is_master=True, timeout_s=10)
+        try:
+            client = native.TCPStore("127.0.0.1", master.port, timeout_s=10)
+            client.set("alpha", b"beta")
+            assert master.get("alpha") == b"beta"
+            assert client.add("ctr", 5) == 5
+            assert master.add("ctr", -2) == 3
+            client.wait("alpha")
+            client.close()
+        finally:
+            master.close()
+
+    def test_blocking_get(self):
+        master = native.TCPStore("127.0.0.1", 0, is_master=True, timeout_s=10)
+        try:
+            c = native.TCPStore("127.0.0.1", master.port, timeout_s=10)
+
+            def late_set():
+                time.sleep(0.3)
+                master.set("late_key", b"now")
+
+            t = threading.Thread(target=late_set)
+            t.start()
+            assert c.get("late_key", timeout_s=5) == b"now"
+            t.join()
+            with pytest.raises(TimeoutError):
+                c.get("never_key", timeout_s=0.2)
+            c.close()
+        finally:
+            master.close()
+
+    def test_cross_process_rendezvous(self):
+        """Two OS processes rendezvous through the store — the launch-time
+        pattern (reference: parallel.py:1134 master store + worker clients)."""
+        master = native.TCPStore("127.0.0.1", 0, is_master=True, timeout_s=10)
+        try:
+            master.set("parent_key", b"from-parent")
+            child = subprocess.run(
+                [sys.executable, "-c", (
+                    "import paddle_tpu.native as native\n"
+                    "c = native.TCPStore('127.0.0.1', %d, timeout_s=10)\n"
+                    "c.set('child_key', b'from-child')\n"
+                    "print(c.get('parent_key').decode())\n"
+                    "c.close()\n"
+                ) % master.port],
+                capture_output=True, text=True, timeout=30,
+                cwd=str(__import__("pathlib").Path(__file__).parents[1]),
+            )
+            assert master.get("child_key", timeout_s=10) == b"from-child"
+            assert child.returncode == 0, child.stderr
+            assert child.stdout.strip() == "from-parent"
+        finally:
+            master.close()
+
+
+class TestBlockingQueue:
+    def test_fifo_and_backpressure(self):
+        q = native.BlockingQueue(2)
+        assert q.push(b"one") and q.push(b"two")
+        assert len(q) == 2
+        assert not q.push(b"three", timeout_s=0.05)  # full → timeout
+        assert q.pop() == b"one"
+        assert q.pop() == b"two"
+        with pytest.raises(TimeoutError):
+            q.pop(timeout_s=0.05)
+        q.close()
+        assert q.pop() is None
+
+    def test_producer_consumer_threads(self):
+        q = native.BlockingQueue(4)
+        n = 200
+        got = []
+
+        def producer():
+            for i in range(n):
+                q.push(str(i).encode())
+            q.close()
+
+        def consumer():
+            while True:
+                item = q.pop()
+                if item is None:
+                    return
+                got.append(int(item))
+
+        tp = threading.Thread(target=producer)
+        tc = threading.Thread(target=consumer)
+        tp.start(); tc.start()
+        tp.join(10); tc.join(10)
+        assert got == list(range(n))
+
+
+class TestNativeTracer:
+    def test_spans_counters_export(self):
+        T = native.NativeTracer
+        T.clear()
+        T.enable(True)
+        T.begin("outer", "test")
+        T.begin("inner", "test")
+        T.end()
+        T.end()
+        T.counter("hbm_bytes", 123.0)
+        T.instant("marker", "test")
+        T.enable(False)
+        events = json.loads(T.export_json())
+        names = [e.get("name") for e in events]
+        assert "outer" in names and "inner" in names
+        ctr = [e for e in events if e.get("ph") == "C"][0]
+        assert ctr["args"]["value"] == 123.0
+        begins = [e for e in events if e.get("ph") == "B"]
+        ends = [e for e in events if e.get("ph") == "E"]
+        assert len(begins) == len(ends) == 2
+        T.clear()
+        assert json.loads(T.export_json()) == []
+
+    def test_disabled_records_nothing(self):
+        T = native.NativeTracer
+        T.clear()
+        T.begin("ghost", "x")
+        T.end()
+        assert json.loads(T.export_json()) == []
+
+
+class TestDataLoaderNativeRing:
+    def test_prefetch_through_native_queue(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Ds(Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return (np.full((3,), i, np.float32), np.int64(i))
+
+        loader = DataLoader(Ds(), batch_size=4, num_workers=2,
+                            drop_last=False)
+        it = iter(loader)
+        assert getattr(it, "nq", None) is not None, \
+            "native ring should be active for default collate"
+        batches = list(it)
+        assert len(batches) == 3
+        x0, y0 = batches[0]
+        assert x0.shape == [4, 3]
+        np.testing.assert_array_equal(
+            np.asarray(y0._value), np.arange(4)
+        )
+        xs = np.concatenate([np.asarray(b[0]._value) for b in batches])
+        assert xs.shape == (10, 3)
+
+    def test_profiler_merges_native_events(self, tmp_path):
+        import paddle_tpu.profiler as profiler
+
+        T = native.NativeTracer
+        T.clear()
+        prof = profiler.Profiler(
+            targets=[profiler.ProfilerTarget.CPU], scheduler=(0, 2)
+        )
+        prof.start()
+        with profiler.RecordEvent("py_span"):
+            pass
+        T.instant("native_only_marker", "native")
+        prof.step()
+        prof.step()
+        prof.stop()
+        out = tmp_path / "trace.json"
+        prof.export(str(out))
+        data = json.load(open(out))
+        names = [e.get("name") for e in data["traceEvents"]]
+        assert "py_span" in names
+        assert "native_only_marker" in names
+        # the mirrored native copy of py_span must have been deduplicated
+        assert names.count("py_span") == 1
