@@ -27,6 +27,8 @@ from . import fleet
 from . import utils
 from . import auto_parallel
 from . import checkpoint
+from . import sharding
+from .sharding import group_sharded_parallel, save_group_sharded_model
 from .launch_utils import spawn, launch
 
 # paddle.distributed.parallel compat namespace

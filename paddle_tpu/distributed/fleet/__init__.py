@@ -121,12 +121,14 @@ def distributed_model(model):
 def distributed_optimizer(optimizer, strategy=None):
     """fleet.distributed_optimizer parity (fleet/fleet.py:1325 →
     HybridParallelOptimizer). Grad allreduce/clip-across-groups is implied by
-    GSPMD layouts; sharding stages come from shard_optimizer."""
-    strategy = strategy or _fleet_state.get("strategy")
-    if strategy is not None and strategy.hybrid_configs.get("sharding_degree", 1) > 1:
-        from ..auto_parallel.api import ShardingStage1, shard_optimizer
+    GSPMD layouts; sharding stages come from the optimizer wrapper."""
+    from .meta_optimizers import HybridParallelOptimizer
 
-        return shard_optimizer(optimizer, ShardingStage1("sharding"))
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return HybridParallelOptimizer(
+            optimizer, hcg, strategy or _fleet_state.get("strategy")
+        )
     return optimizer
 
 

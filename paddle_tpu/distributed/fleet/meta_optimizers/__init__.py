@@ -1,0 +1,5 @@
+"""fleet.meta_optimizers parity (dygraph subset — the static-graph
+meta-optimizer pass stack collapses into GSPMD layouts on TPU)."""
+from .dygraph_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer, HybridParallelOptimizer,
+)

@@ -8,11 +8,15 @@ layouts (see fleet.distributed_model); pipeline keeps an explicit schedule.
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
 from .pipeline_parallel import PipelineParallel
 from .segment_parallel import SegmentParallel
+from .sharding import (
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+)
 from ..sequence_parallel import *  # noqa: F401,F403
 from ..pipeline_spmd import pipeline_spmd_apply
 
 __all__ = [
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
     "SegmentParallel",
+    "GroupShardedOptimizerStage2", "GroupShardedStage2", "GroupShardedStage3",
     "pipeline_spmd_apply",
 ]
