@@ -18,9 +18,12 @@ from .communication import (  # noqa: F401
 from .auto_parallel.placement import (
     Partial, Placement, ProcessMesh, Replicate, Shard,
 )
+from .auto_parallel.dist_model import DistModel, to_static
+from .auto_parallel.strategy import Strategy
 from .auto_parallel.api import (
-    ShardingStage1, ShardingStage2, ShardingStage3, dtensor_from_fn, reshard,
-    shard_layer, shard_optimizer, shard_tensor, unshard_dtensor,
+    ShardDataloader, ShardingStage1, ShardingStage2, ShardingStage3,
+    dtensor_from_fn, reshard, shard_dataloader, shard_layer, shard_optimizer,
+    shard_tensor, unshard_dtensor,
 )
 from .parallel_wrapper import DataParallel
 from . import fleet

@@ -1,6 +1,12 @@
 """Auto-parallel namespace (reference: python/paddle/distributed/auto_parallel/)."""
 from .placement import Partial, Placement, ProcessMesh, Replicate, Shard
 from .api import (
-    ShardingStage1, ShardingStage2, ShardingStage3, dtensor_from_fn, reshard,
-    shard_layer, shard_optimizer, shard_tensor, unshard_dtensor,
+    ShardDataloader, ShardingStage1, ShardingStage2, ShardingStage3,
+    dtensor_from_fn, reshard, shard_dataloader, shard_layer, shard_optimizer,
+    shard_tensor, unshard_dtensor,
 )
+from .dist_model import DistModel, to_static
+from .engine import Engine
+from .strategy import Strategy
+from . import spmd_rules
+from .spmd_rules import DistTensorSpec, get_spmd_rule, register_spmd_rule

@@ -29,7 +29,8 @@ from .placement import (
 __all__ = [
     "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
     "shard_optimizer", "ShardingStage0", "ShardingStage1", "ShardingStage2",
-    "ShardingStage3", "unshard_dtensor",
+    "ShardingStage3", "unshard_dtensor", "shard_dataloader",
+    "ShardDataloader",
 ]
 
 
@@ -146,6 +147,75 @@ class ShardingStage2(ShardingStage1):
 
 class ShardingStage3(ShardingStage1):
     """ZeRO-3: parameters also sharded along the data axis."""
+
+
+class ShardDataloader:
+    """Reference: auto_parallel/api.py:2854 ShardDataloader — wraps a
+    DataLoader so every yielded tensor is laid out on the mesh (batch dim
+    sharded over the dp-like axis given by ``shard_dims``).
+
+    On TPU the single controller sees global batches; sharding the batch
+    dim over the mesh IS data parallelism, and XLA scatters the host
+    arrays to the devices on transfer.
+    """
+
+    def __init__(self, dataloader, meshes, input_keys=None, shard_dims=None,
+                 is_dataset_splitted: bool = False):
+        self._loader = dataloader
+        self._meshes = meshes if isinstance(meshes, (list, tuple)) \
+            else [meshes]
+        self._input_keys = input_keys
+        if shard_dims is None:
+            # default: first axis of the first mesh
+            shard_dims = self._meshes[0].dim_names[0]
+        self._shard_dims = shard_dims
+        self._is_dataset_splitted = is_dataset_splitted
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _placements(self, mesh: ProcessMesh, shard_dim):
+        placements: List[Placement] = [Replicate()] * mesh.ndim
+        if shard_dim is not None:
+            idx = shard_dim if isinstance(shard_dim, int) \
+                else mesh.dim_names.index(shard_dim)
+            placements[idx] = Shard(0)
+        return placements
+
+    def _shard_item(self, item, mesh, shard_dim):
+        if isinstance(item, Tensor):
+            return shard_tensor(
+                item, mesh, self._placements(mesh, shard_dim)
+            )
+        if isinstance(item, dict):
+            return {k: self._shard_item(v, mesh, shard_dim)
+                    for k, v in item.items()}
+        if isinstance(item, (list, tuple)):
+            return type(item)(
+                self._shard_item(v, mesh, shard_dim) for v in item
+            )
+        return item
+
+    def __iter__(self):
+        mesh = self._meshes[0]
+        shard_dim = self._shard_dims if not isinstance(
+            self._shard_dims, (list, tuple, dict)) else None
+        for batch in self._loader:
+            if isinstance(self._shard_dims, (list, tuple)) and \
+                    isinstance(batch, (list, tuple)):
+                yield type(batch)(
+                    self._shard_item(item, mesh, dim)
+                    for item, dim in zip(batch, self._shard_dims)
+                )
+            else:
+                yield self._shard_item(batch, mesh, shard_dim)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted: bool = False) -> ShardDataloader:
+    """Reference: auto_parallel/api.py:2854."""
+    return ShardDataloader(dataloader, meshes, input_keys, shard_dims,
+                           is_dataset_splitted)
 
 
 def shard_optimizer(optimizer, shard_fn=None):

@@ -1,0 +1,153 @@
+"""DistModel / to_static — dy2static distributed training facade.
+
+Reference: python/paddle/distributed/auto_parallel/api.py (DistModel
+:1862, to_static :2348): wraps layer+loader+loss+optimizer, converts the
+dygraph model to a static distributed program per mode (train/eval/
+predict), and dispatches __call__ to the compiled program.
+
+TPU re-design: "static program" = a jit-compiled SPMD step closure.
+Parameters keep their GSPMD layouts (annotated via shard_tensor /
+shard_layer); tracing the step under jax.jit turns every placement into a
+sharding constraint, and XLA emits the collectives. No
+partitioner/completion passes are needed — GSPMD is the partitioner.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ...core.tensor import Tensor
+
+__all__ = ["DistModel", "to_static"]
+
+
+class DistModel:
+    """Compiled-step dispatcher over train/eval/predict modes.
+
+    Reference semantics (api.py:1862): after to_static, calling the
+    DistModel runs the micro-batched static program for the current mode
+    and returns the loss (train/eval) or outputs (predict).
+    """
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        from ... import jit
+
+        self.network = layer
+        self._loss_fn = loss
+        self._optimizer = optimizer
+        self._strategy = strategy
+        self._mode: Optional[str] = None
+        self._loader = loader
+
+        # Apply strategy-driven layout policies before compiling.
+        if strategy is not None and optimizer is not None and \
+                getattr(strategy, "sharding", None) is not None and \
+                strategy.sharding.enable:
+            from .api import (
+                ShardingStage1, ShardingStage2, ShardingStage3,
+                shard_optimizer,
+            )
+
+            stage_cls = {1: ShardingStage1, 2: ShardingStage2,
+                         3: ShardingStage3}[strategy.sharding.stage]
+            self._optimizer = shard_optimizer(optimizer, stage_cls())
+
+        def _forward_loss(*args):
+            if self._loss_fn is None:
+                return self.network(*args)
+            *inputs, labels = args
+            outs = self.network(*inputs)
+            return self._loss_fn(outs, labels)
+
+        @jit.to_static
+        def _train_step(*args):
+            loss = _forward_loss(*args)
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            return loss
+
+        @jit.to_static
+        def _eval_step(*args):
+            return _forward_loss(*args)
+
+        @jit.to_static
+        def _predict_step(*args):
+            return self.network(*args)
+
+        self._train_step = _train_step
+        self._eval_step = _eval_step
+        self._predict_step = _predict_step
+
+        if optimizer is not None and loss is not None:
+            self.train()
+        elif loss is not None:
+            self.eval()
+        else:
+            self.predict()
+
+    # -- mode switches (reference api.py:1952-1984) ----------------------
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+        return self
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+        return self
+
+    @property
+    def mode(self):
+        return self._mode
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            if self._optimizer is None or self._loss_fn is None:
+                raise ValueError(
+                    "DistModel needs loss and optimizer for train mode"
+                )
+            return self._train_step(*args)
+        if self._mode == "eval":
+            if self._loss_fn is None:
+                raise ValueError("DistModel needs loss for eval mode")
+            return self._eval_step(*args)
+        return self._predict_step(*args)
+
+    # -- state (reference api.py:2069 state_dict with dist tensors) ------
+    def state_dict(self, mode: str = "all"):
+        state = {}
+        if mode in ("all", "param"):
+            state.update(self.network.state_dict())
+        if mode in ("all", "opt") and self._optimizer is not None:
+            state.update(
+                {f"opt.{k}": v
+                 for k, v in self._optimizer.state_dict().items()
+                 if isinstance(v, (Tensor,)) or not isinstance(v, dict)}
+            )
+        return state
+
+    def set_state_dict(self, state_dict):
+        net_state = {k: v for k, v in state_dict.items()
+                     if not k.startswith("opt.")}
+        self.network.set_state_dict(net_state)
+
+    def dist_main_program(self, mode=None):
+        """Reference returns the partitioned PIR program; here the program
+        IS the jaxpr of the compiled step — return its repr for inspection."""
+        step = {"train": self._train_step, "eval": self._eval_step,
+                "predict": self._predict_step}.get(mode or self._mode)
+        return getattr(step, "_last_jaxpr", None)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              metrics=None) -> DistModel:
+    """Reference: auto_parallel/api.py:2348. Returns a DistModel whose
+    __call__ runs the compiled SPMD step for the current mode."""
+    return DistModel(layer, loader=loader, loss=loss, optimizer=optimizer,
+                     strategy=strategy, metrics=metrics)

@@ -1,0 +1,140 @@
+"""Fully-auto parallel Engine.
+
+Reference: python/paddle/distributed/auto_parallel/static/engine.py:71 —
+Engine(model, loss, optimizer, metrics, strategy) with
+fit/evaluate/predict; internally completion → planner → partitioner →
+reshard build a distributed program per mode.
+
+TPU re-design: the completion/partition pipeline is GSPMD. The Engine
+annotates a default data-parallel layout over the visible devices (unless
+the model was already hand-sharded), compiles one jitted step per mode via
+DistModel, and runs the epoch loops. The cost-model-driven planner lives
+in distributed.auto_tuner instead.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .dist_model import DistModel
+from .placement import ProcessMesh, Replicate
+from .api import shard_dataloader, shard_tensor
+
+__all__ = ["Engine"]
+
+
+class Engine:
+    def __init__(self, model, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+            else ([metrics] if metrics is not None else [])
+        self._strategy = strategy
+        self._dist_model: Optional[DistModel] = None
+        self._mesh: Optional[ProcessMesh] = None
+
+    # -- layout completion (reference: completion.py, vastly simplified:
+    # default layout = DP over all devices; hand annotations win) --------
+    def _ensure_mesh(self):
+        if self._mesh is not None:
+            return self._mesh
+        for p in self._model.parameters():
+            if p._dist_attr is not None:
+                self._mesh = p._dist_attr[0]
+                return self._mesh
+        import jax
+
+        n = len(jax.devices())
+        self._mesh = ProcessMesh(np.arange(n), ["dp"])
+        for p in self._model.parameters():
+            shard_tensor(p, self._mesh,
+                         [Replicate()] * self._mesh.ndim)
+        return self._mesh
+
+    def _ensure_dist_model(self):
+        if self._dist_model is None:
+            self._ensure_mesh()
+            self._dist_model = DistModel(
+                self._model, loss=self._loss, optimizer=self._optimizer,
+                strategy=self._strategy,
+            )
+        return self._dist_model
+
+    # -- loops ----------------------------------------------------------
+    def fit(self, train_data, epochs: int = 1, batch_size: Optional[int] = None,
+            steps_per_epoch: Optional[int] = None, valid_data=None,
+            log_freq: int = 10, verbose: int = 1, callbacks=None):
+        dm = self._ensure_dist_model().train()
+        loader = self._wrap_loader(train_data, batch_size)
+        history = {"loss": []}
+        for epoch in range(epochs):
+            losses = []
+            for step, batch in enumerate(loader):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                loss = dm(*self._as_args(batch))
+                losses.append(float(loss))
+                if verbose and log_freq and step % log_freq == 0:
+                    print(f"epoch {epoch} step {step}: "
+                          f"loss {losses[-1]:.4f}")
+            history["loss"].append(
+                float(np.mean(losses)) if losses else float("nan")
+            )
+            if valid_data is not None:
+                self.evaluate(valid_data, batch_size=batch_size,
+                              verbose=verbose)
+            dm.train()
+        return history
+
+    def evaluate(self, valid_data, batch_size: Optional[int] = None,
+                 steps: Optional[int] = None, log_freq: int = 10,
+                 verbose: int = 1, callbacks=None):
+        dm = self._ensure_dist_model().eval()
+        loader = self._wrap_loader(valid_data, batch_size)
+        losses = []
+        for step, batch in enumerate(loader):
+            if steps is not None and step >= steps:
+                break
+            losses.append(float(dm(*self._as_args(batch))))
+        result = {"loss": float(np.mean(losses)) if losses else float("nan")}
+        if verbose:
+            print(f"eval: {result}")
+        return result
+
+    def predict(self, test_data, batch_size: Optional[int] = None,
+                steps: Optional[int] = None, callbacks=None):
+        dm = self._ensure_dist_model().predict()
+        loader = self._wrap_loader(test_data, batch_size)
+        outputs = []
+        for step, batch in enumerate(loader):
+            if steps is not None and step >= steps:
+                break
+            args = self._as_args(batch)
+            if self._loss is not None and len(args) > 1:
+                args = args[:-1]  # drop labels for inference
+            outputs.append(dm(*args))
+        return outputs
+
+    # -- helpers --------------------------------------------------------
+    def _wrap_loader(self, data, batch_size):
+        from ...io.dataloader import DataLoader, Dataset
+
+        if isinstance(data, DataLoader):
+            loader = data
+        elif isinstance(data, Dataset):
+            loader = DataLoader(data, batch_size=batch_size or 1,
+                                shuffle=False)
+        else:
+            return data  # already an iterable of batches
+        mesh = self._ensure_mesh()
+        dp_axis = "dp" if "dp" in mesh.dim_names else mesh.dim_names[0]
+        return shard_dataloader(loader, mesh, shard_dims=dp_axis)
+
+    @staticmethod
+    def _as_args(batch):
+        if isinstance(batch, (list, tuple)):
+            return tuple(batch)
+        return (batch,)

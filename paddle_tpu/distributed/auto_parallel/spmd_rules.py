@@ -1,0 +1,404 @@
+"""SPMD placement-propagation rules.
+
+Reference: paddle/phi/infermeta/spmd_rules/ (93 C++ rule files registered in
+rules.cc, queried via get_spmd_rule and exercised by
+test/auto_parallel/spmd_rules/*). Each rule takes input DistTensorSpecs and
+infers (possibly re-laid-out) input placements plus output placements.
+
+TPU re-design: GSPMD already propagates shardings inside jit, so these
+rules are not on the execution hot path. They exist for the same reasons
+the reference exposes them to Python: (a) planning — DistModel and the
+auto-tuner ask "what layout would op X produce?" without tracing, (b)
+validation/debug — mismatched hand annotations are caught early, (c) API
+parity. The propagation logic follows the reference's einsum-notation
+approach: map each tensor dim to a letter, align shardings on matching
+letters, drop conflicting/reduced letters.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .placement import Partial, Placement, ProcessMesh, Replicate, Shard
+
+__all__ = ["DistTensorSpec", "get_spmd_rule", "register_spmd_rule",
+           "SpmdRule"]
+
+
+class DistTensorSpec:
+    """Shape + placements over a mesh (reference:
+    auto_parallel/static/dist_tensor_spec.py DistTensorSpec)."""
+
+    def __init__(self, shape: Sequence[int], mesh: ProcessMesh,
+                 placements: Sequence[Placement]):
+        self.shape = list(shape)
+        self.mesh = mesh
+        self.placements = list(placements)
+        if len(self.placements) != mesh.ndim:
+            raise ValueError(
+                f"placements rank {len(self.placements)} != mesh rank "
+                f"{mesh.ndim}"
+            )
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def dims_mapping(self) -> List[int]:
+        """tensor dim -> mesh dim (or -1), the reference's dims_mapping."""
+        mapping = [-1] * self.ndim
+        for mesh_dim, pl in enumerate(self.placements):
+            if isinstance(pl, Shard) and mapping[pl.dim] == -1:
+                mapping[pl.dim] = mesh_dim
+        return mapping
+
+    @classmethod
+    def from_dims_mapping(cls, shape, mesh, mapping) -> "DistTensorSpec":
+        placements: List[Placement] = [Replicate()] * mesh.ndim
+        for tdim, mdim in enumerate(mapping):
+            if mdim >= 0:
+                placements[mdim] = Shard(tdim)
+        return cls(shape, mesh, placements)
+
+    def __repr__(self):
+        return f"DistTensorSpec(shape={self.shape}, placements={self.placements})"
+
+
+class SpmdRule:
+    def __init__(self, name: str, fn: Callable):
+        self.name = name
+        self._fn = fn
+
+    def infer_forward(self, *specs, **attrs):
+        """Returns (inferred_input_specs, output_specs) — both lists."""
+        return self._fn(*specs, **attrs)
+
+    def __repr__(self):
+        return f"SpmdRule({self.name})"
+
+
+_REGISTRY: Dict[str, SpmdRule] = {}
+
+
+def register_spmd_rule(name: str):
+    def deco(fn):
+        rule = SpmdRule(name, fn)
+        _REGISTRY[name] = rule
+        return fn
+    return deco
+
+
+def get_spmd_rule(name: str) -> SpmdRule:
+    """Reference: phi/infermeta/spmd_rules/rules.cc registry lookup; falls
+    back to the default (replicate-everything) rule like unregistered ops."""
+    return _REGISTRY.get(name, _REGISTRY["default"])
+
+
+# --------------------------------------------------------------- helpers
+def _merge_letter_shardings(notations: Sequence[str],
+                            specs: Sequence[DistTensorSpec]):
+    """Align shardings across inputs by einsum letter. First writer wins;
+    conflicting later shardings are dropped (the reference resolves
+    conflicts the same way, preferring the earlier operand)."""
+    letter_to_mesh_dim: Dict[str, int] = {}
+    used_mesh_dims = set()
+    for notation, spec in zip(notations, specs):
+        mapping = spec.dims_mapping()
+        for i, letter in enumerate(notation):
+            mdim = mapping[i]
+            if mdim < 0 or letter == "1":
+                continue
+            if letter not in letter_to_mesh_dim and mdim not in used_mesh_dims:
+                letter_to_mesh_dim[letter] = mdim
+                used_mesh_dims.add(mdim)
+    return letter_to_mesh_dim
+
+
+def _apply_letters(notation: str, shape, mesh, letter_to_mesh_dim,
+                   partial_dims: Sequence[int] = ()) -> DistTensorSpec:
+    mapping = [-1] * len(notation)
+    for i, letter in enumerate(notation):
+        if letter in letter_to_mesh_dim:
+            mapping[i] = letter_to_mesh_dim[letter]
+    spec = DistTensorSpec.from_dims_mapping(shape, mesh, mapping)
+    for mdim in partial_dims:
+        spec.placements[mdim] = Partial("sum")
+    return spec
+
+
+def _einsum_like(notations_in: Sequence[str], notation_out: str,
+                 specs: Sequence[DistTensorSpec],
+                 out_shape: Sequence[int]) -> Tuple[list, list]:
+    mesh = specs[0].mesh
+    letters = _merge_letter_shardings(notations_in, specs)
+    new_inputs = [
+        _apply_letters(n, s.shape, mesh, letters)
+        for n, s in zip(notations_in, specs)
+    ]
+    # letters contracted away (present in inputs, absent in output) leave
+    # the output Partial on their mesh dims
+    contracted = {l for n in notations_in for l in n} - set(notation_out)
+    partial_dims = [letters[l] for l in contracted if l in letters]
+    out = _apply_letters(notation_out, out_shape, mesh, letters, partial_dims)
+    return new_inputs, [out]
+
+
+def _letters(n: int, skip: str = "") -> str:
+    alphabet = "abcdefghijklmnopqrstuvwxyz"
+    out = "".join(c for c in alphabet if c not in skip)
+    return out[:n]
+
+
+# ----------------------------------------------------------------- rules
+@register_spmd_rule("default")
+def _default_rule(*specs, **attrs):
+    """Replicate everything (unregistered-op fallback)."""
+    mesh = specs[0].mesh
+    new = [DistTensorSpec(s.shape, mesh, [Replicate()] * mesh.ndim)
+           for s in specs]
+    return new, []
+
+
+@register_spmd_rule("matmul")
+def _matmul_rule(x: DistTensorSpec, y: DistTensorSpec,
+                 trans_x: bool = False, trans_y: bool = False):
+    """Reference: spmd_rules/matmul.cc. Batched dims broadcast-align; the
+    contracted dim's sharding makes the output Partial on that mesh dim."""
+    xs, ys = list(x.shape), list(y.shape)
+    if trans_x:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if trans_y:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    nb = max(len(xs), len(ys)) - 2
+    batch = _letters(nb, skip="mnk")
+    x_nb = len(xs) - 2
+    y_nb = len(ys) - 2
+    x_not = batch[nb - x_nb:] + "mk"
+    y_not = batch[nb - y_nb:] + "kn"
+    out_not = batch + "mn"
+    if trans_x:
+        x_not = x_not[:-2] + x_not[-1] + x_not[-2]
+    if trans_y:
+        y_not = y_not[:-2] + y_not[-1] + y_not[-2]
+    out_shape = [max(a, b) for a, b in
+                 zip([1] * (nb - x_nb) + xs[:-2], [1] * (nb - y_nb) + ys[:-2])]
+    out_shape += [xs[-2], ys[-1]]
+    return _einsum_like([x_not, y_not], out_not, [x, y], out_shape)
+
+
+@register_spmd_rule("elementwise")
+def _elementwise_rule(*specs, **attrs):
+    """Reference: spmd_rules/elementwise.cc with numpy broadcasting."""
+    mesh = specs[0].mesh
+    ndim = max(s.ndim for s in specs)
+    out_shape = [1] * ndim
+    for s in specs:
+        for i, d in enumerate(s.shape):
+            j = ndim - s.ndim + i
+            out_shape[j] = max(out_shape[j], d)
+    base = _letters(ndim)
+    notations = []
+    for s in specs:
+        off = ndim - s.ndim
+        # broadcasted (size-1) dims don't propagate sharding: letter "1"
+        notation = "".join(
+            "1" if s.shape[i] == 1 and out_shape[off + i] != 1
+            else base[off + i]
+            for i in range(s.ndim)
+        )
+        notations.append(notation)
+    return _einsum_like(notations, base, list(specs), out_shape)
+
+
+@register_spmd_rule("reduction")
+def _reduction_rule(x: DistTensorSpec, axis=None, keepdim: bool = False,
+                    **attrs):
+    """Reference: spmd_rules/reduction.cc — reduced dims become Partial."""
+    mesh = x.mesh
+    ndim = x.ndim
+    if axis is None:
+        axes = list(range(ndim))
+    else:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        axes = [a % ndim for a in axes]
+    notation = _letters(ndim)
+    if keepdim:
+        out_not = "".join("1" if i in axes else notation[i]
+                          for i in range(ndim))
+        out_shape = [1 if i in axes else x.shape[i] for i in range(ndim)]
+    else:
+        out_not = "".join(notation[i] for i in range(ndim) if i not in axes)
+        out_shape = [x.shape[i] for i in range(ndim) if i not in axes]
+    letters = _merge_letter_shardings([notation], [x])
+    new_in = [_apply_letters(notation, x.shape, mesh, letters)]
+    reduced = {notation[i] for i in axes}
+    partial_dims = [letters[l] for l in reduced if l in letters]
+    out = _apply_letters(out_not, out_shape, mesh, letters, partial_dims)
+    return new_in, [out]
+
+
+@register_spmd_rule("transpose")
+def _transpose_rule(x: DistTensorSpec, perm=None, **attrs):
+    perm = perm or list(reversed(range(x.ndim)))
+    notation = _letters(x.ndim)
+    out_not = "".join(notation[p] for p in perm)
+    out_shape = [x.shape[p] for p in perm]
+    return _einsum_like([notation], out_not, [x], out_shape)
+
+
+@register_spmd_rule("reshape")
+def _reshape_rule(x: DistTensorSpec, shape=None, **attrs):
+    """Reference: spmd_rules/reshape.cc (dim-transform analysis). We keep
+    shardings on dims whose size is unchanged and aligned from the left;
+    anything split/merged falls back to replicated."""
+    mesh = x.mesh
+    out_shape = list(shape or [])
+    neg = [i for i, d in enumerate(out_shape) if d == -1]
+    if neg:
+        known = 1
+        for d in out_shape:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in x.shape:
+            total *= d
+        out_shape[neg[0]] = total // max(known, 1)
+    mapping_in = x.dims_mapping()
+    mapping_out = [-1] * len(out_shape)
+    for i in range(min(x.ndim, len(out_shape))):
+        if x.shape[i] == out_shape[i]:
+            mapping_out[i] = mapping_in[i]
+        else:
+            break
+    out = DistTensorSpec.from_dims_mapping(out_shape, mesh, mapping_out)
+    return [x], [out]
+
+
+@register_spmd_rule("embedding")
+def _embedding_rule(w: DistTensorSpec, ids: DistTensorSpec, **attrs):
+    """Reference: spmd_rules/embedding.cc — vocab-sharded weight makes the
+    output Partial (masked local lookup + allreduce); ids batch sharding
+    propagates to output rows."""
+    mesh = w.mesh
+    id_not = _letters(ids.ndim, skip="vh")
+    w_not = "vh"
+    out_not = id_not + "h"
+    out_shape = list(ids.shape) + [w.shape[1]]
+    return _einsum_like([w_not, id_not], out_not, [w, ids], out_shape)
+
+
+@register_spmd_rule("layer_norm")
+def _layer_norm_rule(x: DistTensorSpec, scale: Optional[DistTensorSpec] = None,
+                     bias: Optional[DistTensorSpec] = None,
+                     begin_norm_axis: int = -1, **attrs):
+    """Reference: spmd_rules/layer_norm.cc — normalized trailing dims must
+    be replicated; leading (batch) shardings pass through."""
+    mesh = x.mesh
+    ax = begin_norm_axis % x.ndim
+    mapping = x.dims_mapping()
+    for i in range(ax, x.ndim):
+        mapping[i] = -1
+    out = DistTensorSpec.from_dims_mapping(x.shape, mesh, mapping)
+    new_x = DistTensorSpec.from_dims_mapping(x.shape, mesh, mapping)
+    mean_shape = x.shape[:ax]
+    mean = DistTensorSpec.from_dims_mapping(mean_shape, mesh, mapping[:ax])
+    new_inputs = [new_x]
+    for aux in (scale, bias):
+        if aux is not None:
+            new_inputs.append(
+                DistTensorSpec(aux.shape, mesh, [Replicate()] * mesh.ndim)
+            )
+    return new_inputs, [out, mean, mean]
+
+
+@register_spmd_rule("rms_norm")
+def _rms_norm_rule(x: DistTensorSpec, scale: Optional[DistTensorSpec] = None,
+                   **attrs):
+    new_in, outs = _layer_norm_rule(x, scale, None, begin_norm_axis=-1)
+    return new_in, outs[:1]
+
+
+@register_spmd_rule("softmax")
+def _softmax_rule(x: DistTensorSpec, axis: int = -1, **attrs):
+    """Softmax axis must be whole; other shardings pass through."""
+    mesh = x.mesh
+    ax = axis % x.ndim
+    mapping = x.dims_mapping()
+    mapping[ax] = -1
+    spec = DistTensorSpec.from_dims_mapping(x.shape, mesh, mapping)
+    return [spec], [DistTensorSpec.from_dims_mapping(x.shape, mesh, mapping)]
+
+
+@register_spmd_rule("cross_entropy_with_softmax")
+def _ce_rule(logits: DistTensorSpec, label: DistTensorSpec, **attrs):
+    """Reference: spmd_rules/cross_entropy_with_softmax.cc. Class-dim
+    sharding is allowed (ParallelCrossEntropy) → loss Partial; otherwise
+    batch shardings pass through."""
+    mesh = logits.mesh
+    mapping = logits.dims_mapping()
+    class_mesh_dim = mapping[-1]
+    batch_mapping = mapping[:-1]
+    loss_shape = logits.shape[:-1] + [1]
+    loss = DistTensorSpec.from_dims_mapping(
+        loss_shape, mesh, batch_mapping + [-1]
+    )
+    if class_mesh_dim >= 0:
+        loss.placements[class_mesh_dim] = Partial("sum")
+    softmax_out = DistTensorSpec.from_dims_mapping(
+        logits.shape, mesh, mapping
+    )
+    return [logits, label], [softmax_out, loss]
+
+
+@register_spmd_rule("flash_attention")
+def _flash_attention_rule(q: DistTensorSpec, k: DistTensorSpec,
+                          v: DistTensorSpec, **attrs):
+    """Reference: spmd_rules/flash_attention.cc — shard batch and heads;
+    seq/head_dim replicated (ring attention handles seq sharding)."""
+    mesh = q.mesh
+    # dims: (batch, seq, heads, head_dim)
+    mq = q.dims_mapping()
+    mk = k.dims_mapping()
+    batch = mq[0] if mq[0] >= 0 else mk[0]
+    heads = mq[2] if mq[2] >= 0 else mk[2]
+    used = set()
+    mapping = [-1, -1, -1, -1]
+    if batch >= 0:
+        mapping[0] = batch
+        used.add(batch)
+    if heads >= 0 and heads not in used:
+        mapping[2] = heads
+    new = [DistTensorSpec.from_dims_mapping(s.shape, mesh, mapping)
+           for s in (q, k, v)]
+    out = DistTensorSpec.from_dims_mapping(q.shape, mesh, mapping)
+    return new, [out]
+
+
+@register_spmd_rule("concat")
+def _concat_rule(*specs, axis: int = 0, **attrs):
+    mesh = specs[0].mesh
+    ndim = specs[0].ndim
+    ax = axis % ndim
+    notation = _letters(ndim)
+    notation = notation[:ax] + "1" + notation[ax + 1:]
+    out_shape = list(specs[0].shape)
+    out_shape[ax] = sum(s.shape[ax] for s in specs)
+    return _einsum_like([notation] * len(specs), notation, list(specs),
+                        out_shape)
+
+
+@register_spmd_rule("split")
+def _split_rule(x: DistTensorSpec, num_or_sections=2, axis: int = 0, **attrs):
+    mesh = x.mesh
+    ax = axis % x.ndim
+    mapping = x.dims_mapping()
+    mapping[ax] = -1
+    n = num_or_sections if isinstance(num_or_sections, int) \
+        else len(num_or_sections)
+    sizes = [x.shape[ax] // n] * n if isinstance(num_or_sections, int) \
+        else list(num_or_sections)
+    outs = []
+    for s in sizes:
+        shape = list(x.shape)
+        shape[ax] = s
+        outs.append(DistTensorSpec.from_dims_mapping(shape, mesh, mapping))
+    return [DistTensorSpec.from_dims_mapping(x.shape, mesh, mapping)], outs

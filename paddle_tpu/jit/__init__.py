@@ -124,12 +124,14 @@ def _discover(fn, args, kwargs):
     from ..nn.layer import Layer
     from ..optimizer.optimizer import Optimizer
 
+    import types
+
     layers: List[Any] = []
     optimizers: List[Any] = []
     seen = set()
 
-    def visit(o):
-        if id(o) in seen:
+    def visit(o, depth=0):
+        if id(o) in seen or depth > 6:
             return
         seen.add(id(o))
         if isinstance(o, Layer):
@@ -138,7 +140,22 @@ def _discover(fn, args, kwargs):
             optimizers.append(o)
         elif isinstance(o, (list, tuple)):
             for x in o:
-                visit(x)
+                visit(x, depth + 1)
+        elif isinstance(o, dict):
+            for x in o.values():
+                visit(x, depth + 1)
+        elif isinstance(o, types.FunctionType):
+            # nested helper closures (e.g. a step fn calling a local
+            # forward fn that holds the model)
+            for c in _closure_objects(o):
+                visit(c, depth + 1)
+        elif hasattr(o, "__dict__") and not isinstance(
+            o, (Tensor, type, types.ModuleType)
+        ):
+            # plain containers (wrapper objects like DistModel) — scan
+            # their attributes for Layers/Optimizers
+            for x in vars(o).values():
+                visit(x, depth + 1)
 
     for o in _closure_objects(fn):
         visit(o)

@@ -1,0 +1,261 @@
+"""Semi-auto parallel API tests: SPMD rules, DistModel/to_static,
+shard_dataloader, Strategy, Engine.
+
+Reference behaviors: test/auto_parallel/spmd_rules/* (rule propagation),
+test/auto_parallel/semi_auto_parallel_* (DistModel train/eval/predict).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed.auto_parallel import (
+    DistTensorSpec, Engine, Strategy, get_spmd_rule,
+)
+from paddle_tpu.distributed.auto_parallel.placement import (
+    Partial, Replicate, Shard,
+)
+
+
+def _mesh2d():
+    return dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+
+
+class TestSpmdRules:
+    def test_matmul_contracted_dim_partial(self):
+        mesh = _mesh2d()
+        # x: [batch=8, k=16] sharded k over mp; y: [16, 32] sharded k too
+        x = DistTensorSpec([8, 16], mesh, [Replicate(), Shard(1)])
+        y = DistTensorSpec([16, 32], mesh, [Replicate(), Shard(0)])
+        rule = get_spmd_rule("matmul")
+        new_in, outs = rule.infer_forward(x, y)
+        out = outs[0]
+        assert out.shape == [8, 32]
+        # contracted k sharded on mp → output Partial over mp
+        assert isinstance(out.placements[1], Partial)
+
+    def test_matmul_row_col(self):
+        mesh = _mesh2d()
+        x = DistTensorSpec([8, 16], mesh, [Shard(0), Replicate()])
+        y = DistTensorSpec([16, 32], mesh, [Replicate(), Shard(1)])
+        rule = get_spmd_rule("matmul")
+        _, outs = rule.infer_forward(x, y)
+        out = outs[0]
+        # batch rows sharded on dp, cols on mp
+        assert out.placements[0] == Shard(0)
+        assert out.placements[1] == Shard(1)
+
+    def test_elementwise_broadcast(self):
+        mesh = _mesh2d()
+        x = DistTensorSpec([8, 1, 32], mesh, [Shard(0), Replicate()])
+        b = DistTensorSpec([32], mesh, [Replicate(), Replicate()])
+        rule = get_spmd_rule("elementwise")
+        new_in, outs = rule.infer_forward(x, b)
+        assert outs[0].shape == [8, 1, 32]
+        assert outs[0].placements[0] == Shard(0)
+
+    def test_reduction_partial(self):
+        mesh = _mesh2d()
+        x = DistTensorSpec([8, 32], mesh, [Shard(0), Shard(1)])
+        rule = get_spmd_rule("reduction")
+        _, outs = rule.infer_forward(x, axis=1)
+        out = outs[0]
+        assert out.shape == [8]
+        assert out.placements[0] == Shard(0)
+        assert isinstance(out.placements[1], Partial)
+
+    def test_reduction_keepdim(self):
+        mesh = _mesh2d()
+        x = DistTensorSpec([8, 32], mesh, [Shard(0), Replicate()])
+        _, outs = get_spmd_rule("reduction").infer_forward(
+            x, axis=1, keepdim=True
+        )
+        assert outs[0].shape == [8, 1]
+        assert outs[0].placements[0] == Shard(0)
+
+    def test_layer_norm_frees_normalized_dims(self):
+        mesh = _mesh2d()
+        x = DistTensorSpec([8, 16, 64], mesh, [Shard(0), Shard(2)])
+        rule = get_spmd_rule("layer_norm")
+        new_in, outs = rule.infer_forward(x, begin_norm_axis=2)
+        assert outs[0].placements[0] == Shard(0)
+        assert outs[0].placements[1] == Replicate()  # norm dim unsharded
+        assert new_in[0].placements[1] == Replicate()
+
+    def test_embedding_vocab_parallel(self):
+        mesh = _mesh2d()
+        w = DistTensorSpec([1000, 64], mesh, [Replicate(), Shard(0)])
+        ids = DistTensorSpec([8, 16], mesh, [Shard(0), Replicate()])
+        _, outs = get_spmd_rule("embedding").infer_forward(w, ids)
+        out = outs[0]
+        assert out.shape == [8, 16, 64]
+        assert out.placements[0] == Shard(0)
+        assert isinstance(out.placements[1], Partial)  # vocab-parallel
+
+    def test_transpose(self):
+        mesh = _mesh2d()
+        x = DistTensorSpec([8, 16, 32], mesh, [Shard(0), Shard(2)])
+        _, outs = get_spmd_rule("transpose").infer_forward(
+            x, perm=[2, 0, 1]
+        )
+        assert outs[0].shape == [32, 8, 16]
+        assert outs[0].placements[0] == Shard(1)
+        assert outs[0].placements[1] == Shard(0)
+
+    def test_flash_attention(self):
+        mesh = _mesh2d()
+        q = DistTensorSpec([4, 128, 8, 64], mesh, [Shard(0), Shard(2)])
+        k = DistTensorSpec([4, 128, 8, 64], mesh, [Shard(0), Shard(2)])
+        v = DistTensorSpec([4, 128, 8, 64], mesh, [Shard(0), Shard(2)])
+        new_in, outs = get_spmd_rule("flash_attention").infer_forward(
+            q, k, v
+        )
+        assert outs[0].placements[0] == Shard(0)
+        assert outs[0].placements[1] == Shard(2)
+
+    def test_default_rule_for_unknown_op(self):
+        mesh = _mesh2d()
+        x = DistTensorSpec([8], mesh, [Shard(0), Replicate()])
+        rule = get_spmd_rule("totally_unknown_op")
+        new_in, _ = rule.infer_forward(x)
+        assert all(isinstance(p, Replicate) for p in new_in[0].placements)
+
+    def test_cross_entropy_class_parallel(self):
+        mesh = _mesh2d()
+        logits = DistTensorSpec([8, 1000], mesh, [Shard(0), Shard(1)])
+        label = DistTensorSpec([8, 1], mesh, [Shard(0), Replicate()])
+        _, outs = get_spmd_rule(
+            "cross_entropy_with_softmax"
+        ).infer_forward(logits, label)
+        softmax_out, loss = outs
+        assert loss.placements[0] == Shard(0)
+        assert isinstance(loss.placements[1], Partial)
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 64)
+        self.fc2 = nn.Linear(64, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _loss_fn(out, label):
+    return ((out - label) ** 2).mean()
+
+
+def _batch(rng, n=8):
+    return (
+        paddle.to_tensor(rng.standard_normal((n, 16)).astype("float32")),
+        paddle.to_tensor(rng.standard_normal((n, 4)).astype("float32")),
+    )
+
+
+class TestDistModel:
+    def test_train_eval_predict_modes(self):
+        paddle.seed(0)
+        model = _MLP()
+        mesh = dist.ProcessMesh(np.arange(8), ["dp"])
+        for p in model.parameters():
+            dist.shard_tensor(p, mesh, [dist.Replicate()])
+        optimizer = opt.AdamW(learning_rate=0.01,
+                              parameters=model.parameters())
+        dm = dist.to_static(model, loss=_loss_fn, optimizer=optimizer)
+        assert dm.mode == "train"
+        rng = np.random.default_rng(0)
+        x, y = _batch(rng)
+        l1 = float(dm(x, y))
+        l2 = float(dm(x, y))
+        assert l2 < l1  # training decreases loss on a fixed batch
+
+        dm.eval()
+        le = float(dm(x, y))
+        assert np.isfinite(le)
+
+        dm.predict()
+        out = dm(x)
+        assert list(out.shape) == [8, 4]
+
+    def test_state_dict_roundtrip(self):
+        paddle.seed(1)
+        model = _MLP()
+        optimizer = opt.AdamW(learning_rate=0.01,
+                              parameters=model.parameters())
+        dm = dist.to_static(model, loss=_loss_fn, optimizer=optimizer)
+        state = dm.state_dict("param")
+        fresh = _MLP()
+        dm2 = dist.to_static(fresh, loss=_loss_fn, optimizer=opt.AdamW(
+            learning_rate=0.01, parameters=fresh.parameters()))
+        dm2.set_state_dict(state)
+        for p, q in zip(model.parameters(), fresh.parameters()):
+            np.testing.assert_allclose(
+                np.asarray(p._value), np.asarray(q._value)
+            )
+
+    def test_strategy_sharding_applied(self):
+        paddle.seed(2)
+        model = _MLP()
+        mesh = dist.ProcessMesh(np.arange(8), ["dp"])
+        for p in model.parameters():
+            dist.shard_tensor(p, mesh, [dist.Replicate()])
+        optimizer = opt.AdamW(learning_rate=0.01,
+                              parameters=model.parameters())
+        strategy = dist.Strategy()
+        strategy.sharding.enable = True
+        strategy.sharding.stage = 1
+        dm = dist.to_static(model, loss=_loss_fn, optimizer=optimizer,
+                            strategy=strategy)
+        rng = np.random.default_rng(1)
+        x, y = _batch(rng)
+        float(dm(x, y))
+        sharded = 0
+        for store in optimizer._accumulators.values():
+            for arr in store.values():
+                spec = getattr(arr.sharding, "spec", None)
+                if spec and len(spec) > 0 and spec[0] == "dp":
+                    sharded += 1
+        assert sharded > 0
+
+
+class TestShardDataloader:
+    def test_batches_sharded_on_dp(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+
+        mesh = dist.ProcessMesh(np.arange(8), ["dp"])
+        xs = paddle.to_tensor(np.random.rand(32, 16).astype("float32"))
+        ys = paddle.to_tensor(np.random.rand(32, 4).astype("float32"))
+        loader = DataLoader(TensorDataset([xs, ys]), batch_size=8)
+        sharded = dist.shard_dataloader(loader, mesh, shard_dims="dp")
+        assert len(sharded) == 4
+        for x, y in sharded:
+            assert x._dist_attr is not None
+            m, placements = x._dist_attr
+            assert placements[0] == dist.Shard(0)
+            spec = getattr(x._value.sharding, "spec", None)
+            assert spec is not None and spec[0] == "dp"
+
+
+class TestEngine:
+    def test_fit_evaluate_predict(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+
+        paddle.seed(3)
+        model = _MLP()
+        optimizer = opt.AdamW(learning_rate=0.02,
+                              parameters=model.parameters())
+        engine = Engine(model, loss=_loss_fn, optimizer=optimizer)
+        xs = paddle.to_tensor(np.random.rand(32, 16).astype("float32"))
+        ys = paddle.to_tensor(np.random.rand(32, 4).astype("float32"))
+        ds = TensorDataset([xs, ys])
+        loader = DataLoader(ds, batch_size=8)
+        history = engine.fit(loader, epochs=2, verbose=0)
+        assert len(history["loss"]) == 2
+        assert history["loss"][1] < history["loss"][0]
+        result = engine.evaluate(loader, verbose=0)
+        assert np.isfinite(result["loss"])
+        outs = engine.predict(loader)
+        assert len(outs) == 4
