@@ -32,6 +32,9 @@ from . import auto_parallel
 from . import checkpoint
 from . import sharding
 from .sharding import group_sharded_parallel, save_group_sharded_model
+from . import elastic
+from .store import InMemoryStore, Store, TCPStore, create_store
+from .env import get_store
 from .launch_utils import spawn, launch
 
 # paddle.distributed.parallel compat namespace

@@ -1,0 +1,152 @@
+"""Elastic training manager.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py
+(ElasticManager :124 — registers nodes in etcd :217-238, watches
+membership, scales np within --np min:max, relaunches the job with
+re-ranked endpoints via LauncherInterface :56; status enum ElasticStatus
+:48) and the CLI glue distributed/elastic.py.
+
+TPU re-design: etcd is replaced by the native TCPStore (the rendezvous
+service the launcher already runs); membership is a heartbeat key per
+node with a TTL the manager enforces by timestamp. Recovery stays
+"relaunch + checkpoint-resume", same as the reference (§5.3): no
+in-process peer repair is attempted.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from .store import Store
+
+__all__ = ["ElasticStatus", "ElasticManager"]
+
+
+class ElasticStatus:
+    """Reference: manager.py:48."""
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Membership + scale watcher over the rendezvous store.
+
+    np_range "min:max" (reference --np syntax). The manager:
+    - heartbeats this node's liveness key,
+    - watches the member set,
+    - reports RESTART when membership changed but stays within range
+      (job relaunches with re-ranked nodes),
+    - reports HOLD while below min (waiting for nodes),
+    - reports COMPLETED/ERROR from the job's own exit.
+    """
+
+    def __init__(self, store: Store, node_id: str, np_range: str = "1:1",
+                 job_id: str = "default", heartbeat_interval_s: float = 2.0,
+                 dead_after_s: float = 10.0):
+        self.store = store
+        self.node_id = node_id
+        self.job_id = job_id
+        lo, _, hi = np_range.partition(":")
+        self.np_min = int(lo)
+        self.np_max = int(hi or lo)
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.dead_after_s = dead_after_s
+        self._registered = False
+        self._last_members: Optional[List[str]] = None
+
+    # -- membership (reference: manager.py:217-238 etcd registration) ----
+    def _members_key(self):
+        return f"elastic/{self.job_id}/members"
+
+    def _node_key(self, node_id: str):
+        return f"elastic/{self.job_id}/node/{node_id}"
+
+    def register(self):
+        """Join the member set and start counting as alive."""
+        members = self._read_members()
+        if self.node_id not in members:
+            members.append(self.node_id)
+            self.store.set(self._members_key(), ",".join(sorted(members)))
+        self.heartbeat()
+        self._registered = True
+
+    def deregister(self):
+        members = [m for m in self._read_members() if m != self.node_id]
+        self.store.set(self._members_key(), ",".join(sorted(members)))
+        self._registered = False
+
+    def heartbeat(self):
+        self.store.set(self._node_key(self.node_id), str(time.time()))
+
+    def _read_members(self) -> List[str]:
+        try:
+            # non-blocking: an absent member list means an empty job
+            raw = self.store.get(self._members_key(), timeout_s=0)
+        except Exception:
+            return []
+        return [m for m in raw.decode().split(",") if m]
+
+    def alive_members(self) -> List[str]:
+        """Members whose heartbeat is fresher than dead_after_s."""
+        now = time.time()
+        alive = []
+        for m in self._read_members():
+            try:
+                ts = float(self.store.get(self._node_key(m), timeout_s=0))
+            except Exception:
+                continue
+            if now - ts <= self.dead_after_s:
+                alive.append(m)
+        return alive
+
+    # -- scale decisions (reference: manager.py watch loop) --------------
+    def check_scale(self) -> str:
+        """One watch-loop tick: HOLD below min, RESTART on membership
+        change within range, ERROR above max (misconfiguration)."""
+        alive = self.alive_members()
+        n = len(alive)
+        if n < self.np_min:
+            return ElasticStatus.HOLD
+        if n > self.np_max:
+            return ElasticStatus.ERROR
+        if self._last_members is None:
+            self._last_members = sorted(alive)
+            return "ok"
+        if sorted(alive) != self._last_members:
+            self._last_members = sorted(alive)
+            return ElasticStatus.RESTART
+        return "ok"
+
+    def rerank(self) -> Dict[str, int]:
+        """New node_id → rank map after a membership change (the
+        reference re-writes trainer endpoints the same way)."""
+        return {m: i for i, m in enumerate(sorted(self.alive_members()))}
+
+    # -- supervised run (reference: LauncherInterface :56) ---------------
+    def watch(self, launcher_fn: Callable[[Dict[str, int]], int],
+              poll_interval_s: float = 1.0,
+              max_relaunches: int = 10) -> str:
+        """Run launcher_fn under elastic supervision. launcher_fn receives
+        the current rank map and returns the job's exit code; the manager
+        relaunches on membership change until the job completes."""
+        relaunches = 0
+        while True:
+            self.heartbeat()
+            status = self.check_scale()
+            if status == ElasticStatus.HOLD:
+                time.sleep(poll_interval_s)
+                continue
+            if status == ElasticStatus.ERROR:
+                return ElasticStatus.ERROR
+            rc = launcher_fn(self.rerank())
+            if rc == 0:
+                return ElasticStatus.COMPLETED
+            relaunches += 1
+            if relaunches > max_relaunches:
+                return ElasticStatus.ERROR
+            # refresh membership before relaunching
+            self._last_members = None
+            time.sleep(poll_interval_s)

@@ -59,15 +59,28 @@ def is_initialized() -> bool:
     return _initialized
 
 
+_store = None
+_barrier_epoch = 0
+
+
+def get_store():
+    """The process's rendezvous store (reference: the global TCPStore made
+    at parallel.py:1134). None before init_parallel_env."""
+    return _store
+
+
 def init_parallel_env(strategy=None):
     """paddle.distributed.init_parallel_env parity (parallel.py:977).
 
-    Single host: no-op beyond validating devices. Multi-host: reads the
-    master endpoint from env (PADDLE_MASTER / MASTER_ADDR:MASTER_PORT) and
-    calls jax.distributed.initialize — the TCPStore + comm-context bring-up
-    collapse into the JAX coordination service over DCN.
+    Single host: no-op beyond validating devices. Multi-host: (1) build the
+    TCPStore rendezvous (rank 0 hosts the server — parallel.py:1134), (2)
+    register this rank and wait for the full world, (3) on TPU backends,
+    call jax.distributed.initialize (coordinator on master port+1) — the
+    comm-context bring-up collapses into the JAX coordination service over
+    DCN. On CPU rigs the store IS the rendezvous and jax stays
+    single-process (the reference's gloo-only path).
     """
-    global _initialized
+    global _initialized, _store
     if _initialized:
         return _default_group()
     nprocs = _env_int("PADDLE_TRAINERS_NUM", "WORLD_SIZE", default=1)
@@ -77,9 +90,31 @@ def init_parallel_env(strategy=None):
         if master and port and ":" not in master:
             master = f"{master}:{port}"
         rank = _env_int("PADDLE_TRAINER_ID", "RANK", default=0)
-        jax.distributed.initialize(
-            coordinator_address=master, num_processes=nprocs, process_id=rank
+
+        from .store import create_store
+
+        _store = create_store(master, rank, nprocs)
+        # rendezvous: every rank checks in; everyone waits for the world
+        _store.set(f"worker/{rank}", str(os.getpid()))
+        _store.add("worker_count", 1)
+        _store.wait([f"worker/{r}" for r in range(nprocs)])
+
+        use_jax = os.environ.get("PADDLE_USE_JAX_COORDINATOR", "auto")
+        # Decide WITHOUT querying devices: jax.distributed.initialize must
+        # run before any backend-initializing call (jax.devices etc.), so
+        # probe env vars only. TPU pods set TPU_WORKER_ID / megascale vars.
+        on_accel = use_jax == "1" or (
+            use_jax == "auto" and (
+                os.environ.get("TPU_WORKER_ID") is not None
+                or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS") is not None
+            )
         )
+        if on_accel and master:
+            host, p = master.rsplit(":", 1)
+            jax.distributed.initialize(
+                coordinator_address=f"{host}:{int(p) + 1}",
+                num_processes=nprocs, process_id=rank,
+            )
     _initialized = True
     return _default_group()
 
@@ -91,10 +126,23 @@ def _default_group():
 
 
 def barrier(group=None):
-    """paddle.distributed.barrier parity: a psum over all devices forces a
-    cross-host sync point."""
-    import jax.numpy as jnp
+    """paddle.distributed.barrier parity. Multi-process: counter rendezvous
+    through the store (reference: Barrier at process_group.h:167). On a
+    multi-host device runtime, also syncs global devices."""
+    global _barrier_epoch
+    nprocs = _env_int("PADDLE_TRAINERS_NUM", "WORLD_SIZE", default=1)
+    if _store is not None and nprocs > 1:
+        _barrier_epoch += 1
+        key = f"barrier/{_barrier_epoch}"
+        _store.add(key, 1)
+        deadline = 900
+        import time as _time
 
+        t0 = _time.time()
+        while int(_store.get(key)) < nprocs:
+            if _time.time() - t0 > deadline:
+                raise TimeoutError("barrier timed out")
+            _time.sleep(0.01)
     devs = jax.devices()
     if len(devs) <= 1:
         return

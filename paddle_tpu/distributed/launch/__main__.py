@@ -1,6 +1,9 @@
 """CLI: python -m paddle_tpu.distributed.launch train.py [args...]
 
-Reference: python/paddle/distributed/launch/__main__.py + main.py.
+Reference: python/paddle/distributed/launch/__main__.py + main.py
+(args parsed in launch/context/args_envs.py). TPU notes: --devices and
+--nproc_per_node are accepted for parity but one worker process drives
+all local chips (mesh-addressed), so per-chip fan-out args are no-ops.
 """
 import argparse
 import sys
@@ -10,17 +13,45 @@ from ..launch_utils import launch
 
 def main():
     p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
-    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="node count, or elastic range 'min:max'")
     p.add_argument("--node_rank", type=int, default=0)
-    p.add_argument("--master", type=str, default=None)
+    p.add_argument("--master", type=str, default=None,
+                   help="host:port of the rendezvous store")
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--max_restarts", type=int, default=0)
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--devices", "--gpus", type=str, default=None,
+                   help="accepted for parity; chips are mesh-addressed")
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="accepted for parity; one proc drives all chips")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     a = p.parse_args()
+
+    if ":" in a.nnodes:
+        # elastic mode: supervise relaunches within the np range
+        from ..elastic import ElasticManager
+        from ..store import create_store
+
+        lo = int(a.nnodes.split(":")[0])
+        store = create_store(a.master, a.node_rank, max(lo, 2))
+        mgr = ElasticManager(store, node_id=str(a.node_rank),
+                             np_range=a.nnodes, job_id=a.job_id)
+        mgr.register()
+
+        def launcher_fn(rank_map):
+            rank = rank_map.get(str(a.node_rank), a.node_rank)
+            return launch(a.training_script, a.training_script_args,
+                          len(rank_map), rank, a.master, a.log_dir,
+                          a.max_restarts, a.job_id)
+
+        status = mgr.watch(launcher_fn)
+        sys.exit(0 if status == "completed" else 1)
+
     sys.exit(
-        launch(a.training_script, a.training_script_args, a.nnodes, a.node_rank,
-               a.master, a.log_dir, a.max_restarts)
+        launch(a.training_script, a.training_script_args, int(a.nnodes),
+               a.node_rank, a.master, a.log_dir, a.max_restarts, a.job_id)
     )
 
 

@@ -1,18 +1,22 @@
 """Launcher: paddle.distributed.launch / spawn.
 
-Reference: python/paddle/distributed/launch/ (main.py CLI,
-controllers/collective.py — one process per GPU, env wiring, watch loop).
+Reference: python/paddle/distributed/launch/ — main.py CLI,
+controllers/collective.py (builds a Pod of per-device Containers, wires
+PADDLE_TRAINER_ID/endpoints env, master KV for multi-node rendezvous via
+controllers/master.py, watches and restarts procs via
+controllers/watcher.py).
 
-TPU re-design: one worker process per HOST (all local chips belong to the
-process); the launcher wires PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM/
-PADDLE_MASTER and restarts failed workers. Single-host multi-chip needs no
-spawning at all — the mesh covers local devices — so `spawn(nprocs=1)` and
-`launch` on one node simply exec the entry.
+TPU re-design: one worker process per HOST — all local chips belong to
+that process and parallelism is mesh-addressed, so a "Pod" holds exactly
+one Container (per-chip process fan-out is a CUDA-ism). Multi-node
+rendezvous rides the native TCPStore (csrc/ptpu_tcp_store.cc); the node-0
+launcher hosts the store server, every node's launcher registers, and the
+watch loop restarts failed workers up to max_restarts (elastic relaunch
+lives in distributed.elastic).
 """
 from __future__ import annotations
 
 import os
-import runpy
 import subprocess
 import sys
 import time
@@ -37,12 +41,16 @@ def spawn(func: Callable, args=(), nprocs: int = 1, join: bool = True,
     func(*args)
 
 
-class _Worker:
-    def __init__(self, cmd: List[str], env_vars: dict, log_path: Optional[str]):
+class Container:
+    """One worker OS process (reference: launch/job/container.py)."""
+
+    def __init__(self, cmd: List[str], env_vars: dict,
+                 log_path: Optional[str]):
         self.cmd = cmd
         self.env_vars = env_vars
         self.log_path = log_path
         self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
 
     def start(self):
         out = open(self.log_path, "ab") if self.log_path else None
@@ -51,31 +59,141 @@ class _Worker:
             stderr=subprocess.STDOUT if out else None,
         )
 
+    def poll(self):
+        return None if self.proc is None else self.proc.poll()
+
+    def wait(self, timeout=None):
+        return self.proc.wait(timeout)
+
+    def terminate(self):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+class Pod:
+    """This node's set of containers — exactly one on TPU
+    (reference: launch/job/pod.py)."""
+
+    def __init__(self, container: Container):
+        self.containers = [container]
+
+    def deploy(self):
+        for c in self.containers:
+            c.start()
+
+    def join(self):
+        return max(c.wait() for c in self.containers)
+
+    def stop(self):
+        for c in self.containers:
+            c.terminate()
+
+
+class CollectiveController:
+    """Reference: launch/controllers/collective.py. Builds the pod env,
+    runs the master rendezvous, deploys, and watches."""
+
+    def __init__(self, training_script: str, args: List[str],
+                 nnodes: int = 1, node_rank: int = 0,
+                 master: Optional[str] = None, log_dir: str = "log",
+                 max_restarts: int = 0, job_id: str = "default"):
+        self.training_script = training_script
+        self.args = list(args)
+        self.nnodes = nnodes
+        self.node_rank = node_rank
+        self.master = master
+        self.log_dir = log_dir
+        self.max_restarts = max_restarts
+        self.job_id = job_id
+        self._store = None
+
+    # -- rendezvous (reference: controllers/master.py) -------------------
+    def _rendezvous(self):
+        if self.nnodes <= 1 or self.master is None:
+            return
+        from .store import create_store
+
+        self._store = create_store(
+            self.master, self.node_rank, self.nnodes
+        )
+        self._store.set(
+            f"launch/{self.job_id}/node/{self.node_rank}",
+            f"{os.getpid()}"
+        )
+        self._store.wait(
+            [f"launch/{self.job_id}/node/{r}" for r in range(self.nnodes)]
+        )
+
+    def _build_pod(self) -> Pod:
+        env_vars = {
+            "PADDLE_TRAINERS_NUM": str(self.nnodes),
+            "PADDLE_TRAINER_ID": str(self.node_rank),
+            "PADDLE_JOB_ID": self.job_id,
+        }
+        if self.master:
+            # the launcher's own store owns `port`; trainers rendezvous on
+            # port+2 (port+1 is the jax coordinator — see env.py), mirroring
+            # the reference's separate launcher-KV vs trainer-TCPStore
+            host, port = self.master.rsplit(":", 1)
+            env_vars["PADDLE_MASTER"] = f"{host}:{int(port) + 2}"
+        os.makedirs(self.log_dir, exist_ok=True)
+        cmd = [sys.executable, self.training_script] + self.args
+        log = os.path.join(self.log_dir, f"workerlog.{self.node_rank}")
+        return Pod(Container(cmd, env_vars, log))
+
+    # -- watch loop (reference: controllers/watcher.py) ------------------
+    def run(self) -> int:
+        self._rendezvous()
+        pod = self._build_pod()
+        pod.deploy()
+        container = pod.containers[0]
+        while True:
+            rc = container.wait()
+            if rc == 0:
+                self._finalize(0)
+                return 0
+            container.restarts += 1
+            if container.restarts > self.max_restarts:
+                self._finalize(rc)
+                return rc
+            # brief backoff, then restart the worker in place
+            time.sleep(1)
+            if self._store is not None:
+                self._store.add(f"launch/{self.job_id}/restarts", 1)
+            container.start()
+
+    def _finalize(self, rc: int):
+        if self._store is None:
+            return
+        try:
+            self._store.set(
+                f"launch/{self.job_id}/done/{self.node_rank}", str(rc)
+            )
+            if self.node_rank == 0:
+                # the master hosts the store server: keep it alive until
+                # every node reported done (or a grace timeout), else peers
+                # lose their rendezvous mid-shutdown
+                self._store.wait(
+                    [f"launch/{self.job_id}/done/{r}"
+                     for r in range(self.nnodes)],
+                    timeout_s=60,
+                )
+        except Exception:
+            pass  # best-effort: a vanished master must not fail the job
+        finally:
+            self._store.close()
+
 
 def launch(training_script: str, args: List[str], nnodes: int = 1,
            node_rank: int = 0, master: Optional[str] = None,
-           log_dir: str = "log", max_restarts: int = 0):
-    """Programmatic launcher (CLI in paddle_tpu/distributed/launch/__main__.py).
-
-    Single node: exec inline. Multi node: set the coordination env and exec —
-    actual remote process placement belongs to the cluster scheduler, as in
-    the reference's non-elastic path."""
-    env_vars = {
-        "PADDLE_TRAINERS_NUM": str(nnodes),
-        "PADDLE_TRAINER_ID": str(node_rank),
-    }
-    if master:
-        env_vars["PADDLE_MASTER"] = master
-    os.makedirs(log_dir, exist_ok=True)
-    cmd = [sys.executable, training_script] + list(args)
-    restarts = 0
-    while True:
-        w = _Worker(cmd, env_vars, os.path.join(log_dir, f"workerlog.{node_rank}"))
-        w.start()
-        rc = w.proc.wait()
-        if rc == 0:
-            return 0
-        restarts += 1
-        if restarts > max_restarts:
-            return rc
-        time.sleep(1)
+           log_dir: str = "log", max_restarts: int = 0,
+           job_id: str = "default"):
+    """Programmatic launcher (CLI in paddle_tpu/distributed/launch/__main__.py)."""
+    return CollectiveController(
+        training_script, args, nnodes, node_rank, master, log_dir,
+        max_restarts, job_id,
+    ).run()

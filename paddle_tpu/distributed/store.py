@@ -1,0 +1,132 @@
+"""Rendezvous store.
+
+Reference: paddle/phi/core/distributed/store/store.h:24 (Store base),
+tcp_store.h:121 (TCPStore master/client), used by init_parallel_env at
+python/paddle/distributed/parallel.py:1134 to exchange bootstrap info.
+
+The server/client are native C++ (csrc/ptpu_tcp_store.cc) bound via
+ctypes; a pure-Python in-process store backs single-process runs and
+environments without the native lib.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["Store", "InMemoryStore", "TCPStore", "create_store"]
+
+
+class Store:
+    """Abstract KV store with blocking get/wait + atomic add."""
+
+    def set(self, key: str, value) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str, timeout_s: Optional[float] = None) -> bytes:
+        raise NotImplementedError
+
+    def add(self, key: str, delta: int = 1) -> int:
+        raise NotImplementedError
+
+    def wait(self, keys, timeout_s: Optional[float] = None) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStore(Store):
+    """Single-process fallback (and unit-test double)."""
+
+    def __init__(self):
+        self._data: Dict[str, bytes] = {}
+        self._cv = threading.Condition()
+
+    def set(self, key, value):
+        data = value if isinstance(value, bytes) else str(value).encode()
+        with self._cv:
+            self._data[key] = data
+            self._cv.notify_all()
+
+    def get(self, key, timeout_s=None):
+        deadline = None if timeout_s is None else time.time() + timeout_s
+        with self._cv:
+            while key not in self._data:
+                remaining = None if deadline is None \
+                    else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(f"get({key!r}) timed out")
+                self._cv.wait(remaining)
+            return self._data[key]
+
+    def add(self, key, delta=1):
+        with self._cv:
+            cur = int(self._data.get(key, b"0"))
+            cur += delta
+            self._data[key] = str(cur).encode()
+            self._cv.notify_all()
+            return cur
+
+    def wait(self, keys, timeout_s=None):
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            self.get(k, timeout_s)
+
+
+class TCPStore(Store):
+    """Native TCPStore (reference: tcp_store.h:121 semantics)."""
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: Optional[int] = None,
+                 timeout_s: float = 900.0):
+        from .. import native
+
+        self._impl = native.TCPStore(
+            host, port, is_master=is_master, timeout_s=timeout_s
+        )
+        self.host = host
+        self.port = self._impl.port
+        self.is_master = is_master
+        self.world_size = world_size
+
+    def set(self, key, value):
+        self._impl.set(key, value)
+
+    def get(self, key, timeout_s=None):
+        return self._impl.get(key, timeout_s)
+
+    def add(self, key, delta=1):
+        return self._impl.add(key, delta)
+
+    def wait(self, keys, timeout_s=None):
+        self._impl.wait(keys, timeout_s)
+
+    def close(self):
+        self._impl.close()
+
+
+def create_store(master: Optional[str] = None, rank: int = 0,
+                 world_size: int = 1, timeout_s: float = 900.0) -> Store:
+    """Build the process's rendezvous store.
+
+    master format "host:port" (PADDLE_MASTER). Rank 0 hosts the server
+    in-process, exactly like the reference's is_master=rank==0 TCPStore
+    (parallel.py:1134). Falls back to InMemoryStore for world_size==1 or
+    when the native lib is unavailable.
+    """
+    if master is None or world_size <= 1:
+        return InMemoryStore()
+    try:
+        from .. import native
+
+        if not native.is_available():
+            return InMemoryStore()
+    except Exception:
+        return InMemoryStore()
+    host, port = master.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size, timeout_s=timeout_s)
+    return store
