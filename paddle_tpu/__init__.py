@@ -49,6 +49,7 @@ from . import io
 from . import amp
 from . import jit
 from . import static
+from . import inference
 from . import metric
 from . import device
 from . import incubate

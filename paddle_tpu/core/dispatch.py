@@ -100,12 +100,41 @@ def _check_nan_inf(name: str, outs):
                 warnings.warn(msg)
 
 
+# --------------------------------------------------------------------------
+# Static-graph capture (reference: the PIR program-build path — Python ops
+# append pir::Operations instead of executing; SURVEY §3.3). When a
+# paddle.static.Program is being built, ops on placeholder values record
+# instructions instead of running; shapes propagate via jax.eval_shape.
+# --------------------------------------------------------------------------
+_capture_program = None
+
+
+def set_capture_program(prog):
+    global _capture_program
+    _capture_program = prog
+
+
+def capture_active() -> bool:
+    return _capture_program is not None
+
+
+def eval_shape(name: str, arrays, static):
+    prim = PRIMITIVES[name]
+    fn = functools.partial(prim.forward, **static)
+    return jax.eval_shape(fn, *arrays)
+
+
 def call_primitive(name: str, arrays: Sequence[Any], static: Dict[str, Any]):
     """Run a primitive's forward. Returns tuple of raw outputs.
 
     NaN/Inf watchdog (reference: fluid/eager/nan_inf_utils.cc behind
     FLAGS_check_nan_inf) only fires on concrete values, never on tracers.
     """
+    if _capture_program is not None and any(
+        isinstance(a, jax.ShapeDtypeStruct) for a in arrays
+    ):
+        outs = _capture_program.record(name, arrays, static)
+        return outs if isinstance(outs, tuple) else (outs,)
     prim = PRIMITIVES[name]
     if flags.get_flag("eager_op_jit") and prim.jittable:
         fn = _jitted_forward(name, _hashable(static))

@@ -329,7 +329,7 @@ def apply(prim_name: str, *tensors: Tensor, **static) -> Any:
     outs = dispatch.call_primitive(prim_name, arrays, static)
     requires = (not prim.nondiff) and engine.grad_enabled() and any(
         not t.stop_gradient for t in tensors
-    )
+    ) and not dispatch.capture_active()
     node = None
     if requires:
         saved = prim.save(arrays, outs) if prim.save else arrays

@@ -1,0 +1,145 @@
+"""Inference engine.
+
+Reference: paddle/fluid/inference/ — AnalysisPredictor
+(api/analysis_predictor.h: load a saved program, run the IR pass
+pipeline, execute), paddle.inference.Config + create_predictor
+(python/paddle/inference/).
+
+TPU re-design: the saved artifact is jit.save's payload (state_dict +
+serialized StableHLO from jax.export). "Analysis passes" are XLA — the
+deserialized executable is already optimized for the target; the
+predictor's job is name-based input/output plumbing, exactly the
+AnalysisPredictor surface.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .core.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+
+
+class Config:
+    """Reference: paddle.inference.Config — model path + device/runtime
+    knobs. TPU knobs map to XLA/jit; CUDA-specific toggles are accepted
+    and ignored for API parity."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._device = "tpu"
+        self._memory_pool_init_size_mb = 0
+        self._enable_profile = False
+        self._glog_info = False
+
+    def set_prog_file(self, path: str):
+        self._prog_file = path
+
+    def prog_file(self):
+        return self._prog_file
+
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        self._device = "tpu"  # accelerator path; XLA owns memory
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device != "cpu"
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def switch_ir_optim(self, on: bool = True):
+        pass  # XLA always optimizes
+
+    def enable_memory_optim(self):
+        pass
+
+    def summary(self) -> str:
+        return f"Config(prog_file={self._prog_file}, device={self._device})"
+
+
+class PredictorTensor:
+    """Name-addressed input/output handle (reference:
+    paddle.inference Tensor / ZeroCopyTensor)."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._owner._inputs[self.name] = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._owner._outputs[self.name])
+
+    def shape(self):
+        if self._is_input:
+            return list(self._owner._inputs[self.name].shape)
+        return list(np.asarray(self._owner._outputs[self.name]).shape)
+
+
+class Predictor:
+    """Reference: AnalysisPredictor. Loads a jit.save artifact and runs
+    the deserialized StableHLO executable."""
+
+    def __init__(self, config: Config):
+        from . import jit
+
+        self._config = config
+        self._loaded = jit.load(config.prog_file())
+        in_specs = self._loaded._payload.get("in_specs") or []
+        self._input_names = [f"x{i}" for i in range(len(in_specs))]
+        self._in_specs = in_specs
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+        self._output_names: List[str] = []
+
+    # -- AnalysisPredictor surface --------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        return PredictorTensor(name, self, is_input=True)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return PredictorTensor(name, self, is_input=False)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Either positional (returns outputs) or handle-based like the
+        reference's zero-copy flow."""
+        if inputs is not None:
+            arrays = [np.asarray(a) for a in inputs]
+        else:
+            arrays = [self._inputs[n] for n in self._input_names]
+        outs = self._loaded(*arrays)
+        if isinstance(outs, Tensor):
+            outs = [outs]
+        self._output_names = [f"out{i}" for i in range(len(outs))]
+        self._outputs = {
+            n: np.asarray(o._value) for n, o in zip(self._output_names, outs)
+        }
+        if inputs is not None:
+            return [self._outputs[n] for n in self._output_names]
+        return None
+
+    def state_dict(self):
+        return self._loaded.state_dict()
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Reference: paddle.inference.create_predictor."""
+    return Predictor(config)

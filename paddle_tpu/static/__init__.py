@@ -1,49 +1,51 @@
-"""paddle.static parity (thin).
+"""paddle.static parity.
 
-Reference: python/paddle/static/ — the reference's separate static-graph
-mode (Program/Executor) collapses into jit.to_static on this framework
-(SURVEY §7 design stance): InputSpec describes traced inputs, and the
-Executor/Program surface is kept as a compatibility veneer over compiled
-functions for code being ported.
+Reference: python/paddle/static/ — Program/Executor/program_guard/data
+(static graph build + run, SURVEY §3.3) plus InputSpec. The capture
+machinery lives in program.py; save/load_inference_model bridge to the
+jit.save StableHLO format consumed by paddle_tpu.inference.
 """
 from __future__ import annotations
 
+import contextlib
+
 from ..jit import InputSpec  # noqa: F401
+from .program import (  # noqa: F401
+    Executor, Program, data, default_main_program, default_startup_program,
+    program_guard,
+)
 
-
-class Program:
-    """Placeholder for ported code; real capture goes through jit.to_static."""
-
-    def __init__(self):
-        self._ops = []
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        return self
-
-
-def default_main_program():
-    return Program()
-
-
-def default_startup_program():
-    return Program()
-
-
-class Executor:
-    def __init__(self, place=None):
-        self.place = place
-
-    def run(self, program=None, feed=None, fetch_list=None, **kw):
-        raise NotImplementedError(
-            "static Executor is not part of the TPU framework; decorate the "
-            "model with paddle_tpu.jit.to_static instead (SURVEY §7)"
-        )
+__all__ = [
+    "InputSpec", "Program", "Executor", "data", "program_guard",
+    "default_main_program", "default_startup_program", "name_scope",
+    "save_inference_model", "load_inference_model",
+]
 
 
 def name_scope(name):
-    import contextlib
-
     return contextlib.nullcontext()
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
+                         executor=None, **kwargs):
+    """Reference: paddle.static.save_inference_model. The TPU framework's
+    inference artifact is the jit.save payload (params + StableHLO); pass
+    the source layer via kwargs['layer'] or export with paddle_tpu.jit.save
+    directly."""
+    layer = kwargs.get("layer")
+    if layer is None:
+        raise NotImplementedError(
+            "save_inference_model for raw static programs is not supported; "
+            "export the model with paddle_tpu.jit.save(layer, path, "
+            "input_spec=...) and serve it with paddle_tpu.inference"
+        )
+    from .. import jit
+
+    jit.save(layer, path_prefix, input_spec=feed_vars)
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    from .. import jit
+
+    fn = jit.load(path_prefix)
+    return fn, [], []

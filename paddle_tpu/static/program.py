@@ -1,0 +1,232 @@
+"""Static graph: Program capture + Executor replay.
+
+Reference: the PIR static mode (SURVEY §3.3) — Python builds a
+pir::Program under program_guard (ops append Operations instead of
+executing), then Executor.run lowers and interprets it
+(python/paddle/base/executor.py:1199, StandaloneExecutor at
+fluid/framework/new_executor/standalone_executor.h:34).
+
+TPU re-design: the "Program" records (primitive, inputs, attrs) triples as
+ops execute on placeholder values (shape propagation via jax.eval_shape —
+the InferMeta analog); Executor.run replays the instruction list as one
+jax function and jit-compiles it per feed signature — the
+pd_op_to_kernel_pass + PirInterpreter pipeline collapses into XLA.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..core import dispatch
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["Program", "program_guard", "data", "Executor",
+           "default_main_program", "default_startup_program"]
+
+
+class Program:
+    """Recorded instruction list (the pir::Program analog)."""
+
+    def __init__(self):
+        self._placeholders: List[Tuple[str, int, tuple, Any]] = []
+        self._consts: Dict[int, Any] = {}
+        self._insts: List[Tuple[str, Tuple[int, ...], tuple, Tuple[int, ...]]] = []
+        self._next_vid = 0
+        self._vid_by_obj: Dict[int, int] = {}  # id(value object) -> vid
+        self._keepalive: List[Any] = []  # pins captured objects: id() reuse
+        self._feed_names: Dict[str, int] = {}
+        self._cache: Dict[Any, Any] = {}
+
+    # -- recording -------------------------------------------------------
+    def _new_vid(self) -> int:
+        vid = self._next_vid
+        self._next_vid += 1
+        return vid
+
+    def _vid_for_input(self, value) -> int:
+        vid = self._vid_by_obj.get(id(value))
+        if vid is not None:
+            return vid
+        if isinstance(value, jax.ShapeDtypeStruct):
+            raise ValueError(
+                "placeholder value used outside its source program"
+            )
+        # concrete constant created during capture (e.g. paddle.ones)
+        vid = self._new_vid()
+        self._consts[vid] = value
+        self._vid_by_obj[id(value)] = vid
+        self._keepalive.append(value)
+        return vid
+
+    def add_placeholder(self, name: str, shape, dtype):
+        if name in self._feed_names:
+            raise ValueError(f"duplicate static.data name {name!r}")
+        # None (dynamic) dims captured as 1 for shape propagation; the real
+        # extent binds at Executor.run from the feed arrays
+        cap_shape = tuple(1 if s in (None, -1) else int(s) for s in shape)
+        spec = jax.ShapeDtypeStruct(cap_shape, convert_dtype(dtype))
+        vid = self._new_vid()
+        self._vid_by_obj[id(spec)] = vid
+        self._keepalive.append(spec)
+        self._placeholders.append((name, vid, tuple(shape), dtype))
+        self._feed_names[name] = vid
+        return spec
+
+    def record(self, prim_name: str, arrays, static) -> tuple:
+        """Called from dispatch.call_primitive in capture mode."""
+        in_vids = tuple(self._vid_for_input(a) for a in arrays)
+        outs = dispatch.eval_shape(prim_name, arrays, static)
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        out_vids = []
+        for o in outs:
+            vid = self._new_vid()
+            self._vid_by_obj[id(o)] = vid
+            self._keepalive.append(o)
+            out_vids.append(vid)
+        self._insts.append(
+            (prim_name, in_vids, tuple(sorted(static.items(),
+                                              key=lambda kv: kv[0])),
+             tuple(out_vids))
+        )
+        self._cache.clear()  # program changed; invalidate compiled replays
+        return outs
+
+    def vid_of(self, t: Tensor) -> int:
+        vid = self._vid_by_obj.get(id(t._value))
+        if vid is None:
+            raise ValueError(
+                "fetch target was not produced by this Program"
+            )
+        return vid
+
+    # -- parity surface --------------------------------------------------
+    def global_block(self):
+        return self
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program.__new__(Program)
+        p.__dict__.update(self.__dict__)
+        p._cache = {}
+        return p
+
+    @property
+    def num_ops(self) -> int:
+        return len(self._insts)
+
+    def __repr__(self):
+        lines = [f"Program({len(self._insts)} ops, "
+                 f"{len(self._placeholders)} feeds)"]
+        for name, in_vids, static, out_vids in self._insts:
+            lines.append(f"  %{out_vids} = {name}(%{in_vids})")
+        return "\n".join(lines)
+
+
+_default_main = Program()
+_default_startup = Program()
+_guard_stack: List[Program] = []
+
+
+def default_main_program() -> Program:
+    return _guard_stack[-1] if _guard_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class program_guard:
+    """Reference: paddle.static.program_guard — ops inside the block are
+    captured into `main_program` instead of executing."""
+
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        _guard_stack.append(self.main)
+        dispatch.set_capture_program(self.main)
+        return self.main
+
+    def __exit__(self, *exc):
+        _guard_stack.pop()
+        dispatch.set_capture_program(
+            _guard_stack[-1] if _guard_stack else None
+        )
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Reference: paddle.static.data — declare a feed placeholder."""
+    prog = default_main_program()
+    if not _guard_stack:
+        raise RuntimeError(
+            "static.data must be called under static.program_guard"
+        )
+    spec = prog.add_placeholder(name, shape, dtype)
+    t = Tensor._from_value(spec, stop_gradient=True)
+    t.name = name
+    return t
+
+
+class Executor:
+    """Reference: paddle.static.Executor (executor.py:1199) — replays the
+    captured instruction list as one jitted XLA program per feed
+    signature (the _ExecutorCache analog)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+            fetch_list: Optional[Sequence] = None, return_numpy: bool = True,
+            **kwargs):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        fetch_vids = tuple(
+            program.vid_of(t) if isinstance(t, Tensor) else int(t)
+            for t in fetch_list
+        )
+        feed_items = sorted(feed.items())
+        feed_names = tuple(k for k, _ in feed_items)
+        missing = {n for n, _, _, _ in program._placeholders} - set(feed_names)
+        if missing:
+            raise ValueError(f"missing feeds: {sorted(missing)}")
+        arrays = [np.asarray(v._value if isinstance(v, Tensor) else v)
+                  for _, v in feed_items]
+        key = (feed_names,
+               tuple((a.shape, str(a.dtype)) for a in arrays), fetch_vids)
+        fn = program._cache.get(key)
+        if fn is None:
+            fn = self._compile(program, feed_names, fetch_vids)
+            program._cache[key] = fn
+        outs = fn(*arrays)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor._from_value(o) for o in outs]
+
+    @staticmethod
+    def _compile(program: Program, feed_names, fetch_vids):
+        name_to_vid = program._feed_names
+
+        def replay(*feed_arrays):
+            env: Dict[int, Any] = dict(program._consts)
+            for n, a in zip(feed_names, feed_arrays):
+                env[name_to_vid[n]] = a
+            for prim_name, in_vids, static_items, out_vids in program._insts:
+                prim = dispatch.PRIMITIVES[prim_name]
+                outs = prim.forward(
+                    *[env[v] for v in in_vids], **dict(static_items)
+                )
+                outs = outs if isinstance(outs, tuple) else (outs,)
+                for v, o in zip(out_vids, outs):
+                    env[v] = o
+            return [env[v] for v in fetch_vids]
+
+        return jax.jit(replay)
+
+    def close(self):
+        pass

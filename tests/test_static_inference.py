@@ -1,0 +1,147 @@
+"""Static graph (Program/Executor) + inference Predictor tests.
+
+Reference behaviors: static program build-and-run (SURVEY §3.3, the
+exe.run(program) call stack) and the AnalysisPredictor load-and-run flow
+(fluid/inference/api/analysis_predictor.h).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+
+
+class TestStaticProgram:
+    def test_build_and_run(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            y = static.data("y", [None, 4], "float32")
+            z = (x * y).sum(axis=1)
+        assert main.num_ops >= 2
+        exe = static.Executor()
+        xv = np.random.rand(3, 4).astype("float32")
+        yv = np.random.rand(3, 4).astype("float32")
+        (out,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[z])
+        np.testing.assert_allclose(out, (xv * yv).sum(1), rtol=1e-6)
+
+    def test_dynamic_batch_dim(self):
+        """None dims bind at run time — different batch sizes recompile."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 8], "float32")
+            w = paddle.ones([8, 2])
+            out = paddle.matmul(x, w)
+        exe = static.Executor()
+        for bs in (2, 5):
+            xv = np.random.rand(bs, 8).astype("float32")
+            (res,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+            assert res.shape == (bs, 2)
+            np.testing.assert_allclose(res, xv @ np.ones((8, 2)), rtol=1e-5)
+
+    def test_constants_captured(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4], "float32")
+            c = paddle.to_tensor(np.arange(4, dtype="float32"))
+            out = x + c * 2.0
+        exe = static.Executor()
+        (res,) = exe.run(main, feed={"x": np.zeros(4, "float32")},
+                         fetch_list=[out])
+        np.testing.assert_allclose(res, np.arange(4) * 2.0)
+
+    def test_missing_feed_rejected(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4], "float32")
+            out = x + 1.0
+        with pytest.raises(ValueError, match="missing feeds"):
+            static.Executor().run(main, feed={}, fetch_list=[out])
+
+    def test_eager_unaffected_outside_guard(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            _ = x * 3.0
+        t = paddle.to_tensor(np.ones(2, "float32")) * 3.0
+        np.testing.assert_allclose(np.asarray(t._value), [3.0, 3.0])
+
+    def test_duplicate_data_name_rejected(self):
+        main = static.Program()
+        with static.program_guard(main):
+            static.data("x", [2], "float32")
+            with pytest.raises(ValueError, match="duplicate"):
+                static.data("x", [2], "float32")
+
+    def test_layer_forward_under_capture(self):
+        """An nn.Layer forward captures into the program (weights become
+        constants, like freezing a graph)."""
+        paddle.seed(5)
+        layer = nn.Linear(6, 3)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 6], "float32")
+            out = layer(x)
+        exe = static.Executor()
+        xv = np.random.rand(4, 6).astype("float32")
+        (res,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        expect = layer(paddle.to_tensor(xv))
+        np.testing.assert_allclose(
+            res, np.asarray(expect._value), rtol=1e-5
+        )
+
+
+class TestInferencePredictor:
+    def _export(self, tmp_path):
+        paddle.seed(11)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        model.eval()
+        path = str(tmp_path / "model")
+        paddle.jit.save(
+            model, path,
+            input_spec=[static.InputSpec([2, 8], "float32")],
+        )
+        return model, path
+
+    def test_predictor_run_positional(self, tmp_path):
+        model, path = self._export(tmp_path)
+        from paddle_tpu import inference
+
+        config = inference.Config(path)
+        predictor = inference.create_predictor(config)
+        x = np.random.rand(2, 8).astype("float32")
+        outs = predictor.run([x])
+        expect = model(paddle.to_tensor(x))
+        np.testing.assert_allclose(
+            outs[0], np.asarray(expect._value), rtol=1e-5
+        )
+
+    def test_predictor_handle_flow(self, tmp_path):
+        model, path = self._export(tmp_path)
+        from paddle_tpu import inference
+
+        predictor = inference.create_predictor(inference.Config(path))
+        names = predictor.get_input_names()
+        assert len(names) == 1
+        x = np.random.rand(2, 8).astype("float32")
+        predictor.get_input_handle(names[0]).copy_from_cpu(x)
+        predictor.run()
+        out_names = predictor.get_output_names()
+        assert len(out_names) == 1
+        out = predictor.get_output_handle(out_names[0]).copy_to_cpu()
+        expect = model(paddle.to_tensor(x))
+        np.testing.assert_allclose(
+            out, np.asarray(expect._value), rtol=1e-5
+        )
+
+    def test_load_inference_model(self, tmp_path):
+        model, path = self._export(tmp_path)
+        fn, _, _ = static.load_inference_model(path)
+        x = np.random.rand(2, 8).astype("float32")
+        out = fn(paddle.to_tensor(x))
+        out = out[0] if isinstance(out, list) else out
+        expect = model(paddle.to_tensor(x))
+        np.testing.assert_allclose(
+            np.asarray(out._value), np.asarray(expect._value), rtol=1e-5
+        )
