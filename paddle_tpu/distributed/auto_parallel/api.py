@@ -183,14 +183,26 @@ class ShardDataloader:
         return placements
 
     def _shard_item(self, item, mesh, shard_dim):
+        """shard_dim may itself be a list (positional) or dict (by key),
+        mirroring the reference's per-input shard_dims shapes."""
         if isinstance(item, Tensor):
+            if isinstance(shard_dim, (list, tuple, dict)):
+                shard_dim = None  # structure mismatch: replicate
             return shard_tensor(
                 item, mesh, self._placements(mesh, shard_dim)
             )
         if isinstance(item, dict):
+            if isinstance(shard_dim, dict):
+                return {k: self._shard_item(v, mesh, shard_dim.get(k))
+                        for k, v in item.items()}
             return {k: self._shard_item(v, mesh, shard_dim)
                     for k, v in item.items()}
         if isinstance(item, (list, tuple)):
+            if isinstance(shard_dim, (list, tuple)):
+                return type(item)(
+                    self._shard_item(v, mesh, d)
+                    for v, d in zip(item, shard_dim)
+                )
             return type(item)(
                 self._shard_item(v, mesh, shard_dim) for v in item
             )
@@ -198,17 +210,8 @@ class ShardDataloader:
 
     def __iter__(self):
         mesh = self._meshes[0]
-        shard_dim = self._shard_dims if not isinstance(
-            self._shard_dims, (list, tuple, dict)) else None
         for batch in self._loader:
-            if isinstance(self._shard_dims, (list, tuple)) and \
-                    isinstance(batch, (list, tuple)):
-                yield type(batch)(
-                    self._shard_item(item, mesh, dim)
-                    for item, dim in zip(batch, self._shard_dims)
-                )
-            else:
-                yield self._shard_item(batch, mesh, shard_dim)
+            yield self._shard_item(batch, mesh, self._shard_dims)
 
 
 def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
-from ...core.tensor import Tensor
 
 __all__ = ["DistModel", "to_static"]
 
@@ -127,15 +126,22 @@ class DistModel:
         if mode in ("all", "opt") and self._optimizer is not None:
             state.update(
                 {f"opt.{k}": v
-                 for k, v in self._optimizer.state_dict().items()
-                 if isinstance(v, (Tensor,)) or not isinstance(v, dict)}
+                 for k, v in self._optimizer.state_dict().items()}
             )
         return state
 
     def set_state_dict(self, state_dict):
-        net_state = {k: v for k, v in state_dict.items()
-                     if not k.startswith("opt.")}
-        self.network.set_state_dict(net_state)
+        net_state = {}
+        opt_state = {}
+        for k, v in state_dict.items():
+            if k.startswith("opt."):
+                opt_state[k[len("opt."):]] = v
+            else:
+                net_state[k] = v
+        if net_state:
+            self.network.set_state_dict(net_state)
+        if opt_state and self._optimizer is not None:
+            self._optimizer.set_state_dict(opt_state)
 
     def dist_main_program(self, mode=None):
         """Reference returns the partitioned PIR program; here the program

@@ -109,14 +109,35 @@ class Engine:
         dm = self._ensure_dist_model().predict()
         loader = self._wrap_loader(test_data, batch_size)
         outputs = []
+        fwd_arity = self._forward_arity()
         for step, batch in enumerate(loader):
             if steps is not None and step >= steps:
                 break
             args = self._as_args(batch)
-            if self._loss is not None and len(args) > 1:
-                args = args[:-1]  # drop labels for inference
+            # drop trailing labels only when the forward can't take them
+            # (a loss-configured loader usually yields (inputs..., labels))
+            if self._loss is not None and fwd_arity is not None and \
+                    len(args) > fwd_arity:
+                args = args[:fwd_arity]
             outputs.append(dm(*args))
         return outputs
+
+    def _forward_arity(self):
+        """Positional-arg count of model.forward, or None if varargs."""
+        import inspect
+
+        try:
+            sig = inspect.signature(self._model.forward)
+        except (TypeError, ValueError):
+            return None
+        count = 0
+        for p in sig.parameters.values():
+            if p.kind == inspect.Parameter.VAR_POSITIONAL:
+                return None
+            if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                          inspect.Parameter.POSITIONAL_OR_KEYWORD):
+                count += 1
+        return count
 
     # -- helpers --------------------------------------------------------
     def _wrap_loader(self, data, batch_size):

@@ -58,36 +58,58 @@ class ElasticManager:
         self._last_members: Optional[List[str]] = None
 
     # -- membership (reference: manager.py:217-238 etcd registration) ----
-    def _members_key(self):
-        return f"elastic/{self.job_id}/members"
+    # Membership is an atomic append log: register claims a slot index via
+    # the store's atomic add() and writes member/<idx> = node_id; readers
+    # scan member/0..seq. No read-modify-write of a shared list, so
+    # concurrent registrations (the normal elastic startup) cannot lose
+    # members. Deregistration tombstones the slot.
+    def _seq_key(self):
+        return f"elastic/{self.job_id}/member_seq"
+
+    def _slot_key(self, idx: int):
+        return f"elastic/{self.job_id}/member/{idx}"
 
     def _node_key(self, node_id: str):
         return f"elastic/{self.job_id}/node/{node_id}"
 
     def register(self):
         """Join the member set and start counting as alive."""
-        members = self._read_members()
-        if self.node_id not in members:
-            members.append(self.node_id)
-            self.store.set(self._members_key(), ",".join(sorted(members)))
+        if self.node_id not in self._read_members():
+            idx = self.store.add(self._seq_key(), 1) - 1
+            self.store.set(self._slot_key(idx), self.node_id)
         self.heartbeat()
         self._registered = True
 
     def deregister(self):
-        members = [m for m in self._read_members() if m != self.node_id]
-        self.store.set(self._members_key(), ",".join(sorted(members)))
+        for idx in range(self._seq_len()):
+            try:
+                raw = self.store.get(self._slot_key(idx), timeout_s=0)
+            except Exception:
+                continue
+            if raw.decode() == self.node_id:
+                self.store.set(self._slot_key(idx), "")  # tombstone
         self._registered = False
 
     def heartbeat(self):
         self.store.set(self._node_key(self.node_id), str(time.time()))
 
-    def _read_members(self) -> List[str]:
+    def _seq_len(self) -> int:
         try:
-            # non-blocking: an absent member list means an empty job
-            raw = self.store.get(self._members_key(), timeout_s=0)
+            return int(self.store.get(self._seq_key(), timeout_s=0))
         except Exception:
-            return []
-        return [m for m in raw.decode().split(",") if m]
+            return 0
+
+    def _read_members(self) -> List[str]:
+        members = []
+        for idx in range(self._seq_len()):
+            try:
+                raw = self.store.get(self._slot_key(idx), timeout_s=0)
+            except Exception:
+                continue
+            name = raw.decode()
+            if name and name not in members:
+                members.append(name)
+        return members
 
     def alive_members(self) -> List[str]:
         """Members whose heartbeat is fresher than dead_after_s."""
@@ -131,22 +153,44 @@ class ElasticManager:
               max_relaunches: int = 10) -> str:
         """Run launcher_fn under elastic supervision. launcher_fn receives
         the current rank map and returns the job's exit code; the manager
-        relaunches on membership change until the job completes."""
-        relaunches = 0
-        while True:
-            self.heartbeat()
-            status = self.check_scale()
-            if status == ElasticStatus.HOLD:
+        relaunches on membership change until the job completes.
+
+        A background thread keeps heartbeating while launcher_fn blocks —
+        otherwise every node would look dead to its peers for the whole
+        job duration and a single worker failure would split-brain the
+        membership."""
+        import threading
+
+        stop = threading.Event()
+
+        def beat():
+            while not stop.is_set():
+                try:
+                    self.heartbeat()
+                except Exception:
+                    pass
+                stop.wait(self.heartbeat_interval_s)
+
+        beater = threading.Thread(target=beat, daemon=True)
+        beater.start()
+        try:
+            relaunches = 0
+            while True:
+                status = self.check_scale()
+                if status == ElasticStatus.HOLD:
+                    time.sleep(poll_interval_s)
+                    continue
+                if status == ElasticStatus.ERROR:
+                    return ElasticStatus.ERROR
+                rc = launcher_fn(self.rerank())
+                if rc == 0:
+                    return ElasticStatus.COMPLETED
+                relaunches += 1
+                if relaunches > max_relaunches:
+                    return ElasticStatus.ERROR
+                # refresh membership before relaunching
+                self._last_members = None
                 time.sleep(poll_interval_s)
-                continue
-            if status == ElasticStatus.ERROR:
-                return ElasticStatus.ERROR
-            rc = launcher_fn(self.rerank())
-            if rc == 0:
-                return ElasticStatus.COMPLETED
-            relaunches += 1
-            if relaunches > max_relaunches:
-                return ElasticStatus.ERROR
-            # refresh membership before relaunching
-            self._last_members = None
-            time.sleep(poll_interval_s)
+        finally:
+            stop.set()
+            beater.join(timeout=2)

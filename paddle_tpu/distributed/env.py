@@ -61,6 +61,7 @@ def is_initialized() -> bool:
 
 _store = None
 _barrier_epoch = 0
+_key_prefix = "g0/"
 
 
 def get_store():
@@ -94,10 +95,16 @@ def init_parallel_env(strategy=None):
         from .store import create_store
 
         _store = create_store(master, rank, nprocs)
-        # rendezvous: every rank checks in; everyone waits for the world
-        _store.set(f"worker/{rank}", str(os.getpid()))
-        _store.add("worker_count", 1)
-        _store.wait([f"worker/{r}" for r in range(nprocs)])
+        # rendezvous keys are namespaced by the restart generation the
+        # launcher hands down (PADDLE_RESTART_GEN): a restarted worker must
+        # not satisfy its rendezvous/barriers from a previous incarnation's
+        # stale keys
+        gen = os.environ.get("PADDLE_RESTART_GEN", "0")
+        global _key_prefix
+        _key_prefix = f"g{gen}/"
+        _store.set(f"{_key_prefix}worker/{rank}", str(os.getpid()))
+        _store.add(f"{_key_prefix}worker_count", 1)
+        _store.wait([f"{_key_prefix}worker/{r}" for r in range(nprocs)])
 
         use_jax = os.environ.get("PADDLE_USE_JAX_COORDINATOR", "auto")
         # Decide WITHOUT querying devices: jax.distributed.initialize must
@@ -133,7 +140,7 @@ def barrier(group=None):
     nprocs = _env_int("PADDLE_TRAINERS_NUM", "WORLD_SIZE", default=1)
     if _store is not None and nprocs > 1:
         _barrier_epoch += 1
-        key = f"barrier/{_barrier_epoch}"
+        key = f"{_key_prefix}barrier/{_barrier_epoch}"
         _store.add(key, 1)
         deadline = 900
         import time as _time

@@ -30,7 +30,11 @@ def main():
     a = p.parse_args()
 
     if ":" in a.nnodes:
-        # elastic mode: supervise relaunches within the np range
+        # elastic mode: supervise relaunches within the np range.
+        # Port layout: master port X = elastic supervisor store; X+4 =
+        # launcher rendezvous (from which _build_pod derives X+6 for the
+        # trainer store and X+7 for the jax coordinator) — the supervisor
+        # and the inner controller must not fight over one port.
         from ..elastic import ElasticManager
         from ..store import create_store
 
@@ -39,11 +43,13 @@ def main():
         mgr = ElasticManager(store, node_id=str(a.node_rank),
                              np_range=a.nnodes, job_id=a.job_id)
         mgr.register()
+        host, port = a.master.rsplit(":", 1)
+        inner_master = f"{host}:{int(port) + 4}"
 
         def launcher_fn(rank_map):
             rank = rank_map.get(str(a.node_rank), a.node_rank)
             return launch(a.training_script, a.training_script_args,
-                          len(rank_map), rank, a.master, a.log_dir,
+                          len(rank_map), rank, inner_master, a.log_dir,
                           a.max_restarts, a.job_id)
 
         status = mgr.watch(launcher_fn)

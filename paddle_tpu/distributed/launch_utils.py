@@ -146,13 +146,46 @@ class CollectiveController:
         return Pod(Container(cmd, env_vars, log))
 
     # -- watch loop (reference: controllers/watcher.py) ------------------
+    def _gen_key(self):
+        return f"launch/{self.job_id}/generation"
+
+    def _peer_generation(self) -> int:
+        if self._store is None:
+            return 0
+        try:
+            return int(self._store.get(self._gen_key(), timeout_s=0))
+        except Exception:
+            return 0
+
     def run(self) -> int:
+        """Watch loop. A collective job restarts as a WHOLE: when any
+        node's worker fails, its controller bumps the shared generation
+        counter; every controller notices, kills its (healthy) worker, and
+        restarts at the new generation. Workers namespace rendezvous keys
+        by generation (PADDLE_RESTART_GEN) so a restarted world can never
+        satisfy barriers from the previous incarnation."""
         self._rendezvous()
         pod = self._build_pod()
-        pod.deploy()
         container = pod.containers[0]
+        generation = self._peer_generation()
+        container.env_vars["PADDLE_RESTART_GEN"] = str(generation)
+        container.start()
         while True:
-            rc = container.wait()
+            rc = container.poll()
+            if rc is None:
+                # healthy so far — did a peer trigger a restart?
+                peer_gen = self._peer_generation()
+                if peer_gen > generation:
+                    container.terminate()
+                    generation = peer_gen
+                    container.restarts += 1
+                    if container.restarts > self.max_restarts:
+                        self._finalize(1)
+                        return 1
+                    container.env_vars["PADDLE_RESTART_GEN"] = str(generation)
+                    container.start()
+                time.sleep(0.5)
+                continue
             if rc == 0:
                 self._finalize(0)
                 return 0
@@ -160,10 +193,13 @@ class CollectiveController:
             if container.restarts > self.max_restarts:
                 self._finalize(rc)
                 return rc
-            # brief backoff, then restart the worker in place
             time.sleep(1)
             if self._store is not None:
-                self._store.add(f"launch/{self.job_id}/restarts", 1)
+                # tell every other node to restart at the next generation
+                generation = self._store.add(self._gen_key(), 1)
+            else:
+                generation += 1
+            container.env_vars["PADDLE_RESTART_GEN"] = str(generation)
             container.start()
 
     def _finalize(self, rc: int):

@@ -108,8 +108,17 @@ class Program:
         return self
 
     def clone(self, for_test: bool = False) -> "Program":
+        """Snapshot: ops recorded into the original AFTER the clone (e.g.
+        the loss/optimizer section following a test-program clone) must not
+        leak into the clone, so all mutable state is copied."""
         p = Program.__new__(Program)
-        p.__dict__.update(self.__dict__)
+        p._placeholders = list(self._placeholders)
+        p._consts = dict(self._consts)
+        p._insts = list(self._insts)
+        p._next_vid = self._next_vid
+        p._vid_by_obj = dict(self._vid_by_obj)
+        p._keepalive = list(self._keepalive)
+        p._feed_names = dict(self._feed_names)
         p._cache = {}
         return p
 

@@ -196,6 +196,37 @@ class TestDistModel:
                 np.asarray(p._value), np.asarray(q._value)
             )
 
+    def test_optimizer_state_roundtrip(self):
+        """state_dict('all') must restore Adam moments on set_state_dict —
+        a checkpoint resume must not silently reset optimizer state."""
+        paddle.seed(4)
+        model = _MLP()
+        optimizer = opt.AdamW(learning_rate=0.01,
+                              parameters=model.parameters())
+        dm = dist.to_static(model, loss=_loss_fn, optimizer=optimizer)
+        rng = np.random.default_rng(2)
+        x, y = _batch(rng)
+        float(dm(x, y))  # one step populates moments
+        state = dm.state_dict()
+        assert any(k.startswith("opt.") for k in state)
+
+        fresh = _MLP()
+        opt2 = opt.AdamW(learning_rate=0.01, parameters=fresh.parameters())
+        dm2 = dist.to_static(fresh, loss=_loss_fn, optimizer=opt2)
+        dm2.set_state_dict(state)
+        assert opt2._step_count == optimizer._step_count
+        moments1 = sorted(
+            (k, np.asarray(v._value).sum()) for k, v in
+            optimizer.state_dict().items() if hasattr(v, "_value")
+        )
+        moments2 = sorted(
+            (k, np.asarray(v._value).sum()) for k, v in
+            opt2.state_dict().items() if hasattr(v, "_value")
+        )
+        for (k1, s1), (k2, s2) in zip(moments1, moments2):
+            assert k1 == k2
+            np.testing.assert_allclose(s1, s2, rtol=1e-6)
+
     def test_strategy_sharding_applied(self):
         paddle.seed(2)
         model = _MLP()
@@ -237,6 +268,31 @@ class TestShardDataloader:
             assert placements[0] == dist.Shard(0)
             spec = getattr(x._value.sharding, "spec", None)
             assert spec is not None and spec[0] == "dp"
+
+
+    def test_dict_batches_with_dict_shard_dims(self):
+        """Dict batches shard per-key via a shard_dims dict (reference
+        api.py:2854 signature) — they must NOT silently replicate."""
+        mesh = dist.ProcessMesh(np.arange(8), ["dp"])
+
+        def gen():
+            for _ in range(2):
+                yield {
+                    "x": paddle.to_tensor(
+                        np.random.rand(16, 4).astype("float32")),
+                    "label": paddle.to_tensor(
+                        np.random.rand(16, 1).astype("float32")),
+                }
+
+        sharded = dist.shard_dataloader(
+            list(gen()), mesh, input_keys=["x", "label"],
+            shard_dims={"x": "dp", "label": "dp"},
+        )
+        for batch in sharded:
+            for key in ("x", "label"):
+                spec = getattr(batch[key]._value.sharding, "spec", None)
+                assert spec is not None and spec[0] == "dp", \
+                    f"{key} not sharded: {spec}"
 
 
 class TestEngine:

@@ -108,6 +108,56 @@ class TestLaunchRendezvous:
         assert rc == 0
         assert int(marker.read_text()) == 3
 
+    def test_multinode_coordinated_restart(self, tmp_path):
+        """When one node's worker dies, ALL nodes restart at a bumped
+        generation (rendezvous keys re-namespaced) — no stale-key
+        split-brain."""
+        script = tmp_path / "genworker.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            gen = int(os.environ.get("PADDLE_RESTART_GEN", "0"))
+            import paddle_tpu.distributed as dist
+            dist.init_parallel_env()
+            dist.barrier()
+            if gen == 0:
+                if rank == 1:
+                    sys.exit(3)     # rank 1 dies at generation 0
+                time.sleep(30)      # rank 0 healthy; must be preempted
+                sys.exit(9)         # (never reached if restart works)
+            print(f"gen{gen} rank{rank} done")
+            sys.exit(0)
+        """))
+        port = _free_port()
+        master = f"127.0.0.1:{port}"
+        log_dir = str(tmp_path / "logs")
+
+        def run_node(rank, results):
+            proc = subprocess.run(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nnodes", "2", "--node_rank", str(rank),
+                 "--master", master, "--log_dir", log_dir,
+                 "--max_restarts", "2", str(script)],
+                capture_output=True, text=True, timeout=180, cwd=REPO,
+                env={**os.environ, "JAX_PLATFORMS": "cpu",
+                     "PYTHONPATH": REPO + os.pathsep
+                     + os.environ.get("PYTHONPATH", "")},
+            )
+            results[rank] = proc
+
+        results = {}
+        threads = [threading.Thread(target=run_node, args=(r, results))
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(200)
+        for rank in range(2):
+            log = open(os.path.join(log_dir, f"workerlog.{rank}")).read()
+            assert results[rank].returncode == 0, \
+                f"node {rank} rc={results[rank].returncode}\nlog:{log}"
+            assert f"gen1 rank{rank} done" in log
+
     def test_restart_budget_exhausted(self, tmp_path):
         script = tmp_path / "alwaysfail.py"
         script.write_text("import sys; sys.exit(7)\n")
@@ -137,6 +187,25 @@ class TestElasticManager:
         assert ranks == {"nodeA": 0, "nodeB": 1}
         m2.deregister()
         assert m1.alive_members() == ["nodeA"]
+
+    def test_concurrent_registration_loses_nobody(self):
+        """Registration is an atomic slot append — simultaneous joins from
+        many threads must all land in the member set."""
+        from paddle_tpu.distributed.elastic import ElasticManager
+
+        store = self._store()
+        n = 8
+        managers = [
+            ElasticManager(store, f"n{i}", np_range=f"1:{n}", dead_after_s=5)
+            for i in range(n)
+        ]
+        threads = [threading.Thread(target=m.register) for m in managers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert sorted(managers[0].alive_members()) == \
+            sorted(f"n{i}" for i in range(n))
 
     def test_dead_node_detected_by_stale_heartbeat(self):
         from paddle_tpu.distributed.elastic import ElasticManager

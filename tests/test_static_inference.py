@@ -67,6 +67,23 @@ class TestStaticProgram:
         t = paddle.to_tensor(np.ones(2, "float32")) * 3.0
         np.testing.assert_allclose(np.asarray(t._value), [3.0, 3.0])
 
+    def test_clone_isolated_from_later_ops(self):
+        """clone(for_test=True) mid-build must snapshot: ops recorded
+        afterwards (the loss section) stay out of the clone."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4], "float32")
+            fwd = x * 2.0
+            test_prog = main.clone(for_test=True)
+            n_ops_at_clone = test_prog.num_ops
+            _loss = (fwd - 1.0).sum()  # recorded after the clone
+        assert main.num_ops > n_ops_at_clone
+        assert test_prog.num_ops == n_ops_at_clone
+        exe = static.Executor()
+        (out,) = exe.run(test_prog, feed={"x": np.ones(4, "float32")},
+                         fetch_list=[fwd])
+        np.testing.assert_allclose(out, np.full(4, 2.0))
+
     def test_duplicate_data_name_rejected(self):
         main = static.Program()
         with static.program_guard(main):
