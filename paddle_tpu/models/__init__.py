@@ -9,3 +9,11 @@ from .llama import (
     LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaDecoderLayer,
     LlamaAttention, LlamaMLP, llama_shard_plan,
 )
+from .bert import (
+    BertConfig, BertModel, BertForPretraining,
+    BertForSequenceClassification, BertEmbeddings, BertEncoderLayer,
+    bert_shard_plan,
+)
+from .gpt import (
+    GPTConfig, GPTModel, GPTForCausalLM, GPTDecoderLayer, gpt_shard_plan,
+)

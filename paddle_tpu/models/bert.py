@@ -1,0 +1,241 @@
+"""BERT model family.
+
+Reference: the reference framework's transformer encoder stack
+(python/paddle/nn/layer/transformer.py TransformerEncoder/
+TransformerEncoderLayer) as used by ERNIE/BERT workloads, plus the
+fused_attention/fused_feedforward big-op pattern
+(fluid/operators/fused/fused_attention_op.cu — here XLA fuses the same
+graph; SURVEY §2.1 fused-op row).
+
+TPU notes: post-LN encoder, GELU FFN, attention via
+scaled_dot_product_attention (Pallas flash on TPU). bert_shard_plan gives
+the Megatron TP layout over a (dp, mp) mesh.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+
+__all__ = [
+    "BertConfig", "BertModel", "BertForPretraining",
+    "BertForSequenceClassification", "BertEmbeddings", "BertEncoderLayer",
+    "bert_shard_plan",
+]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    recompute: bool = False
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def large() -> "BertConfig":
+        return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                          num_attention_heads=16, intermediate_size=4096)
+
+    @staticmethod
+    def tiny(**kw) -> "BertConfig":
+        base = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=64)
+        base.update(kw)
+        return BertConfig(**base)
+
+
+class BertEmbeddings(nn.Layer):
+    """word + position + token_type embeddings → LayerNorm → dropout."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(
+            config.vocab_size, config.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(
+            config.type_vocab_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(
+            config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        import paddle_tpu as paddle
+
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = paddle.arange(s, dtype="int64").unsqueeze(0)
+        if token_type_ids is None:
+            token_type_ids = paddle.zeros([b, s], dtype="int64")
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        h = config.hidden_size
+        self.q_proj = nn.Linear(h, h)
+        self.k_proj = nn.Linear(h, h)
+        self.v_proj = nn.Linear(h, h)
+        self.out_proj = nn.Linear(h, h)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attention_mask=None):
+        b, s, h = x.shape
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attention_mask, is_causal=False)
+        return self.dropout(self.out_proj(out.reshape([b, s, h])))
+
+
+class BertEncoderLayer(nn.Layer):
+    """Post-LN encoder block (the fused_attention+fused_feedforward graph
+    of the reference, left to XLA to fuse)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.self_attn = BertSelfAttention(config)
+        self.norm1 = nn.LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_eps)
+        self.linear1 = nn.Linear(config.hidden_size, config.intermediate_size)
+        self.linear2 = nn.Linear(config.intermediate_size, config.hidden_size)
+        self.norm2 = nn.LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attention_mask=None):
+        x = self.norm1(x + self.self_attn(x, attention_mask))
+        ff = self.linear2(F.gelu(self.linear1(x)))
+        return self.norm2(x + self.dropout(ff))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList(
+            [BertEncoderLayer(config) for _ in range(config.num_hidden_layers)]
+        )
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [b, s] padding mask → additive [b, 1, 1, s]
+            import paddle_tpu as paddle
+
+            neg = (1.0 - attention_mask.astype("float32")) * -1e4
+            attention_mask = neg.unsqueeze(1).unsqueeze(1)
+        if self.config.recompute:
+            from ..distributed.fleet.utils import recompute
+
+            for layer in self.encoder:
+                x = recompute(layer, x, attention_mask)
+        else:
+            for layer in self.encoder:
+                x = layer(x, attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+    def num_parameters(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.mlm_transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.mlm_norm = nn.LayerNorm(config.hidden_size,
+                                     epsilon=config.layer_norm_eps)
+        self.mlm_head = nn.Linear(config.hidden_size, config.vocab_size)
+        self.nsp_head = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_labels=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask=attention_mask)
+        mlm = self.mlm_head(self.mlm_norm(F.gelu(self.mlm_transform(seq))))
+        nsp = self.nsp_head(pooled)
+        if masked_lm_labels is not None:
+            loss = F.cross_entropy(
+                mlm.reshape([-1, self.config.vocab_size]),
+                masked_lm_labels.reshape([-1]), ignore_index=-100)
+            if next_sentence_labels is not None:
+                loss = loss + F.cross_entropy(
+                    nsp, next_sentence_labels.reshape([-1]))
+            return loss, mlm, nsp
+        return mlm, nsp
+
+    def num_parameters(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels.reshape([-1])), logits
+        return logits
+
+
+def bert_shard_plan(model, mesh, dp_axis="dp", mp_axis="mp"):
+    """Megatron TP layout: qkv/linear1 column-parallel, out/linear2
+    row-parallel, word embeddings vocab-parallel."""
+    import paddle_tpu.distributed as dist
+
+    mp = mesh.dim_names.index(mp_axis)
+
+    def place(p, tensor_dim=None):
+        placements = [dist.Replicate() for _ in range(mesh.ndim)]
+        if tensor_dim is not None:
+            placements[mp] = dist.Shard(tensor_dim)
+        dist.shard_tensor(p, mesh, placements)
+
+    bert = model.bert if hasattr(model, "bert") else model
+    place(bert.embeddings.word_embeddings.weight, 0)
+    for layer in bert.encoder:
+        place(layer.self_attn.q_proj.weight, 1)
+        place(layer.self_attn.k_proj.weight, 1)
+        place(layer.self_attn.v_proj.weight, 1)
+        place(layer.self_attn.out_proj.weight, 0)
+        place(layer.linear1.weight, 1)
+        place(layer.linear1.bias, 0)
+        place(layer.linear2.weight, 0)
+    return model

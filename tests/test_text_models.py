@@ -1,0 +1,197 @@
+"""BERT + GPT model family tests.
+
+Reference behaviors: encoder/decoder transformer stacks train and shard
+under TP like the auto-parallel Llama fixture (SURVEY §4 — one LLM
+fixture exercised under parallelism combos).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.optimizer as opt
+from paddle_tpu.models import (
+    BertConfig, BertForPretraining, BertForSequenceClassification, BertModel,
+    GPTConfig, GPTForCausalLM, bert_shard_plan, gpt_shard_plan,
+)
+
+
+def _ids(rng, b, s, vocab):
+    return paddle.to_tensor(
+        rng.integers(0, vocab, (b, s)).astype("int64"))
+
+
+class TestBert:
+    def test_forward_shapes(self):
+        paddle.seed(0)
+        config = BertConfig.tiny()
+        model = BertModel(config)
+        rng = np.random.default_rng(0)
+        seq, pooled = model(_ids(rng, 2, 16, config.vocab_size))
+        assert list(seq.shape) == [2, 16, config.hidden_size]
+        assert list(pooled.shape) == [2, config.hidden_size]
+
+    def test_padding_mask_changes_output(self):
+        paddle.seed(0)
+        config = BertConfig.tiny()
+        config.hidden_dropout_prob = 0.0
+        model = BertModel(config)
+        model.eval()
+        rng = np.random.default_rng(1)
+        ids = _ids(rng, 1, 8, config.vocab_size)
+        mask = paddle.to_tensor(
+            np.array([[1, 1, 1, 1, 0, 0, 0, 0]], dtype="float32"))
+        full, _ = model(ids)
+        masked, _ = model(ids, attention_mask=mask)
+        # masking the tail must change the first token's representation
+        assert not np.allclose(
+            np.asarray(full._value)[0, 0], np.asarray(masked._value)[0, 0]
+        )
+
+    def test_pretraining_loss_decreases(self):
+        paddle.seed(1)
+        config = BertConfig.tiny()
+        config.hidden_dropout_prob = 0.0
+        model = BertForPretraining(config)
+        optimizer = opt.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        rng = np.random.default_rng(2)
+        ids = _ids(rng, 4, 16, config.vocab_size)
+        mlm_labels = _ids(rng, 4, 16, config.vocab_size)
+        nsp = paddle.to_tensor(rng.integers(0, 2, (4,)).astype("int64"))
+
+        @paddle.jit.to_static
+        def step(ids, mlm_labels, nsp):
+            loss, _, _ = model(ids, masked_lm_labels=mlm_labels,
+                               next_sentence_labels=nsp)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            return loss
+
+        losses = [float(step(ids, mlm_labels, nsp)) for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+    def test_sequence_classification(self):
+        paddle.seed(2)
+        config = BertConfig.tiny()
+        model = BertForSequenceClassification(config, num_classes=3)
+        rng = np.random.default_rng(3)
+        ids = _ids(rng, 2, 8, config.vocab_size)
+        labels = paddle.to_tensor(np.array([0, 2], dtype="int64"))
+        loss, logits = model(ids, labels=labels)
+        assert list(logits.shape) == [2, 3]
+        assert np.isfinite(float(loss))
+
+    def test_tp_shard_plan_trains(self):
+        paddle.seed(3)
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        config = BertConfig.tiny(
+            hidden_size=8 * 4, intermediate_size=16 * 4, vocab_size=64 * 4)
+        config.hidden_dropout_prob = 0.0
+        model = BertForPretraining(config)
+        bert_shard_plan(model, mesh)
+        optimizer = opt.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        rng = np.random.default_rng(4)
+        ids = dist.shard_tensor(
+            np.asarray(rng.integers(0, config.vocab_size, (4, 8)), "int64"),
+            mesh, [dist.Shard(0), dist.Replicate()])
+        labels = dist.shard_tensor(
+            np.asarray(rng.integers(0, config.vocab_size, (4, 8)), "int64"),
+            mesh, [dist.Shard(0), dist.Replicate()])
+
+        @paddle.jit.to_static
+        def step(ids, labels):
+            loss, _, _ = model(ids, masked_lm_labels=labels)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            return loss
+
+        l1 = float(step(ids, labels))
+        l2 = float(step(ids, labels))
+        assert np.isfinite(l1) and l2 < l1
+
+
+class TestGPT:
+    def test_forward_and_tied_embeddings(self):
+        paddle.seed(4)
+        config = GPTConfig.tiny()
+        model = GPTForCausalLM(config)
+        assert config.tie_word_embeddings
+        assert not hasattr(model, "lm_head")
+        rng = np.random.default_rng(5)
+        logits = model(_ids(rng, 2, 12, config.vocab_size))
+        assert list(logits.shape) == [2, 12, config.vocab_size]
+
+    def test_causality(self):
+        """Changing a future token must not affect earlier logits."""
+        paddle.seed(5)
+        config = GPTConfig.tiny()
+        config.hidden_dropout_prob = 0.0
+        model = GPTForCausalLM(config)
+        model.eval()
+        rng = np.random.default_rng(6)
+        ids_np = rng.integers(0, config.vocab_size, (1, 8)).astype("int64")
+        logits1 = model(paddle.to_tensor(ids_np))
+        ids_np2 = ids_np.copy()
+        ids_np2[0, -1] = (ids_np2[0, -1] + 1) % config.vocab_size
+        logits2 = model(paddle.to_tensor(ids_np2))
+        np.testing.assert_allclose(
+            np.asarray(logits1._value)[0, :-1],
+            np.asarray(logits2._value)[0, :-1], atol=1e-5)
+
+    def test_training_loss_decreases(self):
+        paddle.seed(6)
+        config = GPTConfig.tiny()
+        config.hidden_dropout_prob = 0.0
+        model = GPTForCausalLM(config)
+        optimizer = opt.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        rng = np.random.default_rng(7)
+        ids_np = rng.integers(0, config.vocab_size, (4, 16)).astype("int64")
+        ids = paddle.to_tensor(ids_np)
+        labels = paddle.to_tensor(np.roll(ids_np, -1, axis=1))
+
+        @paddle.jit.to_static
+        def step(ids, labels):
+            loss, _ = model(ids, labels=labels)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            return loss
+
+        losses = [float(step(ids, labels)) for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+    def test_untied_head_and_tp_plan(self):
+        paddle.seed(7)
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        config = GPTConfig.tiny(
+            hidden_size=8 * 4, intermediate_size=16 * 4, vocab_size=64 * 4,
+            tie_word_embeddings=False)
+        config.hidden_dropout_prob = 0.0
+        model = GPTForCausalLM(config)
+        assert hasattr(model, "lm_head")
+        gpt_shard_plan(model, mesh)
+        optimizer = opt.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        rng = np.random.default_rng(8)
+        ids_np = rng.integers(0, config.vocab_size, (4, 8)).astype("int64")
+        ids = dist.shard_tensor(ids_np, mesh,
+                                [dist.Shard(0), dist.Replicate()])
+        labels = dist.shard_tensor(np.roll(ids_np, -1, 1), mesh,
+                                   [dist.Shard(0), dist.Replicate()])
+
+        @paddle.jit.to_static
+        def step(ids, labels):
+            loss, _ = model(ids, labels=labels)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            return loss
+
+        l1 = float(step(ids, labels))
+        l2 = float(step(ids, labels))
+        assert np.isfinite(l1) and l2 < l1
