@@ -50,6 +50,7 @@ from . import amp
 from . import jit
 from . import static
 from . import inference
+from . import sparse
 from . import metric
 from . import device
 from . import incubate

@@ -1,0 +1,306 @@
+"""paddle.sparse — COO/CSR sparse tensors and ops.
+
+Reference: paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h (C++
+tensor types), phi/kernels/sparse/ (kernel set), python/paddle/sparse/
+(sparse_coo_tensor/sparse_csr_tensor creation, unary/binary/matmul ops,
+Tensor.to_sparse_coo/to_dense methods).
+
+TPU re-design: storage is jax.experimental.sparse BCOO/BCSR — XLA
+compiles scatter/gather/dot_general programs for them, which is the TPU
+analog of the reference's cuSPARSE-backed kernels. Sparse tensors are
+inference/feature-engineering objects here (stop_gradient=True), matching
+the reference's main sparse use (recommendation/point-cloud feature
+paths); autograd flows through to_dense().
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "add", "subtract", "multiply", "divide", "matmul",
+    "masked_matmul", "relu", "tanh", "sqrt", "sin", "abs", "neg", "pow",
+    "cast", "coalesce", "transpose", "is_same_shape",
+]
+
+
+class _SparseBase:
+    @property
+    def shape(self):
+        return list(self._mat.shape)
+
+    @property
+    def dtype(self):
+        return self._mat.dtype
+
+    @property
+    def ndim(self):
+        return self._mat.ndim
+
+    def nnz(self) -> int:
+        return int(self._mat.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor._from_value(self._mat.todense())
+
+    def numpy(self):
+        return np.asarray(self._mat.todense())
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(shape={self.shape}, "
+                f"nnz={self.nnz()}, dtype={self.dtype})")
+
+
+class SparseCooTensor(_SparseBase):
+    """COO sparse tensor (reference: phi SparseCooTensor — non_zero_indices
+    + non_zero_elements + dims)."""
+
+    def __init__(self, mat: jsparse.BCOO):
+        self._mat = mat
+        self.stop_gradient = True
+
+    def indices(self) -> Tensor:
+        # paddle layout: [sparse_ndim, nnz]; BCOO stores [nnz, sparse_ndim]
+        return Tensor._from_value(self._mat.indices.T)
+
+    def values(self) -> Tensor:
+        return Tensor._from_value(self._mat.data)
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._mat.sum_duplicates())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._mat.sum_duplicates()))
+
+    def is_coalesced(self) -> bool:
+        return bool(self._mat.unique_indices)
+
+    # -- operators -------------------------------------------------------
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+class SparseCsrTensor(_SparseBase):
+    """CSR sparse tensor (reference: phi SparseCsrTensor — crows/cols/
+    values)."""
+
+    def __init__(self, mat: jsparse.BCSR):
+        self._mat = mat
+        self.stop_gradient = True
+
+    def crows(self) -> Tensor:
+        return Tensor._from_value(self._mat.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor._from_value(self._mat.indices)
+
+    def values(self) -> Tensor:
+        return Tensor._from_value(self._mat.data)
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None) -> SparseCooTensor:
+        return SparseCooTensor(self._mat.to_bcoo())
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+# ------------------------------------------------------------- creation
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    """Reference: paddle.sparse.sparse_coo_tensor(indices [sparse_ndim,nnz],
+    values [nnz,...], shape)."""
+    idx = np.asarray(
+        indices._value if isinstance(indices, Tensor) else indices)
+    vals = jnp.asarray(
+        values._value if isinstance(values, Tensor) else values, dtype=dtype)
+    idx = jnp.asarray(idx.T, jnp.int32)  # → [nnz, sparse_ndim]
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=0))
+        shape = shape + tuple(vals.shape[1:])
+    mat = jsparse.BCOO((vals, idx), shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(mat)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCsrTensor:
+    """Reference: paddle.sparse.sparse_csr_tensor."""
+    def arr(x, dt=None):
+        return jnp.asarray(
+            x._value if isinstance(x, Tensor) else x, dtype=dt)
+
+    mat = jsparse.BCSR(
+        (arr(values, dtype), arr(cols, jnp.int32), arr(crows, jnp.int32)),
+        shape=tuple(int(s) for s in shape),
+    )
+    return SparseCsrTensor(mat)
+
+
+def _as_coo(x) -> jsparse.BCOO:
+    if isinstance(x, SparseCooTensor):
+        return x._mat
+    if isinstance(x, SparseCsrTensor):
+        return x._mat.to_bcoo()
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+def _wrap_like(x, mat: jsparse.BCOO):
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(mat))
+    return SparseCooTensor(mat)
+
+
+# ------------------------------------------------------------- binary ops
+def add(x, y):
+    """sparse+sparse or sparse+dense (densifies). Reference:
+    paddle.sparse.add."""
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        return _wrap_like(x, (_as_coo(x) + _as_coo(y)).sum_duplicates())
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor._from_value(_as_coo(x).todense() + yv)
+
+
+def subtract(x, y):
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        neg_y = jsparse.BCOO(
+            (-_as_coo(y).data, _as_coo(y).indices), shape=tuple(y.shape))
+        return _wrap_like(x, (_as_coo(x) + neg_y).sum_duplicates())
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor._from_value(_as_coo(x).todense() - yv)
+
+
+def multiply(x, y):
+    """Elementwise multiply. sparse*scalar and sparse*dense keep sparsity
+    (dense is sampled at the nonzero positions)."""
+    coo = _as_coo(x)
+    if isinstance(y, (int, float)):
+        return _wrap_like(x, jsparse.BCOO((coo.data * y, coo.indices),
+                                          shape=coo.shape))
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        yd = _as_coo(y).todense()
+    else:
+        yd = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    sampled = yd[tuple(coo.indices[:, i] for i in range(coo.indices.shape[1]))]
+    return _wrap_like(x, jsparse.BCOO((coo.data * sampled, coo.indices),
+                                      shape=coo.shape))
+
+
+def divide(x, y):
+    coo = _as_coo(x)
+    if isinstance(y, (int, float)):
+        return _wrap_like(x, jsparse.BCOO((coo.data / y, coo.indices),
+                                          shape=coo.shape))
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        yd = _as_coo(y).todense()
+    else:
+        yd = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    sampled = yd[tuple(coo.indices[:, i] for i in range(coo.indices.shape[1]))]
+    return _wrap_like(x, jsparse.BCOO((coo.data / sampled, coo.indices),
+                                      shape=coo.shape))
+
+
+# ------------------------------------------------------------------ matmul
+def matmul(x, y):
+    """sparse @ dense → dense (reference: paddle.sparse.matmul; phi
+    kernels sparse/cpu/matmul_kernel). XLA lowers to gather+dot."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        lhs = _as_coo(x)
+        rhs = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        n = lhs.ndim
+        out = jsparse.bcoo_dot_general(
+            lhs, rhs, dimension_numbers=(([n - 1], [0]), ([], [])))
+        return Tensor._from_value(out)
+    raise TypeError("sparse.matmul expects a sparse lhs")
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask):
+    """dense @ dense sampled at mask's sparsity (reference:
+    paddle.sparse.masked_matmul — SDDMM)."""
+    coo = _as_coo(mask)
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    rows = coo.indices[:, 0]
+    cols = coo.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseCooTensor(
+        jsparse.BCOO((vals, coo.indices), shape=coo.shape))
+
+
+# ------------------------------------------------------------- unary ops
+def _unary(fn):
+    def op(x):
+        coo = _as_coo(x)
+        return _wrap_like(x, jsparse.BCOO((fn(coo.data), coo.indices),
+                                          shape=coo.shape))
+    return op
+
+
+relu = _unary(jax.nn.relu)
+tanh = _unary(jnp.tanh)
+sqrt = _unary(jnp.sqrt)
+sin = _unary(jnp.sin)
+abs = _unary(jnp.abs)
+neg = _unary(jnp.negative)
+
+
+def pow(x, factor):
+    coo = _as_coo(x)
+    return _wrap_like(x, jsparse.BCOO((coo.data ** factor, coo.indices),
+                                      shape=coo.shape))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    coo = _as_coo(x)
+    data = coo.data if value_dtype is None else coo.data.astype(value_dtype)
+    idx = coo.indices if index_dtype is None \
+        else coo.indices.astype(index_dtype)
+    return _wrap_like(x, jsparse.BCOO((data, idx), shape=coo.shape))
+
+
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    return x.coalesce()
+
+
+def transpose(x, perm: Sequence[int]):
+    coo = _as_coo(x)
+    return _wrap_like(
+        x, jsparse.bcoo_transpose(coo, permutation=tuple(perm)))
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+# ---------------------------------------------------------------------------
+# Dense→sparse conversion methods on Tensor (the reference patches these
+# onto its Tensor too: python/paddle/sparse binds to_sparse_coo/to_sparse_csr)
+# ---------------------------------------------------------------------------
+def _to_sparse_coo(self: Tensor, sparse_dim: Optional[int] = None):
+    mat = jsparse.BCOO.fromdense(self._value)
+    return SparseCooTensor(mat)
+
+
+def _to_sparse_csr(self: Tensor):
+    return SparseCsrTensor(jsparse.BCSR.fromdense(self._value))
+
+
+Tensor.to_sparse_coo = _to_sparse_coo
+Tensor.to_sparse_csr = _to_sparse_csr

@@ -1,0 +1,129 @@
+"""paddle.sparse tests (COO/CSR types + op set).
+
+Reference behaviors: python/paddle/sparse API surface backed by
+phi/kernels/sparse/; indices layout [sparse_ndim, nnz] like
+SparseCooTensor (phi/core/sparse_coo_tensor.h).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+
+
+def _dense():
+    return np.array([[1.0, 0.0, 2.0],
+                     [0.0, 0.0, 3.0],
+                     [4.0, 0.0, 0.0]], dtype=np.float32)
+
+
+class TestCreation:
+    def test_coo_from_indices_values(self):
+        st = sparse.sparse_coo_tensor(
+            indices=[[0, 0, 1, 2], [0, 2, 2, 0]],
+            values=[1.0, 2.0, 3.0, 4.0], shape=[3, 3])
+        assert st.shape == [3, 3]
+        assert st.nnz() == 4
+        np.testing.assert_allclose(st.numpy(), _dense())
+        # paddle indices layout [sparse_ndim, nnz]
+        assert list(st.indices().shape) == [2, 4]
+        np.testing.assert_allclose(
+            np.asarray(st.values()._value), [1, 2, 3, 4])
+
+    def test_csr_from_crows_cols_values(self):
+        st = sparse.sparse_csr_tensor(
+            crows=[0, 2, 3, 4], cols=[0, 2, 2, 0],
+            values=[1.0, 2.0, 3.0, 4.0], shape=[3, 3])
+        np.testing.assert_allclose(st.numpy(), _dense())
+        np.testing.assert_array_equal(
+            np.asarray(st.crows()._value), [0, 2, 3, 4])
+        np.testing.assert_array_equal(
+            np.asarray(st.cols()._value), [0, 2, 2, 0])
+
+    def test_dense_roundtrip(self):
+        x = paddle.to_tensor(_dense())
+        coo = x.to_sparse_coo()
+        assert coo.nnz() == 4
+        np.testing.assert_allclose(
+            np.asarray(coo.to_dense()._value), _dense())
+        csr = x.to_sparse_csr()
+        np.testing.assert_allclose(
+            np.asarray(csr.to_dense()._value), _dense())
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.numpy(), _dense())
+
+
+class TestOps:
+    def test_add_sub_sparse(self):
+        x = paddle.to_tensor(_dense()).to_sparse_coo()
+        y = paddle.to_tensor(2 * _dense()).to_sparse_coo()
+        np.testing.assert_allclose((x + y).numpy(), 3 * _dense())
+        np.testing.assert_allclose(
+            sparse.subtract(y, x).numpy(), _dense())
+
+    def test_add_dense(self):
+        x = paddle.to_tensor(_dense()).to_sparse_coo()
+        d = paddle.to_tensor(np.ones((3, 3), np.float32))
+        out = sparse.add(x, d)
+        np.testing.assert_allclose(
+            np.asarray(out._value), _dense() + 1.0)
+
+    def test_multiply_scalar_and_dense(self):
+        x = paddle.to_tensor(_dense()).to_sparse_coo()
+        np.testing.assert_allclose(
+            sparse.multiply(x, 3.0).numpy(), 3 * _dense())
+        d = paddle.to_tensor(np.full((3, 3), 2.0, np.float32))
+        np.testing.assert_allclose(
+            sparse.multiply(x, d).numpy(), 2 * _dense())
+
+    def test_matmul(self):
+        x = paddle.to_tensor(_dense()).to_sparse_coo()
+        w = np.random.rand(3, 4).astype(np.float32)
+        out = sparse.matmul(x, paddle.to_tensor(w))
+        np.testing.assert_allclose(
+            np.asarray(out._value), _dense() @ w, rtol=1e-5)
+        csr = paddle.to_tensor(_dense()).to_sparse_csr()
+        out2 = csr @ paddle.to_tensor(w)
+        np.testing.assert_allclose(
+            np.asarray(out2._value), _dense() @ w, rtol=1e-5)
+
+    def test_masked_matmul(self):
+        a = np.random.rand(3, 5).astype(np.float32)
+        b = np.random.rand(5, 3).astype(np.float32)
+        mask = paddle.to_tensor(_dense()).to_sparse_coo()
+        out = sparse.masked_matmul(
+            paddle.to_tensor(a), paddle.to_tensor(b), mask)
+        full = a @ b
+        expect = np.where(_dense() != 0, full, 0.0)
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5)
+
+    def test_unary(self):
+        neg = -_dense()
+        x = paddle.to_tensor(neg).to_sparse_coo()
+        np.testing.assert_allclose(
+            sparse.relu(x).numpy(), np.maximum(neg, 0))
+        np.testing.assert_allclose(
+            sparse.abs(x).numpy(), np.abs(neg), rtol=1e-6)
+        np.testing.assert_allclose(
+            sparse.tanh(x).numpy(), np.tanh(neg), rtol=1e-6)
+        np.testing.assert_allclose(
+            sparse.pow(x, 2).numpy(), neg ** 2, rtol=1e-6)
+
+    def test_coalesce(self):
+        st = sparse.sparse_coo_tensor(
+            indices=[[0, 0], [1, 1]], values=[1.0, 2.0], shape=[2, 2])
+        co = st.coalesce()
+        assert co.nnz() == 1
+        np.testing.assert_allclose(
+            co.numpy(), np.array([[0, 3.0], [0, 0]], np.float32))
+
+    def test_transpose(self):
+        x = paddle.to_tensor(_dense()).to_sparse_coo()
+        np.testing.assert_allclose(
+            sparse.transpose(x, [1, 0]).numpy(), _dense().T)
+
+    def test_cast_and_same_shape(self):
+        x = paddle.to_tensor(_dense()).to_sparse_coo()
+        y = sparse.cast(x, value_dtype="float64")
+        assert str(y.dtype) == "float64"
+        assert sparse.is_same_shape(x, y)
