@@ -1,36 +1,154 @@
-"""AMP debugging tools.
+"""AMP debugging utilities.
 
-Reference: python/paddle/amp/debugging.py (TensorCheckerConfig,
-enable_operator_stats_collection, compare_accuracy). Minimal parity: op
-stats collection over the dispatch cache + nan/inf checking toggles.
+Reference: python/paddle/amp/debugging.py — TensorCheckerConfig,
+enable_tensor_checker/disable_tensor_checker (drive FLAGS_check_nan_inf),
+check_numerics, collect_operator_stats (per-op dtype counters),
+compare_accuracy (cross-run op-output diff).
+
+TPU re-design: the checker rides the dispatch-layer NaN/Inf watchdog
+(core/dispatch.py behind FLAGS_check_nan_inf — the nan_inf_utils.cc
+analog); operator stats hook the same dispatch path.
 """
 from __future__ import annotations
 
+import contextlib
+from collections import defaultdict
+from enum import Enum
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
 from ..core import flags
+from ..core.tensor import Tensor
+
+__all__ = [
+    "DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+    "disable_tensor_checker", "check_numerics",
+    "enable_operator_stats_collection", "disable_operator_stats_collection",
+    "collect_operator_stats", "compare_accuracy",
+]
 
 
-def enable_tensor_checker(checker_config=None):
-    flags.set_flags({"check_nan_inf": True})
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    """Reference: debugging.py TensorCheckerConfig."""
+
+    def __init__(self, enable: bool = False,
+                 debug_mode: "DebugMode" = DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir: Optional[str] = None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None,
+                 stack_height_limit=1, **kw):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list or []
+        self.skipped_op_list = skipped_op_list or []
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+
+
+def enable_tensor_checker(checker_config: Optional[TensorCheckerConfig] = None):
+    """Reference: debugging.py enable_tensor_checker → sets
+    FLAGS_check_nan_inf(+level)."""
+    config = checker_config or TensorCheckerConfig(enable=True)
+    if config.enable:
+        level = 0 if config.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT \
+            else 1
+        flags.set_flags({"check_nan_inf": True,
+                         "check_nan_inf_level": level})
 
 
 def disable_tensor_checker():
     flags.set_flags({"check_nan_inf": False})
 
 
-class TensorCheckerConfig:
-    def __init__(self, enable=True, debug_mode=None, **kw):
-        self.enable = enable
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Reference: debugging.py check_numerics — count NaN/Inf in one
+    tensor and abort/warn. Returns (num_nan, num_inf, num_zero)."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    num_nan = int(jnp.isnan(v).sum())
+    num_inf = int(jnp.isinf(v).sum())
+    num_zero = int((v == 0).sum())
+    if num_nan or num_inf:
+        msg = (f"check_numerics: op={op_type} var={var_name} "
+               f"nan={num_nan} inf={num_inf}")
+        if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        import warnings
+
+        warnings.warn(msg)
+    return (Tensor._from_value(jnp.asarray(num_nan)),
+            Tensor._from_value(jnp.asarray(num_inf)),
+            Tensor._from_value(jnp.asarray(num_zero)))
 
 
-def collect_operator_stats():
-    from ..core.dispatch import dispatch_cache_info
+# ---------------------------------------------------------------- op stats
+_op_stats: Optional[Dict[str, Dict[str, int]]] = None
+_orig_call_primitive = None
 
-    return dispatch_cache_info()
+
+def _install_stats_hook():
+    """Wrap dispatch.call_primitive to count per-op output dtypes
+    (reference: debugging.py collect_operator_stats tables)."""
+    from ..core import dispatch
+
+    global _orig_call_primitive
+    if _orig_call_primitive is not None:
+        return
+    _orig_call_primitive = dispatch.call_primitive
+
+    def counted(name, arrays, static):
+        outs = _orig_call_primitive(name, arrays, static)
+        if _op_stats is not None:
+            for o in outs:
+                dt = str(getattr(o, "dtype", "other"))
+                _op_stats[name][dt] = _op_stats[name].get(dt, 0) + 1
+        return outs
+
+    dispatch.call_primitive = counted
 
 
 def enable_operator_stats_collection():
-    pass
+    global _op_stats
+    _op_stats = defaultdict(dict)
+    _install_stats_hook()
 
 
 def disable_operator_stats_collection():
-    pass
+    global _op_stats
+    stats = _op_stats
+    _op_stats = None
+    if stats:
+        print("<" + "-" * 28 + " op list " + "-" * 28 + ">")
+        print(f"{'op':<32}{'dtype':<12}{'count':<8}")
+        for op, by_dtype in sorted(stats.items()):
+            for dt, n in by_dtype.items():
+                print(f"{op:<32}{dt:<12}{n:<8}")
+    return dict(stats) if stats is not None else {}
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """Reference: debugging.py collect_operator_stats context manager."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def compare_accuracy(dump_path: str, another_dump_path: str,
+                     output_filename: str, loss_scale: float = 1,
+                     dump_all_tensors: bool = False):
+    """Reference: debugging.py compare_accuracy consumes check_nan_inf
+    GPU dump files; this framework checks values in-process instead."""
+    raise NotImplementedError(
+        "compare_accuracy consumes dump files from the reference's GPU "
+        "runs; use collect_operator_stats() + check_numerics() in-process"
+    )

@@ -1,0 +1,209 @@
+"""Distributed auto-tuner: search over parallelism configs.
+
+Reference: python/paddle/distributed/auto_tuner/ — tuner.py:21 (Tuner:
+candidate generation + history), prune.py (divisibility/memory prune
+rules), cost_model.py, recorder.py.
+
+TPU re-design: the search space is (dp, mp, pp, sharding stage,
+micro-batch, recompute) over a chip count; pruning uses an analytic HBM
+model and the cost model scores configs with an MXU-utilization +
+ICI-collective-volume estimate (the "How to Scale Your Model" roofline
+recipe). `Tuner.search()` is pure/offline; `Tuner.run(trial_fn)`
+measures real trials and keeps the best, like the reference's
+launch-based loop.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["TuneSpace", "Candidate", "Tuner", "prune_candidates",
+           "estimate_memory_bytes", "estimate_step_time_s"]
+
+
+@dataclass
+class TuneSpace:
+    """Model + hardware description (reference: tuner_cfg dict)."""
+
+    # model
+    num_layers: int = 32
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    vocab_size: int = 32000
+    seq_length: int = 4096
+    global_batch_size: int = 32
+    dtype_bytes: int = 2          # bf16 params/activations
+    # hardware
+    num_devices: int = 8
+    hbm_bytes: float = 95e9       # v5p HBM
+    peak_flops: float = 459e12    # v5p bf16
+    ici_bandwidth: float = 90e9   # bytes/s per link, one direction
+    # search space (None → derive from num_devices)
+    dp_degree: Optional[List[int]] = None
+    mp_degree: Optional[List[int]] = None
+    pp_degree: Optional[List[int]] = None
+    sharding_stage: List[int] = field(default_factory=lambda: [0, 1, 2, 3])
+    micro_batch_size: Optional[List[int]] = None
+    use_recompute: List[bool] = field(default_factory=lambda: [False, True])
+
+    def degrees(self) -> List[int]:
+        return [d for d in (1, 2, 4, 8, 16, 32, 64, 128, 256)
+                if d <= self.num_devices]
+
+
+@dataclass
+class Candidate:
+    dp: int
+    mp: int
+    pp: int
+    sharding_stage: int
+    micro_batch_size: int
+    recompute: bool
+    memory_bytes: float = 0.0
+    est_step_time_s: float = float("inf")
+    measured_time_s: Optional[float] = None
+    pruned_reason: Optional[str] = None
+
+    def as_dict(self) -> Dict:
+        return {
+            "dp_degree": self.dp, "mp_degree": self.mp,
+            "pp_degree": self.pp, "sharding_stage": self.sharding_stage,
+            "micro_batch_size": self.micro_batch_size,
+            "use_recompute": self.recompute,
+        }
+
+
+def _param_count(space: TuneSpace) -> float:
+    h, i, v, L = (space.hidden_size, space.intermediate_size,
+                  space.vocab_size, space.num_layers)
+    per_layer = 4 * h * h + 3 * h * i + 2 * h  # attn + swiglu mlp + norms
+    return L * per_layer + 2 * v * h
+
+
+def estimate_memory_bytes(space: TuneSpace, c: Candidate) -> float:
+    """Per-chip HBM estimate (reference: prune.py memory rules; Megatron
+    activation formulas, recompute ≈ keeps only layer inputs)."""
+    P = _param_count(space)
+    shard_params = c.mp * c.pp * (c.dp if c.sharding_stage >= 3 else 1)
+    shard_opt = c.mp * c.pp * (c.dp if c.sharding_stage >= 1 else 1)
+    param_mem = P * space.dtype_bytes / shard_params
+    grad_mem = P * space.dtype_bytes / (
+        c.mp * c.pp * (c.dp if c.sharding_stage >= 2 else 1))
+    # AdamW fp32 master + 2 moments
+    opt_mem = P * 12 / shard_opt
+    # activations per micro-batch per layer ≈ s*b*h*(34 + 5*a*s/h) bytes/2
+    s = space.seq_length
+    b = c.micro_batch_size
+    h = space.hidden_size
+    layers_here = space.num_layers / c.pp
+    if c.recompute:
+        act_per_layer = s * b * h * space.dtype_bytes  # layer inputs only
+    else:
+        act_per_layer = s * b * h * 34 / 2 * space.dtype_bytes / c.mp
+    # pipeline keeps up to pp in-flight micro-batches of activations
+    act_mem = act_per_layer * layers_here * min(c.pp, 2 if c.pp == 1 else c.pp)
+    return param_mem + grad_mem + opt_mem + act_mem
+
+
+def estimate_step_time_s(space: TuneSpace, c: Candidate) -> float:
+    """Roofline step-time estimate: MXU compute + TP allreduce volume over
+    ICI + PP bubble + DP grad reduction (reference: cost_model.py)."""
+    P = _param_count(space)
+    tokens = space.global_batch_size * space.seq_length
+    flops = 6 * P * tokens * (4 / 3 if c.recompute else 1)
+    mfu_ceiling = 0.55 if c.mp <= 8 else 0.45
+    compute = flops / (space.num_devices * space.peak_flops * mfu_ceiling)
+
+    # TP: 2 allreduces (fwd+bwd each) per layer over activations
+    s_local = space.seq_length
+    b_local = space.global_batch_size / c.dp
+    act_bytes = b_local * s_local * space.hidden_size * space.dtype_bytes
+    tp_volume = 4 * space.num_layers * act_bytes * 2 * (c.mp - 1) / c.mp
+    tp_time = tp_volume / space.ici_bandwidth if c.mp > 1 else 0.0
+
+    # PP bubble fraction: (pp-1)/(m + pp - 1)
+    m = max(1, space.global_batch_size // (c.dp * c.micro_batch_size))
+    bubble = (c.pp - 1) / (m + c.pp - 1) if c.pp > 1 else 0.0
+
+    # DP grad allreduce (or reduce-scatter under sharding)
+    grad_bytes = P * space.dtype_bytes / (c.mp * c.pp)
+    dp_time = (2 * (c.dp - 1) / c.dp * grad_bytes /
+               space.ici_bandwidth) if c.dp > 1 else 0.0
+
+    return (compute + tp_time) / (1 - bubble) + dp_time
+
+
+def prune_candidates(space: TuneSpace,
+                     candidates: List[Candidate]) -> List[Candidate]:
+    """Reference: prune.py rule chain. Marks pruned_reason instead of
+    dropping silently."""
+    kept = []
+    for c in candidates:
+        if c.dp * c.mp * c.pp != space.num_devices:
+            c.pruned_reason = "dp*mp*pp != num_devices"
+        elif space.hidden_size % c.mp != 0:
+            c.pruned_reason = "hidden_size % mp != 0"
+        elif space.vocab_size % c.mp != 0:
+            c.pruned_reason = "vocab_size % mp != 0"
+        elif space.num_layers % c.pp != 0:
+            c.pruned_reason = "num_layers % pp != 0"
+        elif space.global_batch_size % (c.dp * c.micro_batch_size) != 0:
+            c.pruned_reason = "global_batch % (dp*micro) != 0"
+        elif c.sharding_stage > 0 and c.dp == 1:
+            c.pruned_reason = "sharding needs dp > 1"
+        else:
+            c.memory_bytes = estimate_memory_bytes(space, c)
+            if c.memory_bytes > space.hbm_bytes:
+                c.pruned_reason = (
+                    f"memory {c.memory_bytes/1e9:.1f}GB > HBM "
+                    f"{space.hbm_bytes/1e9:.1f}GB")
+        if c.pruned_reason is None:
+            kept.append(c)
+    return kept
+
+
+class Tuner:
+    """Reference: tuner.py:21 Tuner."""
+
+    def __init__(self, space: TuneSpace):
+        self.space = space
+        self.history: List[Candidate] = []
+
+    def candidates(self) -> List[Candidate]:
+        sp = self.space
+        dps = sp.dp_degree or sp.degrees()
+        mps = sp.mp_degree or sp.degrees()
+        pps = sp.pp_degree or sp.degrees()
+        micros = sp.micro_batch_size or [1, 2, 4, 8]
+        out = []
+        for dp, mp, pp, stage, micro, rc in itertools.product(
+                dps, mps, pps, sp.sharding_stage, micros, sp.use_recompute):
+            out.append(Candidate(dp, mp, pp, stage, micro, rc))
+        return out
+
+    def search(self, top_k: int = 5) -> List[Candidate]:
+        """Offline search: generate → prune → score → rank."""
+        kept = prune_candidates(self.space, self.candidates())
+        for c in kept:
+            c.est_step_time_s = estimate_step_time_s(self.space, c)
+        kept.sort(key=lambda c: c.est_step_time_s)
+        self.history = kept
+        return kept[:top_k]
+
+    def run(self, trial_fn: Callable[[Dict], float],
+            max_trials: int = 8) -> Candidate:
+        """Measured search: launch trial_fn(cfg) on the top candidates and
+        keep the fastest (reference: the tuner's launch+record loop)."""
+        best: Optional[Candidate] = None
+        for c in self.search(top_k=max_trials):
+            try:
+                c.measured_time_s = float(trial_fn(c.as_dict()))
+            except Exception:
+                c.pruned_reason = "trial failed"
+                continue
+            if best is None or c.measured_time_s < best.measured_time_s:
+                best = c
+        if best is None:
+            raise RuntimeError("auto-tuner: every trial failed")
+        return best

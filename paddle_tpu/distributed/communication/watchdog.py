@@ -1,0 +1,136 @@
+"""Async communication watchdog.
+
+Reference: paddle/phi/core/distributed/comm_task_manager.h
+(CommTaskManager + NCCLCommTask — tracks async collective status and
+flags hangs/timeouts; FLAGS_stop_check_timeout read at parallel.py:1133).
+
+TPU re-design: XLA collectives complete inside compiled programs, so the
+hang surface moves to HOST-side coordination — store rendezvous,
+barriers, cross-host data waits. CommTaskManager watches those: register
+a task around any blocking wait; a daemon thread flags tasks that
+outlive their timeout (warn, then abort like the reference's
+FLAGS_stop_check_timeout behavior).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from typing import Dict, Optional
+
+from ...core import flags
+
+__all__ = ["CommTask", "CommTaskManager", "get_comm_task_manager"]
+
+
+class CommTask:
+    """One in-flight communication/coordination op."""
+
+    __slots__ = ("name", "start_s", "timeout_s", "done", "warned")
+
+    def __init__(self, name: str, timeout_s: float):
+        self.name = name
+        self.start_s = time.time()
+        self.timeout_s = timeout_s
+        self.done = False
+        self.warned = False
+
+    def elapsed_s(self) -> float:
+        return time.time() - self.start_s
+
+    def overdue(self) -> bool:
+        return not self.done and self.elapsed_s() > self.timeout_s
+
+
+class CommTaskManager:
+    """Reference: comm_task_manager.h:?? CommTaskManager — a loop thread
+    scanning in-flight tasks."""
+
+    def __init__(self, scan_interval_s: float = 1.0):
+        self._tasks: Dict[int, CommTask] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._scan_interval_s = scan_interval_s
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._overdue_log = []
+
+    # -- task lifecycle --------------------------------------------------
+    def start_task(self, name: str, timeout_s: Optional[float] = None) -> int:
+        if timeout_s is None:
+            timeout_s = float(flags.get_flag("stop_check_timeout"))
+        task = CommTask(name, timeout_s)
+        with self._lock:
+            self._seq += 1
+            tid = self._seq
+            self._tasks[tid] = task
+        self._ensure_thread()
+        return tid
+
+    def end_task(self, tid: int):
+        with self._lock:
+            task = self._tasks.pop(tid, None)
+            if task is not None:
+                task.done = True
+
+    def task(self, name: str, timeout_s: Optional[float] = None):
+        """Context manager form: with manager.task('barrier'): ..."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            tid = self.start_task(name, timeout_s)
+            try:
+                yield
+            finally:
+                self.end_task(tid)
+
+        return cm()
+
+    # -- watchdog loop ---------------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self._scan_interval_s):
+            with self._lock:
+                tasks = list(self._tasks.values())
+            if not tasks:
+                continue
+            for t in tasks:
+                if t.overdue() and not t.warned:
+                    t.warned = True
+                    msg = (f"CommTaskManager: task '{t.name}' has been "
+                           f"in-flight for {t.elapsed_s():.0f}s "
+                           f"(timeout {t.timeout_s:.0f}s) — probable "
+                           f"distributed hang")
+                    self._overdue_log.append(msg)
+                    warnings.warn(msg)
+
+    def overdue_tasks(self):
+        with self._lock:
+            return [t for t in self._tasks.values() if t.overdue()]
+
+    def overdue_log(self):
+        return list(self._overdue_log)
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+_manager: Optional[CommTaskManager] = None
+_manager_lock = threading.Lock()
+
+
+def get_comm_task_manager() -> CommTaskManager:
+    global _manager
+    with _manager_lock:
+        if _manager is None:
+            _manager = CommTaskManager()
+        return _manager

@@ -141,15 +141,20 @@ def barrier(group=None):
     if _store is not None and nprocs > 1:
         _barrier_epoch += 1
         key = f"{_key_prefix}barrier/{_barrier_epoch}"
-        _store.add(key, 1)
-        deadline = 900
+        from ..core.flags import get_flag
+        from .communication.watchdog import get_comm_task_manager
+
+        deadline = float(get_flag("stop_check_timeout"))
         import time as _time
 
-        t0 = _time.time()
-        while int(_store.get(key)) < nprocs:
-            if _time.time() - t0 > deadline:
-                raise TimeoutError("barrier timed out")
-            _time.sleep(0.01)
+        with get_comm_task_manager().task(f"barrier#{_barrier_epoch}",
+                                          timeout_s=deadline):
+            _store.add(key, 1)
+            t0 = _time.time()
+            while int(_store.get(key)) < nprocs:
+                if _time.time() - t0 > deadline:
+                    raise TimeoutError("barrier timed out")
+                _time.sleep(0.01)
     devs = jax.devices()
     if len(devs) <= 1:
         return

@@ -1,0 +1,137 @@
+"""amp.debugging + comm watchdog + auto-tuner tests."""
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.amp import debugging
+from paddle_tpu.distributed.auto_tuner import (
+    Candidate, Tuner, TuneSpace, estimate_memory_bytes, prune_candidates,
+)
+from paddle_tpu.distributed.communication.watchdog import CommTaskManager
+
+
+class TestTensorChecker:
+    def test_nan_detection_via_dispatch(self):
+        cfg = debugging.TensorCheckerConfig(enable=True)
+        debugging.enable_tensor_checker(cfg)
+        try:
+            x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+            with pytest.raises(FloatingPointError):
+                _ = x / paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        finally:
+            debugging.disable_tensor_checker()
+        # disabled → no raise
+        y = x / paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        assert not np.isfinite(np.asarray(y._value)).all()
+
+    def test_check_numerics(self):
+        t = paddle.to_tensor(np.array([1.0, np.nan, np.inf, 0.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            debugging.check_numerics(t, "op", "var")
+        nan, inf, zero = debugging.check_numerics(
+            t, "op", "var", debug_mode=debugging.DebugMode.CHECK_NAN_INF)
+        assert int(nan._value) == 1
+        assert int(inf._value) == 1
+        assert int(zero._value) == 1
+
+    def test_operator_stats(self, capsys):
+        with debugging.collect_operator_stats():
+            a = paddle.to_tensor(np.ones(4, np.float32))
+            _ = a + a
+            _ = a * a
+        out = capsys.readouterr().out
+        assert "op list" in out
+        assert "float32" in out
+
+
+class TestCommWatchdog:
+    def test_overdue_task_warned(self):
+        mgr = CommTaskManager(scan_interval_s=0.05)
+        try:
+            tid = mgr.start_task("slow_barrier", timeout_s=0.1)
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                time.sleep(0.4)
+            assert any("slow_barrier" in str(x.message) for x in w), \
+                [str(x.message) for x in w]
+            assert mgr.overdue_tasks()
+            mgr.end_task(tid)
+            assert not mgr.overdue_tasks()
+        finally:
+            mgr.shutdown()
+
+    def test_completed_task_not_warned(self):
+        mgr = CommTaskManager(scan_interval_s=0.05)
+        try:
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                with mgr.task("fast", timeout_s=5):
+                    pass
+                time.sleep(0.15)
+            assert not any("fast" in str(x.message) for x in w)
+        finally:
+            mgr.shutdown()
+
+
+class TestAutoTuner:
+    def _space(self):
+        return TuneSpace(
+            num_layers=32, hidden_size=4096, intermediate_size=11008,
+            vocab_size=32000, seq_length=4096, global_batch_size=64,
+            num_devices=8, hbm_bytes=95e9,
+        )
+
+    def test_prune_rules(self):
+        space = self._space()
+        bad = [
+            Candidate(dp=3, mp=2, pp=1, sharding_stage=0,
+                      micro_batch_size=1, recompute=False),   # 3*2*1 != 8
+            Candidate(dp=1, mp=8, pp=1, sharding_stage=1,
+                      micro_batch_size=1, recompute=False),   # sharding, dp=1
+            Candidate(dp=8, mp=1, pp=1, sharding_stage=0,
+                      micro_batch_size=3, recompute=False),   # 64 % 24 != 0
+        ]
+        kept = prune_candidates(space, bad)
+        assert kept == []
+        assert all(c.pruned_reason for c in bad)
+
+    def test_memory_model_monotonic_in_sharding(self):
+        space = self._space()
+        base = Candidate(dp=8, mp=1, pp=1, sharding_stage=0,
+                         micro_batch_size=1, recompute=True)
+        z1 = Candidate(dp=8, mp=1, pp=1, sharding_stage=1,
+                       micro_batch_size=1, recompute=True)
+        z3 = Candidate(dp=8, mp=1, pp=1, sharding_stage=3,
+                       micro_batch_size=1, recompute=True)
+        m0 = estimate_memory_bytes(space, base)
+        m1 = estimate_memory_bytes(space, z1)
+        m3 = estimate_memory_bytes(space, z3)
+        assert m0 > m1 > m3
+
+    def test_search_returns_valid_ranked_configs(self):
+        space = self._space()
+        tuner = Tuner(space)
+        top = tuner.search(top_k=5)
+        assert top, "no valid configs found"
+        for c in top:
+            assert c.dp * c.mp * c.pp == 8
+            assert c.memory_bytes <= space.hbm_bytes
+            assert np.isfinite(c.est_step_time_s)
+        times = [c.est_step_time_s for c in top]
+        assert times == sorted(times)
+
+    def test_run_measured_trials(self):
+        space = self._space()
+        tuner = Tuner(space)
+
+        def trial(cfg):
+            # pretend pure-DP is fastest
+            return 1.0 if cfg["mp_degree"] == 1 and cfg["pp_degree"] == 1 \
+                else 2.0
+
+        best = tuner.run(trial, max_trials=6)
+        assert best.measured_time_s is not None
+        assert best.measured_time_s <= 2.0
