@@ -29,6 +29,8 @@ from jax.experimental.pallas import tpu as pltpu
 from ...core import dispatch
 
 NEG_INF = float("-inf")
+Z = __import__("numpy").int32(0)  # index-map literal: stays i32 under jax_enable_x64
+LANES = 128  # lse/delta lane padding (TPU (8,128) tiling; see _fwd_kernel)
 
 
 def _interpret() -> bool:
@@ -42,6 +44,19 @@ def _pick_block(n: int, target: int = 512) -> int:
     while b > 1 and n % b:
         b //= 2
     return max(b, 1)
+
+
+
+def _kv_head_map(g: int):
+    """Index-map component mapping q head -> kv head (GQA). `h // g` via
+    jnp inside an index map trips an int-promotion convert_element_type
+    cycle in Mosaic lowering; use an identity map for g==1 and a
+    same-dtype lax.div otherwise."""
+    if g == 1:
+        return lambda h: h
+    import numpy as _np
+
+    return lambda h: jax.lax.div(h, _np.int32(g))
 
 
 # ---------------------------------------------------------------------------
@@ -104,7 +119,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
         m = m_scr[:, :1]
         lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
-        lse_ref[0, 0] = lse[:, 0]
+        # lse is carried in a 128-lane layout ([..., Sq, LANES]) — TPU block
+        # shapes need the last two dims (8, 128)-tileable, so a [B, H, Sq]
+        # output with (1, 1, block_q) blocks is not expressible
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale"))
@@ -116,6 +134,7 @@ def _flash_fwd_bhsd(q, k, v, *, causal, scale):
     block_q = _pick_block(Sq)
     block_k = _pick_block(Sk)
     nq, nk = Sq // block_q, Sk // block_k
+    kv_head = _kv_head_map(g)
     grid = (B, H, nq, nk)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
@@ -124,19 +143,20 @@ def _flash_fwd_bhsd(q, k, v, *, causal, scale):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, Z)),
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, i, j: (b, h // g, j, 0)),
+                         lambda b, h, i, j: (b, kv_head(h), j, Z)),
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, i, j: (b, h // g, j, 0)),
+                         lambda b, h, i, j: (b, kv_head(h), j, Z)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, Z)),
+            pl.BlockSpec((1, 1, block_q, LANES),
+                         lambda b, h, i, j: (b, h, i, Z)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Sq, LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -154,7 +174,7 @@ def _flash_fwd_bhsd(q, k, v, *, causal, scale):
         ),
         interpret=_interpret(),
     )(q, k, v)
-    return out, lse
+    return out, lse[:, :, :, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -174,8 +194,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0][:, :1]  # lane-padded [block_q, LANES]
+        delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -221,8 +241,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0][:, :1]  # lane-padded [block_q, LANES]
+        delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -265,7 +285,11 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, *, causal, scale):
     block_q = _pick_block(Sq)
     block_k = _pick_block(Sk)
     nq, nk = Sq // block_q, Sk // block_k
+    kv_head = _kv_head_map(g)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    # lane-pad lse/delta to [B, H, Sq, LANES] (see _fwd_kernel finalize)
+    lse = jnp.broadcast_to(lse[..., None], (B, H, Sq, LANES))
+    delta = jnp.broadcast_to(delta[..., None], (B, H, Sq, LANES))
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal,
@@ -274,17 +298,19 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, *, causal, scale):
         dq_kernel,
         grid=(B, H, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, Z)),
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, i, j: (b, h // g, j, 0)),
+                         lambda b, h, i, j: (b, kv_head(h), j, Z)),
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, i, j: (b, h // g, j, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+                         lambda b, h, i, j: (b, kv_head(h), j, Z)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, Z)),
+            pl.BlockSpec((1, 1, block_q, LANES),
+                         lambda b, h, i, j: (b, h, i, Z)),
+            pl.BlockSpec((1, 1, block_q, LANES),
+                         lambda b, h, i, j: (b, h, i, Z)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, i, j: (b, h, i, 0)),
+                               lambda b, h, i, j: (b, h, i, Z)),
         out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
@@ -302,18 +328,20 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, *, causal, scale):
         dkv_kernel,
         grid=(B, H, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, Z)),
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, j, i: (b, h // g, j, 0)),
+                         lambda b, h, j, i: (b, kv_head(h), j, Z)),
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, j, i: (b, h // g, j, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i)),
+                         lambda b, h, j, i: (b, kv_head(h), j, Z)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, Z)),
+            pl.BlockSpec((1, 1, block_q, LANES),
+                         lambda b, h, j, i: (b, h, i, Z)),
+            pl.BlockSpec((1, 1, block_q, LANES),
+                         lambda b, h, j, i: (b, h, i, Z)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, Z)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, Z)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),

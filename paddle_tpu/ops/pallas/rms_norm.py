@@ -18,7 +18,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ...core import dispatch
-from .flash_attention import _interpret, _pick_block
+from .flash_attention import Z, _interpret, _pick_block
 
 
 def _fwd_kernel(x_ref, w_ref, y_ref, *, eps):
@@ -59,10 +59,10 @@ def _rms_fwd(x, w, *, eps):
         functools.partial(_fwd_kernel, eps=eps),
         grid=(rows // block_r,),
         in_specs=[
-            pl.BlockSpec((block_r, hidden), lambda r: (r, 0)),
-            pl.BlockSpec((hidden,), lambda r: (0,)),
+            pl.BlockSpec((block_r, hidden), lambda r: (r, Z)),
+            pl.BlockSpec((hidden,), lambda r: (Z,)),
         ],
-        out_specs=pl.BlockSpec((block_r, hidden), lambda r: (r, 0)),
+        out_specs=pl.BlockSpec((block_r, hidden), lambda r: (r, Z)),
         out_shape=jax.ShapeDtypeStruct((rows, hidden), x.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",)),
@@ -83,13 +83,13 @@ def _rms_bwd(x, w, g, *, eps):
         functools.partial(_bwd_kernel, eps=eps, nr=nr),
         grid=(nr,),
         in_specs=[
-            pl.BlockSpec((block_r, hidden), lambda r: (r, 0)),
-            pl.BlockSpec((hidden,), lambda r: (0,)),
-            pl.BlockSpec((block_r, hidden), lambda r: (r, 0)),
+            pl.BlockSpec((block_r, hidden), lambda r: (r, Z)),
+            pl.BlockSpec((hidden,), lambda r: (Z,)),
+            pl.BlockSpec((block_r, hidden), lambda r: (r, Z)),
         ],
         out_specs=[
-            pl.BlockSpec((block_r, hidden), lambda r: (r, 0)),
-            pl.BlockSpec((hidden,), lambda r: (0,)),
+            pl.BlockSpec((block_r, hidden), lambda r: (r, Z)),
+            pl.BlockSpec((hidden,), lambda r: (Z,)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows, hidden), x.dtype),
