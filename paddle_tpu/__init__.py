@@ -63,10 +63,20 @@ import sys as _sys
 
 def __getattr__(name):
     # heavyweight subpackages loaded on demand
-    if name in ("distributed", "vision", "profiler", "hapi", "callbacks"):
+    if name in ("distributed", "vision", "profiler", "hapi", "callbacks",
+                "fft", "signal", "distribution", "geometric", "quantization",
+                "text", "audio", "dataset", "hub", "sysconfig", "linalg",
+                "regularizer", "decomposition"):
         import importlib
 
-        mod = importlib.import_module(f".{name}", __name__)
+        try:
+            mod = importlib.import_module(f".{name}", __name__)
+        except ModuleNotFoundError as e:
+            # PEP 562: missing attributes must surface as AttributeError so
+            # hasattr()/getattr()-based feature detection works.
+            raise AttributeError(
+                f"module 'paddle_tpu' has no attribute {name!r}"
+            ) from e
         setattr(_sys.modules[__name__], name, mod)
         return mod
     if name in ("Model", "summary"):

@@ -1,0 +1,21 @@
+"""``paddle.linalg`` namespace — re-exports the linear-algebra op surface.
+
+Reference: python/paddle/linalg.py (a pure re-export module over
+paddle/tensor/linalg.py); here the implementations live in
+``paddle_tpu.ops.linalg``.
+"""
+from .ops.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh, eigvals,
+    eigvalsh, householder_product, inv, lstsq, lu, matrix_norm, matrix_power,
+    matrix_rank, multi_dot, norm, pinv, qr, slogdet, solve, svd,
+    triangular_solve, vector_norm,
+)
+from .ops.math import matmul  # noqa: F401
+
+__all__ = [
+    "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det", "eig",
+    "eigh", "eigvals", "eigvalsh", "householder_product", "inv", "lstsq",
+    "lu", "matmul", "matrix_norm", "matrix_power", "matrix_rank", "multi_dot",
+    "norm", "pinv", "qr", "slogdet", "solve", "svd", "triangular_solve",
+    "vector_norm",
+]

@@ -1,0 +1,195 @@
+"""paddle.fft / paddle.signal vs numpy oracle.
+
+Mirrors the reference test strategy (test/fft/test_fft.py: numpy.fft as the
+oracle across norm conventions; test/signal: stft/istft round trips).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestFFT1D:
+    @pytest.mark.parametrize("norm", ["backward", "forward", "ortho"])
+    def test_fft_ifft_roundtrip(self, norm):
+        x = np.random.randn(4, 16).astype("float32") + 1j * np.random.randn(4, 16).astype("float32")
+        x = x.astype("complex64")
+        y = paddle.fft.fft(paddle.to_tensor(x), norm=norm)
+        np.testing.assert_allclose(_np(y), np.fft.fft(x, norm=norm), rtol=1e-4, atol=1e-4)
+        back = paddle.fft.ifft(y, norm=norm)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-4, atol=1e-4)
+
+    def test_fft_n_axis(self):
+        x = np.random.randn(3, 10).astype("float32")
+        y = paddle.fft.fft(paddle.to_tensor(x), n=16, axis=0)
+        np.testing.assert_allclose(_np(y), np.fft.fft(x, n=16, axis=0), rtol=1e-4, atol=1e-4)
+
+    def test_rfft_irfft(self):
+        x = np.random.randn(5, 32).astype("float32")
+        y = paddle.fft.rfft(paddle.to_tensor(x))
+        np.testing.assert_allclose(_np(y), np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+        back = paddle.fft.irfft(y)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-4, atol=1e-4)
+
+    def test_hfft_ihfft(self):
+        x = np.random.randn(17).astype("float32")
+        h = paddle.fft.hfft(paddle.to_tensor(x.astype("complex64")))
+        np.testing.assert_allclose(_np(h), np.fft.hfft(x), rtol=1e-4, atol=1e-4)
+        ih = paddle.fft.ihfft(paddle.to_tensor(np.fft.hfft(x).astype("float32")))
+        np.testing.assert_allclose(_np(ih), np.fft.ihfft(np.fft.hfft(x)), rtol=1e-4, atol=1e-4)
+
+    def test_bad_norm_raises(self):
+        with pytest.raises(ValueError):
+            paddle.fft.fft(paddle.ones([4]), norm="bogus")
+
+
+class TestFFTND:
+    def test_fft2(self):
+        x = (np.random.randn(2, 8, 8) + 1j * np.random.randn(2, 8, 8)).astype("complex64")
+        y = paddle.fft.fft2(paddle.to_tensor(x))
+        np.testing.assert_allclose(_np(y), np.fft.fft2(x), rtol=1e-3, atol=1e-3)
+
+    def test_rfftn_irfftn(self):
+        x = np.random.randn(4, 6, 8).astype("float32")
+        y = paddle.fft.rfftn(paddle.to_tensor(x))
+        np.testing.assert_allclose(_np(y), np.fft.rfftn(x), rtol=1e-3, atol=1e-3)
+        back = paddle.fft.irfftn(y, s=x.shape)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-3, atol=1e-3)
+
+    def test_fftn_s_axes(self):
+        x = (np.random.randn(3, 4, 5) + 0j).astype("complex64")
+        y = paddle.fft.fftn(paddle.to_tensor(x), s=(8, 8), axes=(1, 2))
+        np.testing.assert_allclose(_np(y), np.fft.fftn(x, s=(8, 8), axes=(1, 2)), rtol=1e-3, atol=1e-3)
+
+
+class TestHelpers:
+    def test_fftfreq(self):
+        np.testing.assert_allclose(_np(paddle.fft.fftfreq(9, d=0.5)), np.fft.fftfreq(9, 0.5).astype("float32"), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.fft.rfftfreq(9, d=0.5)), np.fft.rfftfreq(9, 0.5).astype("float32"), rtol=1e-6)
+
+    def test_fftshift_roundtrip(self):
+        x = np.random.randn(4, 5).astype("float32")
+        s = paddle.fft.fftshift(paddle.to_tensor(x))
+        np.testing.assert_allclose(_np(s), np.fft.fftshift(x), rtol=1e-6)
+        back = paddle.fft.ifftshift(s)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-6)
+
+    def test_fft_grad(self):
+        # FFT is linear: d/dx sum(|fft(x)|^2) = 2*n*x by Parseval
+        x = paddle.to_tensor(np.random.randn(8).astype("float32"), stop_gradient=False)
+        y = paddle.fft.rfft(x)
+        loss = (y.abs() ** 2).sum() - (y.abs() ** 2)[0] * 0  # keep graph simple
+        loss.backward()
+        assert x.grad is not None
+
+
+class TestHFFTN:
+    def test_hfftn_vs_scipy(self):
+        scipy_fft = pytest.importorskip("scipy.fft")
+        x = (np.random.randn(4, 5, 8) + 1j * np.random.randn(4, 5, 8)).astype("complex64")
+        for norm in ("backward", "forward", "ortho"):
+            y = paddle.fft.hfftn(paddle.to_tensor(x), norm=norm)
+            np.testing.assert_allclose(_np(y), scipy_fft.hfftn(x, norm=norm), rtol=1e-3, atol=1e-3)
+
+    def test_hfft2_vs_scipy(self):
+        scipy_fft = pytest.importorskip("scipy.fft")
+        x = (np.random.randn(4, 8) + 1j * np.random.randn(4, 8)).astype("complex64")
+        y = paddle.fft.hfft2(paddle.to_tensor(x))
+        np.testing.assert_allclose(_np(y), scipy_fft.hfft2(x), rtol=1e-3, atol=1e-3)
+
+    def test_ihfftn_vs_scipy(self):
+        scipy_fft = pytest.importorskip("scipy.fft")
+        x = np.random.randn(4, 5, 8).astype("float32")
+        y = paddle.fft.ihfftn(paddle.to_tensor(x))
+        np.testing.assert_allclose(_np(y), scipy_fft.ihfftn(x), rtol=1e-3, atol=1e-3)
+
+
+class TestSignal:
+    def test_frame(self):
+        x = np.arange(10, dtype="float32")
+        f = paddle.signal.frame(paddle.to_tensor(x), frame_length=4, hop_length=2)
+        assert tuple(f.shape) == (4, 4)
+        np.testing.assert_allclose(np.asarray(f._value)[:, 0], x[0:4])
+        np.testing.assert_allclose(np.asarray(f._value)[:, 1], x[2:6])
+
+    def test_overlap_add_inverts_disjoint_frames(self):
+        x = np.random.randn(2, 4, 3).astype("float32")  # hop == frame_length
+        y = paddle.signal.overlap_add(paddle.to_tensor(x), hop_length=4)
+        np.testing.assert_allclose(np.asarray(y._value), x.transpose(0, 2, 1).reshape(2, 12), rtol=1e-6)
+
+    def test_stft_matches_manual(self):
+        n_fft, hop = 16, 4
+        x = np.random.randn(64).astype("float32")
+        w = np.hanning(n_fft).astype("float32")
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+                                  window=paddle.to_tensor(w), center=False)
+        # manual frame 0
+        ref0 = np.fft.rfft(x[:n_fft] * w)
+        np.testing.assert_allclose(np.asarray(spec._value)[:, 0], ref0, rtol=1e-3, atol=1e-3)
+
+    def test_stft_istft_roundtrip(self):
+        n_fft, hop = 32, 8
+        x = np.random.randn(2, 128).astype("float32")
+        w = np.hanning(n_fft).astype("float32")
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+                                  window=paddle.to_tensor(w))
+        rec = paddle.signal.istft(spec, n_fft, hop_length=hop,
+                                  window=paddle.to_tensor(w), length=128)
+        np.testing.assert_allclose(np.asarray(rec._value), x, rtol=1e-3, atol=1e-3)
+
+    def test_frame_axis0(self):
+        # reference signal.py docstring: 1-D axis=0 -> (num_frames, frame_length)
+        x = np.arange(8, dtype="float32")
+        y = paddle.signal.frame(paddle.to_tensor(x), frame_length=4, hop_length=2, axis=0)
+        assert tuple(y.shape) == (3, 4)
+        np.testing.assert_allclose(np.asarray(y._value)[0], x[0:4])
+        np.testing.assert_allclose(np.asarray(y._value)[1], x[2:6])
+        # 2-D (seq, ...) axis=0 -> (num_frames, frame_length, ...)
+        x2 = np.arange(16, dtype="float32").reshape(8, 2)
+        y2 = paddle.signal.frame(paddle.to_tensor(x2), frame_length=4, hop_length=2, axis=0)
+        assert tuple(y2.shape) == (3, 4, 2)
+        np.testing.assert_allclose(np.asarray(y2._value)[1], x2[2:6])
+
+    def test_overlap_add_axis0(self):
+        x = np.random.randn(3, 4, 2).astype("float32")  # (nf, fl, ...)
+        y = paddle.signal.overlap_add(paddle.to_tensor(x), hop_length=4, axis=0)
+        assert tuple(y.shape) == (12, 2)
+        np.testing.assert_allclose(np.asarray(y._value), x.reshape(12, 2), rtol=1e-6)
+
+    def test_stft_differentiable(self):
+        x = paddle.to_tensor(np.random.randn(64).astype("float32"), stop_gradient=False)
+        spec = paddle.signal.stft(x, n_fft=16, hop_length=4)
+        assert not spec.stop_gradient
+        loss = (spec.abs() ** 2).sum()
+        loss.backward()
+        assert x.grad is not None
+        assert float(np.abs(np.asarray(x.grad._value)).max()) > 0
+
+    def test_frame_validation(self):
+        with pytest.raises(ValueError):
+            paddle.signal.frame(paddle.ones([4]), frame_length=8, hop_length=2)
+        with pytest.raises(ValueError):
+            paddle.signal.frame(paddle.ones([8]), frame_length=4, hop_length=0)
+        with pytest.raises(ValueError):
+            paddle.signal.frame(paddle.ones([4, 8]), frame_length=2, hop_length=1, axis=1)
+
+    def test_stft_validation(self):
+        # complex input requires onesided=False
+        z = paddle.to_tensor((np.random.randn(64) + 1j * np.random.randn(64)).astype("complex64"))
+        with pytest.raises(ValueError):
+            paddle.signal.stft(z, n_fft=16)
+        spec = paddle.signal.stft(z, n_fft=16, onesided=False)
+        assert spec.shape[0] == 16
+        # too-short input
+        with pytest.raises(ValueError):
+            paddle.signal.stft(paddle.ones([10]), n_fft=16, center=False)
+        # istft bin-count check
+        with pytest.raises(ValueError):
+            paddle.signal.istft(paddle.ones([16, 5], dtype="complex64"), n_fft=16)
+
+    def test_lazy_attr_error(self):
+        assert not hasattr(paddle, "definitely_not_a_module")
