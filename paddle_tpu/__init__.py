@@ -72,6 +72,9 @@ def __getattr__(name):
         try:
             mod = importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:
+            if e.name != f"{__name__}.{name}":
+                # a dependency inside the submodule is missing — surface it
+                raise
             # PEP 562: missing attributes must surface as AttributeError so
             # hasattr()/getattr()-based feature detection works.
             raise AttributeError(

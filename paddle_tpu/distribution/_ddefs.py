@@ -1,0 +1,86 @@
+"""Shared machinery for paddle.distribution.
+
+Each distribution's math (log_prob/entropy/cdf/...) is registered as one
+framework primitive (pure jnp function), so the whole expression compiles to
+a single fused XLA program and differentiates through the framework autograd
+(jax.vjp fallback in core/dispatch.py). Sampling draws keys from the global
+generator stream (core/generator.py) like the random creation ops.
+
+Reference analog: python/paddle/distribution/* compose per-op paddle calls;
+collapsing each method into one primitive is the TPU-idiomatic equivalent
+(one dispatch instead of dozens).
+"""
+from __future__ import annotations
+
+import numbers
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ..ops._helpers import defprim, ensure_tensor
+from ..ops.creation import _key_tensor
+
+__all__ = [
+    "Tensor", "apply", "defprim", "ensure_tensor", "jnp", "jax", "np",
+    "dprim", "key_tensor", "broadcast_params", "to_shape_tuple",
+]
+
+_registered = set()
+
+
+def dprim(name: str, fn, **kw):
+    """Register a distribution primitive (idempotent) and return its caller."""
+    pname = f"dist_{name}"
+    if pname not in _registered:
+        defprim(pname, fn, **kw)
+        _registered.add(pname)
+
+    def call(*tensors, **static):
+        return apply(pname, *tensors, **static)
+
+    call.__name__ = pname
+    return call
+
+
+def key_tensor() -> Tensor:
+    return _key_tensor()
+
+
+def broadcast_params(*params, dtype=None):
+    """Convert params to Tensors of a common broadcast shape and dtype
+    (reference distributions broadcast loc/scale in __init__)."""
+    ts = []
+    for p in params:
+        if isinstance(p, Tensor):
+            ts.append(p)
+        elif isinstance(p, (numbers.Number, np.bool_)):
+            ts.append(Tensor._from_value(jnp.asarray(p, dtype=np.dtype(dtype or "float32"))))
+        else:
+            ts.append(ensure_tensor(p))
+    common = jnp.result_type(*[t._value for t in ts])
+    if not jnp.issubdtype(common, jnp.floating):
+        common = np.dtype(dtype or "float32")
+    shape = jnp.broadcast_shapes(*[t._value.shape for t in ts])
+    # broadcast/cast through framework ops so params stay connected to the
+    # autograd graph (rsample/log_prob gradients reach the caller's tensors)
+    from ..ops.math import cast
+    from ..ops.manipulation import broadcast_to
+
+    out = []
+    for t in ts:
+        if np.dtype(t.dtype) != np.dtype(common):
+            t = cast(t, common)
+        if tuple(t.shape) != tuple(shape):
+            t = broadcast_to(t, shape)
+        out.append(t)
+    return out
+
+
+def to_shape_tuple(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)

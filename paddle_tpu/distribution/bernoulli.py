@@ -1,0 +1,93 @@
+"""Bernoulli distribution (reference: python/paddle/distribution/bernoulli.py)."""
+from __future__ import annotations
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+from .exponential_family import ExponentialFamily
+
+_bern_sample = dprim(
+    "bern_sample",
+    lambda key, probs, *, shape: jax.random.bernoulli(
+        key, probs, shape
+    ).astype(probs.dtype),
+    nondiff=True,
+)
+# reparameterized sample: sigmoid((logits + logistic noise) / temperature)
+# (reference bernoulli.py rsample — Gumbel-softmax style relaxation)
+_bern_rsample = dprim(
+    "bern_rsample",
+    lambda key, probs, *, shape, temperature: jax.nn.sigmoid(
+        (
+            jnp.log(probs) - jnp.log1p(-probs)
+            + (lambda u: jnp.log(u) - jnp.log1p(-u))(
+                jax.random.uniform(
+                    key, shape, probs.dtype, jnp.finfo(probs.dtype).tiny, 1.0
+                )
+            )
+        )
+        / temperature
+    ),
+)
+_bern_log_prob = dprim(
+    "bern_log_prob",
+    lambda value, probs: jax.scipy.special.xlogy(value, probs)
+    + jax.scipy.special.xlog1py(1.0 - value, -probs),
+)
+_bern_entropy = dprim(
+    "bern_entropy",
+    lambda probs: -(
+        jax.scipy.special.xlogy(probs, probs)
+        + jax.scipy.special.xlog1py(1.0 - probs, -probs)
+    ),
+)
+_bern_cdf = dprim(
+    "bern_cdf",
+    lambda value, probs: jnp.where(
+        value < 0.0, 0.0, jnp.where(value < 1.0, 1.0 - probs, 1.0)
+    ),
+)
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs, name=None):
+        (self.probs,) = broadcast_params(probs)
+        self.logits = None  # paddle exposes probs; logits derived lazily
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        full = to_shape_tuple(shape) + self.batch_shape
+        return _bern_sample(key_tensor(), self.probs, shape=full)
+
+    def rsample(self, shape=(), temperature=1.0):
+        full = to_shape_tuple(shape) + self.batch_shape
+        return _bern_rsample(
+            key_tensor(), self.probs, shape=full, temperature=float(temperature)
+        )
+
+    def log_prob(self, value):
+        return _bern_log_prob(ensure_tensor(value), self.probs)
+
+    def entropy(self):
+        return _bern_entropy(self.probs)
+
+    def cdf(self, value):
+        return _bern_cdf(ensure_tensor(value), self.probs)
+
+    @property
+    def _natural_parameters(self):
+        from ..ops.math import log
+
+        return (log(self.probs / (1.0 - self.probs)),)
+
+    def _log_normalizer(self, x):
+        from ..ops.math import exp, log
+
+        return log(1.0 + exp(x))

@@ -1,0 +1,68 @@
+"""Beta distribution (reference: python/paddle/distribution/beta.py)."""
+from __future__ import annotations
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+from .exponential_family import ExponentialFamily
+
+
+def _betaln(a, b):
+    return (
+        jax.scipy.special.gammaln(a)
+        + jax.scipy.special.gammaln(b)
+        - jax.scipy.special.gammaln(a + b)
+    )
+
+
+_beta_sample = dprim(
+    "beta_sample",
+    lambda key, a, b, *, shape: jax.random.beta(key, a, b, shape, dtype=a.dtype),
+    nondiff=True,
+)
+_beta_log_prob = dprim(
+    "beta_log_prob",
+    lambda value, a, b: (a - 1.0) * jnp.log(value)
+    + (b - 1.0) * jnp.log1p(-value)
+    - _betaln(a, b),
+)
+_beta_entropy = dprim(
+    "beta_entropy",
+    lambda a, b: _betaln(a, b)
+    - (a - 1.0) * jax.scipy.special.digamma(a)
+    - (b - 1.0) * jax.scipy.special.digamma(b)
+    + (a + b - 2.0) * jax.scipy.special.digamma(a + b),
+)
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha, self.beta = broadcast_params(alpha, beta)
+        super().__init__(tuple(self.alpha.shape))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1.0))
+
+    def sample(self, shape=()):
+        full = to_shape_tuple(shape) + self.batch_shape
+        return _beta_sample(key_tensor(), self.alpha, self.beta, shape=full)
+
+    def log_prob(self, value):
+        return _beta_log_prob(ensure_tensor(value), self.alpha, self.beta)
+
+    def entropy(self):
+        return _beta_entropy(self.alpha, self.beta)
+
+    @property
+    def _natural_parameters(self):
+        return (self.alpha, self.beta)
+
+    def _log_normalizer(self, x, y):
+        from ..ops.math import lgamma
+
+        return lgamma(x) + lgamma(y) - lgamma(x + y)

@@ -1,0 +1,62 @@
+"""Binomial distribution (reference: python/paddle/distribution/binomial.py)."""
+from __future__ import annotations
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+
+_binom_sample = dprim(
+    "binom_sample",
+    lambda key, n, p, *, shape: jax.random.binomial(key, n, p, shape).astype(p.dtype),
+    nondiff=True,
+    jittable=False,
+)
+_binom_log_prob = dprim(
+    "binom_log_prob",
+    lambda value, n, p: jax.scipy.special.gammaln(n + 1.0)
+    - jax.scipy.special.gammaln(value + 1.0)
+    - jax.scipy.special.gammaln(n - value + 1.0)
+    + jax.scipy.special.xlogy(value, p)
+    + jax.scipy.special.xlog1py(n - value, -p),
+)
+
+
+def _binom_entropy_fwd(n, p):
+    upper = int(jnp.max(n)) + 1
+    values = jnp.arange(0, upper, dtype=p.dtype).reshape((-1,) + (1,) * p.ndim)
+    lp = (
+        jax.scipy.special.gammaln(n + 1.0)
+        - jax.scipy.special.gammaln(values + 1.0)
+        - jax.scipy.special.gammaln(n - values + 1.0)
+        + jax.scipy.special.xlogy(values, p)
+        + jax.scipy.special.xlog1py(n - values, -p)
+    )
+    lp = jnp.where(values <= n, lp, -jnp.inf)
+    probs = jnp.exp(lp)
+    return -jnp.sum(jnp.where(probs > 0.0, probs * lp, 0.0), axis=0)
+
+
+_binom_entropy = dprim("binom_entropy", _binom_entropy_fwd, jittable=False)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count, self.probs = broadcast_params(total_count, probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        full = to_shape_tuple(shape) + self.batch_shape
+        return _binom_sample(key_tensor(), self.total_count, self.probs, shape=full)
+
+    def log_prob(self, value):
+        return _binom_log_prob(ensure_tensor(value), self.total_count, self.probs)
+
+    def entropy(self):
+        return _binom_entropy(self.total_count, self.probs)

@@ -1,0 +1,61 @@
+"""Categorical distribution (reference: python/paddle/distribution/categorical.py).
+
+Paddle convention: ``logits`` are unnormalized non-negative weights,
+normalized by their sum (categorical.py:146-147), NOT softmax logits.
+"""
+from __future__ import annotations
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+
+_cat_sample = dprim(
+    "cat_sample",
+    lambda key, probs, *, shape: jax.random.categorical(
+        key, jnp.log(probs), axis=-1, shape=shape
+    ),
+    nondiff=True,
+)
+_cat_entropy = dprim(
+    "cat_entropy",
+    lambda probs: -jnp.sum(jax.scipy.special.xlogy(probs, probs), axis=-1),
+)
+_cat_kl = dprim(
+    "cat_kl",
+    lambda p, q: jnp.sum(
+        p * (jnp.log(p) - jnp.log(q)), axis=-1
+    ),
+)
+def _cat_gather_fwd(probs, idx):
+    idx = idx.astype(jnp.int64)
+    if probs.ndim == 1:
+        return probs[idx]
+    return jnp.take_along_axis(probs, idx[..., None], axis=-1)[..., 0]
+
+
+_cat_gather = dprim("cat_gather", _cat_gather_fwd)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        (self.logits,) = broadcast_params(logits)
+        s = self.logits.sum(axis=-1, keepdim=True)
+        self._prob_t = self.logits / s
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    def sample(self, shape=()):
+        full = to_shape_tuple(shape) + self.batch_shape
+        return _cat_sample(key_tensor(), self._prob_t, shape=full)
+
+    def entropy(self):
+        return _cat_entropy(self._prob_t)
+
+    def kl_divergence(self, other):
+        return _cat_kl(self._prob_t, other._prob_t)
+
+    def probs(self, value):
+        return _cat_gather(self._prob_t, ensure_tensor(value))
+
+    def log_prob(self, value):
+        from ..ops.math import log
+
+        return log(self.probs(value))

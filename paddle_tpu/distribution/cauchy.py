@@ -1,0 +1,65 @@
+"""Cauchy distribution (reference: python/paddle/distribution/cauchy.py)."""
+from __future__ import annotations
+
+import math
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+
+_cauchy_std = dprim(
+    "cauchy_std",
+    lambda key, *, shape, dtype: jax.random.cauchy(key, shape, jnp.dtype(dtype)),
+    nondiff=True,
+)
+_cauchy_log_prob = dprim(
+    "cauchy_log_prob",
+    lambda value, loc, scale: -jnp.log(math.pi * scale)
+    - jnp.log1p(((value - loc) / scale) ** 2),
+)
+_cauchy_cdf = dprim(
+    "cauchy_cdf",
+    lambda value, loc, scale: jnp.arctan((value - loc) / scale) / math.pi + 0.5,
+)
+_cauchy_icdf = dprim(
+    "cauchy_icdf",
+    lambda p, loc, scale: loc + scale * jnp.tan(math.pi * (p - 0.5)),
+)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = broadcast_params(loc, scale)
+        super().__init__(tuple(self.loc.shape))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean.")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance.")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev.")
+
+    def rsample(self, shape=()):
+        import numpy as np
+
+        full = to_shape_tuple(shape) + self.batch_shape
+        z = _cauchy_std(key_tensor(), shape=full, dtype=np.dtype(self.loc.dtype).name)
+        return self.loc + self.scale * z
+
+    def log_prob(self, value):
+        return _cauchy_log_prob(ensure_tensor(value), self.loc, self.scale)
+
+    def entropy(self):
+        from ..ops.math import log
+
+        return log(4.0 * math.pi * self.scale)
+
+    def cdf(self, value):
+        return _cauchy_cdf(ensure_tensor(value), self.loc, self.scale)
+
+    def icdf(self, value):
+        return _cauchy_icdf(ensure_tensor(value), self.loc, self.scale)

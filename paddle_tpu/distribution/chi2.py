@@ -1,0 +1,16 @@
+"""Chi-squared distribution (reference: python/paddle/distribution/chi2.py) —
+Gamma(df/2, 1/2)."""
+from __future__ import annotations
+
+from ._ddefs import broadcast_params
+from .gamma import Gamma
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        (df_t,) = broadcast_params(df)
+        super().__init__(df_t * 0.5, df_t * 0.0 + 0.5)
+
+    @property
+    def df(self):
+        return self.concentration * 2.0

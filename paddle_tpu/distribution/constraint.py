@@ -1,0 +1,53 @@
+"""Value constraints (reference: python/paddle/distribution/constraint.py)."""
+from __future__ import annotations
+
+from ._ddefs import dprim, ensure_tensor, jnp
+
+
+class Constraint:
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        value = ensure_tensor(value)
+        return value == value
+
+
+_range_check = dprim(
+    "constraint_range",
+    lambda v, lo, hi: (lo <= v) & (v <= hi),
+)
+_positive_check = dprim("constraint_positive", lambda v: v >= 0.0)
+_simplex_check = dprim(
+    "constraint_simplex",
+    lambda v: jnp.all(v >= 0.0, axis=-1)
+    & (jnp.abs(jnp.sum(v, axis=-1) - 1.0) < 1e-6),
+)
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower = lower
+        self._upper = upper
+
+    def __call__(self, value):
+        return _range_check(
+            ensure_tensor(value), ensure_tensor(self._lower), ensure_tensor(self._upper)
+        )
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return _positive_check(ensure_tensor(value))
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        return _simplex_check(ensure_tensor(value))
+
+
+real = Real()
+positive = Positive()
+simplex = Simplex()

@@ -1,0 +1,136 @@
+"""Continuous Bernoulli (reference: python/paddle/distribution/continuous_bernoulli.py).
+
+Density p(x|λ) = C(λ) λ^x (1-λ)^(1-x) on [0,1], with normalizing constant
+C(λ) = 2 atanh(1-2λ)/(1-2λ) for λ≠1/2 and 2 for λ=1/2; a Taylor expansion is
+used inside ``lims`` around 0.5 for numerical stability (same policy as the
+reference's _cut_support_region)."""
+from __future__ import annotations
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+
+
+def _safe_lam(p, lims):
+    # clamp λ away from 0.5 inside the unstable region, remember the mask
+    lo, hi = lims
+    unstable = (p > lo) & (p < hi)
+    return unstable, jnp.where(unstable, lo, p)
+
+
+def _log_norm_const(p, lims):
+    unstable, lam = _safe_lam(p, lims)
+    exact = jnp.log(jnp.abs(2.0 * jnp.arctanh(1.0 - 2.0 * lam))) - jnp.log(
+        jnp.abs(1.0 - 2.0 * lam)
+    )
+    # 2nd-order Taylor of log C around λ=1/2: log 2 + 4/3 (λ-1/2)^2
+    taylor = jnp.log(2.0) + 4.0 / 3.0 * (p - 0.5) ** 2
+    return jnp.where(unstable, taylor, exact)
+
+
+def _cb_log_prob_fwd(value, p, *, lims):
+    return (
+        _log_norm_const(p, lims)
+        + jax.scipy.special.xlogy(value, p)
+        + jax.scipy.special.xlog1py(1.0 - value, -p)
+    )
+
+
+def _cb_mean_fwd(p, *, lims):
+    unstable, lam = _safe_lam(p, lims)
+    exact = lam / (2.0 * lam - 1.0) + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * lam))
+    taylor = 0.5 + (p - 0.5) / 3.0
+    return jnp.where(unstable, taylor, exact)
+
+
+def _cb_var_fwd(p, *, lims):
+    unstable, lam = _safe_lam(p, lims)
+    atan_h = jnp.arctanh(1.0 - 2.0 * lam)
+    exact = lam * (lam - 1.0) / (1.0 - 2.0 * lam) ** 2 + 1.0 / (2.0 * atan_h) ** 2
+    taylor = 1.0 / 12.0 - (p - 0.5) ** 2 / 5.0
+    return jnp.where(unstable, taylor, exact)
+
+
+def _cb_cdf_fwd(value, p, *, lims):
+    unstable, lam = _safe_lam(p, lims)
+    exact = (
+        jax.scipy.special.xlogy(value, lam)
+        + jax.scipy.special.xlog1py(1.0 - value, -lam)
+    )
+    numer = jnp.exp(exact) * (2.0 * jnp.arctanh(1.0 - 2.0 * lam)) / (1.0 - 2.0 * lam)
+    # closed form: [λ^x (1-λ)^(1-x) + λ - 1] / (2λ - 1)
+    cdf_exact = (
+        jnp.power(lam, value) * jnp.power(1.0 - lam, 1.0 - value) + lam - 1.0
+    ) / (2.0 * lam - 1.0)
+    cdf_taylor = value  # λ≈1/2 → uniform
+    out = jnp.where(unstable, cdf_taylor, cdf_exact)
+    return jnp.clip(out, 0.0, 1.0)
+
+
+def _cb_icdf_fwd(u, p, *, lims):
+    unstable, lam = _safe_lam(p, lims)
+    exact = jnp.log1p(u * (2.0 * lam - 1.0) / (1.0 - lam)) / (
+        jnp.log(lam) - jnp.log1p(-lam)
+    )
+    return jnp.where(unstable, u, exact)
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        (self.probs,) = broadcast_params(probs)
+        self._lims = (float(lims[0]), float(lims[1]))
+        self._log_prob_p = dprim("cb_log_prob", _cb_log_prob_fwd)
+        self._mean_p = dprim("cb_mean", _cb_mean_fwd)
+        self._var_p = dprim("cb_var", _cb_var_fwd)
+        self._cdf_p = dprim("cb_cdf", _cb_cdf_fwd)
+        self._icdf_p = dprim("cb_icdf", _cb_icdf_fwd)
+        self._u_p = dprim(
+            "cb_uniform",
+            lambda key, *, shape, dtype: jax.random.uniform(key, shape, jnp.dtype(dtype)),
+            nondiff=True,
+        )
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self._mean_p(self.probs, lims=self._lims)
+
+    @property
+    def variance(self):
+        return self._var_p(self.probs, lims=self._lims)
+
+    def sample(self, shape=()):
+        from .. import autograd
+
+        with autograd.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        import numpy as np
+
+        full = to_shape_tuple(shape) + self.batch_shape
+        u = self._u_p(key_tensor(), shape=full, dtype=np.dtype(self.probs.dtype).name)
+        return self._icdf_p(u, self.probs, lims=self._lims)
+
+    def log_prob(self, value):
+        return self._log_prob_p(ensure_tensor(value), self.probs, lims=self._lims)
+
+    def entropy(self):
+        # H = -(E[X] logit(λ) + log(1-λ) + log C(λ))
+        from ..ops.math import log
+
+        logits = log(self.probs / (1.0 - self.probs))
+        log_c = Tensor_log_norm(self.probs, self._lims)
+        return -(self.mean * logits + log(1.0 - self.probs) + log_c)
+
+    def cdf(self, value):
+        return self._cdf_p(ensure_tensor(value), self.probs, lims=self._lims)
+
+    def icdf(self, value):
+        return self._icdf_p(ensure_tensor(value), self.probs, lims=self._lims)
+
+
+_log_norm_p = dprim("cb_log_norm", lambda p, *, lims: _log_norm_const(p, lims))
+
+
+def Tensor_log_norm(p, lims):
+    return _log_norm_p(p, lims=lims)

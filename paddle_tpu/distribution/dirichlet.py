@@ -1,0 +1,71 @@
+"""Dirichlet distribution (reference: python/paddle/distribution/dirichlet.py)."""
+from __future__ import annotations
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+from .exponential_family import ExponentialFamily
+
+_dir_sample = dprim(
+    "dir_sample",
+    lambda key, conc, *, shape: jax.random.dirichlet(key, conc, shape, dtype=conc.dtype),
+    nondiff=True,
+)
+_dir_log_prob = dprim(
+    "dir_log_prob",
+    lambda value, conc: jnp.sum((conc - 1.0) * jnp.log(value), axis=-1)
+    - jnp.sum(jax.scipy.special.gammaln(conc), axis=-1)
+    + jax.scipy.special.gammaln(jnp.sum(conc, axis=-1)),
+)
+
+
+def _dir_entropy_fwd(conc):
+    a0 = jnp.sum(conc, axis=-1)
+    k = conc.shape[-1]
+    log_b = jnp.sum(jax.scipy.special.gammaln(conc), axis=-1) - jax.scipy.special.gammaln(a0)
+    return (
+        log_b
+        + (a0 - k) * jax.scipy.special.digamma(a0)
+        - jnp.sum((conc - 1.0) * jax.scipy.special.digamma(conc), axis=-1)
+    )
+
+
+_dir_entropy = dprim("dir_entropy", _dir_entropy_fwd)
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration, name=None):
+        (self.concentration,) = broadcast_params(concentration)
+        if self.concentration.ndim < 1:
+            raise ValueError("concentration must be at least 1-dimensional")
+        super().__init__(
+            tuple(self.concentration.shape[:-1]), tuple(self.concentration.shape[-1:])
+        )
+
+    @property
+    def mean(self):
+        return self.concentration / self.concentration.sum(axis=-1, keepdim=True)
+
+    @property
+    def variance(self):
+        a0 = self.concentration.sum(axis=-1, keepdim=True)
+        m = self.concentration / a0
+        return m * (1.0 - m) / (a0 + 1.0)
+
+    def sample(self, shape=()):
+        full = to_shape_tuple(shape) + self.batch_shape
+        return _dir_sample(key_tensor(), self.concentration, shape=full)
+
+    def log_prob(self, value):
+        return _dir_log_prob(ensure_tensor(value), self.concentration)
+
+    def entropy(self):
+        return _dir_entropy(self.concentration)
+
+    @property
+    def _natural_parameters(self):
+        return (self.concentration,)
+
+    def _log_normalizer(self, x):
+        from ..ops.math import lgamma
+
+        return lgamma(x).sum(axis=-1) - lgamma(x.sum(axis=-1))

@@ -1,0 +1,80 @@
+"""Distribution base class.
+
+Reference: python/paddle/distribution/distribution.py — batch_shape /
+event_shape bookkeeping, sample/rsample/prob/log_prob/entropy contract,
+``sample_shape + batch_shape + event_shape`` sample layout.
+"""
+from __future__ import annotations
+
+from ._ddefs import Tensor, ensure_tensor, to_shape_tuple
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = to_shape_tuple(batch_shape)
+        self._event_shape = to_shape_tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        from ..ops.math import sqrt
+
+        return sqrt(self.variance)
+
+    def sample(self, shape=()):
+        """Draw samples; default delegates to rsample without gradients
+        (reference distribution.py sample→rsample contract)."""
+        from .. import autograd
+
+        with autograd.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..ops.math import exp
+
+        return exp(self.log_prob(value))
+
+    # paddle exposes both prob() and probs() historically
+    def probs(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return to_shape_tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_shape={self._batch_shape}, event_shape={self._event_shape})"

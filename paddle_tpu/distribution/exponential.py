@@ -1,0 +1,61 @@
+"""Exponential distribution (reference: python/paddle/distribution/exponential.py)."""
+from __future__ import annotations
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+from .exponential_family import ExponentialFamily
+
+_exp_std = dprim(
+    "exp_std",
+    lambda key, *, shape, dtype: jax.random.exponential(key, shape, jnp.dtype(dtype)),
+    nondiff=True,
+)
+_exp_log_prob = dprim(
+    "exp_log_prob", lambda value, rate: jnp.log(rate) - rate * value
+)
+_exp_cdf = dprim("exp_cdf", lambda value, rate: 1.0 - jnp.exp(-rate * value))
+_exp_icdf = dprim("exp_icdf", lambda p, rate: -jnp.log1p(-p) / rate)
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        (self.rate,) = broadcast_params(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return 1.0 / (self.rate * self.rate)
+
+    def rsample(self, shape=()):
+        import numpy as np
+
+        full = to_shape_tuple(shape) + self.batch_shape
+        e = _exp_std(key_tensor(), shape=full, dtype=np.dtype(self.rate.dtype).name)
+        return e / self.rate
+
+    def log_prob(self, value):
+        return _exp_log_prob(ensure_tensor(value), self.rate)
+
+    def entropy(self):
+        from ..ops.math import log
+
+        return 1.0 - log(self.rate)
+
+    def cdf(self, value):
+        return _exp_cdf(ensure_tensor(value), self.rate)
+
+    def icdf(self, value):
+        return _exp_icdf(ensure_tensor(value), self.rate)
+
+    @property
+    def _natural_parameters(self):
+        return (-self.rate,)
+
+    def _log_normalizer(self, x):
+        from ..ops.math import log
+
+        return -log(-x)

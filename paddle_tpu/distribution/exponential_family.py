@@ -1,0 +1,22 @@
+"""ExponentialFamily base (reference: python/paddle/distribution/exponential_family.py).
+
+Subclasses expose natural parameters and a log-normalizer; the generic
+KL between two members of the same family is a Bregman divergence of the
+log-normalizer, computed in kl.py with jax autodiff (the reference computes
+the same thing with paddle.grad)."""
+from __future__ import annotations
+
+from .distribution import Distribution
+
+
+class ExponentialFamily(Distribution):
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0

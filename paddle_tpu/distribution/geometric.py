@@ -1,0 +1,76 @@
+"""Geometric distribution (reference: python/paddle/distribution/geometric.py).
+
+Support k ∈ {0, 1, 2, ...} with pmf (1-p)^k p (geometric.py:129 docstring);
+mean = 1/p - 1 (:112)."""
+from __future__ import annotations
+
+import numbers
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+
+_geom_rsample = dprim(
+    "geom_rsample",
+    lambda key, probs, *, shape: jnp.floor(
+        jnp.log(
+            jax.random.uniform(key, shape, probs.dtype, jnp.finfo(probs.dtype).tiny, 1.0)
+        )
+        / jnp.log1p(-probs)
+    ),
+    nondiff=True,
+)
+_geom_entropy = dprim(
+    "geom_entropy",
+    lambda p: -(
+        jax.scipy.special.xlogy(p, p) + jax.scipy.special.xlog1py(1.0 - p, -p)
+    )
+    / p,
+)
+_geom_cdf = dprim(
+    "geom_cdf", lambda k, p: 1.0 - jnp.power(1.0 - p, k + 1.0)
+)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        (self.probs,) = broadcast_params(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return 1.0 / self.probs - 1.0
+
+    @property
+    def variance(self):
+        return (1.0 / self.probs - 1.0) / self.probs
+
+    def pmf(self, k):
+        if not isinstance(k, (numbers.Real,)) and not hasattr(k, "_value"):
+            raise TypeError(f"Expected int or Tensor k, got {type(k)}")
+        from ..ops.math import pow as pow_
+
+        return pow_(1.0 - self.probs, ensure_tensor(k)) * self.probs
+
+    def log_pmf(self, k):
+        from ..ops.math import log
+
+        return log(self.pmf(k))
+
+    def log_prob(self, value):
+        return self.log_pmf(value)
+
+    def sample(self, shape=()):
+        from .. import autograd
+
+        with autograd.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        full = to_shape_tuple(shape) + self.batch_shape
+        return _geom_rsample(key_tensor(), self.probs, shape=full)
+
+    def entropy(self):
+        return _geom_entropy(self.probs)
+
+    def cdf(self, k):
+        return _geom_cdf(ensure_tensor(k), self.probs)

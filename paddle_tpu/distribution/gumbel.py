@@ -1,0 +1,58 @@
+"""Gumbel distribution (reference: python/paddle/distribution/gumbel.py)."""
+from __future__ import annotations
+
+import math
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+
+_EULER = 0.57721566490153286060
+
+_gumbel_std = dprim(
+    "gumbel_std",
+    lambda key, *, shape, dtype: jax.random.gumbel(key, shape, jnp.dtype(dtype)),
+    nondiff=True,
+)
+_gumbel_log_prob = dprim(
+    "gumbel_log_prob",
+    lambda value, loc, scale: -(
+        (value - loc) / scale + jnp.exp(-(value - loc) / scale)
+    )
+    - jnp.log(scale),
+)
+_gumbel_cdf = dprim(
+    "gumbel_cdf",
+    lambda value, loc, scale: jnp.exp(-jnp.exp(-(value - loc) / scale)),
+)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = broadcast_params(loc, scale)
+        super().__init__(tuple(self.loc.shape))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * _EULER
+
+    @property
+    def variance(self):
+        return self.scale * self.scale * (math.pi**2 / 6.0)
+
+    def rsample(self, shape=()):
+        import numpy as np
+
+        full = to_shape_tuple(shape) + self.batch_shape
+        g = _gumbel_std(key_tensor(), shape=full, dtype=np.dtype(self.loc.dtype).name)
+        return self.loc + self.scale * g
+
+    def log_prob(self, value):
+        return _gumbel_log_prob(ensure_tensor(value), self.loc, self.scale)
+
+    def entropy(self):
+        from ..ops.math import log
+
+        return log(self.scale) + (1.0 + _EULER)
+
+    def cdf(self, value):
+        return _gumbel_cdf(ensure_tensor(value), self.loc, self.scale)

@@ -1,0 +1,51 @@
+"""Independent distribution wrapper (reference: python/paddle/distribution/independent.py).
+
+Reinterprets the rightmost ``reinterpreted_batch_rank`` batch dims of a base
+distribution as event dims: log_prob/entropy sum over them."""
+from __future__ import annotations
+
+from .distribution import Distribution
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Distribution):
+            raise TypeError("base should be a Distribution instance")
+        r = int(reinterpreted_batch_rank)
+        if not 0 < r <= len(base.batch_shape):
+            raise ValueError(
+                f"reinterpreted_batch_rank {r} out of range for batch_shape {base.batch_shape}"
+            )
+        self._base = base
+        self._reinterpreted_batch_rank = r
+        shape = base.batch_shape + base.event_shape
+        cut = len(base.batch_shape) - r
+        super().__init__(shape[:cut], shape[cut:])
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def log_prob(self, value):
+        return self._sum_rightmost(self._base.log_prob(value))
+
+    def entropy(self):
+        return self._sum_rightmost(self._base.entropy())
+
+    def _sum_rightmost(self, t):
+        r = self._reinterpreted_batch_rank
+        if r == 0:
+            return t
+        from ..ops.math import sum as sum_
+
+        return sum_(t, axis=tuple(range(t.ndim - r, t.ndim)))

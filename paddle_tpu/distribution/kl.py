@@ -1,0 +1,312 @@
+"""KL divergence registry (reference: python/paddle/distribution/kl.py).
+
+``register_kl(P, Q)`` registers a pairwise rule; ``kl_divergence`` dispatches
+on the most specific registered (type(p), type(q)) pair. The generic
+exponential-family fallback computes the Bregman divergence of the
+log-normalizer with jax autodiff (the reference uses paddle.grad for the
+same construction, kl.py:242-280)."""
+from __future__ import annotations
+
+import functools
+
+from ._ddefs import dprim, jax, jnp, Tensor
+from .bernoulli import Bernoulli
+from .beta import Beta
+from .binomial import Binomial
+from .categorical import Categorical
+from .cauchy import Cauchy
+from .continuous_bernoulli import ContinuousBernoulli
+from .dirichlet import Dirichlet
+from .distribution import Distribution
+from .exponential import Exponential
+from .exponential_family import ExponentialFamily
+from .gamma import Gamma
+from .geometric import Geometric
+from .laplace import Laplace
+from .lognormal import LogNormal
+from .multivariate_normal import MultivariateNormal
+from .normal import Normal
+from .poisson import Poisson
+from .uniform import Uniform
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    if not issubclass(cls_p, Distribution) or not issubclass(cls_q, Distribution):
+        raise TypeError("cls_p and cls_q must be Distribution subclasses")
+
+    def decorator(fn):
+        _REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return decorator
+
+
+def _dispatch(type_p, type_q):
+    matches = [
+        (p, q) for (p, q) in _REGISTRY
+        if issubclass(type_p, p) and issubclass(type_q, q)
+    ]
+    if not matches:
+        raise NotImplementedError(
+            f"No KL(p||q) registered for p={type_p.__name__}, q={type_q.__name__}"
+        )
+
+    def specificity(pair):
+        p, q = pair
+        return (type_p.__mro__.index(p), type_q.__mro__.index(q))
+
+    return _REGISTRY[min(matches, key=specificity)]
+
+
+def kl_divergence(p, q):
+    return _dispatch(type(p), type(q))(p, q)
+
+
+# -- pairwise rules ---------------------------------------------------------
+
+_kl_normal = dprim(
+    "kl_normal_normal",
+    lambda lp, sp, lq, sq: jnp.log(sq / sp)
+    + (sp**2 + (lp - lq) ** 2) / (2.0 * sq**2)
+    - 0.5,
+)
+_kl_bern = dprim(
+    "kl_bern_bern",
+    lambda p, q: p * (jnp.log(p) - jnp.log(q))
+    + (1.0 - p) * (jnp.log1p(-p) - jnp.log1p(-q)),
+)
+_kl_beta = dprim(
+    "kl_beta_beta",
+    lambda a1, b1, a2, b2: (
+        jax.scipy.special.gammaln(a2)
+        + jax.scipy.special.gammaln(b2)
+        - jax.scipy.special.gammaln(a2 + b2)
+    )
+    - (
+        jax.scipy.special.gammaln(a1)
+        + jax.scipy.special.gammaln(b1)
+        - jax.scipy.special.gammaln(a1 + b1)
+    )
+    + (a1 - a2) * jax.scipy.special.digamma(a1)
+    + (b1 - b2) * jax.scipy.special.digamma(b1)
+    + (a2 - a1 + b2 - b1) * jax.scipy.special.digamma(a1 + b1),
+)
+
+
+def _kl_dirichlet_fwd(c1, c2):
+    s1 = jnp.sum(c1, axis=-1)
+    return (
+        jax.scipy.special.gammaln(s1)
+        - jax.scipy.special.gammaln(jnp.sum(c2, axis=-1))
+        - jnp.sum(jax.scipy.special.gammaln(c1), axis=-1)
+        + jnp.sum(jax.scipy.special.gammaln(c2), axis=-1)
+        + jnp.sum(
+            (c1 - c2)
+            * (jax.scipy.special.digamma(c1) - jax.scipy.special.digamma(s1)[..., None]),
+            axis=-1,
+        )
+    )
+
+
+_kl_dirichlet = dprim("kl_dirichlet", _kl_dirichlet_fwd)
+_kl_cauchy = dprim(
+    "kl_cauchy_cauchy",
+    lambda lp, sp, lq, sq: jnp.log(((sp + sq) ** 2 + (lp - lq) ** 2) / (4.0 * sp * sq)),
+)
+_kl_uniform = dprim(
+    "kl_uniform_uniform",
+    lambda lo_p, hi_p, lo_q, hi_q: jnp.where(
+        (lo_q <= lo_p) & (hi_p <= hi_q),
+        jnp.log((hi_q - lo_q) / (hi_p - lo_p)),
+        jnp.inf,
+    ),
+)
+_kl_laplace = dprim(
+    "kl_laplace_laplace",
+    lambda lp, sp, lq, sq: jnp.log(sq / sp)
+    + jnp.abs(lp - lq) / sq
+    + sp / sq * jnp.exp(-jnp.abs(lp - lq) / sp)
+    - 1.0,
+)
+_kl_geometric = dprim(
+    "kl_geometric",
+    lambda pp, pq: jnp.log(pp / pq)
+    + (1.0 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-pq)),
+)
+_kl_exponential = dprim(
+    "kl_exponential",
+    lambda rp, rq: jnp.log(rp / rq) + rq / rp - 1.0,
+)
+_kl_gamma = dprim(
+    "kl_gamma_gamma",
+    lambda ap, bp, aq, bq: (ap - aq) * jax.scipy.special.digamma(ap)
+    - jax.scipy.special.gammaln(ap)
+    + jax.scipy.special.gammaln(aq)
+    + aq * (jnp.log(bp) - jnp.log(bq))
+    + ap * (bq - bp) / bp,
+)
+_kl_poisson = dprim(
+    "kl_poisson",
+    lambda rp, rq: rp * (jnp.log(rp) - jnp.log(rq)) - rp + rq,
+)
+_kl_binomial = dprim(
+    "kl_binomial",
+    lambda n, pp, pq: n
+    * (
+        pp * (jnp.log(pp) - jnp.log(pq))
+        + (1.0 - pp) * (jnp.log1p(-pp) - jnp.log1p(-pq))
+    ),
+)
+
+
+def _kl_mvn_fwd(lp, tp, lq, tq):
+    k = lp.shape[-1]
+    half_logdet_p = jnp.sum(jnp.log(jnp.diagonal(tp, axis1=-2, axis2=-1)), axis=-1)
+    half_logdet_q = jnp.sum(jnp.log(jnp.diagonal(tq, axis1=-2, axis2=-1)), axis=-1)
+    m = jax.scipy.linalg.solve_triangular(tq, tp, lower=True)
+    trace = jnp.sum(m * m, axis=(-2, -1))
+    diff = jax.scipy.linalg.solve_triangular(tq, (lq - lp)[..., None], lower=True)[..., 0]
+    maha = jnp.sum(diff * diff, axis=-1)
+    return half_logdet_q - half_logdet_p + 0.5 * (trace + maha - k)
+
+
+_kl_mvn = dprim("kl_mvn", _kl_mvn_fwd)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _bern_bern(p, q):
+    return _kl_bern(p.probs, q.probs)
+
+
+@register_kl(Beta, Beta)
+def _beta_beta(p, q):
+    return _kl_beta(p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Binomial, Binomial)
+def _binom_binom(p, q):
+    import numpy as np
+
+    np_, nq_ = np.asarray(p.total_count._value), np.asarray(q.total_count._value)
+    if np.all(np_ == nq_):
+        return _kl_binomial(p.total_count, p.probs, q.probs)
+    if np.all(np_ > nq_):
+        # support(p) ⊄ support(q) → divergence is infinite
+        from ..ops.creation import full
+
+        return full(list(np.broadcast_shapes(np_.shape, nq_.shape)) or [1], float("inf"))
+    raise NotImplementedError(
+        "KL between Binomials with p.total_count < q.total_count is not implemented"
+    )
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _dir_dir(p, q):
+    return _kl_dirichlet(p.concentration, q.concentration)
+
+
+@register_kl(Categorical, Categorical)
+def _cat_cat(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Cauchy, Cauchy)
+def _cauchy_cauchy(p, q):
+    return _kl_cauchy(p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(ContinuousBernoulli, ContinuousBernoulli)
+def _cb_cb(p, q):
+    from .continuous_bernoulli import Tensor_log_norm
+    from ..ops.math import log
+
+    logit_p = log(p.probs / (1.0 - p.probs))
+    logit_q = log(q.probs / (1.0 - q.probs))
+    return (
+        Tensor_log_norm(p.probs, p._lims)
+        - Tensor_log_norm(q.probs, q._lims)
+        + p.mean * (logit_p - logit_q)
+        + log(1.0 - p.probs)
+        - log(1.0 - q.probs)
+    )
+
+
+@register_kl(Normal, Normal)
+def _normal_normal(p, q):
+    return _kl_normal(p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _mvn_mvn(p, q):
+    return _kl_mvn(p.loc, p.scale_tril, q.loc, q.scale_tril)
+
+
+@register_kl(Uniform, Uniform)
+def _uniform_uniform(p, q):
+    return _kl_uniform(p.low, p.high, q.low, q.high)
+
+
+@register_kl(Laplace, Laplace)
+def _laplace_laplace(p, q):
+    return _kl_laplace(p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Geometric, Geometric)
+def _geom_geom(p, q):
+    return _kl_geometric(p.probs, q.probs)
+
+
+@register_kl(Exponential, Exponential)
+def _exp_exp(p, q):
+    return _kl_exponential(p.rate, q.rate)
+
+
+@register_kl(Gamma, Gamma)
+def _gamma_gamma(p, q):
+    return _kl_gamma(p.concentration, p.rate, q.concentration, q.rate)
+
+
+@register_kl(LogNormal, LogNormal)
+def _lognormal_lognormal(p, q):
+    return _kl_normal(p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Poisson, Poisson)
+def _poisson_poisson(p, q):
+    return _kl_poisson(p.rate, q.rate)
+
+
+@register_kl(ExponentialFamily, ExponentialFamily)
+def _expfamily_expfamily(p, q):
+    """Bregman divergence of the log-normalizer (reference kl.py:242)."""
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            "KL between different exponential-family types is not implemented; "
+            "register an explicit rule."
+        )
+    p_nat = [t._value for t in p._natural_parameters]
+    q_nat = [t._value for t in q._natural_parameters]
+
+    def log_norm(*arrays):
+        out = p._log_normalizer(*[Tensor._from_value(a) for a in arrays])
+        return out._value.sum(), out._value
+
+    grads, lognorm_p = jax.grad(log_norm, argnums=tuple(range(len(p_nat))), has_aux=True)(
+        *p_nat
+    )
+    lognorm_q = p._log_normalizer(
+        *[Tensor._from_value(a) for a in q_nat]
+    )._value
+    kl = lognorm_q - lognorm_p
+    for gp, pn, qn in zip(grads, p_nat, q_nat):
+        term = gp * (qn - pn)
+        # sum event dims if natural params carry them
+        extra = term.ndim - kl.ndim
+        if extra > 0:
+            term = term.sum(axis=tuple(range(term.ndim - extra, term.ndim)))
+        kl = kl - term
+    return Tensor._from_value(kl)

@@ -1,0 +1,73 @@
+"""Laplace distribution (reference: python/paddle/distribution/laplace.py)."""
+from __future__ import annotations
+
+import math
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+
+_laplace_noise = dprim(
+    "laplace_noise",
+    lambda key, *, shape, dtype: jax.random.uniform(
+        key, shape, jnp.dtype(dtype), -0.5 + jnp.finfo(jnp.dtype(dtype)).tiny, 0.5
+    ),
+    nondiff=True,
+)
+_laplace_log_prob = dprim(
+    "laplace_log_prob",
+    lambda value, loc, scale: -jnp.abs(value - loc) / scale
+    - jnp.log(2.0 * scale),
+)
+_laplace_cdf = dprim(
+    "laplace_cdf",
+    lambda value, loc, scale: 0.5
+    - 0.5 * jnp.sign(value - loc) * jnp.expm1(-jnp.abs(value - loc) / scale),
+)
+_laplace_icdf = dprim(
+    "laplace_icdf",
+    lambda p, loc, scale: loc
+    - scale * jnp.sign(p - 0.5) * jnp.log1p(-2.0 * jnp.abs(p - 0.5)),
+)
+_laplace_from_u = dprim(
+    "laplace_from_u",
+    lambda u, loc, scale: loc - scale * jnp.sign(u) * jnp.log1p(-2.0 * jnp.abs(u)),
+)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = broadcast_params(loc, scale)
+        super().__init__(tuple(self.loc.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2.0 * self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return math.sqrt(2.0) * self.scale
+
+    def rsample(self, shape=()):
+        import numpy as np
+
+        full = to_shape_tuple(shape) + self.batch_shape
+        u = _laplace_noise(key_tensor(), shape=full, dtype=np.dtype(self.loc.dtype).name)
+        return _laplace_from_u(u, self.loc, self.scale)
+
+    def log_prob(self, value):
+        return _laplace_log_prob(ensure_tensor(value), self.loc, self.scale)
+
+    def entropy(self):
+        from ..ops.math import log
+
+        return 1.0 + log(2.0 * self.scale)
+
+    def cdf(self, value):
+        return _laplace_cdf(ensure_tensor(value), self.loc, self.scale)
+
+    def icdf(self, value):
+        return _laplace_icdf(ensure_tensor(value), self.loc, self.scale)

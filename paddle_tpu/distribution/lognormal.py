@@ -1,0 +1,32 @@
+"""LogNormal distribution (reference: python/paddle/distribution/lognormal.py) —
+a TransformedDistribution of Normal through ExpTransform, with closed-form
+moments."""
+from __future__ import annotations
+
+from ._ddefs import broadcast_params
+from .normal import Normal
+from .transform import ExpTransform
+from .transformed_distribution import TransformedDistribution
+
+
+class LogNormal(TransformedDistribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = broadcast_params(loc, scale)
+        self._base = Normal(self.loc, self.scale)
+        super().__init__(self._base, [ExpTransform()])
+
+    @property
+    def mean(self):
+        from ..ops.math import exp
+
+        return exp(self.loc + self.scale * self.scale / 2.0)
+
+    @property
+    def variance(self):
+        from ..ops.math import exp
+
+        s2 = self.scale * self.scale
+        return (exp(s2) - 1.0) * exp(2.0 * self.loc + s2)
+
+    def entropy(self):
+        return self._base.entropy() + self.loc

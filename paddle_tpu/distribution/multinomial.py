@@ -1,0 +1,60 @@
+"""Multinomial distribution (reference: python/paddle/distribution/multinomial.py)."""
+from __future__ import annotations
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+
+
+def _multinomial_sample_fwd(key, probs, *, total_count, shape):
+    # draw total_count categorical samples and histogram them (one-hot sum)
+    k = probs.shape[-1]
+    draws = jax.random.categorical(
+        key, jnp.log(probs), axis=-1, shape=(total_count,) + shape
+    )
+    return jnp.sum(jax.nn.one_hot(draws, k, dtype=probs.dtype), axis=0)
+
+
+_multinomial_sample = dprim("multinomial_sample", _multinomial_sample_fwd, nondiff=True)
+_multinomial_log_prob = dprim(
+    "multinomial_log_prob",
+    lambda value, probs, *, total_count: jax.scipy.special.gammaln(total_count + 1.0)
+    - jnp.sum(jax.scipy.special.gammaln(value + 1.0), axis=-1)
+    + jnp.sum(jax.scipy.special.xlogy(value, probs), axis=-1),
+)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        if int(total_count) < 1:
+            raise ValueError("total_count should be greater than one.")
+        self.total_count = int(total_count)
+        (probs_t,) = broadcast_params(probs)
+        self.probs = probs_t / probs_t.sum(axis=-1, keepdim=True)
+        super().__init__(tuple(probs_t.shape[:-1]), tuple(probs_t.shape[-1:]))
+
+    @property
+    def mean(self):
+        return self.probs * float(self.total_count)
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs) * float(self.total_count)
+
+    def sample(self, shape=()):
+        full = to_shape_tuple(shape) + self.batch_shape
+        return _multinomial_sample(
+            key_tensor(), self.probs, total_count=self.total_count, shape=full
+        )
+
+    def log_prob(self, value):
+        return _multinomial_log_prob(
+            ensure_tensor(value), self.probs, total_count=float(self.total_count)
+        )
+
+    def entropy(self):
+        # E[-log p(X)] with X ~ Multinomial: use the exact decomposition
+        # -log n! + sum_i E[log x_i!] - n sum_i p_i log p_i is intractable in
+        # closed form; follow the reference and Monte-Carlo-free bound via
+        # per-category Binomial entropy is not provided — reference omits
+        # entropy for Multinomial as well.
+        raise NotImplementedError

@@ -1,0 +1,91 @@
+"""Multivariate normal (reference: python/paddle/distribution/multivariate_normal.py)."""
+from __future__ import annotations
+
+import math
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+
+_mvn_chol = dprim("mvn_chol", lambda cov: jnp.linalg.cholesky(cov))
+_mvn_chol_inv = dprim(
+    "mvn_chol_inv",
+    lambda prec: jnp.linalg.cholesky(
+        jnp.linalg.inv(prec)
+    ),
+)
+_mvn_std = dprim(
+    "mvn_std",
+    lambda key, *, shape, dtype: jax.random.normal(key, shape, jnp.dtype(dtype)),
+    nondiff=True,
+)
+_mvn_affine = dprim(
+    "mvn_affine",
+    lambda eps, loc, tril: loc + jnp.einsum("...ij,...j->...i", tril, eps),
+)
+
+
+def _mvn_log_prob_fwd(value, loc, tril):
+    diff = value - loc
+    t = jnp.broadcast_to(tril, diff.shape[:-1] + tril.shape[-2:])
+    m = jax.scipy.linalg.solve_triangular(t, diff[..., None], lower=True)[..., 0]
+    half_log_det = jnp.sum(jnp.log(jnp.diagonal(tril, axis1=-2, axis2=-1)), axis=-1)
+    k = value.shape[-1]
+    return -0.5 * (k * math.log(2 * math.pi) + jnp.sum(m * m, axis=-1)) - half_log_det
+
+
+_mvn_log_prob = dprim("mvn_log_prob", _mvn_log_prob_fwd)
+_mvn_entropy = dprim(
+    "mvn_entropy",
+    lambda tril: 0.5 * tril.shape[-1] * (1.0 + math.log(2 * math.pi))
+    + jnp.sum(jnp.log(jnp.diagonal(tril, axis1=-2, axis2=-1)), axis=-1),
+)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        given = sum(m is not None for m in (covariance_matrix, precision_matrix, scale_tril))
+        if given != 1:
+            raise ValueError(
+                "Exactly one of covariance_matrix, precision_matrix or scale_tril must be specified."
+            )
+        (self.loc,) = broadcast_params(loc)
+        if self.loc.ndim < 1:
+            raise ValueError("loc must be at least 1-dimensional")
+        if scale_tril is not None:
+            (self.scale_tril,) = broadcast_params(scale_tril)
+        elif covariance_matrix is not None:
+            (cov,) = broadcast_params(covariance_matrix)
+            self.covariance_matrix = cov
+            self.scale_tril = _mvn_chol(cov)
+        else:
+            (prec,) = broadcast_params(precision_matrix)
+            self.precision_matrix = prec
+            self.scale_tril = _mvn_chol_inv(prec)
+        batch = jnp.broadcast_shapes(
+            tuple(self.loc.shape[:-1]), tuple(self.scale_tril.shape[:-2])
+        )
+        super().__init__(batch, tuple(self.loc.shape[-1:]))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        from ..ops.math import sum as sum_
+
+        return sum_(self.scale_tril * self.scale_tril, axis=-1)
+
+    def rsample(self, shape=()):
+        import numpy as np
+
+        full = to_shape_tuple(shape) + self.batch_shape + self.event_shape
+        eps = _mvn_std(key_tensor(), shape=full, dtype=np.dtype(self.loc.dtype).name)
+        return _mvn_affine(eps, self.loc, self.scale_tril)
+
+    def log_prob(self, value):
+        return _mvn_log_prob(ensure_tensor(value), self.loc, self.scale_tril)
+
+    def entropy(self):
+        return _mvn_entropy(self.scale_tril)

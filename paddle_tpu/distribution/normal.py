@@ -1,0 +1,81 @@
+"""Normal distribution (reference: python/paddle/distribution/normal.py)."""
+from __future__ import annotations
+
+import math
+
+from ._ddefs import broadcast_params, dprim, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+
+_HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
+
+_std_normal = dprim(
+    "std_normal",
+    lambda key, *, shape, dtype: jax.random.normal(key, shape, jnp.dtype(dtype)),
+    nondiff=True,
+)
+_normal_log_prob = dprim(
+    "normal_log_prob",
+    lambda value, loc, scale: -((value - loc) ** 2) / (2.0 * scale**2)
+    - jnp.log(scale) - _HALF_LOG_2PI,
+)
+_normal_entropy = dprim(
+    "normal_entropy", lambda scale: 0.5 + _HALF_LOG_2PI + jnp.log(scale)
+)
+_normal_cdf = dprim(
+    "normal_cdf",
+    lambda value, loc, scale: 0.5
+    * (1.0 + jax.scipy.special.erf((value - loc) / (scale * math.sqrt(2.0)))),
+)
+_normal_icdf = dprim(
+    "normal_icdf",
+    lambda p, loc, scale: loc
+    + scale * math.sqrt(2.0) * jax.scipy.special.erfinv(2.0 * p - 1.0),
+)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = broadcast_params(loc, scale)
+        super().__init__(tuple(self.loc.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def rsample(self, shape=()):
+        full = to_shape_tuple(shape) + self.batch_shape
+        import numpy as np
+
+        eps = _std_normal(key_tensor(), shape=full, dtype=np.dtype(self.loc.dtype).name)
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        from ._ddefs import ensure_tensor
+
+        return _normal_log_prob(ensure_tensor(value), self.loc, self.scale)
+
+    def entropy(self):
+        return _normal_entropy(self.scale)
+
+    def cdf(self, value):
+        from ._ddefs import ensure_tensor
+
+        return _normal_cdf(ensure_tensor(value), self.loc, self.scale)
+
+    def icdf(self, value):
+        from ._ddefs import ensure_tensor
+
+        return _normal_icdf(ensure_tensor(value), self.loc, self.scale)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)

@@ -1,0 +1,58 @@
+"""Poisson distribution (reference: python/paddle/distribution/poisson.py).
+
+Entropy follows the reference's bounded-support enumeration
+(poisson.py:146-200, 30-sigma rule) — data-dependent support size, so the
+entropy primitive is registered non-jittable and runs eagerly."""
+from __future__ import annotations
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+
+_poisson_sample = dprim(
+    "poisson_sample",
+    lambda key, rate, *, shape: jax.random.poisson(key, rate, shape).astype(rate.dtype),
+    nondiff=True,
+)
+_poisson_log_prob = dprim(
+    "poisson_log_prob",
+    lambda value, rate: jax.scipy.special.xlogy(value, rate)
+    - rate
+    - jax.scipy.special.gammaln(value + 1.0),
+)
+
+
+def _poisson_entropy_fwd(rate):
+    r = jnp.asarray(rate)
+    s_max = jnp.sqrt(jnp.maximum(jnp.max(r), 1.0))
+    upper = int(jnp.max(r + 30.0 * s_max))
+    values = jnp.arange(0, max(upper, 1), dtype=r.dtype).reshape((-1,) + (1,) * r.ndim)
+    lp = jax.scipy.special.xlogy(values, r) - r - jax.scipy.special.gammaln(values + 1.0)
+    ent = -jnp.sum(jnp.exp(lp) * lp, axis=0)
+    return jnp.where(r != 0.0, ent, 0.0)
+
+
+_poisson_entropy = dprim("poisson_entropy", _poisson_entropy_fwd, jittable=False)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        (self.rate,) = broadcast_params(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        full = to_shape_tuple(shape) + self.batch_shape
+        return _poisson_sample(key_tensor(), self.rate, shape=full)
+
+    def log_prob(self, value):
+        return _poisson_log_prob(ensure_tensor(value), self.rate)
+
+    def entropy(self):
+        return _poisson_entropy(self.rate)

@@ -1,0 +1,64 @@
+"""Student's t distribution (reference: python/paddle/distribution/student_t.py)."""
+from __future__ import annotations
+
+import math
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+
+_t_std = dprim(
+    "t_std",
+    lambda key, df, *, shape: jax.random.t(key, df, shape, dtype=df.dtype),
+    nondiff=True,
+)
+_t_log_prob = dprim(
+    "t_log_prob",
+    lambda value, df, loc, scale: jax.scipy.special.gammaln((df + 1.0) / 2.0)
+    - jax.scipy.special.gammaln(df / 2.0)
+    - 0.5 * jnp.log(df * math.pi)
+    - jnp.log(scale)
+    - (df + 1.0) / 2.0 * jnp.log1p(((value - loc) / scale) ** 2 / df),
+)
+
+
+def _t_entropy_fwd(df, scale):
+    half = (df + 1.0) / 2.0
+    return (
+        half * (jax.scipy.special.digamma(half) - jax.scipy.special.digamma(df / 2.0))
+        + 0.5 * jnp.log(df)
+        + jax.scipy.special.gammaln(df / 2.0)
+        + jax.scipy.special.gammaln(0.5)
+        - jax.scipy.special.gammaln(half)
+        + jnp.log(scale)
+    )
+
+
+_t_entropy = dprim("t_entropy", _t_entropy_fwd)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc, scale, name=None):
+        self.df, self.loc, self.scale = broadcast_params(df, loc, scale)
+        super().__init__(tuple(self.df.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale * self.df / (self.df - 2.0)
+
+    def sample(self, shape=()):
+        full = to_shape_tuple(shape) + self.batch_shape
+        z = _t_std(key_tensor(), self.df, shape=full)
+        from .. import autograd
+
+        with autograd.no_grad():
+            return self.loc + self.scale * z
+
+    def log_prob(self, value):
+        return _t_log_prob(ensure_tensor(value), self.df, self.loc, self.scale)
+
+    def entropy(self):
+        return _t_entropy(self.df, self.scale)

@@ -1,0 +1,594 @@
+"""Bijective transforms (reference: python/paddle/distribution/transform.py:70-1330).
+
+Each transform exposes forward / inverse / forward_log_det_jacobian /
+inverse_log_det_jacobian / forward_shape / inverse_shape plus domain and
+codomain variables, matching the reference class-by-class. Math runs through
+framework primitives, so every transform is differentiable end to end.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import operator
+
+from . import variable
+from ._ddefs import dprim, ensure_tensor, jax, jnp
+from .distribution import Distribution
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class _Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    _type = _Type.INJECTION
+
+    @property
+    def _is_injective(self):
+        return _Type.is_injective(self._type)
+
+    @property
+    def _domain(self):
+        return variable.real
+
+    @property
+    def _codomain(self):
+        return variable.real
+
+    def __call__(self, input):
+        if isinstance(input, Distribution):
+            from .transformed_distribution import TransformedDistribution
+
+            return TransformedDistribution(input, [self])
+        return self.forward(ensure_tensor(input))
+
+    def forward(self, x):
+        return self._forward(ensure_tensor(x))
+
+    def inverse(self, y):
+        return self._inverse(ensure_tensor(y))
+
+    def forward_log_det_jacobian(self, x):
+        x = ensure_tensor(x)
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return self._forward_log_det_jacobian(x)
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return -self._inverse_log_det_jacobian(self.forward(x))
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        y = ensure_tensor(y)
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return self._inverse_log_det_jacobian(y)
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return -self._forward_log_det_jacobian(self.inverse(y))
+        raise NotImplementedError
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+
+class AbsTransform(Transform):
+    """y = |x| (reference transform.py:374) — surjective, not injective."""
+
+    _type = _Type.SURJECTION
+
+    def _forward(self, x):
+        from ..ops.math import abs as abs_
+
+        return abs_(x)
+
+    def _inverse(self, y):
+        return y
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference transform.py:447)."""
+
+    _type = _Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self._loc = ensure_tensor(loc)
+        self._scale = ensure_tensor(scale)
+
+    @property
+    def loc(self):
+        return self._loc
+
+    @property
+    def scale(self):
+        return self._scale
+
+    def _forward(self, x):
+        return self._loc + self._scale * x
+
+    def _inverse(self, y):
+        return (y - self._loc) / self._scale
+
+    def _forward_log_det_jacobian(self, x):
+        from ..ops.math import abs as abs_
+        from ..ops.math import log
+        from ..ops.creation import ones_like
+
+        return log(abs_(self._scale * ones_like(x)))
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ ... ∘ t_1 (reference transform.py:534)."""
+
+    def __init__(self, transforms):
+        if not all(isinstance(t, Transform) for t in transforms):
+            raise TypeError("All elements of transforms should be Transform type.")
+        self.transforms = tuple(transforms)
+
+    @property
+    def _is_injective(self):
+        return all(t._is_injective for t in self.transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        x = ensure_tensor(x)
+        value = 0.0
+        event_rank = self._domain.event_rank
+        for t in self.transforms:
+            value = value + self._sum_rightmost(
+                t.forward_log_det_jacobian(x), event_rank - t._domain.event_rank
+            )
+            x = t.forward(x)
+            event_rank += t._codomain.event_rank - t._domain.event_rank
+        return value
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(ensure_tensor(y)))
+
+    @staticmethod
+    def _sum_rightmost(t, n):
+        if n <= 0:
+            return t
+        from ..ops.math import sum as sum_
+
+        return sum_(t, axis=tuple(range(t.ndim - n, t.ndim)))
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)
+
+    @property
+    def _domain(self):
+        return self.transforms[0]._domain
+
+    @property
+    def _codomain(self):
+        return self.transforms[-1]._codomain
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (reference transform.py:659)."""
+
+    _type = _Type.BIJECTION
+
+    @property
+    def _codomain(self):
+        return variable.positive
+
+    def _forward(self, x):
+        from ..ops.math import exp
+
+        return exp(x)
+
+    def _inverse(self, y):
+        from ..ops.math import log
+
+        return log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+    def _inverse_log_det_jacobian(self, y):
+        from ..ops.math import log
+
+        return -log(y)
+
+
+class IndependentTransform(Transform):
+    """Reinterpret rightmost batch dims as event dims (reference transform.py:709)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Transform):
+            raise TypeError("base should be a Transform instance")
+        if reinterpreted_batch_rank <= 0:
+            raise ValueError("reinterpreted_batch_rank should be positive")
+        self._base = base
+        self._reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+
+    @property
+    def _is_injective(self):
+        return self._base._is_injective
+
+    @property
+    def _domain(self):
+        return variable.Independent(self._base._domain, self._reinterpreted_batch_rank)
+
+    @property
+    def _codomain(self):
+        return variable.Independent(self._base._codomain, self._reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self._base.forward(x)
+
+    def _inverse(self, y):
+        return self._base.inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self._base.forward_log_det_jacobian(x)
+        from ..ops.math import sum as sum_
+
+        r = self._reinterpreted_batch_rank
+        return sum_(ldj, axis=tuple(range(ldj.ndim - r, ldj.ndim)))
+
+    def forward_shape(self, shape):
+        return self._base.forward_shape(shape)
+
+    def inverse_shape(self, shape):
+        return self._base.inverse_shape(shape)
+
+
+class PowerTransform(Transform):
+    """y = x^power on the positive reals (reference transform.py:804)."""
+
+    _type = _Type.BIJECTION
+
+    def __init__(self, power):
+        self._power = ensure_tensor(power)
+
+    @property
+    def power(self):
+        return self._power
+
+    @property
+    def _domain(self):
+        return variable.positive
+
+    @property
+    def _codomain(self):
+        return variable.positive
+
+    def _forward(self, x):
+        from ..ops.math import pow as pow_
+
+        return pow_(x, self._power)
+
+    def _inverse(self, y):
+        from ..ops.math import pow as pow_
+
+        return pow_(y, 1.0 / self._power)
+
+    def _forward_log_det_jacobian(self, x):
+        from ..ops.math import abs as abs_
+        from ..ops.math import log
+
+        return log(abs_(self._power * x ** (self._power - 1.0)))
+
+    def forward_shape(self, shape):
+        return tuple(jnp.broadcast_shapes(tuple(shape), tuple(self._power.shape)))
+
+    inverse_shape = forward_shape
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event shape (reference transform.py:871)."""
+
+    _type = _Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        in_event_shape, out_event_shape = tuple(in_event_shape), tuple(out_event_shape)
+        if functools.reduce(operator.mul, in_event_shape, 1) != functools.reduce(
+            operator.mul, out_event_shape, 1
+        ):
+            raise ValueError(
+                f"The numel of in_event_shape should be same with out_event_shape, "
+                f"but got {in_event_shape} and {out_event_shape}"
+            )
+        self._in_event_shape = in_event_shape
+        self._out_event_shape = out_event_shape
+
+    @property
+    def in_event_shape(self):
+        return self._in_event_shape
+
+    @property
+    def out_event_shape(self):
+        return self._out_event_shape
+
+    @property
+    def _domain(self):
+        return variable.Independent(variable.real, len(self._in_event_shape))
+
+    @property
+    def _codomain(self):
+        return variable.Independent(variable.real, len(self._out_event_shape))
+
+    def _forward(self, x):
+        from ..ops.manipulation import reshape
+
+        batch = tuple(x.shape)[: x.ndim - len(self._in_event_shape)]
+        return reshape(x, batch + self._out_event_shape)
+
+    def _inverse(self, y):
+        from ..ops.manipulation import reshape
+
+        batch = tuple(y.shape)[: y.ndim - len(self._out_event_shape)]
+        return reshape(y, batch + self._in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        from ..ops.creation import zeros
+
+        batch = tuple(x.shape)[: x.ndim - len(self._in_event_shape)]
+        return zeros(batch if batch else [1], dtype=x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self._in_event_shape)
+        if tuple(shape[len(shape) - n:]) != self._in_event_shape:
+            raise ValueError("shape mismatch in ReshapeTransform.forward_shape")
+        return tuple(shape[: len(shape) - n]) + self._out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self._out_event_shape)
+        if tuple(shape[len(shape) - n:]) != self._out_event_shape:
+            raise ValueError("shape mismatch in ReshapeTransform.inverse_shape")
+        return tuple(shape[: len(shape) - n]) + self._in_event_shape
+
+
+_sigmoid_fldj = dprim(
+    "sigmoid_fldj",
+    lambda x: -jax.nn.softplus(-x) - jax.nn.softplus(x),
+)
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (reference transform.py:997)."""
+
+    _type = _Type.BIJECTION
+
+    @property
+    def _codomain(self):
+        from .constraint import Range
+
+        return variable.Variable(False, 0, Range(0.0, 1.0))
+
+    def _forward(self, x):
+        from ..ops.activation import sigmoid
+
+        return sigmoid(x)
+
+    def _inverse(self, y):
+        from ..ops.math import log
+
+        return log(y) - log(1.0 - y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _sigmoid_fldj(x)
+
+
+class SoftmaxTransform(Transform):
+    """x → softmax-normalized simplex point (reference transform.py:1040).
+    Not bijective: no log-det jacobian."""
+
+    _type = _Type.OTHER
+
+    @property
+    def _domain(self):
+        return variable.Independent(variable.real, 1)
+
+    @property
+    def _codomain(self):
+        return variable.Variable(False, 1, None)
+
+    def _forward(self, x):
+        from ..ops.math import exp, max as max_, sum as sum_
+
+        z = exp(x - max_(x, axis=-1, keepdim=True))
+        return z / z.sum(axis=-1, keepdim=True)
+
+    def _inverse(self, y):
+        from ..ops.math import log
+
+        return log(y)
+
+
+class StackTransform(Transform):
+    """Apply a sequence of transforms to slices along an axis
+    (reference transform.py:1097)."""
+
+    def __init__(self, transforms, axis=0):
+        if not transforms or not all(isinstance(t, Transform) for t in transforms):
+            raise TypeError("transforms should be a non-empty sequence of Transform")
+        self._transforms = tuple(transforms)
+        self._axis = int(axis)
+
+    @property
+    def transforms(self):
+        return self._transforms
+
+    @property
+    def axis(self):
+        return self._axis
+
+    @property
+    def _is_injective(self):
+        return all(t._is_injective for t in self._transforms)
+
+    @property
+    def _domain(self):
+        return variable.Stack([t._domain for t in self._transforms], self._axis)
+
+    @property
+    def _codomain(self):
+        return variable.Stack([t._codomain for t in self._transforms], self._axis)
+
+    def _zip_slices(self, v):
+        from ..ops.manipulation import unstack
+
+        slices = unstack(v, self._axis)
+        if len(slices) != len(self._transforms):
+            raise ValueError(
+                f"Input has {len(slices)} slices along axis {self._axis}, "
+                f"expected {len(self._transforms)}"
+            )
+        return slices
+
+    def _forward(self, x):
+        from ..ops.manipulation import stack
+
+        return stack(
+            [t.forward(v) for t, v in zip(self._transforms, self._zip_slices(x))],
+            self._axis,
+        )
+
+    def _inverse(self, y):
+        from ..ops.manipulation import stack
+
+        return stack(
+            [t.inverse(v) for t, v in zip(self._transforms, self._zip_slices(y))],
+            self._axis,
+        )
+
+    def _forward_log_det_jacobian(self, x):
+        from ..ops.manipulation import stack
+
+        return stack(
+            [
+                t.forward_log_det_jacobian(v)
+                for t, v in zip(self._transforms, self._zip_slices(x))
+            ],
+            self._axis,
+        )
+
+
+def _stickbreaking_fwd2(x):
+    # numerically standard construction (matches torch/paddle):
+    offset = x.shape[-1] + 1 - jnp.cumsum(jnp.ones_like(x), axis=-1)
+    z = jax.nn.sigmoid(x - jnp.log(offset))
+    one_minus_cumprod = jnp.cumprod(1.0 - z, axis=-1)
+    pad = [(0, 0)] * (x.ndim - 1)
+    y_head = z * jnp.concatenate(
+        [jnp.ones(x.shape[:-1] + (1,), x.dtype), one_minus_cumprod[..., :-1]], axis=-1
+    )
+    y_tail = one_minus_cumprod[..., -1:]
+    return jnp.concatenate([y_head, y_tail], axis=-1)
+
+
+def _stickbreaking_inv(y):
+    y_crop = y[..., :-1]
+    offset = y.shape[-1] - jnp.cumsum(jnp.ones_like(y_crop), axis=-1)
+    sf = 1.0 - jnp.cumsum(y_crop, axis=-1)
+    x = jnp.log(y_crop) - jnp.log(sf) + jnp.log(offset)
+    return x
+
+
+def _stickbreaking_fldj(x):
+    offset = x.shape[-1] + 1 - jnp.cumsum(jnp.ones_like(x), axis=-1)
+    xo = x - jnp.log(offset)
+    y = _stickbreaking_fwd2(x)
+    return jnp.sum(-xo + jax.nn.log_sigmoid(xo) + jnp.log(y[..., :-1]), axis=-1)
+
+
+_sb_fwd = dprim("stickbreaking_fwd", _stickbreaking_fwd2)
+_sb_inv = dprim("stickbreaking_inv", _stickbreaking_inv)
+_sb_fldj = dprim("stickbreaking_fldj", _stickbreaking_fldj)
+
+
+class StickBreakingTransform(Transform):
+    """R^(K-1) → K-simplex via stick-breaking (reference transform.py:1217)."""
+
+    _type = _Type.BIJECTION
+
+    @property
+    def _domain(self):
+        return variable.Independent(variable.real, 1)
+
+    @property
+    def _codomain(self):
+        return variable.Variable(False, 1, None)
+
+    def _forward(self, x):
+        return _sb_fwd(x)
+
+    def _inverse(self, y):
+        return _sb_inv(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _sb_fldj(x)
+
+    def forward_shape(self, shape):
+        if not shape:
+            raise ValueError("Too few dimensions on input")
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        if not shape:
+            raise ValueError("Too few dimensions on input")
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+_tanh_fldj = dprim(
+    "tanh_fldj",
+    lambda x: 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x)),
+)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference transform.py:1283)."""
+
+    _type = _Type.BIJECTION
+
+    @property
+    def _codomain(self):
+        from .constraint import Range
+
+        return variable.Variable(False, 0, Range(-1.0, 1.0))
+
+    def _forward(self, x):
+        from ..ops.math import tanh
+
+        return tanh(x)
+
+    def _inverse(self, y):
+        from ..ops.math import atanh
+
+        return atanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _tanh_fldj(x)

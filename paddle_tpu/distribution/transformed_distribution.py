@@ -1,0 +1,75 @@
+"""TransformedDistribution (reference: python/paddle/distribution/transformed_distribution.py).
+
+Pushes a base distribution through a chain of transforms; log_prob walks the
+chain backwards accumulating inverse log-det jacobians."""
+from __future__ import annotations
+
+from .distribution import Distribution
+from .transform import ChainTransform, Transform
+
+
+def _sum_rightmost(t, n):
+    if n <= 0:
+        return t
+    from ..ops.math import sum as sum_
+
+    return sum_(t, axis=tuple(range(t.ndim - n, t.ndim)))
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        if not isinstance(base, Distribution):
+            raise TypeError("base should be a Distribution instance")
+        if not all(isinstance(t, Transform) for t in transforms):
+            raise TypeError("transforms must be a sequence of Transform")
+        self._base = base
+        self._transforms = list(transforms)
+        chain = ChainTransform(self._transforms) if self._transforms else None
+        base_shape = base.batch_shape + base.event_shape
+        if chain is not None:
+            out_shape = chain.forward_shape(base_shape)
+            event_rank = max(
+                chain._codomain.event_rank,
+                len(base.event_shape)
+                + (len(out_shape) - len(base_shape)),
+            )
+        else:
+            out_shape = base_shape
+            event_rank = len(base.event_shape)
+        cut = len(out_shape) - event_rank
+        super().__init__(out_shape[:cut], out_shape[cut:])
+
+    @property
+    def transforms(self):
+        return self._transforms
+
+    def sample(self, shape=()):
+        x = self._base.sample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self._base.rsample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        from ._ddefs import ensure_tensor
+
+        y = ensure_tensor(value)
+        log_prob = 0.0
+        event_rank = len(self.event_shape)
+        for t in reversed(self._transforms):
+            x = t.inverse(y)
+            ldj = t.forward_log_det_jacobian(x)
+            log_prob = log_prob - _sum_rightmost(
+                ldj, event_rank - t._domain.event_rank
+            )
+            event_rank += t._domain.event_rank - t._codomain.event_rank
+            y = x
+        log_prob = log_prob + _sum_rightmost(
+            self._base.log_prob(y), event_rank - len(self._base.event_shape)
+        )
+        return log_prob

@@ -1,0 +1,65 @@
+"""Uniform distribution (reference: python/paddle/distribution/uniform.py)."""
+from __future__ import annotations
+
+from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
+from .distribution import Distribution
+
+_std_uniform = dprim(
+    "std_uniform",
+    lambda key, *, shape, dtype: jax.random.uniform(key, shape, jnp.dtype(dtype)),
+    nondiff=True,
+)
+_uniform_log_prob = dprim(
+    "uniform_log_prob",
+    lambda value, low, high: jnp.where(
+        (value >= low) & (value < high),
+        -jnp.log(high - low),
+        -jnp.inf,
+    ),
+)
+_uniform_cdf = dprim(
+    "uniform_cdf",
+    lambda value, low, high: jnp.clip((value - low) / (high - low), 0.0, 1.0),
+)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low, self.high = broadcast_params(low, high)
+        super().__init__(tuple(self.low.shape))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2.0
+
+    @property
+    def variance(self):
+        d = self.high - self.low
+        return d * d / 12.0
+
+    def rsample(self, shape=()):
+        import numpy as np
+
+        full = to_shape_tuple(shape) + self.batch_shape
+        u = _std_uniform(key_tensor(), shape=full, dtype=np.dtype(self.low.dtype).name)
+        return self.low + (self.high - self.low) * u
+
+    def sample(self, shape=(), seed=0):
+        from .. import autograd
+
+        with autograd.no_grad():
+            return self.rsample(shape)
+
+    def log_prob(self, value):
+        return _uniform_log_prob(ensure_tensor(value), self.low, self.high)
+
+    def entropy(self):
+        from ..ops.math import log
+
+        return log(self.high - self.low)
+
+    def cdf(self, value):
+        return _uniform_cdf(ensure_tensor(value), self.low, self.high)
+
+    def icdf(self, value):
+        return self.low + (self.high - self.low) * ensure_tensor(value)

@@ -1,0 +1,83 @@
+"""Random-variable domain descriptors (reference: python/paddle/distribution/variable.py)."""
+from __future__ import annotations
+
+from . import constraint as _constraint
+
+
+class Variable:
+    def __init__(self, is_discrete=False, event_rank=0, constraint=None):
+        self._is_discrete = is_discrete
+        self._event_rank = event_rank
+        self._constraint = constraint
+
+    @property
+    def is_discrete(self):
+        return self._is_discrete
+
+    @property
+    def event_rank(self):
+        return self._event_rank
+
+    def constraint(self, value):
+        return self._constraint(value)
+
+
+class Real(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, _constraint.real)
+
+
+class Positive(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, _constraint.positive)
+
+
+class Independent(Variable):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._reinterpreted_batch_rank = reinterpreted_batch_rank
+        super().__init__(
+            base.is_discrete, base.event_rank + reinterpreted_batch_rank
+        )
+
+    def constraint(self, value):
+        ret = self._base.constraint(value)
+        if ret.ndim < self._reinterpreted_batch_rank:
+            raise ValueError(
+                f"Input dimensions must be equal or greater than {self._reinterpreted_batch_rank}"
+            )
+        from ..ops.math import all as all_
+
+        return all_(
+            ret,
+            axis=tuple(range(ret.ndim - self._reinterpreted_batch_rank, ret.ndim)),
+        )
+
+
+class Stack(Variable):
+    def __init__(self, vars, axis=0):
+        self._vars = vars
+        self._axis = axis
+
+    @property
+    def is_discrete(self):
+        return any(v.is_discrete for v in self._vars)
+
+    @property
+    def event_rank(self):
+        rank = max(v.event_rank for v in self._vars)
+        if self._axis + rank < 0:
+            rank += 1
+        return rank
+
+    def constraint(self, value):
+        from ..ops.manipulation import stack, unstack
+
+        return stack(
+            [v.constraint(vv) for v, vv in zip(self._vars, unstack(value, self._axis))],
+            self._axis,
+        )
+
+
+real = Real()
+positive = Positive()

@@ -22,6 +22,7 @@ from ._helpers import axis_tuple, binary_args, defprim, ensure_tensor
 __all__ = [
     "reshape", "reshape_", "transpose", "flatten", "squeeze", "unsqueeze",
     "squeeze_", "unsqueeze_", "concat", "stack", "split", "chunk", "unbind",
+    "unstack",
     "tile", "expand", "expand_as", "broadcast_to", "flip", "rot90", "roll",
     "gather", "gather_nd", "scatter", "scatter_nd_add", "index_select",
     "index_sample", "index_add", "index_put", "take_along_axis",
@@ -274,6 +275,18 @@ def unbind(x, axis=0, name=None):
     axis = int(axis) % x.ndim
     outs = split(x, x.shape[axis], axis)
     return [squeeze(o, axis) for o in outs]
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """Unpack a tensor into ``num`` slices along ``axis``
+    (reference: python/paddle/tensor/manipulation.py unstack)."""
+    x = ensure_tensor(x)
+    ax = int(axis) % x.ndim
+    if num is not None and num != x.shape[ax]:
+        raise ValueError(
+            f"num({num}) must match the size of axis {axis} ({x.shape[ax]})"
+        )
+    return unbind(x, ax)
 
 
 # ---------------------------------------------------------------------------

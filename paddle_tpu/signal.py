@@ -102,8 +102,21 @@ def overlap_add(x, hop_length, axis=-1, name=None):
 def _padded_window(w, win_length, n_fft, dtype):
     if w is None:
         w = jnp.ones((win_length,), dtype=dtype)
+    if w.shape[0] != win_length:
+        raise ValueError(
+            f"Expected window length {win_length} (win_length), but got {w.shape[0]}."
+        )
     pad = n_fft - w.shape[0]
     return jnp.pad(w, (pad // 2, pad - pad // 2))
+
+
+def _resolve_hop(hop_length, n_fft):
+    hop = n_fft // 4 if hop_length is None else int(hop_length)
+    if hop <= 0:
+        raise ValueError(
+            f"Attribute hop_length should be greater than 0, but got ({hop})."
+        )
+    return hop
 
 
 def _stft_fwd(sig, w, *, n_fft, hop_length, center, pad_mode, normalized, onesided):
@@ -158,7 +171,7 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
     x = ensure_tensor(x)
     if x.ndim not in (1, 2):
         raise ValueError(f"x should be a 1D or 2D real tensor, but got rank {x.ndim}")
-    hop_length = hop_length or n_fft // 4
+    hop_length = _resolve_hop(hop_length, n_fft)
     win_length = win_length or n_fft
     if not 0 < win_length <= n_fft:
         raise ValueError(f"Expected 0 < win_length <= n_fft, but got win_length={win_length}")
@@ -188,7 +201,12 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
     x = ensure_tensor(x)
     if x.ndim not in (2, 3):
         raise ValueError(f"x should be a 2D or 3D complex tensor, but got rank {x.ndim}")
-    hop_length = hop_length or n_fft // 4
+    if return_complex and onesided:
+        raise ValueError(
+            "onesided should be False when return_complex is True (a onesided "
+            "spectrogram reconstructs a real signal)."
+        )
+    hop_length = _resolve_hop(hop_length, n_fft)
     win_length = win_length or n_fft
     if not 0 < win_length <= n_fft:
         raise ValueError(f"Expected 0 < win_length <= n_fft, but got win_length={win_length}")
@@ -204,6 +222,19 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
     else:
         wt = Tensor._from_value(jnp.ones((win_length,), dtype=np.dtype("float32")))
     w_padded = Tensor._from_value(_padded_window(wt._value, win_length, n_fft, wt._value.dtype))
+    import jax.core as _jcore
+
+    if not isinstance(w_padded._value, _jcore.Tracer):
+        # NOLA check (reference istft raises on a degenerate window envelope);
+        # runs eagerly on the concrete window — inside jit we can't raise.
+        wv = w_padded._value
+        env = _overlap_add_last((wv * wv)[:, None] * jnp.ones((1, x.shape[-1])), hop_length)
+        interior = env[n_fft // 2 : env.shape[0] - n_fft // 2] if center else env
+        if interior.size and float(jnp.min(jnp.abs(interior))) < 1e-11:
+            raise ValueError(
+                "window overlap-add envelope is (near) zero — the window/"
+                "hop_length combination violates the NOLA constraint."
+            )
     return apply("istft_p", x, w_padded, n_fft=int(n_fft), hop_length=int(hop_length),
                  center=bool(center), normalized=bool(normalized),
                  onesided=bool(onesided), return_complex=bool(return_complex),

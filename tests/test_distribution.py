@@ -1,0 +1,379 @@
+"""paddle.distribution vs scipy oracles.
+
+Mirrors the reference test strategy (test/distribution/): log_prob/entropy
+against scipy.stats, sampling moments against analytic mean/variance,
+transforms round-trip + log-det checks, KL registry pairs."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+RTOL, ATOL = 1e-4, 1e-4
+
+
+class TestNormal:
+    def test_log_prob_entropy_cdf(self):
+        loc, scale = np.float32(0.3), np.float32(1.7)
+        d = D.Normal(loc, scale)
+        x = np.linspace(-3, 3, 11).astype("float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), st.norm.logpdf(x, loc, scale), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.norm.entropy(loc, scale), rtol=RTOL)
+        np.testing.assert_allclose(_np(d.cdf(paddle.to_tensor(x))), st.norm.cdf(x, loc, scale), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(_np(d.icdf(paddle.to_tensor(np.asarray([0.25, 0.5, 0.9], "float32")))), st.norm.ppf([0.25, 0.5, 0.9], loc, scale), rtol=1e-3, atol=1e-3)
+
+    def test_sample_moments_and_rsample_grad(self):
+        paddle.seed(0)
+        loc = paddle.to_tensor(np.float32(1.5), stop_gradient=False)
+        scale = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        d = D.Normal(loc, scale)
+        s = d.sample([20000])
+        assert abs(float(_np(s).mean()) - 1.5) < 0.02
+        assert abs(float(_np(s).std()) - 0.5) < 0.02
+        r = d.rsample([1000])
+        loss = (r * r).mean()
+        loss.backward()
+        assert loc.grad is not None and scale.grad is not None
+        # d/dloc E[(loc+scale*eps)^2] = 2 loc
+        assert abs(float(_np(loc.grad)) - 2 * 1.5) < 0.15
+
+    def test_kl(self):
+        p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+        expected = np.log(2.0) + (1.0 + 1.0) / (2 * 4.0) - 0.5
+        np.testing.assert_allclose(float(_np(D.kl_divergence(p, q))), expected, rtol=RTOL)
+
+
+class TestBasicScalars:
+    def test_uniform(self):
+        d = D.Uniform(1.0, 3.0)
+        x = np.asarray([0.5, 1.5, 2.9], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), st.uniform.logpdf(x, 1, 2), rtol=RTOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), np.log(2.0), rtol=RTOL)
+        s = d.sample([8000])
+        assert 1.9 < float(_np(s).mean()) < 2.1
+
+    def test_bernoulli(self):
+        d = D.Bernoulli(0.3)
+        np.testing.assert_allclose(float(_np(d.log_prob(1.0))), np.log(0.3), rtol=RTOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.bernoulli.entropy(0.3), rtol=RTOL)
+        assert abs(float(_np(d.sample([8000])).mean()) - 0.3) < 0.03
+
+    def test_laplace(self):
+        d = D.Laplace(0.5, 2.0)
+        x = np.asarray([-1.0, 0.5, 3.0], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), st.laplace.logpdf(x, 0.5, 2.0), rtol=RTOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.laplace.entropy(0.5, 2.0), rtol=RTOL)
+        np.testing.assert_allclose(_np(d.cdf(paddle.to_tensor(x))), st.laplace.cdf(x, 0.5, 2.0), rtol=RTOL, atol=ATOL)
+
+    def test_cauchy(self):
+        d = D.Cauchy(0.1, 1.2)
+        x = np.asarray([-2.0, 0.0, 2.0], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), st.cauchy.logpdf(x, 0.1, 1.2), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.cauchy.entropy(0.1, 1.2), rtol=RTOL)
+        with pytest.raises(ValueError):
+            d.mean
+
+    def test_gumbel(self):
+        d = D.Gumbel(0.5, 2.0)
+        x = np.asarray([-1.0, 0.5, 4.0], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), st.gumbel_r.logpdf(x, 0.5, 2.0), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.gumbel_r.entropy(0.5, 2.0), rtol=RTOL)
+        np.testing.assert_allclose(float(_np(d.mean)), st.gumbel_r.mean(0.5, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(float(_np(d.variance)), st.gumbel_r.var(0.5, 2.0), rtol=1e-5)
+
+    def test_exponential(self):
+        d = D.Exponential(2.0)
+        x = np.asarray([0.1, 1.0, 3.0], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), st.expon.logpdf(x, scale=0.5), rtol=RTOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.expon.entropy(scale=0.5), rtol=RTOL)
+        assert abs(float(_np(d.sample([8000])).mean()) - 0.5) < 0.05
+
+    def test_geometric(self):
+        d = D.Geometric(0.4)
+        np.testing.assert_allclose(float(_np(d.pmf(3))), st.geom.pmf(4, 0.4), rtol=RTOL)  # scipy geom starts at 1
+        np.testing.assert_allclose(float(_np(d.mean)), st.geom.mean(0.4) - 1, rtol=RTOL)
+        np.testing.assert_allclose(float(_np(d.variance)), st.geom.var(0.4), rtol=RTOL)
+        assert abs(float(_np(d.sample([8000])).mean()) - (1 / 0.4 - 1)) < 0.1
+
+
+class TestGammaFamily:
+    def test_gamma(self):
+        d = D.Gamma(3.0, 2.0)
+        x = np.asarray([0.5, 1.5, 4.0], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), st.gamma.logpdf(x, 3.0, scale=0.5), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.gamma.entropy(3.0, scale=0.5), rtol=RTOL)
+        assert abs(float(_np(d.sample([8000])).mean()) - 1.5) < 0.1
+
+    def test_gamma_rsample_grad(self):
+        paddle.seed(1)
+        conc = paddle.to_tensor(np.float32(3.0), stop_gradient=False)
+        d = D.Gamma(conc, 2.0)
+        r = d.rsample([2000])
+        r.mean().backward()
+        # dE[X]/dconc = 1/rate = 0.5 (implicit reparameterization)
+        assert conc.grad is not None
+        assert abs(float(_np(conc.grad)) - 0.5) < 0.1
+
+    def test_chi2(self):
+        d = D.Chi2(5.0)
+        x = np.asarray([1.0, 4.0, 9.0], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), st.chi2.logpdf(x, 5.0), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(float(_np(d.mean)), 5.0, rtol=RTOL)
+
+    def test_beta(self):
+        d = D.Beta(2.0, 3.0)
+        x = np.asarray([0.1, 0.5, 0.9], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), st.beta.logpdf(x, 2, 3), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.beta.entropy(2, 3), rtol=1e-3, atol=1e-5)
+        assert abs(float(_np(d.sample([8000])).mean()) - 0.4) < 0.03
+
+    def test_dirichlet(self):
+        conc = np.asarray([1.0, 2.0, 3.0], "float32")
+        d = D.Dirichlet(paddle.to_tensor(conc))
+        x = np.asarray([0.2, 0.3, 0.5], "float32")
+        np.testing.assert_allclose(float(_np(d.log_prob(paddle.to_tensor(x)))), st.dirichlet.logpdf(x, conc), rtol=RTOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.dirichlet.entropy(conc), rtol=1e-3, atol=1e-4)
+        s = _np(d.sample([4000]))
+        assert s.shape == (4000, 3)
+        np.testing.assert_allclose(s.mean(0), conc / conc.sum(), atol=0.03)
+
+
+class TestDiscrete:
+    def test_categorical(self):
+        logits = np.asarray([1.0, 2.0, 7.0], "float32")  # paddle: normalized by sum
+        d = D.Categorical(paddle.to_tensor(logits))
+        probs = logits / logits.sum()
+        np.testing.assert_allclose(_np(d.probs(paddle.to_tensor(np.asarray([0, 2])))), probs[[0, 2]], rtol=RTOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), -(probs * np.log(probs)).sum(), rtol=RTOL)
+        s = _np(d.sample([8000]))
+        freq = np.bincount(s.astype(int), minlength=3) / 8000
+        np.testing.assert_allclose(freq, probs, atol=0.03)
+
+    def test_categorical_kl(self):
+        p = D.Categorical(paddle.to_tensor(np.asarray([1.0, 1.0], "float32")))
+        q = D.Categorical(paddle.to_tensor(np.asarray([1.0, 3.0], "float32")))
+        pk, qk = np.asarray([0.5, 0.5]), np.asarray([0.25, 0.75])
+        np.testing.assert_allclose(float(_np(D.kl_divergence(p, q))), (pk * np.log(pk / qk)).sum(), rtol=RTOL)
+
+    def test_multinomial(self):
+        probs = np.asarray([0.2, 0.3, 0.5], "float32")
+        d = D.Multinomial(10, paddle.to_tensor(probs))
+        x = np.asarray([2.0, 3.0, 5.0], "float32")
+        np.testing.assert_allclose(float(_np(d.log_prob(paddle.to_tensor(x)))), st.multinomial.logpmf(x, 10, probs), rtol=RTOL)
+        s = _np(d.sample([500]))
+        assert s.shape == (500, 3)
+        assert (s.sum(-1) == 10).all()
+        np.testing.assert_allclose(s.mean(0), 10 * probs, atol=0.3)
+
+    def test_binomial(self):
+        d = D.Binomial(10.0, 0.3)
+        ks = np.asarray([0.0, 3.0, 10.0], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(ks))), st.binom.logpmf(ks, 10, 0.3), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.binom.entropy(10, 0.3), rtol=1e-3)
+
+    def test_poisson(self):
+        d = D.Poisson(4.0)
+        ks = np.asarray([0.0, 4.0, 9.0], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(ks))), st.poisson.logpmf(ks, 4.0), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.poisson.entropy(4.0), rtol=1e-3)
+        assert abs(float(_np(d.sample([8000])).mean()) - 4.0) < 0.15
+
+
+class TestMultivariate:
+    def test_mvn_log_prob_entropy(self):
+        mu = np.asarray([0.5, -0.3], "float32")
+        cov = np.asarray([[2.0, 0.5], [0.5, 1.0]], "float32")
+        d = D.MultivariateNormal(paddle.to_tensor(mu), covariance_matrix=paddle.to_tensor(cov))
+        x = np.asarray([[0.0, 0.0], [1.0, -1.0]], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), st.multivariate_normal.logpdf(x, mu, cov), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.multivariate_normal.entropy(mu, cov), rtol=1e-3)
+        s = _np(d.rsample([6000]))
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.15)
+
+    def test_mvn_kl_self_zero(self):
+        mu = paddle.to_tensor(np.asarray([0.5, -0.3], "float32"))
+        cov = paddle.to_tensor(np.asarray([[2.0, 0.5], [0.5, 1.0]], "float32"))
+        p = D.MultivariateNormal(mu, covariance_matrix=cov)
+        q = D.MultivariateNormal(mu, covariance_matrix=cov)
+        assert abs(float(_np(D.kl_divergence(p, q)))) < 1e-5
+
+    def test_student_t(self):
+        d = D.StudentT(5.0, 0.5, 2.0)
+        x = np.asarray([-1.0, 0.5, 3.0], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), st.t.logpdf(x, 5.0, 0.5, 2.0), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.t.entropy(5.0, 0.5, 2.0), rtol=1e-3)
+
+    def test_lognormal(self):
+        d = D.LogNormal(0.2, 0.5)
+        x = np.asarray([0.5, 1.0, 3.0], "float32")
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), st.lognorm.logpdf(x, 0.5, scale=np.exp(0.2)), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(float(_np(d.mean)), st.lognorm.mean(0.5, scale=np.exp(0.2)), rtol=1e-4)
+        np.testing.assert_allclose(float(_np(d.entropy())), st.lognorm.entropy(0.5, scale=np.exp(0.2)), rtol=1e-3)
+
+    def test_lkj_cholesky(self):
+        paddle.seed(7)
+        for method in ("onion", "cvine"):
+            d = D.LKJCholesky(3, 1.5, sample_method=method)
+            L = _np(d.sample([50]))
+            assert L.shape == (50, 3, 3)
+            corr = L @ np.swapaxes(L, -1, -2)
+            np.testing.assert_allclose(np.diagonal(corr, axis1=-2, axis2=-1), 1.0, atol=1e-4)
+            assert np.all(np.abs(corr) <= 1.0 + 1e-5)
+        lp = d.log_prob(paddle.to_tensor(np.linalg.cholesky(np.eye(3, dtype="float32"))))
+        assert np.isfinite(float(_np(lp)))
+
+
+class TestWrappers:
+    def test_independent(self):
+        base = D.Normal(paddle.zeros([3, 4]), paddle.ones([3, 4]))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+        x = paddle.zeros([3, 4])
+        np.testing.assert_allclose(_np(ind.log_prob(x)), _np(base.log_prob(x)).sum(-1), rtol=RTOL)
+
+    def test_transformed_distribution(self):
+        base = D.Normal(0.2, 0.5)
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        ln = D.LogNormal(0.2, 0.5)
+        x = np.asarray([0.5, 1.5], "float32")
+        np.testing.assert_allclose(_np(td.log_prob(paddle.to_tensor(x))), _np(ln.log_prob(paddle.to_tensor(x))), rtol=1e-4)
+
+    def test_continuous_bernoulli(self):
+        lam = 0.3
+        d = D.ContinuousBernoulli(lam)
+        # normalizing constant: ∫ C λ^x (1-λ)^(1-x) dx = 1
+        xs = np.linspace(0, 1, 20001).astype("float64")
+        dens = np.exp(_np(d.log_prob(paddle.to_tensor(xs.astype("float32")))).astype("float64"))
+        integral = np.trapezoid(dens, xs)
+        assert abs(integral - 1.0) < 1e-3
+        s = _np(d.sample([8000]))
+        assert abs(s.mean() - float(_np(d.mean))) < 0.02
+
+
+class TestTransforms:
+    def test_affine(self):
+        t = D.AffineTransform(paddle.to_tensor(1.0), paddle.to_tensor(2.0))
+        x = paddle.to_tensor(np.asarray([0.0, 1.0], "float32"))
+        y = t.forward(x)
+        np.testing.assert_allclose(_np(y), [1.0, 3.0])
+        np.testing.assert_allclose(_np(t.inverse(y)), _np(x), rtol=RTOL)
+        np.testing.assert_allclose(_np(t.forward_log_det_jacobian(x)), np.log(2.0) * np.ones(2), rtol=RTOL)
+
+    @pytest.mark.parametrize("t,xval", [
+        ("exp", [0.5, -1.0]),
+        ("sigmoid", [0.5, -1.0]),
+        ("tanh", [0.5, -0.2]),
+        ("power", [0.5, 2.0]),
+    ])
+    def test_bijectors_roundtrip_and_ldj(self, t, xval):
+        tr = {
+            "exp": D.ExpTransform(),
+            "sigmoid": D.SigmoidTransform(),
+            "tanh": D.TanhTransform(),
+            "power": D.PowerTransform(paddle.to_tensor(2.0)),
+        }[t]
+        x = paddle.to_tensor(np.asarray(xval, "float32"))
+        y = tr.forward(x)
+        np.testing.assert_allclose(_np(tr.inverse(y)), _np(x), rtol=1e-4, atol=1e-5)
+        # numeric log-det check
+        eps = 1e-3
+        xp = paddle.to_tensor(np.asarray(xval, "float32") + eps)
+        num = np.log(np.abs((_np(tr.forward(xp)) - _np(y)) / eps))
+        np.testing.assert_allclose(_np(tr.forward_log_det_jacobian(x)), num, atol=5e-2)
+        np.testing.assert_allclose(_np(tr.inverse_log_det_jacobian(y)), -_np(tr.forward_log_det_jacobian(x)), rtol=1e-4)
+
+    def test_chain(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0), D.ExpTransform()])
+        x = paddle.to_tensor(np.asarray([0.1, 0.5], "float32"))
+        y = chain.forward(x)
+        np.testing.assert_allclose(_np(y), np.exp(2 * np.asarray([0.1, 0.5])), rtol=1e-5)
+        np.testing.assert_allclose(_np(chain.inverse(y)), _np(x), rtol=1e-4)
+
+    def test_stickbreaking(self):
+        t = D.StickBreakingTransform()
+        x = paddle.to_tensor(np.asarray([0.3, -0.2, 0.5], "float32"))
+        y = t.forward(x)
+        assert y.shape[-1] == 4
+        np.testing.assert_allclose(float(_np(y).sum()), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(_np(t.inverse(y)), _np(x), rtol=1e-4, atol=1e-5)
+        assert t.forward_shape((3,)) == (4,) and t.inverse_shape((4,)) == (3,)
+
+    def test_reshape_stack(self):
+        t = D.ReshapeTransform((2, 3), (6,))
+        x = paddle.ones([5, 2, 3])
+        assert tuple(t.forward(x).shape) == (5, 6)
+        assert tuple(t.inverse(t.forward(x)).shape) == (5, 2, 3)
+        s = D.StackTransform([D.ExpTransform(), D.AffineTransform(0.0, 2.0)], axis=1)
+        x2 = paddle.to_tensor(np.ones((3, 2), "float32"))
+        y2 = s.forward(x2)
+        np.testing.assert_allclose(_np(y2)[:, 0], np.e * np.ones(3), rtol=1e-5)
+        np.testing.assert_allclose(_np(y2)[:, 1], 2 * np.ones(3), rtol=1e-5)
+
+    def test_transform_call_on_distribution(self):
+        td = D.ExpTransform()(D.Normal(0.0, 1.0))
+        assert isinstance(td, D.TransformedDistribution)
+
+
+class TestKLRegistry:
+    @pytest.mark.parametrize("maker,expected", [
+        (lambda: (D.Exponential(2.0), D.Exponential(3.0)), st.expon.entropy(scale=0.5) * 0 + (np.log(2 / 3) + 3 / 2 - 1)),
+        (lambda: (D.Gamma(2.0, 1.0), D.Gamma(3.0, 2.0)), None),
+        (lambda: (D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)), None),
+    ])
+    def test_kl_nonnegative_and_selfzero(self, maker, expected):
+        p, q = maker()
+        kl = float(_np(D.kl_divergence(p, q)))
+        assert kl > 0
+        if expected is not None:
+            np.testing.assert_allclose(kl, expected, rtol=1e-4)
+        same = float(_np(D.kl_divergence(p, p)))
+        assert abs(same) < 1e-5
+
+    def test_kl_monte_carlo_gamma(self):
+        paddle.seed(3)
+        p, q = D.Gamma(2.0, 1.0), D.Gamma(3.0, 2.0)
+        s = p.sample([200000])
+        mc = float((_np(p.log_prob(s)) - _np(q.log_prob(s))).mean())
+        np.testing.assert_allclose(float(_np(D.kl_divergence(p, q))), mc, rtol=0.05)
+
+    def test_expfamily_generic_matches_explicit(self):
+        p, q = D.Beta(2.0, 3.0), D.Beta(4.0, 1.5)
+        from paddle_tpu.distribution.kl import _expfamily_expfamily
+
+        generic = float(_np(_expfamily_expfamily(p, q)))
+        explicit = float(_np(D.kl_divergence(p, q)))
+        np.testing.assert_allclose(generic, explicit, rtol=1e-4)
+
+    def test_kl_binomial_total_count(self):
+        same = D.kl_divergence(D.Binomial(10.0, 0.3), D.Binomial(10.0, 0.4))
+        assert float(_np(same)) > 0
+        bigger_p = D.kl_divergence(D.Binomial(20.0, 0.3), D.Binomial(10.0, 0.3))
+        assert np.isinf(_np(bigger_p)).all()
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Binomial(10.0, 0.3), D.Binomial(20.0, 0.3))
+
+    def test_chain_inverse_ldj(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0), D.ExpTransform()])
+        x = paddle.to_tensor(np.asarray([0.1, 0.5], "float32"))
+        y = chain.forward(x)
+        np.testing.assert_allclose(
+            _np(chain.inverse_log_det_jacobian(y)),
+            -_np(chain.forward_log_det_jacobian(x)),
+            rtol=1e-5,
+        )
+
+    def test_register_kl_custom(self):
+        class MyDist(D.Normal):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _my_kl(p, q):
+            return paddle.to_tensor(42.0)
+
+        assert float(_np(D.kl_divergence(MyDist(0.0, 1.0), MyDist(0.0, 1.0)))) == 42.0

@@ -193,3 +193,28 @@ class TestSignal:
 
     def test_lazy_attr_error(self):
         assert not hasattr(paddle, "definitely_not_a_module")
+
+    def test_stft_istft_arg_validation(self):
+        x = paddle.ones([64])
+        with pytest.raises(ValueError):
+            paddle.signal.stft(x, 16, hop_length=0)
+        with pytest.raises(ValueError):
+            paddle.signal.stft(x, 16, hop_length=-4)
+        # window length must equal win_length
+        with pytest.raises(ValueError):
+            paddle.signal.stft(x, 16, win_length=8, window=paddle.ones([16]))
+        with pytest.raises(ValueError):
+            paddle.signal.stft(x, 16, window=paddle.ones([32]))
+        spec = paddle.signal.stft(x, 16)
+        with pytest.raises(ValueError):
+            paddle.signal.istft(spec, 16, return_complex=True)
+        with pytest.raises(ValueError):
+            paddle.signal.istft(spec, 16, hop_length=0)
+
+    def test_istft_nola_rejected(self):
+        # hop > effective window support: envelope has zero gaps
+        spec = paddle.signal.stft(paddle.ones([256]), 32, hop_length=8)
+        bad_w = np.zeros(32, "float32")
+        bad_w[:4] = 1.0
+        with pytest.raises(ValueError):
+            paddle.signal.istft(spec, 32, hop_length=8, window=paddle.to_tensor(bad_w))
