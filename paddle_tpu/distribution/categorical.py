@@ -29,6 +29,11 @@ def _cat_gather_fwd(probs, idx):
     idx = idx.astype(jnp.int64)
     if probs.ndim == 1:
         return probs[idx]
+    # idx: sample_shape + batch_shape, probs: batch_shape + (K,) — broadcast
+    # probs over the leading sample dims before gathering along categories
+    extra = idx.ndim - (probs.ndim - 1)
+    if extra > 0:
+        probs = jnp.broadcast_to(probs, idx.shape[:extra] + probs.shape)
     return jnp.take_along_axis(probs, idx[..., None], axis=-1)[..., 0]
 
 

@@ -52,11 +52,6 @@ def _cb_var_fwd(p, *, lims):
 
 def _cb_cdf_fwd(value, p, *, lims):
     unstable, lam = _safe_lam(p, lims)
-    exact = (
-        jax.scipy.special.xlogy(value, lam)
-        + jax.scipy.special.xlog1py(1.0 - value, -lam)
-    )
-    numer = jnp.exp(exact) * (2.0 * jnp.arctanh(1.0 - 2.0 * lam)) / (1.0 - 2.0 * lam)
     # closed form: [λ^x (1-λ)^(1-x) + λ - 1] / (2λ - 1)
     cdf_exact = (
         jnp.power(lam, value) * jnp.power(1.0 - lam, 1.0 - value) + lam - 1.0
@@ -74,20 +69,29 @@ def _cb_icdf_fwd(u, p, *, lims):
     return jnp.where(unstable, u, exact)
 
 
+_log_prob_p = dprim("cb_log_prob", _cb_log_prob_fwd)
+_mean_p = dprim("cb_mean", _cb_mean_fwd)
+_var_p = dprim("cb_var", _cb_var_fwd)
+_cdf_p = dprim("cb_cdf", _cb_cdf_fwd)
+_icdf_p = dprim("cb_icdf", _cb_icdf_fwd)
+_u_p = dprim(
+    "cb_uniform",
+    lambda key, *, shape, dtype: jax.random.uniform(key, shape, jnp.dtype(dtype)),
+    nondiff=True,
+)
+
+
 class ContinuousBernoulli(Distribution):
+    _log_prob_p = staticmethod(_log_prob_p)
+    _mean_p = staticmethod(_mean_p)
+    _var_p = staticmethod(_var_p)
+    _cdf_p = staticmethod(_cdf_p)
+    _icdf_p = staticmethod(_icdf_p)
+    _u_p = staticmethod(_u_p)
+
     def __init__(self, probs, lims=(0.499, 0.501), name=None):
         (self.probs,) = broadcast_params(probs)
         self._lims = (float(lims[0]), float(lims[1]))
-        self._log_prob_p = dprim("cb_log_prob", _cb_log_prob_fwd)
-        self._mean_p = dprim("cb_mean", _cb_mean_fwd)
-        self._var_p = dprim("cb_var", _cb_var_fwd)
-        self._cdf_p = dprim("cb_cdf", _cb_cdf_fwd)
-        self._icdf_p = dprim("cb_icdf", _cb_icdf_fwd)
-        self._u_p = dprim(
-            "cb_uniform",
-            lambda key, *, shape, dtype: jax.random.uniform(key, shape, jnp.dtype(dtype)),
-            nondiff=True,
-        )
         super().__init__(tuple(self.probs.shape))
 
     @property

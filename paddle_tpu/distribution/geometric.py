@@ -4,8 +4,6 @@ Support k ∈ {0, 1, 2, ...} with pmf (1-p)^k p (geometric.py:129 docstring);
 mean = 1/p - 1 (:112)."""
 from __future__ import annotations
 
-import numbers
-
 from ._ddefs import broadcast_params, dprim, ensure_tensor, jax, jnp, key_tensor, to_shape_tuple
 from .distribution import Distribution
 
@@ -45,8 +43,6 @@ class Geometric(Distribution):
         return (1.0 / self.probs - 1.0) / self.probs
 
     def pmf(self, k):
-        if not isinstance(k, (numbers.Real,)) and not hasattr(k, "_value"):
-            raise TypeError(f"Expected int or Tensor k, got {type(k)}")
         from ..ops.math import pow as pow_
 
         return pow_(1.0 - self.probs, ensure_tensor(k)) * self.probs

@@ -74,8 +74,3 @@ class Normal(Distribution):
         from ._ddefs import ensure_tensor
 
         return _normal_icdf(ensure_tensor(value), self.loc, self.scale)
-
-    def kl_divergence(self, other):
-        from .kl import kl_divergence
-
-        return kl_divergence(self, other)

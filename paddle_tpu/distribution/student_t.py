@@ -34,6 +34,14 @@ def _t_entropy_fwd(df, scale):
 
 
 _t_entropy = dprim("t_entropy", _t_entropy_fwd)
+_t_variance = dprim(
+    "t_variance",
+    lambda df, scale: jnp.where(
+        df > 2.0,
+        scale * scale * df / jnp.where(df > 2.0, df - 2.0, 1.0),
+        jnp.where(df > 1.0, jnp.inf, jnp.nan),
+    ),
+)
 
 
 class StudentT(Distribution):
@@ -47,7 +55,8 @@ class StudentT(Distribution):
 
     @property
     def variance(self):
-        return self.scale * self.scale * self.df / (self.df - 2.0)
+        # undefined moments: inf for 1 < df <= 2, nan for df <= 1
+        return _t_variance(self.df, self.scale)
 
     def sample(self, shape=()):
         full = to_shape_tuple(shape) + self.batch_shape

@@ -1,0 +1,65 @@
+"""Segment reductions (reference: python/paddle/geometric/math.py:23-260).
+
+Lowered to XLA's segment reductions (jax.ops.segment_*), which compile to
+efficient TPU scatter programs. ``num_segments`` is shape-determining, so the
+wrapper reads the last segment id eagerly (paddle semantics: segment_ids are
+sorted, result has segment_ids[-1]+1 rows) and passes it as a static arg."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ..ops._helpers import defprim, ensure_tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max"]
+
+defprim(
+    "segment_sum_p",
+    lambda data, ids, *, n: jax.ops.segment_sum(data, ids, num_segments=n),
+)
+defprim(
+    "segment_mean_p",
+    lambda data, ids, *, n: jax.ops.segment_sum(data, ids, num_segments=n)
+    / jnp.maximum(
+        jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype), ids, num_segments=n),
+        1.0,
+    ).reshape((n,) + (1,) * (data.ndim - 1)),
+)
+defprim(
+    "segment_min_p",
+    lambda data, ids, *, n: jnp.where(
+        jnp.isinf(m := jax.ops.segment_min(data, ids, num_segments=n)), 0.0, m
+    ).astype(data.dtype),
+)
+defprim(
+    "segment_max_p",
+    lambda data, ids, *, n: jnp.where(
+        jnp.isinf(m := jax.ops.segment_max(data, ids, num_segments=n)), 0.0, m
+    ).astype(data.dtype),
+)
+
+
+def _segment(prim, data, segment_ids):
+    data, segment_ids = ensure_tensor(data), ensure_tensor(segment_ids)
+    if segment_ids.ndim != 1:
+        raise ValueError("segment_ids should be 1-D")
+    n = int(np.asarray(segment_ids._value[-1])) + 1 if segment_ids.shape[0] else 0
+    return apply(prim, data, segment_ids, n=n)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("segment_sum_p", data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment("segment_mean_p", data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("segment_min_p", data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("segment_max_p", data, segment_ids)

@@ -1,0 +1,104 @@
+"""Graph message passing (reference:
+python/paddle/geometric/message_passing/send_recv.py:36,186,389).
+
+send_u_recv gathers source-node features along edges and scatter-reduces to
+destinations — one fused XLA gather+segment-reduce program on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ..ops._helpers import binary_args, defprim, ensure_tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv"]
+
+_MSG_OPS = ("add", "sub", "mul", "div")
+_REDUCE_OPS = ("sum", "mean", "max", "min")
+
+
+def _message(m, x_e, y_e, op):
+    if op == "add":
+        return x_e + y_e
+    if op == "sub":
+        return x_e - y_e
+    if op == "mul":
+        return x_e * y_e
+    return x_e / y_e
+
+
+def _reduce(msg, dst, n, op):
+    if op == "sum":
+        return jax.ops.segment_sum(msg, dst, num_segments=n)
+    if op == "mean":
+        s = jax.ops.segment_sum(msg, dst, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst, num_segments=n)
+        return s / jnp.maximum(c, 1.0).reshape((n,) + (1,) * (msg.ndim - 1))
+    if op == "max":
+        m = jax.ops.segment_max(msg, dst, num_segments=n)
+    else:
+        m = jax.ops.segment_min(msg, dst, num_segments=n)
+    return jnp.where(jnp.isinf(m), 0.0, m).astype(msg.dtype)
+
+
+defprim(
+    "send_u_recv_p",
+    lambda x, src, dst, *, reduce_op, n: _reduce(x[src], dst, n, reduce_op),
+)
+def _send_ue_recv_fwd(x, y, src, dst, *, message_op, reduce_op, n):
+    x_e = x[src]
+    # edge features broadcast against node features on trailing dims
+    if y.ndim < x_e.ndim:
+        y = y.reshape(y.shape + (1,) * (x_e.ndim - y.ndim))
+    return _reduce(_message(None, x_e, y, message_op), dst, n, reduce_op)
+
+
+defprim("send_ue_recv_p", _send_ue_recv_fwd)
+defprim(
+    "send_uv_p",
+    lambda x, y, src, dst, *, message_op: _message(None, x[src], y[dst], message_op),
+)
+
+
+def _indices(src_index, dst_index):
+    src, dst = ensure_tensor(src_index), ensure_tensor(dst_index)
+    if src.ndim != 1 or dst.ndim != 1 or src.shape[0] != dst.shape[0]:
+        raise ValueError("src_index and dst_index should be 1-D with equal length")
+    return src, dst
+
+
+def _out_size(out_size, dst):
+    if out_size is not None:
+        return int(out_size)
+    return int(np.asarray(jnp.max(dst._value))) + 1 if dst.shape[0] else 0
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, name=None):
+    if reduce_op not in _REDUCE_OPS:
+        raise ValueError(f"reduce_op should be one of {_REDUCE_OPS}, got {reduce_op}")
+    x = ensure_tensor(x)
+    src, dst = _indices(src_index, dst_index)
+    return apply("send_u_recv_p", x, src, dst, reduce_op=reduce_op,
+                 n=_out_size(out_size, dst))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
+                 out_size=None, name=None):
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"message_op should be one of {_MSG_OPS}, got {message_op}")
+    if reduce_op not in _REDUCE_OPS:
+        raise ValueError(f"reduce_op should be one of {_REDUCE_OPS}, got {reduce_op}")
+    x, y = binary_args(x, y)
+    src, dst = _indices(src_index, dst_index)
+    return apply("send_ue_recv_p", x, y, src, dst, message_op=message_op,
+                 reduce_op=reduce_op, n=_out_size(out_size, dst))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"message_op should be one of {_MSG_OPS}, got {message_op}")
+    x, y = binary_args(x, y)
+    src, dst = _indices(src_index, dst_index)
+    return apply("send_uv_p", x, y, src, dst, message_op=message_op)

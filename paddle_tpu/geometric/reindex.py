@@ -1,0 +1,65 @@
+"""Graph reindexing (reference: python/paddle/geometric/reindex.py:25,139).
+
+Host-side graph preprocessing with data-dependent output shapes — in the
+reference these are CPU/GPU kernels used between sampling steps; here they
+run eagerly in numpy (the results feed static-shape device programs).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops._helpers import ensure_tensor
+
+__all__ = ["reindex_graph", "reindex_heter_graph"]
+
+
+def _np(t):
+    return np.asarray(ensure_tensor(t)._value)
+
+
+def _reindex(x, neighbor_list, count_list):
+    """Shared core: map original ids to [0, num_unique) with center nodes
+    first (reference semantics: out_nodes = x ++ first-seen neighbors)."""
+    out_nodes = list(x.tolist())
+    mapping = {int(v): i for i, v in enumerate(out_nodes)}
+    all_neighbors = np.concatenate(neighbor_list) if neighbor_list else np.empty(0, x.dtype)
+    for v in all_neighbors.tolist():
+        if int(v) not in mapping:
+            mapping[int(v)] = len(out_nodes)
+            out_nodes.append(int(v))
+    reindex_src = np.asarray([mapping[int(v)] for v in all_neighbors], dtype=x.dtype)
+    # dst: center node of each neighbor, repeated per count
+    dst_all = []
+    for neighbors, count in zip(neighbor_list, count_list):
+        dst = np.repeat(np.arange(len(x), dtype=x.dtype), count)
+        dst_all.append(dst)
+    reindex_dst = np.concatenate(dst_all) if dst_all else np.empty(0, x.dtype)
+    return (
+        np.asarray(reindex_src),
+        reindex_dst,
+        np.asarray(out_nodes, dtype=x.dtype),
+    )
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    x_np, nbr, cnt = _np(x), _np(neighbors), _np(count)
+    src, dst, nodes = _reindex(x_np, [nbr], [cnt])
+    return (
+        Tensor._from_value(src),
+        Tensor._from_value(dst),
+        Tensor._from_value(nodes),
+    )
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                        name=None):
+    x_np = _np(x)
+    nbrs = [_np(n) for n in neighbors]
+    cnts = [_np(c) for c in count]
+    src, dst, nodes = _reindex(x_np, nbrs, cnts)
+    return (
+        Tensor._from_value(src),
+        Tensor._from_value(dst),
+        Tensor._from_value(nodes),
+    )

@@ -1,0 +1,73 @@
+"""Neighbor sampling (reference: python/paddle/geometric/sampling/neighbors.py:23,172).
+
+Graph stored CSC: ``row`` holds the source of every edge, ``colptr[i]:
+colptr[i+1]`` spans the in-edges of node i. Data-dependent output shapes →
+host-side numpy, seeded from the framework generator stream so paddle.seed
+reproduces draws."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import generator
+from ..core.tensor import Tensor
+from ..ops._helpers import ensure_tensor
+
+__all__ = ["sample_neighbors", "weighted_sample_neighbors"]
+
+
+def _np(t):
+    return np.asarray(ensure_tensor(t)._value)
+
+
+def _rng():
+    import jax
+
+    key = generator.next_key()
+    return np.random.default_rng(int(jax.random.randint(key, (), 0, 2**31 - 1)))
+
+
+def _sample(row, colptr, nodes, sample_size, eids, return_eids, weights=None):
+    rng = _rng()
+    out_neighbors, out_counts, out_eids = [], [], []
+    for node in nodes.tolist():
+        beg, end = int(colptr[node]), int(colptr[node + 1])
+        cand = row[beg:end]
+        idx = np.arange(beg, end)
+        if sample_size != -1 and len(cand) > sample_size:
+            if weights is not None:
+                w = weights[beg:end].astype("float64")
+                p = w / w.sum()
+                pick = rng.choice(len(cand), size=sample_size, replace=False, p=p)
+            else:
+                pick = rng.choice(len(cand), size=sample_size, replace=False)
+            cand, idx = cand[pick], idx[pick]
+        out_neighbors.append(cand)
+        out_counts.append(len(cand))
+        out_eids.append(eids[idx] if eids is not None else idx)
+    neighbors = np.concatenate(out_neighbors) if out_neighbors else np.empty(0, row.dtype)
+    counts = np.asarray(out_counts, dtype=row.dtype)
+    rets = (Tensor._from_value(neighbors), Tensor._from_value(counts))
+    if return_eids:
+        e = np.concatenate(out_eids) if out_eids else np.empty(0, row.dtype)
+        rets = rets + (Tensor._from_value(e.astype(row.dtype)),)
+    return rets
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    if return_eids and eids is None:
+        raise ValueError("eids should not be None if return_eids is True.")
+    return _sample(
+        _np(row), _np(colptr), _np(input_nodes), int(sample_size),
+        None if eids is None else _np(eids), return_eids,
+    )
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes, sample_size=-1,
+                              eids=None, return_eids=False, name=None):
+    if return_eids and eids is None:
+        raise ValueError("eids should not be None if return_eids is True.")
+    return _sample(
+        _np(row), _np(colptr), _np(input_nodes), int(sample_size),
+        None if eids is None else _np(eids), return_eids, weights=_np(edge_weight),
+    )

@@ -203,6 +203,27 @@ class TestMultivariate:
         q = D.MultivariateNormal(mu, covariance_matrix=cov)
         assert abs(float(_np(D.kl_divergence(p, q)))) < 1e-5
 
+    def test_student_t_variance_regimes(self):
+        np.testing.assert_allclose(float(_np(D.StudentT(5.0, 0.0, 2.0).variance)), 4.0 * 5 / 3, rtol=1e-5)
+        assert np.isinf(float(_np(D.StudentT(1.5, 0.0, 1.0).variance)))
+        assert np.isnan(float(_np(D.StudentT(0.5, 0.0, 1.0).variance)))
+
+    def test_categorical_batched_sample_log_prob(self):
+        logits = np.asarray([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0]], "float32")
+        d = D.Categorical(paddle.to_tensor(logits))
+        s = d.sample([5])
+        assert tuple(s.shape) == (5, 2)
+        lp = d.log_prob(s)
+        assert tuple(lp.shape) == (5, 2)
+        assert np.isfinite(_np(lp)).all()
+
+    def test_geometric_log_prob_array(self):
+        d = D.Geometric(0.4)
+        lp = d.log_prob(np.asarray([0.0, 1.0, 2.0], "float32"))
+        import scipy.stats as _st
+
+        np.testing.assert_allclose(_np(lp), _st.geom.logpmf([1, 2, 3], 0.4), rtol=1e-4)
+
     def test_student_t(self):
         d = D.StudentT(5.0, 0.5, 2.0)
         x = np.asarray([-1.0, 0.5, 3.0], "float32")

@@ -1,0 +1,178 @@
+"""paddle.geometric vs numpy oracles (reference test model: test/collective/../
+test_segment_ops.py, test_graph_send_recv.py, test_graph_reindex.py,
+test_graph_sample_neighbors.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestSegmentOps:
+    def setup_method(self, _):
+        self.data = np.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0], [7.0, 8.0]], "float32")
+        self.ids = np.asarray([0, 0, 1, 3])
+
+    def test_segment_sum(self):
+        out = G.segment_sum(paddle.to_tensor(self.data), paddle.to_tensor(self.ids))
+        expected = np.asarray([[4, 6], [5, 6], [0, 0], [7, 8]], "float32")
+        np.testing.assert_allclose(_np(out), expected)
+
+    def test_segment_mean(self):
+        out = G.segment_mean(paddle.to_tensor(self.data), paddle.to_tensor(self.ids))
+        expected = np.asarray([[2, 3], [5, 6], [0, 0], [7, 8]], "float32")
+        np.testing.assert_allclose(_np(out), expected)
+
+    def test_segment_min_max(self):
+        mn = G.segment_min(paddle.to_tensor(self.data), paddle.to_tensor(self.ids))
+        mx = G.segment_max(paddle.to_tensor(self.data), paddle.to_tensor(self.ids))
+        np.testing.assert_allclose(_np(mn), [[1, 2], [5, 6], [0, 0], [7, 8]])
+        np.testing.assert_allclose(_np(mx), [[3, 4], [5, 6], [0, 0], [7, 8]])
+
+    def test_segment_sum_grad(self):
+        x = paddle.to_tensor(self.data, stop_gradient=False)
+        out = G.segment_sum(x, paddle.to_tensor(self.ids))
+        out.sum().backward()
+        np.testing.assert_allclose(_np(x.grad), np.ones_like(self.data))
+
+
+class TestMessagePassing:
+    def setup_method(self, _):
+        self.x = np.asarray([[0.0, 2.0, 3.0], [1.0, 4.0, 5.0], [2.0, 6.0, 7.0]], "float32")
+        self.src = np.asarray([0, 1, 2, 0])
+        self.dst = np.asarray([1, 2, 1, 0])
+
+    def test_send_u_recv_sum(self):
+        out = G.send_u_recv(paddle.to_tensor(self.x), paddle.to_tensor(self.src),
+                            paddle.to_tensor(self.dst))
+        expected = np.zeros_like(self.x)
+        for s, d in zip(self.src, self.dst):
+            expected[d] += self.x[s]
+        np.testing.assert_allclose(_np(out), expected)
+
+    def test_send_u_recv_mean_max(self):
+        for op in ("mean", "max", "min"):
+            out = G.send_u_recv(paddle.to_tensor(self.x), paddle.to_tensor(self.src),
+                                paddle.to_tensor(self.dst), reduce_op=op)
+            assert _np(out).shape == self.x.shape
+
+    def test_send_u_recv_out_size(self):
+        out = G.send_u_recv(paddle.to_tensor(self.x), paddle.to_tensor(self.src),
+                            paddle.to_tensor(self.dst), out_size=5)
+        assert _np(out).shape == (5, 3)
+
+    def test_send_ue_recv(self):
+        y = np.asarray([1.0, 2.0, 3.0, 4.0], "float32")
+        out = G.send_ue_recv(paddle.to_tensor(self.x), paddle.to_tensor(y),
+                             paddle.to_tensor(self.src), paddle.to_tensor(self.dst),
+                             message_op="mul", reduce_op="sum")
+        expected = np.zeros_like(self.x)
+        for i, (s, d) in enumerate(zip(self.src, self.dst)):
+            expected[d] += self.x[s] * y[i]
+        np.testing.assert_allclose(_np(out), expected)
+
+    def test_send_uv(self):
+        y = self.x + 1
+        out = G.send_uv(paddle.to_tensor(self.x), paddle.to_tensor(y),
+                        paddle.to_tensor(self.src), paddle.to_tensor(self.dst),
+                        message_op="add")
+        expected = self.x[self.src] + y[self.dst]
+        np.testing.assert_allclose(_np(out), expected)
+
+    def test_send_u_recv_grad(self):
+        x = paddle.to_tensor(self.x, stop_gradient=False)
+        out = G.send_u_recv(x, paddle.to_tensor(self.src), paddle.to_tensor(self.dst))
+        out.sum().backward()
+        expected = np.zeros_like(self.x)
+        for s in self.src:
+            expected[s] += 1.0
+        np.testing.assert_allclose(_np(x.grad), expected)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            G.send_u_recv(paddle.to_tensor(self.x), paddle.to_tensor(self.src),
+                          paddle.to_tensor(self.dst), reduce_op="bogus")
+        with pytest.raises(ValueError):
+            G.send_uv(paddle.to_tensor(self.x), paddle.to_tensor(self.x),
+                      paddle.to_tensor(self.src), paddle.to_tensor(self.dst),
+                      message_op="bogus")
+
+
+class TestReindex:
+    def test_reindex_graph(self):
+        x = paddle.to_tensor(np.asarray([0, 5, 9]))
+        neighbors = paddle.to_tensor(np.asarray([8, 9, 0, 4, 7, 6, 7]))
+        count = paddle.to_tensor(np.asarray([2, 3, 2]))
+        src, dst, nodes = G.reindex_graph(x, neighbors, count)
+        nodes_np = _np(nodes)
+        # center nodes first, then first-seen neighbors
+        np.testing.assert_array_equal(nodes_np[:3], [0, 5, 9])
+        assert set(nodes_np.tolist()) == {0, 5, 9, 8, 4, 7, 6}
+        # mapping round-trips
+        np.testing.assert_array_equal(nodes_np[_np(src)], [8, 9, 0, 4, 7, 6, 7])
+        np.testing.assert_array_equal(_np(dst), [0, 0, 1, 1, 1, 2, 2])
+
+    def test_reindex_heter_graph(self):
+        x = paddle.to_tensor(np.asarray([0, 3]))
+        n1 = paddle.to_tensor(np.asarray([1, 2, 4]))
+        c1 = paddle.to_tensor(np.asarray([2, 1]))
+        n2 = paddle.to_tensor(np.asarray([0, 2]))
+        c2 = paddle.to_tensor(np.asarray([1, 1]))
+        src, dst, nodes = G.reindex_heter_graph(x, [n1, n2], [c1, c2])
+        assert _np(src).shape == (5,)
+        assert _np(dst).shape == (5,)
+        np.testing.assert_array_equal(_np(nodes)[:2], [0, 3])
+
+
+class TestSampling:
+    def _csc(self):
+        # graph: node 0 <- {1,2,3}, node 1 <- {0,2}, node 2 <- {}
+        row = np.asarray([1, 2, 3, 0, 2])
+        colptr = np.asarray([0, 3, 5, 5])
+        return row, colptr
+
+    def test_sample_all(self):
+        row, colptr = self._csc()
+        n, c = G.sample_neighbors(paddle.to_tensor(row), paddle.to_tensor(colptr),
+                                  paddle.to_tensor(np.asarray([0, 1, 2])))
+        np.testing.assert_array_equal(_np(c), [3, 2, 0])
+        np.testing.assert_array_equal(_np(n), [1, 2, 3, 0, 2])
+
+    def test_sample_limited_reproducible(self):
+        row, colptr = self._csc()
+        paddle.seed(42)
+        n1, c1 = G.sample_neighbors(paddle.to_tensor(row), paddle.to_tensor(colptr),
+                                    paddle.to_tensor(np.asarray([0])), sample_size=2)
+        assert _np(c1)[0] == 2
+        assert set(_np(n1).tolist()) <= {1, 2, 3}
+        paddle.seed(42)
+        n2, _ = G.sample_neighbors(paddle.to_tensor(row), paddle.to_tensor(colptr),
+                                   paddle.to_tensor(np.asarray([0])), sample_size=2)
+        np.testing.assert_array_equal(_np(n1), _np(n2))
+
+    def test_sample_eids(self):
+        row, colptr = self._csc()
+        eids = np.asarray([10, 11, 12, 13, 14])
+        n, c, e = G.sample_neighbors(paddle.to_tensor(row), paddle.to_tensor(colptr),
+                                     paddle.to_tensor(np.asarray([1])),
+                                     eids=paddle.to_tensor(eids), return_eids=True)
+        np.testing.assert_array_equal(_np(e), [13, 14])
+        with pytest.raises(ValueError):
+            G.sample_neighbors(paddle.to_tensor(row), paddle.to_tensor(colptr),
+                               paddle.to_tensor(np.asarray([1])), return_eids=True)
+
+    def test_weighted_sample(self):
+        row, colptr = self._csc()
+        w = np.asarray([100.0, 1e-6, 1e-6, 1.0, 1.0], "float32")
+        paddle.seed(0)
+        counts = np.zeros(4)
+        for _ in range(20):
+            n, c = G.weighted_sample_neighbors(
+                paddle.to_tensor(row), paddle.to_tensor(colptr),
+                paddle.to_tensor(w), paddle.to_tensor(np.asarray([0])), sample_size=1)
+            counts[_np(n)[0]] += 1
+        assert counts[1] >= 18  # heavy-weight neighbor dominates
