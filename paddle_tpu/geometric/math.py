@@ -15,29 +15,39 @@ from ..ops._helpers import defprim, ensure_tensor
 
 __all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max"]
 
+def _segment_counts(ids, n):
+    return jax.ops.segment_sum(jnp.ones_like(ids, jnp.int32), ids, num_segments=n)
+
+
+def _bshape(n, data):
+    return (n,) + (1,) * (data.ndim - 1)
+
+
+def _segment_mean_raw(data, ids, n):
+    s = jax.ops.segment_sum(data, ids, num_segments=n)
+    c = jnp.maximum(_segment_counts(ids, n), 1).reshape(_bshape(n, data))
+    return (s / c.astype(s.dtype)).astype(data.dtype)
+
+
+def _segment_extreme_raw(data, ids, n, op):
+    """segment min/max with paddle's empty-segment fill of 0 — masked on the
+    segment count, so integer sentinels and legitimate ±inf values survive."""
+    fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+    m = fn(data, ids, num_segments=n)
+    empty = (_segment_counts(ids, n) == 0).reshape(_bshape(n, data))
+    return jnp.where(empty, jnp.zeros((), data.dtype), m)
+
+
 defprim(
     "segment_sum_p",
     lambda data, ids, *, n: jax.ops.segment_sum(data, ids, num_segments=n),
 )
+defprim("segment_mean_p", lambda data, ids, *, n: _segment_mean_raw(data, ids, n))
 defprim(
-    "segment_mean_p",
-    lambda data, ids, *, n: jax.ops.segment_sum(data, ids, num_segments=n)
-    / jnp.maximum(
-        jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype), ids, num_segments=n),
-        1.0,
-    ).reshape((n,) + (1,) * (data.ndim - 1)),
+    "segment_min_p", lambda data, ids, *, n: _segment_extreme_raw(data, ids, n, "min")
 )
 defprim(
-    "segment_min_p",
-    lambda data, ids, *, n: jnp.where(
-        jnp.isinf(m := jax.ops.segment_min(data, ids, num_segments=n)), 0.0, m
-    ).astype(data.dtype),
-)
-defprim(
-    "segment_max_p",
-    lambda data, ids, *, n: jnp.where(
-        jnp.isinf(m := jax.ops.segment_max(data, ids, num_segments=n)), 0.0, m
-    ).astype(data.dtype),
+    "segment_max_p", lambda data, ids, *, n: _segment_extreme_raw(data, ids, n, "max")
 )
 
 

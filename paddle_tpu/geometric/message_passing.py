@@ -19,7 +19,10 @@ _MSG_OPS = ("add", "sub", "mul", "div")
 _REDUCE_OPS = ("sum", "mean", "max", "min")
 
 
-def _message(m, x_e, y_e, op):
+from .math import _segment_extreme_raw, _segment_mean_raw
+
+
+def _message(x_e, y_e, op):
     if op == "add":
         return x_e + y_e
     if op == "sub":
@@ -33,14 +36,8 @@ def _reduce(msg, dst, n, op):
     if op == "sum":
         return jax.ops.segment_sum(msg, dst, num_segments=n)
     if op == "mean":
-        s = jax.ops.segment_sum(msg, dst, num_segments=n)
-        c = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst, num_segments=n)
-        return s / jnp.maximum(c, 1.0).reshape((n,) + (1,) * (msg.ndim - 1))
-    if op == "max":
-        m = jax.ops.segment_max(msg, dst, num_segments=n)
-    else:
-        m = jax.ops.segment_min(msg, dst, num_segments=n)
-    return jnp.where(jnp.isinf(m), 0.0, m).astype(msg.dtype)
+        return _segment_mean_raw(msg, dst, n)
+    return _segment_extreme_raw(msg, dst, n, op)
 
 
 defprim(
@@ -52,13 +49,13 @@ def _send_ue_recv_fwd(x, y, src, dst, *, message_op, reduce_op, n):
     # edge features broadcast against node features on trailing dims
     if y.ndim < x_e.ndim:
         y = y.reshape(y.shape + (1,) * (x_e.ndim - y.ndim))
-    return _reduce(_message(None, x_e, y, message_op), dst, n, reduce_op)
+    return _reduce(_message(x_e, y, message_op), dst, n, reduce_op)
 
 
 defprim("send_ue_recv_p", _send_ue_recv_fwd)
 defprim(
     "send_uv_p",
-    lambda x, y, src, dst, *, message_op: _message(None, x[src], y[dst], message_op),
+    lambda x, y, src, dst, *, message_op: _message(x[src], y[dst], message_op),
 )
 
 

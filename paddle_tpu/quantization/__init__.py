@@ -1,0 +1,31 @@
+"""``paddle.quantization`` parity package (reference:
+python/paddle/quantization/__init__.py)."""
+from .base import (
+    BaseObserver,
+    BaseQuanter,
+    ObserverFactory,
+    QuanterFactory,
+    fake_quant_dequant,
+    quanter,
+)
+from .config import QuantConfig, SingleLayerConfig
+from .observers import (
+    AbsmaxObserver,
+    AbsmaxObserverLayer,
+    GroupWiseWeightObserver,
+    GroupWiseWeightObserverLayer,
+)
+from .quanters import (
+    FakeQuanterWithAbsMaxObserver,
+    FakeQuanterWithAbsMaxObserverLayer,
+)
+from .quantize import PTQ, QAT, Quantization
+from .wrapper import ObserveWrapper, QuantedWrapper
+
+__all__ = [
+    "QuantConfig", "SingleLayerConfig", "BaseQuanter", "BaseObserver",
+    "QuanterFactory", "ObserverFactory", "quanter",
+    "FakeQuanterWithAbsMaxObserver", "AbsmaxObserver",
+    "GroupWiseWeightObserver", "QAT", "PTQ", "Quantization",
+    "QuantedWrapper", "ObserveWrapper", "fake_quant_dequant",
+]

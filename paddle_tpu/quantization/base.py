@@ -1,0 +1,132 @@
+"""Quantization base classes and the fake-quant primitive.
+
+Reference: python/paddle/quantization/base_quanter.py:25,
+base_observer.py:21, factory.py:52-130. Fake quantization runs as one
+framework primitive with a straight-through-estimator VJP (gradient passes
+inside the clip range, zero outside) — the TPU analog of the reference's
+fake_quantize_dequantize_moving_average_abs_max kernel pair.
+"""
+from __future__ import annotations
+
+import abc
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import register_primitive
+from ..core.tensor import Tensor, apply
+from ..nn.layer import Layer
+
+
+def _fake_quant_fwd(x, scale, *, bit_length, quant_axis):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    if quant_axis is not None and s.ndim:
+        shape = [1] * x.ndim
+        shape[quant_axis] = -1
+        s = s.reshape(shape)
+    return jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) / qmax * s
+
+
+def _fake_quant_vjp(grads_out, saved, *, bit_length, quant_axis):
+    x, scale = saved
+    s = jnp.maximum(scale, 1e-9)
+    if quant_axis is not None and s.ndim:
+        shape = [1] * x.ndim
+        shape[quant_axis] = -1
+        s = s.reshape(shape)
+    g = grads_out[0]
+    mask = (jnp.abs(x) <= s).astype(g.dtype)
+    return (g * mask, None)
+
+
+register_primitive(
+    "fake_quant_dequant", _fake_quant_fwd, vjp=_fake_quant_vjp
+)
+
+
+def fake_quant_dequant(x, scale, bit_length=8, quant_axis=None):
+    return apply(
+        "fake_quant_dequant", x, scale,
+        bit_length=int(bit_length), quant_axis=quant_axis,
+    )
+
+
+class BaseQuanter(Layer, metaclass=abc.ABCMeta):
+    """Built-in and customized quanters implement forward + quant params."""
+
+    @abc.abstractmethod
+    def forward(self, input):
+        ...
+
+    @abc.abstractmethod
+    def scales(self):
+        ...
+
+    @abc.abstractmethod
+    def zero_points(self):
+        ...
+
+    def quant_axis(self):
+        return None
+
+    def bit_length(self):
+        return 8
+
+
+class BaseObserver(BaseQuanter, metaclass=abc.ABCMeta):
+    """Observers collect statistics during calibration (PTQ)."""
+
+    @abc.abstractmethod
+    def cal_thresholds(self):
+        ...
+
+
+class ClassWithArguments(metaclass=abc.ABCMeta):
+    def __init__(self, **kwargs):
+        self._args = kwargs
+
+    @property
+    def args(self):
+        return self._args
+
+    @abc.abstractmethod
+    def _get_class(self):
+        ...
+
+    def _instance(self, layer):
+        return self._get_class()(layer, **self._args)
+
+
+class QuanterFactory(ClassWithArguments):
+    """Holds a quanter class + ctor args (reference factory.py:52)."""
+
+    def __init__(self, cls=None, **kwargs):
+        super().__init__(**kwargs)
+        self._cls = cls
+
+    def _get_class(self):
+        return self._cls
+
+
+ObserverFactory = QuanterFactory
+
+
+def quanter(class_name):
+    """Decorator declaring a factory class for a quanter
+    (reference factory.py:78): adds ``class_name`` to the quanter's module
+    so users write ``MyQuanter(bit_length=8)`` to get a factory."""
+
+    def wrapper(cls):
+        import sys
+
+        def fac_init(self, **kwargs):
+            QuanterFactory.__init__(self, cls, **kwargs)
+
+        fac = type(class_name, (QuanterFactory,), {"__init__": fac_init})
+        setattr(sys.modules[cls.__module__], class_name, fac)
+        cls.__factory__ = fac
+        return cls
+
+    return wrapper

@@ -1,0 +1,102 @@
+"""Quantization configuration (reference: python/paddle/quantization/config.py:35-440).
+
+QuantConfig maps layers (by instance, by type, or by name prefix) to a
+SingleLayerConfig of (activation quanter factory, weight quanter factory)."""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from .base import QuanterFactory
+
+
+class SingleLayerConfig:
+    def __init__(self, activation, weight):
+        self._activation = activation
+        self._weight = weight
+
+    @property
+    def activation(self):
+        return self._activation
+
+    @property
+    def weight(self):
+        return self._weight
+
+    def __str__(self):
+        return f"activation: {self._activation}\nweight: {self._weight}"
+
+
+class QuantConfig:
+    def __init__(self, activation, weight):
+        if activation is not None and not isinstance(activation, QuanterFactory):
+            raise TypeError("activation should be a QuanterFactory or None")
+        if weight is not None and not isinstance(weight, QuanterFactory):
+            raise TypeError("weight should be a QuanterFactory or None")
+        self._global_config = (
+            SingleLayerConfig(activation, weight)
+            if activation is not None or weight is not None
+            else None
+        )
+        self._layer_configs = {}      # id(layer) -> SingleLayerConfig
+        self._type_configs = {}       # type -> SingleLayerConfig
+        self._prefix_configs = {}     # name prefix -> SingleLayerConfig
+        self._qat_layer_mapping = {}  # source type -> quanted type
+        self._customized_leaves = []
+
+    @property
+    def global_config(self):
+        return self._global_config
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        """Highest-priority per-instance config (reference config.py:105)."""
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            if not isinstance(l, Layer):
+                raise TypeError("layer should be a paddle Layer instance")
+            self._layer_configs[id(l)] = SingleLayerConfig(activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        """Config by layer full name (reference config.py:154)."""
+        names = layer_name if isinstance(layer_name, (list, tuple)) else [layer_name]
+        for n in names:
+            self._prefix_configs[str(n)] = SingleLayerConfig(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        """Config by layer type (reference config.py:204)."""
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            if not (isinstance(t, type) and issubclass(t, Layer)):
+                raise TypeError("layer_type should be a Layer subclass")
+            self._type_configs[t] = SingleLayerConfig(activation, weight)
+
+    def add_qat_layer_mapping(self, source, target):
+        """Map a layer type to a customized quantized implementation
+        (reference config.py:253)."""
+        if not (isinstance(source, type) and issubclass(source, Layer)):
+            raise TypeError("The source layer should be a subclass of Layer")
+        self._qat_layer_mapping[source] = target
+
+    def add_customized_leaf(self, layer_type):
+        self._customized_leaves.append(layer_type)
+
+    @property
+    def qat_layer_mappings(self):
+        return self._qat_layer_mapping
+
+    @property
+    def customized_leaves(self):
+        return self._customized_leaves
+
+    def _config_for(self, layer, full_name=""):
+        """Resolve the effective config for one layer: instance > name >
+        type > global (reference priority order)."""
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        for prefix, cfg in self._prefix_configs.items():
+            if full_name == prefix or full_name.startswith(prefix + "."):
+                return cfg
+        if type(layer) in self._type_configs:
+            return self._type_configs[type(layer)]
+        return None
+
+    def _need_quant(self, layer, full_name=""):
+        return self._config_for(layer, full_name) is not None

@@ -1,0 +1,74 @@
+"""PTQ observers (reference: python/paddle/quantization/observers/abs_max.py,
+groupwise.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .base import BaseObserver, fake_quant_dequant, quanter
+
+
+@quanter("AbsmaxObserver")
+class AbsmaxObserverLayer(BaseObserver):
+    """Per-tensor abs-max calibration (reference observers/abs_max.py)."""
+
+    def __init__(self, layer=None, quant_bits=8, dtype="float32", name=None):
+        super().__init__()
+        self._quant_bits = int(quant_bits)
+        self.register_buffer(
+            "abs_max_val", Tensor._from_value(jnp.asarray(1e-9, np.dtype(dtype)))
+        )
+
+    def forward(self, input):
+        absmax = jnp.maximum(jnp.max(jnp.abs(input._value)), self.abs_max_val._value)
+        self.abs_max_val._replace_value(absmax.astype(self.abs_max_val._value.dtype))
+        return input
+
+    def cal_thresholds(self):
+        return self.abs_max_val
+
+    def scales(self):
+        return self.abs_max_val
+
+    def zero_points(self):
+        return None
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+@quanter("GroupWiseWeightObserver")
+class GroupWiseWeightObserverLayer(BaseObserver):
+    """Group-wise abs-max for weights (reference observers/groupwise.py):
+    scales per group of ``group_size`` rows along axis 0."""
+
+    def __init__(self, layer=None, quant_bits=4, group_size=128, dtype="float32",
+                 name=None):
+        super().__init__()
+        self._quant_bits = int(quant_bits)
+        self._group_size = int(group_size)
+        self._scale = None
+
+    def forward(self, input):
+        x = input._value
+        n = x.shape[0]
+        g = min(self._group_size, n)
+        pad = (-n) % g
+        xp = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+        grouped = xp.reshape((xp.shape[0] // g, g) + xp.shape[1:])
+        scale = jnp.max(jnp.abs(grouped), axis=1)
+        self._scale = Tensor._from_value(scale)
+        return input
+
+    def cal_thresholds(self):
+        return self._scale
+
+    def scales(self):
+        return self._scale
+
+    def zero_points(self):
+        return None
+
+    def bit_length(self):
+        return self._quant_bits

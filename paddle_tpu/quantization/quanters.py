@@ -1,0 +1,47 @@
+"""QAT quanters (reference: python/paddle/quantization/quanters/abs_max.py).
+
+FakeQuanterWithAbsMaxObserver: moving-average abs-max scale, fake
+quant-dequant with straight-through gradients while training."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .base import BaseQuanter, fake_quant_dequant, quanter
+
+
+@quanter("FakeQuanterWithAbsMaxObserver")
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    def __init__(self, layer=None, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__()
+        self._moving_rate = float(moving_rate)
+        self._bit_length = int(bit_length)
+        self.register_buffer(
+            "scale", Tensor._from_value(jnp.asarray(1.0, np.dtype(dtype)))
+        )
+        self.register_buffer(
+            "state", Tensor._from_value(jnp.asarray(0.0, np.dtype(dtype)))
+        )
+
+    def forward(self, input):
+        if self.training:
+            absmax = jnp.max(jnp.abs(input._value))
+            state = self.state._value + 1.0
+            # moving-average absmax (reference abs_max.py accum semantics)
+            accum = self._moving_rate * self.scale._value * jnp.minimum(
+                self.state._value, 1.0
+            ) + absmax * (1.0 - self._moving_rate * jnp.minimum(self.state._value, 1.0))
+            self.state._replace_value(state)
+            self.scale._replace_value(accum.astype(self.scale._value.dtype))
+        return fake_quant_dequant(input, self.scale, self._bit_length)
+
+    def scales(self):
+        return self.scale
+
+    def zero_points(self):
+        return None
+
+    def bit_length(self):
+        return self._bit_length

@@ -1,0 +1,70 @@
+"""Quantization base + model surgery (reference:
+python/paddle/quantization/quantize.py, qat.py:23, ptq.py:24)."""
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer import Layer
+from .config import QuantConfig
+from .wrapper import ObserveWrapper, QuantedWrapper
+
+
+def _replace_layers(model, config, wrapper_cls, prefix=""):
+    for name, child in list(model.named_children()):
+        full = f"{prefix}.{name}" if prefix else name
+        cfg = config._config_for(child, full) or config.global_config
+        is_leaf = not any(True for _ in child.named_children()) or type(
+            child
+        ) in config.customized_leaves
+        if cfg is not None and is_leaf and hasattr(child, "weight"):
+            mapped = config.qat_layer_mappings.get(type(child))
+            wrapped = (
+                mapped(child, cfg) if mapped is not None else wrapper_cls(child, cfg)
+            )
+            model.add_sublayer(name, wrapped)
+        else:
+            _replace_layers(child, config, wrapper_cls, full)
+    return model
+
+
+class Quantization:
+    def __init__(self, config):
+        if not isinstance(config, QuantConfig):
+            raise TypeError("config should be a QuantConfig instance")
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        raise NotImplementedError
+
+    def convert(self, model, inplace=False):
+        """Replace QAT/PTQ wrappers with plain layers whose weights are
+        baked onto the quantized grid (reference quantize.py convert)."""
+        target = model if inplace else copy.deepcopy(model)
+        self._convert_inner(target)
+        return target
+
+    def _convert_inner(self, model):
+        for name, child in list(model.named_children()):
+            if isinstance(child, (QuantedWrapper, ObserveWrapper)):
+                model.add_sublayer(name, child.converted_layer())
+            else:
+                self._convert_inner(child)
+
+
+class QAT(Quantization):
+    """Quantization-aware training (reference qat.py:23)."""
+
+    def quantize(self, model, inplace=False):
+        target = model if inplace else copy.deepcopy(model)
+        _replace_layers(target, self._config, QuantedWrapper)
+        return target
+
+
+class PTQ(Quantization):
+    """Post-training quantization (reference ptq.py:24)."""
+
+    def quantize(self, model, inplace=False):
+        target = model if inplace else copy.deepcopy(model)
+        target.eval()
+        _replace_layers(target, self._config, ObserveWrapper)
+        return target

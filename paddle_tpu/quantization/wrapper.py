@@ -1,0 +1,85 @@
+"""Quantized layer wrappers (reference: python/paddle/quantization/wrapper.py
++ paddle/nn/quant/qat behavior): wrap a layer with activation/weight
+quanters; convert() bakes weights onto the quantized grid."""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from .base import BaseQuanter, fake_quant_dequant
+
+
+class QuantedWrapper(Layer):
+    """Generic QAT wrapper: input → activation_quanter, weight →
+    weight_quanter, then the wrapped layer's functional forward."""
+
+    def __init__(self, layer, q_config_entry):
+        super().__init__()
+        self._layer = layer
+        self.activation_quanter = (
+            q_config_entry.activation._instance(layer)
+            if q_config_entry.activation is not None
+            else None
+        )
+        self.weight_quanter = (
+            q_config_entry.weight._instance(layer)
+            if q_config_entry.weight is not None
+            else None
+        )
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None and hasattr(self._layer, "weight"):
+            w = self._layer.weight
+            qw = self.weight_quanter(w)
+            # run the wrapped layer with the fake-quantized weight
+            orig = w
+            try:
+                self._layer.weight = qw
+                return self._layer(x)
+            finally:
+                self._layer.weight = orig
+        return self._layer(x)
+
+    def converted_layer(self):
+        """Bake fake-quantized weights into the wrapped layer and return it
+        (reference Quantization.convert semantics)."""
+        if self.weight_quanter is not None and hasattr(self._layer, "weight"):
+            qw = self.weight_quanter(self._layer.weight)
+            self._layer.weight._replace_value(qw._value)
+        return self._layer
+
+
+class ObserveWrapper(Layer):
+    """PTQ wrapper: observers watch activations/weights without altering
+    the computation (reference wrapper.py ObserveWrapper)."""
+
+    def __init__(self, layer, q_config_entry):
+        super().__init__()
+        self._layer = layer
+        self.activation_observer = (
+            q_config_entry.activation._instance(layer)
+            if q_config_entry.activation is not None
+            else None
+        )
+        self.weight_observer = (
+            q_config_entry.weight._instance(layer)
+            if q_config_entry.weight is not None
+            else None
+        )
+
+    def forward(self, x):
+        if self.activation_observer is not None:
+            x = self.activation_observer(x)
+        if self.weight_observer is not None and hasattr(self._layer, "weight"):
+            self.weight_observer(self._layer.weight)
+        return self._layer(x)
+
+    def converted_layer(self):
+        if self.weight_observer is not None and hasattr(self._layer, "weight"):
+            scale = self.weight_observer.scales()
+            if scale is not None:
+                qw = fake_quant_dequant(
+                    self._layer.weight, scale, self.weight_observer.bit_length()
+                )
+                self._layer.weight._replace_value(qw._value)
+        return self._layer

@@ -33,6 +33,27 @@ class TestSegmentOps:
         np.testing.assert_allclose(_np(mn), [[1, 2], [5, 6], [0, 0], [7, 8]])
         np.testing.assert_allclose(_np(mx), [[3, 4], [5, 6], [0, 0], [7, 8]])
 
+    def test_segment_minmax_int_dtype(self):
+        data = np.asarray([[1, 2], [3, 4], [7, 8]], "int32")
+        ids = np.asarray([0, 0, 2])
+        mn = G.segment_min(paddle.to_tensor(data), paddle.to_tensor(ids))
+        mx = G.segment_max(paddle.to_tensor(data), paddle.to_tensor(ids))
+        np.testing.assert_array_equal(_np(mn), [[1, 2], [0, 0], [7, 8]])
+        np.testing.assert_array_equal(_np(mx), [[3, 4], [0, 0], [7, 8]])
+
+    def test_segment_max_preserves_inf(self):
+        data = np.asarray([np.inf, 2.0], "float32")
+        ids = np.asarray([0, 0])
+        out = G.segment_max(paddle.to_tensor(data), paddle.to_tensor(ids))
+        assert np.isinf(_np(out)[0])
+
+    def test_send_u_recv_max_int_no_in_edges(self):
+        x = np.asarray([[1], [2], [3]], "int32")
+        out = G.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(np.asarray([0, 1])),
+                            paddle.to_tensor(np.asarray([1, 1])), reduce_op="max",
+                            out_size=3)
+        np.testing.assert_array_equal(_np(out), [[0], [2], [0]])
+
     def test_segment_sum_grad(self):
         x = paddle.to_tensor(self.data, stop_gradient=False)
         out = G.segment_sum(x, paddle.to_tensor(self.ids))

@@ -1,0 +1,135 @@
+"""paddle.quantization: fake-quant numerics/STE, QAT and PTQ flows
+(reference test model: test/quantization/test_quant.py, test_qat.py,
+test_ptq.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import quantization as Q
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 2)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+class TestFakeQuant:
+    def test_grid_and_range(self):
+        x = paddle.to_tensor(np.linspace(-2, 2, 101).astype("float32"))
+        scale = paddle.to_tensor(np.float32(1.0))
+        y = _np(Q.fake_quant_dequant(x, scale, bit_length=8))
+        # values snap to the 127-level grid and saturate at ±scale
+        assert np.abs(y).max() <= 1.0 + 1e-6
+        grid = np.round(y * 127)
+        np.testing.assert_allclose(grid, y * 127, atol=1e-4)
+
+    def test_ste_gradient(self):
+        x = paddle.to_tensor(np.asarray([-2.0, -0.5, 0.5, 2.0], "float32"),
+                             stop_gradient=False)
+        scale = paddle.to_tensor(np.float32(1.0))
+        y = Q.fake_quant_dequant(x, scale)
+        y.sum().backward()
+        # gradient passes inside [-scale, scale], blocked outside
+        np.testing.assert_allclose(_np(x.grad), [0.0, 1.0, 1.0, 0.0])
+
+
+class TestQAT:
+    def test_quantize_wraps_and_trains(self):
+        paddle.seed(0)
+        model = Net()
+        qcfg = Q.QuantConfig(
+            activation=Q.FakeQuanterWithAbsMaxObserver(moving_rate=0.9),
+            weight=Q.FakeQuanterWithAbsMaxObserver(moving_rate=0.9),
+        )
+        qat = Q.QAT(qcfg)
+        qmodel = qat.quantize(model)
+        assert isinstance(qmodel.fc1, Q.QuantedWrapper)
+        assert isinstance(qmodel.fc2, Q.QuantedWrapper)
+        # original model untouched (inplace=False)
+        assert isinstance(model.fc1, nn.Linear)
+
+        optimizer = opt.SGD(learning_rate=0.1, parameters=qmodel.parameters())
+        x = paddle.to_tensor(np.random.randn(16, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.randint(0, 2, (16,)))
+        ce = nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(10):
+            loss = ce(qmodel(x), y)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            losses.append(float(loss._value))
+        assert losses[-1] < losses[0]
+        # scale buffers moved off their init values
+        assert float(_np(qmodel.fc1.activation_quanter.scales())) != 1.0
+
+    def test_convert_bakes_weights(self):
+        paddle.seed(0)
+        model = Net()
+        qcfg = Q.QuantConfig(activation=None,
+                             weight=Q.FakeQuanterWithAbsMaxObserver())
+        qmodel = Q.QAT(qcfg).quantize(model)
+        qmodel(paddle.to_tensor(np.random.randn(4, 8).astype("float32")))
+        infer = Q.QAT(qcfg).convert(qmodel)
+        assert isinstance(infer.fc1, nn.Linear)
+        w = _np(infer.fc1.weight)
+        scale = np.abs(w).max()
+        grid = w / scale * 127
+        np.testing.assert_allclose(grid, np.round(grid), atol=1e-3)
+
+    def test_type_and_layer_config_priority(self):
+        model = Net()
+        qcfg = Q.QuantConfig(activation=None, weight=None)
+        qcfg.add_type_config(nn.Linear, weight=Q.FakeQuanterWithAbsMaxObserver())
+        qcfg.add_layer_config(model.fc2, activation=Q.FakeQuanterWithAbsMaxObserver())
+        qmodel = Q.QAT(qcfg).quantize(model, inplace=True)
+        assert qmodel.fc1.weight_quanter is not None
+        assert qmodel.fc1.activation_quanter is None
+        assert qmodel.fc2.activation_quanter is not None
+        assert qmodel.fc2.weight_quanter is None
+
+
+class TestPTQ:
+    def test_observe_then_convert(self):
+        paddle.seed(0)
+        model = Net()
+        qcfg = Q.QuantConfig(
+            activation=Q.AbsmaxObserver(), weight=Q.AbsmaxObserver()
+        )
+        ptq = Q.PTQ(qcfg)
+        qmodel = ptq.quantize(model)
+        ref_out = None
+        for _ in range(5):
+            x = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+            out = qmodel(x)  # observers only record; computation unchanged
+            ref_out = _np(model(x))
+            np.testing.assert_allclose(_np(out), ref_out, rtol=1e-5)
+        assert float(_np(qmodel.fc1.activation_observer.cal_thresholds())) > 0.5
+        infer = ptq.convert(qmodel)
+        w = _np(infer.fc1.weight)
+        grid = w / np.abs(w).max() * 127
+        np.testing.assert_allclose(grid, np.round(grid), atol=1e-3)
+
+    def test_groupwise_observer(self):
+        obs = Q.GroupWiseWeightObserverLayer(group_size=4, quant_bits=4)
+        w = paddle.to_tensor(np.random.randn(8, 3).astype("float32"))
+        obs(w)
+        assert tuple(_np(obs.scales()).shape) == (2, 3)
+
+    def test_config_validation(self):
+        with pytest.raises(TypeError):
+            Q.QuantConfig(activation="notafactory", weight=None)
+        qcfg = Q.QuantConfig(activation=None, weight=None)
+        with pytest.raises(TypeError):
+            qcfg.add_type_config(int, weight=Q.FakeQuanterWithAbsMaxObserver())
