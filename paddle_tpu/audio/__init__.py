@@ -1,0 +1,12 @@
+"""``paddle.audio`` parity package (reference: python/paddle/audio/__init__.py)."""
+from . import functional
+from . import features
+from . import backends
+from .backends import load, save, info
+from .window import get_window
+
+# the reference exposes get_window under audio.functional as well
+functional.get_window = get_window
+
+__all__ = ["functional", "features", "backends", "load", "save", "info",
+           "get_window"]

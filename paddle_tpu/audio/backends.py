@@ -1,0 +1,105 @@
+"""Audio IO backends (reference: python/paddle/audio/backends/ —
+wave_backend.py load/save/info, init_backend.py backend registry).
+
+The built-in backend reads/writes 16-bit PCM WAV via the stdlib ``wave``
+module — no third-party soundfile dependency."""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "load", "save", "info", "list_available_backends", "get_current_backend",
+    "set_backend",
+]
+
+_BACKENDS = ["wave_backend"]
+_current = "wave_backend"
+
+
+def list_available_backends():
+    return list(_BACKENDS)
+
+
+def get_current_backend():
+    return _current
+
+
+def set_backend(backend_name):
+    global _current
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"backend {backend_name!r} is not available; choices: {_BACKENDS}"
+        )
+    _current = backend_name
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels, bits_per_sample,
+                 encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    with _wave.open(str(filepath), "rb") as f:
+        return AudioInfo(
+            sample_rate=f.getframerate(),
+            num_samples=f.getnframes(),
+            num_channels=f.getnchannels(),
+            bits_per_sample=f.getsampwidth() * 8,
+            encoding="PCM_S",
+        )
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Load a WAV file → (waveform Tensor [C, T] or [T, C], sample_rate)."""
+    with _wave.open(str(filepath), "rb") as f:
+        sr = f.getframerate()
+        channels = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(int(frame_offset))
+        n = f.getnframes() - int(frame_offset) if num_frames < 0 else int(num_frames)
+        raw = f.readframes(n)
+    if width == 2:
+        data = np.frombuffer(raw, dtype="<i2").astype("float32")
+        scale = 32768.0
+    elif width == 1:
+        data = (np.frombuffer(raw, dtype="u1").astype("float32") - 128.0)
+        scale = 128.0
+    elif width == 4:
+        data = np.frombuffer(raw, dtype="<i4").astype("float32")
+        scale = 2147483648.0
+    else:
+        raise ValueError(f"Unsupported sample width: {width}")
+    if normalize:
+        data = data / scale
+    data = data.reshape(-1, channels)
+    wav = data.T if channels_first else data
+    return Tensor._from_value(wav.copy()), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding="PCM_16",
+         bits_per_sample=16):
+    """Save a [C, T] (or [T, C]) waveform Tensor as 16-bit PCM WAV."""
+    data = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if data.ndim == 1:
+        data = data[None, :]
+    if channels_first:
+        data = data.T                      # (T, C)
+    if bits_per_sample != 16:
+        raise ValueError("wave backend only supports 16 bits_per_sample")
+    pcm = np.clip(data, -1.0, 1.0 - 1.0 / 32768.0)
+    pcm = (pcm * 32768.0).astype("<i2")
+    with _wave.open(str(filepath), "wb") as f:
+        f.setnchannels(data.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())

@@ -1,0 +1,110 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py:
+Spectrogram :24, MelSpectrogram :106, LogMelSpectrogram :206, MFCC :309).
+
+Each layer precomputes its window / filterbank / DCT tables once at
+construction and runs stft → |·|^p → fbank matmul → dB → DCT as one
+differentiable device pipeline."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import signal as _signal
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..ops._helpers import ensure_tensor
+from . import functional as F
+from .window import get_window
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None, window="hann",
+                 power=2.0, center=True, pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        if power <= 0:
+            raise ValueError("power must be positive")
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = float(power)
+        self.center = center
+        self.pad_mode = pad_mode
+        w = get_window(window, self.win_length, fftbins=True, dtype=dtype)
+        self.register_buffer("fft_window", w)
+
+    def forward(self, x):
+        x = ensure_tensor(x)
+        spec = _signal.stft(
+            x, self.n_fft, hop_length=self.hop_length, win_length=self.win_length,
+            window=self.fft_window, center=self.center, pad_mode=self.pad_mode,
+        )
+        return spec.abs() ** self.power
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(
+            n_fft, hop_length, win_length, window, power, center, pad_mode, dtype
+        )
+        self.n_mels = n_mels
+        fbank = F.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype,
+        )
+        self.register_buffer("fbank_matrix", fbank)
+
+    def forward(self, x):
+        from ..ops.math import matmul
+
+        spec = self._spectrogram(x)            # (..., n_fft//2+1, frames)
+        return matmul(self.fbank_matrix, spec)  # (..., n_mels, frames)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode,
+            n_mels, f_min, f_max, htk, norm, dtype,
+        )
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return F.power_to_db(mel, ref_value=self.ref_value, amin=self.amin,
+                             top_db=self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", ref_value=1.0, amin=1e-10, top_db=None,
+                 dtype="float32"):
+        super().__init__()
+        if n_mfcc > n_mels:
+            raise ValueError("n_mfcc cannot be larger than n_mels")
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode,
+            n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db, dtype,
+        )
+        self.register_buffer("dct_matrix", F.create_dct(n_mfcc, n_mels, dtype=dtype))
+
+    def forward(self, x):
+        from ..ops.math import matmul
+        from ..ops.manipulation import swapaxes
+
+        log_mel = self._log_melspectrogram(x)   # (..., n_mels, frames)
+        # DCT over the mel axis: (..., frames, n_mels) @ (n_mels, n_mfcc)
+        out = matmul(swapaxes(log_mel, -1, -2), self.dct_matrix)
+        return swapaxes(out, -1, -2)            # (..., n_mfcc, frames)

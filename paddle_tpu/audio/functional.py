@@ -1,0 +1,155 @@
+"""Audio functional ops (reference:
+python/paddle/audio/functional/functional.py:24-340).
+
+Filterbank/DCT construction is host-side table building (numpy); the tables
+feed device matmuls in the feature layers."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops._helpers import ensure_tensor
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "power_to_db", "create_dct",
+]
+
+
+def _hz_to_mel_np(freq, htk):
+    freq = np.asarray(freq, dtype="float64")
+    if htk:
+        return 2595.0 * np.log10(1.0 + freq / 700.0)
+    f_sp = 200.0 / 3
+    mels = freq / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(
+        freq >= min_log_hz,
+        min_log_mel + np.log(np.maximum(freq, 1e-10) / min_log_hz) / logstep,
+        mels,
+    )
+
+
+def _mel_to_hz_np(mel, htk):
+    mel = np.asarray(mel, dtype="float64")
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_sp = 200.0 / 3
+    freqs = f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(
+        mel >= min_log_mel,
+        min_log_hz * np.exp(logstep * (mel - min_log_mel)),
+        freqs,
+    )
+
+
+def _wrap(x, dtype, was_tensor_or_array):
+    arr = np.asarray(x, dtype=np.dtype(dtype))
+    if was_tensor_or_array:
+        return Tensor._from_value(arr)
+    return Tensor._from_value(arr) if arr.ndim else Tensor._from_value(arr)
+
+
+def hz_to_mel(freq, htk=False):
+    """Convert Hz to Mels (reference functional.py:24). Accepts float or
+    Tensor; returns the same kind."""
+    if isinstance(freq, Tensor):
+        out = _hz_to_mel_np(np.asarray(freq._value), htk)
+        return Tensor._from_value(out.astype(np.asarray(freq._value).dtype))
+    return float(_hz_to_mel_np(freq, htk))
+
+
+def mel_to_hz(mel, htk=False):
+    if isinstance(mel, Tensor):
+        out = _mel_to_hz_np(np.asarray(mel._value), htk)
+        return Tensor._from_value(out.astype(np.asarray(mel._value).dtype))
+    return float(_mel_to_hz_np(mel, htk))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False, dtype="float32"):
+    min_mel = _hz_to_mel_np(f_min, htk)
+    max_mel = _hz_to_mel_np(f_max, htk)
+    mels = np.linspace(min_mel, max_mel, n_mels)
+    return Tensor._from_value(_mel_to_hz_np(mels, htk).astype(np.dtype(dtype)))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor._from_value(
+        np.linspace(0, float(sr) / 2, 1 + n_fft // 2).astype(np.dtype(dtype))
+    )
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None, htk=False,
+                         norm="slaney", dtype="float32"):
+    """Mel filterbank matrix of shape (n_mels, 1 + n_fft//2)
+    (reference functional.py:188)."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = np.linspace(0, float(sr) / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(
+        mel_frequencies(n_mels + 2, f_min, f_max, htk, "float64")._value
+    )
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2 : n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    elif norm is not None and norm != 1:
+        weights = weights / np.linalg.norm(weights, ord=norm, axis=-1, keepdims=True)
+    return Tensor._from_value(weights.astype(np.dtype(dtype)))
+
+
+def _power_to_db_fwd(m, *, ref_value, amin, top_db):
+    import jax.numpy as jnp
+
+    db = 10.0 * jnp.log10(jnp.maximum(m, amin)) - 10.0 * jnp.log10(
+        jnp.maximum(amin, ref_value)
+    )
+    if top_db is not None:
+        db = jnp.maximum(db, jnp.max(db) - top_db)
+    return db
+
+
+from ..ops._helpers import defprim as _defprim  # noqa: E402
+from ..core.tensor import apply as _apply  # noqa: E402
+
+_defprim("power_to_db_p", _power_to_db_fwd)
+
+
+def power_to_db(magnitude, ref_value=1.0, amin=1e-10, top_db=None, name=None):
+    """Power spectrogram → decibels (reference functional.py:261)."""
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+    if top_db is not None and top_db < 0:
+        raise ValueError("top_db must be non-negative")
+    x = ensure_tensor(magnitude)
+    return _apply("power_to_db_p", x, ref_value=float(ref_value), amin=float(amin),
+                  top_db=None if top_db is None else float(top_db))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II transform matrix of shape (n_mels, n_mfcc)
+    (reference functional.py:305)."""
+    n = np.arange(float(n_mels))
+    k = np.arange(float(n_mfcc))[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k) * 2.0
+    if norm is None:
+        dct *= 0.5
+    elif norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(1.0 / (2.0 * n_mels))
+    else:
+        raise ValueError(f"Unsupported norm: {norm}")
+    return Tensor._from_value(dct.astype(np.dtype(dtype)))

@@ -1,0 +1,57 @@
+"""Window functions (reference: python/paddle/audio/functional/window.py:335
+get_window). The reference hand-builds each window in paddle ops; windows
+are tiny host-side tables, so scipy.signal.windows supplies the numerics
+and the result lands in a framework Tensor."""
+from __future__ import annotations
+
+import numpy as np
+import scipy.signal.windows as _sw
+
+from ..core.tensor import Tensor
+
+__all__ = ["get_window"]
+
+_WINDOWS = {
+    "hamming": _sw.hamming,
+    "hann": _sw.hann,
+    "tukey": _sw.tukey,
+    "kaiser": _sw.kaiser,
+    "gaussian": _sw.gaussian,
+    "exponential": _sw.exponential,
+    "triang": _sw.triang,
+    "bohman": _sw.bohman,
+    "blackman": _sw.blackman,
+    "cosine": _sw.cosine,
+    "taylor": _sw.taylor,
+    "bartlett": _sw.bartlett,
+    "nuttall": _sw.nuttall,
+    "general_gaussian": _sw.general_gaussian,
+    "general_cosine": _sw.general_cosine,
+    "general_hamming": _sw.general_hamming,
+}
+
+
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    """Return a window of ``win_length`` samples. ``window`` is a name or a
+    (name, *params) tuple; ``fftbins=True`` returns a periodic window for
+    spectral analysis (reference window.py:335)."""
+    sym = not fftbins
+    if isinstance(window, (str,)):
+        name, args = window, ()
+    elif isinstance(window, tuple):
+        if len(window) == 0:
+            raise ValueError("window tuple must have at least one element")
+        name, args = window[0], tuple(window[1:])
+    elif isinstance(window, (int, float)):
+        # scipy convention: a float means a kaiser beta
+        name, args = "kaiser", (float(window),)
+    else:
+        raise ValueError(f"The window type {type(window)} is not supported")
+    if name not in _WINDOWS:
+        raise ValueError(f"Unknown window type: {name}")
+    if name == "kaiser" and not args:
+        raise ValueError("The 'kaiser' window needs a beta parameter")
+    if name == "gaussian" and not args:
+        raise ValueError("The 'gaussian' window needs a std parameter")
+    w = _WINDOWS[name](int(win_length), *args, sym=sym)
+    return Tensor._from_value(np.asarray(w, dtype=np.dtype(dtype)))

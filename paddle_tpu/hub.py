@@ -1,0 +1,73 @@
+"""Model hub (reference: python/paddle/hub.py — list/help/load over github/
+gitee/local sources via a repo's hubconf.py).
+
+The local source is fully supported; remote sources raise a clear error in
+this zero-egress environment."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+_VAR_DEPS = "dependencies"
+
+
+def _import_hubconf(directory):
+    path = os.path.join(directory, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"hubconf.py not found in {directory}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, directory)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(directory)
+    deps = getattr(module, _VAR_DEPS, [])
+    missing = [d for d in deps if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(f"Missing dependencies required by hubconf: {missing}")
+    return module
+
+def _resolve(repo_dir, source):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"Unknown source: {source}. Valid sources: 'github', 'gitee', 'local'."
+        )
+    if source in ("github", "gitee"):
+        raise RuntimeError(
+            f"source={source!r} requires network access, which is unavailable; "
+            "clone the repository and use source='local'."
+        )
+    return _import_hubconf(os.path.expanduser(repo_dir))
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """List callable entry points exposed by the repo's hubconf.py."""
+    module = _resolve(repo_dir, source)
+    return [
+        name
+        for name, obj in vars(module).items()
+        if callable(obj) and not name.startswith("_")
+    ]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    """Return the docstring of a hub entry point."""
+    module = _resolve(repo_dir, source)
+    fn = getattr(module, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable {model} in hubconf")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Build a model from a hub entry point."""
+    module = _resolve(repo_dir, source)
+    fn = getattr(module, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable {model} in hubconf")
+    return fn(**kwargs)

@@ -9,13 +9,11 @@ fake_quantize_dequantize_moving_average_abs_max kernel pair.
 from __future__ import annotations
 
 import abc
-from functools import partial
 
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.dispatch import register_primitive
-from ..core.tensor import Tensor, apply
+from ..core.tensor import apply
 from ..nn.layer import Layer
 
 

@@ -86,6 +86,23 @@ class QuantConfig:
     def customized_leaves(self):
         return self._customized_leaves
 
+    def _materialize_names(self, model):
+        """Pin id-keyed per-instance configs to layer full names BEFORE the
+        model is deep-copied for out-of-place quantize — the copy has new
+        object ids, so id-keyed lookups would silently miss."""
+        if not self._layer_configs:
+            return
+
+        def walk(layer, prefix=""):
+            for name, child in layer.named_children():
+                full = f"{prefix}.{name}" if prefix else name
+                cfg = self._layer_configs.get(id(child))
+                if cfg is not None:
+                    self._prefix_configs[full] = cfg
+                walk(child, full)
+
+        walk(model)
+
     def _config_for(self, layer, full_name=""):
         """Resolve the effective config for one layer: instance > name >
         type > global (reference priority order)."""

@@ -16,7 +16,10 @@ def _replace_layers(model, config, wrapper_cls, prefix=""):
         is_leaf = not any(True for _ in child.named_children()) or type(
             child
         ) in config.customized_leaves
-        if cfg is not None and is_leaf and hasattr(child, "weight"):
+        quantizable = cfg is not None and (
+            hasattr(child, "weight") or cfg.activation is not None
+        )
+        if quantizable and is_leaf:
             mapped = config.qat_layer_mappings.get(type(child))
             wrapped = (
                 mapped(child, cfg) if mapped is not None else wrapper_cls(child, cfg)
@@ -55,6 +58,7 @@ class QAT(Quantization):
     """Quantization-aware training (reference qat.py:23)."""
 
     def quantize(self, model, inplace=False):
+        self._config._materialize_names(model)
         target = model if inplace else copy.deepcopy(model)
         _replace_layers(target, self._config, QuantedWrapper)
         return target
@@ -64,6 +68,7 @@ class PTQ(Quantization):
     """Post-training quantization (reference ptq.py:24)."""
 
     def quantize(self, model, inplace=False):
+        self._config._materialize_names(model)
         target = model if inplace else copy.deepcopy(model)
         target.eval()
         _replace_layers(target, self._config, ObserveWrapper)

@@ -3,8 +3,34 @@
 quanters; convert() bakes weights onto the quantized grid."""
 from __future__ import annotations
 
+import numpy as np
+
+from ..core.tensor import Tensor
 from ..nn.layer import Layer
-from .base import BaseQuanter, fake_quant_dequant
+from .base import fake_quant_dequant
+
+
+def _expanded_scale(scale, weight):
+    """Broadcast a per-group scale (n_groups, *rest) onto the weight's rows
+    so the fake-quant grid covers each group (GroupWiseWeightObserver)."""
+    sv = np.asarray(scale._value)
+    if sv.ndim and sv.shape[0] not in (1, weight.shape[0]):
+        g = -(-weight.shape[0] // sv.shape[0])  # rows per group (ceil)
+        sv = np.repeat(sv, g, axis=0)[: weight.shape[0]]
+        return Tensor._from_value(sv)
+    return scale
+
+
+def _bake_weight(layer, quanter):
+    """Quantize-dequantize the stored weight with the quanter's CURRENT
+    scales — never through quanter.forward, which would mutate the
+    moving-average state during convert."""
+    scale = quanter.scales()
+    if scale is None or not hasattr(layer, "weight"):
+        return
+    scale = _expanded_scale(scale, layer.weight)
+    qw = fake_quant_dequant(layer.weight, scale, quanter.bit_length())
+    layer.weight._replace_value(qw._value)
 
 
 class QuantedWrapper(Layer):
@@ -21,7 +47,7 @@ class QuantedWrapper(Layer):
         )
         self.weight_quanter = (
             q_config_entry.weight._instance(layer)
-            if q_config_entry.weight is not None
+            if q_config_entry.weight is not None and hasattr(layer, "weight")
             else None
         )
 
@@ -43,9 +69,8 @@ class QuantedWrapper(Layer):
     def converted_layer(self):
         """Bake fake-quantized weights into the wrapped layer and return it
         (reference Quantization.convert semantics)."""
-        if self.weight_quanter is not None and hasattr(self._layer, "weight"):
-            qw = self.weight_quanter(self._layer.weight)
-            self._layer.weight._replace_value(qw._value)
+        if self.weight_quanter is not None:
+            _bake_weight(self._layer, self.weight_quanter)
         return self._layer
 
 
@@ -63,23 +88,18 @@ class ObserveWrapper(Layer):
         )
         self.weight_observer = (
             q_config_entry.weight._instance(layer)
-            if q_config_entry.weight is not None
+            if q_config_entry.weight is not None and hasattr(layer, "weight")
             else None
         )
 
     def forward(self, x):
         if self.activation_observer is not None:
             x = self.activation_observer(x)
-        if self.weight_observer is not None and hasattr(self._layer, "weight"):
+        if self.weight_observer is not None:
             self.weight_observer(self._layer.weight)
         return self._layer(x)
 
     def converted_layer(self):
-        if self.weight_observer is not None and hasattr(self._layer, "weight"):
-            scale = self.weight_observer.scales()
-            if scale is not None:
-                qw = fake_quant_dequant(
-                    self._layer.weight, scale, self.weight_observer.bit_length()
-                )
-                self._layer.weight._replace_value(qw._value)
+        if self.weight_observer is not None:
+            _bake_weight(self._layer, self.weight_observer)
         return self._layer

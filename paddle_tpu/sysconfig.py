@@ -1,0 +1,20 @@
+"""Build configuration paths (reference: python/paddle/sysconfig.py —
+get_include/get_lib for compiling C++ extensions against the framework)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory containing the framework's C headers (csrc/)."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(root), "csrc")
+
+
+def get_lib():
+    """Directory containing the framework's native shared libraries."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    native = os.path.join(root, "native")
+    return native

@@ -1,0 +1,16 @@
+"""``paddle.text`` parity package (reference: python/paddle/text/__init__.py)."""
+from .datasets import (
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
+from .viterbi_decode import ViterbiDecoder, viterbi_decode
+
+__all__ = [
+    "Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14",
+    "WMT16", "ViterbiDecoder", "viterbi_decode",
+]

@@ -1,0 +1,295 @@
+"""Text datasets (reference: python/paddle/text/datasets/ — uci_housing.py:51,
+imdb.py:39, imikolov.py, conll05.py, movielens.py, wmt14.py, wmt16.py).
+
+This build runs with zero network egress, so ``download=True`` raises a
+clear error; every dataset accepts ``data_file`` pointing at a local copy in
+the reference's archive format and parses it the same way."""
+from __future__ import annotations
+
+import collections
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io.dataloader import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Conll05st", "Movielens",
+           "WMT14", "WMT16"]
+
+
+def _require_file(data_file, download, name):
+    if data_file is not None and os.path.exists(data_file):
+        return data_file
+    if download:
+        raise RuntimeError(
+            f"{name}: automatic download is unavailable in this environment "
+            f"(no network egress). Pass data_file= pointing at a local copy."
+        )
+    raise ValueError(
+        f"{name}: data_file must be set to an existing local file when "
+        f"download is False; got {data_file!r}"
+    )
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference uci_housing.py:51): whitespace
+    table of 14 columns; 80/20 train/test split, features normalized by
+    train-split min/max/avg."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', but got {mode}")
+        self.mode = mode.lower()
+        self.data_file = _require_file(data_file, download, "UCIHousing")
+        self._load_data()
+
+    def _load_data(self, feature_num=14, ratio=0.8):
+        data = np.loadtxt(self.data_file).astype("float32")
+        data = data.reshape(-1, feature_num)
+        maxs, mins, avgs = (
+            data.max(axis=0), data.min(axis=0), data.sum(axis=0) / data.shape[0]
+        )
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference imdb.py:39): aclImdb tar with
+    train|test/pos|neg/*.txt; builds a frequency-cutoff word dict."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=True):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', but got {mode}")
+        self.mode = mode.lower()
+        self.data_file = _require_file(data_file, download, "Imdb")
+        self.word_idx = self._build_work_dict(cutoff)
+        self._load_anno()
+
+    def _tokenize(self, pattern):
+        docs = []
+        with tarfile.open(self.data_file) as tf:
+            for member in tf.getmembers():
+                if pattern.match(member.name):
+                    text = tf.extractfile(member).read().decode("latin-1")
+                    docs.append(text.lower().split())
+        return docs
+
+    def _build_work_dict(self, cutoff):
+        word_freq = collections.Counter()
+        pattern = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        for doc in self._tokenize(pattern):
+            word_freq.update(doc)
+        word_freq = {k: v for k, v in word_freq.items() if v > cutoff}
+        dictionary = sorted(word_freq.items(), key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(dictionary)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, polarity in ((0, "pos"), (1, "neg")):
+            pattern = re.compile(rf"aclImdb/{self.mode}/{polarity}/.*\.txt$")
+            for doc in self._tokenize(pattern):
+                self.docs.append([self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.asarray(self.docs[idx]), np.asarray([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB n-gram dataset (reference imikolov.py): simple-examples tar,
+    data/ptb.{train,valid}.txt; data_type NGRAM (windows of size N) or SEQ."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', but got {mode}")
+        if data_type.upper() not in ("NGRAM", "SEQ"):
+            raise ValueError(f"data_type should be 'NGRAM' or 'SEQ', got {data_type}")
+        self.mode = mode.lower()
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        self.data_file = _require_file(data_file, download, "Imikolov")
+        self.word_idx = self._build_dict()
+        self._load_anno()
+
+    def _read(self, suffix):
+        with tarfile.open(self.data_file) as tf:
+            for member in tf.getmembers():
+                if member.name.endswith(suffix):
+                    content = tf.extractfile(member).read().decode()
+                    return [l.strip().split() for l in content.splitlines()]
+        raise ValueError(f"no member ending with {suffix} in {self.data_file}")
+
+    def _build_dict(self):
+        freq = collections.Counter()
+        for line in self._read("ptb.train.txt"):
+            freq.update(line)
+            freq["<s>"] += 1
+            freq["<e>"] += 1
+        freq = {k: v for k, v in freq.items() if v >= self.min_word_freq}
+        freq.pop("<unk>", None)
+        items = sorted(freq.items(), key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(items)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        suffix = "ptb.train.txt" if self.mode == "train" else "ptb.valid.txt"
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for line in self._read(suffix):
+            if self.data_type == "NGRAM":
+                if self.window_size <= 0:
+                    raise ValueError("window_size must be positive for NGRAM")
+                ids = [self.word_idx.get(w, unk) for w in ["<s>"] + line + ["<e>"]]
+                for i in range(self.window_size - 1, len(ids)):
+                    self.data.append(tuple(ids[i - self.window_size + 1 : i + 1]))
+            else:
+                ids = [self.word_idx.get(w, unk) for w in line]
+                src = [self.word_idx["<s>"]] + ids
+                trg = ids + [self.word_idx["<e>"]]
+                self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.asarray(x) for x in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _LocalOnlyDataset(Dataset):
+    """Shared shell for corpora whose archives must be supplied locally."""
+
+    _NAME = "dataset"
+
+    def __init__(self, data_file=None, mode="train", download=True, **kwargs):
+        self.mode = mode
+        self.data_file = _require_file(data_file, download, self._NAME)
+        self.data = self._parse(**kwargs)
+
+    def _parse(self, **kwargs):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(_LocalOnlyDataset):
+    """CoNLL-2005 SRL (reference conll05.py). Parses the conll05st test
+    archive's wordpos/targets propositions into (sentence, predicate, labels)
+    tuples of raw strings."""
+
+    _NAME = "Conll05st"
+
+    def _parse(self):
+        sents = []
+        with tarfile.open(self.data_file) as tf:
+            words_member = next(
+                (m for m in tf.getmembers() if m.name.endswith("words.txt")), None
+            )
+            props_member = next(
+                (m for m in tf.getmembers() if m.name.endswith("props.txt")), None
+            )
+            if words_member is None or props_member is None:
+                raise ValueError("archive must contain words.txt and props.txt")
+            words = tf.extractfile(words_member).read().decode().splitlines()
+            props = tf.extractfile(props_member).read().decode().splitlines()
+        sent, lab = [], []
+        for w, p in zip(words, props):
+            if not w.strip():
+                if sent:
+                    sents.append((sent, lab))
+                sent, lab = [], []
+            else:
+                sent.append(w.strip())
+                lab.append(p.strip())
+        if sent:
+            sents.append((sent, lab))
+        return sents
+
+
+class Movielens(_LocalOnlyDataset):
+    """MovieLens-1M ratings (reference movielens.py): ml-1m zip/tar with
+    ratings.dat 'user::movie::rating::ts' lines."""
+
+    _NAME = "Movielens"
+
+    def _parse(self):
+        rows = []
+        opener = tarfile.open if tarfile.is_tarfile(self.data_file) else None
+        if opener is None:
+            import zipfile
+
+            with zipfile.ZipFile(self.data_file) as zf:
+                name = next(n for n in zf.namelist() if n.endswith("ratings.dat"))
+                content = zf.read(name).decode("latin-1")
+        else:
+            with tarfile.open(self.data_file) as tf:
+                member = next(
+                    m for m in tf.getmembers() if m.name.endswith("ratings.dat")
+                )
+                content = tf.extractfile(member).read().decode("latin-1")
+        for line in content.splitlines():
+            parts = line.strip().split("::")
+            if len(parts) == 4:
+                u, m, r, _ = parts
+                rows.append(
+                    (np.asarray(int(u)), np.asarray(int(m)), np.asarray(float(r)))
+                )
+        return rows
+
+
+class _ParallelCorpus(_LocalOnlyDataset):
+    """Shared parser for WMT14/WMT16-style parallel corpora: tar containing
+    ``*.src``/``*.trg`` (or train/test .en/.de) line-aligned files."""
+
+    _SRC_SUFFIXES = (".src", ".en")
+    _TRG_SUFFIXES = (".trg", ".de")
+
+    def _parse(self):
+        with tarfile.open(self.data_file) as tf:
+            members = tf.getmembers()
+
+            def find(suffixes):
+                for m in members:
+                    if self.mode in m.name and m.name.endswith(suffixes):
+                        return tf.extractfile(m).read().decode().splitlines()
+                for m in members:
+                    if m.name.endswith(suffixes):
+                        return tf.extractfile(m).read().decode().splitlines()
+                raise ValueError(f"no member with suffix {suffixes}")
+
+            src = find(self._SRC_SUFFIXES)
+            trg = find(self._TRG_SUFFIXES)
+        return list(zip(
+            [l.strip().split() for l in src], [l.strip().split() for l in trg]
+        ))
+
+
+class WMT14(_ParallelCorpus):
+    _NAME = "WMT14"
+
+
+class WMT16(_ParallelCorpus):
+    _NAME = "WMT16"

@@ -1,0 +1,94 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py:31,110).
+
+CRF max-sum decoding as one primitive: a lax.scan forward pass recording
+argmax back-pointers, then a reversed scan to recover the best path —
+compiles to a single XLA while-loop program on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..nn.layer import Layer
+from ..ops._helpers import defprim, ensure_tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi_fwd(potentials, transitions, lengths, *, include_bos_eos_tag):
+    b, t_max, k = potentials.shape
+    lengths = lengths.astype(jnp.int64)
+    if include_bos_eos_tag:
+        start_idx, stop_idx = k - 1, k - 2
+        alpha = potentials[:, 0] + transitions[start_idx][None, :]
+    else:
+        alpha = potentials[:, 0]
+
+    pot_tm = jnp.moveaxis(potentials, 1, 0)  # (T, B, K)
+
+    def step(alpha, inp):
+        t, pot_t = inp
+        scores = alpha[:, :, None] + transitions[None, :, :]  # (B, Kprev, Knext)
+        best_prev = jnp.argmax(scores, axis=1)                # (B, K)
+        new_alpha = jnp.max(scores, axis=1) + pot_t
+        mask = (t < lengths)[:, None]
+        alpha = jnp.where(mask, new_alpha, alpha)
+        return alpha, best_prev
+
+    ts = jnp.arange(1, t_max)
+    alpha, history = jax.lax.scan(step, alpha, (ts, pot_tm[1:]))
+    # history: (T-1, B, K) back-pointers for transitions into step t
+
+    if include_bos_eos_tag:
+        final = alpha + transitions[:, stop_idx][None, :]
+    else:
+        final = alpha
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1)                     # (B,)
+
+    def back(tag, inp):
+        t, hist_t = inp                                       # t in [T-1, ..., 1]
+        emit = jnp.where(t < lengths, tag, 0)                 # path[t]
+        prev = jnp.take_along_axis(hist_t, tag[:, None], axis=-1)[:, 0]
+        tag = jnp.where(t <= lengths - 1, prev, tag)
+        return tag, emit
+
+    tag, emits = jax.lax.scan(
+        back, last_tag, (jnp.arange(1, t_max)[::-1], history[::-1])
+    )
+    # emits[i] = path at position T-1-i; first position = final tag state
+    path = jnp.concatenate([tag[:, None], emits[::-1].T], axis=1)  # (B, T)
+    path = jnp.where(jnp.arange(t_max)[None, :] < lengths[:, None], path, 0)
+    return scores, path.astype(jnp.int64)
+
+
+defprim("viterbi_decode_p", _viterbi_fwd, multi_out=True, nondiff=True)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    potentials = ensure_tensor(potentials)
+    transition_params = ensure_tensor(transition_params)
+    lengths = ensure_tensor(lengths)
+    if potentials.ndim != 3:
+        raise ValueError("potentials should be [batch, seq_len, num_tags]")
+    if transition_params.ndim != 2:
+        raise ValueError("transition_params should be [num_tags, num_tags]")
+    return apply(
+        "viterbi_decode_p", potentials, transition_params, lengths,
+        include_bos_eos_tag=bool(include_bos_eos_tag),
+    )
+
+
+class ViterbiDecoder(Layer):
+    """Layer form of viterbi_decode (reference viterbi_decode.py:110)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = ensure_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(
+            potentials, self.transitions, lengths, self.include_bos_eos_tag
+        )

@@ -1,0 +1,235 @@
+"""paddle.audio + paddle.text + hub/sysconfig (reference test model:
+test/legacy_test/test_audio_functions.py, test_viterbi_decode_op.py)."""
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, text
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestAudioFunctional:
+    def test_mel_scale_roundtrip(self):
+        librosa_mel = pytest.importorskip("scipy")  # formulas match librosa/slaney
+        for htk in (False, True):
+            f = 4000.0
+            m = audio.functional.hz_to_mel(f, htk=htk)
+            back = audio.functional.mel_to_hz(m, htk=htk)
+            assert abs(back - f) < 1e-3
+
+    def test_mel_frequencies_monotonic(self):
+        freqs = _np(audio.functional.mel_frequencies(40, 0.0, 8000.0))
+        assert freqs.shape == (40,)
+        assert (np.diff(freqs) > 0).all()
+        assert abs(freqs[0]) < 1e-3 and abs(freqs[-1] - 8000) < 1.0
+
+    def test_fft_frequencies(self):
+        f = _np(audio.functional.fft_frequencies(16000, 512))
+        np.testing.assert_allclose(f, np.linspace(0, 8000, 257), rtol=1e-5)
+
+    def test_fbank_matrix(self):
+        fb = _np(audio.functional.compute_fbank_matrix(16000, 512, n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(-1) > 0).all()  # every filter has support
+
+    def test_power_to_db(self):
+        x = paddle.to_tensor(np.asarray([1.0, 0.1, 0.01], "float32"))
+        db = _np(audio.functional.power_to_db(x))
+        np.testing.assert_allclose(db, [0.0, -10.0, -20.0], atol=1e-4)
+        db2 = _np(audio.functional.power_to_db(x, top_db=15.0))
+        np.testing.assert_allclose(db2, [0.0, -10.0, -15.0], atol=1e-4)
+        with pytest.raises(ValueError):
+            audio.functional.power_to_db(x, amin=0.0)
+
+    def test_create_dct_orthonormal(self):
+        d = _np(audio.functional.create_dct(8, 8))
+        np.testing.assert_allclose(d.T @ d, np.eye(8), atol=1e-5)
+
+    def test_get_window(self):
+        import scipy.signal.windows as sw
+
+        w = _np(audio.get_window("hann", 32))
+        np.testing.assert_allclose(w, sw.hann(32, sym=False), rtol=1e-6)
+        w2 = _np(audio.get_window(("kaiser", 8.0), 16, fftbins=False))
+        np.testing.assert_allclose(w2, sw.kaiser(16, 8.0, sym=True), rtol=1e-6)
+        with pytest.raises(ValueError):
+            audio.get_window("kaiser", 16)
+        with pytest.raises(ValueError):
+            audio.get_window("bogus_window", 16)
+
+
+class TestAudioFeatures:
+    def test_spectrogram_matches_signal_stft(self):
+        x = np.random.randn(2, 1000).astype("float32")
+        layer = audio.features.Spectrogram(n_fft=128, hop_length=32, power=2.0)
+        out = _np(layer(paddle.to_tensor(x)))
+        assert out.shape[0] == 2 and out.shape[1] == 65
+        assert (out >= 0).all()
+
+    def test_melspectrogram_and_mfcc_shapes(self):
+        x = paddle.to_tensor(np.random.randn(1600).astype("float32"))
+        mel = audio.features.MelSpectrogram(sr=16000, n_fft=256, n_mels=40)
+        m = _np(mel(x))
+        assert m.shape[0] == 40
+        logmel = audio.features.LogMelSpectrogram(sr=16000, n_fft=256, n_mels=40, top_db=80.0)
+        lm = _np(logmel(x))
+        assert lm.shape == m.shape
+        mfcc = audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=256, n_mels=40)
+        c = _np(mfcc(x))
+        assert c.shape[0] == 13
+        with pytest.raises(ValueError):
+            audio.features.MFCC(n_mfcc=80, n_mels=40)
+
+    def test_feature_grad_flows(self):
+        x = paddle.to_tensor(np.random.randn(800).astype("float32"), stop_gradient=False)
+        mel = audio.features.MelSpectrogram(sr=8000, n_fft=128, n_mels=20)
+        out = mel(x)
+        out.sum().backward()
+        assert x.grad is not None
+
+
+class TestAudioBackends:
+    def test_save_load_roundtrip(self, tmp_path):
+        sr = 8000
+        t = np.linspace(0, 1, sr, dtype="float32")
+        wav = (0.5 * np.sin(2 * np.pi * 440 * t))[None, :]  # (1, T)
+        path = str(tmp_path / "tone.wav")
+        audio.save(path, paddle.to_tensor(wav), sr)
+        meta = audio.info(path)
+        assert meta.sample_rate == sr and meta.num_channels == 1
+        assert meta.bits_per_sample == 16
+        loaded, sr2 = audio.load(path)
+        assert sr2 == sr
+        np.testing.assert_allclose(_np(loaded), wav, atol=1e-3)
+        assert audio.backends.get_current_backend() == "wave_backend"
+        assert "wave_backend" in audio.backends.list_available_backends()
+
+
+class TestViterbi:
+    def _brute_force(self, emission, transition, length, with_tags):
+        import itertools
+
+        k = emission.shape[-1]
+        best_score, best_path = -np.inf, None
+        start, stop = k - 1, k - 2
+        for tags in itertools.product(range(k), repeat=length):
+            s = emission[0, tags[0]]
+            if with_tags:
+                s += transition[start, tags[0]]
+            for i in range(1, length):
+                s += transition[tags[i - 1], tags[i]] + emission[i, tags[i]]
+            if with_tags:
+                s += transition[tags[-1], stop]
+            if s > best_score:
+                best_score, best_path = s, tags
+        return best_score, best_path
+
+    @pytest.mark.parametrize("with_tags", [False, True])
+    def test_matches_brute_force(self, with_tags):
+        np.random.seed(0)
+        b, t, k = 3, 5, 4
+        emission = np.random.randn(b, t, k).astype("float32")
+        transition = np.random.randn(k, k).astype("float32")
+        lengths = np.asarray([5, 3, 1])
+        scores, paths = text.viterbi_decode(
+            paddle.to_tensor(emission), paddle.to_tensor(transition),
+            paddle.to_tensor(lengths), include_bos_eos_tag=with_tags)
+        for i in range(b):
+            ref_score, ref_path = self._brute_force(
+                emission[i], transition, lengths[i], with_tags)
+            np.testing.assert_allclose(float(_np(scores)[i]), ref_score, rtol=1e-4)
+            np.testing.assert_array_equal(_np(paths)[i, :lengths[i]], ref_path)
+            np.testing.assert_array_equal(_np(paths)[i, lengths[i]:], 0)
+
+    def test_decoder_layer(self):
+        k = 4
+        dec = text.ViterbiDecoder(paddle.rand([k, k]), include_bos_eos_tag=False)
+        scores, paths = dec(paddle.rand([2, 6, k]), paddle.to_tensor(np.asarray([6, 4])))
+        assert tuple(scores.shape) == (2,) and tuple(paths.shape) == (2, 6)
+
+
+class TestTextDatasets:
+    def test_uci_housing(self, tmp_path):
+        data = np.random.rand(50, 14).astype("float32")
+        path = str(tmp_path / "housing.data")
+        np.savetxt(path, data)
+        train = text.UCIHousing(data_file=path, mode="train")
+        test = text.UCIHousing(data_file=path, mode="test")
+        assert len(train) == 40 and len(test) == 10
+        feat, target = train[0]
+        assert feat.shape == (13,) and target.shape == (1,)
+
+    def test_imdb(self, tmp_path):
+        root = tmp_path / "aclImdb"
+        texts = {
+            "train/pos/0.txt": "great great great movie " * 60,
+            "train/neg/0.txt": "awful awful awful movie " * 60,
+            "test/pos/0.txt": "great film " * 80,
+        }
+        for rel, content in texts.items():
+            p = root / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(content)
+        tar_path = str(tmp_path / "aclImdb_v1.tar.gz")
+        with tarfile.open(tar_path, "w:gz") as tf:
+            tf.add(str(root), arcname="aclImdb")
+        ds = text.Imdb(data_file=tar_path, mode="train", cutoff=100)
+        assert len(ds) == 2
+        doc, label = ds[0]
+        assert label[0] in (0, 1)
+        assert "great" in ds.word_idx and "<unk>" in ds.word_idx
+
+    def test_imikolov(self, tmp_path):
+        root = tmp_path / "simple-examples" / "data"
+        root.mkdir(parents=True)
+        (root / "ptb.train.txt").write_text("a b c\n" * 60)
+        (root / "ptb.valid.txt").write_text("a b\n" * 10)
+        tar_path = str(tmp_path / "simple-examples.tgz")
+        with tarfile.open(tar_path, "w:gz") as tf:
+            tf.add(str(tmp_path / "simple-examples"), arcname="simple-examples")
+        ds = text.Imikolov(data_file=tar_path, data_type="NGRAM", window_size=2,
+                           mode="train", min_word_freq=10)
+        assert len(ds) > 0
+        gram = ds[0]
+        assert len(gram) == 2
+
+    def test_download_unavailable(self):
+        with pytest.raises(RuntimeError):
+            text.UCIHousing(download=True)
+        with pytest.raises(ValueError):
+            text.UCIHousing(download=False)
+
+
+class TestHubSysconfig:
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "dependencies = []\n"
+            "def tiny_model(scale=1.0):\n"
+            "    'a tiny test model'\n"
+            "    return {'scale': scale}\n"
+        )
+        import paddle_tpu.hub as hub
+
+        assert "tiny_model" in hub.list(str(tmp_path), source="local")
+        assert "tiny" in hub.help(str(tmp_path), "tiny_model", source="local")
+        m = hub.load(str(tmp_path), "tiny_model", source="local", scale=2.0)
+        assert m == {"scale": 2.0}
+        with pytest.raises(RuntimeError):
+            hub.load(str(tmp_path), "tiny_model", source="github")
+        with pytest.raises(ValueError):
+            hub.load(str(tmp_path), "tiny_model", source="bogus")
+
+    def test_sysconfig(self):
+        import paddle_tpu.sysconfig as sysconfig
+
+        inc = sysconfig.get_include()
+        assert os.path.isdir(inc)
+        assert os.path.exists(os.path.join(inc, "ptpu_c_api.h"))
+        assert isinstance(sysconfig.get_lib(), str)

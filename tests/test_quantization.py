@@ -88,6 +88,58 @@ class TestQAT:
         grid = w / scale * 127
         np.testing.assert_allclose(grid, np.round(grid), atol=1e-3)
 
+    def test_layer_config_survives_deepcopy(self):
+        model = Net()
+        qcfg = Q.QuantConfig(activation=None, weight=None)
+        qcfg.add_layer_config(model.fc2, weight=Q.FakeQuanterWithAbsMaxObserver())
+        qmodel = Q.QAT(qcfg).quantize(model)  # inplace=False deepcopies
+        assert isinstance(qmodel.fc2, Q.QuantedWrapper)
+        assert isinstance(qmodel.fc1, nn.Linear)  # untouched
+
+    def test_activation_only_weightless_layer(self):
+        class ActNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+                self.act = nn.ReLU()
+
+            def forward(self, x):
+                return self.act(self.fc(x))
+
+        model = ActNet()
+        qcfg = Q.QuantConfig(activation=None, weight=None)
+        qcfg.add_type_config(nn.ReLU, activation=Q.FakeQuanterWithAbsMaxObserver())
+        qmodel = Q.QAT(qcfg).quantize(model, inplace=True)
+        assert isinstance(qmodel.act, Q.QuantedWrapper)
+        assert qmodel.act.weight_quanter is None
+        out = qmodel(paddle.ones([2, 4]))
+        assert tuple(out.shape) == (2, 4)
+
+    def test_convert_does_not_mutate_qat_scale(self):
+        paddle.seed(0)
+        model = Net()
+        qcfg = Q.QuantConfig(activation=None, weight=Q.FakeQuanterWithAbsMaxObserver())
+        qat = Q.QAT(qcfg)
+        qmodel = qat.quantize(model)
+        qmodel.train()
+        qmodel(paddle.to_tensor(np.random.randn(4, 8).astype("float32")))
+        scale_before = float(_np(qmodel.fc1.weight_quanter.scales()))
+        infer1 = qat.convert(qmodel)
+        assert float(_np(qmodel.fc1.weight_quanter.scales())) == scale_before
+        infer2 = qat.convert(qmodel)
+        np.testing.assert_allclose(_np(infer1.fc1.weight), _np(infer2.fc1.weight))
+
+    def test_groupwise_ptq_convert(self):
+        paddle.seed(0)
+        model = Net()
+        qcfg = Q.QuantConfig(activation=None,
+                             weight=Q.GroupWiseWeightObserver(group_size=4))
+        ptq = Q.PTQ(qcfg)
+        qmodel = ptq.quantize(model)
+        qmodel(paddle.to_tensor(np.random.randn(4, 8).astype("float32")))
+        infer = ptq.convert(qmodel)  # must not crash on group-shaped scales
+        assert np.isfinite(_np(infer.fc1.weight)).all()
+
     def test_type_and_layer_config_priority(self):
         model = Net()
         qcfg = Q.QuantConfig(activation=None, weight=None)
