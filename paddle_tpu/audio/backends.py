@@ -91,7 +91,8 @@ def save(filepath, src, sample_rate, channels_first=True, encoding="PCM_16",
     """Save a [C, T] (or [T, C]) waveform Tensor as 16-bit PCM WAV."""
     data = np.asarray(src._value if isinstance(src, Tensor) else src)
     if data.ndim == 1:
-        data = data[None, :]
+        # mono: orient per the declared layout so (T,) never becomes T channels
+        data = data[None, :] if channels_first else data[:, None]
     if channels_first:
         data = data.T                      # (T, C)
     if bits_per_sample != 16:

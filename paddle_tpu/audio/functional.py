@@ -50,13 +50,6 @@ def _mel_to_hz_np(mel, htk):
     )
 
 
-def _wrap(x, dtype, was_tensor_or_array):
-    arr = np.asarray(x, dtype=np.dtype(dtype))
-    if was_tensor_or_array:
-        return Tensor._from_value(arr)
-    return Tensor._from_value(arr) if arr.ndim else Tensor._from_value(arr)
-
-
 def hz_to_mel(freq, htk=False):
     """Convert Hz to Mels (reference functional.py:24). Accepts float or
     Tensor; returns the same kind."""

@@ -7,7 +7,15 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Union
 
-from .engine import enable_grad, grad_enabled, no_grad, run_backward, set_grad_enabled
+from .engine import (
+    enable_grad,
+    grad_enabled,
+    no_grad,
+    run_backward,
+    run_backward_create_graph,
+    set_grad_enabled,
+)
+from .functional import Hessian, Jacobian, hessian, jacobian
 from .py_layer import PyLayer, PyLayerContext
 
 __all__ = [
@@ -19,6 +27,10 @@ __all__ = [
     "is_grad_enabled",
     "PyLayer",
     "PyLayerContext",
+    "jacobian",
+    "hessian",
+    "Jacobian",
+    "Hessian",
 ]
 
 
@@ -49,17 +61,12 @@ def grad(
     C++ GeneralGrad partial-graph engine). Computes grads of ``outputs``
     w.r.t. ``inputs`` without touching ``.grad`` fields.
 
-    create_graph (double backward) is not yet supported in the eager tape;
-    use jax-level autodiff via paddle_tpu.incubate.autograd for higher order.
+    With create_graph=True the backward pass replays through the primitive
+    layer, so the returned grads carry their own grad graph — paddle.grad
+    composes to arbitrary derivative order (double backward and beyond).
     """
     from ..core.tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not supported by the eager "
-            "tape yet; trace the whole computation with paddle_tpu.jit and "
-            "use functional grad instead"
-        )
     single = isinstance(inputs, Tensor)
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     inputs = [inputs] if single else list(inputs)
@@ -73,14 +80,22 @@ def grad(
         else:
             capture[(id(t._accum_node()), 0)] = i
 
-    retain = bool(retain_graph) if retain_graph is not None else False
-    captured = run_backward(
-        outputs,
-        grad_outputs,
-        retain_graph=retain,
-        capture=capture,
-        accumulate_leaves=False,
-    )
+    if create_graph:
+        # create_graph implies the graph survives (reference semantics:
+        # retain_graph defaults to create_graph)
+        retain = bool(retain_graph) if retain_graph is not None else True
+        captured = run_backward_create_graph(
+            outputs, grad_outputs, capture=capture, retain_graph=retain
+        )
+    else:
+        retain = bool(retain_graph) if retain_graph is not None else False
+        captured = run_backward(
+            outputs,
+            grad_outputs,
+            retain_graph=retain,
+            capture=capture,
+            accumulate_leaves=False,
+        )
     result = []
     for i, t in enumerate(inputs):
         g = captured.get(i)
@@ -91,6 +106,8 @@ def grad(
                     "allow_unused=True to return None for it"
                 )
             result.append(None)
+        elif isinstance(g, Tensor):
+            result.append(g)
         else:
             result.append(Tensor._from_value(g))
     return result

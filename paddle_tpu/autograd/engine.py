@@ -114,6 +114,7 @@ class GradNode:
         "prim_name",
         "static",
         "saved",
+        "saved_tensors",
         "out_avals",
         "in_edges",
         "out_hooks",
@@ -121,10 +122,18 @@ class GradNode:
         "name_hint",
     )
 
-    def __init__(self, prim_name, static, saved, out_avals, in_edges):
+    def __init__(self, prim_name, static, saved, out_avals, in_edges,
+                 saved_tensors=None):
         self.prim_name = prim_name
         self.static = static
         self.saved = saved
+        # input Tensor refs: keep the upstream graph reachable for
+        # create_graph double backward — the TensorWrapper analog
+        # (fluid/eager/tensor_wrapper.h keeps the autograd graph of saved
+        # tensors for higher-order grad). Trade-off: prims with a slim
+        # custom save (e.g. save=()) now also retain their input arrays
+        # until release(); paddle pays the same via TensorWrapper.
+        self.saved_tensors = saved_tensors
         self.out_avals = out_avals  # [(shape, dtype)] per forward output
         self.in_edges: List[Optional[Tuple[Any, int]]] = in_edges
         self.out_hooks: Dict[int, List[Callable]] = {}
@@ -133,12 +142,14 @@ class GradNode:
 
     def release(self):
         self.saved = None
+        self.saved_tensors = None
 
     def __repr__(self):
         return f"<GradNode {self.name_hint}>"
 
 
-def record_op(prim_name, static, saved, in_tensors, out_arrays):
+def record_op(prim_name, static, saved, in_tensors, out_arrays,
+              saved_tensors=None):
     """Create the GradNode for a primitive call; returns it (or None when
     nothing requires grad / grad is disabled). Mirrors the node-creation block
     eager_gen.py emits into every *_ad_func (eager_gen.py:1132)."""
@@ -158,7 +169,8 @@ def record_op(prim_name, static, saved, in_tensors, out_arrays):
     if not any_grad:
         return None
     out_avals = [(tuple(o.shape), o.dtype) for o in out_arrays]
-    return GradNode(prim_name, static, saved, out_avals, edges)
+    return GradNode(prim_name, static, saved, out_avals, edges,
+                    saved_tensors=saved_tensors)
 
 
 # --------------------------------------------------------------------------
@@ -314,4 +326,175 @@ def run_backward(
         # nodes whose indegree never hit zero are unreachable-from-seed
         # consumers; any buffered grads there are simply dropped (matches
         # reference partial-graph semantics).
+    return captured
+
+
+# --------------------------------------------------------------------------
+# create_graph (double backward): replay the backward pass THROUGH the
+# primitive-application layer so every gradient computation is itself
+# recorded on the tape. Each forward primitive gets a derived "__vjp__"
+# primitive whose forward runs its backward rule; nesting is handled by
+# jax's nested vjp in the generic fallback. Reference analog: GradNode
+# backward functions are themselves differentiable ops when TensorWrappers
+# keep the autograd graph (fluid/eager/general_grad.h + eager_gen VJP
+# emission for higher-order ops).
+# --------------------------------------------------------------------------
+import jax as _jax
+
+
+def _ensure_vjp_prim(prim_name: str) -> str:
+    """Derived primitive running ``jax.vjp`` over the forward with the
+    ORIGINAL inputs. Custom save/vjp fast paths are deliberately bypassed:
+    they may save forward outputs (severing input dependence), while
+    rematerialising the forward keeps every second-order path intact and
+    XLA CSE/fusion absorbs the recompute."""
+    vname = f"__vjp__{prim_name}"
+    if vname in dispatch.PRIMITIVES:
+        return vname
+    prim = dispatch.PRIMITIVES[prim_name]
+
+    def vjp_forward(*arrays, n_out, inner):
+        static = dict(inner)
+        grads_out = arrays[:n_out]
+        inputs = arrays[n_out:]
+        f = lambda *a: prim.forward(*a, **static)
+        outs, vjp_fn = _jax.vjp(f, *inputs)
+        grads = vjp_fn(grads_out if isinstance(outs, tuple) else grads_out[0])
+        grads = tuple(grads) if isinstance(grads, (tuple, list)) else (grads,)
+        # non-differentiable inputs (ints, PRNG keys) yield None/float0
+        # cotangents — replace with float32 zero placeholders; their edges
+        # are None so the placeholders are never consumed
+        from jax.dtypes import float0
+
+        return tuple(
+            jnp.zeros(a.shape, jnp.float32)
+            if g is None or getattr(g, "dtype", None) == float0
+            else g
+            for g, a in zip(grads, inputs)
+        )
+
+    dispatch.register_primitive(
+        vname, vjp_forward, multi_out=True, jittable=prim.jittable
+    )
+    return vname
+
+
+def run_backward_create_graph(
+    tensors,
+    grad_tensors=None,
+    capture: Optional[Dict[Tuple[int, int], Any]] = None,
+    retain_graph: bool = True,
+):
+    """Backward pass where gradients are Tensors on the live tape, enabling
+    paddle.grad(..., create_graph=True) and arbitrary-order derivatives."""
+    from ..core.tensor import Tensor, apply as tensor_apply
+
+    capture = capture or {}
+    captured: Dict[Any, Any] = {}
+    buffers: Dict[int, List[Optional[Any]]] = {}
+    roots: List[GradNode] = []
+
+    def seed_for(t, i):
+        if grad_tensors is not None and grad_tensors[i] is not None:
+            gt = grad_tensors[i]
+            return gt if isinstance(gt, Tensor) else Tensor._from_value(jnp.asarray(gt))
+        return Tensor._from_value(jnp.ones(t.shape, t.dtype))
+
+    for i, t in enumerate(tensors):
+        if t.stop_gradient and t._node is None:
+            raise RuntimeError(
+                f"backward(): tensor {i} has stop_gradient=True and no grad graph"
+            )
+        g = seed_for(t, i)
+        node = t._node
+        if node is None:
+            acc = t._accum_node()
+            key = capture.get((id(acc), 0))
+            if key is not None:
+                captured[key] = g if key not in captured else captured[key] + g
+            continue
+        if id(node) not in buffers:
+            buffers[id(node)] = [None] * len(node.out_avals)
+            roots.append(node)
+        buf = buffers[id(node)]
+        slot = t._out_slot
+        buf[slot] = g if buf[slot] is None else buf[slot] + g
+
+    if not roots:
+        return captured
+
+    indeg, _nodes = _collect_indegree(roots)
+    ready = deque(n for n in roots if indeg[id(n)] == 0)
+    processed = set()
+
+    while ready:
+        node = ready.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        buf = buffers.pop(id(node), [None] * len(node.out_avals))
+        grads_out = [
+            b if b is not None else Tensor._from_value(jnp.zeros(shape, dtype))
+            for b, (shape, dtype) in zip(buf, node.out_avals)
+        ]
+        for slot, hooks in node.out_hooks.items():
+            g = grads_out[slot]
+            for hook in hooks:
+                new = hook(g)
+                if new is not None:
+                    g = new if isinstance(new, Tensor) else Tensor._from_value(new)
+            grads_out[slot] = g
+        for slot in range(len(node.out_avals)):
+            key = capture.get((id(node), slot))
+            if key is not None:
+                captured[key] = grads_out[slot]
+
+        if node.saved is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time; "
+                "set retain_graph=True to allow this."
+            )
+        prim = dispatch.PRIMITIVES[node.prim_name]
+        if node.saved_tensors is None or prim.forward is None:
+            # non-replayable node (PyLayer / recompute: opaque Python
+            # backward, no jax forward to differentiate) — run its
+            # first-order vjp; the produced grads enter the new tape as
+            # constants, so second order THROUGH this node is cut, matching
+            # the reference's behavior for non-double-grad custom ops
+            raw = dispatch.call_vjp(
+                node.prim_name,
+                tuple(g._value for g in grads_out),
+                node.saved,
+                node.static,
+            )
+            in_grads = tuple(
+                None if g is None else Tensor._from_value(g) for g in raw
+            )
+        else:
+            vname = _ensure_vjp_prim(node.prim_name)
+            in_grads = tensor_apply(
+                vname, *grads_out, *node.saved_tensors,
+                n_out=len(grads_out),
+                inner=dispatch._hashable(node.static),
+            )
+            if not isinstance(in_grads, tuple):
+                in_grads = (in_grads,)
+        if not retain_graph:
+            node.release()
+
+        for e, g in zip(node.in_edges, in_grads):
+            if e is None or g is None:
+                continue
+            p, slot = e
+            if isinstance(p, AccumulationNode):
+                key = capture.get((id(p), 0))
+                if key is not None:
+                    captured[key] = g if key not in captured else captured[key] + g
+                continue
+            b = buffers.setdefault(id(p), [None] * len(p.out_avals))
+            b[slot] = g if b[slot] is None else b[slot] + g
+            indeg[id(p)] -= 1
+            if indeg[id(p)] == 0:
+                ready.append(p)
+
     return captured

@@ -333,7 +333,13 @@ def apply(prim_name: str, *tensors: Tensor, **static) -> Any:
     node = None
     if requires:
         saved = prim.save(arrays, outs) if prim.save else arrays
-        node = engine.record_op(prim_name, static, saved, tensors, outs)
+        # input Tensor refs kept for create_graph replay (TensorWrapper
+        # analog): the replay differentiates jax.vjp over the forward with
+        # the ORIGINAL inputs, so custom save/vjp fast paths don't sever
+        # the second-order graph
+        node = engine.record_op(
+            prim_name, static, saved, tensors, outs, saved_tensors=tensors
+        )
     result = []
     for i, o in enumerate(outs):
         t = Tensor._from_value(o, stop_gradient=not requires)

@@ -1,0 +1,110 @@
+"""Composite-op decomposition — ``paddle.decomposition`` parity.
+
+Reference: python/paddle/decomposition/ (decompose rules lowering composite
+ops to primitive ops for the compiler and higher-order autodiff;
+fluid/prim + fluid/primitive in C++). In this framework XLA is the
+primitive layer — every registered primitive already lowers to StableHLO,
+and higher-order autodiff runs through nested jax.vjp — so decomposition is
+a *view*, not a rewrite: ``decompose_rule`` registers a pure-primitive
+expansion, and ``decompose`` re-expresses a captured static Program with
+those expansions applied (useful for inspecting what a composite op does
+and for excluding fused kernels from a compiled program)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+__all__ = ["register_decomp", "get_decomp_rule", "has_decomp", "decompose"]
+
+_RULES: Dict[str, Callable] = {}
+
+
+def register_decomp(op_name: str):
+    """Register a decomposition rule: fn(*input_tensors, **static) returning
+    the composite's outputs built only from primitive ops."""
+
+    def wrapper(fn):
+        _RULES[op_name] = fn
+        return fn
+
+    return wrapper
+
+
+def has_decomp(op_name: str) -> bool:
+    return op_name in _RULES
+
+
+def get_decomp_rule(op_name: str):
+    return _RULES.get(op_name)
+
+
+def decompose(program, ops_filter=None):
+    """Rewrite a captured static Program, replacing each instruction whose
+    op has a registered rule (and passes ops_filter) by re-tracing the rule
+    under capture — the instruction expands into primitive instructions in
+    a NEW Program (reference: decomposition/decompose.py rewriting a
+    pir::Program in place). Fetch targets from the original trace remain
+    resolvable against the returned program."""
+    from .core import dispatch as _dispatch
+    from .core.tensor import Tensor
+    from .static.program import Program
+
+    if not isinstance(program, Program):
+        raise TypeError("decompose expects a paddle_tpu.static.Program")
+
+    new = Program()
+    env = {}  # old vid -> value object in the new trace
+    for name, vid, shape, dtype in program._placeholders:
+        env[vid] = new.add_placeholder(name, shape, dtype)
+    for vid, const in program._consts.items():
+        env[vid] = const
+
+    prev_capture = _dispatch._capture_program
+    _dispatch.set_capture_program(new)
+    try:
+        for prim_name, in_vids, static_items, out_vids in program._insts:
+            static = dict(static_items)
+            ins = tuple(env[v] for v in in_vids)
+            rule = _RULES.get(prim_name)
+            if rule is not None and (ops_filter is None or prim_name in ops_filter):
+                touts = rule(*(Tensor._from_value(a) for a in ins), **static)
+                touts = touts if isinstance(touts, (tuple, list)) else (touts,)
+                outs = tuple(t._value for t in touts)
+            else:
+                outs = _dispatch.call_primitive(prim_name, ins, static)
+                outs = outs if isinstance(outs, tuple) else (outs,)
+            for ov, o in zip(out_vids, outs):
+                env[ov] = o
+    finally:
+        _dispatch.set_capture_program(prev_capture)
+
+    # keep fetch Tensors from the ORIGINAL trace resolvable: alias each old
+    # captured object's id to the corresponding new vid
+    for obj in program._keepalive:
+        old_vid = program._vid_by_obj.get(id(obj))
+        if old_vid is None or old_vid not in env:
+            continue
+        new_vid = new._vid_by_obj.get(id(env[old_vid]))
+        if new_vid is not None:
+            new._vid_by_obj[id(obj)] = new_vid
+            new._keepalive.append(obj)
+    return new
+
+
+# -- built-in rules for the fused primitives (inspection/reference) --------
+@register_decomp("softmax_p")
+def _softmax_rule(x, *, axis=-1):
+    from .ops.math import exp, max as max_, sum as sum_
+
+    z = exp(x - max_(x, axis=axis, keepdim=True))
+    return z / z.sum(axis=axis, keepdim=True)
+
+
+@register_decomp("gelu_p")
+def _gelu_rule(x, *, approximate=False):
+    import math
+
+    from .ops.math import erf, pow as pow_, tanh
+
+    if approximate:
+        return 0.5 * x * (1.0 + tanh(math.sqrt(2.0 / math.pi) * (x + 0.044715 * pow_(x, 3.0))))
+    return 0.5 * x * (1.0 + erf(x / math.sqrt(2.0)))

@@ -39,6 +39,9 @@ class QuantConfig:
         self._layer_configs = {}      # id(layer) -> SingleLayerConfig
         self._type_configs = {}       # type -> SingleLayerConfig
         self._prefix_configs = {}     # name prefix -> SingleLayerConfig
+        # instance configs pinned to full names before deepcopy — checked
+        # FIRST so they keep instance priority over name configs
+        self._pinned_instance_configs = {}
         self._qat_layer_mapping = {}  # source type -> quanted type
         self._customized_leaves = []
 
@@ -98,7 +101,7 @@ class QuantConfig:
                 full = f"{prefix}.{name}" if prefix else name
                 cfg = self._layer_configs.get(id(child))
                 if cfg is not None:
-                    self._prefix_configs[full] = cfg
+                    self._pinned_instance_configs[full] = cfg
                 walk(child, full)
 
         walk(model)
@@ -108,6 +111,8 @@ class QuantConfig:
         type > global (reference priority order)."""
         if id(layer) in self._layer_configs:
             return self._layer_configs[id(layer)]
+        if full_name in self._pinned_instance_configs:
+            return self._pinned_instance_configs[full_name]
         for prefix, cfg in self._prefix_configs.items():
             if full_name == prefix or full_name.startswith(prefix + "."):
                 return cfg

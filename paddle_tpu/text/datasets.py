@@ -73,35 +73,39 @@ class Imdb(Dataset):
             raise ValueError(f"mode should be 'train' or 'test', but got {mode}")
         self.mode = mode.lower()
         self.data_file = _require_file(data_file, download, "Imdb")
-        self.word_idx = self._build_work_dict(cutoff)
-        self._load_anno()
+        # one decompression pass: bucket documents by (split, polarity),
+        # then build the vocab and annotation lists from the buckets
+        buckets = self._scan_archive()
+        self.word_idx = self._build_work_dict(buckets, cutoff)
+        self._load_anno(buckets)
 
-    def _tokenize(self, pattern):
-        docs = []
+    def _scan_archive(self):
+        pattern = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        buckets = {}
         with tarfile.open(self.data_file) as tf:
-            for member in tf.getmembers():
-                if pattern.match(member.name):
+            for member in tf:
+                m = pattern.match(member.name)
+                if m:
                     text = tf.extractfile(member).read().decode("latin-1")
-                    docs.append(text.lower().split())
-        return docs
+                    buckets.setdefault(m.groups(), []).append(text.lower().split())
+        return buckets
 
-    def _build_work_dict(self, cutoff):
+    def _build_work_dict(self, buckets, cutoff):
         word_freq = collections.Counter()
-        pattern = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
-        for doc in self._tokenize(pattern):
-            word_freq.update(doc)
+        for docs in buckets.values():
+            for doc in docs:
+                word_freq.update(doc)
         word_freq = {k: v for k, v in word_freq.items() if v > cutoff}
         dictionary = sorted(word_freq.items(), key=lambda x: (-x[1], x[0]))
         word_idx = {w: i for i, (w, _) in enumerate(dictionary)}
         word_idx["<unk>"] = len(word_idx)
         return word_idx
 
-    def _load_anno(self):
+    def _load_anno(self, buckets):
         unk = self.word_idx["<unk>"]
         self.docs, self.labels = [], []
         for label, polarity in ((0, "pos"), (1, "neg")):
-            pattern = re.compile(rf"aclImdb/{self.mode}/{polarity}/.*\.txt$")
-            for doc in self._tokenize(pattern):
+            for doc in buckets.get((self.mode, polarity), []):
                 self.docs.append([self.word_idx.get(w, unk) for w in doc])
                 self.labels.append(label)
 

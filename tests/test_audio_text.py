@@ -111,6 +111,14 @@ class TestAudioBackends:
         assert audio.backends.get_current_backend() == "wave_backend"
         assert "wave_backend" in audio.backends.list_available_backends()
 
+    def test_save_mono_channels_last(self, tmp_path):
+        sr = 8000
+        wav = np.sin(np.linspace(0, 20, 500)).astype("float32")  # 1-D mono
+        path = str(tmp_path / "mono.wav")
+        audio.save(path, paddle.to_tensor(wav), sr, channels_first=False)
+        meta = audio.info(path)
+        assert meta.num_channels == 1 and meta.num_samples == 500
+
 
 class TestViterbi:
     def _brute_force(self, emission, transition, length, with_tags):

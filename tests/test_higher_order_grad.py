@@ -1,0 +1,180 @@
+"""Higher-order autograd: create_graph double/triple backward, jacobian/
+hessian, decomposition (reference test model: test/legacy_test/
+test_imperative_double_grad.py, test_autograd_functional_*.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import autograd
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestCreateGraph:
+    def test_polynomial_third_order(self):
+        x = paddle.to_tensor(np.asarray([2.0, 3.0], "float32"), stop_gradient=False)
+        y = x * x * x
+        (g1,) = paddle.grad(y, x, create_graph=True)
+        (g2,) = paddle.grad(g1, x, create_graph=True)
+        (g3,) = paddle.grad(g2, x)
+        np.testing.assert_allclose(_np(g1), [12, 27])
+        np.testing.assert_allclose(_np(g2), [12, 18])
+        np.testing.assert_allclose(_np(g3), [6, 6])
+
+    def test_transcendental_second_order(self):
+        import math
+
+        x = paddle.to_tensor(np.float32(0.7), stop_gradient=False)
+        y = paddle.sin(paddle.exp(x))
+        (g1,) = paddle.grad(y, x, create_graph=True)
+        (g2,) = paddle.grad(g1, x)
+        e = math.exp(0.7)
+        np.testing.assert_allclose(float(_np(g1)), math.cos(e) * e, rtol=1e-4)
+        np.testing.assert_allclose(
+            float(_np(g2)), -math.sin(e) * e * e + math.cos(e) * e, rtol=1e-4)
+
+    def test_matmul_double_grad(self):
+        # f = sum((x W)^2): dL/dW then d(||dL/dW||^2)/dx must match numeric
+        np.random.seed(0)
+        xv = np.random.randn(3, 4).astype("float32")
+        wv = np.random.randn(4, 2).astype("float32")
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        w = paddle.to_tensor(wv, stop_gradient=False)
+        y = (paddle.matmul(x, w) ** 2).sum()
+        (gw,) = paddle.grad(y, w, create_graph=True)
+        z = (gw ** 2).sum()
+        (gx,) = paddle.grad(z, x)
+
+        def z_of_x(xnp):
+            xw = xnp @ wv
+            gw_np = 2 * xnp.T @ xw     # d/dW sum((xW)^2)
+            return (gw_np ** 2).sum()
+
+        eps = 1e-3
+        num = np.zeros_like(xv)
+        for i in range(3):
+            for j in range(4):
+                xp = xv.copy(); xp[i, j] += eps
+                xm = xv.copy(); xm[i, j] -= eps
+                num[i, j] = (z_of_x(xp) - z_of_x(xm)) / (2 * eps)
+        np.testing.assert_allclose(_np(gx), num, rtol=2e-2, atol=2e-2)
+
+    def test_gradient_penalty_training_step(self):
+        paddle.seed(0)
+        lin = nn.Linear(4, 1)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"),
+                             stop_gradient=False)
+        (gx,) = paddle.grad(lin(x), x, create_graph=True)
+        penalty = ((gx ** 2).sum(axis=-1) - 1.0).pow(2).mean()
+        penalty.backward()
+        assert lin.weight.grad is not None
+        # analytic: penalty depends on W only; dP/dW = 2(||w||^2-1)*2w per col
+        wv = _np(lin.weight)[:, 0]
+        expected = 4 * (np.sum(wv ** 2) - 1) * wv
+        np.testing.assert_allclose(_np(lin.weight.grad)[:, 0], expected, rtol=1e-3)
+
+    def test_first_order_still_default(self):
+        x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        (g,) = paddle.grad(x * x, x)
+        assert g.stop_gradient  # no graph recorded without create_graph
+        np.testing.assert_allclose(float(_np(g)), 4.0)
+
+
+class TestJacobianHessian:
+    def test_jacobian_dense(self):
+        A = np.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], "float32")
+        x = paddle.to_tensor(np.asarray([0.5, -1.0], "float32"), stop_gradient=False)
+        y = paddle.matmul(paddle.to_tensor(A), x)
+        J = autograd.jacobian(y, x)
+        np.testing.assert_allclose(_np(J.tensor), A, rtol=1e-5)
+        assert tuple(J.shape) == (3, 2)
+        np.testing.assert_allclose(_np(J[0]), A[0], rtol=1e-5)
+
+    def test_jacobian_batch(self):
+        x = paddle.to_tensor(np.random.randn(4, 3).astype("float32"),
+                             stop_gradient=False)
+        y = x * x
+        J = autograd.jacobian(y, x, batch_axis=0)
+        assert tuple(J.shape) == (4, 3, 3)
+        for b in range(4):
+            np.testing.assert_allclose(_np(J[b]), np.diag(2 * _np(x)[b]), rtol=1e-5)
+
+    def test_hessian(self):
+        # f(x) = x^T A x  →  H = A + A^T
+        A = np.asarray([[2.0, 1.0], [0.5, 3.0]], "float32")
+        x = paddle.to_tensor(np.asarray([1.0, -2.0], "float32"), stop_gradient=False)
+        y = paddle.matmul(x, paddle.matmul(paddle.to_tensor(A), x))
+        H = autograd.hessian(y, x)
+        np.testing.assert_allclose(_np(H.tensor), A + A.T, rtol=1e-4)
+
+    def test_hessian_unused_input_zeros(self):
+        a = paddle.to_tensor(np.asarray([1.0, 2.0], "float32"), stop_gradient=False)
+        b = paddle.to_tensor(np.asarray([3.0], "float32"), stop_gradient=False)
+        y = (a * a).sum()
+        H = autograd.hessian(y, [a, b])
+        np.testing.assert_allclose(_np(H[0][0].tensor), 2 * np.eye(2), rtol=1e-5)
+        np.testing.assert_allclose(_np(H[1][1].tensor), np.zeros((1, 1)))
+        np.testing.assert_allclose(_np(H[0][1].tensor), np.zeros((2, 1)))
+
+    def test_pylayer_create_graph_first_order(self):
+        # non-replayable custom backward: create_graph must not crash; the
+        # first-order grads through the PyLayer are still correct
+        class Double(autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 2
+
+        x = paddle.to_tensor(np.asarray([1.0, 2.0], "float32"), stop_gradient=False)
+        y = (Double.apply(x) ** 2).sum()
+        (g1,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(_np(g1), 8 * _np(x))
+
+    def test_hessian_validates_scalar(self):
+        x = paddle.to_tensor(np.asarray([1.0, 2.0], "float32"), stop_gradient=False)
+        with pytest.raises(ValueError):
+            autograd.hessian(x * x, x)
+
+
+class TestDecomposition:
+    def test_registry(self):
+        from paddle_tpu import decomposition
+
+        assert decomposition.has_decomp("softmax_p")
+        assert decomposition.get_decomp_rule("nonexistent_op") is None
+
+    def test_decompose_program(self):
+        import paddle_tpu.static as static
+        from paddle_tpu import decomposition
+
+        main = static.Program()
+        start = static.Program()
+        with static.program_guard(main, start):
+            x = static.data("x", [2, 4], "float32")
+            y = paddle.nn.functional.softmax(x)
+        n_before = main.num_ops
+        assert any(i[0] == "softmax_p" for i in main._insts)
+        decomposed = decomposition.decompose(main)
+        assert not any(i[0] == "softmax_p" for i in decomposed._insts)
+        assert decomposed.num_ops > n_before  # expanded into primitives
+
+        exe = static.Executor()
+        xv = np.random.randn(2, 4).astype("float32")
+        (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+        (out,) = exe.run(decomposed, feed={"x": xv}, fetch_list=[y])
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_custom_rule(self):
+        from paddle_tpu import decomposition
+
+        @decomposition.register_decomp("__test_fake_op")
+        def rule(x):
+            return x
+
+        assert decomposition.has_decomp("__test_fake_op")

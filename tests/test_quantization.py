@@ -96,6 +96,15 @@ class TestQAT:
         assert isinstance(qmodel.fc2, Q.QuantedWrapper)
         assert isinstance(qmodel.fc1, nn.Linear)  # untouched
 
+    def test_layer_config_beats_name_config_after_deepcopy(self):
+        model = Net()
+        qcfg = Q.QuantConfig(activation=None, weight=None)
+        qcfg.add_name_config("fc2")  # broader, earlier, empty config
+        qcfg.add_layer_config(model.fc2, weight=Q.FakeQuanterWithAbsMaxObserver())
+        qmodel = Q.QAT(qcfg).quantize(model)  # deepcopy path
+        assert isinstance(qmodel.fc2, Q.QuantedWrapper)
+        assert qmodel.fc2.weight_quanter is not None
+
     def test_activation_only_weightless_layer(self):
         class ActNet(nn.Layer):
             def __init__(self):
