@@ -149,10 +149,14 @@ class GradNode:
 
 
 def record_op(prim_name, static, saved, in_tensors, out_arrays,
-              saved_tensors=None):
+              saved_tensors=None, force=False):
     """Create the GradNode for a primitive call; returns it (or None when
     nothing requires grad / grad is disabled). Mirrors the node-creation block
-    eager_gen.py emits into every *_ad_func (eager_gen.py:1132)."""
+    eager_gen.py emits into every *_ad_func (eager_gen.py:1132).
+
+    force=True records the node even when no INPUT requires grad — needed by
+    opaque-backward blocks (recompute/PyLayer) whose internal parameters
+    still need gradients (the reference PyLayer records unconditionally)."""
     if not grad_enabled():
         return None
     edges: List[Optional[Tuple[Any, int]]] = []
@@ -166,7 +170,7 @@ def record_op(prim_name, static, saved, in_tensors, out_arrays,
             edges.append((t._node, t._out_slot))
         else:
             edges.append((t._accum_node(), 0))
-    if not any_grad:
+    if not any_grad and not force:
         return None
     out_avals = [(tuple(o.shape), o.dtype) for o in out_arrays]
     return GradNode(prim_name, static, saved, out_avals, edges,

@@ -72,6 +72,11 @@ class PipelineParallel(Layer):
         m = len(micros)
         losses: List[Tensor] = []
 
+        mode = self.schedule_mode.upper().replace("-", "").replace("_", "")
+        if mode in ("VPP", "INTERLEAVED", "INTERLEAVED1F1B", "ZBH1",
+                    "ZEROBUBBLE"):
+            return self._run_task_schedule(micros, scaler, mode)
+
         if self.schedule_mode.upper() in ("FTHENB", "F-THEN-B"):
             # all forwards, then all backwards (reference FThenB pass)
             for micro in micros:
@@ -99,6 +104,102 @@ class PipelineParallel(Layer):
 
         total = add_n(losses)
         return scale(total.detach(), 1.0 / m)
+
+    def _run_task_schedule(self, micros, scaler, mode):
+        """Execute a generated schedule (VPP interleaved or ZBH1 zero-bubble)
+        in the simulator's global order. Chunk boundaries are detached
+        leaves, so each B computes only that chunk's activation grad; ZBH1
+        defers weight-grad accumulation to W tasks (reference
+        pipeline_zero_bubble.py B/W split)."""
+        from ....autograd import engine
+        from ....core.tensor import Tensor
+        from .pipeline_schedules import make_schedule, simulate
+
+        m = len(micros)
+        pp = self.num_stages
+        vpp = self._layers._num_virtual_stages
+        n_chunks = self._layers.num_chunks
+        zb = mode in ("ZBH1", "ZEROBUBBLE")
+        if zb and vpp > 1:
+            raise ValueError(
+                "ZBH1 does not compose with virtual pipeline stages; use "
+                "num_virtual_pipeline_stages=1 or schedule_mode='VPP'"
+            )
+        streams = {s: make_schedule(mode, s, pp, m, vpp) for s in range(pp)}
+        order = simulate(streams, pp, m, vpp)["order"]
+        chunk_params = {
+            c: self._layers.chunk_parameters(c) for c in range(n_chunks)
+        } if zb else {}
+
+        acts = {}      # (micro, chunk) -> (xin or None, out)
+        seeds = {}     # (micro, chunk) -> backward seed Tensor from chunk+1
+        pending_w = {}  # (micro, chunk) -> [(param, captured grad)] for W
+        losses: List[Optional[Tensor]] = [None] * m
+
+        for _stage, task in order:
+            key = (task.micro, task.chunk)
+            if task.kind == "F":
+                if task.chunk == 0:
+                    micro = micros[task.micro]
+                    x, xin = micro[0], None
+                else:
+                    prev_out = acts[(task.micro, task.chunk - 1)][1]
+                    xin = prev_out.detach()
+                    xin.stop_gradient = False
+                    x = xin
+                out = self._layers.forward_chunk(x, task.chunk)
+                if task.chunk == n_chunks - 1:
+                    micro = micros[task.micro]
+                    label = micro[-1] if len(micro) > 1 else None
+                    if self._layers._loss_fn is not None and label is not None:
+                        out = self._layers._loss_fn(out, label)
+                    from ....ops.math import scale as _scale
+
+                    out = _scale(out, 1.0 / m)
+                    if scaler is not None:
+                        out = scaler.scale(out)
+                    losses[task.micro] = out
+                acts[key] = (xin, out)
+            elif task.kind == "B":
+                xin, out = acts.pop(key)
+                seed = seeds.pop(key, None)
+                capture = {}
+                if xin is not None:
+                    capture[(id(xin._accum_node()), 0)] = "gin"
+                params = chunk_params.get(task.chunk, ())
+                if zb:
+                    # B computes everything once; weight grads are captured
+                    # here and merely ACCUMULATED at the W task (reference
+                    # ZBH1 B/W split without recompute)
+                    for pi, p in enumerate(params):
+                        capture[(id(p._accum_node()), 0)] = ("p", pi)
+                captured = engine.run_backward(
+                    [out],
+                    None if seed is None else [seed],
+                    retain_graph=False,
+                    capture=capture,
+                    accumulate_leaves=not zb,
+                )
+                if xin is not None:
+                    gin = captured.get("gin")
+                    if gin is not None:
+                        seeds[(task.micro, task.chunk - 1)] = Tensor._from_value(gin)
+                if zb:
+                    pending_w[key] = [
+                        (p, captured.get(("p", pi)))
+                        for pi, p in enumerate(params)
+                    ]
+            else:  # W: accumulate the weight grads captured by B
+                for p, g in pending_w.pop(key, ()):
+                    if g is not None:
+                        p._accum_node().accumulate(g)
+
+        from ....ops.math import add_n, scale
+
+        total = add_n([l for l in losses if l is not None])
+        if scaler is not None:
+            total = scale(total, 1.0 / scaler._scale)
+        return total.detach()
 
     def _backward_one(self, loss, m, scaler):
         from ....ops.math import scale as _scale

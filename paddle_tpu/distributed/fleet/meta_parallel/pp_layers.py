@@ -127,6 +127,16 @@ class PipelineLayer(Layer):
 
         seg = SegmentLayers(self._layers_desc, self._num_stages, seg_method)
         self.segment_parts = seg.do_segment()
+        # interleaved (VPP) chunking: num_stages * vpp chunks; chunk c lives
+        # on stage c % num_stages (Megatron convention, reference
+        # pipeline_parallel.py:1136 virtual pipeline)
+        n_chunks = self._num_stages * self._num_virtual_stages
+        if self._num_virtual_stages > 1:
+            self.chunk_parts = SegmentLayers(
+                self._layers_desc, n_chunks, seg_method
+            ).do_segment()
+        else:
+            self.chunk_parts = self.segment_parts
 
         # build all layers; shared descs build once per key
         self._shared: dict = {}
@@ -171,9 +181,30 @@ class PipelineLayer(Layer):
     def get_num_items(self) -> int:
         return len(self._layers_desc)
 
-    # --- execution -------------------------------------------------------
-    def forward_stage(self, x, stage: int):
-        for i in range(self.segment_parts[stage], self.segment_parts[stage + 1]):
+    @property
+    def num_chunks(self) -> int:
+        return self._num_stages * self._num_virtual_stages
+
+    def _run_range(self, x, lo: int, hi: int):
+        """Run layers [lo, hi) with shared-layer dispatch; honors
+        recompute_interval by wrapping sub-segments in recompute."""
+        if self._recompute_interval > 0:
+            from ..utils import recompute as _recompute
+
+            i = lo
+            while i < hi:
+                j = min(i + self._recompute_interval, hi)
+
+                def _seg(inp, lo=i, hi=j):
+                    return self._run_range_plain(inp, lo, hi)
+
+                x = _recompute(_seg, x)
+                i = j
+            return x
+        return self._run_range_plain(x, lo, hi)
+
+    def _run_range_plain(self, x, lo: int, hi: int):
+        for i in range(lo, hi):
             fn = self.run_function[i]
             if i in self._shared_forward:
                 built, fwd = self._shared_forward[i]
@@ -182,28 +213,28 @@ class PipelineLayer(Layer):
                 x = fn(x)
         return x
 
+    def forward_chunk(self, x, chunk: int):
+        """Run one virtual-pipeline chunk (VPP granularity)."""
+        return self._run_range(x, self.chunk_parts[chunk], self.chunk_parts[chunk + 1])
+
+    def chunk_parameters(self, chunk: int):
+        """Parameters owned by one chunk (for deferred weight-grad passes)."""
+        params = []
+        for i in range(self.chunk_parts[chunk], self.chunk_parts[chunk + 1]):
+            fn = self.run_function[i]
+            if isinstance(fn, Layer):
+                params.extend(fn.parameters())
+        return params
+
+    # --- execution -------------------------------------------------------
+    def forward_stage(self, x, stage: int):
+        return self._run_range_plain(
+            x, self.segment_parts[stage], self.segment_parts[stage + 1]
+        )
+
     def forward(self, x):
         if self._recompute_interval > 0:
-            from ..utils import recompute as _recompute
-
-            i, n = 0, len(self.run_function)
-            while i < n:
-                j = min(i + self._recompute_interval, n)
-                lo, hi = i, j
-
-                def _seg(inp, lo=lo, hi=hi):
-                    for idx in range(lo, hi):
-                        fn = self.run_function[idx]
-                        if idx in self._shared_forward:
-                            built, fwd = self._shared_forward[idx]
-                            inp = fwd(built, inp)
-                        else:
-                            inp = fn(inp)
-                    return inp
-
-                x = _recompute(_seg, x)
-                i = j
-            return x
+            return self._run_range(x, 0, len(self.run_function))
         for s in range(self._num_stages):
             x = self.forward_stage(x, s)
         return x

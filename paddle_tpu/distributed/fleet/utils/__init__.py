@@ -113,6 +113,9 @@ def recompute(function, *args, **kwargs):
         (function, args, kwargs, len(tensor_inputs), rng_snapshot),
         tensor_inputs,
         out_arrays,
+        # record even when no tensor INPUT requires grad: the block's
+        # internal parameters still need grads from the replay backward
+        force=True,
     )
     requires = node is not None
     wrapped = []

@@ -1,0 +1,189 @@
+"""Pipeline schedules: generator properties + runtime numerical equivalence
+(reference test model: test/auto_parallel/pipeline_scheduler_vpp_unittest.py,
+pipeline_scheduler_zb_unittest.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed.fleet.meta_parallel.pipeline_schedules import (
+    Task,
+    make_schedule,
+    simulate,
+    vpp_schedule,
+    zbh1_schedule,
+)
+
+
+class TestGenerators:
+    @pytest.mark.parametrize("mode", ["FThenB", "1F1B", "ZBH1"])
+    @pytest.mark.parametrize("pp,m", [(2, 4), (4, 8), (4, 4), (3, 6)])
+    def test_complete_and_deadlock_free(self, mode, pp, m):
+        streams = {s: make_schedule(mode, s, pp, m) for s in range(pp)}
+        stats = simulate(streams, pp, m)
+        # every micro forward+backward appears exactly once per stage
+        for s in range(pp):
+            fs = [t for t in streams[s] if t.kind == "F"]
+            bs = [t for t in streams[s] if t.kind == "B"]
+            assert sorted(t.micro for t in fs) == list(range(m))
+            assert sorted(t.micro for t in bs) == list(range(m))
+        assert stats["makespan"] > 0
+
+    @pytest.mark.parametrize("pp,m,vpp", [(2, 4, 2), (2, 2, 3), (4, 4, 2)])
+    def test_vpp_complete(self, pp, m, vpp):
+        streams = {s: vpp_schedule(s, pp, m, vpp) for s in range(pp)}
+        stats = simulate(streams, pp, m, vpp)
+        for s in range(pp):
+            fs = [(t.micro, t.chunk) for t in streams[s] if t.kind == "F"]
+            assert len(fs) == m * vpp and len(set(fs)) == m * vpp
+            # all chunks on stage s have chunk % pp == s
+            assert all(c % pp == s for _, c in fs)
+
+    def test_vpp_requires_divisibility(self):
+        with pytest.raises(ValueError):
+            vpp_schedule(0, 4, 6, 2)
+
+    def test_1f1b_less_memory_than_fthenb(self):
+        pp, m = 4, 8
+        fthenb = simulate({s: make_schedule("FThenB", s, pp, m) for s in range(pp)}, pp, m)
+        one = simulate({s: make_schedule("1F1B", s, pp, m) for s in range(pp)}, pp, m)
+        # FThenB holds all m activations; 1F1B bounds stage 0 at pp
+        assert fthenb["peak_activations"][0] == m
+        assert one["peak_activations"][0] <= pp
+        assert one["peak_activations"][0] < fthenb["peak_activations"][0]
+
+    def test_zbh1_fewer_bubbles_than_1f1b(self):
+        pp, m = 4, 8
+        one = simulate({s: make_schedule("1F1B", s, pp, m) for s in range(pp)}, pp, m)
+        zb = simulate({s: make_schedule("ZBH1", s, pp, m) for s in range(pp)}, pp, m)
+        assert zb["bubble_fraction"] < one["bubble_fraction"]
+        # W task per micro per stage
+        for s in range(pp):
+            ws = [t for t in make_schedule("ZBH1", s, pp, m) if t.kind == "W"]
+            assert sorted(t.micro for t in ws) == list(range(m))
+
+    def test_unknown_mode(self):
+        with pytest.raises(ValueError):
+            make_schedule("bogus", 0, 2, 4)
+
+    def test_deadlock_detection(self):
+        # backward before any forward deadlocks
+        bad = {0: [Task("B", 0, 0)], 1: [Task("F", 0, 1), Task("B", 0, 1)]}
+        with pytest.raises(RuntimeError):
+            simulate(bad, 2, 1)
+
+
+def _make_pipeline(mode, vpp=1, pp=2, seed=0):
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+        LayerDesc,
+        PipelineLayer,
+    )
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        PipelineParallel,
+    )
+
+    paddle.seed(seed)
+    descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(4)] + [
+        LayerDesc(nn.Linear, 8, 2)
+    ]
+
+    class Strategy:
+        pipeline_configs = {"accumulate_steps": 4, "schedule_mode": mode}
+
+    layers = PipelineLayer(
+        descs, num_stages=pp, loss_fn=nn.CrossEntropyLoss(),
+        num_virtual_pipeline_stages=vpp,
+    )
+    return PipelineParallel(layers, strategy=Strategy())
+
+
+class TestRuntimeEquivalence:
+    def _grads_and_loss(self, mode, vpp=1):
+        pipe = _make_pipeline(mode, vpp)
+        np.random.seed(0)
+        x = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.randint(0, 2, (8,)))
+        loss = pipe.forward_backward_pipeline([x, y])
+        grads = [np.asarray(p.grad._value) for p in pipe.parameters()
+                 if p.grad is not None]
+        return float(loss._value), grads
+
+    @pytest.mark.parametrize("mode,vpp", [("VPP", 2), ("ZBH1", 1)])
+    def test_matches_1f1b(self, mode, vpp):
+        ref_loss, ref_grads = self._grads_and_loss("1F1B")
+        loss, grads = self._grads_and_loss(mode, vpp)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+        assert len(grads) == len(ref_grads)
+        for g, r in zip(grads, ref_grads):
+            np.testing.assert_allclose(g, r, rtol=1e-4, atol=1e-5)
+
+    def test_zbh1_rejects_vpp(self):
+        pipe = _make_pipeline("ZBH1", vpp=2)
+        x = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.randint(0, 2, (8,)))
+        with pytest.raises(ValueError):
+            pipe.forward_backward_pipeline([x, y])
+
+    def test_vpp_with_recompute_matches(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+            LayerDesc,
+            PipelineLayer,
+        )
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+            PipelineParallel,
+        )
+
+        def build(recompute):
+            paddle.seed(3)
+            descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(4)] + [
+                LayerDesc(nn.Linear, 8, 2)
+            ]
+
+            class S:
+                pipeline_configs = {"accumulate_steps": 4, "schedule_mode": "VPP"}
+
+            pl = PipelineLayer(descs, num_stages=2, loss_fn=nn.CrossEntropyLoss(),
+                               num_virtual_pipeline_stages=2,
+                               recompute_interval=recompute)
+            return PipelineParallel(pl, strategy=S())
+
+        np.random.seed(3)
+        x = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.randint(0, 2, (8,)))
+        ref = build(0)
+        rec = build(1)
+        l0 = ref.forward_backward_pipeline([x, y])
+        l1 = rec.forward_backward_pipeline([x, y])
+        np.testing.assert_allclose(float(l1._value), float(l0._value), rtol=1e-5)
+        for p0, p1 in zip(ref.parameters(), rec.parameters()):
+            if p0.grad is not None:
+                np.testing.assert_allclose(
+                    np.asarray(p1.grad._value), np.asarray(p0.grad._value),
+                    rtol=1e-4, atol=1e-6)
+
+    def test_vpp_train_batch_converges(self):
+        pipe = _make_pipeline("VPP", vpp=2, seed=1)
+        optimizer = opt.Adam(learning_rate=0.05, parameters=pipe.parameters())
+        np.random.seed(1)
+        x = np.random.randn(16, 8).astype("float32")
+        y = (x.sum(-1) > 0).astype("int64")
+        losses = []
+        for _ in range(25):
+            loss = pipe.train_batch(
+                [paddle.to_tensor(x), paddle.to_tensor(y)], optimizer)
+            losses.append(float(loss._value))
+        assert losses[-1] < losses[0] * 0.7
+
+    def test_zbh1_train_batch_converges(self):
+        pipe = _make_pipeline("ZBH1", seed=2)
+        optimizer = opt.Adam(learning_rate=0.05, parameters=pipe.parameters())
+        np.random.seed(2)
+        x = np.random.randn(16, 8).astype("float32")
+        y = (x.sum(-1) > 0).astype("int64")
+        losses = []
+        for _ in range(25):
+            loss = pipe.train_batch(
+                [paddle.to_tensor(x), paddle.to_tensor(y)], optimizer)
+            losses.append(float(loss._value))
+        assert losses[-1] < losses[0] * 0.7
