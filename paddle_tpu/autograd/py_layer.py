@@ -88,6 +88,10 @@ class PyLayer(metaclass=_PyLayerMeta):
             (cls, ctx, len(tensor_inputs)),
             tensor_inputs,
             out_arrays,
+            # the reference PyLayer records unconditionally: a custom
+            # backward may have side effects (e.g. PS push_sparse) or feed
+            # internal parameters even when no INPUT requires grad
+            force=True,
         )
         requires = node is not None
         wrapped = []

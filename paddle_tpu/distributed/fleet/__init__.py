@@ -31,6 +31,8 @@ __all__ = [
     "distributed_optimizer", "get_hybrid_communicate_group",
     "HybridCommunicateGroup", "CommunicateTopology", "worker_index",
     "worker_num", "is_first_worker", "barrier_worker",
+    "is_server", "is_worker", "init_server", "run_server", "init_worker",
+    "stop_worker", "server_endpoints",
 ]
 
 
@@ -68,13 +70,36 @@ class DistributedStrategy:
         return f"DistributedStrategy(hybrid={self.hybrid_configs})"
 
 
-_fleet_state = {"initialized": False, "strategy": None}
+_fleet_state = {"initialized": False, "strategy": None, "role_maker": None,
+                "ps_server": None, "ps_client": None}
 
 
 def init(role_maker=None, is_collective: bool = False, strategy=None, log_level="INFO"):
     """fleet.init parity (fleet/fleet.py:166): build the hybrid topology
-    mesh from strategy.hybrid_configs."""
+    mesh from strategy.hybrid_configs. With a PS role_maker (or
+    PADDLE_TRAINING_ROLE set) the process joins parameter-server mode
+    instead (fleet/fleet.py:892-936 init_server/init_worker flow)."""
+    import os
+
     from .. import env
+
+    # auto-detect PS mode only on an unambiguous signal: an explicit PSERVER
+    # role or configured server endpoints. (The reference launcher exports
+    # PADDLE_TRAINING_ROLE=TRAINER for collective jobs too, so its mere
+    # presence must not reroute a collective init.)
+    ps_env = (
+        os.environ.get("PADDLE_TRAINING_ROLE", "").upper() == "PSERVER"
+        or bool(os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST"))
+    )
+    if role_maker is None and ps_env and not is_collective:
+        from ..ps.role import PaddleCloudRoleMaker
+
+        role_maker = PaddleCloudRoleMaker()
+    if role_maker is not None and not is_collective:
+        _fleet_state["initialized"] = True
+        _fleet_state["strategy"] = strategy or DistributedStrategy()
+        _fleet_state["role_maker"] = role_maker
+        return None
 
     env.init_parallel_env()
     strategy = strategy or DistributedStrategy()
@@ -132,6 +157,86 @@ def distributed_optimizer(optimizer, strategy=None):
     return optimizer
 
 
+# ---------------------------------------------------------------------------
+# Parameter-server mode (reference fleet.fleet: is_server :~, init_server
+# :892, run_server :908, init_worker :920, stop_worker :936)
+# ---------------------------------------------------------------------------
+def _role():
+    return _fleet_state.get("role_maker")
+
+
+def is_server():
+    rm = _role()
+    return rm is not None and rm._is_server()
+
+
+def is_worker():
+    rm = _role()
+    return rm is None or rm._is_worker()
+
+
+def server_endpoints():
+    rm = _role()
+    return rm._get_pserver_endpoints() if rm is not None else []
+
+
+def init_server(*args, **kwargs):
+    """Create this process's table server bound to its endpoint from the
+    launcher env (reference fleet.init_server)."""
+    from ..ps.server import PsServer
+
+    rm = _role()
+    if rm is None or not rm._is_server():
+        raise RuntimeError("init_server called on a non-server role")
+    host, port = rm._cur_endpoint.rsplit(":", 1)
+    srv = PsServer(host=host, port=int(port), num_trainers=rm._worker_num())
+    _fleet_state["ps_server"] = srv
+    return srv
+
+
+def run_server():
+    """Serve until stop_worker tells us to quit (reference fleet.run_server
+    blocks the server process)."""
+    srv = _fleet_state.get("ps_server")
+    if srv is None:
+        srv = init_server()
+    srv.start()
+    srv.join()
+
+
+def init_worker(*args, **kwargs):
+    """Connect this trainer to all table servers (reference
+    fleet.init_worker)."""
+    from ..ps.client import PsClient
+
+    rm = _role()
+    if rm is None:
+        raise RuntimeError("init_worker requires fleet.init(role_maker=...)")
+    client = PsClient(rm._get_pserver_endpoints())
+    _fleet_state["ps_client"] = client
+    return client
+
+
+def ps_client():
+    return _fleet_state.get("ps_client")
+
+
+def stop_worker():
+    """Disconnect after all workers arrive; worker 0 then shuts the servers
+    down — the barrier guarantees no peer is mid-step when STOP lands
+    (reference fleet.stop_worker semantics)."""
+    client = _fleet_state.pop("ps_client", None)
+    if client is not None:
+        rm = _role()
+        try:
+            if rm is not None and rm._worker_num() > 1:
+                client.barrier()
+            if rm is None or rm._worker_index() == 0:
+                client.stop_servers()
+        finally:
+            client.close()
+
+
 def worker_index():
     from .. import env
 
@@ -154,11 +259,23 @@ def barrier_worker():
     env.barrier()
 
 
-class UserDefinedRoleMaker:
-    def __init__(self, *a, **k):
-        pass
+# the real role maker lives with the PS implementation; re-exported here so
+# the canonical `fleet.init(fleet.PaddleCloudRoleMaker())` flow works
+from ..ps.role import PaddleCloudRoleMaker  # noqa: E402
 
 
-class PaddleCloudRoleMaker:
-    def __init__(self, *a, **k):
-        pass
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit role specification (reference role_maker.py
+    UserDefinedRoleMaker): overrides the env-derived fields."""
+
+    def __init__(self, is_collective=False, current_id=0, role=None,
+                 worker_num=1, server_endpoints=(), **kwargs):
+        super().__init__(is_collective=is_collective)
+        from ..ps.role import Role
+
+        if role is not None:
+            self._role = role
+        self._trainer_id = int(current_id)
+        self._trainers_num = int(worker_num)
+        if server_endpoints:
+            self._server_endpoints = list(server_endpoints)

@@ -125,11 +125,18 @@ class PipelineParallel(Layer):
                 "ZBH1 does not compose with virtual pipeline stages; use "
                 "num_virtual_pipeline_stages=1 or schedule_mode='VPP'"
             )
-        streams = {s: make_schedule(mode, s, pp, m, vpp) for s in range(pp)}
-        order = simulate(streams, pp, m, vpp)["order"]
-        chunk_params = {
-            c: self._layers.chunk_parameters(c) for c in range(n_chunks)
-        } if zb else {}
+        # order depends only on (mode, pp, m, vpp) — fixed for a run; cache
+        # it (and the chunk→params map) off the per-step hot path
+        cache_key = (mode, pp, m, vpp)
+        cached = getattr(self, "_sched_cache", None)
+        if cached is None or cached[0] != cache_key:
+            streams = {s: make_schedule(mode, s, pp, m, vpp) for s in range(pp)}
+            order = simulate(streams, pp, m, vpp)["order"]
+            chunk_params = {
+                c: self._layers.chunk_parameters(c) for c in range(n_chunks)
+            } if zb else {}
+            self._sched_cache = (cache_key, order, chunk_params)
+        _, order, chunk_params = self._sched_cache
 
         acts = {}      # (micro, chunk) -> (xin or None, out)
         seeds = {}     # (micro, chunk) -> backward seed Tensor from chunk+1

@@ -135,7 +135,6 @@ def simulate(streams: Dict[int, List[Task]], num_stages: int, num_micro: int,
     num_chunks = num_stages * vpp
     done = set()          # ("F"|"B"|"W", micro, chunk) completed
     pos = {s: 0 for s in streams}
-    finish_time = {}
     order = []
     live = {s: 0 for s in streams}      # activations held per stage
     peak = {s: 0 for s in streams}
@@ -173,7 +172,6 @@ def simulate(streams: Dict[int, List[Task]], num_stages: int, num_micro: int,
                 progressed = True
         for s, task in completed_now:
             done.add((task.kind, task.micro, task.chunk))
-            finish_time[(task.kind, task.micro, task.chunk)] = t
             pos[s] += 1
         if not progressed:
             stuck = {s: streams[s][pos[s]] for s in streams if pos[s] < len(streams[s])}

@@ -1,0 +1,18 @@
+"""Parameter-server mode (reference: paddle/fluid/distributed/ps/ C++ brpc
+PS + python/paddle/distributed/ps/the_one_ps.py orchestration).
+
+TPU-native re-design: brpc tables become a threaded TCP table server with a
+length-prefixed binary protocol; dense/sparse tables apply server-side
+optimizers (sgd/adagrad/adam/sum); workers exchange gradients via PsClient.
+Roles come from the same env contract as the reference launcher
+(PADDLE_TRAINING_ROLE, PADDLE_PSERVERS_IP_PORT_LIST, PADDLE_TRAINER_ID)."""
+from .client import PsClient
+from .role import PaddleCloudRoleMaker, Role
+from .server import PsServer
+from .table import DenseTable, SparseTable
+from .worker import DistributedEmbedding, PsOptimizer
+
+__all__ = [
+    "PsServer", "PsClient", "DenseTable", "SparseTable",
+    "DistributedEmbedding", "PsOptimizer", "PaddleCloudRoleMaker", "Role",
+]

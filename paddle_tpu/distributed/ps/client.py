@@ -1,0 +1,108 @@
+"""Parameter-server client (reference: ps/service/brpc_ps_client.h).
+
+Sparse ids shard across servers by ``id % num_servers``; dense tables hash
+by table id. One socket per server per client, guarded by a lock (the
+reference multiplexes brpc channels the same way)."""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import List, Sequence
+
+import numpy as np
+
+from . import rpc
+
+_OPT_IDS = {"sgd": 0, "adagrad": 1, "adam": 2, "sum": 3}
+
+
+class PsClient:
+    def __init__(self, endpoints: Sequence[str]):
+        self.endpoints = list(endpoints)
+        self._socks: List[socket.socket] = []
+        self._locks: List[threading.Lock] = []
+        for ep in self.endpoints:
+            host, port = ep.rsplit(":", 1)
+            self._socks.append(socket.create_connection((host, int(port))))
+            self._locks.append(threading.Lock())
+
+    def close(self):
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _call(self, server: int, cmd: int, table_id: int, arrays=()):
+        with self._locks[server]:
+            return rpc.send_request(self._socks[server], cmd, table_id, arrays)
+
+    # -- dense ----------------------------------------------------------
+    def _dense_server(self, table_id: int) -> int:
+        return table_id % len(self._socks)
+
+    def init_dense(self, table_id: int, init: np.ndarray, lr=0.01,
+                   optimizer="sgd", sync=False):
+        cfg = np.asarray([lr, _OPT_IDS[optimizer], 1.0 if sync else 0.0], "float64")
+        self._call(self._dense_server(table_id), rpc.INIT_DENSE, table_id,
+                   [np.asarray(init, "float32"), cfg])
+
+    def pull_dense(self, table_id: int) -> np.ndarray:
+        return self._call(self._dense_server(table_id), rpc.PULL_DENSE, table_id)[0]
+
+    def push_dense(self, table_id: int, grad: np.ndarray):
+        self._call(self._dense_server(table_id), rpc.PUSH_DENSE, table_id,
+                   [np.asarray(grad, "float32")])
+
+    # -- sparse ---------------------------------------------------------
+    def init_sparse(self, table_id: int, emb_dim: int, lr=0.01, optimizer="sgd",
+                    init_range=0.01, seed=0):
+        cfg = np.asarray(
+            [lr, _OPT_IDS[optimizer], emb_dim, init_range, seed], "float64"
+        )
+        for s in range(len(self._socks)):
+            self._call(s, rpc.INIT_SPARSE, table_id, [cfg])
+
+    def pull_sparse(self, table_id: int, keys: np.ndarray) -> np.ndarray:
+        """Pull rows for possibly-duplicated ids, preserving order."""
+        keys = np.asarray(keys, "int64").reshape(-1)
+        n_srv = len(self._socks)
+        out = None
+        for s in range(n_srv):
+            mask = (keys % n_srv) == s
+            if not mask.any():
+                continue
+            rows = self._call(s, rpc.PULL_SPARSE, table_id, [keys[mask]])[0]
+            if out is None:
+                out = np.zeros((len(keys), rows.shape[-1]), "float32")
+            out[mask] = rows
+        if out is None:
+            raise ValueError("pull_sparse with empty key list")
+        return out
+
+    def push_sparse(self, table_id: int, keys: np.ndarray, grads: np.ndarray):
+        keys = np.asarray(keys, "int64").reshape(-1)
+        grads = np.asarray(grads, "float32").reshape(len(keys), -1)
+        n_srv = len(self._socks)
+        for s in range(n_srv):
+            mask = (keys % n_srv) == s
+            if mask.any():
+                self._call(s, rpc.PUSH_SPARSE, table_id, [keys[mask], grads[mask]])
+
+    # -- control --------------------------------------------------------
+    def barrier(self):
+        for s in range(len(self._socks)):
+            self._call(s, rpc.BARRIER, 0)
+
+    def num_sparse_rows(self, table_id: int) -> int:
+        n_srv = len(self._socks)
+        return sum(
+            int(self._call(s, rpc.NUM_ROWS, table_id)[0][0]) for s in range(n_srv)
+        )
+
+    def stop_servers(self):
+        for s in range(len(self._socks)):
+            try:
+                self._call(s, rpc.STOP, 0)
+            except (RuntimeError, ConnectionError, OSError):
+                pass

@@ -1,0 +1,51 @@
+"""PS role resolution (reference: fleet/base/role_maker.py
+PaddleCloudRoleMaker — roles from the launcher's env contract)."""
+from __future__ import annotations
+
+import enum
+import os
+
+
+class Role(enum.Enum):
+    WORKER = 1
+    SERVER = 2
+
+
+class PaddleCloudRoleMaker:
+    """Reads the reference env contract:
+    PADDLE_TRAINING_ROLE=TRAINER|PSERVER, PADDLE_PSERVERS_IP_PORT_LIST,
+    PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID, POD_IP, PADDLE_PORT."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+        role = os.environ.get("PADDLE_TRAINING_ROLE", "TRAINER").upper()
+        self._role = Role.SERVER if role == "PSERVER" else Role.WORKER
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = [e for e in eps.split(",") if e]
+        self._trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._cur_endpoint = (
+            f"{os.environ.get('POD_IP', '127.0.0.1')}:"
+            f"{os.environ.get('PADDLE_PORT', '0')}"
+        )
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    def _worker_index(self):
+        return self._trainer_id
+
+    def _worker_num(self):
+        return self._trainers_num
+
+    def _get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+    def _server_index(self):
+        try:
+            return self._server_endpoints.index(self._cur_endpoint)
+        except ValueError:
+            return 0

@@ -1,0 +1,99 @@
+"""Wire protocol for the parameter server.
+
+Reference: the brpc transport (ps/service/brpc_ps_client.h) — replaced by a
+length-prefixed binary protocol over TCP sockets: one request =
+``u8 cmd | u16 table_id | u32 n_arrays | per-array (u8 dtype, u8 ndim,
+u32*ndim shape, raw bytes)``. Responses reuse the array framing. numpy
+buffers go over the wire zero-copy (tobytes/frombuffer)."""
+from __future__ import annotations
+
+import socket
+import struct
+from typing import List, Sequence
+
+import numpy as np
+
+# commands
+PULL_DENSE = 1
+PUSH_DENSE = 2
+PULL_SPARSE = 3
+PUSH_SPARSE = 4
+INIT_DENSE = 5
+INIT_SPARSE = 6
+BARRIER = 7
+STOP = 8
+NUM_ROWS = 9
+EXPORT_SPARSE = 10
+OK = 200
+ERROR = 255
+
+_DTYPES = {0: "float32", 1: "int64", 2: "float64", 3: "int32"}
+_DTYPE_IDS = {v: k for k, v in _DTYPES.items()}
+
+
+def _send_all(sock: socket.socket, data: bytes):
+    sock.sendall(data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def pack_arrays(arrays: Sequence[np.ndarray]) -> bytes:
+    parts = [struct.pack("<I", len(arrays))]
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        dt = _DTYPE_IDS[str(a.dtype)]
+        parts.append(struct.pack("<BB", dt, a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}I", *a.shape))
+        raw = a.tobytes()
+        parts.append(struct.pack("<Q", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def unpack_arrays(sock: socket.socket) -> List[np.ndarray]:
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    out = []
+    for _ in range(n):
+        dt, ndim = struct.unpack("<BB", _recv_exact(sock, 2))
+        shape = struct.unpack(f"<{ndim}I", _recv_exact(sock, 4 * ndim))
+        (nbytes,) = struct.unpack("<Q", _recv_exact(sock, 8))
+        raw = _recv_exact(sock, nbytes)
+        out.append(np.frombuffer(raw, dtype=_DTYPES[dt]).reshape(shape).copy())
+    return out
+
+
+def send_request(sock: socket.socket, cmd: int, table_id: int,
+                 arrays: Sequence[np.ndarray] = ()) -> List[np.ndarray]:
+    _send_all(sock, struct.pack("<BH", cmd, table_id) + pack_arrays(arrays))
+    (status,) = struct.unpack("<B", _recv_exact(sock, 1))
+    if status == ERROR:
+        (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+        msg = _recv_exact(sock, n).decode("utf-8", "replace")
+        raise RuntimeError(f"ps server error: {msg}")
+    if status != OK:
+        raise RuntimeError(f"ps server returned unknown status {status}")
+    return unpack_arrays(sock)
+
+
+def recv_request(sock: socket.socket):
+    header = _recv_exact(sock, 3)
+    cmd, table_id = struct.unpack("<BH", header)
+    arrays = unpack_arrays(sock)
+    return cmd, table_id, arrays
+
+
+def send_response(sock: socket.socket, arrays: Sequence[np.ndarray] = ()):
+    _send_all(sock, struct.pack("<B", OK) + pack_arrays(arrays))
+
+
+def send_error(sock: socket.socket, message: str):
+    raw = message.encode("utf-8")
+    _send_all(sock, struct.pack("<B", ERROR) + struct.pack("<I", len(raw)) + raw)

@@ -1,0 +1,170 @@
+"""Parameter server process (reference: ps/service/brpc_ps_server.h +
+server.cc — a table host serving pull/push RPCs; here a threaded TCP server
+over the rpc.py framing)."""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict
+
+import numpy as np
+
+from . import rpc
+from .table import DenseTable, SparseTable, _Optimizer
+
+
+class PsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 num_trainers: int = 1, sync: bool = False):
+        self.dense: Dict[int, DenseTable] = {}
+        self.sparse: Dict[int, SparseTable] = {}
+        self.num_trainers = num_trainers
+        self.sync = sync
+        self._barrier_lock = threading.Lock()
+        self._barrier_count = 0
+        self._barrier_round = 0
+        self._barrier_cv = threading.Condition(self._barrier_lock)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._threads = []
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self):
+        """Serve in a background accept loop (fleet.run_server blocks on
+        join() instead)."""
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._accept_thread = t
+        return self
+
+    def join(self):
+        self._accept_thread.join()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            # unblock accept()
+            poke = socket.create_connection((self.host, self.port), timeout=1)
+            poke.close()
+        except OSError:
+            pass
+        self._sock.close()
+
+    # ------------------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            if self._stop.is_set():
+                conn.close()
+                break
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                try:
+                    cmd, tid, arrays = rpc.recv_request(conn)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    if self._dispatch(conn, cmd, tid, arrays):
+                        return
+                except (ConnectionError, OSError):
+                    return
+                except Exception as e:  # noqa: BLE001 — surfaced to client
+                    # report instead of killing the connection: the client
+                    # raises with the real cause and can keep using it
+                    try:
+                        rpc.send_error(conn, f"{type(e).__name__}: {e}")
+                    except OSError:
+                        return
+        finally:
+            conn.close()
+
+    def _dense_table(self, tid) -> DenseTable:
+        t = self.dense.get(tid)
+        if t is None:
+            raise KeyError(f"dense table {tid} not initialized (init_dense first)")
+        return t
+
+    def _sparse_table(self, tid) -> SparseTable:
+        t = self.sparse.get(tid)
+        if t is None:
+            raise KeyError(f"sparse table {tid} not initialized (init_sparse first)")
+        return t
+
+    def _dispatch(self, conn, cmd, tid, arrays) -> bool:
+        """Handle one request; True means the server is stopping."""
+        if cmd == rpc.INIT_DENSE:
+            # arrays: [init_values, config(lr, opt_kind_id, sync)]
+            init, cfg = arrays
+            kind = ["sgd", "adagrad", "adam", "sum"][int(cfg[1])]
+            if tid not in self.dense:
+                self.dense[tid] = DenseTable(
+                    init.shape,
+                    _Optimizer(kind, lr=float(cfg[0])),
+                    init=init,
+                    num_trainers=self.num_trainers,
+                    sync=bool(int(cfg[2])),
+                )
+            rpc.send_response(conn)
+        elif cmd == rpc.INIT_SPARSE:
+            cfg = arrays[0]
+            kind = ["sgd", "adagrad", "adam", "sum"][int(cfg[1])]
+            if tid not in self.sparse:
+                self.sparse[tid] = SparseTable(
+                    int(cfg[2]), _Optimizer(kind, lr=float(cfg[0])),
+                    init_range=float(cfg[3]), seed=int(cfg[4]),
+                )
+            rpc.send_response(conn)
+        elif cmd == rpc.PULL_DENSE:
+            rpc.send_response(conn, [self._dense_table(tid).pull()])
+        elif cmd == rpc.PUSH_DENSE:
+            self._dense_table(tid).push(arrays[0])
+            rpc.send_response(conn)
+        elif cmd == rpc.PULL_SPARSE:
+            rpc.send_response(conn, [self._sparse_table(tid).pull(arrays[0])])
+        elif cmd == rpc.PUSH_SPARSE:
+            self._sparse_table(tid).push(arrays[0], arrays[1])
+            rpc.send_response(conn)
+        elif cmd == rpc.NUM_ROWS:
+            rpc.send_response(
+                conn, [np.asarray([self._sparse_table(tid).num_rows()], "int64")]
+            )
+        elif cmd == rpc.EXPORT_SPARSE:
+            keys, vals = self._sparse_table(tid).export_rows()
+            rpc.send_response(conn, [keys, vals])
+        elif cmd == rpc.BARRIER:
+            self._barrier(conn)
+        elif cmd == rpc.STOP:
+            rpc.send_response(conn)
+            self.stop()
+            return True
+        else:
+            raise RuntimeError(f"unknown ps command {cmd}")
+        return False
+
+    def _barrier(self, conn):
+        with self._barrier_cv:
+            self._barrier_count += 1
+            r = self._barrier_round
+            if self._barrier_count >= self.num_trainers:
+                self._barrier_count = 0
+                self._barrier_round += 1
+                self._barrier_cv.notify_all()
+            else:
+                while self._barrier_round == r and not self._stop.is_set():
+                    self._barrier_cv.wait(timeout=30.0)
+        rpc.send_response(conn)

@@ -1,0 +1,161 @@
+"""Parameter-server tables.
+
+Reference: paddle/fluid/distributed/ps/table/ (dense/sparse tables with
+server-side optimizers, memory_sparse_table.cc lazy row creation).
+
+Server-side state lives in numpy (vectorized C kernels); the sparse table
+creates rows lazily on first access with the configured initializer, and
+both tables apply the configured optimizer server-side so workers exchange
+gradients, not parameters."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class _Optimizer:
+    """Server-side update rule (reference: ps/table/sparse_sgd_rule.cc)."""
+
+    def __init__(self, kind: str = "sgd", lr: float = 0.01, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8):
+        if kind not in ("sgd", "adagrad", "adam", "sum"):
+            raise ValueError(f"unknown ps optimizer: {kind}")
+        self.kind = kind
+        self.lr = lr
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+
+    def make_state(self, shape):
+        if self.kind == "adagrad":
+            return {"g2": np.zeros(shape, "float32")}
+        if self.kind == "adam":
+            return {"m": np.zeros(shape, "float32"),
+                    "v": np.zeros(shape, "float32"), "t": np.zeros((), "int64")}
+        return {}
+
+    def apply(self, param, grad, state):
+        if self.kind == "sum":
+            param += grad
+        elif self.kind == "sgd":
+            param -= self.lr * grad
+        elif self.kind == "adagrad":
+            state["g2"] += grad * grad
+            param -= self.lr * grad / (np.sqrt(state["g2"]) + self.eps)
+        else:  # adam
+            state["t"] += 1
+            t = int(state["t"])
+            state["m"] = self.beta1 * state["m"] + (1 - self.beta1) * grad
+            state["v"] = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
+            mhat = state["m"] / (1 - self.beta1**t)
+            vhat = state["v"] / (1 - self.beta2**t)
+            param -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+        return param
+
+
+class DenseTable:
+    """Contiguous parameter block (reference: ps/table/common_dense_table)."""
+
+    def __init__(self, shape, optimizer: Optional[_Optimizer] = None,
+                 init: Optional[np.ndarray] = None, num_trainers: int = 1,
+                 sync: bool = False):
+        self.param = (np.array(init, "float32") if init is not None
+                      else np.zeros(shape, "float32"))
+        self.opt = optimizer or _Optimizer()
+        self.state = self.opt.make_state(self.param.shape)
+        self.lock = threading.Lock()
+        self.sync = sync
+        self.num_trainers = num_trainers
+        self._pending = None
+        self._pending_count = 0
+        self._applied = threading.Condition(self.lock)
+        self._round = 0
+
+    def pull(self) -> np.ndarray:
+        with self.lock:
+            return self.param.copy()
+
+    def push(self, grad: np.ndarray):
+        """async: apply immediately. sync: accumulate until every trainer
+        contributed, then apply the averaged gradient once (reference
+        sync-mode dense push semantics)."""
+        with self.lock:
+            if not self.sync:
+                self.opt.apply(self.param, grad, self.state)
+                return
+            if self._pending is None:
+                self._pending = grad.astype("float32").copy()
+            else:
+                self._pending += grad
+            self._pending_count += 1
+            if self._pending_count >= self.num_trainers:
+                self.opt.apply(
+                    self.param, self._pending / self.num_trainers, self.state
+                )
+                self._pending = None
+                self._pending_count = 0
+                self._round += 1
+                self._applied.notify_all()
+            else:
+                import time
+
+                r = self._round
+                deadline = time.monotonic() + 120.0
+                while self._round == r:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            "sync dense push timed out waiting for peer "
+                            "trainers (a trainer likely died mid-step)"
+                        )
+                    self._applied.wait(timeout=5.0)
+
+
+class SparseTable:
+    """Lazy-row embedding table (reference: ps/table/memory_sparse_table.cc):
+    rows materialize on first pull with the configured initializer."""
+
+    def __init__(self, emb_dim: int, optimizer: Optional[_Optimizer] = None,
+                 init_range: float = 0.01, seed: int = 0):
+        self.emb_dim = int(emb_dim)
+        self.opt = optimizer or _Optimizer()
+        self.rows: Dict[int, np.ndarray] = {}
+        self.states: Dict[int, dict] = {}
+        self.rng = np.random.default_rng(seed)
+        self.init_range = init_range
+        self.lock = threading.Lock()
+
+    def _row(self, key: int) -> np.ndarray:
+        row = self.rows.get(key)
+        if row is None:
+            row = self.rng.uniform(
+                -self.init_range, self.init_range, self.emb_dim
+            ).astype("float32")
+            self.rows[key] = row
+            self.states[key] = self.opt.make_state((self.emb_dim,))
+        return row
+
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        with self.lock:
+            return np.stack([self._row(int(k)) for k in keys])
+
+    def push(self, keys: np.ndarray, grads: np.ndarray):
+        with self.lock:
+            # duplicate ids in one batch: sum their gradients first
+            order = np.argsort(keys, kind="stable")
+            uniq, starts = np.unique(keys[order], return_index=True)
+            summed = np.add.reduceat(grads[order], starts, axis=0)
+            for k, g in zip(uniq, summed):
+                row = self._row(int(k))
+                self.opt.apply(row, g, self.states[int(k)])
+
+    def num_rows(self) -> int:
+        with self.lock:
+            return len(self.rows)
+
+    def export_rows(self):
+        with self.lock:
+            keys = np.asarray(sorted(self.rows), "int64")
+            vals = np.stack([self.rows[int(k)] for k in keys]) if len(keys) else (
+                np.zeros((0, self.emb_dim), "float32")
+            )
+            return keys, vals

@@ -1,0 +1,118 @@
+"""Worker-side PS integration.
+
+Reference: the PS worker path — sparse_embedding lookups through the PS
+(python/paddle/static/nn sparse_embedding + distributed_ops) and the
+DistributedStrategy a_sync / sync training loop (fleet/fleet.py:892-936,
+distributed/ps/the_one_ps.py).
+
+DistributedEmbedding pulls rows for each batch from the PS and pushes row
+gradients in its custom backward; PsOptimizer pushes dense gradients and
+pulls fresh parameters each step (async: immediately applied server-side;
+sync: server waits for all trainers; geo: local steps with periodic delta
+pushes)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...autograd import PyLayer
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from .client import PsClient
+
+
+class _PsEmbeddingFn(PyLayer):
+    @staticmethod
+    def forward(ctx, ids, client, table_id, emb_dim):
+        ids_np = np.asarray(ids._value, "int64")
+        rows = client.pull_sparse(table_id, ids_np.reshape(-1))
+        ctx.save = (client, table_id, ids_np, rows.shape[-1])
+        out = rows.reshape(ids_np.shape + (emb_dim,))
+        return Tensor._from_value(np.asarray(out, "float32"))
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        client, table_id, ids_np, emb_dim = ctx.save
+        g = np.asarray(grad_out._value, "float32").reshape(-1, emb_dim)
+        client.push_sparse(table_id, ids_np.reshape(-1), g)
+        return None  # ids take no gradient
+
+
+class DistributedEmbedding(Layer):
+    """Embedding whose table lives on the parameter servers."""
+
+    def __init__(self, client: PsClient, table_id: int, emb_dim: int,
+                 lr: float = 0.01, optimizer: str = "sgd",
+                 init_range: float = 0.01, seed: int = 0):
+        super().__init__()
+        self.client = client
+        self.table_id = int(table_id)
+        self.emb_dim = int(emb_dim)
+        client.init_sparse(self.table_id, emb_dim, lr=lr, optimizer=optimizer,
+                           init_range=init_range, seed=seed)
+
+    def forward(self, ids):
+        return _PsEmbeddingFn.apply(ids, self.client, self.table_id, self.emb_dim)
+
+
+class PsOptimizer:
+    """Dense-parameter training against the PS (reference
+    fleet.distributed_optimizer in PS mode).
+
+    mode: "async" (push grad → server applies immediately → pull),
+          "sync"  (server averages one grad per trainer per step),
+          "geo"   (local optimizer steps; every ``geo_k`` steps push the
+                   accumulated parameter delta with a "sum" server rule —
+                   geo-SGD, reference ps/README + geo mode strategy).
+    """
+
+    def __init__(self, parameters, client: PsClient, lr=0.01, mode="async",
+                 table_id_base=0, geo_k=4, local_optimizer=None):
+        if mode not in ("async", "sync", "geo"):
+            raise ValueError(f"unknown ps mode {mode}")
+        self.params = list(parameters)
+        self.client = client
+        self.mode = mode
+        self.geo_k = int(geo_k)
+        self._step_count = 0
+        self._local_opt = local_optimizer
+        self.tables = {}
+        self._geo_anchors = {}
+        for i, p in enumerate(self.params):
+            tid = table_id_base + i
+            self.tables[id(p)] = tid
+            init = np.asarray(p._value, "float32")
+            client.init_dense(
+                tid, init, lr=lr,
+                optimizer="sum" if mode == "geo" else "sgd",
+                sync=(mode == "sync"),
+            )
+            if mode == "geo":
+                self._geo_anchors[id(p)] = init.copy()
+
+    def step(self):
+        self._step_count += 1
+        if self.mode == "geo":
+            # local update, periodic delta exchange
+            self._local_opt.step()
+            if self._step_count % self.geo_k == 0:
+                for p in self.params:
+                    tid = self.tables[id(p)]
+                    cur = np.asarray(p._value, "float32")
+                    delta = cur - self._geo_anchors[id(p)]
+                    self.client.push_dense(tid, delta)
+                    fresh = self.client.pull_dense(tid)
+                    p._replace_value(fresh)
+                    self._geo_anchors[id(p)] = fresh.copy()
+            return
+        for p in self.params:
+            if p.grad is None:
+                continue
+            tid = self.tables[id(p)]
+            self.client.push_dense(tid, np.asarray(p.grad._value, "float32"))
+            p._replace_value(self.client.pull_dense(tid))
+
+    def clear_grad(self):
+        for p in self.params:
+            p.clear_grad()
+        if self._local_opt is not None:
+            self._local_opt.clear_grad()

@@ -1,0 +1,216 @@
+"""Parameter server: tables, wire protocol, embedding, sync/async/geo
+training (reference test model: test/ps/, test_dist_fleet_ps*.py — real
+transport over localhost; here servers run as in-process threads)."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed.ps import (
+    DistributedEmbedding,
+    PsClient,
+    PsOptimizer,
+    PsServer,
+)
+
+
+@pytest.fixture
+def servers():
+    srvs = [PsServer(num_trainers=1).start() for _ in range(2)]
+    yield srvs
+    for s in srvs:
+        s.stop()
+
+
+@pytest.fixture
+def client(servers):
+    c = PsClient([s.endpoint for s in servers])
+    yield c
+    c.close()
+
+
+class TestDenseTable:
+    def test_pull_push_sgd(self, client):
+        init = np.arange(6, dtype="float32").reshape(2, 3)
+        client.init_dense(0, init, lr=0.1, optimizer="sgd")
+        np.testing.assert_allclose(client.pull_dense(0), init)
+        grad = np.ones((2, 3), "float32")
+        client.push_dense(0, grad)
+        np.testing.assert_allclose(client.pull_dense(0), init - 0.1)
+
+    def test_adam_rule(self, client):
+        client.init_dense(1, np.zeros(4, "float32"), lr=0.1, optimizer="adam")
+        for _ in range(3):
+            client.push_dense(1, np.ones(4, "float32"))
+        out = client.pull_dense(1)
+        assert (out < 0).all()  # moved against the gradient
+
+
+class TestSparseTable:
+    def test_lazy_rows_and_update(self, client):
+        client.init_sparse(0, emb_dim=4, lr=0.5, optimizer="sgd", seed=3)
+        keys = np.asarray([5, 9, 5, 123456789])
+        rows = client.pull_sparse(0, keys)
+        assert rows.shape == (4, 4)
+        np.testing.assert_allclose(rows[0], rows[2])  # duplicate id
+        assert client.num_sparse_rows(0) == 3
+        # deterministic rows per server seed
+        rows2 = client.pull_sparse(0, keys)
+        np.testing.assert_allclose(rows, rows2)
+        # push: duplicate ids sum their grads
+        g = np.zeros((4, 4), "float32")
+        g[0] = 1.0
+        g[2] = 1.0
+        client.push_sparse(0, keys, g)
+        rows3 = client.pull_sparse(0, keys)
+        np.testing.assert_allclose(rows3[0], rows[0] - 0.5 * 2.0, rtol=1e-5)
+        np.testing.assert_allclose(rows3[1], rows[1])
+
+    def test_sharding_across_servers(self, servers, client):
+        client.init_sparse(2, emb_dim=2)
+        keys = np.arange(10)
+        client.pull_sparse(2, keys)
+        n0 = servers[0].sparse[2].num_rows()
+        n1 = servers[1].sparse[2].num_rows()
+        assert n0 == 5 and n1 == 5  # id % 2 sharding
+
+
+class TestDistributedEmbedding:
+    def test_end_to_end_training(self, client):
+        paddle.seed(0)
+        np.random.seed(0)
+        emb = DistributedEmbedding(client, table_id=7, emb_dim=8, lr=0.2)
+        head = nn.Linear(8, 2)
+        optimizer = PsOptimizer(head.parameters(), client, lr=0.2, mode="async",
+                                table_id_base=100)
+        ce = nn.CrossEntropyLoss()
+        ids = np.random.randint(0, 20, (32,))
+        labels = (ids % 2).astype("int64")
+        losses = []
+        for _ in range(60):
+            x = emb(paddle.to_tensor(ids))
+            loss = ce(head(x), paddle.to_tensor(labels))
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            losses.append(float(loss._value))
+        assert losses[-1] < losses[0] * 0.5
+        assert client.num_sparse_rows(7) == len(set(ids.tolist()))
+
+
+class TestSyncMode:
+    def test_sync_dense_waits_for_all_trainers(self):
+        srv = PsServer(num_trainers=2, sync=True).start()
+        c1 = PsClient([srv.endpoint])
+        c2 = PsClient([srv.endpoint])
+        try:
+            c1.init_dense(0, np.zeros(2, "float32"), lr=1.0, optimizer="sgd",
+                          sync=True)
+            results = {}
+
+            def push(name, cli, g):
+                cli.push_dense(0, np.asarray(g, "float32"))
+                results[name] = cli.pull_dense(0)
+
+            t1 = threading.Thread(target=push, args=("a", c1, [1.0, 1.0]))
+            t1.start()
+            t1.join(timeout=0.5)
+            assert t1.is_alive()  # blocked until trainer 2 contributes
+            t2 = threading.Thread(target=push, args=("b", c2, [3.0, 3.0]))
+            t2.start()
+            t1.join(5)
+            t2.join(5)
+            assert not t1.is_alive() and not t2.is_alive()
+            # applied once with the averaged grad: -(1+3)/2 = -2
+            np.testing.assert_allclose(results["a"], [-2.0, -2.0])
+            np.testing.assert_allclose(results["b"], [-2.0, -2.0])
+        finally:
+            c1.close()
+            c2.close()
+            srv.stop()
+
+
+class TestGeoMode:
+    def test_geo_delta_exchange(self, client):
+        paddle.seed(0)
+        lin = nn.Linear(4, 1)
+        local = opt.SGD(learning_rate=0.1, parameters=lin.parameters())
+        ps_opt = PsOptimizer(lin.parameters(), client, mode="geo",
+                             table_id_base=200, geo_k=2, local_optimizer=local)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+        y = paddle.to_tensor(np.random.randn(8, 1).astype("float32"))
+        w_before = np.asarray(lin.weight._value).copy()
+        for _ in range(4):
+            loss = ((lin(x) - y) ** 2).mean()
+            loss.backward()
+            ps_opt.step()
+            ps_opt.clear_grad()
+        w_after = np.asarray(lin.weight._value)
+        assert not np.allclose(w_before, w_after)
+        # server table reflects local progress after the delta pushes
+        server_w = client.pull_dense(200)
+        np.testing.assert_allclose(server_w, w_after, rtol=1e-5)
+
+
+class TestErrorHandling:
+    def test_uninitialized_table_reports_cause(self, client):
+        with pytest.raises(RuntimeError, match="not initialized"):
+            client.pull_dense(999)
+        # connection survives the error
+        client.init_dense(3, np.zeros(2, "float32"))
+        np.testing.assert_allclose(client.pull_dense(3), np.zeros(2))
+
+    def test_role_maker_exported_from_fleet(self):
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.ps.role import PaddleCloudRoleMaker
+
+        assert fleet.PaddleCloudRoleMaker is PaddleCloudRoleMaker
+        rm = fleet.UserDefinedRoleMaker(current_id=1, worker_num=3,
+                                        server_endpoints=["h:1"])
+        assert rm._worker_index() == 1 and rm._worker_num() == 3
+        assert rm._get_pserver_endpoints() == ["h:1"]
+
+    def test_collective_env_var_does_not_hijack_init(self, monkeypatch):
+        import paddle_tpu.distributed.fleet as fleet
+
+        monkeypatch.setenv("PADDLE_TRAINING_ROLE", "TRAINER")
+        monkeypatch.delenv("PADDLE_PSERVERS_IP_PORT_LIST", raising=False)
+        fleet._fleet_state["role_maker"] = None
+        fleet.init()  # must build the collective topology, not PS mode
+        assert fleet.get_hybrid_communicate_group() is not None
+        assert fleet._fleet_state["role_maker"] is None
+
+
+class TestFleetPsApi:
+    def test_roles_and_lifecycle(self, monkeypatch):
+        import paddle_tpu.distributed.fleet as fleet
+
+        srv_holder = {}
+
+        def server_proc():
+            monkeypatch.setenv("PADDLE_TRAINING_ROLE", "PSERVER")
+            monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", "127.0.0.1:0")
+            monkeypatch.setenv("POD_IP", "127.0.0.1")
+            monkeypatch.setenv("PADDLE_PORT", "0")
+            fleet.init()
+            assert fleet.is_server()
+            srv = fleet.init_server()
+            srv_holder["srv"] = srv
+            srv.start()
+
+        server_proc()
+        srv = srv_holder["srv"]
+        # now act as the trainer against the bound endpoint
+        monkeypatch.setenv("PADDLE_TRAINING_ROLE", "TRAINER")
+        monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", srv.endpoint)
+        fleet.init()
+        assert fleet.is_worker() and not fleet.is_server()
+        client = fleet.init_worker()
+        client.init_dense(0, np.zeros(3, "float32"), lr=1.0)
+        client.push_dense(0, np.ones(3, "float32"))
+        np.testing.assert_allclose(client.pull_dense(0), -np.ones(3))
+        fleet.stop_worker()  # worker 0 → also stops the server
+        srv.join()
