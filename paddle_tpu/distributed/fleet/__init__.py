@@ -95,12 +95,18 @@ def init(role_maker=None, is_collective: bool = False, strategy=None, log_level=
         from ..ps.role import PaddleCloudRoleMaker
 
         role_maker = PaddleCloudRoleMaker()
-    if role_maker is not None and not is_collective:
+    collective = is_collective or (
+        role_maker is not None and getattr(role_maker, "_is_collective", False)
+    )
+    if role_maker is not None and not collective:
         _fleet_state["initialized"] = True
         _fleet_state["strategy"] = strategy or DistributedStrategy()
         _fleet_state["role_maker"] = role_maker
         return None
 
+    # collective init: drop any stale PS role state from a previous init so
+    # is_server()/server_endpoints() reflect THIS run
+    _fleet_state["role_maker"] = None
     env.init_parallel_env()
     strategy = strategy or DistributedStrategy()
     hc = strategy.hybrid_configs

@@ -30,7 +30,6 @@ class PsServer:
         self._sock.listen(128)
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
-        self._threads = []
 
     @property
     def endpoint(self) -> str:
@@ -67,9 +66,9 @@ class PsServer:
             if self._stop.is_set():
                 conn.close()
                 break
-            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
-            t.start()
-            self._threads.append(t)
+            # daemonized per-connection threads; not tracked (they exit
+            # with their connection, and a tracked list would leak)
+            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket):
         try:

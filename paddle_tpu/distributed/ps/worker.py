@@ -69,6 +69,11 @@ class PsOptimizer:
                  table_id_base=0, geo_k=4, local_optimizer=None):
         if mode not in ("async", "sync", "geo"):
             raise ValueError(f"unknown ps mode {mode}")
+        if mode == "geo" and local_optimizer is None:
+            raise ValueError(
+                "mode='geo' requires a local_optimizer that applies the "
+                "between-sync local steps"
+            )
         self.params = list(parameters)
         self.client = client
         self.mode = mode

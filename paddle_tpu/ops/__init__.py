@@ -25,6 +25,10 @@ from ..core.tensor import Tensor
 from .stat import *  # noqa: F401,F403
 from . import stat
 
+# long-tail surface completion
+from .extras import *  # noqa: F401,F403
+from . import extras
+
 
 def _patch_tensor():
     import numbers

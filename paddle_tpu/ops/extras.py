@@ -1,0 +1,819 @@
+"""Long-tail tensor ops completing the reference surface.
+
+Reference: python/paddle/tensor/{math,manipulation,linalg,logic,search}.py —
+the remaining public functions beyond the core op files. Most lower to a
+single jnp/jax.scipy expression; data-dependent-shape ops document their
+eager-only behavior."""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ._helpers import binary_args, defprim, ensure_tensor
+
+__all__ = [
+    # elementwise / special functions
+    "copysign", "gammaln", "gammainc", "gammaincc", "multigammaln",
+    "polygamma", "i0", "i0e", "i1", "i1e", "heaviside", "hypot", "ldexp",
+    "frexp", "logaddexp", "logit", "nextafter", "sgn", "signbit", "sinc",
+    "isneginf", "isposinf", "isreal", "isin", "bitwise_left_shift",
+    "bitwise_right_shift",
+    # predicates / conversion
+    "is_tensor", "is_complex", "is_floating_point", "is_integer", "rank",
+    "tolist",
+    # stacking / combination
+    "hstack", "vstack", "dstack", "column_stack", "row_stack", "block_diag",
+    "broadcast_tensors", "cartesian_prod", "combinations", "vander",
+    # scatter / fill variants
+    "index_fill", "masked_scatter", "diagonal_scatter", "select_scatter",
+    "slice_scatter", "fill_diagonal_tensor",
+    # shape / view
+    "unflatten", "unfold", "as_strided", "view_as", "multiplex", "mv",
+    "take", "shard_index", "renorm",
+    # reductions / numerics
+    "trapezoid", "cumulative_trapezoid", "cdist", "histogram_bin_edges",
+    "histogramdd",
+    # linalg extensions
+    "matrix_exp", "cholesky_inverse", "lu_unpack", "svd_lowrank",
+    "pca_lowrank", "ormqr",
+    # random
+    "binomial", "poisson", "standard_gamma", "log_normal", "randint_like",
+    "top_p_sampling",
+    # misc
+    "polar",
+]
+
+
+# --------------------------------------------------------------------------
+# elementwise / special functions
+# --------------------------------------------------------------------------
+def _binary(prim_name, fn):
+    defprim(prim_name, fn)
+
+    def op(x, y, name=None):
+        x, y = binary_args(x, y)
+        return apply(prim_name, x, y)
+
+    return op
+
+
+def _unary(prim_name, fn, **kw):
+    defprim(prim_name, fn, **kw)
+
+    def op(x, name=None):
+        return apply(prim_name, ensure_tensor(x))
+
+    return op
+
+
+copysign = _binary("copysign_p", jnp.copysign)
+gammaln = _unary("gammaln_p", jax.scipy.special.gammaln)
+gammainc = _binary("gammainc_p", jax.scipy.special.gammainc)
+gammaincc = _binary("gammaincc_p", jax.scipy.special.gammaincc)
+heaviside = _binary("heaviside_p", lambda x, y: jnp.where(
+    x < 0.0, 0.0, jnp.where(x > 0.0, 1.0, y)).astype(x.dtype))
+hypot = _binary("hypot_p", jnp.hypot)
+logaddexp = _binary("logaddexp_p", jnp.logaddexp)
+nextafter = _binary("nextafter_p", jnp.nextafter)
+sinc = _unary("sinc_p", jnp.sinc)
+i0 = _unary("i0_p", lambda x: jax.scipy.special.i0(x))
+i0e = _unary("i0e_p", lambda x: jax.scipy.special.i0e(x))
+i1 = _unary("i1_p", lambda x: jax.scipy.special.i1(x))
+i1e = _unary("i1e_p", lambda x: jax.scipy.special.i1e(x))
+signbit = _unary("signbit_p", jnp.signbit, nondiff=True)
+isneginf = _unary("isneginf_p", jnp.isneginf, nondiff=True)
+isposinf = _unary("isposinf_p", jnp.isposinf, nondiff=True)
+isreal = _unary("isreal_p", jnp.isreal, nondiff=True)
+bitwise_left_shift = _binary("bitwise_left_shift_p", jnp.left_shift)
+bitwise_right_shift = _binary("bitwise_right_shift_p", jnp.right_shift)
+sgn = _unary(
+    "sgn_p",
+    lambda x: jnp.where(
+        jnp.abs(x) == 0, 0.0 * x, x / jnp.abs(x)
+    ) if jnp.iscomplexobj(x) else jnp.sign(x),
+)
+
+
+def multigammaln(x, p, name=None):
+    x = ensure_tensor(x)
+    return apply("multigammaln_p", x, p=int(p))
+
+
+defprim(
+    "multigammaln_p",
+    lambda x, *, p: p * (p - 1) / 4.0 * _math.log(_math.pi)
+    + jnp.sum(
+        jax.scipy.special.gammaln(x[..., None] + (1.0 - jnp.arange(1, p + 1)) / 2.0),
+        axis=-1,
+    ),
+)
+
+
+def polygamma(x, n, name=None):
+    if n == 0:
+        from .math import digamma
+
+        return digamma(x)
+    return apply("polygamma_p", ensure_tensor(x), n=int(n))
+
+
+defprim("polygamma_p", lambda x, *, n: jax.scipy.special.polygamma(n, x))
+
+
+def ldexp(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("ldexp_p", x, y)
+
+
+defprim("ldexp_p", lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)))
+defprim("frexp_p", lambda x: jnp.frexp(x), multi_out=True, nondiff=True)
+
+
+def frexp(x, name=None):
+    m, e = apply("frexp_p", ensure_tensor(x))
+    from .math import cast
+
+    return m, cast(e, "int32")
+
+
+def logit(x, eps=None, name=None):
+    return apply("logit_p", ensure_tensor(x),
+                 eps=None if eps is None else float(eps))
+
+
+def _logit_fwd(x, *, eps):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x) - jnp.log1p(-x)
+
+
+defprim("logit_p", _logit_fwd)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    x, t = ensure_tensor(x), ensure_tensor(test_x)
+    return apply("isin_p", x, t, invert=bool(invert))
+
+
+defprim("isin_p", lambda x, t, *, invert: jnp.isin(x, t, invert=invert),
+        nondiff=True)
+
+
+# --------------------------------------------------------------------------
+# predicates / conversion
+# --------------------------------------------------------------------------
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return np.dtype(ensure_tensor(x).dtype).kind == "c"
+
+
+def is_floating_point(x):
+    return np.dtype(ensure_tensor(x).dtype).kind == "f"
+
+
+def is_integer(x):
+    return np.dtype(ensure_tensor(x).dtype).kind in "iu"
+
+
+def rank(input, name=None):
+    from .creation import to_tensor
+
+    return to_tensor(ensure_tensor(input).ndim)
+
+
+def tolist(x):
+    return np.asarray(ensure_tensor(x)._value).tolist()
+
+
+# --------------------------------------------------------------------------
+# stacking / combination
+# --------------------------------------------------------------------------
+def _multi(prim_name, fn):
+    def op(xs, name=None):
+        ts = [ensure_tensor(t) for t in xs]
+        caller = defprim(f"{prim_name}_{len(ts)}", lambda *arrs: fn(arrs))
+        return caller(*ts)
+
+    op.__name__ = prim_name
+    return op
+
+
+hstack = _multi("hstack_p", jnp.hstack)
+vstack = _multi("vstack_p", jnp.vstack)
+dstack = _multi("dstack_p", jnp.dstack)
+column_stack = _multi("column_stack_p", jnp.column_stack)
+row_stack = vstack
+block_diag = _multi("block_diag_p", lambda arrs: jax.scipy.linalg.block_diag(*arrs))
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in ts])
+    from .manipulation import broadcast_to
+
+    return [broadcast_to(t, shape) for t in ts]
+
+
+def cartesian_prod(x, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    caller = defprim(
+        f"cartesian_prod_{len(ts)}",
+        lambda *arrs: jnp.stack(
+            [g.reshape(-1) for g in jnp.meshgrid(*arrs, indexing="ij")], axis=-1
+        ) if len(arrs) > 1 else arrs[0].reshape(-1, 1),
+    )
+    out = caller(*ts)
+    if len(ts) == 1:
+        from .manipulation import reshape
+
+        return reshape(out, [-1])
+    return out
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor (reference math.py combinations).
+    Index set computed host-side (data-independent), gather on device."""
+    import itertools
+
+    x = ensure_tensor(x)
+    n = x.shape[0]
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(it), dtype="int64").reshape(-1, r)
+    return apply("combinations_p", x, idx_tuple=tuple(map(tuple, idx)))
+
+
+defprim(
+    "combinations_p",
+    lambda x, *, idx_tuple: x[jnp.asarray(idx_tuple, jnp.int64).reshape(len(idx_tuple), -1)]
+    if len(idx_tuple) else jnp.zeros((0,) + x.shape[1:], x.dtype),
+)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    x = ensure_tensor(x)
+    return apply("vander_p", x, n=x.shape[0] if n is None else int(n),
+                 increasing=bool(increasing))
+
+
+defprim("vander_p", lambda x, *, n, increasing: jnp.vander(x, n, increasing=increasing))
+
+
+# --------------------------------------------------------------------------
+# scatter / fill variants
+# --------------------------------------------------------------------------
+def index_fill(x, index, axis, value, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return apply("index_fill_p", x, index, axis=int(axis), value=float(value))
+
+
+def _index_fill_fwd(x, index, *, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[index].set(jnp.asarray(value, x.dtype))
+    return jnp.moveaxis(moved, 0, axis)
+
+
+defprim("index_fill_p", _index_fill_fwd)
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions from ``value`` in row-major order (reference
+    manipulation.py masked_scatter). Data-dependent placement runs via a
+    cumulative index, shape-static."""
+    x, mask, value = ensure_tensor(x), ensure_tensor(mask), ensure_tensor(value)
+    return apply("masked_scatter_p", x, mask, value)
+
+
+def _masked_scatter_fwd(x, mask, value):
+    mask_b = jnp.broadcast_to(mask, x.shape).reshape(-1)
+    flat = x.reshape(-1)
+    src = value.reshape(-1)
+    pick = jnp.cumsum(mask_b) - 1
+    gathered = src[jnp.clip(pick, 0, src.shape[0] - 1)]
+    return jnp.where(mask_b, gathered, flat).reshape(x.shape)
+
+
+defprim("masked_scatter_p", _masked_scatter_fwd)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("diagonal_scatter_p", x, y, offset=int(offset),
+                 axis1=int(axis1), axis2=int(axis2))
+
+
+def _diagonal_scatter_fwd(x, y, *, offset, axis1, axis2):
+    moved = jnp.moveaxis(x, (axis1, axis2), (-2, -1))
+    n, m = moved.shape[-2], moved.shape[-1]
+    rows = jnp.arange(max(min(n, m - offset) if offset >= 0 else min(n + offset, m), 0))
+    if offset >= 0:
+        r, c = rows, rows + offset
+    else:
+        r, c = rows - offset, rows
+    moved = moved.at[..., r, c].set(y)
+    return jnp.moveaxis(moved, (-2, -1), (axis1, axis2))
+
+
+defprim("diagonal_scatter_p", _diagonal_scatter_fwd)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    x, v = ensure_tensor(x), ensure_tensor(values)
+    return apply("select_scatter_p", x, v, axis=int(axis), index=int(index))
+
+
+defprim(
+    "select_scatter_p",
+    lambda x, v, *, axis, index: jnp.moveaxis(
+        jnp.moveaxis(x, axis, 0).at[index].set(v), 0, axis
+    ),
+)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    x, v = ensure_tensor(x), ensure_tensor(value)
+    return apply("slice_scatter_p", x, v, axes=tuple(int(a) for a in axes),
+                 starts=tuple(int(s) for s in starts),
+                 ends=tuple(int(e) for e in ends),
+                 strides=tuple(int(s) for s in strides))
+
+
+def _slice_scatter_fwd(x, v, *, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return x.at[tuple(idx)].set(v)
+
+
+defprim("slice_scatter_p", _slice_scatter_fwd)
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    return diagonal_scatter(x, y, offset=offset, axis1=dim1, axis2=dim2)
+
+
+# --------------------------------------------------------------------------
+# shape / view
+# --------------------------------------------------------------------------
+def unflatten(x, axis, shape, name=None):
+    x = ensure_tensor(x)
+    axis = int(axis) % x.ndim
+    new_shape = tuple(x.shape[:axis]) + tuple(int(s) for s in shape) + tuple(
+        x.shape[axis + 1:]
+    )
+    from .manipulation import reshape
+
+    return reshape(x, new_shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis`` (reference manipulation.py unfold):
+    output appends a window dim of length ``size``."""
+    x = ensure_tensor(x)
+    return apply("tensor_unfold_p", x, axis=int(axis) % x.ndim, size=int(size),
+                 step=int(step))
+
+
+defprim(
+    "tensor_unfold_p",
+    lambda x, *, axis, size, step: jnp.moveaxis(
+        jnp.moveaxis(x, axis, 0)[
+            jnp.arange(0, x.shape[axis] - size + 1, step)[:, None]
+            + jnp.arange(size)[None, :]
+        ],
+        (0, 1), (axis, x.ndim),
+    ),
+)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view (reference manipulation.py as_strided / kernels/stride).
+    XLA has no aliasing views; materialized via a strided gather."""
+    x = ensure_tensor(x)
+    return apply("as_strided_p", x, shape=tuple(int(s) for s in shape),
+                 stride=tuple(int(s) for s in stride), offset=int(offset))
+
+
+def _as_strided_fwd(x, *, shape, stride, offset):
+    flat = x.reshape(-1)
+    idx = jnp.asarray(offset)
+    for dim, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(dim) * st
+    return flat[idx.reshape(shape)]
+
+
+defprim("as_strided_p", _as_strided_fwd)
+
+
+def view_as(x, other, name=None):
+    from .manipulation import reshape
+
+    return reshape(ensure_tensor(x), tuple(ensure_tensor(other).shape))
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (reference math.py
+    multiplex: out[i] = inputs[index[i]][i])."""
+    ts = [ensure_tensor(t) for t in inputs]
+    index = ensure_tensor(index)
+    caller = defprim(
+        f"multiplex_{len(ts)}",
+        lambda idx, *arrs: jnp.stack(arrs, 0)[
+            idx.reshape(-1).astype(jnp.int64), jnp.arange(arrs[0].shape[0])
+        ],
+    )
+    return caller(index, *ts)
+
+
+def mv(x, vec, name=None):
+    from .math import matmul
+
+    return matmul(x, vec)
+
+
+def take(x, index, mode="raise", name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"'mode' in 'take' should be 'raise', 'wrap', 'clip', but received {mode}.")
+    import jax.core as _jcore
+
+    if mode == "raise" and not isinstance(index._value, _jcore.Tracer):
+        idx_np = np.asarray(index._value)
+        n = int(np.prod(x.shape)) if x.shape else 1
+        if idx_np.size and (idx_np.min() < -n or idx_np.max() >= n):
+            raise ValueError(
+                f"take index out of range for tensor with {n} elements "
+                f"(got min {idx_np.min()}, max {idx_np.max()})"
+            )
+    return apply("take_p", x, index, mode=mode)
+
+
+def _take_fwd(x, index, *, mode):
+    flat = x.reshape(-1)
+    idx = index.astype(jnp.int64)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    else:  # raise / clip both clamp in-graph (raise validated eagerly)
+        idx = jnp.clip(jnp.where(idx < 0, idx + n, idx), 0, n - 1)
+    return flat[idx]
+
+
+defprim("take_p", _take_fwd)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1, name=None):
+    """Recompute global label ids for one shard (reference math.py
+    shard_index — used by sharded classification heads)."""
+    input = ensure_tensor(input)
+    if shard_id < 0 or shard_id >= nshards:
+        raise ValueError(
+            f"The shard_id({shard_id}) should be in [0, {nshards})"
+        )
+    return apply("shard_index_p", input, index_num=int(index_num),
+                 nshards=int(nshards), shard_id=int(shard_id),
+                 ignore_value=int(ignore_value))
+
+
+def _shard_index_fwd(x, *, index_num, nshards, shard_id, ignore_value):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_shard = (x >= lo) & (x < hi)
+    return jnp.where(in_shard, x - lo, ignore_value)
+
+
+defprim("shard_index_p", _shard_index_fwd)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    x = ensure_tensor(x)
+    return apply("renorm_p", x, p=float(p), axis=int(axis) % x.ndim,
+                 max_norm=float(max_norm))
+
+
+def _renorm_fwd(x, *, p, axis, max_norm):
+    dims = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+    return x * factor
+
+
+defprim("renorm_p", _renorm_fwd)
+
+
+# --------------------------------------------------------------------------
+# reductions / numerics
+# --------------------------------------------------------------------------
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+    if x is not None:
+        return apply("trapezoid_x_p", y, ensure_tensor(x), axis=int(axis))
+    return apply("trapezoid_p", y, dx=1.0 if dx is None else float(dx),
+                 axis=int(axis))
+
+
+defprim("trapezoid_p", lambda y, *, dx, axis: jax.scipy.integrate.trapezoid(
+    y, dx=dx, axis=axis))
+defprim("trapezoid_x_p", lambda y, x, *, axis: jax.scipy.integrate.trapezoid(
+    y, x, axis=axis))
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+    if x is not None:
+        return apply("cumtrapz_x_p", y, ensure_tensor(x), axis=int(axis))
+    return apply("cumtrapz_p", y, dx=1.0 if dx is None else float(dx),
+                 axis=int(axis))
+
+
+def _cumtrapz(y, x=None, dx=1.0, axis=-1):
+    ys = jnp.moveaxis(y, axis, -1)
+    mids = (ys[..., 1:] + ys[..., :-1]) / 2.0
+    if x is not None:
+        if x.ndim == 1:
+            widths = jnp.diff(x)
+        else:
+            widths = jnp.diff(jnp.moveaxis(x, axis, -1), axis=-1)
+        mids = mids * widths
+    else:
+        mids = mids * dx
+    return jnp.moveaxis(jnp.cumsum(mids, axis=-1), -1, axis)
+
+
+defprim("cumtrapz_p", lambda y, *, dx, axis: _cumtrapz(y, dx=dx, axis=axis))
+defprim("cumtrapz_x_p", lambda y, x, *, axis: _cumtrapz(y, x=x, axis=axis))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("cdist_p", x, y, p=float(p))
+
+
+def _cdist_fwd(x, y, *, p):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), axis=-1)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+defprim("cdist_p", _cdist_fwd)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    input = ensure_tensor(input)
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        arr = np.asarray(input._value)
+        lo, hi = float(arr.min()), float(arr.max())
+        if lo == hi:
+            lo, hi = lo - 0.5, hi + 0.5
+    return Tensor._from_value(jnp.linspace(lo, hi, int(bins) + 1))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    """N-d histogram (reference linalg.py histogramdd) — eager numpy."""
+    arr = np.asarray(ensure_tensor(x)._value)
+    w = None if weights is None else np.asarray(ensure_tensor(weights)._value)
+    hist, edges = np.histogramdd(arr, bins=bins, range=ranges, density=density,
+                                 weights=w)
+    return (Tensor._from_value(jnp.asarray(hist)),
+            [Tensor._from_value(jnp.asarray(e)) for e in edges])
+
+
+# --------------------------------------------------------------------------
+# linalg extensions
+# --------------------------------------------------------------------------
+def matrix_exp(x, name=None):
+    return apply("matrix_exp_p", ensure_tensor(x))
+
+
+defprim("matrix_exp_p", jax.scipy.linalg.expm)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    return apply("cholesky_inverse_p", ensure_tensor(x), upper=bool(upper))
+
+
+def _cholesky_inverse_fwd(x, *, upper):
+    # inverse of A where x is its Cholesky factor
+    eye = jnp.eye(x.shape[-1], dtype=x.dtype)
+    inv_factor = jax.scipy.linalg.solve_triangular(x, eye, lower=not upper)
+    return (inv_factor.T @ inv_factor) if not upper else (inv_factor @ inv_factor.T)
+
+
+defprim("cholesky_inverse_p", _cholesky_inverse_fwd)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack LU factorization results (reference linalg.py lu_unpack)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("lu_unpack_p", x, y)
+
+
+def _lu_unpack_fwd(lu, pivots):
+    n = lu.shape[-2]
+    l = jnp.tril(lu, -1) + jnp.eye(n, lu.shape[-1], dtype=lu.dtype)
+    l = l[..., :, : min(lu.shape[-2], lu.shape[-1])]
+    u = jnp.triu(lu)[..., : min(lu.shape[-2], lu.shape[-1]), :]
+    # pivots (1-based sequential swaps) -> permutation matrix
+    perm = jnp.arange(n)
+    piv = pivots.astype(jnp.int64) - 1
+
+    def body(i, p):
+        j = piv[i]
+        pi, pj = p[i], p[j]
+        return p.at[i].set(pj).at[j].set(pi)
+
+    perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+    pmat = jnp.eye(n, dtype=lu.dtype)[perm].T
+    return pmat, l, u
+
+
+defprim("lu_unpack_p", _lu_unpack_fwd, multi_out=True)
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply ``other`` by the (implicit m×m) orthogonal Q of a QR
+    factorization given in Householder form (reference linalg.py ormqr).
+    XLA has no ormqr primitive; the reflectors are applied one by one in a
+    fori_loop, never materializing Q."""
+    return apply("ormqr_p", ensure_tensor(x), ensure_tensor(tau),
+                 ensure_tensor(other), left=bool(left),
+                 transpose=bool(transpose))
+
+
+def _ormqr_fwd(a, tau, other, *, left, transpose):
+    m, k = a.shape[-2], tau.shape[-1]
+
+    def reflector(i):
+        col = a[:, i]
+        v = jnp.where(jnp.arange(m) > i, col, 0.0).at[i].set(1.0)
+        return v
+
+    def apply_q(mat, trans):
+        # Q = H_0 H_1 ... H_{k-1}; Q@x applies reflectors last-to-first,
+        # Q^T@x first-to-last (each H_i is symmetric)
+        def body(j, acc):
+            i = j if trans else k - 1 - j
+            v = reflector(i)
+            return acc - tau[i] * jnp.outer(v, v @ acc)
+
+        return jax.lax.fori_loop(0, k, body, mat)
+
+    if left:
+        return apply_q(other, transpose)
+    # x @ op(Q) = (op(Q)^T @ x^T)^T
+    return apply_q(other.swapaxes(-1, -2), not transpose).swapaxes(-1, -2)
+
+
+defprim("ormqr_p", _ormqr_fwd)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD of ``x - M`` (reference linalg.py
+    svd_lowrank, Halko et al. subspace iteration)."""
+    x = ensure_tensor(x)
+    if M is not None:
+        x = x - ensure_tensor(M)
+    from ..core import generator
+
+    key = Tensor._from_value(generator.next_key())
+    return apply("svd_lowrank_p", x, key, q=int(q), niter=int(niter))
+
+
+def _svd_lowrank_fwd(a, key, *, q, niter):
+    m, n = a.shape[-2], a.shape[-1]
+    q = min(q, m, n)
+    omega = jax.random.normal(key, a.shape[:-2] + (n, q), a.dtype)
+    # subspace iteration with QR re-orthonormalization each step (Halko et
+    # al. alg. 4.4) — plain power iterations collapse in float32
+    qmat, _ = jnp.linalg.qr(a @ omega)
+    for _ in range(niter):
+        z, _ = jnp.linalg.qr(a.swapaxes(-1, -2) @ qmat)
+        qmat, _ = jnp.linalg.qr(a @ z)
+    b = qmat.swapaxes(-1, -2) @ a
+    u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    return qmat @ u, s, vh.swapaxes(-1, -2)
+
+
+defprim("svd_lowrank_p", _svd_lowrank_fwd, multi_out=True)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = ensure_tensor(x)
+    if q is None:
+        q = min(6, x.shape[-2], x.shape[-1])
+    if center:
+        from .math import mean
+
+        x = x - mean(x, axis=-2, keepdim=True)
+    return svd_lowrank(x, q=q, niter=niter)
+
+
+# --------------------------------------------------------------------------
+# random
+# --------------------------------------------------------------------------
+def _key_tensor():
+    from ..core import generator
+
+    return Tensor._from_value(generator.next_key())
+
+
+def binomial(count, prob, name=None):
+    count, prob = binary_args(count, prob)
+    return apply("binomial_sample_p", _key_tensor(), count, prob)
+
+
+defprim(
+    "binomial_sample_p",
+    lambda key, n, p: jax.random.binomial(key, n, p).astype(jnp.int64),
+    nondiff=True, jittable=False,
+)
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    return apply("poisson_sample_p", _key_tensor(), x)
+
+
+defprim(
+    "poisson_sample_p",
+    lambda key, lam: jax.random.poisson(key, lam).astype(lam.dtype),
+    nondiff=True,
+)
+
+
+def standard_gamma(x, name=None):
+    x = ensure_tensor(x)
+    return apply("standard_gamma_p", _key_tensor(), x)
+
+
+defprim(
+    "standard_gamma_p",
+    lambda key, alpha: jax.random.gamma(key, alpha, dtype=alpha.dtype),
+)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    from .math import exp
+
+    from .creation import normal
+
+    return exp(normal(float(mean), float(std), shape))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    from .creation import randint
+    from .math import cast
+
+    if high is None:
+        low, high = 0, low
+    target = dtype or np.dtype(x.dtype).name
+    out = randint(low, high, tuple(x.shape), "int64")
+    return cast(out, target)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling over the last axis (reference math.py
+    top_p_sampling): sample from the smallest prefix of the sorted
+    distribution whose mass exceeds p."""
+    x, ps = ensure_tensor(x), ensure_tensor(ps)
+    return apply("top_p_sampling_p", _key_tensor(), x, ps)
+
+
+def _top_p_fwd(key, probs, ps):
+    sort_idx = jnp.argsort(-probs, axis=-1)
+    sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    keep = cum - sorted_p <= ps[..., None]     # always keep the top token
+    masked = jnp.where(keep, sorted_p, 0.0)
+    masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
+    draw = jax.random.categorical(key, jnp.log(jnp.maximum(masked, 1e-30)), axis=-1)
+    ids = jnp.take_along_axis(sort_idx, draw[..., None], axis=-1)
+    scores = jnp.take_along_axis(probs, ids, axis=-1)
+    return scores, ids.astype(jnp.int64)
+
+
+defprim("top_p_sampling_p", _top_p_fwd, multi_out=True, nondiff=True)
+
+
+# --------------------------------------------------------------------------
+# misc
+# --------------------------------------------------------------------------
+def polar(abs, angle, name=None):
+    abs_t, angle_t = binary_args(abs, angle)
+    return apply("polar_p", abs_t, angle_t)
+
+
+defprim("polar_p", lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)))

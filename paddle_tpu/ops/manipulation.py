@@ -24,7 +24,7 @@ __all__ = [
     "squeeze_", "unsqueeze_", "concat", "stack", "split", "chunk", "unbind",
     "unstack",
     "tile", "expand", "expand_as", "broadcast_to", "flip", "rot90", "roll",
-    "gather", "gather_nd", "scatter", "scatter_nd_add", "index_select",
+    "gather", "gather_nd", "scatter", "scatter_nd", "scatter_nd_add", "index_select",
     "index_sample", "index_add", "index_put", "take_along_axis",
     "put_along_axis", "masked_select", "masked_fill", "where", "nonzero",
     "topk", "sort", "argsort", "argmax", "argmin", "unique", "unique_consecutive",

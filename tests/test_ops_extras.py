@@ -1,0 +1,248 @@
+"""Long-tail op surface vs numpy/scipy oracles."""
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def t(x, dtype="float32"):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+class TestSpecialFunctions:
+    def test_elementwise_pairs(self):
+        x = np.asarray([0.5, 1.5, 3.0], "float32")
+        y = np.asarray([-1.0, 2.0, 0.5], "float32")
+        np.testing.assert_allclose(_np(paddle.copysign(t(x), t(y))), np.copysign(x, y))
+        np.testing.assert_allclose(_np(paddle.hypot(t(x), t(y))), np.hypot(x, y), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.logaddexp(t(x), t(y))), np.logaddexp(x, y), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.heaviside(t(y), t(x))), np.heaviside(y, x))
+        np.testing.assert_allclose(_np(paddle.nextafter(t(x), t(y))), np.nextafter(x, y))
+
+    def test_gamma_family(self):
+        x = np.asarray([0.5, 2.0, 5.0], "float32")
+        np.testing.assert_allclose(_np(paddle.gammaln(t(x))), sps.gammaln(x), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.gammainc(t(x), t(x))), sps.gammainc(x, x), rtol=1e-4)
+        np.testing.assert_allclose(_np(paddle.gammaincc(t(x), t(x))), sps.gammaincc(x, x), rtol=1e-4)
+        np.testing.assert_allclose(float(_np(paddle.multigammaln(t(5.0), 3))), sps.multigammaln(5.0, 3), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.polygamma(t(x), 1)), sps.polygamma(1, x), rtol=1e-4)
+
+    def test_bessel(self):
+        x = np.asarray([0.1, 1.0, 3.0], "float32")
+        np.testing.assert_allclose(_np(paddle.i0(t(x))), sps.i0(x), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.i0e(t(x))), sps.i0e(x), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.i1(t(x))), sps.i1(x), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.i1e(t(x))), sps.i1e(x), rtol=1e-5)
+
+    def test_logit_ldexp_frexp_sinc(self):
+        p = np.asarray([0.2, 0.5, 0.9], "float32")
+        np.testing.assert_allclose(_np(paddle.logit(t(p))), sps.logit(p), rtol=1e-5)
+        m, e = paddle.frexp(t([4.0, 10.0]))
+        np.testing.assert_allclose(_np(m) * 2.0 ** _np(e), [4.0, 10.0], rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.ldexp(t([1.5]), t([3], "int64"))), [12.0])
+        np.testing.assert_allclose(_np(paddle.sinc(t([0.0, 0.5]))), np.sinc([0.0, 0.5]), rtol=1e-6)
+
+    def test_predicates(self):
+        assert paddle.is_tensor(t([1.0])) and not paddle.is_tensor(3)
+        assert paddle.is_floating_point(t([1.0]))
+        assert paddle.is_integer(t([1], "int64"))
+        assert paddle.is_complex(t(np.asarray([1 + 1j]), "complex64"))
+        np.testing.assert_array_equal(_np(paddle.signbit(t([-1.0, 2.0]))), [True, False])
+        np.testing.assert_array_equal(_np(paddle.isposinf(t([np.inf, 1.0]))), [True, False])
+        np.testing.assert_array_equal(_np(paddle.isin(t([1, 2, 3], "int64"), t([2], "int64"))), [False, True, False])
+        assert paddle.tolist(t([[1.0, 2.0]])) == [[1.0, 2.0]]
+        assert int(_np(paddle.rank(t(np.zeros((2, 3)))))) == 2
+        np.testing.assert_allclose(_np(paddle.sgn(t([-3.0, 0.0, 5.0]))), [-1.0, 0.0, 1.0])
+
+
+class TestStacking:
+    def test_stacks(self):
+        a, b = np.ones((2, 3), "float32"), np.zeros((2, 3), "float32")
+        np.testing.assert_allclose(_np(paddle.hstack([t(a), t(b)])), np.hstack([a, b]))
+        np.testing.assert_allclose(_np(paddle.vstack([t(a), t(b)])), np.vstack([a, b]))
+        np.testing.assert_allclose(_np(paddle.dstack([t(a), t(b)])), np.dstack([a, b]))
+        np.testing.assert_allclose(_np(paddle.column_stack([t(a[:, 0]), t(b[:, 0])])), np.column_stack([a[:, 0], b[:, 0]]))
+        bd = _np(paddle.block_diag([t(np.eye(2, dtype="float32")), t(np.full((1, 3), 2.0, "float32"))]))
+        assert bd.shape == (3, 5)
+
+    def test_broadcast_cartesian_combinations_vander(self):
+        outs = paddle.broadcast_tensors([t(np.ones((1, 3))), t(np.ones((2, 1)))])
+        assert all(tuple(o.shape) == (2, 3) for o in outs)
+        cp = _np(paddle.cartesian_prod([t([1.0, 2.0]), t([3.0, 4.0, 5.0])]))
+        assert cp.shape == (6, 2)
+        comb = _np(paddle.combinations(t([1.0, 2.0, 3.0]), 2))
+        np.testing.assert_allclose(comb, [[1, 2], [1, 3], [2, 3]])
+        np.testing.assert_allclose(_np(paddle.vander(t([1.0, 2.0, 3.0]))), np.vander([1, 2, 3]), rtol=1e-6)
+
+
+class TestScatterVariants:
+    def test_index_fill_masked_scatter(self):
+        x = np.zeros((3, 3), "float32")
+        out = _np(paddle.index_fill(t(x), t([0, 2], "int64"), 0, 7.0))
+        np.testing.assert_allclose(out[[0, 2]], 7.0)
+        np.testing.assert_allclose(out[1], 0.0)
+        m = np.asarray([[True, False], [False, True]])
+        ms = _np(paddle.masked_scatter(t(np.zeros((2, 2))), paddle.to_tensor(m), t([5.0, 6.0])))
+        np.testing.assert_allclose(ms, [[5.0, 0.0], [0.0, 6.0]])
+
+    def test_diag_select_slice_scatter(self):
+        x = np.zeros((3, 3), "float32")
+        d = _np(paddle.diagonal_scatter(t(x), t([1.0, 2.0, 3.0])))
+        np.testing.assert_allclose(np.diag(d), [1, 2, 3])
+        s = _np(paddle.select_scatter(t(x), t([9.0, 9.0, 9.0]), 0, 1))
+        np.testing.assert_allclose(s[1], 9.0)
+        sl = _np(paddle.slice_scatter(t(x), t(np.full((3, 1), 4.0, "float32")), [1], [0], [1], [1]))
+        np.testing.assert_allclose(sl[:, 0], 4.0)
+        sn = _np(paddle.scatter_nd(t([[1], [2]], "int64"), t([10.0, 20.0]), [4]))
+        np.testing.assert_allclose(sn, [0, 10, 20, 0])
+
+
+class TestShapeView:
+    def test_unflatten_unfold_as_strided(self):
+        x = np.arange(24, dtype="float32")
+        assert tuple(paddle.unflatten(t(x), 0, [4, 6]).shape) == (4, 6)
+        u = _np(paddle.unfold(t(np.arange(8).astype("float32")), 0, 4, 2))
+        assert u.shape == (3, 4)
+        np.testing.assert_allclose(u[1], [2, 3, 4, 5])
+        a = _np(paddle.as_strided(t(x), [3, 2], [6, 1]))
+        np.testing.assert_allclose(a, [[0, 1], [6, 7], [12, 13]])
+        assert tuple(paddle.view_as(t(x), t(np.zeros((4, 6)))).shape) == (4, 6)
+
+    def test_take_raise_validates(self):
+        a = t(np.arange(6).astype("float32"))
+        with pytest.raises(ValueError):
+            paddle.take(a, t([10], "int64"))
+        with pytest.raises(ValueError):
+            paddle.take(a, t([-7], "int64"))
+        # wrap mode accepts anything
+        np.testing.assert_allclose(_np(paddle.take(a, t([7], "int64"), mode="wrap")), [1.0])
+
+    def test_svd_lowrank_M(self):
+        paddle.seed(0)
+        a = np.random.randn(10, 4).astype("float32")
+        shift = np.ones((10, 4), "float32") * 5.0
+        u, s, v = paddle.svd_lowrank(t(a + shift), q=4, niter=8, M=t(shift))
+        np.testing.assert_allclose(_np(u) @ np.diag(_np(s)) @ _np(v).T, a, rtol=5e-2, atol=5e-2)
+        # without M the shifted matrix would dominate: check M was honored
+        s_np = _np(s)
+        assert s_np[0] < 20.0  # ||shift|| alone is ~44
+
+    def test_multiplex_mv_take_shard_renorm(self):
+        a = np.asarray([[1.0, 2.0], [3.0, 4.0]], "float32")
+        b = np.asarray([[10.0, 20.0], [30.0, 40.0]], "float32")
+        out = _np(paddle.multiplex([t(a), t(b)], t([[0], [1]], "int64")))
+        np.testing.assert_allclose(out, [[1, 2], [30, 40]])
+        np.testing.assert_allclose(_np(paddle.mv(t(a), t([1.0, 1.0]))), [3, 7])
+        np.testing.assert_allclose(_np(paddle.take(t(a), t([0, 3, -1], "int64"))), [1, 4, 4])
+        sh = _np(paddle.shard_index(t([[0], [7], [15]], "int64"), 20, 2, 0))
+        np.testing.assert_array_equal(sh, [[0], [7], [-1]])
+        rn = _np(paddle.renorm(t(np.ones((2, 4))), 2.0, 0, 1.0))
+        np.testing.assert_allclose(np.linalg.norm(rn, axis=1), [1.0, 1.0], rtol=1e-5)
+
+
+class TestNumerics:
+    def test_trapezoid(self):
+        y = np.asarray([1.0, 2.0, 3.0], "float32")
+        np.testing.assert_allclose(float(_np(paddle.trapezoid(t(y)))), np.trapezoid(y))
+        x = np.asarray([0.0, 1.0, 3.0], "float32")
+        np.testing.assert_allclose(float(_np(paddle.trapezoid(t(y), t(x)))), np.trapezoid(y, x))
+        ct = _np(paddle.cumulative_trapezoid(t(y)))
+        np.testing.assert_allclose(ct, [1.5, 4.0])
+
+    def test_cdist_logcumsumexp(self):
+        a = np.random.randn(4, 3).astype("float32")
+        b = np.random.randn(5, 3).astype("float32")
+        from scipy.spatial.distance import cdist as sp_cdist
+
+        np.testing.assert_allclose(_np(paddle.cdist(t(a), t(b))), sp_cdist(a, b), rtol=1e-4, atol=1e-5)
+        x = np.random.randn(6).astype("float32")
+        np.testing.assert_allclose(_np(paddle.logcumsumexp(t(x))), np.logaddexp.accumulate(x), rtol=1e-5)
+
+    def test_histograms(self):
+        e = _np(paddle.histogram_bin_edges(t([0.0, 4.0]), bins=4))
+        np.testing.assert_allclose(e, [0, 1, 2, 3, 4])
+        h, edges = paddle.histogramdd(t(np.random.rand(100, 2)), bins=5)
+        assert _np(h).shape == (5, 5) and len(edges) == 2
+
+
+class TestLinalgExtras:
+    def test_matrix_exp(self):
+        from scipy.linalg import expm
+
+        a = np.random.randn(3, 3).astype("float32") * 0.1
+        np.testing.assert_allclose(_np(paddle.matrix_exp(t(a))), expm(a), rtol=1e-4, atol=1e-5)
+
+    def test_cholesky_inverse(self):
+        a = np.random.randn(4, 4).astype("float32")
+        spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+        L = np.linalg.cholesky(spd)
+        np.testing.assert_allclose(_np(paddle.cholesky_inverse(t(L))), np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+
+    def test_lu_unpack(self):
+        a = np.random.randn(4, 4).astype("float32")
+        lu, piv = paddle.lu(t(a))
+        p, l, u = paddle.lu_unpack(lu, piv)
+        np.testing.assert_allclose(_np(p) @ _np(l) @ _np(u), a, rtol=1e-4, atol=1e-5)
+
+    def test_ormqr(self):
+        import scipy.linalg as sla
+
+        a = np.random.randn(5, 3).astype("float64")
+        h, tau = sla.qr(a, mode="raw")[0]
+        other = np.random.randn(5, 2).astype("float64")
+        # scipy raw returns (h, tau) packed: columns of h hold reflectors
+        out = _np(paddle.ormqr(t(h, "float64"), t(tau, "float64"),
+                               t(other, "float64")))
+        q = sla.qr(a)[0]  # full (5, 5) Q
+        np.testing.assert_allclose(out, q @ other, rtol=1e-6, atol=1e-8)
+        # transpose path: Q^T @ other
+        out_t = _np(paddle.ormqr(t(h, "float64"), t(tau, "float64"),
+                                 t(other, "float64"), transpose=True))
+        q = sla.qr(a)[0]  # (5, 5) full Q
+        np.testing.assert_allclose(out_t, q.T @ other, rtol=1e-6, atol=1e-8)
+
+    def test_bitwise_shifts(self):
+        x = t([1, 2, 8], "int64")
+        np.testing.assert_array_equal(_np(paddle.bitwise_left_shift(x, t([2, 1, 0], "int64"))), [4, 4, 8])
+        np.testing.assert_array_equal(_np(paddle.bitwise_right_shift(x, t([0, 1, 3], "int64"))), [1, 1, 1])
+
+    def test_svd_pca_lowrank(self):
+        paddle.seed(0)
+        a = np.random.randn(20, 5).astype("float32")
+        u, s, v = paddle.svd_lowrank(t(a), q=5, niter=4)
+        np.testing.assert_allclose(_np(u) @ np.diag(_np(s)) @ _np(v).T, a, rtol=1e-2, atol=1e-2)
+        u2, s2, v2 = paddle.pca_lowrank(t(a), q=3)
+        assert _np(s2).shape == (3,)
+
+
+class TestRandomExtras:
+    def test_samplers(self):
+        paddle.seed(0)
+        b = _np(paddle.binomial(t(np.full(2000, 10.0)), t(np.full(2000, 0.3))))
+        assert abs(b.mean() - 3.0) < 0.2
+        p = _np(paddle.poisson(t(np.full(2000, 4.0))))
+        assert abs(p.mean() - 4.0) < 0.3
+        g = _np(paddle.standard_gamma(t(np.full(2000, 3.0))))
+        assert abs(g.mean() - 3.0) < 0.3
+        ln = _np(paddle.log_normal(0.0, 0.25, [4000]))
+        assert abs(np.log(ln).mean()) < 0.05
+        r = paddle.randint_like(t(np.zeros((3, 3))), 0, 5)
+        assert tuple(r.shape) == (3, 3)
+
+    def test_top_p_sampling(self):
+        paddle.seed(0)
+        probs = np.asarray([[0.05, 0.05, 0.6, 0.3]] * 200, "float32")
+        scores, ids = paddle.top_p_sampling(t(probs), t(np.full(200, 0.8, "float32")))
+        ids_np = _np(ids)[:, 0]
+        assert set(ids_np.tolist()) <= {2, 3}  # nucleus = top-0.8 mass
+        assert (ids_np == 2).mean() > 0.5
+
+    def test_polar(self):
+        out = _np(paddle.polar(t([1.0, 2.0]), t([0.0, np.pi / 2])))
+        np.testing.assert_allclose(out.real, [1.0, 0.0], atol=1e-6)
+        np.testing.assert_allclose(out.imag, [0.0, 2.0], atol=1e-6)

@@ -178,7 +178,6 @@ class TestErrorHandling:
 
         monkeypatch.setenv("PADDLE_TRAINING_ROLE", "TRAINER")
         monkeypatch.delenv("PADDLE_PSERVERS_IP_PORT_LIST", raising=False)
-        fleet._fleet_state["role_maker"] = None
         fleet.init()  # must build the collective topology, not PS mode
         assert fleet.get_hybrid_communicate_group() is not None
         assert fleet._fleet_state["role_maker"] is None
