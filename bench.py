@@ -37,7 +37,10 @@ def main():
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
             num_hidden_layers=24, num_attention_heads=16,
             num_key_value_heads=16, max_position_embeddings=2048,
-            recompute=True,
+            # bs=8/seq=2048 fits v5e HBM without remat (params + fp32 AdamW
+            # state ≈ 6 GB, activations ≈ 8 GB); dropping the full-layer
+            # recompute buys ~22% MFU (0.312 → 0.381 measured)
+            recompute=False,
         )
         batch, seq = 8, 2048
         steps, warmup = 20, 3
