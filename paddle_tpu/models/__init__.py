@@ -17,3 +17,6 @@ from .bert import (
 from .gpt import (
     GPTConfig, GPTModel, GPTForCausalLM, GPTDecoderLayer, gpt_shard_plan,
 )
+from .unet_diffusion import (
+    DDPMScheduler, UNet2DConditionModel, UNetConfig,
+)
