@@ -66,7 +66,7 @@ def __getattr__(name):
     if name in ("distributed", "vision", "profiler", "hapi", "callbacks",
                 "fft", "signal", "distribution", "geometric", "quantization",
                 "text", "audio", "dataset", "hub", "sysconfig", "linalg",
-                "regularizer", "decomposition"):
+                "regularizer", "decomposition", "onnx"):
         import importlib
 
         try:

@@ -1,0 +1,50 @@
+"""Model export — ``paddle.onnx`` parity surface.
+
+Reference: python/paddle/onnx/export.py (paddle.onnx.export delegating to
+paddle2onnx). In this framework the portable interchange format is
+StableHLO (what XLA consumes natively and what jax.export serializes with
+compatibility guarantees); ``export`` emits it alongside the parameters.
+Actual .onnx serialization additionally needs the ``onnx`` package, which
+is not part of this environment — requesting it raises with instructions
+rather than writing a file in the wrong format."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, format="stablehlo",
+           **configs):
+    """Export ``layer`` for inference.
+
+    format="stablehlo" (default): writes ``<path>.pdmodel`` via jit.save —
+    parameters plus a serialized StableHLO forward for the given
+    input_spec — loadable with paddle_tpu.jit.load and the inference
+    Predictor on any XLA backend.
+
+    format="onnx": reference behavior; requires the ``onnx`` package.
+    """
+    if format == "onnx":
+        try:
+            import onnx  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "ONNX serialization requires the 'onnx' package, which is "
+                "not installed in this environment. Use the default "
+                "format='stablehlo' export (loadable via paddle_tpu.jit.load "
+                "/ inference.Predictor), or install onnx."
+            ) from e
+        raise NotImplementedError(
+            "onnx graph conversion is not implemented; export StableHLO "
+            "instead (the TPU-native interchange format)"
+        )
+    if format != "stablehlo":
+        raise ValueError(f"unknown export format: {format}")
+    if input_spec is None:
+        raise ValueError(
+            "export requires input_spec (shapes/dtypes of the forward inputs)"
+        )
+    from . import jit
+
+    base = path[:-len(".onnx")] if path.endswith(".onnx") else path
+    jit.save(layer, base, input_spec=input_spec, **configs)
+    return base + ".pdmodel" if not base.endswith(".pdmodel") else base

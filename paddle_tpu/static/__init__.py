@@ -10,6 +10,7 @@ from __future__ import annotations
 import contextlib
 
 from ..jit import InputSpec  # noqa: F401
+from . import nn  # noqa: F401
 from .program import (  # noqa: F401
     Executor, Program, data, default_main_program, default_startup_program,
     program_guard,

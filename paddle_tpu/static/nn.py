@@ -1,0 +1,117 @@
+"""``paddle.static.nn`` — layer functions for static-graph programs.
+
+Reference: python/paddle/static/nn/common.py (fc :~, conv2d, batch_norm,
+embedding, ...). Under this framework's capture model the dynamic layers
+already record into the active Program, so these functions build the layer
+once (parameters register with the startup program's initialization) and
+apply it to the placeholder value."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn as _nn
+from ..ops._helpers import ensure_tensor
+
+__all__ = ["fc", "embedding", "conv2d", "batch_norm", "layer_norm",
+           "sparse_embedding", "prelu", "group_norm"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    x = ensure_tensor(x)
+    from ..ops.manipulation import reshape
+
+    in_features = int(np.prod(x.shape[num_flatten_dims:]))
+    if x.ndim > num_flatten_dims + 1:
+        x = reshape(x, tuple(x.shape[:num_flatten_dims]) + (in_features,))
+    layer = _nn.Linear(in_features, size, weight_attr=weight_attr,
+                       bias_attr=bias_attr)
+    out = layer(x)
+    if activation is not None:
+        out = getattr(_nn.functional, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                          weight_attr=param_attr)
+    if dtype is not None and str(np.dtype(dtype)) != "float32":
+        from ..ops.math import cast
+
+        layer.weight._replace_value(cast(layer.weight, dtype)._value)
+    return layer(ensure_tensor(input))
+
+
+# the reference's distributed lookup-table embedding; dense layout is the
+# TPU-native storage (the PS-backed variant lives in distributed.ps)
+sparse_embedding = embedding
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    x = ensure_tensor(input)
+    in_channels = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    layer = _nn.Conv2D(in_channels, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    out = layer(x)
+    if act is not None:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None):
+    x = ensure_tensor(input)
+    channels = x.shape[1] if data_layout == "NCHW" else x.shape[-1]
+    layer = _nn.BatchNorm2D(channels, momentum=momentum, epsilon=epsilon,
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            data_format=data_layout)
+    if is_test:
+        layer.eval()
+    out = layer(x)
+    if act is not None:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    x = ensure_tensor(input)
+    normalized_shape = list(x.shape[begin_norm_axis:])
+    layer = _nn.LayerNorm(
+        normalized_shape, epsilon=epsilon,
+        weight_attr=param_attr if scale else False,
+        bias_attr=bias_attr if shift else False,
+    )
+    out = layer(x)
+    if act is not None:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    x = ensure_tensor(input)
+    channels = x.shape[1] if data_layout == "NCHW" else x.shape[-1]
+    layer = _nn.GroupNorm(groups, channels, epsilon=epsilon,
+                          weight_attr=param_attr, bias_attr=bias_attr,
+                          data_format=data_layout)
+    out = layer(x)
+    if act is not None:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    num = 1 if mode == "all" else (
+        x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    )
+    layer = _nn.PReLU(num_parameters=num, weight_attr=param_attr,
+                      data_format=data_format)
+    return layer(x)

@@ -1,0 +1,94 @@
+"""paddle.static.nn layer functions + paddle.onnx export surface."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestStaticNN:
+    def test_fc_embedding_in_program(self):
+        main, start = static.Program(), static.Program()
+        with static.program_guard(main, start):
+            ids = static.data("ids", [4, 6], "int64")
+            emb = static.nn.embedding(ids, size=(100, 16))
+            out = static.nn.fc(emb, 8, num_flatten_dims=2, activation="relu")
+        exe = static.Executor()
+        ids_np = np.random.randint(0, 100, (4, 6))
+        (res,) = exe.run(main, feed={"ids": ids_np}, fetch_list=[out])
+        assert res.shape == (4, 6, 8)
+        assert (res >= 0).all()  # relu applied
+
+    def test_conv_bn_group_layer_norm_eager(self):
+        x = paddle.randn([2, 8, 8, 8])
+        y = static.nn.conv2d(x, 16, 3, padding=1, act="relu")
+        assert tuple(y.shape) == (2, 16, 8, 8)
+        z = static.nn.batch_norm(y, is_test=True)
+        assert tuple(z.shape) == (2, 16, 8, 8)
+        g = static.nn.group_norm(y, groups=4)
+        assert tuple(g.shape) == (2, 16, 8, 8)
+        ln = static.nn.layer_norm(paddle.randn([3, 5]), begin_norm_axis=1)
+        assert tuple(ln.shape) == (3, 5)
+        pr = static.nn.prelu(paddle.randn([2, 4, 3, 3]), mode="channel")
+        assert tuple(pr.shape) == (2, 4, 3, 3)
+
+    def test_nhwc_layouts(self):
+        x = paddle.randn([2, 6, 6, 16])  # NHWC
+        bn = static.nn.batch_norm(x, data_layout="NHWC", is_test=True)
+        assert tuple(bn.shape) == (2, 6, 6, 16)
+        gn = static.nn.group_norm(x, groups=4, data_layout="NHWC")
+        assert tuple(gn.shape) == (2, 6, 6, 16)
+        pr = static.nn.prelu(x, mode="channel", data_format="NHWC")
+        assert tuple(pr.shape) == (2, 6, 6, 16)
+
+    def test_layer_norm_no_affine(self):
+        ln = static.nn.layer_norm(paddle.randn([3, 5]), scale=False, shift=False)
+        assert tuple(ln.shape) == (3, 5)
+
+    def test_embedding_dtype(self):
+        out = static.nn.embedding(paddle.to_tensor(np.asarray([1, 2])),
+                                  size=(10, 4), dtype="float64")
+        assert str(np.dtype(out.dtype)) == "float64"
+
+    def test_fc_flattens(self):
+        x = paddle.randn([3, 4, 5])
+        out = static.nn.fc(x, 7, num_flatten_dims=1)
+        assert tuple(out.shape) == (3, 7)
+
+
+class TestOnnxExport:
+    def test_stablehlo_export_roundtrip(self, tmp_path):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        path = str(tmp_path / "model")
+        out_path = paddle.onnx.export(
+            model, path, input_spec=[paddle.static.InputSpec([2, 4], "float32")]
+        )
+        assert os.path.exists(out_path)
+        loaded = paddle.jit.load(path)
+        x = np.random.randn(2, 4).astype("float32")
+        ref = _np(model(paddle.to_tensor(x)))
+        np.testing.assert_allclose(loaded(x), ref, rtol=1e-5)
+
+    def test_onnx_format_requires_package(self, tmp_path):
+        import paddle_tpu.nn as nn
+
+        with pytest.raises((ImportError, NotImplementedError)):
+            paddle.onnx.export(
+                nn.Linear(2, 2), str(tmp_path / "m"), format="onnx",
+                input_spec=[paddle.static.InputSpec([1, 2], "float32")],
+            )
+
+    def test_requires_input_spec(self):
+        import paddle_tpu.nn as nn
+
+        with pytest.raises(ValueError):
+            paddle.onnx.export(nn.Linear(2, 2), "m")
