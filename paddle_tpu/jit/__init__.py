@@ -454,6 +454,7 @@ def save(layer, path, input_spec=None, **configs):
                     specs.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
                 elif isinstance(s, InputSpec):
                     specs.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
+            was_training = layer.training
             layer.eval()
 
             def fwd(*xs):
@@ -469,6 +470,10 @@ def save(layer, path, input_spec=None, **configs):
                 payload["in_specs"] = [(tuple(s.shape), str(s.dtype)) for s in specs]
             except Exception as e:  # export is best-effort; params always saved
                 payload["export_error"] = repr(e)
+            finally:
+                # saving must not flip the live model's train/eval state
+                if was_training:
+                    layer.train()
     else:
         payload["state_dict"] = _pack(layer)
     with open(path + (".pdmodel" if not path.endswith(".pdmodel") else ""), "wb") as f:

@@ -20,3 +20,6 @@ from .gpt import (
 from .unet_diffusion import (
     DDPMScheduler, UNet2DConditionModel, UNetConfig,
 )
+from .ernie_moe import (
+    ErnieMoeConfig, ErnieMoeForCausalLM, ErnieMoeModel, ernie_moe_shard_plan,
+)

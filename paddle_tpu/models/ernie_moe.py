@@ -1,0 +1,190 @@
+"""ERNIE-4.5-MoE-style mixture-of-experts causal LM.
+
+BASELINE.json config 4 ("ERNIE-4.5 MoE — expert-parallel all_to_all over
+ICI, fused_moe kernel"). The decoder reuses the Llama attention/RMSNorm
+blocks; FFNs alternate between a dense MLP and a FusedMoELayer whose
+routing dispatch is the einsum the EP sharding turns into the all-to-all
+(incubate/distributed/models/moe). The training loss adds the gates'
+load-balancing aux loss, and ``ernie_moe_shard_plan`` lays out Megatron TP
+for attention + expert-dim sharding for the expert banks over a dp×mp×ep
+mesh.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import nn
+from ..nn import functional as F
+from ..incubate.distributed.models.moe import FusedMoELayer
+from .llama import LlamaAttention, LlamaConfig, LlamaMLP, LlamaRMSNorm
+
+__all__ = ["ErnieMoeConfig", "ErnieMoeForCausalLM", "ErnieMoeModel",
+           "ernie_moe_shard_plan"]
+
+
+@dataclass
+class ErnieMoeConfig(LlamaConfig):
+    num_experts: int = 8
+    moe_top_k: int = 2
+    moe_layer_interval: int = 2      # every k-th decoder layer is MoE
+    moe_intermediate_size: Optional[int] = None
+    aux_loss_weight: float = 0.01
+    gate_type: str = "gshard"
+
+    @staticmethod
+    def tiny(**kw) -> "ErnieMoeConfig":
+        base = dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            num_experts=4, moe_top_k=2, moe_layer_interval=1,
+        )
+        base.update(kw)
+        return ErnieMoeConfig(**base)
+
+    def is_moe_layer(self, idx: int) -> bool:
+        return (idx + 1) % self.moe_layer_interval == 0
+
+
+class ErnieMoeDecoderLayer(nn.Layer):
+    def __init__(self, config: ErnieMoeConfig, layer_idx: int,
+                 moe_group=None):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.input_layernorm = LlamaRMSNorm(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config)
+        self.is_moe = config.is_moe_layer(layer_idx)
+        if self.is_moe:
+            self.mlp = FusedMoELayer(
+                config.hidden_size,
+                config.moe_intermediate_size or config.intermediate_size,
+                config.num_experts,
+                gate={"type": config.gate_type, "topk": config.moe_top_k},
+                moe_group=moe_group,
+            )
+        else:
+            self.mlp = LlamaMLP(config)
+
+    def forward(self, hidden_states, position_ids=None, attention_mask=None):
+        residual = hidden_states
+        hidden_states = self.input_layernorm(hidden_states)
+        hidden_states = self.self_attn(hidden_states, position_ids, attention_mask)
+        hidden_states = residual + hidden_states
+        residual = hidden_states
+        hidden_states = self.post_attention_layernorm(hidden_states)
+        hidden_states = self.mlp(hidden_states)
+        return residual + hidden_states
+
+
+class ErnieMoeModel(nn.Layer):
+    def __init__(self, config: ErnieMoeConfig, moe_group=None):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList([
+            ErnieMoeDecoderLayer(config, i, moe_group=moe_group)
+            for i in range(config.num_hidden_layers)
+        ])
+        self.norm = LlamaRMSNorm(config)
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None):
+        hidden_states = self.embed_tokens(input_ids)
+        if self.config.recompute:
+            from ..distributed.fleet.utils import recompute
+
+            for layer in self.layers:
+                if layer.is_moe:
+                    # MoE layers run un-checkpointed: recompute's no_grad
+                    # forward would detach the gate's load-balancing aux
+                    # loss, silently un-training the router
+                    hidden_states = layer(
+                        hidden_states, position_ids, attention_mask
+                    )
+                else:
+                    hidden_states = recompute(
+                        layer, hidden_states, position_ids, attention_mask
+                    )
+        else:
+            for layer in self.layers:
+                hidden_states = layer(hidden_states, position_ids, attention_mask)
+        return self.norm(hidden_states)
+
+
+class ErnieMoeForCausalLM(nn.Layer):
+    def __init__(self, config: ErnieMoeConfig, moe_group=None):
+        super().__init__()
+        self.config = config
+        self.model = ErnieMoeModel(config, moe_group=moe_group)
+        self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                 bias_attr=False)
+
+    def moe_aux_loss(self):
+        """Sum the gates' pending load-balancing losses (clears them)."""
+        total = None
+        for layer in self.model.layers:
+            gate = getattr(layer.mlp, "gate", None)
+            if gate is not None and hasattr(gate, "get_loss"):
+                l = gate.get_loss(clear=True)
+                if l is not None:
+                    total = l if total is None else total + l
+        return total
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None,
+                labels=None):
+        hidden_states = self.model(input_ids, position_ids, attention_mask)
+        logits = self.lm_head(hidden_states)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]),
+                ignore_index=-100,
+            )
+            aux = self.moe_aux_loss()
+            if aux is not None:
+                loss = loss + self.config.aux_loss_weight * aux
+            return loss, logits
+        return logits
+
+    def num_parameters(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+
+def ernie_moe_shard_plan(model: ErnieMoeForCausalLM, mesh, dp_axis="dp",
+                         mp_axis="mp", ep_axis="ep"):
+    """dp×mp×ep layout: Megatron TP on attention/dense-MLP/vocab, expert-dim
+    sharding on the fused expert banks (GSPMD turns the routing einsums into
+    the all_to_all the reference issues via global_scatter/global_gather)."""
+    import paddle_tpu.distributed as dist
+
+    mp = mesh.dim_names.index(mp_axis) if mp_axis in mesh.dim_names else None
+    ep = mesh.dim_names.index(ep_axis) if ep_axis in mesh.dim_names else None
+
+    def place(p, dim=None, axis_idx=None):
+        placements = [dist.Replicate() for _ in range(mesh.ndim)]
+        target = mp if axis_idx is None else axis_idx
+        if dim is not None and target is not None:
+            placements[target] = dist.Shard(dim)
+        dist.shard_tensor(p, mesh, placements)
+
+    place(model.model.embed_tokens.weight, 0)
+    place(model.lm_head.weight, 1)
+    for layer in model.model.layers:
+        place(layer.self_attn.q_proj.weight, 1)
+        place(layer.self_attn.k_proj.weight, 1)
+        place(layer.self_attn.v_proj.weight, 1)
+        place(layer.self_attn.o_proj.weight, 0)
+        if layer.is_moe:
+            experts = layer.mlp.experts
+            for w in (experts.w0, experts.b0, experts.w1, experts.b1):
+                if ep is not None:
+                    place(w, 0, axis_idx=ep)   # expert dim
+                else:
+                    place(w)
+            if hasattr(layer.mlp.gate, "weight"):
+                place(layer.mlp.gate.weight)
+        else:
+            place(layer.mlp.gate_proj.weight, 1)
+            place(layer.mlp.up_proj.weight, 1)
+            place(layer.mlp.down_proj.weight, 0)
+    return model

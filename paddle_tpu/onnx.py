@@ -47,4 +47,16 @@ def export(layer, path, input_spec=None, opset_version=9, format="stablehlo",
 
     base = path[:-len(".onnx")] if path.endswith(".onnx") else path
     jit.save(layer, base, input_spec=input_spec, **configs)
-    return base + ".pdmodel" if not base.endswith(".pdmodel") else base
+    out_path = base + ".pdmodel" if not base.endswith(".pdmodel") else base
+    # jit.save is best-effort (it always persists params); export promises a
+    # SERVABLE artifact, so surface a trace/export failure loudly
+    import pickle
+
+    with open(out_path, "rb") as f:
+        payload = pickle.load(f)
+    if "serialized" not in payload:
+        raise RuntimeError(
+            "StableHLO export of the forward failed; the saved file holds "
+            f"parameters only. Cause: {payload.get('export_error', 'unknown')}"
+        )
+    return out_path

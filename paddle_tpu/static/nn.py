@@ -107,11 +107,34 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
     return out
 
 
+class _ElementwisePReLU(_nn.Layer):
+    """One alpha per (non-batch) element — the reference's mode='element'."""
+
+    def __init__(self, shape, weight_attr=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            list(shape), attr=weight_attr,
+            default_initializer=_nn.initializer.Constant(0.25),
+        )
+
+    def forward(self, x):
+        from ..ops.math import maximum, minimum
+
+        return maximum(x, 0.0) + self.weight * minimum(x, 0.0)
+
+
 def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
     x = ensure_tensor(x)
-    num = 1 if mode == "all" else (
-        x.shape[1] if data_format == "NCHW" else x.shape[-1]
-    )
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    elif mode == "element":
+        return _ElementwisePReLU(x.shape[1:], weight_attr=param_attr)(x)
+    else:
+        raise ValueError(
+            f"mode should be 'all', 'channel' or 'element', but got {mode!r}"
+        )
     layer = _nn.PReLU(num_parameters=num, weight_attr=param_attr,
                       data_format=data_format)
     return layer(x)

@@ -92,3 +92,32 @@ class TestOnnxExport:
 
         with pytest.raises(ValueError):
             paddle.onnx.export(nn.Linear(2, 2), "m")
+
+    def test_export_preserves_training_mode(self, tmp_path):
+        import paddle_tpu.nn as nn
+
+        model = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        model.train()
+        paddle.onnx.export(model, str(tmp_path / "m"),
+                           input_spec=[paddle.static.InputSpec([2, 4], "float32")])
+        assert model.training and model[1].training
+
+    def test_export_fails_loudly_on_untraceable_forward(self, tmp_path):
+        import paddle_tpu.nn as nn
+
+        class Bad(nn.Layer):
+            def forward(self, x):
+                if float(x.sum()._value) > 0:  # data-dependent Python branch
+                    return x
+                return -x
+
+        with pytest.raises(RuntimeError, match="StableHLO export"):
+            paddle.onnx.export(Bad(), str(tmp_path / "bad"),
+                               input_spec=[paddle.static.InputSpec([2, 4], "float32")])
+
+    def test_prelu_modes(self):
+        x = paddle.randn([2, 4, 3, 3])
+        elem = static.nn.prelu(x, mode="element")
+        assert tuple(elem.shape) == (2, 4, 3, 3)
+        with pytest.raises(ValueError):
+            static.nn.prelu(x, mode="chanel")
