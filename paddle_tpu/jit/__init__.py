@@ -478,6 +478,7 @@ def save(layer, path, input_spec=None, **configs):
         payload["state_dict"] = _pack(layer)
     with open(path + (".pdmodel" if not path.endswith(".pdmodel") else ""), "wb") as f:
         pickle.dump(payload, f, protocol=4)
+    return payload
 
 
 class InputSpec:

@@ -150,11 +150,13 @@ class ErnieMoeForCausalLM(nn.Layer):
         return sum(p.size for p in self.parameters())
 
 
-def ernie_moe_shard_plan(model: ErnieMoeForCausalLM, mesh, dp_axis="dp",
-                         mp_axis="mp", ep_axis="ep"):
-    """dp×mp×ep layout: Megatron TP on attention/dense-MLP/vocab, expert-dim
-    sharding on the fused expert banks (GSPMD turns the routing einsums into
-    the all_to_all the reference issues via global_scatter/global_gather)."""
+def ernie_moe_shard_plan(model: ErnieMoeForCausalLM, mesh, mp_axis="mp",
+                         ep_axis="ep"):
+    """mp×ep layout: Megatron TP on attention/dense-MLP/vocab (when
+    ``mp_axis`` exists in the mesh), expert-dim sharding on the fused expert
+    banks (GSPMD turns the routing einsums into the all_to_all the reference
+    issues via global_scatter/global_gather). Data parallelism needs no
+    parameter placement — it comes from sharding the batch inputs."""
     import paddle_tpu.distributed as dist
 
     mp = mesh.dim_names.index(mp_axis) if mp_axis in mesh.dim_names else None

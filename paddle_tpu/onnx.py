@@ -46,17 +46,19 @@ def export(layer, path, input_spec=None, opset_version=9, format="stablehlo",
     from . import jit
 
     base = path[:-len(".onnx")] if path.endswith(".onnx") else path
-    jit.save(layer, base, input_spec=input_spec, **configs)
+    payload = jit.save(layer, base, input_spec=input_spec, **configs)
     out_path = base + ".pdmodel" if not base.endswith(".pdmodel") else base
-    # jit.save is best-effort (it always persists params); export promises a
-    # SERVABLE artifact, so surface a trace/export failure loudly
-    import pickle
-
-    with open(out_path, "rb") as f:
-        payload = pickle.load(f)
     if "serialized" not in payload:
+        # jit.save is best-effort (params always persist); export promises a
+        # SERVABLE artifact — remove the params-only file and fail loudly
+        import os
+
+        try:
+            os.remove(out_path)
+        except OSError:
+            pass
         raise RuntimeError(
-            "StableHLO export of the forward failed; the saved file holds "
-            f"parameters only. Cause: {payload.get('export_error', 'unknown')}"
+            "StableHLO export of the forward failed. Cause: "
+            f"{payload.get('export_error', 'unknown')}"
         )
     return out_path

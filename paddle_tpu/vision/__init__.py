@@ -1,3 +1,4 @@
 """paddle.vision parity. Reference: python/paddle/vision/__init__.py."""
 from . import datasets, models, transforms  # noqa: F401
 from .models import *  # noqa: F401,F403
+from . import ops  # noqa: F401

@@ -1,0 +1,573 @@
+"""Detection / vision operators — ``paddle.vision.ops`` parity.
+
+Reference: python/paddle/vision/ops.py (yolo_box :275, prior_box :436,
+box_coder :582, deform_conv2d :764, roi_pool :1571, roi_align :1704,
+nms :1933, psroi_pool :1440, distribute_fpn_proposals :1172).
+
+Device ops (roi_align/roi_pool/psroi_pool/deform_conv2d/yolo_box/box_coder/
+prior_box) are single fused XLA programs built from gathers and einsums —
+differentiable where the reference's are. Selection ops with
+data-dependent output sizes (nms, distribute_fpn_proposals) run host-side
+in numpy, matching the reference's CPU kernels in role."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ..ops._helpers import defprim, ensure_tensor
+
+__all__ = [
+    "yolo_box", "prior_box", "box_coder", "deform_conv2d", "roi_pool",
+    "roi_align", "psroi_pool", "nms", "distribute_fpn_proposals",
+    "read_file", "decode_jpeg",
+]
+
+
+# --------------------------------------------------------------------------
+# RoI ops
+# --------------------------------------------------------------------------
+def _bilinear(img, y, x):
+    """Bilinear sample img (C,H,W) at float coords y,x (...,) → (C, ...)."""
+    h, w = img.shape[-2], img.shape[-1]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1, wx1 = y - y0, x - x0
+    wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+
+    def at(yy, xx):
+        yy = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xx = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        return img[:, yy, xx]
+
+    valid = (y > -1.0) & (y < h) & (x > -1.0) & (x < w)
+    out = (
+        at(y0, x0) * (wy0 * wx0)
+        + at(y0, x1) * (wy0 * wx1)
+        + at(y1, x0) * (wy1 * wx0)
+        + at(y1, x1) * (wy1 * wx1)
+    )
+    return jnp.where(valid, out, 0.0)
+
+
+def _roi_align_fwd(x, boxes, box_img_idx, *, output_size, spatial_scale,
+                   sampling_ratio, aligned):
+    ph, pw = output_size
+
+    def one_roi(box, img_idx):
+        img = x[img_idx]
+        offset = 0.5 if aligned else 0.0
+        x1 = box[0] * spatial_scale - offset
+        y1 = box[1] * spatial_scale - offset
+        x2 = box[2] * spatial_scale - offset
+        y2 = box[3] * spatial_scale - offset
+        rh = y2 - y1
+        rw = x2 - x1
+        if not aligned:
+            rh = jnp.maximum(rh, 1.0)
+            rw = jnp.maximum(rw, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        n = sampling_ratio if sampling_ratio > 0 else 2
+        iy = (jnp.arange(n) + 0.5) / n
+        # sample grid: (ph, n) y-coords per bin row, likewise x
+        ys = y1 + (jnp.arange(ph)[:, None] + iy[None, :]) * bin_h  # (ph, n)
+        xs = x1 + (jnp.arange(pw)[:, None] + iy[None, :]) * bin_w  # (pw, n)
+        yy = ys.reshape(-1)[:, None]            # (ph*n, 1)
+        xx = xs.reshape(-1)[None, :]            # (1, pw*n)
+        samples = _bilinear(img, jnp.broadcast_to(yy, (ph * n, pw * n)),
+                            jnp.broadcast_to(xx, (ph * n, pw * n)))
+        c = samples.shape[0]
+        samples = samples.reshape(c, ph, n, pw, n)
+        return samples.mean(axis=(2, 4))        # (C, ph, pw)
+
+    return jax.vmap(one_roi)(boxes, box_img_idx)
+
+
+defprim("roi_align_p", _roi_align_fwd)
+
+
+def _box_image_index(boxes_num):
+    counts = np.asarray(boxes_num, "int64")
+    return np.repeat(np.arange(len(counts)), counts).astype("int32")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    x, boxes = ensure_tensor(x), ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    idx = Tensor._from_value(
+        jnp.asarray(_box_image_index(ensure_tensor(boxes_num)._value))
+    )
+    return apply("roi_align_p", x, boxes, idx,
+                 output_size=tuple(int(s) for s in output_size),
+                 spatial_scale=float(spatial_scale),
+                 sampling_ratio=int(sampling_ratio), aligned=bool(aligned))
+
+
+def _roi_pool_fwd(x, boxes, box_img_idx, *, output_size, spatial_scale):
+    ph, pw = output_size
+    h, w = x.shape[-2], x.shape[-1]
+
+    def one_roi(box, img_idx):
+        img = x[img_idx]
+        x1 = jnp.round(box[0] * spatial_scale)
+        y1 = jnp.round(box[1] * spatial_scale)
+        x2 = jnp.round(box[2] * spatial_scale)
+        y2 = jnp.round(box[3] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        ys = jnp.arange(h)[None, :]             # (1, H)
+        xs = jnp.arange(w)[None, :]             # (1, W)
+        # bin membership masks per output cell
+        bins_y0 = y1 + jnp.floor(jnp.arange(ph) * rh / ph)
+        bins_y1 = y1 + jnp.ceil((jnp.arange(ph) + 1) * rh / ph)
+        bins_x0 = x1 + jnp.floor(jnp.arange(pw) * rw / pw)
+        bins_x1 = x1 + jnp.ceil((jnp.arange(pw) + 1) * rw / pw)
+        my = (ys >= bins_y0[:, None]) & (ys < bins_y1[:, None])  # (ph, H)
+        mx = (xs >= bins_x0[:, None]) & (xs < bins_x1[:, None])  # (pw, W)
+        mask = my[:, None, :, None] & mx[None, :, None, :]       # (ph,pw,H,W)
+        vals = jnp.where(mask[None], img[:, None, None, :, :], -jnp.inf)
+        out = vals.max(axis=(-2, -1))                            # (C, ph, pw)
+        return jnp.where(jnp.isinf(out), 0.0, out)
+
+    return jax.vmap(one_roi)(boxes, box_img_idx)
+
+
+defprim("roi_pool_p", _roi_pool_fwd)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    x, boxes = ensure_tensor(x), ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    idx = Tensor._from_value(
+        jnp.asarray(_box_image_index(ensure_tensor(boxes_num)._value))
+    )
+    return apply("roi_pool_p", x, boxes, idx,
+                 output_size=tuple(int(s) for s in output_size),
+                 spatial_scale=float(spatial_scale))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI pooling (reference ops.py:1440): input channels
+    C = output_channels*ph*pw; output cell (i,j) averages its own channel
+    group within the bin."""
+    x, boxes = ensure_tensor(x), ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = (int(s) for s in output_size)
+    if x.shape[1] % (ph * pw):
+        raise ValueError(
+            f"input channel ({x.shape[1]}) must be divisible by "
+            f"output_size^2 ({ph * pw})"
+        )
+    idx = Tensor._from_value(
+        jnp.asarray(_box_image_index(ensure_tensor(boxes_num)._value))
+    )
+    return apply("psroi_pool_p", x, boxes, idx, output_size=(ph, pw),
+                 spatial_scale=float(spatial_scale))
+
+
+def _psroi_pool_fwd(x, boxes, box_img_idx, *, output_size, spatial_scale):
+    ph, pw = output_size
+    out_c = x.shape[1] // (ph * pw)
+    h, w = x.shape[-2], x.shape[-1]
+
+    def one_roi(box, img_idx):
+        img = x[img_idx].reshape(out_c, ph, pw, h, w)
+        x1 = box[0] * spatial_scale
+        y1 = box[1] * spatial_scale
+        rh = jnp.maximum(box[3] * spatial_scale - y1, 0.1)
+        rw = jnp.maximum(box[2] * spatial_scale - x1, 0.1)
+        ys = jnp.arange(h)[None, :]
+        xs = jnp.arange(w)[None, :]
+        bins_y0 = jnp.floor(y1 + jnp.arange(ph) * rh / ph)
+        bins_y1 = jnp.ceil(y1 + (jnp.arange(ph) + 1) * rh / ph)
+        bins_x0 = jnp.floor(x1 + jnp.arange(pw) * rw / pw)
+        bins_x1 = jnp.ceil(x1 + (jnp.arange(pw) + 1) * rw / pw)
+        my = (ys >= bins_y0[:, None]) & (ys < bins_y1[:, None])
+        mx = (xs >= bins_x0[:, None]) & (xs < bins_x1[:, None])
+        mask = (my[:, None, :, None] & mx[None, :, None, :]).astype(img.dtype)
+        # img[c, i, j, :, :] averaged over its (i, j) bin
+        s = jnp.einsum("cijhw,ijhw->cij", img, mask)
+        cnt = jnp.maximum(mask.sum(axis=(-2, -1)), 1.0)
+        return s / cnt
+
+    return jax.vmap(one_roi)(boxes, box_img_idx)
+
+
+defprim("psroi_pool_p", _psroi_pool_fwd)
+
+
+# --------------------------------------------------------------------------
+# box ops
+# --------------------------------------------------------------------------
+def _box_coder_fwd(prior_box, prior_var, target_box, *, code_type, normalized,
+                   axis):
+    if code_type == "encode_center_size":
+        pw = prior_box[:, 2] - prior_box[:, 0] + (0 if normalized else 1)
+        ph_ = prior_box[:, 3] - prior_box[:, 1] + (0 if normalized else 1)
+        px = prior_box[:, 0] + pw * 0.5
+        py = prior_box[:, 1] + ph_ * 0.5
+        tw = target_box[:, 2] - target_box[:, 0] + (0 if normalized else 1)
+        th = target_box[:, 3] - target_box[:, 1] + (0 if normalized else 1)
+        tx = target_box[:, 0] + tw * 0.5
+        ty = target_box[:, 1] + th * 0.5
+        out = jnp.stack([
+            (tx[:, None] - px[None, :]) / pw[None, :],
+            (ty[:, None] - py[None, :]) / ph_[None, :],
+            jnp.log(tw[:, None] / pw[None, :]),
+            jnp.log(th[:, None] / ph_[None, :]),
+        ], axis=-1)                              # (T, P, 4)
+        return out / prior_var[None, :, :]
+    # decode_center_size: target (N, P, 4) deltas around priors
+    pb = jnp.expand_dims(prior_box, axis)        # broadcast along axis
+    pv = jnp.expand_dims(prior_var, axis)
+    pw = pb[..., 2] - pb[..., 0] + (0 if normalized else 1)
+    ph_ = pb[..., 3] - pb[..., 1] + (0 if normalized else 1)
+    px = pb[..., 0] + pw * 0.5
+    py = pb[..., 1] + ph_ * 0.5
+    d = target_box * pv
+    ox = d[..., 0] * pw + px
+    oy = d[..., 1] * ph_ + py
+    ow = jnp.exp(d[..., 2]) * pw
+    oh = jnp.exp(d[..., 3]) * ph_
+    sub = 0 if normalized else 1
+    return jnp.stack([
+        ox - ow * 0.5, oy - oh * 0.5,
+        ox + ow * 0.5 - sub, oy + oh * 0.5 - sub,
+    ], axis=-1)
+
+
+defprim("box_coder_p", _box_coder_fwd)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    if code_type not in ("encode_center_size", "decode_center_size"):
+        raise ValueError(f"unknown code_type: {code_type}")
+    prior_box = ensure_tensor(prior_box)
+    target_box = ensure_tensor(target_box)
+    if isinstance(prior_box_var, (list, tuple)):
+        pv = Tensor._from_value(
+            jnp.broadcast_to(jnp.asarray(prior_box_var, jnp.float32),
+                             tuple(prior_box.shape))
+        )
+    else:
+        pv = ensure_tensor(prior_box_var)
+    return apply("box_coder_p", prior_box, pv, target_box,
+                 code_type=code_type, normalized=bool(box_normalized),
+                 axis=int(axis))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (reference ops.py:436) — host-built constant tables
+    (depend only on shapes/config), returned as device tensors."""
+    input, image = ensure_tensor(input), ensure_tensor(image)
+    fh, fw = input.shape[-2], input.shape[-1]
+    ih, iw = image.shape[-2], image.shape[-1]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    whs = []
+    for k, ms in enumerate(min_sizes):
+        ms = float(ms)
+        ar_whs = [
+            (ms * math.sqrt(ar), ms / math.sqrt(ar))
+            for ar in ars if abs(ar - 1.0) >= 1e-6
+        ]
+        big = (
+            [(math.sqrt(ms * float(max_sizes[k])),) * 2] if max_sizes else []
+        )
+        if min_max_aspect_ratios_order:
+            whs += [(ms, ms)] + big + ar_whs     # min, max, ARs (reference)
+        else:
+            whs += [(ms, ms)] + ar_whs + big     # min, ARs, max (reference)
+
+    boxes = np.zeros((fh, fw, len(whs), 4), "float32")
+    for i in range(fh):
+        cy = (i + offset) * step_h
+        for j in range(fw):
+            cx = (j + offset) * step_w
+            for k, (bw, bh) in enumerate(whs):
+                boxes[i, j, k] = [
+                    (cx - bw / 2) / iw, (cy - bh / 2) / ih,
+                    (cx + bw / 2) / iw, (cy + bh / 2) / ih,
+                ]
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(
+        np.asarray(variance, "float32"), boxes.shape
+    ).copy()
+    return Tensor._from_value(jnp.asarray(boxes)), Tensor._from_value(
+        jnp.asarray(var)
+    )
+
+
+def _yolo_box_fwd(x, img_size, *, anchors, class_num, conf_thresh,
+                  downsample_ratio, clip_bbox, scale_x_y, iou_aware,
+                  iou_aware_factor):
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    if iou_aware:
+        # layout (reference yolo_box op): first na channels are per-anchor
+        # IoU predictions, then the standard na*(5+cls) block
+        ioup = jax.nn.sigmoid(x[:, :na])            # (n, na, h, w)
+        x = x[:, na:]
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=jnp.float32)[None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[:, None]
+    sx, sy = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    bx = (jax.nn.sigmoid(x[:, :, 0]) * sx + sy + gx) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) * sx + sy + gy) / h
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / (downsample_ratio * w)
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / (downsample_ratio * h)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1.0 - iou_aware_factor) * ioup ** iou_aware_factor
+    keep = conf >= conf_thresh
+    conf = jnp.where(keep, conf, 0.0)
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    imh = img_size[:, 0].astype(jnp.float32)[:, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None]
+    x1 = (bx - bw / 2).reshape(n, -1) * imw
+    y1 = (by - bh / 2).reshape(n, -1) * imh
+    x2 = (bx + bw / 2).reshape(n, -1) * imw
+    y2 = (by + bh / 2).reshape(n, -1) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, imw - 1)
+        y1 = jnp.clip(y1, 0.0, imh - 1)
+        x2 = jnp.clip(x2, 0.0, imw - 1)
+        y2 = jnp.clip(y2, 0.0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+    # reference zeroes suppressed predictions' boxes too, not just scores
+    boxes = boxes * keep.reshape(n, -1)[..., None]
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    return boxes, scores
+
+
+defprim("yolo_box_p", _yolo_box_fwd, multi_out=True)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    x, img_size = ensure_tensor(x), ensure_tensor(img_size)
+    return apply("yolo_box_p", x, img_size, anchors=tuple(int(a) for a in anchors),
+                 class_num=int(class_num), conf_thresh=float(conf_thresh),
+                 downsample_ratio=int(downsample_ratio),
+                 clip_bbox=bool(clip_bbox), scale_x_y=float(scale_x_y),
+                 iou_aware=bool(iou_aware),
+                 iou_aware_factor=float(iou_aware_factor))
+
+
+# --------------------------------------------------------------------------
+# deformable convolution
+# --------------------------------------------------------------------------
+def _deform_conv2d_fwd(x, offset, weight, mask, *, stride, padding, dilation,
+                       deformable_groups, groups, use_mask):
+    n, cin, h, w = x.shape
+    cout, cin_g, kh, kw = weight.shape
+    sh, sw = stride
+    ph_, pw_ = padding
+    dh, dw = dilation
+    out_h = (h + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+    out_w = (w + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)))
+
+    base_y = (jnp.arange(out_h) * sh)[:, None, None]            # (oh,1,1)
+    base_x = (jnp.arange(out_w) * sw)[None, :, None]            # (1,ow,1)
+    k_y = jnp.repeat(jnp.arange(kh) * dh, kw)                   # (kh*kw,)
+    k_x = jnp.tile(jnp.arange(kw) * dw, kh)                     # (kh*kw,)
+
+    off = offset.reshape(n, deformable_groups, kh * kw, 2, out_h, out_w)
+    m = (mask.reshape(n, deformable_groups, kh * kw, out_h, out_w)
+         if use_mask else None)
+    ch_per_dg = cin // deformable_groups
+
+    def one_image(img, off_i, m_i):
+        def one_dg(dg):
+            ys = base_y + k_y[None, None, :] + off_i[dg, :, 0].transpose(1, 2, 0)
+            xs = base_x + k_x[None, None, :] + off_i[dg, :, 1].transpose(1, 2, 0)
+            chans = jax.lax.dynamic_slice_in_dim(img, dg * ch_per_dg, ch_per_dg, 0)
+            samp = _bilinear(chans, ys, xs)          # (ch, oh, ow, kk)
+            if m_i is not None:
+                samp = samp * m_i[dg].transpose(1, 2, 0)[None]
+            return samp
+
+        return jnp.concatenate(
+            [one_dg(dg) for dg in range(deformable_groups)], axis=0
+        )  # (cin, oh, ow, kk)
+
+    cols = jax.vmap(lambda im, of: one_image(
+        im, of, None))(xp, off) if m is None else jax.vmap(one_image)(xp, off, m)
+    # (n, cin, oh, ow, kh*kw) x weight (cout, cin/g, kh*kw) with groups
+    wflat = weight.reshape(groups, cout // groups, cin_g, kh * kw)
+    cols = cols.reshape(n, groups, cin // groups, out_h, out_w, kh * kw)
+    return jnp.einsum("ngchwk,gock->ngohw", cols, wflat).reshape(
+        n, cout, out_h, out_w
+    )
+
+
+defprim("deform_conv2d_p", _deform_conv2d_fwd)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    x, offset, weight = ensure_tensor(x), ensure_tensor(offset), ensure_tensor(weight)
+    use_mask = mask is not None
+    mask_t = ensure_tensor(mask) if use_mask else Tensor._from_value(
+        jnp.zeros((1,), jnp.float32)
+    )
+    out = apply("deform_conv2d_p", x, offset, weight, mask_t,
+                stride=pair(stride), padding=pair(padding),
+                dilation=pair(dilation),
+                deformable_groups=int(deformable_groups), groups=int(groups),
+                use_mask=use_mask)
+    if bias is not None:
+        out = out + ensure_tensor(bias).reshape([1, -1, 1, 1])
+    return out
+
+
+# --------------------------------------------------------------------------
+# selection ops (host-side: data-dependent output sizes)
+# --------------------------------------------------------------------------
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    ix1 = np.maximum(x1[:, None], x1[None, :])
+    iy1 = np.maximum(y1[:, None], y1[None, :])
+    ix2 = np.minimum(x2[:, None], x2[None, :])
+    iy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / np.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS (reference ops.py:1933): returns kept indices, score-sorted;
+    with category_idxs the suppression is per-category."""
+    b = np.asarray(ensure_tensor(boxes)._value, "float64")
+    n = b.shape[0]
+    s = (np.asarray(ensure_tensor(scores)._value, "float64")
+         if scores is not None else None)
+
+    iou = _iou_matrix(b)
+
+    def hard_nms(idxs):
+        order = idxs if s is None else idxs[np.argsort(-s[idxs], kind="stable")]
+        keep = []
+        suppressed = np.zeros(n, bool)
+        for i in order:
+            if suppressed[i]:
+                continue
+            keep.append(i)
+            suppressed |= iou[i] > iou_threshold
+            suppressed[i] = True
+        return keep
+
+    if category_idxs is None:
+        keep = hard_nms(np.arange(n))
+    else:
+        cats = np.asarray(ensure_tensor(category_idxs)._value)
+        if categories is None:
+            raise ValueError("categories is required when category_idxs is given")
+        keep = []
+        for c in categories:
+            keep.extend(hard_nms(np.nonzero(cats == c)[0]))
+        if s is not None:
+            keep = sorted(keep, key=lambda i: -s[i])
+    if top_k is not None:
+        keep = keep[: int(top_k)]
+    return Tensor._from_value(jnp.asarray(np.asarray(keep, "int64")))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels by scale (reference ops.py:1172). Returns
+    (rois per level, restore index, rois_num per level when given)."""
+    rois = np.asarray(ensure_tensor(fpn_rois)._value, "float64")
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(
+        np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+        * np.maximum(rois[:, 3] - rois[:, 1] + off, 0)
+    )
+    level = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    level = np.clip(level, min_level, max_level).astype("int64")
+    num_levels = max_level - min_level + 1
+    outs, order = [], []
+    for lv in range(min_level, min_level + num_levels):
+        idx = np.nonzero(level == lv)[0]
+        order.append(idx)
+        outs.append(Tensor._from_value(jnp.asarray(rois[idx].astype("float32"))))
+    order_cat = np.concatenate(order) if order else np.zeros(0, "int64")
+    restore = np.empty_like(order_cat)
+    restore[order_cat] = np.arange(len(order_cat))
+    restore_t = Tensor._from_value(jnp.asarray(restore.astype("int32")[:, None]))
+    if rois_num is not None:
+        counts = np.asarray(ensure_tensor(rois_num)._value, "int64")
+        img_of_roi = np.repeat(np.arange(len(counts)), counts)
+        per_level_counts = [
+            Tensor._from_value(jnp.asarray(np.bincount(
+                img_of_roi[level == lv], minlength=len(counts)
+            ).astype("int32")))
+            for lv in range(min_level, min_level + num_levels)
+        ]
+        return outs, restore_t, per_level_counts
+    return outs, restore_t, None
+
+
+# --------------------------------------------------------------------------
+# image IO
+# --------------------------------------------------------------------------
+def read_file(filename, name=None):
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor._from_value(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG decode via PIL (the reference uses nvjpeg on GPU; decode is a
+    host-side input-pipeline op on TPU)."""
+    import io
+
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise ImportError("decode_jpeg requires Pillow") from e
+
+    raw = bytes(np.asarray(ensure_tensor(x)._value, np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor._from_value(jnp.asarray(arr))

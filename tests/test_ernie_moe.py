@@ -89,7 +89,7 @@ class TestExpertParallel:
         mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2), ["dp", "ep"])
         cfg = ErnieMoeConfig.tiny(num_experts=4)
         model = ErnieMoeForCausalLM(cfg)
-        ernie_moe_shard_plan(model, mesh, dp_axis="dp", mp_axis="ep", ep_axis="ep")
+        ernie_moe_shard_plan(model, mesh, mp_axis="ep", ep_axis="ep")
         moe_layer = next(l for l in model.model.layers if l.is_moe)
         assert moe_layer.mlp.experts.w0._dist_attr is not None
         optimizer = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())

@@ -1,0 +1,175 @@
+"""paddle.vision.ops vs handwritten oracles (reference test model:
+test/legacy_test/test_roi_align_op.py, test_nms_op.py, test_yolo_box_op.py,
+test_box_coder_op.py, test_deform_conv2d.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestRoiOps:
+    def test_roi_align_constant_region(self):
+        # constant image → any aligned roi pools to the constant
+        x = np.full((1, 3, 16, 16), 7.0, "float32")
+        boxes = np.asarray([[2.0, 2.0, 10.0, 10.0]], "float32")
+        out = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.asarray([1], "int32")),
+                          output_size=4)
+        assert _np(out).shape == (1, 3, 4, 4)
+        np.testing.assert_allclose(_np(out), 7.0, rtol=1e-5)
+
+    def test_roi_align_gradient(self):
+        x = paddle.to_tensor(np.random.randn(1, 2, 8, 8).astype("float32"),
+                             stop_gradient=False)
+        boxes = paddle.to_tensor(np.asarray([[1.0, 1.0, 6.0, 6.0]], "float32"))
+        out = V.roi_align(x, boxes, paddle.to_tensor(np.asarray([1], "int32")),
+                          output_size=2)
+        out.sum().backward()
+        assert x.grad is not None
+        assert float(np.abs(_np(x.grad)).sum()) > 0
+
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 8, 8), "float32")
+        x[0, 0, 3, 3] = 5.0
+        out = V.roi_pool(paddle.to_tensor(x),
+                         paddle.to_tensor(np.asarray([[0.0, 0.0, 7.0, 7.0]], "float32")),
+                         paddle.to_tensor(np.asarray([1], "int32")),
+                         output_size=2)
+        assert _np(out).max() == 5.0
+
+    def test_psroi_pool_shapes(self):
+        x = np.random.randn(1, 2 * 2 * 3, 10, 10).astype("float32")
+        out = V.psroi_pool(paddle.to_tensor(x),
+                           paddle.to_tensor(np.asarray([[0.0, 0.0, 9.0, 9.0]], "float32")),
+                           paddle.to_tensor(np.asarray([1], "int32")),
+                           output_size=2)
+        assert _np(out).shape == (1, 3, 2, 2)
+        with pytest.raises(ValueError):
+            V.psroi_pool(paddle.to_tensor(x),
+                         paddle.to_tensor(np.asarray([[0.0, 0.0, 9.0, 9.0]], "float32")),
+                         paddle.to_tensor(np.asarray([1], "int32")),
+                         output_size=5)
+
+    def test_roi_align_multi_image(self):
+        x = np.stack([np.full((3, 8, 8), 1.0), np.full((3, 8, 8), 2.0)]).astype("float32")
+        boxes = np.asarray([[0, 0, 7, 7], [0, 0, 7, 7]], "float32")
+        out = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.asarray([1, 1], "int32")),
+                          output_size=2)
+        np.testing.assert_allclose(_np(out)[0], 1.0, rtol=1e-5)
+        np.testing.assert_allclose(_np(out)[1], 2.0, rtol=1e-5)
+
+
+class TestBoxOps:
+    def test_box_coder_roundtrip(self):
+        priors = np.asarray([[10, 10, 30, 30], [5, 20, 25, 50]], "float32")
+        var = [0.1, 0.1, 0.2, 0.2]
+        targets = np.asarray([[12, 8, 33, 28]], "float32")
+        enc = V.box_coder(paddle.to_tensor(priors), var, paddle.to_tensor(targets),
+                          code_type="encode_center_size")
+        assert _np(enc).shape == (1, 2, 4)
+        dec = V.box_coder(paddle.to_tensor(priors), var, enc,
+                          code_type="decode_center_size", axis=0)
+        np.testing.assert_allclose(
+            _np(dec)[0], np.repeat(targets, 2, 0), rtol=1e-4, atol=1e-3)
+
+    def test_prior_box(self):
+        feat = paddle.zeros([1, 8, 4, 4])
+        img = paddle.zeros([1, 3, 32, 32])
+        boxes, var = V.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                                 aspect_ratios=[2.0], clip=True)
+        b = _np(boxes)
+        assert b.shape[:2] == (4, 4) and b.shape[-1] == 4
+        assert (b >= 0).all() and (b <= 1).all()
+        assert _np(var).shape == b.shape
+
+    def test_yolo_box(self):
+        n, na, cls, h = 1, 2, 3, 4
+        x = np.random.randn(n, na * (5 + cls), h, h).astype("float32")
+        boxes, scores = V.yolo_box(
+            paddle.to_tensor(x),
+            paddle.to_tensor(np.asarray([[64, 64]], "int32")),
+            anchors=[10, 13, 16, 30], class_num=cls, conf_thresh=0.0,
+            downsample_ratio=16)
+        assert _np(boxes).shape == (1, na * h * h, 4)
+        assert _np(scores).shape == (1, na * h * h, cls)
+        b = _np(boxes)
+        assert (b >= 0).all() and (b <= 63).all()  # clipped to image
+
+
+class TestDeformConv:
+    def test_zero_offset_matches_conv(self):
+        import paddle_tpu.nn.functional as F
+
+        np.random.seed(0)
+        x = np.random.randn(2, 4, 8, 8).astype("float32")
+        w = np.random.randn(6, 4, 3, 3).astype("float32")
+        offset = np.zeros((2, 2 * 3 * 3, 8, 8), "float32")
+        out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                              paddle.to_tensor(w), stride=1, padding=1)
+        ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), stride=1, padding=1)
+        np.testing.assert_allclose(_np(out), _np(ref), rtol=1e-3, atol=1e-4)
+
+    def test_mask_scales_output(self):
+        x = np.random.randn(1, 2, 6, 6).astype("float32")
+        w = np.random.randn(2, 2, 3, 3).astype("float32")
+        offset = np.zeros((1, 18, 6, 6), "float32")
+        half_mask = np.full((1, 9, 6, 6), 0.5, "float32")
+        out_full = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                                   paddle.to_tensor(w), padding=1)
+        out_half = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                                   paddle.to_tensor(w), padding=1,
+                                   mask=paddle.to_tensor(half_mask))
+        np.testing.assert_allclose(_np(out_half), 0.5 * _np(out_full),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestSelection:
+    def test_nms_suppresses_overlaps(self):
+        boxes = np.asarray([
+            [0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], "float32")
+        scores = np.asarray([0.9, 0.8, 0.7], "float32")
+        keep = _np(V.nms(paddle.to_tensor(boxes), 0.5,
+                         scores=paddle.to_tensor(scores)))
+        np.testing.assert_array_equal(keep, [0, 2])
+
+    def test_nms_categories(self):
+        boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11]], "float32")
+        scores = np.asarray([0.9, 0.8], "float32")
+        cats = np.asarray([0, 1])
+        keep = _np(V.nms(paddle.to_tensor(boxes), 0.5,
+                         scores=paddle.to_tensor(scores),
+                         category_idxs=paddle.to_tensor(cats), categories=[0, 1]))
+        assert sorted(keep.tolist()) == [0, 1]  # different class → both kept
+
+    def test_distribute_fpn_proposals(self):
+        rois = np.asarray([
+            [0, 0, 20, 20],      # small → low level
+            [0, 0, 500, 500],    # large → high level
+        ], "float32")
+        outs, restore, _ = V.distribute_fpn_proposals(
+            paddle.to_tensor(rois), 2, 5, 4, 224)
+        assert len(outs) == 4
+        sizes = [len(_np(o)) for o in outs]
+        assert sum(sizes) == 2
+        assert sizes[0] == 1 and sizes[-1] == 1
+        assert sorted(_np(restore)[:, 0].tolist()) == [0, 1]
+
+
+class TestImageIO:
+    def test_read_decode_jpeg(self, tmp_path):
+        pil = pytest.importorskip("PIL.Image")
+        import PIL.Image as Image
+
+        arr = (np.random.rand(16, 16, 3) * 255).astype("uint8")
+        path = str(tmp_path / "img.jpg")
+        Image.fromarray(arr).save(path, quality=95)
+        raw = V.read_file(path)
+        assert _np(raw).dtype == np.uint8
+        img = V.decode_jpeg(raw, mode="rgb")
+        assert _np(img).shape == (3, 16, 16)
