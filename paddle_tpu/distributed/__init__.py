@@ -30,6 +30,7 @@ from . import fleet
 from . import utils
 from . import auto_parallel
 from . import checkpoint
+from . import rpc
 from . import sharding
 from .sharding import group_sharded_parallel, save_group_sharded_model
 from . import elastic

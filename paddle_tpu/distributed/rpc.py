@@ -1,0 +1,243 @@
+"""RPC framework — ``paddle.distributed.rpc`` parity.
+
+Reference: python/paddle/distributed/rpc/rpc.py (init_rpc :73, rpc_sync
+:143, rpc_async :183, shutdown :276, get_worker_info :307) over brpc.
+Here: a threaded TCP server per worker executing pickled callables
+(length-prefixed frames), with the framework TCPStore as the rendezvous
+that exchanges (name, ip, port) triples — the same trust model as the
+reference (serialized Python between cluster peers).
+
+The module-level API drives one process-global agent; the ``RpcAgent``
+class underneath is instantiable directly, which is how the tests run a
+multi-worker topology inside one process."""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from collections import namedtuple
+from concurrent.futures import Future
+from typing import Dict, Optional
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "get_current_worker_info", "WorkerInfo",
+           "RpcAgent"]
+
+_DEFAULT_TIMEOUT = 30.0
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send_msg(sock, obj):
+    raw = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<Q", len(raw)) + raw)
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _routable_ip() -> str:
+    """This host's address as peers should dial it: POD_IP (the launcher
+    env contract) when set, else the interface a default route uses,
+    falling back to loopback for single-host runs."""
+    import os
+
+    ip = os.environ.get("POD_IP")
+    if ip:
+        return ip
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+class RpcAgent:
+    """One RPC endpoint: serves incoming calls, issues outgoing ones."""
+
+    def __init__(self, name: str, rank: int, host: str = "0.0.0.0", port: int = 0,
+                 advertise_ip: Optional[str] = None):
+        self.name = name
+        self.rank = rank
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        bound_ip, self.port = self._sock.getsockname()
+        # advertise a peer-dialable address, not the wildcard bind
+        self.ip = advertise_ip or (
+            bound_ip if bound_ip not in ("0.0.0.0", "::") else _routable_ip()
+        )
+        self._stop = threading.Event()
+        self._workers: Dict[str, WorkerInfo] = {}
+        self._accept_thread = threading.Thread(target=self._serve, daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def info(self) -> WorkerInfo:
+        return WorkerInfo(self.name, self.rank, self.ip, self.port)
+
+    def register_workers(self, infos):
+        self._workers = {i.name: WorkerInfo(*i) for i in infos}
+
+    # -- serving --------------------------------------------------------
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = _recv_msg(conn)
+                except (ConnectionError, OSError, EOFError):
+                    return
+                kind = msg[0]
+                if kind == "call":
+                    _, fn, args, kwargs = msg
+                    try:
+                        result = fn(*(args or ()), **(kwargs or {}))
+                        _send_msg(conn, ("ok", result))
+                    except Exception as e:  # noqa: BLE001 — shipped to caller
+                        _send_msg(conn, ("err", e))
+        finally:
+            conn.close()
+
+    # -- calling --------------------------------------------------------
+    def _call(self, to: str, fn, args, kwargs, timeout):
+        w = self._workers.get(to)
+        if w is None:
+            raise ValueError(f"unknown rpc worker: {to!r}")
+        with socket.create_connection((w.ip, w.port),
+                                      timeout=None if timeout <= 0 else timeout) as s:
+            _send_msg(s, ("call", fn, args, kwargs))
+            status, payload = _recv_msg(s)
+        if status == "err":
+            raise payload
+        return payload
+
+    def rpc_sync(self, to, fn, args=None, kwargs=None, timeout=_DEFAULT_TIMEOUT):
+        return self._call(to, fn, args, kwargs, timeout)
+
+    def rpc_async(self, to, fn, args=None, kwargs=None, timeout=_DEFAULT_TIMEOUT):
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self._call(to, fn, args, kwargs, timeout))
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        fut.wait = fut.result  # paddle returns an object with .wait()
+        return fut
+
+    def stop(self):
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        try:
+            poke = socket.create_connection((self.ip, self.port), timeout=1)
+            poke.close()
+        except OSError:
+            pass
+        self._sock.close()
+
+
+# ---------------------------------------------------------------------------
+# module-level API over one process-global agent
+# ---------------------------------------------------------------------------
+_agent: Optional[RpcAgent] = None
+_store = None
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this process's RPC agent and exchange worker infos through the
+    TCPStore rendezvous (reference init_rpc :73; env fallbacks
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER)."""
+    global _agent, _store
+    import os
+
+    from .store import TCPStore
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+                  if world_size is None else world_size)
+    agent = RpcAgent(name, rank)
+    if world_size > 1:
+        master_endpoint = master_endpoint or os.environ.get("PADDLE_MASTER")
+        if master_endpoint is None:
+            raise ValueError("master_endpoint required for world_size > 1")
+        host, port = master_endpoint.rsplit(":", 1)
+        _store = TCPStore(host, int(port), is_master=(rank == 0),
+                          world_size=world_size)
+        _store.set(f"rpc/{rank}", pickle.dumps(tuple(agent.info)))
+        infos = []
+        for r in range(world_size):
+            infos.append(WorkerInfo(*pickle.loads(_store.get(f"rpc/{r}"))))
+    else:
+        infos = [agent.info]
+    agent.register_workers(infos)
+    _agent = agent
+    return agent
+
+
+def _require_agent() -> RpcAgent:
+    if _agent is None:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+    return _agent
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_TIMEOUT):
+    return _require_agent().rpc_sync(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_TIMEOUT):
+    return _require_agent().rpc_async(to, fn, args, kwargs, timeout)
+
+
+def get_worker_info(name):
+    return _require_agent()._workers[name]
+
+
+def get_all_worker_infos():
+    return list(_require_agent()._workers.values())
+
+
+def get_current_worker_info():
+    return _require_agent().info
+
+
+def shutdown():
+    """Stop the local agent (reference shutdown :276 barriers then stops;
+    single-controller tests stop directly)."""
+    global _agent, _store
+    if _agent is not None:
+        _agent.stop()
+        _agent = None
+    if _store is not None:
+        try:
+            _store.close()
+        except Exception:  # noqa: BLE001
+            pass
+        _store = None

@@ -54,6 +54,31 @@ def _bilinear(img, y, x):
     return jnp.where(valid, out, 0.0)
 
 
+def _bilinear_zero_pad(img, y, x):
+    """Bilinear sample where each out-of-bounds TAP contributes zero (the
+    reference deformable-conv im2col convention) — unlike _bilinear's
+    RoIAlign-style edge clamping."""
+    h, w = img.shape[-2], img.shape[-1]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1, wx1 = y - y0, x - x0
+    wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+
+    def at(yy, xx):
+        inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        return img[:, yc, xc] * inb
+
+    return (
+        at(y0, x0) * (wy0 * wx0)
+        + at(y0, x1) * (wy0 * wx1)
+        + at(y1, x0) * (wy1 * wx0)
+        + at(y1, x1) * (wy1 * wx1)
+    )
+
+
 def _roi_align_fwd(x, boxes, box_img_idx, *, output_size, spatial_scale,
                    sampling_ratio, aligned):
     ph, pw = output_size
@@ -97,6 +122,11 @@ def _box_image_index(boxes_num):
 
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference ops.py:1704). XLA requires a static sample grid,
+    so sampling_ratio<=0 uses a FIXED 2x2 grid per bin rather than the
+    reference's per-RoI adaptive ceil(roi_size/pooled_size) — pass an
+    explicit sampling_ratio (detection configs typically use 2) for exact
+    parity with a given reference setting."""
     x, boxes = ensure_tensor(x), ensure_tensor(boxes)
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
@@ -300,16 +330,14 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
         else:
             whs += [(ms, ms)] + ar_whs + big     # min, ARs, max (reference)
 
-    boxes = np.zeros((fh, fw, len(whs), 4), "float32")
-    for i in range(fh):
-        cy = (i + offset) * step_h
-        for j in range(fw):
-            cx = (j + offset) * step_w
-            for k, (bw, bh) in enumerate(whs):
-                boxes[i, j, k] = [
-                    (cx - bw / 2) / iw, (cy - bh / 2) / ih,
-                    (cx + bw / 2) / iw, (cy + bh / 2) / ih,
-                ]
+    cy = ((np.arange(fh, dtype="float32") + offset) * step_h)[:, None, None]
+    cx = ((np.arange(fw, dtype="float32") + offset) * step_w)[None, :, None]
+    wh = np.asarray(whs, "float32")                      # (K, 2)
+    bw = wh[None, None, :, 0] / 2
+    bh = wh[None, None, :, 1] / 2
+    boxes = np.stack(np.broadcast_arrays(
+        (cx - bw) / iw, (cy - bh) / ih, (cx + bw) / iw, (cy + bh) / ih,
+    ), axis=-1).astype("float32")                        # (fh, fw, K, 4)
     if clip:
         boxes = np.clip(boxes, 0.0, 1.0)
     var = np.broadcast_to(
@@ -407,7 +435,7 @@ def _deform_conv2d_fwd(x, offset, weight, mask, *, stride, padding, dilation,
             ys = base_y + k_y[None, None, :] + off_i[dg, :, 0].transpose(1, 2, 0)
             xs = base_x + k_x[None, None, :] + off_i[dg, :, 1].transpose(1, 2, 0)
             chans = jax.lax.dynamic_slice_in_dim(img, dg * ch_per_dg, ch_per_dg, 0)
-            samp = _bilinear(chans, ys, xs)          # (ch, oh, ow, kk)
+            samp = _bilinear_zero_pad(chans, ys, xs)  # (ch, oh, ow, kk)
             if m_i is not None:
                 samp = samp * m_i[dg].transpose(1, 2, 0)[None]
             return samp

@@ -1,0 +1,82 @@
+"""paddle.distributed.rpc over real sockets (reference test model:
+test/rpc/test_rpc.py launching real workers; here agents in one process)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.rpc import RpcAgent, WorkerInfo
+import paddle_tpu.distributed.rpc as rpc
+
+
+def _square(x):
+    return x * x
+
+
+def _add(a, b=0):
+    return a + b
+
+
+def _boom():
+    raise ValueError("remote failure")
+
+
+@pytest.fixture
+def pair():
+    a = RpcAgent("alice", 0)
+    b = RpcAgent("bob", 1)
+    infos = [a.info, b.info]
+    a.register_workers(infos)
+    b.register_workers(infos)
+    yield a, b
+    a.stop()
+    b.stop()
+
+
+class TestAgents:
+    def test_sync_call(self, pair):
+        a, b = pair
+        assert a.rpc_sync("bob", _square, args=(7,)) == 49
+        assert b.rpc_sync("alice", _add, args=(1,), kwargs={"b": 2}) == 3
+
+    def test_async_call(self, pair):
+        a, _ = pair
+        futs = [a.rpc_async("bob", _square, args=(i,)) for i in range(8)]
+        assert [f.wait() for f in futs] == [i * i for i in range(8)]
+
+    def test_numpy_payload(self, pair):
+        a, _ = pair
+        arr = np.arange(6, dtype="float32").reshape(2, 3)
+        out = a.rpc_sync("bob", _square, args=(arr,))
+        np.testing.assert_allclose(out, arr * arr)
+
+    def test_remote_exception_propagates(self, pair):
+        a, _ = pair
+        with pytest.raises(ValueError, match="remote failure"):
+            a.rpc_sync("bob", _boom)
+        fut = a.rpc_async("bob", _boom)
+        with pytest.raises(ValueError):
+            fut.wait()
+
+    def test_unknown_worker(self, pair):
+        a, _ = pair
+        with pytest.raises(ValueError, match="unknown rpc worker"):
+            a.rpc_sync("carol", _square, args=(1,))
+
+    def test_self_call(self, pair):
+        a, _ = pair
+        assert a.rpc_sync("alice", _add, args=(20, 22)) == 42
+
+
+class TestModuleApi:
+    def test_single_worker_lifecycle(self):
+        rpc.init_rpc("solo", rank=0, world_size=1)
+        try:
+            info = rpc.get_current_worker_info()
+            assert info.name == "solo" and info.rank == 0
+            assert rpc.get_worker_info("solo") == info
+            assert rpc.get_all_worker_infos() == [info]
+            assert rpc.rpc_sync("solo", _square, args=(9,)) == 81
+            assert rpc.rpc_async("solo", _add, args=(2, 3)).wait() == 5
+        finally:
+            rpc.shutdown()
+        with pytest.raises(RuntimeError):
+            rpc.rpc_sync("solo", _square, args=(1,))

@@ -115,6 +115,19 @@ class TestDeformConv:
         ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), stride=1, padding=1)
         np.testing.assert_allclose(_np(out), _np(ref), rtol=1e-3, atol=1e-4)
 
+    def test_border_taps_zero_contribution(self):
+        # offset pushing a sample half a pixel above the top row: the
+        # out-of-bounds tap contributes 0, so the sample is 0.5 * row0
+        x = np.zeros((1, 1, 4, 4), "float32")
+        x[0, 0, 0, :] = 2.0
+        w = np.zeros((1, 1, 1, 1), "float32")
+        w[0, 0, 0, 0] = 1.0
+        offset = np.zeros((1, 2, 4, 4), "float32")
+        offset[0, 0] = -0.5   # shift all samples up by half a pixel
+        out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                              paddle.to_tensor(w), stride=1, padding=0)
+        np.testing.assert_allclose(_np(out)[0, 0, 0], 1.0, rtol=1e-6)
+
     def test_mask_scales_output(self):
         x = np.random.randn(1, 2, 6, 6).astype("float32")
         w = np.random.randn(2, 2, 3, 3).astype("float32")
