@@ -1,2 +1,3 @@
 """paddle.incubate parity (MoE, fused ops). Reference: python/paddle/incubate."""
 from . import distributed, nn
+from . import asp  # noqa: F401

@@ -1,0 +1,36 @@
+"""Automatic SParsity (ASP) — ``paddle.incubate.asp`` parity.
+
+Reference: python/paddle/incubate/asp/ (utils.py mask algorithms
+get_mask_1d :192 / get_mask_2d_greedy :334 / get_mask_2d_best :452,
+asp.py decorate :230 / prune_model :316 / set_excluded_layers :52).
+
+n:m structured sparsity (default 2:4): ``prune_model`` computes masks for
+supported layers' weights and applies them; ``decorate`` wraps the
+optimizer so every step re-applies the masks (the reference inserts masked
+update ops), keeping pruned positions at zero through training."""
+from .utils import (
+    MaskAlgo,
+    calculate_density,
+    check_mask_1d,
+    check_mask_2d,
+    check_sparsity,
+    create_mask,
+    get_mask_1d,
+    get_mask_2d_best,
+    get_mask_2d_greedy,
+)
+from .asp import (
+    ASPHelper,
+    OptimizerWithSparsityGuarantee,
+    decorate,
+    prune_model,
+    reset_excluded_layers,
+    set_excluded_layers,
+)
+
+__all__ = [
+    "calculate_density", "check_mask_1d", "check_mask_2d", "check_sparsity",
+    "create_mask", "get_mask_1d", "get_mask_2d_greedy", "get_mask_2d_best",
+    "MaskAlgo", "decorate", "prune_model", "set_excluded_layers",
+    "reset_excluded_layers", "ASPHelper", "OptimizerWithSparsityGuarantee",
+]

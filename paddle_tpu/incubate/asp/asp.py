@@ -1,0 +1,168 @@
+"""ASP orchestration (reference: incubate/asp/asp.py — decorate :230,
+prune_model :316, set_excluded_layers :52, ASPHelper class).
+
+Mask orientation matters: n:m groups must run along the GEMM REDUCTION
+dimension (what sparse matmul hardware consumes — the reference prunes
+``weight_nparray.T``). Linear weights here are [in_features, out_features],
+so their masks are computed on the transpose; conv weights
+[cout, cin, kh, kw] flatten to (cout, reduction) and group directly."""
+from __future__ import annotations
+
+import weakref
+from typing import Dict, List
+
+import numpy as np
+
+from ...nn.layer import Layer
+from .utils import MaskAlgo, check_sparsity, create_mask
+
+_EXCLUDED: set = set()
+_SUPPORTED_TYPES = None
+
+
+def _supported_types():
+    global _SUPPORTED_TYPES
+    if _SUPPORTED_TYPES is None:
+        from ... import nn
+
+        _SUPPORTED_TYPES = (nn.Linear, nn.Conv2D)
+    return _SUPPORTED_TYPES
+
+
+def set_excluded_layers(param_names=None, main_program=None, model=None):
+    """Exclude parameters from pruning (reference set_excluded_layers :52):
+    ``param_names`` lists parameter full names; with ``model`` given, the
+    names are the model's LAYER names and all their weights are excluded."""
+    if model is not None:
+        wanted = set(param_names or [])
+        for lname, layer in model.named_sublayers(include_self=True):
+            if not wanted or lname in wanted:
+                w = getattr(layer, "weight", None)
+                if w is not None:
+                    _EXCLUDED.add(f"{lname}.weight" if lname else "weight")
+        return
+    for n in param_names or []:
+        _EXCLUDED.add(str(n))
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _oriented_mask(wv: np.ndarray, algo: MaskAlgo, n: int, m: int) -> np.ndarray:
+    if wv.ndim == 2:
+        # [in, out]: groups along in (reduction) → mask the transpose
+        return create_mask(wv.T, func_name=algo, n=n, m=m).T
+    # conv [cout, ...reduction...]: create_mask flattens to (cout, -1) and
+    # groups along the trailing (reduction) dims
+    return create_mask(wv, func_name=algo, n=n, m=m)
+
+
+def _reduction_len(shape) -> int:
+    if len(shape) == 2:
+        return int(shape[0])
+    return int(np.prod(shape[1:]))
+
+
+def _check_param_sparsity(wv: np.ndarray, n=2, m=4, func_name="mask_1d") -> bool:
+    mat = wv.T if wv.ndim == 2 else wv.reshape(wv.shape[0], -1)
+    return check_sparsity(mat, n=n, m=m, func_name=func_name)
+
+
+class ASPHelper:
+    """Registry of per-parameter masks (reference ASPHelper). Parameters are
+    weakly referenced so discarded models can be collected; mask
+    application is scoped per decorated optimizer."""
+
+    _masks: Dict[int, np.ndarray] = {}
+    _params: Dict[int, "weakref.ref"] = {}
+
+    @classmethod
+    def prunable_parameters(cls, model: Layer) -> List:
+        out = []
+        for lname, layer in model.named_sublayers(include_self=True):
+            if isinstance(layer, _supported_types()):
+                w = getattr(layer, "weight", None)
+                if w is None:
+                    continue
+                full = f"{lname}.weight" if lname else "weight"
+                if full in _EXCLUDED or getattr(w, "name", None) in _EXCLUDED:
+                    continue
+                if _reduction_len(w.shape) < 4:
+                    continue
+                out.append((full, w))
+        return out
+
+    @classmethod
+    def prune_model(cls, model, n=2, m=4, mask_algo="mask_1d"):
+        algo = MaskAlgo(mask_algo)
+        masks = {}
+        for name, w in cls.prunable_parameters(model):
+            wv = np.asarray(w._value)
+            mask = _oriented_mask(wv, algo, n, m)
+            w._replace_value((wv * mask).astype(wv.dtype))
+            cls._masks[id(w)] = mask
+            cls._params[id(w)] = weakref.ref(w)
+            masks[name] = mask
+        return masks
+
+    @classmethod
+    def masks_for(cls, parameters):
+        """(param, mask) pairs for live registered params among ``parameters``."""
+        out = []
+        for p in parameters:
+            mask = cls._masks.get(id(p))
+            ref = cls._params.get(id(p))
+            if mask is not None and ref is not None and ref() is p:
+                out.append((p, mask))
+        return out
+
+    @classmethod
+    def reset(cls):
+        cls._masks.clear()
+        cls._params.clear()
+
+
+class OptimizerWithSparsityGuarantee:
+    """Wrapped optimizer: every update re-applies the ASP masks of ITS OWN
+    parameters, through both step() and minimize() (reference asp.py
+    OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        params = getattr(optimizer, "_parameter_list", None) or []
+        self._masked = ASPHelper.masks_for(params)
+
+    def _apply_masks(self):
+        for p, mask in self._masked:
+            pv = np.asarray(p._value)
+            p._replace_value((pv * mask).astype(pv.dtype))
+
+    def step(self, *args, **kwargs):
+        out = self._optimizer.step(*args, **kwargs)
+        self._apply_masks()
+        return out
+
+    def minimize(self, *args, **kwargs):
+        out = self._optimizer.minimize(*args, **kwargs)
+        self._apply_masks()
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune supported layers' weights to n:m sparsity along the reduction
+    dim (reference prune_model :316). Returns {param_name: mask}."""
+    masks = ASPHelper.prune_model(model, n=n, m=m, mask_algo=mask_algo)
+    for name, w in ASPHelper.prunable_parameters(model):
+        if name in masks and not _check_param_sparsity(
+            np.asarray(w._value), n=n, m=m, func_name=mask_algo
+        ):
+            raise RuntimeError(f"pruning produced an invalid mask for {name}")
+    return masks

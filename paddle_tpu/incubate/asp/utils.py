@@ -1,0 +1,156 @@
+"""n:m sparsity mask algorithms (reference: incubate/asp/utils.py).
+
+Pure numpy — masks are computed host-side once per prune (the reference
+does the same; only the masked multiply runs on device)."""
+from __future__ import annotations
+
+import enum
+import itertools
+
+import numpy as np
+
+__all__ = [
+    "MaskAlgo", "calculate_density", "check_mask_1d", "get_mask_1d",
+    "check_mask_2d", "get_mask_2d_greedy", "get_mask_2d_best", "create_mask",
+    "check_sparsity",
+]
+
+
+class MaskAlgo(enum.Enum):
+    MASK_1D = "mask_1d"
+    MASK_2D_GREEDY = "mask_2d_greedy"
+    MASK_2D_BEST = "mask_2d_best"
+
+
+def calculate_density(x) -> float:
+    x = np.asarray(x)
+    return float(np.count_nonzero(x)) / x.size
+
+
+def _reshape_1d(mat, m):
+    pad = (-mat.shape[1]) % m
+    padded = np.pad(mat, ((0, 0), (0, pad)))
+    return padded.reshape(-1, m), padded.shape
+
+
+def check_mask_1d(mat, n, m) -> bool:
+    rows, _ = _reshape_1d(np.asarray(mat), m)
+    return bool((np.count_nonzero(rows, axis=1) <= n).all())
+
+
+def get_mask_1d(mat, n, m):
+    """Keep the n largest-magnitude entries of every m-length group."""
+    mat = np.asarray(mat)
+    rows, padded_shape = _reshape_1d(mat, m)
+    mask = np.zeros_like(rows)
+    order = np.argsort(np.abs(rows), axis=1)[:, -n:]
+    np.put_along_axis(mask, order, 1.0, axis=1)
+    mask = mask.reshape(padded_shape)[:, : mat.shape[1]]
+    return mask.astype(mat.dtype)
+
+
+def _reshape_2d(mat, m):
+    pad_r = (-mat.shape[0]) % m
+    pad_c = (-mat.shape[1]) % m
+    padded = np.pad(mat, ((0, pad_r), (0, pad_c)))
+    h, w = padded.shape
+    blocks = padded.reshape(h // m, m, w // m, m).transpose(0, 2, 1, 3)
+    return blocks.reshape(-1, m, m), padded.shape
+
+
+def _blocks_to_mat(blocks, padded_shape, m, orig_shape):
+    h, w = padded_shape
+    mat = blocks.reshape(h // m, w // m, m, m).transpose(0, 2, 1, 3).reshape(h, w)
+    return mat[: orig_shape[0], : orig_shape[1]]
+
+
+def check_mask_2d(mat, n, m) -> bool:
+    blocks, _ = _reshape_2d(np.asarray(mat), m)
+    nz_rows = np.count_nonzero(blocks, axis=2) <= n
+    nz_cols = np.count_nonzero(blocks, axis=1) <= n
+    return bool(nz_rows.all() and nz_cols.all())
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """Greedy per-block selection keeping ≤n nonzeros per row AND column."""
+    mat = np.asarray(mat)
+    blocks, padded_shape = _reshape_2d(mat, m)
+    masks = np.zeros_like(blocks)
+    for b in range(blocks.shape[0]):
+        block = np.abs(blocks[b])
+        order = np.argsort(-block.reshape(-1), kind="stable")
+        row_cnt = np.zeros(m, int)
+        col_cnt = np.zeros(m, int)
+        for flat in order:
+            i, j = divmod(int(flat), m)
+            if row_cnt[i] < n and col_cnt[j] < n:
+                masks[b, i, j] = 1.0
+                row_cnt[i] += 1
+                col_cnt[j] += 1
+    return _blocks_to_mat(masks, padded_shape, m, mat.shape).astype(mat.dtype)
+
+
+_PATTERN_CACHE = {}
+
+
+def _compute_valid_2d_patterns(n, m):
+    """All m×m 0/1 matrices with exactly n ones per row and per column."""
+    key = (n, m)
+    if key in _PATTERN_CACHE:
+        return _PATTERN_CACHE[key]
+    row_choices = [
+        np.asarray(p) for p in itertools.combinations(range(m), n)
+    ]
+    patterns = []
+
+    def rec(rows, col_cnt):
+        if len(rows) == m:
+            patterns.append(np.stack(rows))
+            return
+        for choice in row_choices:
+            if (col_cnt[choice] < n).all():
+                row = np.zeros(m)
+                row[choice] = 1
+                col_cnt[choice] += 1
+                rec(rows + [row], col_cnt)
+                col_cnt[choice] -= 1
+
+    rec([], np.zeros(m, int))
+    out = np.stack(patterns)
+    _PATTERN_CACHE[key] = out
+    return out
+
+
+def get_mask_2d_best(mat, n, m):
+    """Exhaustive best pattern per block (reference get_mask_2d_best :452)."""
+    mat = np.asarray(mat)
+    blocks, padded_shape = _reshape_2d(mat, m)
+    patterns = _compute_valid_2d_patterns(n, m)        # (P, m, m)
+    scores = np.einsum("bij,pij->bp", np.abs(blocks), patterns)
+    best = patterns[np.argmax(scores, axis=1)]         # (B, m, m)
+    return _blocks_to_mat(best, padded_shape, m, mat.shape).astype(mat.dtype)
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
+    """Mask for a (possibly >2-D) weight: trailing-2D view like the
+    reference (conv weights reshape to (out, -1))."""
+    t = np.asarray(tensor)
+    if isinstance(func_name, str):
+        func_name = MaskAlgo(func_name)
+    shape = t.shape
+    mat = t.reshape(shape[0], -1) if t.ndim != 2 else t
+    fn = {
+        MaskAlgo.MASK_1D: get_mask_1d,
+        MaskAlgo.MASK_2D_GREEDY: get_mask_2d_greedy,
+        MaskAlgo.MASK_2D_BEST: get_mask_2d_best,
+    }[func_name]
+    return fn(mat, n, m).reshape(shape)
+
+
+def check_sparsity(tensor, n=2, m=4, func_name=None):
+    t = np.asarray(tensor)
+    mat = t.reshape(t.shape[0], -1) if t.ndim != 2 else t
+    if func_name in (MaskAlgo.MASK_2D_GREEDY, MaskAlgo.MASK_2D_BEST,
+                     "mask_2d_greedy", "mask_2d_best"):
+        return check_mask_2d(mat, n, m)
+    return check_mask_1d(mat, n, m)

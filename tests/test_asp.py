@@ -1,0 +1,112 @@
+"""paddle.incubate.asp: n:m mask algorithms + masked training
+(reference test model: test/asp/test_asp_pruning_*.py, test_asp_optimize_*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate import asp
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestMasks:
+    def test_mask_1d(self):
+        np.random.seed(0)
+        mat = np.random.randn(8, 16).astype("float32")
+        mask = asp.get_mask_1d(mat, 2, 4)
+        assert asp.check_mask_1d(mask, 2, 4)
+        assert abs(asp.calculate_density(mask) - 0.5) < 1e-6
+        # keeps the two largest |values| per group of four
+        groups = np.abs(mat).reshape(-1, 4)
+        kept = (mask.reshape(-1, 4) > 0)
+        for g, k in zip(groups, kept):
+            assert set(np.argsort(-g)[:2]) == set(np.nonzero(k)[0])
+
+    def test_mask_2d_greedy_and_best(self):
+        np.random.seed(1)
+        mat = np.random.randn(8, 8).astype("float32")
+        for fn, name in ((asp.get_mask_2d_greedy, "mask_2d_greedy"),
+                         (asp.get_mask_2d_best, "mask_2d_best")):
+            mask = fn(mat, 2, 4)
+            assert asp.check_mask_2d(mask, 2, 4), name
+            assert abs(asp.calculate_density(mask) - 0.5) < 1e-6
+        # best is at least as good as greedy in retained magnitude
+        g = np.abs(mat * asp.get_mask_2d_greedy(mat, 2, 4)).sum()
+        b = np.abs(mat * asp.get_mask_2d_best(mat, 2, 4)).sum()
+        assert b >= g - 1e-5
+
+    def test_create_mask_conv_shape(self):
+        w = np.random.randn(8, 4, 3, 3).astype("float32")
+        mask = asp.create_mask(w, "mask_1d", 2, 4)
+        assert mask.shape == w.shape
+        assert asp.check_sparsity(mask, 2, 4)
+
+    def test_nondivisible_columns(self):
+        mat = np.random.randn(4, 10).astype("float32")  # 10 % 4 != 0
+        mask = asp.get_mask_1d(mat, 2, 4)
+        assert mask.shape == mat.shape
+        assert asp.check_mask_1d(mask, 2, 4)
+
+
+class TestPruneAndTrain:
+    def test_prune_model_and_sparse_training(self):
+        paddle.seed(0)
+        np.random.seed(0)
+        asp.reset_excluded_layers()
+        asp.ASPHelper.reset()
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        masks = asp.prune_model(model, mask_algo="mask_1d")
+        assert len(masks) == 2
+        # groups run along the reduction dim (in_features) → check on w.T
+        for _, w in asp.ASPHelper.prunable_parameters(model):
+            assert asp.check_sparsity(_np(w).T)
+
+        optimizer = asp.decorate(opt.SGD(learning_rate=0.1,
+                                         parameters=model.parameters()))
+        x = paddle.to_tensor(np.random.randn(8, 16).astype("float32"))
+        y = paddle.to_tensor(np.random.randint(0, 4, (8,)))
+        ce = nn.CrossEntropyLoss()
+        for _ in range(5):
+            loss = ce(model(x), y)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+        # sparsity survives training steps
+        for _, w in asp.ASPHelper.prunable_parameters(model):
+            assert asp.check_sparsity(_np(w).T)
+            assert abs(asp.calculate_density(_np(w)) - 0.5) < 0.01
+
+    def test_minimize_reapplies_masks(self):
+        paddle.seed(1)
+        asp.reset_excluded_layers()
+        asp.ASPHelper.reset()
+        model = nn.Sequential(nn.Linear(8, 8))
+        asp.prune_model(model)
+        optimizer = asp.decorate(opt.SGD(learning_rate=0.5,
+                                         parameters=model.parameters()))
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        loss = (model(x) ** 2).mean()
+        optimizer.minimize(loss)
+        assert asp.check_sparsity(_np(model[0].weight).T)
+
+    def test_model_scoped_exclusion(self):
+        asp.reset_excluded_layers()
+        asp.ASPHelper.reset()
+        model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        asp.set_excluded_layers(["0"], model=model)
+        masks = asp.prune_model(model)
+        assert list(masks) == ["1.weight"]
+        asp.reset_excluded_layers()
+
+    def test_excluded_layers(self):
+        asp.reset_excluded_layers()
+        asp.ASPHelper.reset()
+        model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        asp.set_excluded_layers(["0.weight"])
+        masks = asp.prune_model(model)
+        assert list(masks) == ["1.weight"]
+        asp.reset_excluded_layers()
