@@ -66,7 +66,7 @@ def __getattr__(name):
     if name in ("distributed", "vision", "profiler", "hapi", "callbacks",
                 "fft", "signal", "distribution", "geometric", "quantization",
                 "text", "audio", "dataset", "hub", "sysconfig", "linalg",
-                "regularizer", "decomposition", "onnx"):
+                "regularizer", "decomposition", "onnx", "utils", "reader"):
         import importlib
 
         try:
@@ -88,6 +88,16 @@ def __getattr__(name):
         val = getattr(_hapi, name)
         setattr(_sys.modules[__name__], name, val)
         return val
+    if name == "flops":
+        from .utils.flops import dynamic_flops
+
+        setattr(_sys.modules[__name__], "flops", dynamic_flops)
+        return dynamic_flops
+    if name == "batch":
+        from .reader import batch as _batch
+
+        setattr(_sys.modules[__name__], "batch", _batch)
+        return _batch
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 

@@ -1,0 +1,23 @@
+"""Legacy ``paddle.dataset`` namespace (reader-factory API).
+
+Reference: python/paddle/dataset/ — each submodule exposes ``train()`` /
+``test()`` returning zero-arg reader callables that yield tuples, fed to
+``paddle.batch``. This build reads the standard file formats from a local
+cache (zero network egress; see paddle_tpu/utils/download.py) and offers a
+deterministic ``synthetic=True`` mode for CI so the reader pipeline is
+testable without the original archives.
+"""
+from . import common
+from . import mnist
+from . import cifar
+from . import uci_housing
+from . import imdb
+from . import imikolov
+from . import movielens
+from . import conll05
+from . import flowers
+
+__all__ = [
+    "common", "mnist", "cifar", "uci_housing", "imdb", "imikolov",
+    "movielens", "conll05", "flowers",
+]

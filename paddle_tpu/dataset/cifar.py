@@ -1,0 +1,73 @@
+"""CIFAR-10/100 reader-factory API.
+
+Reference: python/paddle/dataset/cifar.py — train10/test10/train100/test100
+yield (3072-float image in [0, 1], int label) read from the pickled batch
+tarballs; ``synthetic=True`` generates deterministic samples.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _reader_from_tar(tar_path, sub_name, label_key):
+    def reader():
+        with tarfile.open(tar_path, mode="r") as f:
+            names = [
+                n for n in f.getnames() if sub_name in n and "batches.meta" not in n
+            ]
+            for name in sorted(names):
+                batch = pickle.load(f.extractfile(name), encoding="latin1")
+                data = batch["data"].astype("float32") / 255.0
+                labels = batch.get(label_key)
+                for sample, label in zip(data, labels):
+                    yield sample, int(label)
+
+    return reader
+
+
+def _synthetic_reader(n, n_classes, seed_name):
+    rng = common._synthetic_rng(seed_name)
+    images = rng.random((n, 3072), dtype=np.float32)
+    labels = rng.integers(0, n_classes, size=n)
+
+    def reader():
+        for img, lab in zip(images, labels):
+            yield img, int(lab)
+
+    return reader
+
+
+def _path(fname):
+    return os.path.join(common.DATA_HOME, "cifar", fname)
+
+
+def train10(synthetic=False, n_synthetic=512):
+    if synthetic:
+        return _synthetic_reader(n_synthetic, 10, "cifar10-train")
+    return _reader_from_tar(_path("cifar-10-python.tar.gz"), "data_batch", "labels")
+
+
+def test10(synthetic=False, n_synthetic=128):
+    if synthetic:
+        return _synthetic_reader(n_synthetic, 10, "cifar10-test")
+    return _reader_from_tar(_path("cifar-10-python.tar.gz"), "test_batch", "labels")
+
+
+def train100(synthetic=False, n_synthetic=512):
+    if synthetic:
+        return _synthetic_reader(n_synthetic, 100, "cifar100-train")
+    return _reader_from_tar(_path("cifar-100-python.tar.gz"), "train", "fine_labels")
+
+
+def test100(synthetic=False, n_synthetic=128):
+    if synthetic:
+        return _synthetic_reader(n_synthetic, 100, "cifar100-test")
+    return _reader_from_tar(_path("cifar-100-python.tar.gz"), "test", "fine_labels")

@@ -1,0 +1,83 @@
+"""Shared dataset helpers.
+
+Reference: python/paddle/dataset/common.py (DATA_HOME, md5file, download,
+cluster-split helpers). Download here resolves against the local cache only.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["DATA_HOME", "md5file", "download", "split", "cluster_files_reader"]
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset")
+)
+
+
+def md5file(fname: str) -> str:
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str | None = None,
+             save_name: str | None = None) -> str:
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(dirname,
+                            save_name or url.split("/")[-1])
+    if os.path.exists(filename) and (
+        md5sum is None or md5file(filename) == md5sum
+    ):
+        return filename
+    raise RuntimeError(
+        f"'{filename}' missing from the local dataset cache and this build "
+        f"has no network egress; place the file there manually (source: {url})."
+    )
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    import pickle
+
+    dumper = dumper or pickle.dump
+    lines = []
+    indx_f = 0
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= (indx_f + 1) * line_count - 1:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+            lines = []
+            indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    import glob
+    import pickle
+
+    loader = loader or pickle.load
+
+    def reader():
+        file_list = sorted(glob.glob(files_pattern))
+        my_files = [
+            fn for i, fn in enumerate(file_list)
+            if i % trainer_count == trainer_id
+        ]
+        for fn in my_files:
+            with open(fn, "rb") as f:
+                for item in loader(f):
+                    yield item
+
+    return reader
+
+
+def _synthetic_rng(name: str):
+    import numpy as np
+
+    return np.random.default_rng(abs(hash(name)) % (2**32))

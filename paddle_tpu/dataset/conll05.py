@@ -1,0 +1,75 @@
+"""CoNLL-2005 SRL reader.
+
+Reference: python/paddle/dataset/conll05.py — test() yields
+(word_ids, ctx_n2/n1/0/p1/p2, verb_id, mark, label_ids) tuples built from
+word/verb/label dictionaries. Synthetic mode fabricates a consistent tagged
+corpus so the 9-slot feature pipeline is exercised end to end.
+"""
+from __future__ import annotations
+
+from . import common
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+_UNK = "<unk>"
+
+
+def _synthetic_corpus(n=128):
+    rng = common._synthetic_rng("conll05")
+    words = [f"w{i}" for i in range(48)]
+    labels = ["B-A0", "I-A0", "B-A1", "I-A1", "B-V", "O"]
+    sents = []
+    for _ in range(n):
+        length = int(rng.integers(4, 12))
+        sent = [words[int(i)] for i in rng.integers(0, 48, size=length)]
+        verb_idx = int(rng.integers(0, length))
+        tags = [labels[int(i)] for i in rng.integers(0, 6, size=length)]
+        tags[verb_idx] = "B-V"
+        sents.append((sent, verb_idx, tags))
+    return sents
+
+
+def get_dict(synthetic: bool = True):
+    """Returns (word_dict, verb_dict, label_dict)."""
+    corpus = _synthetic_corpus()
+    word_dict, verb_dict, label_dict = {}, {}, {}
+    for sent, verb_idx, tags in corpus:
+        for w in sent:
+            word_dict.setdefault(w, len(word_dict))
+        verb_dict.setdefault(sent[verb_idx], len(verb_dict))
+        for t in tags:
+            label_dict.setdefault(t, len(label_dict))
+    word_dict.setdefault(_UNK, len(word_dict))
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding(word_dict=None, dim: int = 32):
+    import numpy as np
+
+    word_dict = word_dict or get_dict()[0]
+    rng = common._synthetic_rng("conll05-emb")
+    return rng.standard_normal((len(word_dict), dim)).astype("float32")
+
+
+def test(synthetic: bool = True):
+    word_dict, verb_dict, label_dict = get_dict(synthetic)
+    unk = word_dict[_UNK]
+
+    def reader():
+        for sent, verb_idx, tags in _synthetic_corpus():
+            n = len(sent)
+
+            def ctx(offset):
+                i = min(max(verb_idx + offset, 0), n - 1)
+                return word_dict.get(sent[i], unk)
+
+            word_ids = [word_dict.get(w, unk) for w in sent]
+            ctx_n2, ctx_n1 = [ctx(-2)] * n, [ctx(-1)] * n
+            ctx_0, ctx_p1, ctx_p2 = [ctx(0)] * n, [ctx(1)] * n, [ctx(2)] * n
+            verb_id = [verb_dict[sent[verb_idx]]] * n
+            mark = [1 if i == verb_idx else 0 for i in range(n)]
+            label_ids = [label_dict[t] for t in tags]
+            yield (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+                   verb_id, mark, label_ids)
+
+    return reader

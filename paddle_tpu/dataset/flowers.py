@@ -1,0 +1,55 @@
+"""Oxford-102 flowers reader.
+
+Reference: python/paddle/dataset/flowers.py — train()/test()/valid() yield
+(3x224x224 float image, int label) from the image tarball + .mat label
+files. Synthetic mode generates deterministic images so vision pipelines
+can run without the archives.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "valid"]
+
+
+def _synthetic_reader(n, seed_name, size=(3, 32, 32)):
+    rng = common._synthetic_rng(seed_name)
+
+    def reader():
+        for _ in range(n):
+            img = rng.random(size, dtype=np.float32)
+            yield img, int(rng.integers(0, 102))
+
+    return reader
+
+
+def train(synthetic: bool = True, mapper=None, buffered_size: int = 1024,
+          use_xmap: bool = False):
+    r = _synthetic_reader(256, "flowers-train")
+    if mapper is not None:
+        from ..reader import map_readers
+
+        return map_readers(mapper, r)
+    return r
+
+
+def test(synthetic: bool = True, mapper=None, buffered_size: int = 1024,
+         use_xmap: bool = False):
+    r = _synthetic_reader(64, "flowers-test")
+    if mapper is not None:
+        from ..reader import map_readers
+
+        return map_readers(mapper, r)
+    return r
+
+
+def valid(synthetic: bool = True, mapper=None, buffered_size: int = 1024,
+          use_xmap: bool = False):
+    r = _synthetic_reader(64, "flowers-valid")
+    if mapper is not None:
+        from ..reader import map_readers
+
+        return map_readers(mapper, r)
+    return r

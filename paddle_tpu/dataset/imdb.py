@@ -1,0 +1,95 @@
+"""IMDB sentiment reader.
+
+Reference: python/paddle/dataset/imdb.py — word_dict() built from the
+aclImdb tarball by frequency, train()/test() yield (word-id list, 0/1
+label). Local-cache tarball or deterministic synthetic corpus.
+"""
+from __future__ import annotations
+
+import os
+import re
+import string
+import tarfile
+from collections import Counter
+
+from . import common
+
+__all__ = ["word_dict", "train", "test"]
+
+_SYN_VOCAB = 256
+_SYN_POS = ["good great fine nice best love", "enjoy superb brilliant strong"]
+_SYN_NEG = ["bad poor worst awful hate", "boring weak terrible dull"]
+
+
+def _tokenize(text: str):
+    text = text.lower()
+    return re.sub(f"[{re.escape(string.punctuation)}]", " ", text).split()
+
+
+def _tar_reader(pattern):
+    path = os.path.join(common.DATA_HOME, "imdb", "aclImdb_v1.tar.gz")
+    pat = re.compile(pattern)
+    with tarfile.open(path) as t:
+        for name in t.getnames():
+            if pat.match(name):
+                yield _tokenize(t.extractfile(name).read().decode("utf-8"))
+
+
+def _synthetic_docs(n, seed_name):
+    rng = common._synthetic_rng(seed_name)
+    docs = []
+    for i in range(n):
+        pos = bool(rng.integers(0, 2))
+        base = (_SYN_POS if pos else _SYN_NEG)[int(rng.integers(0, 2))]
+        filler = " ".join(
+            f"w{int(v)}" for v in rng.integers(0, _SYN_VOCAB, size=20)
+        )
+        docs.append((_tokenize(base + " " + filler), int(pos)))
+    return docs
+
+
+def word_dict(synthetic: bool = False, cutoff: int = 150):
+    cnt: Counter = Counter()
+    if synthetic:
+        for tokens, _ in _synthetic_docs(512, "imdb-train"):
+            cnt.update(tokens)
+        cutoff = 0
+    else:
+        for tokens in _tar_reader(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$"):
+            cnt.update(tokens)
+    words = [w for w, c in cnt.items() if c > cutoff]
+    words.sort(key=lambda w: (-cnt[w], w))
+    d = {w: i for i, w in enumerate(words)}
+    d["<unk>"] = len(d)
+    return d
+
+def _reader_creator(docs, w_dict):
+    unk = w_dict["<unk>"]
+
+    def reader():
+        for tokens, label in docs:
+            yield [w_dict.get(t, unk) for t in tokens], label
+
+    return reader
+
+
+def train(word_idx=None, synthetic: bool = False):
+    w = word_idx or word_dict(synthetic=synthetic)
+    if synthetic:
+        return _reader_creator(_synthetic_docs(512, "imdb-train"), w)
+    docs = (
+        [(tok, 1) for tok in _tar_reader(r"aclImdb/train/pos/.*\.txt$")]
+        + [(tok, 0) for tok in _tar_reader(r"aclImdb/train/neg/.*\.txt$")]
+    )
+    return _reader_creator(docs, w)
+
+
+def test(word_idx=None, synthetic: bool = False):
+    w = word_idx or word_dict(synthetic=synthetic)
+    if synthetic:
+        return _reader_creator(_synthetic_docs(128, "imdb-test"), w)
+    docs = (
+        [(tok, 1) for tok in _tar_reader(r"aclImdb/test/pos/.*\.txt$")]
+        + [(tok, 0) for tok in _tar_reader(r"aclImdb/test/neg/.*\.txt$")]
+    )
+    return _reader_creator(docs, w)

@@ -1,0 +1,82 @@
+"""PTB (imikolov) language-model reader.
+
+Reference: python/paddle/dataset/imikolov.py — build_dict() over the PTB
+text, train()/test() yield n-gram tuples (NGRAM mode) or (src, trg)
+sequences (SEQ mode).
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+from collections import Counter
+
+from . import common
+
+__all__ = ["build_dict", "train", "test", "DataType"]
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def _lines(split):
+    path = os.path.join(common.DATA_HOME, "imikolov", "simple-examples.tgz")
+    fname = f"./simple-examples/data/ptb.{split}.txt"
+    with tarfile.open(path) as t:
+        for line in t.extractfile(fname):
+            yield line.decode("utf-8").split()
+
+
+def _synthetic_lines(split, n=256):
+    rng = common._synthetic_rng(f"imikolov-{split}")
+    vocab = [f"tok{i}" for i in range(64)]
+    for _ in range(n):
+        length = int(rng.integers(3, 12))
+        yield [vocab[int(i)] for i in rng.integers(0, 64, size=length)]
+
+
+def build_dict(min_word_freq: int = 50, synthetic: bool = False):
+    cnt: Counter = Counter()
+    lines = _synthetic_lines("train") if synthetic else _lines("train")
+    for words in lines:
+        cnt.update(words)
+    cnt.pop("<unk>", None)
+    if synthetic:
+        min_word_freq = 0
+    keep = [w for w, c in cnt.items() if c > min_word_freq]
+    keep.sort(key=lambda w: (-cnt[w], w))
+    word_idx = {w: i for i, w in enumerate(keep)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def _reader_creator(split, word_idx, n, data_type, synthetic):
+    def reader():
+        lines = _synthetic_lines(split) if synthetic else _lines(split)
+        UNK = word_idx["<unk>"]
+        for words in lines:
+            if data_type == DataType.NGRAM:
+                assert n > -1, "Invalid gram length"
+                sent = ["<s>"] + words + ["<e>"]
+                if len(sent) >= n:
+                    ids = [word_idx.get(w, UNK) for w in sent]
+                    for i in range(n, len(ids) + 1):
+                        yield tuple(ids[i - n : i])
+            elif data_type == DataType.SEQ:
+                ids = [word_idx.get(w, UNK) for w in words]
+                src = [word_idx.get("<s>", UNK)] + ids
+                trg = ids + [word_idx.get("<e>", UNK)]
+                yield src, trg
+            else:
+                raise ValueError(f"Unknown data type {data_type}")
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM, synthetic: bool = False):
+    return _reader_creator("train", word_idx, n, data_type, synthetic)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM, synthetic: bool = False):
+    return _reader_creator("valid", word_idx, n, data_type, synthetic)

@@ -1,0 +1,66 @@
+"""MNIST reader-factory API.
+
+Reference: python/paddle/dataset/mnist.py — train()/test() yield
+(784-float image in [-1, 1], int label). Reads idx-ubyte files from the
+local cache; ``synthetic=True`` yields deterministic generated digits.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+
+def _reader_from_files(image_path, label_path):
+    def reader():
+        opener = gzip.open if image_path.endswith(".gz") else open
+        with opener(image_path, "rb") as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows * cols)
+        opener = gzip.open if label_path.endswith(".gz") else open
+        with opener(label_path, "rb") as f:
+            _, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), dtype=np.uint8)
+        images = images.astype("float32") / 127.5 - 1.0
+        for img, lab in zip(images, labels):
+            yield img, int(lab)
+
+    return reader
+
+
+def _synthetic_reader(n, seed_name):
+    rng = common._synthetic_rng(seed_name)
+    images = (rng.random((n, 784), dtype=np.float32) * 2.0 - 1.0)
+    labels = rng.integers(0, 10, size=n)
+
+    def reader():
+        for img, lab in zip(images, labels):
+            yield img, int(lab)
+
+    return reader
+
+
+def train(synthetic: bool = False, n_synthetic: int = 512):
+    if synthetic:
+        return _synthetic_reader(n_synthetic, "mnist-train")
+    base = os.path.join(common.DATA_HOME, "mnist")
+    return _reader_from_files(
+        os.path.join(base, "train-images-idx3-ubyte.gz"),
+        os.path.join(base, "train-labels-idx1-ubyte.gz"),
+    )
+
+
+def test(synthetic: bool = False, n_synthetic: int = 128):
+    if synthetic:
+        return _synthetic_reader(n_synthetic, "mnist-test")
+    base = os.path.join(common.DATA_HOME, "mnist")
+    return _reader_from_files(
+        os.path.join(base, "t10k-images-idx3-ubyte.gz"),
+        os.path.join(base, "t10k-labels-idx1-ubyte.gz"),
+    )

@@ -1,0 +1,178 @@
+"""MovieLens-1M reader.
+
+Reference: python/paddle/dataset/movielens.py — MovieInfo/UserInfo records,
+train()/test() yield (user features..., movie features..., score). Reads the
+ml-1m zip from the local cache; synthetic mode fabricates a small consistent
+catalog.
+"""
+from __future__ import annotations
+
+import os
+import re
+import zipfile
+
+import numpy as np
+
+from . import common
+
+__all__ = [
+    "MovieInfo", "UserInfo", "train", "test", "get_movie_title_dict",
+    "max_movie_id", "max_user_id", "max_job_id", "age_table",
+]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [
+            self.index,
+            [CATEGORIES_DICT[c] for c in self.categories],
+            [MOVIE_TITLE_DICT[w.lower()] for w in self.title.split()],
+        ]
+
+    def __repr__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+    def __repr__(self):
+        return (f"<UserInfo id({self.index}), gender({'M' if self.is_male else 'F'}), "
+                f"age({age_table[self.age]}), job({self.job_id})>")
+
+
+MOVIE_INFO: dict | None = None
+MOVIE_TITLE_DICT: dict | None = None
+CATEGORIES_DICT: dict | None = None
+USER_INFO: dict | None = None
+RATINGS: list | None = None
+
+
+def _load_synthetic():
+    global MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT, USER_INFO, RATINGS
+    rng = common._synthetic_rng("movielens")
+    cats = ["Action", "Comedy", "Drama", "Horror", "Sci-Fi"]
+    CATEGORIES_DICT = {c: i for i, c in enumerate(cats)}
+    words = [f"title{i}" for i in range(32)]
+    MOVIE_TITLE_DICT = {w: i for i, w in enumerate(words)}
+    MOVIE_INFO = {}
+    for mid in range(1, 65):
+        n_cat = int(rng.integers(1, 3))
+        title = " ".join(
+            words[int(i)] for i in rng.integers(0, 32, size=3)
+        )
+        MOVIE_INFO[mid] = MovieInfo(
+            mid, [cats[int(i)] for i in rng.integers(0, 5, size=n_cat)], title
+        )
+    USER_INFO = {
+        uid: UserInfo(uid, "M" if rng.integers(0, 2) else "F",
+                      age_table[int(rng.integers(0, len(age_table)))],
+                      int(rng.integers(0, 21)))
+        for uid in range(1, 33)
+    }
+    RATINGS = []
+    for _ in range(512):
+        uid = int(rng.integers(1, 33))
+        mid = int(rng.integers(1, 65))
+        score = float(rng.integers(1, 6))
+        RATINGS.append((uid, mid, score))
+
+
+def _load_real():
+    global MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT, USER_INFO, RATINGS
+    path = os.path.join(common.DATA_HOME, "movielens", "ml-1m.zip")
+    pattern = re.compile(r"^(.*)\((\d+)\)$")
+    CATEGORIES_DICT = {}
+    MOVIE_TITLE_DICT = {}
+    MOVIE_INFO = {}
+    with zipfile.ZipFile(path) as package:
+        for info in package.infolist():
+            assert isinstance(info, zipfile.ZipInfo)
+        with package.open("ml-1m/movies.dat") as movie_file:
+            for line in movie_file:
+                line = line.decode(encoding="latin1")
+                movie_id, title, categories = line.strip().split("::")
+                categories = categories.split("|")
+                for c in categories:
+                    CATEGORIES_DICT.setdefault(c, len(CATEGORIES_DICT))
+                title = pattern.match(title).group(1)
+                for w in title.split():
+                    MOVIE_TITLE_DICT.setdefault(w.lower(), len(MOVIE_TITLE_DICT))
+                MOVIE_INFO[int(movie_id)] = MovieInfo(movie_id, categories, title)
+        USER_INFO = {}
+        with package.open("ml-1m/users.dat") as user_file:
+            for line in user_file:
+                uid, gender, age, job, _ = line.decode("latin1").strip().split("::")
+                USER_INFO[int(uid)] = UserInfo(uid, gender, age, job)
+        RATINGS = []
+        with package.open("ml-1m/ratings.dat") as rating:
+            for line in rating:
+                uid, mid, score, _ = line.decode("latin1").strip().split("::")
+                RATINGS.append((int(uid), int(mid), float(score)))
+
+
+def _ensure_loaded(synthetic):
+    if MOVIE_INFO is None:
+        if synthetic:
+            _load_synthetic()
+        else:
+            _load_real()
+
+
+def _reader(synthetic, is_test, test_ratio=0.1):
+    _ensure_loaded(synthetic)
+    rng = common._synthetic_rng("movielens-split")
+
+    def reader():
+        for uid, mid, score in RATINGS:
+            in_test = rng.random() < test_ratio
+            if in_test != is_test:
+                continue
+            usr = USER_INFO[uid]
+            mov = MOVIE_INFO[mid]
+            yield usr.value() + mov.value() + [[score]]
+
+    return reader
+
+
+def train(synthetic: bool = False):
+    return _reader(synthetic, is_test=False)
+
+
+def test(synthetic: bool = False):
+    return _reader(synthetic, is_test=True)
+
+
+def get_movie_title_dict(synthetic: bool = False):
+    _ensure_loaded(synthetic)
+    return MOVIE_TITLE_DICT
+
+
+def max_movie_id(synthetic: bool = False):
+    _ensure_loaded(synthetic)
+    return max(MOVIE_INFO)
+
+
+def max_user_id(synthetic: bool = False):
+    _ensure_loaded(synthetic)
+    return max(USER_INFO)
+
+
+def max_job_id(synthetic: bool = False):
+    _ensure_loaded(synthetic)
+    return max(u.job_id for u in USER_INFO.values())

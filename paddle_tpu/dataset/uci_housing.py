@@ -1,0 +1,62 @@
+"""UCI housing regression reader.
+
+Reference: python/paddle/dataset/uci_housing.py — 13 features normalized by
+feature-wise (max-min)/count stats, 80/20 train/test split. Reads the
+space-separated ``housing.data`` file from the local cache; synthetic mode
+generates a deterministic linear-plus-noise regression set.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD", "TAX",
+    "PTRATIO", "B", "LSTAT",
+]
+
+TRAIN_RATIO = 0.8
+
+
+def _load_real():
+    path = os.path.join(common.DATA_HOME, "uci_housing", "housing.data")
+    data = np.loadtxt(path)
+    features = data[:, :-1]
+    maximums, minimums = features.max(axis=0), features.min(axis=0)
+    avgs = features.sum(axis=0) / features.shape[0]
+    features = (features - avgs) / (maximums - minimums)
+    return np.concatenate([features, data[:, -1:]], axis=1).astype("float32")
+
+
+def _load_synthetic():
+    rng = common._synthetic_rng("uci-housing")
+    n = 506
+    x = rng.standard_normal((n, 13)).astype("float32") * 0.3
+    w = rng.standard_normal((13, 1)).astype("float32")
+    y = x @ w + 22.5 + rng.standard_normal((n, 1)).astype("float32") * 0.1
+    return np.concatenate([x, y], axis=1)
+
+
+def _make_reader(rows):
+    def reader():
+        for row in rows:
+            yield row[:-1], row[-1:]
+
+    return reader
+
+
+def train(synthetic: bool = False):
+    data = _load_synthetic() if synthetic else _load_real()
+    n = int(data.shape[0] * TRAIN_RATIO)
+    return _make_reader(data[:n])
+
+
+def test(synthetic: bool = False):
+    data = _load_synthetic() if synthetic else _load_real()
+    n = int(data.shape[0] * TRAIN_RATIO)
+    return _make_reader(data[n:])

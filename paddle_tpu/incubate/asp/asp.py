@@ -11,11 +11,15 @@ from __future__ import annotations
 import weakref
 from typing import Dict, List
 
+import jax.numpy as jnp
 import numpy as np
 
 from ...nn.layer import Layer
 from .utils import MaskAlgo, check_sparsity, create_mask
 
+# exclusions are keyed (model_id, layer_param_name) when model-scoped —
+# positional sublayer names like "0.weight" are not unique across models —
+# or (None, param_name) for global param-name exclusions
 _EXCLUDED: set = set()
 _SUPPORTED_TYPES = None
 
@@ -29,24 +33,35 @@ def _supported_types():
     return _SUPPORTED_TYPES
 
 
-def set_excluded_layers(param_names=None, main_program=None, model=None):
+def set_excluded_layers(param_names, main_program=None, model=None):
     """Exclude parameters from pruning (reference set_excluded_layers :52):
     ``param_names`` lists parameter full names; with ``model`` given, the
-    names are the model's LAYER names and all their weights are excluded."""
+    names are the model's LAYER names and all their weights are excluded.
+    An empty ``param_names`` excludes nothing."""
     if model is not None:
         wanted = set(param_names or [])
         for lname, layer in model.named_sublayers(include_self=True):
-            if not wanted or lname in wanted:
+            if lname in wanted:
                 w = getattr(layer, "weight", None)
                 if w is not None:
-                    _EXCLUDED.add(f"{lname}.weight" if lname else "weight")
+                    _EXCLUDED.add(
+                        (id(model), f"{lname}.weight" if lname else "weight")
+                    )
         return
     for n in param_names or []:
-        _EXCLUDED.add(str(n))
+        _EXCLUDED.add((None, str(n)))
 
 
 def reset_excluded_layers(main_program=None):
     _EXCLUDED.clear()
+
+
+def _is_excluded(model, full_name, param) -> bool:
+    return (
+        (id(model), full_name) in _EXCLUDED
+        or (None, full_name) in _EXCLUDED
+        or (None, getattr(param, "name", None)) in _EXCLUDED
+    )
 
 
 def _oriented_mask(wv: np.ndarray, algo: MaskAlgo, n: int, m: int) -> np.ndarray:
@@ -71,10 +86,10 @@ def _check_param_sparsity(wv: np.ndarray, n=2, m=4, func_name="mask_1d") -> bool
 
 class ASPHelper:
     """Registry of per-parameter masks (reference ASPHelper). Parameters are
-    weakly referenced so discarded models can be collected; mask
-    application is scoped per decorated optimizer."""
+    weakly referenced; a finalizer evicts a parameter's entry when it is
+    collected, so long-lived sweeps don't accumulate dead masks."""
 
-    _masks: Dict[int, np.ndarray] = {}
+    _masks: Dict[int, jnp.ndarray] = {}
     _params: Dict[int, "weakref.ref"] = {}
 
     @classmethod
@@ -86,7 +101,7 @@ class ASPHelper:
                 if w is None:
                     continue
                 full = f"{lname}.weight" if lname else "weight"
-                if full in _EXCLUDED or getattr(w, "name", None) in _EXCLUDED:
+                if _is_excluded(model, full, w):
                     continue
                 if _reduction_len(w.shape) < 4:
                     continue
@@ -94,15 +109,30 @@ class ASPHelper:
         return out
 
     @classmethod
-    def prune_model(cls, model, n=2, m=4, mask_algo="mask_1d"):
+    def _register(cls, w, mask: jnp.ndarray):
+        key = id(w)
+        cls._masks[key] = mask
+        cls._params[key] = weakref.ref(w)
+        weakref.finalize(w, cls._evict, key)
+
+    @classmethod
+    def _evict(cls, key: int):
+        cls._masks.pop(key, None)
+        cls._params.pop(key, None)
+
+    @classmethod
+    def prune_model(cls, model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         algo = MaskAlgo(mask_algo)
         masks = {}
         for name, w in cls.prunable_parameters(model):
             wv = np.asarray(w._value)
             mask = _oriented_mask(wv, algo, n, m)
-            w._replace_value((wv * mask).astype(wv.dtype))
-            cls._masks[id(w)] = mask
-            cls._params[id(w)] = weakref.ref(w)
+            mask_dev = jnp.asarray(mask, dtype=w._value.dtype)
+            # mask on device — keeps _value a jnp array and avoids a
+            # host round-trip per parameter
+            w._replace_value(w._value * mask_dev)
+            if with_mask:
+                cls._register(w, mask_dev)
             masks[name] = mask
         return masks
 
@@ -126,17 +156,18 @@ class ASPHelper:
 class OptimizerWithSparsityGuarantee:
     """Wrapped optimizer: every update re-applies the ASP masks of ITS OWN
     parameters, through both step() and minimize() (reference asp.py
-    OptimizerWithSparsityGuarantee)."""
+    OptimizerWithSparsityGuarantee). Masks are looked up lazily each update
+    so ``decorate(opt)`` works whether called before or after
+    ``prune_model`` — the order the reference docs prescribe for dygraph is
+    decorate-then-prune."""
 
     def __init__(self, optimizer):
         self._optimizer = optimizer
-        params = getattr(optimizer, "_parameter_list", None) or []
-        self._masked = ASPHelper.masks_for(params)
 
     def _apply_masks(self):
-        for p, mask in self._masked:
-            pv = np.asarray(p._value)
-            p._replace_value((pv * mask).astype(pv.dtype))
+        params = getattr(self._optimizer, "_parameter_list", None) or []
+        for p, mask in ASPHelper.masks_for(params):
+            p._replace_value(p._value * mask)
 
     def step(self, *args, **kwargs):
         out = self._optimizer.step(*args, **kwargs)
@@ -158,8 +189,12 @@ def decorate(optimizer):
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     """Prune supported layers' weights to n:m sparsity along the reduction
-    dim (reference prune_model :316). Returns {param_name: mask}."""
-    masks = ASPHelper.prune_model(model, n=n, m=m, mask_algo=mask_algo)
+    dim (reference prune_model :316). ``with_mask=False`` prunes values only
+    and does not register masks for optimizer re-application. Returns
+    {param_name: mask}."""
+    masks = ASPHelper.prune_model(
+        model, n=n, m=m, mask_algo=mask_algo, with_mask=with_mask
+    )
     for name, w in ASPHelper.prunable_parameters(model):
         if name in masks and not _check_param_sparsity(
             np.asarray(w._value), n=n, m=m, func_name=mask_algo

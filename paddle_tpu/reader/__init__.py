@@ -1,0 +1,233 @@
+"""paddle.reader parity — composable reader (generator-factory) decorators.
+
+Reference: python/paddle/reader/decorator.py (cache, map_readers, shuffle,
+chain, compose, buffered, firstn, xmap_readers, multiprocess_reader) and
+python/paddle/batch.py (paddle.batch). A "reader" is a zero-arg callable
+returning an iterator of samples; decorators wrap readers into new readers.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = [
+    "cache", "map_readers", "shuffle", "chain", "compose", "buffered",
+    "firstn", "xmap_readers", "multiprocess_reader", "batch",
+    "ComposeNotAligned",
+]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into mini-batches. Reference: python/paddle/batch.py:23."""
+    if batch_size <= 0 or int(batch_size) != batch_size:
+        raise ValueError("batch_size should be a positive integer")
+
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
+
+
+def cache(reader):
+    """Materialize the reader's samples in memory on first pass."""
+    all_data = tuple(reader())
+
+    def cache_reader():
+        yield from all_data
+
+    return cache_reader
+
+
+def map_readers(func, *readers):
+    """Yield func applied across the zipped outputs of the readers."""
+
+    def reader():
+        rs = [r() for r in readers]
+        yield from map(func, *rs)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle within windows of buf_size samples."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+
+    def reader():
+        yield from itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into tuple samples; flattens tuple-valued components."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(x) for x in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned.")
+                yield sum((make_tuple(x) for x in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Read-ahead buffer of `size` samples filled by a daemon thread."""
+
+    class EndSignal:
+        pass
+
+    end = EndSignal()
+
+    def read_worker(r, q):
+        for d in r:
+            q.put(d)
+        q.put(end)
+
+    def data_reader():
+        r = reader()
+        q = queue.Queue(maxsize=size)
+        t = threading.Thread(target=read_worker, args=(r, q))
+        t.daemon = True
+        t.start()
+        e = q.get()
+        while e is not end:
+            yield e
+            e = q.get()
+
+    return data_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with `process_num` worker threads.
+
+    Reference semantics (python/paddle/reader/decorator.py:479): workers pull
+    samples from an input queue, apply mapper, push to an output queue;
+    `order=True` preserves sample order.
+    """
+    in_q: queue.Queue = queue.Queue(buffer_size)
+    end = object()
+
+    def data_reader():
+        out_q: queue.Queue = queue.Queue(buffer_size)
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if order:
+            pending: dict[int, object] = {}
+            next_i = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                i, mapped = item
+                pending[i] = mapped
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item[1]
+
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers concurrently (thread-backed here: samples
+    are numpy-producing Python generators, so the GIL-bound thread pool
+    matches the reference's throughput role without fork hazards under JAX)."""
+
+    def data_reader():
+        out_q: queue.Queue = queue.Queue(queue_size)
+        end = object()
+
+        def work(r):
+            for sample in r():
+                out_q.put(sample)
+            out_q.put(end)
+
+        for r in readers:
+            threading.Thread(target=work, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            item = out_q.get()
+            if item is end:
+                finished += 1
+            else:
+                yield item
+
+    return data_reader

@@ -1,0 +1,54 @@
+"""paddle.utils parity.
+
+Reference surface: python/paddle/utils/__init__.py — deprecated decorator,
+dlpack interchange, unique_name, download cache, flops accounting,
+install_check, layer-structure helpers, cpp_extension.
+"""
+from __future__ import annotations
+
+from . import deprecated as _deprecated_mod  # noqa: F401
+from .deprecated import deprecated
+from . import dlpack
+from . import unique_name
+from . import download
+from . import flops as _flops_mod
+from .flops import flops, register_flops
+from . import install_check
+from .install_check import run_check
+from .lazy_import import try_import
+from .layers_utils import flatten, pack_sequence_as, map_structure
+
+__all__ = [
+    "deprecated", "dlpack", "unique_name", "download", "flops",
+    "register_flops", "install_check", "run_check", "try_import",
+    "flatten", "pack_sequence_as", "map_structure", "require_version",
+]
+
+
+def require_version(min_version: str, max_version: str | None = None) -> None:
+    """Check that the installed framework version is within range.
+
+    Reference: python/paddle/utils/__init__.py require_version.
+    """
+    from .. import __version__
+
+    def _parse(v):
+        parts = []
+        for p in str(v).split("."):
+            digits = "".join(ch for ch in p if ch.isdigit())
+            parts.append(int(digits) if digits else 0)
+        while len(parts) < 3:
+            parts.append(0)
+        return tuple(parts[:3])
+
+    if not isinstance(min_version, str):
+        raise TypeError("min_version must be a str")
+    cur = _parse(__version__)
+    if cur < _parse(min_version):
+        raise Exception(
+            f"installed version {__version__} < required min {min_version}"
+        )
+    if max_version is not None and cur > _parse(max_version):
+        raise Exception(
+            f"installed version {__version__} > allowed max {max_version}"
+        )

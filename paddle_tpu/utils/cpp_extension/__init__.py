@@ -1,0 +1,3 @@
+from .cpp_extension import CppExtension, CUDAExtension, load, setup
+
+__all__ = ["CppExtension", "CUDAExtension", "load", "setup"]

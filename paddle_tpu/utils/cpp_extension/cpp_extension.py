@@ -1,0 +1,99 @@
+"""JIT / setuptools build of native extensions.
+
+Reference: python/paddle/utils/cpp_extension/ (CppExtension/CUDAExtension/
+setup/load building custom operators against the paddle C++ headers).
+
+TPU-native shape: custom *device* kernels are Pallas (pure Python), so this
+module's job is the host-side native path — compile C/C++ sources into a
+shared object with g++ and expose it via ctypes (pybind11 is not available
+in this image; the framework's own runtime in csrc/ uses a C ABI the same
+way). `load()` returns the loaded ctypes.CDLL; `setup()` defers to
+setuptools for installable packages.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+__all__ = ["CppExtension", "CUDAExtension", "load", "setup"]
+
+
+class CppExtension:
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = list(sources)
+        self.extra_compile_args = kwargs.get("extra_compile_args", [])
+        self.extra_link_args = kwargs.get("extra_link_args", [])
+        self.include_dirs = kwargs.get("include_dirs", [])
+
+
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension is not supported in the TPU build: device kernels are "
+        "Pallas (see paddle_tpu/ops/pallas). Use CppExtension for host-side "
+        "native code."
+    )
+
+
+def _build_dir() -> str:
+    d = os.environ.get(
+        "PADDLE_TPU_EXTENSION_DIR",
+        os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions"),
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name: str, sources, extra_cxx_cflags=None, extra_include_paths=None,
+         build_directory: str | None = None, verbose: bool = False, **kwargs):
+    """Compile `sources` into lib<name>.so and load it via ctypes.
+
+    Rebuilds only when source content changes (content-hash cache key),
+    mirroring the reference's version-checked JIT build.
+    """
+    sources = [os.path.abspath(s) for s in sources]
+    build_directory = build_directory or _build_dir()
+    h = hashlib.sha1()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    tag = h.hexdigest()[:12]
+    out = os.path.join(build_directory, f"lib{name}_{tag}.so")
+    if not os.path.exists(out):
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", out]
+        for inc in extra_include_paths or []:
+            cmd += ["-I", inc]
+        cmd += list(extra_cxx_cflags or [])
+        cmd += sources
+        if verbose:
+            print("Compiling:", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(out)
+
+
+def setup(**attrs):
+    """setuptools-based installable build of CppExtension modules."""
+    import setuptools
+    from setuptools.command.build_ext import build_ext
+
+    ext_modules = attrs.pop("ext_modules", [])
+    converted = []
+    for ext in ext_modules if isinstance(ext_modules, list) else [ext_modules]:
+        if isinstance(ext, CppExtension):
+            converted.append(
+                setuptools.Extension(
+                    name=attrs.get("name", "paddle_tpu_ext"),
+                    sources=ext.sources,
+                    extra_compile_args=["-std=c++17"] + list(ext.extra_compile_args),
+                    extra_link_args=list(ext.extra_link_args),
+                    include_dirs=list(ext.include_dirs),
+                    language="c++",
+                )
+            )
+        else:
+            converted.append(ext)
+    attrs["ext_modules"] = converted
+    attrs.setdefault("cmdclass", {})["build_ext"] = build_ext
+    return setuptools.setup(**attrs)

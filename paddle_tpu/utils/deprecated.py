@@ -1,0 +1,39 @@
+"""Deprecation decorator.
+
+Reference: python/paddle/utils/deprecated.py — annotates the docstring and
+emits a DeprecationWarning with since/update_to/reason on first call.
+"""
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0):
+    def decorator(func):
+        msg = f'API "{func.__module__}.{func.__name__}" is deprecated'
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f', and will be removed in future versions. Please use "{update_to}" instead'
+        if reason:
+            msg += f". Reason: {reason}"
+        if level == 2:
+            raise RuntimeError(msg)
+
+        existing = func.__doc__ or ""
+        func.__doc__ = f"\n\n.. warning::\n    {msg}\n\n" + existing
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level == 1:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator

@@ -1,0 +1,50 @@
+"""DLPack interchange.
+
+Reference: python/paddle/utils/dlpack.py (to_dlpack/from_dlpack).
+
+On TPU the PJRT plugin does not expose zero-copy external references, so
+the interchange path stages through host memory (numpy implements the
+DLPack protocol); CPU arrays interchange zero-copy where the consumer
+supports it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    if not isinstance(x, Tensor):
+        raise TypeError(
+            f"The type of 'x' in to_dlpack must be paddle_tpu.Tensor, but received {type(x)}."
+        )
+    # np.asarray of a jax array is readonly; DLPack can't signal readonly,
+    # so export an owned writable copy.
+    host = np.array(x._value, copy=True)
+    return host.__dlpack__()
+
+
+def from_dlpack(dlpack) -> Tensor:
+    """Accepts a DLPack capsule or any object implementing ``__dlpack__``
+    (torch/numpy/jax arrays)."""
+    if hasattr(dlpack, "__dlpack__"):
+        host = np.from_dlpack(dlpack)
+    else:
+        # raw capsule: numpy's from_dlpack consumes capsules via a shim
+        host = np.from_dlpack(_CapsuleWrapper(dlpack))
+    return Tensor._from_value(np.ascontiguousarray(host))
+
+
+class _CapsuleWrapper:
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, stream=None):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        # kDLCPU = 1; host-staged capsules are always CPU-resident
+        return (1, 0)

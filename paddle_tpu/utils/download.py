@@ -1,0 +1,71 @@
+"""Weight/data file cache resolution.
+
+Reference: python/paddle/utils/download.py (get_weights_path_from_url /
+get_path_from_url with a ~/.cache download directory and md5 checks).
+
+This environment has zero network egress, so the TPU build resolves URLs
+against the local cache only: a file already placed under
+``$PADDLE_TPU_HOME/weights`` (default ``~/.cache/paddle_tpu``) by an offline
+sync is returned; anything else raises with instructions. Decompression of
+cached .tar/.zip archives is supported like the reference.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+import tarfile
+import zipfile
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url", "WEIGHTS_HOME"]
+
+WEIGHTS_HOME = osp.expanduser(
+    os.environ.get("PADDLE_TPU_HOME", "~/.cache/paddle_tpu/weights")
+)
+
+
+def _md5check(fullname: str, md5sum: str | None) -> bool:
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def _decompress(fname: str) -> str:
+    dirname = osp.dirname(fname)
+    if tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as f:
+            names = f.getnames()
+            f.extractall(path=dirname, filter="data")
+        root = names[0].split(os.sep)[0]
+        return osp.join(dirname, root)
+    if zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as f:
+            names = f.namelist()
+            f.extractall(path=dirname)
+        root = names[0].split(os.sep)[0]
+        return osp.join(dirname, root)
+    return fname
+
+
+def get_path_from_url(url: str, root_dir: str | None = None,
+                      md5sum: str | None = None, check_exist: bool = True,
+                      decompress: bool = True) -> str:
+    root_dir = root_dir or WEIGHTS_HOME
+    fname = osp.split(url)[-1]
+    fullname = osp.join(root_dir, fname)
+    if osp.exists(fullname) and _md5check(fullname, md5sum):
+        if decompress and (fullname.endswith((".tar", ".tar.gz", ".tgz", ".zip"))):
+            return _decompress(fullname)
+        return fullname
+    raise RuntimeError(
+        f"'{fname}' not found in local cache {root_dir} and this build has no "
+        f"network egress. Place the file there manually (source: {url})."
+    )
+
+
+def get_weights_path_from_url(url: str, md5sum: str | None = None) -> str:
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

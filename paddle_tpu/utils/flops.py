@@ -1,0 +1,201 @@
+"""FLOPs accounting.
+
+Reference: python/paddle/utils/flops.py (per-op registry keyed by op_type)
+and python/paddle/hapi/dynamic_flops.py (`paddle.flops(net, input_size)`
+layer-walking summary). The TPU build adds an XLA-native third path:
+``xla_flops(fn, *args)`` reads the compiled executable's cost analysis, which
+is exactly what the hardware will execute after fusion.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+__all__ = ["flops", "register_flops", "dynamic_flops", "xla_flops"]
+
+_FLOPS_COMPUTE_FUNC_MAP: dict[str, Callable] = {}
+
+
+def _prod(s):
+    out = 1
+    for v in s:
+        out *= v
+    return out
+
+
+def flops(op_type: str, input_shapes: dict, attrs: dict) -> int:
+    """Count FLOPs for one op invocation; unknown op types count 0."""
+    func = _FLOPS_COMPUTE_FUNC_MAP.get(op_type)
+    if func is None:
+        return 0
+    try:
+        return func(input_shapes, attrs)
+    except Exception:
+        return 0
+
+
+def register_flops(op_type: str):
+    def register(func):
+        _FLOPS_COMPUTE_FUNC_MAP[op_type] = func
+        return func
+
+    return register
+
+
+@register_flops("matmul")
+@register_flops("matmul_v2")
+def _matmul_flops(input_shapes, attrs):
+    x = list(input_shapes.get("X", input_shapes.get("x"))[0])
+    y = list(input_shapes.get("Y", input_shapes.get("y"))[0])
+    if attrs.get("transpose_X") or attrs.get("transpose_x") or attrs.get("trans_x"):
+        x[-1], x[-2] = x[-2], x[-1]
+    if attrs.get("transpose_Y") or attrs.get("transpose_y") or attrs.get("trans_y"):
+        y[-1], y[-2] = y[-2], y[-1]
+    batch = _prod(x[:-2]) if len(x) > 2 else (_prod(y[:-2]) if len(y) > 2 else 1)
+    return 2 * batch * x[-2] * x[-1] * y[-1]
+
+
+@register_flops("conv2d")
+def _conv2d_flops(input_shapes, attrs):
+    inp = input_shapes.get("Input", input_shapes.get("x"))[0]
+    filt = input_shapes.get("Filter", input_shapes.get("weight"))[0]
+    strides = attrs.get("strides", [1, 1])
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1)
+    n, _, h, w = inp
+    c_out, c_in_g, kh, kw = filt
+    ho = (h + 2 * paddings[0] - dilations[0] * (kh - 1) - 1) // strides[0] + 1
+    wo = (w + 2 * paddings[1] - dilations[1] * (kw - 1) - 1) // strides[1] + 1
+    return 2 * n * c_out * ho * wo * c_in_g * kh * kw
+
+
+@register_flops("relu")
+@register_flops("gelu")
+@register_flops("silu")
+@register_flops("dropout")
+@register_flops("softmax")
+@register_flops("elementwise_add")
+@register_flops("elementwise_mul")
+@register_flops("elementwise_div")
+def _elementwise_flops(input_shapes, attrs):
+    key = next(iter(input_shapes))
+    return _prod(input_shapes[key][0])
+
+
+@register_flops("layer_norm")
+@register_flops("rms_norm")
+def _norm_flops(input_shapes, attrs):
+    key = next(iter(input_shapes))
+    return 5 * _prod(input_shapes[key][0])
+
+
+@register_flops("c_embedding")
+@register_flops("embedding")
+def _embedding_flops(input_shapes, attrs):
+    return 0
+
+
+def xla_flops(fn, *args, **kwargs) -> int:
+    """FLOPs of `fn(*args)` as XLA's compiled cost analysis reports them —
+    the post-fusion count the TPU actually executes."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    def unwrap(a):
+        return a._value if isinstance(a, Tensor) else a
+
+    args = [unwrap(a) for a in args]
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, list):
+        analysis = analysis[0]
+    return int(analysis.get("flops", 0))
+
+
+def dynamic_flops(net, input_size, custom_ops=None, print_detail=False) -> int:
+    """`paddle.flops(net, input_size)` — hook-based per-layer FLOPs table.
+
+    Reference: python/paddle/hapi/dynamic_flops.py:28.
+    """
+    import numpy as np
+
+    from .. import nn
+    from ..core.tensor import Tensor
+
+    custom_ops = custom_ops or {}
+    counts: dict[int, dict] = {}
+    handles = []
+
+    def count_linear(layer, inp, out):
+        w = layer.weight.shape
+        return _prod(out.shape) * w[0] * 2
+
+    def count_conv(layer, inp, out):
+        kshape = layer.weight.shape  # [C_out, C_in/g, kh, kw]
+        return 2 * _prod(out.shape) * _prod(kshape[1:])
+
+    def count_norm(layer, inp, out):
+        return 5 * _prod(out.shape)
+
+    def count_act(layer, inp, out):
+        return _prod(out.shape)
+
+    def count_pool(layer, inp, out):
+        return _prod(out.shape)
+
+    handlers = {
+        nn.Linear: count_linear,
+        nn.Conv2D: count_conv,
+        nn.BatchNorm2D: count_norm,
+        nn.BatchNorm1D: count_norm,
+        nn.LayerNorm: count_norm,
+        nn.ReLU: count_act,
+        nn.GELU: count_act,
+        nn.Sigmoid: count_act,
+        nn.Softmax: count_act,
+        nn.MaxPool2D: count_pool,
+        nn.AvgPool2D: count_pool,
+        nn.AdaptiveAvgPool2D: count_pool,
+    }
+    handlers.update(custom_ops)
+
+    def make_hook(handler):
+        def hook(layer, inp, out):
+            o = out[0] if isinstance(out, (tuple, list)) else out
+            i = inp[0] if isinstance(inp, (tuple, list)) else inp
+            n_params = sum(_prod(p.shape) for p in layer.parameters(include_sublayers=False))
+            counts[id(layer)] = {
+                "layer": layer,
+                "flops": handler(layer, i, o),
+                "params": n_params,
+                "output_shape": list(o.shape),
+            }
+
+        return hook
+
+    for sub in net.sublayers(include_self=True):
+        handler = handlers.get(type(sub))
+        if handler is not None:
+            handles.append(sub.register_forward_post_hook(make_hook(handler)))
+
+    was_training = getattr(net, "training", True)
+    net.eval()
+    x = Tensor(np.zeros(input_size, dtype="float32"))
+    try:
+        net(x)
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total_flops = sum(v["flops"] for v in counts.values())
+    total_params = sum(v["params"] for v in counts.values())
+    if print_detail:
+        print(f"{'Layer':<30}{'Output Shape':<24}{'Params':>12}{'FLOPs':>16}")
+        for v in counts.values():
+            print(f"{type(v['layer']).__name__:<30}"
+                  f"{str(v['output_shape']):<24}{v['params']:>12}{v['flops']:>16}")
+    print(f"Total Flops: {total_flops}     Total Params: {total_params}")
+    return int(total_flops)

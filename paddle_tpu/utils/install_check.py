@@ -1,0 +1,45 @@
+"""Installation self-check.
+
+Reference: python/paddle/utils/install_check.py (run_check trains a tiny
+linear model on one and, when available, multiple devices and prints a
+verdict). TPU form: one compiled train step single-device, then the same
+step pjit-sharded over all visible devices.
+"""
+from __future__ import annotations
+
+__all__ = ["run_check"]
+
+
+def run_check() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    devices = jax.devices()
+    print(f"Running verify on {len(devices)} {devices[0].platform} device(s).")
+
+    def loss_fn(w, x, y):
+        pred = x @ w
+        return jnp.mean((pred - y) ** 2)
+
+    grad_fn = jax.jit(jax.grad(loss_fn))
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (8, 4), dtype=jnp.float32)
+    w = jnp.zeros((4, 1), dtype=jnp.float32)
+    y = jnp.ones((8, 1), dtype=jnp.float32)
+    g = grad_fn(w, x, y)
+    assert g.shape == (4, 1)
+    print("paddle_tpu works well on 1 device.")
+
+    if len(devices) > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(devices, ("dp",))
+        sharded = jax.jit(
+            jax.grad(loss_fn),
+            in_shardings=(NamedSharding(mesh, P()), NamedSharding(mesh, P("dp")),
+                          NamedSharding(mesh, P("dp"))),
+        )
+        g = sharded(w, x, y)
+        assert g.shape == (4, 1)
+        print(f"paddle_tpu works well on {len(devices)} devices.")
+    print("paddle_tpu is installed successfully!")

@@ -1,0 +1,37 @@
+"""Nested-structure helpers.
+
+Reference: python/paddle/utils/layers_utils.py (flatten / pack_sequence_as /
+map_structure over arbitrarily nested lists/tuples/dicts). On TPU these ride
+jax.tree_util so the flattening order matches what pjit/jit see.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["flatten", "pack_sequence_as", "map_structure", "to_sequence"]
+
+
+def flatten(nest):
+    return jax.tree_util.tree_leaves(
+        nest, is_leaf=lambda x: not isinstance(x, (list, tuple, dict))
+    )
+
+
+def pack_sequence_as(structure, flat_sequence):
+    treedef = jax.tree_util.tree_structure(
+        structure, is_leaf=lambda x: not isinstance(x, (list, tuple, dict))
+    )
+    return jax.tree_util.tree_unflatten(treedef, list(flat_sequence))
+
+
+def map_structure(func, *structures):
+    return jax.tree_util.tree_map(
+        func, *structures,
+        is_leaf=lambda x: not isinstance(x, (list, tuple, dict)),
+    )
+
+
+def to_sequence(nest):
+    if isinstance(nest, (list, tuple)):
+        return list(nest)
+    return [nest]

@@ -1,0 +1,22 @@
+"""Optional-dependency import helper.
+
+Reference: python/paddle/utils/lazy_import.py (try_import).
+"""
+from __future__ import annotations
+
+import importlib
+
+__all__ = ["try_import"]
+
+
+def try_import(module_name: str, err_msg: str | None = None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        if err_msg is None:
+            err_msg = (
+                f"Failed importing {module_name}. This likely means that some "
+                f"modules require additional dependencies that have to be "
+                f"manually installed (usually with `pip install {module_name}`)."
+            )
+        raise ImportError(err_msg) from e

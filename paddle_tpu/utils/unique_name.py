@@ -1,0 +1,53 @@
+"""Unique-name generator.
+
+Reference: python/paddle/base/unique_name.py re-exported through
+python/paddle/utils/unique_name.py (generate/switch/guard).
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+__all__ = ["generate", "switch", "guard", "generate_with_ignorable_key"]
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+        self.ids: defaultdict[str, int] = defaultdict(int)
+
+    def __call__(self, key: str) -> str:
+        tmp = self.ids[key]
+        self.ids[key] += 1
+        return "_".join([self.prefix + key, str(tmp)]) if self.prefix else f"{key}_{tmp}"
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    return generator(key)
+
+
+def generate_with_ignorable_key(key: str) -> str:
+    return generator(key)
+
+
+def switch(new_generator: UniqueNameGenerator | None = None):
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None else UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    elif isinstance(new_generator, bytes):
+        new_generator = UniqueNameGenerator(new_generator.decode())
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,233 @@
+"""paddle.utils / paddle.reader / paddle.dataset tests.
+
+Reference models: test/legacy_test/test_unique_name.py, test_dlpack.py,
+test_flops.py (hapi), test/reader tests, dataset readers feeding
+paddle.batch.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import reader as reader_mod
+from paddle_tpu import dataset
+from paddle_tpu.utils import (
+    deprecated, dlpack, flops, register_flops, try_import, unique_name,
+    require_version, flatten, pack_sequence_as, map_structure,
+)
+
+
+class TestUniqueName:
+    def test_generate(self):
+        with unique_name.guard():
+            a = unique_name.generate("fc")
+            b = unique_name.generate("fc")
+        assert a == "fc_0" and b == "fc_1"
+
+    def test_guard_isolation(self):
+        with unique_name.guard():
+            a = unique_name.generate("w")
+        with unique_name.guard():
+            b = unique_name.generate("w")
+        assert a == b == "w_0"
+
+    def test_prefix_guard(self):
+        with unique_name.guard("pre_"):
+            n = unique_name.generate("fc")
+        assert n.startswith("pre_fc")
+
+
+class TestDlpack:
+    def test_roundtrip(self):
+        x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+        cap = dlpack.to_dlpack(x)
+        y = dlpack.from_dlpack(cap)
+        np.testing.assert_array_equal(x.numpy(), y.numpy())
+
+    def test_from_external(self):
+        a = np.arange(6, dtype="int32").reshape(2, 3)
+        y = dlpack.from_dlpack(a)
+        np.testing.assert_array_equal(a, y.numpy())
+
+    def test_type_error(self):
+        with pytest.raises(TypeError):
+            dlpack.to_dlpack(np.ones(3))
+
+
+class TestDeprecated:
+    def test_warns(self):
+        @deprecated(since="2.0", update_to="paddle.new_api")
+        def old_api():
+            return 7
+
+        with pytest.warns(DeprecationWarning):
+            assert old_api() == 7
+        assert "deprecated" in old_api.__doc__
+
+
+class TestFlops:
+    def test_op_flops_matmul(self):
+        n = flops("matmul", {"X": [[4, 8]], "Y": [[8, 3]]}, {})
+        assert n == 2 * 4 * 8 * 3
+
+    def test_register(self):
+        @register_flops("my_op")
+        def _my(input_shapes, attrs):
+            return 42
+
+        assert flops("my_op", {}, {}) == 42
+        assert flops("unknown_op_xyz", {}, {}) == 0
+
+    def test_dynamic_flops(self, capsys):
+        import paddle_tpu.nn as nn
+
+        net = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 2))
+        total = paddle.flops(net, [1, 8], print_detail=True)
+        # linear1: 2*1*4*8, relu: 4, linear2: 2*1*2*4
+        assert total == 64 + 4 + 16
+        assert "Total Flops" in capsys.readouterr().out
+
+    def test_xla_flops(self):
+        from paddle_tpu.utils.flops import xla_flops
+
+        x = paddle.to_tensor(np.ones((16, 16), dtype="float32"))
+        n = xla_flops(lambda a: a @ a, x)
+        assert n >= 2 * 16 * 16 * 16 - 16 * 16  # fused variants may differ slightly
+
+
+class TestUtilsMisc:
+    def test_try_import(self):
+        assert try_import("json") is not None
+        with pytest.raises(ImportError):
+            try_import("not_a_real_module_xyz")
+
+    def test_require_version(self):
+        require_version("0.0.1")
+        with pytest.raises(Exception):
+            require_version("999.0.0")
+
+    def test_structure_helpers(self):
+        nest = {"a": [1, 2], "b": (3, {"c": 4})}
+        flat = flatten(nest)
+        assert sorted(flat) == [1, 2, 3, 4]
+        rebuilt = pack_sequence_as(nest, flat)
+        assert flatten(rebuilt) == flat
+        doubled = map_structure(lambda v: v * 2, nest)
+        assert sorted(flatten(doubled)) == [2, 4, 6, 8]
+
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        assert "successfully" in capsys.readouterr().out
+
+    def test_cpp_extension_load(self, tmp_path):
+        src = tmp_path / "ext.cc"
+        src.write_text('extern "C" int add_one(int x) { return x + 1; }\n')
+        from paddle_tpu.utils.cpp_extension import load
+
+        lib = load("tadd", [str(src)], build_directory=str(tmp_path))
+        assert lib.add_one(41) == 42
+
+
+class TestReader:
+    def test_batch(self):
+        r = paddle.batch(lambda: iter(range(10)), batch_size=3)
+        batches = list(r())
+        assert batches[0] == [0, 1, 2] and batches[-1] == [9]
+        r = paddle.batch(lambda: iter(range(10)), batch_size=3, drop_last=True)
+        assert len(list(r())) == 3
+
+    def test_shuffle_chain_firstn(self):
+        r = reader_mod.shuffle(lambda: iter(range(10)), buf_size=10)
+        assert sorted(r()) == list(range(10))
+        c = reader_mod.chain(lambda: iter([1, 2]), lambda: iter([3]))
+        assert list(c()) == [1, 2, 3]
+        f = reader_mod.firstn(lambda: iter(range(100)), 5)
+        assert list(f()) == [0, 1, 2, 3, 4]
+
+    def test_compose_map_cache_buffered(self):
+        c = reader_mod.compose(lambda: iter([1, 2]), lambda: iter([(3, 4), (5, 6)]))
+        assert list(c()) == [(1, 3, 4), (2, 5, 6)]
+        m = reader_mod.map_readers(lambda a, b: a + b,
+                                   lambda: iter([1, 2]), lambda: iter([10, 20]))
+        assert list(m()) == [11, 22]
+        cached = reader_mod.cache(lambda: iter(range(3)))
+        assert list(cached()) == list(cached()) == [0, 1, 2]
+        b = reader_mod.buffered(lambda: iter(range(5)), size=2)
+        assert list(b()) == [0, 1, 2, 3, 4]
+
+    def test_compose_misaligned(self):
+        c = reader_mod.compose(lambda: iter([1]), lambda: iter([1, 2]))
+        with pytest.raises(reader_mod.ComposeNotAligned):
+            list(c())
+
+    def test_xmap(self):
+        r = reader_mod.xmap_readers(lambda x: x * 2, lambda: iter(range(20)),
+                                    process_num=3, buffer_size=4, order=True)
+        assert list(r()) == [v * 2 for v in range(20)]
+        r = reader_mod.xmap_readers(lambda x: x * 2, lambda: iter(range(20)),
+                                    process_num=3, buffer_size=4, order=False)
+        assert sorted(r()) == [v * 2 for v in range(20)]
+
+    def test_multiprocess_reader(self):
+        r = reader_mod.multiprocess_reader(
+            [lambda: iter(range(5)), lambda: iter(range(5, 10))])
+        assert sorted(r()) == list(range(10))
+
+
+class TestDataset:
+    def test_mnist_synthetic(self):
+        r = dataset.mnist.train(synthetic=True)
+        img, lab = next(r())
+        assert img.shape == (784,) and 0 <= lab < 10
+        batches = list(paddle.batch(r, 64)())
+        assert len(batches[0]) == 64
+
+    def test_cifar_synthetic(self):
+        img, lab = next(dataset.cifar.train10(synthetic=True)())
+        assert img.shape == (3072,) and 0 <= lab < 10
+        _, lab100 = next(dataset.cifar.train100(synthetic=True)())
+        assert 0 <= lab100 < 100
+
+    def test_uci_housing(self):
+        x, y = next(dataset.uci_housing.train(synthetic=True)())
+        assert x.shape == (13,) and y.shape == (1,)
+        n_train = len(list(dataset.uci_housing.train(synthetic=True)()))
+        n_test = len(list(dataset.uci_housing.test(synthetic=True)()))
+        assert n_train == 404 and n_test == 102
+
+    def test_imdb_synthetic(self):
+        w = dataset.imdb.word_dict(synthetic=True)
+        assert "<unk>" in w
+        ids, label = next(dataset.imdb.train(w, synthetic=True)())
+        assert all(isinstance(i, int) for i in ids) and label in (0, 1)
+
+    def test_imikolov_synthetic(self):
+        w = dataset.imikolov.build_dict(synthetic=True)
+        gram = next(dataset.imikolov.train(w, 5, synthetic=True)())
+        assert len(gram) == 5
+        src, trg = next(dataset.imikolov.train(
+            w, -1, dataset.imikolov.DataType.SEQ, synthetic=True)())
+        assert len(src) == len(trg)
+
+    def test_movielens_synthetic(self):
+        sample = next(dataset.movielens.train(synthetic=True)())
+        # user(4) + movie(3) + score(1)
+        assert len(sample) == 8
+        assert dataset.movielens.max_user_id(synthetic=True) == 32
+
+    def test_conll05(self):
+        word_d, verb_d, label_d = dataset.conll05.get_dict()
+        sample = next(dataset.conll05.test()())
+        assert len(sample) == 9
+        assert len(sample[0]) == len(sample[8])
+        emb = dataset.conll05.get_embedding(word_d)
+        assert emb.shape[0] == len(word_d)
+
+    def test_flowers(self):
+        img, lab = next(dataset.flowers.train()())
+        assert img.shape == (3, 32, 32) and 0 <= lab < 102
+
+    def test_common_download_raises(self):
+        with pytest.raises(RuntimeError):
+            dataset.common.download("http://example.com/x.tar", "x")
