@@ -8,6 +8,8 @@ from __future__ import annotations
 import hashlib
 import os
 
+from ..utils.download import _md5check as _md5check  # noqa: F401
+
 __all__ = ["DATA_HOME", "md5file", "download", "split", "cluster_files_reader"]
 
 DATA_HOME = os.path.expanduser(
@@ -16,11 +18,9 @@ DATA_HOME = os.path.expanduser(
 
 
 def md5file(fname: str) -> str:
-    hash_md5 = hashlib.md5()
-    with open(fname, "rb") as f:
-        for chunk in iter(lambda: f.read(4096), b""):
-            hash_md5.update(chunk)
-    return hash_md5.hexdigest()
+    from ..utils.download import md5file as _md5
+
+    return _md5(fname)
 
 
 def download(url: str, module_name: str, md5sum: str | None = None,
@@ -80,4 +80,6 @@ def cluster_files_reader(files_pattern, trainer_count, trainer_id,
 def _synthetic_rng(name: str):
     import numpy as np
 
-    return np.random.default_rng(abs(hash(name)) % (2**32))
+    # stable across processes (str hash() is randomized per interpreter)
+    seed = int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "little")
+    return np.random.default_rng(seed)

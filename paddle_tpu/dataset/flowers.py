@@ -1,11 +1,15 @@
 """Oxford-102 flowers reader.
 
 Reference: python/paddle/dataset/flowers.py — train()/test()/valid() yield
-(3x224x224 float image, int label) from the image tarball + .mat label
-files. Synthetic mode generates deterministic images so vision pipelines
-can run without the archives.
+(float image CHW, int label). The real-archive path (image tarball + .mat
+label files, scipy-loaded) requires files in the local cache; synthetic
+mode generates deterministic 3x32x32 images (a reduced stand-in shape —
+the reference emits 3x224x224 crops) so vision pipelines can run without
+the archives.
 """
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
@@ -25,31 +29,32 @@ def _synthetic_reader(n, seed_name, size=(3, 32, 32)):
     return reader
 
 
-def train(synthetic: bool = True, mapper=None, buffered_size: int = 1024,
-          use_xmap: bool = False):
-    r = _synthetic_reader(256, "flowers-train")
+def _make(split, n, synthetic, mapper):
+    if not synthetic:
+        base = os.path.join(common.DATA_HOME, "flowers")
+        raise RuntimeError(
+            f"flowers.{split}(synthetic=False) needs 102flowers.tgz + "
+            f"setid.mat + imagelabels.mat in {base}; this build has no "
+            "network egress. Use synthetic=True for generated data."
+        )
+    r = _synthetic_reader(n, f"flowers-{split}")
     if mapper is not None:
         from ..reader import map_readers
 
         return map_readers(mapper, r)
     return r
+
+
+def train(synthetic: bool = True, mapper=None, buffered_size: int = 1024,
+          use_xmap: bool = False):
+    return _make("train", 256, synthetic, mapper)
 
 
 def test(synthetic: bool = True, mapper=None, buffered_size: int = 1024,
          use_xmap: bool = False):
-    r = _synthetic_reader(64, "flowers-test")
-    if mapper is not None:
-        from ..reader import map_readers
-
-        return map_readers(mapper, r)
-    return r
+    return _make("test", 64, synthetic, mapper)
 
 
 def valid(synthetic: bool = True, mapper=None, buffered_size: int = 1024,
           use_xmap: bool = False):
-    r = _synthetic_reader(64, "flowers-valid")
-    if mapper is not None:
-        from ..reader import map_readers
-
-        return map_readers(mapper, r)
-    return r
+    return _make("valid", 64, synthetic, mapper)

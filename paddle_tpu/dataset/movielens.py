@@ -61,6 +61,7 @@ MOVIE_TITLE_DICT: dict | None = None
 CATEGORIES_DICT: dict | None = None
 USER_INFO: dict | None = None
 RATINGS: list | None = None
+_LOADED_MODE: bool | None = None  # synthetic flag the globals were built with
 
 
 def _load_synthetic():
@@ -127,18 +128,22 @@ def _load_real():
 
 
 def _ensure_loaded(synthetic):
-    if MOVIE_INFO is None:
+    global _LOADED_MODE
+    if MOVIE_INFO is None or _LOADED_MODE != bool(synthetic):
         if synthetic:
             _load_synthetic()
         else:
             _load_real()
+        _LOADED_MODE = bool(synthetic)
 
 
 def _reader(synthetic, is_test, test_ratio=0.1):
     _ensure_loaded(synthetic)
-    rng = common._synthetic_rng("movielens-split")
 
     def reader():
+        # fresh RNG per iteration: the train/test split must be identical
+        # every epoch (and between the train() and test() readers)
+        rng = common._synthetic_rng("movielens-split")
         for uid, mid, score in RATINGS:
             in_test = rng.random() < test_ratio
             if in_test != is_test:

@@ -1,2 +1,21 @@
-"""paddle.incubate.nn parity."""
+"""paddle.incubate.nn parity.
+
+Reference: python/paddle/incubate/nn/__init__.py — fused transformer Layer
+classes plus the functional fused-op namespace, attn_bias descriptors and
+memory_efficient_attention.
+"""
 from . import functional
+from . import attn_bias
+from .memory_efficient_attention import memory_efficient_attention
+from .layer import (
+    FusedLinear, FusedDropoutAdd, FusedEcMoe,
+    FusedBiasDropoutResidualLayerNorm, FusedMultiHeadAttention,
+    FusedFeedForward, FusedTransformerEncoderLayer, FusedMultiTransformer,
+)
+
+__all__ = [
+    "FusedMultiHeadAttention", "FusedFeedForward",
+    "FusedTransformerEncoderLayer", "FusedMultiTransformer", "FusedLinear",
+    "FusedBiasDropoutResidualLayerNorm", "FusedEcMoe", "FusedDropoutAdd",
+    "functional", "attn_bias", "memory_efficient_attention",
+]

@@ -1,0 +1,190 @@
+"""Structured attention-bias descriptors.
+
+Reference: python/paddle/incubate/nn/attn_bias.py — AttentionBias hierarchy
+consumed by memory_efficient_attention (xformers-style). Materialization is
+numpy/jnp-built additive masks; on TPU a materialized bias feeds the masked
+SDPA path inside jit.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import ensure_tensor
+
+__all__ = [
+    "AttentionBias", "LowerTriangularMask", "LowerTriangularMaskWithTensorBias",
+    "SeqLenInfo", "PaddedSeqLenInfo", "BlockDiagonalMask",
+    "BlockDiagonalCausalMask",
+]
+
+_NEG_INF = float("-inf")
+
+
+class AttentionBias(ABC):
+    @abstractmethod
+    def materialize(self, shape, dtype="float32"):
+        raise NotImplementedError()
+
+
+class LowerTriangularMask(AttentionBias):
+    def materialize(self, shape, dtype="float32"):
+        from ...core.dtype import convert_dtype
+
+        dt = convert_dtype(dtype)
+        mask = jnp.triu(jnp.full(shape, _NEG_INF, dtype=jnp.float32), k=1)
+        return Tensor._from_value(mask.astype(dt))
+
+    def add_bias(self, bias):
+        return LowerTriangularMaskWithTensorBias(bias)
+
+
+class LowerTriangularMaskWithTensorBias(LowerTriangularMask):
+    def __init__(self, bias):
+        self._bias = ensure_tensor(bias)
+
+    def materialize(self, shape, dtype="float32"):
+        base = super().materialize(shape, dtype)
+        return Tensor._from_value(base._value + self._bias._value)
+
+
+@dataclass
+class SeqLenInfo:
+    seqstart: Tensor
+    max_seqlen: int
+    seqstart_py: List[int]
+
+    def intervals(self):
+        yield from zip(self.seqstart_py, self.seqstart_py[1:])
+
+    @classmethod
+    def from_seqlens(cls, seqlens):
+        seqstart_py = [0]
+        max_seqlen = -1
+        for seqlen in seqlens:
+            max_seqlen = max(max_seqlen, seqlen)
+            seqstart_py.append(seqstart_py[-1] + seqlen)
+        seqstart = Tensor._from_value(jnp.asarray(seqstart_py, dtype=jnp.int32))
+        return cls(max_seqlen=max_seqlen, seqstart=seqstart,
+                   seqstart_py=seqstart_py)
+
+    def split(self, x, batch_sizes=None):
+        assert self.seqstart_py[-1] == x.shape[1] and x.shape[0] == 1
+        if batch_sizes is None:
+            batch_sizes = [1] * (len(self.seqstart_py) - 1)
+        chunks = []
+        it = 0
+        for bs in batch_sizes:
+            chunks.append((self.seqstart_py[it], self.seqstart_py[it + bs], bs))
+            it += bs
+        out = []
+        for start, end, bs in chunks:
+            sub = x._value[:, start:end]
+            out.append(Tensor._from_value(
+                sub.reshape((bs, -1) + sub.shape[2:])
+            ))
+        return out
+
+
+@dataclass
+class PaddedSeqLenInfo(SeqLenInfo):
+    seqlen: Tensor = None
+    seqlen_py: Sequence[int] = ()
+
+    def intervals(self):
+        for (start, _), length in zip(
+            zip(self.seqstart_py, self.seqstart_py[1:]), self.seqlen_py
+        ):
+            yield start, start + length
+
+    @classmethod
+    def from_seqlens(cls, seqlens):
+        raise NotImplementedError(
+            "Use SeqLenInfo.from_seqlens() or PaddedSeqLenInfo.from_seqlens_padded()."
+        )
+
+    @classmethod
+    def from_seqlens_padded(cls, seqlens, padding):
+        assert all(s <= padding for s in seqlens)
+        seqstart_py = list(range(0, len(seqlens) * padding + 1, padding))
+        return cls(
+            seqlen=Tensor._from_value(jnp.asarray(seqlens, dtype=jnp.int32)),
+            seqlen_py=list(seqlens),
+            max_seqlen=max(seqlens),
+            seqstart=Tensor._from_value(
+                jnp.asarray(seqstart_py, dtype=jnp.int32)
+            ),
+            seqstart_py=seqstart_py,
+        )
+
+    def split(self, x, batch_sizes=None):
+        raise NotImplementedError()
+
+
+@dataclass
+class BlockDiagonalMask(AttentionBias):
+    q_seqinfo: SeqLenInfo
+    k_seqinfo: SeqLenInfo
+    _batch_sizes: Optional[Sequence[int]] = None
+
+    def _block(self, q_len, k_len):
+        return jnp.zeros((q_len, k_len), dtype=jnp.float32)
+
+    def materialize(self, shape, dtype="float32"):
+        from ...core.dtype import convert_dtype
+
+        assert shape[-1] == self.k_seqinfo.seqstart_py[-1]
+        assert shape[-2] == self.q_seqinfo.seqstart_py[-1]
+        mask = jnp.full(shape[-2:], _NEG_INF, dtype=jnp.float32)
+        for (qs, qe), (ks, ke) in zip(self.q_seqinfo.intervals(),
+                                      self.k_seqinfo.intervals()):
+            mask = mask.at[qs:qe, ks:ke].set(self._block(qe - qs, ke - ks))
+        mask = jnp.broadcast_to(mask, shape)
+        return Tensor._from_value(mask.astype(convert_dtype(dtype)))
+
+    @classmethod
+    def from_seqlens(cls, q_seqlen, kv_seqlen=None):
+        assert kv_seqlen is None or len(q_seqlen) == len(kv_seqlen)
+        q_seqinfo = SeqLenInfo.from_seqlens(q_seqlen)
+        if kv_seqlen is None or list(q_seqlen) == list(kv_seqlen):
+            k_seqinfo = q_seqinfo
+        else:
+            k_seqinfo = SeqLenInfo.from_seqlens(kv_seqlen)
+        return cls(q_seqinfo=q_seqinfo, k_seqinfo=k_seqinfo)
+
+    @classmethod
+    def from_tensor_list(cls, tensors):
+        from ...ops.manipulation import concat, reshape
+
+        batch_sizes = [t.shape[0] for t in tensors]
+        seqlens = []
+        for x in tensors:
+            seqlens.extend([x.shape[1]] * x.shape[0])
+        block_diag = cls.from_seqlens(seqlens)
+        block_diag._batch_sizes = batch_sizes
+        concated = concat(
+            [reshape(x, [1, -1, *x.shape[2:]]) for x in tensors], axis=1
+        )
+        return block_diag, concated
+
+    def make_causal(self):
+        return BlockDiagonalCausalMask(
+            q_seqinfo=self.q_seqinfo, k_seqinfo=self.k_seqinfo,
+            _batch_sizes=self._batch_sizes,
+        )
+
+    def split(self, x, batch_sizes=None):
+        return self.q_seqinfo.split(x, batch_sizes or self._batch_sizes)
+
+
+@dataclass
+class BlockDiagonalCausalMask(BlockDiagonalMask):
+    def _block(self, q_len, k_len):
+        return jnp.triu(
+            jnp.full((q_len, k_len), _NEG_INF, dtype=jnp.float32),
+            k=1 + k_len - q_len if k_len > q_len else 1,
+        )

@@ -16,7 +16,11 @@ from ....ops._helpers import defprim, ensure_tensor
 __all__ = [
     "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
     "fused_linear", "swiglu", "fused_bias_act", "fused_dropout_add",
-    "fused_feedforward", "fused_multi_head_attention",
+    "fused_feedforward", "fused_multi_head_attention", "fused_matmul_bias",
+    "fused_linear_activation", "masked_multihead_attention",
+    "blha_get_max_len", "block_multihead_attention",
+    "variable_length_memory_efficient_attention",
+    "fused_dot_product_attention",
 ]
 
 
@@ -121,6 +125,32 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
     if transpose_weight:
         weight = _t(ensure_tensor(weight))
     return linear(x, weight, bias)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """Reference: incubate/nn/functional/fused_matmul_bias.py:31 (cuBLASLt
+    epilogue fusion; on TPU XLA fuses the bias add into the GEMM)."""
+    from ....ops.math import add, matmul
+
+    out = matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    if bias is None:
+        return out
+    return add(out, ensure_tensor(bias))
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation=None):
+    """Reference: incubate/nn/functional/fused_matmul_bias.py:136
+    (gemm_epilogue with gelu/relu epilogue)."""
+    from ....ops import activation as A
+
+    if activation is None:
+        activation = "none"
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    if activation == "none":
+        return out
+    return {"gelu": A.gelu, "relu": A.relu}[activation](out)
 
 
 defprim("swiglu_p", lambda x, y: jax.nn.silu(x) * y)
@@ -272,3 +302,8 @@ def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
 
 
 __all__.append("fused_ec_moe")
+
+from .inference_attention import (  # noqa: E402
+    masked_multihead_attention, blha_get_max_len, block_multihead_attention,
+    variable_length_memory_efficient_attention, fused_dot_product_attention,
+)

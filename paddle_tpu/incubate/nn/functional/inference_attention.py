@@ -1,0 +1,373 @@
+"""Inference-serving fused attention ops.
+
+Reference surface: python/paddle/incubate/nn/functional/
+masked_multihead_attention.py:19 (single-step decode over a dense KV cache),
+block_multihead_attention.py:19 (paged KV cache prefill+decode),
+blha_get_max_len.py:26, variable_length_memory_efficient_attention.py,
+fused_dot_product_attention.py.
+
+TPU design: these are jnp programs meant to run under jit — the KV-cache
+update is a functional scatter (XLA dynamic-update-slice / scatter on the
+cache operand), attention rides einsum on the MXU, and padding masks replace
+the reference's CUDA warp-level varlen iteration. Quantized-cache arguments
+are rejected (int8 KV cache is not part of the TPU build's serving path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....core.tensor import Tensor, apply
+from ....ops._helpers import defprim, ensure_tensor
+
+__all__ = [
+    "masked_multihead_attention", "blha_get_max_len",
+    "block_multihead_attention", "variable_length_memory_efficient_attention",
+    "fused_dot_product_attention",
+]
+
+_NEG_INF = -1e9
+
+
+def _mmha_fwd(x, cache_kv, src_mask, seq_lens, *, num_heads, use_mask,
+              use_seq_lens):
+    # x: [B, 3*H*D] single decode step; cache_kv: [2, B, H, S_max, D]
+    b = x.shape[0]
+    h = num_heads
+    s_max = cache_kv.shape[3]
+    d = cache_kv.shape[4]
+    qkv = x.reshape(b, 3, h, d)
+    q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, H, D]
+
+    if use_seq_lens:
+        pos = seq_lens.reshape(b).astype(jnp.int32)  # write position per batch
+    elif use_mask:
+        # reference decode convention: src_mask is [B, 1, 1, t+1] at step t —
+        # its trailing dim carries the current timestep
+        pos = jnp.full((b,), src_mask.shape[-1] - 1, dtype=jnp.int32)
+    else:
+        # neither given: first decode step, append at 0
+        pos = jnp.zeros((b,), dtype=jnp.int32)
+
+    # functional cache append: scatter k/v at [b, :, pos[b], :]
+    b_idx = jnp.arange(b)
+    k_cache = cache_kv[0].at[b_idx, :, pos, :].set(k_new)
+    v_cache = cache_kv[1].at[b_idx, :, pos, :].set(v_new)
+
+    scale = 1.0 / np.sqrt(d)
+    scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * scale
+    valid = jnp.arange(s_max)[None, :] <= pos[:, None]  # [B, S]
+    scores = jnp.where(valid[:, None, :], scores, _NEG_INF)
+    if use_mask:
+        m = src_mask.reshape(b, 1, -1).astype(jnp.float32)
+        if m.shape[-1] < s_max:
+            # decode masks are [B,1,1,t+1]; positions beyond t are already
+            # dropped by `valid`, pad neutrally
+            m = jnp.pad(m, ((0, 0), (0, 0), (0, s_max - m.shape[-1])))
+        scores = scores + m[:, :, :s_max]
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhs,bhsd->bhd", probs, v_cache.astype(jnp.float32))
+    out = out.astype(x.dtype).reshape(b, h * d)
+    return out, jnp.stack([k_cache, v_cache], axis=0)
+
+
+defprim("masked_mha_p", _mmha_fwd, multi_out=True)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Single-token decode attention over a dense KV cache.
+
+    Reference: incubate/nn/functional/masked_multihead_attention.py:19 —
+    x [B, 3*H*D], cache_kv [2, B, H, S_max, D], sequence_lengths [B, 1]
+    gives each sequence's current length (the write position). Returns
+    (out [B, H*D], cache_kv_out) like the reference's inplace variant.
+    """
+    if qkv_out_scale is not None or out_scale != -1:
+        raise NotImplementedError(
+            "quantized masked_multihead_attention is not part of the TPU build"
+        )
+    x = ensure_tensor(x)
+    cache = ensure_tensor(cache_kv)
+    num_heads = cache.shape[2]
+    head_dim = cache.shape[4]
+    if bias is not None:
+        from ....ops.manipulation import reshape
+        from ....ops.math import add
+
+        x = add(x, reshape(ensure_tensor(bias), [3 * num_heads * head_dim]))
+    if rotary_emb_dims > 0 and rotary_tensor is not None:
+        x = _apply_decode_rope(x, ensure_tensor(rotary_tensor),
+                               sequence_lengths, num_heads, head_dim,
+                               use_neox_rotary_style)
+    use_mask = src_mask is not None
+    use_seq = sequence_lengths is not None
+    mask_t = ensure_tensor(src_mask) if use_mask else x
+    seq_t = ensure_tensor(sequence_lengths) if use_seq else x
+    out, cache_out = apply("masked_mha_p", x, cache, mask_t, seq_t,
+                           num_heads=int(num_heads), use_mask=use_mask,
+                           use_seq_lens=use_seq)
+    return out, cache_out
+
+
+def _apply_decode_rope(x, rotary_tensor, sequence_lengths, h, d, neox):
+    """RoPE on the q/k slices of a packed decode qkv row."""
+    def fwd(xv, rot, lens):
+        b = xv.shape[0]
+        qkv = xv.reshape(b, 3, h, d)
+        pos = (lens.reshape(b).astype(jnp.int32)
+               if lens is not None else jnp.zeros((b,), jnp.int32))
+        # rot: [B, 1, 1, S, D] cos-sin interleaved per reference; take the
+        # current position's row
+        rot_row = rot.reshape(b, -1, rot.shape[-1])[jnp.arange(b), pos]  # [B, D]
+        cos = rot_row[:, None, :]
+        sin = jnp.roll(rot_row, shift=d // 2, axis=-1)[:, None, :]
+
+        def rotate(t):
+            if neox:
+                t1, t2 = jnp.split(t, 2, axis=-1)
+                return jnp.concatenate([-t2, t1], axis=-1)
+            t1 = t[..., 0::2]
+            t2 = t[..., 1::2]
+            return jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
+
+        q = qkv[:, 0] * cos + rotate(qkv[:, 0]) * sin
+        k = qkv[:, 1] * cos + rotate(qkv[:, 1]) * sin
+        return jnp.stack([q, k, qkv[:, 2]], axis=1).reshape(b, 3 * h * d)
+
+    seq_v = sequence_lengths._value if sequence_lengths is not None else None
+    return Tensor._from_value(
+        fwd(x._value, rotary_tensor._value, seq_v)
+    )
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
+    """Max encoder/decoder lengths for block attention scheduling.
+
+    Reference: incubate/nn/functional/blha_get_max_len.py:26.
+    """
+    from ....ops.math import max as _max
+
+    return (_max(ensure_tensor(seq_lens_encoder)),
+            _max(ensure_tensor(seq_lens_decoder)))
+
+
+def _bmha_fwd(qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+              cu_seqlens_q, block_tables, *, num_heads, kv_num_heads,
+              block_size, max_seq_len, use_neox):
+    """Paged-KV attention, prefill + decode in one jnp program.
+
+    Caches: [num_blocks, kv_H, block_size, D]; block_tables [B, blocks/seq].
+    Tokens arrive packed varlen: qkv [T, (H + 2*kv_H) * D], sequence b owns
+    rows cu_seqlens_q[b] : cu_seqlens_q[b+1].
+    """
+    t = qkv.shape[0]
+    d = key_cache.shape[-1]
+    h = num_heads
+    kvh = kv_num_heads
+    b = block_tables.shape[0]
+    blocks_per_seq = block_tables.shape[1]
+    s_pad = blocks_per_seq * block_size
+
+    q_flat = qkv[:, : h * d].reshape(t, h, d)
+    k_flat = qkv[:, h * d : (h + kvh) * d].reshape(t, kvh, d)
+    v_flat = qkv[:, (h + kvh) * d :].reshape(t, kvh, d)
+
+    enc = seq_lens_encoder.reshape(b).astype(jnp.int32)
+    dec = seq_lens_decoder.reshape(b).astype(jnp.int32)
+    starts = cu_seqlens_q.reshape(-1)[:b].astype(jnp.int32)
+    n_this = jnp.where(enc > 0, enc, jnp.where(dec > 0, 1, 0))
+
+    # token write positions: prefill writes 0..enc-1, decode appends at dec
+    offs = jnp.arange(s_pad, dtype=jnp.int32)  # padded per-seq positions
+    tok_idx = starts[:, None] + offs[None, :]           # [B, S_pad] into qkv
+    write_pos = jnp.where(enc[:, None] > 0, offs[None, :], dec[:, None])
+    tok_valid = offs[None, :] < n_this[:, None]
+    tok_idx_c = jnp.clip(tok_idx, 0, t - 1)
+
+    # map logical position -> physical cache slot through the block table
+    blk = write_pos // block_size
+    blk_c = jnp.clip(blk, 0, blocks_per_seq - 1)
+    phys_block = jnp.take_along_axis(block_tables.astype(jnp.int32), blk_c,
+                                     axis=1)
+    slot = phys_block * block_size + (write_pos % block_size)  # [B, S_pad]
+
+    # caches as [slot, kvh, d] so token writes are single-index scatters
+    nb = key_cache.shape[0]
+    kc = key_cache.transpose(0, 2, 1, 3).reshape(nb * block_size, kvh, d)
+    vc = value_cache.transpose(0, 2, 1, 3).reshape(nb * block_size, kvh, d)
+    flat_slot = slot.reshape(-1)
+    flat_tok = tok_idx_c.reshape(-1)
+    flat_valid = tok_valid.reshape(-1)
+    safe_slot = jnp.where(flat_valid, flat_slot, nb * block_size)  # OOB drops
+    kc = kc.at[safe_slot].set(k_flat[flat_tok], mode="drop")
+    vc = vc.at[safe_slot].set(v_flat[flat_tok], mode="drop")
+
+    # gather each sequence's padded K/V window back for attention
+    total = jnp.where(enc > 0, enc, dec + 1)  # valid cached length per seq
+    gslot = jnp.take_along_axis(
+        block_tables.astype(jnp.int32), offs[None, :] // block_size, axis=1
+    ) * block_size + (offs[None, :] % block_size)       # [B, S_pad]
+    k_seq = kc[jnp.clip(gslot, 0, nb * block_size - 1)]  # [B, S_pad, kvh, D]
+    v_seq = vc[jnp.clip(gslot, 0, nb * block_size - 1)]
+
+    group = h // kvh
+    k_rep = jnp.repeat(k_seq, group, axis=2)
+    v_rep = jnp.repeat(v_seq, group, axis=2)
+
+    q_seq = q_flat[tok_idx_c]                           # [B, S_pad, H, D]
+    scale = 1.0 / np.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q_seq.astype(jnp.float32),
+                        k_rep.astype(jnp.float32)) * scale
+    q_pos = jnp.where(enc[:, None] > 0, offs[None, :], dec[:, None])
+    causal_ok = offs[None, None, :] <= q_pos[:, :, None]   # [B, Sq, Sk]
+    kv_ok = offs[None, None, :] < total[:, None, None]
+    mask = (causal_ok & kv_ok)[:, None, :, :]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out_seq = jnp.einsum("bhqk,bkhd->bqhd", probs, v_rep.astype(jnp.float32))
+    out_seq = out_seq.astype(qkv.dtype)
+
+    # scatter back to packed token rows
+    out = jnp.zeros((t, h, d), dtype=qkv.dtype)
+    safe_tok = jnp.where(flat_valid, flat_tok, t)
+    out = out.at[safe_tok].set(out_seq.reshape(b * s_pad, h, d), mode="drop")
+
+    kc_out = kc.reshape(nb, block_size, kvh, d).transpose(0, 2, 1, 3)
+    vc_out = vc.reshape(nb, block_size, kvh, d).transpose(0, 2, 1, 3)
+    return out.reshape(t, h * d), qkv, kc_out, vc_out
+
+
+defprim("block_mha_p", _bmha_fwd, multi_out=True)
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets, cum_offsets, cu_seqlens_q,
+                              cu_seqlens_k, block_tables, pre_key_cache=None,
+                              pre_value_cache=None, cache_k_quant_scales=None,
+                              cache_v_quant_scales=None,
+                              cache_k_dequant_scales=None,
+                              cache_v_dequant_scales=None, qkv_out_scale=None,
+                              qkv_bias=None, out_shift=None, out_smooth=None,
+                              max_enc_len_this_time=None,
+                              max_dec_len_this_time=None, rope_emb=None,
+                              mask=None, tgt_mask=None, max_seq_len=-1,
+                              block_size=64, use_neox_style=False,
+                              use_dynamic_cachekv_quant=False,
+                              quant_round_type=1, quant_max_bound=127.0,
+                              quant_min_bound=-127.0, out_scale=-1.0,
+                              compute_dtype="default"):
+    """Paged-KV-cache attention (prefill and decode in one call).
+
+    Reference: incubate/nn/functional/block_multihead_attention.py:19 —
+    packed varlen qkv [T, (H+2*kv_H)*D], block caches
+    [num_blocks, kv_H, block_size, D], per-sequence block_tables. Returns
+    (out, qkv, key_cache, value_cache).
+    """
+    if cache_k_quant_scales is not None or use_dynamic_cachekv_quant:
+        raise NotImplementedError(
+            "int8/quantized KV cache is not part of the TPU build"
+        )
+    qkv = ensure_tensor(qkv)
+    kc = ensure_tensor(key_cache)
+    vc = ensure_tensor(value_cache)
+    kvh = kc.shape[1]
+    d = kc.shape[3]
+    h = qkv.shape[-1] // d - 2 * kvh
+    if qkv_bias is not None:
+        from ....ops.math import add
+
+        qkv = add(qkv, ensure_tensor(qkv_bias))
+    out, qkv_out, kc_out, vc_out = apply(
+        "block_mha_p", qkv, kc, vc, ensure_tensor(seq_lens_encoder),
+        ensure_tensor(seq_lens_decoder), ensure_tensor(cu_seqlens_q),
+        ensure_tensor(block_tables), num_heads=int(h), kv_num_heads=int(kvh),
+        block_size=int(block_size), max_seq_len=int(max_seq_len),
+        use_neox=bool(use_neox_style),
+    )
+    return out, qkv_out, kc_out, vc_out
+
+
+def _vl_attn_fwd(q, k, v, kv_lens, mask, *, scale, use_mask):
+    # q: [B, H, Sq, D]; k/v: [B, kvH, Sk, D]; kv_lens: [B]
+    b, h, sq, d = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    if kvh != h:
+        k = jnp.repeat(k, h // kvh, axis=1)
+        v = jnp.repeat(v, h // kvh, axis=1)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    valid = jnp.arange(sk)[None, :] < kv_lens.reshape(b, 1)
+    scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
+    if use_mask:
+        scores = scores + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+defprim("vl_attn_p", _vl_attn_fwd)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0):
+    """Attention over [B, H, S, D] tensors with per-sequence KV lengths.
+
+    Reference: incubate/nn/functional/
+    variable_length_memory_efficient_attention.py (phi kernel
+    variable_length_memory_efficient_attention).
+    """
+    q = ensure_tensor(query)
+    k = ensure_tensor(key)
+    scale = float(scale) if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    use_mask = mask is not None
+    if causal and not use_mask:
+        sq, sk = q.shape[2], k.shape[2]
+        tri = jnp.where(
+            jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :] - (sk - sq),
+            0.0, _NEG_INF,
+        )
+        mask_t = Tensor._from_value(tri[None, None])
+        use_mask = True
+    else:
+        mask_t = ensure_tensor(mask) if use_mask else q
+    return apply("vl_attn_p", q, k, ensure_tensor(value),
+                 ensure_tensor(kv_seq_lens), mask_t, scale=scale,
+                 use_mask=use_mask)
+
+
+def fused_dot_product_attention(q, k, v, bias=None, cu_seqlen_q=None,
+                                cu_seqlen_kv=None, scaling_factor=None,
+                                dropout_prob=0.0, training=True,
+                                is_causal_masking=False, mask_type=None,
+                                bias_type=None, name=None):
+    """cuDNN-fused SDPA analog ([B, S, H, D] layout).
+
+    Reference: incubate/nn/functional/fused_dot_product_attention.py — on
+    TPU this routes to the framework's flash/SDPA path (Pallas on chip).
+    """
+    from ....nn.functional.attention import scaled_dot_product_attention
+
+    if bias is not None:
+        from ....ops.manipulation import transpose
+
+        # sdpa takes an additive [B, H, Sq, Sk] mask
+        return scaled_dot_product_attention(
+            q, k, v, attn_mask=bias, dropout_p=dropout_prob,
+            is_causal=is_causal_masking, training=training,
+        )
+    return scaled_dot_product_attention(
+        q, k, v, None, dropout_prob, is_causal_masking, training
+    )

@@ -1,0 +1,440 @@
+"""Fused transformer Layer classes.
+
+Reference: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention :189, FusedFeedForward :483,
+FusedTransformerEncoderLayer :697, FusedMultiTransformer :994,
+FusedBiasDropoutResidualLayerNorm :83), fused_linear.py (FusedLinear),
+fused_ec_moe.py (FusedEcMoe), fused_dropout_add.py (FusedDropoutAdd).
+
+On TPU "fused" is what XLA/Pallas produce from the functional composition
+in incubate.nn.functional — the Layer classes hold parameters in the same
+shapes as the reference so state_dicts line up.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer import Layer
+
+__all__ = [
+    "FusedLinear", "FusedDropoutAdd", "FusedEcMoe",
+    "FusedBiasDropoutResidualLayerNorm", "FusedMultiHeadAttention",
+    "FusedFeedForward", "FusedTransformerEncoderLayer",
+    "FusedMultiTransformer",
+]
+
+
+class FusedLinear(Layer):
+    """Reference: incubate/nn/layer/fused_linear.py — Linear whose forward
+    is the fused matmul+bias op; with transpose_weight the weight is stored
+    [out, in]."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(shape=shape, attr=weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter(shape=[out_features],
+                                           attr=bias_attr, is_bias=True))
+        self.transpose_weight = transpose_weight
+
+    def forward(self, input):
+        from .functional import fused_linear
+
+        return fused_linear(input, self.weight, self.bias,
+                            self.transpose_weight)
+
+
+class FusedDropoutAdd(Layer):
+    """Reference: incubate/nn/layer/fused_dropout_add.py."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from .functional import fused_dropout_add
+
+        return fused_dropout_add(x, y, p=self.p, training=self.training,
+                                 mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedEcMoe(Layer):
+    """Reference: incubate/nn/layer/fused_ec_moe.py — expert-choice MoE FFN
+    with stacked expert weights [E, d, h] / [E, h, d]."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError(f"unsupported act_type {act_type!r}")
+        self.act_type = act_type
+        self.bmm_weight0 = self.create_parameter(
+            shape=[num_experts, hidden_size, inter_size], attr=weight_attr)
+        self.bmm_bias0 = self.create_parameter(
+            shape=[num_experts, 1, inter_size], attr=bias_attr, is_bias=True)
+        self.bmm_weight1 = self.create_parameter(
+            shape=[num_experts, inter_size, hidden_size], attr=weight_attr)
+        self.bmm_bias1 = self.create_parameter(
+            shape=[num_experts, 1, hidden_size], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, gate):
+        from .functional import fused_ec_moe
+
+        return fused_ec_moe(x, gate, self.bmm_weight0, self.bmm_bias0,
+                            self.bmm_weight1, self.bmm_bias1, self.act_type)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """Reference: fused_transformer.py:83 — out = LN(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        assert embed_dim > 0
+        self.embed_dim = embed_dim
+        self._dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = self.create_parameter(shape=[embed_dim],
+                                                 attr=bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=weight_attr,
+            default_initializer=_ones_init())
+        self.ln_bias = self.create_parameter(shape=[embed_dim], attr=None,
+                                             is_bias=True)
+
+    def forward(self, x, residual):
+        from ...nn.functional.common import dropout
+        from ...nn.functional.norm import layer_norm
+        from ...ops.math import add
+
+        h = add(x, self.linear_bias)
+        h = dropout(h, self._dropout_rate, training=self.training)
+        h = add(residual, h)
+        return layer_norm(h, [self.embed_dim], self.ln_scale, self.ln_bias,
+                          self._epsilon)
+
+    def extra_repr(self):
+        return (f"embed_dim={self.embed_dim}, "
+                f"dropout_rate={self._dropout_rate}, epsilon={self._epsilon}")
+
+
+def _ones_init():
+    from ...nn.initializer import Constant
+
+    return Constant(1.0)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Reference: fused_transformer.py:189 — pre/post-LN MHA block with
+    packed qkv weight [3, H, D, E] (or [E, 3*H*D] with transpose_qkv_wb)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, transpose_qkv_wb=False, name=None):
+        super().__init__()
+        assert embed_dim > 0 and num_heads > 0
+        assert need_weights is False, "Only need_weights=False is supported"
+        self.embed_dim = embed_dim
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim
+        assert num_heads % nranks == 0
+        self.num_heads = num_heads // nranks
+        self.normalize_before = normalize_before
+        self._dropout_rate = dropout_rate
+        self._attn_dropout_rate = attn_dropout_rate
+        self._epsilon = epsilon
+        self.transpose_qkv_wb = transpose_qkv_wb
+
+        if transpose_qkv_wb:
+            qkv_w_shape = [embed_dim, 3 * self.num_heads * self.head_dim]
+            qkv_b_shape = [3 * self.num_heads * self.head_dim]
+        else:
+            qkv_w_shape = [3, self.num_heads, self.head_dim, embed_dim]
+            qkv_b_shape = [3, self.num_heads, self.head_dim]
+        self.qkv_weight = self.create_parameter(shape=qkv_w_shape,
+                                                attr=qkv_weight_attr)
+        self.qkv_bias = (None if qkv_bias_attr is False else
+                         self.create_parameter(shape=qkv_b_shape,
+                                               attr=None, is_bias=True))
+        out_w_shape = [self.num_heads * self.head_dim, embed_dim]
+        self.linear_weight = self.create_parameter(shape=out_w_shape,
+                                                   attr=linear_weight_attr)
+        self.linear_bias = (None if linear_bias_attr is False else
+                            self.create_parameter(shape=[embed_dim],
+                                                  attr=None, is_bias=True))
+        if normalize_before:
+            self.pre_ln_scale = self.create_parameter(
+                shape=[embed_dim], attr=pre_ln_scale_attr,
+                default_initializer=_ones_init())
+            self.pre_ln_bias = self.create_parameter(shape=[embed_dim],
+                                                     attr=None, is_bias=True)
+            self.ln_scale, self.ln_bias = None, None
+        else:
+            self.pre_ln_scale, self.pre_ln_bias = None, None
+            self.ln_scale = self.create_parameter(
+                shape=[embed_dim], attr=ln_scale_attr,
+                default_initializer=_ones_init())
+            self.ln_bias = self.create_parameter(shape=[embed_dim], attr=None,
+                                                 is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        from .functional import fused_multi_head_attention
+
+        return fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self._epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self._dropout_rate,
+            attn_dropout_rate=self._attn_dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training,
+            num_heads=self.num_heads, transpose_qkv_wb=self.transpose_qkv_wb,
+        )
+
+    def extra_repr(self):
+        return (f"embed_dim={self.embed_dim}, num_heads={self.num_heads}, "
+                f"normalize_before={self.normalize_before}")
+
+
+class FusedFeedForward(Layer):
+    """Reference: fused_transformer.py:483 — pre/post-LN FFN block."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert d_model > 0 and dim_feedforward > 0
+        self._d_model = d_model
+        assert dim_feedforward % nranks == 0
+        dim_feedforward = dim_feedforward // nranks
+        self._dim_feedforward = dim_feedforward
+        self._dropout_rate = dropout_rate
+        self._act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                  else act_dropout_rate)
+        self._act_method = activation
+        self._normalize_before = normalize_before
+        self._epsilon = epsilon
+
+        self._linear1_weight = self.create_parameter(
+            shape=[d_model, dim_feedforward], attr=linear1_weight_attr)
+        self._linear1_bias = self.create_parameter(
+            shape=[dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self._linear2_weight = self.create_parameter(
+            shape=[dim_feedforward, d_model], attr=linear2_weight_attr)
+        self._linear2_bias = self.create_parameter(
+            shape=[d_model], attr=linear2_bias_attr, is_bias=True)
+        if normalize_before:
+            self._ln1_scale = self.create_parameter(
+                shape=[d_model], attr=ln1_scale_attr,
+                default_initializer=_ones_init())
+            self._ln1_bias = self.create_parameter(shape=[d_model], attr=None,
+                                                   is_bias=True)
+            self._ln2_scale, self._ln2_bias = None, None
+        else:
+            self._ln1_scale, self._ln1_bias = None, None
+            self._ln2_scale = self.create_parameter(
+                shape=[d_model], attr=ln2_scale_attr,
+                default_initializer=_ones_init())
+            self._ln2_bias = self.create_parameter(shape=[d_model], attr=None,
+                                                   is_bias=True)
+
+    def forward(self, src, cache=None):
+        from .functional import fused_feedforward
+
+        return fused_feedforward(
+            src, self._linear1_weight, self._linear2_weight,
+            self._linear1_bias, self._linear2_bias, self._ln1_scale,
+            self._ln1_bias, self._ln2_scale, self._ln2_bias,
+            dropout1_rate=self._act_dropout_rate,
+            dropout2_rate=self._dropout_rate,
+            activation=self._act_method, ln1_epsilon=self._epsilon,
+            ln2_epsilon=self._epsilon,
+            pre_layer_norm=self._normalize_before, training=self.training,
+        )
+
+    def extra_repr(self):
+        return (f"d_model={self._d_model}, "
+                f"dim_feedforward={self._dim_feedforward}, "
+                f"activation={self._act_method}")
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Reference: fused_transformer.py:697 — FusedMultiHeadAttention +
+    FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        assert d_model > 0 and nhead > 0 and dim_feedforward > 0
+        attn_dropout_rate = (dropout_rate if attn_dropout_rate is None
+                             else attn_dropout_rate)
+        act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                            else act_dropout_rate)
+        self.normalize_before = normalize_before
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before,
+            qkv_weight_attr=weight_attr, qkv_bias_attr=bias_attr,
+            linear_weight_attr=weight_attr, linear_bias_attr=bias_attr,
+        )
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before,
+            linear1_weight_attr=weight_attr, linear1_bias_attr=bias_attr,
+            linear2_weight_attr=weight_attr, linear2_bias_attr=bias_attr,
+        )
+
+    def forward(self, src, src_mask=None, cache=None):
+        attn_out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+        return self.ffn(attn_out)
+
+
+class FusedMultiTransformer(Layer):
+    """Reference: fused_transformer.py:994 — a stack of pre/post-LN decoder
+    blocks with per-layer packed parameters (the serving-side
+    fused_multi_transformer op). Parameters are stored per layer in lists
+    like the reference; generation-time KV caches are the caller's
+    (functional) responsibility."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None, epsilon=1e-5,
+                 num_layers=-1, nranks=1, trans_qkvw=True, ring_id=-1,
+                 name=None):
+        super().__init__()
+        assert embed_dim > 0 and num_heads > 0 and dim_feedforward > 0
+        if num_layers < 0:
+            num_layers = (len(qkv_weight_attrs)
+                          if isinstance(qkv_weight_attrs, (list, tuple)) else 1)
+        self.num_layers = num_layers
+        self.embed_dim = embed_dim
+        assert num_heads % nranks == 0
+        self.num_heads = num_heads // nranks
+        self.head_dim = embed_dim // num_heads
+        self._dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self._act = activation
+        self.normalize_before = normalize_before
+        assert trans_qkvw, "only trans_qkvw=True layout is supported"
+
+        def attr_at(attrs, i):
+            return attrs[i] if isinstance(attrs, (list, tuple)) else attrs
+
+        self.ln_scales, self.ln_biases = [], []
+        self.qkv_weights, self.qkv_biases = [], []
+        self.linear_weights, self.linear_biases = [], []
+        self.ffn_ln_scales, self.ffn_ln_biases = [], []
+        self.ffn1_weights, self.ffn1_biases = [], []
+        self.ffn2_weights, self.ffn2_biases = [], []
+        for i in range(num_layers):
+            self.ln_scales.append(self.create_parameter(
+                shape=[embed_dim], attr=attr_at(ln_scale_attrs, i),
+                default_initializer=_ones_init()))
+            self.ln_biases.append(self.create_parameter(
+                shape=[embed_dim], attr=attr_at(ln_bias_attrs, i),
+                is_bias=True))
+            self.qkv_weights.append(self.create_parameter(
+                shape=[3, self.num_heads, self.head_dim, embed_dim],
+                attr=attr_at(qkv_weight_attrs, i)))
+            self.qkv_biases.append(self.create_parameter(
+                shape=[3, self.num_heads, self.head_dim],
+                attr=attr_at(qkv_bias_attrs, i), is_bias=True))
+            self.linear_weights.append(self.create_parameter(
+                shape=[self.num_heads * self.head_dim, embed_dim],
+                attr=attr_at(linear_weight_attrs, i)))
+            self.linear_biases.append(self.create_parameter(
+                shape=[embed_dim], attr=attr_at(linear_bias_attrs, i),
+                is_bias=True))
+            self.ffn_ln_scales.append(self.create_parameter(
+                shape=[embed_dim], attr=attr_at(ffn_ln_scale_attrs, i),
+                default_initializer=_ones_init()))
+            self.ffn_ln_biases.append(self.create_parameter(
+                shape=[embed_dim], attr=attr_at(ffn_ln_bias_attrs, i),
+                is_bias=True))
+            self.ffn1_weights.append(self.create_parameter(
+                shape=[embed_dim, dim_feedforward // nranks],
+                attr=attr_at(ffn1_weight_attrs, i)))
+            self.ffn1_biases.append(self.create_parameter(
+                shape=[dim_feedforward // nranks],
+                attr=attr_at(ffn1_bias_attrs, i), is_bias=True))
+            self.ffn2_weights.append(self.create_parameter(
+                shape=[dim_feedforward // nranks, embed_dim],
+                attr=attr_at(ffn2_weight_attrs, i)))
+            self.ffn2_biases.append(self.create_parameter(
+                shape=[embed_dim], attr=attr_at(ffn2_bias_attrs, i),
+                is_bias=True))
+            for j, p in enumerate([
+                self.ln_scales[-1], self.ln_biases[-1], self.qkv_weights[-1],
+                self.qkv_biases[-1], self.linear_weights[-1],
+                self.linear_biases[-1], self.ffn_ln_scales[-1],
+                self.ffn_ln_biases[-1], self.ffn1_weights[-1],
+                self.ffn1_biases[-1], self.ffn2_weights[-1],
+                self.ffn2_biases[-1],
+            ]):
+                self.add_parameter(f"layer_{i}_p{j}", p)
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        from .functional import (fused_bias_act, fused_multi_head_attention)
+        from ...nn.functional.common import linear
+        from ...nn.functional.norm import layer_norm
+        from ...ops.math import add
+
+        out = src
+        for i in range(self.num_layers):
+            residual = out
+            attn_out = fused_multi_head_attention(
+                out, self.qkv_weights[i], self.linear_weights[i],
+                pre_layer_norm=self.normalize_before,
+                pre_ln_scale=self.ln_scales[i], pre_ln_bias=self.ln_biases[i],
+                ln_scale=self.ln_scales[i], ln_bias=self.ln_biases[i],
+                pre_ln_epsilon=self._epsilon, qkv_bias=self.qkv_biases[i],
+                linear_bias=self.linear_biases[i], attn_mask=attn_mask,
+                dropout_rate=self._dropout_rate,
+                attn_dropout_rate=self._dropout_rate,
+                ln_epsilon=self._epsilon, training=self.training,
+                num_heads=self.num_heads,
+            )
+            residual = attn_out
+            h = attn_out
+            if self.normalize_before:
+                h = layer_norm(h, [self.embed_dim], self.ffn_ln_scales[i],
+                               self.ffn_ln_biases[i], self._epsilon)
+            h = linear(h, self.ffn1_weights[i])
+            h = fused_bias_act(h, self.ffn1_biases[i], act_method=self._act)
+            h = linear(h, self.ffn2_weights[i], self.ffn2_biases[i])
+            out = add(residual, h)
+            if not self.normalize_before:
+                out = layer_norm(out, [self.embed_dim],
+                                 self.ffn_ln_scales[i], self.ffn_ln_biases[i],
+                                 self._epsilon)
+        return out

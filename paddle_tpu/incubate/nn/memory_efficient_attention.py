@@ -1,0 +1,43 @@
+"""Memory-efficient attention entry point.
+
+Reference: python/paddle/incubate/nn/memory_efficient_attention.py — the
+xformers-cutlass kernel behind an (q, k, v, attn_bias, p, scale) API with
+[B, S, H, D] layout. On TPU the memory-efficient algorithm IS flash
+attention: the call routes to the framework SDPA path (Pallas kernel on
+chip, masked-XLA composition otherwise); structured AttentionBias objects
+materialize to additive masks.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...ops._helpers import ensure_tensor
+from .attn_bias import AttentionBias
+
+__all__ = ["memory_efficient_attention"]
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    from ...nn.functional.attention import scaled_dot_product_attention
+    from ...ops.math import multiply, scale as scale_op
+
+    q = ensure_tensor(query)
+    k = ensure_tensor(key)
+    v = ensure_tensor(value)
+    if scale is not None:
+        # fold a custom softmax scale into q (sdpa applies 1/sqrt(d) itself)
+        default = 1.0 / float(np.sqrt(q.shape[-1]))
+        q = scale_op(q, float(scale) / default)
+    mask = None
+    if attn_bias is not None:
+        if isinstance(attn_bias, AttentionBias):
+            b, sq, h, _ = q.shape
+            sk = k.shape[1]
+            mask = attn_bias.materialize((b, h, sq, sk), dtype="float32")
+        else:
+            mask = ensure_tensor(attn_bias)
+    return scaled_dot_product_attention(
+        q, k, v, attn_mask=mask, dropout_p=p, is_causal=False,
+        training=training,
+    )

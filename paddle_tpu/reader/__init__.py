@@ -154,11 +154,12 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     samples from an input queue, apply mapper, push to an output queue;
     `order=True` preserves sample order.
     """
-    in_q: queue.Queue = queue.Queue(buffer_size)
-    end = object()
-
     def data_reader():
+        # per-iteration queues: a shared input queue would let an abandoned
+        # earlier iteration's workers steal samples from a later one
+        in_q: queue.Queue = queue.Queue(buffer_size)
         out_q: queue.Queue = queue.Queue(buffer_size)
+        end = object()
 
         def feed():
             for i, sample in enumerate(reader()):

@@ -24,14 +24,18 @@ WEIGHTS_HOME = osp.expanduser(
 )
 
 
+def md5file(fname: str) -> str:
+    md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest()
+
+
 def _md5check(fullname: str, md5sum: str | None) -> bool:
     if md5sum is None:
         return True
-    md5 = hashlib.md5()
-    with open(fullname, "rb") as f:
-        for chunk in iter(lambda: f.read(4096), b""):
-            md5.update(chunk)
-    return md5.hexdigest() == md5sum
+    return md5file(fullname) == md5sum
 
 
 def _decompress(fname: str) -> str:

@@ -164,13 +164,22 @@ def dynamic_flops(net, input_size, custom_ops=None, print_detail=False) -> int:
         def hook(layer, inp, out):
             o = out[0] if isinstance(out, (tuple, list)) else out
             i = inp[0] if isinstance(inp, (tuple, list)) else inp
-            n_params = sum(_prod(p.shape) for p in layer.parameters(include_sublayers=False))
-            counts[id(layer)] = {
-                "layer": layer,
-                "flops": handler(layer, i, o),
-                "params": n_params,
-                "output_shape": list(o.shape),
-            }
+            entry = counts.get(id(layer))
+            if entry is None:
+                n_params = sum(
+                    _prod(p.shape)
+                    for p in layer.parameters(include_sublayers=False)
+                )
+                counts[id(layer)] = {
+                    "layer": layer,
+                    "flops": handler(layer, i, o),
+                    "params": n_params,
+                    "output_shape": list(o.shape),
+                }
+            else:
+                # shared module applied more than once: accumulate flops
+                entry["flops"] += handler(layer, i, o)
+                entry["output_shape"] = list(o.shape)
 
         return hook
 

@@ -1,0 +1,445 @@
+"""incubate.nn fused layers + inference attention ops.
+
+Reference models: test/legacy_test/test_fused_attention_op.py,
+test_fused_feedforward_op.py, test_fused_linear.py,
+test_masked_multihead_attention_op.py, test_block_multihead_attention.py,
+test_memory_efficient_attention.py, test_variable_length_memory_efficient_attention.py.
+Oracles are numpy dense-attention compositions.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import nn as inn
+from paddle_tpu.incubate.nn import functional as F
+
+
+def _r(*shape, scale=1.0):
+    return (np.random.randn(*shape) * scale).astype("float32")
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def dense_attention(q, k, v, mask=None):
+    # q [B,H,Sq,D], k/v [B,H,Sk,D]
+    scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    if mask is not None:
+        scores = scores + mask
+    return np.einsum("bhqk,bhkd->bhqd", _softmax(scores), v)
+
+
+class TestFusedMatmulBias:
+    def test_forward(self):
+        x, y, b = _r(4, 8), _r(8, 3), _r(3)
+        got = F.fused_matmul_bias(paddle.to_tensor(x), paddle.to_tensor(y),
+                                  paddle.to_tensor(b))
+        np.testing.assert_allclose(got.numpy(), x @ y + b, rtol=1e-5)
+
+    def test_transpose(self):
+        x, y = _r(8, 4), _r(3, 8)
+        got = F.fused_matmul_bias(paddle.to_tensor(x), paddle.to_tensor(y),
+                                  None, transpose_x=True, transpose_y=True)
+        np.testing.assert_allclose(got.numpy(), x.T @ y.T, rtol=1e-5)
+
+    def test_linear_activation(self):
+        x, y, b = _r(4, 8), _r(8, 3), _r(3)
+        got = F.fused_linear_activation(paddle.to_tensor(x),
+                                        paddle.to_tensor(y),
+                                        paddle.to_tensor(b),
+                                        activation="relu")
+        np.testing.assert_allclose(got.numpy(), np.maximum(x @ y + b, 0),
+                                   rtol=1e-5)
+
+    def test_grad_flows(self):
+        x = paddle.to_tensor(_r(4, 8), stop_gradient=False)
+        y = paddle.to_tensor(_r(8, 3), stop_gradient=False)
+        out = F.fused_matmul_bias(x, y, None)
+        out.sum().backward()
+        assert x.grad is not None and y.grad.shape == [8, 3]
+
+
+class TestMaskedMHA:
+    def test_decode_step_matches_dense(self):
+        b, h, d, s_max = 2, 4, 8, 16
+        cur_len = 5  # tokens already cached
+        np.random.seed(0)
+        cache = np.zeros((2, b, h, s_max, d), dtype="float32")
+        cache[:, :, :, :cur_len, :] = _r(2, b, h, cur_len, d)
+        x = _r(b, 3 * h * d)
+        seq_lens = np.full((b, 1), cur_len, dtype="int32")
+
+        out, cache_out = F.masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(seq_lens))
+
+        qkv = x.reshape(b, 3, h, d)
+        k_full = cache[0].copy()
+        v_full = cache[1].copy()
+        k_full[:, :, cur_len, :] = qkv[:, 1]
+        v_full[:, :, cur_len, :] = qkv[:, 2]
+        q = qkv[:, 0][:, :, None, :]  # [B,H,1,D]
+        mask = np.where(
+            np.arange(s_max)[None, None, None, :] <= cur_len - 0.5 + 0.5,
+            0.0, -1e9).astype("float32")
+        # valid positions are <= cur_len (appended token included)
+        valid = np.arange(s_max) <= cur_len
+        mask = np.where(valid, 0.0, -1e9)[None, None, None, :]
+        want = dense_attention(q, k_full, v_full, mask)[:, :, 0, :]
+        np.testing.assert_allclose(out.numpy(), want.reshape(b, h * d),
+                                   rtol=2e-5, atol=2e-5)
+        # cache got the new kv written at cur_len
+        np.testing.assert_allclose(
+            np.asarray(cache_out.numpy())[0][:, :, cur_len, :], qkv[:, 1],
+            rtol=1e-6)
+
+    def test_with_src_mask_and_bias(self):
+        b, h, d, s_max = 1, 2, 4, 8
+        cache = np.zeros((2, b, h, s_max, d), dtype="float32")
+        cache[:, :, :, :3, :] = _r(2, b, h, 3, d)
+        x = _r(b, 3 * h * d)
+        bias = _r(3 * h * d, scale=0.1)
+        src_mask = np.zeros((b, 1, 1, s_max), dtype="float32")
+        src_mask[..., 1] = -1e9  # mask out position 1
+        seq = np.full((b, 1), 3, dtype="int32")
+        out, _ = F.masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            bias=paddle.to_tensor(bias), src_mask=paddle.to_tensor(src_mask),
+            sequence_lengths=paddle.to_tensor(seq))
+        xb = (x + bias).reshape(b, 3, h, d)
+        k_full = cache[0].copy(); k_full[:, :, 3] = xb[:, 1]
+        v_full = cache[1].copy(); v_full[:, :, 3] = xb[:, 2]
+        valid = (np.arange(s_max) <= 3).astype("float32")
+        mask = np.where(valid, 0.0, -1e9)[None, None, None, :] + \
+            src_mask[:, :, :, :]
+        want = dense_attention(xb[:, 0][:, :, None, :], k_full, v_full,
+                               mask)[:, :, 0, :]
+        np.testing.assert_allclose(out.numpy(), want.reshape(b, h * d),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_quant_rejected(self):
+        with pytest.raises(NotImplementedError):
+            F.masked_multihead_attention(
+                paddle.to_tensor(_r(1, 24)),
+                paddle.to_tensor(np.zeros((2, 1, 2, 4, 4), dtype="float32")),
+                out_scale=0.5)
+
+
+class TestBlhaGetMaxLen:
+    def test_basic(self):
+        enc = paddle.to_tensor(np.array([3, 0, 7], dtype="int32"))
+        dec = paddle.to_tensor(np.array([0, 5, 0], dtype="int32"))
+        me, md = F.blha_get_max_len(enc, dec, paddle.to_tensor(3))
+        assert int(me.numpy()) == 7 and int(md.numpy()) == 5
+
+
+class TestBlockMHA:
+    def _run(self, enc_lens, dec_lens, cached, h=4, kvh=2, d=8,
+             block_size=4, blocks_per_seq=4):
+        """cached[b] = tokens already in the cache for decode seqs."""
+        b = len(enc_lens)
+        n_blocks = b * blocks_per_seq + 1
+        key_cache = np.zeros((n_blocks, kvh, block_size, d), dtype="float32")
+        value_cache = np.zeros_like(key_cache)
+        block_tables = np.full((b, blocks_per_seq), -1, dtype="int32")
+        for i in range(b):
+            block_tables[i] = np.arange(i * blocks_per_seq,
+                                        (i + 1) * blocks_per_seq)
+        # fill cache for decode sequences
+        dense_k = np.zeros((b, blocks_per_seq * block_size, kvh, d),
+                           dtype="float32")
+        dense_v = np.zeros_like(dense_k)
+        for i in range(b):
+            for pos in range(cached[i]):
+                kv = _r(2, kvh, d)
+                blk = block_tables[i][pos // block_size]
+                key_cache[blk, :, pos % block_size, :] = kv[0]
+                value_cache[blk, :, pos % block_size, :] = kv[1]
+                dense_k[i, pos] = kv[0]
+                dense_v[i, pos] = kv[1]
+        n_this = [e if e > 0 else 1 for e in enc_lens]
+        total = sum(n_this)
+        qkv = _r(total, (h + 2 * kvh) * d)
+        cu = np.zeros(b + 1, dtype="int32")
+        cu[1:] = np.cumsum(n_this)
+        out, _, kc_out, vc_out = F.block_multihead_attention(
+            paddle.to_tensor(qkv), paddle.to_tensor(key_cache),
+            paddle.to_tensor(value_cache),
+            paddle.to_tensor(np.array(enc_lens, dtype="int32")),
+            paddle.to_tensor(np.array(dec_lens, dtype="int32")),
+            paddle.to_tensor(np.array(n_this, dtype="int32")),
+            None, None, paddle.to_tensor(cu), paddle.to_tensor(cu),
+            paddle.to_tensor(block_tables), block_size=block_size,
+            max_seq_len=blocks_per_seq * block_size)
+        return (qkv, out.numpy(), kc_out.numpy(), vc_out.numpy(),
+                dense_k, dense_v, cu, block_tables)
+
+    def test_prefill_matches_causal_dense(self):
+        h, kvh, d = 4, 2, 8
+        enc = [5, 3]
+        qkv, out, kc, vc, _, _, cu, bt = self._run(enc, [0, 0], [0, 0],
+                                                   h=h, kvh=kvh, d=d)
+        for i, n in enumerate(enc):
+            rows = qkv[cu[i]:cu[i] + n]
+            q = rows[:, :h * d].reshape(n, h, d).transpose(1, 0, 2)[None]
+            k = rows[:, h * d:(h + kvh) * d].reshape(n, kvh, d)
+            v = rows[:, (h + kvh) * d:].reshape(n, kvh, d)
+            k_rep = np.repeat(k, h // kvh, axis=1).transpose(1, 0, 2)[None]
+            v_rep = np.repeat(v, h // kvh, axis=1).transpose(1, 0, 2)[None]
+            causal = np.where(
+                np.arange(n)[:, None] >= np.arange(n)[None, :], 0.0,
+                -1e9)[None, None]
+            want = dense_attention(q, k_rep, v_rep, causal)[0].transpose(1, 0, 2)
+            got = out[cu[i]:cu[i] + n].reshape(n, h, d)
+            np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+            # cache contains the prefill K
+            blk0 = bt[i][0]
+            np.testing.assert_allclose(kc[blk0, :, :min(n, 4), :],
+                                       k[:min(n, 4)].transpose(1, 0, 2),
+                                       rtol=1e-6)
+
+    def test_decode_matches_dense(self):
+        h, kvh, d = 4, 2, 8
+        cached = [6, 2]
+        qkv, out, kc, vc, dense_k, dense_v, cu, bt = self._run(
+            [0, 0], cached, cached, h=h, kvh=kvh, d=d)
+        for i, n_cached in enumerate(cached):
+            row = qkv[cu[i]]
+            q = row[:h * d].reshape(h, d)[None, :, None, :]  # [1,H,1,D]
+            k_new = row[h * d:(h + kvh) * d].reshape(kvh, d)
+            v_new = row[(h + kvh) * d:].reshape(kvh, d)
+            k_full = dense_k[i].copy()
+            v_full = dense_v[i].copy()
+            k_full[n_cached] = k_new
+            v_full[n_cached] = v_new
+            sk = n_cached + 1
+            k_rep = np.repeat(k_full[:sk], h // kvh, 1).transpose(1, 0, 2)[None]
+            v_rep = np.repeat(v_full[:sk], h // kvh, 1).transpose(1, 0, 2)[None]
+            want = dense_attention(q, k_rep, v_rep)[0, :, 0, :]
+            got = out[cu[i]].reshape(h, d)
+            np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_quant_rejected(self):
+        with pytest.raises(NotImplementedError):
+            F.block_multihead_attention(
+                *([paddle.to_tensor(np.zeros((1, 1), dtype="float32"))] * 11),
+                use_dynamic_cachekv_quant=True)
+
+
+class TestVarlenMemEffAttention:
+    def test_matches_dense_with_lens(self):
+        b, h, sq, sk, d = 2, 3, 4, 6, 8
+        q, k, v = _r(b, h, sq, d), _r(b, h, sk, d), _r(b, h, sk, d)
+        kv_lens = np.array([6, 3], dtype="int32")
+        got = F.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(np.array([sq, sq], dtype="int32")),
+            paddle.to_tensor(kv_lens))
+        mask = np.where(np.arange(sk)[None, :] < kv_lens[:, None], 0.0,
+                        -1e9)[:, None, None, :]
+        want = dense_attention(q, k, v, mask)
+        np.testing.assert_allclose(got.numpy(), want, rtol=2e-5, atol=2e-5)
+
+    def test_gqa_and_scale(self):
+        b, h, kvh, s, d = 1, 4, 2, 5, 8
+        q, k, v = _r(b, h, s, d), _r(b, kvh, s, d), _r(b, kvh, s, d)
+        got = F.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(np.array([s], dtype="int32")),
+            paddle.to_tensor(np.array([s], dtype="int32")), scale=0.5)
+        k_rep = np.repeat(k, 2, axis=1)
+        v_rep = np.repeat(v, 2, axis=1)
+        scores = np.einsum("bhqd,bhkd->bhqk", q, k_rep) * 0.5
+        want = np.einsum("bhqk,bhkd->bhqd", _softmax(scores), v_rep)
+        np.testing.assert_allclose(got.numpy(), want, rtol=2e-5, atol=2e-5)
+
+
+class TestMemoryEfficientAttention:
+    def test_plain(self):
+        b, s, h, d = 2, 6, 2, 8
+        q, k, v = _r(b, s, h, d), _r(b, s, h, d), _r(b, s, h, d)
+        got = inn.memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v))
+        qt = q.transpose(0, 2, 1, 3)
+        want = dense_attention(qt, k.transpose(0, 2, 1, 3),
+                               v.transpose(0, 2, 1, 3)).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(got.numpy(), want, rtol=2e-5, atol=2e-5)
+
+    def test_lower_triangular_bias(self):
+        from paddle_tpu.incubate.nn.attn_bias import LowerTriangularMask
+
+        b, s, h, d = 1, 5, 2, 4
+        q, k, v = _r(b, s, h, d), _r(b, s, h, d), _r(b, s, h, d)
+        got = inn.memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            attn_bias=LowerTriangularMask())
+        tri = np.triu(np.full((s, s), -np.inf, dtype="float32"), 1)[None, None]
+        qt = q.transpose(0, 2, 1, 3)
+        want = dense_attention(qt, k.transpose(0, 2, 1, 3),
+                               v.transpose(0, 2, 1, 3),
+                               tri).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(got.numpy(), want, rtol=2e-5, atol=2e-5)
+
+
+class TestAttnBias:
+    def test_seqleninfo(self):
+        from paddle_tpu.incubate.nn.attn_bias import SeqLenInfo
+
+        info = SeqLenInfo.from_seqlens([2, 3, 1])
+        assert info.seqstart_py == [0, 2, 5, 6]
+        assert info.max_seqlen == 3
+        assert list(info.intervals()) == [(0, 2), (2, 5), (5, 6)]
+
+    def test_block_diagonal(self):
+        from paddle_tpu.incubate.nn.attn_bias import BlockDiagonalMask
+
+        m = BlockDiagonalMask.from_seqlens([2, 2])
+        mat = m.materialize((4, 4)).numpy()
+        assert np.isfinite(mat[:2, :2]).all() and np.isfinite(mat[2:, 2:]).all()
+        assert (mat[:2, 2:] == -np.inf).all() and (mat[2:, :2] == -np.inf).all()
+
+    def test_block_diagonal_causal(self):
+        from paddle_tpu.incubate.nn.attn_bias import BlockDiagonalMask
+
+        m = BlockDiagonalMask.from_seqlens([3]).make_causal()
+        mat = m.materialize((3, 3)).numpy()
+        assert np.isfinite(np.tril(mat)).all()
+        assert mat[0, 1] == -np.inf and mat[0, 2] == -np.inf
+
+    def test_padded_seqlens(self):
+        from paddle_tpu.incubate.nn.attn_bias import PaddedSeqLenInfo
+
+        info = PaddedSeqLenInfo.from_seqlens_padded([2, 3], padding=4)
+        assert info.seqstart_py == [0, 4, 8]
+        assert list(info.intervals()) == [(0, 2), (4, 7)]
+
+
+class TestFusedLayers:
+    def test_fused_linear_layer(self):
+        lin = inn.FusedLinear(8, 3)
+        x = _r(4, 8)
+        got = lin(paddle.to_tensor(x))
+        want = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+
+    def test_fused_linear_transpose(self):
+        lin = inn.FusedLinear(8, 3, transpose_weight=True)
+        assert lin.weight.shape == [3, 8]
+        x = _r(4, 8)
+        got = lin(paddle.to_tensor(x))
+        np.testing.assert_allclose(got.numpy(),
+                                   x @ lin.weight.numpy().T + lin.bias.numpy(),
+                                   rtol=1e-5)
+
+    def test_fused_dropout_add_eval(self):
+        layer = inn.FusedDropoutAdd(p=0.5)
+        layer.eval()
+        x, y = _r(3, 4), _r(3, 4)
+        got = layer(paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(got.numpy(), x + y, rtol=1e-6)
+
+    def test_fused_bias_dropout_residual_ln(self):
+        d = 8
+        layer = inn.FusedBiasDropoutResidualLayerNorm(d, dropout_rate=0.0)
+        layer.eval()
+        x, res = _r(2, 3, d), _r(2, 3, d)
+        got = layer(paddle.to_tensor(x), paddle.to_tensor(res))
+        h = x + layer.linear_bias.numpy() + res
+        mu = h.mean(-1, keepdims=True)
+        var = h.var(-1, keepdims=True)
+        want = (h - mu) / np.sqrt(var + 1e-5) * layer.ln_scale.numpy() + \
+            layer.ln_bias.numpy()
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-4, atol=1e-4)
+
+    def test_fused_mha_layer(self):
+        paddle.seed(7)
+        mha = inn.FusedMultiHeadAttention(16, 2, dropout_rate=0.0,
+                                          attn_dropout_rate=0.0)
+        mha.eval()
+        x = _r(2, 4, 16)
+        out = mha(paddle.to_tensor(x))
+        assert out.shape == [2, 4, 16]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_fused_mha_pre_ln_and_transpose_wb(self):
+        mha = inn.FusedMultiHeadAttention(16, 2, dropout_rate=0.0,
+                                          attn_dropout_rate=0.0,
+                                          normalize_before=True,
+                                          transpose_qkv_wb=True)
+        mha.eval()
+        assert mha.qkv_weight.shape == [16, 48]
+        out = mha(paddle.to_tensor(_r(2, 4, 16)))
+        assert out.shape == [2, 4, 16]
+
+    def test_fused_ffn_layer(self):
+        ffn = inn.FusedFeedForward(16, 32, dropout_rate=0.0)
+        ffn.eval()
+        x = _r(2, 4, 16)
+        out = ffn(paddle.to_tensor(x))
+        w1, b1 = ffn._linear1_weight.numpy(), ffn._linear1_bias.numpy()
+        w2, b2 = ffn._linear2_weight.numpy(), ffn._linear2_bias.numpy()
+        h = np.maximum(x @ w1 + b1, 0) @ w2 + b2
+        res = x + h
+        mu, var = res.mean(-1, keepdims=True), res.var(-1, keepdims=True)
+        want = (res - mu) / np.sqrt(var + 1e-5) * ffn._ln2_scale.numpy() + \
+            ffn._ln2_bias.numpy()
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-4)
+
+    def test_fused_encoder_layer(self):
+        enc = inn.FusedTransformerEncoderLayer(16, 2, 32, dropout_rate=0.0)
+        enc.eval()
+        out = enc(paddle.to_tensor(_r(2, 4, 16)))
+        assert out.shape == [2, 4, 16]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_fused_ec_moe_layer(self):
+        moe = inn.FusedEcMoe(8, 16, 4, "gelu")
+        x, gate = _r(2, 3, 8), _r(2, 3, 4)
+        out = moe(paddle.to_tensor(x), paddle.to_tensor(gate))
+        assert out.shape == [2, 3, 8]
+
+    def test_fused_multi_transformer(self):
+        mt = inn.FusedMultiTransformer(16, 2, 32, num_layers=2,
+                                       dropout_rate=0.0)
+        mt.eval()
+        out = mt(paddle.to_tensor(_r(2, 4, 16)))
+        assert out.shape == [2, 4, 16]
+        assert np.isfinite(out.numpy()).all()
+        assert len(mt.parameters()) == 2 * 12
+
+    def test_fused_mha_backward(self):
+        mha = inn.FusedMultiHeadAttention(8, 2, dropout_rate=0.0,
+                                          attn_dropout_rate=0.0)
+        x = paddle.to_tensor(_r(1, 3, 8), stop_gradient=False)
+        mha(x).sum().backward()
+        assert mha.qkv_weight.grad is not None
+        assert x.grad.shape == [1, 3, 8]
+
+
+class TestMaskedMHANoSeqLens:
+    def test_position_from_src_mask(self):
+        b, h, d, s_max = 1, 2, 4, 8
+        t = 3  # current step
+        np.random.seed(1)
+        cache = np.zeros((2, b, h, s_max, d), dtype="float32")
+        cache[:, :, :, :t, :] = _r(2, b, h, t, d)
+        x = _r(b, 3 * h * d)
+        src_mask = np.zeros((b, 1, 1, t + 1), dtype="float32")
+        out, cache_out = F.masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            src_mask=paddle.to_tensor(src_mask))
+        qkv = x.reshape(b, 3, h, d)
+        # new kv must land at slot t, not slot 0
+        np.testing.assert_allclose(
+            np.asarray(cache_out.numpy())[0][:, :, t, :], qkv[:, 1], rtol=1e-6)
+        k_full = cache[0].copy(); k_full[:, :, t] = qkv[:, 1]
+        v_full = cache[1].copy(); v_full[:, :, t] = qkv[:, 2]
+        valid = np.arange(s_max) <= t
+        mask = np.where(valid, 0.0, -1e9)[None, None, None, :].copy()
+        mask[..., :t + 1] += src_mask
+        want = dense_attention(qkv[:, 0][:, :, None, :], k_full, v_full,
+                               mask)[:, :, 0, :]
+        np.testing.assert_allclose(out.numpy(), want.reshape(b, h * d),
+                                   rtol=2e-5, atol=2e-5)

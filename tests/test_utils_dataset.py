@@ -231,3 +231,56 @@ class TestDataset:
     def test_common_download_raises(self):
         with pytest.raises(RuntimeError):
             dataset.common.download("http://example.com/x.tar", "x")
+
+
+class TestReviewRegressions:
+    def test_movielens_split_stable_across_epochs(self):
+        r = dataset.movielens.train(synthetic=True)
+        e1 = [tuple(map(str, s)) for s in r()]
+        e2 = [tuple(map(str, s)) for s in r()]
+        assert e1 == e2
+        n_train = len(list(dataset.movielens.train(synthetic=True)()))
+        n_test = len(list(dataset.movielens.test(synthetic=True)()))
+        assert n_train + n_test == 512
+
+    def test_xmap_abandoned_iteration(self):
+        r = reader_mod.xmap_readers(lambda x: x, lambda: iter(range(10)),
+                                    process_num=2, buffer_size=2, order=True)
+        it = r()
+        next(it)  # abandon mid-iteration
+        assert list(r()) == list(range(10))
+
+    def test_synthetic_rng_stable(self):
+        import subprocess, sys
+        code = ("import paddle_tpu.dataset as d;"
+                "print(next(d.mnist.train(synthetic=True)())[1])")
+        outs = {
+            subprocess.run(
+                [sys.executable, "-c", code], capture_output=True, text=True,
+                env={"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu",
+                     "PYTHONHASHSEED": str(i), "PATH": "/usr/bin:/bin",
+                     "HOME": "/root"},
+            ).stdout.strip()
+            for i in (1, 2)
+        }
+        assert len(outs) == 1, outs
+
+    def test_flowers_real_raises(self):
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError):
+            dataset.flowers.train(synthetic=False)
+
+    def test_shared_layer_flops_accumulates(self):
+        import paddle_tpu.nn as nn
+
+        class Twice(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.lin(self.lin(x))
+
+        total = paddle.flops(Twice(), [1, 4])
+        assert total == 2 * (2 * 1 * 4 * 4)
