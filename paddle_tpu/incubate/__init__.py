@@ -1,3 +1,23 @@
-"""paddle.incubate parity (MoE, fused ops). Reference: python/paddle/incubate."""
+"""paddle.incubate parity.
+
+Reference: python/paddle/incubate/__init__.py — MoE/expert-parallel models,
+fused nn ops and layers, ASP sparsity, incubating optimizers, autograd
+primitives, autotune config, segment-op tensor namespace.
+"""
 from . import distributed, nn
 from . import asp  # noqa: F401
+from . import optimizer
+from . import autograd
+from . import operators
+from . import layers
+from . import tensor
+from . import multiprocessing
+from .autotune import set_config
+
+from .optimizer import LookAhead, ModelAverage
+
+__all__ = [
+    "distributed", "nn", "asp", "optimizer", "autograd", "operators",
+    "layers", "tensor", "multiprocessing", "LookAhead", "ModelAverage",
+    "set_config",
+]

@@ -1,0 +1,192 @@
+"""paddle.incubate.autograd parity.
+
+Reference: python/paddle/incubate/autograd/__init__.py — vjp, jvp,
+Jacobian, Hessian (functional, lazy), forward_grad, grad, and the prim
+enable/disable switches. On TPU forward-mode rides jax.jvp and the "prim"
+mode is always effectively on (every op lowers to primitive StableHLO);
+the switches record state for API parity and gate the decomposition pass
+facade in paddle_tpu.decomposition.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import ensure_tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "prim_enabled", "forward_grad", "grad"]
+
+_PRIM_ENABLED = False
+
+
+def enable_prim():
+    global _PRIM_ENABLED
+    _PRIM_ENABLED = True
+
+
+def disable_prim():
+    global _PRIM_ENABLED
+    _PRIM_ENABLED = False
+
+
+def prim_enabled():
+    return _PRIM_ENABLED
+
+
+def _wrap(func):
+    """paddle-level callable -> jax-level callable on raw arrays."""
+
+    def fn(*arrays):
+        outs = func(*[Tensor._from_value(a, stop_gradient=False)
+                      if hasattr(a, "dtype") else a for a in arrays])
+        if isinstance(outs, (list, tuple)):
+            return tuple(ensure_tensor(o)._value for o in outs)
+        return ensure_tensor(outs)._value
+
+    return fn
+
+
+def _unpack(xs):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    return [ensure_tensor(x)._value for x in xs_list], isinstance(xs, (list, tuple))
+
+
+def _rewrap(vals, was_seq):
+    ts = [Tensor._from_value(v) for v in vals]
+    return ts if was_seq else ts[0]
+
+
+def vjp(func, xs, v=None):
+    """Reference: incubate/autograd/primapi (vjp) — returns
+    (func(xs), vjp_result)."""
+    arrs, was_seq = _unpack(xs)
+    fn = _wrap(func)
+    outs, vjp_fn = jax.vjp(fn, *arrs)
+    if v is None:
+        if isinstance(outs, tuple):
+            cot = tuple(jnp.ones_like(o) for o in outs)
+        else:
+            cot = jnp.ones_like(outs)
+    else:
+        vs, _ = _unpack(v)
+        cot = tuple(vs) if isinstance(outs, tuple) else vs[0]
+    grads = vjp_fn(cot)
+    outs_t = ([Tensor._from_value(o) for o in outs]
+              if isinstance(outs, tuple) else Tensor._from_value(outs))
+    return outs_t, _rewrap(list(grads), was_seq)
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode JVP: returns (func(xs), jvp_result)."""
+    arrs, was_seq = _unpack(xs)
+    fn = _wrap(func)
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrs]
+    else:
+        tangents, _ = _unpack(v)
+    outs, tangents_out = jax.jvp(fn, tuple(arrs), tuple(tangents))
+    outs_t = ([Tensor._from_value(o) for o in outs]
+              if isinstance(outs, tuple) else Tensor._from_value(outs))
+    tout = ([Tensor._from_value(t) for t in tangents_out]
+            if isinstance(tangents_out, tuple) else Tensor._from_value(tangents_out))
+    return outs_t, tout
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode gradients of outputs w.r.t. inputs (reference
+    primapi.forward_grad). Implemented through the tape's jvp on the
+    captured function is not available eagerly, so this walks jax.jvp over
+    a replay closure is unnecessary: eager tensors already know their
+    graph — use paddle_tpu.incubate.autograd.jvp with an explicit func
+    instead. Provided here for static-capture use via Program tracing."""
+    raise NotImplementedError(
+        "forward_grad requires static capture; use "
+        "paddle.incubate.autograd.jvp(func, xs, v) in dygraph."
+    )
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Reverse-mode grad mirroring paddle.incubate.autograd.grad."""
+    from ...autograd import grad as _grad
+
+    return _grad(outputs, inputs, grad_outputs, allow_unused=True)
+
+
+class Jacobian:
+    """Lazy Jacobian (reference: incubate/autograd/functional.py Jacobian —
+    J[i, j] indexing over flattened outputs x inputs; is_batched keeps
+    axis 0)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = xs
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        arrs, _ = _unpack(self._xs)
+        fn = _wrap(self._func)
+
+        if len(arrs) == 1:
+            jac = jax.jacrev(lambda a: fn(a))(arrs[0])
+        else:
+            jac = jax.jacrev(lambda *a: fn(*a), argnums=tuple(range(len(arrs))))(*arrs)
+            jac = jnp.concatenate(
+                [j.reshape(j.shape[: -len(a.shape)] + (-1,))
+                 for j, a in zip(jac, arrs)], axis=-1)
+        if self._is_batched:
+            # func output [B, m], input [B, n] -> jac [B, m, B, n]; the
+            # cross-batch blocks are zero, keep the per-batch diagonal
+            jac = jnp.einsum("bmbn->bmn", jac) if jac.ndim == 4 else jac
+            self._mat = jac
+        else:
+            # flatten to 2D [num_out, num_in]
+            total = int(jnp.size(jac))
+            in_sz = sum(int(jnp.size(a)) for a in arrs)
+            self._mat = jac.reshape(total // in_sz, in_sz)
+        return self._mat
+
+    def __getitem__(self, idx):
+        return Tensor._from_value(self._compute()[idx])
+
+    @property
+    def shape(self):
+        return list(self._compute().shape)
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._compute())
+
+
+class Hessian(Jacobian):
+    """Lazy Hessian of a scalar-output func."""
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        arrs, _ = _unpack(self._xs)
+        fn = _wrap(self._func)
+        if len(arrs) == 1:
+            h = jax.hessian(lambda a: fn(a).sum())(arrs[0])
+            n = int(jnp.size(arrs[0]))
+            self._mat = h.reshape(n, n)
+        else:
+            flat = jnp.concatenate([a.reshape(-1) for a in arrs])
+            sizes = [int(jnp.size(a)) for a in arrs]
+            shapes = [a.shape for a in arrs]
+
+            def split_fn(v):
+                outs = []
+                off = 0
+                for s, sh in zip(sizes, shapes):
+                    outs.append(v[off:off + s].reshape(sh))
+                    off += s
+                return fn(*outs).sum()
+
+            self._mat = jax.hessian(split_fn)(flat)
+        return self._mat

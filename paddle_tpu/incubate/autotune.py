@@ -1,0 +1,58 @@
+"""Kernel/layout/dataloader autotune configuration.
+
+Reference: python/paddle/incubate/autotune.py — set_config(config) with
+"kernel" (enable + tuning step range), "layout", and "dataloader" sections;
+accepts a dict or a JSON file object. On TPU, kernel autotuning is XLA's
+autotuner (always on) plus the framework's dispatch-cache warmup window;
+the accepted config is recorded in the flags registry so subsystems
+(dataloader, layout chooser) can consult it.
+"""
+from __future__ import annotations
+
+import json
+
+from ..core import flags as _flags
+
+__all__ = ["set_config"]
+
+_VALID_KEYS = {"kernel", "layout", "dataloader"}
+
+_flags.define_flag("use_autotune", False, "enable kernel autotune", bool)
+_flags.define_flag("autotune_tuning_start", 1,
+                   "first step of the autotune window", int)
+_flags.define_flag("autotune_tuning_stop", 10,
+                   "last step of the autotune window", int)
+_flags.define_flag("autotune_layout", False, "enable layout autotune", bool)
+_flags.define_flag("autotune_dataloader", False,
+                   "enable dataloader autotune", bool)
+
+
+def set_config(config=None):
+    if config is None:
+        _flags.set_flags({
+            "use_autotune": True,
+        })
+        return
+    if hasattr(config, "read"):
+        config = json.loads(config.read())
+    if not isinstance(config, dict):
+        raise ValueError("config must be None, a dict, or a JSON file object")
+    unknown = set(config) - _VALID_KEYS
+    if unknown:
+        raise ValueError(f"unknown autotune sections: {sorted(unknown)}")
+    kernel = config.get("kernel", {})
+    _flags.set_flags({
+        "use_autotune": bool(kernel.get("enable", True)),
+    })
+    if "tuning_range" in kernel:
+        lo, hi = kernel["tuning_range"]
+        _flags.set_flags({"autotune_tuning_start": int(lo),
+                          "autotune_tuning_stop": int(hi)})
+    if "layout" in config:
+        _flags.set_flags({
+            "autotune_layout": bool(config["layout"].get("enable", False))
+        })
+    if "dataloader" in config:
+        _flags.set_flags({
+            "autotune_dataloader": bool(config["dataloader"].get("enable", False))
+        })

@@ -1,0 +1,89 @@
+"""paddle.incubate.layers parity (search/rec helper ops).
+
+Reference: python/paddle/incubate/layers/nn.py — shuffle_batch,
+partial_concat, partial_sum, batch_fc and friends used by
+recommendation-system models.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ...ops._helpers import defprim, ensure_tensor
+
+__all__ = ["shuffle_batch", "partial_concat", "partial_sum", "batch_fc"]
+
+
+def shuffle_batch(x, seed=None):
+    """Random permutation of the batch (axis 0).
+
+    Reference: incubate/layers/nn.py shuffle_batch (returns shuffled x; the
+    static op also outputs the permutation for backward — the tape replays
+    the same permutation here via the captured index tensor)."""
+    from ...core import generator
+    from ...ops.manipulation import gather
+
+    x = ensure_tensor(x)
+    key = generator.next_key("local_seed") if seed is None else \
+        jax.random.PRNGKey(int(seed))
+    perm = jax.random.permutation(key, x.shape[0])
+    return gather(x, Tensor._from_value(perm), axis=0)
+
+
+def _partial_slice(t, start_index, length):
+    t = ensure_tensor(t)
+    feat = t.shape[1]
+    start = start_index if start_index >= 0 else feat + start_index
+    stop = feat if length < 0 else min(start + length, feat)
+    from ...ops.manipulation import slice as slice_op
+
+    return slice_op(t, axes=[1], starts=[start], ends=[stop])
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """Concat a column slice of each input along axis 1
+    (reference: incubate/layers/nn.py partial_concat)."""
+    from ...ops.manipulation import concat
+
+    if not isinstance(input, (list, tuple)):
+        input = [input]
+    return concat([_partial_slice(t, start_index, length) for t in input],
+                  axis=1)
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """Sum a column slice of each input elementwise
+    (reference: incubate/layers/nn.py partial_sum)."""
+    from ...ops.math import add
+
+    if not isinstance(input, (list, tuple)):
+        input = [input]
+    parts = [_partial_slice(t, start_index, length) for t in input]
+    out = parts[0]
+    for p in parts[1:]:
+        out = add(out, p)
+    return out
+
+
+defprim("batch_fc_p", lambda x, w, b: jnp.einsum("bid,bdo->bio", x, w) + b)
+
+
+def batch_fc(input, param_size, param_attr, bias_size, bias_attr, act=None):
+    """Per-batch-slot FC: x [B, I, D] @ w [B, D, O] + b [B, I, O]
+    (reference: incubate/layers/nn.py batch_fc). Returns the output with
+    freshly created parameters, dygraph-style."""
+    from ...nn.layer import Layer
+
+    holder = Layer()
+    w = holder.create_parameter(shape=list(param_size), attr=param_attr)
+    b = holder.create_parameter(shape=list(bias_size), attr=bias_attr,
+                                is_bias=True)
+    out = apply("batch_fc_p", ensure_tensor(input), w, b)
+    if act == "relu":
+        from ...ops.activation import relu
+
+        out = relu(out)
+    elif act is not None:
+        raise ValueError(f"unsupported act {act!r}")
+    return out

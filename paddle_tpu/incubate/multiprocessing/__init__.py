@@ -1,0 +1,41 @@
+"""paddle.incubate.multiprocessing parity.
+
+Reference: python/paddle/incubate/multiprocessing/ — registers tensor
+reductions with multiprocessing so Tensors can cross process boundaries
+(the reference shares CUDA/CPU memory via cudaIPC/shm). TPU build: device
+arrays serialize through host numpy (PJRT buffers are not shareable
+between host processes), which keeps the API portable.
+"""
+from __future__ import annotations
+
+import multiprocessing
+from multiprocessing.reduction import ForkingPickler
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["init_reductions"] + [
+    n for n in dir(multiprocessing) if not n.startswith("_")
+]
+
+
+def _rebuild_tensor(arr, stop_gradient):
+    t = Tensor(arr)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _reduce_tensor(t: Tensor):
+    return _rebuild_tensor, (np.asarray(t._value), t.stop_gradient)
+
+
+def init_reductions():
+    ForkingPickler.register(Tensor, _reduce_tensor)
+
+
+init_reductions()
+
+
+def __getattr__(name):
+    return getattr(multiprocessing, name)

@@ -184,7 +184,8 @@ class BlockDiagonalMask(AttentionBias):
 @dataclass
 class BlockDiagonalCausalMask(BlockDiagonalMask):
     def _block(self, q_len, k_len):
+        # top-left aligned like the reference (materializes via
+        # LowerTriangularMask, triu k=1, regardless of k_len vs q_len)
         return jnp.triu(
-            jnp.full((q_len, k_len), _NEG_INF, dtype=jnp.float32),
-            k=1 + k_len - q_len if k_len > q_len else 1,
+            jnp.full((q_len, k_len), _NEG_INF, dtype=jnp.float32), k=1
         )

@@ -60,16 +60,10 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
 
 def _rope_fwd(q, k, cos, sin, *, use_neox):
     # q,k: [B, S, H, D]; cos/sin broadcastable [1, S, 1, D]
-    def rot(x):
-        if use_neox:
-            x1, x2 = jnp.split(x, 2, axis=-1)
-            return jnp.concatenate([-x2, x1], axis=-1)
-        x1 = x[..., 0::2]
-        x2 = x[..., 1::2]
-        return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+    from ._rope_common import rotate_half
 
-    q_out = q * cos + rot(q) * sin
-    k_out = k * cos + rot(k) * sin
+    q_out = q * cos + rotate_half(q, use_neox) * sin
+    k_out = k * cos + rotate_half(k, use_neox) * sin
     return q_out, k_out
 
 
@@ -228,7 +222,8 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
                                ln_epsilon=1e-5, training=True,
                                mode="upscale_in_train", ring_id=-1,
                                add_residual=True, num_heads=None,
-                               transpose_qkv_wb=False, name=None):
+                               transpose_qkv_wb=False, rotary_embs=None,
+                               name=None):
     """Reference behavior: fluid/operators/fused/fused_attention_op.cu
     (pre/post-LN MHA transformer block)."""
     from ....nn.functional.attention import scaled_dot_product_attention
@@ -256,6 +251,14 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
             qkv = add(qkv, reshape(ensure_tensor(qkv_bias), [3 * nh * hd]))
         qkv = reshape(qkv, [b, s, 3, nh, hd])
     q, k, v = unbind(qkv, 2)
+    if rotary_embs is not None:
+        # rotary_embs: [2, B, S, 1, D] (cos at [0], sin at [1] — the
+        # fused_multi_transformer rope layout)
+        rot = ensure_tensor(rotary_embs)._value
+        hd_r = rot.shape[-1]
+        cos = Tensor._from_value(rot[0].reshape(rot.shape[1], -1, 1, hd_r))
+        sin = Tensor._from_value(rot[1].reshape(rot.shape[1], -1, 1, hd_r))
+        q, k = apply("fused_rope_p", q, k, cos, sin, use_neox=True)
     out = scaled_dot_product_attention(
         q, k, v, attn_mask, attn_dropout_rate, False, training
     )
@@ -307,3 +310,6 @@ from .inference_attention import (  # noqa: E402
     masked_multihead_attention, blha_get_max_len, block_multihead_attention,
     variable_length_memory_efficient_attention, fused_dot_product_attention,
 )
+from .fused_linear_ce import fused_linear_cross_entropy  # noqa: E402
+
+__all__.append("fused_linear_cross_entropy")

@@ -1,0 +1,18 @@
+"""Shared rotary-embedding rotation (single source of truth for the
+training rope (fused_rope_p), decode rope, and paged-attention rope)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["rotate_half"]
+
+
+def rotate_half(t, neox: bool):
+    """The RoPE companion rotation: neox=True splits the feature dim in
+    halves ([-x2, x1]); neox=False pairs even/odd lanes."""
+    if neox:
+        t1, t2 = jnp.split(t, 2, axis=-1)
+        return jnp.concatenate([-t2, t1], axis=-1)
+    t1 = t[..., 0::2]
+    t2 = t[..., 1::2]
+    return jnp.stack([-t2, t1], axis=-1).reshape(t.shape)

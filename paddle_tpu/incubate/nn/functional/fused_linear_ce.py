@@ -1,0 +1,74 @@
+"""Fused linear + softmax cross-entropy (chunked over tokens).
+
+TPU-native extra (no direct reference op; the reference composes
+ParallelCrossEntropy / fused_linear). Motivation: a Llama-class LM head
+materializes fp32 logits [T, V] — at bs=16/seq=2048/V=32k that is 4 GB
+plus its gradient, which is what OOMs large-batch training. This op scans
+the token dim in chunks, computing each chunk's logits, log-sum-exp and
+label log-prob inside a `jax.checkpoint` region so the backward replays
+one chunk at a time; peak extra memory is one [chunk, V] block instead of
+[T, V]. The matmul runs on the MXU in the input dtype with fp32
+accumulation; the softmax math is fp32.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....ops._helpers import defprim, ensure_tensor
+
+__all__ = ["fused_linear_cross_entropy"]
+
+
+def _fused_linear_ce_fwd(hidden, weight, labels, *, chunk, ignore_index):
+    t, h = hidden.shape
+    n_chunks = (t + chunk - 1) // chunk
+    pad = n_chunks * chunk - t
+    hidden_p = jnp.pad(hidden, ((0, pad), (0, 0)))
+    labels_p = jnp.pad(labels.astype(jnp.int32), (0, pad),
+                       constant_values=ignore_index)
+
+    @jax.checkpoint
+    def chunk_loss(h_c, l_c):
+        logits = jnp.dot(h_c, weight,
+                         preferred_element_type=jnp.float32)  # [C, V] fp32
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        safe = jnp.clip(l_c, 0, logits.shape[-1] - 1)
+        ll = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
+        valid = l_c != ignore_index
+        loss_sum = jnp.sum(jnp.where(valid, lse - ll, 0.0))
+        return loss_sum, jnp.sum(valid, dtype=jnp.int32)
+
+    # unrolled loop (not lax.scan): lets XLA schedule chunk matmuls freely
+    # and reuse one [chunk, V] buffer; checkpoint drops each chunk's logits
+    # so backward replays one chunk at a time
+    loss_sum = jnp.float32(0.0)
+    count = jnp.int32(0)
+    for i in range(n_chunks):
+        ls, c = chunk_loss(
+            jax.lax.dynamic_slice_in_dim(hidden_p, i * chunk, chunk),
+            jax.lax.dynamic_slice_in_dim(labels_p, i * chunk, chunk),
+        )
+        loss_sum = loss_sum + ls
+        count = count + c
+    return loss_sum / jnp.maximum(count, 1).astype(jnp.float32)
+
+
+defprim("fused_linear_ce_p", _fused_linear_ce_fwd)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
+                               chunk_size=2048):
+    """Mean token cross-entropy of softmax(hidden @ weight) without
+    materializing the full logits tensor.
+
+    hidden: [T, H] (flatten batch*seq first); weight: [H, V];
+    labels: [T] int, `ignore_index` entries excluded from the mean.
+    """
+    from ....core.tensor import apply
+
+    hidden = ensure_tensor(hidden)
+    weight = ensure_tensor(weight)
+    labels = ensure_tensor(labels)
+    return apply("fused_linear_ce_p", hidden, weight, labels,
+                 chunk=int(chunk_size), ignore_index=int(ignore_index))

@@ -96,6 +96,11 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         raise NotImplementedError(
             "quantized masked_multihead_attention is not part of the TPU build"
         )
+    if beam_cache_offset is not None or cum_offsets is not None:
+        raise NotImplementedError(
+            "beam-search cache reordering (beam_cache_offset/cum_offsets) is "
+            "not implemented in the TPU build"
+        )
     x = ensure_tensor(x)
     cache = ensure_tensor(cache_kv)
     num_heads = cache.shape[2]
@@ -119,6 +124,20 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     return out, cache_out
 
 
+from ._rope_common import rotate_half as _rotate_half  # noqa: E402
+
+
+def _rope_rows(rot, b, pos):
+    """cos/sin rows at per-batch positions from the reference layout
+    [2, B, S, 1, D] (cos at [0], sin at [1] —
+    fusion/gpu/masked_multihead_attention_kernel.cu:46)."""
+    d = rot.shape[-1]
+    cos_tab = rot[0].reshape(b, -1, d)
+    sin_tab = rot[1].reshape(b, -1, d)
+    bi = jnp.arange(b)
+    return cos_tab[bi, pos], sin_tab[bi, pos]  # each [B, D]
+
+
 def _apply_decode_rope(x, rotary_tensor, sequence_lengths, h, d, neox):
     """RoPE on the q/k slices of a packed decode qkv row."""
     def fwd(xv, rot, lens):
@@ -126,22 +145,11 @@ def _apply_decode_rope(x, rotary_tensor, sequence_lengths, h, d, neox):
         qkv = xv.reshape(b, 3, h, d)
         pos = (lens.reshape(b).astype(jnp.int32)
                if lens is not None else jnp.zeros((b,), jnp.int32))
-        # rot: [B, 1, 1, S, D] cos-sin interleaved per reference; take the
-        # current position's row
-        rot_row = rot.reshape(b, -1, rot.shape[-1])[jnp.arange(b), pos]  # [B, D]
-        cos = rot_row[:, None, :]
-        sin = jnp.roll(rot_row, shift=d // 2, axis=-1)[:, None, :]
-
-        def rotate(t):
-            if neox:
-                t1, t2 = jnp.split(t, 2, axis=-1)
-                return jnp.concatenate([-t2, t1], axis=-1)
-            t1 = t[..., 0::2]
-            t2 = t[..., 1::2]
-            return jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
-
-        q = qkv[:, 0] * cos + rotate(qkv[:, 0]) * sin
-        k = qkv[:, 1] * cos + rotate(qkv[:, 1]) * sin
+        cos, sin = _rope_rows(rot, b, pos)
+        cos = cos[:, None, :]
+        sin = sin[:, None, :]
+        q = qkv[:, 0] * cos + _rotate_half(qkv[:, 0], neox) * sin
+        k = qkv[:, 1] * cos + _rotate_half(qkv[:, 1], neox) * sin
         return jnp.stack([q, k, qkv[:, 2]], axis=1).reshape(b, 3 * h * d)
 
     seq_v = sequence_lengths._value if sequence_lengths is not None else None
@@ -162,8 +170,8 @@ def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
 
 
 def _bmha_fwd(qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
-              cu_seqlens_q, block_tables, *, num_heads, kv_num_heads,
-              block_size, max_seq_len, use_neox):
+              cu_seqlens_q, block_tables, rope_emb, *, num_heads, kv_num_heads,
+              block_size, max_seq_len, use_neox, use_rope):
     """Paged-KV attention, prefill + decode in one jnp program.
 
     Caches: [num_blocks, kv_H, block_size, D]; block_tables [B, blocks/seq].
@@ -193,6 +201,27 @@ def _bmha_fwd(qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
     write_pos = jnp.where(enc[:, None] > 0, offs[None, :], dec[:, None])
     tok_valid = offs[None, :] < n_this[:, None]
     tok_idx_c = jnp.clip(tok_idx, 0, t - 1)
+
+    if use_rope:
+        # rope_emb: [2, B, S, 1, D] (cos at [0], sin at [1]); rotate each
+        # token's q/k by its own logical position before caching/attention
+        d_r = rope_emb.shape[-1]
+        cos_tab = rope_emb[0].reshape(b, -1, d_r)
+        sin_tab = rope_emb[1].reshape(b, -1, d_r)
+        pos_c = jnp.clip(write_pos, 0, cos_tab.shape[1] - 1)   # [B, S_pad]
+        bi = jnp.arange(b)[:, None]
+        cos_tok = cos_tab[bi, pos_c]                            # [B, S_pad, D]
+        sin_tok = sin_tab[bi, pos_c]
+        scat_cos = jnp.zeros((t, d_r), qkv.dtype).at[
+            jnp.where(tok_valid, tok_idx_c, t).reshape(-1)
+        ].set(cos_tok.reshape(-1, d_r).astype(qkv.dtype), mode="drop")
+        scat_sin = jnp.zeros((t, d_r), qkv.dtype).at[
+            jnp.where(tok_valid, tok_idx_c, t).reshape(-1)
+        ].set(sin_tok.reshape(-1, d_r).astype(qkv.dtype), mode="drop")
+        cos_e = scat_cos[:, None, :]
+        sin_e = scat_sin[:, None, :]
+        q_flat = q_flat * cos_e + _rotate_half(q_flat, use_neox) * sin_e
+        k_flat = k_flat * cos_e + _rotate_half(k_flat, use_neox) * sin_e
 
     # map logical position -> physical cache slot through the block table
     blk = write_pos // block_size
@@ -224,23 +253,40 @@ def _bmha_fwd(qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
     k_rep = jnp.repeat(k_seq, group, axis=2)
     v_rep = jnp.repeat(v_seq, group, axis=2)
 
-    q_seq = q_flat[tok_idx_c]                           # [B, S_pad, H, D]
     scale = 1.0 / np.sqrt(d)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q_seq.astype(jnp.float32),
-                        k_rep.astype(jnp.float32)) * scale
-    q_pos = jnp.where(enc[:, None] > 0, offs[None, :], dec[:, None])
-    causal_ok = offs[None, None, :] <= q_pos[:, :, None]   # [B, Sq, Sk]
-    kv_ok = offs[None, None, :] < total[:, None, None]
-    mask = (causal_ok & kv_ok)[:, None, :, :]
-    scores = jnp.where(mask, scores, _NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out_seq = jnp.einsum("bhqk,bkhd->bqhd", probs, v_rep.astype(jnp.float32))
-    out_seq = out_seq.astype(qkv.dtype)
+    kv_ok = offs[None, :] < total[:, None]               # [B, Sk]
 
-    # scatter back to packed token rows
-    out = jnp.zeros((t, h, d), dtype=qkv.dtype)
-    safe_tok = jnp.where(flat_valid, flat_tok, t)
-    out = out.at[safe_tok].set(out_seq.reshape(b * s_pad, h, d), mode="drop")
+    def full_attn(_):
+        # prefill (or mixed) batch: [S_pad, S_pad] causal attention per seq
+        q_seq = q_flat[tok_idx_c]                        # [B, S_pad, H, D]
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q_seq.astype(jnp.float32),
+                            k_rep.astype(jnp.float32)) * scale
+        q_pos = jnp.where(enc[:, None] > 0, offs[None, :], dec[:, None])
+        causal_ok = offs[None, None, :] <= q_pos[:, :, None]  # [B, Sq, Sk]
+        mask = (causal_ok & kv_ok[:, None, :])[:, None, :, :]
+        scores = jnp.where(mask, scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out_seq = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                             v_rep.astype(jnp.float32)).astype(qkv.dtype)
+        out = jnp.zeros((t, h, d), dtype=qkv.dtype)
+        safe_tok = jnp.where(flat_valid, flat_tok, t)
+        return out.at[safe_tok].set(out_seq.reshape(b * s_pad, h, d),
+                                    mode="drop")
+
+    def decode_attn(_):
+        # decode-only batch: one valid query row per sequence — [1, S_pad]
+        # attention instead of [S_pad, S_pad] (the serving hot path)
+        q_dec = q_flat[jnp.clip(starts, 0, t - 1)]       # [B, H, D]
+        scores = jnp.einsum("bhd,bkhd->bhk", q_dec.astype(jnp.float32),
+                            k_rep.astype(jnp.float32)) * scale
+        scores = jnp.where(kv_ok[:, None, :], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out_dec = jnp.einsum("bhk,bkhd->bhd", probs,
+                             v_rep.astype(jnp.float32)).astype(qkv.dtype)
+        out = jnp.zeros((t, h, d), dtype=qkv.dtype)
+        return out.at[jnp.clip(starts, 0, t - 1)].set(out_dec)
+
+    out = jax.lax.cond(jnp.all(enc == 0), decode_attn, full_attn, 0)
 
     kc_out = kc.reshape(nb, block_size, kvh, d).transpose(0, 2, 1, 3)
     vc_out = vc.reshape(nb, block_size, kvh, d).transpose(0, 2, 1, 3)
@@ -288,12 +334,15 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         from ....ops.math import add
 
         qkv = add(qkv, ensure_tensor(qkv_bias))
+    use_rope = rope_emb is not None
+    rope_t = ensure_tensor(rope_emb) if use_rope else qkv
     out, qkv_out, kc_out, vc_out = apply(
         "block_mha_p", qkv, kc, vc, ensure_tensor(seq_lens_encoder),
         ensure_tensor(seq_lens_decoder), ensure_tensor(cu_seqlens_q),
-        ensure_tensor(block_tables), num_heads=int(h), kv_num_heads=int(kvh),
-        block_size=int(block_size), max_seq_len=int(max_seq_len),
-        use_neox=bool(use_neox_style),
+        ensure_tensor(block_tables), rope_t, num_heads=int(h),
+        kv_num_heads=int(kvh), block_size=int(block_size),
+        max_seq_len=int(max_seq_len), use_neox=bool(use_neox_style),
+        use_rope=use_rope,
     )
     return out, qkv_out, kc_out, vc_out
 
@@ -333,16 +382,17 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
     k = ensure_tensor(key)
     scale = float(scale) if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     use_mask = mask is not None
-    if causal and not use_mask:
+    mask_v = ensure_tensor(mask)._value.astype(jnp.float32) if use_mask else None
+    if causal:
+        # causal composes with an explicit padding mask (additive)
         sq, sk = q.shape[2], k.shape[2]
         tri = jnp.where(
             jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :] - (sk - sq),
             0.0, _NEG_INF,
-        )
-        mask_t = Tensor._from_value(tri[None, None])
+        )[None, None]
+        mask_v = tri if mask_v is None else mask_v + tri
         use_mask = True
-    else:
-        mask_t = ensure_tensor(mask) if use_mask else q
+    mask_t = Tensor._from_value(mask_v) if use_mask else q
     return apply("vl_attn_p", q, k, ensure_tensor(value),
                  ensure_tensor(kv_seq_lens), mask_t, scale=scale,
                  use_mask=use_mask)
@@ -353,21 +403,21 @@ def fused_dot_product_attention(q, k, v, bias=None, cu_seqlen_q=None,
                                 dropout_prob=0.0, training=True,
                                 is_causal_masking=False, mask_type=None,
                                 bias_type=None, name=None):
-    """cuDNN-fused SDPA analog ([B, S, H, D] layout).
+    """cuDNN-fused SDPA analog ([B, S, H, D] layout; bias is an additive
+    [B, H, Sq, Sk] mask).
 
     Reference: incubate/nn/functional/fused_dot_product_attention.py — on
     TPU this routes to the framework's flash/SDPA path (Pallas on chip).
     """
     from ....nn.functional.attention import scaled_dot_product_attention
 
-    if bias is not None:
-        from ....ops.manipulation import transpose
+    if scaling_factor is not None:
+        # sdpa applies 1/sqrt(d) itself; fold the custom scale into q
+        from ....ops.math import scale as scale_op
 
-        # sdpa takes an additive [B, H, Sq, Sk] mask
-        return scaled_dot_product_attention(
-            q, k, v, attn_mask=bias, dropout_p=dropout_prob,
-            is_causal=is_causal_masking, training=training,
-        )
+        default = 1.0 / float(np.sqrt(ensure_tensor(q).shape[-1]))
+        q = scale_op(ensure_tensor(q), float(scaling_factor) / default)
     return scaled_dot_product_attention(
-        q, k, v, None, dropout_prob, is_causal_masking, training
+        q, k, v, attn_mask=bias, dropout_p=dropout_prob,
+        is_causal=is_causal_masking, training=training,
     )

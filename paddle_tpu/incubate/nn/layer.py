@@ -106,8 +106,8 @@ class FusedBiasDropoutResidualLayerNorm(Layer):
         self.ln_scale = self.create_parameter(
             shape=[embed_dim], attr=weight_attr,
             default_initializer=_ones_init())
-        self.ln_bias = self.create_parameter(shape=[embed_dim], attr=None,
-                                             is_bias=True)
+        self.ln_bias = self.create_parameter(shape=[embed_dim],
+                                             attr=bias_attr, is_bias=True)
 
     def forward(self, x, residual):
         from ...nn.functional.common import dropout
@@ -167,27 +167,33 @@ class FusedMultiHeadAttention(Layer):
                                                 attr=qkv_weight_attr)
         self.qkv_bias = (None if qkv_bias_attr is False else
                          self.create_parameter(shape=qkv_b_shape,
-                                               attr=None, is_bias=True))
+                                               attr=qkv_bias_attr,
+                                               is_bias=True))
         out_w_shape = [self.num_heads * self.head_dim, embed_dim]
         self.linear_weight = self.create_parameter(shape=out_w_shape,
                                                    attr=linear_weight_attr)
         self.linear_bias = (None if linear_bias_attr is False else
                             self.create_parameter(shape=[embed_dim],
-                                                  attr=None, is_bias=True))
+                                                  attr=linear_bias_attr,
+                                                  is_bias=True))
         if normalize_before:
             self.pre_ln_scale = self.create_parameter(
                 shape=[embed_dim], attr=pre_ln_scale_attr,
                 default_initializer=_ones_init())
-            self.pre_ln_bias = self.create_parameter(shape=[embed_dim],
-                                                     attr=None, is_bias=True)
+            self.pre_ln_bias = (None if pre_ln_bias_attr is False else
+                                self.create_parameter(shape=[embed_dim],
+                                                      attr=pre_ln_bias_attr,
+                                                      is_bias=True))
             self.ln_scale, self.ln_bias = None, None
         else:
             self.pre_ln_scale, self.pre_ln_bias = None, None
             self.ln_scale = self.create_parameter(
                 shape=[embed_dim], attr=ln_scale_attr,
                 default_initializer=_ones_init())
-            self.ln_bias = self.create_parameter(shape=[embed_dim], attr=None,
-                                                 is_bias=True)
+            self.ln_bias = (None if ln_bias_attr is False else
+                            self.create_parameter(shape=[embed_dim],
+                                                  attr=ln_bias_attr,
+                                                  is_bias=True))
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
@@ -246,7 +252,8 @@ class FusedFeedForward(Layer):
             self._ln1_scale = self.create_parameter(
                 shape=[d_model], attr=ln1_scale_attr,
                 default_initializer=_ones_init())
-            self._ln1_bias = self.create_parameter(shape=[d_model], attr=None,
+            self._ln1_bias = self.create_parameter(shape=[d_model],
+                                                   attr=ln1_bias_attr,
                                                    is_bias=True)
             self._ln2_scale, self._ln2_bias = None, None
         else:
@@ -254,7 +261,8 @@ class FusedFeedForward(Layer):
             self._ln2_scale = self.create_parameter(
                 shape=[d_model], attr=ln2_scale_attr,
                 default_initializer=_ones_init())
-            self._ln2_bias = self.create_parameter(shape=[d_model], attr=None,
+            self._ln2_bias = self.create_parameter(shape=[d_model],
+                                                   attr=ln2_bias_attr,
                                                    is_bias=True)
 
     def forward(self, src, cache=None):
@@ -404,14 +412,26 @@ class FusedMultiTransformer(Layer):
     def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
                 rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
                 time_step=None):
-        from .functional import (fused_bias_act, fused_multi_head_attention)
+        from .functional import (fused_bias_act, fused_multi_head_attention,
+                                 fused_rotary_position_embedding)
         from ...nn.functional.common import linear
         from ...nn.functional.norm import layer_norm
         from ...ops.math import add
 
+        for unsupported, argname in ((caches, "caches"),
+                                     (pre_caches, "pre_caches"),
+                                     (time_step, "time_step"),
+                                     (seq_lens, "seq_lens")):
+            if unsupported is not None:
+                raise NotImplementedError(
+                    f"FusedMultiTransformer: generation-time {argname} is the "
+                    "caller's responsibility in the TPU build — use "
+                    "functional.block_multihead_attention /"
+                    " masked_multihead_attention for cached decode."
+                )
         out = src
         for i in range(self.num_layers):
-            residual = out
+            # fused_multi_head_attention adds its own input residual
             attn_out = fused_multi_head_attention(
                 out, self.qkv_weights[i], self.linear_weights[i],
                 pre_layer_norm=self.normalize_before,
@@ -422,7 +442,7 @@ class FusedMultiTransformer(Layer):
                 dropout_rate=self._dropout_rate,
                 attn_dropout_rate=self._dropout_rate,
                 ln_epsilon=self._epsilon, training=self.training,
-                num_heads=self.num_heads,
+                num_heads=self.num_heads, rotary_embs=rotary_embs,
             )
             residual = attn_out
             h = attn_out

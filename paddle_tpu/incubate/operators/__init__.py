@@ -1,0 +1,139 @@
+"""paddle.incubate.operators parity.
+
+Reference: python/paddle/incubate/operators/ — softmax_mask_fuse(+upper
+triangle), graph_send_recv, graph sampling/reindex wrappers, resnet_unit.
+The graph ops delegate to paddle_tpu.geometric; the fused softmax-mask ops
+are single XLA programs (one fusion on TPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import defprim, ensure_tensor
+from .resnet_unit import ResNetUnit
+
+__all__ = [
+    "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+    "graph_send_recv", "graph_khop_sampler", "graph_reindex",
+    "graph_sample_neighbors", "ResNetUnit",
+]
+
+
+defprim("softmax_mask_fuse_p", lambda x, mask: jax.nn.softmax(
+    x.astype(jnp.float32) + mask.astype(jnp.float32), axis=-1
+).astype(x.dtype))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fusion.
+
+    Reference: incubate/operators/softmax_mask_fuse.py (phi
+    fused_softmax_mask kernel); x [B, H, Sq, Sk], additive mask
+    [B, 1, Sq, Sk]."""
+    from ...core.tensor import apply
+
+    return apply("softmax_mask_fuse_p", ensure_tensor(x), ensure_tensor(mask))
+
+
+def _smf_ut_fwd(x):
+    s = x.shape[-1]
+    tri = jnp.where(
+        jnp.arange(s)[:, None] >= jnp.arange(s)[None, :], 0.0, -1e9
+    ).astype(jnp.float32)
+    probs = jax.nn.softmax(x.astype(jnp.float32) + tri, axis=-1)
+    return probs.astype(x.dtype)
+
+
+defprim("softmax_mask_fuse_ut_p", _smf_ut_fwd)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax in one fusion (reference:
+    softmax_mask_fuse_upper_triangle.py; phi fused_softmax_mask_upper_triangle)."""
+    from ...core.tensor import apply
+
+    return apply("softmax_mask_fuse_ut_p", ensure_tensor(x))
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Reference: incubate/operators/graph_send_recv.py — superseded by
+    paddle.geometric.send_u_recv; same semantics."""
+    from ...geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1, return_eids=False,
+                           flag_perm_buffer=False, name=None):
+    from ...geometric import sample_neighbors
+
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from ...geometric import reindex_graph
+
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling: iterate sample_neighbors per hop and
+    reindex (reference: incubate/operators/graph_khop_sampler.py)."""
+    from ...geometric import sample_neighbors
+    from ...ops.manipulation import concat
+
+    cur = ensure_tensor(input_nodes)
+    all_neighbors = []
+    all_counts = []
+    for size in sample_sizes:
+        res = sample_neighbors(row, colptr, cur, sample_size=size,
+                               eids=sorted_eids, return_eids=return_eids)
+        if return_eids:
+            neigh, count, _ = res
+        else:
+            neigh, count = res
+        all_neighbors.append(neigh)
+        all_counts.append(count)
+        cur = neigh
+    neighbors = concat(all_neighbors, axis=0)
+    reindex_src, reindex_dst, out_nodes = _khop_edges(
+        ensure_tensor(input_nodes), all_neighbors, all_counts)
+    return neighbors, reindex_src, reindex_dst, out_nodes
+
+
+def _khop_edges(nodes, neighbor_lists, count_lists):
+    import numpy as np
+
+    from ...core.tensor import Tensor
+
+    seed = np.asarray(nodes._value).reshape(-1)
+    keep = list(seed)
+    pos = {int(n): i for i, n in enumerate(keep)}
+    src_out, dst_out = [], []
+    frontier = seed
+    for neigh_t, count_t in zip(neighbor_lists, count_lists):
+        neigh = np.asarray(neigh_t._value).reshape(-1)
+        count = np.asarray(count_t._value).reshape(-1)
+        off = 0
+        for i, c in enumerate(count):
+            dst_node = int(frontier[i])
+            for n in neigh[off:off + int(c)]:
+                n = int(n)
+                if n not in pos:
+                    pos[n] = len(keep)
+                    keep.append(n)
+                src_out.append(pos[n])
+                dst_out.append(pos[dst_node])
+            off += int(c)
+        frontier = neigh
+    return (Tensor._from_value(jnp.asarray(src_out, dtype=jnp.int64)),
+            Tensor._from_value(jnp.asarray(dst_out, dtype=jnp.int64)),
+            Tensor._from_value(jnp.asarray(keep, dtype=jnp.int64)))

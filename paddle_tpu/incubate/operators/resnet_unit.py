@@ -1,0 +1,66 @@
+"""ResNetUnit fused block.
+
+Reference: python/paddle/incubate/operators/resnet_unit.py — the cuDNN
+fused conv+BN(+add+relu) residual unit used by ResNet NHWC training. On
+TPU the same composition is one XLA fusion region; this Layer keeps the
+reference's parameter surface (filter/scale/bias per branch, has_shortcut)
+and composes framework conv/batch_norm/relu.
+"""
+from __future__ import annotations
+
+from ...nn.layer import Layer
+
+__all__ = ["ResNetUnit"]
+
+
+class ResNetUnit(Layer):
+    def __init__(self, num_channels_x, num_filters, filter_size, stride=1,
+                 momentum=0.9, eps=1e-5, data_format="NHWC", act="relu",
+                 fuse_add=False, has_shortcut=False, use_global_stats=False,
+                 is_test=False, filter_x_attr=None, scale_x_attr=None,
+                 bias_x_attr=None, moving_mean_x_name=None,
+                 moving_var_x_name=None, num_channels_z=1, stride_z=1,
+                 filter_z_attr=None, scale_z_attr=None, bias_z_attr=None,
+                 moving_mean_z_name=None, moving_var_z_name=None):
+        super().__init__()
+        from ... import nn
+
+        if data_format not in ("NHWC", "NCHW"):
+            raise ValueError(f"unsupported data_format {data_format!r}")
+        if act not in ("relu",):
+            raise ValueError("ResNetUnit only supports act='relu'")
+        self._fuse_add = fuse_add
+        self._has_shortcut = has_shortcut
+        self._data_format = data_format
+
+        self.conv_x = nn.Conv2D(num_channels_x, num_filters, filter_size,
+                                stride=stride,
+                                padding=(filter_size - 1) // 2,
+                                weight_attr=filter_x_attr, bias_attr=False,
+                                data_format=data_format)
+        self.bn_x = nn.BatchNorm2D(num_filters, momentum=momentum,
+                                   epsilon=eps, weight_attr=scale_x_attr,
+                                   bias_attr=bias_x_attr,
+                                   data_format=data_format,
+                                   use_global_stats=use_global_stats)
+        if has_shortcut:
+            self.conv_z = nn.Conv2D(num_channels_z, num_filters, 1,
+                                    stride=stride_z,
+                                    weight_attr=filter_z_attr,
+                                    bias_attr=False, data_format=data_format)
+            self.bn_z = nn.BatchNorm2D(num_filters, momentum=momentum,
+                                       epsilon=eps, weight_attr=scale_z_attr,
+                                       bias_attr=bias_z_attr,
+                                       data_format=data_format,
+                                       use_global_stats=use_global_stats)
+
+    def forward(self, x, z=None):
+        from ...nn import functional as F
+        from ...ops.math import add
+
+        out = self.bn_x(self.conv_x(x))
+        if self._has_shortcut:
+            out = add(out, self.bn_z(self.conv_z(z)))
+        elif self._fuse_add and z is not None:
+            out = add(out, z)
+        return F.relu(out)

@@ -1,0 +1,18 @@
+"""paddle.incubate.optimizer parity.
+
+Reference: python/paddle/incubate/optimizer/ — LookAhead, ModelAverage,
+LBFGS, GradientMergeOptimizer, LarsMomentumOptimizer, DistributedFusedLamb,
+functional (minimize_bfgs / minimize_lbfgs), recompute re-export.
+"""
+from . import functional
+from .lookahead import LookAhead
+from .modelaverage import ModelAverage
+from .lbfgs import LBFGS
+from .gradient_merge import GradientMergeOptimizer
+from .lars_momentum import LarsMomentumOptimizer
+from .distributed_fused_lamb import DistributedFusedLamb
+
+__all__ = [
+    "LookAhead", "ModelAverage", "LBFGS", "GradientMergeOptimizer",
+    "LarsMomentumOptimizer", "DistributedFusedLamb", "functional",
+]

@@ -1,0 +1,69 @@
+"""Distributed fused LAMB.
+
+Reference: python/paddle/incubate/optimizer/distributed_fused_lamb.py —
+a CUDA mega-kernel that flattens all params into two fused buffers,
+shards moments across ranks and fuses the LAMB trust-ratio update with the
+gradient allreduce.
+
+TPU-native shape: the flattening/sharding job belongs to GSPMD — moments
+and updates shard automatically when the train step is pjit-compiled over a
+mesh with a sharding axis (see distributed/sharding). This class therefore
+provides the reference's API surface (clip_after_allreduce,
+is_grad_scaled_by_nranks, gradient_accumulation_steps) over the framework's
+LAMB update, with gradient accumulation handled like GradientMergeOptimizer.
+"""
+from __future__ import annotations
+
+from ...optimizer.optimizers import Lamb
+
+__all__ = ["DistributedFusedLamb"]
+
+
+class DistributedFusedLamb(Lamb):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 clip_after_allreduce=True, is_grad_scaled_by_nranks=True,
+                 alignment=128, use_master_param_norm=True,
+                 gradient_accumulation_steps=1, use_master_acc_grad=True,
+                 nproc_per_node=None, use_hierarchical_allreduce=False,
+                 name=None):
+        super().__init__(learning_rate=learning_rate,
+                         lamb_weight_decay=lamb_weight_decay, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, parameters=parameters,
+                         grad_clip=grad_clip,
+                         exclude_from_weight_decay_fn=exclude_from_weight_decay_fn,
+                         multi_precision=use_master_param_norm)
+        self._acc_steps = int(gradient_accumulation_steps)
+        self._merge = None
+        if self._acc_steps > 1:
+            from .gradient_merge import GradientMergeOptimizer
+
+            # the reference averages accumulated micro-batch grads before
+            # the LAMB update (acc_grad = sum/steps in its acc kernel), so
+            # avg=True matches
+            self._merge = GradientMergeOptimizer(
+                _InnerStep(self), k_steps=self._acc_steps, avg=True)
+
+    def step(self):
+        if self._merge is not None:
+            self._merge.step()
+        else:
+            super().step()
+
+
+class _InnerStep:
+    """Adapter handing GradientMergeOptimizer the un-merged Lamb step."""
+
+    def __init__(self, outer):
+        self._outer = outer
+        self._parameter_list = outer._parameter_list
+
+    def step(self):
+        Lamb.step(self._outer)
+
+    def clear_grad(self, set_to_zero=False):
+        Lamb.clear_grad(self._outer, set_to_zero)
+
+    def __getattr__(self, item):
+        return getattr(self._outer, item)

@@ -1,0 +1,4 @@
+from .bfgs import minimize_bfgs
+from .lbfgs import minimize_lbfgs
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]

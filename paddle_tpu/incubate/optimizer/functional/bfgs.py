@@ -1,0 +1,80 @@
+"""BFGS minimizer.
+
+Reference: python/paddle/incubate/optimizer/functional/bfgs.py:27 —
+minimize_bfgs(objective_func, initial_position, ...) returns
+(is_converge, num_func_calls, position, objective_value,
+objective_gradient, inverse_hessian_estimate).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....core.tensor import Tensor
+from ....ops._helpers import ensure_tensor
+from .line_search import strong_wolfe
+
+__all__ = ["minimize_bfgs"]
+
+
+def _wrap_objective(objective_func, dtype):
+    def f(x):
+        out = objective_func(Tensor._from_value(x))
+        return ensure_tensor(out)._value.astype(dtype).reshape(())
+
+    return jax.value_and_grad(f)
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError("only strong_wolfe line search is supported")
+    dt = jnp.dtype(dtype)
+    x = ensure_tensor(initial_position)._value.astype(dt).reshape(-1)
+    n = x.shape[0]
+    H = (jnp.eye(n, dtype=dt)
+         if initial_inverse_hessian_estimate is None
+         else ensure_tensor(initial_inverse_hessian_estimate)._value.astype(dt))
+    vg = jax.jit(_wrap_objective(objective_func, dt))
+    value, g = vg(x)
+    num_calls = 1
+    is_converge = False
+
+    for _ in range(int(max_iters)):
+        if float(jnp.max(jnp.abs(g))) < tolerance_grad:
+            is_converge = True
+            break
+        p = -H @ g
+
+        def f_dir(a, x=x, p=p):
+            v, grad = vg(x + a * p)
+            return float(v), float(grad @ p)
+
+        alpha, _, _, evals = strong_wolfe(f_dir, a1=initial_step_length,
+                                          max_iters=max_line_search_iters)
+        num_calls += evals
+        s = alpha * p
+        x_new = x + s
+        value_new, g_new = vg(x_new)
+        num_calls += 1
+        y = g_new - g
+        sy = float(s @ y)
+        if sy > 1e-10:
+            rho = 1.0 / sy
+            I = jnp.eye(n, dtype=dt)
+            V = I - rho * jnp.outer(s, y)
+            H = V @ H @ V.T + rho * jnp.outer(s, s)
+        if float(jnp.max(jnp.abs(s))) < tolerance_change:
+            x, value, g = x_new, value_new, g_new
+            is_converge = True
+            break
+        x, value, g = x_new, value_new, g_new
+
+    return (Tensor._from_value(jnp.asarray(is_converge)),
+            Tensor._from_value(jnp.asarray(num_calls, dtype=jnp.int64)),
+            Tensor._from_value(x), Tensor._from_value(value),
+            Tensor._from_value(g), Tensor._from_value(H))

@@ -1,0 +1,85 @@
+"""L-BFGS minimizer.
+
+Reference: python/paddle/incubate/optimizer/functional/lbfgs.py —
+minimize_lbfgs(objective_func, initial_position, history_size=100, ...)
+returns (is_converge, num_func_calls, position, objective_value,
+objective_gradient) using the two-loop recursion over the last m (s, y)
+pairs instead of a dense inverse Hessian.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....ops._helpers import ensure_tensor
+from .bfgs import _wrap_objective
+from .line_search import strong_wolfe
+
+__all__ = ["minimize_lbfgs"]
+
+
+def _two_loop(g, hist, gamma):
+    q = g
+    alphas = []
+    for s, y, rho in reversed(hist):
+        a = rho * (s @ q)
+        alphas.append(a)
+        q = q - a * y
+    r = gamma * q
+    for (s, y, rho), a in zip(hist, reversed(alphas)):
+        b = rho * (y @ r)
+        r = r + s * (a - b)
+    return r
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-8, tolerance_change=1e-8,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError("only strong_wolfe line search is supported")
+    dt = jnp.dtype(dtype)
+    x = ensure_tensor(initial_position)._value.astype(dt).reshape(-1)
+    vg = jax.jit(_wrap_objective(objective_func, dt))
+    value, g = vg(x)
+    num_calls = 1
+    is_converge = False
+    hist = []  # (s, y, rho)
+    gamma = jnp.asarray(1.0, dtype=dt)
+
+    for _ in range(int(max_iters)):
+        if float(jnp.max(jnp.abs(g))) < tolerance_grad:
+            is_converge = True
+            break
+        p = -_two_loop(g, hist, gamma)
+
+        def f_dir(a, x=x, p=p):
+            v, grad = vg(x + a * p)
+            return float(v), float(grad @ p)
+
+        alpha, _, _, evals = strong_wolfe(f_dir, a1=initial_step_length,
+                                          max_iters=max_line_search_iters)
+        num_calls += evals
+        s = alpha * p
+        x_new = x + s
+        value_new, g_new = vg(x_new)
+        num_calls += 1
+        y = g_new - g
+        sy = float(s @ y)
+        if sy > 1e-10:
+            hist.append((s, y, 1.0 / sy))
+            if len(hist) > history_size:
+                hist.pop(0)
+            gamma = jnp.asarray(sy / float(y @ y), dtype=dt)
+        if float(jnp.max(jnp.abs(s))) < tolerance_change:
+            x, value, g = x_new, value_new, g_new
+            is_converge = True
+            break
+        x, value, g = x_new, value_new, g_new
+
+    return (Tensor._from_value(jnp.asarray(is_converge)),
+            Tensor._from_value(jnp.asarray(num_calls, dtype=jnp.int64)),
+            Tensor._from_value(x), Tensor._from_value(value),
+            Tensor._from_value(g))

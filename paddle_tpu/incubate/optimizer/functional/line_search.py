@@ -1,0 +1,81 @@
+"""Strong-Wolfe line search.
+
+Reference: python/paddle/incubate/optimizer/functional/line_search.py
+(strong_wolfe with cubic interpolation zoom). Operates on jnp scalars; the
+objective is a jax value_and_grad closure, so the whole search stays on
+device when called under jit, and is a plain Python loop otherwise.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["strong_wolfe"]
+
+
+def _cubic_interp(x1, f1, g1, x2, f2, g2):
+    """Minimizer of the cubic through (x1,f1,g1), (x2,f2,g2)."""
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2 + 1e-20)
+    d2_sq = d1 * d1 - g1 * g2
+    d2 = jnp.sqrt(jnp.maximum(d2_sq, 0.0))
+    t = x2 - (x2 - x1) * (g2 + d2 - d1) / (g2 - g1 + 2 * d2 + 1e-20)
+    lo, hi = jnp.minimum(x1, x2), jnp.maximum(x1, x2)
+    return jnp.clip(jnp.where(jnp.isfinite(t), t, (x1 + x2) / 2), lo, hi)
+
+
+def strong_wolfe(f_dir, a1=1.0, c1=1e-4, c2=0.9, max_iters=50):
+    """Find a s.t. phi(a) satisfies the strong Wolfe conditions.
+
+    f_dir(a) -> (phi(a), phi'(a)) along the search direction. Returns
+    (alpha, phi(alpha), phi'(alpha), n_evals).
+    """
+    phi0, dphi0 = f_dir(0.0)
+    n_evals = [1]
+
+    def ev(a):
+        n_evals[0] += 1
+        return f_dir(a)
+
+    a_prev, phi_prev, dphi_prev = 0.0, phi0, dphi0
+    a = float(a1)
+    result = None
+    for _ in range(max_iters):
+        phi_a, dphi_a = ev(a)
+        if (phi_a > phi0 + c1 * a * dphi0) or (
+            result is None and phi_a >= phi_prev and _ > 0
+        ):
+            result = _zoom(ev, a_prev, phi_prev, dphi_prev, a, phi_a, dphi_a,
+                           phi0, dphi0, c1, c2, max_iters)
+            break
+        if abs(float(dphi_a)) <= -c2 * float(dphi0):
+            result = (a, phi_a, dphi_a)
+            break
+        if float(dphi_a) >= 0:
+            result = _zoom(ev, a, phi_a, dphi_a, a_prev, phi_prev, dphi_prev,
+                           phi0, dphi0, c1, c2, max_iters)
+            break
+        a_prev, phi_prev, dphi_prev = a, phi_a, dphi_a
+        a = a * 2.0
+    if result is None:
+        result = (a, phi_a, dphi_a)
+    alpha, phi_alpha, dphi_alpha = result
+    return alpha, phi_alpha, dphi_alpha, n_evals[0]
+
+
+def _zoom(ev, a_lo, phi_lo, dphi_lo, a_hi, phi_hi, dphi_hi, phi0, dphi0,
+          c1, c2, max_iters):
+    for _ in range(max_iters):
+        a = float(_cubic_interp(a_lo, phi_lo, dphi_lo, a_hi, phi_hi, dphi_hi))
+        if not (min(a_lo, a_hi) < a < max(a_lo, a_hi)):
+            a = (a_lo + a_hi) / 2.0
+        phi_a, dphi_a = ev(a)
+        if (phi_a > phi0 + c1 * a * dphi0) or (phi_a >= phi_lo):
+            a_hi, phi_hi, dphi_hi = a, phi_a, dphi_a
+        else:
+            if abs(float(dphi_a)) <= -c2 * float(dphi0):
+                return a, phi_a, dphi_a
+            if float(dphi_a) * (a_hi - a_lo) >= 0:
+                a_hi, phi_hi, dphi_hi = a_lo, phi_lo, dphi_lo
+            a_lo, phi_lo, dphi_lo = a, phi_a, dphi_a
+        if abs(a_hi - a_lo) < 1e-12:
+            break
+    return a_lo, phi_lo, dphi_lo

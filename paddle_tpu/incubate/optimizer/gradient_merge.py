@@ -1,0 +1,65 @@
+"""Gradient-merge (micro-batch gradient accumulation) optimizer.
+
+Reference: python/paddle/incubate/optimizer/gradient_merge.py — accumulate
+gradients for k_steps batches, apply the inner optimizer once per window
+(avg=True divides by k). The reference rewrites the static program; the TPU
+build wraps the dygraph optimizer: step() buffers grads and triggers the
+inner update every k-th call.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...autograd import no_grad
+
+__all__ = ["GradientMergeOptimizer"]
+
+
+class GradientMergeOptimizer:
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        assert k_steps >= 1
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = k_steps
+        self.avg = avg
+        self._parameter_list = inner_optimizer._parameter_list
+        self._acc = {}
+        self._step_in_window = 0
+
+    @no_grad()
+    def step(self):
+        self._step_in_window += 1
+        for p in self._parameter_list:
+            if not getattr(p, "trainable", True) or p._grad_value is None:
+                continue
+            buf = self._acc.get(id(p))
+            g = p._grad_value.astype(jnp.float32)
+            self._acc[id(p)] = g if buf is None else buf + g
+        if self._step_in_window < self.k_steps:
+            # window still open: clear this micro-batch's grads, no update
+            for p in self._parameter_list:
+                p.clear_grad()
+            return
+        # window complete: install merged grads and run the inner update
+        for p in self._parameter_list:
+            buf = self._acc.get(id(p))
+            if buf is None:
+                continue
+            if self.avg:
+                buf = buf / self.k_steps
+            p._grad_value = buf.astype(p._value.dtype)
+        self.inner_optimizer.step()
+        self._acc.clear()
+        self._step_in_window = 0
+
+    @no_grad()
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)

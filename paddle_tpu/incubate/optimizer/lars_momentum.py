@@ -1,0 +1,58 @@
+"""LARS momentum optimizer.
+
+Reference: python/paddle/incubate/optimizer/lars_momentum.py:94 —
+local_lr = lr * lars_coeff * ||param|| / (||grad|| + wd * ||param|| + eps);
+velocity = mu * velocity + local_lr * (grad + wd * param);
+param -= velocity. Layers named in exclude_from_weight_decay skip the decay
+term (and then local_lr uses ||grad|| only).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LarsMomentumOptimizer"]
+
+
+class LarsMomentumOptimizer(Optimizer):
+    _accum_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, regularization=None,
+                 grad_clip=None, name=None, exclude_from_weight_decay=None,
+                 epsilon=0, multi_precision=False, rescale_grad=1.0):
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         weight_decay=regularization, grad_clip=grad_clip,
+                         multi_precision=multi_precision)
+        self._momentum = float(momentum)
+        self._lars_coeff = float(lars_coeff)
+        self._lars_weight_decay = float(lars_weight_decay)
+        self._epsilon = float(epsilon)
+        self._exclude = list(exclude_from_weight_decay or [])
+        self._rescale_grad = float(rescale_grad)
+
+    def _update_param(self, p, grad, lr):
+        master = self._master(p)
+        pv = (master if master is not None else p._value).astype(jnp.float32)
+        g = grad.astype(jnp.float32) * self._rescale_grad
+        wd = self._lars_weight_decay
+        pname = getattr(p, "name", None) or ""
+        if any(tag in pname for tag in self._exclude):
+            wd = 0.0
+        p_norm = jnp.sqrt(jnp.sum(pv * pv))
+        g_norm = jnp.sqrt(jnp.sum(g * g))
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * p_norm
+            / (g_norm + wd * p_norm + self._epsilon),
+            jnp.float32(lr),
+        )
+        v = self._accum("velocity", p)
+        v = self._momentum * v + local_lr * (g + wd * pv)
+        self._set_accum("velocity", p, v)
+        new = pv - v
+        if master is not None:
+            self._apply(p, None, new)
+        else:
+            self._apply(p, new.astype(p._value.dtype))

@@ -1,0 +1,184 @@
+"""L-BFGS dygraph optimizer with closure-based step().
+
+Reference: python/paddle/incubate/optimizer/lbfgs.py (and
+paddle/optimizer/lbfgs.py) — torch-style API: opt.step(closure) where the
+closure re-evaluates the loss (with backward) and returns it; the optimizer
+flattens all parameter grads into one vector, runs two-loop-recursion
+L-BFGS with optional strong-Wolfe line search, and writes updates back.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd import no_grad
+from ...core.tensor import Tensor
+
+__all__ = ["LBFGS"]
+
+
+class LBFGS:
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided")
+        if max_eval is None:
+            max_eval = max_iter * 5 // 4
+        self._parameter_list = [p for p in parameters
+                                if getattr(p, "trainable", True)]
+        self.lr = learning_rate
+        self.max_iter = max_iter
+        self.max_eval = max_eval
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._hist = []  # (s, y, rho)
+        self._prev_flat_grad = None
+        self._func_evals = 0
+
+    # -- flat-vector helpers ------------------------------------------------
+    def _gather_flat_grad(self):
+        views = []
+        for p in self._parameter_list:
+            g = p._grad_value
+            views.append(
+                jnp.zeros(int(np.prod(p.shape)), dtype=jnp.float32)
+                if g is None else g.astype(jnp.float32).reshape(-1)
+            )
+        return jnp.concatenate(views)
+
+    def _add_to_params(self, step_size, direction):
+        offset = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p.shape))
+            upd = direction[offset:offset + n].reshape(p._value.shape)
+            p._replace_value(
+                (p._value.astype(jnp.float32) + step_size * upd).astype(
+                    p._value.dtype)
+            )
+            offset += n
+
+    def _clone_params(self):
+        return [p._value for p in self._parameter_list]
+
+    def _set_params(self, values):
+        for p, v in zip(self._parameter_list, values):
+            p._replace_value(v)
+
+    # -----------------------------------------------------------------------
+    def step(self, closure):
+        """closure() must zero grads, compute loss, call backward, and
+        return the loss tensor."""
+        with no_grad():
+            return self._step_impl(closure)
+
+    def _step_impl(self, closure):
+        from ... import autograd
+
+        def eval_closure():
+            with autograd.enable_grad():
+                loss = closure()
+            self._func_evals += 1
+            return loss
+
+        loss = eval_closure()
+        orig_loss = loss
+        flat_grad = self._gather_flat_grad()
+        if float(jnp.max(jnp.abs(flat_grad))) <= self.tolerance_grad:
+            return orig_loss
+
+        n_iter = 0
+        while n_iter < self.max_iter:
+            n_iter += 1
+            # direction via two-loop recursion
+            if not self._hist:
+                d = -flat_grad
+                gamma = 1.0
+            else:
+                q = flat_grad
+                alphas = []
+                for s, y, rho in reversed(self._hist):
+                    a = rho * float(s @ q)
+                    alphas.append(a)
+                    q = q - a * y
+                s_l, y_l, _ = self._hist[-1]
+                gamma = float(s_l @ y_l) / max(float(y_l @ y_l), 1e-20)
+                r = gamma * q
+                for (s, y, rho), a in zip(self._hist, reversed(alphas)):
+                    b = rho * float(y @ r)
+                    r = r + s * (a - b)
+                d = -r
+            prev_grad = flat_grad
+            prev_loss = float(loss.numpy()) if isinstance(loss, Tensor) else float(loss)
+
+            t = self.lr if (self._hist or n_iter > 1) else (
+                min(1.0, 1.0 / max(float(jnp.abs(flat_grad).sum()), 1e-20))
+                * self.lr
+            )
+
+            if self.line_search_fn is not None:
+                if self.line_search_fn != "strong_wolfe":
+                    raise NotImplementedError(
+                        "only strong_wolfe line search is supported")
+                saved = self._clone_params()
+
+                def f_dir(a):
+                    self._set_params(saved)
+                    self._add_to_params(a, d)
+                    l = eval_closure()
+                    g = self._gather_flat_grad()
+                    return (float(l.numpy()) if isinstance(l, Tensor)
+                            else float(l)), float(g @ d)
+
+                from .functional.line_search import strong_wolfe
+
+                t, _, _, _ = strong_wolfe(f_dir, a1=t)
+                self._set_params(saved)
+                self._add_to_params(t, d)
+                loss = eval_closure()
+                flat_grad = self._gather_flat_grad()
+            else:
+                self._add_to_params(t, d)
+                loss = eval_closure()
+                flat_grad = self._gather_flat_grad()
+
+            # curvature update
+            s = t * d
+            y = flat_grad - prev_grad
+            sy = float(s @ y)
+            if sy > 1e-10:
+                self._hist.append((s, y, 1.0 / sy))
+                if len(self._hist) > self.history_size:
+                    self._hist.pop(0)
+
+            if self._func_evals >= self.max_eval:
+                break
+            if float(jnp.max(jnp.abs(flat_grad))) <= self.tolerance_grad:
+                break
+            if float(jnp.max(jnp.abs(s))) <= self.tolerance_change:
+                break
+            new_loss = float(loss.numpy()) if isinstance(loss, Tensor) else float(loss)
+            if abs(new_loss - prev_loss) < self.tolerance_change:
+                break
+        return orig_loss
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return {
+            "hist": [(np.asarray(s), np.asarray(y), rho)
+                     for s, y, rho in self._hist],
+            "func_evals": self._func_evals,
+        }
+
+    def set_state_dict(self, state):
+        self._hist = [(jnp.asarray(s), jnp.asarray(y), rho)
+                      for s, y, rho in state.get("hist", [])]
+        self._func_evals = int(state.get("func_evals", 0))

@@ -1,0 +1,76 @@
+"""Lookahead optimizer (arXiv:1907.08610).
+
+Reference: python/paddle/incubate/optimizer/lookahead.py:27 — the inner
+optimizer updates fast params every step; every k steps
+slow = slow + alpha * (fast - slow); fast = slow.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...autograd import no_grad
+
+__all__ = ["LookAhead"]
+
+
+class LookAhead:
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        assert inner_optimizer is not None
+        assert 0.0 <= alpha <= 1.0
+        assert isinstance(k, int) and k > 0
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._parameter_list = inner_optimizer._parameter_list
+        self._global_step = 0
+        # slow params seeded from the params' values at wrap time (the
+        # reference seeds its slow accumulators from the initial params)
+        self._slow = {
+            id(p): p._value.astype(jnp.float32)
+            for p in self._parameter_list if getattr(p, "trainable", True)
+        }
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        self._global_step += 1
+        if self._global_step % self.k == 0:
+            self._lookahead()
+
+    def _lookahead(self):
+        for p in self._parameter_list:
+            if not getattr(p, "trainable", True):
+                continue
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = p._value.astype(jnp.float32)
+            fast = p._value.astype(jnp.float32)
+            slow = slow + self.alpha * (fast - slow)
+            self._slow[id(p)] = slow
+            p._replace_value(slow.astype(p._value.dtype))
+
+    @no_grad()
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["@lookahead_step"] = self._global_step
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.pop("@lookahead_step",
+                                               self._global_step))
+        self.inner_optimizer.set_state_dict(state_dict)
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)

@@ -1,0 +1,103 @@
+"""ModelAverage optimizer.
+
+Reference: python/paddle/incubate/optimizer/modelaverage.py:31 — sliding
+window average of parameters (sum_1/sum_2/sum_3 accumulator scheme), with
+apply()/restore() to swap averaged weights in for evaluation.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ...autograd import no_grad
+
+__all__ = ["ModelAverage"]
+
+
+class ModelAverage:
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided")
+        self._parameter_list = list(parameters)
+        self.avg_window_rate = average_window_rate
+        self.min_avg_window = min_average_window
+        self.max_avg_window = max_average_window
+        # per-param: sum_1 (current window), sum_2 (previous windows),
+        # sum_3 (rolled-up old windows) — the reference's 3-tier scheme
+        self._state = {
+            id(p): {
+                "sum_1": jnp.zeros_like(p._value, dtype=jnp.float32),
+                "sum_2": jnp.zeros_like(p._value, dtype=jnp.float32),
+                "sum_3": jnp.zeros_like(p._value, dtype=jnp.float32),
+                "num_accumulates": 0,
+                "old_num_accumulates": 0,
+                "num_updates": 0,
+            }
+            for p in self._parameter_list
+        }
+        self._backup = {}
+
+    # reference kernel rolls sum_1 into sum_2 every 16384 accumulates to
+    # bound float error (average_accumulates_kernel_impl.h kMaxNumAccumulates)
+    _MAX_NUM_ACCUMULATES = 16384
+
+    @no_grad()
+    def step(self):
+        """Accumulate the current parameter values into the window
+        (reference kernel: phi average_accumulates)."""
+        for p in self._parameter_list:
+            if not getattr(p, "trainable", True):
+                continue
+            st = self._state[id(p)]
+            st["num_updates"] += 1
+            st["num_accumulates"] += 1
+            st["sum_1"] = st["sum_1"] + p._value.astype(jnp.float32)
+            if st["num_updates"] % self._MAX_NUM_ACCUMULATES == 0:
+                st["sum_2"] = st["sum_2"] + st["sum_1"]
+                st["sum_1"] = jnp.zeros_like(st["sum_1"])
+            if st["num_accumulates"] >= self.min_avg_window and \
+               st["num_accumulates"] >= min(
+                   self.max_avg_window,
+                   st["num_updates"] * self.avg_window_rate):
+                # window too long: discard the old sum
+                st["sum_3"] = st["sum_1"] + st["sum_2"]
+                st["sum_1"] = jnp.zeros_like(st["sum_1"])
+                st["sum_2"] = jnp.zeros_like(st["sum_2"])
+                st["old_num_accumulates"] = st["num_accumulates"]
+                st["num_accumulates"] = 0
+
+    minimize = step
+
+    def _average(self, p):
+        st = self._state[id(p)]
+        total = st["num_accumulates"] + st["old_num_accumulates"]
+        if total == 0:
+            return p._value
+        s = st["sum_1"] + st["sum_2"] + st["sum_3"]
+        return (s / total).astype(p._value.dtype)
+
+    @no_grad()
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: parameters hold their window average inside."""
+        return self._apply_ctx(need_restore)
+
+    @contextlib.contextmanager
+    def _apply_ctx(self, need_restore):
+        for p in self._parameter_list:
+            self._backup[id(p)] = p._value
+            p._replace_value(self._average(p))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    @no_grad()
+    def restore(self, executor=None):
+        for p in self._parameter_list:
+            backup = self._backup.pop(id(p), None)
+            if backup is not None:
+                p._replace_value(backup)

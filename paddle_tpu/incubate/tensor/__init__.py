@@ -1,0 +1,10 @@
+"""paddle.incubate.tensor parity.
+
+Reference: python/paddle/incubate/tensor/__init__.py — re-exports the
+segment reduction ops (canonical implementations live in paddle_tpu.geometric).
+"""
+from ...geometric import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+
+__all__ = []

@@ -43,6 +43,11 @@ class LlamaConfig:
     use_flash_attention: bool = True
     tie_word_embeddings: bool = False
     recompute: bool = False  # activation checkpointing per decoder layer
+    # chunked fused lm_head+cross_entropy: never materializes the fp32
+    # [tokens, vocab] logits (the single biggest activation at bs*seq*32k —
+    # see incubate/nn/functional/fused_linear_ce.py). Only affects the
+    # labels-given training path; generation still returns full logits.
+    fused_lm_head_ce: bool = True
     dtype: str = "float32"
     # context parallelism: "ring" | "ulysses" | None. When set, attention
     # runs over the sequence sharded on cp_mesh_axis (fleet.context_parallel
@@ -217,6 +222,18 @@ class LlamaForCausalLM(nn.Layer):
     def forward(self, input_ids, position_ids=None, attention_mask=None,
                 labels=None):
         hidden_states = self.llama(input_ids, position_ids, attention_mask)
+        if labels is not None and self.config.fused_lm_head_ce:
+            from ..incubate.nn.functional.fused_linear_ce import (
+                fused_linear_cross_entropy,
+            )
+
+            loss = fused_linear_cross_entropy(
+                hidden_states.reshape([-1, self.config.hidden_size]),
+                self.lm_head.weight,
+                labels.reshape([-1]),
+                ignore_index=-100,
+            )
+            return loss, None
         logits = self.lm_head(hidden_states)
         if labels is not None:
             loss = F.cross_entropy(

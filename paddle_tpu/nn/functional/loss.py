@@ -111,6 +111,16 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             loss = m.multiply(loss, wsel.astype(loss.dtype))
             if reduction == "mean":
                 return m.divide(m.sum(loss), m.sum(wsel))
+        elif reduction == "mean":
+            # reference mean with ignore_index: sum(loss) / count(valid)
+            # (loss.py:3066 "denominator: count sample num with
+            # class_index != ignore_index")
+            from ...ops.comparison import not_equal
+
+            valid = not_equal(label, ignore_index)
+            denom = m.sum(valid.astype(loss.dtype))
+            denom = m.maximum(denom, ensure_tensor(1.0, dtype=loss.dtype))
+            return m.divide(m.sum(loss), denom)
     return _reduce_loss(loss, reduction)
 
 

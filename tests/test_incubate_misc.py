@@ -1,0 +1,361 @@
+"""incubate.optimizer / autograd / operators / layers / autotune tests.
+
+Reference models: test/legacy_test/test_lookahead.py, test_modelaverage.py,
+test_lbfgs*.py, test_bfgs.py, test_lars_momentum_op.py,
+test_softmax_mask_fuse_op.py, test_graph_send_recv_op.py,
+test/autograd/test_primapi.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import incubate
+
+
+def _r(*shape, scale=1.0):
+    return (np.random.randn(*shape) * scale).astype("float32")
+
+
+class TestLookAhead:
+    def test_slow_fast_update(self):
+        # loss = mean(Wx + b) has a constant gradient g = mean(x), so the
+        # lookahead trajectory is exactly computable:
+        # after 4 steps (k=2, alpha=0.5, lr=0.1): w = w0 - 0.2*g
+        paddle.seed(0)
+        lin = nn.Linear(4, 1)
+        w0 = lin.weight.numpy().copy()
+        sgd = opt.SGD(learning_rate=0.1, parameters=lin.parameters())
+        la = incubate.LookAhead(sgd, alpha=0.5, k=2)
+        x = _r(8, 4)
+
+        for step in range(4):
+            loss = lin(paddle.to_tensor(x)).mean()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+        g = x.mean(axis=0, keepdims=True).T
+        np.testing.assert_allclose(lin.weight.numpy(), w0 - 0.2 * g,
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_interp_matches_formula(self):
+        lin = nn.Linear(3, 1, bias_attr=False)
+        w0 = lin.weight.numpy().copy()
+        sgd = opt.SGD(learning_rate=0.0, parameters=lin.parameters())
+        la = incubate.LookAhead(sgd, alpha=0.25, k=1)
+        # zero lr: fast never moves; slow interp keeps params at w0
+        x = paddle.to_tensor(_r(4, 3))
+        lin(x).mean().backward()
+        la.step()
+        np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-6)
+
+    def test_state_dict_roundtrip(self):
+        lin = nn.Linear(3, 1)
+        la = incubate.LookAhead(
+            opt.SGD(learning_rate=0.1, parameters=lin.parameters()), k=3)
+        sd = la.state_dict()
+        la.set_state_dict(sd)
+        assert la._global_step == 0
+
+
+class TestModelAverage:
+    def test_apply_restore(self):
+        lin = nn.Linear(2, 1, bias_attr=False)
+        ma = incubate.ModelAverage(0.5, parameters=lin.parameters(),
+                                   min_average_window=2,
+                                   max_average_window=4)
+        vals = []
+        for v in [1.0, 2.0, 3.0]:
+            lin.weight.set_value(np.full((2, 1), v, dtype="float32"))
+            ma.step()
+            vals.append(v)
+        cur = lin.weight.numpy().copy()
+        with ma.apply():
+            avg = lin.weight.numpy()
+            # window scheme: sums of accumulated values / total count
+            assert avg.mean() == pytest.approx(2.0, rel=1e-5)
+        np.testing.assert_allclose(lin.weight.numpy(), cur)
+
+    def test_no_restore(self):
+        lin = nn.Linear(2, 1, bias_attr=False)
+        ma = incubate.ModelAverage(1.0, parameters=lin.parameters(),
+                                   min_average_window=1,
+                                   max_average_window=100)
+        lin.weight.set_value(np.full((2, 1), 4.0, dtype="float32"))
+        ma.step()
+        with ma.apply(need_restore=False):
+            pass
+        assert lin.weight.numpy().mean() == pytest.approx(4.0)
+
+
+class TestLBFGS:
+    def test_quadratic_converges(self):
+        # minimize ||Wx - b||^2 over W via closure API
+        target = _r(4, 1)
+        x = paddle.to_tensor(_r(16, 4))
+        y = paddle.to_tensor(np.asarray(x.numpy() @ target))
+        lin = nn.Linear(4, 1, bias_attr=False)
+        lbfgs = incubate.optimizer.LBFGS(
+            learning_rate=1.0, max_iter=30, history_size=10,
+            line_search_fn="strong_wolfe", parameters=lin.parameters())
+
+        def closure():
+            lbfgs.clear_grad()
+            loss = ((lin(x) - y) ** 2).mean()
+            loss.backward()
+            return loss
+
+        for _ in range(5):
+            lbfgs.step(closure)
+        np.testing.assert_allclose(lin.weight.numpy(), target, atol=1e-3)
+
+    def test_no_line_search(self):
+        lin = nn.Linear(2, 1, bias_attr=False)
+        x = paddle.to_tensor(_r(8, 2))
+        lbfgs = incubate.optimizer.LBFGS(learning_rate=0.5, max_iter=5,
+                                         parameters=lin.parameters())
+
+        def closure():
+            lbfgs.clear_grad()
+            loss = (lin(x) ** 2).mean()
+            loss.backward()
+            return loss
+
+        l0 = float(closure().numpy())
+        lbfgs.step(closure)
+        l1 = float(closure().numpy())
+        assert l1 < l0
+
+
+class TestFunctionalMinimize:
+    def test_bfgs_rosenbrock_ish(self):
+        def f(x):
+            return (x * x).sum() + (x[0] - 1.0) ** 2
+
+        x0 = paddle.to_tensor(np.array([3.0, -4.0], dtype="float32"))
+        ok, n_calls, xk, val, g, H = incubate.optimizer.functional.minimize_bfgs(
+            f, x0, max_iters=50)
+        assert bool(ok.numpy())
+        np.testing.assert_allclose(xk.numpy(), [0.5, 0.0], atol=1e-4)
+
+    def test_lbfgs_quadratic(self):
+        A = np.diag([1.0, 10.0, 100.0]).astype("float32")
+
+        def f(x):
+            return (x * paddle.to_tensor(A) @ x).sum()
+
+        x0 = paddle.to_tensor(np.array([1.0, 1.0, 1.0], dtype="float32"))
+        ok, n_calls, xk, val, g = incubate.optimizer.functional.minimize_lbfgs(
+            f, x0, max_iters=100)
+        np.testing.assert_allclose(xk.numpy(), np.zeros(3), atol=1e-4)
+
+
+class TestGradientMerge:
+    def test_equivalent_to_large_batch(self):
+        paddle.seed(3)
+        x = _r(8, 4)
+        y = _r(8, 1)
+
+        def make():
+            paddle.seed(5)
+            return nn.Linear(4, 1)
+
+        # merged: two half-batches
+        lin_a = make()
+        gm = incubate.optimizer.GradientMergeOptimizer(
+            opt.SGD(learning_rate=0.1, parameters=lin_a.parameters()),
+            k_steps=2, avg=True)
+        for sl in (slice(0, 4), slice(4, 8)):
+            loss = ((lin_a(paddle.to_tensor(x[sl])) -
+                     paddle.to_tensor(y[sl])) ** 2).mean()
+            loss.backward()
+            gm.step()
+        # reference: one full batch (same average gradient)
+        lin_b = make()
+        sgd = opt.SGD(learning_rate=0.1, parameters=lin_b.parameters())
+        loss = ((lin_b(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        sgd.step()
+        np.testing.assert_allclose(lin_a.weight.numpy(), lin_b.weight.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestLarsMomentum:
+    def test_update_formula(self):
+        lin = nn.Linear(4, 4, bias_attr=False)
+        w0 = lin.weight.numpy().copy()
+        lars = incubate.optimizer.LarsMomentumOptimizer(
+            learning_rate=0.1, momentum=0.9, lars_coeff=0.001,
+            lars_weight_decay=0.0005, parameters=lin.parameters())
+        x = paddle.to_tensor(_r(8, 4))
+        lin(x).sum().backward()
+        g = lin.weight.grad.numpy()
+        lars.step()
+        p_norm = np.sqrt((w0 ** 2).sum())
+        g_norm = np.sqrt((g ** 2).sum())
+        local_lr = 0.1 * 0.001 * p_norm / (g_norm + 0.0005 * p_norm)
+        v = local_lr * (g + 0.0005 * w0)
+        np.testing.assert_allclose(lin.weight.numpy(), w0 - v, rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_distributed_fused_lamb_runs(self):
+        lin = nn.Linear(4, 2)
+        lamb = incubate.optimizer.DistributedFusedLamb(
+            learning_rate=0.01, parameters=lin.parameters(),
+            gradient_accumulation_steps=2)
+        x = paddle.to_tensor(_r(4, 4))
+        w0 = lin.weight.numpy().copy()
+        lin(x).mean().backward()
+        lamb.step()  # first micro-batch: no update yet
+        np.testing.assert_allclose(lin.weight.numpy(), w0)
+        lin(x).mean().backward()
+        lamb.step()
+        assert not np.allclose(lin.weight.numpy(), w0)
+
+
+class TestIncubateAutograd:
+    def test_vjp(self):
+        iag = incubate.autograd
+
+        def f(x):
+            return (x * x).sum()
+
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], dtype="float32"))
+        out, g = iag.vjp(f, x)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0, 6.0], rtol=1e-6)
+
+    def test_jvp(self):
+        iag = incubate.autograd
+
+        def f(x):
+            return x * x
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))
+        v = paddle.to_tensor(np.array([1.0, 0.0], dtype="float32"))
+        out, t = iag.jvp(f, x, v)
+        np.testing.assert_allclose(t.numpy(), [2.0, 0.0], rtol=1e-6)
+
+    def test_jacobian_lazy(self):
+        iag = incubate.autograd
+
+        def f(x):
+            return paddle.to_tensor(
+                np.array([[1.0, 2.0], [3.0, 4.0]], dtype="float32")) @ x
+
+        x = paddle.to_tensor(np.array([1.0, 1.0], dtype="float32"))
+        J = iag.Jacobian(f, x)
+        np.testing.assert_allclose(np.asarray(J.numpy()),
+                                   [[1.0, 2.0], [3.0, 4.0]], rtol=1e-6)
+        np.testing.assert_allclose(J[0, 1].numpy(), 2.0)
+
+    def test_hessian(self):
+        iag = incubate.autograd
+
+        def f(x):
+            return (x * x).sum()
+
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], dtype="float32"))
+        H = iag.Hessian(f, x)
+        np.testing.assert_allclose(np.asarray(H.numpy()), 2 * np.eye(3),
+                                   rtol=1e-6)
+
+    def test_prim_switches(self):
+        iag = incubate.autograd
+
+        iag.enable_prim()
+        assert iag.prim_enabled()
+        iag.disable_prim()
+        assert not iag.prim_enabled()
+
+
+class TestIncubateOperators:
+    def test_softmax_mask_fuse(self):
+        x = _r(2, 2, 3, 4)
+        mask = np.zeros((2, 1, 3, 4), dtype="float32")
+        mask[..., -1] = -1e9
+        got = incubate.operators.softmax_mask_fuse(
+            paddle.to_tensor(x), paddle.to_tensor(mask))
+        e = np.exp((x + mask) - (x + mask).max(-1, keepdims=True))
+        want = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-6)
+        assert got.numpy()[..., -1].max() < 1e-6
+
+    def test_softmax_mask_fuse_upper_triangle(self):
+        x = _r(1, 1, 4, 4)
+        got = incubate.operators.softmax_mask_fuse_upper_triangle(
+            paddle.to_tensor(x))
+        out = got.numpy()[0, 0]
+        assert out[0, 1] < 1e-6 and out[0, 0] == pytest.approx(1.0)
+        np.testing.assert_allclose(out.sum(-1), np.ones(4), rtol=1e-5)
+
+    def test_graph_send_recv(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], dtype="float32"))
+        src = paddle.to_tensor(np.array([0, 1, 2], dtype="int64"))
+        dst = paddle.to_tensor(np.array([1, 2, 1], dtype="int64"))
+        out = incubate.operators.graph_send_recv(x, src, dst, "sum")
+        np.testing.assert_allclose(out.numpy(), [[0.0], [4.0], [2.0]])
+
+    def test_resnet_unit(self):
+        unit = incubate.operators.ResNetUnit(
+            num_channels_x=3, num_filters=8, filter_size=3,
+            data_format="NCHW", has_shortcut=True, num_channels_z=3)
+        unit.eval()
+        x = paddle.to_tensor(_r(2, 3, 8, 8))
+        out = unit(x, x)
+        assert out.shape == [2, 8, 8, 8]
+        assert float(out.numpy().min()) >= 0.0  # relu output
+
+
+class TestIncubateLayers:
+    def test_shuffle_batch(self):
+        x = np.arange(12, dtype="float32").reshape(6, 2)
+        got = incubate.layers.shuffle_batch(paddle.to_tensor(x), seed=0)
+        assert sorted(got.numpy()[:, 0].tolist()) == x[:, 0].tolist()
+
+    def test_partial_concat_sum(self):
+        a = np.arange(8, dtype="float32").reshape(2, 4)
+        b = np.arange(8, 16, dtype="float32").reshape(2, 4)
+        got = incubate.layers.partial_concat(
+            [paddle.to_tensor(a), paddle.to_tensor(b)], start_index=1,
+            length=2)
+        np.testing.assert_allclose(
+            got.numpy(), np.concatenate([a[:, 1:3], b[:, 1:3]], axis=1))
+        s = incubate.layers.partial_sum(
+            [paddle.to_tensor(a), paddle.to_tensor(b)], start_index=0,
+            length=3)
+        np.testing.assert_allclose(s.numpy(), a[:, :3] + b[:, :3])
+
+    def test_batch_fc(self):
+        x = _r(2, 3, 4)
+        out = incubate.layers.batch_fc(paddle.to_tensor(x),
+                                       param_size=[2, 4, 5], param_attr=None,
+                                       bias_size=[2, 3, 5], bias_attr=None)
+        assert out.shape == [2, 3, 5]
+
+
+class TestAutotuneAndTensor:
+    def test_set_config(self):
+        incubate.set_config({"kernel": {"enable": True,
+                                        "tuning_range": [1, 5]}})
+        assert paddle.get_flags("use_autotune")["FLAGS_use_autotune"]
+        with pytest.raises(ValueError):
+            incubate.set_config({"bogus": {}})
+
+    def test_incubate_tensor_segment(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], dtype="float32"))
+        ids = paddle.to_tensor(np.array([0, 0, 1], dtype="int64"))
+        out = incubate.tensor.segment_sum(x, ids)
+        np.testing.assert_allclose(out.numpy(), [[3.0], [3.0]])
+
+    def test_multiprocessing_pickle(self):
+        import pickle
+        from multiprocessing.reduction import ForkingPickler
+        import io
+
+        incubate.multiprocessing.init_reductions()
+        t = paddle.to_tensor(np.arange(4, dtype="float32"))
+        buf = io.BytesIO()
+        ForkingPickler(buf).dump(t)
+        back = pickle.loads(buf.getvalue())
+        np.testing.assert_allclose(back.numpy(), t.numpy())

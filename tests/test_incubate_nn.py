@@ -443,3 +443,210 @@ class TestMaskedMHANoSeqLens:
                                mask)[:, :, 0, :]
         np.testing.assert_allclose(out.numpy(), want.reshape(b, h * d),
                                    rtol=2e-5, atol=2e-5)
+
+
+def _rope_tables_ref(s, d, b=1):
+    """[2, B, S, 1, D] neox-layout cos/sin tables (mmha kernel layout)."""
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2, dtype="float32") / d))
+    freqs = np.outer(np.arange(s, dtype="float32"), inv)
+    emb = np.concatenate([freqs, freqs], axis=-1)  # neox half-split layout
+    cos = np.cos(emb)[None, :, None, :]
+    sin = np.sin(emb)[None, :, None, :]
+    cos = np.repeat(cos, b, axis=0)
+    sin = np.repeat(sin, b, axis=0)
+    return np.stack([cos, sin], axis=0).astype("float32")
+
+
+def _apply_rope_ref(x, cos, sin):
+    # x [.., D] neox style
+    d = x.shape[-1]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    rot = np.concatenate([-x2, x1], axis=-1)
+    return x * cos + rot * sin
+
+
+class TestRopePaths:
+    def test_masked_mha_rotary(self):
+        b, h, d, s_max = 1, 2, 8, 8
+        t = 2
+        np.random.seed(3)
+        cache = np.zeros((2, b, h, s_max, d), dtype="float32")
+        cache[:, :, :, :t, :] = _r(2, b, h, t, d)
+        x = _r(b, 3 * h * d)
+        rope = _rope_tables_ref(s_max, d, b)
+        seq = np.full((b, 1), t, dtype="int32")
+        out, cache_out = F.masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(seq),
+            rotary_tensor=paddle.to_tensor(rope), rotary_emb_dims=1,
+            use_neox_rotary_style=True)
+        qkv = x.reshape(b, 3, h, d)
+        cos_t, sin_t = rope[0, :, t, 0], rope[1, :, t, 0]  # [B, D]
+        k_rot = _apply_rope_ref(qkv[:, 1], cos_t[:, None, :], sin_t[:, None, :])
+        np.testing.assert_allclose(
+            np.asarray(cache_out.numpy())[0][:, :, t, :], k_rot,
+            rtol=1e-5, atol=1e-5)
+
+    def test_block_mha_rope_prefill(self):
+        h, kvh, d, bs, bps = 2, 2, 8, 4, 2
+        n = 3
+        np.random.seed(4)
+        n_blocks = bps + 1
+        kc = np.zeros((n_blocks, kvh, bs, d), dtype="float32")
+        vc = np.zeros_like(kc)
+        bt = np.arange(bps, dtype="int32").reshape(1, bps)
+        qkv = _r(n, (h + 2 * kvh) * d)
+        cu = np.array([0, n], dtype="int32")
+        rope = _rope_tables_ref(bps * bs, d, 1)
+        out, _, kc_out, _ = F.block_multihead_attention(
+            paddle.to_tensor(qkv), paddle.to_tensor(kc), paddle.to_tensor(vc),
+            paddle.to_tensor(np.array([n], dtype="int32")),
+            paddle.to_tensor(np.array([0], dtype="int32")),
+            paddle.to_tensor(np.array([n], dtype="int32")), None, None,
+            paddle.to_tensor(cu), paddle.to_tensor(cu), paddle.to_tensor(bt),
+            rope_emb=paddle.to_tensor(rope), block_size=bs,
+            use_neox_style=True)
+        # cached K at position p must be rope(K_p, pos=p)
+        k_raw = qkv[:, h * d:(h + kvh) * d].reshape(n, kvh, d)
+        for p in range(n):
+            cos_p = rope[0, 0, p, 0]
+            sin_p = rope[1, 0, p, 0]
+            want = _apply_rope_ref(k_raw[p], cos_p[None, :], sin_p[None, :])
+            got = kc_out.numpy()[bt[0][p // bs], :, p % bs, :]
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_fused_mha_rotary_embs(self):
+        b, s, e, nh = 1, 4, 16, 2
+        mha = inn.FusedMultiHeadAttention(e, nh, dropout_rate=0.0,
+                                          attn_dropout_rate=0.0)
+        mha.eval()
+        rope = _rope_tables_ref(s, e // nh, b)
+        out = mha(paddle.to_tensor(_r(b, s, e)))
+        out_r = inn.functional.fused_multi_head_attention(
+            paddle.to_tensor(_r(b, s, e)), mha.qkv_weight, mha.linear_weight,
+            qkv_bias=mha.qkv_bias, linear_bias=mha.linear_bias,
+            ln_scale=mha.ln_scale, ln_bias=mha.ln_bias, dropout_rate=0.0,
+            attn_dropout_rate=0.0, training=False,
+            rotary_embs=paddle.to_tensor(rope))
+        assert out_r.shape == [b, s, e]
+        assert np.isfinite(out_r.numpy()).all()
+
+    def test_multi_transformer_rejects_caches(self):
+        mt = inn.FusedMultiTransformer(16, 2, 32, num_layers=1)
+        with pytest.raises(NotImplementedError):
+            mt(paddle.to_tensor(_r(1, 2, 16)), caches=[paddle.to_tensor(_r(1))])
+
+    def test_block_diag_causal_top_left(self):
+        from paddle_tpu.incubate.nn.attn_bias import BlockDiagonalMask
+
+        m = BlockDiagonalMask.from_seqlens([2], [5]).make_causal()
+        mat = m.materialize((2, 5)).numpy()
+        # top-left aligned: row 0 sees only key 0
+        assert np.isfinite(mat[0, 0]) and (mat[0, 1:] == -np.inf).all()
+        assert np.isfinite(mat[1, :2]).all() and (mat[1, 2:] == -np.inf).all()
+
+
+class TestFusedLinearCrossEntropy:
+    def test_matches_composed_path_and_torch(self):
+        from paddle_tpu.incubate.nn.functional import fused_linear_cross_entropy
+
+        np.random.seed(0)
+        T, H, V = 70, 16, 50  # non-multiple of chunk exercises padding
+        h = np.random.randn(T, H).astype("float32")
+        w = np.random.randn(H, V).astype("float32") * 0.1
+        lab = np.random.randint(0, V, T).astype("int64")
+        lab[5] = -100
+        ht = paddle.to_tensor(h, stop_gradient=False)
+        wt = paddle.to_tensor(w, stop_gradient=False)
+        loss = fused_linear_cross_entropy(ht, wt, paddle.to_tensor(lab),
+                                          chunk_size=16)
+        loss.backward()
+
+        import paddle_tpu.nn.functional as PF
+
+        ht2 = paddle.to_tensor(h, stop_gradient=False)
+        wt2 = paddle.to_tensor(w, stop_gradient=False)
+        ref = PF.cross_entropy(paddle.matmul(ht2, wt2), paddle.to_tensor(lab),
+                               ignore_index=-100)
+        ref.backward()
+        np.testing.assert_allclose(float(loss.numpy()), float(ref.numpy()),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(ht.grad.numpy(), ht2.grad.numpy(),
+                                   rtol=1e-3, atol=1e-6)
+        np.testing.assert_allclose(wt.grad.numpy(), wt2.grad.numpy(),
+                                   rtol=1e-3, atol=1e-6)
+
+        import torch
+
+        tl = torch.nn.functional.cross_entropy(
+            torch.tensor(h @ w), torch.tensor(lab), ignore_index=-100)
+        np.testing.assert_allclose(float(loss.numpy()), float(tl), rtol=1e-4)
+
+    def test_ce_ignore_index_mean_divides_by_valid(self):
+        # regression: mean with ignore_index divides by the VALID count
+        # (reference loss.py:3066), not the total element count
+        import paddle_tpu.nn.functional as PF
+
+        logits = np.random.randn(4, 7).astype("float32")
+        lab = np.array([1, -100, 3, -100], dtype="int64")
+        got = PF.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(lab), ignore_index=-100)
+        import torch
+
+        want = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(lab), ignore_index=-100)
+        np.testing.assert_allclose(float(got.numpy()), float(want), rtol=1e-5)
+
+    def test_llama_fused_lm_head_matches_unfused(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        np.random.seed(0)
+        paddle.seed(0)
+        cfgA = LlamaConfig.tiny()
+        cfgA.fused_lm_head_ce = True
+        mA = LlamaForCausalLM(cfgA)
+        paddle.seed(0)
+        cfgB = LlamaConfig.tiny()
+        cfgB.fused_lm_head_ce = False
+        mB = LlamaForCausalLM(cfgB)
+        ids = np.random.randint(0, cfgA.vocab_size, (2, 32)).astype("int64")
+        labs = np.roll(ids, -1, 1)
+        lA, _ = mA(paddle.to_tensor(ids), labels=paddle.to_tensor(labs))
+        lB, _ = mB(paddle.to_tensor(ids), labels=paddle.to_tensor(labs))
+        np.testing.assert_allclose(float(lA.numpy()), float(lB.numpy()),
+                                   rtol=1e-4)
+
+
+class TestReviewFixesRound3:
+    def test_varlen_causal_composes_with_mask(self):
+        b, h, s, d = 1, 2, 4, 8
+        q, k, v = _r(b, h, s, d), _r(b, h, s, d), _r(b, h, s, d)
+        pad_mask = np.zeros((b, 1, 1, s), dtype="float32")
+        got = F.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(np.array([s], dtype="int32")),
+            paddle.to_tensor(np.array([s], dtype="int32")),
+            mask=paddle.to_tensor(pad_mask), causal=True)
+        tri = np.where(np.arange(s)[:, None] >= np.arange(s)[None, :],
+                       0.0, -1e9)[None, None]
+        want = dense_attention(q, k, v, tri)
+        np.testing.assert_allclose(got.numpy(), want, rtol=2e-5, atol=2e-5)
+
+    def test_fused_sdpa_scaling_factor(self):
+        b, s, h, d = 1, 3, 2, 8
+        q, k, v = _r(b, s, h, d), _r(b, s, h, d), _r(b, s, h, d)
+        got = F.fused_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            scaling_factor=1.0, training=False)
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        scores = np.einsum("bhqd,bhkd->bhqk", qt, kt)  # scale 1.0
+        want = np.einsum("bhqk,bhkd->bhqd", _softmax(scores),
+                         vt).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(got.numpy(), want, rtol=2e-5, atol=2e-5)
+
+    def test_mmha_beam_offset_rejected(self):
+        with pytest.raises(NotImplementedError):
+            F.masked_multihead_attention(
+                paddle.to_tensor(_r(1, 24)),
+                paddle.to_tensor(np.zeros((2, 1, 2, 4, 4), dtype="float32")),
+                beam_cache_offset=paddle.to_tensor(np.zeros(1, dtype="int32")))
