@@ -56,6 +56,15 @@ from . import device
 from . import incubate
 
 from .framework.io_ import save, load
+from .framework.misc import (
+    dtype, iinfo, finfo, LazyGuard, create_parameter, get_rng_state,
+    set_rng_state, get_cuda_rng_state, set_cuda_rng_state,
+    set_printoptions, check_shape, disable_signal_handler, enable_static,
+    disable_static,
+)
+from .core.place import CUDAPinnedPlace
+from .ops.manipulation import flip as reverse  # deprecated paddle.reverse
+from .nn.param_attr import ParamAttr
 from . import framework
 
 import sys as _sys
@@ -98,6 +107,11 @@ def __getattr__(name):
 
         setattr(_sys.modules[__name__], "batch", _batch)
         return _batch
+    if name == "DataParallel":
+        from .distributed import DataParallel as _DP
+
+        setattr(_sys.modules[__name__], "DataParallel", _DP)
+        return _DP
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 
@@ -134,9 +148,10 @@ def device_count():
 
 
 def in_dynamic_mode():
+    from .framework.misc import in_static_mode
     from .jit.trace_state import in_tracing
 
-    return not in_tracing()
+    return not in_tracing() and not in_static_mode()
 
 
 def synchronize():

@@ -128,3 +128,17 @@ def is_compiled_with_tpu() -> bool:
 
 def device_count() -> int:
     return len(_devices_for("tpu"))
+
+
+class CUDAPinnedPlace(Place):
+    """Reference: paddle.CUDAPinnedPlace — page-locked host staging memory.
+    On TPU, host staging buffers are managed by PJRT; this place maps to
+    host memory."""
+
+    device_type = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "Place(gpu_pinned)"

@@ -1,0 +1,185 @@
+"""Top-level framework utilities.
+
+Reference: python/paddle/framework/ (dtype exposure, iinfo/finfo —
+framework/dtype.py), random-state API (framework/random.py), LazyGuard
+(nn/initializer/lazy_init.py), create_parameter (tensor/creation.py).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ..core.dtype import convert_dtype
+
+__all__ = [
+    "dtype", "iinfo", "finfo", "LazyGuard", "create_parameter",
+    "get_rng_state", "set_rng_state", "get_cuda_rng_state",
+    "set_cuda_rng_state",
+]
+
+
+def dtype(d):
+    """paddle.dtype — the canonical dtype object (numpy dtype here)."""
+    return convert_dtype(d)
+
+
+class _IInfo:
+    def __init__(self, np_info):
+        self.min = int(np_info.min)
+        self.max = int(np_info.max)
+        self.bits = int(np_info.bits)
+        self.dtype = str(np_info.dtype)
+
+    def __repr__(self):
+        return f"iinfo(min={self.min}, max={self.max}, bits={self.bits}, dtype={self.dtype})"
+
+
+class _FInfo:
+    def __init__(self, np_info):
+        self.min = float(np_info.min)
+        self.max = float(np_info.max)
+        self.eps = float(np_info.eps)
+        self.tiny = float(np_info.tiny)
+        self.smallest_normal = float(np_info.tiny)
+        self.resolution = float(np_info.resolution)
+        self.bits = int(np_info.bits)
+        self.dtype = str(np_info.dtype)
+
+    def __repr__(self):
+        return (f"finfo(min={self.min}, max={self.max}, eps={self.eps}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+def iinfo(d):
+    """Reference: paddle.iinfo (framework/dtype.py)."""
+    return _IInfo(np.iinfo(convert_dtype(d)))
+
+
+def finfo(d):
+    """Reference: paddle.finfo. Handles bfloat16 via jax's dtype info."""
+    nd = convert_dtype(d)
+    if str(nd) == "bfloat16":
+        import jax.numpy as jnp
+        import ml_dtypes
+
+        return _FInfo(ml_dtypes.finfo(jnp.bfloat16))
+    return _FInfo(np.finfo(nd))
+
+
+class LazyGuard:
+    """Reference: paddle.LazyGuard (nn/initializer/lazy_init.py) — delays
+    parameter initialization until first use. TPU build: parameter arrays
+    are created lazily by jax anyway (no device commit until consumed);
+    the guard records its active window for API parity."""
+
+    _active = False
+
+    def __enter__(self):
+        type(self)._active = True
+        return self
+
+    def __exit__(self, *exc):
+        type(self)._active = False
+        return False
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Reference: paddle.create_parameter (tensor/creation.py) — free
+    parameter with ParamAttr/initializer semantics."""
+    from ..nn.layer import Layer
+
+    holder = Layer()
+    p = holder.create_parameter(shape=list(shape), attr=attr,
+                                dtype=str(convert_dtype(dtype)),
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+    if name:
+        p.name = name
+    return p
+
+
+def get_rng_state(device=None):
+    """Reference: paddle.get_rng_state — list of generator states."""
+    from ..core import generator
+
+    return [generator.default_generator().get_state()]
+
+
+def set_rng_state(state_list, device=None):
+    from ..core import generator
+
+    states = state_list if isinstance(state_list, (list, tuple)) else [state_list]
+    generator.default_generator().set_state(states[0])
+
+
+def get_cuda_rng_state():
+    """CUDA alias — one accelerator stream on TPU."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state_list):
+    set_rng_state(state_list)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Reference: paddle.set_printoptions (tensor/to_string.py:38). Tensor
+    repr renders through numpy, so this maps onto numpy printoptions."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    np.set_printoptions(**kw)
+
+
+def check_shape(shape):
+    """Reference: utils/layers_utils.py:468 — validate a shape argument."""
+    if isinstance(shape, (list, tuple)):
+        for s in shape:
+            if not isinstance(s, (int, np.integer)) and s is not None:
+                from ..core.tensor import Tensor
+
+                if not isinstance(s, Tensor):
+                    raise TypeError(
+                        f"shape entries must be int/Tensor, got {type(s)}")
+    return shape
+
+
+def disable_signal_handler():
+    """Reference: paddle.disable_signal_handler — the TPU build installs no
+    signal handlers, so this is a recorded no-op."""
+
+
+_STATIC_MODE = False
+
+
+def enable_static():
+    """Reference: paddle.enable_static — the TPU build's static path is the
+    Program-capture layer (paddle_tpu.static); this flag makes
+    in_dynamic_mode() report static."""
+    global _STATIC_MODE
+    _STATIC_MODE = True
+
+
+def disable_static():
+    global _STATIC_MODE
+    _STATIC_MODE = False
+
+
+def in_static_mode() -> bool:
+    return _STATIC_MODE
+
+
+__all__ += [
+    "set_printoptions", "check_shape", "disable_signal_handler",
+    "enable_static", "disable_static", "in_static_mode",
+]

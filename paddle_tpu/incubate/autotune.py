@@ -29,8 +29,11 @@ _flags.define_flag("autotune_dataloader", False,
 
 def set_config(config=None):
     if config is None:
+        # reference: config=None enables all three autotune sections
         _flags.set_flags({
             "use_autotune": True,
+            "autotune_layout": True,
+            "autotune_dataloader": True,
         })
         return
     if hasattr(config, "read"):
@@ -40,19 +43,19 @@ def set_config(config=None):
     unknown = set(config) - _VALID_KEYS
     if unknown:
         raise ValueError(f"unknown autotune sections: {sorted(unknown)}")
-    kernel = config.get("kernel", {})
-    _flags.set_flags({
-        "use_autotune": bool(kernel.get("enable", True)),
-    })
-    if "tuning_range" in kernel:
-        lo, hi = kernel["tuning_range"]
-        _flags.set_flags({"autotune_tuning_start": int(lo),
-                          "autotune_tuning_stop": int(hi)})
+    # only sections present in the config are touched
+    if "kernel" in config:
+        kernel = config["kernel"]
+        _flags.set_flags({"use_autotune": bool(kernel.get("enable", True))})
+        if "tuning_range" in kernel:
+            lo, hi = kernel["tuning_range"]
+            _flags.set_flags({"autotune_tuning_start": int(lo),
+                              "autotune_tuning_stop": int(hi)})
     if "layout" in config:
         _flags.set_flags({
-            "autotune_layout": bool(config["layout"].get("enable", False))
+            "autotune_layout": bool(config["layout"].get("enable", True))
         })
     if "dataloader" in config:
         _flags.set_flags({
-            "autotune_dataloader": bool(config["dataloader"].get("enable", False))
+            "autotune_dataloader": bool(config["dataloader"].get("enable", True))
         })

@@ -284,7 +284,11 @@ def _bmha_fwd(qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
         out_dec = jnp.einsum("bhk,bkhd->bhd", probs,
                              v_rep.astype(jnp.float32)).astype(qkv.dtype)
         out = jnp.zeros((t, h, d), dtype=qkv.dtype)
-        return out.at[jnp.clip(starts, 0, t - 1)].set(out_dec)
+        # finished slots (n_this == 0) must not scatter — a duplicate
+        # clipped index would clobber a live sequence's row
+        active = n_this > 0
+        safe_start = jnp.where(active, jnp.clip(starts, 0, t - 1), t)
+        return out.at[safe_start].set(out_dec, mode="drop")
 
     out = jax.lax.cond(jnp.all(enc == 0), decode_attn, full_attn, 0)
 

@@ -50,17 +50,26 @@ def minimize_bfgs(objective_func, initial_position, max_iters=50,
             break
         p = -H @ g
 
+        # cache line-search evaluations by alpha so the accepted point's
+        # full (value, gradient) is reused instead of recomputed
+        evals_cache = {}
+
         def f_dir(a, x=x, p=p):
             v, grad = vg(x + a * p)
+            evals_cache[float(a)] = (v, grad)
             return float(v), float(grad @ p)
 
-        alpha, _, _, evals = strong_wolfe(f_dir, a1=initial_step_length,
-                                          max_iters=max_line_search_iters)
+        alpha, _, _, evals = strong_wolfe(
+            f_dir, a1=initial_step_length, max_iters=max_line_search_iters,
+            phi0=float(value), dphi0=float(g @ p))
         num_calls += evals
         s = alpha * p
         x_new = x + s
-        value_new, g_new = vg(x_new)
-        num_calls += 1
+        if float(alpha) in evals_cache:
+            value_new, g_new = evals_cache[float(alpha)]
+        else:
+            value_new, g_new = vg(x_new)
+            num_calls += 1
         y = g_new - g
         sy = float(s @ y)
         if sy > 1e-10:

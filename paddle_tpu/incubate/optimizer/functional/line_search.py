@@ -22,14 +22,19 @@ def _cubic_interp(x1, f1, g1, x2, f2, g2):
     return jnp.clip(jnp.where(jnp.isfinite(t), t, (x1 + x2) / 2), lo, hi)
 
 
-def strong_wolfe(f_dir, a1=1.0, c1=1e-4, c2=0.9, max_iters=50):
+def strong_wolfe(f_dir, a1=1.0, c1=1e-4, c2=0.9, max_iters=50,
+                 phi0=None, dphi0=None):
     """Find a s.t. phi(a) satisfies the strong Wolfe conditions.
 
-    f_dir(a) -> (phi(a), phi'(a)) along the search direction. Returns
+    f_dir(a) -> (phi(a), phi'(a)) along the search direction. Pass
+    phi0/dphi0 when already known to skip the a=0 evaluation. Returns
     (alpha, phi(alpha), phi'(alpha), n_evals).
     """
-    phi0, dphi0 = f_dir(0.0)
-    n_evals = [1]
+    if phi0 is None or dphi0 is None:
+        phi0, dphi0 = f_dir(0.0)
+        n_evals = [1]
+    else:
+        n_evals = [0]
 
     def ev(a):
         n_evals[0] += 1

@@ -78,10 +78,13 @@ class LBFGS:
     def _step_impl(self, closure):
         from ... import autograd
 
+        step_evals = [0]  # per-call budget (reference checks current_evals)
+
         def eval_closure():
             with autograd.enable_grad():
                 loss = closure()
             self._func_evals += 1
+            step_evals[0] += 1
             return loss
 
         loss = eval_closure()
@@ -93,24 +96,15 @@ class LBFGS:
         n_iter = 0
         while n_iter < self.max_iter:
             n_iter += 1
-            # direction via two-loop recursion
+            # direction via two-loop recursion (shared with functional lbfgs)
+            from .functional.lbfgs import _two_loop
+
             if not self._hist:
                 d = -flat_grad
-                gamma = 1.0
             else:
-                q = flat_grad
-                alphas = []
-                for s, y, rho in reversed(self._hist):
-                    a = rho * float(s @ q)
-                    alphas.append(a)
-                    q = q - a * y
                 s_l, y_l, _ = self._hist[-1]
                 gamma = float(s_l @ y_l) / max(float(y_l @ y_l), 1e-20)
-                r = gamma * q
-                for (s, y, rho), a in zip(self._hist, reversed(alphas)):
-                    b = rho * float(y @ r)
-                    r = r + s * (a - b)
-                d = -r
+                d = -_two_loop(flat_grad, self._hist, gamma)
             prev_grad = flat_grad
             prev_loss = float(loss.numpy()) if isinstance(loss, Tensor) else float(loss)
 
@@ -154,7 +148,7 @@ class LBFGS:
                 if len(self._hist) > self.history_size:
                     self._hist.pop(0)
 
-            if self._func_evals >= self.max_eval:
+            if step_evals[0] >= self.max_eval:
                 break
             if float(jnp.max(jnp.abs(flat_grad))) <= self.tolerance_grad:
                 break

@@ -69,7 +69,10 @@ class ModelAverage:
                 st["old_num_accumulates"] = st["num_accumulates"]
                 st["num_accumulates"] = 0
 
-    minimize = step
+    @no_grad()
+    def minimize(self, loss=None, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
 
     def _average(self, p):
         st = self._state[id(p)]

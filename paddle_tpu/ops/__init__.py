@@ -29,6 +29,17 @@ from . import stat
 from .extras import *  # noqa: F401,F403
 from . import extras
 
+# inplace variants over the whole op surface
+from .inplace import *  # noqa: F401,F403
+from . import inplace as _inplace_mod
+
+
+def _patch_tensor_inplace():
+    """Attach every generated inplace/random-fill variant as a method."""
+    for name in _inplace_mod.__all__:
+        if name.endswith("_"):
+            setattr(Tensor, name, getattr(_inplace_mod, name))
+
 
 def _patch_tensor():
     import numbers
@@ -182,3 +193,4 @@ def cr_normal_(x, mean=0.0, std=1.0):
 
 
 _patch_tensor()
+_patch_tensor_inplace()

@@ -817,3 +817,51 @@ def polar(abs, angle, name=None):
 
 
 defprim("polar_p", lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)))
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise p-norm distances of the rows of x [N, D] →
+    [N*(N-1)/2] (reference: tensor/linalg.py pdist)."""
+    x = ensure_tensor(x)
+    return apply("pdist_p", x, p=float(p))
+
+
+def _pdist_fwd(x, *, p):
+    n = x.shape[0]
+    diff = x[:, None, :] - x[None, :, :]
+    if p == 2.0:
+        d = jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 0.0)
+    elif p == 0.0:
+        d = jnp.sum(diff != 0, axis=-1).astype(x.dtype)
+    elif p == float("inf"):
+        d = jnp.max(jnp.abs(diff), axis=-1)
+    else:
+        d = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    iu, ju = jnp.triu_indices(n, k=1)
+    return d[iu, ju]
+
+
+defprim("pdist_p", _pdist_fwd)
+
+
+def reduce_as(x, target, name=None):
+    """Sum x down to target's shape (reference: tensor/math.py reduce_as —
+    the broadcast-inverse reduction)."""
+    x = ensure_tensor(x)
+    target = ensure_tensor(target)
+    return apply("reduce_as_p", x, target)
+
+
+def _reduce_as_fwd(x, target):
+    tshape = target.shape
+    ndiff = x.ndim - len(tshape)
+    axes = tuple(range(ndiff)) + tuple(
+        i + ndiff for i, s in enumerate(tshape) if s == 1 and x.shape[i + ndiff] != 1
+    )
+    out = jnp.sum(x, axis=axes, keepdims=False)
+    return out.reshape(tshape)
+
+
+defprim("reduce_as_p", _reduce_as_fwd)
+
+__all__.extend(["pdist", "reduce_as"])

@@ -1,0 +1,161 @@
+"""Inplace op surface completion.
+
+Reference: python/paddle/tensor/__init__.py tensor_method_func — every
+``op_`` name rebinds the same python Tensor to the op's result (storage
+swap; the graph link moves with it). Random fills (normal_/bernoulli_/
+cauchy_/geometric_/log_normal_/exponential_) sample through the framework
+generator so seeding matches the functional ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._helpers import ensure_tensor
+from .math import _make_inplace
+
+__all__ = []
+
+
+def _export(name, fn):
+    fn.__name__ = name
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def _build_inplace_variants():
+    from . import activation as act
+    from . import comparison as c
+    from . import creation as cr
+    from . import extras as ex
+    from . import manipulation as mp
+    from . import math as m
+
+    sources = {}
+    for mod in (m, mp, c, act, ex, cr):
+        for n in dir(mod):
+            if not n.startswith("_") and callable(getattr(mod, n)):
+                sources.setdefault(n, getattr(mod, n))
+
+    names = [
+        "addmm", "t", "cumsum", "cumprod", "logit", "equal", "cos",
+        "tan", "logical_and", "logical_or", "logical_xor", "logical_not",
+        "less_than", "less_equal", "greater_than", "greater_equal",
+        "not_equal", "floor_divide", "remainder", "mod", "floor_mod",
+        "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "triu",
+        "tril", "sin", "pow", "acos", "asin", "atan", "expm1", "sinh",
+        "cosh", "sinc", "lgamma", "gammaincc", "gammainc", "square",
+        "gammaln", "gcd", "lcm", "cast", "erf", "transpose", "digamma",
+        "erfinv", "log", "log2", "log10", "log1p", "trunc", "frac",
+        "nan_to_num", "fill_diagonal", "lerp", "put_along_axis",
+        "index_put", "index_fill", "renorm", "copysign", "hypot",
+        "ldexp", "i0", "atanh", "asinh", "acosh", "flatten", "scatter",
+        "index_add", "multigammaln", "polygamma", "bitwise_left_shift",
+        "bitwise_right_shift", "masked_fill", "masked_scatter",
+    ]
+    for n in names:
+        base = sources.get(n)
+        if base is None:
+            continue
+        _export(n + "_", _make_inplace(base))
+
+
+_build_inplace_variants()
+
+def where_(condition, x, y=None, name=None):
+    """Reference where_ inplaces X (the second argument), not the
+    condition — generated _make_inplace would mutate the wrong operand."""
+    from .manipulation import where
+
+    x = ensure_tensor(x)
+    out = where(condition, x, y)
+    x._replace_value(out._value)
+    if getattr(out, "_node", None) is not None:
+        x._node, x._out_slot = out._node, out._out_slot
+        x.stop_gradient = out.stop_gradient
+    return x
+
+
+__all__.append("where_")
+
+
+# floor_mod is an alias of mod in the reference op_compat table
+from .math import mod as floor_mod  # noqa: E402
+
+floor_mod_ = _make_inplace(floor_mod)
+floor_mod_.__name__ = "floor_mod_"
+__all__.extend(["floor_mod", "floor_mod_"])
+
+
+# ---------------------------------------------------------------------------
+# inplace random fills — reference: tensor/random.py (Tensor.normal_,
+# bernoulli_, cauchy_, geometric_, log_normal_, exponential_, uniform_)
+# ---------------------------------------------------------------------------
+def _next_key():
+    from ..core import generator
+
+    return generator.next_key("local_seed")
+
+
+def _fill(x: Tensor, sample) -> Tensor:
+    x._replace_value(sample.astype(x._value.dtype))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    # routes through creation.gaussian so the sample stream matches the
+    # functional paddle.normal
+    from .creation import gaussian
+
+    x = ensure_tensor(x)
+    return _fill(x, gaussian(x.shape, mean, std, dtype=x.dtype)._value)
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    x = ensure_tensor(x)
+    s = jnp.exp(jax.random.normal(_next_key(), x._value.shape) * std + mean)
+    return _fill(x, s)
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x = ensure_tensor(x)
+    s = jax.random.bernoulli(_next_key(), p, x._value.shape)
+    return _fill(x, s)
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    x = ensure_tensor(x)
+    u = jax.random.uniform(_next_key(), x._value.shape,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    s = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+    return _fill(x, s)
+
+
+def geometric_(x, probs, name=None):
+    x = ensure_tensor(x)
+    u = jax.random.uniform(_next_key(), x._value.shape,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    # number of Bernoulli(p) trials to first success (support 1, 2, ...)
+    s = jnp.ceil(jnp.log(u) / jnp.log1p(-jnp.asarray(probs, jnp.float32)))
+    return _fill(x, s)
+
+
+def exponential_(x, lam=1.0, name=None):
+    x = ensure_tensor(x)
+    u = jax.random.uniform(_next_key(), x._value.shape,
+                           minval=1e-7, maxval=1.0)
+    return _fill(x, -jnp.log(u) / lam)
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    from .creation import uniform
+
+    x = ensure_tensor(x)
+    return _fill(x, uniform(x.shape, x.dtype, min, max)._value)
+
+
+for _n in ("normal_", "log_normal_", "bernoulli_", "cauchy_", "geometric_",
+           "exponential_", "uniform_"):
+    __all__.append(_n)

@@ -669,8 +669,13 @@ def _make_inplace(op):
     def inplace(x, *args, **kwargs):
         out = op(x, *args, **kwargs)
         x._replace_value(out._value)
-        x._node, x._out_slot = out._node, out._out_slot
-        x.stop_gradient = out.stop_gradient
+        if getattr(out, "_node", None) is not None:
+            # grad-tracked: the object adopts the result's graph position
+            x._node, x._out_slot = out._node, out._out_slot
+            x.stop_gradient = out.stop_gradient
+        # else (no_grad / non-differentiable): value-only update — a leaf
+        # param updated in place stays a trainable leaf (reference inplace
+        # optimizer-update semantics)
         return x
 
     inplace.__name__ = op.__name__ + "_"

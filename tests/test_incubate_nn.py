@@ -650,3 +650,40 @@ class TestReviewFixesRound3:
                 paddle.to_tensor(_r(1, 24)),
                 paddle.to_tensor(np.zeros((2, 1, 2, 4, 4), dtype="float32")),
                 beam_cache_offset=paddle.to_tensor(np.zeros(1, dtype="int32")))
+
+
+class TestDecodeFinishedSlot:
+    def test_finished_slot_does_not_clobber(self):
+        h, kvh, d, bs, bps = 2, 2, 4, 4, 2
+        b = 2
+        n_blocks = b * bps + 1
+        kc = np.zeros((n_blocks, kvh, bs, d), dtype="float32")
+        vc = np.zeros_like(kc)
+        bt = np.arange(b * bps, dtype="int32").reshape(b, bps)
+        # seq0 finished (dec=0, this_time=0), seq1 decoding with 3 cached
+        cached = 3
+        dense_k = np.random.randn(cached, kvh, d).astype("float32")
+        dense_v = np.random.randn(cached, kvh, d).astype("float32")
+        for pos in range(cached):
+            blk = bt[1][pos // bs]
+            kc[blk, :, pos % bs, :] = dense_k[pos]
+            vc[blk, :, pos % bs, :] = dense_v[pos]
+        qkv = np.random.randn(1, (h + 2 * kvh) * d).astype("float32")
+        cu = np.array([0, 0, 1], dtype="int32")
+        out, _, _, _ = F.block_multihead_attention(
+            paddle.to_tensor(qkv), paddle.to_tensor(kc), paddle.to_tensor(vc),
+            paddle.to_tensor(np.array([0, 0], dtype="int32")),
+            paddle.to_tensor(np.array([0, cached], dtype="int32")),
+            paddle.to_tensor(np.array([0, 1], dtype="int32")), None, None,
+            paddle.to_tensor(cu), paddle.to_tensor(cu), paddle.to_tensor(bt),
+            block_size=bs)
+        # oracle: seq1's single token attends over its cache + itself
+        row = qkv[0]
+        q = row[:h * d].reshape(h, d)
+        k_new = row[h * d:(h + kvh) * d].reshape(kvh, d)
+        v_new = row[(h + kvh) * d:].reshape(kvh, d)
+        k_full = np.concatenate([dense_k, k_new[None]], 0)
+        v_full = np.concatenate([dense_v, v_new[None]], 0)
+        sc = np.einsum("hd,shd->hs", q, k_full) / np.sqrt(d)
+        want = np.einsum("hs,shd->hd", _softmax(sc), v_full).reshape(h * d)
+        np.testing.assert_allclose(out.numpy()[0], want, rtol=3e-4, atol=3e-4)
