@@ -43,3 +43,21 @@ from .activation_layers import (
     Softmax, LogSoftmax, PReLU, RReLU, GLU,
 )
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+
+from .rnn import (
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
+    LSTM, GRU,
+)
+from .transformer import (
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .decode import Decoder, BeamSearchDecoder, dynamic_decode
+from .extra_layers import (
+    CTCLoss, RNNTLoss, HSigmoidLoss, PoissonNLLLoss, GaussianNLLLoss,
+    MultiMarginLoss, TripletMarginWithDistanceLoss,
+    AdaptiveLogSoftmaxWithLoss, PairwiseDistance, MaxUnPool1D, MaxUnPool2D,
+    MaxUnPool3D, LPPool1D, LPPool2D, FractionalMaxPool2D,
+    FractionalMaxPool3D, ZeroPad1D, ZeroPad3D, Fold, Unfold,
+    FeatureAlphaDropout, Silu, Softmax2D, SpectralNorm,
+)

@@ -1,0 +1,224 @@
+"""Dynamic decoding (beam search).
+
+Reference: python/paddle/nn/decode.py — Decoder protocol (initialize/step/
+finalize :42), BeamSearchDecoder (:153; OutputWrapper/StateWrapper
+namedtuples, tile_beam_merge_with_batch :241, gather_tree finalize :630),
+dynamic_decode loop (:994).
+
+The decode loop is host-driven (data-dependent termination); each step's
+math is framework ops, so one jit-compiled cell step per token on TPU.
+"""
+from __future__ import annotations
+
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops._helpers import ensure_tensor
+from .layer import Layer
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Abstract decode protocol (reference decode.py:42)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Reference: decode.py:153."""
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+
+    # -- beam layout helpers ------------------------------------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] by repeating each batch row beam times
+        (reference :241)."""
+        x = ensure_tensor(x)
+        v = jnp.repeat(x._value[:, None], beam_size, axis=1)
+        return Tensor._from_value(v.reshape((-1,) + x._value.shape[1:]))
+
+    def _split(self, v):
+        return v.reshape((-1, self.beam_size) + v.shape[1:])
+
+    def _merge(self, v):
+        return v.reshape((-1,) + v.shape[2:])
+
+    def initialize(self, initial_cell_states):
+        import jax
+
+        cell_states = jax.tree_util.tree_map(
+            lambda t: self.tile_beam_merge_with_batch(t, self.beam_size),
+            initial_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        flat = jax.tree_util.tree_leaves(
+            cell_states, is_leaf=lambda t: isinstance(t, Tensor))
+        batch_beam = flat[0].shape[0]
+        b = batch_beam // self.beam_size
+        # only beam 0 is live initially so duplicated beams don't tie
+        log_probs = jnp.tile(
+            jnp.array([0.0] + [-1e9] * (self.beam_size - 1), jnp.float32),
+            (b, 1)).reshape(-1)
+        finished = jnp.zeros((batch_beam,), bool)
+        lengths = jnp.zeros((batch_beam,), jnp.int64)
+        init_ids = Tensor._from_value(
+            jnp.full((batch_beam,), self.start_token, jnp.int64))
+        init_inputs = (self.embedding_fn(init_ids)
+                       if self.embedding_fn is not None else init_ids)
+        state = self.StateWrapper(cell_states,
+                                  Tensor._from_value(log_probs),
+                                  Tensor._from_value(finished),
+                                  Tensor._from_value(lengths))
+        return init_inputs, state, Tensor._from_value(finished)
+
+    def step(self, time, inputs, states, **kwargs):
+        import jax
+
+        cell_out, next_cell_states = self.cell(inputs, states.cell_states,
+                                               **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = ensure_tensor(cell_out)._value.astype(jnp.float32)
+        vocab = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits, axis=-1)     # [B*beam, V]
+
+        prev_lp = states.log_probs._value
+        finished = states.finished._value
+        lengths = states.lengths._value
+
+        # finished beams only extend with end_token at zero cost
+        end_mask = jnp.full((vocab,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[:, None], end_mask[None, :], logp)
+        total = prev_lp[:, None] + step_lp                   # [B*beam, V]
+
+        b = total.shape[0] // self.beam_size
+        flat = self._split(total).reshape(b, self.beam_size * vocab)
+        top_lp, top_idx = jax.lax.top_k(flat, self.beam_size)  # [B, beam]
+        parent = top_idx // vocab                              # beam index
+        token = (top_idx % vocab).astype(jnp.int64)
+
+        # gather beam-aligned state rows through parent indices
+        gather_rows = (jnp.arange(b)[:, None] * self.beam_size
+                       + parent).reshape(-1)
+
+        def regather(t):
+            t = ensure_tensor(t)
+            return Tensor._from_value(t._value[gather_rows])
+
+        next_cell_states = jax.tree_util.tree_map(
+            regather, next_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        new_finished = finished[gather_rows] | (
+            token.reshape(-1) == self.end_token)
+        new_lengths = lengths[gather_rows] + jnp.where(
+            finished[gather_rows], 0, 1)
+
+        out = self.OutputWrapper(
+            Tensor._from_value(top_lp.reshape(-1)),
+            Tensor._from_value(token.reshape(-1)),
+            Tensor._from_value(parent.reshape(-1).astype(jnp.int64)),
+        )
+        next_state = self.StateWrapper(
+            next_cell_states,
+            Tensor._from_value(top_lp.reshape(-1)),
+            Tensor._from_value(new_finished),
+            Tensor._from_value(new_lengths),
+        )
+        ids = Tensor._from_value(token.reshape(-1))
+        next_inputs = (self.embedding_fn(ids)
+                       if self.embedding_fn is not None else ids)
+        return out, next_state, next_inputs, Tensor._from_value(new_finished)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrack parent pointers via the gather_tree op (reference
+        finalize :630)."""
+        from .functional.vision import gather_tree
+
+        pred = np.asarray(outputs.predicted_ids._value)   # [T, B*beam]
+        parents = np.asarray(outputs.parent_ids._value)
+        T = pred.shape[0]
+        b = pred.shape[1] // self.beam_size
+        out = gather_tree(
+            Tensor._from_value(jnp.asarray(
+                pred.reshape(T, b, self.beam_size))),
+            Tensor._from_value(jnp.asarray(
+                parents.reshape(T, b, self.beam_size))),
+        )
+        # [T, B, beam] -> [B, T, beam] time-minor like the reference
+        return Tensor._from_value(out._value.transpose(1, 0, 2)), final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Repeatedly decoder.step() until all beams finish or max_step_num
+    (reference: decode.py:994)."""
+    from ..ops.manipulation import stack
+
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    max_steps = max_step_num if max_step_num is not None else 256
+    final_states = states
+    for t in range(int(max_steps)):
+        out, states, inputs, finished = decoder.step(t, inputs, states,
+                                                     **kwargs)
+        step_outputs.append(out)
+        final_states = states
+        if bool(np.asarray(ensure_tensor(finished)._value).all()):
+            break
+
+    # stack the per-step namedtuples field-wise: [T, ...]
+    first = step_outputs[0]
+    if isinstance(first, tuple) and hasattr(first, "_fields"):
+        outputs = type(first)(*[
+            stack([getattr(o, f) for o in step_outputs], axis=0)
+            for f in first._fields
+        ])
+    else:
+        outputs = stack(step_outputs, axis=0)
+
+    if hasattr(decoder, "finalize"):
+        final_outputs, final_states = decoder.finalize(
+            outputs, final_states, getattr(final_states, "lengths", None))
+    else:
+        final_outputs = outputs
+    if output_time_major and isinstance(final_outputs, Tensor):
+        from ..ops.manipulation import transpose
+
+        perm = [1, 0] + list(range(2, final_outputs.ndim))
+        final_outputs = transpose(final_outputs, perm)
+    if return_length:
+        return final_outputs, final_states, getattr(final_states, "lengths",
+                                                    None)
+    return final_outputs, final_states

@@ -1,0 +1,426 @@
+"""Layer-surface completion.
+
+Reference: python/paddle/nn/layer/ — loss.py (CTCLoss, RNNTLoss,
+HSigmoidLoss, PoissonNLLLoss, GaussianNLLLoss, MultiMarginLoss,
+TripletMarginWithDistanceLoss, AdaptiveLogSoftmaxWithLoss), distance.py
+(PairwiseDistance), pooling.py (MaxUnPool*, LPPool*, FractionalMaxPool*),
+padding.py (ZeroPad1D/3D), common.py (Fold, Unfold, FeatureAlphaDropout,
+Unflatten), activation.py (Silu, Softmax2D), norm.py (SpectralNorm).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import functional as F
+from .layer import Layer
+
+__all__ = [
+    "CTCLoss", "RNNTLoss", "HSigmoidLoss", "PoissonNLLLoss",
+    "GaussianNLLLoss", "MultiMarginLoss", "TripletMarginWithDistanceLoss",
+    "AdaptiveLogSoftmaxWithLoss", "PairwiseDistance", "MaxUnPool1D",
+    "MaxUnPool2D", "MaxUnPool3D", "LPPool1D", "LPPool2D",
+    "FractionalMaxPool2D", "FractionalMaxPool3D", "ZeroPad1D", "ZeroPad3D",
+    "Fold", "Unfold", "FeatureAlphaDropout", "Silu", "Softmax2D",
+    "SpectralNorm",
+]
+
+
+class CTCLoss(Layer):
+    """Reference: nn/layer/loss.py CTCLoss."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction, norm_by_times)
+
+
+class RNNTLoss(Layer):
+    """Reference: nn/layer/loss.py RNNTLoss."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Reference: nn/layer/loss.py HSigmoidLoss (default-tree mode)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError("custom-tree HSigmoidLoss")
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.log_input = log_input
+        self.full = full
+        self.epsilon = epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, self.log_input, self.full,
+                                  self.epsilon, self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self.full = full
+        self.epsilon = epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, self.full,
+                                   self.epsilon, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.margin = margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Reference: nn/layer/loss.py AdaptiveLogSoftmaxWithLoss — head plus
+    factorized tail clusters (div_value controls tail down-projection)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if any(c <= 0 or c >= n_classes - 1 for c in cutoffs) or \
+                sorted(set(cutoffs)) != cutoffs:
+            raise ValueError("invalid cutoffs")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.shortlist_size = self.cutoffs[0]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.shortlist_size + self.n_clusters
+        self.head_weight = self.create_parameter(
+            [in_features, self.head_size])
+        self.head_bias = (self.create_parameter([self.head_size],
+                                                is_bias=True)
+                          if head_bias else None)
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w_down = self.create_parameter([in_features, hsz])
+            w_out = self.create_parameter([hsz, osz])
+            self.add_parameter(f"tail_down_{i}", w_down)
+            self.add_parameter(f"tail_out_{i}", w_out)
+            self.tail_weights.append((w_down, w_out))
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs[:-1] + [self.n_classes], self.head_bias)
+
+    def log_prob(self, input):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..ops._helpers import ensure_tensor
+
+        x = ensure_tensor(input)._value.astype(jnp.float32)
+        hw = self.head_weight._value.astype(jnp.float32)
+        head = x @ hw
+        if self.head_bias is not None:
+            head = head + self.head_bias._value
+        head_lp = jax.nn.log_softmax(head, axis=-1)
+        parts = [head_lp[:, : self.shortlist_size]]
+        for i, (w_down, w_out) in enumerate(self.tail_weights):
+            tail_lp = jax.nn.log_softmax(
+                (x @ w_down._value.astype(jnp.float32))
+                @ w_out._value.astype(jnp.float32), axis=-1)
+            parts.append(head_lp[:, self.shortlist_size + i: self.shortlist_size + i + 1]
+                         + tail_lp)
+        return Tensor._from_value(jnp.concatenate(parts, axis=-1))
+
+    def predict(self, input):
+        from ..ops.manipulation import argmax
+
+        return argmax(self.log_prob(input), axis=-1)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class _MaxUnPoolNd(Layer):
+    FN = None
+    FORMAT = None
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format=None,
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format or self.FORMAT
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return type(self).FN(x, indices, self.kernel_size, self.stride,
+                             self.padding, self.data_format,
+                             self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPoolNd):
+    FN = staticmethod(F.max_unpool1d)
+    FORMAT = "NCL"
+
+
+class MaxUnPool2D(_MaxUnPoolNd):
+    FN = staticmethod(F.max_unpool2d)
+    FORMAT = "NCHW"
+
+
+class MaxUnPool3D(_MaxUnPoolNd):
+    FN = staticmethod(F.max_unpool3d)
+    FORMAT = "NCDHW"
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.norm_type = norm_type
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.lp_pool1d(x, self.norm_type, self.kernel_size, self.stride,
+                           self.padding, self.ceil_mode, self.data_format)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.norm_type = norm_type
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.lp_pool2d(x, self.norm_type, self.kernel_size, self.stride,
+                           self.padding, self.ceil_mode, self.data_format)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)
+
+
+class FractionalMaxPool3D(FractionalMaxPool2D):
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)
+
+
+class ZeroPad1D(Layer):
+    """Reference: nn/layer/padding ZeroPad1D — pad [left, right] on NCL."""
+
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = ([padding, padding] if isinstance(padding, int)
+                        else list(padding))
+        self.data_format = data_format
+
+    def forward(self, x):
+        from ..ops.manipulation import pad as pad_op
+
+        return pad_op(x, self.padding, mode="constant", value=0.0,
+                      data_format=self.data_format)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = ([padding] * 6 if isinstance(padding, int)
+                        else list(padding))
+        self.data_format = data_format
+
+    def forward(self, x):
+        from ..ops.manipulation import pad as pad_op
+
+        return pad_op(x, self.padding, mode="constant", value=0.0,
+                      data_format=self.data_format)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.output_sizes = output_sizes
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
+                      self.paddings, self.dilations)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
+                        self.dilations)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, training=self.training)
+
+
+class Silu(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.silu(x)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input
+    (reference: nn/layer/activation.py Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError("Softmax2D expects 3D/4D input")
+        return F.softmax(x, axis=-3)
+
+
+class SpectralNorm(Layer):
+    """Standalone spectral-norm layer: returns weight / sigma_max via power
+    iteration (reference: nn/layer/norm.py SpectralNorm)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        self._weight_shape = list(weight_shape)
+        h = self._weight_shape[dim]
+        w = int(np.prod(self._weight_shape)) // h
+        from .initializer import Normal
+
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=Normal(0.0, 1.0))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..ops._helpers import ensure_tensor
+
+        x = ensure_tensor(x)
+        perm = [self.dim] + [i for i in range(x.ndim) if i != self.dim]
+        w_mat = x._value.transpose(perm).reshape(x.shape[self.dim], -1)
+        u = self.weight_u._value
+        v = self.weight_v._value
+        for _ in range(self.power_iters):
+            v = w_mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.epsilon)
+            u = w_mat @ v
+            u = u / (jnp.linalg.norm(u) + self.epsilon)
+        self.weight_u._replace_value(u)
+        self.weight_v._replace_value(v)
+        sigma = u @ (w_mat @ v)
+        return Tensor._from_value(x._value / sigma)

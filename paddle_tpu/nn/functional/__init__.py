@@ -22,3 +22,140 @@ from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
 from . import flash_attention  # noqa: F401
+
+from .extra_loss import *  # noqa: F401,F403
+from .extra_pooling import *  # noqa: F401,F403
+from .vision import *  # noqa: F401,F403
+
+# inplace activation variants (reference: functional/activation.py *_ ops)
+from ...ops.math import _make_inplace as _mi
+
+elu_ = _mi(elu)
+hardtanh_ = _mi(hardtanh)
+leaky_relu_ = _mi(leaky_relu)
+softmax_ = _mi(softmax)
+tanh_ = _mi(tanh)
+thresholded_relu_ = _mi(thresholded_relu)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Reference: functional/common.py feature_alpha_dropout — alpha
+    dropout over whole channel maps (axis 1)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...core import generator
+    from ...core.tensor import Tensor
+    from ...ops._helpers import ensure_tensor
+
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x
+    alpha_p = -1.7580993408473766
+    key = generator.next_key("local_seed")
+    shape = (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+    v = jnp.where(keep, x._value, alpha_p)
+    return Tensor._from_value((a * v + b).astype(x._value.dtype))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Reference: functional/sparse_attention.py (CUDA block-sparse DSA).
+    The TPU path computes the same masked attention from the CSR pattern —
+    correctness surface; a Pallas block-sparse kernel is the perf path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ...core.tensor import Tensor
+    from ...ops._helpers import ensure_tensor
+
+    q = ensure_tensor(query)._value.astype(jnp.float32)  # [B, H, S, D]
+    k = ensure_tensor(key)._value.astype(jnp.float32)
+    v = ensure_tensor(value)._value.astype(jnp.float32)
+    offs = np.asarray(ensure_tensor(sparse_csr_offset)._value)   # [B, H, S+1]
+    cols = np.asarray(ensure_tensor(sparse_csr_columns)._value)  # [B, H, nnz]
+    b, h, s, d = q.shape
+    mask = np.full((b, h, s, s), -1e9, dtype=np.float32)
+    for bi in range(b):
+        for hi in range(h):
+            for row in range(s):
+                lo, hi_ = offs[bi, hi, row], offs[bi, hi, row + 1]
+                mask[bi, hi, row, cols[bi, hi, lo:hi_]] = 0.0
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d) + mask
+    if key_padding_mask is not None:
+        kpm = ensure_tensor(key_padding_mask)._value.astype(jnp.float32)
+        scores = scores + kpm[:, None, None, :]    # [B, S] additive (0/-inf)
+    if attn_mask is not None:
+        am = ensure_tensor(attn_mask)._value.astype(jnp.float32)
+        scores = scores + am[None, None]           # [S, S] additive
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return Tensor._from_value(out.astype(ensure_tensor(query)._value.dtype))
+
+
+def flash_attention_with_sparse_mask(query, key, value,
+                                     attn_mask_start_row_indices=None,
+                                     attn_mask_start_row=0, dropout_p=0.0,
+                                     is_causal=True, training=True,
+                                     name=None):
+    """Reference: functional/flash_attention.py flash_attention_with_sparse_mask
+    — causal attention where row i additionally masks keys before
+    start_row_indices[i]. Composed as an additive mask over the SDPA path."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor
+    from ...ops._helpers import ensure_tensor
+    from .attention import scaled_dot_product_attention
+
+    q = ensure_tensor(query)
+    if attn_mask_start_row_indices is None:
+        return scaled_dot_product_attention(q, key, value, None, dropout_p,
+                                            is_causal, training)
+    sr = ensure_tensor(attn_mask_start_row_indices)._value  # [B, H, S]
+    s = q.shape[1]
+    rows = jnp.arange(s)[:, None]
+    keys = jnp.arange(s)[None, :]
+    causal = jnp.where(rows >= keys, 0.0, -1e9)
+    # sr[j] is the query ROW from which key-column j becomes masked:
+    # mask[i, j] = -inf when i >= sr[j] (reference sparse-mask layout)
+    start = sr[:, :, None, :]  # [B, H, 1, S] over key columns
+    sparse = jnp.where(rows[None, None] < start, 0.0, -1e9)
+    mask = jnp.maximum(causal[None, None] + sparse, -1e9)
+    return scaled_dot_product_attention(
+        q, key, value, Tensor._from_value(mask.astype(jnp.float32)),
+        dropout_p, False, training)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         fixed_seed_offset=None, rng_name="", training=True,
+                         name=None):
+    """Reference: functional/flash_attention.py flash_attn_qkvpacked —
+    qkv [B, S, 3, H, D]."""
+    from ...ops.manipulation import unbind
+    from .flash_attention import flash_attention
+
+    q, k, v = unbind(qkv, 2)
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                                max_seqlen_k, scale=None, dropout=0.0,
+                                causal=False, return_softmax=False,
+                                fixed_seed_offset=None, rng_name="",
+                                varlen_padded=True, training=True, name=None):
+    """Reference: flash_attn_varlen_qkvpacked — packed varlen
+    qkv [T, 3, H, D]."""
+    from ...ops.manipulation import unbind
+    from .flash_attention import flash_attn_unpadded
+
+    q, k, v = unbind(qkv, 1)
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k,
+                               scale=scale, dropout=dropout, causal=causal,
+                               return_softmax=return_softmax,
+                               training=training)

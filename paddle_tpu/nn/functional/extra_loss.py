@@ -1,0 +1,477 @@
+"""Loss-function surface completion.
+
+Reference: python/paddle/nn/functional/loss.py — ctc_loss, rnnt_loss,
+hsigmoid_loss, poisson_nll_loss, gaussian_nll_loss, multi_margin_loss,
+triplet_margin_with_distance_loss, dice_loss, adaptive_log_softmax_with_loss
+(nn/layer AdaptiveLogSoftmaxWithLoss), margin_cross_entropy, and
+distance.py pairwise_distance.
+
+CTC/RNNT are log-space alpha recursions under `lax.scan` — one compiled
+while-loop on TPU, differentiated by jax (the adjoint of the recursion IS
+the standard beta-pass gradient).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from ...ops._helpers import defprim, ensure_tensor
+
+__all__ = [
+    "ctc_loss", "rnnt_loss", "hsigmoid_loss", "poisson_nll_loss",
+    "gaussian_nll_loss", "multi_margin_loss",
+    "triplet_margin_with_distance_loss", "dice_loss", "pairwise_distance",
+    "margin_cross_entropy", "class_center_sample",
+    "adaptive_log_softmax_with_loss", "sequence_mask",
+]
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+def _ctc_nll(logits, labels, input_lengths, label_lengths, *, blank):
+    """logits [T, B, C] unnormalized; labels [B, L]; returns nll [B]."""
+    T, B, C = logits.shape
+    L = labels.shape[1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # extended sequence: blank l1 blank l2 ... lL blank (length S = 2L+1)
+    S = 2 * L + 1
+    ext = jnp.full((B, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    ext_valid = jnp.arange(S)[None, :] < (2 * label_lengths[:, None] + 1)
+
+    # can we skip from s-2 to s? only when ext[s] != blank and != ext[s-2]
+    ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    alpha0 = jnp.full((B, S), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0])
+    # a length-0 label has no position 1
+    alpha0 = jnp.where(ext_valid, alpha0, _NEG_INF)
+
+    def step(alpha, t):
+        stay = alpha
+        prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                        constant_values=_NEG_INF)[:, :S]
+        prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                        constant_values=_NEG_INF)[:, :S]
+        prev2 = jnp.where(can_skip, prev2, _NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        emit = jnp.take_along_axis(logp[t], ext, axis=1)
+        new_alpha = merged + emit
+        new_alpha = jnp.where(ext_valid, new_alpha, _NEG_INF)
+        # frozen past each sequence's input length
+        alive = (t < input_lengths)[:, None]
+        new_alpha = jnp.where(alive, new_alpha, alpha)
+        return new_alpha, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    s_last = 2 * label_lengths  # final blank position
+    final_blank = jnp.take_along_axis(alpha, s_last[:, None], axis=1)[:, 0]
+    final_label = jnp.take_along_axis(
+        alpha, jnp.maximum(s_last - 1, 0)[:, None], axis=1)[:, 0]
+    final_label = jnp.where(label_lengths > 0, final_label, _NEG_INF)
+    return -jnp.logaddexp(final_blank, final_label)
+
+
+defprim("ctc_loss_p", _ctc_nll)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Reference: nn/functional/loss.py ctc_loss — log_probs [T, B, C]
+    unnormalized (log_softmax applied internally), labels [B, L] padded."""
+    logits = ensure_tensor(log_probs)
+    in_lens = ensure_tensor(input_lengths)
+    lab_lens = ensure_tensor(label_lengths)
+    nll = apply("ctc_loss_p", logits, ensure_tensor(labels),
+                in_lens, lab_lens, blank=int(blank))
+    from ...ops import math as m
+
+    if norm_by_times:
+        nll = m.divide(nll, in_lens.astype("float32"))
+    if reduction == "mean":
+        # reference mean divides each sample by its label length first
+        return m.mean(m.divide(
+            nll, m.maximum(lab_lens.astype("float32"),
+                           ensure_tensor(1.0))))
+    if reduction == "sum":
+        return m.sum(nll)
+    return nll
+
+
+# ---------------------------------------------------------------------------
+# RNN-T
+# ---------------------------------------------------------------------------
+def _rnnt_alpha_nll(blank_lp, emit_lp, input_lengths, label_lengths):
+    """Transducer forward pass given blank/emit log-probs."""
+    B, T, U1 = blank_lp.shape
+
+    def step_t(alpha_prev, t):
+        # alpha along u for fixed t: alpha[t, u] = logaddexp(
+        #   alpha[t-1, u] + blank(t-1, u), alpha[t, u-1] + emit(t, u-1))
+        from_blank = alpha_prev + blank_lp[:, t - 1, :]  # [B, U+1]
+
+        def step_u(carry, u):
+            # carry: alpha[t, u-1]
+            val = jnp.logaddexp(from_blank[:, u],
+                                carry + emit_lp[:, t, u - 1])
+            return val, val
+
+        first = from_blank[:, 0]
+        _, rest = jax.lax.scan(step_u, first, jnp.arange(1, U1))
+        alpha_t = jnp.concatenate([first[:, None], rest.T], axis=1)
+        alive = (t < input_lengths)[:, None]
+        alpha_t = jnp.where(alive, alpha_t, alpha_prev)
+        return alpha_t, None
+
+    # t = 0 row: only emissions advance u
+    def init_u(carry, u):
+        val = carry + emit_lp[:, 0, u - 1]
+        return val, val
+
+    _, rest0 = jax.lax.scan(init_u, jnp.zeros((B,)), jnp.arange(1, U1))
+    alpha0 = jnp.concatenate([jnp.zeros((B, 1)), rest0.T], axis=1)
+    u_ok = jnp.arange(U1)[None, :] <= label_lengths[:, None]
+    alpha0 = jnp.where(u_ok, alpha0, _NEG_INF)
+
+    alpha_T, _ = jax.lax.scan(step_t, alpha0, jnp.arange(1, T))
+    # final: alpha[T-1, U] + blank(T-1, U)
+    t_last = jnp.clip(input_lengths - 1, 0, T - 1)
+    final = jnp.take_along_axis(
+        alpha_T, label_lengths[:, None], axis=1)[:, 0]
+    final_blank = jnp.take_along_axis(
+        blank_lp[jnp.arange(B), t_last], label_lengths[:, None], axis=1
+    )[:, 0]
+    return -(final + final_blank)
+
+
+def _rnnt_nll(logits, labels, input_lengths, label_lengths, *, blank,
+              fastemit_lambda):
+    """logits [B, T, U+1, V]; labels [B, U]; transducer alpha recursion.
+
+    FastEmit (arXiv:2010.11148): emission-arc gradients scaled by
+    (1 + λ). Implemented as loss + λ·loss_emit where loss_emit shares the
+    value of loss but stops gradients through the blank arcs, so only the
+    emission terms receive the extra λ gradient weight."""
+    T = logits.shape[1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    blank_lp = logp[..., blank]                      # [B, T, U+1]
+    lab = labels.astype(jnp.int32)                   # [B, U]
+    emit_lp = jnp.take_along_axis(
+        logp[:, :, :-1, :], lab[:, None, :, None].repeat(T, 1), axis=3
+    )[..., 0]                                        # [B, T, U]
+    nll = _rnnt_alpha_nll(blank_lp, emit_lp, input_lengths, label_lengths)
+    if fastemit_lambda > 0.0:
+        nll_emit = _rnnt_alpha_nll(jax.lax.stop_gradient(blank_lp), emit_lp,
+                                   input_lengths, label_lengths)
+        nll = nll + fastemit_lambda * nll_emit - jax.lax.stop_gradient(
+            fastemit_lambda * nll_emit)  # value unchanged, grads scaled
+    return nll
+
+
+defprim("rnnt_loss_p", _rnnt_nll)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """Reference: nn/functional/loss.py rnnt_loss — input [B, T, U+1, V]
+    joint-network logits."""
+    nll = apply("rnnt_loss_p", ensure_tensor(input), ensure_tensor(label),
+                ensure_tensor(input_lengths), ensure_tensor(label_lengths),
+                blank=int(blank), fastemit_lambda=float(fastemit_lambda))
+    from ...ops import math as m
+
+    if reduction == "mean":
+        return m.mean(nll)
+    if reduction == "sum":
+        return m.sum(nll)
+    return nll
+
+
+# ---------------------------------------------------------------------------
+# assorted losses
+# ---------------------------------------------------------------------------
+def _hsigmoid_fwd(x, lab, w, b, *, num_classes, use_bias):
+    """Default complete binary tree: internal nodes 0..num_classes-2; leaf
+    for class c sits at heap node (c + num_classes - 1)."""
+    x = x.astype(jnp.float32)
+    lab = lab.reshape(-1).astype(jnp.int32)
+    w = w.astype(jnp.float32)
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+    total = jnp.zeros(x.shape[0], jnp.float32)
+    node = lab + num_classes - 1
+    for _ in range(depth):
+        parent = (node - 1) // 2
+        is_right = (node % 2 == 0).astype(jnp.float32)  # right child
+        valid = (node > 0).astype(jnp.float32)
+        pw = w[jnp.clip(parent, 0, w.shape[0] - 1)]
+        logit = jnp.sum(x * pw, axis=-1)
+        if use_bias:
+            logit = logit + b.reshape(-1)[jnp.clip(parent, 0,
+                                                   w.shape[0] - 1)]
+        # sigmoid cross entropy: target 1 for right branch
+        ll = jnp.logaddexp(0.0, logit) - is_right * logit
+        total = total + ll * valid
+        node = parent
+    return total[:, None]
+
+
+defprim("hsigmoid_loss_p", _hsigmoid_fwd)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over a default complete binary tree
+    (reference: nn/functional/loss.py hsigmoid_loss; default-tree mode)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is not implemented")
+    x = ensure_tensor(input)
+    w = ensure_tensor(weight)
+    b = ensure_tensor(bias) if bias is not None else w
+    return apply("hsigmoid_loss_p", x, ensure_tensor(label), w, b,
+                 num_classes=int(num_classes), use_bias=bias is not None)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    """Reference: nn/functional/loss.py poisson_nll_loss."""
+    x = ensure_tensor(input)
+    t = ensure_tensor(label)
+    from ...ops import math as m
+
+    if log_input:
+        loss = m.exp(x) - t * x
+    else:
+        loss = x - t * m.log(x + ensure_tensor(epsilon))
+    if full:
+        import jax.numpy as _jnp
+
+        tv = t._value
+        stirling = tv * _jnp.log(_jnp.maximum(tv, 1.0)) - tv + \
+            0.5 * _jnp.log(2 * _jnp.pi * _jnp.maximum(tv, 1.0))
+        stirling = _jnp.where(tv > 1, stirling, 0.0)
+        loss = loss + Tensor._from_value(stirling.astype(loss._value.dtype))
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Reference: nn/functional/loss.py gaussian_nll_loss."""
+    from ...ops import math as m
+
+    x = ensure_tensor(input)
+    t = ensure_tensor(label)
+    var = m.maximum(ensure_tensor(variance), ensure_tensor(epsilon))
+    loss = 0.5 * (m.log(var) + m.square(t - x) / var)
+    if full:
+        loss = loss + 0.5 * float(np.log(2 * np.pi))
+    return _reduce(loss, reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Reference: nn/functional/loss.py multi_margin_loss."""
+    x = ensure_tensor(input)._value.astype(jnp.float32)  # [N, C]
+    lab = ensure_tensor(label)._value.reshape(-1).astype(jnp.int32)
+    n, c = x.shape
+    x_y = x[jnp.arange(n), lab][:, None]
+    margins = jnp.maximum(0.0, margin - x_y + x) ** p
+    if weight is not None:
+        w = ensure_tensor(weight)._value.astype(jnp.float32)
+        margins = margins * w[lab][:, None]
+    margins = margins.at[jnp.arange(n), lab].set(0.0)
+    loss = Tensor._from_value(jnp.sum(margins, axis=1) / c)
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Reference: nn/functional/loss.py triplet_margin_with_distance_loss."""
+    from ...ops import math as m
+
+    if distance_function is None:
+        distance_function = lambda a, b: pairwise_distance(a, b)
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_neg = m.minimum(d_neg, distance_function(positive, negative))
+    loss = m.maximum(d_pos - d_neg + ensure_tensor(float(margin)),
+                     ensure_tensor(0.0))
+    return _reduce(loss, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Reference: nn/functional/loss.py dice_loss — input [..., C] probs,
+    label [..., 1] ids."""
+    from ...ops.creation import one_hot
+    from ...ops.manipulation import squeeze
+    from ...ops import math as m
+
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    label = squeeze(label, -1)
+    label = one_hot(label, input.shape[-1]).astype(input.dtype)
+    reduce_dims = list(range(1, input.ndim))
+    inse = m.sum(input * label, axis=reduce_dims)
+    dice_denominator = m.sum(input, axis=reduce_dims) + m.sum(
+        label, axis=reduce_dims)
+    dice_score = 1 - inse * 2 / (dice_denominator + ensure_tensor(epsilon))
+    return m.mean(dice_score)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """Reference: nn/functional/distance.py pairwise_distance."""
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+    return apply("pairwise_distance_p", x, y, p=float(p),
+                 eps=float(epsilon), keepdim=bool(keepdim))
+
+
+defprim(
+    "pairwise_distance_p",
+    lambda x, y, *, p, eps, keepdim: jnp.linalg.norm(
+        x - y + eps, ord=p, axis=-1, keepdims=keepdim),
+)
+
+
+def _margin_ce_fwd(x, lab, *, margin1, margin2, margin3, scale):
+    x = x.astype(jnp.float32)
+    lab = lab.reshape(-1).astype(jnp.int32)
+    n = x.shape[0]
+    theta = jnp.arccos(jnp.clip(x[jnp.arange(n), lab], -1.0 + 1e-7,
+                                1.0 - 1e-7))
+    target_logit = jnp.cos(margin1 * theta + margin2) - margin3
+    logits_m = x.at[jnp.arange(n), lab].set(target_logit) * scale
+    logp = jax.nn.log_softmax(logits_m, axis=-1)
+    nll = -logp[jnp.arange(n), lab]
+    return nll[:, None], jax.nn.softmax(logits_m, axis=-1)
+
+
+defprim("margin_ce_p", _margin_ce_fwd, multi_out=True)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax (reference: nn/functional/loss.py
+    margin_cross_entropy; single-rank path — the TP path shards the class
+    dim via the mp mesh axis instead of a process group)."""
+    loss, softmax_out = apply(
+        "margin_ce_p", ensure_tensor(logits), ensure_tensor(label),
+        margin1=float(margin1), margin2=float(margin2),
+        margin3=float(margin3), scale=float(scale))
+    loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (reference: nn/functional/common.py
+    class_center_sample). Positive classes always kept; negatives sampled
+    uniformly to reach num_samples."""
+    from ...core import generator
+
+    lab = np.asarray(ensure_tensor(label)._value).reshape(-1)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = np.sort(pos)
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        key = generator.next_key("local_seed")
+        perm = np.asarray(jax.random.permutation(key, rest.shape[0]))
+        extra = rest[perm[: num_samples - len(pos)]]
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, dtype=np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor._from_value(jnp.asarray(remap[lab])),
+            Tensor._from_value(jnp.asarray(sampled)))
+
+
+def _adaptive_lsm_fwd(x, lab, hw, hb, *tails, cutoffs, use_bias):
+    x = x.astype(jnp.float32)
+    lab = lab.reshape(-1).astype(jnp.int32)
+    shortlist = cutoffs[0]
+    head_logits = x @ hw.astype(jnp.float32)
+    if use_bias:
+        head_logits = head_logits + hb.astype(jnp.float32)
+    head_logp = jax.nn.log_softmax(head_logits, axis=-1)
+
+    out = jnp.zeros(x.shape[0], jnp.float32)
+    in_short = lab < shortlist
+    safe_short = jnp.clip(lab, 0, shortlist - 1)
+    out = jnp.where(
+        in_short,
+        jnp.take_along_axis(head_logp, safe_short[:, None], axis=1)[:, 0],
+        out)
+    low = shortlist
+    n_clusters = len(tails) // 2
+    for i in range(n_clusters):
+        high = cutoffs[i + 1] if i + 1 < len(cutoffs) else cutoffs[-1]
+        w_down = tails[2 * i].astype(jnp.float32)
+        w_out = tails[2 * i + 1].astype(jnp.float32)
+        cluster_lp = head_logp[:, shortlist + i]
+        tail_logp = jax.nn.log_softmax((x @ w_down) @ w_out, axis=-1)
+        in_cluster = (lab >= low) & (lab < high)
+        safe_idx = jnp.clip(lab - low, 0, tail_logp.shape[1] - 1)
+        lp = cluster_lp + jnp.take_along_axis(
+            tail_logp, safe_idx[:, None], axis=1)[:, 0]
+        out = jnp.where(in_cluster, lp, out)
+        low = high
+    return out, -out.mean()
+
+
+defprim("adaptive_lsm_p", _adaptive_lsm_fwd, multi_out=True)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Reference: nn/functional/loss.py adaptive_log_softmax_with_loss —
+    hierarchical softmax over frequency-sorted clusters. Returns
+    (per-sample logprob output, mean nll loss)."""
+    x = ensure_tensor(input)
+    hw = ensure_tensor(head_weight)
+    hb = ensure_tensor(head_bias) if head_bias is not None else hw
+    tails = []
+    for pair in tail_weights:
+        tails.append(ensure_tensor(pair[0]))
+        tails.append(ensure_tensor(pair[1]))
+    return apply("adaptive_lsm_p", x, ensure_tensor(label), hw, hb, *tails,
+                 cutoffs=tuple(int(c) for c in cutoffs),
+                 use_bias=head_bias is not None)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Reference: nn/functional/common.py sequence_mask —
+    mask[i, ..., j] = j < x[i, ...]."""
+    from ...core.dtype import convert_dtype
+
+    x = ensure_tensor(x)
+    lens = x._value
+    if maxlen is None:
+        maxlen = int(np.asarray(lens).max())
+    mask = jnp.arange(int(maxlen))[None, :] < lens.reshape(-1, 1)
+    mask = mask.reshape(tuple(lens.shape) + (int(maxlen),))
+    return Tensor._from_value(mask.astype(convert_dtype(dtype)))
+
+
+def _reduce(loss, reduction):
+    from ...ops import math as m
+
+    if reduction == "mean":
+        return m.mean(loss)
+    if reduction == "sum":
+        return m.sum(loss)
+    return loss

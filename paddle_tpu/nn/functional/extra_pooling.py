@@ -1,0 +1,214 @@
+"""Pooling surface completion.
+
+Reference: python/paddle/nn/functional/pooling.py — max_unpool1d/2d/3d
+(scatter by recorded argmax indices), lp_pool1d/2d (p-norm windows),
+fractional_max_pool2d/3d (pseudo-random window boundaries, Graham 2014).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from ...ops._helpers import defprim, ensure_tensor
+
+__all__ = [
+    "max_unpool1d", "max_unpool2d", "max_unpool3d", "lp_pool1d", "lp_pool2d",
+    "fractional_max_pool2d", "fractional_max_pool3d",
+]
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+# ---------------------------------------------------------------------------
+# max_unpool — scatter values back to the argmax positions
+# ---------------------------------------------------------------------------
+def _max_unpool_nd(x, indices, *, out_spatial):
+    """x/indices: [N, C, *spatial_in]; indices index the FLAT output
+    spatial volume per (n, c) like the reference's max_pool return_mask."""
+    n, c = x.shape[0], x.shape[1]
+    in_flat = int(np.prod(x.shape[2:]))
+    out_flat = int(np.prod(out_spatial))
+    xv = x.reshape(n, c, in_flat)
+    iv = indices.reshape(n, c, in_flat).astype(jnp.int32)
+    out = jnp.zeros((n, c, out_flat), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda o, idx, val: o.at[idx].set(val)
+    ))(out, iv, xv)
+    return out.reshape((n, c) + tuple(out_spatial))
+
+
+defprim("max_unpool_p", _max_unpool_nd)
+
+
+def _unpool(x, indices, kernel_size, stride, padding, output_size, nd,
+            data_format):
+    if data_format not in ("NCL", "NCHW", "NCDHW"):
+        raise ValueError(f"unsupported data_format {data_format!r}")
+    x = ensure_tensor(x)
+    indices = ensure_tensor(indices)
+    k = _pair(kernel_size, nd)
+    s = _pair(stride if stride is not None else kernel_size, nd)
+    p = _pair(padding, nd)
+    if output_size is None:
+        out_spatial = tuple(
+            (x.shape[2 + i] - 1) * s[i] - 2 * p[i] + k[i] for i in range(nd))
+    else:
+        out_spatial = tuple(output_size[-nd:])
+    return apply("max_unpool_p", x, indices, out_spatial=out_spatial)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 1,
+                   data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 2,
+                   data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 3,
+                   data_format)
+
+
+# ---------------------------------------------------------------------------
+# lp_pool — (sum |x|^p)^(1/p) over windows
+# ---------------------------------------------------------------------------
+def _lp_pool(x, kernel, stride, padding, *, p, ceil_mode, nd):
+    spatial = x.shape[2:]
+    dims = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = [(0, 0), (0, 0)]
+    for i in range(nd):
+        lo = hi = padding[i]
+        size = spatial[i] + lo + hi
+        if ceil_mode:
+            out = -(-(size - kernel[i]) // stride[i]) + 1
+            need = (out - 1) * stride[i] + kernel[i] - size
+            hi += max(0, need)
+        pads.append((lo, hi))
+    xp = jnp.pad(x.astype(jnp.float32), pads)
+    if p == float("inf"):
+        return jax.lax.reduce_window(
+            xp, -jnp.inf, jax.lax.max, dims, strides, "VALID").astype(x.dtype)
+    summed = jax.lax.reduce_window(
+        jnp.abs(xp) ** p, 0.0, jax.lax.add, dims, strides, "VALID")
+    return (summed ** (1.0 / p)).astype(x.dtype)
+
+
+defprim("lp_pool_p", lambda x, *, kernel, stride, padding, p, ceil_mode, nd:
+        _lp_pool(x, kernel, stride, padding, p=p, ceil_mode=ceil_mode,
+                 nd=nd))
+
+
+def _lp_pool_call(x, norm_type, kernel_size, stride, padding, ceil_mode,
+                  data_format, nd, channels_last_fmt):
+    from ...ops.manipulation import transpose
+
+    x = ensure_tensor(x)
+    k = _pair(kernel_size, nd)
+    s = _pair(stride if stride is not None else kernel_size, nd)
+    pad = _pair(padding, nd)
+    if data_format == channels_last_fmt:
+        # channels-last: pool over the middle spatial dims
+        perm_in = [0, nd + 1] + list(range(1, nd + 1))
+        perm_out = [0] + list(range(2, nd + 2)) + [1]
+        out = apply("lp_pool_p", transpose(x, perm_in), kernel=k, stride=s,
+                    padding=pad, p=float(norm_type),
+                    ceil_mode=bool(ceil_mode), nd=nd)
+        return transpose(out, perm_out)
+    return apply("lp_pool_p", x, kernel=k, stride=s, padding=pad,
+                 p=float(norm_type), ceil_mode=bool(ceil_mode), nd=nd)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    return _lp_pool_call(x, norm_type, kernel_size, stride, padding,
+                         ceil_mode, data_format, 1, "NLC")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    return _lp_pool_call(x, norm_type, kernel_size, stride, padding,
+                         ceil_mode, data_format, 2, "NHWC")
+
+
+# ---------------------------------------------------------------------------
+# fractional max pool (Graham 2014 pseudo-random sequences)
+# ---------------------------------------------------------------------------
+def _frac_boundaries(in_size, out_size, u):
+    """alpha = in/out; index i -> ceil(alpha*(i+u)) - ceil(alpha*u)."""
+    alpha = in_size / out_size
+    i = np.arange(out_size + 1)
+    b = np.ceil(alpha * (i + u)).astype(int) - int(np.ceil(alpha * u))
+    b[-1] = in_size
+    return b
+
+
+def _fractional_pool(x, output_size, kernel_size, u, nd):
+    x = ensure_tensor(x)
+    spatial = x.shape[2:]
+    out_spatial = _pair(output_size, nd)
+    bounds = [
+        _frac_boundaries(spatial[i], out_spatial[i], u[i]) for i in range(nd)
+    ]
+    xv = x._value
+
+    def pool_axis(v, axis, b, k):
+        slices = []
+        for i in range(len(b) - 1):
+            lo = b[i]
+            hi = b[i + 1] if k is None else min(lo + k, v.shape[axis])
+            hi = max(hi, lo + 1)
+            slices.append(jnp.max(
+                jax.lax.slice_in_dim(v, lo, hi, axis=axis), axis=axis,
+                keepdims=True))
+        return jnp.concatenate(slices, axis=axis)
+
+    ks = _pair(kernel_size, nd) if kernel_size is not None else [None] * nd
+    for i in range(nd):
+        xv = pool_axis(xv, 2 + i, bounds[i], ks[i])
+    return Tensor._from_value(xv)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    """Reference: nn/functional/pooling.py fractional_max_pool2d."""
+    from ...core import generator
+
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool return_mask=True is not implemented in the "
+            "TPU build")
+    if random_u is None:
+        key = generator.next_key("local_seed")
+        u = float(jax.random.uniform(key, (), minval=1e-4, maxval=1.0 - 1e-4))
+    else:
+        u = float(random_u)
+    return _fractional_pool(x, output_size, kernel_size, (u, u), 2)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    from ...core import generator
+
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool return_mask=True is not implemented in the "
+            "TPU build")
+    if random_u is None:
+        key = generator.next_key("local_seed")
+        u = float(jax.random.uniform(key, (), minval=1e-4, maxval=1.0 - 1e-4))
+    else:
+        u = float(random_u)
+    return _fractional_pool(x, output_size, kernel_size, (u, u, u), 3)

@@ -70,17 +70,26 @@ def _pool(x, kind, kernel_size, stride, padding, n, data_format, exclusive=True,
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     df = "NCW" if data_format == "NCL" else "NWC"
+    if return_mask:
+        return _pool_with_mask(x, kernel_size, stride, padding, 1, df,
+                               ceil_mode)
     return _pool(x, "max", kernel_size, stride, padding, 1, df, ceil_mode=ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _pool_with_mask(x, kernel_size, stride, padding, 2,
+                               data_format, ceil_mode)
     return _pool(x, "max", kernel_size, stride, padding, 2, data_format,
                  ceil_mode=ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _pool_with_mask(x, kernel_size, stride, padding, 3,
+                               data_format, ceil_mode)
     return _pool(x, "max", kernel_size, stride, padding, 3, data_format,
                  ceil_mode=ceil_mode)
 
@@ -189,3 +198,46 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive(x, "max", output_size, 3, "NCDHW")
+
+
+def _max_pool_with_index_fwd(x, *, dims, strides, pads, channels_first):
+    """Max pool returning (out, flat-spatial argmax indices) — the mask the
+    reference's return_mask=True produces (consumed by max_unpool*)."""
+    if channels_first:
+        spatial = x.shape[2:]
+        idx_shape = (1, 1) + tuple(spatial)
+    else:
+        spatial = x.shape[1:-1]
+        idx_shape = (1,) + tuple(spatial) + (1,)
+    flat_idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(
+        idx_shape)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+
+    def select(acc, cur):
+        acc_v, acc_i = acc
+        cur_v, cur_i = cur
+        take_cur = cur_v > acc_v
+        return (jnp.where(take_cur, cur_v, acc_v),
+                jnp.where(take_cur, cur_i, acc_i))
+
+    init_v = (jnp.asarray(-jnp.inf, x.dtype)
+              if jnp.issubdtype(x.dtype, jnp.floating)
+              else jnp.asarray(jnp.iinfo(x.dtype).min, x.dtype))
+    out, idx = jax.lax.reduce_window(
+        (x, flat_idx), (init_v, jnp.int32(-1)), select, dims, strides, pads)
+    return out, idx
+
+
+defprim("max_pool_index_p", _max_pool_with_index_fwd, multi_out=True)
+
+
+def _pool_with_mask(x, kernel_size, stride, padding, n, data_format,
+                    ceil_mode):
+    x = ensure_tensor(x)
+    channels_first = data_format.startswith("NC")
+    kernel = _ntuple(kernel_size, n)
+    stride = _ntuple(stride if stride is not None else kernel_size, n)
+    dims, strides, pads = _window(kernel, stride, padding, n, channels_first,
+                                  ceil_mode)
+    return apply("max_pool_index_p", x, dims=dims, strides=strides,
+                 pads=pads, channels_first=bool(channels_first))

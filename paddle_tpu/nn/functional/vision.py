@@ -1,0 +1,168 @@
+"""Vision-geometry functionals.
+
+Reference: python/paddle/nn/functional/vision.py — affine_grid (inverse-
+warp sampling grids), grid_sample (bilinear/nearest with zeros/border/
+reflection padding), temporal_shift (TSM channel shift), plus
+gather_tree (beam backtrace, nn/decode.py-adjacent op).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from ...ops._helpers import defprim, ensure_tensor
+
+__all__ = ["affine_grid", "grid_sample", "temporal_shift", "gather_tree"]
+
+
+def _affine_grid_fwd(theta, *, out_shape, align_corners):
+    n, c, h, w = out_shape
+
+    def linspace(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = linspace(h)
+    xs = linspace(w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [H*W, 3]
+    grid = jnp.einsum("hk,nrk->nhr", base, theta.astype(jnp.float32))
+    return grid.reshape(n, h, w, 2)
+
+
+defprim("affine_grid_p", _affine_grid_fwd)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N, 2, 3] -> sampling grid [N, H, W, 2]
+    (reference: vision.py affine_grid)."""
+    theta = ensure_tensor(theta)
+    if hasattr(out_shape, "_value"):
+        out_shape = [int(v) for v in np.asarray(out_shape._value)]
+    return apply("affine_grid_p", theta, out_shape=tuple(int(v) for v in out_shape),
+                 align_corners=bool(align_corners))
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) / 2.0 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _reflect(x, lo, hi):
+    rng = hi - lo
+    if rng <= 0:
+        return jnp.zeros_like(x)
+    double = 2 * rng
+    x = jnp.mod(x - lo, double)
+    x = jnp.where(x > rng, double - x, x)
+    return x + lo
+
+
+def _grid_sample_fwd(x, grid, *, mode, padding_mode, align_corners):
+    n, c, h, w = x.shape
+    gx = _unnormalize(grid[..., 0].astype(jnp.float32), w, align_corners)
+    gy = _unnormalize(grid[..., 1].astype(jnp.float32), h, align_corners)
+
+    if padding_mode == "border":
+        gx = jnp.clip(gx, 0, w - 1)
+        gy = jnp.clip(gy, 0, h - 1)
+    elif padding_mode == "reflection":
+        if align_corners:
+            gx = _reflect(gx, 0, w - 1)
+            gy = _reflect(gy, 0, h - 1)
+        else:
+            gx = jnp.clip(_reflect(gx, -0.5, w - 0.5), 0, w - 1)
+            gy = jnp.clip(_reflect(gy, -0.5, h - 0.5), 0, h - 1)
+
+    def sample(ix, iy):
+        ok = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        vals = x[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [N, Hg, Wg, C]
+        return jnp.where(ok[..., None], vals, 0.0)
+
+    if mode == "nearest":
+        out = sample(jnp.round(gx).astype(jnp.int32),
+                     jnp.round(gy).astype(jnp.int32))
+    else:  # bilinear
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = gx - x0
+        wy = gy - y0
+        out = (
+            sample(x0, y0) * ((1 - wx) * (1 - wy))[..., None]
+            + sample(x1, y0) * (wx * (1 - wy))[..., None]
+            + sample(x0, y1) * ((1 - wx) * wy)[..., None]
+            + sample(x1, y1) * (wx * wy)[..., None]
+        )
+    return out.transpose(0, 3, 1, 2).astype(x.dtype)  # [N, C, Hg, Wg]
+
+
+defprim("grid_sample_p", _grid_sample_fwd)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Reference: vision.py grid_sample — x [N,C,H,W], grid [N,Hg,Wg,2]."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+    return apply("grid_sample_p", ensure_tensor(x), ensure_tensor(grid),
+                 mode=mode, padding_mode=padding_mode,
+                 align_corners=bool(align_corners))
+
+
+def _temporal_shift_fwd(x, *, seg_num, shift_ratio):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    v = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    back = jnp.concatenate(
+        [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]],
+        axis=1)
+    rest = v[:, :, 2 * fold:]
+    return jnp.concatenate([back, fwd, rest], axis=2).reshape(nt, c, h, w)
+
+
+defprim("temporal_shift_p", _temporal_shift_fwd)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM shift (reference: vision.py temporal_shift): first chunk shifts
+    backward in time, second forward, rest untouched."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"unsupported data_format {data_format!r}")
+    x = ensure_tensor(x)
+    if data_format == "NHWC":
+        from ...ops.manipulation import transpose
+
+        out = apply("temporal_shift_p", transpose(x, [0, 3, 1, 2]),
+                    seg_num=int(seg_num), shift_ratio=float(shift_ratio))
+        return transpose(out, [0, 2, 3, 1])
+    return apply("temporal_shift_p", x, seg_num=int(seg_num),
+                 shift_ratio=float(shift_ratio))
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference: tensor/manipulation.py gather_tree;
+    op behind BeamSearchDecoder.finalize). ids/parents: [T, B, beam]."""
+    ids_v = np.asarray(ensure_tensor(ids)._value)
+    par_v = np.asarray(ensure_tensor(parents)._value)
+    T, b, beam = ids_v.shape
+    out = np.zeros_like(ids_v)
+    beam_idx = np.tile(np.arange(beam)[None, :], (b, 1))
+    for t in range(T - 1, -1, -1):
+        out[t] = np.take_along_axis(ids_v[t], beam_idx, axis=1)
+        beam_idx = np.take_along_axis(par_v[t], beam_idx, axis=1)
+    return Tensor._from_value(jnp.asarray(out))

@@ -1,0 +1,662 @@
+"""Recurrent layers.
+
+Reference: python/paddle/nn/layer/rnn.py — RNNCellBase:*, SimpleRNNCell:741,
+LSTMCell:918 (gate order i,f,g,o; optional proj_size), GRUCell:1144
+(h = z*h_prev + (1-z)*c), RNN:1339, BiRNN:1421, SimpleRNN:1859, LSTM:1982,
+GRU:2119.
+
+TPU design: the per-step cell math is plain framework ops (usable eagerly
+and inside custom cells); the full-sequence layers run ONE `lax.scan`
+primitive per direction per layer — the recurrence compiles to a single
+fused XLA while-loop instead of per-step dispatch, and jax differentiates
+through the scan for BPTT. Variable-length sequences freeze the carried
+state and zero the outputs past each row's length, matching the reference's
+mask_fn semantics.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ..ops._helpers import defprim, ensure_tensor
+from .layer import Layer
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+    "SimpleRNN", "LSTM", "GRU",
+]
+
+
+# ---------------------------------------------------------------------------
+# sequence-scan primitives (one per cell type)
+# ---------------------------------------------------------------------------
+def _mask_step(t_idx, seq_lens, new, old):
+    """Freeze state rows whose sequence already ended (t >= len)."""
+    if seq_lens is None:
+        return new
+    alive = (t_idx < seq_lens)[:, None]
+    return jnp.where(alive, new, old)
+
+
+def _simple_rnn_step(x_t, h, w_ih, w_hh, b_ih, b_hh, act):
+    z = x_t @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    return jnp.tanh(z) if act == "tanh" else jnp.maximum(z, 0)
+
+
+def _simple_rnn_seq(x, h0, w_ih, w_hh, b_ih, b_hh, seq_lens, *, act,
+                    reverse, use_lens):
+    T = x.shape[0]
+    lens = seq_lens if use_lens else None
+
+    def step(h, xs):
+        t_idx, x_t = xs
+        h_new = _simple_rnn_step(x_t, h, w_ih, w_hh, b_ih, b_hh, act)
+        h_new = _mask_step(t_idx, lens, h_new, h)
+        out = h_new if lens is None else jnp.where(
+            (t_idx < lens)[:, None], h_new, 0.0)
+        return h_new, out
+
+    ts = jnp.arange(T)
+    if reverse:
+        x = x[::-1]
+        ts = ts[::-1]
+    h_T, outs = jax.lax.scan(step, h0, (ts, x))
+    if reverse:
+        outs = outs[::-1]
+    return outs, h_T
+
+
+defprim("simple_rnn_seq_p", _simple_rnn_seq, multi_out=True)
+
+
+def _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh, w_ho):
+    gates = x_t @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    if w_ho is not None:
+        h_new = h_new @ w_ho.T
+    return h_new, c_new
+
+
+def _lstm_seq(x, h0, c0, w_ih, w_hh, b_ih, b_hh, seq_lens, *, reverse,
+              use_lens, proj):
+    T = x.shape[0]
+    lens = seq_lens if use_lens else None
+    w_ho = None  # proj variant uses the 9-arg prim below
+
+    def step(carry, xs):
+        h, c = carry
+        t_idx, x_t = xs
+        h_new, c_new = _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh, w_ho)
+        h_new = _mask_step(t_idx, lens, h_new, h)
+        c_new = _mask_step(t_idx, lens, c_new, c)
+        out = h_new if lens is None else jnp.where(
+            (t_idx < lens)[:, None], h_new, 0.0)
+        return (h_new, c_new), out
+
+    ts = jnp.arange(T)
+    if reverse:
+        x = x[::-1]
+        ts = ts[::-1]
+    (h_T, c_T), outs = jax.lax.scan(step, (h0, c0), (ts, x))
+    if reverse:
+        outs = outs[::-1]
+    return outs, h_T, c_T
+
+
+defprim("lstm_seq_p", _lstm_seq, multi_out=True)
+
+
+def _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh):
+    xg = x_t @ w_ih.T + b_ih
+    hg = h @ w_hh.T + b_hh
+    xr, xz, xc = jnp.split(xg, 3, axis=-1)
+    hr, hz, hc = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    c = jnp.tanh(xc + r * hc)
+    return z * h + (1.0 - z) * c
+
+
+def _gru_seq(x, h0, w_ih, w_hh, b_ih, b_hh, seq_lens, *, reverse, use_lens):
+    T = x.shape[0]
+    lens = seq_lens if use_lens else None
+
+    def step(h, xs):
+        t_idx, x_t = xs
+        h_new = _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh)
+        h_new = _mask_step(t_idx, lens, h_new, h)
+        out = h_new if lens is None else jnp.where(
+            (t_idx < lens)[:, None], h_new, 0.0)
+        return h_new, out
+
+    ts = jnp.arange(T)
+    if reverse:
+        x = x[::-1]
+        ts = ts[::-1]
+    h_T, outs = jax.lax.scan(step, h0, (ts, x))
+    if reverse:
+        outs = outs[::-1]
+    return outs, h_T
+
+
+defprim("gru_seq_p", _gru_seq, multi_out=True)
+
+
+# ---------------------------------------------------------------------------
+# cells
+# ---------------------------------------------------------------------------
+class RNNCellBase(Layer):
+    """Reference: nn/layer/rnn.py RNNCellBase — get_initial_states."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or getattr(self, "state_shape")
+        if isinstance(shape, (list, tuple)) and shape and \
+                isinstance(shape[0], (list, tuple)):
+            return tuple(
+                Tensor._from_value(jnp.full((batch,) + tuple(
+                    s if s > 0 else 1 for s in sub), init_value,
+                    jnp.float32))
+                for sub in shape
+            )
+        return Tensor._from_value(
+            jnp.full((batch,) + tuple(s if s > 0 else 1 for s in shape),
+                     init_value, jnp.float32))
+
+    def _uniform_init(self):
+        from .initializer import Uniform
+
+        k = 1.0 / _math.sqrt(self.hidden_size)
+        return Uniform(-k, k)
+
+
+class SimpleRNNCell(RNNCellBase):
+    """Reference: nn/layer/rnn.py:741 — h = act(Wih x + bih + Whh h + bhh)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError("hidden_size must be positive")
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        init = self._uniform_init()
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        inputs = ensure_tensor(inputs)
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = apply("simple_rnn_cell_p", inputs, ensure_tensor(states),
+                  self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+                  act=self.activation)
+        return h, h
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+defprim(
+    "simple_rnn_cell_p",
+    lambda x, h, w_ih, w_hh, b_ih, b_hh, *, act: _simple_rnn_step(
+        x, h, w_ih, w_hh, b_ih, b_hh, act),
+)
+
+
+class LSTMCell(RNNCellBase):
+    """Reference: nn/layer/rnn.py:918 — gate order (i, f, g, o);
+    optional proj_size projects h."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError("hidden_size must be positive")
+        if proj_size is not None and proj_size >= hidden_size:
+            raise ValueError("proj_size must be smaller than hidden_size")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.proj_size = proj_size
+        init = self._uniform_init()
+        h_in = proj_size or hidden_size
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, h_in], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+        self.weight_ho = (
+            self.create_parameter([proj_size, hidden_size],
+                                  default_initializer=init)
+            if proj_size else None
+        )
+
+    @property
+    def state_shape(self):
+        return ((self.proj_size or self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        inputs = ensure_tensor(inputs)
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h_prev, c_prev = states
+        if self.weight_ho is not None:
+            h, c = apply("lstm_cell_proj_p", inputs, ensure_tensor(h_prev),
+                         ensure_tensor(c_prev), self.weight_ih,
+                         self.weight_hh, self.bias_ih, self.bias_hh,
+                         self.weight_ho)
+        else:
+            h, c = apply("lstm_cell_p", inputs, ensure_tensor(h_prev),
+                         ensure_tensor(c_prev), self.weight_ih,
+                         self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, (h, c)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+defprim(
+    "lstm_cell_p",
+    lambda x, h, c, w_ih, w_hh, b_ih, b_hh: _lstm_step(
+        x, h, c, w_ih, w_hh, b_ih, b_hh, None),
+    multi_out=True,
+)
+defprim(
+    "lstm_cell_proj_p",
+    lambda x, h, c, w_ih, w_hh, b_ih, b_hh, w_ho: _lstm_step(
+        x, h, c, w_ih, w_hh, b_ih, b_hh, w_ho),
+    multi_out=True,
+)
+
+
+class GRUCell(RNNCellBase):
+    """Reference: nn/layer/rnn.py:1144 — gate order (r, z, c);
+    h = z*h_prev + (1-z)*c."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError("hidden_size must be positive")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = self._uniform_init()
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        inputs = ensure_tensor(inputs)
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = apply("gru_cell_p", inputs, ensure_tensor(states),
+                  self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+defprim(
+    "gru_cell_p",
+    lambda x, h, w_ih, w_hh, b_ih, b_hh: _gru_step(
+        x, h, w_ih, w_hh, b_ih, b_hh),
+)
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+class RNN(Layer):
+    """Generic cell-over-time wrapper (reference: nn/layer/rnn.py:1339).
+    Runs any RNNCell across the time dim with a Python loop (custom cells
+    may carry arbitrary state pytrees)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import stack, transpose
+
+        inputs = ensure_tensor(inputs)
+        if not self.time_major:
+            inputs = transpose(inputs, [1, 0, 2])
+        T = inputs.shape[0]
+        states = initial_states
+        if states is None:
+            batch_ref = transpose(inputs, [1, 0, 2])
+            states = self.cell.get_initial_states(batch_ref)
+        lens = (np.asarray(ensure_tensor(sequence_length)._value)
+                if sequence_length is not None else None)
+        order = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outs = [None] * T
+        for t in order:
+            out_t, new_states = self.cell(inputs[t], states)
+            if lens is not None:
+                alive = Tensor._from_value(
+                    jnp.asarray(t < lens)[:, None].astype(jnp.float32))
+                out_t = out_t * alive
+
+                def keep(new, old):
+                    return new * alive + ensure_tensor(old) * (
+                        Tensor._from_value(jnp.asarray(1.0)) - alive)
+
+                states = jax.tree_util.tree_map(
+                    keep, new_states, states,
+                    is_leaf=lambda v: isinstance(v, Tensor))
+            else:
+                states = new_states
+            outs[t] = out_t
+        outputs = stack(outs, axis=0)
+        if not self.time_major:
+            outputs = transpose(outputs, [1, 0, 2])
+        return outputs, states
+
+
+class BiRNN(Layer):
+    """Two RNN passes (fw/bw) with concatenated outputs
+    (reference: nn/layer/rnn.py:1421)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import concat
+
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class RNNBase(Layer):
+    """Multi-layer (bi)directional recurrence over the scan primitives.
+
+    Reference: nn/layer/rnn.py RNNBase — mode in SimpleRNN/LSTM/GRU,
+    direction "forward" | "bidirect"/"bidirectional", dropout between
+    layers, time_major, sequence_length masking.
+    """
+
+    MODE = None  # "RNN_TANH"/"RNN_RELU"/"LSTM"/"GRU"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=None,
+                 name=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"unknown direction {direction!r}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = direction != "forward"
+        self.num_directions = 2 if self.bidirectional else 1
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.proj_size = proj_size
+
+        gate_mult = {"LSTM": 4, "GRU": 3}.get(self.MODE, 1)
+        init_std = 1.0 / _math.sqrt(hidden_size)
+        from .initializer import Uniform
+
+        init = Uniform(-init_std, init_std)
+        h_out = proj_size or hidden_size
+
+        self._all_weights = []
+        for layer in range(num_layers):
+            for direction_i in range(self.num_directions):
+                in_sz = (input_size if layer == 0
+                         else h_out * self.num_directions)
+                suffix = "_reverse" if direction_i else ""
+                w_ih = self.create_parameter(
+                    [gate_mult * hidden_size, in_sz], attr=weight_ih_attr,
+                    default_initializer=init)
+                w_hh = self.create_parameter(
+                    [gate_mult * hidden_size, h_out], attr=weight_hh_attr,
+                    default_initializer=init)
+                b_ih = self.create_parameter(
+                    [gate_mult * hidden_size], attr=bias_ih_attr,
+                    is_bias=True, default_initializer=init)
+                b_hh = self.create_parameter(
+                    [gate_mult * hidden_size], attr=bias_hh_attr,
+                    is_bias=True, default_initializer=init)
+                names = [f"weight_ih_l{layer}{suffix}",
+                         f"weight_hh_l{layer}{suffix}",
+                         f"bias_ih_l{layer}{suffix}",
+                         f"bias_hh_l{layer}{suffix}"]
+                params = [w_ih, w_hh, b_ih, b_hh]
+                if self.MODE == "LSTM" and proj_size:
+                    w_ho = self.create_parameter(
+                        [proj_size, hidden_size], default_initializer=init)
+                    names.append(f"weight_ho_l{layer}{suffix}")
+                    params.append(w_ho)
+                for n, p in zip(names, params):
+                    self.add_parameter(n, p)
+                self._all_weights.append(dict(zip(
+                    ["w_ih", "w_hh", "b_ih", "b_hh", "w_ho"],
+                    params + [None] * (5 - len(params)))))
+
+    def _run_direction(self, xt, h0, c0, weights, reverse, lens):
+        """xt: [T, B, I] Tensor; returns (outs [T, B, H], h_T, c_T|None)."""
+        use_lens = lens is not None
+        lens_t = (Tensor._from_value(lens) if use_lens
+                  else Tensor._from_value(jnp.zeros((xt.shape[1],),
+                                                    jnp.int64)))
+        if self.MODE == "LSTM":
+            if weights["w_ho"] is not None:
+                outs, h_T, c_T = apply(
+                    "lstm_seq_proj_p", xt, h0, c0, weights["w_ih"],
+                    weights["w_hh"], weights["b_ih"], weights["b_hh"],
+                    weights["w_ho"], lens_t, reverse=reverse,
+                    use_lens=use_lens)
+            else:
+                outs, h_T, c_T = apply(
+                    "lstm_seq_p", xt, h0, c0, weights["w_ih"],
+                    weights["w_hh"], weights["b_ih"], weights["b_hh"],
+                    lens_t, reverse=reverse, use_lens=use_lens, proj=False)
+            return outs, h_T, c_T
+        if self.MODE == "GRU":
+            outs, h_T = apply(
+                "gru_seq_p", xt, h0, weights["w_ih"], weights["w_hh"],
+                weights["b_ih"], weights["b_hh"], lens_t, reverse=reverse,
+                use_lens=use_lens)
+            return outs, h_T, None
+        act = "relu" if self.MODE == "RNN_RELU" else "tanh"
+        outs, h_T = apply(
+            "simple_rnn_seq_p", xt, h0, weights["w_ih"], weights["w_hh"],
+            weights["b_ih"], weights["b_hh"], lens_t, act=act,
+            reverse=reverse, use_lens=use_lens)
+        return outs, h_T, None
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..nn.functional.common import dropout as dropout_fn
+        from ..ops.manipulation import concat, stack, transpose
+
+        inputs = ensure_tensor(inputs)
+        if not self.time_major:
+            inputs = transpose(inputs, [1, 0, 2])
+        T, B = inputs.shape[0], inputs.shape[1]
+        nd = self.num_directions
+        h_out = self.proj_size or self.hidden_size
+
+        lens = (ensure_tensor(sequence_length)._value
+                if sequence_length is not None else None)
+
+        is_lstm = self.MODE == "LSTM"
+        if initial_states is None:
+            zeros_h = Tensor._from_value(
+                jnp.zeros((self.num_layers * nd, B, h_out), jnp.float32))
+            zeros_c = Tensor._from_value(
+                jnp.zeros((self.num_layers * nd, B, self.hidden_size),
+                          jnp.float32))
+            initial_states = (zeros_h, zeros_c) if is_lstm else zeros_h
+        if is_lstm:
+            h_all, c_all = initial_states
+            h_all, c_all = ensure_tensor(h_all), ensure_tensor(c_all)
+        else:
+            h_all = ensure_tensor(initial_states)
+            c_all = None
+
+        x = inputs
+        final_h, final_c = [], []
+        for layer in range(self.num_layers):
+            outs_dir = []
+            for d in range(nd):
+                idx = layer * nd + d
+                weights = self._all_weights[idx]
+                h0 = h_all[idx]
+                c0 = c_all[idx] if c_all is not None else h0
+                outs, h_T, c_T = self._run_direction(
+                    x, h0, c0, weights, reverse=bool(d), lens=lens)
+                outs_dir.append(outs)
+                final_h.append(h_T)
+                if c_T is not None:
+                    final_c.append(c_T)
+            x = outs_dir[0] if nd == 1 else concat(outs_dir, axis=-1)
+            if self.dropout > 0.0 and layer < self.num_layers - 1:
+                x = dropout_fn(x, self.dropout, training=self.training)
+
+        outputs = x
+        if not self.time_major:
+            outputs = transpose(outputs, [1, 0, 2])
+        h_stack = stack(final_h, axis=0)
+        if is_lstm:
+            return outputs, (h_stack, stack(final_c, axis=0))
+        return outputs, h_stack
+
+    def extra_repr(self):
+        return (f"{self.input_size}, {self.hidden_size}, "
+                f"num_layers={self.num_layers}, "
+                f"bidirectional={self.bidirectional}")
+
+
+def _lstm_seq_proj(x, h0, c0, w_ih, w_hh, b_ih, b_hh, w_ho, seq_lens, *,
+                   reverse, use_lens):
+    T = x.shape[0]
+    lens = seq_lens if use_lens else None
+
+    def step(carry, xs):
+        h, c = carry
+        t_idx, x_t = xs
+        h_new, c_new = _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh, w_ho)
+        h_new = _mask_step(t_idx, lens, h_new, h)
+        c_new = _mask_step(t_idx, lens, c_new, c)
+        out = h_new if lens is None else jnp.where(
+            (t_idx < lens)[:, None], h_new, 0.0)
+        return (h_new, c_new), out
+
+    ts = jnp.arange(T)
+    if reverse:
+        x = x[::-1]
+        ts = ts[::-1]
+    (h_T, c_T), outs = jax.lax.scan(step, (h0, c0), (ts, x))
+    if reverse:
+        outs = outs[::-1]
+    return outs, h_T, c_T
+
+
+defprim("lstm_seq_proj_p", _lstm_seq_proj, multi_out=True)
+
+
+class SimpleRNN(RNNBase):
+    """Reference: nn/layer/rnn.py:1859."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        self.MODE = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class LSTM(RNNBase):
+    """Reference: nn/layer/rnn.py:1982."""
+
+    MODE = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, proj_size=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, None, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr,
+                         proj_size)
+
+
+class GRU(RNNBase):
+    """Reference: nn/layer/rnn.py:2119."""
+
+    MODE = "GRU"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, None, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)

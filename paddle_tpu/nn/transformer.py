@@ -1,0 +1,389 @@
+"""Transformer layers.
+
+Reference: python/paddle/nn/layer/transformer.py — MultiHeadAttention (:221
+q/k/v/out projections, Cache/StaticCache gen_cache, forward :484),
+TransformerEncoderLayer (:~640), TransformerEncoder, TransformerDecoderLayer,
+TransformerDecoder, Transformer (full seq2seq with
+generate_square_subsequent_mask).
+
+Attention rides the framework SDPA path (Pallas flash on chip); caches are
+functional tuples returned alongside outputs, matching the reference's
+namedtuple Cache semantics.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops._helpers import ensure_tensor
+from .common_layers import Dropout, Linear
+from .container import LayerList
+from .layer import Layer
+from .norm_layers import LayerNorm
+
+__all__ = [
+    "MultiHeadAttention", "TransformerEncoderLayer", "TransformerEncoder",
+    "TransformerDecoderLayer", "TransformerDecoder", "Transformer",
+]
+
+
+def _convert_attn_mask(mask, dtype):
+    """bool mask (True=keep) -> additive; float mask passes through."""
+    if mask is None:
+        return None
+    mask = ensure_tensor(mask)
+    import jax.numpy as jnp
+
+    v = mask._value
+    if v.dtype == jnp.bool_:
+        v = jnp.where(v, 0.0, -1e9).astype(jnp.float32)
+    return Tensor._from_value(v)
+
+
+class MultiHeadAttention(Layer):
+    """Reference: nn/layer/transformer.py MultiHeadAttention."""
+
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
+                 vdim=None, need_weights=False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        assert embed_dim > 0 and num_heads > 0
+        self.embed_dim = embed_dim
+        self.kdim = kdim or embed_dim
+        self.vdim = vdim or embed_dim
+        self.num_heads = num_heads
+        self.dropout = dropout
+        self.need_weights = need_weights
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr,
+                             bias_attr=bias_attr)
+        self.k_proj = Linear(self.kdim, embed_dim, weight_attr,
+                             bias_attr=bias_attr)
+        self.v_proj = Linear(self.vdim, embed_dim, weight_attr,
+                             bias_attr=bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr,
+                               bias_attr=bias_attr)
+
+    def _shape(self, x):
+        from ..ops.manipulation import reshape
+
+        b, s, _ = x.shape
+        return reshape(x, [b, s, self.num_heads, self.head_dim])
+
+    def compute_kv(self, key, value):
+        return self._shape(self.k_proj(key)), self._shape(self.v_proj(value))
+
+    def gen_cache(self, key, value=None, type=None):
+        """Reference :356 — StaticCache for cross-attention (precomputed
+        k/v), Cache for incremental self-attention."""
+        type = type or MultiHeadAttention.Cache
+        if type is MultiHeadAttention.StaticCache:
+            k, v = self.compute_kv(key, value if value is not None else key)
+            return self.StaticCache(k, v)
+        import jax.numpy as jnp
+
+        if value is None:
+            # key is a batch-reference tensor
+            b = key.shape[0]
+            k = Tensor._from_value(jnp.zeros(
+                (b, 0, self.num_heads, self.head_dim), jnp.float32))
+            return self.Cache(k, k)
+        return self.Cache(key, value)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        from ..nn.functional.attention import scaled_dot_product_attention
+        from ..ops.manipulation import concat, reshape
+
+        query = ensure_tensor(query)
+        key = query if key is None else ensure_tensor(key)
+        value = key if value is None else ensure_tensor(value)
+
+        q = self._shape(self.q_proj(query))
+        if isinstance(cache, MultiHeadAttention.StaticCache):
+            k, v = cache.k, cache.v
+        else:
+            k, v = self.compute_kv(key, value)
+            if isinstance(cache, MultiHeadAttention.Cache):
+                k = concat([cache.k, k], axis=1)
+                v = concat([cache.v, v], axis=1)
+                cache = self.Cache(k, v)
+
+        mask = _convert_attn_mask(attn_mask, q.dtype)
+        out = scaled_dot_product_attention(
+            q, k, v, attn_mask=mask, dropout_p=self.dropout,
+            is_causal=False, training=self.training)
+        b, s = out.shape[0], out.shape[1]
+        out = self.out_proj(reshape(out, [b, s, self.embed_dim]))
+        if self.need_weights:
+            # flash path doesn't expose probs; recompute explicitly
+            import jax
+            import jax.numpy as jnp
+
+            qv = q._value.transpose(0, 2, 1, 3).astype(jnp.float32)
+            kv_ = k._value.transpose(0, 2, 1, 3).astype(jnp.float32)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", qv, kv_) / np.sqrt(
+                self.head_dim)
+            if mask is not None:
+                scores = scores + mask._value
+            weights = Tensor._from_value(jax.nn.softmax(scores, axis=-1))
+            outs = (out, weights)
+        else:
+            outs = (out,)
+        if cache is not None and not isinstance(
+                cache, MultiHeadAttention.StaticCache):
+            outs = outs + (cache,)
+        return out if len(outs) == 1 else outs
+
+
+class TransformerEncoderLayer(Layer):
+    """Reference: nn/layer/transformer.py TransformerEncoderLayer."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 layer_norm_eps=1e-5):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead,
+                                            dropout=attn_dropout,
+                                            weight_attr=weight_attr,
+                                            bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr,
+                              bias_attr=bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr,
+                              bias_attr=bias_attr)
+        self.norm1 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.act_dropout = Dropout(act_dropout)
+        self._act = activation
+
+    def _activation(self, x):
+        from ..ops import activation as A
+
+        return {"relu": A.relu, "gelu": A.gelu}[self._act](x)
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm1(src)
+        if cache is None:
+            attn_out = self.self_attn(src, src, src, src_mask)
+        else:
+            attn_out, cache = self.self_attn(src, src, src, src_mask, cache)
+        src = residual + self.dropout1(attn_out)
+        if not self.normalize_before:
+            src = self.norm1(src)
+        residual = src
+        if self.normalize_before:
+            src = self.norm2(src)
+        src = self.linear2(self.act_dropout(self._activation(
+            self.linear1(src))))
+        src = residual + self.dropout2(src)
+        if not self.normalize_before:
+            src = self.norm2(src)
+        return src if cache is None else (src, cache)
+
+    def gen_cache(self, src):
+        return self.self_attn.gen_cache(src)
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+
+        self.layers = LayerList([
+            encoder_layer if i == 0 else copy.deepcopy(encoder_layer)
+            for i in range(num_layers)
+        ])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, src, src_mask=None, cache=None):
+        output = src
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                output = layer(output, src_mask)
+            else:
+                output, c = layer(output, src_mask, cache[i])
+                new_caches.append(c)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
+
+
+class TransformerDecoderLayer(Layer):
+    """Self-attn + cross-attn + FFN (reference TransformerDecoderLayer)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 layer_norm_eps=1e-5):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead,
+                                            dropout=attn_dropout,
+                                            weight_attr=weight_attr,
+                                            bias_attr=bias_attr)
+        self.cross_attn = MultiHeadAttention(d_model, nhead,
+                                             dropout=attn_dropout,
+                                             weight_attr=weight_attr,
+                                             bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr,
+                              bias_attr=bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr,
+                              bias_attr=bias_attr)
+        self.norm1 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm3 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(dropout)
+        self.act_dropout = Dropout(act_dropout)
+        self._act = activation
+
+    def _activation(self, x):
+        from ..ops import activation as A
+
+        return {"relu": A.relu, "gelu": A.gelu}[self._act](x)
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm1(tgt)
+        if cache is None:
+            attn_out = self.self_attn(tgt, tgt, tgt, tgt_mask)
+            new_self_cache = None
+        else:
+            attn_out, new_self_cache = self.self_attn(
+                tgt, tgt, tgt, tgt_mask, cache[0])
+        tgt = residual + self.dropout1(attn_out)
+        if not self.normalize_before:
+            tgt = self.norm1(tgt)
+
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm2(tgt)
+        if cache is None:
+            cross_out = self.cross_attn(tgt, memory, memory, memory_mask)
+        else:
+            cross_out = self.cross_attn(tgt, memory, memory, memory_mask,
+                                        cache[1])
+        tgt = residual + self.dropout2(cross_out)
+        if not self.normalize_before:
+            tgt = self.norm2(tgt)
+
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm3(tgt)
+        tgt = self.linear2(self.act_dropout(self._activation(
+            self.linear1(tgt))))
+        tgt = residual + self.dropout3(tgt)
+        if not self.normalize_before:
+            tgt = self.norm3(tgt)
+        return tgt if cache is None else (tgt, (new_self_cache, cache[1]))
+
+    def gen_cache(self, memory):
+        incremental = self.self_attn.gen_cache(memory)
+        static = self.cross_attn.gen_cache(
+            memory, memory, type=MultiHeadAttention.StaticCache)
+        return incremental, static
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, decoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+
+        self.layers = LayerList([
+            decoder_layer if i == 0 else copy.deepcopy(decoder_layer)
+            for i in range(num_layers)
+        ])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        output = tgt
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                output = layer(output, memory, tgt_mask, memory_mask)
+            else:
+                output, c = layer(output, memory, tgt_mask, memory_mask,
+                                  cache[i])
+                new_caches.append(c)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, memory, do_zip=False):
+        caches = [layer.gen_cache(memory) for layer in self.layers]
+        if do_zip:
+            caches = list(zip(*caches))
+        return caches
+
+
+class Transformer(Layer):
+    """Full encoder-decoder (reference: nn/layer/transformer.py Transformer)."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None):
+        super().__init__()
+        self.d_model = d_model
+        self.nhead = nhead
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            enc_layer = TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
+            enc_norm = LayerNorm(d_model) if normalize_before else None
+            self.encoder = TransformerEncoder(enc_layer, num_encoder_layers,
+                                              enc_norm)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            dec_layer = TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
+            dec_norm = LayerNorm(d_model) if normalize_before else None
+            self.decoder = TransformerDecoder(dec_layer, num_decoder_layers,
+                                              dec_norm)
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask)
+        return self.decoder(tgt, memory, tgt_mask, memory_mask)
+
+    def generate_square_subsequent_mask(self, length):
+        import jax.numpy as jnp
+
+        mask = jnp.where(
+            jnp.arange(length)[:, None] >= jnp.arange(length)[None, :],
+            0.0, float("-inf"),
+        ).astype(jnp.float32)
+        return Tensor._from_value(mask)

@@ -1,0 +1,415 @@
+"""nn loss/pooling/vision surface completion tests.
+
+Reference models: test/legacy_test/test_ctc_loss.py (vs torch),
+test_warprnnt_op.py, test_hsigmoid_op.py, test_poisson_nll_loss.py,
+test_gaussian_nll_loss.py, test_multi_margin_loss.py, test_unpool*.py,
+test_lp_pool*.py, test_affine_grid_op.py, test_grid_sampler_op.py,
+test_temporal_shift_op.py. Oracles: torch (cpu) and numpy.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _r(*shape):
+    return np.random.randn(*shape).astype("float32")
+
+
+class TestCTC:
+    def test_matches_torch_all_reductions(self):
+        np.random.seed(0)
+        T, B, C, L = 10, 2, 6, 3
+        logits = _r(T, B, C)
+        labels = np.random.randint(1, C, (B, L)).astype("int32")
+        in_lens = np.array([10, 8], dtype="int64")
+        lab_lens = np.array([3, 2], dtype="int64")
+        for reduction in ("none", "mean", "sum"):
+            got = F.ctc_loss(paddle.to_tensor(logits),
+                             paddle.to_tensor(labels),
+                             paddle.to_tensor(in_lens),
+                             paddle.to_tensor(lab_lens),
+                             reduction=reduction)
+            want = torch.nn.functional.ctc_loss(
+                torch.log_softmax(torch.tensor(logits), -1),
+                torch.tensor(labels.astype("int64")),
+                torch.tensor(in_lens), torch.tensor(lab_lens),
+                reduction=reduction)
+            np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4,
+                                       atol=1e-4)
+
+    def test_layer_and_grad(self):
+        logits = paddle.to_tensor(_r(8, 2, 5), stop_gradient=False)
+        loss = nn.CTCLoss()(logits,
+                            paddle.to_tensor(np.array([[1, 2], [3, 4]],
+                                                      dtype="int32")),
+                            paddle.to_tensor(np.array([8, 8], dtype="int64")),
+                            paddle.to_tensor(np.array([2, 2], dtype="int64")))
+        loss.backward()
+        assert logits.grad is not None
+        assert np.isfinite(logits.grad.numpy()).all()
+
+
+class TestRNNT:
+    def test_layer_runs_and_decreases(self):
+        paddle.seed(0)
+        np.random.seed(0)
+        B, T, U, V = 2, 4, 2, 5
+        logits = paddle.to_tensor(_r(B, T, U + 1, V), stop_gradient=False)
+        labels = paddle.to_tensor(
+            np.random.randint(1, V, (B, U)).astype("int32"))
+        loss = nn.RNNTLoss()(logits, labels,
+                             paddle.to_tensor(np.array([4, 3], dtype="int64")),
+                             paddle.to_tensor(np.array([2, 1], dtype="int64")))
+        loss.backward()
+        assert float(loss.numpy()) > 0
+        assert np.isfinite(logits.grad.numpy()).all()
+
+
+class TestSimpleLosses:
+    def test_poisson_nll_vs_torch(self):
+        x, t = _r(4, 5), np.abs(_r(4, 5))
+        for log_input in (True, False):
+            for full in (True, False):
+                got = F.poisson_nll_loss(paddle.to_tensor(x),
+                                         paddle.to_tensor(t),
+                                         log_input=log_input, full=full)
+                want = torch.nn.functional.poisson_nll_loss(
+                    torch.tensor(np.abs(x) if not log_input else x),
+                    torch.tensor(t), log_input=log_input, full=full)
+                if log_input:
+                    np.testing.assert_allclose(got.numpy(), want.numpy(),
+                                               rtol=1e-4, atol=1e-5)
+
+    def test_gaussian_nll_vs_torch(self):
+        x, t, var = _r(4, 5), _r(4, 5), np.abs(_r(4, 5)) + 0.1
+        got = F.gaussian_nll_loss(paddle.to_tensor(x), paddle.to_tensor(t),
+                                  paddle.to_tensor(var))
+        want = torch.nn.functional.gaussian_nll_loss(
+            torch.tensor(x), torch.tensor(t), torch.tensor(var))
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_multi_margin_vs_torch(self):
+        x = _r(4, 6)
+        lab = np.array([0, 2, 4, 1], dtype="int64")
+        got = F.multi_margin_loss(paddle.to_tensor(x), paddle.to_tensor(lab))
+        want = torch.nn.functional.multi_margin_loss(
+            torch.tensor(x), torch.tensor(lab))
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_triplet_with_distance_vs_torch(self):
+        a, p, n = _r(4, 8), _r(4, 8), _r(4, 8)
+        got = F.triplet_margin_with_distance_loss(
+            paddle.to_tensor(a), paddle.to_tensor(p), paddle.to_tensor(n),
+            margin=0.5, swap=True)
+        want = torch.nn.functional.triplet_margin_with_distance_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n), margin=0.5,
+            swap=True)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_pairwise_distance_vs_torch(self):
+        x, y = _r(4, 8), _r(4, 8)
+        got = nn.PairwiseDistance(p=2.0)(paddle.to_tensor(x),
+                                         paddle.to_tensor(y))
+        want = torch.nn.functional.pairwise_distance(
+            torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_dice_loss(self):
+        probs = np.random.rand(2, 4, 3).astype("float32")
+        probs = probs / probs.sum(-1, keepdims=True)
+        lab = np.random.randint(0, 3, (2, 4, 1)).astype("int64")
+        got = F.dice_loss(paddle.to_tensor(probs), paddle.to_tensor(lab))
+        assert 0 <= float(got.numpy()) <= 1
+
+    def test_hsigmoid_runs_and_learns(self):
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(8, 6)
+        x = paddle.to_tensor(_r(16, 8), stop_gradient=False)
+        lab = paddle.to_tensor(np.random.randint(0, 6, (16,)).astype("int64"))
+        loss = layer(x, lab).mean()
+        loss.backward()
+        assert float(loss.numpy()) > 0
+        assert layer.weight.grad is not None
+
+    def test_adaptive_log_softmax(self):
+        paddle.seed(0)
+        m = nn.AdaptiveLogSoftmaxWithLoss(16, 20, [4, 10], div_value=2.0)
+        x = paddle.to_tensor(_r(8, 16))
+        lab = paddle.to_tensor(np.random.randint(0, 20, (8,)).astype("int64"))
+        out, loss = m(x, lab)
+        assert out.shape == [8] and float(loss.numpy()) > 0
+        lp = m.log_prob(x)
+        assert lp.shape == [8, 20]
+        # log_prob rows are (log of a) distribution
+        np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1), np.ones(8),
+                                   rtol=1e-4)
+        # loss equals mean of -log_prob at the labels
+        picked = np.take_along_axis(lp.numpy(),
+                                    lab.numpy()[:, None], 1)[:, 0]
+        np.testing.assert_allclose(float(loss.numpy()), -picked.mean(),
+                                   rtol=1e-4)
+        pred = m.predict(x)
+        assert pred.shape == [8]
+
+    def test_margin_cross_entropy(self):
+        logits = np.random.uniform(-1, 1, (4, 10)).astype("float32")
+        lab = np.array([1, 3, 5, 7], dtype="int64")
+        loss, sm = F.margin_cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(lab),
+            return_softmax=True)
+        assert float(loss.numpy()) > 0
+        np.testing.assert_allclose(sm.numpy().sum(-1), np.ones(4), rtol=1e-4)
+
+    def test_class_center_sample(self):
+        lab = paddle.to_tensor(np.array([0, 5, 5, 9], dtype="int64"))
+        remap, sampled = F.class_center_sample(lab, 20, 6)
+        s = sampled.numpy()
+        assert {0, 5, 9}.issubset(set(s.tolist())) and len(s) == 6
+        # remapped labels point at the positions of the original classes
+        assert (s[remap.numpy()] == lab.numpy()).all()
+
+    def test_sequence_mask(self):
+        m = F.sequence_mask(paddle.to_tensor(np.array([2, 4], dtype="int64")),
+                            maxlen=5)
+        want = np.array([[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+        np.testing.assert_array_equal(m.numpy(), want)
+
+
+class TestPoolingExtras:
+    def test_max_unpool2d_roundtrip(self):
+        x = _r(1, 2, 6, 6)
+        xp = paddle.to_tensor(x)
+        pooled, indices = F.max_pool2d(xp, 2, 2, return_mask=True)
+        unpooled = F.max_unpool2d(pooled, indices, 2, 2)
+        assert unpooled.shape == [1, 2, 6, 6]
+        # every pooled max value must appear at its original location
+        t_pooled, t_idx = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 2, 2, return_indices=True)
+        t_unpooled = torch.nn.functional.max_unpool2d(t_pooled, t_idx, 2, 2)
+        np.testing.assert_allclose(unpooled.numpy(), t_unpooled.numpy(),
+                                   rtol=1e-5)
+
+    def test_max_unpool1d(self):
+        x = _r(1, 2, 8)
+        pooled, idx = F.max_pool1d(paddle.to_tensor(x), 2, 2,
+                                   return_mask=True)
+        up = nn.MaxUnPool1D(2, 2)(pooled, idx)
+        assert up.shape == [1, 2, 8]
+
+    def test_lp_pool_vs_torch(self):
+        x = _r(2, 3, 8, 8)
+        got = F.lp_pool2d(paddle.to_tensor(x), 2.0, 2, 2)
+        want = torch.nn.functional.lp_pool2d(torch.tensor(x), 2.0, 2, 2)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        got1 = nn.LPPool1D(3.0, 2)(paddle.to_tensor(_r(2, 3, 8)))
+        assert got1.shape == [2, 3, 4]
+
+    def test_fractional_max_pool(self):
+        x = _r(1, 2, 9, 9)
+        out = F.fractional_max_pool2d(paddle.to_tensor(x), output_size=4,
+                                      random_u=0.5)
+        assert out.shape == [1, 2, 4, 4]
+        # every output is the max of SOME window: must appear in input
+        assert np.isin(out.numpy(), x).all()
+        out3 = nn.FractionalMaxPool3D(output_size=2, random_u=0.3)(
+            paddle.to_tensor(_r(1, 1, 5, 5, 5)))
+        assert out3.shape == [1, 1, 2, 2, 2]
+
+
+class TestVisionOps:
+    def test_affine_grid_vs_torch(self):
+        theta = _r(2, 2, 3)
+        for ac in (True, False):
+            got = F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5],
+                                align_corners=ac)
+            want = torch.nn.functional.affine_grid(
+                torch.tensor(theta), (2, 3, 4, 5), align_corners=ac)
+            np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4,
+                                       atol=1e-5)
+
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+    def test_grid_sample_vs_torch(self, mode, pad):
+        np.random.seed(1)
+        x = _r(2, 3, 5, 6)
+        grid = np.random.uniform(-1.3, 1.3, (2, 4, 4, 2)).astype("float32")
+        got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            mode=mode, padding_mode=pad, align_corners=True)
+        want = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), mode=mode,
+            padding_mode=pad, align_corners=True)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_temporal_shift(self):
+        x = _r(4, 8, 2, 2)  # nt=4 (n=2, t=2)
+        got = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                               shift_ratio=0.25)
+        v = x.reshape(2, 2, 8, 2, 2)
+        # first quarter channels shift backward: out[:, t, :2] = v[:, t+1, :2]
+        np.testing.assert_allclose(got.numpy().reshape(2, 2, 8, 2, 2)[:, 0, :2],
+                                   v[:, 1, :2], rtol=1e-6)
+        np.testing.assert_allclose(got.numpy().reshape(2, 2, 8, 2, 2)[:, 1, :2],
+                                   0.0)
+
+    def test_gather_tree(self):
+        ids = paddle.to_tensor(np.array(
+            [[[2, 2]], [[3, 4]], [[5, 6]]], dtype="int64"))
+        parents = paddle.to_tensor(np.array(
+            [[[0, 0]], [[0, 0]], [[1, 0]]], dtype="int64"))
+        out = F.gather_tree(ids, parents)
+        # beam 0 at final step came through parent 1 at t=2
+        np.testing.assert_array_equal(out.numpy()[:, 0, 0], [2, 4, 5])
+
+
+class TestMiscLayers:
+    def test_zeropad(self):
+        x = paddle.to_tensor(_r(1, 2, 4))
+        out = nn.ZeroPad1D(2)(x)
+        assert out.shape == [1, 2, 8]
+        assert np.allclose(out.numpy()[..., :2], 0)
+        out3 = nn.ZeroPad3D(1)(paddle.to_tensor(_r(1, 1, 2, 2, 2)))
+        assert out3.shape == [1, 1, 4, 4, 4]
+
+    def test_fold_unfold_layers(self):
+        x = paddle.to_tensor(_r(1, 3, 6, 6))
+        unfolded = nn.Unfold(2, strides=2)(x)
+        assert unfolded.shape == [1, 12, 9]
+        folded = nn.Fold([6, 6], 2, strides=2)(unfolded)
+        np.testing.assert_allclose(folded.numpy(), x.numpy(), rtol=1e-5)
+
+    def test_silu_softmax2d(self):
+        x = _r(2, 3, 4, 4)
+        out = nn.Silu()(paddle.to_tensor(x))
+        want = x / (1 + np.exp(-x)) * 1.0
+        np.testing.assert_allclose(out.numpy(),
+                                   torch.nn.functional.silu(
+                                       torch.tensor(x)).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        sm = nn.Softmax2D()(paddle.to_tensor(x))
+        np.testing.assert_allclose(sm.numpy().sum(1),
+                                   np.ones((2, 4, 4)), rtol=1e-5)
+
+    def test_feature_alpha_dropout(self):
+        layer = nn.FeatureAlphaDropout(p=0.5)
+        x = paddle.to_tensor(_r(4, 8, 3, 3))
+        out = layer(x)
+        assert out.shape == [4, 8, 3, 3]
+        layer.eval()
+        np.testing.assert_allclose(layer(x).numpy(), x.numpy())
+
+    def test_spectral_norm(self):
+        paddle.seed(0)
+        w = _r(4, 6)
+        sn = nn.SpectralNorm([4, 6], dim=0, power_iters=20)
+        out = sn(paddle.to_tensor(w))
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(out.numpy(), w / sigma, rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_sparse_attention_matches_dense_on_full_pattern(self):
+        b, h, s, d = 1, 2, 4, 8
+        q, k, v = _r(b, h, s, d), _r(b, h, s, d), _r(b, h, s, d)
+        offs = np.tile(np.arange(0, (s + 1) * s, s), (b, h, 1)).astype("int32")
+        offs = np.tile((np.arange(s + 1) * s)[None, None], (b, h, 1)).astype("int32")
+        cols = np.tile(np.tile(np.arange(s), s)[None, None],
+                       (b, h, 1)).astype("int32")
+        got = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                 paddle.to_tensor(v), paddle.to_tensor(offs),
+                                 paddle.to_tensor(cols))
+        scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        e = np.exp(scores - scores.max(-1, keepdims=True))
+        probs = e / e.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", probs, v)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-4, atol=1e-5)
+
+
+class TestReviewFixes2:
+    def test_sparse_mask_flash_attention_column_semantics(self):
+        # sr[j] = query row from which key column j is masked
+        b, h, s, d = 1, 1, 4, 8
+        np.random.seed(2)
+        q = _r(b, s, h, d)
+        sr = np.array([[[1, 4, 4, 4]]], dtype="int32")  # key 0 dies at row 1
+        out = F.flash_attention_with_sparse_mask(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(sr), training=False)
+        # oracle: causal + (mask key0 for rows >= 1)
+        qt = q.transpose(0, 2, 1, 3)
+        mask = np.where(np.arange(s)[:, None] >= np.arange(s)[None, :],
+                        0.0, -1e9)
+        mask[1:, 0] = -1e9
+        scores = np.einsum("bhqd,bhkd->bhqk", qt, qt) / np.sqrt(d) + mask
+        e = np.exp(scores - scores.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", p, qt).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-4)
+
+    def test_max_pool_mask_nhwc(self):
+        x = _r(1, 4, 4, 2)  # NHWC
+        pooled, idx = F.max_pool2d(paddle.to_tensor(x), 2, 2,
+                                   return_mask=True, data_format="NHWC")
+        # indices address the flat H*W spatial grid
+        assert int(idx.numpy().max()) < 16
+
+    def test_lp_pool_padding(self):
+        x = _r(1, 2, 6, 6)
+        got = F.lp_pool2d(paddle.to_tensor(x), 2.0, 2, 2, padding=1)
+        want = torch.nn.functional.lp_pool2d(
+            torch.nn.functional.pad(torch.tensor(x), (1, 1, 1, 1)), 2.0, 2, 2)
+        assert got.shape == [1, 2, 4, 4]
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_fractional_mask_raises(self):
+        with pytest.raises(NotImplementedError):
+            F.fractional_max_pool2d(paddle.to_tensor(_r(1, 1, 8, 8)), 4,
+                                    return_mask=True)
+
+    def test_rnnt_fastemit_changes_grad_not_value(self):
+        np.random.seed(5)
+        B, T, U, V = 1, 4, 2, 5
+        logits = _r(B, T, U + 1, V)
+        lab = np.random.randint(1, V, (B, U)).astype("int32")
+        il = np.array([T], dtype="int64")
+        ll = np.array([U], dtype="int64")
+
+        def run(lmbda):
+            lt = paddle.to_tensor(logits, stop_gradient=False)
+            loss = F.rnnt_loss(lt, paddle.to_tensor(lab),
+                               paddle.to_tensor(il), paddle.to_tensor(ll),
+                               fastemit_lambda=lmbda, reduction="sum")
+            loss.backward()
+            return float(loss.numpy()), lt.grad.numpy().copy()
+
+        v0, g0 = run(0.0)
+        v1, g1 = run(0.5)
+        np.testing.assert_allclose(v0, v1, rtol=1e-5)  # value unchanged
+        assert not np.allclose(g0, g1)                 # grads rescaled
+
+    def test_sparse_attention_key_padding(self):
+        b, h, s, d = 1, 1, 4, 4
+        q = _r(b, h, s, d)
+        offs = np.tile((np.arange(s + 1) * s)[None, None],
+                       (b, h, 1)).astype("int32")
+        cols = np.tile(np.tile(np.arange(s), s)[None, None],
+                       (b, h, 1)).astype("int32")
+        kpm = np.zeros((b, s), dtype="float32")
+        kpm[0, -1] = -1e9
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(offs), paddle.to_tensor(cols),
+            key_padding_mask=paddle.to_tensor(kpm))
+        out_nomask = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(offs), paddle.to_tensor(cols))
+        assert not np.allclose(out.numpy(), out_nomask.numpy())

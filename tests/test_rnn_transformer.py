@@ -1,0 +1,304 @@
+"""RNN family + Transformer stack + dynamic decode.
+
+Reference models: test/legacy_test/test_rnn_cells*.py, test_rnn_nets*.py
+(torch-parity numerics via the shared cudnn formulas), test_transformer_api.py,
+test/rnn/ suites. Oracle: torch.nn layers with copied weights.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _r(*shape):
+    return np.random.randn(*shape).astype("float32")
+
+
+def _copy_rnn_weights(pl, tl, num_layers=1, directions=1, mode=""):
+    sd = {}
+    for layer in range(num_layers):
+        for d in range(directions):
+            sfx = "_reverse" if d else ""
+            for name in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                pname = f"{name}_l{layer}{sfx}"
+                sd[pname] = torch.tensor(getattr(pl, pname).numpy())
+    tl.load_state_dict(sd)
+
+
+class TestCells:
+    def test_simple_rnn_cell(self):
+        cell = nn.SimpleRNNCell(4, 6)
+        t_cell = torch.nn.RNNCell(4, 6)
+        t_cell.load_state_dict({
+            "weight_ih": torch.tensor(cell.weight_ih.numpy()),
+            "weight_hh": torch.tensor(cell.weight_hh.numpy()),
+            "bias_ih": torch.tensor(cell.bias_ih.numpy()),
+            "bias_hh": torch.tensor(cell.bias_hh.numpy()),
+        })
+        x, h = _r(3, 4), _r(3, 6)
+        out, new_h = cell(paddle.to_tensor(x), paddle.to_tensor(h))
+        want = t_cell(torch.tensor(x), torch.tensor(h))
+        np.testing.assert_allclose(out.numpy(), want.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        assert new_h is out
+
+    def test_lstm_cell(self):
+        cell = nn.LSTMCell(4, 6)
+        t_cell = torch.nn.LSTMCell(4, 6)
+        t_cell.load_state_dict({
+            "weight_ih": torch.tensor(cell.weight_ih.numpy()),
+            "weight_hh": torch.tensor(cell.weight_hh.numpy()),
+            "bias_ih": torch.tensor(cell.bias_ih.numpy()),
+            "bias_hh": torch.tensor(cell.bias_hh.numpy()),
+        })
+        x, h, c = _r(3, 4), _r(3, 6), _r(3, 6)
+        out, (new_h, new_c) = cell(paddle.to_tensor(x),
+                                   (paddle.to_tensor(h), paddle.to_tensor(c)))
+        th, tc = t_cell(torch.tensor(x), (torch.tensor(h), torch.tensor(c)))
+        np.testing.assert_allclose(new_h.numpy(), th.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(new_c.numpy(), tc.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gru_cell(self):
+        cell = nn.GRUCell(4, 6)
+        t_cell = torch.nn.GRUCell(4, 6)
+        t_cell.load_state_dict({
+            "weight_ih": torch.tensor(cell.weight_ih.numpy()),
+            "weight_hh": torch.tensor(cell.weight_hh.numpy()),
+            "bias_ih": torch.tensor(cell.bias_ih.numpy()),
+            "bias_hh": torch.tensor(cell.bias_hh.numpy()),
+        })
+        x, h = _r(3, 4), _r(3, 6)
+        out, _ = cell(paddle.to_tensor(x), paddle.to_tensor(h))
+        want = t_cell(torch.tensor(x), torch.tensor(h))
+        np.testing.assert_allclose(out.numpy(), want.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_cell_default_state(self):
+        cell = nn.LSTMCell(4, 6)
+        out, (h, c) = cell(paddle.to_tensor(_r(2, 4)))
+        assert h.shape == [2, 6] and c.shape == [2, 6]
+
+
+class TestRNNLayers:
+    @pytest.mark.parametrize("direction,layers", [("forward", 1),
+                                                  ("forward", 2),
+                                                  ("bidirect", 1)])
+    def test_lstm_matches_torch(self, direction, layers):
+        dirs = 2 if direction == "bidirect" else 1
+        pl = nn.LSTM(4, 6, num_layers=layers, direction=direction)
+        tl = torch.nn.LSTM(4, 6, num_layers=layers, batch_first=True,
+                           bidirectional=dirs == 2)
+        _copy_rnn_weights(pl, tl, layers, dirs)
+        x = _r(3, 5, 4)
+        out, (h, c) = pl(paddle.to_tensor(x))
+        t_out, (t_h, t_c) = tl(torch.tensor(x))
+        np.testing.assert_allclose(out.numpy(), t_out.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), t_h.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), t_c.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gru_matches_torch(self):
+        pl = nn.GRU(4, 6)
+        tl = torch.nn.GRU(4, 6, batch_first=True)
+        _copy_rnn_weights(pl, tl)
+        x = _r(2, 7, 4)
+        out, h = pl(paddle.to_tensor(x))
+        t_out, t_h = tl(torch.tensor(x))
+        np.testing.assert_allclose(out.numpy(), t_out.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_simple_rnn_matches_torch(self):
+        pl = nn.SimpleRNN(4, 6, activation="relu")
+        tl = torch.nn.RNN(4, 6, nonlinearity="relu", batch_first=True)
+        _copy_rnn_weights(pl, tl)
+        x = _r(2, 5, 4)
+        out, h = pl(paddle.to_tensor(x))
+        t_out, t_h = tl(torch.tensor(x))
+        np.testing.assert_allclose(out.numpy(), t_out.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sequence_length_masking(self):
+        pl = nn.LSTM(4, 6)
+        x = _r(2, 5, 4)
+        lens = np.array([3, 5], dtype="int64")
+        out, (h, c) = pl(paddle.to_tensor(x),
+                         sequence_length=paddle.to_tensor(lens))
+        # outputs past each row's length are zeroed
+        assert np.allclose(out.numpy()[0, 3:], 0.0)
+        assert not np.allclose(out.numpy()[1, 3:], 0.0)
+        # final state equals state at t=len-1: rerun truncated
+        out2, (h2, _) = pl(paddle.to_tensor(x[:1, :3]))
+        np.testing.assert_allclose(h.numpy()[0, 0], h2.numpy()[0, 0],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_time_major(self):
+        pl = nn.GRU(4, 6, time_major=True)
+        x = _r(5, 2, 4)  # [T, B, I]
+        out, h = pl(paddle.to_tensor(x))
+        assert out.shape == [5, 2, 6]
+
+    def test_lstm_proj_size(self):
+        pl = nn.LSTM(4, 8, proj_size=5)
+        out, (h, c) = pl(paddle.to_tensor(_r(2, 3, 4)))
+        assert out.shape == [2, 3, 5]
+        assert h.shape == [1, 2, 5] and c.shape == [1, 2, 8]
+
+    def test_rnn_backward(self):
+        pl = nn.LSTM(4, 6)
+        x = paddle.to_tensor(_r(2, 5, 4), stop_gradient=False)
+        out, _ = pl(x)
+        out.sum().backward()
+        assert x.grad.shape == [2, 5, 4]
+        assert pl.weight_ih_l0.grad is not None
+
+
+class TestRNNWrappers:
+    def test_rnn_wrapper_matches_layer(self):
+        cell = nn.GRUCell(4, 6)
+        wrapper = nn.RNN(cell)
+        x = _r(2, 5, 4)
+        out, h = wrapper(paddle.to_tensor(x))
+        assert out.shape == [2, 5, 6] and h.shape == [2, 6]
+        # stepwise oracle
+        ht = paddle.to_tensor(np.zeros((2, 6), dtype="float32"))
+        for t in range(5):
+            _, ht = cell(paddle.to_tensor(x[:, t]), ht)
+        np.testing.assert_allclose(h.numpy(), ht.numpy(), rtol=1e-5)
+
+    def test_birnn(self):
+        fw, bw = nn.SimpleRNNCell(4, 6), nn.SimpleRNNCell(4, 6)
+        bi = nn.BiRNN(fw, bw)
+        out, (st_f, st_b) = bi(paddle.to_tensor(_r(2, 5, 4)))
+        assert out.shape == [2, 5, 12]
+
+
+class TestTransformer:
+    def test_mha_matches_torch(self):
+        e, h = 16, 4
+        pl = nn.MultiHeadAttention(e, h, dropout=0.0)
+        pl.eval()
+        tl = torch.nn.MultiheadAttention(e, h, dropout=0.0, batch_first=True)
+        qw = np.concatenate([pl.q_proj.weight.numpy().T,
+                             pl.k_proj.weight.numpy().T,
+                             pl.v_proj.weight.numpy().T], 0)
+        qb = np.concatenate([pl.q_proj.bias.numpy(), pl.k_proj.bias.numpy(),
+                             pl.v_proj.bias.numpy()], 0)
+        tl.load_state_dict({
+            "in_proj_weight": torch.tensor(qw),
+            "in_proj_bias": torch.tensor(qb),
+            "out_proj.weight": torch.tensor(pl.out_proj.weight.numpy().T),
+            "out_proj.bias": torch.tensor(pl.out_proj.bias.numpy()),
+        })
+        x = _r(2, 5, e)
+        got = pl(paddle.to_tensor(x))
+        want, _ = tl(torch.tensor(x), torch.tensor(x), torch.tensor(x))
+        np.testing.assert_allclose(got.numpy(), want.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_mha_incremental_cache_matches_full(self):
+        e = 16
+        pl = nn.MultiHeadAttention(e, 2, dropout=0.0)
+        pl.eval()
+        x = _r(1, 4, e)
+        # full causal pass, compare last position vs incremental decode
+        causal = np.triu(np.full((4, 4), -1e9, dtype="float32"), 1)
+        full = pl(paddle.to_tensor(x),
+                  attn_mask=paddle.to_tensor(causal[None, None]))
+        cache = pl.gen_cache(paddle.to_tensor(x[:, :0]))
+        outs = []
+        for t in range(4):
+            o, cache = pl(paddle.to_tensor(x[:, t:t + 1]), cache=cache)
+            outs.append(o.numpy())
+        np.testing.assert_allclose(np.concatenate(outs, 1), full.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_encoder_decoder_shapes(self):
+        t = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32,
+                           dropout=0.0)
+        t.eval()
+        src = paddle.to_tensor(_r(2, 4, 16))
+        tgt = paddle.to_tensor(_r(2, 3, 16))
+        out = t(src, tgt, tgt_mask=t.generate_square_subsequent_mask(3))
+        assert out.shape == [2, 3, 16]
+        m = t.generate_square_subsequent_mask(3).numpy()
+        assert m[0, 1] == -np.inf and m[1, 0] == 0
+
+    def test_encoder_layers_are_independent(self):
+        layer = nn.TransformerEncoderLayer(8, 2, 16, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 3)
+        params = enc.parameters()
+        ids = {id(p) for p in params}
+        assert len(ids) == len(params)  # deepcopied layers don't share
+
+    def test_transformer_bool_mask(self):
+        t = nn.TransformerEncoderLayer(8, 2, 16, dropout=0.0)
+        t.eval()
+        x = paddle.to_tensor(_r(1, 4, 8))
+        keep = np.ones((1, 1, 4, 4), dtype=bool)
+        keep[..., -1] = False  # mask out last key
+        out = t(x, src_mask=paddle.to_tensor(keep))
+        assert np.isfinite(out.numpy()).all()
+
+    def test_decoder_cached_matches_uncached(self):
+        t = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32,
+                           dropout=0.0)
+        t.eval()
+        src = paddle.to_tensor(_r(1, 4, 16))
+        tgt = _r(1, 3, 16)
+        memory = t.encoder(src)
+        full = t.decoder(paddle.to_tensor(tgt), memory,
+                         tgt_mask=t.generate_square_subsequent_mask(3))
+        cache = t.decoder.gen_cache(memory)
+        outs = []
+        for i in range(3):
+            o, cache = t.decoder(paddle.to_tensor(tgt[:, i:i + 1]), memory,
+                                 cache=cache)
+            outs.append(o.numpy())
+        np.testing.assert_allclose(np.concatenate(outs, 1), full.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestDynamicDecode:
+    def test_beam_search_prefers_likely_tokens(self):
+        paddle.seed(3)
+        V, H, B, beam = 10, 8, 2, 3
+        emb = nn.Embedding(V, H)
+        cell = nn.GRUCell(H, H)
+        lin = nn.Linear(H, V)
+        # bias the output layer hard toward token 7
+        bias = np.zeros(V, dtype="float32")
+        bias[7] = 5.0
+        lin.bias.set_value(bias)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=beam, embedding_fn=emb,
+                                   output_fn=lin)
+        h0 = paddle.to_tensor(_r(B, H))
+        ids, states = nn.dynamic_decode(dec, inits=h0, max_step_num=5)
+        assert ids.shape == [B, 5, beam]
+        # top beam should be dominated by token 7
+        top = ids.numpy()[:, :, 0]
+        assert (top == 7).mean() > 0.6
+
+    def test_decode_terminates_on_end_token(self):
+        V, H, beam = 6, 4, 2
+        emb = nn.Embedding(V, H)
+        cell = nn.GRUCell(H, H)
+        lin = nn.Linear(H, V)
+        bias = np.zeros(V, dtype="float32")
+        bias[1] = 10.0  # end token immediately most likely
+        lin.bias.set_value(bias)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=beam, embedding_fn=emb,
+                                   output_fn=lin)
+        h0 = paddle.to_tensor(_r(1, H))
+        ids, states, lengths = nn.dynamic_decode(
+            dec, inits=h0, max_step_num=20, return_length=True)
+        assert ids.shape[1] < 20  # stopped early
