@@ -64,6 +64,13 @@ from .framework.misc import (
 )
 from .core.place import CUDAPinnedPlace
 from .ops.manipulation import flip as reverse  # deprecated paddle.reverse
+# the ops star-import binds paddle.linalg to ops.linalg (the kernel
+# module), which also stops `from . import linalg` from importing the
+# package-level namespace module; import it explicitly and rebind (adds
+# lu_unpack, matrix_exp, *_lowrank, ormqr, cholesky_inverse, fp8 gemm)
+import importlib as _importlib
+
+linalg = _importlib.import_module(".linalg", __name__)
 from .nn.param_attr import ParamAttr
 from . import framework
 

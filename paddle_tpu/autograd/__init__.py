@@ -111,3 +111,29 @@ def grad(
         else:
             result.append(Tensor._from_value(g))
     return result
+
+
+class saved_tensors_hooks:
+    """Reference: autograd/saved_tensors_hooks.py — pack/unpack hooks
+    applied to tensors the tape saves for backward (e.g. offload-to-host
+    compression). Hooks wrap GradNode saved tensors while the context is
+    active."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from . import engine
+
+        engine._saved_tensor_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        from . import engine
+
+        engine._saved_tensor_hooks.pop()
+        return False
+
+
+__all__.append("saved_tensors_hooks")

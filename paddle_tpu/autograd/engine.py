@@ -173,8 +173,30 @@ def record_op(prim_name, static, saved, in_tensors, out_arrays,
     if not any_grad and not force:
         return None
     out_avals = [(tuple(o.shape), o.dtype) for o in out_arrays]
+    if _saved_tensor_hooks and saved is not None:
+        # saved_tensors_hooks pack stage (reference:
+        # autograd/saved_tensors_hooks.py — wrap each saved array; the
+        # unpack fn is captured so backward works after the context exits)
+        pack, unpack = _saved_tensor_hooks[-1]
+        saved = _SavedPacked(tuple(pack(a) for a in saved), unpack)
     return GradNode(prim_name, static, saved, out_avals, edges,
                     saved_tensors=saved_tensors)
+
+
+_saved_tensor_hooks: List[Tuple[Any, Any]] = []
+
+
+class _SavedPacked:
+    """Marker wrapping hook-packed saved tensors until backward unpacks."""
+
+    __slots__ = ("payload", "unpack_fn")
+
+    def __init__(self, payload, unpack_fn):
+        self.payload = payload
+        self.unpack_fn = unpack_fn
+
+    def unpack(self):
+        return tuple(self.unpack_fn(a) for a in self.payload)
 
 
 # --------------------------------------------------------------------------
@@ -302,8 +324,10 @@ def run_backward(
                     "Trying to backward through the graph a second time; "
                     "set retain_graph=True to allow this."
                 )
+            saved = (node.saved.unpack()
+                     if isinstance(node.saved, _SavedPacked) else node.saved)
             in_grads = dispatch.call_vjp(
-                node.prim_name, grads_out, node.saved, node.static
+                node.prim_name, grads_out, saved, node.static
             )
             if not retain_graph:
                 node.release()
@@ -468,7 +492,8 @@ def run_backward_create_graph(
             raw = dispatch.call_vjp(
                 node.prim_name,
                 tuple(g._value for g in grads_out),
-                node.saved,
+                node.saved.unpack() if isinstance(node.saved, _SavedPacked)
+                else node.saved,
                 node.static,
             )
             in_grads = tuple(

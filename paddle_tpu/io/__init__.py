@@ -2,6 +2,6 @@
 from .dataloader import (
     Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
     ConcatDataset, Subset, random_split, Sampler, SequenceSampler,
-    RandomSampler, WeightedRandomSampler, BatchSampler,
+    RandomSampler, WeightedRandomSampler, BatchSampler, SubsetRandomSampler,
     DistributedBatchSampler, DataLoader, default_collate_fn, get_worker_info,
 )

@@ -525,4 +525,54 @@ def load(path, **configs):
     p = path if path.endswith(".pdmodel") else path + ".pdmodel"
     with open(p, "rb") as f:
         payload = pickle.load(f)
-    return _LoadedFunction(payload)
+    return TranslatedLayer(payload)
+
+
+class TranslatedLayer(_LoadedFunction):
+    """Reference: jit/translated_layer.py:1285 — the Layer-like object
+    jit.load returns: callable, exposes state_dict/parameters, eval/train
+    toggles (inference programs ignore mode)."""
+
+    def __init__(self, payload):
+        super().__init__(payload)
+        self.training = False
+
+    def forward(self, *args):
+        return self(*args)
+
+    def parameters(self, include_sublayers=True):
+        from ..core.tensor import Parameter
+
+        return [
+            v if isinstance(v, Parameter) else Parameter(v._value if hasattr(v, "_value") else v)
+            for v in self.state_dict().values()
+        ]
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        self.training = True
+        return self
+
+
+_SOT_CODE_LEVEL = 0
+_SOT_VERBOSITY = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference: jit/sot/utils/code_status.py via paddle.jit.set_code_level
+    — bytecode-translation logging. The TPU build traces through jax (no
+    bytecode simulation); the level gates trace-cache debug output."""
+    global _SOT_CODE_LEVEL
+    _SOT_CODE_LEVEL = int(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Reference: paddle.jit.set_verbosity — dy2static logging level."""
+    global _SOT_VERBOSITY
+    _SOT_VERBOSITY = int(level)
+
+
+__all__.extend(["TranslatedLayer", "set_code_level", "set_verbosity"])

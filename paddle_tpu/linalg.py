@@ -19,3 +19,65 @@ __all__ = [
     "norm", "pinv", "qr", "slogdet", "solve", "svd", "triangular_solve",
     "vector_norm",
 ]
+
+
+# long-tail linalg ops live in ops.extras (single registration point);
+# re-export them on the paddle.linalg namespace like the reference
+from .ops.extras import (  # noqa: E402,F401
+    cholesky_inverse, lu_unpack, matrix_exp, ormqr, pca_lowrank, svd_lowrank,
+)
+
+__all__ += [
+    "cholesky_inverse", "lu_unpack", "matrix_exp", "ormqr", "pca_lowrank",
+    "svd_lowrank", "fp8_fp8_half_gemm_fused",
+]
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="float16", act="identity",
+                            name=None):
+    """fp8 x fp8 -> half GEMM (reference: tensor/linalg.py
+    fp8_fp8_half_gemm_fused, cuBLASLt fp8 path). On TPU v5e the MXU has no
+    fp8 mode; inputs are computed in bf16 with the same scale/act epilogue
+    and cast to the requested half dtype."""
+    import jax.numpy as jnp
+
+    from .core.tensor import apply
+    from .ops._helpers import ensure_tensor
+
+    return apply("fp8_gemm_p", ensure_tensor(x), ensure_tensor(y),
+                 ensure_tensor(bias) if bias is not None else ensure_tensor(0.0),
+                 use_bias=bias is not None, tx=bool(transpose_x),
+                 ty=bool(transpose_y), scale=float(scale),
+                 out_dtype=str(output_dtype), act=str(act))
+
+
+def _register_fp8_prim():
+    import jax
+    import jax.numpy as jnp
+
+    from .ops._helpers import defprim
+
+    def fwd(x, y, b, *, use_bias, tx, ty, scale, out_dtype, act):
+        xb = x.astype(jnp.bfloat16)
+        yb = y.astype(jnp.bfloat16)
+        if tx:
+            xb = jnp.swapaxes(xb, -1, -2)
+        if ty:
+            yb = jnp.swapaxes(yb, -1, -2)
+        out = jnp.matmul(xb, yb,
+                         preferred_element_type=jnp.float32) * scale
+        if use_bias:
+            out = out + b.astype(jnp.float32)
+        if act == "gelu":
+            out = jax.nn.gelu(out)
+        elif act == "relu":
+            out = jnp.maximum(out, 0)
+        dt = jnp.bfloat16 if out_dtype == "bfloat16" else jnp.float16
+        return out.astype(dt)
+
+    defprim("fp8_gemm_p", fwd)
+
+
+_register_fp8_prim()

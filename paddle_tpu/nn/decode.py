@@ -188,15 +188,18 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
 
     inputs, states, finished = decoder.initialize(inits)
     step_outputs = []
-    max_steps = max_step_num if max_step_num is not None else 256
     final_states = states
-    for t in range(int(max_steps)):
+    t = 0
+    while max_step_num is None or t < int(max_step_num):
         out, states, inputs, finished = decoder.step(t, inputs, states,
                                                      **kwargs)
         step_outputs.append(out)
         final_states = states
+        t += 1
         if bool(np.asarray(ensure_tensor(finished)._value).all()):
             break
+    if not step_outputs:
+        raise ValueError("dynamic_decode ran zero steps (max_step_num=0?)")
 
     # stack the per-step namedtuples field-wise: [T, ...]
     first = step_outputs[0]

@@ -138,7 +138,9 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
                  head_bias=False, name=None):
         super().__init__()
         cutoffs = list(cutoffs)
-        if any(c <= 0 or c >= n_classes - 1 for c in cutoffs) or \
+        # last cluster of size 1 (cutoff == n_classes - 1) is valid, like
+        # the reference/torch
+        if any(c <= 0 or c > n_classes - 1 for c in cutoffs) or \
                 sorted(set(cutoffs)) != cutoffs:
             raise ValueError("invalid cutoffs")
         self.in_features = in_features

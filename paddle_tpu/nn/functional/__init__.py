@@ -79,12 +79,23 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
     offs = np.asarray(ensure_tensor(sparse_csr_offset)._value)   # [B, H, S+1]
     cols = np.asarray(ensure_tensor(sparse_csr_columns)._value)  # [B, H, nnz]
     b, h, s, d = q.shape
+    # vectorized CSR -> dense mask: expand row ids by per-row counts and
+    # scatter once (no per-element Python loop)
     mask = np.full((b, h, s, s), -1e9, dtype=np.float32)
-    for bi in range(b):
-        for hi in range(h):
-            for row in range(s):
-                lo, hi_ = offs[bi, hi, row], offs[bi, hi, row + 1]
-                mask[bi, hi, row, cols[bi, hi, lo:hi_]] = 0.0
+    counts = np.diff(offs, axis=-1)                     # [B, H, S]
+    bi, hi = np.meshgrid(np.arange(b), np.arange(h), indexing="ij")
+    bi = np.repeat(bi.reshape(b, h, 1), s, axis=2)
+    hi = np.repeat(hi.reshape(b, h, 1), s, axis=2)
+    rows = np.broadcast_to(np.arange(s)[None, None, :], (b, h, s))
+    flat_counts = counts.reshape(-1)
+    rep_b = np.repeat(bi.reshape(-1), flat_counts)
+    rep_h = np.repeat(hi.reshape(-1), flat_counts)
+    rep_r = np.repeat(rows.reshape(-1), flat_counts)
+    nnz_per_bh = offs[..., -1]                          # [B, H]
+    col_vals = np.concatenate([
+        cols[i, j, : nnz_per_bh[i, j]] for i in range(b) for j in range(h)
+    ]) if b * h > 1 else cols[0, 0, : nnz_per_bh[0, 0]]
+    mask[rep_b, rep_h, rep_r, col_vals] = 0.0
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d) + mask
     if key_padding_mask is not None:
         kpm = ensure_tensor(key_padding_mask)._value.astype(jnp.float32)

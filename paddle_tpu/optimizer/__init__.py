@@ -4,3 +4,6 @@ from .optimizers import (
     SGD, Momentum, Adam, AdamW, RMSProp, Adagrad, Adadelta, Adamax, Lamb,
 )
 from . import lr
+
+from .extra_optimizers import ASGD, RAdam, Rprop, NAdam  # noqa: F401
+from ..incubate.optimizer.lbfgs import LBFGS  # noqa: F401

@@ -50,3 +50,16 @@ def load_inference_model(path_prefix: str, executor=None, **kwargs):
 
     fn = jit.load(path_prefix)
     return fn, [], []
+
+from .extras import (  # noqa: F401
+    append_backward, gradients, Scope, global_scope, scope_guard,
+    BuildStrategy, CompiledProgram, Print, py_func, WeightNormParamAttr,
+    ExponentialMovingAverage, save, load, serialize_program,
+    serialize_persistables, save_to_file, deserialize_program,
+    deserialize_persistables, load_from_file, normalize_program,
+    load_program_state, set_program_state, cpu_places, cuda_places,
+    xpu_places, Variable, create_global_var, accuracy, auc, device_guard,
+    ipu_shard_guard, set_ipu_shard, IpuCompiledProgram, IpuStrategy,
+    ctr_metric_bundle,
+)
+from ..framework.misc import create_parameter  # noqa: F401

