@@ -10,3 +10,4 @@ functional.get_window = get_window
 
 __all__ = ["functional", "features", "backends", "load", "save", "info",
            "get_window"]
+from . import datasets  # noqa: F401

@@ -178,3 +178,30 @@ class stream_guard:
     def __exit__(self, *exc):
         set_stream(self._prev)
         return False
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU (reference returns None when not compiled with CUDA)."""
+    return None
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+class XPUPlace:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("XPU devices are not part of the TPU build")
+
+
+class IPUPlace:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU devices are not part of the TPU build")

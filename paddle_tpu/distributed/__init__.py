@@ -46,3 +46,204 @@ def get_device_count():
     from . import env as _env
 
     return _env.device_count()
+
+# --- surface completion (reference: distributed/__init__.py __all__) -----
+from .communication import all_to_all as alltoall  # noqa: F401
+from .communication import all_to_all_single as alltoall_single  # noqa: F401
+
+
+class ParallelEnv:
+    """Reference: distributed/parallel.py ParallelEnv — env-derived rank
+    topology view (superseded by get_rank/get_world_size but still public)."""
+
+    def __init__(self):
+        from . import env as _env
+
+        self._rank = _env.get_rank()
+        self._world_size = _env.get_world_size()
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def local_rank(self):
+        import os
+
+        return int(os.environ.get("PADDLE_LOCAL_RANK", self._rank))
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Reference: communication/scatter.py scatter_object_list — single-host
+    mesh build: rank src's list is partitioned across ranks."""
+    from . import env as _env
+
+    rank = _env.get_rank(group)
+    world = _env.get_world_size(group)
+    if in_object_list is None:
+        raise ValueError("src rank must provide in_object_list")
+    per = len(in_object_list) // world
+    out_object_list.clear()
+    out_object_list.extend(in_object_list[rank * per:(rank + 1) * per])
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Reference: parallel_with_gloo.py — CPU-barrier bootstrap. The TPU
+    build's rendezvous is the TCPStore in init_parallel_env; this shim
+    delegates there."""
+    import os
+
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    os.environ.setdefault("PADDLE_MASTER", server_endpoint)
+
+
+def gloo_barrier():
+    from .communication import barrier
+
+    barrier()
+
+
+def gloo_release():
+    """No persistent gloo context to release in the TPU build."""
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel split (reference: fleet/layers/mpu/mp_ops.py:698 —
+    builds a row/column-parallel embedding or linear over num_partitions).
+    The TPU build expresses the same layouts with the fleet mpu layers over
+    the mesh mp axis."""
+    from .fleet.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                  VocabParallelEmbedding)
+
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    raise ValueError(f"unsupported operation {operation!r}")
+
+
+# PS-mode sparse-table entry configs (reference: distributed/entry_attr.py)
+class ProbabilityEntry:
+    def __init__(self, probability):
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _to_attr(self):
+        return f"{self._name}:{self._probability}"
+
+
+class CountFilterEntry:
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._name = "count_filter_entry"
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return f"{self._name}:{self._count_filter}"
+
+
+class ShowClickEntry:
+    def __init__(self, show_name, click_name):
+        self._name = "show_click_entry"
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return f"{self._name}:{self._show_name}:{self._click_name}"
+
+
+def __getattr__(name):
+    # heavier legacy subsurfaces resolved lazily
+    if name in ("QueueDataset", "InMemoryDataset"):
+        from .ps import dataset as _ds
+
+        return getattr(_ds, name)
+    if name == "io":
+        import importlib
+
+        return importlib.import_module(".io", __name__)
+    raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")
+
+
+# checkpoint save/load re-exports (reference: distributed/__init__.py pulls
+# them from distributed.checkpoint)
+from .checkpoint import load_state_dict, save_state_dict  # noqa: E402,F401
+
+
+class ParallelMode:
+    """Reference: distributed/parallel.py ParallelMode enum."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """Reference: auto_parallel placement reduce types (phi ReduceType)."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Reference: DistAttr (phi TensorDistAttr pybind) — mesh + dims_mapping
+    view; the semi-auto API expresses the same via placements."""
+
+    def __init__(self, mesh, sharding_specs):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs)
+
+    @property
+    def dims_mapping(self):
+        names = list(getattr(self.process_mesh, "dim_names", []))
+        return [
+            (names.index(s) if s in names else -1)
+            for s in self.sharding_specs
+        ]
+
+
+def is_available() -> bool:
+    """Reference: distributed/parallel.py is_available — whether the
+    distributed runtime can be used (always true: the mesh runtime is
+    in-process)."""
+    return True
+
+
+def shard_scaler(scaler):
+    """Reference: auto_parallel/api.py shard_scaler — adapts a GradScaler
+    to DistTensor grads. GSPMD layouts keep scaler math replicated, so the
+    scaler works unchanged; returned as-is for API parity."""
+    return scaler

@@ -1,0 +1,58 @@
+"""paddle.distributed.io parity.
+
+Reference: python/paddle/distributed/io.py — persistable save/load helpers
+for PS training. The TPU build's canonical checkpoint path is
+paddle.distributed.checkpoint (sharded, reshard-on-load); these entry
+points cover the legacy executor-style API over it.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable",
+           "load_inference_model_distributed"]
+
+
+def is_persistable(var):
+    return bool(getattr(var, "persistable", getattr(var, "trainable", False)))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """main_program here may be a Layer (dygraph-first build) or a static
+    Program; persistable state is gathered and pickled per the reference's
+    single-file mode."""
+    os.makedirs(dirname, exist_ok=True)
+    state = {}
+    if main_program is None:
+        raise ValueError("main_program (a Layer or Program) is required")
+    if hasattr(main_program, "state_dict"):
+        for k, v in main_program.state_dict().items():
+            state[k] = np.asarray(v._value if hasattr(v, "_value") else v)
+    elif hasattr(main_program, "_consts"):
+        from ..static.extras import _collect_state
+
+        state = _collect_state(main_program)
+    path = os.path.join(dirname, filename or "__persistables__")
+    with open(path, "wb") as f:
+        pickle.dump(state, f)
+    return path
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    path = os.path.join(dirname, filename or "__persistables__")
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    if main_program is not None and hasattr(main_program, "set_state_dict"):
+        main_program.set_state_dict(state)
+    return state
+
+
+def load_inference_model_distributed(dirname, executor, model_filename=None,
+                                     params_filename=None):
+    from ..static import load_inference_model
+
+    return load_inference_model(os.path.join(dirname, model_filename or
+                                             "model"), executor)

@@ -1,0 +1,84 @@
+"""PS-mode training datasets.
+
+Reference: python/paddle/distributed/fleet/dataset/dataset.py —
+QueueDataset (streaming file reader feeding trainers) and InMemoryDataset
+(loads/shuffles the whole file list in memory; local/global shuffle). The
+reference pipes samples through a C++ DataFeed; here files are
+line-oriented text parsed by a user-settable parse function, feeding the
+Python training loop.
+"""
+from __future__ import annotations
+
+import random
+
+__all__ = ["QueueDataset", "InMemoryDataset"]
+
+
+class _DatasetBase:
+    def __init__(self):
+        self._filelist = []
+        self._parse = lambda line: line.rstrip("\n")
+        self._batch_size = 1
+        self._thread_num = 1
+        self._use_var = []
+        self._pipe_command = None
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name="", fs_ugi="",
+             **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._use_var = use_var or []
+        self._pipe_command = pipe_command
+        return self
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_parse_func(self, fn):
+        """TPU-build extension point standing in for pipe_command parsing."""
+        self._parse = fn
+
+    def _iter_lines(self):
+        for path in self._filelist:
+            with open(path, "r") as f:
+                for line in f:
+                    yield self._parse(line)
+
+
+class QueueDataset(_DatasetBase):
+    """Streaming dataset: one pass over the file list per epoch."""
+
+    def __iter__(self):
+        return self._iter_lines()
+
+
+class InMemoryDataset(_DatasetBase):
+    """Loads the file list into memory; supports local/global shuffle
+    (global shuffle degenerates to local on a single host — the reference
+    shuffles through the PS fleet)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_lines())
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def local_shuffle(self):
+        random.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = []
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def __iter__(self):
+        return iter(self._samples)

@@ -531,11 +531,18 @@ def load(path, **configs):
 class TranslatedLayer(_LoadedFunction):
     """Reference: jit/translated_layer.py:1285 — the Layer-like object
     jit.load returns: callable, exposes state_dict/parameters, eval/train
-    toggles (inference programs ignore mode)."""
+    toggles.
+
+    Limitation vs the reference: the loaded program is a serialized
+    StableHLO executable with baked weights, so optimizer updates on
+    parameters() do NOT feed back into __call__ — the artifact is an
+    inference program (the reference's fine-tune path re-executes the
+    stored ProgramDesc, which this build does not reconstruct)."""
 
     def __init__(self, payload):
         super().__init__(payload)
         self.training = False
+        self._parameters_cache = None
 
     def forward(self, *args):
         return self(*args)
@@ -543,10 +550,14 @@ class TranslatedLayer(_LoadedFunction):
     def parameters(self, include_sublayers=True):
         from ..core.tensor import Parameter
 
-        return [
-            v if isinstance(v, Parameter) else Parameter(v._value if hasattr(v, "_value") else v)
-            for v in self.state_dict().values()
-        ]
+        if self._parameters_cache is None:
+            # stable identity: repeated calls return the same objects
+            self._parameters_cache = [
+                v if isinstance(v, Parameter)
+                else Parameter(v._value if hasattr(v, "_value") else v)
+                for v in self.state_dict().values()
+            ]
+        return list(self._parameters_cache)
 
     def eval(self):
         self.training = False

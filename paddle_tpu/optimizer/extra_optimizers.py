@@ -7,6 +7,7 @@ re-exported from incubate.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .optimizer import Optimizer
@@ -16,10 +17,12 @@ __all__ = ["ASGD", "RAdam", "Rprop", "NAdam"]
 
 class ASGD(Optimizer):
     """Reference: optimizer/asgd.py — averaged SGD. Keeps a window of n
-    historical gradients (n=batch_num); update uses d = d - y_old + g and
-    the running mean d/n."""
+    historical gradients (n=batch_num) as an accumulator [n, *shape] so the
+    whole state lifts to functional form under jit capture; update uses
+    d = d - y_old + g and the running mean d/n. The rolling write position
+    is derived from the shared step counter (same for every param)."""
 
-    _accum_names = ("d", "ys_mean")
+    _accum_names = ("d", "grad_window")
 
     def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
                  weight_decay=None, grad_clip=None, multi_precision=False,
@@ -29,21 +32,32 @@ class ASGD(Optimizer):
         if batch_num <= 0:
             raise ValueError("batch_num must be positive")
         self._n = int(batch_num)
-        self._ys = {}   # id(p) -> list of last n grads (rolling)
-        self._pos = {}
+
+    def _ensure_accumulators(self):
+        # grad_window is [n, *shape]; the base pre-creation would make it
+        # p-shaped zeros, so create both accumulators with their real inits
+        for p in self._parameter_list:
+            if not getattr(p, "trainable", True):
+                continue
+            self._accum("d", p)
+            self._accum("grad_window", p, init=jnp.zeros(
+                (self._n,) + tuple(p._value.shape), jnp.float32))
+            self._master(p)
 
     def _update_param(self, p, grad, lr):
         master = self._master(p)
         pv = (master if master is not None else p._value).astype(jnp.float32)
         g = grad.astype(jnp.float32)
         d = self._accum("d", p)
-        ys = self._ys.setdefault(id(p), [jnp.zeros_like(g)] * self._n)
-        pos = self._pos.get(id(p), 0)
-        y_old = ys[pos]
+        window = self._accum(
+            "grad_window", p,
+            init=jnp.zeros((self._n,) + tuple(p._value.shape), jnp.float32))
+        pos = jnp.mod(self._step_num().astype(jnp.int32) - 1, self._n)
+        y_old = jax.lax.dynamic_index_in_dim(window, pos, 0, keepdims=False)
         d = d - y_old + g
-        ys[pos] = g
-        self._pos[id(p)] = (pos + 1) % self._n
+        window = jax.lax.dynamic_update_index_in_dim(window, g, pos, 0)
         self._set_accum("d", p, d)
+        self._set_accum("grad_window", p, window)
         new = pv - lr * d / self._n
         if master is not None:
             self._apply(p, None, new)
@@ -146,6 +160,16 @@ class NAdam(Optimizer):
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._psi = momentum_decay
 
+    def _ensure_accumulators(self):
+        for p in self._parameter_list:
+            if not getattr(p, "trainable", True):
+                continue
+            self._accum("moment1", p)
+            self._accum("moment2", p)
+            self._accum("mu_product", p,
+                        init=jnp.ones(p._value.shape, jnp.float32))
+            self._master(p)
+
     def _update_param(self, p, grad, lr):
         master = self._master(p)
         pv = (master if master is not None else p._value).astype(jnp.float32)
@@ -154,9 +178,10 @@ class NAdam(Optimizer):
         t = self._step_num()
         mu_t = b1 * (1.0 - 0.5 * 0.96 ** (t * self._psi))
         mu_t1 = b1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self._psi))
-        mu_prod_prev = self._accum("mu_product", p)
-        # scalar schedule carried as a same-shape accumulator for jit lifting
-        mu_prod_prev = jnp.where(mu_prod_prev == 0.0, 1.0, mu_prod_prev)
+        # seeded to ones at creation; never use 0 as an init sentinel (the
+        # product legitimately underflows toward 0 late in training)
+        mu_prod_prev = self._accum(
+            "mu_product", p, init=jnp.ones(p._value.shape, jnp.float32))
         mu_prod = mu_prod_prev * mu_t
         self._set_accum("mu_product", p, mu_prod)
         m = self._accum("moment1", p)

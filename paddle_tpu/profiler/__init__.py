@@ -15,3 +15,17 @@ __all__ = [
     "export_chrome_tracing", "export_protobuf", "load_profiler_result",
     "in_profiler_mode", "get_profiler",
 ]
+
+
+class SortedKeys:
+    """Summary-table sort orders (reference: profiler/profiler_statistic.py
+    SortedKeys enum)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7

@@ -304,3 +304,109 @@ def _to_sparse_csr(self: Tensor):
 
 Tensor.to_sparse_coo = _to_sparse_coo
 Tensor.to_sparse_csr = _to_sparse_csr
+
+# remaining zero-preserving unary surface (reference: sparse/unary.py)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+expm1 = _unary(jnp.expm1)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix x dense vector (reference: sparse/matmul.py mv)."""
+    from ..core.tensor import Tensor
+    from ..ops._helpers import ensure_tensor
+
+    coo = _as_coo(x)
+    v = ensure_tensor(vec)._value
+    return Tensor._from_value((coo @ v))
+
+
+def mask_as(x, mask, name=None):
+    """Dense x filtered by a sparse mask's pattern
+    (reference: sparse/unary.py mask_as)."""
+    from ..ops._helpers import ensure_tensor
+
+    coo = _as_coo(mask)
+    xv = ensure_tensor(x)._value
+    rows = tuple(coo.indices[:, i] for i in range(coo.indices.shape[1]))
+    vals = xv[rows]
+    return _wrap_like(mask, jsparse.BCOO((vals, coo.indices),
+                                         shape=coo.shape))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Reference: sparse pca_lowrank over a sparse input — densify (the
+    randomized iteration is dense anyway) and run the dense kernel."""
+    from ..core.tensor import Tensor
+    from ..ops.extras import pca_lowrank as _dense
+
+    coo = _as_coo(x)
+    return _dense(Tensor._from_value(coo.todense()), q=q, center=center,
+                  niter=niter)
+
+
+__all__ += [
+    "tan", "asin", "atan", "sinh", "asinh", "atanh", "square", "log1p",
+    "expm1", "deg2rad", "rad2deg", "mv", "mask_as", "pca_lowrank",
+]
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) with sparse x (reference: sparse/matmul.py
+    addmm)."""
+    from ..core.tensor import Tensor
+    from ..ops._helpers import ensure_tensor
+
+    coo = _as_coo(x)
+    yv = ensure_tensor(y)._value
+    iv = ensure_tensor(input)._value
+    return Tensor._from_value(beta * iv + alpha * (coo @ yv))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Sparse sum (reference: sparse/unary.py sum) — returns dense."""
+    from ..core.tensor import Tensor
+
+    coo = _as_coo(x)
+    dense = coo.todense()
+    out = dense.sum() if axis is None else dense.sum(
+        axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        out = out.astype(convert_dtype(dtype))
+    return Tensor._from_value(out)
+
+
+def reshape(x, shape, name=None):
+    """Sparse reshape (reference: sparse/unary.py reshape)."""
+    coo = _as_coo(x)
+    dense = coo.todense().reshape(tuple(shape))
+    return _wrap_like(x, jsparse.BCOO.fromdense(dense))
+
+
+def isnan(x, name=None):
+    return _unary(jnp.isnan)(x)
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Sparse slice (reference: sparse/unary.py slice) — dense roundtrip."""
+    coo = _as_coo(x)
+    dense = coo.todense()
+    import builtins
+
+    sl = [builtins.slice(None)] * dense.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[ax] = builtins.slice(int(st), int(en))
+    return _wrap_like(x, jsparse.BCOO.fromdense(dense[tuple(sl)]))
+
+
+__all__ += ["addmm", "sum", "reshape", "isnan", "slice"]

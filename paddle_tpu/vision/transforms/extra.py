@@ -1,0 +1,454 @@
+"""Vision transforms surface completion.
+
+Reference: python/paddle/vision/transforms/transforms.py + functional.py —
+color adjustments (brightness/contrast/saturation/hue, ColorJitter),
+geometric warps (affine/rotate/perspective via inverse-warp bilinear
+sampling), RandomResizedCrop, Grayscale, RandomErasing, crop/pad/erase
+functionals. Images are numpy HWC uint8/float or paddle Tensors (CHW),
+matching the package's existing convention.
+"""
+from __future__ import annotations
+
+import math
+import random as _random
+
+import numpy as np
+
+from . import _to_numpy_hwc, BaseTransform, center_crop, resize
+
+
+def _wrap_like(arr, meta=None):
+    # the package's functional convention returns plain numpy HWC arrays
+    return arr
+
+
+def _hwc(img):
+    return _to_numpy_hwc(img), None
+
+__all__ = [
+    "crop", "pad", "erase", "affine", "rotate", "perspective",
+    "to_grayscale", "adjust_brightness", "adjust_contrast", "adjust_hue",
+    "adjust_saturation", "RandomResizedCrop", "BrightnessTransform",
+    "SaturationTransform", "ContrastTransform", "HueTransform", "ColorJitter",
+    "RandomAffine", "RandomRotation", "RandomPerspective", "Grayscale",
+    "RandomErasing",
+]
+
+
+# ---------------------------------------------------------------------------
+# functional
+# ---------------------------------------------------------------------------
+def crop(img, top, left, height, width):
+    arr, meta = _hwc(img)
+    return _wrap_like(arr[top:top + height, left:left + width], meta)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr, meta = _hwc(img)
+    if isinstance(padding, int):
+        l = r = t = b = padding
+    elif len(padding) == 2:
+        l, t = padding
+        r, b = padding
+    else:
+        l, t, r, b = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    out = np.pad(arr, ((t, b), (l, r), (0, 0)), mode=mode, **kw)
+    return _wrap_like(out, meta)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr, meta = _hwc(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w, :] = v
+    return _wrap_like(out, meta)
+
+
+def _inverse_warp(arr, matrix, fill=0.0):
+    """Sample arr (HWC) at inverse-transformed grid coords; matrix maps
+    OUTPUT (x, y, 1) -> INPUT (x, y)."""
+    h, w = arr.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], axis=-1).reshape(-1, 3).astype(
+        np.float64)
+    src = coords @ np.asarray(matrix, np.float64).T  # [N, 2 or 3]
+    if src.shape[1] == 3:
+        src = src[:, :2] / np.maximum(src[:, 2:3], 1e-9)
+    sx = src[:, 0].reshape(h, w)
+    sy = src[:, 1].reshape(h, w)
+    x0 = np.floor(sx).astype(int)
+    y0 = np.floor(sy).astype(int)
+    wx = sx - x0
+    wy = sy - y0
+
+    def sample(yy, xx):
+        ok = (xx >= 0) & (xx < w) & (yy >= 0) & (yy < h)
+        yc = np.clip(yy, 0, h - 1)
+        xc = np.clip(xx, 0, w - 1)
+        vals = arr[yc, xc].astype(np.float64)
+        vals[~ok] = fill
+        return vals
+
+    out = (sample(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
+           + sample(y0, x0 + 1) * (wx * (1 - wy))[..., None]
+           + sample(y0 + 1, x0) * ((1 - wx) * wy)[..., None]
+           + sample(y0 + 1, x0 + 1) * (wx * wy)[..., None])
+    return out.astype(arr.dtype)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    cx, cy = center
+    rot = math.radians(angle)
+    sx, sy = (math.radians(s) for s in shear)
+    # torch/paddle convention: M = T(center) R(angle) Shear Scale T(-center) T(translate)
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    m = np.array([[a, b, 0.0], [c, d, 0.0]]) * scale
+    m[0, 2] = cx + translate[0] - (m[0, 0] * cx + m[0, 1] * cy)
+    m[1, 2] = cy + translate[1] - (m[1, 0] * cx + m[1, 1] * cy)
+    # invert for inverse warping
+    full = np.vstack([m, [0, 0, 1]])
+    return np.linalg.inv(full)[:2]
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    arr, meta = _hwc(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    if np.isscalar(shear):
+        shear = (shear, 0.0)
+    inv = _affine_matrix(angle, translate, scale, shear, center)
+    return _wrap_like(_inverse_warp(arr, inv, fill), meta)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr, meta = _hwc(img)
+    h, w = arr.shape[:2]
+    if expand:
+        rad = math.radians(angle)
+        nw = int(abs(w * math.cos(rad)) + abs(h * math.sin(rad)) + 0.5)
+        nh = int(abs(w * math.sin(rad)) + abs(h * math.cos(rad)) + 0.5)
+        pad_l = (nw - w) // 2
+        pad_t = (nh - h) // 2
+        arr = np.pad(arr, ((pad_t, nh - h - pad_t), (pad_l, nw - w - pad_l),
+                           (0, 0)))
+        h, w = nh, nw
+        center = None
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    inv = _affine_matrix(-angle, (0, 0), 1.0, (0.0, 0.0), center)
+    return _wrap_like(_inverse_warp(arr, inv, fill), meta)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Warp mapping startpoints -> endpoints (reference functional
+    perspective; solves the 8-dof homography)."""
+    arr, meta = _hwc(img)
+    a = []
+    bvec = []
+    # solve homography endpoints -> startpoints (inverse warp)
+    for (ex, ey), (sx, sy) in zip(endpoints, startpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        bvec.extend([sx, sy])
+    coeffs = np.linalg.lstsq(np.asarray(a, np.float64),
+                             np.asarray(bvec, np.float64), rcond=None)[0]
+    hmat = np.append(coeffs, 1.0).reshape(3, 3)
+    return _wrap_like(_inverse_warp(arr, hmat, fill), meta)
+
+
+_GRAY_W = np.array([0.299, 0.587, 0.114])
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr, meta = _hwc(img)
+    gray = (arr.astype(np.float64) @ _GRAY_W)[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    return _wrap_like(gray.astype(arr.dtype), meta)
+
+
+def _blend(a, b, factor, dtype):
+    out = a.astype(np.float64) * factor + b.astype(np.float64) * (1 - factor)
+    if np.issubdtype(dtype, np.integer):
+        out = np.clip(out, 0, 255)
+    return out.astype(dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr, meta = _hwc(img)
+    return _wrap_like(_blend(arr, np.zeros_like(arr), brightness_factor,
+                             arr.dtype), meta)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr, meta = _hwc(img)
+    mean = (arr.astype(np.float64) @ _GRAY_W).mean()
+    return _wrap_like(_blend(arr, np.full_like(arr, mean), contrast_factor,
+                             arr.dtype), meta)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr, meta = _hwc(img)
+    gray = (arr.astype(np.float64) @ _GRAY_W)[..., None]
+    return _wrap_like(_blend(arr, np.broadcast_to(gray, arr.shape),
+                             saturation_factor, arr.dtype), meta)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via HSV roundtrip
+    (reference functional adjust_hue)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr, meta = _hwc(img)
+    dtype = arr.dtype
+    x = arr.astype(np.float64)
+    if np.issubdtype(dtype, np.integer):
+        x = x / 255.0
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = x.max(-1)
+    minc = x.min(-1)
+    v = maxc
+    diff = maxc - minc
+    s = np.where(maxc > 0, diff / np.maximum(maxc, 1e-12), 0.0)
+    diff_safe = np.where(diff == 0, 1.0, diff)
+    rc = (maxc - r) / diff_safe
+    gc = (maxc - g) / diff_safe
+    bc = (maxc - b) / diff_safe
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(diff == 0, 0.0, h / 6.0 % 1.0)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(int) % 6
+    conds = [i == k for k in range(6)]
+    r2 = np.select(conds, [v, q, p, p, t, v])
+    g2 = np.select(conds, [t, v, v, q, p, p])
+    b2 = np.select(conds, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1)
+    if np.issubdtype(dtype, np.integer):
+        out = np.clip(out * 255.0, 0, 255)
+    return _wrap_like(out.astype(dtype), meta)
+
+
+# ---------------------------------------------------------------------------
+# transforms
+# ---------------------------------------------------------------------------
+class RandomResizedCrop(BaseTransform):
+    """Reference: transforms.py RandomResizedCrop."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr, meta = _hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = _random.uniform(*self.scale) * area
+            log_ratio = (math.log(self.ratio[0]), math.log(self.ratio[1]))
+            aspect = math.exp(_random.uniform(*log_ratio))
+            cw = int(round(math.sqrt(target_area * aspect)))
+            ch = int(round(math.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = _random.randint(0, h - ch)
+                left = _random.randint(0, w - cw)
+                cropped = arr[top:top + ch, left:left + cw]
+                return resize(_wrap_like(cropped, meta), self.size,
+                              self.interpolation)
+        return resize(center_crop(_wrap_like(arr, meta), min(h, w)),
+                      self.size, self.interpolation)
+
+
+class _FactorTransform(BaseTransform):
+    FN = None
+
+    def __init__(self, value, keys=None):
+        v = float(value)
+        if v < 0:
+            raise ValueError("value must be non-negative")
+        self.value = [max(0.0, 1 - v), 1 + v]
+
+    def _apply_image(self, img):
+        factor = _random.uniform(*self.value)
+        return type(self).FN(img, factor)
+
+
+class BrightnessTransform(_FactorTransform):
+    FN = staticmethod(adjust_brightness)
+
+
+class ContrastTransform(_FactorTransform):
+    FN = staticmethod(adjust_contrast)
+
+
+class SaturationTransform(_FactorTransform):
+    FN = staticmethod(adjust_saturation)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        v = float(value)
+        if not 0 <= v <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = [-v, v]
+
+    def _apply_image(self, img):
+        return adjust_hue(img, _random.uniform(*self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Reference: transforms.py ColorJitter — random order of the four
+    adjustments."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = list(self.transforms)
+        _random.shuffle(order)
+        for t in order:
+            img = t._apply_image(img)
+        return img
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        self.degrees = ((-degrees, degrees) if np.isscalar(degrees)
+                        else tuple(degrees))
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr, _ = _hwc(img)
+        h, w = arr.shape[:2]
+        angle = _random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate is not None:
+            tx = _random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = _random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = (_random.uniform(*self.scale) if self.scale is not None else 1.0)
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            shear = self.shear
+            if np.isscalar(shear):
+                sh = (_random.uniform(-shear, shear), 0.0)
+            elif len(shear) == 2:
+                sh = (_random.uniform(shear[0], shear[1]), 0.0)
+            else:
+                sh = (_random.uniform(shear[0], shear[1]),
+                      _random.uniform(shear[2], shear[3]))
+        return affine(img, angle, (tx, ty), sc, sh, self.interpolation,
+                      self.fill, self.center)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        self.degrees = ((-degrees, degrees) if np.isscalar(degrees)
+                        else tuple(degrees))
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        return rotate(img, _random.uniform(*self.degrees),
+                      self.interpolation, self.expand, self.center,
+                      self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if _random.random() >= self.prob:
+            return img
+        arr, _ = _hwc(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        hd = int(h * d / 2)
+        wd = int(w * d / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [
+            (_random.randint(0, wd), _random.randint(0, hd)),
+            (w - 1 - _random.randint(0, wd), _random.randint(0, hd)),
+            (w - 1 - _random.randint(0, wd), h - 1 - _random.randint(0, hd)),
+            (_random.randint(0, wd), h - 1 - _random.randint(0, hd)),
+        ]
+        return perspective(img, start, end, self.interpolation, self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomErasing(BaseTransform):
+    """Reference: transforms.py RandomErasing (Zhong et al. 2020)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if _random.random() >= self.prob:
+            return img
+        arr, meta = _hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = _random.uniform(*self.scale) * area
+            aspect = math.exp(_random.uniform(math.log(self.ratio[0]),
+                                              math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target / aspect)))
+            ew = int(round(math.sqrt(target * aspect)))
+            if eh < h and ew < w:
+                top = _random.randint(0, h - eh)
+                left = _random.randint(0, w - ew)
+                v = (np.random.randn(eh, ew, arr.shape[2])
+                     if self.value == "random" else self.value)
+                return erase(_wrap_like(arr, meta), top, left, eh, ew, v)
+        return img

@@ -343,3 +343,92 @@ class TestReviewFixes3:
         p = e / e.sum(-1, keepdims=True)
         want = np.einsum("bhqk,bhkd->bhqd", p, q)
         np.testing.assert_allclose(got.numpy(), want, rtol=1e-4, atol=1e-4)
+
+
+class TestReviewFixes4:
+    def test_matrix_nms_actually_suppresses(self):
+        import paddle_tpu.vision.ops as vops
+
+        boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                           [50, 50, 60, 60]]], dtype="float32")
+        scores = np.zeros((1, 2, 3), dtype="float32")
+        scores[0, 1] = [0.9, 0.85, 0.8]  # two overlapping + one distinct
+        out, rois_num = vops.matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.5, nms_top_k=10,
+            keep_top_k=10, background_label=0)
+        # heavily-overlapping duplicate must be decayed below post_threshold
+        assert int(rois_num.numpy()[0]) == 2, out.numpy()
+
+    def test_matrix_nms_index_alignment(self):
+        import paddle_tpu.vision.ops as vops
+
+        boxes = np.array([[[0, 0, 10, 10], [100, 100, 110, 110]]],
+                         dtype="float32")
+        scores = np.zeros((1, 3, 2), dtype="float32")
+        scores[0, 1] = [0.4, 0.1]
+        scores[0, 2] = [0.1, 0.9]   # class-2 box (index 1) scores highest
+        out, idx, rois_num = vops.matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.05, post_threshold=0.05, nms_top_k=10,
+            keep_top_k=10, return_index=True)
+        rows = out.numpy()
+        idxs = idx.numpy()
+        # first row = highest score (class 2, box 1); its index must be 1
+        assert rows[0][0] == 2 and idxs[0] == 1
+        assert rows[0][2] == 100.0  # and the box coords match that index
+
+    def test_py_func_shape_isolation(self):
+        f = lambda a: a * 3
+        a2 = paddle.to_tensor(_r(2, 2))
+        a3 = paddle.to_tensor(_r(3, 3))
+        y2 = static.py_func(f, a2, out=a2)
+        y3 = static.py_func(f, a3, out=a3)
+        np.testing.assert_allclose(y3.numpy(), a3.numpy() * 3, rtol=1e-6)
+        np.testing.assert_allclose(y2.numpy(), a2.numpy() * 3, rtol=1e-6)
+
+    def test_translated_layer_parameters_stable(self, tmp_path):
+        lin = nn.Linear(4, 2)
+        lin.eval()
+        path = str(tmp_path / "m2")
+        paddle.jit.save(lin, path,
+                        input_spec=[paddle.static.InputSpec([1, 4],
+                                                            "float32")])
+        loaded = paddle.jit.load(path)
+        p1 = loaded.parameters()
+        p2 = loaded.parameters()
+        assert all(a is b for a, b in zip(p1, p2))
+
+    def test_yolo_loss_ignore_thresh_matters(self):
+        import paddle_tpu.vision.ops as vops
+
+        np.random.seed(0)
+        x = np.random.randn(1, 3 * 85, 4, 4).astype("float32") * 0.1
+        gt_box = np.array([[[0.5, 0.5, 0.4, 0.4]]], dtype="float32")
+        gt_label = np.array([[1]], dtype="int32")
+        kw = dict(anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+                  class_num=80, downsample_ratio=32)
+        l_strict = vops.yolo_loss(paddle.to_tensor(x),
+                                  paddle.to_tensor(gt_box),
+                                  paddle.to_tensor(gt_label),
+                                  ignore_thresh=0.999, **kw)
+        l_loose = vops.yolo_loss(paddle.to_tensor(x),
+                                 paddle.to_tensor(gt_box),
+                                 paddle.to_tensor(gt_label),
+                                 ignore_thresh=0.0, **kw)
+        # lower threshold ignores more negatives -> smaller objectness loss
+        assert float(l_loose.numpy().sum()) < float(l_strict.numpy().sum())
+
+    def test_asgd_jit_liftable_state(self):
+        lin = nn.Linear(3, 1, bias_attr=False)
+        o = opt.ASGD(learning_rate=0.1, batch_num=3,
+                     parameters=lin.parameters())
+        x = paddle.to_tensor(_r(8, 3))
+        for _ in range(5):
+            (lin(x) ** 2).mean().backward()
+            o.step()
+            o.clear_grad()
+        # all state lives in accumulators (functional-lifting requirement)
+        assert "grad_window" in o._accumulators
+        w = next(iter(o._accumulators["grad_window"].values()))
+        assert w.shape[0] == 3
