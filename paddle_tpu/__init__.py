@@ -166,3 +166,54 @@ def synchronize():
     import jax
 
     (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def _patch_tensor_method_surface():
+    """Attach the remaining tensor_method_func names as Tensor methods
+    (reference: python/paddle/tensor/__init__.py patches every listed
+    function onto the eager Tensor type)."""
+    _names = [
+        "ormqr", "cov", "corrcoef", "cond", "lstsq", "t", "cholesky_inverse",
+        "histogram_bin_edges", "histogramdd", "mv", "qr",
+        "householder_product", "pca_lowrank", "svd_lowrank", "eigvals",
+        "eigvalsh", "logit", "logaddexp", "multiplex", "sinc", "reduce_as",
+        "multigammaln", "hypot", "block_diag", "floor_mod", "addmm", "isin",
+        "isneginf", "isposinf", "isreal", "broadcast_shape", "gammaincc",
+        "gammainc", "is_empty", "is_tensor", "reverse", "scatter_nd",
+        "shard_index", "slice", "slice_scatter", "tensor_split", "hsplit",
+        "dsplit", "vsplit", "stack", "unique_consecutive", "unstack",
+        "top_p_sampling", "is_complex", "is_integer", "rank",
+        "is_floating_point", "gammaln", "broadcast_tensors", "eig",
+        "multi_dot", "cholesky_solve", "triangular_solve", "asinh", "atanh",
+        "acosh", "lu", "lu_unpack", "cdist", "select_scatter", "heaviside",
+        "index_put", "take", "bucketize", "sgn", "frexp", "ldexp",
+        "trapezoid", "cumulative_trapezoid", "polar", "sigmoid_", "vander",
+        "nextafter", "unflatten", "as_strided", "view", "view_as", "unfold",
+        "i0", "i0e", "i1", "i1e", "polygamma", "diagflat", "multinomial",
+        "renorm", "stft", "istft", "diag", "copysign", "bitwise_left_shift",
+        "bitwise_right_shift", "index_fill", "atleast_1d", "atleast_2d",
+        "atleast_3d", "diagonal_scatter", "masked_scatter", "combinations",
+        "signbit",
+    ]
+    mod = _sys.modules[__name__]
+    for n in _names:
+        fn = getattr(mod, n, None)
+        if fn is None and n == "sigmoid_":
+            from .ops.math import _make_inplace
+            from .ops.activation import sigmoid as _sig
+
+            fn = _make_inplace(_sig)
+        if callable(fn) and not hasattr(Tensor, n):
+            setattr(Tensor, n, fn)
+    # signal-domain methods + factory functions the reference also attaches
+    from .signal import istft as _istft, stft as _stft
+
+    for n, fn in (("stft", _stft), ("istft", _istft),
+                  ("create_parameter", create_parameter),
+                  ("create_tensor", getattr(mod, "create_tensor", None))):
+        if callable(fn) and not hasattr(Tensor, n):
+            setattr(Tensor, n, staticmethod(fn) if n.startswith("create")
+                    else fn)
+
+
+_patch_tensor_method_surface()

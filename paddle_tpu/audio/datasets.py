@@ -27,8 +27,9 @@ class _SyntheticAudioDataset(Dataset):
                 f"{type(self).__name__}: audio archives are unavailable in "
                 "this environment; place the files locally or use "
                 "synthetic=True")
-        seed = abs(hash((type(self).__name__, mode))) % (2 ** 31)
-        rng = np.random.default_rng(seed)
+        from ..dataset.common import _synthetic_rng
+
+        rng = _synthetic_rng(f"{type(self).__name__}-{mode}")
         self.mode = mode
         self.feat_type = feat_type
         self._feat_kwargs = feat_kwargs

@@ -87,14 +87,26 @@ class ParallelEnv:
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0,
                         group=None):
-    """Reference: communication/scatter.py scatter_object_list — single-host
-    mesh build: rank src's list is partitioned across ranks."""
+    """Reference: communication/scatter.py scatter_object_list — rank src's
+    list is partitioned across ranks. Non-src ranks may pass None ONLY when
+    a cross-process transport exists; this runtime is mesh-per-process, so
+    the list must be visible on every rank (the usual single-controller
+    pattern), and src selects nothing beyond validation."""
     from . import env as _env
 
     rank = _env.get_rank(group)
     world = _env.get_world_size(group)
     if in_object_list is None:
-        raise ValueError("src rank must provide in_object_list")
+        if rank == src:
+            raise ValueError("src rank must provide in_object_list")
+        raise NotImplementedError(
+            "scatter_object_list with rank-local None requires cross-process "
+            "object transport; in the mesh runtime pass the full list on "
+            "every rank")
+    if len(in_object_list) % world:
+        raise ValueError(
+            f"in_object_list length {len(in_object_list)} must divide the "
+            f"group size {world}")
     per = len(in_object_list) // world
     out_object_list.clear()
     out_object_list.extend(in_object_list[rank * per:(rank + 1) * per])
@@ -121,31 +133,40 @@ def gloo_release():
     """No persistent gloo context to release in the TPU build."""
 
 
+_split_layer_cache = {}
+
+
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
           weight_attr=None, bias_attr=None, name=None):
     """Model-parallel split (reference: fleet/layers/mpu/mp_ops.py:698 —
     builds a row/column-parallel embedding or linear over num_partitions).
     The TPU build expresses the same layouts with the fleet mpu layers over
-    the mesh mp axis."""
+    the mesh mp axis. The created layer is cached per (name-or-config) so
+    repeated forward calls reuse the SAME parameters; pass ``name`` to
+    distinguish multiple splits with identical configs."""
     from .fleet.mp_layers import (ColumnParallelLinear, RowParallelLinear,
                                   VocabParallelEmbedding)
 
-    if operation == "embedding":
-        layer = VocabParallelEmbedding(size[0], size[1],
-                                       weight_attr=weight_attr)
-        return layer(x)
-    if operation == "linear":
-        if axis == 0:
-            layer = RowParallelLinear(size[0], size[1],
-                                      weight_attr=weight_attr,
-                                      has_bias=bias_attr is not False)
+    key = (name, operation, tuple(size), axis, num_partitions, gather_out)
+    layer = _split_layer_cache.get(key)
+    if layer is None:
+        if operation == "embedding":
+            layer = VocabParallelEmbedding(size[0], size[1],
+                                           weight_attr=weight_attr)
+        elif operation == "linear":
+            if axis == 0:
+                layer = RowParallelLinear(size[0], size[1],
+                                          weight_attr=weight_attr,
+                                          has_bias=bias_attr is not False)
+            else:
+                layer = ColumnParallelLinear(size[0], size[1],
+                                             weight_attr=weight_attr,
+                                             has_bias=bias_attr is not False,
+                                             gather_output=gather_out)
         else:
-            layer = ColumnParallelLinear(size[0], size[1],
-                                         weight_attr=weight_attr,
-                                         has_bias=bias_attr is not False,
-                                         gather_output=gather_out)
-        return layer(x)
-    raise ValueError(f"unsupported operation {operation!r}")
+            raise ValueError(f"unsupported operation {operation!r}")
+        _split_layer_cache[key] = layer
+    return layer(x)
 
 
 # PS-mode sparse-table entry configs (reference: distributed/entry_attr.py)

@@ -23,6 +23,7 @@ from ..core.tensor import Tensor, apply
 from ._helpers import defprim, ensure_tensor
 
 __all__ = [
+    "create_tensor",
     "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
     "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
     "tril", "triu", "diag", "diagflat", "meshgrid", "assign", "clone",
@@ -376,3 +377,13 @@ def triu_indices(row, col=None, offset=0, dtype="int64") -> Tensor:
     col = col if col is not None else row
     r, c = np.triu_indices(row, offset, col)
     return Tensor._from_value(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Reference: tensor/creation.py create_tensor — an empty typed tensor
+    placeholder (static-era API; eager form is a 0-size tensor)."""
+    from ..core.dtype import convert_dtype
+
+    t = Tensor(jnp.zeros((0,), convert_dtype(dtype)))
+    t.persistable = persistable
+    return t

@@ -207,7 +207,18 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     shapes = tuple(jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype)
                    for o in outs_spec)
     sig = tuple((s.shape, str(s.dtype)) for s in shapes)
-    key = (func, sig)
+    # bound methods produce a fresh object per attribute access — key on the
+    # underlying function so they don't leak one primitive per call
+    key = (getattr(func, "__func__", func), sig)
+    if len(_py_func_registry) > 512:
+        # bound: per-call lambdas would otherwise grow the primitive table
+        # without limit
+        from ..core import dispatch as _dispatch
+
+        for (_, _), old_name in list(_py_func_registry.items())[:256]:
+            _dispatch.PRIMITIVES.pop(old_name, None)
+        for k in list(_py_func_registry)[:256]:
+            del _py_func_registry[k]
     name = _py_func_registry.get(key)
     if name is None:
         _py_func_counter[0] += 1

@@ -152,7 +152,8 @@ class DatasetFolder(Dataset):
 
         self.root = root
         self.transform = transform
-        extensions = extensions or (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+        extensions = tuple(extensions) if extensions else (
+            ".jpg", ".jpeg", ".png", ".bmp", ".npy")
         classes = sorted(
             d for d in os.listdir(root)
             if os.path.isdir(os.path.join(root, d)))
@@ -194,7 +195,8 @@ class ImageFolder(Dataset):
                  is_valid_file=None):
         import os
 
-        extensions = extensions or (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+        extensions = tuple(extensions) if extensions else (
+            ".jpg", ".jpeg", ".png", ".bmp", ".npy")
         if loader is None:
             from .. import image_load
 

@@ -764,10 +764,13 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         boxes = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2,
                           cy + bh / 2], axis=1)
         h_im, w_im = im[i]
-        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, w_im - 1)
-        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, h_im - 1)
-        ok = ((boxes[:, 2] - boxes[:, 0] >= min_size)
-              & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        # pixel_offset toggles the clip bound and the +1 size convention
+        # (reference generate_proposals kernel)
+        off = 1.0 if pixel_offset else 0.0
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, w_im - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, h_im - off)
+        ok = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+              & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
         boxes, sc_k = boxes[ok], sc_k[ok]
         keep = np.asarray(nms(Tensor._from_value(jnp.asarray(
             boxes.astype(np.float32))), nms_thresh,

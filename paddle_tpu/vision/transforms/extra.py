@@ -17,14 +17,6 @@ import numpy as np
 from . import _to_numpy_hwc, BaseTransform, center_crop, resize
 
 
-def _wrap_like(arr, meta=None):
-    # the package's functional convention returns plain numpy HWC arrays
-    return arr
-
-
-def _hwc(img):
-    return _to_numpy_hwc(img), None
-
 __all__ = [
     "crop", "pad", "erase", "affine", "rotate", "perspective",
     "to_grayscale", "adjust_brightness", "adjust_contrast", "adjust_hue",
@@ -39,12 +31,12 @@ __all__ = [
 # functional
 # ---------------------------------------------------------------------------
 def crop(img, top, left, height, width):
-    arr, meta = _hwc(img)
-    return _wrap_like(arr[top:top + height, left:left + width], meta)
+    arr = _to_numpy_hwc(img)
+    return arr[top:top + height, left:left + width]
 
 
 def pad(img, padding, fill=0, padding_mode="constant"):
-    arr, meta = _hwc(img)
+    arr = _to_numpy_hwc(img)
     if isinstance(padding, int):
         l = r = t = b = padding
     elif len(padding) == 2:
@@ -56,14 +48,14 @@ def pad(img, padding, fill=0, padding_mode="constant"):
             "symmetric": "symmetric"}[padding_mode]
     kw = {"constant_values": fill} if mode == "constant" else {}
     out = np.pad(arr, ((t, b), (l, r), (0, 0)), mode=mode, **kw)
-    return _wrap_like(out, meta)
+    return out
 
 
 def erase(img, i, j, h, w, v, inplace=False):
-    arr, meta = _hwc(img)
+    arr = _to_numpy_hwc(img)
     out = arr if inplace else arr.copy()
     out[i:i + h, j:j + w, :] = v
-    return _wrap_like(out, meta)
+    return out
 
 
 def _inverse_warp(arr, matrix, fill=0.0):
@@ -118,19 +110,19 @@ def _affine_matrix(angle, translate, scale, shear, center):
 
 def affine(img, angle, translate, scale, shear, interpolation="nearest",
            fill=0, center=None):
-    arr, meta = _hwc(img)
+    arr = _to_numpy_hwc(img)
     h, w = arr.shape[:2]
     if center is None:
         center = ((w - 1) * 0.5, (h - 1) * 0.5)
     if np.isscalar(shear):
         shear = (shear, 0.0)
     inv = _affine_matrix(angle, translate, scale, shear, center)
-    return _wrap_like(_inverse_warp(arr, inv, fill), meta)
+    return _inverse_warp(arr, inv, fill)
 
 
 def rotate(img, angle, interpolation="nearest", expand=False, center=None,
            fill=0):
-    arr, meta = _hwc(img)
+    arr = _to_numpy_hwc(img)
     h, w = arr.shape[:2]
     if expand:
         rad = math.radians(angle)
@@ -145,13 +137,13 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     if center is None:
         center = ((w - 1) * 0.5, (h - 1) * 0.5)
     inv = _affine_matrix(-angle, (0, 0), 1.0, (0.0, 0.0), center)
-    return _wrap_like(_inverse_warp(arr, inv, fill), meta)
+    return _inverse_warp(arr, inv, fill)
 
 
 def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
     """Warp mapping startpoints -> endpoints (reference functional
     perspective; solves the 8-dof homography)."""
-    arr, meta = _hwc(img)
+    arr = _to_numpy_hwc(img)
     a = []
     bvec = []
     # solve homography endpoints -> startpoints (inverse warp)
@@ -162,18 +154,18 @@ def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
     coeffs = np.linalg.lstsq(np.asarray(a, np.float64),
                              np.asarray(bvec, np.float64), rcond=None)[0]
     hmat = np.append(coeffs, 1.0).reshape(3, 3)
-    return _wrap_like(_inverse_warp(arr, hmat, fill), meta)
+    return _inverse_warp(arr, hmat, fill)
 
 
 _GRAY_W = np.array([0.299, 0.587, 0.114])
 
 
 def to_grayscale(img, num_output_channels=1):
-    arr, meta = _hwc(img)
+    arr = _to_numpy_hwc(img)
     gray = (arr.astype(np.float64) @ _GRAY_W)[..., None]
     if num_output_channels == 3:
         gray = np.repeat(gray, 3, axis=-1)
-    return _wrap_like(gray.astype(arr.dtype), meta)
+    return gray.astype(arr.dtype)
 
 
 def _blend(a, b, factor, dtype):
@@ -184,23 +176,21 @@ def _blend(a, b, factor, dtype):
 
 
 def adjust_brightness(img, brightness_factor):
-    arr, meta = _hwc(img)
-    return _wrap_like(_blend(arr, np.zeros_like(arr), brightness_factor,
-                             arr.dtype), meta)
+    arr = _to_numpy_hwc(img)
+    return _blend(arr, np.zeros_like(arr), brightness_factor, arr.dtype)
 
 
 def adjust_contrast(img, contrast_factor):
-    arr, meta = _hwc(img)
+    arr = _to_numpy_hwc(img)
     mean = (arr.astype(np.float64) @ _GRAY_W).mean()
-    return _wrap_like(_blend(arr, np.full_like(arr, mean), contrast_factor,
-                             arr.dtype), meta)
+    return _blend(arr, np.full_like(arr, mean), contrast_factor, arr.dtype)
 
 
 def adjust_saturation(img, saturation_factor):
-    arr, meta = _hwc(img)
+    arr = _to_numpy_hwc(img)
     gray = (arr.astype(np.float64) @ _GRAY_W)[..., None]
-    return _wrap_like(_blend(arr, np.broadcast_to(gray, arr.shape),
-                             saturation_factor, arr.dtype), meta)
+    return _blend(arr, np.broadcast_to(gray, arr.shape),
+                  saturation_factor, arr.dtype)
 
 
 def adjust_hue(img, hue_factor):
@@ -208,7 +198,7 @@ def adjust_hue(img, hue_factor):
     (reference functional adjust_hue)."""
     if not -0.5 <= hue_factor <= 0.5:
         raise ValueError("hue_factor must be in [-0.5, 0.5]")
-    arr, meta = _hwc(img)
+    arr = _to_numpy_hwc(img)
     dtype = arr.dtype
     x = arr.astype(np.float64)
     if np.issubdtype(dtype, np.integer):
@@ -240,7 +230,7 @@ def adjust_hue(img, hue_factor):
     out = np.stack([r2, g2, b2], axis=-1)
     if np.issubdtype(dtype, np.integer):
         out = np.clip(out * 255.0, 0, 255)
-    return _wrap_like(out.astype(dtype), meta)
+    return out.astype(dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -257,7 +247,7 @@ class RandomResizedCrop(BaseTransform):
         self.interpolation = interpolation
 
     def _apply_image(self, img):
-        arr, meta = _hwc(img)
+        arr = _to_numpy_hwc(img)
         h, w = arr.shape[:2]
         area = h * w
         for _ in range(10):
@@ -270,9 +260,9 @@ class RandomResizedCrop(BaseTransform):
                 top = _random.randint(0, h - ch)
                 left = _random.randint(0, w - cw)
                 cropped = arr[top:top + ch, left:left + cw]
-                return resize(_wrap_like(cropped, meta), self.size,
+                return resize(cropped, self.size,
                               self.interpolation)
-        return resize(center_crop(_wrap_like(arr, meta), min(h, w)),
+        return resize(center_crop(arr, min(h, w)),
                       self.size, self.interpolation)
 
 
@@ -350,7 +340,7 @@ class RandomAffine(BaseTransform):
         self.interpolation = interpolation
 
     def _apply_image(self, img):
-        arr, _ = _hwc(img)
+        arr = _to_numpy_hwc(img)
         h, w = arr.shape[:2]
         angle = _random.uniform(*self.degrees)
         tx = ty = 0
@@ -399,7 +389,7 @@ class RandomPerspective(BaseTransform):
     def _apply_image(self, img):
         if _random.random() >= self.prob:
             return img
-        arr, _ = _hwc(img)
+        arr = _to_numpy_hwc(img)
         h, w = arr.shape[:2]
         d = self.distortion_scale
         hd = int(h * d / 2)
@@ -436,7 +426,7 @@ class RandomErasing(BaseTransform):
     def _apply_image(self, img):
         if _random.random() >= self.prob:
             return img
-        arr, meta = _hwc(img)
+        arr = _to_numpy_hwc(img)
         h, w = arr.shape[:2]
         area = h * w
         for _ in range(10):
@@ -450,5 +440,5 @@ class RandomErasing(BaseTransform):
                 left = _random.randint(0, w - ew)
                 v = (np.random.randn(eh, ew, arr.shape[2])
                      if self.value == "random" else self.value)
-                return erase(_wrap_like(arr, meta), top, left, eh, ew, v)
+                return erase(arr, top, left, eh, ew, v)
         return img

@@ -58,3 +58,42 @@ def test_namespace_parity(sub):
     mod = importlib.import_module(modname)
     missing = [n for n in names if not hasattr(mod, n)]
     assert not missing, f"{modname} missing {len(missing)}: {missing}"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_ROOT),
+                    reason="reference tree not mounted")
+def test_tensor_method_surface():
+    """Every name in the reference's tensor_method_func list is a Tensor
+    attribute (python/paddle/tensor/__init__.py method patching)."""
+    import paddle_tpu
+
+    src = open(REF_ROOT + "tensor/__init__.py").read()
+    tree = ast.parse(src)
+    names = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "tensor_method_func" and \
+                        isinstance(node.value, (ast.List, ast.Tuple)):
+                    names = [e.value for e in node.value.elts
+                             if isinstance(e, ast.Constant)]
+    assert names
+    missing = [n for n in names if not hasattr(paddle_tpu.Tensor, n)]
+    assert not missing, f"Tensor missing {len(missing)}: {missing}"
+
+
+def test_patched_methods_execute():
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
+    assert x.t().shape == [4, 4]
+    q, r = x.qr()
+    assert x.diag().shape == [4]
+    assert x.rank() == 4 or int(x.rank()) == 2  # rank = ndim op
+    v = paddle.to_tensor(np.random.rand(64).astype("float32"))
+    assert v.stft(n_fft=16, hop_length=8).shape == [9, 9]
+    y = paddle.to_tensor(np.random.rand(3).astype("float32"))
+    y.sigmoid_()
+    assert float(y.numpy().max()) <= 1.0
