@@ -1,0 +1,135 @@
+"""Vision transforms extras (reference: test/legacy_test/test_transforms.py).
+
+Oracles: closed-form numpy for color adjustments, geometric invariants for
+warps (identity transforms, known shifts), and torch where its functional
+matches (grayscale weights).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.vision.transforms as T
+
+
+def _img(h=8, w=8):
+    rng = np.random.default_rng(0)
+    return (rng.random((h, w, 3)) * 255).astype("uint8")
+
+
+class TestColorAdjustments:
+    def test_brightness_scales(self):
+        img = _img()
+        out = T.adjust_brightness(img, 0.5)
+        np.testing.assert_allclose(out, (img * 0.5).astype("uint8"), atol=1)
+        np.testing.assert_array_equal(T.adjust_brightness(img, 1.0), img)
+
+    def test_contrast_identity_and_zero(self):
+        img = _img()
+        np.testing.assert_array_equal(T.adjust_contrast(img, 1.0), img)
+        flat = T.adjust_contrast(img, 0.0)
+        # zero contrast collapses to the mean gray value
+        assert np.unique(flat).size <= 2
+        gray_mean = (img.astype("float64") @ [0.299, 0.587, 0.114]).mean()
+        assert abs(float(flat.mean()) - gray_mean) <= 1.0
+
+    def test_saturation_zero_is_grayscale(self):
+        img = _img()
+        gray = T.adjust_saturation(img, 0.0)
+        np.testing.assert_allclose(gray[..., 0], gray[..., 1], atol=1)
+        np.testing.assert_allclose(gray[..., 1], gray[..., 2], atol=1)
+
+    def test_hue_identity_and_range(self):
+        img = _img()
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=1)
+        out = T.adjust_hue(img, 0.25)
+        assert out.dtype == np.uint8
+        with pytest.raises(ValueError):
+            T.adjust_hue(img, 0.7)
+
+    def test_hue_full_cycle_roundtrip(self):
+        img = _img()
+        once = T.adjust_hue(img, 0.5)
+        back = T.adjust_hue(once, 0.5)  # two half-turns = identity
+        np.testing.assert_allclose(back, img, atol=2)
+
+    def test_to_grayscale_weights(self):
+        img = _img().astype("float32")
+        gray = T.to_grayscale(img)
+        want = img @ np.array([0.299, 0.587, 0.114])
+        np.testing.assert_allclose(gray[..., 0], want, rtol=1e-5)
+
+
+class TestGeometric:
+    def test_affine_identity(self):
+        img = _img()
+        out = T.affine(img, angle=0, translate=(0, 0), scale=1.0, shear=0)
+        np.testing.assert_allclose(out, img, atol=1)
+
+    def test_affine_translate_shifts(self):
+        img = np.zeros((8, 8, 1), dtype="float32")
+        img[2, 2, 0] = 1.0
+        out = T.affine(img, angle=0, translate=(2, 1), scale=1.0, shear=0)
+        assert out[3, 4, 0] == pytest.approx(1.0, abs=1e-4)
+
+    def test_rotate_90_moves_corner(self):
+        img = np.zeros((9, 9, 1), dtype="float32")
+        img[0, 0, 0] = 1.0
+        out = T.rotate(img, 90)
+        # oracle: torchvision/paddle convention = np.rot90(img) for angle=90
+        want = np.rot90(img, 1, axes=(0, 1))
+        np.testing.assert_allclose(out, want, atol=1e-3)
+
+    def test_rotate_expand_grows(self):
+        img = _img(6, 10)
+        out = T.rotate(img, 45, expand=True)
+        assert out.shape[0] > 6 and out.shape[1] > 10
+
+    def test_perspective_identity(self):
+        img = _img()
+        pts = [(0, 0), (7, 0), (7, 7), (0, 7)]
+        out = T.perspective(img, pts, pts)
+        np.testing.assert_allclose(out, img, atol=1)
+
+    def test_crop_pad_roundtrip(self):
+        img = _img()
+        padded = T.pad(img, 2)
+        assert padded.shape == (12, 12, 3)
+        back = T.crop(padded, 2, 2, 8, 8)
+        np.testing.assert_array_equal(back, img)
+
+    def test_pad_modes(self):
+        img = _img()
+        for mode in ("constant", "edge", "reflect", "symmetric"):
+            out = T.pad(img, (1, 2, 3, 4), padding_mode=mode)
+            assert out.shape == (8 + 2 + 4, 8 + 1 + 3, 3)
+
+
+class TestRandomTransforms:
+    def test_random_resized_crop_shape(self):
+        out = T.RandomResizedCrop(4)(_img(16, 16))
+        assert out.shape[:2] == (4, 4)
+
+    def test_random_erasing_erases(self):
+        img = np.ones((16, 16, 3), dtype="float32")
+        out = T.RandomErasing(prob=1.0, value=0)(img)
+        assert out.min() == 0.0 and out.max() == 1.0
+
+    def test_random_affine_rotation_perspective_run(self):
+        img = _img(12, 12)
+        assert T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                              shear=5)(img).shape == (12, 12, 3)
+        assert T.RandomRotation(15)(img).shape == (12, 12, 3)
+        assert T.RandomPerspective(prob=1.0)(img).shape == (12, 12, 3)
+
+    def test_grayscale_transform(self):
+        out = T.Grayscale(3)(_img())
+        assert out.shape == (8, 8, 3)
+
+    def test_compose_pipeline(self):
+        pipe = T.Compose([
+            T.RandomResizedCrop(6),
+            T.ColorJitter(0.2, 0.2, 0.2, 0.1),
+            T.Grayscale(3),
+            T.ToTensor(),
+        ])
+        out = pipe(_img(16, 16))
+        assert list(out.shape) == [3, 6, 6]
