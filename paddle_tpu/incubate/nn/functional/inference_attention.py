@@ -47,8 +47,9 @@ def _mmha_fwd(x, cache_kv, src_mask, seq_lens, *, num_heads, use_mask,
         # its trailing dim carries the current timestep
         pos = jnp.full((b,), src_mask.shape[-1] - 1, dtype=jnp.int32)
     else:
-        # neither given: first decode step, append at 0
-        pos = jnp.zeros((b,), dtype=jnp.int32)
+        # unreachable: the public wrapper rejects calls with no step signal
+        raise ValueError(
+            "masked_mha_p requires src_mask or sequence_lengths")
 
     # functional cache append: scatter k/v at [b, :, pos[b], :]
     b_idx = jnp.arange(b)
@@ -110,12 +111,21 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         from ....ops.math import add
 
         x = add(x, reshape(ensure_tensor(bias), [3 * num_heads * head_dim]))
-    if rotary_emb_dims > 0 and rotary_tensor is not None:
-        x = _apply_decode_rope(x, ensure_tensor(rotary_tensor),
-                               sequence_lengths, num_heads, head_dim,
-                               use_neox_rotary_style)
     use_mask = src_mask is not None
     use_seq = sequence_lengths is not None
+    if not use_mask and not use_seq:
+        # without a step signal every decode step would silently overwrite
+        # cache slot 0 (and use RoPE position 0)
+        raise ValueError(
+            "masked_multihead_attention needs a decode-step signal: pass "
+            "src_mask ([B,1,1,t+1] at step t) or sequence_lengths ([B,1])")
+    if rotary_emb_dims > 0 and rotary_tensor is not None:
+        # when only src_mask is given, its trailing dim carries the step
+        mask_pos = (ensure_tensor(src_mask).shape[-1] - 1) if not use_seq \
+            else 0
+        x = _apply_decode_rope(x, ensure_tensor(rotary_tensor),
+                               sequence_lengths, num_heads, head_dim,
+                               use_neox_rotary_style, fallback_pos=mask_pos)
     mask_t = ensure_tensor(src_mask) if use_mask else x
     seq_t = ensure_tensor(sequence_lengths) if use_seq else x
     out, cache_out = apply("masked_mha_p", x, cache, mask_t, seq_t,
@@ -138,13 +148,18 @@ def _rope_rows(rot, b, pos):
     return cos_tab[bi, pos], sin_tab[bi, pos]  # each [B, D]
 
 
-def _apply_decode_rope(x, rotary_tensor, sequence_lengths, h, d, neox):
-    """RoPE on the q/k slices of a packed decode qkv row."""
+def _apply_decode_rope(x, rotary_tensor, sequence_lengths, h, d, neox,
+                       fallback_pos=0):
+    """RoPE on the q/k slices of a packed decode qkv row.
+
+    fallback_pos: step position to use when sequence_lengths is absent
+    (derived from the src_mask width by the caller)."""
     def fwd(xv, rot, lens):
         b = xv.shape[0]
         qkv = xv.reshape(b, 3, h, d)
         pos = (lens.reshape(b).astype(jnp.int32)
-               if lens is not None else jnp.zeros((b,), jnp.int32))
+               if lens is not None
+               else jnp.full((b,), fallback_pos, jnp.int32))
         cos, sin = _rope_rows(rot, b, pos)
         cos = cos[:, None, :]
         sin = sin[:, None, :]

@@ -96,7 +96,12 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     from ...ops import math as m
 
     if norm_by_times:
-        nll = m.divide(nll, in_lens.astype("float32"))
+        # warpctc norm_by_times normalizes only the GRADIENT by the number
+        # of time steps; the reported loss value stays unscaled. Value-
+        # preserving trick: forward value = nll, backward flows through
+        # nll/T only.
+        scaled = m.divide(nll, in_lens.astype("float32"))
+        nll = m.add(scaled, m.subtract(nll, scaled).detach())
     if reduction == "mean":
         # reference mean divides each sample by its label length first
         return m.mean(m.divide(

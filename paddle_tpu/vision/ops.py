@@ -860,19 +860,29 @@ def _yolo_loss_fwd(x, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
     def gather_pred(p):
         return p[bi, best_a, gj, gi]                      # [N, B]
 
+    def sce(logit, label):
+        # numerically-stable sigmoid cross-entropy on raw logits
+        # (reference SigmoidCrossEntropy, yolo_loss_kernel.cc:33)
+        return (jnp.maximum(logit, 0) - logit * label
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
     tx = gx - gi
     ty = gy - gj
-    box_scale = 2.0 - gw * gh
-    l_xy = (jnp.square(gather_pred(px) - tx)
-            + jnp.square(gather_pred(py) - ty)) * box_scale * score
-    l_wh = (jnp.square(gather_pred(pw_raw) - tw_sel)
-            + jnp.square(gather_pred(ph_raw) - th_sel)) * box_scale * score
+    # reference CalcBoxLocationLoss: SCE on raw x/y logits, L1 on raw w/h,
+    # all scaled by (2 - gw*gh) * score
+    box_scale = (2.0 - gw * gh) * score
+    x_logit = x[:, :, 0]
+    y_logit = x[:, :, 1]
+    l_xy = (sce(gather_pred(x_logit), tx)
+            + sce(gather_pred(y_logit), ty)) * box_scale
+    l_wh = (jnp.abs(gather_pred(pw_raw) - tw_sel)
+            + jnp.abs(gather_pred(ph_raw) - th_sel)) * box_scale
 
-    # objectness: positives at assigned cells; negatives everywhere EXCEPT
-    # cells whose predicted box overlaps any gt above ignore_thresh
-    # (reference yolo_loss ignore mask)
+    # objectness: positive cells carry the per-gt (mixup) score; negatives
+    # everywhere EXCEPT cells whose predicted box overlaps any gt above
+    # ignore_thresh (reference yolo_loss ignore mask + CalcObjnessLoss)
     obj_target = obj_target.at[bi, best_a, gj, gi].max(
-        jnp.where(valid, 1.0, 0.0))
+        jnp.where(valid, score, 0.0))
     # decode every predicted box [N, A, H, W, 4] (normalized xywh)
     cell_x = jnp.arange(w)[None, None, None, :]
     cell_y = jnp.arange(h)[None, None, :, None]
@@ -903,20 +913,22 @@ def _yolo_loss_fwd(x, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
     union = (pred_w * pred_h)[..., None] + gt_w * gt_h - inter
     pred_iou = jnp.where(valid[:, None, None, None, :],
                          inter / jnp.maximum(union, 1e-9), 0.0)
-    ignore = (pred_iou.max(-1) > ignore_thresh) & (obj_target < 0.5)
-    obj_weight = jnp.where(ignore, 0.0, 1.0)
-    obj_ce = jnp.maximum(obj_logit, 0) - obj_logit * obj_target + \
-        jnp.log1p(jnp.exp(-jnp.abs(obj_logit)))
-    l_obj = (obj_ce * obj_weight).sum(axis=(1, 2, 3))
+    pos = obj_target > 1e-5
+    ignore = (pred_iou.max(-1) > ignore_thresh) & ~pos
+    # positive: SCE(logit, 1) * score; negative (non-ignored): SCE(logit, 0)
+    l_obj_map = jnp.where(
+        pos, sce(obj_logit, 1.0) * obj_target,
+        jnp.where(ignore, 0.0, sce(obj_logit, 0.0)))
+    l_obj = l_obj_map.sum(axis=(1, 2, 3))
 
-    smooth = 1.0 / class_num if use_label_smooth else 0.0
+    # reference: smooth_weight = min(1/class_num, 1/40) (yolo_loss_kernel.cc:215)
+    smooth = min(1.0 / class_num, 1.0 / 40.0) if use_label_smooth else 0.0
     cls_t = jnp.full((n, b, class_num), smooth)
     lab = jnp.clip(gt_label.astype(jnp.int32), 0, class_num - 1)
     cls_t = cls_t.at[bi, jnp.arange(b)[None, :].repeat(n, 0), lab].set(
-        1.0 - smooth if use_label_smooth else 1.0)
+        1.0 - smooth)
     cls_pred = cls_logit[bi, best_a, :, gj, gi]           # [N, B, C]
-    cls_ce = jnp.maximum(cls_pred, 0) - cls_pred * cls_t + \
-        jnp.log1p(jnp.exp(-jnp.abs(cls_pred)))
+    cls_ce = sce(cls_pred, cls_t)
     l_cls = (cls_ce.sum(-1) * score).sum(-1)
 
     loss = (l_xy + l_wh).sum(-1) + l_obj + l_cls

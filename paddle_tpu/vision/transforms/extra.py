@@ -130,8 +130,12 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
         nh = int(abs(w * math.sin(rad)) + abs(h * math.cos(rad)) + 0.5)
         pad_l = (nw - w) // 2
         pad_t = (nh - h) // 2
-        arr = np.pad(arr, ((pad_t, nh - h - pad_t), (pad_l, nw - w - pad_l),
-                           (0, 0)))
+        # expansion border must carry the requested fill (per-channel),
+        # not zeros — out-of-bounds warp taps sample this canvas
+        canvas = np.empty((nh, nw, arr.shape[2]), arr.dtype)
+        canvas[...] = np.asarray(fill, dtype=arr.dtype).reshape(1, 1, -1)
+        canvas[pad_t:pad_t + h, pad_l:pad_l + w] = arr
+        arr = canvas
         h, w = nh, nw
         center = None
     if center is None:

@@ -687,3 +687,41 @@ class TestDecodeFinishedSlot:
         sc = np.einsum("hd,shd->hs", q, k_full) / np.sqrt(d)
         want = np.einsum("hs,shd->hd", _softmax(sc), v_full).reshape(h * d)
         np.testing.assert_allclose(out.numpy()[0], want, rtol=3e-4, atol=3e-4)
+
+
+def test_mmha_requires_step_signal():
+    # without src_mask or sequence_lengths the decode position is unknown —
+    # defaulting to slot 0 silently clobbers the cache
+    with pytest.raises(ValueError, match="decode-step signal"):
+        F.masked_multihead_attention(
+            paddle.to_tensor(_r(1, 24)),
+            paddle.to_tensor(np.zeros((2, 1, 2, 4, 4), dtype="float32")))
+
+
+def test_mmha_rotary_position_from_src_mask():
+    # src_mask-only decode: RoPE must rotate with step t (mask width - 1),
+    # matching what sequence_lengths=t would produce
+    b, h, d, s_max = 1, 2, 8, 8
+    t = 3
+    np.random.seed(5)
+    cache = np.zeros((2, b, h, s_max, d), dtype="float32")
+    cache[:, :, :, :t, :] = _r(2, b, h, t, d)
+    x = _r(b, 3 * h * d)
+    rope = _rope_tables_ref(s_max, d, b)
+    src_mask = np.zeros((b, 1, 1, t + 1), dtype="float32")
+
+    out_mask, cache_mask = F.masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache),
+        src_mask=paddle.to_tensor(src_mask),
+        rotary_tensor=paddle.to_tensor(rope), rotary_emb_dims=1,
+        use_neox_rotary_style=True)
+    out_seq, cache_seq = F.masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(
+            np.full((b, 1), t, dtype="int32")),
+        rotary_tensor=paddle.to_tensor(rope), rotary_emb_dims=1,
+        use_neox_rotary_style=True)
+    np.testing.assert_allclose(out_mask.numpy(), out_seq.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cache_mask.numpy(), cache_seq.numpy(),
+                               rtol=1e-5, atol=1e-5)

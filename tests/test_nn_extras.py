@@ -412,3 +412,34 @@ class TestReviewFixes2:
             paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
             paddle.to_tensor(offs), paddle.to_tensor(cols))
         assert not np.allclose(out.numpy(), out_nomask.numpy())
+
+
+class TestCTCNormByTimes:
+    def test_value_unchanged_grad_scaled(self):
+        # warpctc norm_by_times: loss VALUE is unscaled; only the gradient
+        # is divided by each sample's input length
+        np.random.seed(1)
+        T, B, C, L = 6, 2, 5, 2
+        logits_np = _r(T, B, C)
+        labels = paddle.to_tensor(
+            np.random.randint(1, C, (B, L)).astype("int32"))
+        in_lens = paddle.to_tensor(np.array([6, 4], dtype="int64"))
+        lab_lens = paddle.to_tensor(np.array([2, 2], dtype="int64"))
+
+        a = paddle.to_tensor(logits_np, stop_gradient=False)
+        base = F.ctc_loss(a, labels, in_lens, lab_lens, reduction="none")
+        base.sum().backward()
+
+        b = paddle.to_tensor(logits_np, stop_gradient=False)
+        normed = F.ctc_loss(b, labels, in_lens, lab_lens, reduction="none",
+                            norm_by_times=True)
+        normed.sum().backward()
+
+        np.testing.assert_allclose(normed.numpy(), base.numpy(), rtol=1e-6)
+        # grad contributions are per-sample 1/T_i scaled: sample 0 by 1/6,
+        # sample 1 by 1/4 (batch axis is dim 1 of [T, B, C])
+        ga, gb = a.grad.numpy(), b.grad.numpy()
+        np.testing.assert_allclose(gb[:, 0], ga[:, 0] / 6.0, rtol=1e-5,
+                                   atol=1e-7)
+        np.testing.assert_allclose(gb[:, 1], ga[:, 1] / 4.0, rtol=1e-5,
+                                   atol=1e-7)

@@ -186,3 +186,130 @@ class TestImageIO:
         assert _np(raw).dtype == np.uint8
         img = V.decode_jpeg(raw, mode="rgb")
         assert _np(img).shape == (3, 16, 16)
+
+
+def _yolo_loss_oracle(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                      ignore_thresh, downsample_ratio, gt_score=None,
+                      use_label_smooth=True, scale_x_y=1.0):
+    """Loop-based oracle mirroring phi yolo_loss_kernel semantics: SCE on
+    raw x/y logits, L1 on raw w/h, score-weighted positive objectness."""
+    n, c, h, w = x.shape
+    an_num = len(anchor_mask)
+    input_size = downsample_ratio * h
+    x5 = x.reshape(n, an_num, 5 + class_num, h, w)
+    nb = gt_box.shape[1]
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    def sce(logit, label):
+        return max(logit, 0.0) - logit * label + np.log1p(np.exp(-abs(logit)))
+
+    def iou_xywh(b1, b2):
+        x1, y1, w1, h1 = b1
+        x2, y2, w2, h2 = b2
+        iw = min(x1 + w1 / 2, x2 + w2 / 2) - max(x1 - w1 / 2, x2 - w2 / 2)
+        ih = min(y1 + h1 / 2, y2 + h2 / 2) - max(y1 - h1 / 2, y2 - h2 / 2)
+        inter = 0.0 if iw < 0 or ih < 0 else iw * ih
+        return inter / (w1 * h1 + w2 * h2 - inter)
+
+    bias = -0.5 * (scale_x_y - 1.0)
+    smooth = min(1.0 / class_num, 1.0 / 40.0) if use_label_smooth else 0.0
+    loss = np.zeros(n)
+    for i in range(n):
+        obj = np.zeros((an_num, h, w))
+        for a in range(an_num):
+            aw = anchors[2 * anchor_mask[a]]
+            ah = anchors[2 * anchor_mask[a] + 1]
+            for gj in range(h):
+                for gi in range(w):
+                    px = (gi + sig(x5[i, a, 0, gj, gi]) * scale_x_y + bias) / w
+                    py = (gj + sig(x5[i, a, 1, gj, gi]) * scale_x_y + bias) / h
+                    pw = np.exp(x5[i, a, 2, gj, gi]) * aw / input_size
+                    ph = np.exp(x5[i, a, 3, gj, gi]) * ah / input_size
+                    best = 0.0
+                    for t in range(nb):
+                        if gt_box[i, t, 2] <= 0 or gt_box[i, t, 3] <= 0:
+                            continue
+                        best = max(best, iou_xywh(
+                            (px, py, pw, ph), tuple(gt_box[i, t])))
+                    if best > ignore_thresh:
+                        obj[a, gj, gi] = -1.0
+        for t in range(nb):
+            gx, gy, gw, gh = gt_box[i, t]
+            if gw <= 0 or gh <= 0:
+                continue
+            best_iou, best_a = 0.0, 0
+            for a in range(an_num):
+                aw = anchors[2 * anchor_mask[a]] / input_size
+                ah = anchors[2 * anchor_mask[a] + 1] / input_size
+                inter = min(gw, aw) * min(gh, ah)
+                u = gw * gh + aw * ah - inter
+                if inter / u > best_iou:
+                    best_iou, best_a = inter / u, a
+            gi, gj = int(gx * w), int(gy * h)
+            score = 1.0 if gt_score is None else float(gt_score[i, t])
+            scale = (2.0 - gw * gh) * score
+            tx, ty = gx * w - gi, gy * h - gj
+            tw = np.log(gw * input_size / anchors[2 * anchor_mask[best_a]])
+            th = np.log(gh * input_size / anchors[2 * anchor_mask[best_a] + 1])
+            loss[i] += sce(x5[i, best_a, 0, gj, gi], tx) * scale
+            loss[i] += sce(x5[i, best_a, 1, gj, gi], ty) * scale
+            loss[i] += abs(tw - x5[i, best_a, 2, gj, gi]) * scale
+            loss[i] += abs(th - x5[i, best_a, 3, gj, gi]) * scale
+            obj[best_a, gj, gi] = score
+            lab = int(gt_label[i, t])
+            for ci in range(class_num):
+                tgt = 1.0 - smooth if ci == lab else smooth
+                loss[i] += sce(x5[i, best_a, 5 + ci, gj, gi], tgt) * score
+        for a in range(an_num):
+            for gj in range(h):
+                for gi in range(w):
+                    o = obj[a, gj, gi]
+                    if o > 1e-5:
+                        loss[i] += sce(x5[i, a, 4, gj, gi], 1.0) * o
+                    elif o > -0.5:
+                        loss[i] += sce(x5[i, a, 4, gj, gi], 0.0)
+    return loss
+
+
+class TestYoloLossOracle:
+    def _case(self, gt_score=None, use_label_smooth=True, scale_x_y=1.0):
+        np.random.seed(7)
+        n, h, w, class_num = 2, 5, 5, 6
+        anchors = [10, 13, 16, 30, 33, 23]
+        anchor_mask = [0, 1, 2]
+        an_num = len(anchor_mask)
+        x = np.random.randn(n, an_num * (5 + class_num), h, w).astype(
+            "float32") * 0.5
+        gt_box = np.zeros((n, 4, 4), dtype="float32")
+        # distinct cells per gt (scatter order for colliding cells is
+        # implementation-defined; keep the oracle comparison exact)
+        centers = np.array([0.11, 0.35, 0.52, 0.77], dtype="float32")
+        gt_box[:, :, 0] = centers
+        gt_box[:, :, 1] = centers[::-1]
+        gt_box[:, :, 2:] = np.random.uniform(0.1, 0.35, (n, 4, 2))
+        gt_box[0, 3, 2:] = 0.0  # invalid gt: skipped
+        gt_label = np.random.randint(0, class_num, (n, 4)).astype("int32")
+        want = _yolo_loss_oracle(
+            x, gt_box, gt_label, anchors, anchor_mask, class_num, 0.7, 32,
+            gt_score=gt_score, use_label_smooth=use_label_smooth,
+            scale_x_y=scale_x_y)
+        gs = None if gt_score is None else paddle.to_tensor(gt_score)
+        got = V.yolo_loss(
+            paddle.to_tensor(x), paddle.to_tensor(gt_box),
+            paddle.to_tensor(gt_label), anchors, anchor_mask, class_num,
+            0.7, 32, gt_score=gs, use_label_smooth=use_label_smooth,
+            scale_x_y=scale_x_y)
+        np.testing.assert_allclose(_np(got), want, rtol=2e-4, atol=2e-4)
+
+    def test_matches_kernel_semantics(self):
+        self._case()
+
+    def test_gt_score_weights_positives(self):
+        np.random.seed(3)
+        self._case(gt_score=np.random.uniform(
+            0.2, 1.0, (2, 4)).astype("float32"))
+
+    def test_no_label_smooth_scale_xy(self):
+        self._case(use_label_smooth=False, scale_x_y=1.05)

@@ -133,3 +133,14 @@ class TestRandomTransforms:
         ])
         out = pipe(_img(16, 16))
         assert list(out.shape) == [3, 6, 6]
+
+
+def test_rotate_expand_uses_fill():
+    img = np.full((6, 6, 3), 0.5, dtype="float32")
+    out = T.rotate(img, 45, expand=True, fill=0.9)
+    # expanded corners are outside the rotated source: must sample fill
+    assert out[0, 0, 0] == pytest.approx(0.9, abs=1e-3)
+    assert out[-1, -1, 2] == pytest.approx(0.9, abs=1e-3)
+    # interior still carries image content
+    cy, cx = out.shape[0] // 2, out.shape[1] // 2
+    assert out[cy, cx, 0] == pytest.approx(0.5, abs=1e-3)
