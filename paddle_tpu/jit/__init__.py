@@ -238,7 +238,7 @@ def _aval_key(arrays):
 
 class _CompiledEntry:
     __slots__ = ("jitted", "slots", "out_template_box", "optimizers",
-                 "step_deltas")
+                 "step_deltas", "fallback", "ran_ok")
 
     def __init__(self):
         self.jitted = None
@@ -246,6 +246,8 @@ class _CompiledEntry:
         self.out_template_box = [None]
         self.optimizers = []
         self.step_deltas = []
+        self.fallback = False
+        self.ran_ok = False
 
 
 class StaticFunction:
@@ -253,18 +255,20 @@ class StaticFunction:
     ProgramCache keyed by guards; here keyed by input avals + layer modes)."""
 
     def __init__(self, fn, input_spec=None, build_strategy=None,
-                 full_graph=True, donate_state: bool = True):
+                 full_graph=False, donate_state: bool = True):
         functools.update_wrapper(self, fn)
         self._fn = fn
         self._cache: Dict[Any, _CompiledEntry] = {}
         self._donate = donate_state
         self._input_spec = input_spec
+        self._full_graph = full_graph
 
     def __get__(self, instance, owner):
         if instance is None:
             return self
         bound = StaticFunction(self._fn.__get__(instance, owner),
-                               self._input_spec, donate_state=self._donate)
+                               self._input_spec, full_graph=self._full_graph,
+                               donate_state=self._donate)
         # cache the bound wrapper on the instance
         name = self._fn.__name__
         try:
@@ -289,6 +293,8 @@ class StaticFunction:
         if entry is None:
             entry = self._compile(template, arrays, layers, optimizers, args, kwargs)
             self._cache[key] = entry
+        if entry.fallback:
+            return self._fn(*args, **kwargs)
         # runtime invocation
         state = [s.get() for s in entry.slots]
         lr_vals = jnp.asarray(
@@ -298,7 +304,34 @@ class StaticFunction:
             [o._step_count + 1 for o in entry.optimizers], jnp.float32
         ) if entry.optimizers else jnp.zeros((0,), jnp.float32)
         rng = generator.next_key("local_seed")
-        out_arrays, new_state = entry.jitted(state, arrays, rng, lr_vals, steps)
+        try:
+            out_arrays, new_state = entry.jitted(state, arrays, rng, lr_vals,
+                                                 steps)
+        except Exception as e:  # noqa: BLE001 — SOT-style graph break
+            # Reference contract (jit/sot program_translator.py:711): an
+            # untraceable construct (data-dependent Python control flow,
+            # reverse-mode through a while_loop, ...) must not crash the
+            # user's function — fall back to eager for this signature.
+            # Only TRACE-time failures fall back: if tracing succeeded and
+            # XLA execution itself failed, the input state buffers may
+            # already be donated/deleted, and the real error (OOM, nan
+            # check) must surface, not be masked by an eager rerun. Note
+            # the failed trace already ran the function's Python body, so
+            # Python-level side effects execute twice on a fallback call.
+            if self._full_graph or entry.ran_ok:
+                raise
+            if "XlaRuntimeError" in type(e).__name__:
+                raise
+            import warnings
+
+            warnings.warn(
+                f"to_static: tracing '{getattr(self._fn, '__name__', '?')}' "
+                f"failed ({type(e).__name__}: {e}); falling back to eager "
+                "execution for this input signature. Pass full_graph=True "
+                "to make this an error.")
+            entry.fallback = True
+            return self._fn(*args, **kwargs)
+        entry.ran_ok = True
         for s, v in zip(entry.slots, new_state):
             s.set(v)
         # replay python-side step-count increments observed at trace time
@@ -409,18 +442,21 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
-    """paddle.jit.to_static parity (api.py:196)."""
+              backend=None, full_graph=False, **kwargs):
+    """paddle.jit.to_static parity (api.py:196). full_graph=False (the
+    reference SOT default) falls back to eager when tracing fails;
+    full_graph=True surfaces trace errors."""
 
     def decorate(fn):
         from ..nn.layer import Layer
 
         if isinstance(fn, Layer):
             layer = fn
-            static = StaticFunction(layer.forward, input_spec)
+            static = StaticFunction(layer.forward, input_spec,
+                                    full_graph=full_graph)
             layer.forward = static
             return layer
-        return StaticFunction(fn, input_spec)
+        return StaticFunction(fn, input_spec, full_graph=full_graph)
 
     if function is not None:
         return decorate(function)

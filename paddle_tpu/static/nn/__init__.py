@@ -1,0 +1,39 @@
+"""``paddle.static.nn`` — layer functions + control flow for static graphs.
+
+Reference: python/paddle/static/nn/__init__.py (38 exports). Layer
+functions build the matching dynamic layer and apply it; control flow
+maps onto Python/`lax` control flow (see control_flow.py); sequence ops
+use packed (values, lengths) batches instead of LoD (see
+sequence_lod.py).
+"""
+from ..extras import py_func  # noqa: F401
+from .common import (  # noqa: F401
+    batch_norm, conv2d, embedding, fc, group_norm, layer_norm, prelu,
+    sparse_embedding,
+)
+from .control_flow import (  # noqa: F401
+    case, cond, static_pylayer, switch_case, while_loop,
+)
+from .extra_layers import (  # noqa: F401
+    bilinear_tensor_product, conv2d_transpose, conv3d, conv3d_transpose,
+    data_norm, deform_conv2d, instance_norm, nce, row_conv, spectral_norm,
+)
+from .sequence_lod import (  # noqa: F401
+    sequence_conv, sequence_enumerate, sequence_expand, sequence_expand_as,
+    sequence_first_step, sequence_last_step, sequence_pad, sequence_pool,
+    sequence_reshape, sequence_scatter, sequence_slice, sequence_softmax,
+    sequence_unpad,
+)
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate",
+]

@@ -9,8 +9,13 @@ from __future__ import annotations
 
 import numpy as np
 
-from .. import nn as _nn
-from ..ops._helpers import ensure_tensor
+from ... import nn as _nn
+from ...ops._helpers import ensure_tensor
+def _maybe_act(out, act):
+    if act is not None:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
 
 __all__ = ["fc", "embedding", "conv2d", "batch_norm", "layer_norm",
            "sparse_embedding", "prelu", "group_norm"]
@@ -19,17 +24,14 @@ __all__ = ["fc", "embedding", "conv2d", "batch_norm", "layer_norm",
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
     x = ensure_tensor(x)
-    from ..ops.manipulation import reshape
+    from ...ops.manipulation import reshape
 
     in_features = int(np.prod(x.shape[num_flatten_dims:]))
     if x.ndim > num_flatten_dims + 1:
         x = reshape(x, tuple(x.shape[:num_flatten_dims]) + (in_features,))
     layer = _nn.Linear(in_features, size, weight_attr=weight_attr,
                        bias_attr=bias_attr)
-    out = layer(x)
-    if activation is not None:
-        out = getattr(_nn.functional, activation)(out)
-    return out
+    return _maybe_act(layer(x), activation)
 
 
 def embedding(input, size, is_sparse=False, padding_idx=None,
@@ -37,7 +39,7 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
     layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
                           weight_attr=param_attr)
     if dtype is not None and str(np.dtype(dtype)) != "float32":
-        from ..ops.math import cast
+        from ...ops.math import cast
 
         layer.weight._replace_value(cast(layer.weight, dtype)._value)
     return layer(ensure_tensor(input))
@@ -57,10 +59,7 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                        padding=padding, dilation=dilation, groups=groups,
                        weight_attr=param_attr, bias_attr=bias_attr,
                        data_format=data_format)
-    out = layer(x)
-    if act is not None:
-        out = getattr(_nn.functional, act)(out)
-    return out
+    return _maybe_act(layer(x), act)
 
 
 def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
@@ -72,10 +71,7 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
                             data_format=data_layout)
     if is_test:
         layer.eval()
-    out = layer(x)
-    if act is not None:
-        out = getattr(_nn.functional, act)(out)
-    return out
+    return _maybe_act(layer(x), act)
 
 
 def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
@@ -88,10 +84,7 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
         weight_attr=param_attr if scale else False,
         bias_attr=bias_attr if shift else False,
     )
-    out = layer(x)
-    if act is not None:
-        out = getattr(_nn.functional, act)(out)
-    return out
+    return _maybe_act(layer(x), act)
 
 
 def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
@@ -101,10 +94,7 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
     layer = _nn.GroupNorm(groups, channels, epsilon=epsilon,
                           weight_attr=param_attr, bias_attr=bias_attr,
                           data_format=data_layout)
-    out = layer(x)
-    if act is not None:
-        out = getattr(_nn.functional, act)(out)
-    return out
+    return _maybe_act(layer(x), act)
 
 
 class _ElementwisePReLU(_nn.Layer):
@@ -118,7 +108,7 @@ class _ElementwisePReLU(_nn.Layer):
         )
 
     def forward(self, x):
-        from ..ops.math import maximum, minimum
+        from ...ops.math import maximum, minimum
 
         return maximum(x, 0.0) + self.weight * minimum(x, 0.0)
 

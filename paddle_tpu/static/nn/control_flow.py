@@ -1,0 +1,268 @@
+"""Static control-flow ops: cond / while_loop / case / switch_case.
+
+Reference: python/paddle/static/nn/control_flow.py (cond at :1485,
+while_loop at :682, case at :937, switch_case at :1060) and
+static_pylayer.py. The reference builds sub-block programs executed by
+the interpreter; the TPU-first mapping is:
+
+- **Eager** (predicate concrete): plain Python control flow runs exactly
+  one branch — the reference dygraph behavior — and the executed branch
+  records onto the autograd tape, so gradients work naturally (including
+  through a Python ``while``, which unrolls on the tape).
+- **Traced** (predicate is a jax tracer, i.e. inside ``jit.to_static``):
+  ``cond``/``case``/``switch_case`` trace *both* branches and combine
+  outputs with a select — speculative execution of short branches is the
+  idiomatic XLA/TPU lowering for data-dependent branching (keeps shapes
+  static, stays differentiable, lets the compiler fuse both sides).
+  ``while_loop`` lowers to ``lax.while_loop`` (forward-only under trace:
+  reverse-mode through an unbounded loop is not defined; train loops
+  needing gradients through a while fall back to eager via
+  ``to_static``'s fallback path).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ...ops._helpers import ensure_tensor
+from ...core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "static_pylayer"]
+
+
+def _is_traced(value) -> bool:
+    return isinstance(value, jax.core.Tracer)
+
+
+def _select_nest(pred, t_out, f_out):
+    """Leaf-wise select between two same-structure branch outputs."""
+    from ...ops.manipulation import where
+
+    t_leaves, t_tree = jax.tree_util.tree_flatten(
+        t_out, is_leaf=lambda x: isinstance(x, Tensor))
+    f_leaves, f_tree = jax.tree_util.tree_flatten(
+        f_out, is_leaf=lambda x: isinstance(x, Tensor))
+    if t_tree != f_tree or len(t_leaves) != len(f_leaves):
+        raise ValueError(
+            "true_fn and false_fn must return the same structure of "
+            f"outputs, got {t_tree} vs {f_tree}")
+    merged = []
+    for a, b in zip(t_leaves, f_leaves):
+        if isinstance(a, Tensor) or isinstance(b, Tensor):
+            a, b = ensure_tensor(a), ensure_tensor(b)
+            if a.shape != b.shape:
+                raise ValueError(
+                    "branch outputs must have matching shapes under a "
+                    f"traced predicate, got {a.shape} vs {b.shape}")
+            merged.append(where(pred, a, b))
+        else:
+            if a != b:
+                raise ValueError(
+                    "non-Tensor branch outputs must be equal under a "
+                    f"traced predicate, got {a!r} vs {b!r}")
+            merged.append(a)
+    return jax.tree_util.tree_unflatten(t_tree, merged)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Run ``true_fn()`` if ``pred`` else ``false_fn()``.
+
+    Reference: static/nn/control_flow.py:1485. Both callables take no
+    arguments (capture by closure) and must return the same structure.
+    """
+    if not callable(true_fn):
+        raise TypeError("The true_fn in cond must be callable.")
+    if not callable(false_fn):
+        raise TypeError("The false_fn in cond must be callable.")
+    pred = ensure_tensor(pred)
+    if not _is_traced(pred._value):
+        return true_fn() if bool(pred._value) else false_fn()
+    return _select_nest(pred, true_fn(), false_fn())
+
+
+def _normalize_vars(out, n_expected, what):
+    if isinstance(out, (list, tuple)):
+        out = list(out)
+    else:
+        out = [out]
+    if len(out) != n_expected:
+        raise ValueError(
+            f"{what} must return the same number of loop_vars "
+            f"({n_expected}), got {len(out)}")
+    return out
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Repeat ``body`` while ``cond`` holds.
+
+    Reference: static/nn/control_flow.py:682. ``cond(*loop_vars)`` returns
+    a scalar bool Tensor; ``body(*loop_vars)`` returns updated loop_vars
+    (same structure, shapes and dtypes). Returns the final loop_vars as a
+    list.
+    """
+    if not callable(cond):
+        raise TypeError("The cond in while_loop must be callable.")
+    if not callable(body):
+        raise TypeError("The body in while_loop must be callable.")
+    if not isinstance(loop_vars, (list, tuple)) or len(loop_vars) == 0:
+        raise ValueError("loop_vars must be a non-empty list/tuple.")
+    loop_vars = list(loop_vars)
+    n = len(loop_vars)
+
+    first = ensure_tensor(cond(*loop_vars))
+    if not _is_traced(first._value):
+        # eager: Python loop; every executed op lands on the autograd
+        # tape, so this path is differentiable
+        keep_going = bool(first._value)
+        while keep_going:
+            loop_vars = _normalize_vars(body(*loop_vars), n, "body")
+            keep_going = bool(ensure_tensor(cond(*loop_vars))._value)
+        return loop_vars
+
+    # traced: lower to lax.while_loop on the raw values. User callables
+    # see Tensor-wrapped tracers; recording is paused so inner-scope
+    # tracers never leak onto the tape (forward-only under trace).
+    from ...autograd import engine as _engine
+
+    flat0 = []
+    treedefs = []
+    for v in loop_vars:
+        leaves, tree = jax.tree_util.tree_flatten(
+            v, is_leaf=lambda x: isinstance(x, Tensor))
+        flat0.append([ensure_tensor(l)._value for l in leaves])
+        treedefs.append(tree)
+
+    def wrap(flat):
+        vars_ = []
+        for leaves, tree in zip(flat, treedefs):
+            vars_.append(jax.tree_util.tree_unflatten(
+                tree, [Tensor._from_value(l) for l in leaves]))
+        return vars_
+
+    def unwrap(vars_):
+        flat = []
+        for v, tree in zip(vars_, treedefs):
+            leaves, t2 = jax.tree_util.tree_flatten(
+                v, is_leaf=lambda x: isinstance(x, Tensor))
+            if t2 != tree:
+                raise ValueError(
+                    "body must preserve the structure of loop_vars")
+            flat.append([ensure_tensor(l)._value for l in leaves])
+        return flat
+
+    def cond_raw(flat):
+        with _engine.no_grad():
+            r = ensure_tensor(cond(*wrap(flat)))
+        return r._value.reshape(())
+
+    def body_raw(flat):
+        with _engine.no_grad():
+            out = _normalize_vars(body(*wrap(flat)), n, "body")
+        return unwrap(out)
+
+    final = jax.lax.while_loop(cond_raw, body_raw, flat0)
+    return wrap(final)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """if/elif/.../else chain: run the fn of the first true pred.
+
+    Reference: static/nn/control_flow.py:937. With ``default=None`` the
+    last pair's fn serves as the default.
+    """
+    if not isinstance(pred_fn_pairs, (list, tuple)):
+        raise TypeError("pred_fn_pairs must be a list or tuple.")
+    for pair in pred_fn_pairs:
+        if not isinstance(pair, tuple) or len(pair) != 2:
+            raise TypeError(
+                "Each element of pred_fn_pairs must be a (pred, fn) tuple.")
+        if not callable(pair[1]):
+            raise TypeError("The fn of each pred_fn_pair must be callable.")
+    if default is None:
+        default = pred_fn_pairs[-1][1]
+        pred_fn_pairs = pred_fn_pairs[:-1]
+    elif not callable(default):
+        raise TypeError("The default in case must be callable.")
+
+    false_fn = default
+    for pred, true_fn in reversed(list(pred_fn_pairs)):
+        false_fn = functools.partial(
+            cond, pred, true_fn=true_fn, false_fn=false_fn)
+    return false_fn()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """C-style switch on an integer scalar Tensor.
+
+    Reference: static/nn/control_flow.py:1060. ``branch_fns`` is a dict
+    {int: fn}, a list of (int, fn) pairs, or a list of fns (indexed by
+    position). With ``default=None`` the fn with the max index is the
+    default.
+    """
+    from ...ops.comparison import equal
+
+    branch_index = ensure_tensor(branch_index)
+    if isinstance(branch_fns, dict):
+        pairs = list(branch_fns.items())
+    elif isinstance(branch_fns, (list, tuple)):
+        if branch_fns and not isinstance(branch_fns[0], tuple):
+            pairs = list(enumerate(branch_fns))
+        else:
+            pairs = list(branch_fns)
+    else:
+        raise TypeError("branch_fns must be a dict, list or tuple.")
+    keys = []
+    for key, fn in pairs:
+        if not isinstance(key, int):
+            raise TypeError("The key of branch_fns must be an integer.")
+        if key in keys:
+            raise ValueError(
+                f"The key in branch_fns must be unique, but '{key}' "
+                "appears more than once.")
+        keys.append(key)
+        if not callable(fn):
+            raise TypeError(f"The fn for key {key} must be callable.")
+    if default is None:
+        pairs = sorted(pairs)
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+    elif not callable(default):
+        raise TypeError("The default in switch_case must be callable.")
+
+    false_fn = default
+    for key, fn in pairs:
+        pred = equal(branch_index,
+                     ensure_tensor(key, dtype=branch_index.dtype))
+        false_fn = functools.partial(cond, pred, true_fn=fn,
+                                     false_fn=false_fn)
+    return false_fn()
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """Run ``forward_fn(*inputs)`` with a custom backward.
+
+    Reference: static/nn/static_pylayer.py. Delegates to the eager
+    PyLayer mechanism (the single execution path of this framework):
+    ``backward_fn`` receives output grads and returns input grads.
+    """
+    from ...autograd.py_layer import PyLayer
+
+    if not callable(forward_fn):
+        raise TypeError("forward_fn must be callable.")
+    if backward_fn is None:
+        from ...autograd import engine as _engine
+
+        with _engine.no_grad():
+            return forward_fn(*inputs)
+
+    class _StaticPyLayer(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            return forward_fn(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            return backward_fn(*grads)
+
+    return _StaticPyLayer.apply(*inputs)

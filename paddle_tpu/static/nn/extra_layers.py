@@ -1,0 +1,246 @@
+"""Remaining static.nn layer functions.
+
+Reference: python/paddle/static/nn/common.py (conv2d_transpose :~,
+conv3d, data_norm, deform_conv2d, instance_norm, bilinear_tensor_product,
+row_conv, spectral_norm) and loss.py (nce). Each builds the matching
+dynamic layer (or op) and applies it — the static-capture machinery
+records the ops like any other call.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn as _nn
+from ...framework.misc import create_parameter
+from ...ops._helpers import defprim as _defprim, ensure_tensor
+from .common import _maybe_act
+
+__all__ = [
+    "conv2d_transpose", "conv3d", "conv3d_transpose", "instance_norm",
+    "data_norm", "deform_conv2d", "bilinear_tensor_product", "row_conv",
+    "spectral_norm", "nce",
+]
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    x = ensure_tensor(input)
+    in_channels = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    if filter_size is None:
+        raise ValueError("filter_size is required in the TPU build "
+                         "(no output_size-driven inference)")
+    layer = _nn.Conv2DTranspose(
+        in_channels, num_filters, filter_size, stride=stride,
+        padding=padding, dilation=dilation, groups=groups,
+        weight_attr=param_attr, bias_attr=bias_attr, data_format=data_format)
+    out = layer(x, output_size=output_size)
+    return _maybe_act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    x = ensure_tensor(input)
+    in_channels = x.shape[1] if data_format == "NCDHW" else x.shape[-1]
+    layer = _nn.Conv3D(in_channels, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    return _maybe_act(layer(x), act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    x = ensure_tensor(input)
+    in_channels = x.shape[1] if data_format == "NCDHW" else x.shape[-1]
+    if filter_size is None:
+        raise ValueError("filter_size is required in the TPU build")
+    layer = _nn.Conv3DTranspose(
+        in_channels, num_filters, filter_size, stride=stride,
+        padding=padding, dilation=dilation, groups=groups,
+        weight_attr=param_attr, bias_attr=bias_attr, data_format=data_format)
+    out = layer(x, output_size=output_size)
+    return _maybe_act(out, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    x = ensure_tensor(input)
+    cls = {3: _nn.InstanceNorm1D, 4: _nn.InstanceNorm2D,
+           5: _nn.InstanceNorm3D}.get(len(x.shape))
+    if cls is None:
+        raise ValueError(f"instance_norm expects 3-5D input, got {x.shape}")
+    layer = cls(x.shape[1], epsilon=epsilon, weight_attr=param_attr,
+                bias_attr=bias_attr)
+    return layer(x)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Normalization by accumulated batch statistics (reference data_norm:
+    x_norm = (x - mean) / sqrt(scale), stats kept as size/sum/square-sum
+    accumulators updated outside the gradient)."""
+    from ...ops import math as m
+
+    x = ensure_tensor(input)
+    d = x.shape[-1] if data_layout == "NHWC" or len(x.shape) == 2 \
+        else x.shape[1]
+    dt = "float32"
+    # stat accumulators are NOT trainable and never take the user's
+    # param_attr (whose initializer would corrupt them): they are updated
+    # in place from each batch, outside the gradient — the reference
+    # kernel's size/sum/square-sum summary update
+    batch_size = create_parameter(
+        [d], dt, default_initializer=_nn.initializer.Constant(1e4))
+    batch_sum = create_parameter(
+        [d], dt, default_initializer=_nn.initializer.Constant(0.0))
+    batch_square_sum = create_parameter(
+        [d], dt, default_initializer=_nn.initializer.Constant(1e4))
+    for stat in (batch_size, batch_sum, batch_square_sum):
+        stat.stop_gradient = True
+    mean = m.divide(batch_sum, batch_size)
+    scale = m.rsqrt(m.add(m.divide(batch_square_sum, batch_size),
+                          ensure_tensor(float(epsilon))))
+    out = m.multiply(m.subtract(x, mean), scale)
+    # accumulate this batch's summary (detached; reduce over all axes but
+    # the feature axis)
+    import jax.numpy as jnp
+
+    xv = x._value
+    red = tuple(i for i in range(xv.ndim)
+                if not ((data_layout == "NHWC" or xv.ndim == 2)
+                        and i == xv.ndim - 1)
+                and not (data_layout == "NCHW" and xv.ndim > 2 and i == 1))
+    count = 1
+    for i in red:
+        count *= xv.shape[i]
+    batch_size._replace_value(batch_size._value + count)
+    batch_sum._replace_value(batch_sum._value + jnp.sum(xv, axis=red))
+    batch_square_sum._replace_value(
+        batch_square_sum._value + jnp.sum(xv * xv, axis=red))
+    if enable_scale_and_shift:
+        w = create_parameter(
+            [d], dt, attr=param_attr,
+            default_initializer=_nn.initializer.Constant(1.0))
+        b = create_parameter(
+            [d], dt, attr=param_attr,
+            default_initializer=_nn.initializer.Constant(0.0))
+        out = m.add(m.multiply(out, w), b)
+    return _maybe_act(out, act)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  modulated=True, name=None):
+    x = ensure_tensor(input)
+    layer = _nn.Layer()
+    k = filter_size if isinstance(filter_size, (list, tuple)) else (
+        filter_size, filter_size)
+    w = layer.create_parameter(
+        [num_filters, x.shape[1] // groups, k[0], k[1]], attr=param_attr)
+    b = None
+    if bias_attr is not False:
+        b = layer.create_parameter([num_filters], attr=bias_attr,
+                                   is_bias=True)
+    from ...vision.ops import deform_conv2d as _dcn
+
+    return _dcn(x, ensure_tensor(offset), w, bias=b, stride=stride,
+                padding=padding, dilation=dilation,
+                deformable_groups=deformable_groups, groups=groups,
+                mask=None if (mask is None or not modulated)
+                else ensure_tensor(mask))
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out[b, k] = x[b] . W[k] . y[b] + bias (reference
+    bilinear_tensor_product)."""
+    from ...ops import math as m
+
+    xv, yv = ensure_tensor(x), ensure_tensor(y)
+    dx, dy = xv.shape[-1], yv.shape[-1]
+    w = create_parameter([size, dx, dy], "float32", attr=param_attr)
+    from ...ops.linalg import einsum
+
+    out = einsum("bi,kij,bj->bk", xv, w, yv)
+    if bias_attr is not False:
+        b = create_parameter([size], "float32", attr=bias_attr, is_bias=True)
+        out = m.add(out, b)
+    return _maybe_act(out, act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead convolution: out[t] = sum_k x[t+k] * w[k] (reference
+    row_conv over [B, T, D])."""
+    x = ensure_tensor(input)
+    d = x.shape[-1]
+    w = create_parameter([future_context_size + 1, d], "float32",
+                         attr=param_attr)
+    from ...core.tensor import apply
+
+    out = apply("row_conv_p", x, w)
+    return _maybe_act(out, act)
+
+
+def _row_conv_fwd(xv, wv):
+    import jax.numpy as jnp
+
+    t = xv.shape[1]
+    out = jnp.zeros_like(xv)
+    for k in range(wv.shape[0]):
+        out = out.at[:, : t - k, :].add(xv[:, k:, :] * wv[k])
+    return out
+
+
+_defprim("row_conv_p", _row_conv_fwd)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Weight normalized by its largest singular value, estimated with
+    power iteration (reference static/nn/common.py spectral_norm)."""
+    w = ensure_tensor(weight)
+    layer = _nn.SpectralNorm(list(w.shape), dim=dim, power_iters=power_iters,
+                             epsilon=eps)
+    return layer(w)
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference static/nn/loss.py nce):
+    logistic discrimination of the true class against sampled noise."""
+    from ... import randint
+    from ...ops import math as m
+    from ...ops.manipulation import concat, gather, reshape
+
+    x = ensure_tensor(input)
+    lab = ensure_tensor(label)
+    d = x.shape[-1]
+    b = x.shape[0]
+    k = int(num_neg_samples or 10)
+    w = create_parameter([num_total_classes, d], "float32", attr=param_attr)
+    bias = create_parameter([num_total_classes], "float32", attr=bias_attr,
+                            is_bias=True)
+    neg = randint(0, num_total_classes, [b, k])
+    ids = concat([reshape(lab, [b, 1]), neg], axis=1)        # [B, 1+K]
+    wsel = gather(w, reshape(ids, [-1]))                      # [B*(1+K), D]
+    wsel = reshape(wsel, [b, 1 + k, d])
+    bsel = reshape(gather(bias, reshape(ids, [-1])), [b, 1 + k])
+    from ...ops.linalg import einsum
+
+    logits = m.add(einsum("bd,bkd->bk", x, wsel), bsel)       # [B, 1+K]
+    # positive gets label 1, sampled noise 0 — per-example logistic loss
+    pos = logits[:, :1]
+    negs = logits[:, 1:]
+    lp = _nn.functional.log_sigmoid(pos)
+    ln = _nn.functional.log_sigmoid(m.scale(negs, -1.0))
+    return m.scale(m.add(m.sum(lp, axis=1), m.sum(ln, axis=1)), -1.0)

@@ -1,0 +1,352 @@
+"""Sequence ops over packed (values, lengths) batches.
+
+Reference: python/paddle/static/nn/sequence_lod.py — those ops consume
+LoD tensors (ragged batches encoded by offset tables). The TPU-first
+redesign replaces LoD with an explicit dense representation: a batch of
+sequences is a packed tensor ``x`` of shape [T, ...] (all rows
+concatenated) plus an integer ``length`` vector [B] giving each
+sequence's row count. Every op here takes ``length`` explicitly where
+the reference would read LoD metadata; the math is expressed with
+segment reductions and masked gathers so it stays static-shaped and
+XLA-compilable wherever the output shape permits.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops._helpers import defprim, ensure_tensor
+from ...core.tensor import Tensor
+
+__all__ = [
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_expand",
+    "sequence_expand_as", "sequence_enumerate", "sequence_scatter",
+    "sequence_slice",
+]
+
+
+def _seg_ids(length, total):
+    """Row -> sequence index map: [T] int32 from lengths [B]."""
+    ends = jnp.cumsum(length.astype(jnp.int32))
+    return jnp.searchsorted(ends, jnp.arange(total, dtype=jnp.int32),
+                            side="right").astype(jnp.int32)
+
+
+def _starts(length):
+    l = length.astype(jnp.int32)
+    return jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(l)[:-1]])
+
+
+def _valid_rows(length, total):
+    return jnp.arange(total) < jnp.sum(length.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+def _sequence_softmax_fwd(x, length):
+    t = x.shape[0]
+    seg = _seg_ids(length, t)
+    b = length.shape[0]
+    # segment max for stability, then segment-normalized exp
+    mx = jax.ops.segment_max(x, seg, num_segments=b)
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+    e = jnp.exp(x - mx[seg])
+    valid = _valid_rows(length, t)
+    e = jnp.where(valid[(...,) + (None,) * (x.ndim - 1)], e, 0.0)
+    s = jax.ops.segment_sum(e, seg, num_segments=b)
+    return e / jnp.maximum(s[seg], 1e-30)
+
+
+defprim("sequence_softmax_p", _sequence_softmax_fwd)
+
+
+def sequence_softmax(input, length=None, use_cudnn=False, name=None):
+    """Softmax within each sequence of a packed batch.
+
+    Reference: static/nn/sequence_lod.py sequence_softmax (LoD level 0).
+    ``length`` [B] is required (replaces LoD metadata).
+    """
+    x, l = _xl(input, length, "sequence_softmax")
+    from ...core.tensor import apply
+
+    return apply("sequence_softmax_p", x, l)
+
+
+def _xl(input, length, opname):
+    if length is None:
+        raise ValueError(
+            f"{opname} needs the per-sequence `length` vector: the TPU "
+            "build uses packed (values, lengths) batches instead of LoD")
+    return ensure_tensor(input), ensure_tensor(length)
+
+
+# ---------------------------------------------------------------------------
+def _sequence_pool_fwd(x, length, *, pool_type, pad_value):
+    t = x.shape[0]
+    b = length.shape[0]
+    seg = _seg_ids(length, t)
+    valid = _valid_rows(length, t)
+    vmask = valid[(...,) + (None,) * (x.ndim - 1)]
+    l = jnp.maximum(length.astype(x.dtype), 1)
+    lshape = (b,) + (1,) * (x.ndim - 1)
+    # empty sequences emit pad_value (reference sequence_pool semantics)
+    empty = (length.astype(jnp.int32) == 0).reshape(lshape)
+    pad = jnp.asarray(pad_value, x.dtype)
+    if pool_type in ("sum", "average", "sqrt"):
+        s = jax.ops.segment_sum(jnp.where(vmask, x, 0), seg, num_segments=b)
+        if pool_type == "average":
+            s = s / l.reshape(lshape)
+        elif pool_type == "sqrt":
+            s = s / jnp.sqrt(l).reshape(lshape)
+        return jnp.where(empty, pad, s)
+    if pool_type == "max":
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        m = jax.ops.segment_max(jnp.where(vmask, x, neg), seg,
+                                num_segments=b)
+        return jnp.where(empty, pad, jnp.where(jnp.isfinite(m), m, 0))
+    if pool_type == "first":
+        return jnp.where(empty, pad, x[_starts(length)])
+    if pool_type == "last":
+        idx = _starts(length) + jnp.maximum(
+            length.astype(jnp.int32) - 1, 0)
+        return jnp.where(empty, pad, x[idx])
+    raise ValueError(f"unsupported pool_type: {pool_type}")
+
+
+defprim("sequence_pool_p", _sequence_pool_fwd)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  length=None, name=None):
+    """Per-sequence reduction: sum/average/sqrt/max/first/last.
+
+    Reference: static/nn/sequence_lod.py sequence_pool."""
+    x, l = _xl(input, length, "sequence_pool")
+    from ...core.tensor import apply
+
+    return apply("sequence_pool_p", x, l, pool_type=str(pool_type).lower(),
+                 pad_value=float(pad_value))
+
+
+def sequence_first_step(input, length=None, name=None):
+    """First row of each sequence (reference sequence_first_step)."""
+    return sequence_pool(input, "first", length=length)
+
+
+def sequence_last_step(input, length=None, name=None):
+    """Last row of each sequence (reference sequence_last_step)."""
+    return sequence_pool(input, "last", length=length)
+
+
+# ---------------------------------------------------------------------------
+def _sequence_pad_fwd(x, pad_value, length, *, maxlen):
+    b = length.shape[0]
+    starts = _starts(length)
+    idx = starts[:, None] + jnp.arange(maxlen)[None, :]          # [B, L]
+    in_range = jnp.arange(maxlen)[None, :] < length[:, None]
+    gathered = x[jnp.clip(idx, 0, x.shape[0] - 1)]               # [B, L, ...]
+    pad = jnp.broadcast_to(
+        pad_value.astype(x.dtype).reshape((1, 1) + pad_value.shape),
+        gathered.shape) if pad_value.ndim else pad_value.astype(x.dtype)
+    mask = in_range[(...,) + (None,) * (x.ndim - 1)]
+    return jnp.where(mask, gathered, pad), length.astype(jnp.int64)
+
+
+defprim("sequence_pad_p", _sequence_pad_fwd, multi_out=True)
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Pack [T, ...] + lengths -> padded [B, maxlen, ...] and lengths.
+
+    Reference: static/nn/sequence_lod.py sequence_pad (returns the padded
+    tensor and the original lengths)."""
+    xv, l = _xl(x, length, "sequence_pad")
+    pv = ensure_tensor(pad_value)
+    if maxlen is None:
+        maxlen = int(np.asarray(jnp.max(l._value)))
+    from ...core.tensor import apply
+
+    return apply("sequence_pad_p", xv, pv, l, maxlen=int(maxlen))
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded [B, L, ...] + lengths -> packed [T, ...].
+
+    Reference: static/nn/sequence_lod.py sequence_unpad. The output row
+    count is data-dependent, so this op requires concrete lengths
+    (eager; under to_static the eager-fallback path handles it)."""
+    xv = ensure_tensor(x)
+    l = ensure_tensor(length)
+    lens = np.asarray(l._value).astype(np.int64).reshape(-1)
+    from ...ops.manipulation import concat
+
+    rows = [xv[int(i), : int(n)] for i, n in enumerate(lens)]
+    return concat(rows, axis=0)
+
+
+def sequence_reshape(input, new_dim, name=None):
+    """Re-chunk packed rows to width new_dim (reference sequence_reshape)."""
+    x = ensure_tensor(input)
+    from ...ops.manipulation import reshape
+
+    return reshape(x, [-1, int(new_dim)])
+
+
+# ---------------------------------------------------------------------------
+def sequence_expand(x, y, ref_level=-1, length=None, y_length=None,
+                    name=None):
+    """Repeat each sequence of x per the matching sequence count in y.
+
+    Reference: static/nn/sequence_lod.py sequence_expand. Dense form:
+    sequence i of x (lengths ``length``) is tiled ``y_length[i]`` times.
+    Output row count is data-dependent -> concrete lengths required."""
+    xv, l = _xl(x, length, "sequence_expand")
+    if y_length is None:
+        raise ValueError("sequence_expand needs y_length (expand counts)")
+    counts = np.asarray(ensure_tensor(y_length)._value).astype(
+        np.int64).reshape(-1)
+    lens = np.asarray(l._value).astype(np.int64).reshape(-1)
+    starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+    from ...ops.manipulation import concat
+
+    chunks = []
+    for i, c in enumerate(counts):
+        seq = xv[int(starts[i]): int(starts[i] + lens[i])]
+        chunks.extend([seq] * int(max(c, 0)))
+    return concat(chunks, axis=0)
+
+
+def sequence_expand_as(x, y, length=None, y_length=None, name=None):
+    """Tile row i of x to the length of sequence i in y.
+
+    Reference: static/nn/sequence_lod.py sequence_expand_as."""
+    xv = ensure_tensor(x)
+    if y_length is None:
+        raise ValueError("sequence_expand_as needs y_length")
+    counts = np.asarray(ensure_tensor(y_length)._value).astype(
+        np.int64).reshape(-1)
+    from ...ops.manipulation import concat
+
+    chunks = [xv[i: i + 1].tile([int(c)] + [1] * (len(xv.shape) - 1))
+              for i, c in enumerate(counts)]
+    return concat(chunks, axis=0)
+
+
+# ---------------------------------------------------------------------------
+def _sequence_enumerate_fwd(x, length, *, win_size, pad_value):
+    t = x.shape[0]
+    seg = _seg_ids(length, t)
+    idx = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]  # [T, W]
+    safe = jnp.clip(idx, 0, t - 1)
+    same_seq = (seg[safe] == seg[:, None]) & (idx < t)
+    vals = x[safe]
+    return jnp.where(same_seq, vals, jnp.asarray(pad_value, x.dtype))
+
+
+defprim("sequence_enumerate_p", _sequence_enumerate_fwd)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, length=None, name=None):
+    """Sliding windows that do not cross sequence boundaries.
+
+    Reference: static/nn/sequence_lod.py sequence_enumerate."""
+    x, l = _xl(input, length, "sequence_enumerate")
+    from ...core.tensor import apply
+
+    return apply("sequence_enumerate_p", x, l, win_size=int(win_size),
+                 pad_value=int(pad_value))
+
+
+# ---------------------------------------------------------------------------
+def _sequence_scatter_fwd(x, index, updates, length):
+    # x: [B, D]; index/updates packed rows, sequence i of the packed pair
+    # scatters into row i of x (reference sequence_scatter LoD semantics)
+    t = index.shape[0]
+    seg = _seg_ids(length, t)
+    valid = _valid_rows(length, t)
+    upd = jnp.where(valid[(...,) + (None,) * (updates.ndim - 1)], updates, 0)
+    return x.at[seg, index.astype(jnp.int32)].add(upd)
+
+
+defprim("sequence_scatter_p", _sequence_scatter_fwd)
+
+
+def sequence_scatter(input, index, updates, length=None, name=None):
+    """Scatter-add packed per-sequence updates into rows of input.
+
+    Reference: static/nn/sequence_lod.py sequence_scatter."""
+    x = ensure_tensor(input)
+    idx, l = _xl(index, length, "sequence_scatter")
+    from ...core.tensor import apply
+
+    return apply("sequence_scatter_p", x, idx, ensure_tensor(updates), l)
+
+
+def sequence_slice(input, offset, length, seq_length=None, name=None):
+    """Per-sequence slice [offset : offset+length] of a packed batch.
+
+    Reference: static/nn/sequence_lod.py sequence_slice. Output row count
+    is data-dependent -> concrete values required."""
+    xv, sl = _xl(input, seq_length, "sequence_slice")
+    offs = np.asarray(ensure_tensor(offset)._value).astype(np.int64).reshape(-1)
+    lens = np.asarray(ensure_tensor(length)._value).astype(np.int64).reshape(-1)
+    seq = np.asarray(sl._value).astype(np.int64).reshape(-1)
+    starts = np.concatenate([[0], np.cumsum(seq)[:-1]])
+    from ...ops.manipulation import concat
+
+    return concat([xv[int(s + o): int(s + o + n)]
+                   for s, o, n in zip(starts, offs, lens)], axis=0)
+
+
+# ---------------------------------------------------------------------------
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, length=None, name=None):
+    """Context-window convolution within sequence boundaries.
+
+    Reference: static/nn/sequence_lod.py sequence_conv (context windows
+    gathered per row, zero beyond the sequence edge, then projected)."""
+    x, l = _xl(input, length, "sequence_conv")
+    d = x.shape[-1]
+    from ...framework.misc import create_parameter
+
+    w = create_parameter([int(filter_size) * d, int(num_filters)],
+                         dtype=str(x.dtype), attr=param_attr)
+    bias = None
+    if bias_attr is not False:
+        bias = create_parameter([int(num_filters)], dtype=str(x.dtype),
+                                attr=bias_attr, is_bias=True)
+    from ...core.tensor import apply
+
+    if padding_start is None:
+        padding_start = -(int(filter_size) // 2)
+    ctx = apply("sequence_conv_ctx_p", x, l,
+                filter_size=int(filter_size),
+                padding_start=int(padding_start))
+    from ...ops.math import matmul, add
+
+    out = matmul(ctx, w)
+    if bias is not None:
+        out = add(out, bias)
+    if act is not None:
+        from ... import nn
+
+        out = getattr(nn.functional, act)(out)
+    return out
+
+
+def _sequence_conv_ctx_fwd(x, length, *, filter_size, padding_start):
+    t, d = x.shape
+    seg = _seg_ids(length, t)
+    offs = jnp.arange(filter_size) + padding_start                # [W]
+    idx = jnp.arange(t)[:, None] + offs[None, :]                  # [T, W]
+    safe = jnp.clip(idx, 0, t - 1)
+    ok = (idx >= 0) & (idx < t) & (seg[safe] == seg[:, None])
+    vals = jnp.where(ok[..., None], x[safe], 0)                   # [T, W, D]
+    return vals.reshape(t, filter_size * d)
+
+
+defprim("sequence_conv_ctx_p", _sequence_conv_ctx_fwd)

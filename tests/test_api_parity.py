@@ -15,7 +15,8 @@ REF_ROOT = "/root/reference/python/paddle/"
 
 NAMESPACES = [
     "__init__.py", "nn/__init__.py", "nn/functional/__init__.py",
-    "static/__init__.py", "optimizer/__init__.py", "io/__init__.py",
+    "static/__init__.py", "static/nn/__init__.py",
+    "optimizer/__init__.py", "io/__init__.py",
     "autograd/__init__.py", "jit/__init__.py", "linalg.py",
     "distributed/__init__.py", "vision/__init__.py", "vision/ops.py",
     "vision/transforms/__init__.py", "vision/models/__init__.py",

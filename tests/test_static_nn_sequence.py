@@ -1,0 +1,228 @@
+"""static.nn sequence ops (packed values + lengths design) and the extra
+static.nn layer functions.
+
+Reference test models: test/sequence/test_sequence_softmax_op.py,
+test_sequence_pool.py, test_sequence_pad_op.py, test_sequence_expand.py,
+test_sequence_enumerate_op.py, test_sequence_slice_op.py; plus
+test/legacy_test/test_bilinear_tensor_product_op.py, test_row_conv_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static.nn as snn
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+LENS = np.array([3, 2, 4], dtype="int64")
+T = int(LENS.sum())
+
+
+def _packed(d=5, seed=0):
+    return np.random.RandomState(seed).rand(T, d).astype("float32")
+
+
+def _split(x):
+    out, s = [], 0
+    for n in LENS:
+        out.append(x[s: s + n])
+        s += n
+    return out
+
+
+class TestSequenceOps:
+    def test_softmax(self):
+        x = _packed(1)[:, 0]
+        got = snn.sequence_softmax(_t(x), length=_t(LENS)).numpy()
+        for seg, g in zip(_split(x), _split(got)):
+            e = np.exp(seg - seg.max())
+            np.testing.assert_allclose(g, e / e.sum(), rtol=1e-5)
+
+    @pytest.mark.parametrize("ptype,ref", [
+        ("sum", lambda s: s.sum(0)),
+        ("average", lambda s: s.mean(0)),
+        ("sqrt", lambda s: s.sum(0) / np.sqrt(len(s))),
+        ("max", lambda s: s.max(0)),
+        ("first", lambda s: s[0]),
+        ("last", lambda s: s[-1]),
+    ])
+    def test_pool(self, ptype, ref):
+        x = _packed()
+        got = snn.sequence_pool(_t(x), ptype, length=_t(LENS)).numpy()
+        want = np.stack([ref(s) for s in _split(x)])
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_first_last_step(self):
+        x = _packed()
+        np.testing.assert_allclose(
+            snn.sequence_first_step(_t(x), length=_t(LENS)).numpy(),
+            np.stack([s[0] for s in _split(x)]))
+        np.testing.assert_allclose(
+            snn.sequence_last_step(_t(x), length=_t(LENS)).numpy(),
+            np.stack([s[-1] for s in _split(x)]))
+
+    def test_pad_unpad_roundtrip(self):
+        x = _packed()
+        padded, lens = snn.sequence_pad(_t(x), 0.0, length=_t(LENS))
+        assert list(padded.shape) == [3, 4, 5]
+        # pad positions carry pad_value
+        assert float(np.abs(padded.numpy()[0, 3:]).sum()) == 0.0
+        assert float(np.abs(padded.numpy()[1, 2:]).sum()) == 0.0
+        back = snn.sequence_unpad(padded, lens)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+
+    def test_pad_value_used(self):
+        x = _packed()
+        padded, _ = snn.sequence_pad(_t(x), -7.0, maxlen=5, length=_t(LENS))
+        assert padded.numpy()[1, 4, 0] == pytest.approx(-7.0)
+
+    def test_reshape(self):
+        x = _packed(6)
+        got = snn.sequence_reshape(_t(x), 3).numpy()
+        np.testing.assert_allclose(got, x.reshape(-1, 3))
+
+    def test_expand(self):
+        x = _packed()
+        got = snn.sequence_expand(_t(x), None, length=_t(LENS),
+                                  y_length=_t(np.array([2, 1, 0]))).numpy()
+        segs = _split(x)
+        want = np.concatenate([segs[0], segs[0], segs[1]])
+        np.testing.assert_allclose(got, want)
+
+    def test_expand_as(self):
+        x = np.random.rand(3, 4).astype("float32")
+        got = snn.sequence_expand_as(
+            _t(x), None, y_length=_t(LENS)).numpy()
+        want = np.concatenate([np.tile(x[i], (int(LENS[i]), 1))
+                               for i in range(3)])
+        np.testing.assert_allclose(got, want)
+
+    def test_enumerate(self):
+        ids = np.arange(T, dtype="int64")
+        got = snn.sequence_enumerate(_t(ids), 2, pad_value=-1,
+                                     length=_t(LENS)).numpy()
+        # windows must not cross boundaries at rows 2 (len3), 4 (len2), 8
+        np.testing.assert_array_equal(got[0], [0, 1])
+        np.testing.assert_array_equal(got[2], [2, -1])
+        np.testing.assert_array_equal(got[4], [4, -1])
+        np.testing.assert_array_equal(got[8], [8, -1])
+
+    def test_scatter(self):
+        base = np.zeros((3, 6), dtype="float32")
+        idx = np.array([0, 2, 1, 5, 0, 1, 2, 3, 3], dtype="int64")
+        upd = np.ones(T, dtype="float32")
+        got = snn.sequence_scatter(_t(base), _t(idx), _t(upd),
+                                   length=_t(LENS)).numpy()
+        want = np.zeros((3, 6), dtype="float32")
+        for i, (seg_i, seg_u) in enumerate(zip(_split(idx), _split(upd))):
+            for j, u in zip(seg_i, seg_u):
+                want[i, j] += u
+        np.testing.assert_allclose(got, want)
+
+    def test_slice(self):
+        x = _packed()
+        got = snn.sequence_slice(_t(x), _t(np.array([1, 0, 2])),
+                                 _t(np.array([2, 1, 2])),
+                                 seq_length=_t(LENS)).numpy()
+        segs = _split(x)
+        want = np.concatenate([segs[0][1:3], segs[1][0:1], segs[2][2:4]])
+        np.testing.assert_allclose(got, want)
+
+    def test_conv_window_masks_boundaries(self):
+        paddle.seed(0)
+        x = _packed(4)
+        out = snn.sequence_conv(_t(x), num_filters=3, filter_size=3,
+                                length=_t(LENS))
+        assert list(out.shape) == [T, 3]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_softmax_jits(self):
+        # segment machinery must stay traceable (static shapes)
+        @paddle.jit.to_static(full_graph=True)
+        def f(x, l):
+            return snn.sequence_softmax(x, length=l)
+
+        x = _packed(1)[:, 0]
+        np.testing.assert_allclose(
+            f(_t(x), _t(LENS)).numpy(),
+            snn.sequence_softmax(_t(x), length=_t(LENS)).numpy(), rtol=1e-6)
+
+    def test_missing_length_raises(self):
+        with pytest.raises(ValueError, match="length"):
+            snn.sequence_softmax(_t(_packed()))
+
+
+class TestExtraStaticLayers:
+    def test_bilinear_tensor_product(self):
+        paddle.seed(0)
+        x = np.random.rand(4, 3).astype("float32")
+        y = np.random.rand(4, 2).astype("float32")
+        out = snn.bilinear_tensor_product(_t(x), _t(y), size=6)
+        assert list(out.shape) == [4, 6]
+
+    def test_row_conv_lookahead(self):
+        paddle.seed(0)
+        x = np.random.rand(2, 5, 3).astype("float32")
+        out = snn.row_conv(_t(x), future_context_size=2)
+        assert list(out.shape) == [2, 5, 3]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_instance_norm(self):
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        out = snn.instance_norm(_t(x))
+        m = out.numpy().mean(axis=(2, 3))
+        np.testing.assert_allclose(m, np.zeros_like(m), atol=1e-5)
+
+    def test_conv_transpose_shapes(self):
+        x = np.random.rand(1, 3, 8, 8).astype("float32")
+        out = snn.conv2d_transpose(_t(x), 4, filter_size=2, stride=2)
+        assert list(out.shape) == [1, 4, 16, 16]
+
+    def test_conv3d(self):
+        x = np.random.rand(1, 2, 4, 4, 4).astype("float32")
+        out = snn.conv3d(_t(x), 3, filter_size=3, padding=1)
+        assert list(out.shape) == [1, 3, 4, 4, 4]
+
+    def test_data_norm(self):
+        x = np.random.rand(6, 4).astype("float32")
+        out = snn.data_norm(_t(x))
+        assert list(out.shape) == [6, 4]
+
+    def test_spectral_norm(self):
+        w = np.random.RandomState(0).rand(4, 6).astype("float32")
+        out = snn.spectral_norm(_t(w), power_iters=30).numpy()
+        # largest singular value of the normalized weight ~ 1
+        s = np.linalg.svd(out, compute_uv=False)[0]
+        assert s == pytest.approx(1.0, abs=1e-2)
+
+    def test_nce_loss(self):
+        paddle.seed(0)
+        x = np.random.rand(5, 8).astype("float32")
+        lab = np.random.randint(0, 20, (5, 1)).astype("int64")
+        out = snn.nce(_t(x), _t(lab), num_total_classes=20, num_neg_samples=4)
+        assert list(out.shape) == [5]
+        assert (out.numpy() > 0).all()
+
+
+def test_pool_empty_sequence_gets_pad_value():
+    # empty sequences must emit pad_value, never a neighbor's rows
+    x = np.arange(10, dtype="float32").reshape(5, 2)
+    lens = np.array([2, 0, 3], dtype="int64")
+    for ptype in ("sum", "average", "sqrt", "max", "first", "last"):
+        got = snn.sequence_pool(_t(x), ptype, pad_value=-1.0,
+                                length=_t(lens)).numpy()
+        np.testing.assert_allclose(got[1], [-1.0, -1.0], err_msg=ptype)
+    # non-empty rows unaffected
+    got = snn.sequence_pool(_t(x), "last", pad_value=-1.0,
+                            length=_t(lens)).numpy()
+    np.testing.assert_allclose(got[0], x[1])
+    np.testing.assert_allclose(got[2], x[4])
+
+
+def test_data_norm_stats_not_trainable():
+    x = np.random.rand(6, 4).astype("float32")
+    out = snn.data_norm(_t(x))
+    assert np.isfinite(out.numpy()).all()
