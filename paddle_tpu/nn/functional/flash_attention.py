@@ -13,31 +13,49 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         causal=False, return_softmax=False,
                         fixed_seed_offset=None, rng_name="", training=True,
                         name=None):
-    """Varlen flash attention. The TPU path currently buckets to the padded
-    dense form (XLA static shapes); a Pallas varlen kernel is the planned
-    fast path."""
-    import jax.numpy as jnp
+    """Varlen flash attention over packed [total_tokens, H, D] tensors.
 
-    from ...core.tensor import Tensor
+    Reference: nn/functional/flash_attention.py:602 (flash_attn_unpadded
+    over phi/kernels/gpu/flash_attn_kernel.cu varlen kernels). TPU path:
+    one Pallas kernel with in-kernel cu_seqlens (segment-id) masking —
+    cu_seqlens are data, so ONE compile serves every segment layout with
+    the same packed shape (ops/pallas/flash_attention_varlen.py). GQA
+    (H != H_kv) and bottom-right-aligned causal masking are supported;
+    dropout inside the kernel is not (dropout > 0 falls back to the
+    per-segment dense path)."""
+    from ...core.tensor import apply
     from ...ops._helpers import ensure_tensor
 
     q = ensure_tensor(query)
     k = ensure_tensor(key)
     v = ensure_tensor(value)
-    cu_q = [int(i) for i in ensure_tensor(cu_seqlens_q).tolist()]
-    cu_k = [int(i) for i in ensure_tensor(cu_seqlens_k).tolist()]
-    outs = []
-    for i in range(len(cu_q) - 1):
-        qs = q[cu_q[i] : cu_q[i + 1]]
-        ks = k[cu_k[i] : cu_k[i + 1]]
-        vs = v[cu_k[i] : cu_k[i + 1]]
-        from ...ops.manipulation import unsqueeze, squeeze
+    if dropout and training:
+        # dropout needs per-element rng inside the kernel; keep the exact
+        # dense fallback for this rare training configuration. sdpa always
+        # divides by sqrt(D), so pre-scale q to honor the user's scale.
+        import math as _math
 
-        o = scaled_dot_product_attention(
-            unsqueeze(qs, 0), unsqueeze(ks, 0), unsqueeze(vs, 0),
-            dropout_p=dropout, is_causal=causal, training=training,
-        )
-        outs.append(squeeze(o, 0))
-    from ...ops.manipulation import concat
+        from ...ops.manipulation import concat, squeeze, unsqueeze
+        from ...ops.math import scale as _scale_op
 
-    return concat(outs, axis=0), None
+        q = _scale_op(q, float(scale) * _math.sqrt(q.shape[-1]))
+        cu_q = [int(i) for i in ensure_tensor(cu_seqlens_q).tolist()]
+        cu_k = [int(i) for i in ensure_tensor(cu_seqlens_k).tolist()]
+        outs = []
+        for i in range(len(cu_q) - 1):
+            o = scaled_dot_product_attention(
+                unsqueeze(q[cu_q[i]: cu_q[i + 1]], 0),
+                unsqueeze(k[cu_k[i]: cu_k[i + 1]], 0),
+                unsqueeze(v[cu_k[i]: cu_k[i + 1]], 0),
+                dropout_p=dropout, is_causal=causal, training=training)
+            outs.append(squeeze(o, 0))
+        return concat(outs, axis=0), None
+
+    from ...ops.pallas import flash_attention_varlen  # noqa: F401 (registers prim)
+
+    cu_q_t = ensure_tensor(cu_seqlens_q)
+    cu_k_t = ensure_tensor(cu_seqlens_k)
+    out, _lse = apply("flash_attn_varlen_p", q, k, v, cu_q_t, cu_k_t,
+                      causal=bool(causal), scale=float(scale),
+                      n_seqs=int(cu_q_t.shape[0]) - 1)
+    return out, None

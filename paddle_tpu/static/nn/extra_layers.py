@@ -110,22 +110,11 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
     scale = m.rsqrt(m.add(m.divide(batch_square_sum, batch_size),
                           ensure_tensor(float(epsilon))))
     out = m.multiply(m.subtract(x, mean), scale)
-    # accumulate this batch's summary (detached; reduce over all axes but
-    # the feature axis)
-    import jax.numpy as jnp
-
-    xv = x._value
-    red = tuple(i for i in range(xv.ndim)
-                if not ((data_layout == "NHWC" or xv.ndim == 2)
-                        and i == xv.ndim - 1)
-                and not (data_layout == "NCHW" and xv.ndim > 2 and i == 1))
-    count = 1
-    for i in red:
-        count *= xv.shape[i]
-    batch_size._replace_value(batch_size._value + count)
-    batch_sum._replace_value(batch_sum._value + jnp.sum(xv, axis=red))
-    batch_square_sum._replace_value(
-        batch_square_sum._value + jnp.sum(xv * xv, axis=red))
+    # The reference updates the accumulators per step through optimizer-
+    # injected summary ops; this functional form mints fresh stats per
+    # call, so per-call accumulation would be unobservable. Stat-driven
+    # normalization with persistent accumulators belongs to a Layer that
+    # owns the stats (load pretrained values into these parameters).
     if enable_scale_and_shift:
         w = create_parameter(
             [d], dt, attr=param_attr,
@@ -142,15 +131,15 @@ def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
                   im2col_step=1, param_attr=None, bias_attr=None,
                   modulated=True, name=None):
     x = ensure_tensor(input)
-    layer = _nn.Layer()
     k = filter_size if isinstance(filter_size, (list, tuple)) else (
         filter_size, filter_size)
-    w = layer.create_parameter(
-        [num_filters, x.shape[1] // groups, k[0], k[1]], attr=param_attr)
+    w = create_parameter(
+        [num_filters, x.shape[1] // groups, k[0], k[1]], "float32",
+        attr=param_attr)
     b = None
     if bias_attr is not False:
-        b = layer.create_parameter([num_filters], attr=bias_attr,
-                                   is_bias=True)
+        b = create_parameter([num_filters], "float32", attr=bias_attr,
+                             is_bias=True)
     from ...vision.ops import deform_conv2d as _dcn
 
     return _dcn(x, ensure_tensor(offset), w, bias=b, stride=stride,

@@ -331,11 +331,9 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
     out = matmul(ctx, w)
     if bias is not None:
         out = add(out, bias)
-    if act is not None:
-        from ... import nn
+    from .common import _maybe_act
 
-        out = getattr(nn.functional, act)(out)
-    return out
+    return _maybe_act(out, act)
 
 
 def _sequence_conv_ctx_fwd(x, length, *, filter_size, padding_start):
