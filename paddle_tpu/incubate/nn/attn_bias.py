@@ -28,7 +28,7 @@ _NEG_INF = float("-inf")
 class AttentionBias(ABC):
     @abstractmethod
     def materialize(self, shape, dtype="float32"):
-        raise NotImplementedError()
+        raise NotImplementedError  # abstract
 
 
 class LowerTriangularMask(AttentionBias):
@@ -122,7 +122,9 @@ class PaddedSeqLenInfo(SeqLenInfo):
         )
 
     def split(self, x, batch_sizes=None):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            "PaddedSeqLenInfo.split: padded-interleaved splitting is not "
+            "used by the TPU attention path")
 
 
 @dataclass

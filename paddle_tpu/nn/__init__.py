@@ -9,6 +9,7 @@ from .param_attr import ParamAttr
 from . import initializer
 from . import functional
 from . import functional as F  # noqa: F401
+from . import utils  # noqa: F401
 
 from .container import Sequential, LayerList, LayerDict, ParameterList
 from .common_layers import (

@@ -55,22 +55,28 @@ class RNNTLoss(Layer):
 
 
 class HSigmoidLoss(Layer):
-    """Reference: nn/layer/loss.py HSigmoidLoss (default-tree mode)."""
+    """Reference: nn/layer/loss.py HSigmoidLoss (default complete-binary
+    tree, or custom tree via path_table/path_code when is_custom)."""
 
     def __init__(self, feature_size, num_classes, weight_attr=None,
                  bias_attr=None, is_custom=False, is_sparse=False, name=None):
         super().__init__()
-        if is_custom:
-            raise NotImplementedError("custom-tree HSigmoidLoss")
-        if num_classes < 2:
+        self.is_custom = is_custom
+        if not is_custom and num_classes < 2:
             raise ValueError("num_classes must be >= 2")
         self.num_classes = num_classes
+        # custom mode: num_classes counts the tree's non-leaf nodes, so the
+        # table has num_classes rows (reference nn/layer/loss.py:572)
+        rows = num_classes if is_custom else num_classes - 1
         self.weight = self.create_parameter(
-            [num_classes - 1, feature_size], attr=weight_attr)
+            [rows, feature_size], attr=weight_attr)
         self.bias = (None if bias_attr is False else self.create_parameter(
-            [num_classes - 1], attr=bias_attr, is_bias=True))
+            [rows], attr=bias_attr, is_bias=True))
 
     def forward(self, input, label, path_table=None, path_code=None):
+        if self.is_custom and (path_table is None or path_code is None):
+            raise ValueError(
+                "custom-tree HSigmoidLoss requires path_table and path_code")
         return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
                                self.bias, path_table, path_code)
 

@@ -232,17 +232,44 @@ def _hsigmoid_fwd(x, lab, w, b, *, num_classes, use_bias):
 defprim("hsigmoid_loss_p", _hsigmoid_fwd)
 
 
+def _hsigmoid_custom_fwd(x, w, b, pt, pc, *, use_bias):
+    """Custom-tree mode: path_table [N, L] holds the internal-node row of
+    each step (< 0 = padding), path_code [N, L] the 0/1 branch label.
+    Loss_i = sum_j SCE(x_i . w[pt_ij] + b[pt_ij], pc_ij) over valid steps
+    (reference MatrixBitCodeFunctor, phi/kernels/cpu/hsigmoid_loss_kernel)."""
+    x = x.astype(jnp.float32)
+    w = w.astype(jnp.float32)
+    pt = pt.astype(jnp.int32)
+    pcf = pc.astype(jnp.float32)
+    valid = (pt >= 0).astype(jnp.float32)
+    idx = jnp.clip(pt, 0, w.shape[0] - 1)            # [N, L]
+    logit = jnp.einsum("nd,nld->nl", x, w[idx])
+    if use_bias:
+        logit = logit + b.reshape(-1)[idx]
+    ll = jnp.logaddexp(0.0, logit) - pcf * logit
+    return jnp.sum(ll * valid, axis=-1)[:, None]
+
+
+defprim("hsigmoid_custom_p", _hsigmoid_custom_fwd)
+
+
 def hsigmoid_loss(input, label, num_classes, weight, bias=None,
                   path_table=None, path_code=None, is_sparse=False,
                   name=None):
-    """Hierarchical sigmoid over a default complete binary tree
-    (reference: nn/functional/loss.py hsigmoid_loss; default-tree mode)."""
-    if path_table is not None or path_code is not None:
-        raise NotImplementedError(
-            "custom-tree hsigmoid (path_table/path_code) is not implemented")
+    """Hierarchical sigmoid loss (reference: nn/functional/loss.py
+    hsigmoid_loss). Default mode walks a complete binary tree from the
+    label's leaf; custom mode takes explicit per-sample path_table
+    (internal-node rows, < 0 padded) and path_code (0/1 branch labels)."""
     x = ensure_tensor(input)
     w = ensure_tensor(weight)
     b = ensure_tensor(bias) if bias is not None else w
+    if path_table is not None or path_code is not None:
+        if path_table is None or path_code is None:
+            raise ValueError(
+                "custom-tree hsigmoid needs BOTH path_table and path_code")
+        return apply("hsigmoid_custom_p", x, w, b,
+                     ensure_tensor(path_table), ensure_tensor(path_code),
+                     use_bias=bias is not None)
     return apply("hsigmoid_loss_p", x, ensure_tensor(label), w, b,
                  num_classes=int(num_classes), use_bias=bias is not None)
 

@@ -155,6 +155,14 @@ def _frac_boundaries(in_size, out_size, u):
     return b
 
 
+def _frac_window(bounds_d, i, k, size):
+    """(lo, hi) of fractional window i along one axis — the single source
+    of the clamp rules shared by the mask and no-mask paths."""
+    lo = int(bounds_d[i])
+    hi = int(bounds_d[i + 1]) if k is None else min(lo + k, size)
+    return lo, max(hi, lo + 1)
+
+
 def _fractional_pool(x, output_size, kernel_size, u, nd):
     x = ensure_tensor(x)
     spatial = x.shape[2:]
@@ -164,12 +172,10 @@ def _fractional_pool(x, output_size, kernel_size, u, nd):
     ]
     xv = x._value
 
-    def pool_axis(v, axis, b, k):
+    def pool_axis(v, axis, b, k, size):
         slices = []
         for i in range(len(b) - 1):
-            lo = b[i]
-            hi = b[i + 1] if k is None else min(lo + k, v.shape[axis])
-            hi = max(hi, lo + 1)
+            lo, hi = _frac_window(b, i, k, size)
             slices.append(jnp.max(
                 jax.lax.slice_in_dim(v, lo, hi, axis=axis), axis=axis,
                 keepdims=True))
@@ -177,8 +183,44 @@ def _fractional_pool(x, output_size, kernel_size, u, nd):
 
     ks = _pair(kernel_size, nd) if kernel_size is not None else [None] * nd
     for i in range(nd):
-        xv = pool_axis(xv, 2 + i, bounds[i], ks[i])
+        xv = pool_axis(xv, 2 + i, bounds[i], ks[i], spatial[i])
     return Tensor._from_value(xv)
+
+
+def _fractional_pool_with_mask(x, output_size, kernel_size, u, nd):
+    """Max + argmax per fractional window; mask holds indices into the
+    flattened input spatial dims (reference return_mask semantics)."""
+    import itertools
+
+    x = ensure_tensor(x)
+    spatial = list(x.shape[2:])
+    out_spatial = _pair(output_size, nd)
+    bounds = [
+        _frac_boundaries(spatial[i], out_spatial[i], u[i]) for i in range(nd)
+    ]
+    ks = _pair(kernel_size, nd) if kernel_size is not None else [None] * nd
+    xv = x._value
+    n, c = xv.shape[0], xv.shape[1]
+    maxs, idxs = [], []
+    for cell in itertools.product(*[range(o) for o in out_spatial]):
+        los, his = [], []
+        for d, i in enumerate(cell):
+            lo, hi = _frac_window(bounds[d], i, ks[d], spatial[d])
+            los.append(lo)
+            his.append(hi)
+        win = xv[(slice(None), slice(None))
+                 + tuple(slice(l, h) for l, h in zip(los, his))]
+        flat = win.reshape(n, c, -1)
+        maxs.append(jnp.max(flat, -1))
+        coords = jnp.unravel_index(
+            jnp.argmax(flat, -1), [h - l for l, h in zip(los, his)])
+        flat_idx = coords[0] + los[0]
+        for d in range(1, nd):
+            flat_idx = flat_idx * spatial[d] + (coords[d] + los[d])
+        idxs.append(flat_idx)
+    out = jnp.stack(maxs, -1).reshape(n, c, *out_spatial)
+    mask = jnp.stack(idxs, -1).reshape(n, c, *out_spatial).astype(jnp.int32)
+    return Tensor._from_value(out), Tensor._from_value(mask)
 
 
 def fractional_max_pool2d(x, output_size, kernel_size=None,
@@ -186,15 +228,14 @@ def fractional_max_pool2d(x, output_size, kernel_size=None,
     """Reference: nn/functional/pooling.py fractional_max_pool2d."""
     from ...core import generator
 
-    if return_mask:
-        raise NotImplementedError(
-            "fractional_max_pool return_mask=True is not implemented in the "
-            "TPU build")
     if random_u is None:
         key = generator.next_key("local_seed")
         u = float(jax.random.uniform(key, (), minval=1e-4, maxval=1.0 - 1e-4))
     else:
         u = float(random_u)
+    if return_mask:
+        return _fractional_pool_with_mask(x, output_size, kernel_size,
+                                          (u, u), 2)
     return _fractional_pool(x, output_size, kernel_size, (u, u), 2)
 
 
@@ -202,13 +243,12 @@ def fractional_max_pool3d(x, output_size, kernel_size=None,
                           random_u=None, return_mask=False, name=None):
     from ...core import generator
 
-    if return_mask:
-        raise NotImplementedError(
-            "fractional_max_pool return_mask=True is not implemented in the "
-            "TPU build")
     if random_u is None:
         key = generator.next_key("local_seed")
         u = float(jax.random.uniform(key, (), minval=1e-4, maxval=1.0 - 1e-4))
     else:
         u = float(random_u)
+    if return_mask:
+        return _fractional_pool_with_mask(x, output_size, kernel_size,
+                                          (u, u, u), 3)
     return _fractional_pool(x, output_size, kernel_size, (u, u, u), 3)

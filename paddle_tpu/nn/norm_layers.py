@@ -225,8 +225,3 @@ class LocalResponseNorm(Layer):
                                      self.data_format)
 
 
-class SpectralNorm(Layer):
-    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12,
-                 dtype="float32"):
-        super().__init__()
-        raise NotImplementedError("SpectralNorm: planned (utils.spectral_norm)")

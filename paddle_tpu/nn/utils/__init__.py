@@ -1,0 +1,252 @@
+"""``paddle.nn.utils`` — weight reparameterization hooks + grad/param utils.
+
+Reference: python/paddle/nn/utils/ (weight_norm_hook.py,
+spectral_norm_hook.py, transform_parameters.py, clip_grad_norm_.py,
+clip_grad_value_.py). The hooks use this framework's forward-pre-hook
+mechanism: the reparameterized weight is recomputed from the stored
+(g, v) / power-iteration state right before each forward.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "weight_norm", "remove_weight_norm", "spectral_norm",
+    "parameters_to_vector", "vector_to_parameters", "clip_grad_norm_",
+    "clip_grad_value_",
+]
+
+
+def _norm_except(v, dim):
+    """||v|| over all axes except `dim`. dim=None or -1 means the whole-
+    tensor scalar norm (reference weight_norm_hook.py dim semantics)."""
+    import jax.numpy as jnp
+
+    if dim is None or dim == -1:
+        return jnp.sqrt(jnp.sum(v * v))
+    dim = dim % v.ndim
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    shape = [1] * v.ndim
+    shape[dim] = v.shape[dim]
+    return jnp.sqrt(jnp.sum(v * v, axis=axes)).reshape(shape)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """w = g * v / ||v||_dim (reference weight_norm_hook.py)."""
+    from ..layer import Layer
+
+    if not isinstance(layer, Layer):
+        raise TypeError("weight_norm expects a Layer")
+    w = getattr(layer, name)
+    import jax.numpy as jnp
+
+    v0 = w._value
+    g0 = _norm_except(v0, dim)
+    g = layer.create_parameter(list(np.shape(g0)), dtype=str(w.dtype))
+    v = layer.create_parameter(list(v0.shape), dtype=str(w.dtype))
+    g._replace_value(jnp.asarray(g0))
+    v._replace_value(jnp.asarray(v0))
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    # the original weight becomes a derived value (not a parameter)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, _inputs):
+        setattr(lyr, name, _wn_weight(g, v, dim))
+        return None
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_handles = getattr(layer, "_weight_norm_handles", {})
+    layer._weight_norm_handles[name] = (handle, g, v, dim)
+    _recompute(layer, None)
+    return layer
+
+
+def _wn_weight(g, v, dim):
+    """Differentiable w = g * v/||v|| built from framework ops."""
+    from ...core.tensor import apply
+
+    return apply("weight_norm_w_p", g, v, dim=dim)
+
+
+def _wn_fwd(g, v, *, dim):
+    import jax.numpy as jnp
+
+    n = _norm_except(v, dim)
+    return g * (v / jnp.maximum(n, 1e-12))
+
+
+def remove_weight_norm(layer, name="weight"):
+    handles = getattr(layer, "_weight_norm_handles", {})
+    if name not in handles:
+        raise ValueError(f"no weight_norm hook on parameter {name!r}")
+    handle, g, v, dim = handles.pop(name)
+    handle.remove()
+    import jax.numpy as jnp
+
+    w = layer.create_parameter(list(v.shape), dtype=str(v.dtype))
+    w._replace_value(_wn_fwd(g._value, v._value, dim=dim))
+    for pname in (name + "_g", name + "_v"):
+        if pname in layer._parameters:
+            del layer._parameters[pname]
+    layer.add_parameter(name, w)
+    setattr(layer, name, w)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide the weight by its largest singular value, estimated by power
+    iteration on persistent u/v buffers (reference spectral_norm_hook.py).
+
+    Power iteration runs detached and only while ``layer.training`` (the
+    reference's do_power_iteration); sigma itself is computed ON the tape
+    from the weight, so grads keep the -(w/sigma^2) * u v^T term."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor
+    from ..layer import Layer
+
+    if not isinstance(layer, Layer):
+        raise TypeError("spectral_norm expects a Layer")
+    if dim is None:
+        # Linear-style weights store [in, out]: normalize over output dim
+        dim = 1 if type(layer).__name__ in ("Linear",) else 0
+    w = getattr(layer, name)
+    w_orig = layer.create_parameter(list(w.shape), dtype=str(w.dtype))
+    w_orig._replace_value(w._value)
+    if name in layer._parameters:
+        del layer._parameters[name]
+    layer.add_parameter(name + "_orig", w_orig)
+
+    h = int(w.shape[dim])
+    cols = int(np.prod(w.shape)) // h
+    rng = np.random.RandomState(0)
+    u_buf = Tensor._from_value(
+        jnp.asarray(rng.normal(size=(h,)).astype("float32")))
+    v_buf = Tensor._from_value(
+        jnp.asarray(rng.normal(size=(cols,)).astype("float32")))
+    layer.register_buffer(name + "_u", u_buf)
+    layer.register_buffer(name + "_v", v_buf)
+    perm = [dim] + [i for i in range(len(w.shape)) if i != dim]
+
+    def _apply(lyr, _inputs):
+        if lyr.training:
+            mat = jnp.transpose(w_orig._value, perm).reshape(h, cols)
+            u = u_buf._value
+            vv = v_buf._value
+            for _ in range(max(1, int(n_power_iterations))):
+                vv = mat.T @ u
+                vv = vv / (jnp.linalg.norm(vv) + eps)
+                u = mat @ vv
+                u = u / (jnp.linalg.norm(u) + eps)
+            u_buf._replace_value(u)
+            v_buf._replace_value(vv)
+        from ...core.tensor import apply as _op
+
+        setattr(lyr, name, _op("spectral_norm_w_p", w_orig, u_buf, v_buf,
+                               perm=tuple(perm), eps=float(eps)))
+        return None
+
+    handle = layer.register_forward_pre_hook(_apply)
+    layer._spectral_norm_handles = getattr(layer, "_spectral_norm_handles",
+                                           {})
+    layer._spectral_norm_handles[name] = handle
+    _apply(layer, None)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Concatenate flattened parameters (reference
+    transform_parameters.py)."""
+    from ...ops.manipulation import concat, reshape
+
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Slice a flat vector back into the given parameters (in place)."""
+    import jax.numpy as jnp
+
+    from ...ops._helpers import ensure_tensor
+
+    v = ensure_tensor(vec)._value
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._replace_value(jnp.reshape(v[off: off + n],
+                                     tuple(p.shape)).astype(p._value.dtype))
+        off += n
+    if off != v.size:
+        raise ValueError(
+            f"vector has {v.size} elements but parameters take {off}")
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """Scale gradients in place so their global norm <= max_norm
+    (reference clip_grad_norm_.py). Returns the pre-clip total norm."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor
+
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    parameters = list(parameters)  # may be a generator; we iterate twice
+    grads = [p._grad_value for p in parameters if p._grad_value is not None]
+    if not grads:
+        return Tensor._from_value(jnp.asarray(0.0, jnp.float32))
+    norm_type = float(norm_type)
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"the total norm of gradients is non-finite ({total})")
+    clip = jnp.minimum(float(max_norm) / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p._grad_value is not None:
+            p._grad_value = (p._grad_value * clip).astype(
+                p._grad_value.dtype)
+    return Tensor._from_value(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Clamp gradients into [-clip_value, clip_value] in place
+    (reference clip_grad_value_.py)."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor
+
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    cv = abs(float(clip_value))
+    parameters = list(parameters)
+    for p in parameters:
+        if p._grad_value is not None:
+            p._grad_value = jnp.clip(p._grad_value, -cv, cv)
+
+
+def _register_prims():
+    import jax.numpy as jnp
+
+    from ...core import dispatch
+
+    def _sn_fwd(w, u, v, *, perm, eps):
+        # sigma = u^T W v computed FROM w inside the traced forward, so the
+        # fallback VJP differentiates through it (u, v are constants)
+        h = w.shape[perm[0]]
+        mat = jnp.transpose(w, perm).reshape(h, -1)
+        sigma = u @ (mat @ v)
+        return w / jnp.maximum(sigma, eps)
+
+    dispatch.register_primitive("spectral_norm_w_p", _sn_fwd)
+    dispatch.register_primitive(
+        "weight_norm_w_p", lambda g, v, *, dim: _wn_fwd(g, v, dim=dim))
+
+
+_register_prims()

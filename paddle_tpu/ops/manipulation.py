@@ -756,7 +756,24 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
             counts = np.diff(np.append(idx, arr.size))
             outs.append(Tensor._from_value(jnp.asarray(counts)))
         return outs[0] if len(outs) == 1 else tuple(outs)
-    raise NotImplementedError("unique_consecutive with axis")
+    # axis mode: deduplicate consecutive equal SLICES along `axis`
+    axis = int(axis) % arr.ndim
+    moved = np.moveaxis(arr, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    if flat.shape[0] == 0:
+        keep = np.zeros((0,), bool)
+    else:
+        keep = np.concatenate([[True], (flat[1:] != flat[:-1]).any(axis=1)])
+    out = np.moveaxis(moved[keep], 0, axis)
+    outs = [Tensor._from_value(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor._from_value(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, flat.shape[0]))
+        outs.append(Tensor._from_value(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
 
 
 # ---------------------------------------------------------------------------

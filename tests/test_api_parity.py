@@ -15,6 +15,7 @@ REF_ROOT = "/root/reference/python/paddle/"
 
 NAMESPACES = [
     "__init__.py", "nn/__init__.py", "nn/functional/__init__.py",
+    "nn/utils/__init__.py",
     "static/__init__.py", "static/nn/__init__.py",
     "optimizer/__init__.py", "io/__init__.py",
     "autograd/__init__.py", "jit/__init__.py", "linalg.py",
@@ -98,3 +99,86 @@ def test_patched_methods_execute():
     y = paddle.to_tensor(np.random.rand(3).astype("float32"))
     y.sigmoid_()
     assert float(y.numpy().max()) <= 1.0
+
+
+def test_notimplemented_sites_are_documented():
+    """Every NotImplementedError raise is either an abstract-method body
+    (bare raise, the reference's own pattern) or carries a one-line
+    rationale message. Guards against silent feature stubs."""
+    import re
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(root, "paddle_tpu")
+    bad = []
+    total = 0
+    for dirpath, _, files in os.walk(pkg):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            lines = open(path).read().split("\n")
+            for i, line in enumerate(lines):
+                if "raise NotImplementedError" not in line:
+                    continue
+                total += 1
+                blob = "\n".join(lines[i:i + 4])
+                bare = re.search(r"raise NotImplementedError\s*($|#)",
+                                 blob.split("\n")[0])
+                has_msg = re.search(
+                    r'NotImplementedError\(\s*(f?["\'])', blob)
+                if not bare and not has_msg:
+                    bad.append(f"{path}:{i + 1}")
+    assert not bad, f"undocumented NotImplementedError sites: {bad}"
+    # feature surface should not regress behind stubs
+    assert total < 90
+
+
+SMOKE_CALLS = [
+    # (description, zero-arg callable) — a representative subset of APIs
+    # that the hasattr gate alone cannot vouch for. Each must execute.
+    ("SpectralNorm layer", lambda: __import__("paddle_tpu").nn.SpectralNorm(
+        [4, 3], dim=0, power_iters=2)(
+        __import__("paddle_tpu").randn([4, 3]))),
+    ("static.nn.cond", lambda: __import__("paddle_tpu").static.nn.cond(
+        __import__("paddle_tpu").to_tensor(True),
+        lambda: __import__("paddle_tpu").to_tensor(1.0),
+        lambda: __import__("paddle_tpu").to_tensor(2.0))),
+    ("nn.utils.weight_norm", lambda: __import__("paddle_tpu").nn.utils.
+        weight_norm(__import__("paddle_tpu").nn.Linear(3, 2))),
+    ("unique_consecutive axis", lambda: __import__("paddle_tpu").
+        unique_consecutive(__import__("paddle_tpu").to_tensor(
+            [[1, 1], [1, 1], [2, 2]]), axis=0)),
+    ("fractional pool mask", lambda: __import__("paddle_tpu").nn.functional.
+        fractional_max_pool2d(__import__("paddle_tpu").randn([1, 1, 6, 6]),
+                              2, random_u=0.5, return_mask=True)),
+    ("hsigmoid custom tree", lambda: __import__("paddle_tpu").nn.functional.
+        hsigmoid_loss(
+            __import__("paddle_tpu").randn([2, 4]),
+            __import__("paddle_tpu").to_tensor([[0], [1]]), 4,
+            __import__("paddle_tpu").randn([3, 4]), None,
+            path_table=__import__("paddle_tpu").to_tensor([[0, 1], [0, 2]]),
+            path_code=__import__("paddle_tpu").to_tensor([[0, 1], [1, 0]]))),
+    ("vision deform_conv2d", lambda: __import__("paddle_tpu").vision.ops.
+        deform_conv2d(
+            __import__("paddle_tpu").randn([1, 3, 5, 5]),
+            __import__("paddle_tpu").zeros([1, 18, 5, 5]),
+            __import__("paddle_tpu").randn([4, 3, 3, 3]), padding=1)),
+    ("distribution Normal rsample", lambda: __import__("paddle_tpu").
+        distribution.Normal(0.0, 1.0).sample([3])),
+    ("linalg svd", lambda: __import__("paddle_tpu").linalg.svd(
+        __import__("paddle_tpu").randn([3, 3]))),
+    ("incubate fused_rms_norm", lambda: __import__("paddle_tpu").incubate.
+        nn.functional.fused_rms_norm(
+            __import__("paddle_tpu").randn([2, 8]),
+            __import__("paddle_tpu").ones([8]), None, 1e-5, 1)),
+]
+
+
+@pytest.mark.parametrize("desc,call", SMOKE_CALLS,
+                         ids=[c[0] for c in SMOKE_CALLS])
+def test_callable_smoke(desc, call):
+    """Name parity != behavior parity: these must RUN, not just exist."""
+    import paddle_tpu
+
+    paddle_tpu.seed(0)
+    call()

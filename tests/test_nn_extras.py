@@ -369,10 +369,13 @@ class TestReviewFixes2:
         np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4,
                                    atol=1e-5)
 
-    def test_fractional_mask_raises(self):
-        with pytest.raises(NotImplementedError):
-            F.fractional_max_pool2d(paddle.to_tensor(_r(1, 1, 8, 8)), 4,
-                                    return_mask=True)
+    def test_fractional_mask_returns_indices(self):
+        x = _r(1, 1, 8, 8)
+        out, mask = F.fractional_max_pool2d(paddle.to_tensor(x), 4,
+                                            random_u=0.5, return_mask=True)
+        np.testing.assert_allclose(
+            out.numpy().reshape(-1),
+            x.reshape(1, 1, -1)[0, 0][mask.numpy().reshape(-1)])
 
     def test_rnnt_fastemit_changes_grad_not_value(self):
         np.random.seed(5)
