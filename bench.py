@@ -1,43 +1,71 @@
-"""Benchmark: Llama decoder pretraining throughput on one TPU chip.
+"""Benchmarks on one TPU chip. Prints ONE JSON line PER metric:
+{"metric", "value", "unit", "vs_baseline"}.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Configs mirror BASELINE.json's families (the reference publishes no
+in-tree numbers — BASELINE.md):
 
-Config mirrors BASELINE.json's north-star family (Llama pretraining,
-tokens/sec/chip). The reference publishes no in-tree numbers (BASELINE.md),
-so ``vs_baseline`` reports our measured MFU divided by 0.40 — the well-known
-Megatron-LM A100 MFU for Llama-class pretraining that the north star asks us
-to match (">= A100-NCCL MFU").
+- llama:  Llama-decoder pretraining tokens/sec/chip. This is a 645M-param
+  model with v5e-matched shapes (H=2048/I=5632/L=10) — the single-chip
+  HBM-sized stand-in for the Llama-3-8B north star, whose full geometry
+  needs the multi-chip path (validated by __graft_entry__.dryrun_multichip).
+- resnet: ResNet-50 ImageNet-shape images/sec (single chip, synthetic data).
+- moe:    ERNIE-style MoE decoder step time / tokens/sec on one chip
+  (expert-parallel sharding is exercised by the dryrun; here all experts
+  are chip-resident).
 
-Run: python bench.py  (uses the real TPU chip; falls back to CPU with a
-smaller config when no accelerator is present).
+``vs_baseline`` is measured MFU / 0.40 — the Megatron-LM A100 MFU bar the
+north star asks us to match (">= A100-NCCL MFU"). The dense-model loss is
+single-batch memorization, meaningless as a quality signal, and is NOT
+printed in the metric.
+
+Run: python bench.py [--config llama|resnet|moe|all] [--profile]
+[--steps N]. Falls back to tiny CPU configs without an accelerator.
+--profile captures one step with paddle.profiler.Profiler and writes
+bench_trace.json (chrome trace).
 """
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
 import numpy as np
 
+A100_MFU_BAR = 0.40
 
-def main():
-    import jax
 
-    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+def _emit(metric, value, unit, mfu):
+    print(json.dumps({
+        "metric": metric,
+        "value": round(float(value), 1),
+        "unit": unit,
+        "vs_baseline": round(mfu / A100_MFU_BAR, 3),
+    }), flush=True)
 
+
+def _profile_one_step(step_fn, *args):
+    import paddle_tpu.profiler as profiler
+
+    with profiler.Profiler(
+            targets=[profiler.ProfilerTarget.CPU]) as prof:
+        step_fn(*args)
+    prof.export("bench_trace.json")
+    return "bench_trace.json"
+
+
+def bench_llama(on_tpu, steps, warmup, peak_flops, profile=False):
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as opt
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
     paddle.seed(0)
-
     if on_tpu:
         # ~645M-param decoder with v5e-matched shapes. Measured matmul
         # ceilings on this chip: [16k,1024]x[1024,2816] runs at 0.39 MFU
         # (K too small to feed the MXU), [16k,2048]x[2048,5632] at 0.70 —
         # so hidden=2048/inter=5632 is the TPU-first geometry. The chunked
-        # fused lm_head+CE (fused_lm_head_ce) avoids the fp32 [T,32k]
-        # logits that otherwise cap the batch. Measured: 0.381 MFU (old
-        # H=1024 config) → 0.676 MFU here.
+        # fused lm_head+CE avoids the fp32 [T,32k] logits that otherwise
+        # cap the batch. bs=6/8 measured WORSE (padding/OOM).
         config = LlamaConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=10, num_attention_heads=16,
@@ -45,22 +73,16 @@ def main():
             recompute=False,
         )
         batch, seq = 4, 2048
-        steps, warmup = 20, 3
-        peak_flops = 197e12  # TPU v5e bf16 peak
     else:
         config = LlamaConfig.tiny()
         batch, seq = 4, 128
-        steps, warmup = 5, 2
-        peak_flops = 1e12
 
     model = LlamaForCausalLM(config)
     n_params = model.num_parameters()
     if on_tpu:
         model.bfloat16()
-    optimizer = opt.AdamW(
-        learning_rate=3e-4, parameters=model.parameters(),
-        multi_precision=on_tpu,
-    )
+    optimizer = opt.AdamW(learning_rate=3e-4, parameters=model.parameters(),
+                          multi_precision=on_tpu)
 
     @paddle.jit.to_static
     def train_step(ids, labels):
@@ -70,36 +92,166 @@ def main():
         optimizer.clear_grad()
         return loss
 
-    ids_np = np.random.randint(0, config.vocab_size, (batch, seq)).astype("int64")
-    labels_np = np.roll(ids_np, -1, axis=1)
+    ids_np = np.random.randint(0, config.vocab_size,
+                               (batch, seq)).astype("int64")
     ids = paddle.to_tensor(ids_np)
-    labels = paddle.to_tensor(labels_np)
+    labels = paddle.to_tensor(np.roll(ids_np, -1, axis=1))
 
     for _ in range(warmup):
         loss = train_step(ids, labels)
-    float(loss)  # full sync (block_until_ready is a no-op on tunneled backends)
+    float(loss)  # full sync (block_until_ready is a no-op when tunneled)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = train_step(ids, labels)
-    final_loss = float(loss)  # waits on the whole step chain via data dep
+    float(loss)
     dt = time.perf_counter() - t0
 
-    tokens_per_step = batch * seq
-    tok_s = tokens_per_step * steps / dt
-
-    # training FLOPs/token ≈ 6P + 12·L·H·S (attention score/AV terms)
+    tok_s = batch * seq * steps / dt
     attn_flops = 12 * config.num_hidden_layers * config.hidden_size * seq
-    flops_per_token = 6 * n_params + attn_flops
-    mfu = tok_s * flops_per_token / peak_flops
+    mfu = tok_s * (6 * n_params + attn_flops) / peak_flops
+    _emit(f"llama-{n_params / 1e6:.0f}M pretrain tokens/sec/chip "
+          f"(bs={batch} seq={seq}, mfu={mfu:.3f}; single-chip stand-in "
+          f"for the 8B multi-chip north star)",
+          tok_s, "tokens/sec/chip", mfu)
+    if profile:
+        path = _profile_one_step(train_step, ids, labels)
+        print(json.dumps({"profile_trace": path}), flush=True)
 
-    print(json.dumps({
-        "metric": f"llama-{n_params/1e6:.0f}M pretrain tokens/sec/chip "
-                  f"(bs={batch} seq={seq}, loss={final_loss:.3f}, mfu={mfu:.3f})",
-        "value": round(tok_s, 1),
-        "unit": "tokens/sec/chip",
-        "vs_baseline": round(mfu / 0.40, 3),
-    }))
+
+def bench_resnet(on_tpu, steps, warmup, peak_flops):
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    batch, hw = (256, 224) if on_tpu else (4, 64)
+    model = resnet50(num_classes=1000)
+    if on_tpu:
+        model.bfloat16()
+    optimizer = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters(),
+                             multi_precision=on_tpu)
+    loss_fn = paddle.nn.CrossEntropyLoss()
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        logits = model(x)
+        loss = loss_fn(logits.astype("float32"), y)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        return loss
+
+    x_np = np.random.rand(batch, 3, hw, hw).astype("float32")
+    y_np = np.random.randint(0, 1000, (batch,)).astype("int64")
+    x = paddle.to_tensor(x_np.astype("bfloat16") if on_tpu else x_np)
+    y = paddle.to_tensor(y_np)
+
+    for _ in range(warmup):
+        loss = train_step(x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train_step(x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    # ResNet-50 @224: ~4.1 GFLOPs forward; training ~3x forward
+    fwd_flops = 4.1e9 * (hw / 224) ** 2
+    mfu = ips * 3 * fwd_flops / peak_flops
+    _emit(f"resnet50 train images/sec/chip (bs={batch} {hw}x{hw}, "
+          f"mfu={mfu:.3f})", ips, "images/sec/chip", mfu)
+
+
+def bench_moe(on_tpu, steps, warmup, peak_flops):
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import ErnieMoeConfig, ErnieMoeForCausalLM
+
+    paddle.seed(0)
+    if on_tpu:
+        # H=2048 matches the chip's GEMM sweet spot (H=1024 caps at
+        # ~0.39 MFU on this chip; see bench_llama geometry note)
+        config = ErnieMoeConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            moe_intermediate_size=1408, num_hidden_layers=6,
+            num_attention_heads=16, num_key_value_heads=16,
+            num_experts=8, moe_top_k=2, max_position_embeddings=2048,
+        )
+        batch, seq = 4, 2048
+    else:
+        config = ErnieMoeConfig.tiny(num_experts=4, moe_top_k=2)
+        batch, seq = 2, 64
+
+    model = ErnieMoeForCausalLM(config)
+    n_params = model.num_parameters()
+    if on_tpu:
+        model.bfloat16()
+    optimizer = opt.AdamW(learning_rate=3e-4, parameters=model.parameters(),
+                          multi_precision=on_tpu)
+
+    @paddle.jit.to_static
+    def train_step(ids, labels):
+        loss, _ = model(ids, labels=labels)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        return loss
+
+    ids_np = np.random.randint(0, config.vocab_size,
+                               (batch, seq)).astype("int64")
+    ids = paddle.to_tensor(ids_np)
+    labels = paddle.to_tensor(np.roll(ids_np, -1, axis=1))
+
+    for _ in range(warmup):
+        loss = train_step(ids, labels)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train_step(ids, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    step_ms = dt / steps * 1e3
+    tok_s = batch * seq * steps / dt
+    # active params per token: shared + top_k of num_experts expert FFNs
+    try:
+        expert_params = sum(
+            int(np.prod(p.shape)) for n, p in model.named_parameters()
+            if ".experts." in n)
+        active = n_params - expert_params + \
+            expert_params * config.moe_top_k / config.num_experts
+    except Exception:
+        active = n_params
+    mfu = tok_s * 6 * active / peak_flops
+    _emit(f"ernie-moe {n_params / 1e6:.0f}M ({config.num_experts} experts "
+          f"top{config.moe_top_k}) step time (bs={batch} seq={seq}, "
+          f"{tok_s:.0f} tok/s, mfu={mfu:.3f})", step_ms, "ms/step", mfu)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="all",
+                    choices=["llama", "resnet", "moe", "all"])
+    ap.add_argument("--profile", action="store_true")
+    ap.add_argument("--steps", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    peak_flops = 197e12 if on_tpu else 1e12  # v5e bf16 peak
+    steps = args.steps or (20 if on_tpu else 3)
+    warmup = 3 if on_tpu else 1
+
+    if args.config in ("llama", "all"):
+        bench_llama(on_tpu, steps, warmup, peak_flops, profile=args.profile)
+    if args.config in ("resnet", "all"):
+        bench_resnet(on_tpu, steps, warmup, peak_flops)
+    if args.config in ("moe", "all"):
+        bench_moe(on_tpu, steps, warmup, peak_flops)
 
 
 if __name__ == "__main__":
