@@ -60,6 +60,30 @@ def _is_tracer(t: Tensor):
     return isinstance(t._value, jax.core.Tracer)
 
 
+def _live_world() -> int:
+    """Process count of an initialized multi-process world, else 1."""
+    from .. import env
+
+    if env.is_initialized():
+        try:
+            return jax.process_count()
+        except Exception:
+            return 1
+    return 1
+
+
+def _process_allgather(value):
+    """Gather a host-local array across all processes -> [world, ...].
+
+    The cross-process analog of ProcessGroupNCCL allgather: lowers to an
+    XLA collective over the global device mesh (gloo on CPU rigs, ICI/DCN
+    on TPU pods)."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(value)))
+
+
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
                sync_op=True):
     """paddle.distributed.all_reduce parity (communication/all_reduce.py).
@@ -81,6 +105,22 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
             out = reshard(tensor, mesh, new_pl)
             tensor._replace_value(out._value)
             tensor._dist_attr = out._dist_attr
+        return tensor
+    if _live_world() > 1:
+        # plain tensor in a real multi-process world: gather + local reduce
+        gathered = _process_allgather(tensor._value)
+        if op == ReduceOp.SUM:
+            red = gathered.sum(0)
+        elif op == ReduceOp.MAX:
+            red = gathered.max(0)
+        elif op == ReduceOp.MIN:
+            red = gathered.min(0)
+        elif op == ReduceOp.PROD:
+            red = gathered.prod(0)
+        else:  # AVG
+            red = gathered.mean(0)
+        tensor._replace_value(jnp.asarray(red.astype(
+            jnp.dtype(tensor._value.dtype))))
         return tensor
     # single-rank world: identity
     return tensor
@@ -106,6 +146,12 @@ def all_gather(tensor_list: List[Tensor], tensor: Tensor,
             parts = [full._value for _ in range(n)]
         tensor_list.clear()
         tensor_list.extend(Tensor._from_value(p) for p in parts)
+        return tensor_list
+    if _live_world() > 1:
+        gathered = _process_allgather(tensor._value)
+        tensor_list.clear()
+        tensor_list.extend(Tensor._from_value(jnp.asarray(g))
+                           for g in gathered)
         return tensor_list
     tensor_list.clear()
     tensor_list.append(tensor.clone())
@@ -145,6 +191,11 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
 
 
 def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    if _is_tracer(tensor) or tensor._dist_attr is not None:
+        return tensor
+    if _live_world() > 1:
+        gathered = _process_allgather(tensor._value)
+        tensor._replace_value(jnp.asarray(gathered[src]))
     return tensor
 
 

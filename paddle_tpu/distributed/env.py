@@ -117,6 +117,13 @@ def init_parallel_env(strategy=None):
             )
         )
         if on_accel and master:
+            # CPU rigs reduce over gloo (the reference's gloo-only path);
+            # harmless on TPU where collectives ride ICI/DCN
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass
             host, p = master.rsplit(":", 1)
             jax.distributed.initialize(
                 coordinator_address=f"{host}:{int(p) + 1}",

@@ -1,0 +1,60 @@
+"""Worker for test_launch_collectives: launched by the REAL launcher
+(python -m paddle_tpu.distributed.launch --nnodes=2), brings up
+jax.distributed across two localhost processes and runs collectives.
+
+Reference pattern: test/collective/test_communication_api_base.py:28-77
+(subprocess workers through the actual launch path).
+"""
+import os
+import sys
+
+os.environ["PADDLE_USE_JAX_COORDINATOR"] = "1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == 2, f"expected world 2, got {world}"
+    assert jax.process_count() == 2, "jax.distributed did not come up"
+
+    # all_reduce: sum across the two processes
+    x = paddle.to_tensor(
+        np.array([rank + 1.0, 10.0 * (rank + 1)], dtype="float32"))
+    dist.all_reduce(x)
+    np.testing.assert_allclose(x.numpy(), [3.0, 30.0])
+
+    # all_reduce MAX
+    m = paddle.to_tensor(np.array([float(rank)], dtype="float32"))
+    dist.all_reduce(m, op=dist.ReduceOp.MAX)
+    np.testing.assert_allclose(m.numpy(), [1.0])
+
+    # broadcast from rank 1
+    b = paddle.to_tensor(np.array([100.0 * rank], dtype="float32"))
+    dist.broadcast(b, src=1)
+    np.testing.assert_allclose(b.numpy(), [100.0])
+
+    # all_gather
+    outs = []
+    dist.all_gather(outs, paddle.to_tensor(
+        np.array([rank * 7.0], dtype="float32")))
+    assert len(outs) == 2
+    np.testing.assert_allclose(
+        np.concatenate([o.numpy() for o in outs]), [0.0, 7.0])
+
+    dist.barrier()
+    print(f"WORKER {rank} COLLECTIVES OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)
