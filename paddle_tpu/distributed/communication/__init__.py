@@ -53,6 +53,9 @@ def _reduce_fn(op):
         ReduceOp.MAX: jax.lax.pmax,
         ReduceOp.MIN: jax.lax.pmin,
         ReduceOp.AVG: lambda x, n: jax.lax.pmean(x, n),
+        # no pprod primitive: gather the axis then reduce locally
+        ReduceOp.PROD: lambda x, n: jnp.prod(
+            jax.lax.all_gather(x, n), axis=0),
     }[op]
 
 
@@ -159,6 +162,10 @@ def all_gather(tensor_list: List[Tensor], tensor: Tensor,
 
 
 def all_gather_object(object_list: List, obj, group=None):
+    if _live_world() > 1:
+        object_list.clear()
+        object_list.extend(_object_allgather(obj))
+        return object_list
     object_list.clear()
     object_list.append(obj)
     return object_list
@@ -166,19 +173,42 @@ def all_gather_object(object_list: List, obj, group=None):
 
 def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
                    group=None, sync_op=True):
-    """Eager parity path: concat-and-keep-local-shard."""
+    """Multi-process: all_reduce the concatenated input, keep this rank's
+    chunk. Single-process: concat-and-keep-local-shard."""
     if isinstance(tensor_or_tensor_list, (list, tuple)):
         from ...ops.manipulation import concat
 
         src = concat(list(tensor_or_tensor_list), axis=0)
     else:
         src = tensor_or_tensor_list
+    world = _live_world()
+    if world > 1:
+        reduced = Tensor._from_value(src._value)
+        all_reduce(reduced, op=op)
+        me = jax.process_index()
+        n = tensor._value.shape[0]
+        tensor._replace_value(reduced._value[me * n:(me + 1) * n])
+        return tensor
     tensor._replace_value(src._value[: tensor._value.shape[0]])
     return tensor
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
-    """Single-process world: identity permutation."""
+    """out[j] on rank r = rank j's in[r]. Multi-process: gather every
+    rank's input stack, pick this rank's column. Single-process world:
+    identity permutation."""
+    world = _live_world()
+    if world > 1:
+        import numpy as np
+
+        stacked = np.stack([np.asarray(t._value) for t in in_tensor_list])
+        gathered = _process_allgather(stacked)     # [world, world, ...]
+        me = jax.process_index()
+        out_tensor_list.clear()
+        out_tensor_list.extend(
+            Tensor._from_value(jnp.asarray(gathered[j, me]))
+            for j in range(world))
+        return out_tensor_list
     out_tensor_list.clear()
     out_tensor_list.extend(t.clone() for t in in_tensor_list)
     return out_tensor_list
@@ -186,6 +216,21 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
                       in_split_sizes=None, group=None, sync_op=True):
+    world = _live_world()
+    if world > 1:
+        if out_split_sizes is not None or in_split_sizes is not None:
+            from ..utils.moe_utils import _check_single_rank
+
+            _check_single_rank(group, "all_to_all_single(split_sizes)")
+        import numpy as np
+
+        gathered = _process_allgather(np.asarray(in_tensor._value))
+        me = jax.process_index()
+        chunk = in_tensor._value.shape[0] // world
+        parts = [gathered[j, me * chunk:(me + 1) * chunk]
+                 for j in range(world)]
+        out_tensor._replace_value(jnp.asarray(np.concatenate(parts, 0)))
+        return out_tensor
     out_tensor._replace_value(in_tensor._value)
     return out_tensor
 
@@ -194,12 +239,36 @@ def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
     if _is_tracer(tensor) or tensor._dist_attr is not None:
         return tensor
     if _live_world() > 1:
-        gathered = _process_allgather(tensor._value)
-        tensor._replace_value(jnp.asarray(gathered[src]))
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        out = multihost_utils.broadcast_one_to_all(
+            np.asarray(tensor._value), is_source=jax.process_index() == src)
+        tensor._replace_value(jnp.asarray(np.asarray(out)))
     return tensor
 
 
+def _object_allgather(obj):
+    """Pickle -> padded uint8 allgather -> unpickle per rank."""
+    import pickle
+
+    import numpy as np
+
+    payload = np.frombuffer(
+        pickle.dumps(obj, pickle.HIGHEST_PROTOCOL), dtype=np.uint8)
+    n = np.array([payload.size], np.int64)
+    sizes = _process_allgather(n)[:, 0]
+    buf = np.zeros(int(sizes.max()), np.uint8)
+    buf[: payload.size] = payload
+    gathered = _process_allgather(buf)
+    return [pickle.loads(gathered[r, : int(sizes[r])].tobytes())
+            for r in range(gathered.shape[0])]
+
+
 def broadcast_object_list(object_list, src=0, group=None):
+    if _live_world() > 1:
+        objs = _object_allgather(list(object_list))[src]
+        object_list[:] = objs
     return object_list
 
 
@@ -209,12 +278,30 @@ def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None,
 
 
 def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    world = _live_world()
+    if world > 1:
+        import numpy as np
+
+        payload = ([np.asarray(t._value) for t in tensor_list]
+                   if tensor_list else None)
+        parts = _object_allgather(payload)[src]
+        tensor._replace_value(jnp.asarray(parts[jax.process_index()]))
+        return tensor
     if tensor_list:
         tensor._replace_value(tensor_list[0]._value)
     return tensor
 
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    world = _live_world()
+    if world > 1:
+        import numpy as np
+
+        all_vals = _object_allgather(np.asarray(tensor._value))
+        if gather_list is not None and jax.process_index() == dst:
+            gather_list.extend(Tensor._from_value(jnp.asarray(v))
+                               for v in all_vals)
+        return gather_list
     if gather_list is not None:
         gather_list.append(tensor.clone())
     return gather_list

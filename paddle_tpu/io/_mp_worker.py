@@ -1,0 +1,67 @@
+"""DataLoader worker-process side. Deliberately jax-free: spawn startup
+must not pay a backend import for every worker (reference worker
+processes likewise never touch device state —
+python/paddle/io/dataloader/worker.py _worker_loop)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class WorkerInfo:
+    __slots__ = ("id", "num_workers", "seed", "dataset")
+
+    def __init__(self, wid, num_workers, dataset):
+        self.id = wid
+        self.num_workers = num_workers
+        self.seed = wid
+        self.dataset = dataset
+
+
+_worker_info = None  # set inside worker processes
+
+
+def numpy_collate(batch):
+    """Stack samples into host numpy batches (worker-side half of the
+    default collate; Tensors are handled by the parent-side wrapper in
+    dataloader.py to keep this module jax-free)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: numpy_collate([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(
+            numpy_collate(list(fields)) for fields in zip(*batch)
+        )
+    # fallback for framework Tensors (and anything array-like) without
+    # importing the Tensor type here
+    if hasattr(sample, "numpy"):
+        return np.stack([np.asarray(s.numpy()) for s in batch])
+    raise TypeError(f"cannot collate {type(sample)}")
+
+
+def worker_loop(dataset, worker_init_fn, worker_id, num_workers,
+                index_q, result_q):
+    """Pull (seq, idxs) jobs, push (seq, numpy batch, error)."""
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        job = index_q.get()
+        if job is None:
+            return
+        seq, idxs = job
+        try:
+            batch = numpy_collate([dataset[i] for i in idxs])
+            result_q.put((seq, batch, None))
+        except Exception:
+            import traceback
+
+            result_q.put((seq, None, traceback.format_exc()))

@@ -270,26 +270,9 @@ class DistributedBatchSampler(BatchSampler):
         return (self.num_samples + self.batch_size - 1) // self.batch_size
 
 
-def _numpy_collate(batch):
-    """Stack samples into host numpy batches (worker-side half of collate)."""
-    sample = batch[0]
-    if isinstance(sample, Tensor):
-        return np.stack([np.asarray(s._value) for s in batch])
-    if isinstance(sample, np.ndarray):
-        return np.stack(batch)
-    if isinstance(sample, (int, np.integer)):
-        return np.asarray(batch, np.int64)
-    if isinstance(sample, (float, np.floating)):
-        return np.asarray(batch, np.float32)
-    if isinstance(sample, (str, bytes)):
-        return list(batch)
-    if isinstance(sample, dict):
-        return {k: _numpy_collate([d[k] for d in batch]) for k in sample}
-    if isinstance(sample, (list, tuple)):
-        return type(sample)(
-            _numpy_collate(list(fields)) for fields in zip(*batch)
-        )
-    raise TypeError(f"cannot collate {type(sample)}")
+# single collate ladder, shared with worker processes (the jax-free
+# module handles Tensors through its `.numpy()` duck-typed fallback)
+from ._mp_worker import numpy_collate as _numpy_collate  # noqa: E402
 
 
 def _tensorize(obj):
@@ -348,6 +331,10 @@ class _PrefetchIter:
         import pickle
 
         def work():
+            # thread fallback still honors per-worker init (single worker
+            # thread -> id 0)
+            if getattr(self.loader, "worker_init_fn", None) is not None:
+                self.loader.worker_init_fn(0)
             while True:
                 with self.lock:
                     try:
@@ -403,9 +390,140 @@ class _PrefetchIter:
             pass
 
 
+class _MultiprocessIter:
+    """Worker PROCESSES + in-order reassembly.
+
+    The reference runs worker processes (io/dataloader/dataloader_iter.py
+    _DataLoaderIterMultiProcess); thread workers are GIL-bound for
+    Python-heavy __getitem__. Jobs are sequence-numbered and results
+    reordered in the parent, so batch order is identical to the
+    single-process loader regardless of worker scheduling."""
+
+    def __init__(self, loader, index_iter):
+        import multiprocessing as mp
+        import warnings
+
+        self.loader = loader
+        self.index_iter = index_iter
+        n = max(1, loader.num_workers)
+        # fork (the reference's Linux default) inherits the dataset for
+        # free and starts instantly; the child runs ONLY numpy code
+        # (_mp_worker), never jax, so forking an initialized parent is
+        # safe. spawn is the fallback on fork-less platforms.
+        method = "fork" if "fork" in mp.get_all_start_methods() else "spawn"
+        ctx = mp.get_context(method)
+        self.index_q = ctx.Queue()
+        self.result_q = ctx.Queue()
+        from ._mp_worker import worker_loop
+
+        self.procs = []
+        with warnings.catch_warnings():
+            # jax (RuntimeWarning) and CPython 3.12 (DeprecationWarning)
+            # warn about os.fork() in multithreaded processes; the workers
+            # never call into jax or touch parent threads
+            warnings.simplefilter("ignore", RuntimeWarning)
+            warnings.simplefilter("ignore", DeprecationWarning)
+            for wid in range(n):
+                p = ctx.Process(
+                    target=worker_loop,
+                    args=(loader.dataset, loader.worker_init_fn, wid, n,
+                          self.index_q, self.result_q),
+                    daemon=True)
+                p.start()
+                self.procs.append(p)
+        self._next_seq = 0      # next batch to hand out
+        self._sent = 0          # jobs dispatched
+        self._exhausted = False
+        self._pending = {}      # seq -> batch (out-of-order arrivals)
+        self._max_inflight = n * max(2, loader.prefetch_factor)
+        self._fill()
+
+    def _fill(self):
+        while (not self._exhausted
+               and self._sent - self._next_seq < self._max_inflight):
+            try:
+                idxs = next(self.index_iter)
+            except StopIteration:
+                self._exhausted = True
+                break
+            self.index_q.put((self._sent, list(idxs)))
+            self._sent += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import queue as _q
+
+        if self._next_seq >= self._sent and self._exhausted:
+            self._shutdown()
+            raise StopIteration
+        while self._next_seq not in self._pending:
+            try:
+                seq, batch, err = self.result_q.get(timeout=5.0)
+            except _q.Empty:
+                # a worker killed by the OS (OOM, segfault in native code)
+                # posts nothing: surface a diagnosis instead of hanging
+                dead = [p.pid for p in self.procs if not p.is_alive()]
+                if dead:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} exited abnormally "
+                        "(killed?) without reporting a result")
+                continue
+            if err is not None:
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker failed:\n{err}")
+            self._pending[seq] = batch
+        batch = self._pending.pop(self._next_seq)
+        self._next_seq += 1
+        self._fill()
+        return _tensorize(batch)
+
+    def _shutdown(self):
+        for _ in self.procs:
+            try:
+                self.index_q.put(None)
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        self.procs = []
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
+def _mp_usable(loader) -> bool:
+    """Process workers need the default (numpy) collate, and — on
+    platforms without fork — a picklable dataset; otherwise fall back to
+    the thread prefetcher."""
+    if loader.collate_fn is not None:
+        return False
+    import multiprocessing as mp
+
+    if "fork" in mp.get_all_start_methods():
+        return True  # dataset is inherited, no pickling involved
+    import pickle
+
+    try:
+        pickle.dumps((loader.dataset, loader.worker_init_fn))
+        return True
+    except Exception:
+        return False
+
+
 class DataLoader:
     """Reference: python/paddle/io/DataLoader (places/return_list args kept
-    for compatibility; on TPU there is one process per host, not per chip)."""
+    for compatibility; on TPU there is one process per host, not per chip).
+    num_workers > 0 spawns worker PROCESSES (numpy collate in workers,
+    in-order reassembly in the parent); unpicklable datasets or custom
+    collate_fns fall back to the thread prefetcher."""
 
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
@@ -417,6 +535,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -446,6 +565,8 @@ class DataLoader:
 
             return gen()
         if self.num_workers and self.num_workers > 0:
+            if _mp_usable(self):
+                return _MultiprocessIter(self, iter(self.batch_sampler))
             return _PrefetchIter(self, iter(self.batch_sampler))
 
         def gen():
@@ -471,4 +592,8 @@ class DataLoader:
 
 
 def get_worker_info():
-    return None
+    """Inside a worker process: (id, num_workers, dataset); else None
+    (reference io/dataloader/worker.py get_worker_info)."""
+    from ._mp_worker import _worker_info
+
+    return _worker_info

@@ -51,6 +51,31 @@ def main():
     np.testing.assert_allclose(
         np.concatenate([o.numpy() for o in outs]), [0.0, 7.0])
 
+    # all_gather_object / broadcast_object_list (pickled payloads)
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "tag": "x" * (rank + 1)})
+    assert [o["rank"] for o in objs] == [0, 1], objs
+    blist = [f"from-{rank}"]
+    dist.broadcast_object_list(blist, src=0)
+    assert blist == ["from-0"], blist
+
+    # all_to_all: out[j] on rank r = rank j's in[r]
+    ins = [paddle.to_tensor(np.array([10 * rank + j], dtype="float32"))
+           for j in range(2)]
+    outs2 = []
+    dist.all_to_all(outs2, ins)
+    np.testing.assert_allclose(
+        np.concatenate([o.numpy() for o in outs2]),
+        [rank + 0.0, rank + 10.0])
+
+    # reduce_scatter: sum then keep this rank's chunk
+    dst = paddle.to_tensor(np.zeros((1,), dtype="float32"))
+    dist.reduce_scatter(dst, [
+        paddle.to_tensor(np.array([1.0 + rank], dtype="float32")),
+        paddle.to_tensor(np.array([5.0 + rank], dtype="float32"))])
+    np.testing.assert_allclose(dst.numpy(),
+                               [3.0] if rank == 0 else [11.0])
+
     dist.barrier()
     print(f"WORKER {rank} COLLECTIVES OK", flush=True)
 

@@ -22,16 +22,34 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "launch_collective_worker.py")
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _free_port_block(span=4):
+    """A base port with `span` consecutive free ports: the launcher uses
+    port (launcher store), +2 (trainer store) and +3 (jax coordinator)."""
+    for _ in range(64):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+        s.close()
+        if base + span >= 65535:
+            continue
+        ok = True
+        for off in range(1, span):
+            t = socket.socket()
+            try:
+                t.bind(("127.0.0.1", base + off))
+            except OSError:
+                ok = False
+            finally:
+                t.close()
+            if not ok:
+                break
+        if ok:
+            return base
+    raise RuntimeError("no free port block found")
 
 
 def test_two_process_collectives_through_launcher(tmp_path):
-    port = _free_port()
+    port = _free_port_block()
     procs = []
     for rank in range(2):
         env = dict(os.environ)
