@@ -35,6 +35,115 @@ class Engine:
         self._strategy = strategy
         self._dist_model: Optional[DistModel] = None
         self._mesh: Optional[ProcessMesh] = None
+        self._plan = None
+
+    # -- cost-model-driven planning (reference: static/engine.py:71
+    # prepare → completion.py + planner_v2.py + partitioner.py; here the
+    # auto_tuner's analytic HBM + roofline model picks the distribution
+    # and GSPMD applies it) ---------------------------------------------
+    def _model_shard_plan_fn(self):
+        """Model-family shard-plan registry (the partitioner analog)."""
+        from ...models import (
+            bert_shard_plan, ernie_moe_shard_plan, gpt_shard_plan,
+            llama_shard_plan,
+        )
+
+        return {
+            "LlamaForCausalLM": llama_shard_plan,
+            "LlamaModel": llama_shard_plan,
+            "GPTForCausalLM": gpt_shard_plan,
+            "GPTModel": gpt_shard_plan,
+            "BertModel": bert_shard_plan,
+            "BertForPretraining": bert_shard_plan,
+            "ErnieMoeForCausalLM": ernie_moe_shard_plan,
+        }.get(type(self._model).__name__)
+
+    def prepare(self, inputs_spec=None, labels_spec=None, main_program=None,
+                startup_program=None, mode="train", init_parameters=True,
+                global_batch_size=None, sequence_length=None):
+        """Pick and apply a parallel plan automatically.
+
+        Reference: auto_parallel/static/engine.py Engine.prepare, which
+        runs completion → planner → partitioner → reshard. TPU mapping:
+        the auto_tuner's analytic memory + roofline cost model
+        (distributed.auto_tuner) searches (dp, mp, sharding stage,
+        micro-batch) for the visible device count; the winner is applied
+        as GSPMD layouts via the model family's shard plan plus
+        shard_optimizer for the sharding stage. Hand-sharded models are
+        left untouched (manual annotations win, like the reference's
+        semi-auto mode). Returns the chosen Candidate (or None when the
+        model was already sharded)."""
+        import jax
+
+        for p in self._model.parameters():
+            if p._dist_attr is not None:
+                self._mesh = p._dist_attr[0]
+                self._plan = None
+                return None
+
+        from ..auto_tuner import Tuner, TuneSpace
+
+        n = len(jax.devices())
+        cfg = getattr(self._model, "config", None)
+        plan_fn = self._model_shard_plan_fn()
+
+        def _cfg(name, default):
+            return int(getattr(cfg, name, default) or default)
+
+        hidden = _cfg("hidden_size", 1024)
+        heads = _cfg("num_attention_heads", 8)
+        kv_heads = _cfg("num_key_value_heads", heads)
+        vocab = _cfg("vocab_size", 32000)
+        gbs = int(global_batch_size or max(n, 8))
+        # mp degrees must divide the contracted dims; without a registered
+        # shard plan only data parallelism can be applied
+        mp_degrees = [1]
+        if plan_fn is not None:
+            mp_degrees = [d for d in (1, 2, 4, 8, 16)
+                          if d <= n and hidden % d == 0 and vocab % d == 0
+                          and heads % d == 0 and kv_heads % d == 0]
+        space = TuneSpace(
+            num_layers=_cfg("num_hidden_layers", 12),
+            hidden_size=hidden,
+            intermediate_size=_cfg("intermediate_size", 4 * hidden),
+            vocab_size=vocab,
+            seq_length=int(sequence_length
+                           or _cfg("max_position_embeddings", 2048)),
+            global_batch_size=gbs,
+            num_devices=n,
+            mp_degree=mp_degrees,
+            pp_degree=[1],  # compiled pipeline schedules are opted into
+                            # explicitly (fleet.pipeline_spmd), not auto
+            micro_batch_size=[m for m in (1, 2, 4, 8) if gbs % m == 0],
+            use_recompute=[False],
+        )
+        ranked = Tuner(space).search(top_k=1)
+        if not ranked:
+            # nothing survived pruning (e.g. odd device counts): plain DP
+            self._ensure_mesh()
+            self._plan = None
+            return None
+        best = ranked[0]
+
+        mesh = ProcessMesh(
+            np.arange(n).reshape(best.dp, best.mp), ["dp", "mp"])
+        self._mesh = mesh
+        if best.mp > 1 and plan_fn is not None:
+            plan_fn(self._model, mesh)
+        else:
+            for p in self._model.parameters():
+                shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
+        if best.sharding_stage > 0 and self._optimizer is not None:
+            from .api import (
+                ShardingStage1, ShardingStage2, ShardingStage3,
+                shard_optimizer,
+            )
+
+            stage_cls = {1: ShardingStage1, 2: ShardingStage2,
+                         3: ShardingStage3}[best.sharding_stage]
+            self._optimizer = shard_optimizer(self._optimizer, stage_cls())
+        self._plan = best
+        return best
 
     # -- layout completion (reference: completion.py, vastly simplified:
     # default layout = DP over all devices; hand annotations win) --------

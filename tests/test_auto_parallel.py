@@ -315,3 +315,106 @@ class TestEngine:
         assert np.isfinite(result["loss"])
         outs = engine.predict(loader)
         assert len(outs) == 4
+
+
+class TestEnginePrepareAutoPlan:
+    """Engine.prepare wires the auto_tuner cost model into plan selection
+    (reference static/engine.py prepare -> planner_v2 -> partitioner)."""
+
+    def _data(self, cfg, batch=8, seq=16):
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64")
+        return ids, np.roll(ids, -1, axis=1)
+
+    def test_auto_plan_llama_tiny(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=16,
+                               intermediate_size=32, num_hidden_layers=2,
+                               num_attention_heads=8, num_key_value_heads=8)
+        model = LlamaForCausalLM(cfg)
+        optimizer = opt.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        engine = Engine(model, optimizer=optimizer)
+        plan = engine.prepare(mode="train", global_batch_size=8,
+                              sequence_length=16)
+        assert plan is not None
+        assert plan.dp * plan.mp == 8  # full 8-device virtual mesh
+        # the plan was APPLIED: every parameter carries a dist layout
+        assert all(p._dist_attr is not None for p in model.parameters())
+        if plan.mp > 1:
+            from paddle_tpu.distributed import Shard
+
+            sharded = [p for p in model.parameters()
+                       if any(isinstance(pl, Shard)
+                              for pl in p._dist_attr[1])]
+            assert sharded, "mp chosen but no parameter is sharded"
+
+    def test_auto_planned_step_matches_manual_plan(self):
+        from paddle_tpu.distributed.auto_parallel.dist_model import DistModel
+        from paddle_tpu.models import (
+            LlamaConfig, LlamaForCausalLM, llama_shard_plan,
+        )
+
+        cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=16,
+                               intermediate_size=32, num_hidden_layers=2,
+                               num_attention_heads=8, num_key_value_heads=8)
+        ids_np, labels_np = self._data(cfg)
+
+        def _lm_loss(logits, labels):
+            import paddle_tpu.nn.functional as F
+
+            return F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]),
+                labels.reshape([-1]))
+
+        def loss_of(auto):
+            paddle.seed(7)
+            model = LlamaForCausalLM(cfg)
+            optimizer = opt.SGD(learning_rate=0.1,
+                                parameters=model.parameters())
+            if auto:
+                engine = Engine(model, optimizer=optimizer)
+                plan = engine.prepare(mode="train", global_batch_size=8,
+                                      sequence_length=16)
+                assert plan is not None
+                mesh = engine._mesh
+            else:
+                mesh = dist.ProcessMesh(
+                    np.arange(8).reshape(2, 4), ["dp", "mp"])
+                llama_shard_plan(model, mesh)
+            dm = DistModel(model, loss=_lm_loss,
+                           optimizer=optimizer).train()
+            ids = dist.shard_tensor(
+                ids_np, mesh, [dist.Shard(0)] + [dist.Replicate()]
+                * (mesh.ndim - 1))
+            labels = dist.shard_tensor(
+                labels_np, mesh, [dist.Shard(0)] + [dist.Replicate()]
+                * (mesh.ndim - 1))
+            losses = []
+            for _ in range(2):
+                losses.append(float(dm(ids, labels)))
+            return losses
+
+        auto_losses = loss_of(auto=True)
+        manual_losses = loss_of(auto=False)
+        np.testing.assert_allclose(auto_losses, manual_losses, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_manual_annotations_win(self):
+        from paddle_tpu.models import (
+            LlamaConfig, LlamaForCausalLM, llama_shard_plan,
+        )
+
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=16,
+                               intermediate_size=32, num_hidden_layers=2,
+                               num_attention_heads=8, num_key_value_heads=8)
+        model = LlamaForCausalLM(cfg)
+        mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2), ["dp", "mp"])
+        llama_shard_plan(model, mesh)
+        engine = Engine(model)
+        plan = engine.prepare(mode="train")
+        assert plan is None  # hand-sharded model left untouched
+        assert engine._mesh is mesh or engine._mesh.shape == mesh.shape
