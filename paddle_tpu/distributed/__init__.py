@@ -27,6 +27,7 @@ from .auto_parallel.api import (
 )
 from .parallel_wrapper import DataParallel
 from . import fleet
+from . import fleet_executor
 from . import utils
 from . import auto_parallel
 from . import checkpoint

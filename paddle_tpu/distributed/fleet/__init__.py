@@ -18,6 +18,8 @@ from .mp_layers import (
     VocabParallelEmbedding,
 )
 from . import context_parallel, sequence_parallel
+from . import data_generator
+from .data_generator import DataGenerator, MultiSlotDataGenerator
 from .context_parallel import ring_attention, ulysses_attention
 from .sequence_parallel import (
     ColumnSequenceParallelLinear, RowSequenceParallelLinear, ScatterOp,

@@ -1,0 +1,90 @@
+"""Slot-based data generators for the data-feed pipeline.
+
+Reference: python/paddle/distributed/fleet/data_generator/
+data_generator.py — DataGenerator (user overrides generate_sample;
+run_from_stdin/run_from_memory drive it) and MultiSlotDataGenerator
+(_gen_str at :233 serializes [(name, [values...]), ...] into the
+MultiSlot text protocol: per slot "<len> <v...>", space-joined).
+"""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        """User override: return a callable yielding
+        [(slot_name, [values...]), ...] samples for one input line."""
+        raise NotImplementedError(
+            "generate_sample must be implemented by the user")
+
+    def generate_batch(self, samples):
+        """Optional user override for batch-level processing."""
+
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+    def run_from_stdin(self):
+        """Pipe mode: raw lines on stdin -> protocol lines on stdout
+        (the reference's pipe_command contract)."""
+        for line in sys.stdin:
+            for user_parsed_line in self.generate_sample(line)():
+                if user_parsed_line is None:
+                    continue
+                sys.stdout.write(self._gen_str(user_parsed_line))
+
+    def run_from_memory(self):
+        """Generate from generate_sample(None); returns protocol lines."""
+        out = []
+        for user_parsed_line in self.generate_sample(None)():
+            if user_parsed_line is None:
+                continue
+            out.append(self._gen_str(user_parsed_line))
+        return out
+
+    def run_from_files(self, filelist):
+        out = []
+        for path in filelist:
+            with open(path) as f:
+                for line in f:
+                    for parsed in self.generate_sample(line)():
+                        if parsed is None:
+                            continue
+                        out.append(self._gen_str(parsed))
+        return out
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        if isinstance(line, zip):
+            line = list(line)
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of generate_sample() must be a list/tuple of "
+                "(name, [values...]) pairs")
+        parts = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    pass

@@ -1,0 +1,370 @@
+"""Actor-style fleet executor: TaskNode / Interceptor / Carrier / MessageBus.
+
+Reference: paddle/fluid/distributed/fleet_executor/ — FleetExecutor
+(fleet_executor.h:36), Carrier (carrier.h:50), Interceptor message loop
+(interceptor.h:51) with compute/source/sink/cond variants, brpc
+MessageBus (message_bus.h), credit-based flow control in
+compute_interceptor.cc, message protocol interceptor_message.proto
+(DATA_IS_READY / DATA_IS_USELESS / START / STOP).
+
+TPU re-design: the DATA plane of pipeline parallelism is the compiled
+schedule (fleet/pipeline_spmd.py — ppermute inside one XLA program).
+This module is the CONTROL plane the reference runs through brpc actors:
+per-host orchestration of multi-program stages (e.g. separately compiled
+stage executables on different hosts, inference micro-batch streaming),
+where each task's `run_fn` is an opaque callable (typically a jitted
+step). Interceptors are thread actors with mailboxes; in-process routing
+is queue-to-queue, cross-rank routing rides the framed-pickle RPC agent
+(distributed/rpc.py) instead of brpc.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "TaskNode", "InterceptorMessage", "Interceptor", "ComputeInterceptor",
+    "SourceInterceptor", "SinkInterceptor", "CondInterceptor", "Carrier",
+    "MessageBus", "FleetExecutor",
+]
+
+# message types (interceptor_message.proto:20)
+STOP = "STOP"
+DATA_IS_READY = "DATA_IS_READY"
+DATA_IS_USELESS = "DATA_IS_USELESS"
+START = "START"
+DONE = "DONE"
+
+
+@dataclass
+class InterceptorMessage:
+    src_id: int
+    dst_id: int
+    message_type: str
+    scope_idx: int = 0          # micro-batch index
+
+
+@dataclass
+class TaskNode:
+    """One pipeline task (reference task_node.h:36): identity, placement
+    rank, micro-batch count, wiring with per-edge buffer sizes."""
+
+    task_id: int
+    rank: int = 0
+    max_run_times: int = 1      # number of micro-batches
+    role: str = "compute"       # compute | source | sink | cond
+    run_fn: Optional[Callable[[int], object]] = None
+    cond_fn: Optional[Callable[[int], bool]] = None
+    upstreams: List[Tuple[int, int]] = field(default_factory=list)
+    downstreams: List[Tuple[int, int]] = field(default_factory=list)
+
+    def add_upstream_task(self, task_id: int, buff_size: int = 2):
+        self.upstreams.append((task_id, buff_size))
+
+    def add_downstream_task(self, task_id: int, buff_size: int = 2):
+        self.downstreams.append((task_id, buff_size))
+
+
+class Interceptor:
+    """Mailbox actor (interceptor.h:51): one thread drains the queue and
+    dispatches to the registered handler."""
+
+    def __init__(self, interceptor_id: int, node: TaskNode):
+        self.interceptor_id = interceptor_id
+        self.node = node
+        self.carrier: Optional["Carrier"] = None
+        self._mailbox: "queue.Queue[InterceptorMessage]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._handle: Callable[[InterceptorMessage], None] = lambda m: None
+
+    def register_msg_handle(self, handle):
+        self._handle = handle
+
+    def start(self):
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def enqueue(self, msg: InterceptorMessage):
+        self._mailbox.put(msg)
+
+    def send(self, dst_id: int, message_type: str, scope_idx: int = 0):
+        self.carrier.route(InterceptorMessage(
+            self.interceptor_id, dst_id, message_type, scope_idx))
+
+    def _loop(self):
+        while self._running:
+            msg = self._mailbox.get()
+            if msg.message_type == STOP:
+                self._running = False
+                self._handle(msg)
+                return
+            self._handle(msg)
+
+    def join(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+
+class ComputeInterceptor(Interceptor):
+    """Credit-based compute actor (compute_interceptor.cc semantics):
+    runs once per micro-batch when every upstream has data ready AND
+    every downstream has buffer credit; returns DATA_IS_USELESS credits
+    upstream and emits DATA_IS_READY downstream."""
+
+    def __init__(self, interceptor_id, node):
+        super().__init__(interceptor_id, node)
+        self._ready: Dict[int, int] = {u: 0 for u, _ in node.upstreams}
+        self._credit: Dict[int, int] = {d: b for d, b in node.downstreams}
+        self._step = 0
+        self.register_msg_handle(self._on_msg)
+
+    def _on_msg(self, msg):
+        if msg.message_type == DATA_IS_READY:
+            self._ready[msg.src_id] = self._ready.get(msg.src_id, 0) + 1
+        elif msg.message_type == DATA_IS_USELESS:
+            self._credit[msg.src_id] = self._credit.get(msg.src_id, 0) + 1
+        elif msg.message_type == STOP:
+            return
+        self._try_run()
+
+    def _can_run(self) -> bool:
+        if self._step >= self.node.max_run_times:
+            return False
+        if any(v <= 0 for v in self._ready.values()):
+            return False
+        if any(v <= 0 for v in self._credit.values()):
+            return False
+        return True
+
+    def _try_run(self):
+        while self._can_run():
+            mb = self._step
+            if self.node.run_fn is not None:
+                self.node.run_fn(mb)
+            self._step += 1
+            for u in self._ready:
+                self._ready[u] -= 1
+                self.send(u, DATA_IS_USELESS, mb)
+            for d in self._credit:
+                self._credit[d] -= 1
+                self.send(d, DATA_IS_READY, mb)
+
+
+class SourceInterceptor(Interceptor):
+    """Feeds max_run_times micro-batches downstream, throttled by buffer
+    credits (source_interceptor.cc)."""
+
+    def __init__(self, interceptor_id, node):
+        super().__init__(interceptor_id, node)
+        self._credit: Dict[int, int] = {d: b for d, b in node.downstreams}
+        self._emitted = 0
+        self.register_msg_handle(self._on_msg)
+
+    def _on_msg(self, msg):
+        if msg.message_type == DATA_IS_USELESS:
+            self._credit[msg.src_id] = self._credit.get(msg.src_id, 0) + 1
+        elif msg.message_type not in (START,):
+            return
+        while (self._emitted < self.node.max_run_times
+               and all(v > 0 for v in self._credit.values())):
+            mb = self._emitted
+            if self.node.run_fn is not None:
+                self.node.run_fn(mb)
+            self._emitted += 1
+            for d in self._credit:
+                self._credit[d] -= 1
+                self.send(d, DATA_IS_READY, mb)
+
+
+class SinkInterceptor(Interceptor):
+    """Consumes max_run_times micro-batches then reports DONE to the
+    carrier (sink_interceptor.cc)."""
+
+    def __init__(self, interceptor_id, node):
+        super().__init__(interceptor_id, node)
+        self._seen = 0
+        self.register_msg_handle(self._on_msg)
+
+    def _on_msg(self, msg):
+        if msg.message_type != DATA_IS_READY:
+            return
+        if self.node.run_fn is not None:
+            self.node.run_fn(msg.scope_idx)
+        self._seen += 1
+        self.send(msg.src_id, DATA_IS_USELESS, msg.scope_idx)
+        if self._seen >= self.node.max_run_times:
+            self.carrier.notify_done(self.interceptor_id)
+
+
+class CondInterceptor(Interceptor):
+    """While-loop router (cond_interceptor.cc): on each incoming ready,
+    evaluates cond_fn(iteration); True routes to downstream[0] (loop
+    body), False to downstream[1] (exit)."""
+
+    def __init__(self, interceptor_id, node):
+        super().__init__(interceptor_id, node)
+        if len(node.downstreams) != 2:
+            raise ValueError("CondInterceptor needs [body, exit] downstreams")
+        self._iter = 0
+        self.register_msg_handle(self._on_msg)
+
+    def _on_msg(self, msg):
+        if msg.message_type not in (DATA_IS_READY, START):
+            return
+        if msg.message_type == DATA_IS_READY:
+            self.send(msg.src_id, DATA_IS_USELESS, msg.scope_idx)
+        body, exit_ = self.node.downstreams[0][0], self.node.downstreams[1][0]
+        take_body = bool(self.node.cond_fn(self._iter)) \
+            if self.node.cond_fn else False
+        self.send(body if take_body else exit_, DATA_IS_READY, self._iter)
+        self._iter += 1
+
+
+_INTERCEPTOR_TYPES = {
+    "compute": ComputeInterceptor,
+    "source": SourceInterceptor,
+    "sink": SinkInterceptor,
+    "cond": CondInterceptor,
+}
+
+
+class MessageBus:
+    """Cross-rank control transport (message_bus.h). In-process ranks
+    register their carriers directly; remote ranks are reached through
+    the RPC agent (worker name "fleet_exec_<rank>")."""
+
+    def __init__(self):
+        self._local: Dict[int, "Carrier"] = {}
+
+    def register(self, rank: int, carrier: "Carrier"):
+        self._local[rank] = carrier
+
+    def send(self, rank: int, msg: InterceptorMessage):
+        if rank in self._local:
+            self._local[rank].deliver(msg)
+            return
+        from . import rpc
+
+        rpc.rpc_sync(f"fleet_exec_{rank}", _deliver_remote,
+                     args=(msg.src_id, msg.dst_id, msg.message_type,
+                           msg.scope_idx))
+
+
+_CURRENT_CARRIERS: Dict[int, "Carrier"] = {}
+
+
+def _deliver_remote(src_id, dst_id, message_type, scope_idx):
+    """RPC endpoint: hand a message to this process's carrier."""
+    for carrier in _CURRENT_CARRIERS.values():
+        if dst_id in carrier.interceptors:
+            carrier.deliver(InterceptorMessage(src_id, dst_id, message_type,
+                                               scope_idx))
+            return True
+    return False
+
+
+class Carrier:
+    """Owns this rank's interceptors and routes messages (carrier.h:50)."""
+
+    def __init__(self, carrier_id: str, rank: int, bus: MessageBus,
+                 task_id_to_rank: Dict[int, int]):
+        self.carrier_id = carrier_id
+        self.rank = rank
+        self.bus = bus
+        self.task_id_to_rank = task_id_to_rank
+        self.interceptors: Dict[int, Interceptor] = {}
+        self._done = threading.Event()
+        self._expected_sinks = 0
+        self._done_sinks: set = set()
+        bus.register(rank, self)
+        _CURRENT_CARRIERS[rank] = self
+
+    def add_interceptor(self, node: TaskNode) -> Interceptor:
+        cls = _INTERCEPTOR_TYPES.get(node.role)
+        if cls is None:
+            raise ValueError(f"unknown interceptor role: {node.role!r}")
+        itc = cls(node.task_id, node)
+        itc.carrier = self
+        self.interceptors[node.task_id] = itc
+        if node.role == "sink":
+            self._expected_sinks += 1
+        return itc
+
+    def start(self):
+        for itc in self.interceptors.values():
+            itc.start()
+
+    def route(self, msg: InterceptorMessage):
+        rank = self.task_id_to_rank.get(msg.dst_id, self.rank)
+        if rank == self.rank:
+            self.deliver(msg)
+        else:
+            self.bus.send(rank, msg)
+
+    def deliver(self, msg: InterceptorMessage):
+        itc = self.interceptors.get(msg.dst_id)
+        if itc is None:
+            raise KeyError(
+                f"carrier {self.carrier_id} has no interceptor "
+                f"{msg.dst_id}")
+        itc.enqueue(msg)
+
+    def notify_done(self, sink_id: int):
+        self._done_sinks.add(sink_id)
+        if len(self._done_sinks) >= self._expected_sinks:
+            self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if self._expected_sinks == 0:
+            # sink lives on another rank's carrier; that carrier's wait()
+            # is the job's completion signal
+            return True
+        return self._done.wait(timeout)
+
+    def stop(self):
+        for itc in self.interceptors.values():
+            itc.enqueue(InterceptorMessage(-1, itc.interceptor_id, STOP))
+        for itc in self.interceptors.values():
+            itc.join(timeout=5)
+
+
+class FleetExecutor:
+    """Top-level runtime (fleet_executor.h:36): build carriers from task
+    nodes, start the source(s), wait for the sink(s)."""
+
+    def __init__(self, bus: Optional[MessageBus] = None):
+        self.bus = bus or MessageBus()
+        self.carriers: Dict[str, Carrier] = {}
+
+    def init(self, carrier_id: str, task_nodes: List[TaskNode],
+             task_id_to_rank: Optional[Dict[int, int]] = None,
+             rank: int = 0, num_micro_batches: Optional[int] = None):
+        task_id_to_rank = task_id_to_rank or {
+            t.task_id: t.rank for t in task_nodes}
+        carrier = Carrier(carrier_id, rank, self.bus, task_id_to_rank)
+        for t in task_nodes:
+            if num_micro_batches is not None and t.role != "cond":
+                t.max_run_times = num_micro_batches
+            if t.rank == rank:
+                carrier.add_interceptor(t)
+        self.carriers[carrier_id] = carrier
+        return carrier
+
+    def run(self, carrier_id: str, timeout: Optional[float] = 60.0) -> bool:
+        carrier = self.carriers[carrier_id]
+        carrier.start()
+        for itc in carrier.interceptors.values():
+            if itc.node.role == "source":
+                carrier.deliver(InterceptorMessage(
+                    -1, itc.interceptor_id, START))
+        ok = carrier.wait(timeout)
+        carrier.stop()
+        if not ok:
+            raise TimeoutError(
+                f"fleet executor carrier {carrier_id!r} did not finish "
+                f"within {timeout}s")
+        return ok

@@ -22,6 +22,7 @@ class _DatasetBase:
         self._thread_num = 1
         self._use_var = []
         self._pipe_command = None
+        self._data_feed = None
 
     def init(self, batch_size=1, thread_num=1, use_var=None,
              pipe_command=None, input_type=0, fs_name="", fs_ugi="",
@@ -30,6 +31,11 @@ class _DatasetBase:
         self._thread_num = thread_num
         self._use_var = use_var or []
         self._pipe_command = pipe_command
+        if use_var:
+            # names (or (name, dtype)) double as the slot schema
+            from .dataset import MultiSlotDataFeed  # self-import ok at runtime
+
+            self._data_feed = MultiSlotDataFeed(use_var)
         return self
 
     def set_filelist(self, filelist):
@@ -38,6 +44,12 @@ class _DatasetBase:
     def set_parse_func(self, fn):
         """TPU-build extension point standing in for pipe_command parsing."""
         self._parse = fn
+
+    def set_data_feed(self, feed):
+        """Attach a MultiSlotDataFeed (slot schema) for
+        Executor.train_from_dataset (reference: the C++ DataFeed bound at
+        dataset creation)."""
+        self._data_feed = feed
 
     def _iter_lines(self):
         for path in self._filelist:
@@ -82,3 +94,103 @@ class InMemoryDataset(_DatasetBase):
 
     def __iter__(self):
         return iter(self._samples)
+
+
+class MultiSlotDataFeed:
+    """Parse MultiSlot protocol lines into per-slot numpy batches.
+
+    Reference: framework/data_feed.cc MultiSlotDataFeed (text protocol:
+    per line, slots in declared order, each "<len> <v...>"). TPU-first
+    batching: fixed-width slots stack densely [B, L]; variable-length
+    slots become a padded [B, maxlen] tensor plus a "<name>.lens" length
+    vector — the packed/dense representation the sequence ops and
+    embedding kernels consume instead of LoD.
+    """
+
+    def __init__(self, slots, pad_value=0):
+        # slots: list of names, or (name, dtype) pairs
+        self.slots = [(s, "int64") if isinstance(s, str) else
+                      (s[0], s[1]) for s in slots]
+        self.pad_value = pad_value
+
+    def parse_line(self, line):
+        import numpy as np
+
+        toks = line.split()
+        out = []
+        i = 0
+        for name, dtype in self.slots:
+            if i >= len(toks):
+                raise ValueError(
+                    f"line ended before slot {name!r}: {line!r}")
+            n = int(toks[i])
+            vals = toks[i + 1: i + 1 + n]
+            if len(vals) != n:
+                raise ValueError(
+                    f"slot {name!r} declared {n} values, got {len(vals)}")
+            i += 1 + n
+            out.append(np.asarray(vals, dtype=np.dtype(dtype)))
+        if i != len(toks):
+            raise ValueError(
+                f"{len(toks) - i} trailing tokens after last slot: {line!r}")
+        return out
+
+    def collate(self, rows):
+        """rows: list of parse_line outputs -> feed dict of numpy."""
+        import numpy as np
+
+        feed = {}
+        for si, (name, dtype) in enumerate(self.slots):
+            vals = [r[si] for r in rows]
+            lens = np.asarray([len(v) for v in vals], np.int64)
+            if (lens == lens[0]).all():
+                feed[name] = np.stack(vals)
+            else:
+                width = int(lens.max())
+                pad = np.full((len(vals), width), self.pad_value,
+                              np.dtype(dtype))
+                for b, v in enumerate(vals):
+                    pad[b, : len(v)] = v
+                feed[name] = pad
+                feed[name + ".lens"] = lens
+        return feed
+
+
+def batch_iterator(dataset, feed: "MultiSlotDataFeed", batch_size=None,
+                   drop_last=False):
+    """Threaded feed pipeline: parse + collate protocol lines from a
+    Queue/InMemory dataset into feed dicts (the data_feed.cc reader loop;
+    a prefetch thread keeps parsing ahead of the consumer)."""
+    import queue as _q
+    import threading
+
+    bs = batch_size or dataset._batch_size
+    out_q: "_q.Queue" = _q.Queue(maxsize=4)
+    done = object()
+
+    def producer():
+        rows = []
+        try:
+            for line in dataset:
+                rows.append(feed.parse_line(line))
+                if len(rows) == bs:
+                    out_q.put(feed.collate(rows))
+                    rows = []
+            if rows and not drop_last:
+                out_q.put(feed.collate(rows))
+            out_q.put(done)
+        except Exception as e:  # surface parse errors to the consumer
+            out_q.put(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = out_q.get()
+        if item is done:
+            return
+        if isinstance(item, Exception):
+            raise item
+        yield item
+
+
+__all__ += ["MultiSlotDataFeed", "batch_iterator"]

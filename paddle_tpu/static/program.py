@@ -217,6 +217,56 @@ class Executor:
             return [np.asarray(o) for o in outs]
         return [Tensor._from_value(o) for o in outs]
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Drive a captured program from a slot dataset (reference:
+        base/executor.py:3222 — trainer threads consuming the C++
+        DataFeed; here the threaded batch_iterator feeds Executor.run).
+
+        dataset must carry a data feed: set one with
+        ``dataset.set_data_feed(MultiSlotDataFeed(slots))``; its slot
+        names must match the program's placeholder feed names."""
+        return self._run_from_dataset(program, dataset, fetch_list,
+                                      fetch_info, print_period,
+                                      fetch_handler, train=True)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        return self._run_from_dataset(program, dataset, fetch_list,
+                                      fetch_info, print_period,
+                                      fetch_handler, train=False)
+
+    def _run_from_dataset(self, program, dataset, fetch_list, fetch_info,
+                          print_period, fetch_handler, train):
+        from ..distributed.ps.dataset import batch_iterator
+
+        if dataset is None:
+            raise ValueError("dataset is required")
+        feed = getattr(dataset, "_data_feed", None)
+        if feed is None:
+            raise ValueError(
+                "dataset has no data feed: call "
+                "dataset.set_data_feed(MultiSlotDataFeed(slots)) first")
+        results = []
+        for step, batch in enumerate(batch_iterator(dataset, feed)):
+            outs = self.run(program, feed=batch, fetch_list=fetch_list)
+            if fetch_list:
+                results.append(outs)
+                if fetch_handler is not None:
+                    fetch_handler(step, outs)
+                if print_period and step % print_period == 0 and outs:
+                    names = fetch_info or [f"fetch{i}"
+                                           for i in range(len(outs))]
+                    summary = ", ".join(
+                        f"{n}={np.asarray(o).ravel()[:1]}"
+                        for n, o in zip(names, outs))
+                    print(f"step {step}: {summary}")
+        return results
+
     @staticmethod
     def _compile(program: Program, feed_names, fetch_vids):
         name_to_vid = program._feed_names
