@@ -1,0 +1,32 @@
+"""Pretrained-weight loading for the vision zoo.
+
+Reference: python/paddle/vision/models/*.py, which download checkpoint
+files via ``paddle.utils.download.get_weights_path_from_url``. This
+build has zero network egress, so the documented stance is an OFFLINE
+CACHE: ``pretrained=True`` loads ``<arch>.pdparams`` from the weights
+home (``$PADDLE_TPU_WEIGHTS_HOME`` or ``~/.cache/paddle_tpu/weights``)
+when present and raises an actionable error otherwise — drop the file
+in place (converted with ``paddle_tpu.save(model.state_dict(), path)``)
+and every ``<arch>(pretrained=True)`` constructor works.
+"""
+from __future__ import annotations
+
+import os.path as osp
+
+from ...utils.download import WEIGHTS_HOME
+
+
+def load_pretrained(model, arch: str):
+    """Load <arch>.pdparams from the offline weights cache into model."""
+    path = osp.join(WEIGHTS_HOME, f"{arch}.pdparams")
+    if not osp.exists(path):
+        raise NotImplementedError(
+            f"{arch}: pretrained weights are not bundled (zero-egress "
+            f"build). Place a state_dict at {path} — saved with "
+            "paddle_tpu.save(model.state_dict(), path) — and "
+            "pretrained=True will load it.")
+    from ...framework.io_ import load
+
+    state = load(path)
+    model.set_state_dict(state)
+    return model

@@ -36,6 +36,9 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
+    model = AlexNet(**kwargs)
     if pretrained:
-        raise NotImplementedError("alexnet: pretrained weights unavailable")
-    return AlexNet(**kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, "alexnet")
+    return model

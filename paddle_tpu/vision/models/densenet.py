@@ -88,9 +88,12 @@ class DenseNet(nn.Layer):
 
 
 def _densenet(arch, layers, pretrained, **kwargs):
+    model = DenseNet(layers=layers, **kwargs)
     if pretrained:
-        raise NotImplementedError(f"{arch}: pretrained weights unavailable")
-    return DenseNet(layers=layers, **kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, arch)
+    return model
 
 
 def densenet121(pretrained=False, **kwargs):

@@ -97,7 +97,9 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
+    model = GoogLeNet(**kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights are unavailable offline; "
-                           "load a local state_dict instead")
-    return GoogLeNet(**kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, "googlenet")
+    return model

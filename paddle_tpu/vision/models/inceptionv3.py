@@ -158,7 +158,9 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
+    model = InceptionV3(**kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights are unavailable offline; "
-                           "load a local state_dict instead")
-    return InceptionV3(**kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, "inception_v3")
+    return model

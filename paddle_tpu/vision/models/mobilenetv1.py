@@ -74,6 +74,9 @@ class MobileNetV1(nn.Layer):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV1(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError("mobilenet_v1: pretrained unavailable")
-    return MobileNetV1(scale=scale, **kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, "mobilenet_v1")
+    return model

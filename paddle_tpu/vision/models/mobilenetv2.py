@@ -92,6 +92,9 @@ class MobileNetV2(nn.Layer):
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV2(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError("mobilenet_v2: pretrained unavailable")
-    return MobileNetV2(scale=scale, **kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, "mobilenet_v2")
+    return model

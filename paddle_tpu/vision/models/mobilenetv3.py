@@ -115,12 +115,18 @@ class MobileNetV3Small(_MobileNetV3):
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV3Large(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError("mobilenet_v3_large: pretrained unavailable")
-    return MobileNetV3Large(scale=scale, **kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, "mobilenet_v3_large")
+    return model
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV3Small(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError("mobilenet_v3_small: pretrained unavailable")
-    return MobileNetV3Small(scale=scale, **kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, "mobilenet_v3_small")
+    return model

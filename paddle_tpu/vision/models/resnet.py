@@ -149,11 +149,12 @@ class ResNet(nn.Layer):
 
 
 def _resnet(arch, Block, depth, pretrained, **kwargs):
+    model = ResNet(Block, depth, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            f"{arch}: pretrained weights unavailable (no network); load a "
-            "state_dict with paddle.load + set_state_dict instead")
-    return ResNet(Block, depth, **kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, arch)
+    return model
 
 
 def resnet18(pretrained=False, **kwargs):

@@ -104,9 +104,12 @@ class ShuffleNetV2(nn.Layer):
 
 
 def _shufflenet(arch, scale, act, pretrained, **kwargs):
+    model = ShuffleNetV2(scale=scale, act=act, **kwargs)
     if pretrained:
-        raise NotImplementedError(f"{arch}: pretrained weights unavailable")
-    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, arch)
+    return model
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kwargs):

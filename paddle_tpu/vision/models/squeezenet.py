@@ -70,13 +70,18 @@ class SqueezeNet(nn.Layer):
         return x
 
 
-def squeezenet1_0(pretrained=False, **kwargs):
+def _squeezenet(arch, version, pretrained, **kwargs):
+    model = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise NotImplementedError("squeezenet1_0: pretrained unavailable")
-    return SqueezeNet("1.0", **kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, arch)
+    return model
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return _squeezenet("squeezenet1_0", "1.0", pretrained, **kwargs)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("squeezenet1_1: pretrained unavailable")
-    return SqueezeNet("1.1", **kwargs)
+    return _squeezenet("squeezenet1_1", "1.1", pretrained, **kwargs)

@@ -57,9 +57,12 @@ class VGG(nn.Layer):
 
 
 def _vgg(arch, cfg, batch_norm, pretrained, **kwargs):
+    model = VGG(make_layers(_cfgs[cfg], batch_norm=batch_norm), **kwargs)
     if pretrained:
-        raise NotImplementedError(f"{arch}: pretrained weights unavailable")
-    return VGG(make_layers(_cfgs[cfg], batch_norm=batch_norm), **kwargs)
+        from ._pretrained import load_pretrained
+
+        load_pretrained(model, arch)
+    return model
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):

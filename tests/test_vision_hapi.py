@@ -165,3 +165,32 @@ class TestHapiModel:
                   callbacks=[es])
         # with patience=0 and a noisy tiny set, training stops before 5 epochs
         assert model.stop_training or es.best_value is not None
+
+
+class TestPretrainedOfflineCache:
+    def test_loads_from_weights_home(self, tmp_path, monkeypatch):
+        """pretrained=True loads <arch>.pdparams from the offline cache."""
+        import paddle_tpu as paddle
+        import paddle_tpu.vision.models as M
+        from paddle_tpu.vision.models import _pretrained
+        import paddle_tpu.utils.download as DL
+
+        monkeypatch.setattr(DL, "WEIGHTS_HOME", str(tmp_path))
+        monkeypatch.setattr(_pretrained, "WEIGHTS_HOME", str(tmp_path))
+        paddle.seed(0)
+        donor = M.squeezenet1_1(num_classes=10)
+        paddle.save(donor.state_dict(), str(tmp_path / "squeezenet1_1.pdparams"))
+        paddle.seed(123)  # different init for the fresh model
+        model = M.squeezenet1_1(pretrained=True, num_classes=10)
+        for (n1, p1), (n2, p2) in zip(donor.named_parameters(),
+                                      model.named_parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy(),
+                                       err_msg=n1)
+
+    def test_missing_weights_actionable_error(self, tmp_path, monkeypatch):
+        import paddle_tpu.vision.models as M
+        from paddle_tpu.vision.models import _pretrained
+
+        monkeypatch.setattr(_pretrained, "WEIGHTS_HOME", str(tmp_path))
+        with pytest.raises(NotImplementedError, match="pdparams"):
+            M.resnet18(pretrained=True)
