@@ -129,10 +129,15 @@ class NaiveGate(BaseGate):
     def _train_factor(self):
         return self.capacity_factor
 
-    def forward(self, x):
-        """x: [N, d_model] → (combine [N,E,C], dispatch [N,E,C])."""
+    def _jitter(self, x):
+        return x
+
+    def route(self, x):
+        """x: [N, d_model] → (probs [N,E], capacity, rng key). Shared head
+        of both dispatch formulations; sets the aux loss."""
         from .....core import generator
 
+        x = self._jitter(x)
         logits = x.matmul(self.weight) + self.bias
         probs = F.softmax(logits, axis=-1)
         n = int(x.shape[0])
@@ -140,13 +145,18 @@ class NaiveGate(BaseGate):
         # trace-aware draw: under jit the key comes from the traced key
         # stream (generator.py next_key), not a baked-in constant
         key = generator.next_key()
+        if self._loss_kind is not None:
+            self.set_loss(self._balance_loss(probs))
+        return probs, cap, key
+
+    def forward(self, x):
+        """x: [N, d_model] → (combine [N,E,C], dispatch [N,E,C])."""
+        probs, cap, key = self.route(x)
         combine, dispatch = apply(
             "moe_dispatch_p", probs, Tensor._from_value(key),
             k=self.topk, capacity=cap, normalize=self._normalize,
             random2=self._random2 and self.training,
         )
-        if self._loss_kind is not None:
-            self.set_loss(self._balance_loss(probs))
         return combine, dispatch
 
     def _balance_loss(self, probs):
@@ -189,10 +199,10 @@ class SwitchGate(NaiveGate):
     def _train_factor(self):
         return self.capacity[0] if self.training else self.capacity[1]
 
-    def forward(self, x):
+    def _jitter(self, x):
         if self.training and self.switch_eps > 0:
             from .....ops import creation
 
             noise = creation.rand(x.shape, dtype=x.dtype)
             x = x * (noise * (2 * self.switch_eps) + (1.0 - self.switch_eps))
-        return super().forward(x)
+        return x
