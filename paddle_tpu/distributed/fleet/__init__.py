@@ -287,3 +287,117 @@ class UserDefinedRoleMaker(PaddleCloudRoleMaker):
         self._trainers_num = int(worker_num)
         if server_endpoints:
             self._server_endpoints = list(server_endpoints)
+
+
+class Fleet:
+    """Class form of the fleet API (reference: fleet/fleet.py Fleet).
+
+    The module-level functions are the canonical TPU surface; this class
+    forwards to them so code written against `fleet.Fleet()` (or the
+    reference's singleton `fleet.fleet`) ports unchanged."""
+
+    def init(self, role_maker=None, is_collective=False, strategy=None,
+             log_level="INFO"):
+        init(role_maker, is_collective, strategy, log_level)
+        return self
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def is_worker(self):
+        return is_worker()
+
+    def is_server(self):
+        return is_server()
+
+    def barrier_worker(self):
+        return barrier_worker()
+
+    def init_worker(self, *args, **kwargs):
+        return init_worker(*args, **kwargs)
+
+    def init_server(self, *args, **kwargs):
+        return init_server(*args, **kwargs)
+
+    def run_server(self, *args, **kwargs):
+        return run_server(*args, **kwargs)
+
+    def stop_worker(self, *args, **kwargs):
+        return stop_worker(*args, **kwargs)
+
+    def server_endpoints(self, to_string=False):
+        eps = server_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    @property
+    def util(self):
+        return UtilBase()
+
+
+class UtilBase:
+    """Reference: fleet/utils/fleet_util.py UtilBase — small cross-worker
+    utilities over the collective backend."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from .. import communication as C
+        from ...ops._helpers import ensure_tensor
+
+        t = ensure_tensor(np.asarray(input))
+        op = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
+              "min": C.ReduceOp.MIN}[mode]
+        C.all_reduce(t, op=op)
+        return t.numpy()
+
+    def barrier(self, comm_world="worker"):
+        barrier_worker()
+
+    def all_gather(self, input, comm_world="worker"):
+        import numpy as np
+
+        from .. import communication as C
+        from ...ops._helpers import ensure_tensor
+
+        outs = []
+        C.all_gather(outs, ensure_tensor(np.asarray(input)))
+        return [o.numpy() for o in outs]
+
+    def get_file_shard(self, files):
+        """Split a file list contiguously across workers
+        (fleet_util.py get_file_shard)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file paths")
+        n = worker_num()
+        i = worker_index()
+        per, rem = divmod(len(files), n)
+        start = per * i + min(i, rem)
+        return files[start: start + per + (1 if i < rem else 0)]
+
+    def print_on_rank(self, message, rank_id=0):
+        if worker_index() == rank_id:
+            print(message)
+
+
+# reference exposes a ready singleton `fleet.fleet`; Role enumerates PS
+# process roles (role_maker.Role)
+fleet = Fleet()
+from ..ps.role import Role  # noqa: E402,F401
+from .data_generator import (  # noqa: E402,F401
+    MultiSlotStringDataGenerator,
+)
+
+__all__ += ["Fleet", "UtilBase", "Role", "fleet",
+            "MultiSlotStringDataGenerator"]

@@ -128,3 +128,129 @@ def recompute(function, *args, **kwargs):
     if single:
         return wrapped[0]
     return tuple(wrapped)
+
+
+class LocalFS:
+    """Local filesystem client (reference: fleet/utils/fs.py LocalFS) —
+    the FS interface checkpoints/datasets use; HDFS is the remote twin."""
+
+    def ls_dir(self, fs_path):
+        import os
+
+        dirs, files = [], []
+        if not os.path.exists(fs_path):
+            return dirs, files
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        import os
+
+        os.makedirs(fs_path, exist_ok=True)
+
+    def is_dir(self, fs_path):
+        import os
+
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        import os
+
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path):
+        import os
+
+        return os.path.exists(fs_path)
+
+    def delete(self, fs_path):
+        import os
+        import shutil
+
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path, ignore_errors=True)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, src, dst):
+        import os
+
+        os.rename(src, dst)
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        import os
+
+        if test_exists and not os.path.exists(src):
+            raise FileNotFoundError(src)
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        os.rename(src, dst)
+
+    def upload(self, local_path, fs_path, multi_processes=1, overwrite=False):
+        import shutil
+
+        if overwrite:
+            self.delete(fs_path)
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path, multi_processes=1,
+                 overwrite=False):
+        self.upload(fs_path, local_path, multi_processes, overwrite)
+
+    def touch(self, fs_path, exist_ok=True):
+        import os
+
+        if os.path.exists(fs_path) and not exist_ok:
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def cat(self, fs_path):
+        with open(fs_path, "r") as f:
+            return f.read()
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """Reference: fleet/utils/fs.py HDFSClient — shells out to the hadoop
+    CLI. Zero-egress build: constructing the client works (so configs
+    parse), but any filesystem call raises with the offline rationale."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        self.hadoop_home = hadoop_home
+        self.configs = dict(configs or {})
+
+    def _unavailable(self, op):
+        raise NotImplementedError(
+            f"HDFSClient.{op}: no hadoop runtime/network in the TPU build; "
+            "use LocalFS or mount the data locally")
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda *a, **k: self._unavailable(name)
+
+
+class DistributedInfer:
+    """Reference: fleet/utils/__init__.py DistributedInfer — PS-mode
+    distributed inference helper. TPU build: inference is served through
+    paddle_tpu.inference predictors; this wrapper keeps the init/get
+    surface for porting."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self.main_program = main_program
+        self.startup_program = startup_program
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        return None
+
+    def get_dist_infer_program(self):
+        return self.main_program
+
+
+__all__ += ["LocalFS", "HDFSClient", "DistributedInfer"]

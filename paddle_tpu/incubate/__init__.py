@@ -18,6 +18,20 @@ from .optimizer import LookAhead, ModelAverage
 
 __all__ = [
     "distributed", "nn", "asp", "optimizer", "autograd", "operators",
-    "layers", "tensor", "multiprocessing", "LookAhead", "ModelAverage",
-    "set_config",
+    "layers", "tensor", "multiprocessing", "inference", "LookAhead",
+    "ModelAverage", "set_config", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "graph_send_recv",
+    "graph_khop_sampler", "graph_sample_neighbors", "graph_reindex",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "identity_loss",
 ]
+
+from . import inference  # noqa: E402,F401
+from ._graph_compat import (  # noqa: E402,F401
+    graph_khop_sampler, graph_reindex, graph_sample_neighbors,
+    graph_send_recv, identity_loss, segment_max, segment_mean, segment_min,
+    segment_sum,
+)
+from .operators import (  # noqa: E402,F401
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+)

@@ -1,0 +1,135 @@
+"""Legacy incubate graph/segment/fused-op aliases.
+
+Reference: python/paddle/incubate/__init__.py re-exports
+(graph_send_recv, graph_khop_sampler, graph_sample_neighbors,
+graph_reindex from incubate/operators/graph_*.py; segment_* from
+incubate/tensor/math.py; identity_loss from incubate/nn/loss.py). The
+modern equivalents live in paddle.geometric — these wrappers adapt the
+legacy argument names onto them.
+"""
+from __future__ import annotations
+
+from ..geometric.math import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+
+__all__ = [
+    "graph_send_recv", "graph_khop_sampler", "graph_sample_neighbors",
+    "graph_reindex", "segment_sum", "segment_mean", "segment_max",
+    "segment_min", "identity_loss",
+]
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Reference: incubate/operators/graph_send_recv.py:39 — legacy name
+    for geometric.send_u_recv (pool_type -> reduce_op)."""
+    from ..geometric.message_passing import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index,
+                       reduce_op=str(pool_type).lower(), out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling with subgraph reindex.
+
+    Reference: incubate/operators/graph_khop_sampler.py:39 — per hop
+    size, sample neighbors of the current frontier, accumulate edges,
+    then renumber all touched nodes. Returns (edge_src, edge_dst,
+    sample_index, reindex_nodes[, edge_eids]); composed from
+    geometric.sample_neighbors + reindex_graph.
+    """
+    import numpy as np
+
+    from ..geometric.sampling import sample_neighbors
+
+    frontier = input_nodes
+    all_neighbors, all_counts, all_eids = [], [], []
+    for size in sample_sizes:
+        out = sample_neighbors(row, colptr, frontier,
+                               sample_size=int(size),
+                               eids=sorted_eids, return_eids=return_eids)
+        if return_eids:
+            neighbors, counts, eids = out
+            all_eids.append(np.asarray(eids._value).reshape(-1))
+        else:
+            neighbors, counts = out
+        all_neighbors.append(np.asarray(neighbors._value).reshape(-1))
+        all_counts.append(np.asarray(counts._value).reshape(-1))
+        frontier = neighbors
+
+    from ..ops._helpers import ensure_tensor
+
+    neigh_np = np.concatenate(all_neighbors) if all_neighbors else \
+        np.zeros((0,), np.int64)
+    # per-input-node counts for the concatenated neighbor list: hop h's
+    # counts are per hop-(h-1) frontier node; reindex_graph needs counts
+    # aligned with its `x` (the ORIGINAL inputs), so rebuild a flat pair
+    # list instead: sources expand per count
+    srcs = []
+    prev_frontier = np.asarray(ensure_tensor(input_nodes)._value).reshape(-1)
+    for h, counts in enumerate(all_counts):
+        srcs.append(np.repeat(prev_frontier, counts))
+        prev_frontier = all_neighbors[h]
+    src_np = np.concatenate(srcs) if srcs else np.zeros((0,), np.int64)
+
+    # renumber: input nodes first, then new nodes in appearance order
+    inp_np = np.asarray(ensure_tensor(input_nodes)._value).reshape(-1)
+    order = {}
+    for n in inp_np:
+        order.setdefault(int(n), len(order))
+    for n in np.concatenate([neigh_np, src_np]):
+        order.setdefault(int(n), len(order))
+    sample_index = np.fromiter(order.keys(), np.int64, len(order))
+    remap = np.vectorize(order.__getitem__, otypes=[np.int64])
+    edge_src = remap(neigh_np) if neigh_np.size else neigh_np
+    edge_dst = remap(src_np) if src_np.size else src_np
+    reindex_nodes = remap(inp_np) if inp_np.size else inp_np
+    outs = [ensure_tensor(edge_src.reshape(-1, 1)),
+            ensure_tensor(edge_dst.reshape(-1, 1)),
+            ensure_tensor(sample_index),
+            ensure_tensor(reindex_nodes)]
+    if return_eids:
+        outs.append(ensure_tensor(np.concatenate(all_eids)))
+    return tuple(outs)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Reference: incubate/operators/graph_sample_neighbors.py — legacy
+    name for geometric.sample_neighbors."""
+    from ..geometric.sampling import sample_neighbors
+
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reference: incubate/operators/graph_reindex.py — legacy name for
+    geometric.reindex_graph."""
+    from ..geometric.reindex import reindex_graph
+
+    return reindex_graph(x, neighbors, count, value_buffer=value_buffer,
+                         index_buffer=index_buffer)
+
+
+def identity_loss(x, reduction="none"):
+    """Reference: incubate/nn/loss.py:36 — mark/reduce the final loss
+    (IPU-origin API; the reduction semantics are general)."""
+    from ..ops import math as m
+    from ..ops._helpers import ensure_tensor
+
+    x = ensure_tensor(x)
+    mode = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    if mode == "sum":
+        return m.sum(x)
+    if mode == "mean":
+        return m.mean(x)
+    if mode == "none":
+        return x
+    raise ValueError(f"unsupported reduction: {reduction!r}")

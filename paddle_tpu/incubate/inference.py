@@ -1,0 +1,13 @@
+"""paddle.incubate.inference — decorator surface for predictor export.
+
+Reference: python/paddle/incubate/inference/ (wrapper.py) — the main
+export is ``paddle.incubate.inference.convert_to_trt`` style helpers.
+TPU build: inference serving runs through paddle_tpu.inference
+(StableHLO payloads from jit.save); this module provides the module
+boundary plus a thin alias so incubate.inference.* names resolve.
+"""
+from __future__ import annotations
+
+from ..inference import Config, Predictor, create_predictor  # noqa: F401
+
+__all__ = ["Config", "Predictor", "create_predictor"]

@@ -189,3 +189,47 @@ def calculate_gain(nonlinearity: str, param=None) -> float:
     if nonlinearity == "selu":
         return 3.0 / 4
     raise ValueError(f"unknown nonlinearity {nonlinearity}")
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs
+    (reference: nn/initializer/Bilinear — initializer.py BilinearInitializer).
+    Weight shape [C_out, C_in, k, k]: each k x k slice gets the bilinear
+    interpolation stencil."""
+
+    def __call__(self, shape, dtype="float32"):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D weight")
+        k = shape[-1]
+        if shape[-2] != k:
+            raise ValueError("Bilinear initializer expects square kernels")
+        f = math.ceil(k / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:k, :k]
+        filt = (1 - abs(og[0] / f - c)) * (1 - abs(og[1] / f - c))
+        w = np.zeros(shape, dtype="float64")
+        w[..., :, :] = filt
+        return jnp.asarray(w, convert_dtype(dtype))
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference: nn/initializer/dirac.py):
+    out-channel i passes through in-channel i (mod groups) at the kernel
+    center; all else zero."""
+
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype="float32"):
+        if len(shape) < 3:
+            raise ValueError("Dirac initializer expects a 3-5D conv weight")
+        out_c, in_c = shape[0], shape[1]
+        if out_c % self.groups != 0:
+            raise ValueError("out_channels must be divisible by groups")
+        w = np.zeros(shape, dtype="float64")
+        per = out_c // self.groups
+        center = tuple(s // 2 for s in shape[2:])
+        for g in range(self.groups):
+            for i in range(min(per, in_c)):
+                w[(g * per + i, i) + center] = 1.0
+        return jnp.asarray(w, convert_dtype(dtype))

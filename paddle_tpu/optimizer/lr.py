@@ -340,3 +340,46 @@ class ReduceOnPlateau(LRScheduler):
                 self.last_lr = new_lr
                 self.cooldown_counter = self.cooldown
                 self.num_bad = 0
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr_t = lr_{t-1} * lr_lambda(t) (reference: optimizer/lr.py
+    MultiplicativeDecay — note the reference applies the product of
+    lambdas to the BASE lr each step)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        if not callable(lr_lambda):
+            raise TypeError("lr_lambda must be callable")
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        factor = 1.0
+        for e in range(1, self.last_epoch + 1):
+            factor *= self.lr_lambda(e)
+        return self.base_lr * factor
+
+
+class LinearLR(LRScheduler):
+    """Linear ramp of the lr factor from start_factor to end_factor over
+    total_steps (reference: optimizer/lr.py LinearLR)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(max(self.last_epoch, 0), self.total_steps)
+        frac = t / self.total_steps
+        factor = self.start_factor + (self.end_factor - self.start_factor) \
+            * frac
+        return self.base_lr * factor
+
+
+
