@@ -1,0 +1,139 @@
+// MultiSlot data-feed parser — native hot path of the feed pipeline.
+//
+// TPU-native analog of the reference's C++ DataFeed text parsing
+// (framework/data_feed.cc MultiSlotDataFeed::ParseOneInstance): the
+// Python loop over tokens dominates slot-dataset ingestion, so the
+// batch of protocol lines is parsed here in one pass per batch.
+//
+// Protocol per line (slots in declared order): "<len> <v...> <len> <v...>".
+// C ABI (ctypes): parse() tokenizes a whole buffer into per-slot value
+// arrays (int64 or float32 per the slot dtype flags) plus per-line
+// counts; getters copy into caller-provided numpy buffers.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include "ptpu_c_api.h"
+
+namespace {
+
+struct SlotData {
+  bool is_float = false;
+  std::vector<int64_t> ivals;
+  std::vector<float> fvals;
+  std::vector<int64_t> counts;  // per line
+};
+
+struct FeedParse {
+  std::vector<SlotData> slots;
+  int error_line = -1;  // first malformed line, or -1
+};
+
+}  // namespace
+
+extern "C" {
+
+// Parse `buf[0:len]` (newline-separated lines) with `n_slots` slots per
+// line; slot_is_float[s] selects the value dtype. Returns an opaque
+// handle (never null); check ptpu_datafeed_error() before reading.
+void* ptpu_datafeed_parse(const char* buf, uint64_t len,
+                                   int32_t n_slots,
+                                   const int32_t* slot_is_float) {
+  auto* fp = new FeedParse();
+  fp->slots.resize(n_slots);
+  for (int32_t s = 0; s < n_slots; ++s)
+    fp->slots[s].is_float = slot_is_float[s] != 0;
+
+  const char* p = buf;
+  const char* end = buf + len;
+  int line_no = 0;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (line_end == nullptr) line_end = end;
+    if (line_end > p) {  // skip empty lines
+      const char* q = p;
+      for (int32_t s = 0; s < n_slots; ++s) {
+        char* next = nullptr;
+        long long n = strtoll(q, &next, 10);
+        if (next == q || n < 0 || next > line_end) {
+          fp->error_line = line_no;
+          return fp;
+        }
+        q = next;
+        SlotData& sd = fp->slots[s];
+        sd.counts.push_back(static_cast<int64_t>(n));
+        for (long long i = 0; i < n; ++i) {
+          char* vend = nullptr;
+          if (sd.is_float) {
+            float v = strtof(q, &vend);
+            if (vend == q || vend > line_end) {
+              fp->error_line = line_no;
+              return fp;
+            }
+            sd.fvals.push_back(v);
+          } else {
+            long long v = strtoll(q, &vend, 10);
+            if (vend == q || vend > line_end) {
+              fp->error_line = line_no;
+              return fp;
+            }
+            sd.ivals.push_back(static_cast<int64_t>(v));
+          }
+          q = vend;
+        }
+      }
+      // trailing tokens beyond the declared slots are a format error
+      while (q < line_end && (*q == ' ' || *q == '\r' || *q == '\t')) ++q;
+      if (q < line_end) {
+        fp->error_line = line_no;
+        return fp;
+      }
+      ++line_no;
+    }
+    p = line_end + 1;
+  }
+  return fp;
+}
+
+int32_t ptpu_datafeed_error(void* handle) {
+  return static_cast<FeedParse*>(handle)->error_line;
+}
+
+int64_t ptpu_datafeed_num_lines(void* handle) {
+  auto* fp = static_cast<FeedParse*>(handle);
+  return fp->slots.empty() ? 0
+                           : static_cast<int64_t>(fp->slots[0].counts.size());
+}
+
+int64_t ptpu_datafeed_total(void* handle, int32_t slot) {
+  auto* fp = static_cast<FeedParse*>(handle);
+  const SlotData& sd = fp->slots[slot];
+  return static_cast<int64_t>(sd.is_float ? sd.fvals.size()
+                                          : sd.ivals.size());
+}
+
+void ptpu_datafeed_counts(void* handle, int32_t slot,
+                                   int64_t* out) {
+  const SlotData& sd = static_cast<FeedParse*>(handle)->slots[slot];
+  memcpy(out, sd.counts.data(), sd.counts.size() * sizeof(int64_t));
+}
+
+void ptpu_datafeed_ivalues(void* handle, int32_t slot,
+                                    int64_t* out) {
+  const SlotData& sd = static_cast<FeedParse*>(handle)->slots[slot];
+  memcpy(out, sd.ivals.data(), sd.ivals.size() * sizeof(int64_t));
+}
+
+void ptpu_datafeed_fvalues(void* handle, int32_t slot, float* out) {
+  const SlotData& sd = static_cast<FeedParse*>(handle)->slots[slot];
+  memcpy(out, sd.fvals.data(), sd.fvals.size() * sizeof(float));
+}
+
+void ptpu_datafeed_free(void* handle) {
+  delete static_cast<FeedParse*>(handle);
+}
+
+}  // extern "C"

@@ -108,6 +108,26 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.ptpu_queue_size.restype = ctypes.c_uint32
     lib.ptpu_queue_free.argtypes = [ctypes.c_void_p]
 
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.ptpu_datafeed_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.ptpu_datafeed_parse.restype = ctypes.c_void_p
+    lib.ptpu_datafeed_error.argtypes = [ctypes.c_void_p]
+    lib.ptpu_datafeed_error.restype = ctypes.c_int32
+    lib.ptpu_datafeed_num_lines.argtypes = [ctypes.c_void_p]
+    lib.ptpu_datafeed_num_lines.restype = ctypes.c_int64
+    lib.ptpu_datafeed_total.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ptpu_datafeed_total.restype = ctypes.c_int64
+    lib.ptpu_datafeed_counts.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                         i64p]
+    lib.ptpu_datafeed_ivalues.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                          i64p]
+    lib.ptpu_datafeed_fvalues.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                          ctypes.POINTER(ctypes.c_float)]
+    lib.ptpu_datafeed_free.argtypes = [ctypes.c_void_p]
+
     _LIB = lib
     # Mirror the Python flag registry into the freshly loaded native one so
     # both sides observe a single flag state from here on.
@@ -371,3 +391,48 @@ def flag_set(name: str, value: str) -> None:
 
 def version() -> str:
     return lib().ptpu_version().decode()
+
+
+def parse_multislot(text: bytes, slot_is_float) -> list | None:
+    """Parse MultiSlot protocol lines natively (csrc/ptpu_datafeed.cc).
+
+    Returns [(counts int64[L], values int64/float32 flat)] per slot, or
+    None when the native library is unavailable. Raises ValueError on a
+    malformed line (same contract as the Python parser).
+    """
+    if not is_available():
+        return None
+    import numpy as np
+
+    L = lib()
+    n_slots = len(slot_is_float)
+    flags_arr = (ctypes.c_int32 * n_slots)(
+        *[1 if f else 0 for f in slot_is_float])
+    if not text.endswith(b"\0"):
+        text = text + b"\0"  # strtoll/strtof must never run off the buffer
+    h = L.ptpu_datafeed_parse(text, len(text) - 1, n_slots, flags_arr)
+    try:
+        err = L.ptpu_datafeed_error(h)
+        if err >= 0:
+            raise ValueError(f"malformed MultiSlot line {err}")
+        n_lines = L.ptpu_datafeed_num_lines(h)
+        out = []
+        for s in range(n_slots):
+            counts = np.empty(n_lines, np.int64)
+            L.ptpu_datafeed_counts(
+                h, s, counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            total = L.ptpu_datafeed_total(h, s)
+            if slot_is_float[s]:
+                vals = np.empty(total, np.float32)
+                L.ptpu_datafeed_fvalues(
+                    h, s,
+                    vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            else:
+                vals = np.empty(total, np.int64)
+                L.ptpu_datafeed_ivalues(
+                    h, s,
+                    vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            out.append((counts, vals))
+        return out
+    finally:
+        L.ptpu_datafeed_free(h)

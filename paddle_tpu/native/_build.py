@@ -17,6 +17,7 @@ _CSRC = os.path.join(_REPO, "csrc")
 LIB_PATH = os.path.join(_HERE, "libpaddle_tpu.so")
 
 _SOURCES = [
+    "ptpu_datafeed.cc",
     "ptpu_ddim.cc",
     "ptpu_flags.cc",
     "ptpu_tcp_store.cc",

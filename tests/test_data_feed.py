@@ -119,3 +119,53 @@ class TestTrainFromDataset:
         ds.init(batch_size=2)  # no use_var -> no schema
         with pytest.raises(ValueError, match="data feed"):
             exe.train_from_dataset(None, ds)
+
+
+class TestNativeParser:
+    def test_native_matches_python_parser(self, tmp_path):
+        from paddle_tpu import native
+
+        if not native.is_available():
+            pytest.skip("native toolchain unavailable")
+        feed = MultiSlotDataFeed([("words", "int64"), ("score", "float32"),
+                                  ("label", "int64")])
+        lines = ["2 5 9 1 0.25 1 1\n", "3 1 2 3 2 0.5 1.5 1 0\n",
+                 "1 7 1 2.0 1 1\n"]
+        got = feed.collate_batch_lines(lines)
+        want = feed.collate([feed.parse_line(l) for l in lines])
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], err_msg=k)
+
+    def test_native_parser_throughput(self):
+        """The native single-pass parse must beat the Python token loop
+        on a large batch (the point of the data_feed.cc analog)."""
+        import time
+
+        from paddle_tpu import native
+
+        if not native.is_available():
+            pytest.skip("native toolchain unavailable")
+        rng = np.random.RandomState(0)
+        lines = []
+        for _ in range(4000):
+            n = rng.randint(1, 40)
+            ids = " ".join(str(v) for v in rng.randint(0, 10 ** 6, n))
+            lines.append(f"{n} {ids} 1 {rng.randint(0, 2)}\n")
+        feed = MultiSlotDataFeed([("words", "int64"), ("label", "int64")])
+
+        t0 = time.perf_counter()
+        got = feed.collate_batch_lines(lines)
+        t_native = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        want = feed.collate([feed.parse_line(l) for l in lines])
+        t_python = time.perf_counter() - t0
+        np.testing.assert_array_equal(got["words"], want["words"])
+        assert t_native < t_python, (
+            f"native {t_native * 1e3:.1f}ms not faster than python "
+            f"{t_python * 1e3:.1f}ms")
+
+    def test_malformed_line_raises_with_line_number(self):
+        feed = MultiSlotDataFeed(["a", "b"])
+        with pytest.raises(ValueError):
+            feed.collate_batch_lines(["1 5 1 3\n", "2 1\n"])
