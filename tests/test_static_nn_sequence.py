@@ -226,3 +226,53 @@ def test_data_norm_stats_not_trainable():
     x = np.random.rand(6, 4).astype("float32")
     out = snn.data_norm(_t(x))
     assert np.isfinite(out.numpy()).all()
+
+
+class TestSequenceOpGrads:
+    """Numeric-gradient checks for the segment-reduction prims (op_test
+    pattern, SURVEY §4): grads flow through apply()'s fallback VJP."""
+
+    def _num_grad(self, f, x, eps=1e-3):
+        g = np.zeros_like(x)
+        for i in np.ndindex(*x.shape):
+            xp = x.copy(); xp[i] += eps
+            xm = x.copy(); xm[i] -= eps
+            g[i] = (f(xp) - f(xm)) / (2 * eps)
+        return g
+
+    def test_sequence_softmax_grad(self):
+        x = np.random.RandomState(0).rand(T).astype("float64") \
+            .astype("float32")
+        lens = _t(LENS)
+
+        def loss_np(xv):
+            t = _t(xv.astype("float32"))
+            t.stop_gradient = False
+            out = snn.sequence_softmax(t, length=lens)
+            return float((out * out).sum().numpy())
+
+        t = _t(x)
+        t.stop_gradient = False
+        out = snn.sequence_softmax(t, length=lens)
+        (out * out).sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(),
+                                   self._num_grad(loss_np, x), rtol=2e-2,
+                                   atol=1e-3)
+
+    @pytest.mark.parametrize("ptype", ["sum", "average", "max"])
+    def test_sequence_pool_grad(self, ptype):
+        x = np.random.RandomState(1).rand(T, 3).astype("float32")
+        lens = _t(LENS)
+
+        def loss_np(xv):
+            t = _t(xv.astype("float32"))
+            out = snn.sequence_pool(t, ptype, length=lens)
+            return float((out * out).sum().numpy())
+
+        t = _t(x)
+        t.stop_gradient = False
+        out = snn.sequence_pool(t, ptype, length=lens)
+        (out * out).sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(),
+                                   self._num_grad(loss_np, x), rtol=2e-2,
+                                   atol=1e-3)
