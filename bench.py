@@ -251,12 +251,14 @@ def main():
     steps = args.steps or (20 if on_tpu else 3)
     warmup = 3 if on_tpu else 1
 
-    if args.config in ("llama", "all"):
-        bench_llama(on_tpu, steps, warmup, peak_flops, profile=args.profile)
+    # flagship (llama) prints LAST: the driver's summary parses the
+    # final JSON line as the headline metric
     if args.config in ("resnet", "all"):
         bench_resnet(on_tpu, steps, warmup, peak_flops)
     if args.config in ("moe", "all"):
         bench_moe(on_tpu, steps, warmup, peak_flops)
+    if args.config in ("llama", "all"):
+        bench_llama(on_tpu, steps, warmup, peak_flops, profile=args.profile)
 
 
 if __name__ == "__main__":
