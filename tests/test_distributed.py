@@ -212,3 +212,40 @@ class TestDataParallelWrapper:
         x = paddle.to_tensor(_r(16, 4))
         y = model(x)
         assert y.shape == [16, 2]
+
+
+class TestFleetFacade:
+    def test_fleet_class_forwards(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        f = fleet.Fleet()
+        f.init(is_collective=True)
+        assert f.worker_num() >= 1
+        assert f.worker_index() >= 0
+        assert f.is_worker()
+        assert isinstance(f.util, fleet.UtilBase)
+
+    def test_utilbase_file_shard(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        u = fleet.UtilBase()
+        files = [f"f{i}" for i in range(7)]
+        shard = u.get_file_shard(files)
+        # single-worker world gets everything, in order
+        assert shard == files
+        with pytest.raises(TypeError):
+            u.get_file_shard("not-a-list")
+
+    def test_utilbase_allreduce_single_world(self):
+        import numpy as np
+
+        import paddle_tpu.distributed.fleet as fleet
+
+        out = fleet.UtilBase().all_reduce(np.array([1.0, 2.0], "float32"))
+        np.testing.assert_allclose(out, [1.0, 2.0])
+
+    def test_singleton_and_role_exported(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        assert isinstance(fleet.fleet, fleet.Fleet)
+        assert hasattr(fleet.Role, "WORKER") or len(list(fleet.Role)) >= 2
