@@ -313,3 +313,94 @@ from .inference_attention import (  # noqa: E402
 from .fused_linear_ce import fused_linear_cross_entropy  # noqa: E402
 
 __all__.append("fused_linear_cross_entropy")
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """out = LayerNorm(residual + dropout(x + bias)).
+
+    Reference: incubate/nn/functional/fused_transformer.py
+    fused_bias_dropout_residual_layer_norm (phi
+    fused_bias_dropout_residual_layer_norm kernel)."""
+    from ....nn.functional.common import dropout as _dropout
+    from ....nn.functional.norm import layer_norm
+    from ....ops._helpers import ensure_tensor
+    from ....ops.math import add
+
+    h = ensure_tensor(x)
+    if bias is not None:
+        h = add(h, ensure_tensor(bias))
+    h = _dropout(h, dropout_rate, training=training, mode=mode)
+    h = add(ensure_tensor(residual), h)
+    d = h.shape[-1]
+    return layer_norm(h, [d], ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, pre_caches=None, rotary_embs=None,
+        time_step=None, attn_mask=None, dropout_rate=0.0,
+        rotary_emb_dims=0, activation="gelu", training=False,
+        mode="upscale_in_train", trans_qkvw=True, ring_id=-1, name=None):
+    """Functional form of the stacked fused decoder (reference:
+    incubate/nn/functional/fused_transformer.py fused_multi_transformer;
+    serving op fused_multi_transformer_op.cu). Per-layer weights arrive
+    as lists; generation-time caches are handled by the dedicated decode
+    attention ops (masked/block MHA), not here."""
+    from ....nn.functional.common import linear
+    from ....nn.functional.norm import layer_norm
+    from ....ops._helpers import ensure_tensor
+    from ....ops.math import add
+
+    for unsupported, argname in ((cache_kvs, "cache_kvs"),
+                                 (pre_caches, "pre_caches"),
+                                 (time_step, "time_step")):
+        if unsupported is not None:
+            raise NotImplementedError(
+                f"fused_multi_transformer: generation-time {argname} is "
+                "the caller's responsibility in the TPU build — use "
+                "masked_multihead_attention / block_multihead_attention")
+    if not trans_qkvw:
+        raise NotImplementedError("only trans_qkvw=True layout is supported")
+
+    out = ensure_tensor(x)
+    d = out.shape[-1]
+    num_layers = len(qkv_weights)
+    for i in range(num_layers):
+        num_heads = qkv_weights[i].shape[1]
+        attn_out = fused_multi_head_attention(
+            out, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=pre_layer_norm,
+            pre_ln_scale=ln_scales[i], pre_ln_bias=ln_biases[i],
+            ln_scale=ln_scales[i], ln_bias=ln_biases[i],
+            pre_ln_epsilon=epsilon,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, ln_epsilon=epsilon,
+            training=training, num_heads=num_heads,
+            rotary_embs=rotary_embs)
+        residual = attn_out
+        h = attn_out
+        if pre_layer_norm:
+            h = layer_norm(h, [d], ffn_ln_scales[i], ffn_ln_biases[i],
+                           epsilon)
+        h = linear(h, ffn1_weights[i])
+        h = fused_bias_act(
+            h, ffn1_biases[i] if ffn1_biases else None,
+            act_method=activation)
+        h = linear(h, ffn2_weights[i],
+                   ffn2_biases[i] if ffn2_biases else None)
+        out = add(residual, h)
+        if not pre_layer_norm:
+            out = layer_norm(out, [d], ffn_ln_scales[i], ffn_ln_biases[i],
+                             epsilon)
+    return (out, cache_kvs) if cache_kvs is not None else out
+
+
+__all__ += ["fused_bias_dropout_residual_layer_norm",
+            "fused_multi_transformer"]

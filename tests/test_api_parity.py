@@ -26,7 +26,7 @@ NAMESPACES = [
     "audio/__init__.py", "text/__init__.py", "metric/__init__.py",
     "vision/datasets/__init__.py", "geometric/__init__.py", "signal.py",
     "hub.py", "onnx/__init__.py", "incubate/__init__.py",
-    "incubate/nn/__init__.py", "distributed/fleet/__init__.py",
+    "incubate/nn/__init__.py", "incubate/nn/functional/__init__.py", "distributed/fleet/__init__.py",
     "distributed/fleet/utils/__init__.py", "nn/initializer/__init__.py",
     "optimizer/lr.py", "utils/__init__.py",
 ]

@@ -725,3 +725,42 @@ def test_mmha_rotary_position_from_src_mask():
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(cache_mask.numpy(), cache_seq.numpy(),
                                rtol=1e-5, atol=1e-5)
+
+
+class TestFusedFunctionalForms:
+    def test_bias_dropout_residual_ln_matches_layer(self):
+        import paddle_tpu.incubate.nn as inn
+
+        paddle.seed(0)
+        layer = inn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+        x = paddle.to_tensor(_r(2, 8))
+        r = paddle.to_tensor(_r(2, 8))
+        want = layer(x, r)
+        got = F.fused_bias_dropout_residual_layer_norm(
+            x, r, bias=layer.linear_bias, ln_scale=layer.ln_scale,
+            ln_bias=layer.ln_bias, dropout_rate=0.0,
+            ln_epsilon=layer._epsilon)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-5)
+
+    def test_fused_multi_transformer_matches_layer(self):
+        import paddle_tpu.incubate.nn as inn
+
+        paddle.seed(1)
+        mt = inn.FusedMultiTransformer(16, 4, 32, num_layers=2,
+                                       dropout_rate=0.0)
+        src = paddle.to_tensor(_r(2, 5, 16))
+        want = mt(src)
+        got = F.fused_multi_transformer(
+            src, mt.ln_scales, mt.ln_biases, mt.qkv_weights, mt.qkv_biases,
+            mt.linear_weights, mt.linear_biases, mt.ffn_ln_scales,
+            mt.ffn_ln_biases, mt.ffn1_weights, mt.ffn1_biases,
+            mt.ffn2_weights, mt.ffn2_biases, pre_layer_norm=True,
+            dropout_rate=0.0)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_fused_multi_transformer_caches_rejected(self):
+        with pytest.raises(NotImplementedError):
+            F.fused_multi_transformer(
+                paddle.to_tensor(_r(1, 2, 8)), [], [], [], [], [], [], [],
+                [], [], [], [], [], cache_kvs=[1])
