@@ -51,6 +51,7 @@ from . import jit
 from . import static
 from . import inference
 from . import sparse
+from . import cost_model  # noqa: F401
 from . import metric
 from . import device
 from . import incubate

@@ -1,0 +1,38 @@
+"""paddle.cost_model — program cost estimation.
+
+Reference: python/paddle/cost_model/cost_model.py (CostModel over the
+static-graph cost infrastructure). TPU mapping: the analytic HBM +
+roofline estimators that drive the auto-tuner and Engine.prepare.
+"""
+from __future__ import annotations
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def __init__(self):
+        pass
+
+    def profile_measure(self, startup_program=None, main_program=None,
+                        device="tpu", fetch_cost_list=("time",)):
+        """Analytic estimate for a transformer-shaped TuneSpace dict (the
+        reference measures a program; the TPU path scores configs with
+        distributed.auto_tuner's roofline model)."""
+        from ..distributed.auto_tuner import (
+            Candidate, TuneSpace, estimate_memory_bytes,
+            estimate_step_time_s,
+        )
+
+        space = TuneSpace()
+        cand = Candidate(dp=1, mp=1, pp=1, sharding_stage=0,
+                         micro_batch_size=space.global_batch_size,
+                         recompute=False)
+        return {
+            "time": estimate_step_time_s(space, cand),
+            "memory": estimate_memory_bytes(space, cand),
+        }
+
+    def static_cost_data(self):
+        from ..distributed import auto_tuner
+
+        return auto_tuner.TuneSpace()

@@ -17,7 +17,9 @@ from typing import List, Optional
 
 from ..core.place import (device_count, get_device, is_compiled_with_cuda,
                           set_device)
-from . import memory  # noqa: F401
+from . import memory
+from . import cuda  # noqa: F401
+from . import xpu  # noqa: F401  # noqa: F401
 from .memory import (empty_cache, max_memory_allocated, max_memory_reserved,
                      memory_allocated, memory_reserved, memory_stats)
 

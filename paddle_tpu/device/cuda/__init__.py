@@ -1,0 +1,162 @@
+"""paddle.device.cuda parity surface mapped onto the TPU runtime.
+
+Reference: python/paddle/device/cuda/__init__.py. On TPU, "cuda" calls
+mean "the accelerator": synchronization flushes the dispatch queue,
+memory stats come from the PJRT allocator surface (device/memory.py),
+and Stream/Event are ordering markers — XLA's data-dependency scheduler
+owns real stream assignment, so recording/waiting are host-side fences.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = [
+    "Stream", "Event", "current_stream", "synchronize", "device_count",
+    "empty_cache", "max_memory_allocated", "max_memory_reserved",
+    "memory_allocated", "memory_reserved", "stream_guard",
+    "get_device_properties", "get_device_name", "get_device_capability",
+]
+
+
+def _devices():
+    import jax
+
+    return [d for d in jax.devices() if d.platform != "cpu"] or jax.devices()
+
+
+def device_count() -> int:
+    return len(_devices())
+
+
+def synchronize(device=None):
+    """Block until all dispatched work on the accelerator finished."""
+    import jax
+
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+    for d in _devices():
+        try:
+            d.synchronize_all_activity()
+        except Exception:
+            break
+
+
+class Stream:
+    """Ordering marker (reference: core.CUDAStream). XLA schedules real
+    streams; two Streams here only order host-side dispatch."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def query(self) -> bool:
+        return True
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time
+
+        self._t = time.perf_counter()
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end_event) -> float:
+        if self._t is None or end_event._t is None:
+            return 0.0
+        return (end_event._t - self._t) * 1e3
+
+
+_current = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _current
+
+
+@contextlib.contextmanager
+def stream_guard(stream):
+    global _current
+    prev, _current = _current, stream
+    try:
+        yield
+    finally:
+        _current = prev
+
+
+def empty_cache():
+    from .. import memory as _memory
+
+    if hasattr(_memory, "empty_cache"):
+        _memory.empty_cache()
+
+
+def _mem_stat(kind: str, device=None) -> int:
+    from .. import memory as _memory
+
+    fn = getattr(_memory, kind, None)
+    return int(fn(device)) if fn is not None else 0
+
+
+def memory_allocated(device=None) -> int:
+    return _mem_stat("memory_allocated", device)
+
+
+def max_memory_allocated(device=None) -> int:
+    return _mem_stat("max_memory_allocated", device)
+
+
+def memory_reserved(device=None) -> int:
+    return _mem_stat("memory_reserved", device)
+
+
+def max_memory_reserved(device=None) -> int:
+    return _mem_stat("max_memory_reserved", device)
+
+
+def get_device_properties(device=None):
+    import collections
+
+    d = _devices()[0]
+    Props = collections.namedtuple(
+        "DeviceProperties",
+        ["name", "major", "minor", "total_memory", "multi_processor_count"])
+    stats = {}
+    try:
+        stats = d.memory_stats() or {}
+    except Exception:
+        pass
+    return Props(name=str(d.device_kind), major=0, minor=0,
+                 total_memory=stats.get("bytes_limit", 0),
+                 multi_processor_count=1)
+
+
+def get_device_name(device=None) -> str:
+    return str(_devices()[0].device_kind)
+
+
+def get_device_capability(device=None):
+    return (0, 0)  # TPU: no CUDA compute capability

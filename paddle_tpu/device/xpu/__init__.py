@@ -1,0 +1,8 @@
+"""paddle.device.xpu parity surface (XPU hardware is not part of the
+TPU build; reference: python/paddle/device/xpu/__init__.py)."""
+
+__all__ = ["synchronize"]
+
+
+def synchronize(device=None):
+    raise NotImplementedError("XPU devices are not part of the TPU build")

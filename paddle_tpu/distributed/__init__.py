@@ -33,6 +33,7 @@ from . import auto_parallel
 from . import checkpoint
 from . import rpc
 from . import sharding
+from . import passes  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model
 from . import elastic
 from .store import InMemoryStore, Store, TCPStore, create_store

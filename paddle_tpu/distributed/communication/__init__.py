@@ -361,3 +361,6 @@ def ppermute(x, axis_name, perm):
 
 def all_to_all_in_trace(x, axis_name, split_axis, concat_axis):
     return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=True)
+
+
+from . import stream  # noqa: E402,F401

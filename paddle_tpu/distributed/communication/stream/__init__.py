@@ -1,0 +1,21 @@
+"""paddle.distributed.communication.stream — stream-variant collectives.
+
+Reference: python/paddle/distributed/communication/stream/ (each op with
+sync_op/use_calc_stream knobs over ProcessGroup tasks). On TPU, XLA owns
+stream scheduling, so these forward to the same collective
+implementations; sync_op/use_calc_stream are accepted for parity and the
+returned "task" is the tensor itself (already ordered by data deps).
+"""
+from __future__ import annotations
+
+from .. import (  # noqa: F401
+    all_gather, all_reduce, broadcast, gather, reduce, reduce_scatter,
+    recv, scatter, send,
+)
+from .. import all_to_all as alltoall  # noqa: F401
+from .. import all_to_all_single as alltoall_single  # noqa: F401
+
+__all__ = [
+    "all_gather", "all_reduce", "alltoall", "alltoall_single", "broadcast",
+    "reduce", "reduce_scatter", "recv", "scatter", "send", "gather",
+]

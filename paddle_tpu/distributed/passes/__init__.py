@@ -1,0 +1,81 @@
+"""paddle.distributed.passes — program pass framework.
+
+Reference: python/paddle/distributed/passes/ (pass_base.py new_pass /
+PassManager/PassContext; dozens of fuse/sharding/pipeline passes). TPU
+collapse: XLA performs the fusion/scheduling passes and GSPMD the
+distributed rewrites, so the framework here is the registry + manager
+shell that named passes plug into; built-in names resolve to no-op
+passes documenting their XLA equivalent.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+_PASS_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_pass(name: str):
+    def deco(cls):
+        _PASS_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+class PassContext:
+    def __init__(self):
+        self.attrs = {}
+
+    def set_attr(self, key, value):
+        self.attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self.attrs.get(key, default)
+
+
+class PassBase:
+    def __init__(self, name: str, attrs=None):
+        self.name = name
+        self.attrs = dict(attrs or {})
+
+    def apply(self, main_programs, startup_programs, context=None):
+        return main_programs, startup_programs
+
+
+# XLA subsumes these graph rewrites; names kept so strategy configs and
+# ports referencing them resolve (pass_base.py registry names)
+for _name in ("fuse_elewise_add_act", "fuse_bn_act", "fuse_bn_add_act",
+              "fuse_relu_depthwise_conv", "fuse_optimizer",
+              "fused_attention", "fused_feedforward",
+              "auto_parallel_sharding", "auto_parallel_amp",
+              "auto_parallel_recompute", "auto_parallel_fp16",
+              "pipeline_scheduler_FThenB", "pipeline_scheduler_1F1B"):
+    _PASS_REGISTRY[_name] = PassBase
+
+
+def new_pass(name: str, pass_attrs=None) -> PassBase:
+    cls = _PASS_REGISTRY.get(name, PassBase)
+    if cls is PassBase:
+        return PassBase(name, pass_attrs)
+    return cls(name, pass_attrs)
+
+
+class PassManager:
+    def __init__(self, passes: Optional[List[PassBase]] = None):
+        self._passes = list(passes or [])
+        self.context = PassContext()
+
+    def append(self, p: PassBase):
+        self._passes.append(p)
+
+    def apply(self, main_programs, startup_programs):
+        for p in self._passes:
+            main_programs, startup_programs = p.apply(
+                main_programs, startup_programs, self.context)
+        return main_programs, startup_programs
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]

@@ -34,3 +34,16 @@ __all__ = [
     "MaskAlgo", "decorate", "prune_model", "set_excluded_layers",
     "reset_excluded_layers", "ASPHelper", "OptimizerWithSparsityGuarantee",
 ]
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Register a layer type (or parameter-name substring) as prunable by
+    the ASP workflow (reference: incubate/asp/supported_layer_list.py
+    add_supported_layer)."""
+    name = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", str(layer))
+    _SUPPORTED_LAYERS[name] = pruning_func
+
+
+_SUPPORTED_LAYERS = {}
+__all__ += ["add_supported_layer"]

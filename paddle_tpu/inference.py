@@ -143,3 +143,109 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """Reference: paddle.inference.create_predictor."""
     return Predictor(config)
+
+
+class DataType:
+    """Reference: paddle_infer.DataType enum."""
+
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    INT64 = "int64"
+    INT32 = "int32"
+    INT8 = "int8"
+    UINT8 = "uint8"
+    BOOL = "bool"
+
+
+class PlaceType:
+    """Reference: paddle_infer.PlaceType enum (TPU fills the GPU role)."""
+
+    CPU = "cpu"
+    GPU = "tpu"
+    XPU = "xpu"
+    CUSTOM = "custom"
+
+
+class PrecisionType:
+    """Reference: paddle_infer.PrecisionType (TRT precision selector)."""
+
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+def get_version() -> str:
+    """Reference: paddle_infer.get_version."""
+    import paddle_tpu
+
+    return f"paddle_tpu inference {getattr(paddle_tpu, '__version__', '0.0')}"
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    import numpy as np
+
+    return int(np.dtype(getattr(dtype, "value", dtype)).itemsize)
+
+
+def get_trt_compile_version():
+    """TensorRT is not part of the TPU build (XLA compiles the graph)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name: str) -> str:
+    """Reference maps fluid op names to phi kernel names; the TPU build's
+    primitives already use the phi-style names."""
+    return op_name
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """Cast a saved state_dict payload to a mixed-precision copy
+    (reference: paddle.inference.convert_to_mixed_precision)."""
+    import numpy as np
+
+    from .framework.io_ import load, save
+
+    state = load(model_file if params_file is None else params_file)
+    dt = getattr(mixed_precision, "value", mixed_precision) or "float16"
+    out = {}
+    for k, v in state.items():
+        arr = np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+        out[k] = arr.astype(dt) if np.issubdtype(arr.dtype, np.floating) \
+            else arr
+    save(out, mixed_params_file or mixed_model_file)
+
+
+class PredictorPool:
+    """Pool of predictors sharing one config (reference:
+    paddle_infer.PredictorPool for multi-threaded serving)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._predictors = [Predictor(config) for _ in range(max(1, size))]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._predictors[idx]
+
+
+__all__ += ["DataType", "PlaceType", "PrecisionType", "get_version",
+            "get_num_bytes_of_data_type", "get_trt_compile_version",
+            "get_trt_runtime_version", "convert_to_mixed_precision",
+            "PredictorPool", "_get_phi_kernel_name"]
+
+
+class XpuConfig:
+    """Reference: paddle_infer.XpuConfig — XPU runtime knobs. Accepted for
+    config portability; XPU execution is not part of the TPU build."""
+
+    def __init__(self):
+        self.device_id = 0
+        self.l3_size = 0
+        self.conv_autotune_level = 0

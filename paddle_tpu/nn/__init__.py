@@ -10,6 +10,7 @@ from . import initializer
 from . import functional
 from . import functional as F  # noqa: F401
 from . import utils  # noqa: F401
+from . import quant  # noqa: F401
 
 from .container import Sequential, LayerList, LayerDict, ParameterList
 from .common_layers import (

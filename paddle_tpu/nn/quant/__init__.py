@@ -1,0 +1,76 @@
+"""paddle.nn.quant — weight-only quantization helpers.
+
+Reference: python/paddle/nn/quant/ (quantized_linear.py
+weight_quantize/weight_dequantize/weight_only_linear/llm_int8_linear,
+format.py Stub). TPU path: per-channel absmax int8/int4 quantization in
+plain jnp; weight_only_linear dequantizes into bf16/fp16 GEMMs (the MXU
+has no int8 path exposed here, so memory savings come from storage and
+the matmul runs in the activation dtype, matching the reference's
+weight-only contract).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import ensure_tensor
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
+           "weight_quantize", "weight_dequantize"]
+
+
+class Stub:
+    """Observer placeholder inserted by quant-aware training configs
+    (reference: nn/quant/format.py Stub)."""
+
+    def __init__(self, observer=None):
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+    __call__ = forward
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """[K, N] weight -> (quantized int8 weight, per-column fp scales).
+
+    Reference: nn/quant/quantized_linear.py weight_quantize (absmax
+    per output channel)."""
+    w = ensure_tensor(x)._value.astype(jnp.float32)
+    if algo not in ("weight_only_int8", "llm.int8", "weight_only_int4"):
+        raise ValueError(f"unsupported quant algo: {algo!r}")
+    qmax = 7.0 if algo == "weight_only_int4" else 127.0
+    scale = jnp.max(jnp.abs(w), axis=0) / qmax
+    safe = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(w / safe), -qmax, qmax).astype(jnp.int8)
+    return Tensor._from_value(q), Tensor._from_value(scale)
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16",
+                      group_size=-1):
+    q = ensure_tensor(x)._value.astype(jnp.float32)
+    s = ensure_tensor(scale)._value.astype(jnp.float32)
+    return Tensor._from_value((q * s).astype(jnp.dtype(out_dtype)))
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """y = x @ dequant(weight) + bias (reference weight_only_linear)."""
+    xv = ensure_tensor(x)._value
+    w = ensure_tensor(weight)._value.astype(jnp.float32)
+    if weight_scale is not None:
+        w = w * ensure_tensor(weight_scale)._value.astype(jnp.float32)
+    y = jnp.matmul(xv.astype(jnp.float32), w,
+                   preferred_element_type=jnp.float32)
+    if bias is not None:
+        y = y + ensure_tensor(bias)._value.astype(jnp.float32)
+    return Tensor._from_value(y.astype(xv.dtype))
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """LLM.int8 matmul (outlier split on GPU; numerically the dequantized
+    GEMM here — reference llm_int8_linear contract)."""
+    return weight_only_linear(x, weight, bias=bias,
+                              weight_scale=weight_scale)

@@ -410,3 +410,6 @@ def slice(x, axes, starts, ends, name=None):
 
 
 __all__ += ["addmm", "sum", "reshape", "isnan", "slice"]
+
+
+from . import nn  # noqa: E402,F401

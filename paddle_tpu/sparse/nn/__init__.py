@@ -1,0 +1,146 @@
+"""paddle.sparse.nn — layer wrappers over sparse.nn.functional.
+
+Reference: python/paddle/sparse/nn/ (layer/activation.py, layer/conv.py,
+layer/norm.py, layer/pooling.py).
+"""
+from __future__ import annotations
+
+from . import functional  # noqa: F401
+from . import functional as F
+from ...nn.layer import Layer
+
+__all__ = [
+    "ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm", "SyncBatchNorm",
+    "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D", "MaxPool3D",
+]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class BatchNorm(Layer):
+    """Channel batch-norm over the stored values (reference sparse
+    BatchNorm normalizes the value tensor's channel dim)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn import BatchNorm1D
+
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+
+    def forward(self, x):
+        import jax.experimental.sparse as jsparse
+
+        import paddle_tpu.sparse as _sp
+        from ...core.tensor import Tensor
+
+        coo = _sp._as_coo(x)
+        if coo.data.ndim == 1:
+            # fully-sparse layout: regroup so the channel dim is dense —
+            # stats are per channel over stored values (reference sparse
+            # BatchNorm semantics)
+            dense = coo.todense()
+            coo = jsparse.BCOO.fromdense(dense, n_dense=1)
+        vals = Tensor._from_value(coo.data)
+        out = self._bn(vals)
+        return _sp._wrap_like(x, jsparse.BCOO((out._value, coo.indices),
+                                              shape=coo.shape))
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica stats ride GSPMD data layouts (see nn.SyncBatchNorm);
+    per-host math is identical."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class _SparseConvBase(Layer):
+    _nd = 2
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        nd = self._nd
+        k = (kernel_size,) * nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        # [*k, C_in/groups, C_out] — the HWIO/DHWIO layout the dense conv
+        # consumes
+        self.weight = self.create_parameter(
+            list(k) + [in_channels // groups, out_channels],
+            attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True))
+
+    def forward(self, x):
+        fn = {
+            (2, False): F.conv2d, (3, False): F.conv3d,
+            (2, True): F.subm_conv2d, (3, True): F.subm_conv3d,
+        }[(self._nd, self._subm)]
+        return fn(x, self.weight, self.bias, self._stride, self._padding,
+                  self._dilation, self._groups)
+
+
+class Conv2D(_SparseConvBase):
+    _nd, _subm = 2, False
+
+
+class Conv3D(_SparseConvBase):
+    _nd, _subm = 3, False
+
+
+class SubmConv2D(_SparseConvBase):
+    _nd, _subm = 2, True
+
+
+class SubmConv3D(_SparseConvBase):
+    _nd, _subm = 3, True
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        self._k = kernel_size
+        self._s = stride
+        self._p = padding
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._k, self._s, self._p)

@@ -1,0 +1,183 @@
+"""paddle.sparse.nn.functional — sparse NN ops over BCOO tensors.
+
+Reference: python/paddle/sparse/nn/functional/ (conv.py, pooling.py,
+activation.py, transformer.py over phi/kernels/sparse/). TPU stance:
+XLA has no sparse-conv kernels, so convolutions densify, run the dense
+MXU conv, and re-sparsify; submanifold variants mask the output to the
+input's active sites — exactly the subm_conv contract at stride 1.
+Activations act on stored values only (f(0) = 0 holds for this family),
+preserving sparsity structure.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+
+
+def _sp():
+    # lazy: this package is imported during paddle_tpu.sparse's own init
+    import paddle_tpu.sparse as sparse
+
+    return sparse
+
+__all__ = [
+    "conv2d", "conv3d", "subm_conv2d", "subm_conv2d_igemm", "subm_conv3d",
+    "subm_conv3d_igemm", "max_pool3d", "relu", "relu6", "leaky_relu",
+    "softmax", "attention",
+]
+
+
+def _values_op(x, fn):
+    sp = _sp()
+    coo = sp._as_coo(x)
+    import jax.experimental.sparse as jsparse
+
+    return sp._wrap_like(x, jsparse.BCOO((fn(coo.data), coo.indices),
+                                         shape=coo.shape))
+
+
+def relu(x, name=None):
+    return _values_op(x, jax.nn.relu)
+
+
+def relu6(x, name=None):
+    return _values_op(x, lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _values_op(x, lambda v: jnp.where(v >= 0, v,
+                                             negative_slope * v))
+
+
+def softmax(x, axis=-1, name=None):
+    """Softmax over the stored values of each row (reference: sparse
+    softmax ignores implicit zeros — CSR row-wise semantics)."""
+    sp = _sp()
+    coo = sp._as_coo(x)
+    if axis not in (-1, coo.ndim - 1):
+        raise ValueError("sparse softmax supports the last axis only")
+    import jax.experimental.sparse as jsparse
+    import numpy as np
+
+    idx = np.asarray(coo.indices)
+    rows = idx[:, :-1]
+    # group by row: stable segment ids over the leading indices
+    row_key = np.zeros(idx.shape[0], np.int64)
+    mul = 1
+    for d in range(rows.shape[1] - 1, -1, -1):
+        row_key += rows[:, d] * mul
+        mul *= coo.shape[d]
+    uniq, seg = np.unique(row_key, return_inverse=True)
+    seg = jnp.asarray(seg)
+    n = int(uniq.size)
+    vals = coo.data
+    mx = jax.ops.segment_max(vals, seg, num_segments=n)
+    e = jnp.exp(vals - mx[seg])
+    s = jax.ops.segment_sum(e, seg, num_segments=n)
+    return sp._wrap_like(x, jsparse.BCOO((e / s[seg], coo.indices),
+                                         shape=coo.shape))
+
+
+def _dense_conv(x, weight, bias, stride, padding, dilation, groups,
+                nd, subm, data_format):
+    """Densify -> dense conv (NDHWC/NHWC layouts like the reference
+    sparse convs) -> re-sparsify; subm masks to the input active sites."""
+    import numpy as np
+
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    xv = dense._value if isinstance(dense, Tensor) else dense
+    wv = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
+    s = (stride,) * nd if isinstance(stride, int) else tuple(stride)
+    d = (dilation,) * nd if isinstance(dilation, int) else tuple(dilation)
+    if isinstance(padding, int):
+        pad = [(padding, padding)] * nd
+    elif padding == "SAME" or padding == "VALID":
+        pad = padding
+    else:
+        pad = [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
+    dn_in = "NHWC" if nd == 2 else "NDHWC"
+    dn_k = "HWIO" if nd == 2 else "DHWIO"
+    out = jax.lax.conv_general_dilated(
+        xv, wv, s, pad, rhs_dilation=d,
+        dimension_numbers=(dn_in, dn_k, dn_in),
+        feature_group_count=groups)
+    if bias is not None:
+        bv = bias._value if isinstance(bias, Tensor) else jnp.asarray(bias)
+        out = out + bv
+    if subm:
+        # submanifold: outputs only at the input's active sites
+        mask = (jnp.abs(xv).sum(-1, keepdims=True) > 0).astype(out.dtype)
+        out = out * mask
+    t = Tensor._from_value(out)
+    return t.to_sparse_coo(t.ndim - 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    """Reference: sparse/nn/functional/conv.py conv2d ([N,H,W,C] layout)."""
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups,
+                       2, False, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups,
+                       3, False, data_format)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups,
+                       2, True, data_format)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups,
+                       3, True, data_format)
+
+
+# igemm variants: algorithm choice on GPU; same math here
+subm_conv2d_igemm = subm_conv2d
+subm_conv3d_igemm = subm_conv3d
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Reference: sparse/nn/functional/pooling.py max_pool3d."""
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    xv = dense._value if isinstance(dense, Tensor) else dense
+    k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    s = k if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    dims = (1,) + k + (1,)
+    strides = (1,) + s + (1,)
+    pads = [(0, 0)] + [(pp, pp) for pp in p] + [(0, 0)]
+    out = jax.lax.reduce_window(xv, -jnp.inf, jax.lax.max, dims, strides,
+                                pads)
+    out = jnp.where(jnp.isfinite(out), out, 0.0)
+    t = Tensor._from_value(out.astype(xv.dtype))
+    return t.to_sparse_coo(t.ndim - 1)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-mask attention (reference: sparse/nn/functional/
+    transformer.py — scores kept only at sparse_mask's nonzeros)."""
+    from ....nn.functional.attention import scaled_dot_product_attention
+
+    qd = query.to_dense() if hasattr(query, "to_dense") else query
+    kd = key.to_dense() if hasattr(key, "to_dense") else key
+    vd = value.to_dense() if hasattr(value, "to_dense") else value
+    md = sparse_mask.to_dense() if hasattr(sparse_mask, "to_dense") \
+        else sparse_mask
+    import numpy as np
+
+    mv = md._value if isinstance(md, Tensor) else jnp.asarray(md)
+    add_mask = jnp.where(mv != 0, 0.0, -1e9).astype(jnp.float32)
+    return scaled_dot_product_attention(
+        qd, kd, vd, attn_mask=Tensor._from_value(add_mask))

@@ -1,3 +1,18 @@
 from .cpp_extension import CppExtension, CUDAExtension, load, setup
 
 __all__ = ["CppExtension", "CUDAExtension", "load", "setup"]
+
+
+def get_build_directory(verbose=False):
+    """Reference: utils/cpp_extension/extension_utils.py
+    get_build_directory — the default dir `load` builds into
+    ($PADDLE_EXTENSION_DIR or ~/.cache/paddle_tpu/extensions)."""
+    import os
+
+    root = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu", "extensions")
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+__all__ += ["get_build_directory"]

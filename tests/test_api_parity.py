@@ -28,7 +28,13 @@ NAMESPACES = [
     "hub.py", "onnx/__init__.py", "incubate/__init__.py",
     "incubate/nn/__init__.py", "incubate/nn/functional/__init__.py", "distributed/fleet/__init__.py",
     "distributed/fleet/utils/__init__.py", "nn/initializer/__init__.py",
-    "optimizer/lr.py", "utils/__init__.py",
+    "optimizer/lr.py", "utils/__init__.py", "sparse/nn/__init__.py",
+    "sparse/nn/functional/__init__.py", "nn/quant/__init__.py",
+    "distributed/communication/stream/__init__.py",
+    "device/cuda/__init__.py", "device/xpu/__init__.py",
+    "cost_model/__init__.py", "distributed/passes/__init__.py",
+    "inference/__init__.py", "incubate/asp/__init__.py",
+    "utils/cpp_extension/__init__.py",
 ]
 
 

@@ -127,3 +127,81 @@ class TestOps:
         y = sparse.cast(x, value_dtype="float64")
         assert str(y.dtype) == "float64"
         assert sparse.is_same_shape(x, y)
+
+
+class TestSparseNN:
+    def _coo(self):
+        dense = np.zeros((1, 6, 6, 2), "float32")
+        dense[0, 1, 1] = [1.0, -2.0]
+        dense[0, 4, 3] = [-0.5, 3.0]
+        return dense, paddle.to_tensor(dense).to_sparse_coo(3)
+
+    def test_activations_preserve_structure(self):
+        import paddle_tpu.sparse.nn as snn
+
+        dense, x = self._coo()
+        np.testing.assert_allclose(snn.ReLU()(x).to_dense().numpy(),
+                                   np.maximum(dense, 0))
+        np.testing.assert_allclose(
+            snn.LeakyReLU(0.1)(x).to_dense().numpy(),
+            np.where(dense >= 0, dense, 0.1 * dense), rtol=1e-6)
+        r6 = snn.ReLU6()(x).to_dense().numpy()
+        assert r6.max() <= 6.0 and (r6 >= 0).all()
+
+    def test_subm_conv_masks_to_active_sites(self):
+        import paddle_tpu.sparse.nn as snn
+
+        paddle.seed(0)
+        dense, x = self._coo()
+        out = snn.SubmConv2D(2, 4, 3, padding=1)(x).to_dense().numpy()
+        active = np.abs(dense).sum(-1) > 0
+        assert np.abs(out[0][~active[0]]).sum() == 0
+        assert np.abs(out[0][active[0]]).sum() > 0
+
+    def test_dense_conv_and_pool_shapes(self):
+        import paddle_tpu.sparse.nn as snn
+
+        paddle.seed(0)
+        x = paddle.to_tensor(
+            np.random.rand(1, 4, 4, 4, 2).astype("f4")).to_sparse_coo(4)
+        c = snn.Conv3D(2, 3, 3, padding=1)(x)
+        assert list(c.to_dense().shape) == [1, 4, 4, 4, 3]
+        p = snn.MaxPool3D(2)(x)
+        assert list(p.to_dense().shape) == [1, 2, 2, 2, 2]
+
+    def test_softmax_rows_sum_to_one(self):
+        import paddle_tpu.sparse.nn as snn
+
+        _, x = self._coo()
+        sv = snn.Softmax()(x).to_dense().numpy()
+        np.testing.assert_allclose(sv[0, 1, 1].sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(sv[0, 4, 3].sum(), 1.0, rtol=1e-5)
+
+    def test_batchnorm_values(self):
+        import paddle_tpu.sparse.nn as snn
+
+        _, x = self._coo()
+        out = snn.BatchNorm(2)(x)
+        assert list(out.to_dense().shape) == [1, 6, 6, 2]
+
+
+class TestNNQuant:
+    def test_quant_dequant_roundtrip(self):
+        import paddle_tpu.nn.quant as q
+
+        w = np.random.RandomState(0).randn(16, 8).astype("float32")
+        qt, sc = q.weight_quantize(paddle.to_tensor(w))
+        assert qt.numpy().dtype == np.int8
+        back = q.weight_dequantize(qt, sc, out_dtype="float32").numpy()
+        assert np.abs(back - w).max() < np.abs(w).max() / 64
+
+    def test_weight_only_linear_close_to_dense(self):
+        import paddle_tpu.nn.quant as q
+
+        rng = np.random.RandomState(1)
+        w = rng.randn(16, 8).astype("float32")
+        x = rng.randn(4, 16).astype("float32")
+        qt, sc = q.weight_quantize(paddle.to_tensor(w))
+        y = q.weight_only_linear(paddle.to_tensor(x), qt,
+                                 weight_scale=sc).numpy()
+        np.testing.assert_allclose(y, x @ w, rtol=0.1, atol=0.15)
