@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -46,10 +47,15 @@ def _emit(metric, value, unit, mfu):
 def _profile_one_step(step_fn, *args):
     import paddle_tpu.profiler as profiler
 
-    with profiler.Profiler(
-            targets=[profiler.ProfilerTarget.CPU]) as prof:
+    # Default targets include ProfilerTarget.TPU when an accelerator is
+    # attached, so the export merges XLA xplane DEVICE events (decoded by
+    # profiler/xplane.py) next to the host spans in one chrome trace.
+    with profiler.Profiler() as prof:
         step_fn(*args)
-    prof.export("bench_trace.json")
+    evs = prof.export("bench_trace.json") or []
+    n_dev = sum(1 for e in evs if e.get("cat") == "device")
+    print(json.dumps({"profile_events": len(evs),
+                      "profile_device_events": n_dev}), flush=True)
     return "bench_trace.json"
 
 
@@ -236,6 +242,30 @@ def bench_moe(on_tpu, steps, warmup, peak_flops):
           f"{tok_s:.0f} tok/s, mfu={mfu:.3f})", step_ms, "ms/step", mfu)
 
 
+def _run_isolated(config: str, args) -> int:
+    """Run one bench config in its own subprocess.
+
+    Each config gets a fresh process (and therefore a fresh TPU client):
+    a previous config's live buffers — e.g. MoE's 6.6 GB of params+opt
+    state — can never OOM the next one, and a crash in one config still
+    lets the others print their JSON line (round-2's BENCH record lost
+    the flagship Llama metric to exactly that cascade).
+    """
+    import subprocess
+    import sys
+
+    cmd = [sys.executable, os.path.abspath(__file__), "--config", config]
+    if args.steps:
+        cmd += ["--steps", str(args.steps)]
+    if args.profile and config == "llama":
+        cmd += ["--profile"]
+    proc = subprocess.run(cmd)
+    if proc.returncode != 0:
+        print(f"bench config {config!r} FAILED rc={proc.returncode}",
+              file=sys.stderr, flush=True)
+    return proc.returncode
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="all",
@@ -244,6 +274,12 @@ def main():
     ap.add_argument("--steps", type=int, default=None)
     args = ap.parse_args()
 
+    if args.config == "all":
+        # flagship (llama) runs and prints LAST: the driver's summary
+        # parses the final JSON line as the headline metric
+        rcs = [_run_isolated(c, args) for c in ("resnet", "moe", "llama")]
+        raise SystemExit(sum(1 for rc in rcs if rc != 0))
+
     import jax
 
     on_tpu = any(d.platform != "cpu" for d in jax.devices())
@@ -251,13 +287,11 @@ def main():
     steps = args.steps or (20 if on_tpu else 3)
     warmup = 3 if on_tpu else 1
 
-    # flagship (llama) prints LAST: the driver's summary parses the
-    # final JSON line as the headline metric
-    if args.config in ("resnet", "all"):
+    if args.config == "resnet":
         bench_resnet(on_tpu, steps, warmup, peak_flops)
-    if args.config in ("moe", "all"):
+    elif args.config == "moe":
         bench_moe(on_tpu, steps, warmup, peak_flops)
-    if args.config in ("llama", "all"):
+    elif args.config == "llama":
         bench_llama(on_tpu, steps, warmup, peak_flops, profile=args.profile)
 
 

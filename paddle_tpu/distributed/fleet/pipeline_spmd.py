@@ -225,7 +225,10 @@ def pipeline_spmd_train_step(stage_fn, loss_fn, stacked_params, micro_inputs,
 
         state, _ = lax.scan(tick, state, jnp.arange(T))
         loss = lax.psum(state["loss"], axis) / M
-        grads = jax.tree_util.tree_map(lambda g: g[None], state["grads"])
+        # per-microbatch grads were accumulated as a SUM; divide by M so
+        # both schedules return the gradient of the returned MEAN loss
+        grads = jax.tree_util.tree_map(
+            lambda g: (g / M)[None], state["grads"])
         return loss, grads
 
     _LAST_1F1B_RING_SHAPES["in_ring"] = (S,) + tuple(micro_inputs.shape[1:])

@@ -259,6 +259,13 @@ _CURRENT_CARRIERS: Dict[int, "Carrier"] = {}
 
 def _deliver_remote(src_id, dst_id, message_type, scope_idx):
     """RPC endpoint: hand a message to this process's carrier."""
+    if message_type == DONE and dst_id == -1:
+        # rank-sinks-done broadcast, scoped to one job by its fingerprint
+        # (scope_idx) so concurrent jobs in one process don't cross-talk
+        for carrier in _CURRENT_CARRIERS.values():
+            if carrier._job_key == scope_idx:
+                carrier._on_rank_sinks_done(src_id)
+        return True
     for carrier in _CURRENT_CARRIERS.values():
         if dst_id in carrier.interceptors:
             carrier.deliver(InterceptorMessage(src_id, dst_id, message_type,
@@ -267,11 +274,18 @@ def _deliver_remote(src_id, dst_id, message_type, scope_idx):
     return False
 
 
+def _job_fingerprint(task_id_to_rank: Dict[int, int]) -> int:
+    import zlib
+
+    return zlib.crc32(repr(sorted(task_id_to_rank.items())).encode())
+
+
 class Carrier:
     """Owns this rank's interceptors and routes messages (carrier.h:50)."""
 
     def __init__(self, carrier_id: str, rank: int, bus: MessageBus,
-                 task_id_to_rank: Dict[int, int]):
+                 task_id_to_rank: Dict[int, int],
+                 sink_ranks: Optional[set] = None):
         self.carrier_id = carrier_id
         self.rank = rank
         self.bus = bus
@@ -280,6 +294,13 @@ class Carrier:
         self._done = threading.Event()
         self._expected_sinks = 0
         self._done_sinks: set = set()
+        # ranks that own >= 1 sink, GLOBALLY: the job is done only when
+        # every one of them reports its local sinks finished. None =
+        # unknown topology (direct Carrier construction): fall back to
+        # local-only completion.
+        self._sink_ranks = set(sink_ranks) if sink_ranks is not None else None
+        self._done_ranks: set = set()
+        self._job_key = _job_fingerprint(task_id_to_rank)
         bus.register(rank, self)
         _CURRENT_CARRIERS[rank] = self
 
@@ -306,6 +327,12 @@ class Carrier:
             self.bus.send(rank, msg)
 
     def deliver(self, msg: InterceptorMessage):
+        if msg.message_type == DONE and msg.dst_id == -1:
+            # rank-sinks-done broadcast (src_id = the reporting rank),
+            # scoped to this job by fingerprint
+            if msg.scope_idx == self._job_key:
+                self._on_rank_sinks_done(msg.src_id)
+            return
         itc = self.interceptors.get(msg.dst_id)
         if itc is None:
             raise KeyError(
@@ -313,16 +340,38 @@ class Carrier:
                 f"{msg.dst_id}")
         itc.enqueue(msg)
 
+    def _on_rank_sinks_done(self, rank: int):
+        """A rank reported ALL of its local sinks finished. The job is
+        done once every sink-owning rank has reported — not before, so a
+        multi-sink job never unblocks ranks whose sinks are mid-stream."""
+        self._done_ranks.add(rank)
+        if self._sink_ranks is None or \
+                self._done_ranks >= self._sink_ranks:
+            self._done.set()
+
     def notify_done(self, sink_id: int):
         self._done_sinks.add(sink_id)
         if len(self._done_sinks) >= self._expected_sinks:
-            self._done.set()
+            # all LOCAL sinks drained: report this rank to every carrier
+            # of the job (the reference signals completion through its
+            # brpc bus the same way; previously a sink-less rank's run()
+            # stopped its interceptors immediately, killing in-flight
+            # traffic)
+            self._on_rank_sinks_done(self.rank)
+            for rank in set(self.task_id_to_rank.values()):
+                if rank != self.rank:
+                    try:
+                        self.bus.send(rank, InterceptorMessage(
+                            self.rank, -1, DONE, self._job_key))
+                    except Exception:
+                        pass
 
     def wait(self, timeout: Optional[float] = None) -> bool:
-        if self._expected_sinks == 0:
-            # sink lives on another rank's carrier; that carrier's wait()
-            # is the job's completion signal
-            return True
+        # A carrier with no local sink blocks on the DONE broadcasts from
+        # the sink-owning rank(s), so run() on any rank only tears down
+        # its interceptors after the whole job drained.
+        if self._sink_ranks is not None and not self._sink_ranks:
+            return True  # degenerate job with no sinks anywhere
         return self._done.wait(timeout)
 
     def stop(self):
@@ -345,7 +394,10 @@ class FleetExecutor:
              rank: int = 0, num_micro_batches: Optional[int] = None):
         task_id_to_rank = task_id_to_rank or {
             t.task_id: t.rank for t in task_nodes}
-        carrier = Carrier(carrier_id, rank, self.bus, task_id_to_rank)
+        sink_ranks = {task_id_to_rank.get(t.task_id, t.rank)
+                      for t in task_nodes if t.role == "sink"}
+        carrier = Carrier(carrier_id, rank, self.bus, task_id_to_rank,
+                          sink_ranks=sink_ranks)
         for t in task_nodes:
             if num_micro_batches is not None and t.role != "cond":
                 t.max_run_times = num_micro_batches

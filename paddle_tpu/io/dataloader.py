@@ -406,10 +406,10 @@ class _MultiprocessIter:
         n = max(1, loader.num_workers)
         # Plain fork is NOT safe here: the training process is heavily
         # multithreaded (XLA runtime), and a fork can inherit a lock held
-        # mid-operation — observed as futex-deadlocked workers. forkserver
-        # forks children from a clean single-threaded server process
-        # instead; the server preloads the (jax-free) worker module once,
-        # so per-worker startup stays ~fork-fast. spawn is the fallback.
+        # mid-operation — observed as futex-deadlocked workers. _mp_context
+        # therefore uses spawn (see its docstring for why forkserver was
+        # rejected too); the startup cost is amortized by
+        # persistent_workers.
         ctx = _mp_context()
         self.index_q = ctx.Queue()
         self.result_q = ctx.Queue()
@@ -486,7 +486,29 @@ class _MultiprocessIter:
 
     def _attach(self, index_iter):
         """Persistent-worker epoch restart: reuse the live worker pool
-        with a fresh index stream (reference persistent_workers)."""
+        with a fresh index stream (reference persistent_workers).
+
+        If the previous epoch was abandoned mid-iteration (``break``),
+        jobs from the old index stream may still be queued or in flight;
+        drain and discard them first so the new epoch never yields stale
+        batches (mirrors the reference iterator reset)."""
+        import queue as _q
+
+        while self._next_seq < self._sent:
+            if self._next_seq in self._pending:
+                self._pending.pop(self._next_seq)
+                self._next_seq += 1
+                continue
+            try:
+                seq, _batch, _err = self.result_q.get(timeout=30.0)
+            except _q.Empty:
+                dead = [p.pid for p in self.procs if not p.is_alive()]
+                self._shutdown()
+                raise RuntimeError(
+                    "DataLoader worker pool stalled while draining stale "
+                    f"jobs on epoch restart (dead workers: {dead})")
+            self._pending[seq] = None
+        self._pending.clear()
         self.index_iter = index_iter
         self._exhausted = False
         self._fill()

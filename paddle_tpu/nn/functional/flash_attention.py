@@ -38,16 +38,37 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         from ...ops.manipulation import concat, squeeze, unsqueeze
         from ...ops.math import scale as _scale_op
 
+        import numpy as _np
+
         q = _scale_op(q, float(scale) * _math.sqrt(q.shape[-1]))
         cu_q = [int(i) for i in ensure_tensor(cu_seqlens_q).tolist()]
         cu_k = [int(i) for i in ensure_tensor(cu_seqlens_k).tolist()]
         outs = []
         for i in range(len(cu_q) - 1):
+            len_q = cu_q[i + 1] - cu_q[i]
+            len_k = cu_k[i + 1] - cu_k[i]
+            mask = None
+            if causal:
+                # BOTTOM-RIGHT-aligned causal mask, matching the Pallas
+                # varlen kernel and the reference varlen contract: query
+                # row r attends keys c <= r + (len_k - len_q). sdpa's
+                # is_causal is TOP-LEFT aligned, which diverges whenever
+                # len_k != len_q.
+                r = _np.arange(len_q)[:, None]
+                c = _np.arange(len_k)[None, :]
+                allow = c <= r + (len_k - len_q)
+                # finite large-negative (not -inf): a fully-masked query
+                # row (len_k < len_q) must softmax to uniform, not NaN —
+                # same choice as _sdpa_xla's causal branch
+                mask = ensure_tensor(_np.where(
+                    allow, 0.0,
+                    _np.finfo(_np.float32).min).astype("float32"))
             o = scaled_dot_product_attention(
                 unsqueeze(q[cu_q[i]: cu_q[i + 1]], 0),
                 unsqueeze(k[cu_k[i]: cu_k[i + 1]], 0),
                 unsqueeze(v[cu_k[i]: cu_k[i + 1]], 0),
-                dropout_p=dropout, is_causal=causal, training=training)
+                attn_mask=mask,
+                dropout_p=dropout, training=training)
             outs.append(squeeze(o, 0))
         return concat(outs, axis=0), None
 

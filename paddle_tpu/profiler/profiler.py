@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from enum import Enum
 from typing import Callable, Iterable, Optional, Union
 
@@ -248,6 +249,7 @@ class Profiler:
                 os.makedirs(self.log_dir, exist_ok=True)
                 jp.start_trace(self.log_dir)
                 self._device_tracing = True
+                self._device_trace_started = time.time()
             except Exception:
                 self._device_tracing = False
 
@@ -262,6 +264,7 @@ class Profiler:
             try:
                 import jax.profiler as jp
                 jp.stop_trace()
+                self._device_trace_captured = True
             except Exception:
                 pass
             self._device_tracing = False
@@ -300,9 +303,20 @@ class Profiler:
             ev = dict(ev)
             ev.setdefault("cat", "native")
             traces.append(ev)
+        # Merge device (TPU) events decoded from the XLA xplane capture, so
+        # one chrome trace holds both host and device timelines — the
+        # reference's ChromeTracingLogger shape. Gated on a capture having
+        # happened THIS session (plus an mtime filter) so a stale
+        # xplane.pb left in log_dir by an earlier run is never merged.
+        if getattr(self, "_device_trace_captured", False):
+            from .xplane import device_trace_events
+            traces.extend(device_trace_events(
+                self.log_dir,
+                newer_than=getattr(self, "_device_trace_started", 0.0)))
         with open(path, "w") as f:
             json.dump({"traceEvents": traces,
                        "displayTimeUnit": "ms"}, f)
+        return traces
 
     def summary(self, sorted_by=SummaryView.OverView, op_detail: bool = True,
                 thread_sep: bool = False, time_unit: str = "ms"):

@@ -1,0 +1,175 @@
+"""Minimal XSpace (xplane.pb) reader → chrome trace events.
+
+``jax.profiler.start_trace(log_dir)`` writes the device-side trace as a
+serialized ``tensorflow.profiler.XSpace`` protobuf under
+``log_dir/plugins/profile/<run>/<host>.xplane.pb``.  The reference exposes
+device timelines through its own ChromeTracingLogger
+(/root/reference/paddle/fluid/platform/profiler/chrometracing_logger.cc);
+here the device timeline comes from XLA, so we parse the xplane wire
+format directly (hand-rolled varint decoder — no TF/tensorboard
+dependency, which this image does not ship) and convert each device
+XLine/XEvent into a chrome ``"X"`` span.
+
+Only the fields needed for a timeline are decoded:
+
+    XSpace   { repeated XPlane planes = 1; }
+    XPlane   { int64 id = 1; string name = 2; repeated XLine lines = 3;
+               map<int64, XEventMetadata> event_metadata = 4; }
+    XLine    { int64 id = 1; string name = 2; int64 timestamp_ns = 3;
+               repeated XEvent events = 4; string display_name = 11; }
+    XEvent   { int64 metadata_id = 1; int64 offset_ps = 2;
+               int64 duration_ps = 3; }
+    XEventMetadata { int64 id = 1; string name = 2; string display_name=4 }
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Iterator, List, Tuple
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over a message's wire bytes."""
+    pos, n = 0, len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:  # varint
+            val, pos = _read_varint(buf, pos)
+        elif wt == 1:  # fixed64
+            val = int.from_bytes(buf[pos:pos + 8], "little")
+            pos += 8
+        elif wt == 2:  # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:  # fixed32
+            val = int.from_bytes(buf[pos:pos + 4], "little")
+            pos += 4
+        else:  # group / unknown: cannot skip safely
+            return
+        yield field, wt, val
+
+
+def _parse_event_metadata(buf: bytes) -> Tuple[int, str]:
+    """map entry value: XEventMetadata {id=1, name=2, display_name=4}."""
+    mid, name, display = 0, "", ""
+    for field, _, val in _fields(buf):
+        if field == 1:
+            mid = val
+        elif field == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == 4:
+            display = val.decode("utf-8", "replace")
+    return mid, display or name
+
+
+def _parse_map_entry(buf: bytes) -> Tuple[int, bytes]:
+    key, value = 0, b""
+    for field, _, val in _fields(buf):
+        if field == 1:
+            key = val if isinstance(val, int) else 0
+        elif field == 2:
+            value = val
+    return key, value
+
+
+def _zigzag_ok(v: int) -> int:
+    # xplane int64s are plain (not zigzag); mask to signed 64-bit
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _is_device_plane(name: str) -> bool:
+    # XLA device planes are "/device:TPU:0" etc.; host planes
+    # ("/host:CPU", python/TSL lines) are already covered by the host
+    # tracer and must not be re-labeled as device events.
+    return "/device:" in name or name.startswith(("TPU", "GPU"))
+
+
+def parse_xspace(data: bytes) -> List[dict]:
+    """Decode an XSpace blob into chrome trace event dicts.
+
+    Only DEVICE planes are emitted (see _is_device_plane)."""
+    traces: List[dict] = []
+    for field, _, plane_buf in _fields(data):
+        if field != 1:
+            continue
+        plane_id, plane_name = 0, ""
+        lines: List[bytes] = []
+        meta: dict = {}
+        for pf, _, pval in _fields(plane_buf):
+            if pf == 1:
+                plane_id = pval
+            elif pf == 2:
+                plane_name = pval.decode("utf-8", "replace")
+            elif pf == 3:
+                lines.append(pval)
+            elif pf == 4:
+                k, v = _parse_map_entry(pval)
+                mid, mname = _parse_event_metadata(v)
+                meta[mid or k] = mname
+        if not _is_device_plane(plane_name):
+            continue
+        for line_buf in lines:
+            line_name, ts_ns = "", 0
+            events: List[bytes] = []
+            for lf, _, lval in _fields(line_buf):
+                if lf == 2:
+                    line_name = lval.decode("utf-8", "replace")
+                elif lf == 3:
+                    ts_ns = _zigzag_ok(lval)
+                elif lf == 4:
+                    events.append(lval)
+                elif lf == 11 and lval:
+                    line_name = lval.decode("utf-8", "replace")
+            for ev_buf in events:
+                mid, off_ps, dur_ps = 0, 0, 0
+                for ef, _, eval_ in _fields(ev_buf):
+                    if ef == 1:
+                        mid = eval_
+                    elif ef == 2:
+                        off_ps = _zigzag_ok(eval_)
+                    elif ef == 3:
+                        dur_ps = _zigzag_ok(eval_)
+                traces.append({
+                    "name": meta.get(mid, f"event#{mid}"),
+                    "ph": "X", "cat": "device",
+                    # chrome trace wants microseconds
+                    "ts": (ts_ns + off_ps / 1e3) / 1e3,
+                    "dur": max(dur_ps / 1e6, 0.001),
+                    "pid": f"{plane_name or f'plane#{plane_id}'}",
+                    "tid": line_name or "line",
+                })
+    return traces
+
+
+def device_trace_events(log_dir: str, newer_than: float = 0.0) -> List[dict]:
+    """Find the newest ``*.xplane.pb`` under log_dir and decode it.
+
+    ``newer_than`` (unix mtime) filters out stale captures from earlier
+    runs sharing the same log_dir. Returns [] when no capture exists
+    (CPU-only run, trace disabled).
+    """
+    paths = [p for p in glob.glob(os.path.join(log_dir, "plugins", "profile",
+                                               "*", "*.xplane.pb"))
+             if os.path.getmtime(p) >= newer_than]
+    if not paths:
+        return []
+    path = max(paths, key=os.path.getmtime)
+    try:
+        with open(path, "rb") as f:
+            return parse_xspace(f.read())
+    except Exception:
+        return []

@@ -112,6 +112,22 @@ def test_persistent_workers_reused_across_epochs():
     it1._shutdown()
 
 
+def test_persistent_workers_abandoned_epoch_restart():
+    """Breaking out of an epoch mid-iteration must not leak stale batches
+    into the next epoch: _attach drains in-flight jobs from the old index
+    stream first (reference iterator reset semantics)."""
+    dl = DataLoader(FastDataset(32), batch_size=4, num_workers=2,
+                    persistent_workers=True)
+    it1 = iter(dl)
+    first = next(it1).numpy()  # abandon the epoch with 7 batches pending
+    it2 = iter(dl)
+    assert it2 is it1  # same pool, re-armed
+    batches = [b.numpy() for b in it2]
+    assert len(batches) == 8, f"epoch yielded {len(batches)} batches, not 8"
+    np.testing.assert_array_equal(batches[0], first)  # fresh stream start
+    it1._shutdown()
+
+
 def test_unpicklable_dataset_falls_back_to_threads():
     class Local(Dataset):  # local class: not picklable for forkserver/spawn
         def __getitem__(self, idx):

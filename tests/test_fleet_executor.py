@@ -129,3 +129,80 @@ class TestMultiCarrier:
         c1.stop()
         assert [mb for t, mb in log if t == "sink"] == list(range(M))
         assert [mb for t, mb in log if t == "a"] == list(range(M))
+
+    def test_run_on_sinkless_rank_waits_for_done_broadcast(self):
+        """run() on a rank that hosts no sink must NOT tear down its
+        interceptors while micro-batches are in flight: it blocks until
+        the sink-owning rank broadcasts job-done over the bus."""
+        import threading
+
+        M = 6
+        log = []
+        nodes, _ = _pipeline_nodes(M, log)
+        nodes[0].rank = 0
+        nodes[1].rank = 0
+        nodes[2].rank = 1
+        nodes[3].rank = 1
+        bus = MessageBus()
+        fe = FleetExecutor(bus)
+        mapping = {t.task_id: t.rank for t in nodes}
+        fe.init("c0", nodes, task_id_to_rank=mapping, rank=0,
+                num_micro_batches=M)
+        fe.init("c1", nodes, task_id_to_rank=mapping, rank=1,
+                num_micro_batches=M)
+        # rank 1 (sink owner) waits in a thread; rank 0 (source, NO sink)
+        # drives run() — the schedule that used to stop rank 0 early
+        ok1 = []
+        t1 = threading.Thread(target=lambda: ok1.append(
+            fe.run("c1", timeout=30)))
+        t1.start()
+        assert fe.run("c0", timeout=30)
+        t1.join(30)
+        assert ok1 == [True]
+        assert [mb for t, mb in log if t == "sink"] == list(range(M))
+        assert [mb for t, mb in log if t == "a"] == list(range(M))
+
+    def test_multi_sink_job_waits_for_all_sink_ranks(self):
+        """With sinks on BOTH ranks, the fast rank's completion must not
+        unblock the other rank while its sink still streams: done fires
+        only after every sink-owning rank reports."""
+        import threading
+
+        M = 6
+        log = []
+        lock = threading.Lock()
+        # rank 0: source -> fast sink (1 mb). rank 1: compute chain -> slow
+        # sink (M mbs), fed from the same source.
+        src = TaskNode(task_id=0, rank=0, role="source", max_run_times=M)
+        fast_sink = TaskNode(task_id=1, rank=0, role="sink", max_run_times=M)
+        slow = TaskNode(
+            task_id=2, rank=1, role="compute", max_run_times=M,
+            run_fn=lambda mb: (time.sleep(0.02),
+                               lock.__enter__(), log.append(("slow", mb)),
+                               lock.__exit__(None, None, None)))
+        slow_sink = TaskNode(
+            task_id=3, rank=1, role="sink", max_run_times=M,
+            run_fn=lambda mb: log.append(("sink1", mb)))
+        src.add_downstream_task(1, 2)
+        src.add_downstream_task(2, 2)
+        fast_sink.add_upstream_task(0, 2)
+        slow.add_upstream_task(0, 2)
+        slow.add_downstream_task(3, 2)
+        slow_sink.add_upstream_task(2, 2)
+        nodes = [src, fast_sink, slow, slow_sink]
+
+        bus = MessageBus()
+        fe = FleetExecutor(bus)
+        mapping = {t.task_id: t.rank for t in nodes}
+        fe.init("c0", nodes, task_id_to_rank=mapping, rank=0,
+                num_micro_batches=M)
+        fe.init("c1", nodes, task_id_to_rank=mapping, rank=1,
+                num_micro_batches=M)
+        ok1 = []
+        t1 = threading.Thread(target=lambda: ok1.append(
+            fe.run("c1", timeout=30)))
+        t1.start()
+        assert fe.run("c0", timeout=30)
+        t1.join(30)
+        assert ok1 == [True]
+        assert [mb for t, mb in log if t == "sink1"] == list(range(M))

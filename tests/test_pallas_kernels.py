@@ -324,3 +324,41 @@ class TestVarlenFlashAttention:
         np.testing.assert_allclose(qt.grad.numpy(), gq, rtol=2e-3, atol=2e-3)
         np.testing.assert_allclose(kt.grad.numpy(), gk, rtol=2e-3, atol=2e-3)
         np.testing.assert_allclose(vt.grad.numpy(), gv, rtol=2e-3, atol=2e-3)
+
+    def test_dropout_fallback_bottom_right_causal(self):
+        """The dropout>0 dense fallback must use BOTTOM-RIGHT-aligned
+        causal masking (the varlen contract) when len_k != len_q: query
+        row r attends keys c <= r + (len_k - len_q). One-hot values make
+        attention reach observable: over many rng draws every ALLOWED key
+        must contribute at least once and every FORBIDDEN key never."""
+        import paddle_tpu.nn.functional.flash_attention as FA
+
+        rng = np.random.RandomState(7)
+        len_q, len_k, h, d = 2, 6, 2, 8
+        q = rng.randn(len_q, h, d).astype("float32")
+        k = rng.randn(len_k, h, d).astype("float32")
+        v = np.zeros((len_k, h, d), dtype="float32")
+        for t in range(len_k):
+            v[t, :, t] = 1.0  # v one-hot in key position
+        cu_q = np.array([0, len_q], dtype="int32")
+        cu_k = np.array([0, len_k], dtype="int32")
+        scale = 1.0 / np.sqrt(d)
+
+        acc = np.zeros((len_q, len_k))
+        for _ in range(30):
+            out, _ = FA.flash_attn_unpadded(
+                _t(q), _t(k), _t(v), _t(cu_q), _t(cu_k),
+                len_q, len_k, scale, dropout=0.3, causal=True,
+                training=True)
+            acc += np.abs(out.numpy()[:, 0, :len_k])
+
+        off = len_k - len_q
+        for r in range(len_q):
+            for c in range(len_k):
+                if c <= r + off:
+                    assert acc[r, c] > 0, (
+                        f"allowed key {c} never reached by row {r} - "
+                        "top-left-aligned mask?")
+                else:
+                    assert acc[r, c] == 0, (
+                        f"forbidden key {c} leaked into row {r}")

@@ -180,3 +180,61 @@ def test_bench_profile_writes_trace(tmp_path):
     trace = tmp_path / "bench_trace.json"
     assert trace.exists()
     json.loads(trace.read_text())  # valid chrome trace JSON
+
+
+class TestXplaneParser:
+    """profiler/xplane.py: hand-rolled XSpace wire decoder used to merge
+    XLA device events into the exported chrome trace."""
+
+    @staticmethod
+    def _varint(v):
+        out = b""
+        while True:
+            b7 = v & 0x7F
+            v >>= 7
+            out += bytes([b7 | (0x80 if v else 0)])
+            if not v:
+                return out
+
+    @classmethod
+    def _field(cls, num, wt, payload):
+        key = cls._varint((num << 3) | wt)
+        if wt == 0:
+            return key + cls._varint(payload)
+        return key + cls._varint(len(payload)) + payload
+
+    def test_decodes_device_plane_events(self):
+        from paddle_tpu.profiler.xplane import parse_xspace
+
+        f = self._field
+        # XEventMetadata {id=7, name="fusion.3"}
+        md = f(1, 0, 7) + f(2, 2, b"fusion.3")
+        # map entry {key=7, value=md}
+        entry = f(1, 0, 7) + f(2, 2, md)
+        # XEvent {metadata_id=7, offset_ps=2_000_000, duration_ps=5_000_000}
+        ev = f(1, 0, 7) + f(2, 0, 2_000_000) + f(3, 0, 5_000_000)
+        # XLine {name="XLA Ops", timestamp_ns=1000, events=[ev]}
+        line = f(2, 2, b"XLA Ops") + f(3, 0, 1000) + f(4, 2, ev)
+        # XPlane {id=1, name="/device:TPU:0", lines=[line], event_metadata}
+        plane = f(1, 0, 1) + f(2, 2, b"/device:TPU:0") + \
+            f(3, 2, line) + f(4, 2, entry)
+        space = f(1, 2, plane)
+
+        evs = parse_xspace(space)
+        assert len(evs) == 1
+        e = evs[0]
+        assert e["name"] == "fusion.3"
+        assert e["cat"] == "device"
+        assert e["pid"] == "/device:TPU:0"
+        assert e["tid"] == "XLA Ops"
+        # ts us = (1000ns + 2_000_000ps/1e3) / 1e3 = 3.0; dur us = 5.0
+        assert abs(e["ts"] - 3.0) < 1e-9
+        assert abs(e["dur"] - 5.0) < 1e-9
+
+    def test_unknown_and_empty_input(self):
+        from paddle_tpu.profiler.xplane import (
+            device_trace_events, parse_xspace,
+        )
+
+        assert parse_xspace(b"") == []
+        assert device_trace_events("/nonexistent/dir") == []
