@@ -59,3 +59,41 @@ def get_device_properties(device_id: Optional[int] = None):
         "id": d.id,
         "total_memory": s.get("bytes_limit", 0),
     }
+
+
+def compiled_memory_stats(jitted_fn, *args) -> Dict[str, int]:
+    """Compiler-reported memory budget of a jitted function at these
+    argument shapes: {temp, argument, output, alias, generated_code}
+    bytes. This is XLA's buffer-assignment result — the deterministic
+    analog of peeking allocator stats after a run, and the measurement
+    the recompute pass is judged by (reference: the memory estimates in
+    auto_parallel/static/cost_model used by auto_parallel_recompute)."""
+    compiled = jitted_fn.lower(*args).compile()
+    ma = compiled.memory_analysis()
+    if ma is None:
+        return {}
+    return {
+        "temp_size_in_bytes": int(getattr(ma, "temp_size_in_bytes", 0)),
+        "argument_size_in_bytes": int(
+            getattr(ma, "argument_size_in_bytes", 0)),
+        "output_size_in_bytes": int(getattr(ma, "output_size_in_bytes", 0)),
+        "alias_size_in_bytes": int(getattr(ma, "alias_size_in_bytes", 0)),
+        "generated_code_size_in_bytes": int(
+            getattr(ma, "generated_code_size_in_bytes", 0)),
+    }
+
+
+def vjp_residual_bytes(fn, *args) -> int:
+    """Bytes of residuals saved between forward and backward of ``fn``
+    at these arguments — the fwd->bwd live set that activation
+    recomputation (auto_parallel_recompute / jax.checkpoint) shrinks.
+    Backend-independent, unlike buffer-assignment temp sizes (the CPU
+    backend reports those as 0)."""
+    import jax
+
+    _, vjp_fn = jax.vjp(fn, *args)
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(vjp_fn):
+        if hasattr(leaf, "dtype") and hasattr(leaf, "size"):
+            total += int(leaf.size) * leaf.dtype.itemsize
+    return total

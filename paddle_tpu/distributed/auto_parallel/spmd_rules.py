@@ -402,3 +402,682 @@ def _split_rule(x: DistTensorSpec, num_or_sections=2, axis: int = 0, **attrs):
         shape[ax] = s
         outs.append(DistTensorSpec.from_dims_mapping(shape, mesh, mapping))
     return [DistTensorSpec.from_dims_mapping(x.shape, mesh, mapping)], outs
+
+
+# ------------------------------------------------- pass-through & unary
+def _passthrough(x: DistTensorSpec) -> Tuple[list, list]:
+    spec = DistTensorSpec.from_dims_mapping(x.shape, x.mesh,
+                                            x.dims_mapping())
+    return [spec], [DistTensorSpec.from_dims_mapping(
+        x.shape, x.mesh, x.dims_mapping())]
+
+
+@register_spmd_rule("cast")
+def _cast_rule(x: DistTensorSpec, dtype=None, **attrs):
+    """Reference: spmd_rules/cast.cc — layout-preserving."""
+    return _passthrough(x)
+
+
+@register_spmd_rule("scale")
+def _scale_rule(x: DistTensorSpec, scale=1.0, bias=0.0, **attrs):
+    """Reference: spmd_rules/scale.cc — layout-preserving."""
+    return _passthrough(x)
+
+
+@register_spmd_rule("pow")
+def _pow_rule(x: DistTensorSpec, factor=1.0, **attrs):
+    """Reference: spmd_rules/pow.cc — layout-preserving."""
+    return _passthrough(x)
+
+
+@register_spmd_rule("full_like")
+def _full_like_rule(x: DistTensorSpec, value=0.0, **attrs):
+    """Reference: spmd_rules/full_like.cc — output mirrors input layout
+    (a fill needs no data movement under any sharding)."""
+    return _passthrough(x)
+
+
+@register_spmd_rule("triu")
+def _triu_rule(x: DistTensorSpec, diagonal: int = 0, **attrs):
+    """Reference: spmd_rules/triu.cc — the masked last two dims stay
+    replicated (the mask needs global row/col indices); batch dims pass."""
+    mapping = x.dims_mapping()
+    for i in (x.ndim - 2, x.ndim - 1):
+        mapping[i] = -1
+    spec = DistTensorSpec.from_dims_mapping(x.shape, x.mesh, mapping)
+    return [spec], [DistTensorSpec.from_dims_mapping(x.shape, x.mesh,
+                                                     mapping)]
+
+
+@register_spmd_rule("flip")
+def _flip_rule(x: DistTensorSpec, axis=(), **attrs):
+    """Flipped axes must be whole (a local flip would reverse only the
+    shard); others pass through."""
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    mapping = x.dims_mapping()
+    for a in axes:
+        mapping[a % x.ndim] = -1
+    spec = DistTensorSpec.from_dims_mapping(x.shape, x.mesh, mapping)
+    return [spec], [DistTensorSpec.from_dims_mapping(x.shape, x.mesh,
+                                                     mapping)]
+
+
+# ------------------------------------------------ dim-transform family
+@register_spmd_rule("squeeze")
+def _squeeze_rule(x: DistTensorSpec, axis=None, **attrs):
+    """Reference: spmd_rules/squeeze.cc (dim_trans) — dropped size-1 dims
+    carry no sharding; surviving dims keep theirs."""
+    if axis is None:
+        drop = [i for i, d in enumerate(x.shape) if d == 1]
+    else:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        drop = sorted(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    mapping = x.dims_mapping()
+    out_shape = [d for i, d in enumerate(x.shape) if i not in drop]
+    out_mapping = [m for i, m in enumerate(mapping) if i not in drop]
+    out = DistTensorSpec.from_dims_mapping(out_shape, x.mesh, out_mapping)
+    return [DistTensorSpec.from_dims_mapping(x.shape, x.mesh, mapping)], [out]
+
+
+@register_spmd_rule("unsqueeze")
+def _unsqueeze_rule(x: DistTensorSpec, axis=0, **attrs):
+    """Reference: spmd_rules/unsqueeze.cc — inserted size-1 dims are
+    replicated; existing dims keep their sharding."""
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    out_ndim = x.ndim + len(axes)
+    axes = sorted(a % out_ndim for a in axes)
+    mapping = x.dims_mapping()
+    out_shape, out_mapping, src = [], [], 0
+    for i in range(out_ndim):
+        if i in axes:
+            out_shape.append(1)
+            out_mapping.append(-1)
+        else:
+            out_shape.append(x.shape[src])
+            out_mapping.append(mapping[src])
+            src += 1
+    out = DistTensorSpec.from_dims_mapping(out_shape, x.mesh, out_mapping)
+    return [DistTensorSpec.from_dims_mapping(x.shape, x.mesh, mapping)], [out]
+
+
+@register_spmd_rule("flatten")
+def _flatten_rule(x: DistTensorSpec, start_axis: int = 0,
+                  stop_axis: int = -1, **attrs):
+    """Reference: spmd_rules/flatten.cc — the merged range keeps the
+    FIRST merged dim's sharding (a [s, ...] merge stays contiguous per
+    shard); outside dims pass through."""
+    a = start_axis % x.ndim
+    b = stop_axis % x.ndim
+    mapping = x.dims_mapping()
+    merged = 1
+    for d in x.shape[a:b + 1]:
+        merged *= d
+    out_shape = x.shape[:a] + [merged] + x.shape[b + 1:]
+    out_mapping = mapping[:a] + [mapping[a]] + mapping[b + 1:]
+    new_in_mapping = list(mapping)
+    for i in range(a + 1, b + 1):
+        new_in_mapping[i] = -1  # only the leading merged dim may shard
+    new_in = DistTensorSpec.from_dims_mapping(x.shape, x.mesh,
+                                              new_in_mapping)
+    out = DistTensorSpec.from_dims_mapping(out_shape, x.mesh, out_mapping)
+    return [new_in], [out]
+
+
+@register_spmd_rule("tile")
+def _tile_rule(x: DistTensorSpec, repeat_times=(), **attrs):
+    """Reference: spmd_rules/tile.cc — tiled (repeat > 1) dims must be
+    whole; untouched dims keep their sharding."""
+    reps = list(repeat_times)
+    out_ndim = max(x.ndim, len(reps))
+    reps = [1] * (out_ndim - len(reps)) + reps
+    in_off = out_ndim - x.ndim
+    mapping = x.dims_mapping()
+    new_in_mapping = list(mapping)
+    out_shape, out_mapping = [], []
+    for i in range(out_ndim):
+        src = i - in_off
+        size = x.shape[src] if src >= 0 else 1
+        if reps[i] != 1:
+            if src >= 0:
+                new_in_mapping[src] = -1
+            out_shape.append(size * reps[i])
+            out_mapping.append(-1)
+        else:
+            out_shape.append(size)
+            out_mapping.append(mapping[src] if src >= 0 else -1)
+    new_in = DistTensorSpec.from_dims_mapping(x.shape, x.mesh,
+                                              new_in_mapping)
+    out = DistTensorSpec.from_dims_mapping(out_shape, x.mesh, out_mapping)
+    return [new_in], [out]
+
+
+@register_spmd_rule("expand_as")
+def _expand_as_rule(x: DistTensorSpec, y: DistTensorSpec = None,
+                    target_shape=None, **attrs):
+    """Reference: spmd_rules/expand_as.cc — broadcasted dims replicated;
+    matching dims take x's sharding (or y's where x is size-1)."""
+    out_shape = list(y.shape) if y is not None else list(target_shape)
+    off = len(out_shape) - x.ndim
+    mapping = x.dims_mapping()
+    y_map = y.dims_mapping() if y is not None else [-1] * len(out_shape)
+    out_mapping = []
+    for i, d in enumerate(out_shape):
+        src = i - off
+        if src >= 0 and x.shape[src] == d:
+            out_mapping.append(mapping[src])
+        else:
+            out_mapping.append(y_map[i] if y is not None else -1)
+    out = DistTensorSpec.from_dims_mapping(out_shape, x.mesh, out_mapping)
+    new_in = [DistTensorSpec.from_dims_mapping(x.shape, x.mesh, mapping)]
+    if y is not None:
+        new_in.append(DistTensorSpec.from_dims_mapping(y.shape, y.mesh,
+                                                       y.dims_mapping()))
+    return new_in, [out]
+
+
+@register_spmd_rule("slice")
+def _slice_rule(x: DistTensorSpec, axes=(), starts=(), ends=(), **attrs):
+    """Reference: spmd_rules/slice.cc — sliced dims must be whole (a
+    local slice would cut every shard); untouched dims pass through."""
+    mapping = x.dims_mapping()
+    out_shape = list(x.shape)
+    for a, s, e in zip(axes, starts, ends):
+        a = a % x.ndim
+        mapping[a] = -1
+        lo = s % x.shape[a] if s < 0 else min(s, x.shape[a])
+        hi = e % x.shape[a] if e < 0 else min(e, x.shape[a])
+        out_shape[a] = max(hi - lo, 0)
+    new_in = DistTensorSpec.from_dims_mapping(x.shape, x.mesh, mapping)
+    out = DistTensorSpec.from_dims_mapping(out_shape, x.mesh, mapping)
+    return [new_in], [out]
+
+
+@register_spmd_rule("stack")
+def _stack_rule(*specs, axis: int = 0, **attrs):
+    """Reference: spmd_rules/stack.cc — inputs align; the new axis is
+    replicated."""
+    mesh = specs[0].mesh
+    ndim = specs[0].ndim
+    notation = _letters(ndim)
+    letters = _merge_letter_shardings([notation] * len(specs), list(specs))
+    new_in = [_apply_letters(notation, s.shape, mesh, letters)
+              for s in specs]
+    ax = axis % (ndim + 1)
+    out_not = notation[:ax] + "1" + notation[ax:]
+    out_shape = list(specs[0].shape)
+    out_shape.insert(ax, len(specs))
+    out = _apply_letters(out_not, out_shape, mesh, letters)
+    return new_in, [out]
+
+
+@register_spmd_rule("unbind")
+def _unbind_rule(x: DistTensorSpec, axis: int = 0, **attrs):
+    """Reference: spmd_rules/unbind.cc — the unbound axis must be whole;
+    each output drops it."""
+    ax = axis % x.ndim
+    mapping = x.dims_mapping()
+    mapping[ax] = -1
+    out_shape = [d for i, d in enumerate(x.shape) if i != ax]
+    out_mapping = [m for i, m in enumerate(mapping) if i != ax]
+    outs = [DistTensorSpec.from_dims_mapping(out_shape, x.mesh, out_mapping)
+            for _ in range(x.shape[ax])]
+    return [DistTensorSpec.from_dims_mapping(x.shape, x.mesh, mapping)], outs
+
+
+# ------------------------------------------------- scan / index family
+@register_spmd_rule("cumsum")
+def _cumsum_rule(x: DistTensorSpec, axis=None, flatten: bool = False,
+                 **attrs):
+    """Reference: spmd_rules/cumsum.cc — the scan axis must be whole
+    (prefix sums need the full axis); flatten mode replicates all."""
+    mapping = x.dims_mapping()
+    if flatten or axis is None:
+        mapping = [-1] * x.ndim
+    else:
+        mapping[axis % x.ndim] = -1
+    spec = DistTensorSpec.from_dims_mapping(x.shape, x.mesh, mapping)
+    return [spec], [DistTensorSpec.from_dims_mapping(x.shape, x.mesh,
+                                                     mapping)]
+
+
+@register_spmd_rule("argmax")
+def _argmax_rule(x: DistTensorSpec, axis: int = -1, keepdim: bool = False,
+                 **attrs):
+    """Reference: spmd_rules/argmax.cc — the reduced axis must be whole
+    (local argmax yields local indices); other dims pass through."""
+    ax = axis % x.ndim
+    mapping = x.dims_mapping()
+    mapping[ax] = -1
+    new_in = DistTensorSpec.from_dims_mapping(x.shape, x.mesh, mapping)
+    if keepdim:
+        out_shape = [1 if i == ax else d for i, d in enumerate(x.shape)]
+        out_mapping = list(mapping)
+        out_mapping[ax] = -1
+    else:
+        out_shape = [d for i, d in enumerate(x.shape) if i != ax]
+        out_mapping = [m for i, m in enumerate(mapping) if i != ax]
+    out = DistTensorSpec.from_dims_mapping(out_shape, x.mesh, out_mapping)
+    return [new_in], [out]
+
+
+@register_spmd_rule("topk")
+def _topk_rule(x: DistTensorSpec, k: int = 1, axis: int = -1, **attrs):
+    """topk along a sharded axis would return shard-local winners: the
+    axis must be whole. values and indices share the layout."""
+    ax = axis % x.ndim
+    mapping = x.dims_mapping()
+    mapping[ax] = -1
+    out_shape = list(x.shape)
+    out_shape[ax] = k
+    new_in = DistTensorSpec.from_dims_mapping(x.shape, x.mesh, mapping)
+    out = DistTensorSpec.from_dims_mapping(out_shape, x.mesh, mapping)
+    idx = DistTensorSpec.from_dims_mapping(out_shape, x.mesh, mapping)
+    return [new_in], [out, idx]
+
+
+@register_spmd_rule("gather")
+def _gather_rule(x: DistTensorSpec, index: DistTensorSpec, axis: int = 0,
+                 **attrs):
+    """Reference: spmd_rules/gather.cc — the gathered axis of x must be
+    whole; the index's sharding lands on the output's axis position."""
+    ax = axis % x.ndim
+    x_map = x.dims_mapping()
+    x_map[ax] = -1
+    idx_map = index.dims_mapping()
+    out_shape = x.shape[:ax] + list(index.shape) + x.shape[ax + 1:]
+    out_mapping = x_map[:ax] + idx_map + x_map[ax + 1:]
+    # one mesh dim may not shard two tensor dims
+    seen = set()
+    for i, m in enumerate(out_mapping):
+        if m >= 0 and m in seen:
+            out_mapping[i] = -1
+        elif m >= 0:
+            seen.add(m)
+    new_x = DistTensorSpec.from_dims_mapping(x.shape, x.mesh, x_map)
+    new_idx = DistTensorSpec.from_dims_mapping(index.shape, x.mesh, idx_map)
+    out = DistTensorSpec.from_dims_mapping(out_shape, x.mesh, out_mapping)
+    return [new_x, new_idx], [out]
+
+
+@register_spmd_rule("gather_nd")
+def _gather_nd_rule(x: DistTensorSpec, index: DistTensorSpec, **attrs):
+    """Reference: spmd_rules/gather_nd.cc — x replicated (arbitrary
+    addressing), index batch dims pass to the output."""
+    mesh = x.mesh
+    new_x = DistTensorSpec(x.shape, mesh, [Replicate()] * mesh.ndim)
+    idx_map = index.dims_mapping()
+    k = index.shape[-1]
+    out_shape = index.shape[:-1] + x.shape[k:]
+    out_mapping = idx_map[:-1] + [-1] * (x.ndim - k)
+    new_idx = DistTensorSpec.from_dims_mapping(index.shape, mesh, idx_map)
+    out = DistTensorSpec.from_dims_mapping(out_shape, mesh, out_mapping)
+    return [new_x, new_idx], [out]
+
+
+@register_spmd_rule("take_along_axis")
+def _take_along_axis_rule(x: DistTensorSpec, index: DistTensorSpec,
+                          axis: int = 0, **attrs):
+    """x and index align on non-axis dims; the axis must be whole."""
+    ax = axis % x.ndim
+    notation = _letters(x.ndim)
+    x_not = notation[:ax] + "1" + notation[ax + 1:]
+    letters = _merge_letter_shardings([x_not, x_not], [x, index])
+    new_x = _apply_letters(x_not, x.shape, x.mesh, letters)
+    new_idx = _apply_letters(x_not, index.shape, x.mesh, letters)
+    out = _apply_letters(x_not, index.shape, x.mesh, letters)
+    return [new_x, new_idx], [out]
+
+
+@register_spmd_rule("scatter")
+def _scatter_rule(x: DistTensorSpec, index: DistTensorSpec,
+                  updates: DistTensorSpec, overwrite: bool = True, **attrs):
+    """Reference: spmd_rules/scatter.cc — the scattered dim 0 must be
+    whole; trailing dims align between x and updates."""
+    notation = _letters(x.ndim)
+    x_not = "1" + notation[1:x.ndim]
+    u_not = "1" + notation[1:updates.ndim]
+    letters = _merge_letter_shardings([x_not, u_not], [x, updates])
+    new_x = _apply_letters(x_not, x.shape, x.mesh, letters)
+    new_u = _apply_letters(u_not, updates.shape, x.mesh, letters)
+    new_idx = DistTensorSpec(index.shape, x.mesh,
+                             [Replicate()] * x.mesh.ndim)
+    out = _apply_letters(x_not, x.shape, x.mesh, letters)
+    return [new_x, new_idx, new_u], [out]
+
+
+@register_spmd_rule("one_hot")
+def _one_hot_rule(x: DistTensorSpec, num_classes: int = 1, **attrs):
+    """Reference: spmd_rules/one_hot.cc — input layout passes through;
+    the new class dim is replicated."""
+    mapping = x.dims_mapping()
+    out_shape = list(x.shape) + [num_classes]
+    out = DistTensorSpec.from_dims_mapping(out_shape, x.mesh,
+                                           mapping + [-1])
+    return [DistTensorSpec.from_dims_mapping(x.shape, x.mesh,
+                                             mapping)], [out]
+
+
+@register_spmd_rule("where")
+def _where_rule(cond: DistTensorSpec, x: DistTensorSpec, y: DistTensorSpec,
+                **attrs):
+    """Reference: spmd_rules/where.cc — ternary elementwise broadcast."""
+    return _elementwise_rule(cond, x, y)
+
+
+@register_spmd_rule("add_n")
+def _add_n_rule(*specs, **attrs):
+    """Reference: spmd_rules/add_n.cc — n-ary elementwise sum."""
+    return _elementwise_rule(*specs)
+
+
+# --------------------------------------------- scalar-output reductions
+@register_spmd_rule("numel")
+def _numel_rule(x: DistTensorSpec, **attrs):
+    """Reference: spmd_rules/numel.cc — metadata-only scalar, replicated
+    output regardless of input sharding."""
+    mesh = x.mesh
+    new_x = DistTensorSpec.from_dims_mapping(x.shape, mesh,
+                                             x.dims_mapping())
+    out = DistTensorSpec([], mesh, [Replicate()] * mesh.ndim)
+    return [new_x], [out]
+
+
+@register_spmd_rule("squared_l2_norm")
+def _squared_l2_norm_rule(x: DistTensorSpec, **attrs):
+    """Reference: spmd_rules/squared_l2_norm.cc — keeps the input
+    sharding; the scalar is Partial over every sharded mesh dim (the
+    grad-clip global-norm pattern)."""
+    mesh = x.mesh
+    mapping = x.dims_mapping()
+    new_x = DistTensorSpec.from_dims_mapping(x.shape, mesh, mapping)
+    out = DistTensorSpec([], mesh, [Replicate()] * mesh.ndim)
+    for mdim in {m for m in mapping if m >= 0}:
+        out.placements[mdim] = Partial("sum")
+    return [new_x], [out]
+
+
+# ------------------------------------------------------- fused kernels
+@register_spmd_rule("swiglu")
+def _swiglu_rule(x: DistTensorSpec, y: Optional[DistTensorSpec] = None,
+                 **attrs):
+    """Reference: spmd_rules/swiglu.cc — elementwise over (gate, up)."""
+    if y is None:
+        return _passthrough(x)
+    return _elementwise_rule(x, y)
+
+
+@register_spmd_rule("fused_rope")
+def _fused_rope_rule(q: DistTensorSpec, k: Optional[DistTensorSpec] = None,
+                     v: Optional[DistTensorSpec] = None, **attrs):
+    """Reference: spmd_rules/fused_rope.cc — [B, S, H, D] layout: batch
+    and head dims may shard; seq (position lookup) and head_dim (the
+    rotated pairs) stay whole. q/k/v align batch/head mesh dims."""
+    specs = [s for s in (q, k, v) if s is not None]
+    mesh = q.mesh
+    notation = "b1h1"
+    letters = _merge_letter_shardings([notation] * len(specs), specs)
+    new_in = [_apply_letters(notation, s.shape, mesh, letters)
+              for s in specs]
+    outs = [_apply_letters(notation, s.shape, mesh, letters)
+            for s in specs]
+    return new_in, outs
+
+
+@register_spmd_rule("fused_linear_param_grad_add")
+def _fused_linear_param_grad_add_rule(
+        x: DistTensorSpec, dout: DistTensorSpec,
+        dweight: Optional[DistTensorSpec] = None,
+        dbias: Optional[DistTensorSpec] = None, **attrs):
+    """Reference: spmd_rules/fused_linear_param_grad_add.cc —
+    dweight = x^T @ dout contracts every batch/token dim: sharded batch
+    dims make the grads Partial; feature dims pass through."""
+    mesh = x.mesh
+    nb = x.ndim - 1
+    batch = _letters(nb, skip="kn")
+    x_not = batch + "k"
+    d_not = batch + "n"
+    letters = _merge_letter_shardings([x_not, d_not], [x, dout])
+    new_x = _apply_letters(x_not, x.shape, mesh, letters)
+    new_d = _apply_letters(d_not, dout.shape, mesh, letters)
+    partial_dims = [letters[l] for l in batch if l in letters]
+    w_shape = [x.shape[-1], dout.shape[-1]]
+    dw = _apply_letters("kn", w_shape, mesh, letters, partial_dims)
+    db = _apply_letters("n", [dout.shape[-1]], mesh, letters, partial_dims)
+    return [new_x, new_d], [dw, db]
+
+
+# ---------------------------------------------------- optimizer family
+def _optimizer_align(param: DistTensorSpec, grad: DistTensorSpec,
+                     *moments: DistTensorSpec):
+    """Shared layout logic (reference: spmd_rules/optimizer.cc): param,
+    grad, and every moment adopt ONE common sharding (first-writer-wins
+    merge across them); scalars (lr, beta_pow) are replicated; updated
+    outputs mirror it. A Partial grad must be reduced before the update —
+    the inferred grad layout is therefore the merged Shard layout."""
+    mesh = param.mesh
+    notation = _letters(param.ndim)
+    specs = [param, grad] + [m for m in moments if m is not None]
+    letters = _merge_letter_shardings([notation] * len(specs), specs)
+    aligned = _apply_letters(notation, param.shape, mesh, letters)
+
+    def like():
+        return DistTensorSpec(param.shape, mesh, list(aligned.placements))
+
+    return like
+
+
+@register_spmd_rule("sgd")
+def _sgd_rule(param: DistTensorSpec, grad: DistTensorSpec,
+              learning_rate: Optional[DistTensorSpec] = None, **attrs):
+    like = _optimizer_align(param, grad)
+    mesh = param.mesh
+    new_in = [like(), like()]
+    if learning_rate is not None:
+        new_in.append(DistTensorSpec(learning_rate.shape, mesh,
+                                     [Replicate()] * mesh.ndim))
+    return new_in, [like()]
+
+
+@register_spmd_rule("momentum")
+def _momentum_rule(param: DistTensorSpec, grad: DistTensorSpec,
+                   velocity: DistTensorSpec = None, **attrs):
+    like = _optimizer_align(param, grad, velocity)
+    return [like(), like(), like()], [like(), like()]
+
+
+@register_spmd_rule("adam")
+def _adam_rule(param: DistTensorSpec, grad: DistTensorSpec,
+               moment1: DistTensorSpec = None,
+               moment2: DistTensorSpec = None,
+               master_param: Optional[DistTensorSpec] = None, **attrs):
+    """Reference: optimizer.cc AdamInferSpmdDynamic — param/grad/moments/
+    master share one layout; outputs (param, m1, m2, master) mirror it."""
+    like = _optimizer_align(param, grad, moment1, moment2, master_param)
+    n_in = 4 + (1 if master_param is not None else 0)
+    n_out = 3 + (1 if master_param is not None else 0)
+    return [like() for _ in range(n_in)], [like() for _ in range(n_out)]
+
+
+@register_spmd_rule("adamw")
+def _adamw_rule(param: DistTensorSpec, grad: DistTensorSpec,
+                moment1: DistTensorSpec = None,
+                moment2: DistTensorSpec = None,
+                master_param: Optional[DistTensorSpec] = None, **attrs):
+    """Reference: optimizer.cc AdamwInferSpmdDynamic (decoupled decay
+    shares Adam's layout logic)."""
+    return _adam_rule(param, grad, moment1, moment2, master_param)
+
+
+# ------------------------------------------------------- amp / utility
+@register_spmd_rule("check_finite_and_unscale")
+def _check_finite_rule(*specs, **attrs):
+    """Reference: spmd_rules/amp_ops.cc — every param keeps its layout;
+    found_inf is a replicated scalar (an all-reduce OR under the hood)."""
+    mesh = specs[0].mesh
+    new_in = [DistTensorSpec.from_dims_mapping(s.shape, mesh,
+                                               s.dims_mapping())
+              for s in specs]
+    outs = [DistTensorSpec.from_dims_mapping(s.shape, mesh,
+                                             s.dims_mapping())
+            for s in specs]
+    outs.append(DistTensorSpec([], mesh, [Replicate()] * mesh.ndim))
+    return new_in, outs
+
+
+@register_spmd_rule("replicated")
+def _replicated_rule(*specs, **attrs):
+    """Reference: spmd_rules/replicated.cc — force-replicate in and out."""
+    mesh = specs[0].mesh
+    new = [DistTensorSpec(s.shape, mesh, [Replicate()] * mesh.ndim)
+           for s in specs]
+    outs = [DistTensorSpec(s.shape, mesh, [Replicate()] * mesh.ndim)
+            for s in specs]
+    return new, outs
+
+
+@register_spmd_rule("conv2d")
+def _conv2d_rule(x: DistTensorSpec, w: DistTensorSpec, **attrs):
+    """Conv [N, C, H, W] x [O, I, kh, kw]: batch and out-channel dims may
+    shard; in-channels contract (Partial); spatial dims stay whole (halo
+    exchange is GSPMD's job, not a layout choice). The reference routes
+    conv through replicated/default — this rule keeps the data-parallel
+    and channel-parallel layouts instead of dropping them."""
+    mesh = x.mesh
+    xm, wm = x.dims_mapping(), w.dims_mapping()
+    used = set()
+    n_dim = xm[0] if xm[0] >= 0 else -1
+    if n_dim >= 0:
+        used.add(n_dim)
+    c_dim = xm[1] if xm[1] >= 0 and xm[1] not in used else -1
+    if c_dim >= 0:
+        used.add(c_dim)
+    o_dim = wm[0] if wm[0] >= 0 and wm[0] not in used else -1
+    new_x = DistTensorSpec.from_dims_mapping(
+        x.shape, mesh, [n_dim, c_dim] + [-1] * (x.ndim - 2))
+    new_w = DistTensorSpec.from_dims_mapping(
+        w.shape, mesh, [o_dim, c_dim] + [-1] * (w.ndim - 2))
+    # spatial extents: caller may pass the true output via out_shape; the
+    # default (stride-1 same-padding) preserves the input's spatial dims
+    spatial = list(attrs.get("out_shape", x.shape[2:]))
+    out_shape = [x.shape[0], w.shape[0]] + spatial
+    out = DistTensorSpec.from_dims_mapping(
+        out_shape, mesh, [n_dim, o_dim] + [-1] * len(spatial))
+    if c_dim >= 0:
+        out.placements[c_dim] = Partial("sum")
+    return [new_x, new_w], [out]
+
+
+@register_spmd_rule("pad")
+def _pad_rule(x: DistTensorSpec, paddings=(), **attrs):
+    """Padded dims must be whole (edge shards would pad interior
+    boundaries); untouched dims pass through."""
+    mapping = x.dims_mapping()
+    pads = list(paddings)
+    if pads and not isinstance(pads[0], (list, tuple)):
+        pads = [(pads[i], pads[i + 1]) for i in range(0, len(pads), 2)]
+    for i, (lo, hi) in enumerate(pads[:x.ndim]):
+        if lo or hi:
+            mapping[i] = -1
+    spec = DistTensorSpec.from_dims_mapping(x.shape, x.mesh, mapping)
+    return [spec], [DistTensorSpec.from_dims_mapping(x.shape, x.mesh,
+                                                     mapping)]
+
+
+@register_spmd_rule("default_data_parallel")
+def _default_data_parallel_rule(*specs, **attrs):
+    """Reference: spmd_rules/default_data_parallel.cc — shard every
+    tensor's dim 0 on the mesh dim the first batch-sharded input uses;
+    everything else replicated."""
+    mesh = specs[0].mesh
+    batch_mdim = -1
+    for s in specs:
+        m = s.dims_mapping()
+        if m and m[0] >= 0:
+            batch_mdim = m[0]
+            break
+    new = []
+    for s in specs:
+        mapping = [-1] * s.ndim
+        if s.ndim and batch_mdim >= 0:
+            mapping[0] = batch_mdim
+        new.append(DistTensorSpec.from_dims_mapping(s.shape, mesh, mapping))
+    return new, [DistTensorSpec(s.shape, mesh, list(n.placements))
+                 for s, n in zip(specs, new)]
+
+
+# ----------------------------------------------- jax-primitive mapping
+# Which registered rule governs each XLA/jax primitive that appears in
+# the model fixtures' traced programs (the analog of the reference's
+# op-name -> rule registration in rules.cc). tests/test_spmd_rules.py
+# traces all five model families and FAILS if any primitive they use
+# would fall back to the replicate-everything default.
+_ELEMENTWISE_PRIMS = {
+    "abs", "add", "and", "or", "xor", "not", "cos", "div", "eq", "erf",
+    "erfc", "exp", "expm1", "floor", "ceil", "round", "ge", "gt",
+    "integer_pow", "is_finite", "log", "log1p", "logistic", "lt", "max",
+    "min", "mul", "ne", "neg", "rsqrt", "sqrt", "sign", "sin", "square",
+    "sub", "tanh", "select_n", "pow", "atan2", "rem", "clamp",
+    "nextafter",
+}
+
+JAX_PRIMITIVE_RULES = {
+    **{p: "elementwise" for p in _ELEMENTWISE_PRIMS},
+    "convert_element_type": "cast",
+    "bitcast_convert_type": "cast",
+    "reduce_precision": "cast",
+    "broadcast_in_dim": "expand_as",
+    "concatenate": "concat",
+    "conv_general_dilated": "conv2d",
+    "cumsum": "cumsum",
+    "cumlogsumexp": "cumsum",
+    "cummax": "cumsum",
+    "cumprod": "cumsum",
+    "dot_general": "matmul",
+    "dynamic_slice": "slice",
+    "dynamic_update_slice": "scatter",
+    "slice": "slice",
+    "gather": "gather",
+    "scatter": "scatter",
+    "scatter-add": "scatter",
+    "scatter_add": "scatter",
+    "argmax": "argmax",
+    "argmin": "argmax",
+    "top_k": "topk",
+    "sort": "topk",
+    "iota": "full_like",
+    "pad": "pad",
+    "reduce_sum": "reduction",
+    "reduce_max": "reduction",
+    "reduce_min": "reduction",
+    "reduce_prod": "reduction",
+    "reduce_and": "reduction",
+    "reduce_or": "reduction",
+    "logsumexp": "reduction",
+    "reshape": "reshape",
+    "squeeze": "squeeze",
+    "expand_dims": "unsqueeze",
+    "split": "split",
+    "transpose": "transpose",
+    "rev": "flip",
+    "while": "default_data_parallel",
+    "cond": "default_data_parallel",
+    "scan": "default_data_parallel",
+}
+
+# primitives with no tensor-layout semantics of their own: wrappers,
+# control plumbing, and rng-key bookkeeping (their INNER jaxprs are
+# walked separately by the fixture test)
+STRUCTURAL_PRIMITIVES = {
+    "jit", "pjit", "remat2", "remat", "custom_jvp_call", "custom_vjp_call",
+    "custom_vjp_call_jaxpr", "closed_call", "core_call", "copy",
+    "stop_gradient", "random_seed", "random_unwrap", "random_wrap",
+    "random_bits", "random_fold_in", "threefry2x32", "named_call",
+}
+
+
+def rule_for_primitive(prim_name: str) -> "SpmdRule":
+    """Resolve the SPMD rule governing a jax primitive; KeyError when the
+    primitive has no mapped rule (i.e. it WOULD fall back to default)."""
+    if prim_name in STRUCTURAL_PRIMITIVES:
+        return _REGISTRY["default"]
+    return _REGISTRY[JAX_PRIMITIVE_RULES[prim_name]]

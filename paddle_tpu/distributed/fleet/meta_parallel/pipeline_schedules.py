@@ -130,7 +130,11 @@ def simulate(streams: Dict[int, List[Task]], num_stages: int, num_micro: int,
       B(m, c)  needs F(m, last_chunk) done and B(m, c+1) done (c < last);
       W(m, c)  needs B(m, c) done.
     Raises on deadlock or incomplete coverage. Returns
-    {order, makespan, bubble_fraction, peak_activations}.
+    {order, makespan, bubble_fraction, peak_activations, ticks} — ticks
+    is the lockstep tick table: one {stage: Task} dict per unit-time
+    step, the exact execution plan the compiled SPMD engine
+    (fleet/pipeline_spmd_engine.py) bakes into its static routing
+    tables.
     """
     num_chunks = num_stages * vpp
     done = set()          # ("F"|"B"|"W", micro, chunk) completed
@@ -153,6 +157,7 @@ def simulate(streams: Dict[int, List[Task]], num_stages: int, num_micro: int,
 
     t = 0
     total = sum(len(seq) for seq in streams.values())
+    ticks: List[Dict[int, Task]] = []
     while len(done) < total:
         progressed = False
         completed_now = []
@@ -173,6 +178,7 @@ def simulate(streams: Dict[int, List[Task]], num_stages: int, num_micro: int,
         for s, task in completed_now:
             done.add((task.kind, task.micro, task.chunk))
             pos[s] += 1
+        ticks.append(dict(completed_now))
         if not progressed:
             stuck = {s: streams[s][pos[s]] for s in streams if pos[s] < len(streams[s])}
             raise RuntimeError(f"pipeline schedule deadlock at t={t}: {stuck}")
@@ -185,4 +191,5 @@ def simulate(streams: Dict[int, List[Task]], num_stages: int, num_micro: int,
         "makespan": makespan,
         "bubble_fraction": bubbles / (makespan * num_stages),
         "peak_activations": peak,
+        "ticks": ticks,
     }

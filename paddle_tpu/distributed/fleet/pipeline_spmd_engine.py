@@ -1,0 +1,355 @@
+"""Compiled static-schedule pipeline engine: ANY validated schedule
+(1F1B / VPP / ZBH1 / FThenB) as ONE XLA program over ppermute.
+
+Reference semantics: python/paddle/distributed/passes/
+pipeline_scheduler_pass/ — the reference lowers each schedule to a
+static-graph pass that rewrites the program into per-stage task queues
+(pipeline_zero_bubble.py for ZBH1, pipeline_parallel.py:1136 for
+interleaved VPP) executed by NCCL P2P. TPUs have no P2P: the tpu-first
+redesign compiles the WHOLE schedule into a single `lax.scan` inside
+`shard_map`, with `lax.ppermute` ring transfers each tick.
+
+Design (static scheduling → static routing):
+- The per-stage instruction streams come from the already-validated
+  generators in meta_parallel/pipeline_schedules.py; ``simulate()``
+  produces the lockstep tick table (one instruction per stage per tick).
+- Because the schedule is STATIC, every buffer decision is made at trace
+  time in Python: activation/grad/dy lifetimes become intervals, greedy
+  interval coloring assigns them to a fixed slot pool, and per-(tick,
+  stage) int32 tables say where arrivals land and which slots each
+  F/B/W reads. The compiled program just gathers its instruction by
+  ``tbl[t, axis_index]`` — no tags, no dynamic bookkeeping.
+- Zero-bubble W-split costs nothing extra per tick: at most one of
+  B(m,c)/W(m,c) runs per stage per tick and both read the same saved
+  input + dy slots, so ONE vjp serves either phase — B consumes dx
+  (sent up-ring), W consumes dparams (accumulated). A tick is one stage
+  forward + one vjp, the same arithmetic as the specialized 1F1B path
+  in pipeline_spmd.py.
+- Interleaving (VPP) keeps the ring: chunk c lives on stage c % S, so
+  forward hops are always stage p -> p+1 (wrapping) and backward hops
+  p -> p-1; virtual-chunk params are a leading [vpp] axis on each local
+  leaf, dynamically indexed per tick.
+
+Memory: saved inputs are rematerialized from the arrival slot (the
+1F1B remat trade); the slot-pool size is the schedule's true activation
+liveness (simulate's peak), NOT num_micro — e.g. 1F1B/ZBH1 stay O(S)
+while FThenB is O(M), visible directly in ``plan.num_slots``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .meta_parallel.pipeline_schedules import make_schedule, simulate
+
+__all__ = ["compile_pipeline_plan", "pipeline_schedule_train_step",
+           "stack_chunk_params"]
+
+# instruction opcodes in the kind table
+_NOP, _F, _B, _W = 0, 1, 2, 3
+
+
+class PipelinePlan(NamedTuple):
+    """Static routing tables, one row per tick, one column per stage."""
+
+    schedule: str
+    S: int            # stages
+    M: int            # microbatches
+    vpp: int          # virtual chunks per stage
+    C: int            # total chunks = S * vpp
+    T: int            # ticks (simulate makespan)
+    num_slots: int    # activation slot-pool size (liveness-colored)
+    has_w: bool       # schedule splits backward into B (dx) + W (dparams)
+    kind: np.ndarray          # [T, S] opcode
+    micro: np.ndarray         # [T, S] microbatch id
+    vchunk: np.ndarray        # [T, S] local virtual-chunk index (chunk // S)
+    lastf: np.ndarray         # [T, S] 1 when F runs the LAST chunk (loss)
+    fin_slot: np.ndarray      # [T, S] F input slot; -1 = read xs[micro]
+    dy_write: np.ndarray      # [T, S] slot to store loss dy (last-chunk F)
+    b_in: np.ndarray          # [T, S] B/W saved-input slot; -1 = xs[micro]
+    b_dy: np.ndarray          # [T, S] B/W upstream-grad slot
+    send_f: np.ndarray        # [T, S] 1 when F output ppermutes down-ring
+    send_b: np.ndarray        # [T, S] 1 when B dx ppermutes up-ring
+    recv_f: np.ndarray        # [T, S] slot for the fwd arrival; -1 = none
+    recv_b: np.ndarray        # [T, S] slot for the bwd arrival; -1 = none
+    bubble_fraction: float
+
+
+def _color_intervals(intervals: List[Tuple[int, int, object]]) -> Tuple[
+        Dict[object, int], int]:
+    """Greedy interval-graph coloring: (start, end, key) -> slot id.
+
+    A slot is live on [start, end] inclusive; two intervals may share a
+    slot iff they don't overlap. Returns ({key: slot}, num_slots)."""
+    assignment: Dict[object, int] = {}
+    free_at: List[int] = []   # per slot: first tick it is free again
+    for start, end, key in sorted(intervals):
+        for sid, fa in enumerate(free_at):
+            if fa <= start:
+                free_at[sid] = end + 1
+                assignment[key] = sid
+                break
+        else:
+            assignment[key] = len(free_at)
+            free_at.append(end + 1)
+    return assignment, max(len(free_at), 1)
+
+
+def compile_pipeline_plan(schedule: str, S: int, M: int,
+                          vpp: int = 1) -> PipelinePlan:
+    """Lower a named schedule to the static routing tables.
+
+    Runs the generators + dependency simulation (raising on any invalid
+    schedule), then assigns every value that must cross ticks — arrived
+    activations (doubling as remat inputs), arrived dx grads, and the
+    last chunk's loss dy — to a liveness-colored slot pool."""
+    streams = {s: make_schedule(schedule, s, S, M, vpp) for s in range(S)}
+    sim = simulate(streams, S, M, vpp)
+    ticks: List[Dict[int, Any]] = sim["ticks"]
+    T = len(ticks)
+    C = S * vpp
+    has_w = any(t.kind == "W" for seq in streams.values() for t in seq)
+
+    # tick of every task, keyed ("F"|"B"|"W", m, c)
+    when: Dict[Tuple[str, int, int], int] = {}
+    for t, assign in enumerate(ticks):
+        for s, task in assign.items():
+            when[(task.kind, task.micro, task.chunk)] = t
+
+    def last_use(m: int, c: int) -> int:
+        return when[("W", m, c)] if has_w else when[("B", m, c)]
+
+    # ---- slot intervals, per stage ----------------------------------
+    # key -> (stage, interval); three classes of slot tenants:
+    #   ("act", m, c)  c > 0: F(m, c-1) output arrives at stage c%S one
+    #                  tick after it ran upstream; retained (as the remat
+    #                  input) until B/W(m, c).
+    #   ("dy", m)      loss grad computed during F(m, C-1); retained
+    #                  until B/W(m, C-1).
+    #   ("grad", m, c) c < C-1: dx of B(m, c+1) arrives one tick later;
+    #                  retained until B/W(m, c).
+    per_stage: Dict[int, List[Tuple[int, int, object]]] = {
+        s: [] for s in range(S)}
+    for m in range(M):
+        for c in range(C):
+            stage = c % S
+            if c > 0:
+                arrive = when[("F", m, c - 1)] + 1
+                per_stage[stage].append(
+                    (arrive, last_use(m, c), ("act", m, c)))
+            if c == C - 1:
+                per_stage[stage].append(
+                    (when[("F", m, c)], last_use(m, c), ("dy", m)))
+            if c < C - 1:
+                arrive = when[("B", m, c + 1)] + 1
+                per_stage[stage].append(
+                    (arrive, last_use(m, c), ("grad", m, c)))
+
+    slot_of: Dict[int, Dict[object, int]] = {}
+    num_slots = 1
+    for s in range(S):
+        slot_of[s], n = _color_intervals(per_stage[s])
+        num_slots = max(num_slots, n)
+
+    # ---- routing tables ---------------------------------------------
+    def tbl(fill):
+        return np.full((T, S), fill, dtype=np.int32)
+
+    kind, micro, vchunk = tbl(_NOP), tbl(0), tbl(0)
+    lastf, fin_slot, dy_write = tbl(0), tbl(-1), tbl(-1)
+    b_in, b_dy = tbl(-1), tbl(-1)
+    send_f, send_b, recv_f, recv_b = tbl(0), tbl(0), tbl(-1), tbl(-1)
+
+    for t, assign in enumerate(ticks):
+        for s, task in assign.items():
+            k, m, c = task.kind, task.micro, task.chunk
+            micro[t, s] = m
+            vchunk[t, s] = c // S
+            if k == "F":
+                kind[t, s] = _F
+                if c > 0:
+                    fin_slot[t, s] = slot_of[s][("act", m, c)]
+                if c == C - 1:
+                    lastf[t, s] = 1
+                    dy_write[t, s] = slot_of[s][("dy", m)]
+                else:
+                    send_f[t, s] = 1
+                    # the arrival lands down-ring one tick later
+                    ds = (s + 1) % S
+                    recv_f[t + 1, ds] = slot_of[ds][("act", m, c + 1)]
+            else:
+                kind[t, s] = _B if k == "B" else _W
+                if c > 0:
+                    b_in[t, s] = slot_of[s][("act", m, c)]
+                b_dy[t, s] = slot_of[s][
+                    ("dy", m) if c == C - 1 else ("grad", m, c)]
+                if k == "B" and c > 0:
+                    send_b[t, s] = 1
+                    us = (s - 1) % S
+                    recv_b[t + 1, us] = slot_of[us][("grad", m, c - 1)]
+
+    return PipelinePlan(
+        schedule=schedule, S=S, M=M, vpp=vpp, C=C, T=T,
+        num_slots=num_slots, has_w=has_w, kind=kind, micro=micro,
+        vchunk=vchunk, lastf=lastf, fin_slot=fin_slot, dy_write=dy_write,
+        b_in=b_in, b_dy=b_dy, send_f=send_f, send_b=send_b,
+        recv_f=recv_f, recv_b=recv_b,
+        bubble_fraction=float(sim["bubble_fraction"]))
+
+
+def stack_chunk_params(per_chunk_params):
+    """Stack C = S * vpp per-chunk pytrees (chunk order: chunk c lives
+    on stage c % S with virtual index c // S) into one pytree with
+    leading dim C — the layout pipeline_schedule_train_step consumes."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_chunk_params)
+
+
+def pipeline_schedule_train_step(stage_fn: Callable, loss_fn: Callable,
+                                 chunk_params, micro_inputs, micro_labels,
+                                 *, mesh, plan: PipelinePlan,
+                                 axis: str = "pp", param_pspecs=None):
+    """Run one TRAIN step of ``plan`` (fwd + bwd + grads, one XLA program).
+
+    stage_fn(params, x) -> y shape-preserving; loss_fn(y, label) ->
+    scalar. chunk_params: pytree with leading dim C = S * vpp ordered by
+    chunk id (chunk c on stage c % S, virtual index c // S).
+    micro_inputs [M, B, ...] and micro_labels [M, ...] replicated.
+
+    Hybrid PP x TP: pass a 2-D ``mesh`` (e.g. axes ("pp", "mp")) and
+    ``param_pspecs`` — a pytree matching chunk_params whose leaves are
+    PartitionSpecs for the dims AFTER the leading chunk dim (e.g.
+    ``P(None, "mp")`` for a column-parallel weight). stage_fn then sees
+    mp-LOCAL shards and is responsible for its own tensor-parallel
+    collectives (``lax.psum(..., "mp")`` after row-parallel matmuls),
+    Megatron-style. Defaults to fully replicated stage params.
+
+    Returns (mean loss, chunk grads pytree [C, ...] — gradients of the
+    MEAN loss, matching pipeline_spmd_train_step)."""
+    S, M, vpp, C, T = plan.S, plan.M, plan.vpp, plan.C, plan.T
+    if mesh.shape[axis] != S:
+        raise ValueError(
+            f"plan was compiled for {S} stages but mesh axis {axis!r} "
+            f"has size {mesh.shape[axis]}")
+    if micro_inputs.shape[0] != M:
+        raise ValueError(
+            f"plan was compiled for {M} microbatches, got "
+            f"{micro_inputs.shape[0]}")
+
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+    # chunk leaves [C, ...] -> [vpp, S, ...]: dim 1 sharded over pp
+    params_vs = jax.tree_util.tree_map(
+        lambda a: a.reshape((vpp, S) + a.shape[1:]), chunk_params)
+
+    tables = {
+        "kind": plan.kind, "micro": plan.micro, "vchunk": plan.vchunk,
+        "lastf": plan.lastf, "fin": plan.fin_slot, "dyw": plan.dy_write,
+        "bin": plan.b_in, "bdy": plan.b_dy, "sf": plan.send_f,
+        "sb": plan.send_b, "rf": plan.recv_f, "rb": plan.recv_b,
+    }
+    tables = {k: jnp.asarray(v) for k, v in tables.items()}
+
+    if param_pspecs is None:
+        pspec_vs = jax.tree_util.tree_map(lambda _: P(None, axis), params_vs)
+    else:
+        pspec_vs = jax.tree_util.tree_map(
+            lambda _, sp: P(*((None, axis) + tuple(sp))),
+            params_vs, param_pspecs,
+            is_leaf=lambda x: isinstance(x, P))
+    in_specs = (pspec_vs, P(), P())
+    out_specs = (P(), pspec_vs)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+    def run(params, xs, ys):
+        local = jax.tree_util.tree_map(lambda a: a[:, 0], params)  # [vpp,...]
+        p_idx = lax.axis_index(axis)
+        B_shape = xs.shape[1:]
+        zero = jnp.zeros(B_shape, xs.dtype)
+
+        state = {
+            "slots": jnp.zeros((plan.num_slots,) + B_shape, xs.dtype),
+            "act_in": zero,
+            "grad_in": zero,
+            "grads": jax.tree_util.tree_map(jnp.zeros_like, local),
+            "loss": jnp.zeros((), jnp.float32),
+        }
+
+        def at(tb, t):
+            return tables[tb][t, p_idx]
+
+        def masked_slot_set(slots, idx, value, extra_ok=True):
+            safe = jnp.maximum(idx, 0)
+            ok = (idx >= 0) & extra_ok
+            return slots.at[safe].set(
+                jnp.where(ok, value.astype(slots.dtype), slots[safe]))
+
+        def tick(state, t):
+            slots = state["slots"]
+            # ---- arrivals land first (same-tick consumption is legal:
+            # the slot write precedes this tick's reads) ----
+            slots = masked_slot_set(slots, at("rf", t), state["act_in"])
+            slots = masked_slot_set(slots, at("rb", t), state["grad_in"])
+
+            k = at("kind", t)
+            m = at("micro", t)
+            v = at("vchunk", t)
+            params_v = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
+                local)
+            x_m = lax.dynamic_index_in_dim(xs, m, 0, keepdims=False)
+            y_m = lax.dynamic_index_in_dim(ys, m, 0, keepdims=False)
+
+            # ---- F phase ----
+            fin = at("fin", t)
+            x_f = jnp.where(fin >= 0, slots[jnp.maximum(fin, 0)], x_m)
+            y = stage_fn(params_v, x_f)
+            loss_val, dy_last = jax.value_and_grad(
+                lambda yy: loss_fn(yy, y_m).astype(jnp.float32))(y)
+            is_f = k == _F
+            take_loss = is_f & (at("lastf", t) == 1)
+            loss = state["loss"] + jnp.where(take_loss, loss_val, 0.0)
+            slots = masked_slot_set(slots, at("dyw", t), dy_last, is_f)
+
+            # ---- B/W phase: ONE vjp serves both (at most one of them
+            # runs this tick; B consumes dx, W consumes dparams) ----
+            bin_ = at("bin", t)
+            x_b = jnp.where(bin_ >= 0, slots[jnp.maximum(bin_, 0)], x_m)
+            dy = slots[jnp.maximum(at("bdy", t), 0)]
+            _, vjp_fn = jax.vjp(
+                lambda pp_, x_: stage_fn(pp_, x_), params_v, x_b)
+            dparams, dx = vjp_fn(dy)
+            is_b, is_w = k == _B, k == _W
+            # dparams land on B for plain schedules, on W for zero-bubble
+            acc = (is_w | (is_b & (not plan.has_w))).astype(xs.dtype)
+            grads = jax.tree_util.tree_map(
+                lambda g, d: g.at[v].add(d * acc), state["grads"], dparams)
+
+            # ---- transport: acts down-ring, grads up-ring ----
+            mf = (is_f & (at("sf", t) == 1)).astype(y.dtype)
+            mb = (is_b & (at("sb", t) == 1)).astype(dx.dtype)
+            act_in = lax.ppermute(y * mf, axis, perm_fwd)
+            grad_in = lax.ppermute(dx * mb, axis, perm_bwd)
+            return {"slots": slots, "act_in": act_in, "grad_in": grad_in,
+                    "grads": grads, "loss": loss}, None
+
+        state, _ = lax.scan(tick, state, jnp.arange(T))
+        # loss was accumulated only on the last-chunk stage: make the
+        # mean visible everywhere; grads are of the MEAN loss
+        loss = lax.psum(state["loss"], axis) / M
+        grads = jax.tree_util.tree_map(
+            lambda g: (g / M)[:, None], state["grads"])
+        return loss, grads
+
+    loss, grads_vs = run(params_vs, micro_inputs, micro_labels)
+    grads = jax.tree_util.tree_map(
+        lambda a: a.reshape((C,) + a.shape[2:]), grads_vs)
+    return loss, grads

@@ -1,11 +1,13 @@
 """paddle.distributed.passes — program pass framework.
 
 Reference: python/paddle/distributed/passes/ (pass_base.py new_pass /
-PassManager/PassContext; dozens of fuse/sharding/pipeline passes). TPU
-collapse: XLA performs the fusion/scheduling passes and GSPMD the
-distributed rewrites, so the framework here is the registry + manager
-shell that named passes plug into; built-in names resolve to no-op
-passes documenting their XLA equivalent.
+PassManager/PassContext; dozens of fuse/sharding/pipeline passes).
+
+TPU split: passes with real Program-rewrite semantics live in
+program_passes.py (constant folding, DCE, add+act fusion, recompute);
+names whose rewrite XLA/GSPMD performs automatically resolve to a
+documented no-op (XlaSubsumedPass); anything else RAISES at apply() —
+a registry name must never silently do nothing.
 """
 from __future__ import annotations
 
@@ -44,22 +46,47 @@ class PassBase:
         return main_programs, startup_programs
 
 
-# XLA subsumes these graph rewrites; names kept so strategy configs and
-# ports referencing them resolve (pass_base.py registry names)
-for _name in ("fuse_elewise_add_act", "fuse_bn_act", "fuse_bn_add_act",
+class XlaSubsumedPass(PassBase):
+    """A rewrite the XLA compiler (or GSPMD partitioner) performs on every
+    jitted program automatically — applying it is a documented no-op."""
+
+
+class UnimplementedPass(PassBase):
+    def apply(self, main_programs, startup_programs, context=None):
+        raise NotImplementedError(
+            f"pass {self.name!r} is registered for name-parity but has no "
+            "program rewrite here; if the rewrite matters on TPU, add it "
+            "to distributed/passes/program_passes.py")
+
+
+# XLA performs these fusions/rewrites on every jitted program (op fusion,
+# layout assignment, GSPMD sharding prop): documented no-ops
+for _name in ("fuse_bn_act", "fuse_bn_add_act",
               "fuse_relu_depthwise_conv", "fuse_optimizer",
               "fused_attention", "fused_feedforward",
               "auto_parallel_sharding", "auto_parallel_amp",
-              "auto_parallel_recompute", "auto_parallel_fp16",
+              "auto_parallel_fp16",
               "pipeline_scheduler_FThenB", "pipeline_scheduler_1F1B"):
-    _PASS_REGISTRY[_name] = PassBase
+    _PASS_REGISTRY[_name] = XlaSubsumedPass
+
+from .program_passes import (  # noqa: E402
+    ConstantFoldingPass, DeadCodeEliminationPass, FuseAddActPass,
+    RecomputePass,
+)
+
+_PASS_REGISTRY["constant_folding"] = ConstantFoldingPass
+_PASS_REGISTRY["dead_code_elimination"] = DeadCodeEliminationPass
+_PASS_REGISTRY["fuse_elewise_add_act"] = FuseAddActPass
+_PASS_REGISTRY["auto_parallel_recompute"] = RecomputePass
 
 
 def new_pass(name: str, pass_attrs=None) -> PassBase:
-    cls = _PASS_REGISTRY.get(name, PassBase)
-    if cls is PassBase:
-        return PassBase(name, pass_attrs)
-    return cls(name, pass_attrs)
+    cls = _PASS_REGISTRY.get(name)
+    if cls is None:
+        return UnimplementedPass(name, pass_attrs)
+    if cls in (PassBase, XlaSubsumedPass):
+        return cls(name, pass_attrs)
+    return cls(pass_attrs)
 
 
 class PassManager:

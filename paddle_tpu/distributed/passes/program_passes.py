@@ -1,0 +1,220 @@
+"""Real program-rewrite passes over the static ``Program`` instruction list.
+
+Reference: python/paddle/distributed/passes/ — PassBase subclasses that
+rewrite the program (auto_parallel_recompute.py marks/replays forward
+segments; constant-folding and DCE live in the inference analysis
+pipeline, paddle/fluid/inference/analysis/). The captured Program here is
+a flat (prim, in_vids, attrs, out_vids) list (static/program.py), so
+passes are classic compiler passes over SSA-ish value ids.
+
+Implemented passes:
+
+- constant_folding: evaluate ops whose inputs are all compile-time
+  constants; their outputs become constants and the op disappears.
+- dead_code_elimination: drop ops whose outputs never reach the fetch
+  targets (backward liveness sweep).
+- fuse_elewise_add_act: fuse add -> {relu, gelu, sigmoid, tanh} chains
+  into one fused primitive when the add has a single consumer (the
+  reference fuse_elewise_add_act_pass pattern).
+- auto_parallel_recompute: mark checkpoint values; the Program's
+  ``__gradients__`` replay (static/program.py _replay_gradients) then
+  partitions the forward at the checkpoint producers and runs each
+  segment under ``jax.checkpoint``, so only checkpoint values survive
+  the forward and everything between them is rematerialized during the
+  backward. Peak temp memory drops accordingly (asserted against XLA's
+  buffer assignment in tests/test_program_passes.py).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ...core import dispatch
+from ...ops._helpers import defprim
+
+__all__ = [
+    "ConstantFoldingPass", "DeadCodeEliminationPass", "FuseAddActPass",
+    "RecomputePass",
+]
+
+Inst = Tuple[str, Tuple[int, ...], tuple, Tuple[int, ...]]
+
+
+# identity with a scheduling/CSE fence; the recompute pass threads remat
+# inputs through it (optionally paired with a backward "trigger" value)
+def _opt_barrier(*xs):
+    import jax
+
+    out = jax.lax.optimization_barrier(tuple(xs))
+    return out if len(xs) > 1 else out[0]
+
+
+defprim("opt_barrier_p", _opt_barrier)
+
+
+def _fused_add_act(x, y, *, act):
+    import jax
+
+    acts = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+            "sigmoid": jax.nn.sigmoid, "tanh": jax.numpy.tanh}
+    return acts[act](x + y)
+
+
+defprim("fused_add_act_p", _fused_add_act)
+
+
+class _ProgramPass:
+    """Shared base: resolve Tensors in attrs to vids, apply per program."""
+
+    def __init__(self, name: str, attrs=None):
+        self.name = name
+        self.attrs = dict(attrs or {})
+
+    def apply(self, main_programs, startup_programs, context=None):
+        progs = main_programs if isinstance(main_programs, (list, tuple)) \
+            else [main_programs]
+        for prog in progs:
+            self._apply_one(prog, context)
+            prog._cache.clear()
+        return main_programs, startup_programs
+
+    def _apply_one(self, prog, context):
+        raise NotImplementedError
+
+    @staticmethod
+    def _vid(prog, target) -> int:
+        if isinstance(target, int):
+            return target
+        return prog.vid_of(target)
+
+
+class ConstantFoldingPass(_ProgramPass):
+    """Reference: inference/analysis constant_folding_pass."""
+
+    def __init__(self, attrs=None):
+        super().__init__("constant_folding", attrs)
+
+    def _apply_one(self, prog, context):
+        import jax
+
+        consts = prog._consts
+        new_insts: List[Inst] = []
+        for prim_name, in_vids, static_items, out_vids in prog._insts:
+            inputs_const = all(v in consts for v in in_vids)
+            if not inputs_const or prim_name == "opt_barrier_p":
+                new_insts.append((prim_name, in_vids, static_items,
+                                  out_vids))
+                continue
+            prim = dispatch.PRIMITIVES[prim_name]
+            with jax.default_device(jax.devices("cpu")[0]) \
+                    if jax.default_backend() != "cpu" else _nullcontext():
+                outs = prim.forward(*[consts[v] for v in in_vids],
+                                    **dict(static_items))
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for v, o in zip(out_vids, outs):
+                consts[v] = np.asarray(o)
+        prog._insts = new_insts
+
+
+class _nullcontext:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class DeadCodeEliminationPass(_ProgramPass):
+    """Reference: inference/analysis ir_graph_clean_pass / DCE. Keeps ops
+    whose outputs (transitively) reach the fetch vids given in attrs
+    ``fetch`` (Tensors or vids) or context attr "fetch_vids"."""
+
+    def __init__(self, attrs=None):
+        super().__init__("dead_code_elimination", attrs)
+
+    def _apply_one(self, prog, context):
+        fetch = self.attrs.get("fetch")
+        if fetch is None and context is not None:
+            fetch = context.get_attr("fetch_vids")
+        if not fetch:
+            return
+        live: Set[int] = {self._vid(prog, t) for t in fetch}
+        kept: List[Inst] = []
+        for inst in reversed(prog._insts):
+            prim_name, in_vids, _static, out_vids = inst
+            if any(v in live for v in out_vids):
+                kept.append(inst)
+                live.update(in_vids)
+        kept.reverse()
+        prog._insts = kept
+
+
+class FuseAddActPass(_ProgramPass):
+    """Reference: fuse_elewise_add_act_pass — add feeding a single
+    activation consumer becomes one fused op."""
+
+    _ACTS = {"relu", "gelu", "sigmoid", "tanh"}
+
+    def __init__(self, attrs=None):
+        super().__init__("fuse_elewise_add_act", attrs)
+
+    def _apply_one(self, prog, context):
+        insts = prog._insts
+        consumers: Dict[int, List[int]] = {}
+        for idx, (_n, in_vids, _s, _o) in enumerate(insts):
+            for v in in_vids:
+                consumers.setdefault(v, []).append(idx)
+        drop: Set[int] = set()
+        out: List[Inst] = []
+        for idx, inst in enumerate(insts):
+            if idx in drop:
+                continue
+            prim_name, in_vids, static_items, out_vids = inst
+            if prim_name == "add" and len(out_vids) == 1:
+                users = consumers.get(out_vids[0], [])
+                if len(users) == 1:
+                    nxt = insts[users[0]]
+                    if nxt[0] in self._ACTS and len(nxt[1]) == 1:
+                        fused = ("fused_add_act_p", in_vids,
+                                 (("act", nxt[0]),), nxt[3])
+                        out.append(fused)
+                        drop.add(users[0])
+                        continue
+            out.append(inst)
+        prog._insts = out
+
+
+class RecomputePass(_ProgramPass):
+    """Reference: passes/auto_parallel_recompute.py — checkpoint-marked
+    forward segments are re-executed in the backward instead of keeping
+    their activations live across the fwd->bwd gap.
+
+    The program's grad section is the ``__gradients__`` instruction
+    (static/program.py record_gradients, the append_backward analog),
+    replayed as ``jax.grad`` over a sub-replay of the forward. This pass
+    marks the checkpoint vids; the sub-replay then partitions at their
+    producers and wraps every segment in ``jax.checkpoint``, so only the
+    checkpoint values survive the forward and everything between them is
+    rematerialized during the backward.
+
+    attrs:
+      checkpoints: segment-boundary values (Tensors or vids).
+    """
+
+    def __init__(self, attrs=None):
+        super().__init__("auto_parallel_recompute", attrs)
+
+    def _apply_one(self, prog, context):
+        targets = self.attrs.get("checkpoints", [])
+        if not targets and context is not None:
+            targets = context.get_attr("checkpoints", [])
+        ckpt_vids = tuple(self._vid(prog, t) for t in targets)
+        if not ckpt_vids:
+            return
+        if not any(i[0] == "__gradients__" for i in prog._insts):
+            raise ValueError(
+                "auto_parallel_recompute needs a grad section: call "
+                "paddle.static.gradients/append_backward under the "
+                "program guard first")
+        prog._remat_checkpoints = ckpt_vids

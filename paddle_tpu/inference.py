@@ -35,12 +35,20 @@ class Config:
         self._memory_pool_init_size_mb = 0
         self._enable_profile = False
         self._glog_info = False
+        self._ir_optim = True
+        self._memory_optim = False
 
     def set_prog_file(self, path: str):
         self._prog_file = path
 
     def prog_file(self):
         return self._prog_file
+
+    def set_params_file(self, path: str):
+        self._params_file = path
+
+    def params_file(self):
+        return self._params_file
 
     def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
                        device_id: int = 0):
@@ -59,13 +67,29 @@ class Config:
         self._glog_info = False
 
     def switch_ir_optim(self, on: bool = True):
-        pass  # XLA always optimizes
+        """Run the analysis pass pipeline (constant folding, add+act
+        fusion, dead-code elimination) on loaded STATIC programs before
+        execution (reference: AnalysisConfig::SwitchIrOptim driving
+        inference/analysis/). jit.save StableHLO artifacts arrive
+        pre-optimized by XLA and are unaffected."""
+        self._ir_optim = bool(on)
 
-    def enable_memory_optim(self):
-        pass
+    def ir_optim(self) -> bool:
+        return self._ir_optim
+
+    def enable_memory_optim(self, x=True):
+        """Donate input buffers to the compiled executable so XLA reuses
+        them for outputs/temps (reference: Config::EnableMemoryOptim's
+        variable-reuse pass)."""
+        self._memory_optim = bool(x)
+
+    def memory_optim_enabled(self) -> bool:
+        return self._memory_optim
 
     def summary(self) -> str:
-        return f"Config(prog_file={self._prog_file}, device={self._device})"
+        return (f"Config(prog_file={self._prog_file}, "
+                f"device={self._device}, ir_optim={self._ir_optim}, "
+                f"memory_optim={self._memory_optim})")
 
 
 class PredictorTensor:
@@ -90,20 +114,84 @@ class PredictorTensor:
 
 
 class Predictor:
-    """Reference: AnalysisPredictor. Loads a jit.save artifact and runs
-    the deserialized StableHLO executable."""
+    """Reference: AnalysisPredictor. Loads either artifact kind:
+
+    - a jit.save payload (state_dict + StableHLO): executed as-is (XLA
+      already optimized it at export);
+    - a static.save program (.pdmodel instruction list + .pdparams):
+      the ANALYSIS PIPELINE runs first when config.ir_optim() —
+      constant folding, add+act fusion, then dead-code elimination to
+      the saved fetch targets — the inference/analysis/ pass pipeline
+      on the TPU program representation. enable_memory_optim() donates
+      input buffers to the compiled executable.
+    """
 
     def __init__(self, config: Config):
+        self._config = config
+        self._loaded = None
+        self._program = None
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+        self._output_names: List[str] = []
+        self.analysis_passes_applied: List[str] = []
+
+        payload = self._peek_payload(config.prog_file())
+        if isinstance(payload, dict) and "insts" in payload:
+            self._init_static(config, payload)
+        else:
+            self._init_stablehlo(config)
+
+    @staticmethod
+    def _peek_payload(path):
+        import pickle
+
+        p = path if path.endswith(".pdmodel") else path + ".pdmodel"
+        try:
+            with open(p, "rb") as f:
+                return pickle.loads(f.read())
+        except Exception:
+            return None
+
+    def _init_stablehlo(self, config):
         from . import jit
 
-        self._config = config
         self._loaded = jit.load(config.prog_file())
         in_specs = self._loaded._payload.get("in_specs") or []
         self._input_names = [f"x{i}" for i in range(len(in_specs))]
         self._in_specs = in_specs
-        self._inputs: Dict[str, np.ndarray] = {}
-        self._outputs: Dict[str, np.ndarray] = {}
-        self._output_names: List[str] = []
+
+    def _init_static(self, config, payload):
+        from .distributed.passes import PassManager, new_pass
+        from .static.extras import (
+            deserialize_persistables, load_from_file, program_from_payload,
+        )
+
+        prog = program_from_payload(payload)
+        params_path = config.params_file()
+        if params_path is None:
+            base = config.prog_file()
+            base = base[:-len(".pdmodel")] if base.endswith(".pdmodel") \
+                else base
+            params_path = base + ".pdparams"
+        try:
+            deserialize_persistables(prog, load_from_file(params_path))
+        except FileNotFoundError:
+            pass
+        fetch_vids = list(getattr(prog, "_fetch_vids", ()) or ())
+        if not fetch_vids and prog._insts:
+            fetch_vids = list(prog._insts[-1][3])  # last op's outputs
+        if config.ir_optim():
+            pm = PassManager([
+                new_pass("constant_folding"),
+                new_pass("fuse_elewise_add_act"),
+                new_pass("dead_code_elimination", {"fetch": fetch_vids}),
+            ])
+            pm.apply(prog, None)
+            self.analysis_passes_applied = list(pm.names)
+        self._program = prog
+        self._fetch_vids = tuple(fetch_vids)
+        self._input_names = [name for name, _vid, _shape, _dt
+                             in prog._placeholders]
 
     # -- AnalysisPredictor surface --------------------------------------
     def get_input_names(self) -> List[str]:
@@ -125,19 +213,47 @@ class Predictor:
             arrays = [np.asarray(a) for a in inputs]
         else:
             arrays = [self._inputs[n] for n in self._input_names]
-        outs = self._loaded(*arrays)
-        if isinstance(outs, Tensor):
-            outs = [outs]
+        if self._program is not None:
+            outs = self._run_static(arrays)
+        else:
+            outs = self._loaded(*arrays)
+            if isinstance(outs, Tensor):
+                outs = [outs]
+            outs = [np.asarray(o._value) for o in outs]
         self._output_names = [f"out{i}" for i in range(len(outs))]
-        self._outputs = {
-            n: np.asarray(o._value) for n, o in zip(self._output_names, outs)
-        }
+        self._outputs = dict(zip(self._output_names, outs))
         if inputs is not None:
             return [self._outputs[n] for n in self._output_names]
         return None
 
+    def _run_static(self, arrays):
+        import jax
+
+        from .static.program import Executor
+
+        prog = self._program
+        feed_names = tuple(self._input_names)
+        donate = (self._config.memory_optim_enabled()
+                  and jax.default_backend() != "cpu")
+        key = ("__infer__", tuple((a.shape, str(a.dtype)) for a in arrays),
+               self._fetch_vids, donate)
+        fn = prog._cache.get(key)
+        if fn is None:
+            fn = Executor._compile(prog, feed_names, self._fetch_vids,
+                                   donate=donate)
+            prog._cache[key] = fn
+        outs = fn(*arrays)
+        return [np.asarray(o) for o in outs]
+
+    def get_program(self):
+        """The (possibly pass-optimized) static Program, when the loaded
+        artifact is a static one; None for StableHLO payloads."""
+        return self._program
+
     def state_dict(self):
-        return self._loaded.state_dict()
+        if self._loaded is not None:
+            return self._loaded.state_dict()
+        return dict(self._program._consts)
 
 
 def create_predictor(config: Config) -> Predictor:

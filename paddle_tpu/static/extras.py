@@ -44,8 +44,18 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None, checkpoints=None):
     """Reference: base/backward.py append_backward — adds the grad section
     and returns [(param, grad)]. In the collapsed design the tape IS the
-    program, so this runs backward and pairs params with their grads."""
+    program, so this runs backward and pairs params with their grads.
+
+    Under static capture (program_guard) the grad section is recorded
+    into the Program instead (see gradients)."""
     loss = ensure_tensor(loss)
+    if _capture_grad_possible(loss):
+        if parameter_list is None:
+            raise ValueError(
+                "append_backward under program_guard needs an explicit "
+                "parameter_list (the eager tape is off during capture)")
+        grads = gradients([loss], list(parameter_list))
+        return list(zip(parameter_list, grads))
     loss.backward(retain_graph=True)
     params = parameter_list
     if params is None:
@@ -83,8 +93,41 @@ def _walk_tape_params(loss):
     return out
 
 
+def _capture_grad_possible(loss) -> bool:
+    import jax
+
+    from ..core import dispatch
+
+    return dispatch.capture_active() and isinstance(
+        loss._value, jax.ShapeDtypeStruct)
+
+
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    """Reference: paddle.static.gradients — grads of targets w.r.t inputs."""
+    """Reference: paddle.static.gradients — grads of targets w.r.t inputs.
+
+    Under static capture the eager tape is off, so this records a
+    ``__gradients__`` instruction into the Program (the append_backward
+    grad-section analog); the Executor replays it as jax.grad over the
+    captured forward — which is what lets the recompute pass turn
+    checkpoint marks into jax.checkpoint segments."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    loss = ensure_tensor(targets[0])
+    if _capture_grad_possible(loss):
+        from ..core import dispatch
+
+        if target_gradients is not None:
+            raise NotImplementedError(
+                "target_gradients is not supported under static capture")
+        if no_grad_set:
+            raise NotImplementedError(
+                "no_grad_set is not supported under static capture")
+        # multiple targets: paddle semantics differentiate their sum —
+        # the adds are captured as ordinary instructions
+        for extra in targets[1:]:
+            loss = loss + ensure_tensor(extra)
+        prog = dispatch._capture_program
+        return prog.record_gradients(loss, [ensure_tensor(i)
+                                            for i in inputs])
     from ..autograd import grad as _grad
 
     outs = _grad(targets, inputs, target_gradients, retain_graph=True,
@@ -314,11 +357,15 @@ def serialize_program(feed_vars=None, fetch_vars=None, program=None,
     from .program import default_main_program
 
     program = program or default_main_program()
+    fetch_vids = list(getattr(program, "_fetch_vids", ()))
+    if fetch_vars:
+        fetch_vids = [program.vid_of(v) for v in fetch_vars]
     return pickle.dumps({
         "placeholders": program._placeholders,
         "insts": program._insts,
         "next_vid": program._next_vid,
         "feed_names": program._feed_names,
+        "fetch_vids": fetch_vids,
     })
 
 
@@ -341,14 +388,19 @@ def load_from_file(path):
 
 
 def deserialize_program(data):
+    return program_from_payload(pickle.loads(data))
+
+
+def program_from_payload(payload):
+    """Rebuild a Program from an already-unpickled .pdmodel payload."""
     from .program import Program
 
-    payload = pickle.loads(data)
     p = Program()
     p._placeholders = payload["placeholders"]
     p._insts = payload["insts"]
     p._next_vid = payload["next_vid"]
     p._feed_names = payload["feed_names"]
+    p._fetch_vids = tuple(payload.get("fetch_vids", ()))
     return p
 
 
@@ -361,9 +413,19 @@ def deserialize_persistables(program, data, executor=None):
 
 
 def normalize_program(program, feed_vars, fetch_vars, **kwargs):
-    """Reference: static/io.py normalize_program — prune to the feed→fetch
-    slice. The capture Program is already linear; a clone suffices."""
-    return program.clone(for_test=True)
+    """Reference: static/io.py normalize_program — prune to the
+    feed->fetch slice (dead-code elimination) and pin the fetch targets
+    so save/Predictor know the program's outputs."""
+    from ..distributed.passes import new_pass
+
+    clone = program.clone(for_test=True)
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    fetch_vids = [program.vid_of(v) for v in fetch_vars]
+    new_pass("dead_code_elimination",
+             {"fetch": fetch_vids}).apply(clone, None)
+    clone._fetch_vids = tuple(fetch_vids)
+    return clone
 
 
 def save(program, model_path, protocol=4, **configs):

@@ -103,6 +103,36 @@ class Program:
             )
         return vid
 
+    def record_gradients(self, loss_t: Tensor, wrt_ts: Sequence[Tensor]):
+        """Append the grad section as ONE ``__gradients__`` instruction
+        (reference: base/backward.py append_backward adds grad ops).
+
+        The Executor replays it as ``jax.grad`` of a sub-replay of the
+        forward instructions — so the backward is jax-generated, and the
+        recompute pass (distributed/passes/program_passes.py) can mark
+        checkpoint values that partition that sub-replay into
+        ``jax.checkpoint`` segments."""
+        loss_vid = self.vid_of(loss_t)
+        wrt_vids = tuple(self._vid_for_input(t._value) for t in wrt_ts)
+        fwd_len = len(self._insts)
+        grad_vids = []
+        outs = []
+        for t in wrt_ts:
+            # _value.dtype works for ShapeDtypeStruct placeholders AND
+            # concrete arrays (np.asarray would make an object scalar of
+            # a placeholder and force a device copy of an array)
+            spec = jax.ShapeDtypeStruct(tuple(t.shape), t._value.dtype)
+            vid = self._new_vid()
+            self._vid_by_obj[id(spec)] = vid
+            self._keepalive.append(spec)
+            grad_vids.append(vid)
+            outs.append(Tensor._from_value(spec, stop_gradient=True))
+        self._insts.append(
+            ("__gradients__", (loss_vid,) + wrt_vids,
+             (("fwd_len", fwd_len),), tuple(grad_vids)))
+        self._cache.clear()
+        return outs
+
     # -- parity surface --------------------------------------------------
     def global_block(self):
         return self
@@ -120,6 +150,10 @@ class Program:
         p._keepalive = list(self._keepalive)
         p._feed_names = dict(self._feed_names)
         p._cache = {}
+        if hasattr(self, "_remat_checkpoints"):
+            p._remat_checkpoints = self._remat_checkpoints
+        if hasattr(self, "_fetch_vids"):
+            p._fetch_vids = self._fetch_vids
         return p
 
     @property
@@ -132,6 +166,82 @@ class Program:
         for name, in_vids, static, out_vids in self._insts:
             lines.append(f"  %{out_vids} = {name}(%{in_vids})")
         return "\n".join(lines)
+
+
+def _build_loss_fn(program: Program, fwd_len: int, loss_vid: int,
+                   wrt_vids, env: Dict[int, Any]):
+    """Build loss(wrt_values) as a sub-replay of the first ``fwd_len``
+    instructions.
+
+    When the recompute pass has marked checkpoint vids on the program
+    (``_remat_checkpoints``), the forward is partitioned at their
+    producing instructions and each segment runs under ``jax.checkpoint``
+    — activations internal to a segment are rematerialized in the
+    backward instead of saved, the reference auto_parallel_recompute
+    semantics expressed the jax way."""
+    insts = [i for i in program._insts[:fwd_len]
+             if i[0] != "__gradients__"]
+    ckpts = set(getattr(program, "_remat_checkpoints", ()) or ())
+    wrt_vids = tuple(wrt_vids)
+
+    # split after every instruction that produces a checkpoint vid
+    segments: List[List[tuple]] = [[]]
+    for inst in insts:
+        segments[-1].append(inst)
+        if ckpts and any(v in ckpts for v in inst[3]):
+            segments.append([])
+    segments = [s for s in segments if s]
+
+    # dataflow interface per segment: traced inputs = values produced by
+    # earlier segments or differentiated (wrt); outputs = values later
+    # segments (or the loss) read. env consts/feeds are closed over.
+    produced_before: set = set()
+    seg_io = []
+    all_produced = {v for inst in insts for v in inst[3]}
+    for si, seg in enumerate(segments):
+        seg_out = {v for inst in seg for v in inst[3]}
+        ext_in = {v for inst in seg for v in inst[1]
+                  if v not in seg_out and (v in produced_before
+                                           or v in wrt_vids)}
+        used_later = {v for later in segments[si + 1:]
+                      for inst in later for v in inst[1]}
+        used_later.add(loss_vid)
+        seg_io.append((sorted(ext_in), sorted(seg_out & used_later)))
+        produced_before |= seg_out
+
+    def run_seg(seg, in_list, out_list, *in_vals):
+        local = dict(zip(in_list, in_vals))
+
+        def val(v):
+            return local[v] if v in local else env[v]
+
+        for prim_name, in_vids_, static_items, out_vids_ in seg:
+            prim = dispatch.PRIMITIVES[prim_name]
+            outs = prim.forward(*[val(v) for v in in_vids_],
+                                **dict(static_items))
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for v, o in zip(out_vids_, outs):
+                local[v] = o
+        return tuple(local[v] for v in out_list)
+
+    def loss_of(wrt_vals):
+        flow: Dict[int, Any] = dict(zip(wrt_vids, wrt_vals))
+        for seg, (in_list, out_list) in zip(segments, seg_io):
+            fn = functools.partial(run_seg, seg, in_list, out_list)
+            if ckpts and len(segments) > 1:
+                fn = jax.checkpoint(fn)
+            outs = fn(*[flow[v] for v in in_list])
+            flow.update(dict(zip(out_list, outs)))
+        return flow[loss_vid]
+
+    return loss_of
+
+
+def _replay_gradients(program: Program, fwd_len: int, loss_vid: int,
+                      wrt_vids, env: Dict[int, Any]):
+    loss_of = _build_loss_fn(program, fwd_len, loss_vid, wrt_vids, env)
+    grads = jax.grad(loss_of)([env[v] for v in tuple(wrt_vids)])
+    return tuple(grads)
 
 
 _default_main = Program()
@@ -268,14 +378,25 @@ class Executor:
         return results
 
     @staticmethod
-    def _compile(program: Program, feed_names, fetch_vids):
+    def _compile(program: Program, feed_names, fetch_vids,
+                 donate: bool = False):
         name_to_vid = program._feed_names
 
         def replay(*feed_arrays):
             env: Dict[int, Any] = dict(program._consts)
             for n, a in zip(feed_names, feed_arrays):
                 env[name_to_vid[n]] = a
-            for prim_name, in_vids, static_items, out_vids in program._insts:
+            for idx, (prim_name, in_vids, static_items,
+                      out_vids) in enumerate(program._insts):
+                if prim_name == "__gradients__":
+                    # the forward is whatever precedes this instruction
+                    # NOW — rewrite passes may have shrunk the list, so
+                    # the captured fwd_len count cannot be trusted
+                    grads = _replay_gradients(
+                        program, idx, in_vids[0], in_vids[1:], env)
+                    for v, g in zip(out_vids, grads):
+                        env[v] = g
+                    continue
                 prim = dispatch.PRIMITIVES[prim_name]
                 outs = prim.forward(
                     *[env[v] for v in in_vids], **dict(static_items)
@@ -285,6 +406,11 @@ class Executor:
                     env[v] = o
             return [env[v] for v in fetch_vids]
 
+        if donate:
+            # inference memory_optim: feed buffers are donated so XLA's
+            # buffer assignment reuses them for outputs/temps
+            return jax.jit(replay,
+                           donate_argnums=tuple(range(len(feed_names))))
         return jax.jit(replay)
 
     def close(self):
