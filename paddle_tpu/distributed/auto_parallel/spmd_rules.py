@@ -567,6 +567,14 @@ def _expand_as_rule(x: DistTensorSpec, y: DistTensorSpec = None,
             out_mapping.append(mapping[src])
         else:
             out_mapping.append(y_map[i] if y is not None else -1)
+    # one mesh dim may not shard two tensor dims: first writer wins
+    # (matching _merge_letter_shardings' conflict rule)
+    seen = set()
+    for i, m in enumerate(out_mapping):
+        if m >= 0 and m in seen:
+            out_mapping[i] = -1
+        elif m >= 0:
+            seen.add(m)
     out = DistTensorSpec.from_dims_mapping(out_shape, x.mesh, out_mapping)
     new_in = [DistTensorSpec.from_dims_mapping(x.shape, x.mesh, mapping)]
     if y is not None:

@@ -49,7 +49,53 @@ from jax.sharding import PartitionSpec as P
 from .meta_parallel.pipeline_schedules import make_schedule, simulate
 
 __all__ = ["compile_pipeline_plan", "pipeline_schedule_train_step",
-           "stack_chunk_params"]
+           "stack_chunk_params", "mp_copy", "mp_reduce"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_copy(x, axis):
+    """Megatron's f operator: identity forward, psum backward.
+
+    Wrap the INPUT of column-parallel matmuls inside a manual-TP
+    stage_fn: each device's contribution to dx is partial over the mp
+    axis, so the cotangent must be summed. Under plain jax.vjp inside
+    shard_map the transpose of lax.psum is another psum (reference:
+    fleet/meta_parallel/mp_layers _IdentityInForward/_AllReduceBackward
+    semantics), which double-counts — these helpers pin the correct
+    pairing."""
+    return x
+
+
+def _mp_copy_fwd(x, axis):
+    return x, None
+
+
+def _mp_copy_bwd(axis, _res, g):
+    return (lax.psum(g, axis),)
+
+
+mp_copy.defvjp(_mp_copy_fwd, _mp_copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_reduce(x, axis):
+    """Megatron's g operator: psum forward, identity backward.
+
+    Use INSTEAD of a bare lax.psum on row-parallel outputs: the
+    cotangent of the reduced (replicated) output is already replicated,
+    so the backward must NOT psum it again."""
+    return lax.psum(x, axis)
+
+
+def _mp_reduce_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _mp_reduce_bwd(axis, _res, g):
+    return (g,)
+
+
+mp_reduce.defvjp(_mp_reduce_fwd, _mp_reduce_bwd)
 
 # instruction opcodes in the kind table
 _NOP, _F, _B, _W = 0, 1, 2, 3
@@ -227,8 +273,13 @@ def pipeline_schedule_train_step(stage_fn: Callable, loss_fn: Callable,
     PartitionSpecs for the dims AFTER the leading chunk dim (e.g.
     ``P(None, "mp")`` for a column-parallel weight). stage_fn then sees
     mp-LOCAL shards and is responsible for its own tensor-parallel
-    collectives (``lax.psum(..., "mp")`` after row-parallel matmuls),
-    Megatron-style. Defaults to fully replicated stage params.
+    collectives, Megatron-style — and MUST use this module's
+    ``mp_copy`` (identity fwd / psum bwd, on column-parallel inputs)
+    and ``mp_reduce`` (psum fwd / identity bwd, on row-parallel
+    outputs) rather than bare ``lax.psum``: the engine differentiates
+    stage_fn with jax.vjp inside shard_map, where a bare psum
+    transposes into another psum and scales sharded-weight grads by the
+    TP degree. Defaults to fully replicated stage params.
 
     Returns (mean loss, chunk grads pytree [C, ...] — gradients of the
     MEAN loss, matching pipeline_spmd_train_step)."""

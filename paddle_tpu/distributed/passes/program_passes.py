@@ -26,6 +26,7 @@ Implemented passes:
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -108,21 +109,14 @@ class ConstantFoldingPass(_ProgramPass):
                 continue
             prim = dispatch.PRIMITIVES[prim_name]
             with jax.default_device(jax.devices("cpu")[0]) \
-                    if jax.default_backend() != "cpu" else _nullcontext():
+                    if jax.default_backend() != "cpu" \
+                    else contextlib.nullcontext():
                 outs = prim.forward(*[consts[v] for v in in_vids],
                                     **dict(static_items))
             outs = outs if isinstance(outs, tuple) else (outs,)
             for v, o in zip(out_vids, outs):
                 consts[v] = np.asarray(o)
         prog._insts = new_insts
-
-
-class _nullcontext:
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *a):
-        return False
 
 
 class DeadCodeEliminationPass(_ProgramPass):
@@ -161,6 +155,13 @@ class FuseAddActPass(_ProgramPass):
 
     def _apply_one(self, prog, context):
         insts = prog._insts
+        # the add's output must not outlive the fusion: protect fetch
+        # targets (the fused op would delete their only producer)
+        protected: Set[int] = set(getattr(prog, "_fetch_vids", ()) or ())
+        for t in self.attrs.get("fetch", []) or []:
+            protected.add(self._vid(prog, t))
+        if context is not None:
+            protected.update(context.get_attr("fetch_vids", ()) or ())
         consumers: Dict[int, List[int]] = {}
         for idx, (_n, in_vids, _s, _o) in enumerate(insts):
             for v in in_vids:
@@ -171,7 +172,8 @@ class FuseAddActPass(_ProgramPass):
             if idx in drop:
                 continue
             prim_name, in_vids, static_items, out_vids = inst
-            if prim_name == "add" and len(out_vids) == 1:
+            if prim_name == "add" and len(out_vids) == 1 \
+                    and out_vids[0] not in protected:
                 users = consumers.get(out_vids[0], [])
                 if len(users) == 1:
                     nxt = insts[users[0]]

@@ -207,32 +207,32 @@ class FusedMoELayer(Layer):
 # ---------------------------------------------------------------------------
 # index-dispatch fast path (single-device / no-EP)
 # ---------------------------------------------------------------------------
-def _moe_idx_ffn_fwd(probs, x, w0, b0, w1, b1, key, *, k, capacity,
-                     activation, normalize, random2):
-    """Routed MoE FFN with scatter/gather dispatch.
+def _route(probs, key, *, k, capacity, normalize, random2):
+    """GShard routing shared by the fwd and the manual vjp.
 
-    The dense [N,E,C] one-hot einsums cost O(N*E*C*d) MXU FLOPs — ~2.4x
-    the expert GEMMs at bench shapes — where index scatter/gather is
-    memory-bound O(N*k*d). This path keeps identical math (same GShard
-    cumsum capacity ordering as moe_dispatch_p) for the chip-resident
-    case; EP-sharded meshes keep the einsum form whose expert-dim
-    sharding GSPMD turns into the all-to-all.
-    """
+    Returns (tv, raw_tv, top_idx, keep, flat, token_of_slot, j_of_slot,
+    keep2): tv are the (possibly normalized) combine weights BEFORE the
+    keep mask; every integer output is piecewise-constant in probs (no
+    gradient flows through it)."""
     import jax
     import jax.numpy as jnp
 
-    n, d = x.shape
+    n = probs.shape[0]
     e = probs.shape[-1]
     c = capacity
     top_vals, top_idx = jax.lax.top_k(probs, k)
+    keep2 = None
     if random2 and k >= 2:
         u = jax.random.uniform(key, (n,))
         keep2 = u < 2.0 * top_vals[:, 1]
         top_vals = top_vals.at[:, 1].set(
             jnp.where(keep2, top_vals[:, 1], 0.0))
+    raw_tv = top_vals
     if normalize:
-        top_vals = top_vals / jnp.maximum(
+        tv = top_vals / jnp.maximum(
             jnp.sum(top_vals, axis=1, keepdims=True), 1e-9)
+    else:
+        tv = top_vals
 
     prior = jnp.zeros((e,), jnp.int32)
     slots, keeps = [], []
@@ -246,20 +246,54 @@ def _moe_idx_ffn_fwd(probs, x, w0, b0, w1, b1, key, *, k, capacity,
         slots.append(pos_j)
     slot = jnp.stack(slots, 1)
     keep = jnp.stack(keeps, 1)                         # [N, k]
-    w = jnp.where(keep, top_vals, 0.0)
     flat = jnp.where(keep, top_idx * c + slot, e * c)  # overflow bin e*c
 
-    contrib = jnp.broadcast_to(x[:, None, :], (n, k, d)) \
-        * keep[..., None].astype(x.dtype)
-    disp = jnp.zeros((e * c + 1, d), x.dtype).at[
-        flat.reshape(-1)].add(contrib.reshape(n * k, d))
-    disp = disp[: e * c].reshape(e, c, d)
+    # slot -> (token, j) inverse maps: every kept (token, j) owns a
+    # unique flat slot, so int32 scatters (not float scatter-adds) build
+    # the permutation; unfilled slots point at the zero-pad row n
+    arange_n = jnp.arange(n, dtype=jnp.int32)
+    token_of_slot = jnp.full((e * c + 1,), n, jnp.int32).at[
+        flat.reshape(-1)].set(
+            jnp.broadcast_to(arange_n[:, None], (n, k)).reshape(-1))
+    j_of_slot = jnp.zeros((e * c + 1,), jnp.int32).at[
+        flat.reshape(-1)].set(
+            jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :],
+                             (n, k)).reshape(-1))
+    return tv, raw_tv, top_idx, keep, flat, token_of_slot, j_of_slot, keep2
+
+
+def _moe_idx_ffn_fwd(probs, x, w0, b0, w1, b1, key, *, k, capacity,
+                     activation, normalize, random2):
+    """Routed MoE FFN with permutation (gather-only) dispatch.
+
+    The dense [N,E,C] one-hot einsums cost O(N*E*C*d) MXU FLOPs — ~2.4x
+    the expert GEMMs at bench shapes — and a float scatter-add dispatch
+    lowers to a serialized sort/combine on TPU. Here dispatch/combine
+    are pure row gathers through the slot<->token permutation built with
+    int32 scatters; the manual vjp below keeps the BACKWARD gather-only
+    too (autodiff of a gather is a float scatter-add, which is how the
+    cost sneaks back in otherwise). EP-sharded meshes keep the einsum
+    form whose expert-dim sharding GSPMD turns into the all-to-all.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n, d = x.shape
+    e = probs.shape[-1]
+    c = capacity
+    tv, _raw, _idx, keep, flat, token_of_slot, _j, _k2 = _route(
+        probs, key, k=k, capacity=capacity, normalize=normalize,
+        random2=random2)
+    w = jnp.where(keep, tv, 0.0)
+
+    x_ext = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)], axis=0)
+    disp = x_ext[token_of_slot[: e * c]].reshape(e, c, d)
 
     act = getattr(jax.nn, activation)
-    h = jnp.einsum("ecd,edh->ech", disp, w0,
-                   preferred_element_type=jnp.float32).astype(x.dtype) + b0
-    h = act(h)
-    y = jnp.einsum("ech,ehd->ecd", h, w1,
+    h1 = jnp.einsum("ecd,edh->ech", disp, w0,
+                    preferred_element_type=jnp.float32).astype(x.dtype) + b0
+    a = act(h1)
+    y = jnp.einsum("ech,ehd->ecd", a, w1,
                    preferred_element_type=jnp.float32).astype(x.dtype) + b1
     yf = jnp.concatenate(
         [y.reshape(e * c, d), jnp.zeros((1, d), y.dtype)], axis=0)
@@ -267,6 +301,98 @@ def _moe_idx_ffn_fwd(probs, x, w0, b0, w1, b1, key, *, k, capacity,
     return jnp.sum(w[..., None].astype(x.dtype) * gathered, axis=1)
 
 
+def _moe_idx_ffn_vjp(grads_out, saved, *, k, capacity, activation,
+                     normalize, random2):
+    """Manual backward: every dispatch/combine adjoint is a GATHER
+    through the inverse permutation (slot->token / token->slot maps from
+    _route), never a [E*C, d] float scatter-add. Expert weight/input
+    grads are the usual batched GEMMs; routing ints are
+    piecewise-constant so no gradient flows through them (matching
+    jax.vjp of the forward, which the grad-check test asserts)."""
+    import jax
+    import jax.numpy as jnp
+
+    g = grads_out[0]
+    probs, x, w0, b0, w1, b1, key = saved
+    n, d = x.shape
+    e = probs.shape[-1]
+    c = capacity
+    f32 = jnp.float32
+
+    tv, raw_tv, top_idx, keep, flat, token_of_slot, j_of_slot, keep2 = \
+        _route(probs, key, k=k, capacity=capacity, normalize=normalize,
+               random2=random2)
+    w_comb = jnp.where(keep, tv, 0.0)                  # [N, k] f32
+
+    # ---- rematerialize forward activations: XLA CSEs these GEMMs with
+    # the forward's inside one jitted train step, so the recompute is
+    # free (measured: SAVING h1/y as extra outputs was net slower) ----
+    x_ext = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)], axis=0)
+    tok = token_of_slot[: e * c]
+    disp = x_ext[tok].reshape(e, c, d)
+    act = getattr(jax.nn, activation)
+    h1 = jnp.einsum("ecd,edh->ech", disp, w0,
+                    preferred_element_type=f32).astype(x.dtype) + b0
+    a, act_vjp = jax.vjp(act, h1)
+    y = jnp.einsum("ech,ehd->ecd", a, w1,
+                   preferred_element_type=f32).astype(x.dtype) + b1
+    yf = jnp.concatenate(
+        [y.reshape(e * c, d), jnp.zeros((1, d), y.dtype)], axis=0)
+    gathered = yf[flat]                                # [N, k, d]
+
+    # ---- combine adjoints -------------------------------------------
+    d_wcomb = jnp.einsum("nkd,nd->nk", gathered.astype(f32),
+                         g.astype(f32))
+    # dy[slot] = w_comb[token(slot), j(slot)] * g[token(slot)]
+    g_ext = jnp.concatenate([g, jnp.zeros((1, d), g.dtype)], axis=0)
+    w_pad = jnp.concatenate([w_comb, jnp.zeros((1, k), w_comb.dtype)], 0)
+    w_slot = w_pad[tok, j_of_slot[: e * c]]            # [E*C] f32
+    dy = (g_ext[tok] * w_slot[:, None].astype(g.dtype)).reshape(e, c, d)
+
+    # ---- expert GEMM adjoints ---------------------------------------
+    # Calibration (v5e, 50-iter on-device scans): these width-1408 GEMMs
+    # run at ~50 TF/s however expressed — XLA batched einsum 48-53, XLA
+    # flat [16k,2048]x[2048,1408] 49, naive Pallas tiles 35 — while the
+    # same shapes at width 5632 hit 115. The narrow-N MXU ceiling, not
+    # dispatch, is what separates MoE (~0.55 MFU) from the dense path
+    # (0.69); zero-padding h to 1536 wins +21% in isolation but loses
+    # end-to-end to the pad/slice traffic it adds.
+    dw1 = jnp.einsum("ech,ecd->ehd", a, dy,
+                     preferred_element_type=f32).astype(w1.dtype)
+    db1 = jnp.sum(dy.astype(f32), axis=1, keepdims=True).astype(b1.dtype)
+    da = jnp.einsum("ecd,ehd->ech", dy, w1,
+                    preferred_element_type=f32).astype(a.dtype)
+    dh1 = act_vjp(da)[0]
+    dw0 = jnp.einsum("ecd,ech->edh", disp, dh1,
+                     preferred_element_type=f32).astype(w0.dtype)
+    db0 = jnp.sum(dh1.astype(f32), axis=1, keepdims=True).astype(b0.dtype)
+    ddisp = jnp.einsum("ech,edh->ecd", dh1, w0,
+                       preferred_element_type=f32).astype(x.dtype)
+
+    # ---- dispatch adjoint: dx[n] = sum_j keep * ddisp[slot(n, j)] ----
+    ddisp_ext = jnp.concatenate(
+        [ddisp.reshape(e * c, d), jnp.zeros((1, d), ddisp.dtype)], axis=0)
+    dx = jnp.sum(ddisp_ext[flat]
+                 * keep[..., None].astype(ddisp.dtype), axis=1)
+
+    # ---- gate-prob adjoints -----------------------------------------
+    dtv = d_wcomb * keep.astype(f32)
+    if normalize:
+        ssum = jnp.sum(raw_tv, axis=1, keepdims=True)
+        S = jnp.maximum(ssum, 1e-9)
+        dS = -jnp.sum(dtv * raw_tv, axis=1, keepdims=True) / (S * S)
+        draw = dtv / S + jnp.where(ssum > 1e-9, dS, 0.0)
+    else:
+        draw = dtv
+    if random2 and k >= 2:
+        draw = draw.at[:, 1].set(
+            jnp.where(keep2, draw[:, 1], 0.0))
+    dprobs = jnp.sum(
+        jax.nn.one_hot(top_idx, e, dtype=f32) * draw[..., None], axis=1)
+    return (dprobs.astype(probs.dtype), dx.astype(x.dtype), dw0, db0,
+            dw1, db1, None)
+
+
 from .....ops._helpers import defprim as _defprim  # noqa: E402
 
-_defprim("moe_idx_ffn_p", _moe_idx_ffn_fwd)
+_defprim("moe_idx_ffn_p", _moe_idx_ffn_fwd, vjp=_moe_idx_ffn_vjp)

@@ -135,22 +135,14 @@ class Predictor:
         self._output_names: List[str] = []
         self.analysis_passes_applied: List[str] = []
 
-        payload = self._peek_payload(config.prog_file())
-        if isinstance(payload, dict) and "insts" in payload:
-            self._init_static(config, payload)
+        from .static.extras import load_static_artifact
+
+        prog = load_static_artifact(config.prog_file(),
+                                    params_file=config.params_file())
+        if prog is not None:
+            self._init_static(config, prog)
         else:
             self._init_stablehlo(config)
-
-    @staticmethod
-    def _peek_payload(path):
-        import pickle
-
-        p = path if path.endswith(".pdmodel") else path + ".pdmodel"
-        try:
-            with open(p, "rb") as f:
-                return pickle.loads(f.read())
-        except Exception:
-            return None
 
     def _init_stablehlo(self, config):
         from . import jit
@@ -160,30 +152,16 @@ class Predictor:
         self._input_names = [f"x{i}" for i in range(len(in_specs))]
         self._in_specs = in_specs
 
-    def _init_static(self, config, payload):
+    def _init_static(self, config, prog):
         from .distributed.passes import PassManager, new_pass
-        from .static.extras import (
-            deserialize_persistables, load_from_file, program_from_payload,
-        )
 
-        prog = program_from_payload(payload)
-        params_path = config.params_file()
-        if params_path is None:
-            base = config.prog_file()
-            base = base[:-len(".pdmodel")] if base.endswith(".pdmodel") \
-                else base
-            params_path = base + ".pdparams"
-        try:
-            deserialize_persistables(prog, load_from_file(params_path))
-        except FileNotFoundError:
-            pass
         fetch_vids = list(getattr(prog, "_fetch_vids", ()) or ())
         if not fetch_vids and prog._insts:
             fetch_vids = list(prog._insts[-1][3])  # last op's outputs
         if config.ir_optim():
             pm = PassManager([
                 new_pass("constant_folding"),
-                new_pass("fuse_elewise_add_act"),
+                new_pass("fuse_elewise_add_act", {"fetch": fetch_vids}),
                 new_pass("dead_code_elimination", {"fetch": fetch_vids}),
             ])
             pm.apply(prog, None)

@@ -28,24 +28,42 @@ def name_scope(name):
 
 
 def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
-                         executor=None, **kwargs):
-    """Reference: paddle.static.save_inference_model. The TPU framework's
-    inference artifact is the jit.save payload (params + StableHLO); pass
-    the source layer via kwargs['layer'] or export with paddle_tpu.jit.save
-    directly."""
-    layer = kwargs.get("layer")
-    if layer is None:
-        raise NotImplementedError(
-            "save_inference_model for raw static programs is not supported; "
-            "export the model with paddle_tpu.jit.save(layer, path, "
-            "input_spec=...) and serve it with paddle_tpu.inference"
-        )
-    from .. import jit
+                         executor=None, program=None, **kwargs):
+    """Reference: paddle.static.save_inference_model.
 
-    jit.save(layer, path_prefix, input_spec=feed_vars)
+    Two artifact kinds, matching the two capture modes:
+    - dynamic layer (kwargs['layer']): jit.save payload (params +
+      StableHLO);
+    - captured static Program (``program=...`` or the current default
+      main program): normalize to the feed->fetch slice and write
+      <prefix>.pdmodel/.pdparams — the form inference.Predictor's
+      analysis pipeline consumes."""
+    layer = kwargs.get("layer")
+    if layer is not None:
+        from .. import jit
+
+        jit.save(layer, path_prefix, input_spec=feed_vars)
+        return
+    from .extras import normalize_program, save
+    from .program import default_main_program
+
+    program = program or default_main_program()
+    pruned = normalize_program(program, feed_vars, fetch_vars)
+    save(pruned, path_prefix)
 
 
 def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Reference: paddle.static.load_inference_model — returns
+    (program-or-fn, feed_names, fetch_targets). Static .pdmodel
+    programs come back as a Program (run via static.Executor with the
+    returned fetch vids); jit.save payloads come back as the loaded
+    callable."""
+    from .extras import load_static_artifact
+
+    prog = load_static_artifact(path_prefix)
+    if prog is not None:
+        feed_names = [n for n, _v, _s, _d in prog._placeholders]
+        return prog, feed_names, list(getattr(prog, "_fetch_vids", ()))
     from .. import jit
 
     fn = jit.load(path_prefix)

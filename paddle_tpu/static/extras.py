@@ -391,6 +391,28 @@ def deserialize_program(data):
     return program_from_payload(pickle.loads(data))
 
 
+def load_static_artifact(path_prefix, params_file=None):
+    """Load <prefix>.pdmodel (+ .pdparams) when it holds a STATIC
+    program payload; returns the Program or None for other artifact
+    kinds (e.g. jit.save StableHLO payloads). The single loader behind
+    both static.load_inference_model and inference.Predictor."""
+    p = path_prefix if path_prefix.endswith(".pdmodel") \
+        else path_prefix + ".pdmodel"
+    try:
+        payload = pickle.loads(load_from_file(p))
+    except (FileNotFoundError, pickle.UnpicklingError, EOFError):
+        return None
+    if not (isinstance(payload, dict) and "insts" in payload):
+        return None
+    prog = program_from_payload(payload)
+    pp = params_file or p[: -len(".pdmodel")] + ".pdparams"
+    try:
+        deserialize_persistables(prog, load_from_file(pp))
+    except FileNotFoundError:
+        pass
+    return prog
+
+
 def program_from_payload(payload):
     """Rebuild a Program from an already-unpickled .pdmodel payload."""
     from .program import Program

@@ -1,0 +1,343 @@
+"""Callable parity sweep: CALL every exported name, don't just hasattr it.
+
+Extends tests/test_api_parity.py (which checks the reference's __all__
+names exist) to actually invoking each callable with synthesized minimal
+arguments. Existence != works: a name can resolve to a stub that raises
+NotImplementedError the first time anyone calls it. This gate:
+
+- calls every callable exported by each parity namespace (positional
+  required args synthesized by name/shape heuristics);
+- classifies each call: ok / raised-while-running (body executed — shape
+  or value errors from synthesized args are fine) / could-not-bind
+  (synthesis failed to satisfy the signature) / NOT-IMPLEMENTED;
+- FAILS if any callable raises NotImplementedError unless it appears in
+  SKIP_WITH_REASON with a one-line justification;
+- reports called/total per namespace (run pytest -s to see the table).
+
+Reference analog: the op-level coverage of test/legacy_test/* — every op
+there is executed, not imported.
+"""
+import importlib
+import inspect
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from test_api_parity import NAMESPACES, REF_ROOT, _ref_all
+
+# ---------------------------------------------------------------------------
+# justified skips
+# ---------------------------------------------------------------------------
+# Namespaces never swept, with reasons.
+SKIP_NAMESPACES = {
+    "hub.py": "every API performs a network download (zero-egress image)",
+    "vision/datasets/__init__.py":
+        "dataset constructors download archives (zero-egress image)",
+    "text/__init__.py":
+        "dataset constructors download corpora (zero-egress image); the "
+        "viterbi ops are covered by tests/test_audio_text.py",
+    "audio/__init__.py":
+        "dataset loaders read external audio files; functional ops are "
+        "covered by tests/test_audio_text.py",
+    "distributed/communication/stream/__init__.py":
+        "collectives need an initialized process group; covered end-to-end "
+        "by tests/test_launch_collectives.py (two real processes)",
+    "utils/cpp_extension/__init__.py":
+        "each call spawns a C++ compiler build; covered by "
+        "tests/test_native.py",
+}
+
+# Individual callables skipped with justification.
+SKIP_WITH_REASON = {
+    # --- needs an initialized distributed runtime (would bind sockets /
+    #     block); the two-process launcher test covers the real path
+    "distributed/__init__.py": {
+        "init_parallel_env": "binds a TCPStore and blocks for peers; "
+                             "covered by test_launch_collectives.py",
+        "barrier": "needs an initialized process group",
+        "all_reduce": "needs an initialized process group",
+        "all_gather": "needs an initialized process group",
+        "all_gather_object": "needs an initialized process group",
+        "all_to_all": "needs an initialized process group",
+        "all_to_all_single": "needs an initialized process group",
+        "alltoall": "needs an initialized process group",
+        "alltoall_single": "needs an initialized process group",
+        "broadcast": "needs an initialized process group",
+        "broadcast_object_list": "needs an initialized process group",
+        "reduce": "needs an initialized process group",
+        "reduce_scatter": "needs an initialized process group",
+        "scatter": "needs an initialized process group",
+        "scatter_object_list": "needs an initialized process group",
+        "send": "needs an initialized process group",
+        "recv": "needs an initialized process group",
+        "isend": "needs an initialized process group",
+        "irecv": "needs an initialized process group",
+        "gather": "needs an initialized process group",
+        "stream": "namespace module, not a callable API",
+        "spawn": "forks worker processes running a user function",
+        "launch": "process launcher entry point (covered by "
+                  "test_launch_elastic.py)",
+        "destroy_process_group": "needs an initialized process group",
+        "new_group": "needs an initialized process group",
+        "wait": "needs an initialized process group",
+        "get_group": "needs a created group id",
+    },
+    "distributed/fleet/__init__.py": {
+        "init": "mutates the global fleet singleton for the whole "
+                "process; covered by test_distributed.py fixtures",
+    },
+    "device/__init__.py": {
+        "XPUPlace": "XPU runtime is explicitly out of scope on the TPU "
+                    "build (raises by design)",
+        "IPUPlace": "IPU hardware is explicitly out of scope on the TPU "
+                    "build (raises by design)",
+    },
+    "device/xpu/__init__.py": {
+        "synchronize": "XPU runtime is explicitly out of scope on the "
+                       "TPU build (raises by design)",
+    },
+    "__init__.py": {
+        "grad": "requires a live autograd graph built from its inputs; "
+                "covered by tests/test_autograd.py",
+    },
+    "static/__init__.py": {
+        "IpuCompiledProgram": "IPU hardware is out of scope on the TPU "
+                              "build; raises by design (parity name)",
+        "IpuStrategy": "IPU hardware is out of scope; raises by design",
+        "set_ipu_shard": "IPU hardware is out of scope; raises by design",
+        "ipu_shard_guard": "IPU hardware is out of scope; raises by "
+                           "design",
+    },
+    "optimizer/lr.py": {
+        "LRScheduler": "abstract base — get_lr must be overridden; the "
+                       "reference base class raises the same way",
+    },
+    "vision/models/__init__.py": {
+        "DenseNet": "ctor materializes full ImageNet-scale weights "
+                    "(>15s on the 1-core host); the densenet121 factory "
+                    "is exercised by tests/test_vision_hapi.py",
+        "GoogLeNet": "ctor materializes full ImageNet-scale weights; "
+                     "googlenet factory covered by test_vision_hapi.py",
+        "InceptionV3": "ctor materializes full ImageNet-scale weights; "
+                       "inception_v3 factory covered by "
+                       "test_vision_hapi.py",
+        "MobileNetV3Large": "ctor materializes full ImageNet-scale "
+                            "weights; factory covered by "
+                            "test_vision_hapi.py",
+        "ShuffleNetV2": "ctor materializes full ImageNet-scale weights; "
+                        "factory covered by test_vision_hapi.py",
+    },
+}
+
+# namespaces whose callables are pure constructors with NO I/O: a 15s
+# timeout there means real weight-init compute was running on this
+# 1-core host (a stub raises instantly), so count it as exercised
+TIMEOUT_MEANS_RAN = {"vision/models/__init__.py"}
+
+# per-callable synthesized-argument overrides where the generic
+# heuristics produce the wrong TYPES (not a gap — a synthesis limit)
+OVERRIDE_ARGS = {
+    ("distribution/__init__.py", "kl_divergence"): lambda: (
+        _paddle().distribution.Normal(0.0, 1.0),
+        _paddle().distribution.Normal(1.0, 2.0)),
+}
+
+
+def _skip_reason(sub, name):
+    return SKIP_WITH_REASON.get(sub, {}).get(name)
+
+
+# ---------------------------------------------------------------------------
+# argument synthesis
+# ---------------------------------------------------------------------------
+def _paddle():
+    import paddle_tpu
+
+    return paddle_tpu
+
+
+_TENSOR_NAMES = {
+    "x", "y", "a", "b", "input", "tensor", "t", "value", "values", "data",
+    "logits", "pred", "predictions", "img", "image", "hidden", "grad",
+    "grad_tensor", "query", "key", "mat", "matrix", "theta", "logit",
+    "input1", "input2", "x1", "x2", "weight_", "src", "arr", "obj",
+}
+_INT_TENSOR_NAMES = {"label", "labels", "target", "targets", "index",
+                     "indices", "ids", "input_ids", "row", "col"}
+
+
+def _synth_param(name, param):
+    paddle = _paddle()
+    lname = name.lower()
+    ann = param.annotation
+    if lname in _INT_TENSOR_NAMES:
+        return paddle.to_tensor(np.zeros((2,), "int64"))
+    if lname in _TENSOR_NAMES:
+        return paddle.to_tensor(np.random.rand(2, 3).astype("float32"))
+    if lname in ("shape", "size", "sizes"):
+        return [2, 3]
+    if lname in ("axis", "dim", "start", "offset", "device_id", "rank",
+                 "idx", "i"):
+        return 0
+    if lname in ("end", "stop", "step", "num", "n", "k", "depth",
+                 "num_classes", "nrows", "ncols", "num_rows",
+                 "num_columns", "blocksize", "kernel_size", "num_samples",
+                 "in_features", "out_features", "num_embeddings",
+                 "embedding_dim", "num_channels", "num_features",
+                 "in_channels", "out_channels", "groups", "repeat_times",
+                 "diagonal", "num_layers", "input_size", "hidden_size"):
+        return 2
+    if lname in ("dtype",):
+        return "float32"
+    if lname in ("name", "mode"):
+        return None if param.default is not inspect.Parameter.empty \
+            else "a"
+    if lname in ("path", "file", "filename", "model_path", "save_dir"):
+        return "/tmp/_sweep_artifact"
+    if lname in ("learning_rate", "lr"):
+        return 0.1
+    if lname in ("epsilon", "eps", "rho", "alpha", "beta", "momentum",
+                 "weight_decay", "scale", "sigma", "temperature", "p",
+                 "factor", "rate", "probs", "prob", "q"):
+        return 0.5
+    if lname in ("parameters", "params", "parameter_list"):
+        return list(paddle.nn.Linear(2, 2).parameters())
+    if lname in ("layer", "model", "net", "module", "sublayer"):
+        return paddle.nn.Linear(2, 2)
+    if lname in ("optimizer", "opt"):
+        return paddle.optimizer.SGD(
+            learning_rate=0.1,
+            parameters=list(paddle.nn.Linear(2, 2).parameters()))
+    if lname.startswith(("is_", "use_", "with_", "keep", "return_",
+                         "stop_", "include_", "enable")):
+        return False
+    if ann is bool or isinstance(param.default, bool):
+        return False
+    if ann is int:
+        return 2
+    if ann is float:
+        return 0.5
+    if ann is str:
+        return "a"
+    # default: a small float tensor
+    return paddle.to_tensor(np.random.rand(2, 3).astype("float32"))
+
+
+class _Unbindable(Exception):
+    pass
+
+
+def _synth_args(fn):
+    try:
+        sig = inspect.signature(fn)
+    except (ValueError, TypeError):
+        raise _Unbindable("no introspectable signature")
+    args = []
+    for name, param in sig.parameters.items():
+        if param.kind in (inspect.Parameter.VAR_POSITIONAL,
+                          inspect.Parameter.VAR_KEYWORD):
+            continue
+        if param.default is not inspect.Parameter.empty:
+            continue
+        if param.kind == inspect.Parameter.KEYWORD_ONLY:
+            raise _Unbindable(f"required keyword-only arg {name!r}")
+        args.append(_synth_param(name, param))
+    return args
+
+
+class _Timeout(Exception):
+    pass
+
+
+def _call_with_timeout(fn, args, seconds=15):
+    def handler(signum, frame):
+        raise _Timeout()
+
+    old = signal.signal(signal.SIGALRM, handler)
+    signal.alarm(seconds)
+    try:
+        return fn(*args)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+# ---------------------------------------------------------------------------
+# the sweep
+# ---------------------------------------------------------------------------
+SWEEP_NAMESPACES = [ns for ns in NAMESPACES if ns not in SKIP_NAMESPACES]
+
+
+def _module_for(sub):
+    stem = (sub[: -len("/__init__.py")] if sub.endswith("/__init__.py")
+            else ("" if sub == "__init__.py" else sub[:-3]))
+    modname = "paddle_tpu" + ("." + stem.replace("/", ".") if stem else "")
+    return importlib.import_module(modname)
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_ROOT),
+                    reason="reference tree not mounted")
+@pytest.mark.parametrize("sub", SWEEP_NAMESPACES)
+def test_every_exported_callable_is_implemented(sub):
+    """Call every exported callable; NotImplementedError without a
+    justified skip is a FAILURE (a stub hiding behind name parity)."""
+    paddle = _paddle()
+    paddle.seed(0)
+    names = _ref_all(REF_ROOT + sub)
+    if not names:
+        pytest.skip("no __all__ in reference module")
+    mod = _module_for(sub)
+
+    stats = {"total": 0, "ok": 0, "ran": 0, "unbound": 0, "skipped": 0,
+             "timeout": 0}
+    gaps = []
+    for name in sorted(set(names)):
+        fn = getattr(mod, name, None)
+        if fn is None or not callable(fn):
+            continue
+        stats["total"] += 1
+        if _skip_reason(sub, name):
+            stats["skipped"] += 1
+            continue
+        try:
+            override = OVERRIDE_ARGS.get((sub, name))
+            args = override() if override else _synth_args(fn)
+            _call_with_timeout(fn, args)
+            stats["ok"] += 1
+        except NotImplementedError as e:
+            gaps.append(f"{name}: NotImplementedError({e})")
+        except _Unbindable:
+            stats["unbound"] += 1
+        except _Timeout:
+            stats["timeout"] += 1
+            if sub in TIMEOUT_MEANS_RAN:
+                stats["ran"] += 1  # real compute was running, not a stub
+            else:
+                gaps.append(f"{name}: TIMED OUT (blocking call must be "
+                            "skip-listed with a reason)")
+        except TypeError:
+            # synthesized args didn't fit the signature's expectations —
+            # the callable bound and started executing user code
+            stats["ran"] += 1
+        except BaseException:
+            # body executed and rejected the synthesized values
+            stats["ran"] += 1
+    called = stats["ok"] + stats["ran"]
+    print(f"\n[callable-sweep] {sub}: called {called}/{stats['total']} "
+          f"(ok={stats['ok']} ran={stats['ran']} "
+          f"unbound={stats['unbound']} skipped={stats['skipped']})")
+    assert not gaps, (
+        f"{sub}: callables hiding NotImplementedError behind name parity "
+        f"(add to SKIP_WITH_REASON only with a real justification):\n  "
+        + "\n  ".join(gaps))
+
+
+def test_skip_list_entries_carry_justification():
+    for sub, entries in SKIP_WITH_REASON.items():
+        for name, reason in entries.items():
+            assert isinstance(reason, str) and len(reason) >= 15, (
+                f"skip entry {sub}:{name} lacks a real justification")
+    for sub, reason in SKIP_NAMESPACES.items():
+        assert isinstance(reason, str) and len(reason) >= 15

@@ -291,3 +291,36 @@ class TestCaptureGradients:
             with pytest.raises(NotImplementedError, match="target_grad"):
                 static.gradients([loss], [wt],
                                  target_gradients=[loss])
+
+
+class TestSaveLoadInferenceModel:
+    """static.save_inference_model on a RAW captured program (no layer):
+    normalize -> .pdmodel/.pdparams -> load_inference_model Program or
+    inference.Predictor."""
+
+    def test_roundtrip_and_predictor(self, tmp_path):
+        rng = np.random.RandomState(5)
+        w = (rng.randn(8, 4) * 0.2).astype("float32")
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 8], "float32")
+            y = paddle.nn.functional.relu(
+                paddle.matmul(x, paddle.to_tensor(w)) + 0.1)
+            dead = (x * 9.0).sum()  # noqa: F841 pruned at save
+        path = str(tmp_path / "im")
+        static.save_inference_model(path, [x], [y], program=prog)
+
+        prog2, feeds, fetches = static.load_inference_model(path)
+        assert feeds == ["x"]
+        assert len(fetches) == 1
+        xv = rng.randn(2, 8).astype("float32")
+        exe = static.Executor()
+        out = exe.run(prog2, feed={"x": xv}, fetch_list=list(fetches))[0]
+
+        from paddle_tpu import inference
+
+        p = inference.create_predictor(inference.Config(path))
+        got = p.run([xv])[0]
+        np.testing.assert_allclose(out, got, rtol=1e-6)
+        want = np.maximum(xv @ w + 0.1, 0.0)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
