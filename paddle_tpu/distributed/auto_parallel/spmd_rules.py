@@ -1080,6 +1080,9 @@ STRUCTURAL_PRIMITIVES = {
     "custom_vjp_call_jaxpr", "closed_call", "core_call", "copy",
     "stop_gradient", "random_seed", "random_unwrap", "random_wrap",
     "random_bits", "random_fold_in", "threefry2x32", "named_call",
+    # GSPMD annotations/transfers: they CARRY a sharding rather than
+    # needing one inferred (appear when a hybrid topology is active)
+    "sharding_constraint", "device_put",
 }
 
 

@@ -400,7 +400,11 @@ def load_static_artifact(path_prefix, params_file=None):
         else path_prefix + ".pdmodel"
     try:
         payload = pickle.loads(load_from_file(p))
-    except (FileNotFoundError, pickle.UnpicklingError, EOFError):
+    except Exception:
+        # not a pickled static program (missing file, foreign bytes like
+        # a protobuf .pdmodel, or a stream referencing renamed classes):
+        # let the caller fall back to the StableHLO/jit loader, whose
+        # error message names the actual artifact kind
         return None
     if not (isinstance(payload, dict) and "insts" in payload):
         return None
@@ -446,6 +450,21 @@ def normalize_program(program, feed_vars, fetch_vars, **kwargs):
     fetch_vids = [program.vid_of(v) for v in fetch_vars]
     new_pass("dead_code_elimination",
              {"fetch": fetch_vids}).apply(clone, None)
+    # prune placeholders too: keep the declared feeds plus anything the
+    # surviving instructions still read — stray feeds would otherwise
+    # reappear as required Predictor inputs
+    feed_vids = set()
+    for v in (feed_vars if isinstance(feed_vars, (list, tuple))
+              else [feed_vars]):
+        if v is not None:
+            feed_vids.add(program.vid_of(v) if not isinstance(v, int)
+                          else v)
+    used = {v for inst in clone._insts for v in inst[1]}
+    clone._placeholders = [
+        ph for ph in clone._placeholders
+        if ph[1] in feed_vids or ph[1] in used]
+    clone._feed_names = {name: vid for name, vid, _s, _d
+                         in clone._placeholders}
     clone._fetch_vids = tuple(fetch_vids)
     return clone
 

@@ -324,3 +324,21 @@ class TestSaveLoadInferenceModel:
         np.testing.assert_allclose(out, got, rtol=1e-6)
         want = np.maximum(xv @ w + 0.1, 0.0)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_save_inference_model_prunes_stray_placeholders(tmp_path):
+    """Placeholders outside feed_vars (and unused by the pruned slice)
+    must not reappear as required Predictor inputs."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 4], "float32")
+        z = static.data("z", [2, 4], "float32")  # never feeds the fetch
+        y = (x * 2.0).sum()
+    path = str(tmp_path / "pruned")
+    static.save_inference_model(path, [x], [y], program=prog)
+    prog2, feeds, fetches = static.load_inference_model(path)
+    assert feeds == ["x"]
+    out = static.Executor().run(
+        prog2, feed={"x": np.ones((2, 4), "float32")},
+        fetch_list=list(fetches))[0]
+    np.testing.assert_allclose(out, 16.0)

@@ -16,12 +16,33 @@ bounds that order any conv implementation on a TPU:
                  this stack it trails the XLA emitter even on pure
                  GEMMs — see bench history).
 
-Run: python tools/conv_calibration.py [--iters 30]
+Run: python tools/conv_calibration.py [--iters 30] (or --shape i to
+measure one shape per process — the remote-compile tunnel occasionally
+hangs, so a driving shell should give each shape its own timeout).
 Prints a per-shape table and the FLOP-weighted ResNet-50 forward bound.
 
-Usage note: each sample runs inside an on-device lax.scan with a
-carry-chained input — per-call tunnel latency otherwise dominates
-(BENCH round-2/3 lesson).
+MEASURED CONCLUSION (v5e, bf16, batch 64, 20-iter carry-chained scans,
+2026-07-31 — the round-3 calibration this module exists to reproduce):
+
+    shape                      conv lowering   implicit-GEMM bound
+    64x56x56  -> 64  3x3       3.4 TF/s        3.3 TF/s  [M=200704,K=576,N=64]
+    128x28x28 -> 128 3x3       4.1 TF/s        3.4 TF/s  [M=50176,K=1152,N=128]
+    512x7x7   -> 512 3x3       2.5 TF/s        3.8 TF/s  [M=3136,K=4608,N=512]
+    64x56x56  -> 256 1x1       1.6 TF/s        1.5 TF/s  [M=200704,K=64,N=256]
+
+The conv lowering is ALREADY at (or above) the throughput of its own
+implicit-GEMM formulation: ResNet's K=64..4608 / N=64..512 GEMM shapes
+sit at the floor of this chip's width-scaling curve (same harness:
+[16k,2048]x[2048,W] reaches 115 TF/s at W=5632 but 49 at W=1408 — and
+collapses to single digits at the K/N widths conv produces). A Pallas
+implicit-GEMM conv is bounded by its inner matmul plus patch-assembly
+and halo overheads, and a naively-tiled Pallas matmul measures ~30%
+BELOW the XLA emitter on this stack (36 vs 52 TF/s at the MoE expert
+shape). Therefore the bench's ResNet-50 MFU (~0.13 end-to-end, within
+the 0.12-0.19 bare-conv band measured in round 2) is this chip's
+ceiling for conv-shaped arithmetic in any matmul-based formulation —
+not a lowering deficiency a custom kernel could bypass. The chip's MXU
+wants wide GEMMs; ResNet at 224px does not produce them.
 """
 from __future__ import annotations
 
@@ -60,7 +81,7 @@ def _timed(fn, x0, iters, tries=3):
     import jax.numpy as jnp
 
     def body(carry, _):
-        y = fn(x0 * (1.0 + carry))
+        y = fn((x0 * (1.0 + carry)).astype(x0.dtype))
         s = (jnp.mean(y.astype(jnp.float32)) * 1e-12).astype(jnp.float32)
         return s, ()
 
@@ -142,7 +163,23 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=30)
     ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--shape", type=int, default=None,
+                    help="measure only RESNET50_CONVS[i] (emit one "
+                         "json line) — lets a driving shell give each "
+                         "shape its own timeout against tunnel hangs")
     args = ap.parse_args()
+
+    if args.shape is not None:
+        import json
+
+        cin, h, w, cout, kk, stride, cnt = RESNET50_CONVS[args.shape]
+        flops, t_conv, t_gemm, t_pal = measure_shape(
+            cin, h, w, cout, kk, stride, args.batch, args.iters)
+        print(json.dumps({
+            "desc": f"{cin}x{h}x{w}->{cout} k{kk}s{stride}",
+            "flops": flops, "count": cnt, "t_conv": t_conv,
+            "t_gemm": t_gemm, "t_pallas": t_pal}), flush=True)
+        return
 
     peak = 197e12
     rows = []
