@@ -10,3 +10,5 @@ from .engine import Engine
 from .strategy import Strategy
 from . import spmd_rules
 from .spmd_rules import DistTensorSpec, get_spmd_rule, register_spmd_rule
+from . import completion
+from .completion import complete_placements, derive_shard_plan

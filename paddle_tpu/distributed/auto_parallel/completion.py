@@ -1,0 +1,341 @@
+"""Placement completion: derive a shard plan from an unannotated model.
+
+Reference: python/paddle/distributed/auto_parallel/static/completion.py
+(rule-driven placement propagation over the program),
+planner_v2.py (strategy choice where constraints alone don't pin a
+placement) and partitioner.py (applying the completed plan). The
+reference completes a partially-annotated static program by propagating
+per-op SPMD rules forward/backward until a fixpoint.
+
+TPU re-design, same split of labor:
+
+1. **Planner** (`_plan_matmul_patterns`): placements for weights are a
+   COST choice, not a correctness consequence — nothing forces
+   column-parallel on an unannotated q_proj. The planner scans the
+   captured program (static/program.py instruction list) for the
+   comm-minimal Megatron patterns the reference's planner converges to:
+
+   - ``embedding_p`` weight → Shard(0) on mp (vocab parallel: local
+     masked lookup + one psum);
+   - opener/closer matmul pairs → Shard(1)/Shard(0) (column then row
+     parallel: zero comm inside the pair, one psum at the closer). A
+     pair is an unassigned weight-matmul whose output reaches another
+     unassigned weight-matmul's *data* input through non-matmul ops —
+     q/k/v→o through rope+sdpa, gate/up→down through swiglu;
+   - final vocab projection (``fused_linear_ce_p`` / last linear into
+     the vocab dim) → Shard(1) (pairs with the vocab-parallel CE).
+
+2. **Propagation** (`complete_placements`): with weights planned and
+   inputs seeded (batch dim on dp), the registered SPMD rules
+   (spmd_rules.py — the reference's 52-rule registry) propagate
+   placements through every instruction to a fixpoint, completing the
+   intermediate specs exactly like completion.py's forward pass.
+
+`derive_shard_plan` wires both into the user API: capture → plan →
+propagate → per-parameter placements (optionally applied via
+shard_tensor). The derived Llama plan must and does match the
+hand-written `models.llama.llama_shard_plan` spec for spec
+(tests/test_completion.py).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .placement import Placement, ProcessMesh, Replicate, Shard
+from .spmd_rules import DistTensorSpec, get_spmd_rule
+
+__all__ = ["complete_placements", "derive_shard_plan"]
+
+
+# ops whose weight operand (2nd input, const) does x @ W with W [in, out]
+_OPENER_CLOSER_PRIMS = {"linear_nobias_p", "linear_p"}
+# ops that end a chain at the vocab dim (weight pairs with vocab-parallel CE)
+_VOCAB_HEAD_PRIMS = {"fused_linear_ce_p"}
+
+
+def _shape_env(prog) -> Dict[int, "object"]:
+    """vid -> ShapeDtypeStruct for every value in the program, by
+    replaying shape inference (core.dispatch.eval_shape) over the
+    instruction list — the InferMeta pass of the reference."""
+    import jax
+
+    from ...core import dispatch
+
+    from ...core.dtype import convert_dtype
+
+    env: Dict[int, object] = {}
+    for _name, vid, shape, dtype in prog._placeholders:
+        # dynamic (None/-1) dims were captured as 1 (add_placeholder);
+        # replay must use the SAME clamp or eval_shape diverges
+        cap = tuple(1 if s in (None, -1) else int(s) for s in shape)
+        env[vid] = jax.ShapeDtypeStruct(cap, convert_dtype(dtype))
+    for vid, arr in prog._consts.items():
+        env[vid] = jax.ShapeDtypeStruct(
+            tuple(getattr(arr, "shape", ())),
+            getattr(arr, "dtype", "float32"))
+    for name, in_vids, static_items, out_vids in prog._insts:
+        if name == "__gradients__":
+            continue
+        outs = dispatch.eval_shape(
+            name, [env[v] for v in in_vids], dict(static_items))
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        for v, o in zip(out_vids, outs):
+            env[v] = o
+    return env
+
+
+def _divisible(dim_size: int, mesh: ProcessMesh, mesh_axis: int) -> bool:
+    return dim_size % mesh.shape[mesh_axis] == 0
+
+
+def _plan_matmul_patterns(prog, env, mesh, mp: int,
+                          planned: Dict[int, List[Placement]]) -> None:
+    """Assign Megatron column/row placements to weight vids (in
+    ``planned``) by opener/closer pair detection. First assignment wins;
+    weights whose shard dim is not divisible by the mp degree stay
+    replicated."""
+    insts = [i for i in prog._insts if i[0] != "__gradients__"]
+    producer: Dict[int, int] = {}
+    for idx, (_n, _iv, _s, out_vids) in enumerate(insts):
+        for v in out_vids:
+            producer[v] = idx
+
+    def place(wvid: int, tensor_dim: Optional[int]) -> None:
+        if wvid in planned:
+            return
+        p: List[Placement] = [Replicate() for _ in range(mesh.ndim)]
+        if tensor_dim is not None and \
+                _divisible(env[wvid].shape[tensor_dim], mesh, mp):
+            p[mp] = Shard(tensor_dim)
+        planned[wvid] = p
+
+    def weight_vid(idx: int) -> Optional[int]:
+        """The const weight operand of a matmul-like inst, if any."""
+        name, in_vids, _s, _o = insts[idx]
+        if name in _OPENER_CLOSER_PRIMS | _VOCAB_HEAD_PRIMS \
+                and len(in_vids) >= 2 and in_vids[1] in prog._consts:
+            return in_vids[1]
+        return None
+
+    def is_matmul_boundary(idx: int) -> bool:
+        name = insts[idx][0]
+        return name == "embedding_p" or weight_vid(idx) is not None
+
+    # vocab projections and embeddings first: their placement is pinned
+    # by the vocab-parallel pattern, not by pairing
+    for idx, (name, in_vids, _s, _o) in enumerate(insts):
+        if name == "embedding_p" and in_vids[0] in prog._consts:
+            place(in_vids[0], 0)          # [vocab, hidden] → vocab
+        elif name in _VOCAB_HEAD_PRIMS and len(in_vids) >= 2 \
+                and in_vids[1] in prog._consts:
+            place(in_vids[1], 1)          # [hidden, vocab] → vocab
+
+    # opener/closer pairs, in program order: a matmul CLOSES a pair when
+    # walking BACKWARD from its data input through non-matmul ops (rope,
+    # sdpa, swiglu, reshapes, elementwise, ...) reaches >= 1 matmul
+    # whose weight is still unassigned — those become the column-
+    # parallel openers (q/k/v share the o_proj closer through sdpa;
+    # gate/up share down_proj through swiglu), the closer goes row-
+    # parallel, and the pair's only collective is the closer's psum.
+    for idx in range(len(insts)):
+        wc = weight_vid(idx)
+        if wc is None or wc in planned \
+                or insts[idx][0] in _VOCAB_HEAD_PRIMS:
+            continue
+        stack = [insts[idx][1][0]]
+        seen = set(stack)
+        openers: List[int] = []
+        while stack:
+            v = stack.pop()
+            pidx = producer.get(v)
+            if pidx is None:
+                continue                   # placeholder or const leaf
+            if is_matmul_boundary(pidx):
+                wv = weight_vid(pidx)
+                if wv is not None and wv not in planned \
+                        and insts[pidx][0] not in _VOCAB_HEAD_PRIMS:
+                    openers.append(pidx)
+                continue                   # never walk past a matmul
+            for iv in insts[pidx][1]:
+                if iv not in seen and iv not in prog._consts:
+                    seen.add(iv)
+                    stack.append(iv)
+        if not openers:
+            continue
+        for oidx in set(openers):
+            place(weight_vid(oidx), 1)     # column parallel [in, out]
+            name_o, in_o, _so, _oo = insts[oidx]
+            if name_o == "linear_p" and len(in_o) >= 3 \
+                    and in_o[2] in prog._consts:
+                place(in_o[2], 0)          # bias rides the sharded dim
+        place(wc, 0)                       # row parallel [in, out]
+        name_c, in_c, _sc, _oc = insts[idx]
+        if name_c == "linear_p" and len(in_c) >= 3 \
+                and in_c[2] in prog._consts:
+            place(in_c[2], None)           # bias added after the psum
+
+
+# per-prim adapters: inst -> (rule name, spec order fn). Most prims map
+# 1:1 onto a registered rule; anything absent falls back to keeping the
+# batch sharding on same-rank outputs and replicating otherwise.
+_PRIM_RULE = {
+    "linear_nobias_p": "matmul",
+    "linear_p": "matmul",
+    "matmul_p": "matmul",
+    "embedding_p": "embedding",
+    "rms_norm_p": "rms_norm",
+    "layer_norm_p": "layer_norm",
+    "reshape_p": "reshape",
+    "transpose_p": "transpose",
+    "softmax_p": "softmax",
+    "concat_p": "concat",
+}
+
+
+def complete_placements(prog, mesh: ProcessMesh,
+                        seeds: Dict[int, DistTensorSpec],
+                        env: Optional[Dict[int, object]] = None,
+                        ) -> Dict[int, DistTensorSpec]:
+    """Forward-propagate the SPMD rules over the captured program from
+    ``seeds`` (vid -> spec); returns the completed vid -> spec table.
+    Seeded specs are never overridden (user annotations win, like the
+    reference's completion)."""
+    env = env or _shape_env(prog)
+    specs: Dict[int, DistTensorSpec] = dict(seeds)
+
+    def spec_of(vid: int) -> DistTensorSpec:
+        s = specs.get(vid)
+        if s is None:
+            s = DistTensorSpec(list(env[vid].shape), mesh,
+                               [Replicate()] * mesh.ndim)
+            specs[vid] = s
+        return s
+
+    for name, in_vids, static_items, out_vids in prog._insts:
+        if name == "__gradients__":
+            continue
+        attrs = dict(static_items)
+        rule_name = _PRIM_RULE.get(name)
+        outs: Optional[Sequence[DistTensorSpec]] = None
+        if rule_name is not None:
+            rule = get_spmd_rule(rule_name)
+            try:
+                if rule_name == "matmul":
+                    _ins, outs = rule.infer_forward(
+                        spec_of(in_vids[0]), spec_of(in_vids[1]))
+                elif rule_name == "reshape":
+                    outs = rule.infer_forward(
+                        spec_of(in_vids[0]),
+                        shape=list(env[out_vids[0]].shape))[1]
+                else:
+                    outs = rule.infer_forward(
+                        *[spec_of(v) for v in in_vids], **{
+                            k: v for k, v in attrs.items()
+                            if k in ("axis", "keepdim", "perm",
+                                     "begin_norm_axis")})[1]
+            except Exception:
+                outs = None
+        for i, ov in enumerate(out_vids):
+            if ov in specs:
+                continue  # seeded
+            if outs is not None and i < len(outs):
+                o = outs[i]
+                # Partial outputs (reduced contracted dims) read as
+                # replicated for planning: GSPMD inserts the psum
+                specs[ov] = DistTensorSpec(
+                    list(env[ov].shape), mesh,
+                    [p if isinstance(p, Shard) else Replicate()
+                     for p in o.placements])
+            else:
+                # fallback: keep batch (dim-0) sharding through
+                # same-leading-dim ops; replicate the rest
+                x0 = spec_of(in_vids[0]) if in_vids else None
+                out_shape = list(env[ov].shape)
+                placements: List[Placement] = \
+                    [Replicate()] * mesh.ndim
+                if x0 is not None and x0.shape and out_shape \
+                        and out_shape[0] == x0.shape[0]:
+                    for mdim, p in enumerate(x0.placements):
+                        if isinstance(p, Shard) and p.dim == 0:
+                            placements[mdim] = Shard(0)
+                specs[ov] = DistTensorSpec(out_shape, mesh, placements)
+    return specs
+
+
+def derive_shard_plan(model, input_specs: Sequence[Tuple[Sequence[int], str]],
+                      mesh: ProcessMesh, forward: Optional[Callable] = None,
+                      dp_axis: str = "dp", mp_axis: str = "mp",
+                      apply: bool = False,
+                      ) -> Dict[str, List[Placement]]:
+    """Derive per-parameter placements for an UNANNOTATED model.
+
+    Captures ``forward(model, *placeholders)`` (default:
+    ``model(*placeholders)``) as a static program, runs the pattern
+    planner + rule propagation, and returns ``{param_name:
+    [Placement, ...]}`` over ``mesh``. With ``apply=True`` the plan is
+    applied in place via ``dist.shard_tensor``.
+
+    ``input_specs``: one ``(shape, dtype)`` per model input; batch dim 0
+    is seeded Shard(0) on ``dp_axis`` (data parallelism), everything
+    else follows from the plan.
+    """
+    from ... import static
+
+    def _as_pair(spec):
+        if hasattr(spec, "shape"):  # static.InputSpec-like
+            return list(spec.shape), str(getattr(spec, "dtype", "float32"))
+        shape, dtype = spec
+        return list(shape), dtype
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        phs = [static.data(f"__auto_in_{i}", *_as_pair(spec))
+               for i, spec in enumerate(input_specs)]
+        if forward is not None:
+            forward(model, *phs)
+        else:
+            model(*phs)
+
+    env = _shape_env(prog)
+    mp = mesh.dim_names.index(mp_axis)
+    dp = mesh.dim_names.index(dp_axis) if dp_axis in mesh.dim_names else None
+
+    planned: Dict[int, List[Placement]] = {}
+    _plan_matmul_patterns(prog, env, mesh, mp, planned)
+
+    # seed the data inputs batch-sharded on dp, and the planned weights
+    seeds: Dict[int, DistTensorSpec] = {}
+    if dp is not None:
+        for _name, vid, shape, _dtype in prog._placeholders:
+            placements: List[Placement] = [Replicate()] * mesh.ndim
+            # a dynamic (None/-1) batch dim is shardable by definition —
+            # its runtime extent divides the dp degree by contract
+            if shape and (shape[0] in (None, -1)
+                          or _divisible(shape[0], mesh, dp)):
+                placements[dp] = Shard(0)
+            seeds[vid] = DistTensorSpec(
+                list(env[vid].shape), mesh, placements)
+    for wvid, placements in planned.items():
+        seeds[wvid] = DistTensorSpec(
+            list(env[wvid].shape), mesh, list(placements))
+    specs = complete_placements(prog, mesh, seeds, env=env)
+
+    plan: Dict[str, List[Placement]] = {}
+    for pname, p in model.named_parameters():
+        vid = prog._vid_by_obj.get(id(p._value))
+        if vid is not None and vid in planned:
+            plan[pname] = list(planned[vid])
+        elif vid is not None and vid in specs:
+            # not a matmul-pattern weight: take what rule propagation
+            # inferred for it (norm scales etc. come back replicated)
+            plan[pname] = list(specs[vid].placements)
+        else:
+            plan[pname] = [Replicate() for _ in range(mesh.ndim)]
+
+    if apply:
+        from .api import shard_tensor
+
+        for pname, p in model.named_parameters():
+            shard_tensor(p, mesh, plan[pname])
+    return plan

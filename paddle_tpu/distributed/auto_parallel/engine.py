@@ -95,10 +95,12 @@ class Engine:
         kv_heads = _cfg("num_key_value_heads", heads)
         vocab = _cfg("vocab_size", 32000)
         gbs = int(global_batch_size or max(n, 8))
-        # mp degrees must divide the contracted dims; without a registered
-        # shard plan only data parallelism can be applied
+        # mp degrees must divide the contracted dims; a model without a
+        # registered family plan can still go mp>1 when the caller gave
+        # inputs_spec — placement completion derives the plan from the
+        # captured program (completion.derive_shard_plan)
         mp_degrees = [1]
-        if plan_fn is not None:
+        if plan_fn is not None or inputs_spec is not None:
             mp_degrees = [d for d in (1, 2, 4, 8, 16)
                           if d <= n and hidden % d == 0 and vocab % d == 0
                           and heads % d == 0 and kv_heads % d == 0]
@@ -130,6 +132,12 @@ class Engine:
         self._mesh = mesh
         if best.mp > 1 and plan_fn is not None:
             plan_fn(self._model, mesh)
+        elif best.mp > 1 and inputs_spec is not None:
+            # no registered family plan: derive one from the captured
+            # program (completion.py pattern planner + SPMD rules)
+            from .completion import derive_shard_plan
+
+            derive_shard_plan(self._model, inputs_spec, mesh, apply=True)
         else:
             for p in self._model.parameters():
                 shard_tensor(p, mesh, [Replicate()] * mesh.ndim)

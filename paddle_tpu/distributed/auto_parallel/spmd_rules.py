@@ -1019,6 +1019,17 @@ def _default_data_parallel_rule(*specs, **attrs):
 # op-name -> rule registration in rules.cc). tests/test_spmd_rules.py
 # traces all five model families and FAILS if any primitive they use
 # would fall back to the replicate-everything default.
+#
+# NOTE: this table is a COVERAGE-GATING map (primitive -> rule topic),
+# not a callable lowering: some entries alias a rule whose argument
+# conventions differ from the raw primitive and are NOT safe to invoke
+# for layout inference with primitive-shaped args. Known aliases:
+#   broadcast_in_dim -> expand_as assumes right-aligned numpy
+#     broadcasting, but broadcast_dimensions need not be suffix-aligned;
+#   sort -> topk whose default k=1 would infer a wrong (size-1) output
+#     for a shape-preserving sort.
+# Real layout inference must go through infer_spmd(<rule>, ...) with the
+# rule's own signature, or grow a dedicated rule first.
 _ELEMENTWISE_PRIMS = {
     "abs", "add", "and", "or", "xor", "not", "cos", "div", "eq", "erf",
     "erfc", "exp", "expm1", "floor", "ceil", "round", "ge", "gt",

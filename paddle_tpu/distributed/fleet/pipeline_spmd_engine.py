@@ -124,7 +124,31 @@ class PipelinePlan(NamedTuple):
     send_b: np.ndarray        # [T, S] 1 when B dx ppermutes up-ring
     recv_f: np.ndarray        # [T, S] slot for the fwd arrival; -1 = none
     recv_b: np.ndarray        # [T, S] slot for the bwd arrival; -1 = none
+    # Fraction of [T, S] cells that are NOP in the simulated tick table.
+    # CAVEAT — lockstep masked compute: the compiled scan executes
+    # stage_fn's forward AND a full fwd+bwd jax.vjp on EVERY stage EVERY
+    # tick regardless of opcode, masking out unused results. A NOP or
+    # F-only tick therefore still pays ~3x a stage forward in FLOPs, so
+    # the real compute overhead of a schedule is proportional to
+    # (1 - useful_tick_fraction) of the ~3x-forward tick cost, NOT just
+    # the idle time bubble_fraction reports — high-bubble plans (FThenB)
+    # lose more to masked work than their bubble_fraction suggests.
+    # Compare schedules on masked_compute_overhead(), not this field.
     bubble_fraction: float
+
+    def masked_compute_overhead(self) -> float:
+        """Fraction of the scan's total (lockstep) compute that is
+        masked-out work: 1 - useful_cells / total_cells, where a B cell
+        counts ~2 forward-equivalents and F/W count 1 against the 3
+        forward-equivalents every cell always executes."""
+        kinds = self.kind
+        # useful fwd-equivalents per opcode: F=1; full backward B=2
+        # unless the schedule splits it (has_w), then B (dx) and W
+        # (dparams) are ~1 each
+        b_cost = 1.0 if self.has_w else 2.0
+        cost = np.where(kinds == _B, b_cost,
+                        np.where(kinds == _NOP, 0.0, 1.0))
+        return float(1.0 - cost.sum() / (3.0 * kinds.size))
 
 
 def _color_intervals(intervals: List[Tuple[int, int, object]]) -> Tuple[

@@ -19,6 +19,7 @@ is queue-to-queue, cross-rank routing rides the framed-pickle RPC agent
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from dataclasses import dataclass, field
@@ -43,7 +44,11 @@ class InterceptorMessage:
     src_id: int
     dst_id: int
     message_type: str
-    scope_idx: int = 0          # micro-batch index
+    scope_idx: int = 0          # micro-batch index (job key for DONE)
+    job_nonce: Optional[str] = None  # in-process job disambiguator for
+    #                                  DONE broadcasts; never crosses the
+    #                                  RPC boundary (each process has its
+    #                                  own executor nonce)
 
 
 @dataclass
@@ -260,8 +265,12 @@ _CURRENT_CARRIERS: Dict[int, "Carrier"] = {}
 def _deliver_remote(src_id, dst_id, message_type, scope_idx):
     """RPC endpoint: hand a message to this process's carrier."""
     if message_type == DONE and dst_id == -1:
-        # rank-sinks-done broadcast, scoped to one job by its fingerprint
-        # (scope_idx) so concurrent jobs in one process don't cross-talk
+        # Cross-PROCESS rank-sinks-done broadcast. Each process has its
+        # own executor nonce, so match on the deterministic job key
+        # (topology fingerprint or explicit job_id) and ignore the
+        # sender's nonce — cross-process jobs that can run the same
+        # topology concurrently must disambiguate with an explicit
+        # job_id (FleetExecutor.init docstring).
         for carrier in _CURRENT_CARRIERS.values():
             if carrier._job_key == scope_idx:
                 carrier._on_rank_sinks_done(src_id)
@@ -280,12 +289,16 @@ def _job_fingerprint(task_id_to_rank: Dict[int, int]) -> int:
     return zlib.crc32(repr(sorted(task_id_to_rank.items())).encode())
 
 
+_log = logging.getLogger(__name__)
+
+
 class Carrier:
     """Owns this rank's interceptors and routes messages (carrier.h:50)."""
 
     def __init__(self, carrier_id: str, rank: int, bus: MessageBus,
                  task_id_to_rank: Dict[int, int],
-                 sink_ranks: Optional[set] = None):
+                 sink_ranks: Optional[set] = None,
+                 job_id: Optional[str] = None):
         self.carrier_id = carrier_id
         self.rank = rank
         self.bus = bus
@@ -300,7 +313,20 @@ class Carrier:
         # local-only completion.
         self._sink_ranks = set(sink_ranks) if sink_ranks is not None else None
         self._done_ranks: set = set()
-        self._job_key = _job_fingerprint(task_id_to_rank)
+        # DONE-broadcast scope, two layers:
+        #   _job_key   — deterministic (explicit job_id, else topology
+        #                fingerprint): the CROSS-PROCESS wire identity,
+        #                computable on every rank without coordination;
+        #   _job_nonce — per-executor uuid (None for direct Carrier
+        #                construction): disambiguates concurrent
+        #                same-topology jobs WITHIN a process, where the
+        #                fingerprint alone would cross-signal (round-3
+        #                advisor finding). In-process DONE delivery
+        #                requires nonce equality when both sides have
+        #                one; the RPC path compares _job_key only.
+        self._job_key = (job_id if job_id is not None
+                         else f"{_job_fingerprint(task_id_to_rank):08x}")
+        self._job_nonce: Optional[str] = None
         bus.register(rank, self)
         _CURRENT_CARRIERS[rank] = self
 
@@ -328,9 +354,14 @@ class Carrier:
 
     def deliver(self, msg: InterceptorMessage):
         if msg.message_type == DONE and msg.dst_id == -1:
-            # rank-sinks-done broadcast (src_id = the reporting rank),
-            # scoped to this job by fingerprint
-            if msg.scope_idx == self._job_key:
+            # rank-sinks-done broadcast (src_id = the reporting rank).
+            # In-process: key AND nonce must agree (when both sides have
+            # one) so two same-topology jobs never cross-signal; a
+            # nonce-less side (direct Carrier construction, RPC arrival)
+            # matches on key alone.
+            if msg.scope_idx == self._job_key and (
+                    msg.job_nonce is None or self._job_nonce is None
+                    or msg.job_nonce == self._job_nonce):
                 self._on_rank_sinks_done(msg.src_id)
             return
         itc = self.interceptors.get(msg.dst_id)
@@ -362,9 +393,14 @@ class Carrier:
                 if rank != self.rank:
                     try:
                         self.bus.send(rank, InterceptorMessage(
-                            self.rank, -1, DONE, self._job_key))
+                            self.rank, -1, DONE, self._job_key,
+                            job_nonce=self._job_nonce))
                     except Exception:
-                        pass
+                        # a lost DONE leaves the remote carrier blocked in
+                        # wait() until its timeout — surface it, don't hide
+                        _log.warning(
+                            "carrier %s: DONE broadcast to rank %d failed",
+                            self.carrier_id, rank, exc_info=True)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         # A carrier with no local sink blocks on the DONE broadcasts from
@@ -386,18 +422,38 @@ class FleetExecutor:
     nodes, start the source(s), wait for the sink(s)."""
 
     def __init__(self, bus: Optional[MessageBus] = None):
+        import uuid
+
         self.bus = bus or MessageBus()
         self.carriers: Dict[str, Carrier] = {}
+        # per-executor nonce stamped on every carrier: two executors
+        # running the SAME topology concurrently in one process can no
+        # longer cross-signal each other's completion through a shared
+        # topology fingerprint (the key stays deterministic so the RPC
+        # path still works without coordination)
+        self._job_nonce = uuid.uuid4().hex[:12]
 
     def init(self, carrier_id: str, task_nodes: List[TaskNode],
              task_id_to_rank: Optional[Dict[int, int]] = None,
-             rank: int = 0, num_micro_batches: Optional[int] = None):
+             rank: int = 0, num_micro_batches: Optional[int] = None,
+             job_id: Optional[str] = None):
+        """Build this rank's carrier.
+
+        All carriers of one job share the DONE-broadcast scope: the
+        topology fingerprint (or explicit ``job_id``) is the
+        deterministic cross-process key; this executor's nonce
+        additionally isolates concurrent same-topology jobs within a
+        process. Cross-process jobs that may run the same topology
+        concurrently should pass a shared unique ``job_id`` on every
+        rank — the RPC path cannot see nonces.
+        """
         task_id_to_rank = task_id_to_rank or {
             t.task_id: t.rank for t in task_nodes}
         sink_ranks = {task_id_to_rank.get(t.task_id, t.rank)
                       for t in task_nodes if t.role == "sink"}
         carrier = Carrier(carrier_id, rank, self.bus, task_id_to_rank,
-                          sink_ranks=sink_ranks)
+                          sink_ranks=sink_ranks, job_id=job_id)
+        carrier._job_nonce = self._job_nonce
         for t in task_nodes:
             if num_micro_batches is not None and t.role != "cond":
                 t.max_run_times = num_micro_batches

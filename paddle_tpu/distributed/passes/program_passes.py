@@ -156,8 +156,11 @@ class FuseAddActPass(_ProgramPass):
     def _apply_one(self, prog, context):
         insts = prog._insts
         # the add's output must not outlive the fusion: protect fetch
-        # targets (the fused op would delete their only producer)
+        # targets AND recompute checkpoints (the fused op would delete
+        # their only producer — for a checkpoint vid that silently drops
+        # the remat segment split at it)
         protected: Set[int] = set(getattr(prog, "_fetch_vids", ()) or ())
+        protected.update(getattr(prog, "_remat_checkpoints", ()) or ())
         for t in self.attrs.get("fetch", []) or []:
             protected.add(self._vid(prog, t))
         if context is not None:

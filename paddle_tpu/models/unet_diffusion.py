@@ -233,6 +233,9 @@ class UNet2DConditionModel(Layer):
 
         cfg = self.config
         temb = timestep_embedding(timesteps, cfg.block_out_channels[0])
+        # the sinusoid is computed in f32; follow the model's compute
+        # dtype (bf16 under model.bfloat16()) before it meets the convs
+        temb = temb.astype(self.time_mlp1.weight.dtype)
         temb = self.time_mlp2(self.act(self.time_mlp1(temb)))
 
         h = self.conv_in(sample)

@@ -206,3 +206,68 @@ class TestMultiCarrier:
         t1.join(30)
         assert ok1 == [True]
         assert [mb for t, mb in log if t == "sink1"] == list(range(M))
+
+
+class TestJobScope:
+    def test_concurrent_same_topology_jobs_do_not_cross_signal(self):
+        """Two executors running the SAME topology concurrently share a
+        deterministic job key (the RPC path needs it) but carry distinct
+        per-executor nonces, so an in-process DONE broadcast from one
+        job must not open the other's latch (round-3 advisor finding)."""
+        M = 4
+        log1, log2 = [], []
+        nodes1, _ = _pipeline_nodes(M, log1)
+        nodes2, _ = _pipeline_nodes(M, log2)
+        fe1, fe2 = FleetExecutor(), FleetExecutor()
+        c1 = fe1.init("c0", nodes1, num_micro_batches=M)
+        c2 = fe2.init("c0", nodes2, num_micro_batches=M)
+        assert c1._job_key == c2._job_key  # same topology, same key
+        assert c1._job_nonce != c2._job_nonce
+        # job 2's in-process done broadcast must not open job 1's latch
+        c1.deliver(InterceptorMessage(0, -1, "DONE", c2._job_key,
+                                      job_nonce=c2._job_nonce))
+        assert not c1._done.is_set()
+        # same job (matching nonce) does
+        c1.deliver(InterceptorMessage(0, -1, "DONE", c1._job_key,
+                                      job_nonce=c1._job_nonce))
+        assert c1._done.is_set()
+
+    def test_rpc_style_done_matches_on_key_alone(self):
+        """A DONE that crossed the process boundary has no nonce (each
+        process has its own executor); it must match on the job key so
+        cross-process jobs complete without explicit job_id."""
+        M = 4
+        log = []
+        nodes, _ = _pipeline_nodes(M, log)
+        fe = FleetExecutor()
+        c = fe.init("c0", nodes, num_micro_batches=M)
+        # src 0 = the (only) sink-owning rank reporting its sinks done
+        c.deliver(InterceptorMessage(0, -1, "DONE", c._job_key))
+        assert c._done.is_set()
+
+    def test_explicit_job_id_shared_across_ranks(self):
+        """Cross-process jobs pass the same job_id on every rank; both
+        carriers then share the DONE scope."""
+        M = 4
+        log = []
+        nodes, _ = _pipeline_nodes(M, log)
+        nodes[0].rank = nodes[1].rank = 0
+        nodes[2].rank = nodes[3].rank = 1
+        bus = MessageBus()
+        fe = FleetExecutor(bus)
+        mapping = {t.task_id: t.rank for t in nodes}
+        c0 = fe.init("c0", nodes, task_id_to_rank=mapping, rank=0,
+                     num_micro_batches=M, job_id="job-xyz")
+        c1 = fe.init("c1", nodes, task_id_to_rank=mapping, rank=1,
+                     num_micro_batches=M, job_id="job-xyz")
+        assert c0._job_key == c1._job_key == "job-xyz"
+        c0.start()
+        c1.start()
+        for itc in c0.interceptors.values():
+            if itc.node.role == "source":
+                c0.deliver(InterceptorMessage(-1, itc.interceptor_id,
+                                              "START"))
+        assert c1.wait(30)
+        c0.stop()
+        c1.stop()
+        assert [mb for t, mb in log if t == "sink"] == list(range(M))

@@ -342,3 +342,28 @@ def test_save_inference_model_prunes_stray_placeholders(tmp_path):
         prog2, feed={"x": np.ones((2, 4), "float32")},
         fetch_list=list(fetches))[0]
     np.testing.assert_allclose(out, 16.0)
+
+
+class TestFuseAddActProtectsRematCheckpoints:
+    def test_checkpoint_vid_producer_survives_fusion(self):
+        """An add output marked as a recompute checkpoint must NOT be
+        fused away: deleting its producer silently drops the remat
+        segment split at it (round-3 advisor finding)."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            y = static.data("y", [4, 8], "float32")
+            s = x + y
+            z = paddle.nn.functional.relu(s)
+            out = z.sum()
+        # mark the add's output as a remat checkpoint (what RecomputePass
+        # records)
+        prog._remat_checkpoints = (prog.vid_of(s),)
+        n_before = prog.num_ops
+        new_pass("fuse_elewise_add_act").apply(prog, None)
+        assert prog.num_ops == n_before
+        assert not any(i[0] == "fused_add_act_p" for i in prog._insts)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(4, 8).astype("float32"),
+                "y": rng.randn(4, 8).astype("float32")}
+        _run(prog, feed, [out])  # still executable
