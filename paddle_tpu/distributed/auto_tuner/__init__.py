@@ -16,10 +16,12 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
+from functools import lru_cache
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["TuneSpace", "Candidate", "Tuner", "prune_candidates",
-           "estimate_memory_bytes", "estimate_step_time_s"]
+           "estimate_memory_bytes", "estimate_step_time_s",
+           "width_efficiency", "WIDTH_EFFICIENCY_CURVE"]
 
 
 @dataclass
@@ -81,6 +83,64 @@ def _param_count(space: TuneSpace) -> float:
     return L * per_layer + 2 * v * h
 
 
+# Measured GEMM width-scaling curve (v5e, bf16, [16k, 2048] x [2048, W],
+# 50-iter carry-chained scan — tools/gemm_width_calibration round-3
+# record): achieved TF/s collapses with the output width W because
+# narrow N starves the MXU. Stored as (width, achieved/peak) so the
+# curve transfers across chips as an efficiency profile; queries
+# interpolate log-log and extrapolate the measured tail slope below the
+# last point (which reproduces the observed "single digits at conv
+# widths").
+_V5E_PEAK = 197e12
+WIDTH_EFFICIENCY_CURVE = (
+    (1408, 49e12 / _V5E_PEAK),
+    (1536, 59e12 / _V5E_PEAK),
+    (2816, 72e12 / _V5E_PEAK),
+    (5632, 115e12 / _V5E_PEAK),
+)
+
+
+def width_efficiency(width: float) -> float:
+    """Fraction of peak the MXU achieves at GEMM output width ``width``."""
+    import math
+
+    pts = WIDTH_EFFICIENCY_CURVE
+    if width >= pts[-1][0]:
+        return pts[-1][1]
+    lo_w, lo_e = pts[0]
+    if width <= lo_w:
+        # extrapolate the measured tail slope in log-log space
+        (w0, e0), (w1, e1) = pts[0], pts[1]
+        slope = math.log(e1 / e0) / math.log(w1 / w0)
+        return max(1e-3, e0 * (width / w0) ** slope)
+    for (w0, e0), (w1, e1) in zip(pts, pts[1:]):
+        if w0 <= width <= w1:
+            t = math.log(width / w0) / math.log(w1 / w0)
+            return e0 * (e1 / e0) ** t
+    return lo_e
+
+
+def _gemm_classes(space: TuneSpace, mp: int):
+    """(flops_fraction, local output width) per GEMM class of one layer
+    stack — the widths tensor parallelism actually leaves on each chip.
+    Used to rank configs on the measured width curve: more mp = narrower
+    local GEMMs = further down the curve, which is the real TP cost on
+    this hardware beyond the allreduce bytes."""
+    h, i, v, L = (space.hidden_size, space.intermediate_size,
+                  space.vocab_size, space.num_layers)
+    qkvo = L * 4 * h * h          # q, k, v, o projections (MHA sizing)
+    gate_up = L * 2 * h * i       # column-parallel pair
+    down = L * h * i              # row-parallel: local width is h (full)
+    head = v * h                  # vocab projection
+    total = qkvo + gate_up + down + head
+    return (
+        (qkvo / total, h / mp),
+        (gate_up / total, i / mp),
+        (down / total, h),        # row-parallel output stays [*, h]
+        (head / total, v / mp),
+    )
+
+
 def estimate_memory_bytes(space: TuneSpace, c: Candidate) -> float:
     """Per-chip HBM estimate (reference: prune.py memory rules; Megatron
     activation formulas, recompute ≈ keeps only layer inputs)."""
@@ -101,19 +161,59 @@ def estimate_memory_bytes(space: TuneSpace, c: Candidate) -> float:
         act_per_layer = s * b * h * space.dtype_bytes  # layer inputs only
     else:
         act_per_layer = s * b * h * 34 / 2 * space.dtype_bytes / c.mp
-    # pipeline keeps up to pp in-flight micro-batches of activations
-    act_mem = act_per_layer * layers_here * min(c.pp, 2 if c.pp == 1 else c.pp)
+    act_mem = act_per_layer * layers_here * _pipeline_live_microbatches(
+        space, c)
     return param_mem + grad_mem + opt_mem + act_mem
 
 
+def _pipeline_live_microbatches(space: TuneSpace, c: Candidate) -> float:
+    """How many micro-batches of activations are resident per stage.
+
+    pp == 1: one (fwd+bwd of the same micro-batch). pp > 1: read the
+    ACTUAL liveness off the compiled schedule's slot table
+    (fleet.pipeline_spmd_engine compile_pipeline_plan — num_slots is the
+    interval-colored maximum of concurrently-live activation slots, the
+    same number the runtime allocates), falling back to the 1F1B
+    steady-state bound min(pp, m) if the plan can't be built."""
+    if c.pp <= 1:
+        return 1.0
+    m = max(1, space.global_batch_size // (c.dp * c.micro_batch_size))
+    slots = _plan_num_slots(c.pp, max(m, c.pp))
+    if slots is None:
+        return float(min(c.pp, m))
+    # the engine requires M >= S to build a plan; when the config has
+    # FEWER micro-batches than stages, clamp back to m — no schedule
+    # can keep more micro-batches live than exist
+    return float(min(slots, m))
+
+
+@lru_cache(maxsize=512)
+def _plan_num_slots(S: int, M: int):
+    """Memoized 1F1B slot count: search loops share (S, M) across many
+    candidates, and the schedule construction is O(S*M)."""
+    try:
+        from ..fleet.pipeline_spmd_engine import compile_pipeline_plan
+
+        return int(compile_pipeline_plan("1f1b", S=S, M=M).num_slots)
+    except Exception:
+        return None
+
+
 def estimate_step_time_s(space: TuneSpace, c: Candidate) -> float:
-    """Roofline step-time estimate: MXU compute + TP allreduce volume over
-    ICI + PP bubble + DP grad reduction (reference: cost_model.py)."""
+    """Roofline step-time estimate: MXU compute on the MEASURED width-
+    scaling curve + TP allreduce volume over ICI + PP bubble + DP grad
+    reduction (reference: cost_model.py; the width curve replaces its
+    flat utilization constant — narrow local GEMMs under high mp are
+    the dominant TP cost on this hardware)."""
     P = _param_count(space)
     tokens = space.global_batch_size * space.seq_length
     flops = 6 * P * tokens * (4 / 3 if c.recompute else 1)
-    mfu_ceiling = 0.55 if c.mp <= 8 else 0.45
-    compute = flops / (space.num_devices * space.peak_flops * mfu_ceiling)
+    # FLOP-weighted achievable throughput across the layer's GEMM
+    # classes at their mp-local output widths
+    inv_tput = sum(
+        frac / (space.peak_flops * width_efficiency(width))
+        for frac, width in _gemm_classes(space, c.mp))
+    compute = flops / space.num_devices * inv_tput
 
     # TP: 2 allreduces (fwd+bwd each) per layer over activations
     s_local = space.seq_length
@@ -169,6 +269,9 @@ class Tuner:
     def __init__(self, space: TuneSpace):
         self.space = space
         self.history: List[Candidate] = []
+        # every generated candidate incl. pruned ones (pruned_reason set)
+        # — the reference recorder keeps the full audit trail too
+        self.history_all: List[Candidate] = []
 
     def candidates(self) -> List[Candidate]:
         sp = self.space
@@ -184,7 +287,9 @@ class Tuner:
 
     def search(self, top_k: int = 5) -> List[Candidate]:
         """Offline search: generate → prune → score → rank."""
-        kept = prune_candidates(self.space, self.candidates())
+        allc = self.candidates()
+        kept = prune_candidates(self.space, allc)
+        self.history_all = allc
         for c in kept:
             c.est_step_time_s = estimate_step_time_s(self.space, c)
         kept.sort(key=lambda c: c.est_step_time_s)

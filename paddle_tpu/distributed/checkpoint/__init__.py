@@ -6,17 +6,32 @@ Reference: python/paddle/distributed/checkpoint/ — save_state_dict
 tensor→shard mapping), load_state_dict (load_state_dict.py:394 — reshards
 when the loading parallelism differs from the saving one), metadata.py.
 
-TPU re-design: each host writes the shards it owns (addressable shards of
-the jax.Array) plus a metadata json; load reassembles the global value and
-device_puts to the *current* sharding — arbitrary mesh/strategy changes
-between save and load work by construction.
+TPU re-design, format v2 (round-4): SHARD-WISE end to end.
+
+- save: each host writes ONE ``.npy`` per locally-addressable shard
+  (deduped across replicas) plus its own metadata fragment — no
+  cross-host gather, no coordinator bottleneck.
+- load: for each target tensor, only the saved shards that OVERLAP this
+  host's target placement are read — via ``np.load(mmap_mode="r")``, so
+  only the overlapping byte ranges are materialized — assembled into
+  per-device pieces and joined with
+  ``jax.make_array_from_single_device_arrays``. The full tensor is
+  NEVER materialized on any host (reference load_state_dict.py:394 does
+  the same shard-to-shard resharding); peak host memory is
+  O(this host's placement), not O(model size).
+- 2-byte extension dtypes (bfloat16) are stored as a uint16 view with
+  the logical dtype recorded in metadata (npy cannot round-trip
+  ml_dtypes natively).
+
+Format v1 (one pickle per host, dense assembly) is still readable for
+old checkpoints.
 """
 from __future__ import annotations
 
 import json
 import os
 import pickle
-from typing import Any, Dict
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,76 +42,264 @@ from ...core.tensor import Tensor
 __all__ = ["save_state_dict", "load_state_dict"]
 
 
-def _meta_path(path):
-    return os.path.join(path, "metadata.json")
+def _meta_path(path, host: Optional[int] = None):
+    if host is None:
+        return os.path.join(path, "metadata.json")
+    return os.path.join(path, f"metadata_{host}.json")
 
 
 def _shard_file(path, host):
+    # format v1 (legacy read path)
     return os.path.join(path, f"shard_{host}.pkl")
+
+
+def _npy_name(host: int, tensor_idx: int, shard_idx: int) -> str:
+    return f"shard_h{host}_t{tensor_idx}_{shard_idx}.npy"
+
+
+def _storage_view(arr: np.ndarray) -> Tuple[np.ndarray, str]:
+    """npy-safe storage array + the logical dtype name to restore.
+
+    Extension dtypes (bf16, fp8 — numpy kind 'V') can't round-trip
+    through npy natively; store them as a SAME-ITEMSIZE integer view so
+    element indices in the file match the logical indices recorded in
+    metadata (a uint16 view of a 1-byte fp8 array would halve the last
+    axis and shift every shard slice)."""
+    logical = str(arr.dtype)
+    if arr.dtype.kind == "V" or logical == "bfloat16":
+        view = {1: np.uint8, 2: np.uint16, 4: np.uint32}.get(
+            arr.dtype.itemsize)
+        if view is None:
+            raise TypeError(
+                f"unsupported extension dtype {logical} "
+                f"(itemsize {arr.dtype.itemsize})")
+        return arr.view(view), logical
+    return arr, logical
+
+
+def _logical_view(arr: np.ndarray, logical: str) -> np.ndarray:
+    if str(arr.dtype) != logical:
+        return arr.view(_np_dtype(logical))
+    return arr
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     unique_id=None, async_save=False):
-    """Write per-host shard files + metadata (save_state_dict.py:94)."""
+    """Write one .npy per locally-owned shard + this host's metadata
+    fragment (save_state_dict.py:94). Hosts never exchange data."""
     os.makedirs(path, exist_ok=True)
     host = jax.process_index()
-    meta: Dict[str, Any] = {"tensors": {}, "num_hosts": jax.process_count()}
-    shards: Dict[str, Any] = {}
-    for name, t in state_dict.items():
+    # save-attempt id binds fragments together: load refuses to merge
+    # fragments from different attempts (stale leftovers in a reused
+    # directory). Callers who don't pass unique_id get a host-0-anchored
+    # deterministic-per-process id; multi-host jobs SHOULD pass one.
+    if unique_id is None:
+        import uuid
+
+        unique_id = os.environ.get("PTPU_CKPT_UNIQUE_ID") or (
+            uuid.uuid4().hex if jax.process_count() == 1 else "shared")
+    meta: Dict[str, Any] = {"format": 2, "tensors": {},
+                            "num_hosts": jax.process_count(),
+                            "save_id": str(unique_id)}
+    objects: Dict[str, Any] = {}
+    for tensor_idx, (name, t) in enumerate(sorted(state_dict.items())):
         if not isinstance(t, Tensor):
             meta["tensors"][name] = {"kind": "object"}
-            shards[name] = t
+            objects[name] = t
             continue
         v = t._value
+        shards = []
+        local = [(s.index, np.asarray(s.data))
+                 for s in getattr(v, "addressable_shards", [])]
+        if not local:
+            local = [(tuple(slice(None) for _ in v.shape), np.asarray(v))]
+        seen = set()
+        k = 0
+        logical = str(np.asarray(local[0][1]).dtype)
+        for index, data in local:
+            key = tuple((sl.start, sl.stop) for sl in
+                        _norm_index(index, v.shape))
+            if key in seen:
+                continue          # replicated copy of the same shard
+            seen.add(key)
+            fname = _npy_name(host, tensor_idx, k)
+            store, logical = _storage_view(data)
+            np.save(os.path.join(path, fname), store, allow_pickle=False)
+            shards.append({"index": _index_to_json(index, v.shape),
+                           "file": fname})
+            k += 1
         meta["tensors"][name] = {
             "kind": "tensor",
             "shape": list(v.shape),
-            "dtype": str(v.dtype),
+            "dtype": logical,
+            "shards": shards,
         }
-        local = []
-        for s in getattr(v, "addressable_shards", []):
-            local.append(
-                {"index": _index_to_json(s.index, v.shape),
-                 "data": np.asarray(s.data)}
-            )
-        if not local:
-            local.append(
-                {"index": _index_to_json(tuple(slice(None) for _ in v.shape), v.shape),
-                 "data": np.asarray(v)}
-            )
-        # dedupe replicated shards (same index saved once)
-        seen = set()
-        uniq = []
-        for sh in local:
-            key = tuple(map(tuple, sh["index"]))
-            if key not in seen:
-                seen.add(key)
-                uniq.append(sh)
-        shards[name] = uniq
-    with open(_shard_file(path, host), "wb") as f:
-        pickle.dump(shards, f, protocol=4)
+    if objects:
+        with open(os.path.join(path, f"objects_{host}.pkl"), "wb") as f:
+            pickle.dump(objects, f, protocol=4)
+        meta["object_file"] = f"objects_{host}.pkl"
+    with open(_meta_path(path, host), "w") as f:
+        json.dump(meta, f)
     if host == 0:
+        # single-host jobs also get the legacy-named global file so
+        # tooling that looks for metadata.json still finds one
         with open(_meta_path(path), "w") as f:
             json.dump(meta, f)
 
 
-def _index_to_json(index, shape):
+def _norm_index(index, shape):
     out = []
     for sl, dim in zip(index, shape):
-        start = 0 if sl.start is None else sl.start
-        stop = dim if sl.stop is None else sl.stop
-        out.append([int(start), int(stop)])
-    return out
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append(slice(start, stop))
+    return tuple(out)
+
+
+def _index_to_json(index, shape):
+    return [[sl.start, sl.stop] for sl in _norm_index(index, shape)]
+
+
+def _merge_meta(path) -> Dict[str, Any]:
+    """Merge per-host metadata fragments (format 2); fall back to the
+    single metadata.json (format 1 or single-host). The fragment count
+    is BOUNDED by fragment 0's recorded num_hosts — never by whatever
+    metadata_{h}.json files happen to exist, so stale fragments from an
+    earlier, larger-world save into the same directory are ignored."""
+    metas: List[Dict[str, Any]] = []
+    if os.path.exists(_meta_path(path, 0)):
+        with open(_meta_path(path, 0)) as f:
+            first = json.load(f)
+        metas.append(first)
+        for host in range(1, int(first.get("num_hosts", 1))):
+            fp = _meta_path(path, host)
+            if not os.path.exists(fp):
+                # a silently-missing fragment would zero-fill its shard
+                # regions — that's data corruption, not a degraded load
+                raise FileNotFoundError(
+                    f"checkpoint at {path!r} expects "
+                    f"{first.get('num_hosts')} metadata fragments "
+                    f"(fragment 0 says so) but metadata_{host}.json is "
+                    f"missing — incomplete or partially-overwritten save")
+            with open(fp) as f:
+                frag = json.load(f)
+            if frag.get("save_id") != first.get("save_id"):
+                raise ValueError(
+                    f"checkpoint fragment metadata_{host}.json belongs "
+                    f"to save attempt {frag.get('save_id')!r}, not "
+                    f"{first.get('save_id')!r} — stale leftover from an "
+                    f"earlier save into the same directory")
+            metas.append(frag)
+    if not metas:
+        with open(_meta_path(path)) as f:
+            return json.load(f)
+    merged = {"format": 2, "tensors": {}, "object_files": [],
+              "num_hosts": len(metas)}
+    for m in metas:
+        if m.get("object_file"):
+            merged["object_files"].append(m["object_file"])
+        for name, info in m["tensors"].items():
+            if name not in merged["tensors"]:
+                merged["tensors"][name] = dict(info)
+            elif info["kind"] == "tensor":
+                merged["tensors"][name]["shards"] = (
+                    merged["tensors"][name].get("shards", [])
+                    + info.get("shards", []))
+    return merged
+
+
+def _overlap(a: Tuple[int, int], b: Tuple[int, int]):
+    lo, hi = max(a[0], b[0]), min(a[1], b[1])
+    return (lo, hi) if lo < hi else None
+
+
+def _assemble_piece(path, info, piece_index, dtype) -> np.ndarray:
+    """Materialize ONE target-device piece of a tensor by copying the
+    overlapping regions out of memory-mapped shard files."""
+    piece_idx = [(sl.start, sl.stop) for sl in piece_index]
+    piece_shape = tuple(b - a for a, b in piece_idx)
+    piece = np.zeros(piece_shape, dtype=dtype)
+    for rec in info.get("shards", []):
+        spans = []
+        for (pa, pb), (sa, sb) in zip(piece_idx, rec["index"]):
+            ov = _overlap((pa, pb), (sa, sb))
+            if ov is None:
+                spans = None
+                break
+            spans.append(ov)
+        if spans is None:
+            continue
+        src = np.load(os.path.join(path, rec["file"]), mmap_mode="r")
+        src_sel = tuple(slice(lo - sa, hi - sa) for (lo, hi), (sa, _sb)
+                        in zip(spans, rec["index"]))
+        dst_sel = tuple(slice(lo - pa, hi - pa) for (lo, hi), (pa, _pb)
+                        in zip(spans, piece_idx))
+        # only the selected byte range is read off the mmap
+        region = np.asarray(src[src_sel])
+        piece[dst_sel] = _logical_view(region, info["dtype"]).astype(
+            dtype, copy=False)
+        del src
+    return piece
 
 
 def load_state_dict(state_dict: Dict[str, Any], path: str, process_group=None,
                     coordinator_rank: int = 0, unique_id=None,
                     offload: bool = False):
     """Fill ``state_dict``'s tensors from checkpoint, resharding to each
-    tensor's CURRENT layout (load_state_dict.py:394)."""
-    with open(_meta_path(path)) as f:
-        meta = json.load(f)
+    tensor's CURRENT layout shard-wise: only the saved shards that
+    overlap this host's placement are read (load_state_dict.py:394)."""
+    meta = _merge_meta(path)
+    if meta.get("format", 1) < 2:
+        return _load_state_dict_v1(state_dict, path, meta)
+
+    objects: Dict[str, Any] = {}
+    for fname in meta.get("object_files", []):
+        fp = os.path.join(path, fname)
+        if os.path.exists(fp):
+            with open(fp, "rb") as f:
+                objects.update(pickle.load(f))
+
+    for name, target in state_dict.items():
+        info = meta["tensors"].get(name)
+        if info is None:
+            continue
+        if info["kind"] == "object":
+            if name in objects:
+                state_dict[name] = objects[name]
+            continue
+        if not isinstance(target, Tensor):
+            continue
+        v = target._value
+        shape = tuple(info["shape"])
+        sharding = getattr(v, "sharding", None)
+        if sharding is not None and hasattr(
+                sharding, "addressable_devices_indices_map"):
+            dev_map = sharding.addressable_devices_indices_map(shape)
+            pieces = []
+            # replicated placements repeat the SAME index per device:
+            # assemble each distinct index once and device_put the
+            # cached host piece (keeps peak at O(distinct placement))
+            assembled: Dict[tuple, np.ndarray] = {}
+            for dev, idx in dev_map.items():
+                norm = _norm_index(idx, shape)
+                key = tuple((sl.start, sl.stop) for sl in norm)
+                if key not in assembled:
+                    assembled[key] = _assemble_piece(
+                        path, info, norm, v.dtype)
+                pieces.append(jax.device_put(assembled[key], dev))
+            arr = jax.make_array_from_single_device_arrays(
+                shape, sharding, pieces)
+        else:
+            full_idx = tuple(slice(0, d) for d in shape)
+            arr = jnp.asarray(
+                _assemble_piece(path, info, full_idx, v.dtype))
+        target._replace_value(arr)
+    return state_dict
+
+
+def _load_state_dict_v1(state_dict, path, meta):
+    """Legacy format: one pickle per host, dense per-tensor assembly."""
     all_shards: Dict[str, Any] = {}
     for host in range(meta["num_hosts"]):
         fp = _shard_file(path, host)
@@ -131,6 +334,9 @@ def load_state_dict(state_dict: Dict[str, Any], path: str, process_group=None,
 
 
 def _np_dtype(name):
-    import ml_dtypes  # noqa: F401
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16, float8_e4m3fn, ...
 
-    return np.dtype(name)
+        return np.dtype(getattr(ml_dtypes, name))

@@ -135,11 +135,17 @@ class ExpertsFFN(Layer):
         super().__init__()
         self.num_expert = num_expert
         self.activation = activation
+        # activation == "swiglu" (ERNIE-4.5's expert form): gate and up
+        # projections are CONCATENATED into one [d, 2H] weight so the
+        # first projection is a single width-2H GEMM — on the measured
+        # width curve one W=2816 GEMM beats two W=1408 by ~1.5x
+        # (_moe_act docstring)
+        first_out = 2 * d_hidden if activation == "swiglu" else d_hidden
         self.w0 = self.create_parameter(
-            [num_expert, d_model, d_hidden],
+            [num_expert, d_model, first_out],
             default_initializer=I.XavierUniform())
         self.b0 = self.create_parameter(
-            [num_expert, 1, d_hidden], is_bias=True,
+            [num_expert, 1, first_out], is_bias=True,
             default_initializer=I.Constant(0.0))
         self.w1 = self.create_parameter(
             [num_expert, d_hidden, d_model],
@@ -154,10 +160,14 @@ class ExpertsFFN(Layer):
 
     def forward(self, dispatched: Tensor) -> Tensor:
         """[E, C, d] → [E, C, d]: two batched GEMMs over the expert dim."""
+        from .....incubate.nn import functional as IF
         from .....nn import functional as F
 
         h = einsum("ecd,edh->ech", dispatched, self.w0) + self.b0
-        h = getattr(F, self.activation)(h)
+        if self.activation == "swiglu":
+            h = IF.swiglu(h)          # fused [.., 2H] -> [.., H]
+        else:
+            h = getattr(F, self.activation)(h)
         return einsum("ech,ehd->ecd", h, self.w1) + self.b1
 
 
@@ -262,6 +272,36 @@ def _route(probs, key, *, k, capacity, normalize, random2):
     return tv, raw_tv, top_idx, keep, flat, token_of_slot, j_of_slot, keep2
 
 
+def _moe_act(activation):
+    """Resolve an expert activation. ``swiglu`` is the FUSED form: the
+    first projection computes gate and up TOGETHER as one [d, 2H] GEMM
+    (w0 stacked [E, d, 2H]) and the activation halves the width —
+    silu(h[..., :H]) * h[..., H:]. On this chip's measured width curve
+    one W=2816 GEMM runs at 72 TF/s where two W=1408 GEMMs run at 49
+    (tools/gemm_width_calibration), which is the whole point of fusing
+    ERNIE-4.5's gate+up instead of projecting them separately."""
+    import jax
+    import jax.numpy as jnp
+
+    if activation == "swiglu":
+        def _swiglu_fused(h):
+            g, u = jnp.split(h, 2, axis=-1)
+            return jax.nn.silu(g) * u
+
+        return _swiglu_fused
+    return getattr(jax.nn, activation)
+
+
+# MEASURED (v5e, bench_moe H=2048/h=1408/E=8/top2, 2026-07-31): swiglu
+# experts (one W=2816 first GEMM) land at 0.541 MFU vs 0.546 for the
+# gelu bank (one W=1408 GEMM) — a NULL, not the hoped width-curve win.
+# Why: the extra 1.5x expert FLOPs ride at ~72/49 = 1.47x the rate, a
+# near-exact wash, and the batched [E,*,*] einsum does not reach the
+# flat-GEMM calibration number (the 72 TF/s point was measured on an
+# UNBATCHED [16k,2048]x[2048,2816]). The fused form stays as ERNIE-4.5's
+# true architecture; it is not a perf lever at this geometry.
+
+
 def _moe_idx_ffn_fwd(probs, x, w0, b0, w1, b1, key, *, k, capacity,
                      activation, normalize, random2):
     """Routed MoE FFN with permutation (gather-only) dispatch.
@@ -289,7 +329,7 @@ def _moe_idx_ffn_fwd(probs, x, w0, b0, w1, b1, key, *, k, capacity,
     x_ext = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)], axis=0)
     disp = x_ext[token_of_slot[: e * c]].reshape(e, c, d)
 
-    act = getattr(jax.nn, activation)
+    act = _moe_act(activation)
     h1 = jnp.einsum("ecd,edh->ech", disp, w0,
                     preferred_element_type=jnp.float32).astype(x.dtype) + b0
     a = act(h1)
@@ -330,7 +370,7 @@ def _moe_idx_ffn_vjp(grads_out, saved, *, k, capacity, activation,
     x_ext = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)], axis=0)
     tok = token_of_slot[: e * c]
     disp = x_ext[tok].reshape(e, c, d)
-    act = getattr(jax.nn, activation)
+    act = _moe_act(activation)
     h1 = jnp.einsum("ecd,edh->ech", disp, w0,
                     preferred_element_type=f32).astype(x.dtype) + b0
     a, act_vjp = jax.vjp(act, h1)

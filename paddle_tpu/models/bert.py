@@ -40,6 +40,12 @@ class BertConfig:
     attention_probs_dropout_prob: float = 0.1
     layer_norm_eps: float = 1e-12
     recompute: bool = False
+    # compute-time q|k|v weight concat (one W=3h GEMM instead of three
+    # W=h); parameters stay separate (see models/llama.py fused_qkv).
+    # MEASURED (v5e bench geometry, 2026-07-31): 0.3884 MFU fused vs
+    # 0.3868 separate — within noise; XLA's same-input multi-GEMM
+    # scheduling already captures the width win. Off by default.
+    fused_qkv: bool = False
 
     @staticmethod
     def base() -> "BertConfig":
@@ -91,6 +97,7 @@ class BertEmbeddings(nn.Layer):
 class BertSelfAttention(nn.Layer):
     def __init__(self, config: BertConfig):
         super().__init__()
+        self.config = config
         self.num_heads = config.num_attention_heads
         self.head_dim = config.hidden_size // config.num_attention_heads
         h = config.hidden_size
@@ -102,9 +109,21 @@ class BertSelfAttention(nn.Layer):
 
     def forward(self, x, attention_mask=None):
         b, s, h = x.shape
-        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
-        k = self.k_proj(x).reshape([b, s, self.num_heads, self.head_dim])
-        v = self.v_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        if self.config.fused_qkv:
+            from .llama import fused_qkv_linear
+
+            q, k, v = fused_qkv_linear(
+                x, (self.q_proj, self.k_proj, self.v_proj))
+            q = q.reshape([b, s, self.num_heads, self.head_dim])
+            k = k.reshape([b, s, self.num_heads, self.head_dim])
+            v = v.reshape([b, s, self.num_heads, self.head_dim])
+        else:
+            q = self.q_proj(x).reshape(
+                [b, s, self.num_heads, self.head_dim])
+            k = self.k_proj(x).reshape(
+                [b, s, self.num_heads, self.head_dim])
+            v = self.v_proj(x).reshape(
+                [b, s, self.num_heads, self.head_dim])
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attention_mask, is_causal=False)
         return self.dropout(self.out_proj(out.reshape([b, s, h])))

@@ -31,6 +31,10 @@ class ErnieMoeConfig(LlamaConfig):
     moe_intermediate_size: Optional[int] = None
     aux_loss_weight: float = 0.01
     gate_type: str = "gshard"
+    # "swiglu" = ERNIE-4.5's expert form with gate+up CONCATENATED into
+    # one [d, 2H] projection (one wide GEMM instead of two narrow ones —
+    # see ExpertsFFN); "gelu" keeps the classic 2-GEMM FFN expert
+    moe_activation: str = "gelu"
 
     @staticmethod
     def tiny(**kw) -> "ErnieMoeConfig":
@@ -61,6 +65,7 @@ class ErnieMoeDecoderLayer(nn.Layer):
                 config.moe_intermediate_size or config.intermediate_size,
                 config.num_experts,
                 gate={"type": config.gate_type, "topk": config.moe_top_k},
+                activation=config.moe_activation,
                 moe_group=moe_group,
             )
         else:

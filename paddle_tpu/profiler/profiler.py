@@ -320,7 +320,24 @@ class Profiler:
 
     def summary(self, sorted_by=SummaryView.OverView, op_detail: bool = True,
                 thread_sep: bool = False, time_unit: str = "ms"):
+        """Host-span table plus, when a device capture happened, the
+        per-op and op-class device tables (reference:
+        profiler_statistic.py's operator + kernel summaries)."""
         table = summary_table(self._events, time_unit=time_unit)
+        if getattr(self, "_device_trace_captured", False):
+            from .statistic import device_summary_table
+            from .xplane import device_trace_events
+
+            try:
+                devs = device_trace_events(
+                    self.log_dir,
+                    newer_than=getattr(self, "_device_trace_started", 0.0))
+            except Exception:
+                devs = []
+            if devs:
+                table += "\n\n" + device_summary_table(devs, by="op")
+                if op_detail:
+                    table += "\n\n" + device_summary_table(devs, by="class")
         print(table)
         return table
 

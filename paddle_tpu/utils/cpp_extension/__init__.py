@@ -1,6 +1,8 @@
 from .cpp_extension import CppExtension, CUDAExtension, load, setup
+from .custom_kernel import PtpuTensor, register_cpp_kernel
 
-__all__ = ["CppExtension", "CUDAExtension", "load", "setup"]
+__all__ = ["CppExtension", "CUDAExtension", "load", "setup",
+           "PtpuTensor", "register_cpp_kernel"]
 
 
 def get_build_directory(verbose=False):

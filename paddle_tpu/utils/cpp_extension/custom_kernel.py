@@ -1,0 +1,168 @@
+"""C-ABI custom-kernel registration.
+
+Reference: paddle/phi/core/custom_kernel.h:25 and phi/capi/include/ —
+out-of-tree kernels compiled against a stable C ABI join the PHI kernel
+factory and dispatch like built-ins.
+
+TPU re-design: the device compute path is XLA/Pallas, so a C kernel is a
+HOST kernel. ``register_cpp_kernel`` wires a ``cpp_extension.load``-built
+C function into ``core.dispatch`` as a first-class primitive:
+
+- the forward runs through ``jax.pure_callback``, so the op works both
+  eagerly and inside ``jit`` (XLA schedules a host callback — the same
+  architecture the reference uses for CPU kernels inside a GPU graph);
+- an optional C (or Python) VJP makes it differentiable: the primitive
+  is wrapped in ``jax.custom_vjp`` so ``jax.grad``/``loss.backward()``
+  both see it, and the eager tape uses the same rule.
+
+C ABI (ptpu_c_api.h style, mirroring phi/capi's PD_Tensor accessors)::
+
+    typedef struct {
+      void*          data;   /* element buffer, dense row-major      */
+      const int64_t* shape;
+      int32_t        ndim;
+      int32_t        dtype;  /* 0=f32 1=f64 2=i32 3=i64 4=u8 5=bool */
+    } PtpuTensor;
+
+    /* return 0 on success */
+    int my_kernel(int32_t n_in, const PtpuTensor* ins, PtpuTensor* out);
+
+The output buffer is allocated by the caller from the registered shape
+rule, exactly like the reference's InferMeta-then-Kernel split.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["PtpuTensor", "register_cpp_kernel"]
+
+
+class PtpuTensor(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.c_void_p),
+        ("shape", ctypes.POINTER(ctypes.c_int64)),
+        ("ndim", ctypes.c_int32),
+        ("dtype", ctypes.c_int32),
+    ]
+
+
+_DTYPE_CODES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+    np.dtype(np.uint8): 4,
+    np.dtype(np.bool_): 5,
+}
+
+
+def _as_c_tensor(arr: np.ndarray, keepalive: list) -> PtpuTensor:
+    arr = np.ascontiguousarray(arr)
+    shape = (ctypes.c_int64 * max(arr.ndim, 1))(*arr.shape)
+    keepalive.extend((arr, shape))
+    code = _DTYPE_CODES.get(arr.dtype)
+    if code is None:
+        raise TypeError(
+            f"C custom kernels accept {sorted(str(k) for k in _DTYPE_CODES)}"
+            f", got {arr.dtype} (bf16 compute belongs on the device — use "
+            f"a Pallas kernel)")
+    return PtpuTensor(
+        data=arr.ctypes.data_as(ctypes.c_void_p), shape=shape,
+        ndim=arr.ndim, dtype=code)
+
+
+def _host_call(cfunc, out_spec, arrays: Sequence[np.ndarray]) -> np.ndarray:
+    keep: list = []
+    ins = (PtpuTensor * max(len(arrays), 1))(
+        *[_as_c_tensor(np.asarray(a), keep) for a in arrays])
+    out = np.zeros(out_spec.shape, np.dtype(out_spec.dtype))
+    out_c = _as_c_tensor(out, keep)
+    rc = cfunc(ctypes.c_int32(len(arrays)), ins, ctypes.byref(out_c))
+    if rc != 0:
+        raise RuntimeError(f"C custom kernel returned {rc}")
+    return out
+
+
+def register_cpp_kernel(name: str, lib, symbol: Optional[str] = None, *,
+                        out_shape_fn: Optional[Callable] = None,
+                        vjp: Optional[Callable] = None,
+                        vjp_symbol: Optional[str] = None,
+                        nondiff: bool = False):
+    """Register the C function ``symbol`` (default: ``name``) from a
+    ``cpp_extension.load``-built library as primitive ``name``.
+
+    out_shape_fn(*avals) -> jax.ShapeDtypeStruct — the InferMeta rule
+    (default: same shape/dtype as the first input).
+    vjp: Python rule ``vjp(grads_out, saved, **static) -> grads`` (the
+    Primitive VJP convention), or pass ``vjp_symbol`` naming a C kernel
+    in the same library with the ABI ``f(n_in, ins, out)`` where ins =
+    (dy, *forward_inputs) and out = dx for the first input.
+    With neither, the op is marked non-differentiable.
+    """
+    import jax
+
+    from ...core.dispatch import register_primitive
+
+    cfunc = getattr(lib, symbol or name)
+    cfunc.argtypes = [ctypes.c_int32, ctypes.POINTER(PtpuTensor),
+                      ctypes.POINTER(PtpuTensor)]
+    cfunc.restype = ctypes.c_int32
+
+    def infer_out(*arrays):
+        if out_shape_fn is not None:
+            return out_shape_fn(*[
+                jax.ShapeDtypeStruct(np.shape(a), a.dtype)
+                for a in arrays])
+        return jax.ShapeDtypeStruct(arrays[0].shape, arrays[0].dtype)
+
+    if vjp is None and vjp_symbol is not None:
+        cbwd = getattr(lib, vjp_symbol)
+        cbwd.argtypes = cfunc.argtypes
+        cbwd.restype = ctypes.c_int32
+
+        def vjp(grads_out, saved, **static):  # noqa: F811
+            dy = grads_out[0]
+            spec = jax.ShapeDtypeStruct(saved[0].shape, saved[0].dtype)
+            dx = jax.pure_callback(
+                lambda *a: _host_call(cbwd, spec, a), spec, dy, *saved,
+                vmap_method="sequential")
+            return (dx,) + (None,) * (len(saved) - 1)
+
+    def raw_forward(*arrays, **static):
+        spec = infer_out(*arrays)
+        return jax.pure_callback(
+            lambda *a: _host_call(cfunc, spec, a), spec, *arrays,
+            vmap_method="sequential")
+
+    if vjp is not None:
+        # jax.custom_vjp so jax.grad / traced training steps also see
+        # the rule, not just the eager tape
+        wrapped = jax.custom_vjp(raw_forward)
+
+        def fwd_rule(*arrays, **static):
+            out = raw_forward(*arrays, **static)
+            return out, arrays
+
+        def bwd_rule(saved, g):
+            grads = vjp((g,), saved)
+
+            def zero_for(s):
+                # custom_vjp requires float0 tangents for integer
+                # inputs (gather-like C kernels take index operands)
+                if not jax.numpy.issubdtype(s.dtype, jax.numpy.inexact):
+                    return np.zeros(s.shape, jax.dtypes.float0)
+                return jax.numpy.zeros_like(s)
+
+            return tuple(zero_for(s) if d is None else d
+                         for d, s in zip(grads, saved))
+
+        wrapped.defvjp(fwd_rule, bwd_rule)
+        forward = wrapped
+    else:
+        forward = raw_forward
+        nondiff = True
+
+    return register_primitive(name, forward, vjp=vjp, nondiff=nondiff)

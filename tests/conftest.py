@@ -37,14 +37,21 @@ def _seed():
 # `-m fast` gate set (VERDICT r3 #9): the parity gates plus round-critical
 # regression modules, kept regenerable in <= 5 minutes on the 1-core host
 # so every round's record can be re-verified inside any judge/driver window.
+# NOT in the set: test_api_callable_sweep — it calls every one of the
+# 1,300+ exports and alone takes ~8 min on this host; it stays a
+# standalone gate (`pytest tests/test_api_callable_sweep.py`). The set
+# below measures ~3.5 min total (2026-07-31, 1-core host).
 _FAST_MODULES = {
     "test_api_parity",
-    "test_api_callable_sweep",
     "test_spmd_rules",
     "test_pipeline_engine",
     "test_program_passes",
     "test_fleet_executor",
     "test_moe",
+    "test_completion",
+    "test_debugging_tuner",
+    "test_profiler_device",
+    "test_distributed",
 }
 
 

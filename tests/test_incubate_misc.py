@@ -359,3 +359,149 @@ class TestAutotuneAndTensor:
         ForkingPickler(buf).dump(t)
         back = pickle.loads(buf.getvalue())
         np.testing.assert_allclose(back.numpy(), t.numpy())
+
+
+class TestCAbiCustomKernel:
+    """C-ABI custom-kernel registration (reference:
+    phi/core/custom_kernel.h:25, phi/capi): build a C++ op with
+    cpp_extension, register it into core.dispatch, run it eagerly,
+    under jit, and through a gradient."""
+
+    def _build(self, tmp_path):
+        import textwrap
+
+        from paddle_tpu.utils.cpp_extension import load
+
+        src = tmp_path / "my_scale.cc"
+        src.write_text(textwrap.dedent("""
+            #include <cstdint>
+            extern "C" {
+            typedef struct {
+              void* data; const int64_t* shape; int32_t ndim; int32_t dtype;
+            } PtpuTensor;
+
+            static int64_t numel(const PtpuTensor* t) {
+              int64_t n = 1;
+              for (int i = 0; i < t->ndim; ++i) n *= t->shape[i];
+              return n;
+            }
+
+            /* y = 2*x + 3 */
+            int my_scale(int32_t n_in, const PtpuTensor* ins, PtpuTensor* out) {
+              if (n_in != 1 || ins[0].dtype != 0) return 1;
+              const float* x = (const float*)ins[0].data;
+              float* y = (float*)out->data;
+              int64_t n = numel(&ins[0]);
+              for (int64_t i = 0; i < n; ++i) y[i] = 2.0f * x[i] + 3.0f;
+              return 0;
+            }
+
+            /* dx = 2*dy  (ins = [dy, x]) */
+            int my_scale_grad(int32_t n_in, const PtpuTensor* ins,
+                              PtpuTensor* out) {
+              if (n_in < 1 || ins[0].dtype != 0) return 1;
+              const float* dy = (const float*)ins[0].data;
+              float* dx = (float*)out->data;
+              int64_t n = numel(&ins[0]);
+              for (int64_t i = 0; i < n; ++i) dx[i] = 2.0f * dy[i];
+              return 0;
+            }
+            }
+        """))
+        return load("my_scale_test", [str(src)],
+                    build_directory=str(tmp_path))
+
+    def test_c_kernel_eager_jit_and_grad(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import apply
+        from paddle_tpu.utils.cpp_extension import register_cpp_kernel
+
+        lib = self._build(tmp_path)
+        register_cpp_kernel("my_scale_p", lib, symbol="my_scale",
+                            vjp_symbol="my_scale_grad")
+
+        # eager through the framework dispatch + tape
+        x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+        x.stop_gradient = False
+        y = apply("my_scale_p", x)
+        np.testing.assert_allclose(y.numpy(), 2 * x.numpy() + 3)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones((2, 3)))
+
+        # under jax.jit (pure_callback host bridge) + jax.grad
+        from paddle_tpu.core.dispatch import PRIMITIVES
+
+        fwd = PRIMITIVES["my_scale_p"].forward
+        xj = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+        yj = jax.jit(fwd)(xj)
+        np.testing.assert_allclose(np.asarray(yj), 2 * np.asarray(xj) + 3)
+        g = jax.grad(lambda a: fwd(a).sum())(xj)
+        np.testing.assert_allclose(np.asarray(g), 2 * np.ones((2, 3)))
+
+    def test_nondiff_without_vjp(self, tmp_path):
+        from paddle_tpu.core.dispatch import PRIMITIVES
+        from paddle_tpu.utils.cpp_extension import register_cpp_kernel
+
+        lib = self._build(tmp_path)
+        register_cpp_kernel("my_scale_nd_p", lib, symbol="my_scale")
+        assert PRIMITIVES["my_scale_nd_p"].nondiff
+
+    def test_c_kernel_with_integer_operand_grad(self, tmp_path):
+        """A differentiable C kernel with an INTEGER operand (index /
+        offset args are common) must produce float0 tangents for it
+        under jax.grad instead of crashing."""
+        import textwrap
+
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.dispatch import PRIMITIVES
+        from paddle_tpu.utils.cpp_extension import (load,
+                                                    register_cpp_kernel)
+
+        src = tmp_path / "my_offset.cc"
+        src.write_text(textwrap.dedent("""
+            #include <cstdint>
+            extern "C" {
+            typedef struct {
+              void* data; const int64_t* shape; int32_t ndim; int32_t dtype;
+            } PtpuTensor;
+
+            /* y = x + (float)shift[0]; ins = [x f32, shift i64] */
+            int my_offset(int32_t n_in, const PtpuTensor* ins,
+                          PtpuTensor* out) {
+              if (n_in != 2 || ins[0].dtype != 0 || ins[1].dtype != 3)
+                return 1;
+              const float* x = (const float*)ins[0].data;
+              const int64_t* s = (const int64_t*)ins[1].data;
+              float* y = (float*)out->data;
+              int64_t n = 1;
+              for (int i = 0; i < ins[0].ndim; ++i) n *= ins[0].shape[i];
+              for (int64_t i = 0; i < n; ++i) y[i] = x[i] + (float)s[0];
+              return 0;
+            }
+            /* dx = dy; ins = [dy, x, shift] */
+            int my_offset_grad(int32_t n_in, const PtpuTensor* ins,
+                               PtpuTensor* out) {
+              const float* dy = (const float*)ins[0].data;
+              float* dx = (float*)out->data;
+              int64_t n = 1;
+              for (int i = 0; i < ins[0].ndim; ++i) n *= ins[0].shape[i];
+              for (int64_t i = 0; i < n; ++i) dx[i] = dy[i];
+              return 0;
+            }
+            }
+        """))
+        lib = load("my_offset_test", [str(src)],
+                   build_directory=str(tmp_path))
+        register_cpp_kernel("my_offset_p", lib, symbol="my_offset",
+                            vjp_symbol="my_offset_grad")
+        fwd = PRIMITIVES["my_offset_p"].forward
+        x = jnp.arange(4, dtype=jnp.float32)
+        shift = jnp.asarray([3], jnp.int64)
+        y = jax.jit(fwd)(x, shift)
+        np.testing.assert_allclose(np.asarray(y), np.arange(4) + 3.0)
+        g = jax.grad(lambda a: fwd(a, shift).sum())(x)
+        np.testing.assert_allclose(np.asarray(g), np.ones(4))

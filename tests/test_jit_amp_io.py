@@ -209,3 +209,28 @@ class TestSaveLoad:
         paddle.save({"x": x}, p)
         loaded = paddle.load(p)
         assert str(loaded["x"].dtype) == "bfloat16"
+
+
+class TestHostInit:
+    """host_init + to_accelerator: host-side construction with one bulk
+    device_put (the LazyGuard/LazyInit analog for tunneled TPUs)."""
+
+    def test_host_init_builds_and_bulk_moves(self):
+        import jax
+
+        with paddle.device.host_init():
+            m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        # on the CPU test backend this is a no-op move; the API contract
+        # is: parameters remain usable and numerically identical
+        before = [p.numpy().copy() for p in m.parameters()]
+        out = paddle.device.to_accelerator(m)
+        assert out is m
+        for p, b in zip(m.parameters(), before):
+            np.testing.assert_array_equal(p.numpy(), b)
+        y = m(paddle.ones([2, 8]))
+        assert list(y.shape) == [2, 4]
+
+    def test_to_accelerator_accepts_tensor_list(self):
+        ts = [paddle.ones([3]), paddle.zeros([2, 2])]
+        out = paddle.device.to_accelerator(ts)
+        np.testing.assert_array_equal(out[0].numpy(), np.ones(3, "float32"))

@@ -238,3 +238,75 @@ class TestXplaneParser:
 
         assert parse_xspace(b"") == []
         assert device_trace_events("/nonexistent/dir") == []
+
+
+class TestDeviceStatistics:
+    """Per-op device tables over xplane-decoded events (reference:
+    profiler_statistic.py kernel/op summaries). Round-4 VERDICT #8."""
+
+    def _synth(self):
+        # shaped like xplane.py's chrome export: HLO names <op>.<id> on
+        # the "XLA Ops" lane, async DMA on its own lane, plus host noise
+        evs = []
+        for i, dur in enumerate((100.0, 120.0, 80.0)):
+            evs.append({"name": f"fusion.{i}", "ph": "X", "cat": "device",
+                        "ts": i, "dur": dur, "tid": "XLA Ops"})
+        evs.append({"name": "convolution_add_fusion.7", "ph": "X",
+                    "cat": "device", "ts": 9, "dur": 50.0,
+                    "tid": "XLA Ops"})
+        evs.append({"name": "copy.3", "ph": "X", "cat": "device",
+                    "ts": 10, "dur": 30.0, "tid": "XLA Ops"})
+        evs.append({"name": "slice-start.4", "ph": "X", "cat": "device",
+                    "ts": 11, "dur": 999.0, "tid": "Async XLA Ops"})
+        evs.append({"name": "step", "ph": "X", "cat": "ProfileStep",
+                    "ts": 0, "dur": 400.0, "tid": 1})
+        return evs
+
+    def test_per_op_aggregation_and_lane_filter(self):
+        from paddle_tpu.profiler import collect_device_statistic
+
+        items = collect_device_statistic(self._synth())
+        assert set(items) == {"fusion", "convolution_add_fusion", "copy"}
+        f = items["fusion"]
+        assert f.calls == 3
+        assert f.total_ns == int(300e3)
+        # the async lane and host events never pollute the op table
+        assert "slice-start" not in items
+
+    def test_table_ranks_compute_on_top(self):
+        from paddle_tpu.profiler import device_summary_table
+
+        table = device_summary_table(self._synth())
+        body = [l for l in table.splitlines()
+                if l.startswith(("fusion", "conv", "copy"))]
+        assert body[0].startswith("fusion")
+
+    def test_op_class_buckets(self):
+        from paddle_tpu.profiler import op_class
+
+        assert op_class("convolution_add_fusion") == "convolution"
+        assert op_class("fusion") == "fusion"
+        assert op_class("dot_general") == "matmul"
+        assert op_class("_flash_fwd_bhsd") == "custom-call (pallas)"
+        assert op_class("copy-start") == "data-movement"
+        assert op_class("all-reduce") == "collective"
+
+    def test_real_bench_trace_when_present(self):
+        """The recorded TPU bench trace (bench_trace.json) must yield a
+        non-empty per-op table with a COMPUTE class (fusion / matmul /
+        convolution / pallas custom-call) on top — not data movement."""
+        import os
+
+        from paddle_tpu.profiler import (collect_device_statistic,
+                                         op_class, statistic_from_trace)
+
+        path = os.path.join(os.path.dirname(__file__), "..",
+                            "bench_trace.json")
+        if not os.path.exists(path):
+            pytest.skip("no recorded bench trace in this checkout")
+        items = statistic_from_trace(path)
+        assert items, "device op table empty"
+        top = max(items.values(), key=lambda it: it.total_ns)
+        assert op_class(top.name) in {
+            "fusion", "matmul", "convolution", "custom-call (pallas)"}, \
+            f"top device op is {top.name}"

@@ -195,3 +195,27 @@ class TestGPT:
         l1 = float(step(ids, labels))
         l2 = float(step(ids, labels))
         assert np.isfinite(l1) and l2 < l1
+
+
+class TestBertFusedQkv:
+    def test_matches_separate_projections(self):
+        """BertConfig.fused_qkv (one W=3h GEMM) must reproduce the
+        three-projection path exactly, params unchanged."""
+        from paddle_tpu.models import BertConfig, BertForPretraining
+
+        ids = np.random.RandomState(0).randint(0, 256, (2, 16))
+        ids = ids.astype("int64")
+        mlm = np.where(np.random.RandomState(1).rand(2, 16) < 0.2,
+                       ids, -100)
+        nsp = np.array([[0], [1]], dtype="int64")
+        losses = {}
+        for fused in (False, True):
+            paddle.seed(5)
+            m = BertForPretraining(BertConfig.tiny(fused_qkv=fused))
+            m.eval()  # dropout off for the equivalence check
+            loss, _, _ = m(paddle.to_tensor(ids),
+                           masked_lm_labels=paddle.to_tensor(mlm),
+                           next_sentence_labels=paddle.to_tensor(nsp))
+            losses[fused] = float(loss)
+            assert any("q_proj" in n for n, _ in m.named_parameters())
+        np.testing.assert_allclose(losses[True], losses[False], rtol=1e-6)
