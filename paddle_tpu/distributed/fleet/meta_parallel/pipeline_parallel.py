@@ -85,8 +85,13 @@ class PipelineParallel(Layer):
                 self._backward_one(loss, m, scaler)
         else:
             # 1F1B: warmup fwds, steady 1F1B, cooldown bwds
-            # (reference: pipeline_parallel.py:229 — warmup = stages-1)
-            warmup = min(self.num_stages - 1, m)
+            # (reference: pipeline_parallel.py:229 — warmup = stages-1).
+            # Eager1F1B (reference pipeline_scheduler_pass Eager1F1B)
+            # warms up ONE forward deeper: one extra in-flight
+            # micro-batch per stage buys send/recv overlap
+            depth = self.num_stages if mode == "EAGER1F1B" \
+                else self.num_stages - 1
+            warmup = min(depth, m)
             pending: List[Tensor] = []
             for i in range(warmup):
                 pending.append(self._forward_micro(micros[i]))

@@ -23,7 +23,7 @@ from typing import Dict, List
 Task = namedtuple("Task", ["kind", "micro", "chunk"])
 
 __all__ = ["Task", "make_schedule", "fthenb_schedule", "one_f_one_b_schedule",
-           "vpp_schedule", "zbh1_schedule", "simulate"]
+           "eager_1f1b_schedule", "vpp_schedule", "zbh1_schedule", "simulate"]
 
 
 def fthenb_schedule(stage: int, num_stages: int, num_micro: int) -> List[Task]:
@@ -33,10 +33,9 @@ def fthenb_schedule(stage: int, num_stages: int, num_micro: int) -> List[Task]:
     ]
 
 
-def one_f_one_b_schedule(stage: int, num_stages: int, num_micro: int) -> List[Task]:
-    """Classic 1F1B (reference pipeline_parallel.py:229): warmup of
-    (num_stages - stage - 1) forwards, steady 1F1B, cooldown backwards."""
-    warmup = min(num_stages - stage - 1, num_micro)
+def _1f1b_core(warmup_depth: int, stage: int, num_micro: int) -> List[Task]:
+    """Shared 1F1B shape: warmup forwards, steady F+B, cooldown B."""
+    warmup = min(warmup_depth, num_micro)
     seq: List[Task] = [Task("F", m, stage) for m in range(warmup)]
     f_next, b_next = warmup, 0
     while b_next < num_micro:
@@ -46,6 +45,23 @@ def one_f_one_b_schedule(stage: int, num_stages: int, num_micro: int) -> List[Ta
         seq.append(Task("B", b_next, stage))
         b_next += 1
     return seq
+
+
+def one_f_one_b_schedule(stage: int, num_stages: int, num_micro: int) -> List[Task]:
+    """Classic 1F1B (reference pipeline_parallel.py:229): warmup of
+    (num_stages - stage - 1) forwards, steady 1F1B, cooldown backwards."""
+    return _1f1b_core(num_stages - stage - 1, stage, num_micro)
+
+
+def eager_1f1b_schedule(stage: int, num_stages: int,
+                        num_micro: int) -> List[Task]:
+    """Eager-1F1B (reference pipeline_scheduler_pass Eager1F1B): 1F1B
+    with a ONE-forward-deeper warmup per stage, so every stage holds one
+    extra in-flight micro-batch. The extra eager forward lets the stage
+    overlap its next forward with the neighbor's send/recv at the cost
+    of one more activation slot — same bubble as 1F1B, different
+    memory/overlap trade."""
+    return _1f1b_core(num_stages - stage, stage, num_micro)
 
 
 def vpp_schedule(stage: int, num_stages: int, num_micro: int, vpp: int) -> List[Task]:
@@ -114,6 +130,8 @@ def make_schedule(mode: str, stage: int, num_stages: int, num_micro: int,
         return fthenb_schedule(stage, num_stages, num_micro)
     if mode == "1F1B":
         return one_f_one_b_schedule(stage, num_stages, num_micro)
+    if mode == "EAGER1F1B":
+        return eager_1f1b_schedule(stage, num_stages, num_micro)
     if mode in ("VPP", "INTERLEAVED", "INTERLEAVED1F1B"):
         return vpp_schedule(stage, num_stages, num_micro, vpp)
     if mode in ("ZBH1", "ZEROBUBBLE"):

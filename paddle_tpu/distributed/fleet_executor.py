@@ -27,8 +27,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = [
     "TaskNode", "InterceptorMessage", "Interceptor", "ComputeInterceptor",
-    "SourceInterceptor", "SinkInterceptor", "CondInterceptor", "Carrier",
-    "MessageBus", "FleetExecutor",
+    "AmplifierInterceptor", "SourceInterceptor", "SinkInterceptor",
+    "CondInterceptor", "Carrier", "MessageBus", "FleetExecutor",
 ]
 
 # message types (interceptor_message.proto:20)
@@ -59,11 +59,19 @@ class TaskNode:
     task_id: int
     rank: int = 0
     max_run_times: int = 1      # number of micro-batches
-    role: str = "compute"       # compute | source | sink | cond
+    role: str = "compute"       # compute | source | sink | cond | amplifier
     run_fn: Optional[Callable[[int], object]] = None
     cond_fn: Optional[Callable[[int], bool]] = None
     upstreams: List[Tuple[int, int]] = field(default_factory=list)
     downstreams: List[Tuple[int, int]] = field(default_factory=list)
+    # amplifier knobs (amplifier_interceptor.h): decouple the op-run /
+    # downstream-send / upstream-reply cadences from the per-micro-batch
+    # tick — e.g. gradient accumulation runs the optimizer once per K
+    # micro-batches (run_per_steps=K) while replying credits every step
+    run_per_steps: int = 1
+    run_at_offset: int = 0
+    send_down_per_steps: int = 1
+    reply_up_per_steps: int = 1
 
     def add_upstream_task(self, task_id: int, buff_size: int = 2):
         self.upstreams.append((task_id, buff_size))
@@ -159,6 +167,46 @@ class ComputeInterceptor(Interceptor):
                 self.send(d, DATA_IS_READY, mb)
 
 
+class AmplifierInterceptor(ComputeInterceptor):
+    """Cadence-decoupled compute actor (amplifier_interceptor.cc): the
+    op runs only on steps where ``step % run_per_steps == run_at_offset``
+    and credits/data flow down/up only every ``send_down_per_steps`` /
+    ``reply_up_per_steps`` ticks. The reference uses it for gradient
+    accumulation and LR-scheduler tasks in pipeline programs, where one
+    stage advances at 1/K the micro-batch rate of its neighbors."""
+
+    def _try_run(self):
+        while self._can_run():
+            mb = self._step
+            if self.node.run_fn is not None and \
+                    mb % self.node.run_per_steps == self.node.run_at_offset:
+                self.node.run_fn(mb)
+            self._step += 1
+            # every tick consumes one upstream micro-batch and returns
+            # its credit (keeps upstream flowing at full rate) ...
+            for u in self._ready:
+                self._ready[u] -= 1
+                if self._step % self.node.reply_up_per_steps == 0:
+                    self.send(u, DATA_IS_USELESS, mb)
+            # ... but emits downstream only every send_down_per_steps
+            # ticks (K upstream micro-batches -> 1 downstream emission)
+            if self._step % self.node.send_down_per_steps == 0:
+                for d in self._credit:
+                    self._credit[d] -= 1
+                    self.send(d, DATA_IS_READY, mb)
+
+    def _can_run(self) -> bool:
+        if self._step >= self.node.max_run_times:
+            return False
+        if any(v <= 0 for v in self._ready.values()):
+            return False
+        # downstream credit only gates the ticks that will emit
+        if (self._step + 1) % self.node.send_down_per_steps == 0 and any(
+                v <= 0 for v in self._credit.values()):
+            return False
+        return True
+
+
 class SourceInterceptor(Interceptor):
     """Feeds max_run_times micro-batches downstream, throttled by buffer
     credits (source_interceptor.cc)."""
@@ -231,6 +279,7 @@ class CondInterceptor(Interceptor):
 
 _INTERCEPTOR_TYPES = {
     "compute": ComputeInterceptor,
+    "amplifier": AmplifierInterceptor,
     "source": SourceInterceptor,
     "sink": SinkInterceptor,
     "cond": CondInterceptor,

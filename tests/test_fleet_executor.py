@@ -271,3 +271,31 @@ class TestJobScope:
         c0.stop()
         c1.stop()
         assert [mb for t, mb in log if t == "sink"] == list(range(M))
+
+
+class TestAmplifierInterceptor:
+    """Cadence-decoupled actor (reference amplifier_interceptor.cc):
+    gradient-accumulation shape — the op fires once per K micro-batches
+    and the downstream sees 1/K the traffic."""
+
+    def test_runs_once_per_k_and_thins_downstream(self):
+        M, K = 8, 4
+        ran, sunk = [], []
+        src = TaskNode(task_id=0, role="source", max_run_times=M)
+        amp = TaskNode(task_id=1, role="amplifier", max_run_times=M,
+                       run_fn=lambda mb: ran.append(mb),
+                       run_per_steps=K, run_at_offset=K - 1,
+                       send_down_per_steps=K)
+        sink = TaskNode(task_id=2, role="sink", max_run_times=M // K,
+                        run_fn=lambda mb: sunk.append(mb))
+        src.add_downstream_task(1, 2)
+        amp.add_upstream_task(0, 2)
+        amp.add_downstream_task(2, 2)
+        sink.add_upstream_task(1, 2)
+        fe = FleetExecutor()
+        fe.init("c0", [src, amp, sink])
+        assert fe.run("c0", timeout=30)
+        # op ran on the K-1, 2K-1, ... micro-batches only
+        assert ran == [K - 1, 2 * K - 1]
+        # downstream saw M/K emissions
+        assert len(sunk) == M // K

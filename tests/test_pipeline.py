@@ -63,7 +63,7 @@ def test_shared_layer_desc_ties_weights():
     assert pipe.run_function[0] is pipe.run_function[2]
 
 
-@pytest.mark.parametrize("schedule", ["FThenB", "1F1B"])
+@pytest.mark.parametrize("schedule", ["FThenB", "1F1B", "Eager1F1B"])
 def test_pipeline_parallel_matches_plain_training(schedule):
     import paddle_tpu.optimizer as opt
     from paddle_tpu.distributed.fleet import DistributedStrategy

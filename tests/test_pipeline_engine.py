@@ -87,6 +87,7 @@ class TestPlanCompilation:
 class TestCompiledSchedulesMatchOracle:
     @pytest.mark.parametrize("schedule,vpp,M", [
         ("1f1b", 1, 8),
+        ("eager1f1b", 1, 8),
         ("fthenb", 1, 6),
         ("zbh1", 1, 8),
         ("vpp", 2, 8),

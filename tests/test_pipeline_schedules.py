@@ -17,7 +17,7 @@ from paddle_tpu.distributed.fleet.meta_parallel.pipeline_schedules import (
 
 
 class TestGenerators:
-    @pytest.mark.parametrize("mode", ["FThenB", "1F1B", "ZBH1"])
+    @pytest.mark.parametrize("mode", ["FThenB", "1F1B", "Eager1F1B", "ZBH1"])
     @pytest.mark.parametrize("pp,m", [(2, 4), (4, 8), (4, 4), (3, 6)])
     def test_complete_and_deadlock_free(self, mode, pp, m):
         streams = {s: make_schedule(mode, s, pp, m) for s in range(pp)}
@@ -187,3 +187,38 @@ class TestRuntimeEquivalence:
                 [paddle.to_tensor(x), paddle.to_tensor(y)], optimizer)
             losses.append(float(loss._value))
         assert losses[-1] < losses[0] * 0.7
+
+
+class TestEager1F1B:
+    def test_one_deeper_warmup_than_1f1b(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_schedules \
+            import eager_1f1b_schedule, one_f_one_b_schedule
+
+        pp, m = 4, 8
+        for st in range(pp):
+            eager = eager_1f1b_schedule(st, pp, m)
+            plain = one_f_one_b_schedule(st, pp, m)
+            first_b_eager = next(i for i, t in enumerate(eager)
+                                 if t.kind == "B")
+            first_b_plain = next(i for i, t in enumerate(plain)
+                                 if t.kind == "B")
+            # one extra eager forward before the first backward
+            assert first_b_eager == first_b_plain + 1, st
+
+    def test_warmup_saturates_at_num_micro(self):
+        """When m <= warmup depth the eager warmup caps at m: the first
+        backward lands at min(depth, m) + (1 if a steady F remains)."""
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_schedules \
+            import eager_1f1b_schedule, one_f_one_b_schedule, simulate
+
+        pp, m = 4, 4
+        # stage 0: eager warmup = min(4, 4) = 4 = ALL micro-batches ->
+        # the first B comes straight after, same index as plain 1F1B's
+        # warmup-3 + one steady F
+        eager = eager_1f1b_schedule(0, pp, m)
+        plain = one_f_one_b_schedule(0, pp, m)
+        fb = next(i for i, t in enumerate(eager) if t.kind == "B")
+        assert fb == next(i for i, t in enumerate(plain) if t.kind == "B")
+        # still a valid, deadlock-free stream
+        streams = {s_: eager_1f1b_schedule(s_, pp, m) for s_ in range(pp)}
+        assert simulate(streams, pp, m)["makespan"] > 0
