@@ -175,6 +175,15 @@ class AmplifierInterceptor(ComputeInterceptor):
     accumulation and LR-scheduler tasks in pipeline programs, where one
     stage advances at 1/K the micro-batch rate of its neighbors."""
 
+    def __init__(self, interceptor_id, node):
+        if not 0 <= node.run_at_offset < node.run_per_steps:
+            raise ValueError(
+                f"amplifier task {node.task_id}: run_at_offset "
+                f"({node.run_at_offset}) must lie in [0, run_per_steps="
+                f"{node.run_per_steps}) or run_fn would never fire")
+        super().__init__(interceptor_id, node)
+        self._owed: Dict[int, int] = {}   # consumed-but-unreplied credits
+
     def _try_run(self):
         while self._can_run():
             mb = self._step
@@ -182,13 +191,19 @@ class AmplifierInterceptor(ComputeInterceptor):
                     mb % self.node.run_per_steps == self.node.run_at_offset:
                 self.node.run_fn(mb)
             self._step += 1
-            # every tick consumes one upstream micro-batch and returns
-            # its credit (keeps upstream flowing at full rate) ...
+            # every tick consumes one upstream micro-batch; credits are
+            # BATCHED and flushed on the reply cadence (all owed at
+            # once — returning only one would drain upstream credit and
+            # deadlock any reply_up_per_steps > 1)
             for u in self._ready:
                 self._ready[u] -= 1
-                if self._step % self.node.reply_up_per_steps == 0:
-                    self.send(u, DATA_IS_USELESS, mb)
-            # ... but emits downstream only every send_down_per_steps
+                self._owed[u] = self._owed.get(u, 0) + 1
+            if self._step % self.node.reply_up_per_steps == 0:
+                for u, owed in self._owed.items():
+                    for _ in range(owed):
+                        self.send(u, DATA_IS_USELESS, mb)
+                self._owed.clear()
+            # ... and emits downstream only every send_down_per_steps
             # ticks (K upstream micro-batches -> 1 downstream emission)
             if self._step % self.node.send_down_per_steps == 0:
                 for d in self._credit:

@@ -299,3 +299,30 @@ class TestAmplifierInterceptor:
         assert ran == [K - 1, 2 * K - 1]
         # downstream saw M/K emissions
         assert len(sunk) == M // K
+
+    def test_reply_cadence_batches_owed_credits(self):
+        """reply_up_per_steps=2 must flush ALL owed upstream credits on
+        the reply tick — returning one per reply would drain the
+        upstream buffer and deadlock (round-4 review finding)."""
+        M, R = 8, 2
+        sunk = []
+        src = TaskNode(task_id=0, role="source", max_run_times=M)
+        amp = TaskNode(task_id=1, role="amplifier", max_run_times=M,
+                       reply_up_per_steps=R)
+        sink = TaskNode(task_id=2, role="sink", max_run_times=M,
+                        run_fn=lambda mb: sunk.append(mb))
+        src.add_downstream_task(1, 2)
+        amp.add_upstream_task(0, 2)
+        amp.add_downstream_task(2, 2)
+        sink.add_upstream_task(1, 2)
+        fe = FleetExecutor()
+        fe.init("c0", [src, amp, sink])
+        assert fe.run("c0", timeout=30)
+        assert len(sunk) == M
+
+    def test_invalid_offset_rejected(self):
+        amp = TaskNode(task_id=1, role="amplifier", max_run_times=4,
+                       run_per_steps=4, run_at_offset=4)
+        fe = FleetExecutor()
+        with pytest.raises(ValueError, match="run_at_offset"):
+            fe.init("c0", [amp])

@@ -1,0 +1,89 @@
+"""GEMM width-scaling calibration on this chip.
+
+Measures achieved TF/s of bf16 ``[M, K] x [K, W]`` as the output width
+W varies — the curve that explains most single-chip MFU differences in
+this repo (llama 0.695 at W=5632 FFN widths vs MoE 0.546 at W=1408
+expert widths vs resnet 0.131 at conv-class widths), feeds the
+auto-tuner's cost model (distributed/auto_tuner width_efficiency), and
+motivated the measured-null experiments recorded in
+models/llama.py (fused_qkv) and incubate .../moe/moe_layer.py (swiglu).
+
+MEASURED RECORD (v5e, bf16, M=16384, K=2048, 50-iter carry-chained
+scan, round-3, reproduced by this tool):
+
+    W=5632 -> 115 TF/s      W=2816 -> 72      W=1536 -> 59
+    W=1408 -> 49            (single digits at conv-class widths)
+
+Protocol notes (hard-won, see memory of rounds 2-3):
+- ALWAYS carry-chain the iterations inside one ``lax.scan`` — timing a
+  Python loop of independent matmuls lets XLA hoist the op out of the
+  loop and reports fantasy numbers;
+- >= 30 iterations, because the tunneled per-call latency (~1s) must be
+  amortized; use ``--iters`` to raise further on a flaky tunnel;
+- a driving shell should give each width its own process/timeout — the
+  remote-compile tunnel occasionally hangs (HTTP 500 / broken pipe).
+
+Run: python tools/gemm_width_calibration.py [--widths 1408,2816,5632]
+[--m 16384] [--k 2048] [--iters 50]
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def measure_width(m: int, k: int, w: int, iters: int) -> float:
+    """Achieved TF/s of [m,k]x[k,w] bf16, carry-chained over iters."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (m, k), jnp.bfloat16)
+    a = jax.random.normal(key, (k, w), jnp.bfloat16)
+    b = jax.random.normal(key, (w, k), jnp.bfloat16) * 0.01
+
+    def body(carry, _):
+        # carry-chain through BOTH matmuls so no iteration is hoistable;
+        # the [w,k] bounce keeps the operand of interest at width w
+        h = jnp.dot(carry, a, preferred_element_type=jnp.bfloat16)
+        return jnp.dot(h, b, preferred_element_type=jnp.bfloat16), ()
+
+    @jax.jit
+    def run(x0):
+        out, _ = lax.scan(body, x0, None, length=iters)
+        return out
+
+    run(x).block_until_ready()          # compile
+    t0 = time.perf_counter()
+    out = run(x)
+    np.asarray(out[0, 0])               # full sync through the tunnel
+    dt = time.perf_counter() - t0
+    flops = 2.0 * m * k * w * iters + 2.0 * m * w * k * iters
+    return flops / dt / 1e12
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--widths", default="1408,1536,2816,5632")
+    ap.add_argument("--m", type=int, default=16384)
+    ap.add_argument("--k", type=int, default=2048)
+    ap.add_argument("--iters", type=int, default=50)
+    args = ap.parse_args()
+
+    import jax
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    print(f"# device: {jax.devices()[0]}, "
+          f"{'REAL accelerator' if on_tpu else 'CPU (numbers meaningless)'}")
+    print(f"# [M={args.m}, K={args.k}] x [K, W] bf16, "
+          f"{args.iters}-iter carry-chained scan")
+    for w in (int(s) for s in args.widths.split(",")):
+        tf = measure_width(args.m, args.k, w, args.iters)
+        print(f"W={w:<6d} {tf:7.1f} TF/s")
+
+
+if __name__ == "__main__":
+    main()
