@@ -5,6 +5,7 @@ Reference: the auto-parallel Llama fixture
 paddle.vision.models. The LLM families live here; vision models under
 paddle_tpu.vision.models.
 """
+from .generation import generate
 from .llama import (
     LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaDecoderLayer,
     LlamaAttention, LlamaMLP, llama_shard_plan,

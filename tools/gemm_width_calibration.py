@@ -8,16 +8,29 @@ auto-tuner's cost model (distributed/auto_tuner width_efficiency), and
 motivated the measured-null experiments recorded in
 models/llama.py (fused_qkv) and incubate .../moe/moe_layer.py (swiglu).
 
-MEASURED RECORD (v5e, bf16, M=16384, K=2048, 50-iter carry-chained
-scan, round-3, reproduced by this tool):
+MEASURED RECORDS (v5e, bf16, M=16384, K=2048):
 
-    W=5632 -> 115 TF/s      W=2816 -> 72      W=1536 -> 59
-    W=1408 -> 49            (single digits at conv-class widths)
+    round-3 harness (bounce-chained pair, counts both GEMMs):
+        W=5632 -> 115 TF/s   W=2816 -> 72   W=1536 -> 59   W=1408 -> 49
+    this tool (pool-of-8 cycled inputs, single GEMM, 2026-07-31):
+        W=5632 -> 68         W=2816 -> ~43  W=1536 -> ~28  W=1408 -> 34
 
-Protocol notes (hard-won, see memory of rounds 2-3):
-- ALWAYS carry-chain the iterations inside one ``lax.scan`` — timing a
-  Python loop of independent matmuls lets XLA hoist the op out of the
-  loop and reports fantasy numbers;
+ABSOLUTE TF/s is protocol-dependent (the bounce variant amortizes
+operand traffic across two GEMMs; this tool streams a fresh [M,K]
+per iteration). The LOAD-BEARING, protocol-INVARIANT fact is the
+monotone collapse with output width — 2-2.9x between W=5632 and
+W=1408 across protocols, 2.3x in the round-3 record — which is what the auto-tuner's
+width_efficiency ranking and the MoE/conv ceiling analyses consume
+(all relative). Single digits at conv-class widths under every
+protocol tried.
+
+Protocol notes (hard-won, see rounds 2-4):
+- NEVER time independent iterations inside one jit without data
+  dependence or per-iter inputs: XLA hoists/CSEs the op and reports
+  fantasy numbers (a multiply-by-zero dependency gets folded too —
+  183 "TF/s" was measured that way);
+- a bounce-chain ([K,W] then [W,K]) measures the PAIR and goes
+  pathological at some widths (6 TF/s at W=1408);
 - >= 30 iterations, because the tunneled per-call latency (~1s) must be
   amortized; use ``--iters`` to raise further on a flaky tunnel;
 - a driving shell should give each width its own process/timeout — the
@@ -41,27 +54,35 @@ def measure_width(m: int, k: int, w: int, iters: int) -> float:
     from jax import lax
 
     key = jax.random.PRNGKey(0)
-    x = jax.random.normal(key, (m, k), jnp.bfloat16)
+    # DISTINCT input per iteration, consumed by lax.scan: XLA cannot
+    # hoist or CSE any matmul (each sees fresh data), and no auxiliary
+    # GEMM pollutes the number (an earlier [w,k] bounce-chain variant
+    # measured pathological at some widths). The per-iter max-reduction
+    # keeps only a scalar live; its cost is O(m*w) reads ≪ 2*m*k*w.
+    # A small cycled POOL (not one buffer per iteration) keeps HBM
+    # bounded however high --iters goes on a flaky tunnel.
+    pool = 8
+    xs = jax.random.normal(key, (pool, m, k), jnp.bfloat16)
     a = jax.random.normal(key, (k, w), jnp.bfloat16)
-    b = jax.random.normal(key, (w, k), jnp.bfloat16) * 0.01
-
-    def body(carry, _):
-        # carry-chain through BOTH matmuls so no iteration is hoistable;
-        # the [w,k] bounce keeps the operand of interest at width w
-        h = jnp.dot(carry, a, preferred_element_type=jnp.bfloat16)
-        return jnp.dot(h, b, preferred_element_type=jnp.bfloat16), ()
 
     @jax.jit
-    def run(x0):
-        out, _ = lax.scan(body, x0, None, length=iters)
-        return out
+    def run(xs_in):
+        global_idx = jnp.arange(iters) % pool
 
-    run(x).block_until_ready()          # compile
+        def body(carry, idx):
+            h = jnp.dot(xs_in[idx], a,
+                        preferred_element_type=jnp.bfloat16)
+            return carry, jnp.max(h)
+
+        _, outs = lax.scan(body, jnp.bfloat16(0.0), global_idx)
+        return outs
+
+    run(xs).block_until_ready()         # compile
     t0 = time.perf_counter()
-    out = run(x)
-    np.asarray(out[0, 0])               # full sync through the tunnel
+    out = run(xs)
+    np.asarray(out)                     # full sync through the tunnel
     dt = time.perf_counter() - t0
-    flops = 2.0 * m * k * w * iters + 2.0 * m * w * k * iters
+    flops = 2.0 * m * k * w * iters
     return flops / dt / 1e12
 
 
