@@ -107,25 +107,134 @@ def _cached_forward(p, tokens, caches, pos, s_max):
              + rotate_half(q.astype(jnp.float32), True) * sin).astype(dtype)
         k = (k.astype(jnp.float32) * cos
              + rotate_half(k.astype(jnp.float32), True) * sin).astype(dtype)
-        ck, cv = cache
-        ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
-        new_caches.append((ck, cv))
-        kk = jnp.repeat(ck, nh // nkv, axis=2)        # GQA expand
-        vv = jnp.repeat(cv, nh // nkv, axis=2)
-        logits = jnp.einsum("bthd,bshd->bhts", q, kk,
-                            preferred_element_type=jnp.float32)
-        logits = logits * (dh ** -0.5)
-        logits = jnp.where(visible[None, None, :, :], logits,
-                           jnp.float32(-1e30))
-        attn = jax.nn.softmax(logits, axis=-1).astype(dtype)
-        ctx = jnp.einsum("bhts,bshd->bthd", attn, vv).reshape(b, t, -1)
+        ctx, cache = _cached_attention(q, k, v, cache, pos, visible,
+                                       nh // nkv)
+        new_caches.append(cache)
         x = x + ctx @ lp["wo"]
         h = rms(x, lp["ln2"])
         ffn = (jax.nn.silu((h @ lp["wg"]).astype(jnp.float32)).astype(dtype)
                * (h @ lp["wu"])) @ lp["wd"]
         x = x + ffn
     return rms(x, p["norm"])[:, -1, :], new_caches
+
+
+def _gpt_decode_params(model):
+    """GPT-family views: learned positions, pre-LN, fused qkv, GELU."""
+    cfg = model.config
+    layers = []
+    for layer in model.gpt.layers:
+        a = layer.attn
+        layers.append(dict(
+            ln1_w=layer.norm1.weight._value, ln1_b=layer.norm1.bias._value,
+            wqkv=a.qkv_proj.weight._value, bqkv=a.qkv_proj.bias._value,
+            wo=a.out_proj.weight._value, bo=a.out_proj.bias._value,
+            ln2_w=layer.norm2.weight._value, ln2_b=layer.norm2.bias._value,
+            w1=layer.linear1.weight._value, b1=layer.linear1.bias._value,
+            w2=layer.linear2.weight._value, b2=layer.linear2.bias._value,
+        ))
+    out = dict(
+        embed=model.gpt.wte.weight._value,
+        wpe=model.gpt.wpe.weight._value,
+        normf_w=model.gpt.norm_f.weight._value,
+        normf_b=model.gpt.norm_f.bias._value,
+        layers=layers,
+        nh=cfg.num_attention_heads, nkv=cfg.num_attention_heads,
+        dh=cfg.hidden_size // cfg.num_attention_heads,
+        eps=cfg.layer_norm_eps,
+        # tied head: logits = hidden @ embed.T computed in-graph (a
+        # materialized transpose would duplicate [V, H] on device)
+        tied_head=bool(cfg.tie_word_embeddings),
+        max_positions=int(cfg.max_position_embeddings),
+    )
+    if not cfg.tie_word_embeddings:
+        out["head"] = model.lm_head.weight._value
+    return out
+
+
+def _gpt_cached_forward(p, tokens, caches, pos, s_max):
+    """GPT block stack with a dense KV cache (pre-LN, learned
+    positions); same contract as the llama `_cached_forward`."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    b, t = tokens.shape
+    nh, dh = p["nh"], p["dh"]
+    positions = pos + jnp.arange(t)
+    x = jnp.take(p["embed"], tokens, axis=0) \
+        + jnp.take(p["wpe"], positions, axis=0)[None, :, :]
+    dtype = x.dtype
+
+    def ln(h, g, bb):
+        h32 = h.astype(jnp.float32)
+        mu = jnp.mean(h32, axis=-1, keepdims=True)
+        var = jnp.mean((h32 - mu) ** 2, axis=-1, keepdims=True)
+        y = (h32 - mu) * lax.rsqrt(var + p["eps"])
+        return (y * g.astype(jnp.float32)
+                + bb.astype(jnp.float32)).astype(dtype)
+
+    slot = jnp.arange(s_max)[None, :]
+    visible = slot <= (pos + jnp.arange(t))[:, None]
+
+    new_caches = []
+    for lp, cache in zip(p["layers"], caches):
+        h = ln(x, lp["ln1_w"], lp["ln1_b"])
+        qkv = (h @ lp["wqkv"] + lp["bqkv"]).reshape(b, t, 3, nh, dh)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        ctx, cache = _cached_attention(q, k, v, cache, pos, visible, 1)
+        new_caches.append(cache)
+        x = x + ctx @ lp["wo"] + lp["bo"]
+        h = ln(x, lp["ln2_w"], lp["ln2_b"])
+        ffn = jax.nn.gelu(
+            (h @ lp["w1"] + lp["b1"]).astype(jnp.float32),
+            approximate=False).astype(dtype) @ lp["w2"] + lp["b2"]
+        x = x + ffn
+    return ln(x, p["normf_w"], p["normf_b"])[:, -1, :], new_caches
+
+
+def _decode_family(model):
+    """(params, cached_forward) for a supported causal-LM family."""
+    if hasattr(model, "llama"):
+        return _llama_decode_params(model), _cached_forward
+    if hasattr(model, "gpt"):
+        return _gpt_decode_params(model), _gpt_cached_forward
+    raise TypeError(
+        f"generate() supports the Llama and GPT families; got "
+        f"{type(model).__name__}")
+
+
+def _head_logits(p, hidden):
+    """LM-head logits; tied heads reuse the embedding in-graph."""
+    if p.get("tied_head"):
+        return hidden @ p["embed"].T
+    return hidden @ p["head"]
+
+
+def _cached_attention(q, k, v, cache, pos, visible, n_rep):
+    """Shared cache-update + masked-softmax attention core: writes the
+    new k/v at ``pos``, expands GQA kv heads by ``n_rep``, returns
+    (context [B, T, nh*dh], updated cache). One implementation for
+    every decode family so the mask/softmax/scale semantics can't
+    drift."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    b, t = q.shape[:2]
+    dh = q.shape[-1]
+    ck, cv = cache
+    ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+    kk = jnp.repeat(ck, n_rep, axis=2) if n_rep > 1 else ck
+    vv = jnp.repeat(cv, n_rep, axis=2) if n_rep > 1 else cv
+    logits = jnp.einsum("bthd,bshd->bhts", q, kk,
+                        preferred_element_type=jnp.float32)
+    logits = logits * (dh ** -0.5)
+    logits = jnp.where(visible[None, None, :, :], logits,
+                       jnp.float32(-1e30))
+    attn = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    ctx = jnp.einsum("bhts,bshd->bthd", attn, vv).reshape(b, t, -1)
+    return ctx, (ck, cv)
 
 
 def _sample_token(logits, key, *, do_sample, temperature, top_k, top_p):
@@ -156,7 +265,8 @@ def generate(model, input_ids, max_new_tokens: int = 32,
              do_sample: bool = False, temperature: float = 1.0,
              top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None, seed: int = 0):
-    """Decode ``max_new_tokens`` from a ``LlamaForCausalLM`` with a
+    """Decode ``max_new_tokens`` from a Llama- or GPT-family causal
+    LM with a
     dense KV cache; the whole loop is ONE jitted scan. Returns
     ``[B, prompt_len + max_new_tokens]`` (prompt included); positions
     after an emitted ``eos_token_id`` are filled with eos."""
@@ -172,23 +282,31 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     b, t0 = ids.shape
     if max_new_tokens <= 0:
         return Tensor._from_value(ids)
-    p = _llama_decode_params(model)
+    p, fwd = _decode_family(model)
     s_max = t0 + max_new_tokens
+    max_pos = p.get("max_positions")
+    if max_pos is not None and s_max > max_pos:
+        raise ValueError(
+            f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) = "
+            f"{s_max} exceeds the learned position table "
+            f"(max_position_embeddings={max_pos}); jnp.take would "
+            f"silently clamp and repeat the last position embedding")
     nkv, dh, L = p["nkv"], p["dh"], len(p["layers"])
     dtype = p["embed"].dtype
     eos = -1 if eos_token_id is None else int(eos_token_id)
-    # split params: ARRAYS ride as jit arguments (a pytree), the scalar
-    # config (head counts etc.) stays static — shapes depend on it
-    static_cfg = {k: p[k] for k in ("nh", "nkv", "dh", "eps", "theta")}
-    arrays = {k: p[k] for k in ("embed", "norm", "head", "layers")}
+    # non-array scalars are STATIC (shapes depend on them); everything
+    # array-valued rides as a jit argument
+    static_cfg = {k: v for k, v in p.items()
+                  if not hasattr(v, "dtype") and not isinstance(v, list)}
+    arrays = {k: v for k, v in p.items() if k not in static_cfg}
 
     def _run(arrs, ids, key):
         p = {**arrs, **static_cfg}
         caches = [(jnp.zeros((b, s_max, nkv, dh), dtype),
                    jnp.zeros((b, s_max, nkv, dh), dtype))
                   for _ in range(L)]
-        hidden, caches = _cached_forward(p, ids, caches, 0, s_max)
-        logits0 = hidden @ p["head"]
+        hidden, caches = fwd(p, ids, caches, 0, s_max)
+        logits0 = _head_logits(p, hidden)
         key, sub = jax.random.split(key)
         tok0 = _sample_token(logits0, sub, do_sample=do_sample,
                              temperature=temperature, top_k=top_k,
@@ -204,9 +322,9 @@ def generate(model, input_ids, max_new_tokens: int = 32,
             # review, pinned by the multi-token oracle test)
             tok, done, key, *flat = carry
             caches_ = [(flat[2 * j], flat[2 * j + 1]) for j in range(L)]
-            hidden, caches_ = _cached_forward(
+            hidden, caches_ = fwd(
                 p, tok[:, None], caches_, t0 + i - 1, s_max)
-            logits = hidden @ p["head"]
+            logits = _head_logits(p, hidden)
             key, sub = jax.random.split(key)
             nxt = _sample_token(logits, sub, do_sample=do_sample,
                                 temperature=temperature, top_k=top_k,

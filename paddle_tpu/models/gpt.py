@@ -129,6 +129,19 @@ class GPTForCausalLM(nn.Layer):
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
 
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id=None, seed: int = 0):
+        """KV-cache incremental decoding — one jitted lax.scan over a
+        dense cache (models/generation.py, same driver as Llama)."""
+        from .generation import generate as _generate
+
+        return _generate(self, input_ids, max_new_tokens=max_new_tokens,
+                         do_sample=do_sample, temperature=temperature,
+                         top_k=top_k, top_p=top_p,
+                         eos_token_id=eos_token_id, seed=seed)
+
     def forward(self, input_ids, position_ids=None, labels=None):
         import paddle_tpu as paddle
 

@@ -173,3 +173,60 @@ class TestSampling:
         with pytest.raises(ValueError, match="batch"):
             model.generate(paddle.to_tensor(
                 np.array([1, 2, 3], dtype="int64")), max_new_tokens=2)
+
+
+class TestGPTGeneration:
+    """The family dispatch: GPT (learned positions, pre-LN, fused qkv,
+    tied/untied head) decodes through the same single-jit scan."""
+
+    def _gpt(self, tie=False):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        paddle.seed(4)
+        cfg = GPTConfig.tiny(vocab_size=89, hidden_size=32,
+                             num_hidden_layers=2, num_attention_heads=4,
+                             intermediate_size=64,
+                             max_position_embeddings=64,
+                             tie_word_embeddings=tie,
+                             hidden_dropout_prob=0.0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        return m
+
+    @pytest.mark.parametrize("tie", [False, True])
+    def test_multi_token_matches_oracle(self, tie):
+        model = self._gpt(tie)
+        rng = np.random.RandomState(2)
+        ids = rng.randint(0, 89, (2, 6)).astype("int64")
+        n_new = 6
+        want = _oracle_greedy(model, ids, n_new)
+        got = model.generate(paddle.to_tensor(ids),
+                             max_new_tokens=n_new).numpy()
+        assert got.shape == (2, 6 + n_new)
+        walk = ids.copy()
+        for step in range(n_new):
+            logits = model(paddle.to_tensor(walk)).numpy()[:, -1]
+            srt = np.sort(logits, -1)
+            clear = (srt[:, -1] - srt[:, -2]) > 0.05
+            pos = 6 + step
+            if clear.any():
+                np.testing.assert_array_equal(
+                    got[clear, pos], want[clear, pos],
+                    err_msg=f"token {step} (clear margin)")
+            walk = want[:, :pos + 1]
+
+    def test_unsupported_family_rejected(self):
+        from paddle_tpu.models import BertConfig, BertForPretraining
+
+        m = BertForPretraining(BertConfig.tiny())
+        from paddle_tpu.models.generation import generate
+
+        with pytest.raises(TypeError, match="families"):
+            generate(m, np.array([[1, 2]], dtype="int64"),
+                     max_new_tokens=2)
+
+    def test_position_table_overflow_rejected(self):
+        model = self._gpt()
+        ids = np.zeros((1, 60), dtype="int64")
+        with pytest.raises(ValueError, match="position"):
+            model.generate(paddle.to_tensor(ids), max_new_tokens=32)
