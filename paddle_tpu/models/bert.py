@@ -125,7 +125,9 @@ class BertSelfAttention(nn.Layer):
             v = self.v_proj(x).reshape(
                 [b, s, self.num_heads, self.head_dim])
         out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attention_mask, is_causal=False)
+            q, k, v, attn_mask=attention_mask,
+            dropout_p=self.config.attention_probs_dropout_prob,
+            is_causal=False, training=self.training)
         return self.dropout(self.out_proj(out.reshape([b, s, h])))
 
 

@@ -83,8 +83,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  name=None):
     """paddle.nn.functional.scaled_dot_product_attention parity
     (flash_attention.py:991). Input layout [B, S, H, D]. Dropout applies to
-    the attention weights, matching the reference; a nonzero rate routes to
-    the XLA path (the Pallas kernel has no RNG plumbing yet)."""
+    the attention weights, matching the reference; the Pallas kernel
+    regenerates the dropout mask in-kernel from a counter RNG, so a nonzero
+    rate stays on the flash path (the masked path is still XLA)."""
     from ...core import generator
 
     q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
@@ -94,10 +95,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if attn_mask is not None:
         out = apply("sdpa_mask_p", q, k, v, ensure_tensor(attn_mask), rng,
                     scale=scale, dropout_p=p)
-    elif _use_pallas(q, k) and p == 0.0:
+    elif _use_pallas(q, k) and p < 1.0:
+        # p == 1.0 would need 1/(1-p) rescale in-kernel; the XLA path
+        # already produces the exact all-zero output for it
         from ...ops.pallas.flash_attention import flash_attention_fused
 
-        out = flash_attention_fused(q, k, v, causal=bool(is_causal), scale=scale)
+        out = flash_attention_fused(q, k, v, causal=bool(is_causal),
+                                    scale=scale, dropout_p=p, rng=rng)
     else:
         out = apply("sdpa_p", q, k, v, rng, causal=bool(is_causal),
                     scale=scale, dropout_p=p)

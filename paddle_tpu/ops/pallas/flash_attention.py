@@ -33,6 +33,41 @@ Z = __import__("numpy").int32(0)  # index-map literal: stays i32 under jax_enabl
 LANES = 128  # lse/delta lane padding (TPU (8,128) tiling; see _fwd_kernel)
 
 
+def _c32(u):
+    """uint32 literal as a wrapping int32 constant."""
+    import numpy as np
+
+    return jnp.int32(np.uint32(u).astype(np.int32))
+
+
+def _dropout_keep(seed, bh, i, j, block_q, block_k, rate):
+    """Counter-based attention-dropout mask for the (i, j) tile of head bh.
+
+    P(keep) = 1 - rate. murmur3-style int32 mixing over
+    (seed, batch*head, global row, global col) — pure vector int ops, so
+    the SAME bits regenerate in the forward and both backward kernels
+    (their grids visit the same (b, h, i, j) tiles) and under
+    ``interpret=True`` (``pltpu.prng_*`` has no interpret lowering).
+    Reference semantics: dropout on the softmax WEIGHTS
+    (flash_attention.py:991 attn_dropout), denominator excluded.
+    """
+    rows = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    x = (rows * _c32(0x9E3779B1)) ^ (cols * _c32(0x85EBCA77))
+    x = x ^ (bh * _c32(0xC2B2AE3D)) ^ seed
+    shr = lambda a, n: jax.lax.shift_right_logical(a, jnp.int32(n))
+    x = x ^ shr(x, 16)
+    x = x * _c32(0x85EBCA6B)
+    x = x ^ shr(x, 13)
+    x = x * _c32(0xC2B2AE35)
+    x = x ^ shr(x, 16)
+    thresh = jnp.int32(int(min(float(rate), 1.0) * 2147483647.0))
+    keep = (x & _c32(0x7FFFFFFF)) >= thresh
+    return keep.astype(jnp.float32)
+
+
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -62,12 +97,21 @@ def _kv_head_map(g: int):
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, block_q, block_k, nk, offset):
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, nk, offset,
+                rate, n_heads):
     # offset = Sk - Sq: bottom-right-aligned causal mask (query i attends
     # keys <= i + offset), matching paddle/XLA semantics for Sq != Sk
+    if rate > 0.0:
+        (q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        seed_ref = None
     i = pl.program_id(2)
     j = pl.program_id(3)
+    # hoisted: pl.program_id is not available inside a pl.when body under
+    # interpret mode
+    bh = pl.program_id(0) * n_heads + pl.program_id(1)
 
     @pl.when(j == 0)
     def _init():
@@ -98,8 +142,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         alpha = jnp.exp(m_prev - m_eff)  # exp(-inf)=0 for first visit
         p = jnp.exp(s - m_eff)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        if rate > 0.0:
+            # softmax denominator (l) stays over the UNDROPPED weights;
+            # only the value accumulation sees the mask (post-softmax
+            # dropout semantics, matching the XLA oracle path)
+            keep = _dropout_keep(seed_ref[0], bh, i, j, block_q, block_k,
+                                 rate)
+            p_use = p * keep * (1.0 / (1.0 - rate))
+        else:
+            p_use = p
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p_use, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -125,9 +179,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale"))
-def _flash_fwd_bhsd(q, k, v, *, causal, scale):
-    """q: [B,H,Sq,D]; k,v: [B,Hkv,Sk,D] -> (out [B,H,Sq,D], lse [B,H,Sq])."""
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "scale", "dropout_rate"))
+def _flash_fwd_bhsd(q, k, v, seed=None, *, causal, scale, dropout_rate=0.0):
+    """q: [B,H,Sq,D]; k,v: [B,Hkv,Sk,D] -> (out [B,H,Sq,D], lse [B,H,Sq]).
+    seed: int32 [1] dropout seed, required when dropout_rate > 0."""
     B, H, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     g = H // Hkv
@@ -138,17 +194,24 @@ def _flash_fwd_bhsd(q, k, v, *, causal, scale):
     grid = (B, H, nq, nk)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nk=nk, offset=Sk - Sq)
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
+        block_q=block_q, block_k=block_k, nk=nk, offset=Sk - Sq,
+        rate=dropout_rate, n_heads=H)
+    in_specs = [
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, Z)),
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, i, j: (b, kv_head(h), j, Z)),
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, i, j: (b, kv_head(h), j, Z)),
-        ],
+    ]
+    inputs = [q, k, v]
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec((1,), lambda b, h, i, j: (Z,),
+                                  memory_space=pltpu.SMEM))
+        inputs.append(seed)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, Z)),
             pl.BlockSpec((1, 1, block_q, LANES),
@@ -173,17 +236,27 @@ def _flash_fwd_bhsd(q, k, v, *, causal, scale):
             transcendentals=B * H * Sq * Sk,
         ),
         interpret=_interpret(),
-    )(q, k, v)
+    )(*inputs)
     return out, lse[:, :, :, 0]
 
 
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, block_q, block_k, nk, offset):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, nk, offset,
+                   rate, n_heads):
+    if rate > 0.0:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
+        seed_ref = None
     i = pl.program_id(2)
     j = pl.program_id(3)
+    # hoisted: pl.program_id is not available inside a pl.when body under
+    # interpret mode
+    bh = pl.program_id(0) * n_heads + pl.program_id(1)
 
     @pl.when(j == 0)
     def _init():
@@ -209,6 +282,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse_safe)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            # d/ds of out = (keep∘c∘softmax(s)) @ v with the softmax
+            # denominator undropped: ds_j = p_j (keep_j c dp_j - delta),
+            # delta = rowsum(do∘o) (absorbs the Σ p·dp term exactly)
+            keep = _dropout_keep(seed_ref[0], bh, i, j, block_q, block_k,
+                                 rate)
+            dp = dp * keep * (1.0 / (1.0 - rate))
         ds = p * (dp - delta) * scale
         dq_scr[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -225,11 +305,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, block_q, block_k, nq, offset):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, nq, offset,
+                    rate, n_heads):
+    if rate > 0.0:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        seed_ref = None
     j = pl.program_id(2)  # k block
     i = pl.program_id(3)  # q block (innermost: accumulate over q)
+    bh = pl.program_id(0) * n_heads + pl.program_id(1)
 
     @pl.when(i == 0)
     def _init():
@@ -254,11 +341,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(rows + offset >= cols, s, NEG_INF)
         lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
         p = jnp.exp(s - lse_safe)
-        # dV += P^T dO
+        if rate > 0.0:
+            # same (b, h, i, j) tile bits as fwd/dq — note i is pid 3 here
+            keep = _dropout_keep(seed_ref[0], bh, i, j, block_q, block_k,
+                                 rate)
+            p_drop = p * keep * (1.0 / (1.0 - rate))
+        else:
+            p_drop = p
+        # dV += (keep∘c∘P)^T dO
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p_drop, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            dp = dp * keep * (1.0 / (1.0 - rate))
         ds = p * (dp - delta) * scale
         # dK += dS^T Q
         dk_scr[:] += jax.lax.dot_general(
@@ -277,8 +374,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale"))
-def _flash_bwd_bhsd(q, k, v, out, lse, do, *, causal, scale):
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "scale", "dropout_rate"))
+def _flash_bwd_bhsd(q, k, v, out, lse, do, seed=None, *, causal, scale,
+                    dropout_rate=0.0):
     B, H, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     g = H // Hkv
@@ -293,22 +392,29 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, *, causal, scale):
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nk=nk, offset=Sk - Sq)
+        block_q=block_q, block_k=block_k, nk=nk, offset=Sk - Sq,
+        rate=dropout_rate, n_heads=H)
+    dq_in_specs = [
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, Z)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, kv_head(h), j, Z)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, kv_head(h), j, Z)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, Z)),
+            pl.BlockSpec((1, 1, block_q, LANES),
+                         lambda b, h, i, j: (b, h, i, Z)),
+            pl.BlockSpec((1, 1, block_q, LANES),
+                         lambda b, h, i, j: (b, h, i, Z)),
+    ]
+    dq_inputs = [q, k, v, do, lse, delta]
+    if dropout_rate > 0.0:
+        dq_in_specs.append(pl.BlockSpec((1,), lambda b, h, i, j: (Z,),
+                                  memory_space=pltpu.SMEM))
+        dq_inputs.append(seed)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, Z)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, i, j: (b, kv_head(h), j, Z)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, i, j: (b, kv_head(h), j, Z)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, Z)),
-            pl.BlockSpec((1, 1, block_q, LANES),
-                         lambda b, h, i, j: (b, h, i, Z)),
-            pl.BlockSpec((1, 1, block_q, LANES),
-                         lambda b, h, i, j: (b, h, i, Z)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, i, j: (b, h, i, Z)),
         out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
@@ -318,27 +424,34 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, *, causal, scale):
                                  "arbitrary"),
         ),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dq_inputs)
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nq=nq, offset=Sk - Sq)
+        block_q=block_q, block_k=block_k, nq=nq, offset=Sk - Sq,
+        rate=dropout_rate, n_heads=H)
+    dkv_in_specs = [
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, Z)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, j, i: (b, kv_head(h), j, Z)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, j, i: (b, kv_head(h), j, Z)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, Z)),
+            pl.BlockSpec((1, 1, block_q, LANES),
+                         lambda b, h, j, i: (b, h, i, Z)),
+            pl.BlockSpec((1, 1, block_q, LANES),
+                         lambda b, h, j, i: (b, h, i, Z)),
+    ]
+    dkv_inputs = [q, k, v, do, lse, delta]
+    if dropout_rate > 0.0:
+        dkv_in_specs.append(pl.BlockSpec((1,), lambda b, h, i, j: (Z,),
+                                  memory_space=pltpu.SMEM))
+        dkv_inputs.append(seed)
     # dK/dV computed per q-head ([B,H,Sk,D]) then group-reduced to kv heads
     dk_h, dv_h = pl.pallas_call(
         dkv_kernel,
         grid=(B, H, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, Z)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, j, i: (b, kv_head(h), j, Z)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, j, i: (b, kv_head(h), j, Z)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, Z)),
-            pl.BlockSpec((1, 1, block_q, LANES),
-                         lambda b, h, j, i: (b, h, i, Z)),
-            pl.BlockSpec((1, 1, block_q, LANES),
-                         lambda b, h, j, i: (b, h, i, Z)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, Z)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, Z)),
@@ -356,7 +469,7 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, *, causal, scale):
                                  "arbitrary"),
         ),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dkv_inputs)
     if g > 1:
         dk = dk_h.reshape(B, Hkv, g, Sk, D).sum(axis=2).astype(k.dtype)
         dv = dv_h.reshape(B, Hkv, g, Sk, D).sum(axis=2).astype(v.dtype)
@@ -368,26 +481,38 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, *, causal, scale):
 # ---------------------------------------------------------------------------
 # array-level API (paddle [B, S, H, D] layout) + primitive registration
 # ---------------------------------------------------------------------------
-def flash_attention_bshd(q, k, v, *, causal=False, scale=None):
-    """Array-level flash attention in paddle layout. Returns (out, lse)."""
+def flash_attention_bshd(q, k, v, seed=None, *, causal=False, scale=None,
+                         dropout_rate=0.0):
+    """Array-level flash attention in paddle layout. Returns (out, lse).
+    ``seed`` (int32 [1]) enables in-kernel attention-weight dropout at
+    ``dropout_rate`` (reference flash_attn dropout parity,
+    flash_attn_kernel.cu:35 rng plumbing)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out, lse = _flash_fwd_bhsd(qt, kt, vt, causal=causal, scale=float(scale))
+    out, lse = _flash_fwd_bhsd(qt, kt, vt, seed, causal=causal,
+                               scale=float(scale),
+                               dropout_rate=float(dropout_rate))
     return jnp.swapaxes(out, 1, 2), lse
 
 
-def _flash_vjp(grads_out, saved, *, causal, scale):
-    q, k, v, out, lse = saved
+def _flash_vjp(grads_out, saved, *, causal, scale, dropout_rate=0.0):
+    *ins, out, lse = saved
+    q, k, v = ins[:3]
+    seed = ins[3] if len(ins) > 3 else None
     do = grads_out[0]
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     ot, dot = jnp.swapaxes(out, 1, 2), jnp.swapaxes(do, 1, 2)
-    dq, dk, dv = _flash_bwd_bhsd(qt, kt, vt, ot, lse, dot,
-                                 causal=causal, scale=float(scale))
-    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
-            jnp.swapaxes(dv, 1, 2))
+    dq, dk, dv = _flash_bwd_bhsd(qt, kt, vt, ot, lse, dot, seed,
+                                 causal=causal, scale=float(scale),
+                                 dropout_rate=float(dropout_rate))
+    grads = (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+             jnp.swapaxes(dv, 1, 2))
+    if seed is not None:
+        grads = grads + (None,)
+    return grads
 
 
 dispatch.register_primitive(
@@ -400,13 +525,24 @@ dispatch.register_primitive(
 )
 
 
-def flash_attention_fused(q, k, v, *, causal=False, scale=None):
+def flash_attention_fused(q, k, v, *, causal=False, scale=None,
+                          dropout_p=0.0, rng=None):
     """Tensor-level entry used by nn.functional.scaled_dot_product_attention.
-    Returns the attention output Tensor (lse is kept for backward only)."""
-    from ...core.tensor import apply
+    Returns the attention output Tensor (lse is kept for backward only).
+    ``dropout_p`` > 0 requires ``rng`` (a Tensor wrapping a jax PRNG key);
+    the key is folded to an int32 seed for the in-kernel counter RNG."""
+    from ...core.tensor import Tensor, apply
 
-    out, _lse = apply("flash_attention_p", q, k, v,
-                      causal=bool(causal),
-                      scale=float(scale) if scale is not None
-                      else 1.0 / math.sqrt(q.shape[-1]))
+    scale = (float(scale) if scale is not None
+             else 1.0 / math.sqrt(q.shape[-1]))
+    if dropout_p > 0.0:
+        key_bits = jax.lax.bitcast_convert_type(
+            jax.random.key_data(rng._value), jnp.int32).ravel()
+        seed = Tensor._from_value((key_bits[:1] ^ key_bits[-1:]))
+        out, _lse = apply("flash_attention_p", q, k, v, seed,
+                          causal=bool(causal), scale=scale,
+                          dropout_rate=float(dropout_p))
+    else:
+        out, _lse = apply("flash_attention_p", q, k, v,
+                          causal=bool(causal), scale=scale)
     return out

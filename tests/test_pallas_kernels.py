@@ -362,3 +362,137 @@ class TestVarlenFlashAttention:
                 else:
                     assert acc[r, c] == 0, (
                         f"forbidden key {c} leaked into row {r}")
+
+
+class TestFlashDropout:
+    """In-kernel attention-weight dropout (reference flash_attn dropout,
+    flash_attn_kernel.cu:35 rng plumbing; here a counter RNG regenerated
+    identically in fwd and both bwd kernels)."""
+
+    def _arrays(self, B=1, S=128, H=2, D=64, seed=0):
+        rng = np.random.RandomState(seed)
+        mk = lambda: rng.randn(B, S, H, D).astype(np.float32) * 0.3
+        return mk(), mk(), mk()
+
+    def test_deterministic_and_seed_sensitive(self):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention_bshd
+
+        q, k, v = self._arrays()
+        s1 = jnp.array([123], jnp.int32)
+        s2 = jnp.array([987], jnp.int32)
+        o1, l1 = flash_attention_bshd(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), s1, dropout_rate=0.2)
+        o1b, _ = flash_attention_bshd(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), s1, dropout_rate=0.2)
+        o2, _ = flash_attention_bshd(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), s2, dropout_rate=0.2)
+        o0, l0 = flash_attention_bshd(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v))
+        assert np.array_equal(np.asarray(o1), np.asarray(o1b))
+        assert not np.allclose(np.asarray(o1), np.asarray(o2))
+        assert not np.allclose(np.asarray(o1), np.asarray(o0))
+        # the softmax denominator (lse) must NOT see the dropout mask
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l0),
+                                   rtol=1e-6)
+
+    def test_mean_field_and_keep_fraction(self):
+        """E[dropped out] == undropped out (upscale_in_train), and the
+        realized keep fraction tracks 1-rate."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (
+            _dropout_keep, flash_attention_bshd)
+
+        keep = _dropout_keep(jnp.int32(42), jnp.int32(1), jnp.int32(0),
+                             jnp.int32(0), 128, 128, 0.3)
+        frac = float(np.asarray(keep).mean())
+        assert abs(frac - 0.7) < 0.02, frac
+
+        q, k, v = self._arrays(B=2, S=256, H=4)
+        o0, _ = flash_attention_bshd(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v))
+        acc = np.zeros_like(q)
+        n = 8
+        for t in range(n):
+            o, _ = flash_attention_bshd(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                jnp.array([1000 + t], jnp.int32), dropout_rate=0.3)
+            acc += np.asarray(o)
+        # elementwise: n=8 draws at rate .3 leave ~23% relative noise
+        rel = np.abs(acc / n - np.asarray(o0)).mean() / (
+            np.abs(np.asarray(o0)).mean())
+        assert rel < 0.4, rel
+        # aggregate: noise cancels across 512k elements, so any upscale
+        # bias (a missing 1/(1-rate) shows as ~30%) is caught tightly
+        bias = abs(float((acc / n).mean()) - float(np.asarray(o0).mean()))
+        assert bias / abs(float(np.asarray(o0).mean())) < 0.05, bias
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_finite_difference(self, causal):
+        """With the seed fixed the dropped attention is a smooth function
+        of q/k/v, so analytic grads must match central differences
+        (op_test.py:148 numeric-gradient pattern)."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (
+            _flash_fwd_bhsd, _flash_bwd_bhsd)
+
+        B, H, S, D = 1, 2, 128, 64
+        rng = np.random.RandomState(3)
+        q = rng.randn(B, H, S, D).astype(np.float32) * 0.5
+        k = rng.randn(B, H, S, D).astype(np.float32) * 0.5
+        v = rng.randn(B, H, S, D).astype(np.float32) * 0.5
+        do = rng.randn(B, H, S, D).astype(np.float32)
+        seed = jnp.array([99], jnp.int32)
+        kw = dict(causal=causal, scale=0.125, dropout_rate=0.3)
+        out, lse = _flash_fwd_bhsd(jnp.asarray(q), jnp.asarray(k),
+                                   jnp.asarray(v), seed, **kw)
+        dq, dk, dv = _flash_bwd_bhsd(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), out, lse,
+                                     jnp.asarray(do), seed, **kw)
+
+        def loss(q_, k_, v_):
+            o, _ = _flash_fwd_bhsd(jnp.asarray(q_), jnp.asarray(k_),
+                                   jnp.asarray(v_), seed, **kw)
+            return float(np.asarray(o, np.float64).ravel() @ do.ravel())
+
+        eps = 1e-2
+        for name, base, grad in (("dq", q, dq), ("dk", k, dk),
+                                 ("dv", v, dv)):
+            idx = (0, 1, 100, 33)
+            pert = np.zeros_like(base)
+            pert[idx] = eps
+            args = {"dq": ((base + pert, k, v), (base - pert, k, v)),
+                    "dk": ((q, base + pert, v), (q, base - pert, v)),
+                    "dv": ((q, k, base + pert), (q, k, base - pert))}[name]
+            num = (loss(*args[0]) - loss(*args[1])) / (2 * eps)
+            ana = float(np.asarray(grad)[idx])
+            assert abs(num - ana) <= 2e-2 * max(abs(num), abs(ana), 0.05), (
+                name, num, ana)
+
+    def test_sdpa_routes_dropout_to_pallas_with_grads(self):
+        """nn.functional SDPA keeps the flash path for dropout_p > 0 and
+        the tape backward runs the custom vjp (seed grad slot is None)."""
+        from paddle_tpu.core import flags
+        from paddle_tpu.nn.functional.attention import (
+            scaled_dot_product_attention)
+
+        B, S, H, D = 1, 128, 2, 64
+        rng = np.random.RandomState(5)
+        q = _t(rng.randn(B, S, H, D).astype(np.float32) * 0.4)
+        k = _t(rng.randn(B, S, H, D).astype(np.float32) * 0.4)
+        v = _t(rng.randn(B, S, H, D).astype(np.float32) * 0.4)
+        flags.set_flags({"pallas_force_interpret": True})
+        try:
+            out = scaled_dot_product_attention(q, k, v, dropout_p=0.25,
+                                               training=True)
+            out.sum().backward()
+        finally:
+            flags.set_flags({"pallas_force_interpret": False})
+        assert q.grad is not None and k.grad is not None
+        assert v.grad is not None
+        assert np.isfinite(q.grad.numpy()).all()
+        # eval mode must be exactly the no-dropout fast path
+        e1 = scaled_dot_product_attention(q, k, v, dropout_p=0.25,
+                                          training=False)
+        e0 = scaled_dot_product_attention(q, k, v)
+        np.testing.assert_allclose(e1.numpy(), e0.numpy(), rtol=1e-6)
