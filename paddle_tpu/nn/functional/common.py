@@ -152,6 +152,22 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Reference: nn/functional/input.py embedding (note arg order: ids
     first). Grad scatter accumulates in f32 when weights are bf16."""
     ids, w = ensure_tensor(x), ensure_tensor(weight)
+    if (isinstance(ids._value, jax.Array)
+            and not isinstance(ids._value, jax.core.Tracer)
+            and ids._value.size):
+        # eager-mode bounds check (reference embedding kernels enforce
+        # this, funcs/embedding_util.h); must skip tracers AND static-
+        # capture ShapeDtypeStruct placeholders — under jit/capture the
+        # gather keeps XLA's OOB fill semantics. Both extrema in one
+        # device->host transfer.
+        lo, hi = (int(e) for e in np.asarray(jnp.stack(
+            [jnp.min(ids._value), jnp.max(ids._value)])))
+        n = w.shape[0]
+        if lo < 0 or hi >= n:
+            raise ValueError(
+                "Variable value (input) of OP(paddle.nn.functional."
+                f"embedding) expected >= 0 and < {n}, but got "
+                f"{lo if lo < 0 else hi}. Please check input value.")
     pi = None
     if padding_idx is not None:
         pi = int(padding_idx)

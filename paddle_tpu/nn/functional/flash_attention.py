@@ -20,63 +20,45 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     one Pallas kernel with in-kernel cu_seqlens (segment-id) masking —
     cu_seqlens are data, so ONE compile serves every segment layout with
     the same packed shape (ops/pallas/flash_attention_varlen.py). GQA
-    (H != H_kv) and bottom-right-aligned causal masking are supported;
-    dropout inside the kernel is not (dropout > 0 falls back to the
-    per-segment dense path)."""
-    from ...core.tensor import apply
+    (H != H_kv), bottom-right-aligned causal masking, and in-kernel
+    attention dropout (counter RNG; masks regenerate identically in the
+    backward kernels) are all supported. ``fixed_seed_offset`` pins the
+    dropout seed for reproducibility; otherwise the 'local_seed'
+    generator stream advances per call (mpu/random.py semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...core import generator
+    from ...core.tensor import Tensor, apply
     from ...ops._helpers import ensure_tensor
 
     q = ensure_tensor(query)
     k = ensure_tensor(key)
     v = ensure_tensor(value)
-    if dropout and training:
-        # dropout needs per-element rng inside the kernel; keep the exact
-        # dense fallback for this rare training configuration. sdpa always
-        # divides by sqrt(D), so pre-scale q to honor the user's scale.
-        import math as _math
-
-        from ...ops.manipulation import concat, squeeze, unsqueeze
-        from ...ops.math import scale as _scale_op
-
-        import numpy as _np
-
-        q = _scale_op(q, float(scale) * _math.sqrt(q.shape[-1]))
-        cu_q = [int(i) for i in ensure_tensor(cu_seqlens_q).tolist()]
-        cu_k = [int(i) for i in ensure_tensor(cu_seqlens_k).tolist()]
-        outs = []
-        for i in range(len(cu_q) - 1):
-            len_q = cu_q[i + 1] - cu_q[i]
-            len_k = cu_k[i + 1] - cu_k[i]
-            mask = None
-            if causal:
-                # BOTTOM-RIGHT-aligned causal mask, matching the Pallas
-                # varlen kernel and the reference varlen contract: query
-                # row r attends keys c <= r + (len_k - len_q). sdpa's
-                # is_causal is TOP-LEFT aligned, which diverges whenever
-                # len_k != len_q.
-                r = _np.arange(len_q)[:, None]
-                c = _np.arange(len_k)[None, :]
-                allow = c <= r + (len_k - len_q)
-                # finite large-negative (not -inf): a fully-masked query
-                # row (len_k < len_q) must softmax to uniform, not NaN —
-                # same choice as _sdpa_xla's causal branch
-                mask = ensure_tensor(_np.where(
-                    allow, 0.0,
-                    _np.finfo(_np.float32).min).astype("float32"))
-            o = scaled_dot_product_attention(
-                unsqueeze(q[cu_q[i]: cu_q[i + 1]], 0),
-                unsqueeze(k[cu_k[i]: cu_k[i + 1]], 0),
-                unsqueeze(v[cu_k[i]: cu_k[i + 1]], 0),
-                attn_mask=mask,
-                dropout_p=dropout, training=training)
-            outs.append(squeeze(o, 0))
-        return concat(outs, axis=0), None
 
     from ...ops.pallas import flash_attention_varlen  # noqa: F401 (registers prim)
 
     cu_q_t = ensure_tensor(cu_seqlens_q)
     cu_k_t = ensure_tensor(cu_seqlens_k)
-    out, _lse = apply("flash_attn_varlen_p", q, k, v, cu_q_t, cu_k_t,
-                      causal=bool(causal), scale=float(scale),
-                      n_seqs=int(cu_q_t.shape[0]) - 1)
+    p = float(dropout) if training else 0.0
+    if p >= 1.0:
+        raise ValueError("flash_attn_unpadded: dropout must be < 1.0, "
+                         f"got {dropout}")
+    if p > 0.0:
+        if fixed_seed_offset is not None:
+            seed = Tensor._from_value(
+                jnp.asarray([int(fixed_seed_offset)], jnp.int32))
+        else:
+            key_bits = jax.lax.bitcast_convert_type(
+                jax.random.key_data(
+                    generator.next_key(rng_name or "local_seed")),
+                jnp.int32).ravel()
+            seed = Tensor._from_value(key_bits[:1] ^ key_bits[-1:])
+        out, _lse = apply("flash_attn_varlen_p", q, k, v, cu_q_t, cu_k_t,
+                          seed, causal=bool(causal), scale=float(scale),
+                          n_seqs=int(cu_q_t.shape[0]) - 1, dropout_rate=p)
+    else:
+        out, _lse = apply("flash_attn_varlen_p", q, k, v, cu_q_t, cu_k_t,
+                          causal=bool(causal), scale=float(scale),
+                          n_seqs=int(cu_q_t.shape[0]) - 1)
     return out, None

@@ -32,6 +32,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ...core import dispatch
+from .flash_attention import _dropout_keep
 from .flash_attention import (_interpret, _kv_head_map, _pick_block,
                               LANES, NEG_INF, Z)
 
@@ -87,9 +88,15 @@ def _skip_block(sq, sk, bound, j, block_k, causal):
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _vfwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, bound_ref,
-                 o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                 *, scale, causal, block_q, block_k, nk):
+def _vfwd_kernel(*refs, scale, causal, block_q, block_k, nk, rate):
+    if rate > 0.0:
+        (q_ref, k_ref, v_ref, segq_ref, segk_ref, bound_ref, seed_ref,
+         o_ref, lse_ref, m_scr, l_scr, acc_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, segq_ref, segk_ref, bound_ref,
+         o_ref, lse_ref, m_scr, l_scr, acc_scr) = refs
+        seed_ref = None
+    h = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -121,8 +128,18 @@ def _vfwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, bound_ref,
         alpha = jnp.exp(m_prev - m_eff)
         p = jnp.exp(s - m_eff)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        if rate > 0.0:
+            # same contract as the dense kernel (_fwd_kernel): denominator
+            # undropped, value accumulation masked+rescaled; bits keyed on
+            # packed-token coordinates so fwd and both bwd kernels agree
+            keep = _dropout_keep(seed_ref[0], h, i, j, block_q, block_k,
+                                 rate)
+            p_use = p * keep * (1.0 / (1.0 - rate))
+        else:
+            p_use = p
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p_use, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -136,8 +153,10 @@ def _vfwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, bound_ref,
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "n_seqs"))
-def _vflash_fwd(q, k, v, cu_q, cu_k, *, causal, scale, n_seqs):
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "n_seqs",
+                                              "dropout_rate"))
+def _vflash_fwd(q, k, v, cu_q, cu_k, seed=None, *, causal, scale, n_seqs,
+                dropout_rate=0.0):
     """q: [H, Tq, D]; k, v: [Hkv, Tk, D] (already padded to block
     multiples); returns (out [H, Tq, D], lse [H, Tq])."""
     H, Tq, D = q.shape
@@ -151,18 +170,24 @@ def _vflash_fwd(q, k, v, cu_q, cu_k, *, causal, scale, n_seqs):
         cu_q, cu_k, cu_q[-1], cu_k[-1], Tq, Tk, n_seqs)
     kernel = functools.partial(
         _vfwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nk=nk)
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=(H, nq, nk),
-        in_specs=[
+        block_q=block_q, block_k=block_k, nk=nk, rate=dropout_rate)
+    in_specs = [
             pl.BlockSpec((1, block_q, D), lambda h, i, j: (h, i, Z)),
             pl.BlockSpec((1, block_k, D), lambda h, i, j: (kv_head(h), j, Z)),
             pl.BlockSpec((1, block_k, D), lambda h, i, j: (kv_head(h), j, Z)),
             pl.BlockSpec((block_q,), lambda h, i, j: (i,)),
             pl.BlockSpec((block_k,), lambda h, i, j: (j,)),
             pl.BlockSpec((block_q,), lambda h, i, j: (i,)),
-        ],
+    ]
+    inputs = [q, k, v, seg_q, seg_k, bound]
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec((1,), lambda h, i, j: (Z,),
+                                     memory_space=pltpu.SMEM))
+        inputs.append(seed)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(H, nq, nk),
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda h, i, j: (h, i, Z)),
             pl.BlockSpec((1, block_q, LANES), lambda h, i, j: (h, i, Z)),
@@ -180,16 +205,23 @@ def _vflash_fwd(q, k, v, cu_q, cu_k, *, causal, scale, n_seqs):
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(q, k, v, seg_q, seg_k, bound)
+    )(*inputs)
     return out, lse[:, :, 0]
 
 
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
-def _vbwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    segq_ref, segk_ref, bound_ref, dq_ref, dq_scr,
-                    *, scale, causal, block_q, block_k, nk):
+def _vbwd_dq_kernel(*refs, scale, causal, block_q, block_k, nk, rate):
+    if rate > 0.0:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         segq_ref, segk_ref, bound_ref, seed_ref, dq_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         segq_ref, segk_ref, bound_ref, dq_ref, dq_scr) = refs
+        seed_ref = None
+    h = pl.program_id(0)
+    i = pl.program_id(1)
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -217,6 +249,10 @@ def _vbwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse_safe)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            keep = _dropout_keep(seed_ref[0], h, i, j, block_q, block_k,
+                                 rate)
+            dp = dp * keep * (1.0 / (1.0 - rate))
         ds = p * (dp - delta) * scale
         dq_scr[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -226,9 +262,17 @@ def _vbwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _vbwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     segq_ref, segk_ref, bound_ref, dk_ref, dv_ref,
-                     dk_scr, dv_scr, *, scale, causal, block_q, block_k, nq):
+def _vbwd_dkv_kernel(*refs, scale, causal, block_q, block_k, nq, rate):
+    if rate > 0.0:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         segq_ref, segk_ref, bound_ref, seed_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         segq_ref, segk_ref, bound_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        seed_ref = None
+    h = pl.program_id(0)
     j = pl.program_id(1)  # k block
     i = pl.program_id(2)  # q block (innermost: accumulate)
 
@@ -256,10 +300,19 @@ def _vbwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       s, NEG_INF)
         lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
         p = jnp.exp(s - lse_safe)
+        if rate > 0.0:
+            keep = _dropout_keep(seed_ref[0], h, i, j, block_q, block_k,
+                                 rate)
+            p_drop = p * keep * (1.0 / (1.0 - rate))
+        else:
+            p_drop = p
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p_drop, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            dp = dp * keep * (1.0 / (1.0 - rate))
         ds = p * (dp - delta) * scale
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -270,8 +323,10 @@ def _vbwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "n_seqs"))
-def _vflash_bwd(q, k, v, cu_q, cu_k, out, lse, do, *, causal, scale, n_seqs):
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "n_seqs",
+                                              "dropout_rate"))
+def _vflash_bwd(q, k, v, cu_q, cu_k, out, lse, do, seed=None, *, causal,
+                scale, n_seqs, dropout_rate=0.0):
     H, Tq, D = q.shape
     Hkv, Tk = k.shape[0], k.shape[1]
     g = H // Hkv
@@ -285,11 +340,7 @@ def _vflash_bwd(q, k, v, cu_q, cu_k, out, lse, do, *, causal, scale, n_seqs):
     lse_p = jnp.broadcast_to(lse[..., None], (H, Tq, LANES))
     delta_p = jnp.broadcast_to(delta[..., None], (H, Tq, LANES))
 
-    dq = pl.pallas_call(
-        functools.partial(_vbwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nk=nk),
-        grid=(H, nq, nk),
-        in_specs=[
+    dq_in_specs = [
             pl.BlockSpec((1, block_q, D), lambda h, i, j: (h, i, Z)),
             pl.BlockSpec((1, block_k, D), lambda h, i, j: (kv_head(h), j, Z)),
             pl.BlockSpec((1, block_k, D), lambda h, i, j: (kv_head(h), j, Z)),
@@ -299,7 +350,18 @@ def _vflash_bwd(q, k, v, cu_q, cu_k, out, lse, do, *, causal, scale, n_seqs):
             pl.BlockSpec((block_q,), lambda h, i, j: (i,)),
             pl.BlockSpec((block_k,), lambda h, i, j: (j,)),
             pl.BlockSpec((block_q,), lambda h, i, j: (i,)),
-        ],
+    ]
+    dq_inputs = [q, k, v, do, lse_p, delta_p, seg_q, seg_k, bound]
+    if dropout_rate > 0.0:
+        dq_in_specs.append(pl.BlockSpec((1,), lambda h, i, j: (Z,),
+                                        memory_space=pltpu.SMEM))
+        dq_inputs.append(seed)
+    dq = pl.pallas_call(
+        functools.partial(_vbwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk,
+                          rate=dropout_rate),
+        grid=(H, nq, nk),
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda h, i, j: (h, i, Z)),
         out_shape=jax.ShapeDtypeStruct((H, Tq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
@@ -307,13 +369,9 @@ def _vflash_bwd(q, k, v, cu_q, cu_k, out, lse, do, *, causal, scale, n_seqs):
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(q, k, v, do, lse_p, delta_p, seg_q, seg_k, bound)
+    )(*dq_inputs)
 
-    dk_h, dv_h = pl.pallas_call(
-        functools.partial(_vbwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nq=nq),
-        grid=(H, nk, nq),
-        in_specs=[
+    dkv_in_specs = [
             pl.BlockSpec((1, block_q, D), lambda h, j, i: (h, i, Z)),
             pl.BlockSpec((1, block_k, D), lambda h, j, i: (kv_head(h), j, Z)),
             pl.BlockSpec((1, block_k, D), lambda h, j, i: (kv_head(h), j, Z)),
@@ -323,7 +381,18 @@ def _vflash_bwd(q, k, v, cu_q, cu_k, out, lse, do, *, causal, scale, n_seqs):
             pl.BlockSpec((block_q,), lambda h, j, i: (i,)),
             pl.BlockSpec((block_k,), lambda h, j, i: (j,)),
             pl.BlockSpec((block_q,), lambda h, j, i: (i,)),
-        ],
+    ]
+    dkv_inputs = [q, k, v, do, lse_p, delta_p, seg_q, seg_k, bound]
+    if dropout_rate > 0.0:
+        dkv_in_specs.append(pl.BlockSpec((1,), lambda h, j, i: (Z,),
+                                         memory_space=pltpu.SMEM))
+        dkv_inputs.append(seed)
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_vbwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          rate=dropout_rate),
+        grid=(H, nk, nq),
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda h, j, i: (h, j, Z)),
             pl.BlockSpec((1, block_k, D), lambda h, j, i: (h, j, Z)),
@@ -340,7 +409,7 @@ def _vflash_bwd(q, k, v, cu_q, cu_k, out, lse, do, *, causal, scale, n_seqs):
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(q, k, v, do, lse_p, delta_p, seg_q, seg_k, bound)
+    )(*dkv_inputs)
     if g > 1:
         dk = dk_h.reshape(Hkv, g, Tk, D).sum(axis=1).astype(k.dtype)
         dv = dv_h.reshape(Hkv, g, Tk, D).sum(axis=1).astype(v.dtype)
@@ -360,13 +429,14 @@ def _to_htd(x, t_pad):
     return x
 
 
-def flash_attn_varlen_thd(q, k, v, cu_q, cu_k, *, causal=False, scale=None,
-                          n_seqs=None):
+def flash_attn_varlen_thd(q, k, v, cu_q, cu_k, seed=None, *, causal=False,
+                          scale=None, n_seqs=None, dropout_rate=0.0):
     """Array-level varlen attention over packed [T, H, D] tensors.
 
     cu_seqlens are data (not static): one compile serves every segment
-    layout with the same packed lengths. Returns (out [Tq, H, D],
-    lse [H, Tq_pad])."""
+    layout with the same packed lengths. ``seed`` (int32 [1]) enables
+    in-kernel attention dropout at ``dropout_rate``. Returns
+    (out [Tq, H, D], lse [H, Tq_pad])."""
     Tq = q.shape[0]
     Tk = k.shape[0]
     if scale is None:
@@ -378,29 +448,40 @@ def flash_attn_varlen_thd(q, k, v, cu_q, cu_k, *, causal=False, scale=None,
     qh = _to_htd(q, pad_q)
     kh = _to_htd(k, pad_k)
     vh = _to_htd(v, pad_k)
-    out, lse = _vflash_fwd(qh, kh, vh, cu_q, cu_k, causal=bool(causal),
-                           scale=float(scale), n_seqs=int(n_seqs))
+    out, lse = _vflash_fwd(qh, kh, vh, cu_q, cu_k, seed, causal=bool(causal),
+                           scale=float(scale), n_seqs=int(n_seqs),
+                           dropout_rate=float(dropout_rate))
     return jnp.swapaxes(out[:, :Tq], 0, 1), lse
 
 
-def _varlen_fwd_prim(q, k, v, cu_q, cu_k, *, causal, scale, n_seqs):
-    out, lse = flash_attn_varlen_thd(q, k, v, cu_q, cu_k, causal=causal,
-                                     scale=scale, n_seqs=n_seqs)
+def _varlen_fwd_prim(q, k, v, cu_q, cu_k, seed=None, *, causal, scale,
+                     n_seqs, dropout_rate=0.0):
+    out, lse = flash_attn_varlen_thd(q, k, v, cu_q, cu_k, seed,
+                                     causal=causal, scale=scale,
+                                     n_seqs=n_seqs,
+                                     dropout_rate=dropout_rate)
     return out, lse
 
 
-def _varlen_vjp(grads_out, saved, *, causal, scale, n_seqs):
-    q, k, v, cu_q, cu_k, out, lse = saved
+def _varlen_vjp(grads_out, saved, *, causal, scale, n_seqs,
+                dropout_rate=0.0):
+    *ins, out, lse = saved
+    q, k, v, cu_q, cu_k = ins[:5]
+    seed = ins[5] if len(ins) > 5 else None
     do = grads_out[0]
     Tq, Tk = q.shape[0], k.shape[0]
     pad_q = lse.shape[1]
     pad_k = _pad_to(Tk, 128)
     dq, dk, dv = _vflash_bwd(
         _to_htd(q, pad_q), _to_htd(k, pad_k), _to_htd(v, pad_k),
-        cu_q, cu_k, _to_htd(out, pad_q), lse, _to_htd(do, pad_q),
-        causal=causal, scale=float(scale), n_seqs=int(n_seqs))
-    return (jnp.swapaxes(dq[:, :Tq], 0, 1), jnp.swapaxes(dk[:, :Tk], 0, 1),
-            jnp.swapaxes(dv[:, :Tk], 0, 1), None, None)
+        cu_q, cu_k, _to_htd(out, pad_q), lse, _to_htd(do, pad_q), seed,
+        causal=causal, scale=float(scale), n_seqs=int(n_seqs),
+        dropout_rate=float(dropout_rate))
+    grads = (jnp.swapaxes(dq[:, :Tq], 0, 1), jnp.swapaxes(dk[:, :Tk], 0, 1),
+             jnp.swapaxes(dv[:, :Tk], 0, 1), None, None)
+    if seed is not None:
+        grads = grads + (None,)
+    return grads
 
 
 dispatch.register_primitive(

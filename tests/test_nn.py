@@ -142,6 +142,24 @@ class TestDropoutEmbedding:
         out = emb(idx)
         np.testing.assert_allclose(out.numpy()[0], 0.0)
 
+    def test_embedding_out_of_range_raises(self):
+        """Eager lookups with ids outside [0, vocab) must raise like the
+        reference kernels (funcs/embedding_util.h enforce), not silently
+        produce NaN via XLA's out-of-bounds fill."""
+        import pytest as _pytest
+
+        emb = nn.Embedding(10, 4)
+        with _pytest.raises(ValueError, match="expected >= 0 and < 10"):
+            emb(paddle.to_tensor(np.array([3, 10], np.int64)))
+        with _pytest.raises(ValueError, match="but got -1"):
+            emb(paddle.to_tensor(np.array([-1, 2], np.int64)))
+        # under jit/trace the check must not fire (tracers are opaque)
+        @paddle.jit.to_static
+        def f(idx):
+            return emb(idx).sum()
+        assert np.isfinite(float(f(paddle.to_tensor(
+            np.array([1, 2], np.int64)))))
+
 
 class TestActivationsLosses:
     def test_softmax_ce_matches_manual(self):
