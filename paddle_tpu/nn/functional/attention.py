@@ -58,13 +58,31 @@ def _sdpa_mask_xla(q, k, v, mask, key, *, scale, dropout_p):
         v = jnp.repeat(v, rep, axis=2)
     logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
     logits = logits + mask.astype(logits.dtype)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    # safe softmax: a row whose keys are ALL masked to -inf outputs exact
+    # zeros instead of NaN — the same convention as the Pallas flash
+    # kernel's l==0 finalize, so the two routes agree at every Sk
+    lf = logits.astype(jnp.float32)
+    row_max = jnp.max(lf, axis=-1, keepdims=True)
+    dead = row_max == -jnp.inf
+    e = jnp.exp(lf - jnp.where(dead, 0.0, row_max))
+    denom = jnp.sum(e, axis=-1, keepdims=True)
+    probs = jnp.where(dead, 0.0, e / jnp.where(dead, 1.0, denom))
+    probs = probs.astype(q.dtype)
     probs = _attn_dropout(probs, key, dropout_p)
     return jnp.einsum("bhst,bthd->bshd", probs, v)
 
 
 defprim("sdpa_p", _sdpa_xla)
 defprim("sdpa_mask_p", _sdpa_mask_xla)
+
+
+# Masked-SDPA routing crossover, MEASURED on v5e (2026-07-31, fwd+bwd
+# carry-chained, 7/8 keys live): S=512 xla 7.65ms vs flash 7.94; S=1024
+# 11.80 vs 11.37; S=2048 12.16 vs 11.27; S=4096 14.61 vs 13.00. Below
+# this the XLA composition's fused S^2 path is faster; at/above it the
+# flash kernel wins AND avoids the O(S^2) probs buffer XLA materializes
+# for backward (mandatory at long context).
+_MASK_FLASH_MIN_SK = 1024
 
 
 def _use_pallas(q, k):
@@ -93,7 +111,27 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     p = float(dropout_p) if training else 0.0
     rng = Tensor._from_value(generator.next_key("local_seed"))
     if attn_mask is not None:
-        out = apply("sdpa_mask_p", q, k, v, ensure_tensor(attn_mask), rng,
+        m = ensure_tensor(attn_mask)
+        if (_use_pallas(q, k) and p < 1.0 and m.ndim == 4
+                and m.shape[1] == 1 and m.shape[2] == 1
+                and m.shape[3] == k.shape[1]
+                and m.shape[0] in (1, q.shape[0])
+                and m.stop_gradient  # flash takes no bias grad; a
+                # TRAINABLE additive bias must stay on the XLA path
+                and k.shape[1] >= _MASK_FLASH_MIN_SK):
+            # [B, 1, 1, Sk] additive padding mask: stays on the flash
+            # path as a per-key logit bias instead of the XLA fallback
+            from ...ops.pallas.flash_attention import flash_attention_fused
+
+            bias = m.reshape([m.shape[0], m.shape[3]]).astype("float32")
+            if bias.shape[0] == 1 and q.shape[0] > 1:
+                bias = bias.expand([q.shape[0], m.shape[3]])
+            # causal=False: the sdpa_mask_p fallback gives the mask
+            # precedence over is_causal — both paths must agree
+            return flash_attention_fused(
+                q, k, v, causal=False, scale=scale,
+                dropout_p=p, rng=rng, key_bias=bias)
+        out = apply("sdpa_mask_p", q, k, v, m, rng,
                     scale=scale, dropout_p=p)
     elif _use_pallas(q, k) and p < 1.0:
         # p == 1.0 would need 1/(1-p) rescale in-kernel; the XLA path

@@ -98,15 +98,17 @@ def _kv_head_map(g: int):
 # forward
 # ---------------------------------------------------------------------------
 def _fwd_kernel(*refs, scale, causal, block_q, block_k, nk, offset,
-                rate, n_heads):
+                rate, n_heads, has_bias=False):
     # offset = Sk - Sq: bottom-right-aligned causal mask (query i attends
     # keys <= i + offset), matching paddle/XLA semantics for Sq != Sk
-    if rate > 0.0:
-        (q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
-         m_scr, l_scr, acc_scr) = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
-        seed_ref = None
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    n = 3
+    bias_ref = refs[n] if has_bias else None
+    n += int(has_bias)
+    seed_ref = refs[n] if rate > 0.0 else None
+    n += int(rate > 0.0)
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = refs[n:]
     i = pl.program_id(2)
     j = pl.program_id(3)
     # hoisted: pl.program_id is not available inside a pl.when body under
@@ -126,6 +128,10 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, nk, offset,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if has_bias:
+            # additive per-key bias (broadcast over query rows): the
+            # [B, 1, 1, Sk] padding-mask pattern of sdpa_mask_p
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -181,9 +187,12 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, nk, offset,
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "scale", "dropout_rate"))
-def _flash_fwd_bhsd(q, k, v, seed=None, *, causal, scale, dropout_rate=0.0):
+def _flash_fwd_bhsd(q, k, v, seed=None, key_bias=None, *, causal, scale,
+                    dropout_rate=0.0):
     """q: [B,H,Sq,D]; k,v: [B,Hkv,Sk,D] -> (out [B,H,Sq,D], lse [B,H,Sq]).
-    seed: int32 [1] dropout seed, required when dropout_rate > 0."""
+    seed: int32 [1] dropout seed, required when dropout_rate > 0.
+    key_bias: [B, Sk] additive logit bias broadcast over heads/rows (the
+    padding-mask pattern), added BEFORE the causal mask/softmax."""
     B, H, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     g = H // Hkv
@@ -195,7 +204,7 @@ def _flash_fwd_bhsd(q, k, v, seed=None, *, causal, scale, dropout_rate=0.0):
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, nk=nk, offset=Sk - Sq,
-        rate=dropout_rate, n_heads=H)
+        rate=dropout_rate, n_heads=H, has_bias=key_bias is not None)
     in_specs = [
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, Z)),
             pl.BlockSpec((1, 1, block_k, D),
@@ -204,6 +213,13 @@ def _flash_fwd_bhsd(q, k, v, seed=None, *, causal, scale, dropout_rate=0.0):
                          lambda b, h, i, j: (b, kv_head(h), j, Z)),
     ]
     inputs = [q, k, v]
+    if key_bias is not None:
+        # [B, 1, Sk] with (1, 1, block_k) blocks: Mosaic wants the last
+        # two block dims (8, 128)-divisible or equal to the array dims
+        in_specs.append(pl.BlockSpec((1, 1, block_k),
+                                     lambda b, h, i, j: (b, Z, j)))
+        inputs.append(key_bias.reshape(key_bias.shape[0], 1,
+                                       key_bias.shape[1]))
     if dropout_rate > 0.0:
         in_specs.append(pl.BlockSpec((1,), lambda b, h, i, j: (Z,),
                                   memory_space=pltpu.SMEM))
@@ -244,14 +260,15 @@ def _flash_fwd_bhsd(q, k, v, seed=None, *, causal, scale, dropout_rate=0.0):
 # backward
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, nk, offset,
-                   rate, n_heads):
-    if rate > 0.0:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
-         dq_ref, dq_scr) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dq_ref, dq_scr) = refs
-        seed_ref = None
+                   rate, n_heads, has_bias=False):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    n = 6
+    bias_ref = refs[n] if has_bias else None
+    n += int(has_bias)
+    seed_ref = refs[n] if rate > 0.0 else None
+    n += int(rate > 0.0)
+    dq_ref, dq_scr = refs[n:]
     i = pl.program_id(2)
     j = pl.program_id(3)
     # hoisted: pl.program_id is not available inside a pl.when body under
@@ -272,6 +289,8 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, nk, offset,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -306,14 +325,15 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, nk, offset,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, nq, offset,
-                    rate, n_heads):
-    if rate > 0.0:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
-        seed_ref = None
+                    rate, n_heads, has_bias=False):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    n = 6
+    bias_ref = refs[n] if has_bias else None
+    n += int(has_bias)
+    seed_ref = refs[n] if rate > 0.0 else None
+    n += int(rate > 0.0)
+    dk_ref, dv_ref, dk_scr, dv_scr = refs[n:]
     j = pl.program_id(2)  # k block
     i = pl.program_id(3)  # q block (innermost: accumulate over q)
     bh = pl.program_id(0) * n_heads + pl.program_id(1)
@@ -333,6 +353,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, nq, offset,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -376,8 +398,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, nq, offset,
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "scale", "dropout_rate"))
-def _flash_bwd_bhsd(q, k, v, out, lse, do, seed=None, *, causal, scale,
-                    dropout_rate=0.0):
+def _flash_bwd_bhsd(q, k, v, out, lse, do, seed=None, key_bias=None, *,
+                    causal, scale, dropout_rate=0.0):
     B, H, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     g = H // Hkv
@@ -393,7 +415,7 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, seed=None, *, causal, scale,
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, nk=nk, offset=Sk - Sq,
-        rate=dropout_rate, n_heads=H)
+        rate=dropout_rate, n_heads=H, has_bias=key_bias is not None)
     dq_in_specs = [
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, Z)),
             pl.BlockSpec((1, 1, block_k, D),
@@ -407,6 +429,11 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, seed=None, *, causal, scale,
                          lambda b, h, i, j: (b, h, i, Z)),
     ]
     dq_inputs = [q, k, v, do, lse, delta]
+    if key_bias is not None:
+        dq_in_specs.append(pl.BlockSpec((1, 1, block_k),
+                                        lambda b, h, i, j: (b, Z, j)))
+        dq_inputs.append(key_bias.reshape(key_bias.shape[0], 1,
+                                          key_bias.shape[1]))
     if dropout_rate > 0.0:
         dq_in_specs.append(pl.BlockSpec((1,), lambda b, h, i, j: (Z,),
                                   memory_space=pltpu.SMEM))
@@ -429,7 +456,7 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, seed=None, *, causal, scale,
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, nq=nq, offset=Sk - Sq,
-        rate=dropout_rate, n_heads=H)
+        rate=dropout_rate, n_heads=H, has_bias=key_bias is not None)
     dkv_in_specs = [
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, Z)),
             pl.BlockSpec((1, 1, block_k, D),
@@ -443,6 +470,12 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, seed=None, *, causal, scale,
                          lambda b, h, j, i: (b, h, i, Z)),
     ]
     dkv_inputs = [q, k, v, do, lse, delta]
+    if key_bias is not None:
+        # note swapped grid axes here: j=pid2 (k block), i=pid3 (q block)
+        dkv_in_specs.append(pl.BlockSpec((1, 1, block_k),
+                                         lambda b, h, j, i: (b, Z, j)))
+        dkv_inputs.append(key_bias.reshape(key_bias.shape[0], 1,
+                                           key_bias.shape[1]))
     if dropout_rate > 0.0:
         dkv_in_specs.append(pl.BlockSpec((1,), lambda b, h, i, j: (Z,),
                                   memory_space=pltpu.SMEM))
@@ -481,37 +514,45 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, seed=None, *, causal, scale,
 # ---------------------------------------------------------------------------
 # array-level API (paddle [B, S, H, D] layout) + primitive registration
 # ---------------------------------------------------------------------------
-def flash_attention_bshd(q, k, v, seed=None, *, causal=False, scale=None,
-                         dropout_rate=0.0):
+def flash_attention_bshd(q, k, v, *extras, causal=False, scale=None,
+                         dropout_rate=0.0, has_bias=False):
     """Array-level flash attention in paddle layout. Returns (out, lse).
-    ``seed`` (int32 [1]) enables in-kernel attention-weight dropout at
-    ``dropout_rate`` (reference flash_attn dropout parity,
-    flash_attn_kernel.cu:35 rng plumbing)."""
+
+    ``extras`` holds the optional inputs IN ORDER: ``key_bias`` ([B, Sk]
+    additive logit bias, present when ``has_bias``) then ``seed``
+    (int32 [1], present when ``dropout_rate > 0`` — reference flash_attn
+    dropout parity, flash_attn_kernel.cu:35 rng plumbing)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    extras = list(extras)
+    key_bias = extras.pop(0) if has_bias else None
+    seed = extras.pop(0) if dropout_rate > 0.0 else None
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out, lse = _flash_fwd_bhsd(qt, kt, vt, seed, causal=causal,
+    out, lse = _flash_fwd_bhsd(qt, kt, vt, seed, key_bias, causal=causal,
                                scale=float(scale),
                                dropout_rate=float(dropout_rate))
     return jnp.swapaxes(out, 1, 2), lse
 
 
-def _flash_vjp(grads_out, saved, *, causal, scale, dropout_rate=0.0):
+def _flash_vjp(grads_out, saved, *, causal, scale, dropout_rate=0.0,
+               has_bias=False):
     *ins, out, lse = saved
     q, k, v = ins[:3]
-    seed = ins[3] if len(ins) > 3 else None
+    rest = list(ins[3:])
+    key_bias = rest.pop(0) if has_bias else None
+    seed = rest.pop(0) if dropout_rate > 0.0 else None
     do = grads_out[0]
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     ot, dot = jnp.swapaxes(out, 1, 2), jnp.swapaxes(do, 1, 2)
-    dq, dk, dv = _flash_bwd_bhsd(qt, kt, vt, ot, lse, dot, seed,
+    dq, dk, dv = _flash_bwd_bhsd(qt, kt, vt, ot, lse, dot, seed, key_bias,
                                  causal=causal, scale=float(scale),
                                  dropout_rate=float(dropout_rate))
     grads = (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
              jnp.swapaxes(dv, 1, 2))
-    if seed is not None:
-        grads = grads + (None,)
+    # optional inputs (bias, seed) take no grads: the bias is a mask
+    grads = grads + (None,) * (len(ins) - 3)
     return grads
 
 
@@ -526,23 +567,26 @@ dispatch.register_primitive(
 
 
 def flash_attention_fused(q, k, v, *, causal=False, scale=None,
-                          dropout_p=0.0, rng=None):
+                          dropout_p=0.0, rng=None, key_bias=None):
     """Tensor-level entry used by nn.functional.scaled_dot_product_attention.
     Returns the attention output Tensor (lse is kept for backward only).
     ``dropout_p`` > 0 requires ``rng`` (a Tensor wrapping a jax PRNG key);
-    the key is folded to an int32 seed for the in-kernel counter RNG."""
+    the key is folded to an int32 seed for the in-kernel counter RNG.
+    ``key_bias`` is a [B, Sk] additive logit bias Tensor (the padding-mask
+    pattern), broadcast over heads and query rows inside the kernel."""
     from ...core.tensor import Tensor, apply
 
     scale = (float(scale) if scale is not None
              else 1.0 / math.sqrt(q.shape[-1]))
+    extras = []
+    statics = dict(causal=bool(causal), scale=scale)
+    if key_bias is not None:
+        extras.append(key_bias)
+        statics["has_bias"] = True
     if dropout_p > 0.0:
         key_bits = jax.lax.bitcast_convert_type(
             jax.random.key_data(rng._value), jnp.int32).ravel()
-        seed = Tensor._from_value((key_bits[:1] ^ key_bits[-1:]))
-        out, _lse = apply("flash_attention_p", q, k, v, seed,
-                          causal=bool(causal), scale=scale,
-                          dropout_rate=float(dropout_p))
-    else:
-        out, _lse = apply("flash_attention_p", q, k, v,
-                          causal=bool(causal), scale=scale)
+        extras.append(Tensor._from_value((key_bits[:1] ^ key_bits[-1:])))
+        statics["dropout_rate"] = float(dropout_p)
+    out, _lse = apply("flash_attention_p", q, k, v, *extras, **statics)
     return out

@@ -543,3 +543,151 @@ class TestFlashDropout:
                                            fixed_seed_offset=77, **kw)
         np.testing.assert_allclose(o_pert.numpy()[:40], o1.numpy()[:40],
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestFlashKeyBias:
+    """[B, 1, 1, Sk] additive padding masks ride the flash kernel as a
+    per-key logit bias instead of falling back to the XLA composition."""
+
+    def _case(self, B=2, S=128, H=2, D=64, n_pad=37, seed=0):
+        rng = np.random.RandomState(seed)
+        q = rng.randn(B, S, H, D).astype(np.float32) * 0.4
+        k = rng.randn(B, S, H, D).astype(np.float32) * 0.4
+        v = rng.randn(B, S, H, D).astype(np.float32) * 0.4
+        # last n_pad keys of each row masked out (padding pattern)
+        mask = np.zeros((B, 1, 1, S), np.float32)
+        mask[..., S - n_pad:] = -1e9
+        return q, k, v, mask
+
+    def test_matches_sdpa_mask_oracle(self):
+        from paddle_tpu.core import flags
+        from paddle_tpu.nn.functional.attention import (
+            scaled_dot_product_attention)
+        from paddle_tpu.ops.pallas.flash_attention import (
+            flash_attention_fused)
+
+        q, k, v, mask = self._case()
+        # flash path with key_bias
+        q1, k1, v1 = _t(q), _t(k), _t(v)
+        bias = _t(mask.reshape(2, -1), stop_gradient=True)
+        out = flash_attention_fused(q1, k1, v1, key_bias=bias)
+        out.sum().backward()
+        # oracle: sdpa_mask_p (XLA composition)
+        q2, k2, v2 = _t(q), _t(k), _t(v)
+        ref = scaled_dot_product_attention(
+            q2, k2, v2, attn_mask=_t(mask, stop_gradient=True))
+        ref.sum().backward()
+        np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+        for a, b in ((q1, q2), (k1, k2), (v1, v2)):
+            np.testing.assert_allclose(a.grad.numpy(), b.grad.numpy(),
+                                       rtol=3e-3, atol=3e-3)
+        # padded keys must receive zero dV/dK
+        np.testing.assert_allclose(k1.grad.numpy()[:, -37:], 0.0, atol=1e-6)
+        np.testing.assert_allclose(v1.grad.numpy()[:, -37:], 0.0, atol=1e-6)
+
+    def test_sdpa_routes_padding_mask_to_flash(self):
+        """With aligned shapes + the force-interpret flag, SDPA's masked
+        path must produce the flash primitive when Sk is at/above the
+        measured crossover (attention.py _MASK_FLASH_MIN_SK), the XLA
+        fallback below it — and both must agree numerically."""
+        import paddle_tpu.nn.functional.attention as A
+        from paddle_tpu.core import dispatch, flags
+
+        q, k, v, mask = self._case(B=1, n_pad=16)
+        m = _t(mask[:1], stop_gradient=True)
+        prev_flag = flags.get_flag("pallas_force_interpret")
+        flags.set_flags({"pallas_force_interpret": True})
+        orig_thresh = A._MASK_FLASH_MIN_SK
+        calls = []
+        orig_call = dispatch.call_primitive
+        dispatch.call_primitive = lambda n, a, st: (
+            calls.append(n), orig_call(n, a, st))[1]
+        try:
+            A._MASK_FLASH_MIN_SK = 128  # below this case's Sk: flash path
+            out = A.scaled_dot_product_attention(_t(q[:1]), _t(k[:1]),
+                                                 _t(v[:1]), attn_mask=m)
+            routed_big = [c for c in calls if "flash" in c or "sdpa" in c]
+            calls.clear()
+            A._MASK_FLASH_MIN_SK = orig_thresh  # S=128 < 1024: XLA path
+            ref = A.scaled_dot_product_attention(_t(q[:1]), _t(k[:1]),
+                                                 _t(v[:1]), attn_mask=m)
+            routed_small = [c for c in calls if "flash" in c or "sdpa" in c]
+        finally:
+            dispatch.call_primitive = orig_call
+            A._MASK_FLASH_MIN_SK = orig_thresh
+            flags.set_flags({"pallas_force_interpret": prev_flag})
+        # the test must FAIL if routing regresses, not pass vacuously
+        assert routed_big == ["flash_attention_p"], routed_big
+        assert routed_small == ["sdpa_mask_p"], routed_small
+        np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_trainable_mask_stays_on_xla_path(self):
+        """A TRAINABLE additive bias must not route to flash (which
+        returns no bias grad): grads must keep flowing at any Sk."""
+        import paddle_tpu.nn.functional.attention as A
+
+        q, k, v, mask = self._case(B=1, n_pad=16)
+        m = _t(mask[:1])  # stop_gradient=False: trainable bias
+        orig_thresh = A._MASK_FLASH_MIN_SK
+        try:
+            A._MASK_FLASH_MIN_SK = 128
+            out = A.scaled_dot_product_attention(_t(q[:1]), _t(k[:1]),
+                                                 _t(v[:1]), attn_mask=m)
+            out.sum().backward()
+        finally:
+            A._MASK_FLASH_MIN_SK = orig_thresh
+        assert m.grad is not None
+        assert np.isfinite(m.grad.numpy()).all()
+
+    def test_fully_masked_row_zero_both_paths(self):
+        """A batch row whose keys are ALL -inf-masked yields exact zeros
+        on BOTH routes (safe softmax), so behavior cannot flip at the
+        Sk crossover."""
+        import paddle_tpu.nn.functional.attention as A
+        from paddle_tpu.core import flags
+
+        q, k, v, _ = self._case(B=2)
+        mask = np.zeros((2, 1, 1, 128), np.float32)
+        mask[1] = -np.inf  # second row: everything masked
+        m = _t(mask, stop_gradient=True)
+        ref = A.scaled_dot_product_attention(_t(q), _t(k), _t(v),
+                                             attn_mask=m)
+        assert np.isfinite(ref.numpy()).all()
+        np.testing.assert_allclose(ref.numpy()[1], 0.0, atol=1e-7)
+        prev_flag = flags.get_flag("pallas_force_interpret")
+        flags.set_flags({"pallas_force_interpret": True})
+        orig_thresh = A._MASK_FLASH_MIN_SK
+        try:
+            A._MASK_FLASH_MIN_SK = 128
+            out = A.scaled_dot_product_attention(_t(q), _t(k), _t(v),
+                                                 attn_mask=m)
+        finally:
+            A._MASK_FLASH_MIN_SK = orig_thresh
+            flags.set_flags({"pallas_force_interpret": prev_flag})
+        np.testing.assert_allclose(out.numpy()[1], 0.0, atol=1e-7)
+        np.testing.assert_allclose(out.numpy()[0], ref.numpy()[0],
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_bias_with_dropout_composes(self):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (
+            flash_attention_bshd)
+
+        q, k, v, mask = self._case()
+        bias = jnp.asarray(mask.reshape(2, -1))
+        s1 = jnp.array([5], jnp.int32)
+        o1, l1 = flash_attention_bshd(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), bias, s1,
+            has_bias=True, dropout_rate=0.2)
+        o1b, _ = flash_attention_bshd(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), bias, s1,
+            has_bias=True, dropout_rate=0.2)
+        assert np.array_equal(np.asarray(o1), np.asarray(o1b))
+        # masked keys stay masked under dropout; lse reflects bias only
+        o0, l0 = flash_attention_bshd(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), bias,
+            has_bias=True)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l0),
+                                   rtol=1e-5)
