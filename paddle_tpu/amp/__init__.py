@@ -26,7 +26,7 @@ _state = threading.local()
 WHITE_LIST = {
     "matmul", "linear_p", "linear_nobias_p", "conv_p", "conv_transpose_p",
     "einsum_1", "einsum_2", "einsum_3", "bilinear_p", "bilinear_nobias_p",
-    "sdpa_p", "sdpa_mask_p", "flash_attention_p",
+    "sdpa_p", "sdpa_mask_p", "flash_attention_p", "flash_attn_varlen_p",
 }
 BLACK_LIST = {
     "reduce_sum", "reduce_mean", "softmax_p", "log_softmax_p", "layer_norm_p",

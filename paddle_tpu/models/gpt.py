@@ -27,6 +27,9 @@ class GPTConfig:
     intermediate_size: int = 3072
     max_position_embeddings: int = 1024
     hidden_dropout_prob: float = 0.1
+    # GPT-2's attn_pdrop; runs inside the Pallas flash kernel (causal +
+    # dropout compose in-kernel, ops/pallas/flash_attention.py)
+    attention_probs_dropout_prob: float = 0.1
     layer_norm_eps: float = 1e-5
     tie_word_embeddings: bool = True
     recompute: bool = False
@@ -52,6 +55,7 @@ class GPTConfig:
 class GPTAttention(nn.Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
+        self.config = config
         self.num_heads = config.num_attention_heads
         self.head_dim = config.hidden_size // config.num_attention_heads
         h = config.hidden_size
@@ -62,7 +66,9 @@ class GPTAttention(nn.Layer):
         b, s, h = x.shape
         qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.config.attention_probs_dropout_prob,
+            is_causal=True, training=self.training)
         return self.out_proj(out.reshape([b, s, h]))
 
 

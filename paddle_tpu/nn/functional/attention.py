@@ -123,9 +123,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             # path as a per-key logit bias instead of the XLA fallback
             from ...ops.pallas.flash_attention import flash_attention_fused
 
+            # a batch-1 mask stays batch-1: the kernel's index map pins
+            # it to row 0 rather than materializing B copies
             bias = m.reshape([m.shape[0], m.shape[3]]).astype("float32")
-            if bias.shape[0] == 1 and q.shape[0] > 1:
-                bias = bias.expand([q.shape[0], m.shape[3]])
+            bias.stop_gradient = True
             # causal=False: the sdpa_mask_p fallback gives the mask
             # precedence over is_causal — both paths must agree
             return flash_attention_fused(

@@ -215,9 +215,12 @@ def _flash_fwd_bhsd(q, k, v, seed=None, key_bias=None, *, causal, scale,
     inputs = [q, k, v]
     if key_bias is not None:
         # [B, 1, Sk] with (1, 1, block_k) blocks: Mosaic wants the last
-        # two block dims (8, 128)-divisible or equal to the array dims
-        in_specs.append(pl.BlockSpec((1, 1, block_k),
-                                     lambda b, h, i, j: (b, Z, j)))
+        # two block dims (8, 128)-divisible or equal to the array dims.
+        # A batch-1 bias (mask shared across the batch) pins the index
+        # map to row 0 instead of materializing B copies.
+        bmap = ((lambda b, h, i, j: (Z, Z, j)) if key_bias.shape[0] == 1
+                else (lambda b, h, i, j: (b, Z, j)))
+        in_specs.append(pl.BlockSpec((1, 1, block_k), bmap))
         inputs.append(key_bias.reshape(key_bias.shape[0], 1,
                                        key_bias.shape[1]))
     if dropout_rate > 0.0:
@@ -430,8 +433,9 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, seed=None, key_bias=None, *,
     ]
     dq_inputs = [q, k, v, do, lse, delta]
     if key_bias is not None:
-        dq_in_specs.append(pl.BlockSpec((1, 1, block_k),
-                                        lambda b, h, i, j: (b, Z, j)))
+        bmap = ((lambda b, h, i, j: (Z, Z, j)) if key_bias.shape[0] == 1
+                else (lambda b, h, i, j: (b, Z, j)))
+        dq_in_specs.append(pl.BlockSpec((1, 1, block_k), bmap))
         dq_inputs.append(key_bias.reshape(key_bias.shape[0], 1,
                                           key_bias.shape[1]))
     if dropout_rate > 0.0:
@@ -472,8 +476,9 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, seed=None, key_bias=None, *,
     dkv_inputs = [q, k, v, do, lse, delta]
     if key_bias is not None:
         # note swapped grid axes here: j=pid2 (k block), i=pid3 (q block)
-        dkv_in_specs.append(pl.BlockSpec((1, 1, block_k),
-                                         lambda b, h, j, i: (b, Z, j)))
+        bmap = ((lambda b, h, j, i: (Z, Z, j)) if key_bias.shape[0] == 1
+                else (lambda b, h, j, i: (b, Z, j)))
+        dkv_in_specs.append(pl.BlockSpec((1, 1, block_k), bmap))
         dkv_inputs.append(key_bias.reshape(key_bias.shape[0], 1,
                                            key_bias.shape[1]))
     if dropout_rate > 0.0:
@@ -581,6 +586,11 @@ def flash_attention_fused(q, k, v, *, causal=False, scale=None,
     extras = []
     statics = dict(causal=bool(causal), scale=scale)
     if key_bias is not None:
+        if not getattr(key_bias, "stop_gradient", True):
+            raise ValueError(
+                "flash_attention_fused: key_bias is a mask input and "
+                "receives no gradient; a trainable additive bias must "
+                "use the XLA attention path (sdpa with attn_mask).")
         extras.append(key_bias)
         statics["has_bias"] = True
     if dropout_p > 0.0:
