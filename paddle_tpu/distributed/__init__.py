@@ -120,6 +120,17 @@ def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
     delegates there."""
     import os
 
+    # validate BEFORE touching the process env: a bad value written here
+    # (e.g. a stringified tensor) poisons every later _env_int() reader
+    rank_id = int(rank_id)
+    rank_num = int(rank_num)
+    if not isinstance(server_endpoint, str):
+        raise TypeError("gloo_init_parallel_env: server_endpoint must be "
+                        f"an 'ip:port' string, got {type(server_endpoint)}")
+    if rank_id < 0 or rank_num <= 0 or rank_id >= rank_num:
+        raise ValueError(
+            f"gloo_init_parallel_env: need 0 <= rank_id < rank_num, got "
+            f"rank_id={rank_id} rank_num={rank_num}")
     os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
     os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
     os.environ.setdefault("PADDLE_MASTER", server_endpoint)

@@ -280,6 +280,22 @@ def _module_for(sub):
     return importlib.import_module(modname)
 
 
+@pytest.fixture(autouse=True)
+def _sweep_env_guard():
+    """Swept callables run with synthesized args and may legitimately
+    mutate process state before rejecting them (found live: a tensor
+    stringified into PADDLE_TRAINERS_NUM via gloo_init_parallel_env,
+    which then broke every later _env_int() reader in the suite).
+    Snapshot and restore os.environ around every sweep."""
+    snap = dict(os.environ)
+    yield
+    for k in set(os.environ) - set(snap):
+        del os.environ[k]
+    for k, v in snap.items():
+        if os.environ.get(k) != v:
+            os.environ[k] = v
+
+
 @pytest.mark.skipif(not os.path.isdir(REF_ROOT),
                     reason="reference tree not mounted")
 @pytest.mark.parametrize("sub", SWEEP_NAMESPACES)

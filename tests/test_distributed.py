@@ -365,3 +365,31 @@ class TestShardWiseCheckpoint:
                                       [dist.Shard(0)])}
         dist.checkpoint.load_state_dict(out, path)
         np.testing.assert_allclose(out["w"].numpy(), w_new)
+
+
+class TestGlooInitValidation:
+    def test_rejects_non_int_ranks_without_touching_env(self):
+        """Found live: the callable sweep passed a synthesized Tensor as
+        rank_num and gloo_init_parallel_env wrote str(Tensor) into
+        PADDLE_TRAINERS_NUM, breaking every later _env_int() reader in
+        the process. Bad args must raise BEFORE the env is touched."""
+        import os
+
+        import pytest as _pytest
+
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        t = paddle.to_tensor(np.random.rand(2, 3).astype("float32"))
+        for bad in (dict(rank_id=0, rank_num=t, server_endpoint="h:1"),
+                    dict(rank_id=t, rank_num=2, server_endpoint="h:1"),
+                    dict(rank_id=0, rank_num=2, server_endpoint=t),
+                    dict(rank_id=5, rank_num=2, server_endpoint="h:1"),
+                    dict(rank_id=0, rank_num=0, server_endpoint="h:1")):
+            snap = {k: os.environ.get(k) for k in
+                    ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+                     "PADDLE_MASTER")}
+            with _pytest.raises((TypeError, ValueError)):
+                dist.gloo_init_parallel_env(**bad)
+            after = {k: os.environ.get(k) for k in snap}
+            assert after == snap, (bad, after)

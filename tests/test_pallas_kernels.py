@@ -691,3 +691,30 @@ class TestFlashKeyBias:
             has_bias=True)
         np.testing.assert_allclose(np.asarray(l1), np.asarray(l0),
                                    rtol=1e-5)
+
+    def test_shared_batch1_mask_multi_batch(self):
+        """A [1, Sk] bias shared across a B>1 batch uses the pinned
+        (row-0) index map in all three kernels — must match the expanded
+        [B, Sk] bias bit-for-bit, fwd and bwd."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (
+            _flash_fwd_bhsd, _flash_bwd_bhsd)
+
+        B, H, S, D = 3, 2, 128, 64
+        rng = np.random.RandomState(8)
+        q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32) * 0.4)
+        k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32) * 0.4)
+        v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32) * 0.4)
+        do = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+        bias1 = jnp.where(jnp.arange(S)[None, :] < 100, 0.0,
+                          -1e9).astype(jnp.float32)          # [1, S]
+        biasB = jnp.broadcast_to(bias1, (B, S))
+        kw = dict(causal=False, scale=0.125)
+        o1, l1 = _flash_fwd_bhsd(q, k, v, None, bias1, **kw)
+        oB, lB = _flash_fwd_bhsd(q, k, v, None, biasB, **kw)
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(oB))
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(lB))
+        g1 = _flash_bwd_bhsd(q, k, v, o1, l1, do, None, bias1, **kw)
+        gB = _flash_bwd_bhsd(q, k, v, oB, lB, do, None, biasB, **kw)
+        for a, b in zip(g1, gB):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
