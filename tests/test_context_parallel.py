@@ -160,3 +160,77 @@ class TestLlamaContextParallel:
             assert np.isfinite(float(loss._value))
         finally:
             set_hybrid_communicate_group(None)
+
+
+class TestFlashRing:
+    """The Pallas flash ring path (chunk%128==0, D%64==0): per-rotation
+    flash blocks + lse merge forward; ring backward against the GLOBAL
+    lse with dk/dv rotating home. Must match the einsum ring exactly."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_ring_matches_einsum_ring(self, causal):
+        from paddle_tpu.core import flags
+        from paddle_tpu.distributed.fleet import context_parallel as CP
+
+        n = 4
+        mesh4 = dist.ProcessMesh(np.arange(n), ["sep"])
+        Bf, Sf, Hf, Df = 1, 512, 1, 64  # chunk=128: flash-eligible
+        paddle.seed(3)
+        q = paddle.randn([Bf, Sf, Hf, Df])
+        k = paddle.randn([Bf, Sf, Hf, Df])
+        v = paddle.randn([Bf, Sf, Hf, Df])
+        qv, kv, vv = q._value, k._value, v._value
+        co = jnp.asarray(np.random.RandomState(0).randn(Bf, Sf, Hf, Df),
+                         qv.dtype)
+
+        import functools as ft
+        spec = CP.P(None, "sep", None, None)
+        scale = Df ** -0.5
+        einsum_fn = CP.shard_map(
+            ft.partial(CP._ring_attn_local, axis="sep", n=n, chunk=Sf // n,
+                       causal=causal, scale=scale),
+            mesh=mesh4.jax_mesh, in_specs=(spec,) * 3, out_specs=spec)
+        flash_fn = CP.shard_map(
+            CP._ring_flash_local_factory("sep", n, causal, scale),
+            mesh=mesh4.jax_mesh, in_specs=(spec,) * 3, out_specs=spec)
+
+        assert CP._ring_use_flash(Sf // n, Df) or not flags.get_flag(
+            "pallas_force_interpret")
+        flags.set_flags({"pallas_force_interpret": True})
+        try:
+            ref = einsum_fn(qv, kv, vv)
+            out = flash_fn(qv, kv, vv)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=3e-6)
+            g_ref = jax.grad(lambda *a: jnp.sum(einsum_fn(*a) * co),
+                             argnums=(0, 1, 2))(qv, kv, vv)
+            g_out = jax.grad(lambda *a: jnp.sum(flash_fn(*a) * co),
+                             argnums=(0, 1, 2))(qv, kv, vv)
+            for a, b in zip(g_out, g_ref):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           atol=5e-6)
+        finally:
+            flags.set_flags({"pallas_force_interpret": False})
+
+    def test_ring_attention_routes_flash_when_eligible(self):
+        """ring_attention picks the flash body for aligned shapes under
+        the interpret flag, the einsum body otherwise — same numbers."""
+        from paddle_tpu.core import flags
+        from paddle_tpu.distributed.fleet import context_parallel as CP
+
+        n = 4
+        mesh4 = dist.ProcessMesh(np.arange(n), ["sep"])
+        Bf, Sf, Hf, Df = 1, 512, 1, 64
+        paddle.seed(5)
+        q = paddle.randn([Bf, Sf, Hf, Df])
+        # einsum path (flag off on CPU)
+        ref = ring_attention(q, q, q, mesh4, "sep", causal=True)
+        assert not CP._ring_use_flash(Sf // n, Df)
+        flags.set_flags({"pallas_force_interpret": True})
+        try:
+            assert CP._ring_use_flash(Sf // n, Df)
+            out = ring_attention(q, q, q, mesh4, "sep", causal=True)
+        finally:
+            flags.set_flags({"pallas_force_interpret": False})
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   np.asarray(ref._value), atol=3e-6)
