@@ -157,6 +157,10 @@ define_flag("log_level", 0, "VLOG analog verbosity")
 define_flag("benchmark", False, "sync after each op for timing")
 define_flag("stop_check_timeout", 900, "collective watchdog timeout seconds (parallel.py:1133)")
 define_flag("cache_inference_while_scope", False, "parity placeholder")
+define_flag("check_embedding_bounds", True,
+            "eager-mode embedding id range check (one blocking "
+            "device->host sync per call; disable in eager inner loops "
+            "where throughput matters — jit paths never pay it)")
 define_flag("use_pallas_flash_attention", True,
             "use the Pallas flash-attention kernel on TPU backends")
 define_flag("use_pallas_rms_norm", True,

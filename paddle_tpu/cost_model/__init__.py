@@ -14,19 +14,44 @@ class CostModel:
         pass
 
     def profile_measure(self, startup_program=None, main_program=None,
-                        device="tpu", fetch_cost_list=("time",)):
-        """Analytic estimate for a transformer-shaped TuneSpace dict (the
-        reference measures a program; the TPU path scores configs with
-        distributed.auto_tuner's roofline model)."""
+                        device="tpu", fetch_cost_list=("time",),
+                        tune_space=None, candidate=None):
+        """Analytic estimate for a transformer-shaped model description
+        (the reference measures a static program; the TPU path scores
+        configs with distributed.auto_tuner's roofline model).
+
+        Pass ``tune_space`` (an ``auto_tuner.TuneSpace`` or a kwargs
+        dict for one) describing the model/hardware, and optionally
+        ``candidate`` (an ``auto_tuner.Candidate`` or kwargs dict) for
+        the parallelism config to score. Static programs are NOT
+        costed on the TPU path — passing one raises instead of being
+        silently ignored."""
+        if startup_program is not None or main_program is not None:
+            raise NotImplementedError(
+                "CostModel.profile_measure on the TPU backend does not "
+                "cost static programs; describe the model with "
+                "tune_space=TuneSpace(...) (and optionally candidate=) "
+                "instead. Refusing to silently ignore the program "
+                "arguments.")
         from ..distributed.auto_tuner import (
             Candidate, TuneSpace, estimate_memory_bytes,
             estimate_step_time_s,
         )
 
-        space = TuneSpace()
-        cand = Candidate(dp=1, mp=1, pp=1, sharding_stage=0,
-                         micro_batch_size=space.global_batch_size,
-                         recompute=False)
+        if tune_space is None:
+            space = TuneSpace()
+        elif isinstance(tune_space, TuneSpace):
+            space = tune_space
+        else:
+            space = TuneSpace(**dict(tune_space))
+        if candidate is None:
+            cand = Candidate(dp=1, mp=1, pp=1, sharding_stage=0,
+                             micro_batch_size=space.global_batch_size,
+                             recompute=False)
+        elif isinstance(candidate, Candidate):
+            cand = candidate
+        else:
+            cand = Candidate(**dict(candidate))
         return {
             "time": estimate_step_time_s(space, cand),
             "memory": estimate_memory_bytes(space, cand),

@@ -39,7 +39,8 @@ import numpy as np
 
 from ...core.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle",
+           "wait_async_save"]
 
 
 def _meta_path(path, host: Optional[int] = None):
@@ -83,11 +84,69 @@ def _logical_view(arr: np.ndarray, logical: str) -> np.ndarray:
     return arr
 
 
+class AsyncSaveHandle:
+    """Join handle for ``save_state_dict(async_save=True)``.
+
+    The device->host snapshot is taken SYNCHRONOUSLY inside
+    ``save_state_dict`` (so training can mutate parameters immediately
+    after it returns without corrupting the checkpoint); only the file
+    writes run on the background thread. ``wait()`` re-raises any
+    writer-thread exception — an unawaited failed save must not pass
+    silently (reference: checkpoint async_save's pinned-memory copy +
+    background flush)."""
+
+    def __init__(self, thread=None):
+        self._thread = thread
+        self._exc: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        """True once the writer finished SUCCESSFULLY; a failed write
+        raises here as well as in wait() — polling done() must never
+        report a broken checkpoint as durable."""
+        if self._thread is not None and self._thread.is_alive():
+            return False
+        if self._exc is not None:
+            self.wait()
+        return True
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+
+_PENDING_SAVES: Dict[str, AsyncSaveHandle] = {}
+
+
+def wait_async_save(path: Optional[str] = None):
+    """Block until pending async saves (for ``path``, or all) finish."""
+    targets = ([os.path.abspath(path)] if path is not None
+               else list(_PENDING_SAVES))
+    for key in targets:
+        h = _PENDING_SAVES.pop(key, None)
+        if h is not None:
+            h.wait()
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
-                    unique_id=None, async_save=False):
+                    unique_id=None, async_save=False) -> AsyncSaveHandle:
     """Write one .npy per locally-owned shard + this host's metadata
-    fragment (save_state_dict.py:94). Hosts never exchange data."""
+    fragment (save_state_dict.py:94). Hosts never exchange data.
+
+    ``async_save=True`` snapshots shard data to host inline, then runs
+    the file IO on a daemon thread; the returned handle's ``wait()``
+    joins it (and a later save or load touching the same path joins it
+    automatically). The auto-join is PER-PROCESS: a multi-host job must
+    barrier after every host's ``wait()`` before any host loads, and
+    should pass a fresh ``unique_id`` per attempt so a straggler host's
+    stale fragments are rejected at merge instead of mixed in."""
+    # a second save into a directory with an in-flight async writer must
+    # not interleave files from two attempts
+    wait_async_save(path)
     os.makedirs(path, exist_ok=True)
     host = jax.process_index()
     # save-attempt id binds fragments together: load refuses to merge
@@ -103,6 +162,7 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
                             "num_hosts": jax.process_count(),
                             "save_id": str(unique_id)}
     objects: Dict[str, Any] = {}
+    npy_writes: List[Tuple[str, np.ndarray]] = []
     for tensor_idx, (name, t) in enumerate(sorted(state_dict.items())):
         if not isinstance(t, Tensor):
             meta["tensors"][name] = {"kind": "object"}
@@ -110,6 +170,8 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
             continue
         v = t._value
         shards = []
+        # np.asarray here is the device->host snapshot: it happens NOW,
+        # so async mode is safe against subsequent parameter updates
         local = [(s.index, np.asarray(s.data))
                  for s in getattr(v, "addressable_shards", [])]
         if not local:
@@ -125,7 +187,7 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
             seen.add(key)
             fname = _npy_name(host, tensor_idx, k)
             store, logical = _storage_view(data)
-            np.save(os.path.join(path, fname), store, allow_pickle=False)
+            npy_writes.append((fname, store))
             shards.append({"index": _index_to_json(index, v.shape),
                            "file": fname})
             k += 1
@@ -135,17 +197,54 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
             "dtype": logical,
             "shards": shards,
         }
+    object_bytes = None
     if objects:
-        with open(os.path.join(path, f"objects_{host}.pkl"), "wb") as f:
-            pickle.dump(objects, f, protocol=4)
         meta["object_file"] = f"objects_{host}.pkl"
-    with open(_meta_path(path, host), "w") as f:
-        json.dump(meta, f)
-    if host == 0:
-        # single-host jobs also get the legacy-named global file so
-        # tooling that looks for metadata.json still finds one
-        with open(_meta_path(path), "w") as f:
+        # serialize NOW: non-tensor entries (optimizer dicts, step
+        # counters) get the same snapshot-at-call guarantee as tensors
+        object_bytes = pickle.dumps(objects, protocol=4)
+
+    def _flush():
+        for fname, store in npy_writes:
+            np.save(os.path.join(path, fname), store, allow_pickle=False)
+        if object_bytes is not None:
+            with open(os.path.join(path, f"objects_{host}.pkl"), "wb") as f:
+                f.write(object_bytes)
+        # metadata last: its presence marks the fragment complete
+        with open(_meta_path(path, host), "w") as f:
             json.dump(meta, f)
+        if host == 0:
+            # single-host jobs also get the legacy-named global file so
+            # tooling that looks for metadata.json still finds one
+            with open(_meta_path(path), "w") as f:
+                json.dump(meta, f)
+
+    if not async_save:
+        _flush()
+        return AsyncSaveHandle()
+
+    import threading
+
+    handle = AsyncSaveHandle()
+
+    def _run():
+        try:
+            _flush()
+        except BaseException as e:
+            # surfaced by wait()/done(); also logged now so a save the
+            # caller never polls cannot fail invisibly
+            handle._exc = e
+            import sys
+
+            print(f"paddle_tpu async checkpoint save to {path!r} "
+                  f"FAILED: {e!r}", file=sys.stderr)
+
+    thread = threading.Thread(target=_run, name="ptpu-async-ckpt-save",
+                              daemon=True)
+    handle._thread = thread
+    _PENDING_SAVES[os.path.abspath(path)] = handle
+    thread.start()
+    return handle
 
 
 def _norm_index(index, shape):
@@ -249,6 +348,7 @@ def load_state_dict(state_dict: Dict[str, Any], path: str, process_group=None,
     """Fill ``state_dict``'s tensors from checkpoint, resharding to each
     tensor's CURRENT layout shard-wise: only the saved shards that
     overlap this host's placement are read (load_state_dict.py:394)."""
+    wait_async_save(path)  # a half-flushed async save must not be read
     meta = _merge_meta(path)
     if meta.get("format", 1) < 2:
         return _load_state_dict_v1(state_dict, path, meta)

@@ -210,7 +210,7 @@ def _ring_flash_local_factory(axis, n, causal, scale):
     return ring
 
 
-def _ring_use_flash(chunk: int, head_dim: int) -> bool:
+def _ring_use_flash(chunk: int, head_dim: int, nq: int, nkv: int) -> bool:
     from ...core.flags import get_flag
 
     if not get_flag("use_pallas_flash_attention"):
@@ -218,7 +218,10 @@ def _ring_use_flash(chunk: int, head_dim: int) -> bool:
     if (jax.default_backend() != "tpu"
             and not get_flag("pallas_force_interpret")):
         return False
-    return chunk % 128 == 0 and head_dim % 64 == 0
+    # non-divisible GQA head counts would silently floor-divide in the
+    # kernel's kv-head map; let them fall back to the einsum path, which
+    # rejects them with a shape error instead
+    return chunk % 128 == 0 and head_dim % 64 == 0 and nq % nkv == 0
 
 
 def _ring_attn_fwd(q, k, v, *, mesh: ProcessMesh, axis: str, causal: bool,
@@ -228,7 +231,7 @@ def _ring_attn_fwd(q, k, v, *, mesh: ProcessMesh, axis: str, causal: bool,
         scale = q.shape[-1] ** -0.5
     chunk = q.shape[1] // n
     spec = P(None, axis, None, None)                 # [B, S, H, D]: shard S
-    if _ring_use_flash(chunk, q.shape[-1]):
+    if _ring_use_flash(chunk, q.shape[-1], q.shape[2], k.shape[2]):
         fn = _ring_flash_local_factory(axis, n, bool(causal), float(scale))
     else:
         fn = functools.partial(_ring_attn_local, axis=axis, n=n, chunk=chunk,

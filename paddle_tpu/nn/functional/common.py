@@ -152,7 +152,10 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Reference: nn/functional/input.py embedding (note arg order: ids
     first). Grad scatter accumulates in f32 when weights are bf16."""
     ids, w = ensure_tensor(x), ensure_tensor(weight)
-    if (isinstance(ids._value, jax.Array)
+    from ...core.flags import get_flag
+
+    if (get_flag("check_embedding_bounds")
+            and isinstance(ids._value, jax.Array)
             and not isinstance(ids._value, jax.core.Tracer)
             and ids._value.size):
         # eager-mode bounds check (reference embedding kernels enforce

@@ -593,7 +593,16 @@ def flash_attention_fused(q, k, v, *, causal=False, scale=None,
                 "use the XLA attention path (sdpa with attn_mask).")
         extras.append(key_bias)
         statics["has_bias"] = True
+    if not 0.0 <= dropout_p < 1.0:
+        raise ValueError(
+            f"flash_attention_fused: dropout_p must be in [0, 1), "
+            f"got {dropout_p} (the 1/(1-p) keep-scale diverges at 1)")
     if dropout_p > 0.0:
+        if rng is None:
+            raise ValueError(
+                "flash_attention_fused: dropout_p > 0 requires rng (a "
+                "Tensor wrapping a jax PRNG key) for the in-kernel "
+                "counter RNG")
         key_bits = jax.lax.bitcast_convert_type(
             jax.random.key_data(rng._value), jnp.int32).ravel()
         extras.append(Tensor._from_value((key_bits[:1] ^ key_bits[-1:])))

@@ -194,7 +194,7 @@ class TestFlashRing:
             CP._ring_flash_local_factory("sep", n, causal, scale),
             mesh=mesh4.jax_mesh, in_specs=(spec,) * 3, out_specs=spec)
 
-        assert CP._ring_use_flash(Sf // n, Df) or not flags.get_flag(
+        assert CP._ring_use_flash(Sf // n, Df, Hf, Hf) or not flags.get_flag(
             "pallas_force_interpret")
         flags.set_flags({"pallas_force_interpret": True})
         try:
@@ -225,12 +225,28 @@ class TestFlashRing:
         q = paddle.randn([Bf, Sf, Hf, Df])
         # einsum path (flag off on CPU)
         ref = ring_attention(q, q, q, mesh4, "sep", causal=True)
-        assert not CP._ring_use_flash(Sf // n, Df)
+        assert not CP._ring_use_flash(Sf // n, Df, Hf, Hf)
         flags.set_flags({"pallas_force_interpret": True})
         try:
-            assert CP._ring_use_flash(Sf // n, Df)
+            assert CP._ring_use_flash(Sf // n, Df, Hf, Hf)
             out = ring_attention(q, q, q, mesh4, "sep", causal=True)
         finally:
             flags.set_flags({"pallas_force_interpret": False})
         np.testing.assert_allclose(np.asarray(out._value),
                                    np.asarray(ref._value), atol=3e-6)
+
+
+class TestFlashRingGQAGate:
+    def test_non_divisible_gqa_falls_back_to_einsum(self):
+        """nq % nkv != 0 would floor-divide in the flash kernel's
+        kv-head map; the gate must route such shapes to the einsum path
+        (which fails loudly on real mismatches) — advisor round-4."""
+        from paddle_tpu.core import flags
+        from paddle_tpu.distributed.fleet import context_parallel as CP
+
+        flags.set_flags({"pallas_force_interpret": True})
+        try:
+            assert CP._ring_use_flash(128, 64, 4, 2)       # divisible: ok
+            assert not CP._ring_use_flash(128, 64, 3, 2)   # 3 % 2 != 0
+        finally:
+            flags.set_flags({"pallas_force_interpret": False})

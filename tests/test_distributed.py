@@ -293,6 +293,60 @@ class TestShardWiseCheckpoint:
             out["w"].astype("float32").numpy(),
             t.astype("float32").numpy())
 
+    def test_async_save_roundtrip_and_wait(self, tmp_path):
+        """async_save returns a handle; wait() (or a later load, which
+        joins automatically) makes the checkpoint durable — and the
+        snapshot is taken at call time, so mutating the parameter after
+        save_state_dict returns must not corrupt it."""
+        mesh = dist.ProcessMesh(np.arange(8), ["x"])
+        w = _r(16, 8)
+        t = dist.shard_tensor(w.copy(), mesh, [dist.Shard(0)])
+        path = str(tmp_path / "ckpt_async")
+        handle = dist.checkpoint.save_state_dict(
+            {"w": t, "step": 3}, path, async_save=True)
+        # overwrite the tensor AFTER the save call: the checkpoint must
+        # still hold the old values (snapshot-at-call semantics)
+        t2 = dist.shard_tensor(np.zeros_like(w), mesh, [dist.Shard(0)])
+        out = {"w": t2, "step": None}
+        dist.checkpoint.load_state_dict(out, path)  # joins the writer
+        assert handle.done()
+        np.testing.assert_allclose(out["w"].numpy(), w)
+        assert out["step"] == 3
+        handle.wait()  # idempotent
+
+    def test_async_save_second_save_joins_first(self, tmp_path):
+        """Two back-to-back async saves into the same dir must not
+        interleave; the final state is the second save's."""
+        mesh = dist.ProcessMesh(np.arange(8), ["x"])
+        path = str(tmp_path / "ckpt_async2")
+        w1, w2 = _r(8, 8), _r(8, 8)
+        t1 = dist.shard_tensor(w1.copy(), mesh, [dist.Shard(0)])
+        dist.checkpoint.save_state_dict({"w": t1}, path, async_save=True)
+        t2s = dist.shard_tensor(w2.copy(), mesh, [dist.Shard(0)])
+        h2 = dist.checkpoint.save_state_dict({"w": t2s}, path,
+                                             async_save=True)
+        h2.wait()
+        out = {"w": dist.shard_tensor(np.zeros((8, 8), "float32"), mesh,
+                                      [dist.Shard(0)])}
+        dist.checkpoint.load_state_dict(out, path)
+        np.testing.assert_allclose(out["w"].numpy(), w2)
+
+    def test_async_save_error_surfaces_on_wait(self, tmp_path, monkeypatch):
+        """A writer-thread failure must raise from wait(), not vanish."""
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        mesh = dist.ProcessMesh(np.arange(8), ["x"])
+        t = dist.shard_tensor(_r(8, 8), mesh, [dist.Shard(0)])
+        path = str(tmp_path / "ckpt_async_err")
+
+        def _boom(*a, **kw):
+            raise OSError("disk full (injected)")
+
+        monkeypatch.setattr(ckpt.np, "save", _boom)
+        handle = ckpt.save_state_dict({"w": t}, path, async_save=True)
+        with pytest.raises(OSError, match="injected"):
+            handle.wait()
+
     def test_peak_host_memory_stays_shard_sized(self, tmp_path):
         """Shard-wise load must assemble per-PIECE buffers, never the
         dense tensor. Assert (a) one piece assembly allocates piece-

@@ -369,6 +369,26 @@ class TestFlashDropout:
     flash_attn_kernel.cu:35 rng plumbing; here a counter RNG regenerated
     identically in fwd and both bwd kernels)."""
 
+    def test_invalid_dropout_args_raise(self):
+        """Direct calls with dropout_p>=1 or a missing rng must fail
+        with a clear ValueError, not a late division-by-zero or
+        AttributeError (advisor round-4)."""
+        import pytest
+
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.ops.pallas.flash_attention import \
+            flash_attention_fused
+
+        q = Tensor._from_value(
+            __import__("jax.numpy", fromlist=["x"]).zeros((1, 128, 2, 64)))
+        with pytest.raises(ValueError, match="requires rng"):
+            flash_attention_fused(q, q, q, dropout_p=0.5, rng=None)
+        import jax
+
+        rng = Tensor._from_value(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match=r"\[0, 1\)"):
+            flash_attention_fused(q, q, q, dropout_p=1.0, rng=rng)
+
     def _arrays(self, B=1, S=128, H=2, D=64, seed=0):
         rng = np.random.RandomState(seed)
         mk = lambda: rng.randn(B, S, H, D).astype(np.float32) * 0.3
