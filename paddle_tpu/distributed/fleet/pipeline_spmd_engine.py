@@ -284,7 +284,8 @@ def stack_chunk_params(per_chunk_params):
 def pipeline_schedule_train_step(stage_fn: Callable, loss_fn: Callable,
                                  chunk_params, micro_inputs, micro_labels,
                                  *, mesh, plan: PipelinePlan,
-                                 axis: str = "pp", param_pspecs=None):
+                                 axis: str = "pp", param_pspecs=None,
+                                 data_axis: str = None):
     """Run one TRAIN step of ``plan`` (fwd + bwd + grads, one XLA program).
 
     stage_fn(params, x) -> y shape-preserving; loss_fn(y, label) ->
@@ -304,6 +305,13 @@ def pipeline_schedule_train_step(stage_fn: Callable, loss_fn: Callable,
     stage_fn with jax.vjp inside shard_map, where a bare psum
     transposes into another psum and scales sharded-weight grads by the
     TP degree. Defaults to fully replicated stage params.
+
+    3-axis hybrid (dp x mp x pp): pass ``data_axis`` — the microbatch
+    BATCH dim (dim 1 of micro_inputs/labels) shards over it, each dp
+    group runs the full schedule on its slice, and the returned loss
+    and grads are pmean'd over ``data_axis`` (the reference's DP
+    gradient allreduce around the hybrid pipeline,
+    test/auto_parallel/hybrid_strategy/).
 
     Returns (mean loss, chunk grads pytree [C, ...] — gradients of the
     MEAN loss, matching pipeline_spmd_train_step)."""
@@ -339,7 +347,8 @@ def pipeline_schedule_train_step(stage_fn: Callable, loss_fn: Callable,
             lambda _, sp: P(*((None, axis) + tuple(sp))),
             params_vs, param_pspecs,
             is_leaf=lambda x: isinstance(x, P))
-    in_specs = (pspec_vs, P(), P())
+    data_spec = P(None, data_axis) if data_axis is not None else P()
+    in_specs = (pspec_vs, data_spec, data_spec)
     out_specs = (P(), pspec_vs)
 
     @functools.partial(jax.shard_map, mesh=mesh, in_specs=in_specs,
@@ -422,6 +431,11 @@ def pipeline_schedule_train_step(stage_fn: Callable, loss_fn: Callable,
         loss = lax.psum(state["loss"], axis) / M
         grads = jax.tree_util.tree_map(
             lambda g: (g / M)[:, None], state["grads"])
+        if data_axis is not None:
+            # dp reduction: each dp group saw its own batch slice
+            loss = lax.pmean(loss, data_axis)
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, data_axis), grads)
         return loss, grads
 
     loss, grads_vs = run(params_vs, micro_inputs, micro_labels)

@@ -24,8 +24,14 @@ forward, so any architecture drift fails loudly.
 
 Supports: greedy, temperature / top-k / top-p sampling, eos early-stop
 (fixed-length scan with post-eos masking — compiler-friendly control
-flow instead of a data-dependent loop). Same-length prompts per batch
-(left-padding is not implemented; reject ragged input).
+flow instead of a data-dependent loop), LEFT-PADDED mixed-length
+prompts (``pad_token_id=...``: per-row rope/position offsets + a
+pad-aware visibility mask, every row pinned against its own
+full-prefix oracle in tests), and a PAGED block-KV-cache decode path
+(``paged=True``, Llama family) that drives the same
+``block_mha_p`` program the serving op
+``incubate.nn.functional.block_multihead_attention`` exposes
+(reference: incubate/nn/functional/block_multihead_attention.py:19).
 """
 from __future__ import annotations
 
@@ -63,12 +69,14 @@ def _llama_decode_params(model):
     )
 
 
-def _cached_forward(p, tokens, caches, pos, s_max):
+def _cached_forward(p, tokens, caches, pos, s_max, pads=None):
     """Forward ``tokens`` [B, T] through the stack at absolute positions
     ``pos..pos+T-1``, reading/updating the per-layer KV caches
     [B, S_max, kvh, dh]. Returns (last-position hidden [B, H], caches).
     Causal within the new tokens; full attention to everything cached
-    before ``pos``."""
+    before ``pos``. ``pads`` [B] (left-pad counts) offsets each row's
+    rope positions and blanks its pad slots out of the visibility mask
+    — the ragged-prompt path."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -89,13 +97,21 @@ def _cached_forward(p, tokens, caches, pos, s_max):
 
     cos_full, sin_full = _rope_tables(s_max, dh, p["theta"], True,
                                       jnp.float32)
-    positions = pos + jnp.arange(t)
-    cos = jnp.take(cos_full, positions, axis=0)[None, :, None, :]
-    sin = jnp.take(sin_full, positions, axis=0)[None, :, None, :]
-
-    # query i (absolute pos+i) may see cache slot j iff j <= pos+i
-    slot = jnp.arange(s_max)[None, :]                 # [1, S_max]
-    visible = slot <= (pos + jnp.arange(t))[:, None]  # [T, S_max]
+    positions = pos + jnp.arange(t)                   # absolute [T]
+    if pads is None:
+        cos = jnp.take(cos_full, positions, axis=0)[None, :, None, :]
+        sin = jnp.take(sin_full, positions, axis=0)[None, :, None, :]
+        # query i (absolute pos+i) may see cache slot j iff j <= pos+i
+        slot = jnp.arange(s_max)[None, :]             # [1, S_max]
+        visible = (slot <= positions[:, None])[None]  # [1, T, S_max]
+    else:
+        # per-row logical positions: absolute minus this row's pad run
+        rel = jnp.maximum(positions[None, :] - pads[:, None], 0)  # [B, T]
+        cos = jnp.take(cos_full, rel, axis=0)[:, :, None, :]
+        sin = jnp.take(sin_full, rel, axis=0)[:, :, None, :]
+        slot = jnp.arange(s_max)[None, None, :]
+        visible = (slot <= positions[None, :, None]) \
+            & (slot >= pads[:, None, None])           # [B, T, S_max]
 
     new_caches = []
     for lp, cache in zip(p["layers"], caches):
@@ -151,7 +167,7 @@ def _gpt_decode_params(model):
     return out
 
 
-def _gpt_cached_forward(p, tokens, caches, pos, s_max):
+def _gpt_cached_forward(p, tokens, caches, pos, s_max, pads=None):
     """GPT block stack with a dense KV cache (pre-LN, learned
     positions); same contract as the llama `_cached_forward`."""
     import jax
@@ -161,8 +177,17 @@ def _gpt_cached_forward(p, tokens, caches, pos, s_max):
     b, t = tokens.shape
     nh, dh = p["nh"], p["dh"]
     positions = pos + jnp.arange(t)
-    x = jnp.take(p["embed"], tokens, axis=0) \
-        + jnp.take(p["wpe"], positions, axis=0)[None, :, :]
+    if pads is None:
+        wpe_rows = jnp.take(p["wpe"], positions, axis=0)[None, :, :]
+        slot = jnp.arange(s_max)[None, :]
+        visible = (slot <= positions[:, None])[None]  # [1, T, S_max]
+    else:
+        rel = jnp.maximum(positions[None, :] - pads[:, None], 0)  # [B, T]
+        wpe_rows = jnp.take(p["wpe"], rel, axis=0)    # [B, T, H]
+        slot = jnp.arange(s_max)[None, None, :]
+        visible = (slot <= positions[None, :, None]) \
+            & (slot >= pads[:, None, None])
+    x = jnp.take(p["embed"], tokens, axis=0) + wpe_rows
     dtype = x.dtype
 
     def ln(h, g, bb):
@@ -172,9 +197,6 @@ def _gpt_cached_forward(p, tokens, caches, pos, s_max):
         y = (h32 - mu) * lax.rsqrt(var + p["eps"])
         return (y * g.astype(jnp.float32)
                 + bb.astype(jnp.float32)).astype(dtype)
-
-    slot = jnp.arange(s_max)[None, :]
-    visible = slot <= (pos + jnp.arange(t))[:, None]
 
     new_caches = []
     for lp, cache in zip(p["layers"], caches):
@@ -230,7 +252,8 @@ def _cached_attention(q, k, v, cache, pos, visible, n_rep):
     logits = jnp.einsum("bthd,bshd->bhts", q, kk,
                         preferred_element_type=jnp.float32)
     logits = logits * (dh ** -0.5)
-    logits = jnp.where(visible[None, None, :, :], logits,
+    # visible: [1 or B, T, S_max] — broadcast over heads
+    logits = jnp.where(visible[:, None, :, :], logits,
                        jnp.float32(-1e30))
     attn = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     ctx = jnp.einsum("bhts,bshd->bthd", attn, vv).reshape(b, t, -1)
@@ -261,15 +284,37 @@ def _sample_token(logits, key, *, do_sample, temperature, top_k, top_p):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _check_left_padded(ids_np, pad: int):
+    """Leading-pad counts [B]; reject pads anywhere but a left run."""
+    b, t0 = ids_np.shape
+    is_pad = ids_np == pad
+    pads = np.argmax(~is_pad, axis=1).astype(np.int32)
+    pads = np.where(is_pad.all(axis=1), t0, pads)
+    if (pads >= t0).any():
+        raise ValueError("generate: a prompt row is entirely padding")
+    for r in range(b):
+        if is_pad[r, pads[r]:].any():
+            raise ValueError(
+                "generate(pad_token_id=...) expects LEFT-padded prompts; "
+                f"row {r} has pad tokens after its first real token")
+    return pads
+
+
 def generate(model, input_ids, max_new_tokens: int = 32,
              do_sample: bool = False, temperature: float = 1.0,
              top_k: int = 0, top_p: float = 1.0,
-             eos_token_id: Optional[int] = None, seed: int = 0):
+             eos_token_id: Optional[int] = None, seed: int = 0,
+             pad_token_id: Optional[int] = None, paged: bool = False,
+             block_size: int = 64):
     """Decode ``max_new_tokens`` from a Llama- or GPT-family causal
-    LM with a
-    dense KV cache; the whole loop is ONE jitted scan. Returns
+    LM with a KV cache; the whole loop is ONE jitted scan. Returns
     ``[B, prompt_len + max_new_tokens]`` (prompt included); positions
-    after an emitted ``eos_token_id`` are filled with eos."""
+    after an emitted ``eos_token_id`` are filled with eos.
+
+    ``pad_token_id``: enables LEFT-padded mixed-length prompts (each
+    row decodes at its own logical positions). ``paged=True`` decodes
+    over a paged/block KV cache via the serving ``block_mha_p`` program
+    (Llama family; composes with ragged prompts)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -282,6 +327,18 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     b, t0 = ids.shape
     if max_new_tokens <= 0:
         return Tensor._from_value(ids)
+    pads_np = None
+    if pad_token_id is not None:
+        pads_np = _check_left_padded(np.asarray(ids), int(pad_token_id))
+        if not pads_np.any():
+            pads_np = None                    # no row is actually padded
+    if paged:
+        return _generate_paged(model, ids, pads_np,
+                               max_new_tokens=max_new_tokens,
+                               do_sample=do_sample, temperature=temperature,
+                               top_k=top_k, top_p=top_p,
+                               eos_token_id=eos_token_id, seed=seed,
+                               block_size=block_size)
     p, fwd = _decode_family(model)
     s_max = t0 + max_new_tokens
     max_pos = p.get("max_positions")
@@ -300,12 +357,12 @@ def generate(model, input_ids, max_new_tokens: int = 32,
                   if not hasattr(v, "dtype") and not isinstance(v, list)}
     arrays = {k: v for k, v in p.items() if k not in static_cfg}
 
-    def _run(arrs, ids, key):
+    def _run(arrs, ids, pads, key):
         p = {**arrs, **static_cfg}
         caches = [(jnp.zeros((b, s_max, nkv, dh), dtype),
                    jnp.zeros((b, s_max, nkv, dh), dtype))
                   for _ in range(L)]
-        hidden, caches = fwd(p, ids, caches, 0, s_max)
+        hidden, caches = fwd(p, ids, caches, 0, s_max, pads=pads)
         logits0 = _head_logits(p, hidden)
         key, sub = jax.random.split(key)
         tok0 = _sample_token(logits0, sub, do_sample=do_sample,
@@ -323,7 +380,7 @@ def generate(model, input_ids, max_new_tokens: int = 32,
             tok, done, key, *flat = carry
             caches_ = [(flat[2 * j], flat[2 * j + 1]) for j in range(L)]
             hidden, caches_ = fwd(
-                p, tok[:, None], caches_, t0 + i - 1, s_max)
+                p, tok[:, None], caches_, t0 + i - 1, s_max, pads=pads)
             logits = _head_logits(p, hidden)
             key, sub = jax.random.split(key)
             nxt = _sample_token(logits, sub, do_sample=do_sample,
@@ -344,11 +401,153 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     # (weights update between calls; baking them as closure constants
     # would both bloat the executable and force a retrace per call)
     cache = model.__dict__.setdefault("_generation_jit_cache", {})
+    ragged = pads_np is not None
     sig = (b, t0, max_new_tokens, do_sample, float(temperature),
-           int(top_k), float(top_p), eos)
+           int(top_k), float(top_p), eos, ragged)
     fn = cache.get(sig)
     if fn is None:
-        fn = jax.jit(_run)
+        fn = jax.jit(_run, static_argnums=() if ragged else (2,))
         cache[sig] = fn
-    out = fn(arrays, ids, jax.random.PRNGKey(seed))
+    pads_arg = jnp.asarray(pads_np) if ragged else None
+    out = fn(arrays, ids, pads_arg, jax.random.PRNGKey(seed))
+    return Tensor._from_value(out)
+
+
+def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
+                    temperature, top_k, top_p, eos_token_id, seed,
+                    block_size):
+    """Paged/block-KV-cache decode: the prefill packs each row's REAL
+    tokens left-aligned into a varlen batch and one ``block_mha_p``
+    call per layer writes them straight into the block pool; each scan
+    tick appends one token per row through the same program's
+    decode branch. Cache memory is per-LOGICAL-token (pads never enter
+    the pool), and the attention view is gathered through the block
+    table exactly like the reference's serving kernel
+    (block_multihead_attention.py:19)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..incubate.nn.functional import _rope_tables
+    from ..incubate.nn.functional.inference_attention import _bmha_fwd
+
+    if not hasattr(model, "llama"):
+        raise NotImplementedError(
+            "paged=True decode supports the Llama family (the flagship "
+            "serving path); the GPT family uses the dense cache")
+    p = _llama_decode_params(model)
+    b, t0 = ids.shape
+    nh, nkv, dh = p["nh"], p["nkv"], p["dh"]
+    L = len(p["layers"])
+    dtype = p["embed"].dtype
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+    s_max = t0 + max_new_tokens
+    blocks_per_seq = -(-s_max // block_size)
+    nb = b * blocks_per_seq
+    # disjoint row-major block allocation: row b owns blocks
+    # [b*blocks_per_seq, (b+1)*blocks_per_seq)
+    tables_np = (np.arange(nb, dtype=np.int32)
+                 .reshape(b, blocks_per_seq))
+    static_cfg = {k: v for k, v in p.items()
+                  if not hasattr(v, "dtype") and not isinstance(v, list)}
+    arrays = {k: v for k, v in p.items() if k not in static_cfg}
+
+    def _run(arrs, ids, pads, key):
+        p = {**arrs, **static_cfg}
+        tables = jnp.asarray(tables_np)
+        enc = (jnp.full((b,), t0, jnp.int32) if pads is None
+               else (t0 - pads).astype(jnp.int32))
+        # pack real tokens left-aligned per row: row b's segment is
+        # [b*t0, b*t0 + enc_b); the clipped tail duplicates are masked
+        # out of the cache/attention by enc
+        shift = (jnp.zeros((b, 1), jnp.int32) if pads is None
+                 else pads[:, None])
+        gather_cols = jnp.minimum(shift + jnp.arange(t0)[None, :], t0 - 1)
+        packed = jnp.take_along_axis(ids, gather_cols, axis=1).reshape(-1)
+        starts = jnp.arange(b, dtype=jnp.int32) * t0
+        cos_full, sin_full = _rope_tables(s_max, dh, p["theta"], True,
+                                          jnp.float32)
+        # reference rope layout [2, B, S, 1, D]
+        rope = jnp.stack([
+            jnp.broadcast_to(cos_full[None, :, None, :], (b, s_max, 1, dh)),
+            jnp.broadcast_to(sin_full[None, :, None, :], (b, s_max, 1, dh)),
+        ]).astype(jnp.float32)
+
+        def rms(h, g):
+            h32 = h.astype(jnp.float32)
+            y = h32 * lax.rsqrt(
+                jnp.mean(h32 * h32, axis=-1, keepdims=True) + p["eps"])
+            return (y * g.astype(jnp.float32)).astype(dtype)
+
+        def stack_step(tokens_flat, caches, enc_now, dec_now, cu):
+            """One forward through all layers on packed rows [T, H];
+            returns (hidden rows [T, H], new caches)."""
+            x = jnp.take(p["embed"], tokens_flat, axis=0)
+            new_caches = []
+            for lp, (kc, vc) in zip(p["layers"], caches):
+                h = rms(x, lp["ln1"])
+                q = h @ lp["wq"]
+                k = h @ lp["wk"]
+                v = h @ lp["wv"]
+                qkv = jnp.concatenate([q, k, v], axis=-1)
+                ctx, _qkv, kc, vc = _bmha_fwd(
+                    qkv, kc, vc, enc_now, dec_now, cu, tables, rope,
+                    num_heads=nh, kv_num_heads=nkv, block_size=block_size,
+                    max_seq_len=s_max, use_neox=True, use_rope=True)
+                new_caches.append((kc, vc))
+                x = x + ctx.astype(dtype) @ lp["wo"]
+                h = rms(x, lp["ln2"])
+                ffn = (jax.nn.silu((h @ lp["wg"]).astype(jnp.float32))
+                       .astype(dtype) * (h @ lp["wu"])) @ lp["wd"]
+                x = x + ffn
+            return rms(x, p["norm"]), new_caches
+
+        caches = [(jnp.zeros((nb, nkv, block_size, dh), dtype),
+                   jnp.zeros((nb, nkv, block_size, dh), dtype))
+                  for _ in range(L)]
+        zeros_b = jnp.zeros((b,), jnp.int32)
+        hidden, caches = stack_step(packed, caches, enc, zeros_b, starts)
+        last_rows = starts + enc - 1
+        logits0 = _head_logits(p, hidden[last_rows])
+        key, sub = jax.random.split(key)
+        tok0 = _sample_token(logits0, sub, do_sample=do_sample,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p)
+        done0 = tok0 == eos
+        flat = [c for pair in caches for c in pair]
+        dec_starts = jnp.arange(b, dtype=jnp.int32)
+
+        def step(carry, i):
+            tok, done, key, *flat = carry
+            caches_ = [(flat[2 * j], flat[2 * j + 1]) for j in range(L)]
+            # the carried token is each row's element at logical
+            # position enc + i - 1: its append slot and rope angle
+            hidden, caches_ = stack_step(
+                tok, caches_, zeros_b, enc + (i - 1), dec_starts)
+            logits = _head_logits(p, hidden)
+            key, sub = jax.random.split(key)
+            nxt = _sample_token(logits, sub, do_sample=do_sample,
+                                temperature=temperature, top_k=top_k,
+                                top_p=top_p)
+            nxt = jnp.where(done, jnp.int32(eos), nxt)
+            done = done | (nxt == eos)
+            flat_ = [c for pair in caches_ for c in pair]
+            return (nxt, done, key, *flat_), tok
+
+        (last, _done, _key, *_rest), toks = lax.scan(
+            step, (tok0, done0, key, *flat),
+            jnp.arange(1, max_new_tokens))
+        toks = jnp.concatenate([toks.swapaxes(0, 1), last[:, None]], axis=1)
+        return jnp.concatenate([ids, toks], axis=1)
+
+    cache = model.__dict__.setdefault("_generation_jit_cache", {})
+    ragged = pads_np is not None
+    sig = ("paged", b, t0, max_new_tokens, do_sample, float(temperature),
+           int(top_k), float(top_p), eos, ragged, int(block_size))
+    fn = cache.get(sig)
+    if fn is None:
+        fn = jax.jit(_run, static_argnums=() if ragged else (2,))
+        cache[sig] = fn
+    pads_arg = jnp.asarray(pads_np) if ragged else None
+    out = fn(arrays, ids, pads_arg, jax.random.PRNGKey(seed))
     return Tensor._from_value(out)

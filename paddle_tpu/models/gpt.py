@@ -138,15 +138,19 @@ class GPTForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0,
-                 eos_token_id=None, seed: int = 0):
+                 eos_token_id=None, seed: int = 0, pad_token_id=None,
+                 paged: bool = False, block_size: int = 64):
         """KV-cache incremental decoding — one jitted lax.scan over a
-        dense cache (models/generation.py, same driver as Llama)."""
+        dense cache (models/generation.py, same driver as Llama);
+        ``pad_token_id`` enables left-padded ragged prompts."""
         from .generation import generate as _generate
 
         return _generate(self, input_ids, max_new_tokens=max_new_tokens,
                          do_sample=do_sample, temperature=temperature,
                          top_k=top_k, top_p=top_p,
-                         eos_token_id=eos_token_id, seed=seed)
+                         eos_token_id=eos_token_id, seed=seed,
+                         pad_token_id=pad_token_id, paged=paged,
+                         block_size=block_size)
 
     def forward(self, input_ids, position_ids=None, labels=None):
         import paddle_tpu as paddle

@@ -297,17 +297,22 @@ class LlamaForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0,
-                 eos_token_id=None, seed: int = 0):
+                 eos_token_id=None, seed: int = 0, pad_token_id=None,
+                 paged: bool = False, block_size: int = 64):
         """KV-cache incremental decoding: the whole loop is one jitted
         lax.scan (models/generation.py). Greedy by default; sampling
-        via do_sample + temperature/top_k/top_p. Returns
+        via do_sample + temperature/top_k/top_p; ``pad_token_id``
+        enables left-padded ragged prompts; ``paged=True`` decodes over
+        the serving block/paged KV cache. Returns
         [B, prompt + max_new_tokens] including the prompt."""
         from .generation import generate as _generate
 
         return _generate(self, input_ids, max_new_tokens=max_new_tokens,
                          do_sample=do_sample, temperature=temperature,
                          top_k=top_k, top_p=top_p,
-                         eos_token_id=eos_token_id, seed=seed)
+                         eos_token_id=eos_token_id, seed=seed,
+                         pad_token_id=pad_token_id, paged=paged,
+                         block_size=block_size)
 
 
 # ---------------------------------------------------------------------------
