@@ -289,13 +289,15 @@ def bench_bert(on_tpu, steps, warmup, peak_flops):
     tools/conv_calibration.py) — H=768 is the model's own definition, so
     unlike llama we don't get to pick a TPU-friendlier width.
 
-    Batch scaling MEASURED (v5e, 2026-07-31, attn dropout in-kernel):
-    bs32 0.390 MFU, bs36 0.429, bs40 0.431*, bs44 0.420*, bs48 0.413*,
-    bs64 0.344, bs128 OOM (* = measured before in-kernel attn dropout,
-    which costs ~2%) — bs=36 is the peak. Attention dropout (0.1, the
-    reference's attention_probs_dropout_prob) runs INSIDE the Pallas
-    flash kernel via a counter RNG (ops/pallas/flash_attention.py
-    _dropout_keep), so training-parity dropout stays on the flash path.
+    Batch scaling RE-MEASURED as one self-consistent sweep (v5e,
+    2026-07-31, round-5, ALL points with in-kernel attn dropout;
+    tools/bert_batch_sweep.py): bs32 0.377 MFU, bs36 0.415, bs40 0.414,
+    bs44 0.405, bs48 0.399 — bs=36 stays the peak (bs40 within 0.2%,
+    then monotone decline; bs64 0.344 / bs128 OOM from the round-4
+    sweep). Attention dropout (0.1, the reference's
+    attention_probs_dropout_prob) runs INSIDE the Pallas flash kernel
+    via a counter RNG (ops/pallas/flash_attention.py _dropout_keep), so
+    training-parity dropout stays on the flash path.
     """
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as opt

@@ -300,6 +300,17 @@ def _moe_act(activation):
 # flat-GEMM calibration number (the 72 TF/s point was measured on an
 # UNBATCHED [16k,2048]x[2048,2816]). The fused form stays as ERNIE-4.5's
 # true architecture; it is not a perf lever at this geometry.
+#
+# MEASURED (v5e, 2026-07-31, round-5): the grouped/ragged GEMM
+# reformulation (lax.ragged_dot, [E*C, d] x [E, d, h] with per-expert
+# group sizes — the "one wide MXU pass" lever round-4 left untried) is
+# ALSO a null at these shapes: carry-chained probe
+# (tools/moe_grouped_gemm_probe.py) puts the batched einsum pair at
+# 89.7 TF/s vs ragged_dot at 41.7 (uniform full-capacity groups) and
+# 65.4 padded-equivalent with REAL ~50%-occupancy group sizes — i.e.
+# even skipping half the padding FLOPs, ragged_dot's TPU lowering loses
+# to the dense batched einsum (4.22 ms vs 5.78 ms wall). The einsum
+# form stays.
 
 
 def _moe_idx_ffn_fwd(probs, x, w0, b0, w1, b1, key, *, k, capacity,

@@ -28,7 +28,7 @@ flow instead of a data-dependent loop), LEFT-PADDED mixed-length
 prompts (``pad_token_id=...``: per-row rope/position offsets + a
 pad-aware visibility mask, every row pinned against its own
 full-prefix oracle in tests), and a PAGED block-KV-cache decode path
-(``paged=True``, Llama family) that drives the same
+(``paged=True``, Llama and GPT families) that drives the same
 ``block_mha_p`` program the serving op
 ``incubate.nn.functional.block_multihead_attention`` exposes
 (reference: incubate/nn/functional/block_multihead_attention.py:19).
@@ -69,6 +69,48 @@ def _llama_decode_params(model):
     )
 
 
+def _rms(h, g, eps, dtype):
+    """RMSNorm in f32 — ONE implementation for the dense and paged
+    decode paths so the norm math can't drift between them."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    h32 = h.astype(jnp.float32)
+    y = h32 * lax.rsqrt(jnp.mean(h32 * h32, axis=-1, keepdims=True) + eps)
+    return (y * g.astype(jnp.float32)).astype(dtype)
+
+
+def _ln(h, g, bb, eps, dtype):
+    """LayerNorm in f32 — shared by the dense and paged GPT paths."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    h32 = h.astype(jnp.float32)
+    mu = jnp.mean(h32, axis=-1, keepdims=True)
+    var = jnp.mean((h32 - mu) ** 2, axis=-1, keepdims=True)
+    y = (h32 - mu) * lax.rsqrt(var + eps)
+    return (y * g.astype(jnp.float32) + bb.astype(jnp.float32)).astype(dtype)
+
+
+def _llama_ffn(h, lp, dtype):
+    """SwiGLU MLP — shared by the dense and paged Llama paths."""
+    import jax
+    import jax.numpy as jnp
+
+    return (jax.nn.silu((h @ lp["wg"]).astype(jnp.float32)).astype(dtype)
+            * (h @ lp["wu"])) @ lp["wd"]
+
+
+def _gpt_ffn(h, lp, dtype):
+    """GELU MLP with biases — shared by the dense and paged GPT paths."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.nn.gelu((h @ lp["w1"] + lp["b1"]).astype(jnp.float32),
+                       approximate=False).astype(dtype) \
+        @ lp["w2"] + lp["b2"]
+
+
 def _cached_forward(p, tokens, caches, pos, s_max, pads=None):
     """Forward ``tokens`` [B, T] through the stack at absolute positions
     ``pos..pos+T-1``, reading/updating the per-layer KV caches
@@ -90,10 +132,7 @@ def _cached_forward(p, tokens, caches, pos, s_max, pads=None):
     dtype = x.dtype
 
     def rms(h, g):
-        h32 = h.astype(jnp.float32)
-        y = h32 * lax.rsqrt(
-            jnp.mean(h32 * h32, axis=-1, keepdims=True) + p["eps"])
-        return (y * g.astype(jnp.float32)).astype(dtype)
+        return _rms(h, g, p["eps"], dtype)
 
     cos_full, sin_full = _rope_tables(s_max, dh, p["theta"], True,
                                       jnp.float32)
@@ -127,10 +166,7 @@ def _cached_forward(p, tokens, caches, pos, s_max, pads=None):
                                        nh // nkv)
         new_caches.append(cache)
         x = x + ctx @ lp["wo"]
-        h = rms(x, lp["ln2"])
-        ffn = (jax.nn.silu((h @ lp["wg"]).astype(jnp.float32)).astype(dtype)
-               * (h @ lp["wu"])) @ lp["wd"]
-        x = x + ffn
+        x = x + _llama_ffn(rms(x, lp["ln2"]), lp, dtype)
     return rms(x, p["norm"])[:, -1, :], new_caches
 
 
@@ -191,12 +227,7 @@ def _gpt_cached_forward(p, tokens, caches, pos, s_max, pads=None):
     dtype = x.dtype
 
     def ln(h, g, bb):
-        h32 = h.astype(jnp.float32)
-        mu = jnp.mean(h32, axis=-1, keepdims=True)
-        var = jnp.mean((h32 - mu) ** 2, axis=-1, keepdims=True)
-        y = (h32 - mu) * lax.rsqrt(var + p["eps"])
-        return (y * g.astype(jnp.float32)
-                + bb.astype(jnp.float32)).astype(dtype)
+        return _ln(h, g, bb, p["eps"], dtype)
 
     new_caches = []
     for lp, cache in zip(p["layers"], caches):
@@ -206,11 +237,7 @@ def _gpt_cached_forward(p, tokens, caches, pos, s_max, pads=None):
         ctx, cache = _cached_attention(q, k, v, cache, pos, visible, 1)
         new_caches.append(cache)
         x = x + ctx @ lp["wo"] + lp["bo"]
-        h = ln(x, lp["ln2_w"], lp["ln2_b"])
-        ffn = jax.nn.gelu(
-            (h @ lp["w1"] + lp["b1"]).astype(jnp.float32),
-            approximate=False).astype(dtype) @ lp["w2"] + lp["b2"]
-        x = x + ffn
+        x = x + _gpt_ffn(ln(x, lp["ln2_w"], lp["ln2_b"]), lp, dtype)
     return ln(x, p["normf_w"], p["normf_b"])[:, -1, :], new_caches
 
 
@@ -314,7 +341,7 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     ``pad_token_id``: enables LEFT-padded mixed-length prompts (each
     row decodes at its own logical positions). ``paged=True`` decodes
     over a paged/block KV cache via the serving ``block_mha_p`` program
-    (Llama family; composes with ragged prompts)."""
+    (Llama and GPT families; composes with ragged prompts)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -402,8 +429,11 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     # would both bloat the executable and force a retrace per call)
     cache = model.__dict__.setdefault("_generation_jit_cache", {})
     ragged = pads_np is not None
+    # dtype is part of the key: _run closes over the cache dtype/layer
+    # count captured at first trace — a model.bfloat16() after a float32
+    # generate must not reuse the stale closure
     sig = (b, t0, max_new_tokens, do_sample, float(temperature),
-           int(top_k), float(top_p), eos, ragged)
+           int(top_k), float(top_p), eos, ragged, str(dtype), L)
     fn = cache.get(sig)
     if fn is None:
         fn = jax.jit(_run, static_argnums=() if ragged else (2,))
@@ -416,14 +446,16 @@ def generate(model, input_ids, max_new_tokens: int = 32,
 def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
                     temperature, top_k, top_p, eos_token_id, seed,
                     block_size):
-    """Paged/block-KV-cache decode: the prefill packs each row's REAL
-    tokens left-aligned into a varlen batch and one ``block_mha_p``
-    call per layer writes them straight into the block pool; each scan
-    tick appends one token per row through the same program's
-    decode branch. Cache memory is per-LOGICAL-token (pads never enter
-    the pool), and the attention view is gathered through the block
-    table exactly like the reference's serving kernel
-    (block_multihead_attention.py:19)."""
+    """Paged/block-KV-cache decode (Llama and GPT families): the
+    prefill packs each row's REAL tokens left-aligned into a varlen
+    batch and one ``block_mha_p`` call per layer writes them straight
+    into the block pool; each scan tick appends one token per row
+    through the same program's decode branch. Cache memory is
+    per-LOGICAL-token (pads never enter the pool), and the attention
+    view is gathered through the block table exactly like the
+    reference's serving kernel (block_multihead_attention.py:19). RoPE
+    rides inside the block program (Llama); learned positions are added
+    at the embedding by logical position (GPT)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -431,17 +463,20 @@ def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
     from ..incubate.nn.functional import _rope_tables
     from ..incubate.nn.functional.inference_attention import _bmha_fwd
 
-    if not hasattr(model, "llama"):
-        raise NotImplementedError(
-            "paged=True decode supports the Llama family (the flagship "
-            "serving path); the GPT family uses the dense cache")
-    p = _llama_decode_params(model)
+    p, _dense_fwd = _decode_family(model)
+    is_llama = hasattr(model, "llama")
     b, t0 = ids.shape
     nh, nkv, dh = p["nh"], p["nkv"], p["dh"]
     L = len(p["layers"])
     dtype = p["embed"].dtype
     eos = -1 if eos_token_id is None else int(eos_token_id)
     s_max = t0 + max_new_tokens
+    max_pos = p.get("max_positions")
+    if max_pos is not None and s_max > max_pos:
+        raise ValueError(
+            f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) = "
+            f"{s_max} exceeds the learned position table "
+            f"(max_position_embeddings={max_pos})")
     blocks_per_seq = -(-s_max // block_size)
     nb = b * blocks_per_seq
     # disjoint row-major block allocation: row b owns blocks
@@ -465,48 +500,82 @@ def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
         gather_cols = jnp.minimum(shift + jnp.arange(t0)[None, :], t0 - 1)
         packed = jnp.take_along_axis(ids, gather_cols, axis=1).reshape(-1)
         starts = jnp.arange(b, dtype=jnp.int32) * t0
-        cos_full, sin_full = _rope_tables(s_max, dh, p["theta"], True,
-                                          jnp.float32)
-        # reference rope layout [2, B, S, 1, D]
-        rope = jnp.stack([
-            jnp.broadcast_to(cos_full[None, :, None, :], (b, s_max, 1, dh)),
-            jnp.broadcast_to(sin_full[None, :, None, :], (b, s_max, 1, dh)),
-        ]).astype(jnp.float32)
+        if is_llama:
+            cos_full, sin_full = _rope_tables(s_max, dh, p["theta"], True,
+                                              jnp.float32)
+            # reference rope layout [2, B, S, 1, D]
+            rope = jnp.stack([
+                jnp.broadcast_to(cos_full[None, :, None, :],
+                                 (b, s_max, 1, dh)),
+                jnp.broadcast_to(sin_full[None, :, None, :],
+                                 (b, s_max, 1, dh)),
+            ]).astype(jnp.float32)
+        else:
+            rope = jnp.zeros((1,), jnp.float32)   # unused (use_rope=False)
+        # packed-token logical positions: left-aligned row segments, so
+        # slot j of every segment is position j (prefill); decode steps
+        # pass each row's current length instead
+        pos_prefill = jnp.tile(jnp.arange(t0, dtype=jnp.int32), b)
 
         def rms(h, g):
-            h32 = h.astype(jnp.float32)
-            y = h32 * lax.rsqrt(
-                jnp.mean(h32 * h32, axis=-1, keepdims=True) + p["eps"])
-            return (y * g.astype(jnp.float32)).astype(dtype)
+            return _rms(h, g, p["eps"], dtype)
 
-        def stack_step(tokens_flat, caches, enc_now, dec_now, cu):
+        def ln(h, g, bb):
+            return _ln(h, g, bb, p["eps"], dtype)
+
+        def attn(qkv, kc, vc, enc_now, dec_now, cu, win_tables):
+            return _bmha_fwd(
+                qkv, kc, vc, enc_now, dec_now, cu, win_tables, rope,
+                num_heads=nh, kv_num_heads=nkv, block_size=block_size,
+                max_seq_len=s_max, use_neox=True, use_rope=is_llama)
+
+        def stack_step(tokens_flat, caches, enc_now, dec_now, cu,
+                       pos_tok, win_tables):
             """One forward through all layers on packed rows [T, H];
-            returns (hidden rows [T, H], new caches)."""
+            returns (hidden rows [T, H], new caches). The norm/FFN math
+            is the SHARED per-family helpers (_rms/_ln/_llama_ffn/
+            _gpt_ffn) — same source as the dense path, so the two cache
+            layouts can't drift."""
             x = jnp.take(p["embed"], tokens_flat, axis=0)
+            if not is_llama:
+                x = x + jnp.take(p["wpe"], pos_tok, axis=0)
             new_caches = []
             for lp, (kc, vc) in zip(p["layers"], caches):
-                h = rms(x, lp["ln1"])
-                q = h @ lp["wq"]
-                k = h @ lp["wk"]
-                v = h @ lp["wv"]
-                qkv = jnp.concatenate([q, k, v], axis=-1)
-                ctx, _qkv, kc, vc = _bmha_fwd(
-                    qkv, kc, vc, enc_now, dec_now, cu, tables, rope,
-                    num_heads=nh, kv_num_heads=nkv, block_size=block_size,
-                    max_seq_len=s_max, use_neox=True, use_rope=True)
+                if is_llama:
+                    h = rms(x, lp["ln1"])
+                    qkv = jnp.concatenate(
+                        [h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]],
+                        axis=-1)
+                else:
+                    h = ln(x, lp["ln1_w"], lp["ln1_b"])
+                    # fused qkv weight is already laid out q|k|v
+                    qkv = h @ lp["wqkv"] + lp["bqkv"]
+                ctx, _qkv, kc, vc = attn(qkv, kc, vc, enc_now, dec_now,
+                                         cu, win_tables)
                 new_caches.append((kc, vc))
-                x = x + ctx.astype(dtype) @ lp["wo"]
-                h = rms(x, lp["ln2"])
-                ffn = (jax.nn.silu((h @ lp["wg"]).astype(jnp.float32))
-                       .astype(dtype) * (h @ lp["wu"])) @ lp["wd"]
-                x = x + ffn
-            return rms(x, p["norm"]), new_caches
+                if is_llama:
+                    x = x + ctx.astype(dtype) @ lp["wo"]
+                    x = x + _llama_ffn(rms(x, lp["ln2"]), lp, dtype)
+                else:
+                    x = x + ctx.astype(dtype) @ lp["wo"] + lp["bo"]
+                    x = x + _gpt_ffn(ln(x, lp["ln2_w"], lp["ln2_b"]),
+                                     lp, dtype)
+            if is_llama:
+                return rms(x, p["norm"]), new_caches
+            return ln(x, p["normf_w"], p["normf_b"]), new_caches
 
         caches = [(jnp.zeros((nb, nkv, block_size, dh), dtype),
                    jnp.zeros((nb, nkv, block_size, dh), dtype))
                   for _ in range(L)]
         zeros_b = jnp.zeros((b,), jnp.int32)
-        hidden, caches = stack_step(packed, caches, enc, zeros_b, starts)
+        # prefill attends through a PROMPT-SIZED view of the block table:
+        # the full table's padded window would cost
+        # (ceil(s_max/bs)/ceil(t0/bs))^2 x the live attention FLOPs; the
+        # writes land in the same pool either way
+        prompt_blocks = -(-t0 // block_size)
+        hidden, caches = stack_step(packed, caches, enc, zeros_b, starts,
+                                    pos_prefill,
+                                    tables[:, :prompt_blocks])
         last_rows = starts + enc - 1
         logits0 = _head_logits(p, hidden[last_rows])
         key, sub = jax.random.split(key)
@@ -521,9 +590,10 @@ def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
             tok, done, key, *flat = carry
             caches_ = [(flat[2 * j], flat[2 * j + 1]) for j in range(L)]
             # the carried token is each row's element at logical
-            # position enc + i - 1: its append slot and rope angle
+            # position enc + i - 1: its append slot and rope/wpe angle
             hidden, caches_ = stack_step(
-                tok, caches_, zeros_b, enc + (i - 1), dec_starts)
+                tok, caches_, zeros_b, enc + (i - 1), dec_starts,
+                enc + (i - 1), tables)
             logits = _head_logits(p, hidden)
             key, sub = jax.random.split(key)
             nxt = _sample_token(logits, sub, do_sample=do_sample,
@@ -543,7 +613,8 @@ def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
     cache = model.__dict__.setdefault("_generation_jit_cache", {})
     ragged = pads_np is not None
     sig = ("paged", b, t0, max_new_tokens, do_sample, float(temperature),
-           int(top_k), float(top_p), eos, ragged, int(block_size))
+           int(top_k), float(top_p), eos, ragged, int(block_size),
+           str(dtype), L)
     fn = cache.get(sig)
     if fn is None:
         fn = jax.jit(_run, static_argnums=() if ragged else (2,))

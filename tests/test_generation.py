@@ -358,19 +358,35 @@ class TestPagedDecode:
         assert hits.size, "eos never emitted — test premise broken"
         assert (row[hits[0]:] == eos).all(), row
 
-    def test_paged_rejects_gpt_family(self):
+    def test_gpt_paged_equals_dense(self):
+        """The paged path serves GPT too: learned positions are added at
+        the embedding by LOGICAL position while the block program runs
+        without rope — greedy output (incl. ragged) must equal dense."""
         from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
         paddle.seed(0)
         gpt = GPTForCausalLM(GPTConfig.tiny(
-            vocab_size=64, hidden_size=16, intermediate_size=32,
-            num_hidden_layers=1, num_attention_heads=2,
-            max_position_embeddings=32))
+            vocab_size=89, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=32, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0))
         gpt.eval()
-        ids = np.array([[1, 2, 3]], dtype="int64")
-        with pytest.raises(NotImplementedError, match="Llama family"):
-            gpt.generate(paddle.to_tensor(ids), max_new_tokens=2,
-                         paged=True)
+        ids = np.random.RandomState(11).randint(
+            1, 89, (2, 6)).astype("int64")
+        dense = gpt.generate(paddle.to_tensor(ids),
+                             max_new_tokens=5).numpy()
+        paged = gpt.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                             paged=True, block_size=4).numpy()
+        np.testing.assert_array_equal(paged, dense)
+        # ragged composes with the GPT paged path
+        ragged = ids.copy()
+        ragged[0, :2] = 0
+        dr = gpt.generate(paddle.to_tensor(ragged), max_new_tokens=5,
+                          pad_token_id=0).numpy()
+        pr = gpt.generate(paddle.to_tensor(ragged), max_new_tokens=5,
+                          pad_token_id=0, paged=True,
+                          block_size=4).numpy()
+        np.testing.assert_array_equal(pr, dr)
 
 
 class TestGptRaggedPrompts:
@@ -404,3 +420,20 @@ class TestGptRaggedPrompts:
             np.testing.assert_array_equal(
                 out[i, t0:], solo[len(real):],
                 err_msg=f"gpt row {i} (len {len(real)}) diverged")
+
+
+class TestDtypeSwitch:
+    def test_generate_after_dtype_cast_does_not_reuse_stale_closure(self):
+        """The per-model jit cache keys on dtype: float32 generate →
+        model.bfloat16() → generate again must retrace (the closed-over
+        KV-cache dtype would otherwise mismatch the new k/v arrays)."""
+        model = _model()
+        ids = np.random.RandomState(12).randint(
+            1, 97, (1, 4)).astype("int64")
+        out32 = model.generate(paddle.to_tensor(ids),
+                               max_new_tokens=3).numpy()
+        model.bfloat16()
+        out16 = model.generate(paddle.to_tensor(ids),
+                               max_new_tokens=3).numpy()
+        assert out32.shape == out16.shape == (1, 7)
+        np.testing.assert_array_equal(out32[:, :4], out16[:, :4])
