@@ -311,6 +311,25 @@ def _sample_token(logits, key, *, do_sample, temperature, top_k, top_p):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _prep_decode(model, p, t0, max_new_tokens):
+    """Shared decode-path setup (ONE copy for the greedy/beam/paged
+    drivers): validate the learned-position table can hold the target
+    length, split params into STATIC scalars (shapes depend on them)
+    vs jit-argument arrays, and return the per-model jit cache."""
+    max_pos = p.get("max_positions")
+    if max_pos is not None and t0 + max_new_tokens > max_pos:
+        raise ValueError(
+            f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) = "
+            f"{t0 + max_new_tokens} exceeds the learned position table "
+            f"(max_position_embeddings={max_pos}); jnp.take would "
+            f"silently clamp and repeat the last position embedding")
+    static_cfg = {k: v for k, v in p.items()
+                  if not hasattr(v, "dtype") and not isinstance(v, list)}
+    arrays = {k: v for k, v in p.items() if k not in static_cfg}
+    cache = model.__dict__.setdefault("_generation_jit_cache", {})
+    return static_cfg, arrays, cache
+
+
 def _check_left_padded(ids_np, pad: int):
     """Leading-pad counts [B]; reject pads anywhere but a left run."""
     b, t0 = ids_np.shape
@@ -332,7 +351,7 @@ def generate(model, input_ids, max_new_tokens: int = 32,
              top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None, seed: int = 0,
              pad_token_id: Optional[int] = None, paged: bool = False,
-             block_size: int = 64):
+             block_size: int = 64, num_beams: int = 1):
     """Decode ``max_new_tokens`` from a Llama- or GPT-family causal
     LM with a KV cache; the whole loop is ONE jitted scan. Returns
     ``[B, prompt_len + max_new_tokens]`` (prompt included); positions
@@ -341,7 +360,9 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     ``pad_token_id``: enables LEFT-padded mixed-length prompts (each
     row decodes at its own logical positions). ``paged=True`` decodes
     over a paged/block KV cache via the serving ``block_mha_p`` program
-    (Llama and GPT families; composes with ragged prompts)."""
+    (Llama and GPT families; composes with ragged prompts).
+    ``num_beams > 1``: beam search (highest sum-logprob sequence;
+    reference surface: nn.BeamSearchDecoder / PaddleNLP generate)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -359,6 +380,18 @@ def generate(model, input_ids, max_new_tokens: int = 32,
         pads_np = _check_left_padded(np.asarray(ids), int(pad_token_id))
         if not pads_np.any():
             pads_np = None                    # no row is actually padded
+    if num_beams > 1:
+        if do_sample:
+            raise ValueError(
+                "generate: num_beams > 1 is deterministic beam search; "
+                "it does not compose with do_sample")
+        if paged or pads_np is not None:
+            raise NotImplementedError(
+                "generate: beam search runs on the dense same-length "
+                "cache path (no paged=True / ragged prompts)")
+        return _generate_beam(model, ids, max_new_tokens=max_new_tokens,
+                              num_beams=num_beams,
+                              eos_token_id=eos_token_id)
     if paged:
         return _generate_paged(model, ids, pads_np,
                                max_new_tokens=max_new_tokens,
@@ -368,21 +401,10 @@ def generate(model, input_ids, max_new_tokens: int = 32,
                                block_size=block_size)
     p, fwd = _decode_family(model)
     s_max = t0 + max_new_tokens
-    max_pos = p.get("max_positions")
-    if max_pos is not None and s_max > max_pos:
-        raise ValueError(
-            f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) = "
-            f"{s_max} exceeds the learned position table "
-            f"(max_position_embeddings={max_pos}); jnp.take would "
-            f"silently clamp and repeat the last position embedding")
     nkv, dh, L = p["nkv"], p["dh"], len(p["layers"])
     dtype = p["embed"].dtype
     eos = -1 if eos_token_id is None else int(eos_token_id)
-    # non-array scalars are STATIC (shapes depend on them); everything
-    # array-valued rides as a jit argument
-    static_cfg = {k: v for k, v in p.items()
-                  if not hasattr(v, "dtype") and not isinstance(v, list)}
-    arrays = {k: v for k, v in p.items() if k not in static_cfg}
+    static_cfg, arrays, cache = _prep_decode(model, p, t0, max_new_tokens)
 
     def _run(arrs, ids, pads, key):
         p = {**arrs, **static_cfg}
@@ -427,7 +449,6 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     # compiled-step cache on the model: params ride as jit ARGUMENTS
     # (weights update between calls; baking them as closure constants
     # would both bloat the executable and force a retrace per call)
-    cache = model.__dict__.setdefault("_generation_jit_cache", {})
     ragged = pads_np is not None
     # dtype is part of the key: _run closes over the cache dtype/layer
     # count captured at first trace — a model.bfloat16() after a float32
@@ -441,6 +462,100 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     pads_arg = jnp.asarray(pads_np) if ragged else None
     out = fn(arrays, ids, pads_arg, jax.random.PRNGKey(seed))
     return Tensor._from_value(out)
+
+
+def _generate_beam(model, ids, *, max_new_tokens, num_beams,
+                   eos_token_id):
+    """Beam search over the SAME cached single-jit scan as greedy: the
+    batch dim carries B*K beam rows, each tick forwards every beam one
+    token, expands to K*V candidates, keeps the top K per batch row,
+    and reorders the KV caches by each survivor's parent beam. Finished
+    beams (emitted eos) are frozen: their only continuation is eos at
+    zero added logprob. Returns each row's highest-sum-logprob beam.
+
+    Reference surface: nn/decode.py BeamSearchDecoder/dynamic_decode is
+    the seq2seq cell path; this is the decoder-only LLM analog (the
+    reference ecosystem's model.generate(decode_strategy=
+    "beam_search"))."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    p, fwd = _decode_family(model)
+    b, t0 = ids.shape
+    K = int(num_beams)
+    s_max = t0 + max_new_tokens
+    vocab = p["embed"].shape[0]
+    if K > vocab:
+        raise ValueError(f"num_beams ({K}) > vocab size ({vocab})")
+    nkv, dh, L = p["nkv"], p["dh"], len(p["layers"])
+    dtype = p["embed"].dtype
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+    static_cfg, arrays, cache = _prep_decode(model, p, t0, max_new_tokens)
+
+    def _run(arrs, ids):
+        p = {**arrs, **static_cfg}
+        # eos-continuation row for finished beams: only eos, at +0
+        frozen = jnp.full((vocab,), -jnp.inf)
+        if eos >= 0:
+            frozen = frozen.at[eos].set(0.0)
+
+        # ---- prefill on the B prompt rows, then expand to K beams ----
+        caches = [(jnp.zeros((b, s_max, nkv, dh), dtype),
+                   jnp.zeros((b, s_max, nkv, dh), dtype))
+                  for _ in range(L)]
+        hidden, caches = fwd(p, ids, caches, 0, s_max)
+        lp0 = jax.nn.log_softmax(
+            _head_logits(p, hidden).astype(jnp.float32), axis=-1)
+        scores, tok0 = lax.top_k(lp0, K)               # [B, K] each
+        tok0 = tok0.astype(jnp.int32)
+        done = tok0 == eos
+        flat = [jnp.repeat(c, K, axis=0)               # [B*K, S, kvh, dh]
+                for pair in caches for c in pair]
+        tok_buf = jnp.full((b, K, max_new_tokens), eos, jnp.int32)
+        tok_buf = tok_buf.at[:, :, 0].set(tok0)
+
+        def reorder(arr, parent):
+            """[B*K, ...] gathered by each survivor's parent beam."""
+            v = arr.reshape((b, K) + arr.shape[1:])
+            idx = parent.reshape((b, K) + (1,) * (v.ndim - 2))
+            return jnp.take_along_axis(v, idx, axis=1).reshape(arr.shape)
+
+        def step(carry, i):
+            tok, scores, done, tok_buf, *flat = carry
+            caches_ = [(flat[2 * j], flat[2 * j + 1]) for j in range(L)]
+            hidden, caches_ = fwd(
+                p, tok.reshape(b * K, 1), caches_, t0 + i - 1, s_max)
+            lp = jax.nn.log_softmax(
+                _head_logits(p, hidden).astype(jnp.float32),
+                axis=-1).reshape(b, K, vocab)
+            lp = jnp.where(done[:, :, None], frozen[None, None, :], lp)
+            cand = (scores[:, :, None] + lp).reshape(b, K * vocab)
+            scores, idx = lax.top_k(cand, K)           # [B, K]
+            parent = (idx // vocab).astype(jnp.int32)
+            token = (idx % vocab).astype(jnp.int32)
+            flat_ = [reorder(c, parent)
+                     for pair in caches_ for c in pair]
+            done = jnp.take_along_axis(done, parent, axis=1) \
+                | (token == eos)
+            tok_buf = jnp.take_along_axis(
+                tok_buf, parent[:, :, None], axis=1).at[:, :, i].set(token)
+            return (token, scores, done, tok_buf, *flat_), ()
+
+        (_tok, scores, _done, tok_buf, *_rest), _ = lax.scan(
+            step, (tok0, scores, done, tok_buf, *flat),
+            jnp.arange(1, max_new_tokens))
+        best = jnp.argmax(scores, axis=1)              # [B]
+        out = jnp.take_along_axis(
+            tok_buf, best[:, None, None], axis=1)[:, 0, :]
+        return jnp.concatenate([ids, out], axis=1)
+
+    sig = ("beam", b, t0, max_new_tokens, K, eos, str(dtype), L)
+    fn = cache.get(sig)
+    if fn is None:
+        fn = jax.jit(_run)
+        cache[sig] = fn
+    return Tensor._from_value(fn(arrays, ids))
 
 
 def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
@@ -471,21 +586,13 @@ def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
     dtype = p["embed"].dtype
     eos = -1 if eos_token_id is None else int(eos_token_id)
     s_max = t0 + max_new_tokens
-    max_pos = p.get("max_positions")
-    if max_pos is not None and s_max > max_pos:
-        raise ValueError(
-            f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) = "
-            f"{s_max} exceeds the learned position table "
-            f"(max_position_embeddings={max_pos})")
+    static_cfg, arrays, cache = _prep_decode(model, p, t0, max_new_tokens)
     blocks_per_seq = -(-s_max // block_size)
     nb = b * blocks_per_seq
     # disjoint row-major block allocation: row b owns blocks
     # [b*blocks_per_seq, (b+1)*blocks_per_seq)
     tables_np = (np.arange(nb, dtype=np.int32)
                  .reshape(b, blocks_per_seq))
-    static_cfg = {k: v for k, v in p.items()
-                  if not hasattr(v, "dtype") and not isinstance(v, list)}
-    arrays = {k: v for k, v in p.items() if k not in static_cfg}
 
     def _run(arrs, ids, pads, key):
         p = {**arrs, **static_cfg}
@@ -610,7 +717,6 @@ def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
         toks = jnp.concatenate([toks.swapaxes(0, 1), last[:, None]], axis=1)
         return jnp.concatenate([ids, toks], axis=1)
 
-    cache = model.__dict__.setdefault("_generation_jit_cache", {})
     ragged = pads_np is not None
     sig = ("paged", b, t0, max_new_tokens, do_sample, float(temperature),
            int(top_k), float(top_p), eos, ragged, int(block_size),

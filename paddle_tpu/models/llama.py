@@ -298,7 +298,8 @@ class LlamaForCausalLM(nn.Layer):
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0,
                  eos_token_id=None, seed: int = 0, pad_token_id=None,
-                 paged: bool = False, block_size: int = 64):
+                 paged: bool = False, block_size: int = 64,
+                 num_beams: int = 1):
         """KV-cache incremental decoding: the whole loop is one jitted
         lax.scan (models/generation.py). Greedy by default; sampling
         via do_sample + temperature/top_k/top_p; ``pad_token_id``
@@ -312,7 +313,7 @@ class LlamaForCausalLM(nn.Layer):
                          top_k=top_k, top_p=top_p,
                          eos_token_id=eos_token_id, seed=seed,
                          pad_token_id=pad_token_id, paged=paged,
-                         block_size=block_size)
+                         block_size=block_size, num_beams=num_beams)
 
 
 # ---------------------------------------------------------------------------

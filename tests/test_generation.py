@@ -437,3 +437,132 @@ class TestDtypeSwitch:
                                max_new_tokens=3).numpy()
         assert out32.shape == out16.shape == (1, 7)
         np.testing.assert_array_equal(out32[:, :4], out16[:, :4])
+
+
+class TestBeamSearch:
+    """num_beams decode (reference surface: nn/decode.py
+    BeamSearchDecoder; ecosystem generate(decode_strategy=
+    'beam_search')). The oracle is a NUMPY beam search driven by the
+    model's own full-prefix forward — any drift in expansion order,
+    cache reordering, or eos freezing diverges from it."""
+
+    def _np_beam_oracle(self, model, ids_np, n_new, K, eos=-1):
+        b, t0 = ids_np.shape
+        out = []
+        for r in range(b):
+            logits = model(paddle.to_tensor(
+                ids_np[r][None, :])).numpy()[0, -1]
+            lp = logits - np.log(np.exp(logits - logits.max()).sum()) \
+                - logits.max()
+            order = np.argsort(-lp)[:K]
+            beams = [(float(lp[t]), list(ids_np[r]) + [int(t)],
+                      int(t) == eos) for t in order]
+            for _ in range(n_new - 1):
+                cand = []
+                for score, seq, done in beams:
+                    if done:
+                        cand.append((score, seq + [eos], True))
+                        continue
+                    logits = model(paddle.to_tensor(
+                        np.asarray(seq, "int64")[None, :])).numpy()[0, -1]
+                    mx = logits.max()
+                    lp = logits - (np.log(np.exp(logits - mx).sum()) + mx)
+                    for t in np.argsort(-lp)[:K]:
+                        cand.append((score + float(lp[t]),
+                                     seq + [int(t)], int(t) == eos))
+                cand.sort(key=lambda x: -x[0])
+                beams = cand[:K]
+            out.append(np.asarray(beams[0][1], "int64"))
+        return np.stack(out)
+
+    def test_matches_numpy_beam_oracle(self):
+        model = _model()
+        ids = np.random.RandomState(13).randint(
+            1, 97, (2, 5)).astype("int64")
+        n_new, K = 4, 3
+        want = self._np_beam_oracle(model, ids, n_new, K)
+        got = model.generate(paddle.to_tensor(ids), max_new_tokens=n_new,
+                             num_beams=K).numpy()
+        np.testing.assert_array_equal(got, want)
+
+    def test_beam_1_equals_greedy(self):
+        model = _model()
+        ids = np.random.RandomState(14).randint(
+            1, 97, (2, 4)).astype("int64")
+        greedy = model.generate(paddle.to_tensor(ids),
+                                max_new_tokens=5).numpy()
+        beam1 = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                               num_beams=1).numpy()
+        np.testing.assert_array_equal(beam1, greedy)
+
+    def test_beam_score_at_least_greedy(self):
+        """The winning beam's sum logprob must be >= the greedy
+        sequence's (beam explores a superset of greedy's prefix)."""
+        model = _model()
+        ids = np.random.RandomState(15).randint(
+            1, 97, (1, 5)).astype("int64")
+        n_new = 5
+
+        def seq_logprob(full):
+            t0 = ids.shape[1]
+            score = 0.0
+            for i in range(n_new):
+                logits = model(paddle.to_tensor(
+                    full[:, :t0 + i])).numpy()[0, -1]
+                mx = logits.max()
+                lp = logits - (np.log(np.exp(logits - mx).sum()) + mx)
+                score += float(lp[full[0, t0 + i]])
+            return score
+
+        greedy = model.generate(paddle.to_tensor(ids),
+                                max_new_tokens=n_new).numpy()
+        beam = model.generate(paddle.to_tensor(ids), max_new_tokens=n_new,
+                              num_beams=4).numpy()
+        assert seq_logprob(beam) >= seq_logprob(greedy) - 1e-4
+
+    def test_eos_freezes_beam(self):
+        """A beam that emits eos stays frozen (tail is all eos) and its
+        score stops accumulating. Choosing eos = the GREEDY first token
+        makes the frozen beam the GUARANTEED winner: its score is the
+        maximal single-token logprob, and every competing beam's sum
+        only adds non-positive terms to a smaller first term — so the
+        assertion can never pass vacuously."""
+        model = _model()
+        ids = np.random.RandomState(16).randint(
+            1, 97, (1, 4)).astype("int64")
+        greedy = model.generate(paddle.to_tensor(ids),
+                                max_new_tokens=1).numpy()
+        eos = int(greedy[0, 4])  # argmax first token
+        out = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                             num_beams=2, eos_token_id=eos).numpy()
+        row = out[0, 4:]
+        assert row[0] == eos, row
+        assert (row == eos).all(), row
+
+    def test_beam_rejects_sampling_and_ragged(self):
+        model = _model()
+        ids = np.array([[1, 2, 3]], dtype="int64")
+        with pytest.raises(ValueError, match="do_sample"):
+            model.generate(paddle.to_tensor(ids), max_new_tokens=2,
+                           num_beams=2, do_sample=True)
+        ragged = np.array([[0, 2, 3]], dtype="int64")
+        with pytest.raises(NotImplementedError, match="dense"):
+            model.generate(paddle.to_tensor(ragged), max_new_tokens=2,
+                           num_beams=2, pad_token_id=0)
+
+    def test_gpt_beam_matches_numpy_oracle(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        paddle.seed(6)
+        gpt = GPTForCausalLM(GPTConfig.tiny(
+            vocab_size=89, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=32, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0))
+        gpt.eval()
+        ids = np.random.RandomState(17).randint(
+            1, 89, (1, 4)).astype("int64")
+        want = self._np_beam_oracle(gpt, ids, 3, 2)
+        got = gpt.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                           num_beams=2).numpy()
+        np.testing.assert_array_equal(got, want)
