@@ -351,7 +351,9 @@ def generate(model, input_ids, max_new_tokens: int = 32,
              top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None, seed: int = 0,
              pad_token_id: Optional[int] = None, paged: bool = False,
-             block_size: int = 64, num_beams: int = 1):
+             block_size: int = 64, num_beams: int = 1,
+             length_penalty: float = 0.0, repetition_penalty: float = 1.0,
+             min_length: int = 0):
     """Decode ``max_new_tokens`` from a Llama- or GPT-family causal
     LM with a KV cache; the whole loop is ONE jitted scan. Returns
     ``[B, prompt_len + max_new_tokens]`` (prompt included); positions
@@ -361,8 +363,13 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     row decodes at its own logical positions). ``paged=True`` decodes
     over a paged/block KV cache via the serving ``block_mha_p`` program
     (Llama and GPT families; composes with ragged prompts).
-    ``num_beams > 1``: beam search (highest sum-logprob sequence;
-    reference surface: nn.BeamSearchDecoder / PaddleNLP generate)."""
+    ``num_beams > 1``: beam search (reference surface:
+    nn.BeamSearchDecoder / ecosystem generate), ranked by sum logprob /
+    len**``length_penalty`` (0.0 = no length normalization).
+    ``repetition_penalty`` (CTRL-style: seen tokens' logits divided by
+    the factor when positive, multiplied when negative — prompt tokens
+    count as seen) and ``min_length`` (eos masked out for the first
+    ``min_length`` new tokens) apply to the greedy/sampling paths."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -380,6 +387,14 @@ def generate(model, input_ids, max_new_tokens: int = 32,
         pads_np = _check_left_padded(np.asarray(ids), int(pad_token_id))
         if not pads_np.any():
             pads_np = None                    # no row is actually padded
+    if repetition_penalty <= 0.0:
+        raise ValueError(
+            f"repetition_penalty must be > 0, got {repetition_penalty}")
+    if length_penalty != 0.0 and num_beams <= 1:
+        raise ValueError(
+            "generate: length_penalty ranks beam-search hypotheses; it "
+            "has no effect with num_beams=1 — refusing to silently "
+            "ignore it")
     if num_beams > 1:
         if do_sample:
             raise ValueError(
@@ -389,10 +404,19 @@ def generate(model, input_ids, max_new_tokens: int = 32,
             raise NotImplementedError(
                 "generate: beam search runs on the dense same-length "
                 "cache path (no paged=True / ragged prompts)")
+        if repetition_penalty != 1.0 or min_length:
+            raise NotImplementedError(
+                "generate: repetition_penalty/min_length apply to the "
+                "greedy/sampling paths, not beam search")
         return _generate_beam(model, ids, max_new_tokens=max_new_tokens,
                               num_beams=num_beams,
-                              eos_token_id=eos_token_id)
+                              eos_token_id=eos_token_id,
+                              length_penalty=length_penalty)
     if paged:
+        if repetition_penalty != 1.0 or min_length:
+            raise NotImplementedError(
+                "generate: repetition_penalty/min_length run on the "
+                "dense cache path (no paged=True)")
         return _generate_paged(model, ids, pads_np,
                                max_new_tokens=max_new_tokens,
                                do_sample=do_sample, temperature=temperature,
@@ -406,18 +430,43 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     eos = -1 if eos_token_id is None else int(eos_token_id)
     static_cfg, arrays, cache = _prep_decode(model, p, t0, max_new_tokens)
 
+    rep = float(repetition_penalty)
+    min_new = int(min_length)
+
     def _run(arrs, ids, pads, key):
         p = {**arrs, **static_cfg}
+        vocab = p["embed"].shape[0]
+
+        def penalize(logits, presence, i):
+            """CTRL repetition penalty over seen tokens + min-length
+            eos mask; identity when both knobs are off (rep==1, the
+            common case, compiles to nothing)."""
+            if rep != 1.0:
+                scaled = jnp.where(logits > 0, logits / rep, logits * rep)
+                logits = jnp.where(presence, scaled, logits)
+            if min_new > 0 and eos >= 0:
+                blocked = jnp.full_like(logits[:, eos], -jnp.inf)
+                logits = logits.at[:, eos].set(
+                    jnp.where(i < min_new, blocked, logits[:, eos]))
+            return logits
+
+        # tokens already in the prompt count as seen (pad runs don't)
+        row = jnp.arange(b)[:, None]
+        seen_ok = (jnp.ones((b, t0), bool) if pads is None
+                   else jnp.arange(t0)[None, :] >= pads[:, None])
+        presence0 = jnp.zeros((b, vocab), bool).at[row, ids].max(seen_ok)
         caches = [(jnp.zeros((b, s_max, nkv, dh), dtype),
                    jnp.zeros((b, s_max, nkv, dh), dtype))
                   for _ in range(L)]
         hidden, caches = fwd(p, ids, caches, 0, s_max, pads=pads)
-        logits0 = _head_logits(p, hidden)
+        logits0 = penalize(
+            _head_logits(p, hidden).astype(jnp.float32), presence0, 0)
         key, sub = jax.random.split(key)
         tok0 = _sample_token(logits0, sub, do_sample=do_sample,
                              temperature=temperature, top_k=top_k,
                              top_p=top_p)
         done0 = tok0 == eos
+        presence0 = presence0.at[jnp.arange(b), tok0].set(True)
         flat_caches = [c for pair in caches for c in pair]
 
         def step(carry, i):
@@ -426,22 +475,24 @@ def generate(model, input_ids, max_new_tokens: int = 32,
             # position (feeding it one slot later leaves the all-zeros
             # slot t0 visible and shifts every rope angle — caught by
             # review, pinned by the multi-token oracle test)
-            tok, done, key, *flat = carry
+            tok, done, presence, key, *flat = carry
             caches_ = [(flat[2 * j], flat[2 * j + 1]) for j in range(L)]
             hidden, caches_ = fwd(
                 p, tok[:, None], caches_, t0 + i - 1, s_max, pads=pads)
-            logits = _head_logits(p, hidden)
+            logits = penalize(
+                _head_logits(p, hidden).astype(jnp.float32), presence, i)
             key, sub = jax.random.split(key)
             nxt = _sample_token(logits, sub, do_sample=do_sample,
                                 temperature=temperature, top_k=top_k,
                                 top_p=top_p)
             nxt = jnp.where(done, jnp.int32(eos), nxt)
             done = done | (nxt == eos)
+            presence = presence.at[jnp.arange(b), nxt].set(True)
             flat_ = [c for pair in caches_ for c in pair]
-            return (nxt, done, key, *flat_), tok
+            return (nxt, done, presence, key, *flat_), tok
 
-        (last, _done, _key, *_rest), toks = lax.scan(
-            step, (tok0, done0, key, *flat_caches),
+        (last, _done, _pres, _key, *_rest), toks = lax.scan(
+            step, (tok0, done0, presence0, key, *flat_caches),
             jnp.arange(1, max_new_tokens))
         toks = jnp.concatenate([toks.swapaxes(0, 1), last[:, None]], axis=1)
         return jnp.concatenate([ids, toks], axis=1)
@@ -454,7 +505,8 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     # count captured at first trace — a model.bfloat16() after a float32
     # generate must not reuse the stale closure
     sig = (b, t0, max_new_tokens, do_sample, float(temperature),
-           int(top_k), float(top_p), eos, ragged, str(dtype), L)
+           int(top_k), float(top_p), eos, ragged, str(dtype), L,
+           rep, min_new)
     fn = cache.get(sig)
     if fn is None:
         fn = jax.jit(_run, static_argnums=() if ragged else (2,))
@@ -465,13 +517,17 @@ def generate(model, input_ids, max_new_tokens: int = 32,
 
 
 def _generate_beam(model, ids, *, max_new_tokens, num_beams,
-                   eos_token_id):
+                   eos_token_id, length_penalty=0.0):
     """Beam search over the SAME cached single-jit scan as greedy: the
     batch dim carries B*K beam rows, each tick forwards every beam one
     token, expands to K*V candidates, keeps the top K per batch row,
     and reorders the KV caches by each survivor's parent beam. Finished
     beams (emitted eos) are frozen: their only continuation is eos at
     zero added logprob. Returns each row's highest-sum-logprob beam.
+
+    ``length_penalty`` != 0 ranks final beams by
+    sum_logprob / len(generated)**length_penalty (GNMT normalization;
+    0.0 keeps the raw sum — the oracle-pinned default).
 
     Reference surface: nn/decode.py BeamSearchDecoder/dynamic_decode is
     the seq2seq cell path; this is the decoder-only LLM analog (the
@@ -510,6 +566,7 @@ def _generate_beam(model, ids, *, max_new_tokens, num_beams,
         scores, tok0 = lax.top_k(lp0, K)               # [B, K] each
         tok0 = tok0.astype(jnp.int32)
         done = tok0 == eos
+        gen_len = jnp.ones((b, K), jnp.int32)          # tokens incl. eos
         flat = [jnp.repeat(c, K, axis=0)               # [B*K, S, kvh, dh]
                 for pair in caches for c in pair]
         tok_buf = jnp.full((b, K, max_new_tokens), eos, jnp.int32)
@@ -522,7 +579,7 @@ def _generate_beam(model, ids, *, max_new_tokens, num_beams,
             return jnp.take_along_axis(v, idx, axis=1).reshape(arr.shape)
 
         def step(carry, i):
-            tok, scores, done, tok_buf, *flat = carry
+            tok, scores, done, gen_len, tok_buf, *flat = carry
             caches_ = [(flat[2 * j], flat[2 * j + 1]) for j in range(L)]
             hidden, caches_ = fwd(
                 p, tok.reshape(b * K, 1), caches_, t0 + i - 1, s_max)
@@ -536,21 +593,27 @@ def _generate_beam(model, ids, *, max_new_tokens, num_beams,
             token = (idx % vocab).astype(jnp.int32)
             flat_ = [reorder(c, parent)
                      for pair in caches_ for c in pair]
-            done = jnp.take_along_axis(done, parent, axis=1) \
-                | (token == eos)
+            parent_done = jnp.take_along_axis(done, parent, axis=1)
+            done = parent_done | (token == eos)
+            gen_len = jnp.take_along_axis(gen_len, parent, axis=1) \
+                + (~parent_done).astype(jnp.int32)
             tok_buf = jnp.take_along_axis(
                 tok_buf, parent[:, :, None], axis=1).at[:, :, i].set(token)
-            return (token, scores, done, tok_buf, *flat_), ()
+            return (token, scores, done, gen_len, tok_buf, *flat_), ()
 
-        (_tok, scores, _done, tok_buf, *_rest), _ = lax.scan(
-            step, (tok0, scores, done, tok_buf, *flat),
+        (_tok, scores, _done, gen_len, tok_buf, *_rest), _ = lax.scan(
+            step, (tok0, scores, done, gen_len, tok_buf, *flat),
             jnp.arange(1, max_new_tokens))
+        if length_penalty != 0.0:
+            scores = scores / (gen_len.astype(jnp.float32)
+                               ** float(length_penalty))
         best = jnp.argmax(scores, axis=1)              # [B]
         out = jnp.take_along_axis(
             tok_buf, best[:, None, None], axis=1)[:, 0, :]
         return jnp.concatenate([ids, out], axis=1)
 
-    sig = ("beam", b, t0, max_new_tokens, K, eos, str(dtype), L)
+    sig = ("beam", b, t0, max_new_tokens, K, eos, str(dtype), L,
+           float(length_penalty))
     fn = cache.get(sig)
     if fn is None:
         fn = jax.jit(_run)
