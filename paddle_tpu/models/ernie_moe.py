@@ -154,6 +154,27 @@ class ErnieMoeForCausalLM(nn.Layer):
     def num_parameters(self) -> int:
         return sum(p.size for p in self.parameters())
 
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id=None, seed: int = 0,
+                 num_beams: int = 1, length_penalty: float = 0.0,
+                 repetition_penalty: float = 1.0, min_length: int = 0):
+        """KV-cache incremental decoding for the MoE family — the same
+        single-jit scan as Llama (models/generation.py) with the
+        routed-expert FFN run per step through the index-dispatch
+        program (EVAL routing: deterministic top-k, eval capacity)."""
+        from .generation import generate as _generate
+
+        return _generate(self, input_ids, max_new_tokens=max_new_tokens,
+                         do_sample=do_sample, temperature=temperature,
+                         top_k=top_k, top_p=top_p,
+                         eos_token_id=eos_token_id, seed=seed,
+                         num_beams=num_beams,
+                         length_penalty=length_penalty,
+                         repetition_penalty=repetition_penalty,
+                         min_length=min_length)
+
 
 def ernie_moe_shard_plan(model: ErnieMoeForCausalLM, mesh, mp_axis="mp",
                          ep_axis="ep"):

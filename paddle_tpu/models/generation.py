@@ -20,7 +20,9 @@ and a causal mask.
 The math mirrors models/llama.py exactly (same rope tables via
 incubate's ``_rope_tables``/``rotate_half``); the test suite pins the
 cached greedy path token-for-token against the model's own full-prefix
-forward, so any architecture drift fails loudly.
+forward, so any architecture drift fails loudly. Families: Llama, GPT,
+and ERNIE-MoE (per-step expert routing through the same index-dispatch
+program the training forward uses, EVAL routing).
 
 Supports: greedy, temperature / top-k / top-p sampling, eos early-stop
 (fixed-length scan with post-eos masking — compiler-friendly control
@@ -153,7 +155,8 @@ def _cached_forward(p, tokens, caches, pos, s_max, pads=None):
             & (slot >= pads[:, None, None])           # [B, T, S_max]
 
     new_caches = []
-    for lp, cache in zip(p["layers"], caches):
+    moe_statics = p.get("moe_statics")
+    for li, (lp, cache) in enumerate(zip(p["layers"], caches)):
         h = rms(x, lp["ln1"])
         q = (h @ lp["wq"]).reshape(b, t, nh, dh)
         k = (h @ lp["wk"]).reshape(b, t, nkv, dh)
@@ -166,8 +169,97 @@ def _cached_forward(p, tokens, caches, pos, s_max, pads=None):
                                        nh // nkv)
         new_caches.append(cache)
         x = x + ctx @ lp["wo"]
-        x = x + _llama_ffn(rms(x, lp["ln2"]), lp, dtype)
+        h2 = rms(x, lp["ln2"])
+        if "moe" in lp:
+            x = x + _moe_mlp(h2, lp, moe_statics[li], dtype)
+        else:
+            x = x + _llama_ffn(h2, lp, dtype)
     return rms(x, p["norm"])[:, -1, :], new_caches
+
+
+def _ernie_decode_params(model):
+    """ERNIE-MoE views: Llama-style attention/norms, per-layer MLP is
+    either the dense SwiGLU or a routed expert bank. Generation runs
+    the gate's current-mode routing (eval: deterministic top-k, eval
+    capacity factor). Expert CAPACITY is computed over the tokens of
+    each decode call (prefill: B*prompt_len; steps: B) with the same
+    shared formula as the training forward — so decode matches the
+    model's full-prefix forward whenever no expert saturates (the
+    oracle-pinned regime); when capacity binds, drop behavior is
+    per-call, mirroring the reference's step-wise serving ops
+    (masked/block MHA process only the step's tokens too)."""
+    cfg = model.config
+    layers = []
+    moe_statics = []
+    for layer in model.model.layers:
+        a = layer.self_attn
+        entry = dict(
+            ln1=layer.input_layernorm.weight._value,
+            wq=a.q_proj.weight._value, wk=a.k_proj.weight._value,
+            wv=a.v_proj.weight._value, wo=a.o_proj.weight._value,
+            ln2=layer.post_attention_layernorm.weight._value,
+        )
+        if layer.is_moe:
+            gate, ex = layer.mlp.gate, layer.mlp.experts
+            entry["moe"] = dict(
+                gw=gate.weight._value, gb=gate.bias._value,
+                w0=ex.w0._value, b0=ex.b0._value,
+                w1=ex.w1._value, b1=ex.b1._value,
+            )
+            # routing statics live OUTSIDE the layer dict: the layers
+            # list rides as a jit ARGUMENT, and a string inside it
+            # would break tracing. _train_factor() already respects
+            # gate.training (GShard: capacity[0] train / [1] eval;
+            # Naive: flat factor).
+            moe_statics.append((int(gate.topk),
+                                float(gate._train_factor()),
+                                ex.activation, bool(gate._normalize)))
+        else:
+            m = layer.mlp
+            entry.update(wg=m.gate_proj.weight._value,
+                         wu=m.up_proj.weight._value,
+                         wd=m.down_proj.weight._value)
+            moe_statics.append(None)
+        layers.append(entry)
+    return dict(
+        embed=model.model.embed_tokens.weight._value,
+        norm=model.model.norm.weight._value,
+        head=model.lm_head.weight._value,
+        layers=layers,
+        moe_statics=tuple(moe_statics),   # hashable → static_cfg
+        nh=cfg.num_attention_heads, nkv=cfg.num_key_value_heads,
+        dh=cfg.hidden_size // cfg.num_attention_heads,
+        eps=cfg.rms_norm_eps, theta=cfg.rope_theta,
+    )
+
+
+def _moe_mlp(h, lp, statics, dtype):
+    """Routed expert FFN for the decode mirror: EVAL GShard/naive
+    routing (top-k softmax gate, deterministic) through the same
+    index-dispatch program the model's own forward uses
+    (moe_layer._moe_idx_ffn_fwd), so decode and full-prefix forward
+    route identically."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..incubate.distributed.models.moe.moe_layer import _moe_idx_ffn_fwd
+
+    from ..incubate.distributed.models.moe.gate import _capacity
+
+    topk, factor, activation, normalize = statics
+    m = lp["moe"]
+    shape = h.shape
+    x = h.reshape(-1, shape[-1])
+    n, e = x.shape[0], m["gw"].shape[1]
+    probs = jax.nn.softmax(
+        (x @ m["gw"] + m["gb"]).astype(jnp.float32), axis=-1)
+    # the SHARED capacity rule (gate._capacity) over THIS call's tokens
+    cap = _capacity(n, e, topk, factor)
+    out = _moe_idx_ffn_fwd(
+        probs, x, m["w0"], m["b0"], m["w1"], m["b1"],
+        jax.random.PRNGKey(0), k=topk, capacity=cap,
+        activation=activation, normalize=normalize, random2=False)
+    return out.astype(dtype).reshape(shape)
 
 
 def _gpt_decode_params(model):
@@ -247,9 +339,13 @@ def _decode_family(model):
         return _llama_decode_params(model), _cached_forward
     if hasattr(model, "gpt"):
         return _gpt_decode_params(model), _gpt_cached_forward
+    from .ernie_moe import ErnieMoeForCausalLM
+
+    if isinstance(model, ErnieMoeForCausalLM):
+        return _ernie_decode_params(model), _cached_forward
     raise TypeError(
-        f"generate() supports the Llama and GPT families; got "
-        f"{type(model).__name__}")
+        f"generate() supports the Llama, GPT and ERNIE-MoE families; "
+        f"got {type(model).__name__}")
 
 
 def _head_logits(p, hidden):
@@ -424,6 +520,11 @@ def generate(model, input_ids, max_new_tokens: int = 32,
                                eos_token_id=eos_token_id, seed=seed,
                                block_size=block_size)
     p, fwd = _decode_family(model)
+    if pads_np is not None and any("moe" in lp for lp in p["layers"]):
+        raise NotImplementedError(
+            "generate: ragged (left-padded) prompts are not supported "
+            "for MoE models — pad rows would consume expert capacity, "
+            "so a padded row could not reproduce its solo decode")
     s_max = t0 + max_new_tokens
     nkv, dh, L = p["nkv"], p["dh"], len(p["layers"])
     dtype = p["embed"].dtype
@@ -506,7 +607,7 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     # generate must not reuse the stale closure
     sig = (b, t0, max_new_tokens, do_sample, float(temperature),
            int(top_k), float(top_p), eos, ragged, str(dtype), L,
-           rep, min_new)
+           rep, min_new, p.get("moe_statics"))
     fn = cache.get(sig)
     if fn is None:
         fn = jax.jit(_run, static_argnums=() if ragged else (2,))
@@ -613,7 +714,7 @@ def _generate_beam(model, ids, *, max_new_tokens, num_beams,
         return jnp.concatenate([ids, out], axis=1)
 
     sig = ("beam", b, t0, max_new_tokens, K, eos, str(dtype), L,
-           float(length_penalty))
+           float(length_penalty), p.get("moe_statics"))
     fn = cache.get(sig)
     if fn is None:
         fn = jax.jit(_run)
@@ -641,6 +742,10 @@ def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
     from ..incubate.nn.functional import _rope_tables
     from ..incubate.nn.functional.inference_attention import _bmha_fwd
 
+    if not hasattr(model, "llama") and not hasattr(model, "gpt"):
+        raise NotImplementedError(
+            "paged=True decode supports the Llama and GPT families; "
+            "MoE models use the dense cache path")
     p, _dense_fwd = _decode_family(model)
     is_llama = hasattr(model, "llama")
     b, t0 = ids.shape

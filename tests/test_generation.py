@@ -663,3 +663,84 @@ class TestGenerationKnobs:
         with pytest.raises(ValueError, match="length_penalty"):
             model.generate(paddle.to_tensor(ids), max_new_tokens=2,
                            length_penalty=1.0)
+
+
+class TestErnieMoeGeneration:
+    """The MoE family decodes through the same cached scan: per-step
+    expert routing must reproduce the model's own full-prefix forward
+    token for token (EVAL routing is deterministic)."""
+
+    def _moe_model(self):
+        from paddle_tpu.models import ErnieMoeConfig, ErnieMoeForCausalLM
+
+        paddle.seed(8)
+        m = ErnieMoeForCausalLM(ErnieMoeConfig.tiny())
+        m.eval()
+        return m
+
+    def test_greedy_matches_full_prefix_oracle(self):
+        model = self._moe_model()
+        V = model.config.vocab_size
+        ids = np.random.RandomState(31).randint(
+            1, V, (2, 6)).astype("int64")
+        n_new = 6
+        want = _oracle_greedy(model, ids, n_new)
+        got = model.generate(paddle.to_tensor(ids),
+                             max_new_tokens=n_new).numpy()
+        # assert on clear-margin positions like the llama oracle test
+        walk = ids.copy()
+        for step in range(n_new):
+            logits = model(paddle.to_tensor(walk)).numpy()[:, -1]
+            srt = np.sort(logits, -1)
+            clear = (srt[:, -1] - srt[:, -2]) > 0.05
+            pos = 6 + step
+            if clear.any():
+                np.testing.assert_array_equal(
+                    got[clear, pos], want[clear, pos],
+                    err_msg=f"moe token {step} (clear margin)")
+            walk = want[:, :pos + 1]
+
+    def test_sampling_and_beam_run(self):
+        model = self._moe_model()
+        V = model.config.vocab_size
+        ids = np.random.RandomState(32).randint(
+            1, V, (1, 4)).astype("int64")
+        a = model.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                           do_sample=True, seed=1).numpy()
+        b = model.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                           do_sample=True, seed=1).numpy()
+        np.testing.assert_array_equal(a, b)
+        beam = model.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                              num_beams=2).numpy()
+        assert beam.shape == (1, 7)
+        assert (beam >= 0).all() and (beam < V).all()
+
+    def test_unsupported_combos_rejected(self):
+        from paddle_tpu.models.generation import generate
+
+        model = self._moe_model()
+        ids = np.array([[0, 2, 3]], dtype="int64")
+        with pytest.raises(NotImplementedError, match="expert capacity"):
+            generate(model, paddle.to_tensor(ids), max_new_tokens=2,
+                     pad_token_id=0)
+        with pytest.raises(NotImplementedError, match="dense cache"):
+            generate(model, paddle.to_tensor(ids), max_new_tokens=2,
+                     paged=True)
+
+    def test_train_eval_mode_changes_cache_key(self):
+        """The GShard capacity factor depends on gate.training and is
+        baked into the jitted closure: flipping train()/eval() between
+        calls must RETRACE (new cache entry), not reuse the stale
+        factor."""
+        model = self._moe_model()
+        V = model.config.vocab_size
+        ids = np.random.RandomState(33).randint(
+            1, V, (1, 4)).astype("int64")
+        model.generate(paddle.to_tensor(ids), max_new_tokens=2)
+        n1 = len(model._generation_jit_cache)
+        model.train()
+        try:
+            model.generate(paddle.to_tensor(ids), max_new_tokens=2)
+        finally:
+            model.eval()
+        assert len(model._generation_jit_cache) == n1 + 1
