@@ -43,7 +43,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["generate"]
+__all__ = ["generate", "generate_speculative"]
 
 
 def _llama_decode_params(model):
@@ -113,14 +113,18 @@ def _gpt_ffn(h, lp, dtype):
         @ lp["w2"] + lp["b2"]
 
 
-def _cached_forward(p, tokens, caches, pos, s_max, pads=None):
+def _cached_forward(p, tokens, caches, pos, s_max, pads=None,
+                    return_all=False):
     """Forward ``tokens`` [B, T] through the stack at absolute positions
     ``pos..pos+T-1``, reading/updating the per-layer KV caches
-    [B, S_max, kvh, dh]. Returns (last-position hidden [B, H], caches).
-    Causal within the new tokens; full attention to everything cached
-    before ``pos``. ``pads`` [B] (left-pad counts) offsets each row's
-    rope positions and blanks its pad slots out of the visibility mask
-    — the ragged-prompt path."""
+    [B, S_max, kvh, dh]. Returns (last-position hidden [B, H], caches) —
+    or every position's hidden [B, T, H] with ``return_all`` (the
+    speculative verify pass needs all of them). Causal within the new
+    tokens; full attention to everything cached before ``pos``.
+    ``pads`` [B] (left-pad counts) offsets each row's rope positions and
+    blanks its pad slots out of the visibility mask — the ragged-prompt
+    path. ``pos`` may be a traced scalar (speculative decoding advances
+    it dynamically)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -174,7 +178,8 @@ def _cached_forward(p, tokens, caches, pos, s_max, pads=None):
             x = x + _moe_mlp(h2, lp, moe_statics[li], dtype)
         else:
             x = x + _llama_ffn(h2, lp, dtype)
-    return rms(x, p["norm"])[:, -1, :], new_caches
+    out = rms(x, p["norm"])
+    return (out if return_all else out[:, -1, :]), new_caches
 
 
 def _ernie_decode_params(model):
@@ -295,7 +300,8 @@ def _gpt_decode_params(model):
     return out
 
 
-def _gpt_cached_forward(p, tokens, caches, pos, s_max, pads=None):
+def _gpt_cached_forward(p, tokens, caches, pos, s_max, pads=None,
+                        return_all=False):
     """GPT block stack with a dense KV cache (pre-LN, learned
     positions); same contract as the llama `_cached_forward`."""
     import jax
@@ -330,7 +336,8 @@ def _gpt_cached_forward(p, tokens, caches, pos, s_max, pads=None):
         new_caches.append(cache)
         x = x + ctx @ lp["wo"] + lp["bo"]
         x = x + _gpt_ffn(ln(x, lp["ln2_w"], lp["ln2_b"]), lp, dtype)
-    return ln(x, p["normf_w"], p["normf_b"])[:, -1, :], new_caches
+    out = ln(x, p["normf_w"], p["normf_b"])
+    return (out if return_all else out[:, -1, :]), new_caches
 
 
 def _decode_family(model):
@@ -368,8 +375,12 @@ def _cached_attention(q, k, v, cache, pos, visible, n_rep):
     b, t = q.shape[:2]
     dh = q.shape[-1]
     ck, cv = cache
-    ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
-    cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+    # pos may be traced int32 (speculative decode); literal indices must
+    # match its dtype exactly under jax_enable_x64
+    z = jnp.int32(0)
+    pos_i = jnp.asarray(pos, jnp.int32)
+    ck = lax.dynamic_update_slice(ck, k, (z, pos_i, z, z))
+    cv = lax.dynamic_update_slice(cv, v, (z, pos_i, z, z))
     kk = jnp.repeat(ck, n_rep, axis=2) if n_rep > 1 else ck
     vv = jnp.repeat(cv, n_rep, axis=2) if n_rep > 1 else cv
     logits = jnp.einsum("bthd,bshd->bhts", q, kk,
@@ -720,6 +731,170 @@ def _generate_beam(model, ids, *, max_new_tokens, num_beams,
         fn = jax.jit(_run)
         cache[sig] = fn
     return Tensor._from_value(fn(arrays, ids))
+
+
+def generate_speculative(model, draft_model, input_ids,
+                         max_new_tokens: int = 32, gamma: int = 4,
+                         eos_token_id: Optional[int] = None):
+    """Speculative GREEDY decoding: ``draft_model`` proposes ``gamma``
+    tokens per round with its own cached scan, the target verifies all
+    of them in ONE batched cached forward, and the longest matching
+    prefix plus the target's own next token are accepted — so the
+    output is EXACTLY ``model``'s greedy decode (the acceptance rule
+    only ever keeps tokens the target itself would have emitted), while
+    each accepted draft token saves one full target forward.
+
+    The whole loop is one jitted ``lax.while_loop``; cache "rollback"
+    after a rejection is free because the dense cache is addressed by
+    position — stale slots are simply overwritten before they become
+    visible. Batch size 1 (the latency-bound serving regime speculative
+    decoding exists for). Reference surface: the ecosystem's
+    speculative/draft-model decoding over the same serving cache ops.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    ids = input_ids._value if isinstance(input_ids, Tensor) \
+        else jnp.asarray(np.asarray(input_ids))
+    ids = ids.astype(jnp.int32)
+    if ids.ndim != 2 or ids.shape[0] != 1:
+        raise ValueError(
+            "generate_speculative expects [1, prompt_len] input_ids "
+            "(batch 1 — the latency-bound regime)")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    t0 = ids.shape[1]
+    if max_new_tokens <= 0:
+        return Tensor._from_value(ids)
+    pt, fwd_t = _decode_family(model)
+    pd, fwd_d = _decode_family(draft_model)
+    if pt.get("moe_statics") or pd.get("moe_statics"):
+        raise NotImplementedError(
+            "generate_speculative supports dense families only: a MoE "
+            "model's expert capacity is computed per call, so the "
+            "multi-token verify window could drop tokens that the "
+            "one-token-per-step greedy decode keeps, breaking the "
+            "exact-equality guarantee")
+    if pt["embed"].shape[0] != pd["embed"].shape[0]:
+        raise ValueError(
+            f"target and draft vocabularies differ "
+            f"({pt['embed'].shape[0]} vs {pd['embed'].shape[0]})")
+    # buffer leaves room for one full overshoot round past max_new
+    cap = max_new_tokens + gamma + 1
+    s_max = t0 + cap
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+    st_t, arr_t, cache = _prep_decode(model, pt, t0, cap)
+    st_d, arr_d, _ = _prep_decode(draft_model, pd, t0, cap)
+    L_t, L_d = len(pt["layers"]), len(pd["layers"])
+
+    def _mk_caches(p, L):
+        return [(jnp.zeros((1, s_max, p["nkv"], p["dh"]),
+                           p["embed"].dtype),
+                 jnp.zeros((1, s_max, p["nkv"], p["dh"]),
+                           p["embed"].dtype)) for _ in range(L)]
+
+    def _run(at, ad, ids):
+        pt = {**at, **st_t}
+        pd = {**ad, **st_d}
+
+        # prefill BOTH models; target's argmax is the first pending tok
+        ct = _mk_caches(pt, L_t)
+        cd = _mk_caches(pd, L_d)
+        hid, ct = fwd_t(pt, ids, ct, 0, s_max)
+        pending = jnp.argmax(_head_logits(pt, hid),
+                             axis=-1).astype(jnp.int32)     # [1]
+        _hd, cd = fwd_d(pd, ids, cd, 0, s_max)
+        out_buf = jnp.full((1, cap), eos if eos >= 0 else 0, jnp.int32)
+        flat_t = [c for pair in ct for c in pair]
+        flat_d = [c for pair in cd for c in pair]
+
+        def cond(state):
+            n_gen = state[0]
+            return n_gen < max_new_tokens
+
+        def body(state):
+            n_gen, pending, out_buf, *flat = state
+            ct_ = [(flat[2 * j], flat[2 * j + 1]) for j in range(L_t)]
+            off = 2 * L_t
+            cd_ = [(flat[off + 2 * j], flat[off + 2 * j + 1])
+                   for j in range(L_d)]
+            P = t0 + n_gen                 # pending token's position
+
+            # --- draft phase: gamma greedy tokens from the draft ---
+            def dstep(carry, i):
+                tok, *dflat = carry
+                dc = [(dflat[2 * j], dflat[2 * j + 1])
+                      for j in range(L_d)]
+                hid, dc = fwd_d(pd, tok[:, None], dc, P + i, s_max)
+                nxt = jnp.argmax(_head_logits(pd, hid),
+                                 axis=-1).astype(jnp.int32)
+                dflat_ = [c for pair in dc for c in pair]
+                return (nxt, *dflat_), nxt
+
+            dflat0 = [c for pair in cd_ for c in pair]
+            (last_d, *dflat_), drafts = lax.scan(
+                dstep, (pending, *dflat0), jnp.arange(gamma))
+            drafts = drafts[:, 0]                         # [gamma]
+            cd_ = [(dflat_[2 * j], dflat_[2 * j + 1])
+                   for j in range(L_d)]
+            # forward d_gamma too (logits discarded): a fully-accepted
+            # round advances past slot P+gamma, which would otherwise
+            # stay an unwritten-but-visible hole in the draft's cache
+            # and silently corrupt every later draft proposal
+            _hd, cd_ = fwd_d(pd, last_d[:, None], cd_, P + gamma, s_max)
+
+            # --- verify: ONE target forward over pending + drafts ---
+            window = jnp.concatenate([pending, drafts])[None, :]
+            hid_all, ct_ = fwd_t(pt, window, ct_, P, s_max,
+                                 return_all=True)
+            t_preds = jnp.argmax(
+                _head_logits(pt, hid_all[0]), axis=-1
+            ).astype(jnp.int32)                           # [gamma+1]
+
+            # longest matching prefix, then the target's own token:
+            # this round emits [pending, d_1..d_a] (a+1 tokens, all of
+            # them the target's own greedy choices) and the fix/bonus
+            # token y becomes the next pending
+            matches = t_preds[:gamma] == drafts
+            a = jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))
+            y = t_preds[a]
+            # the verify window IS the emit candidate list; slots past
+            # a+1 hold rejected drafts that the NEXT round overwrites
+            # (the loop exits only once n_gen >= max_new, so every slot
+            # below max_new ends up final)
+            out_buf = lax.dynamic_update_slice(
+                out_buf, window, (jnp.int32(0), n_gen))
+            n_gen = (n_gen + a + 1).astype(jnp.int32)
+            flat_t_ = [c for pair in ct_ for c in pair]
+            flat_d_ = [c for pair in cd_ for c in pair]
+            return (n_gen, y[None], out_buf, *flat_t_, *flat_d_)
+
+        state = (jnp.int32(0), pending, out_buf, *flat_t, *flat_d)
+        state = lax.while_loop(cond, body, state)
+        out = state[2][:, :max_new_tokens]
+        if eos >= 0:
+            # greedy-equivalent eos semantics: everything after the
+            # first eos is eos
+            seen = jnp.cumsum((out == eos).astype(jnp.int32), axis=1)
+            prior = seen - (out == eos).astype(jnp.int32)
+            out = jnp.where(prior > 0, jnp.int32(eos), out)
+        return jnp.concatenate([ids, out], axis=1)
+
+    # the compiled fn closes over BOTH models' statics only (weights
+    # ride as jit arguments), so the key is the statics themselves — a
+    # recreated draft with identical architecture reuses the executable,
+    # and no stale closure can survive an id() reuse
+    sig = ("spec", t0, max_new_tokens, gamma, eos,
+           str(pt["embed"].dtype), L_t, str(pd["embed"].dtype), L_d,
+           tuple(sorted((k, v) for k, v in st_t.items())),
+           tuple(sorted((k, v) for k, v in st_d.items())))
+    fn = cache.get(sig)
+    if fn is None:
+        fn = jax.jit(_run)
+        cache[sig] = fn
+    out = fn(arr_t, arr_d, ids)
+    return Tensor._from_value(out)
 
 
 def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,

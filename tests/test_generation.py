@@ -744,3 +744,135 @@ class TestErnieMoeGeneration:
         finally:
             model.eval()
         assert len(model._generation_jit_cache) == n1 + 1
+
+
+class TestSpeculativeDecoding:
+    """Draft-and-verify greedy decoding: by the acceptance rule the
+    output must EXACTLY equal the target's own greedy decode — for any
+    draft model, any gamma. That equality is the whole test surface."""
+
+    def _target(self):
+        return _model()
+
+    def _draft(self):
+        paddle.seed(77)  # different weights: low acceptance
+        cfg = LlamaConfig.tiny(
+            vocab_size=97, hidden_size=16, intermediate_size=32,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_key_value_heads=2, max_position_embeddings=64)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m
+
+    @pytest.mark.parametrize("gamma", [1, 3, 7])
+    def test_equals_target_greedy_with_weak_draft(self, gamma):
+        from paddle_tpu.models.generation import generate_speculative
+
+        target, draft = self._target(), self._draft()
+        ids = np.random.RandomState(50).randint(
+            1, 97, (1, 6)).astype("int64")
+        want = target.generate(paddle.to_tensor(ids),
+                               max_new_tokens=9).numpy()
+        got = generate_speculative(target, draft, paddle.to_tensor(ids),
+                                   max_new_tokens=9, gamma=gamma).numpy()
+        np.testing.assert_array_equal(got, want)
+
+    def test_equals_target_greedy_with_perfect_draft(self):
+        """draft == target: every draft token is accepted (the
+        all-accept + bonus-token path), output still exact."""
+        from paddle_tpu.models.generation import generate_speculative
+
+        target = self._target()
+        ids = np.random.RandomState(51).randint(
+            1, 97, (1, 5)).astype("int64")
+        want = target.generate(paddle.to_tensor(ids),
+                               max_new_tokens=8).numpy()
+        got = generate_speculative(target, target, paddle.to_tensor(ids),
+                                   max_new_tokens=8, gamma=4).numpy()
+        np.testing.assert_array_equal(got, want)
+
+    def test_eos_equivalence(self):
+        from paddle_tpu.models.generation import generate_speculative
+
+        target, draft = self._target(), self._draft()
+        ids = np.random.RandomState(52).randint(
+            1, 97, (1, 4)).astype("int64")
+        greedy1 = target.generate(paddle.to_tensor(ids),
+                                  max_new_tokens=1).numpy()
+        eos = int(greedy1[0, 4])
+        want = target.generate(paddle.to_tensor(ids), max_new_tokens=7,
+                               eos_token_id=eos).numpy()
+        got = generate_speculative(target, draft, paddle.to_tensor(ids),
+                                   max_new_tokens=7, gamma=3,
+                                   eos_token_id=eos).numpy()
+        np.testing.assert_array_equal(got, want)
+
+    def test_short_horizon_and_bad_args(self):
+        from paddle_tpu.models.generation import generate_speculative
+
+        target, draft = self._target(), self._draft()
+        ids = np.random.RandomState(53).randint(
+            1, 97, (1, 4)).astype("int64")
+        # max_new < gamma: overshoot rounds must clip correctly
+        want = target.generate(paddle.to_tensor(ids),
+                               max_new_tokens=2).numpy()
+        got = generate_speculative(target, draft, paddle.to_tensor(ids),
+                                   max_new_tokens=2, gamma=5).numpy()
+        np.testing.assert_array_equal(got, want)
+        with pytest.raises(ValueError, match="batch 1"):
+            generate_speculative(
+                target, draft,
+                paddle.to_tensor(np.ones((2, 3), "int64")),
+                max_new_tokens=2)
+        with pytest.raises(ValueError, match="gamma"):
+            generate_speculative(target, draft, paddle.to_tensor(ids),
+                                 max_new_tokens=2, gamma=0)
+
+    def test_moe_target_rejected(self):
+        from paddle_tpu.models import ErnieMoeConfig, ErnieMoeForCausalLM
+        from paddle_tpu.models.generation import generate_speculative
+
+        paddle.seed(60)
+        moe = ErnieMoeForCausalLM(ErnieMoeConfig.tiny())
+        moe.eval()
+        ids = np.array([[1, 2, 3]], dtype="int64")
+        with pytest.raises(NotImplementedError, match="dense families"):
+            generate_speculative(moe, self._draft(),
+                                 paddle.to_tensor(ids), max_new_tokens=2)
+
+    def test_draft_cache_has_no_hole_after_full_round(self):
+        """Round-5 review catch: the draft scan alone writes k/v only
+        for [pending, d_1..d_{gamma-1}]; a fully-accepted round then
+        advances PAST slot P+gamma, leaving it an unwritten-but-visible
+        hole that silently corrupts every later draft proposal. The fix
+        forwards d_gamma too. White-box: emulate one draft phase with
+        the module's own pieces and assert slot P+gamma is written."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import (_cached_forward,
+                                                  _head_logits,
+                                                  _llama_decode_params)
+
+        model = self._draft()
+        p = _llama_decode_params(model)
+        ids = np.random.RandomState(55).randint(
+            1, 97, (1, 5)).astype("int64")
+        t0, gamma = 5, 3
+        s_max = t0 + 10
+        caches = [(jnp.zeros((1, s_max, 2, 8), jnp.float32),
+                   jnp.zeros((1, s_max, 2, 8), jnp.float32))
+                  for _ in range(len(p["layers"]))]
+        hid, caches = _cached_forward(
+            p, jnp.asarray(ids, jnp.int32), caches, 0, s_max)
+        pending = jnp.argmax(_head_logits(p, hid), -1).astype(jnp.int32)
+        tok = pending
+        for i in range(gamma):
+            hid, caches = _cached_forward(
+                p, tok[:, None], caches, t0 + i, s_max)
+            tok = jnp.argmax(_head_logits(p, hid), -1).astype(jnp.int32)
+        # the FIX: d_gamma forwarded at P+gamma (mirrors the impl)
+        _h, caches = _cached_forward(
+            p, tok[:, None], caches, t0 + gamma, s_max)
+        k0 = np.asarray(caches[0][0])
+        assert np.abs(k0[0, t0 + gamma]).sum() > 0, \
+            "slot P+gamma unwritten — draft cache hole"
