@@ -188,3 +188,63 @@ class TestUNetDerivedPlanOracle:
         np.testing.assert_allclose(
             np.asarray(sharded._value), np.asarray(dense._value),
             rtol=2e-4, atol=2e-5)
+
+
+class TestBertPretrainingDerivedPlan:
+    """BertForPretraining adds a head topology nothing else exercises:
+    transform+norm feed an MLM head linear whose logits reach the CE,
+    plus an indivisible NSP classifier. The planner must vocab-shard
+    the MLM head (detected through the linear->reshape->CE chain),
+    leave the 2-class NSP head replicated, and the derived plan must
+    train to the dense oracle."""
+
+    def _cfg(self):
+        from paddle_tpu.models import BertConfig
+
+        return BertConfig.tiny(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=4,
+            max_position_embeddings=16, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0)
+
+    def _derive(self, m, mesh):
+        return derive_shard_plan(
+            m, [((4, 8), "int64"), ((4, 8), "int64"), ((4, 1), "int64")],
+            mesh,
+            forward=lambda mm, i, l, n: mm(
+                i, masked_lm_labels=l, next_sentence_labels=n))
+
+    def test_mlm_head_is_vocab_parallel_nsp_replicated(self):
+        from paddle_tpu.models import BertForPretraining
+
+        paddle.seed(0)
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        plan = self._derive(BertForPretraining(self._cfg()), mesh)
+        mlm_w = plan["mlm_head.weight"]
+        assert isinstance(mlm_w[1], Shard) and mlm_w[1].dim == 1, mlm_w
+        mlm_b = plan["mlm_head.bias"]
+        assert isinstance(mlm_b[1], Shard) and mlm_b[1].dim == 0, mlm_b
+        for name in ("nsp_head.weight", "nsp_head.bias"):
+            assert all(isinstance(p, Replicate) for p in plan[name]), \
+                (name, plan[name])
+        emb = plan["bert.embeddings.word_embeddings.weight"]
+        assert isinstance(emb[1], Shard) and emb[1].dim == 0, emb
+
+    def test_trains_like_dense(self):
+        from paddle_tpu.models import BertForPretraining
+
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        rng = np.random.RandomState(7)
+        ids = rng.randint(0, 128, (4, 8)).astype("int64")
+        mlm = np.where(rng.rand(4, 8) < 0.3, ids, -100)
+        nsp = rng.randint(0, 2, (4, 1)).astype("int64")
+        rep = [dist.Shard(0), dist.Replicate()]
+        call = lambda m, i, l, n: m(i, masked_lm_labels=l,
+                                    next_sentence_labels=n)
+        mk = lambda: BertForPretraining(self._cfg())
+        derive = lambda m: self._derive(m, mesh)
+        dense = _train_two_steps(mk, (ids, mlm, nsp), mesh, derive,
+                                 (rep, rep, rep), shard=False, call=call)
+        sharded = _train_two_steps(mk, (ids, mlm, nsp), mesh, derive,
+                                   (rep, rep, rep), shard=True, call=call)
+        np.testing.assert_allclose(sharded, dense, rtol=2e-4, atol=2e-5)
