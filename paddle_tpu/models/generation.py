@@ -909,7 +909,13 @@ def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
     view is gathered through the block table exactly like the
     reference's serving kernel (block_multihead_attention.py:19). RoPE
     rides inside the block program (Llama); learned positions are added
-    at the embedding by logical position (GPT)."""
+    at the embedding by logical position (GPT).
+
+    MEASURED (tools/paged_decode_probe.py, v5e): the block-table
+    gather/scatter program is ~10x slower than the dense scan at 645M
+    serving shapes — use paged for its cache semantics (ragged pools,
+    pad-free memory), the dense path for speed, until a Pallas paged-
+    attention kernel lands."""
     import jax
     import jax.numpy as jnp
     from jax import lax
