@@ -911,11 +911,14 @@ def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
     rides inside the block program (Llama); learned positions are added
     at the embedding by logical position (GPT).
 
-    MEASURED (tools/paged_decode_probe.py, v5e): the block-table
-    gather/scatter program is ~10x slower than the dense scan at 645M
-    serving shapes — use paged for its cache semantics (ragged pools,
-    pad-free memory), the dense path for speed, until a Pallas paged-
-    attention kernel lands."""
+    MEASURED (tools/paged_decode_probe.py + paged_kernel_probe.py,
+    v5e): the block-table gather/scatter program is ~10x slower than
+    the dense scan at 645M serving shapes, and even jax's official
+    Pallas paged_attention kernel (numerically equivalent, 1.6x faster
+    than the gather) remains ~6x the dense per-layer budget at short
+    contexts — paged attention is overhead-bound there. Use paged for
+    its cache semantics (ragged pools, pad-free memory, the reference
+    serving interface); the dense scan is the throughput path."""
     import jax
     import jax.numpy as jnp
     from jax import lax
