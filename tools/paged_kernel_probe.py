@@ -14,11 +14,8 @@ overhead-bound; the paged path's value is cache MEMORY semantics
 (pad-free pooling, no per-sequence S_max allocation), and the dense
 single-jit scan remains the throughput path the decode bench measures.
 """
-import os
-import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 import jax
 import jax.numpy as jnp
