@@ -876,3 +876,27 @@ class TestSpeculativeDecoding:
         k0 = np.asarray(caches[0][0])
         assert np.abs(k0[0, t0 + gamma]).sum() > 0, \
             "slot P+gamma unwritten — draft cache hole"
+
+    def test_cross_family_draft(self):
+        """The acceptance rule is family-agnostic: a LLAMA draft
+        proposing for a GPT target (same vocab) must still produce
+        exactly the GPT target's greedy output — each model runs its
+        own cached forward inside the same loop."""
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        from paddle_tpu.models.generation import generate_speculative
+
+        paddle.seed(21)
+        gpt = GPTForCausalLM(GPTConfig.tiny(
+            vocab_size=97, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0))
+        gpt.eval()
+        draft = self._draft()          # llama family, same vocab 97
+        ids = np.random.RandomState(56).randint(
+            1, 97, (1, 5)).astype("int64")
+        want = gpt.generate(paddle.to_tensor(ids),
+                            max_new_tokens=8).numpy()
+        got = generate_speculative(gpt, draft, paddle.to_tensor(ids),
+                                   max_new_tokens=8, gamma=3).numpy()
+        np.testing.assert_array_equal(got, want)
