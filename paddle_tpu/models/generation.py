@@ -24,16 +24,19 @@ forward, so any architecture drift fails loudly. Families: Llama, GPT,
 and ERNIE-MoE (per-step expert routing through the same index-dispatch
 program the training forward uses, EVAL routing).
 
-Supports: greedy, temperature / top-k / top-p sampling, eos early-stop
-(fixed-length scan with post-eos masking — compiler-friendly control
-flow instead of a data-dependent loop), LEFT-PADDED mixed-length
-prompts (``pad_token_id=...``: per-row rope/position offsets + a
-pad-aware visibility mask, every row pinned against its own
-full-prefix oracle in tests), and a PAGED block-KV-cache decode path
-(``paged=True``, Llama and GPT families) that drives the same
-``block_mha_p`` program the serving op
+Supports: greedy, temperature / top-k / top-p sampling with
+repetition_penalty / min_length, eos early-stop (fixed-length scan
+with post-eos masking — compiler-friendly control flow instead of a
+data-dependent loop), BEAM SEARCH with GNMT length_penalty,
+LEFT-PADDED mixed-length prompts (``pad_token_id=...``: per-row
+rope/position offsets + a pad-aware visibility mask, every row pinned
+against its own full-prefix oracle in tests), a PAGED block-KV-cache
+decode path (``paged=True``, Llama and GPT families) that drives the
+same ``block_mha_p`` program the serving op
 ``incubate.nn.functional.block_multihead_attention`` exposes
-(reference: incubate/nn/functional/block_multihead_attention.py:19).
+(reference: incubate/nn/functional/block_multihead_attention.py:19),
+and SPECULATIVE draft-and-verify decoding (``generate_speculative``,
+output exactly equal to the target's greedy by construction).
 """
 from __future__ import annotations
 
