@@ -185,3 +185,61 @@ def dispatch_cache_info():
         "forward": _jitted_forward.cache_info(),
         "vjp_fallback": _jitted_vjp_fallback.cache_info(),
     }
+
+
+def positional_capacity(fn) -> tuple:
+    """(min_required_positional, max_positional_or_None_if_variadic) of a
+    callable, or (None, None) when the signature is opaque (C builtins).
+    Shared by primitive_metadata and tools/lint_registry.py so the
+    analysis layer and the registry lint agree on what a signature can
+    accept."""
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return None, None
+    pos = [p for p in sig.parameters.values()
+           if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    if any(p.kind == p.VAR_POSITIONAL for p in sig.parameters.values()):
+        return sum(1 for p in pos if p.default is p.empty), None
+    return sum(1 for p in pos if p.default is p.empty), len(pos)
+
+
+def primitive_metadata(name: str) -> Dict[str, Any]:
+    """Introspected per-primitive metadata for the analysis/lint layer
+    (static/analysis, tools/lint_registry.py) — the KernelFactory
+    attribute surface (kernel_factory.h KernelKey/KernelArgsDef) reduced
+    to what a flat jax registry can answer: flags, grad wiring, and the
+    positional/keyword capacity of forward/vjp/save."""
+    import inspect
+
+    prim = PRIMITIVES[name]
+    meta: Dict[str, Any] = {
+        "name": prim.name,
+        "jittable": prim.jittable,
+        "multi_out": prim.multi_out,
+        "nondiff": prim.nondiff,
+        "has_vjp": prim.vjp is not None,
+        "has_save": prim.save is not None,
+        "backward_only": prim.forward is None,
+        "min_arity": None,
+        "max_arity": None,
+        "static_kwargs": (),
+        "vjp_capacity": None,
+        "save_capacity": None,
+    }
+    if callable(prim.vjp):
+        meta["vjp_capacity"] = positional_capacity(prim.vjp)
+    if callable(prim.save):
+        meta["save_capacity"] = positional_capacity(prim.save)
+    if prim.forward is None:
+        return meta
+    meta["min_arity"], meta["max_arity"] = positional_capacity(prim.forward)
+    try:
+        sig = inspect.signature(prim.forward)
+    except (TypeError, ValueError):
+        return meta
+    meta["static_kwargs"] = tuple(
+        p.name for p in sig.parameters.values() if p.kind == p.KEYWORD_ONLY)
+    return meta

@@ -15,11 +15,17 @@ from .program import (  # noqa: F401
     Executor, Program, data, default_main_program, default_startup_program,
     program_guard,
 )
+from . import analysis  # noqa: F401
+from .analysis import (  # noqa: F401
+    ProgramVerificationError, check_program, run_lints, verify_program,
+)
 
 __all__ = [
     "InputSpec", "Program", "Executor", "data", "program_guard",
     "default_main_program", "default_startup_program", "name_scope",
     "save_inference_model", "load_inference_model",
+    "analysis", "verify_program", "check_program", "run_lints",
+    "ProgramVerificationError",
 ]
 
 

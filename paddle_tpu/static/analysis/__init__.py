@@ -1,0 +1,51 @@
+"""paddle_tpu.static.analysis — Program IR verifier + lint/diagnostics.
+
+The correctness-tooling layer for the captured static ``Program``
+(static/program.py). Every component maps onto a reference-framework
+analog:
+
+===================  ======================================================
+component            reference analog
+===================  ======================================================
+``verify.py``        the PIR verifier ``pir::PassManager`` runs between
+                     passes (pir/include/pass/pass_manager.h:35 — op
+                     VerifySig/VerifyRegion + region walk); here a single
+                     forward walk over the flat instruction list plus an
+                     InferMeta audit that re-runs ``dispatch.eval_shape``
+                     (the InferMetaInterface analog) per instruction.
+``lint.py``          the read-only analysis passes of the inference
+                     analysis pipeline (paddle/fluid/inference/analysis/)
+                     — advisory findings (dead ops, unused feeds,
+                     redundant cast/transpose chains, CSE candidates,
+                     fp64->fp32 demotion, non-jittable ops under jit).
+``diagnostics.py``   IrNotMetException + the analysis pipeline's logging,
+                     unified into coded ``PTLxxx`` Diagnostic records
+                     (severity, op index, fix hint).
+``ir_dump.py``       pir::Program::Print / EnableIRPrinting — the textual
+                     IR that ``Program.dump()`` (and ``repr``) render, so
+                     a diagnostic's ``op#N`` is readable in context.
+===================  ======================================================
+
+Integration points: ``distributed.passes.PassManager(verify=True)``
+verifies every program before/after each rewrite pass and attaches the
+failing pass name to the raised :class:`ProgramVerificationError`
+(enabled by default when ``PADDLE_TPU_PASS_VERIFY=1``, which the test
+suite sets); ``tools/lint_registry.py`` applies the same discipline to
+the primitive registry itself.
+"""
+from __future__ import annotations
+
+from .diagnostics import (  # noqa: F401
+    CODES, Diagnostic, DiagnosticReport, ProgramVerificationError, Severity,
+)
+from .ir_dump import dump_program  # noqa: F401
+from .lint import LintContext, register_lint, run_lints  # noqa: F401
+from .verify import (  # noqa: F401
+    check_program, propagate_avals, recorded_avals, verify_program,
+)
+
+__all__ = [
+    "CODES", "Diagnostic", "DiagnosticReport", "ProgramVerificationError",
+    "Severity", "dump_program", "LintContext", "register_lint", "run_lints",
+    "check_program", "propagate_avals", "recorded_avals", "verify_program",
+]

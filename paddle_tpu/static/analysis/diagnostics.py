@@ -1,0 +1,159 @@
+"""Diagnostic records for the Program analysis layer.
+
+Reference: the PIR verifier surfaces IrNotMetException with an op trace
+(pir/core/ir_context + pir::Verify), and the inference analysis pipeline
+logs per-pass findings (paddle/fluid/inference/analysis/analysis_pass.h).
+Here both funnel into one coded record type so verifier errors and lint
+warnings share formatting, filtering, and test assertions.
+
+Code namespace (``PTLxxx``):
+
+- ``PTL0xx`` — structural verifier errors (`verify.py`): the program is
+  malformed and replay is undefined behaviour.
+- ``PTL1xx`` — lint findings (`lint.py`): the program is valid but
+  suspicious (dead code, redundant ops, silent dtype demotion, ...).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = [
+    "Severity", "Diagnostic", "DiagnosticReport",
+    "ProgramVerificationError", "CODES",
+]
+
+
+class Severity(enum.IntEnum):
+    NOTE = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self):  # "error" not "Severity.ERROR" in rendered reports
+        return self.name.lower()
+
+
+# Registry of every code this layer can emit — one place to look up what a
+# code means, and the source of truth tests assert against.
+CODES = {
+    # verifier (errors)
+    "PTL001": "unknown primitive (not in dispatch.PRIMITIVES)",
+    "PTL002": "use of an undefined value id (use-before-def or dangling input)",
+    "PTL003": "duplicate value-id definition (out_vid redefined)",
+    "PTL004": "dangling out_vid (value id was never allocated by this program)",
+    "PTL005": "feed placeholder vid also bound as a constant",
+    "PTL006": "unhashable static attribute (breaks executable caching)",
+    "PTL007": "malformed __gradients__ instruction (placement/operands/fwd_len)",
+    "PTL008": "InferMeta audit: recorded output shape diverges from eval_shape",
+    "PTL009": "InferMeta audit: recorded output dtype diverges from eval_shape",
+    "PTL010": "InferMeta audit: shape inference failed or output arity mismatch",
+    # lints (warnings/notes)
+    "PTL101": "dead op: outputs never reach a fetch target",
+    "PTL102": "unused feed: placeholder is never consumed",
+    "PTL103": "redundant cast (no-op cast or collapsible cast chain)",
+    "PTL104": "redundant transpose chain (permutations cancel out)",
+    "PTL105": "common-subexpression candidate (identical op computed twice)",
+    "PTL106": "silent float64 -> float32 demotion",
+    "PTL107": "non-jittable primitive inside a jit-replayed program",
+}
+
+
+@dataclass
+class Diagnostic:
+    """One finding: coded, located, and actionable.
+
+    ``op_index`` is the instruction index in ``Program._insts`` (None for
+    program-level findings like feed/const overlap)."""
+
+    code: str
+    severity: Severity
+    message: str
+    op_index: Optional[int] = None
+    hint: Optional[str] = None
+
+    def __post_init__(self):
+        if self.code not in CODES:
+            raise ValueError(f"unregistered diagnostic code {self.code!r}")
+
+    def render(self) -> str:
+        loc = f"op#{self.op_index}: " if self.op_index is not None else ""
+        s = f"{self.code} {self.severity}: {loc}{self.message}"
+        if self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+    def __str__(self):
+        return self.render()
+
+
+@dataclass
+class DiagnosticReport:
+    """Ordered collection of diagnostics with an overall verdict."""
+
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+
+    def add(self, code, severity, message, op_index=None, hint=None):
+        self.diagnostics.append(
+            Diagnostic(code, severity, message, op_index, hint))
+
+    def extend(self, other: "DiagnosticReport"):
+        self.diagnostics.extend(other.diagnostics)
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.ERROR]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.WARNING]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def codes(self):
+        return {d.code for d in self.diagnostics}
+
+    def by_code(self, code: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.code == code]
+
+    def render(self, header: Optional[str] = None) -> str:
+        lines = []
+        if header:
+            lines.append(header)
+        if not self.diagnostics:
+            lines.append("no diagnostics")
+        lines.extend(d.render() for d in self.diagnostics)
+        return "\n".join(lines)
+
+    def raise_if_errors(self, context: Optional[str] = None):
+        if self.errors:
+            raise ProgramVerificationError(self, context=context)
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __str__(self):
+        return self.render()
+
+
+class ProgramVerificationError(RuntimeError):
+    """Raised when verification finds structural errors.
+
+    ``context`` carries provenance — the PassManager attaches the name of
+    the rewrite pass after which verification failed (the pir::PassManager
+    verify-between-passes behaviour)."""
+
+    def __init__(self, report: DiagnosticReport, context: Optional[str] = None):
+        self.report = report
+        self.context = context
+        where = f" [{context}]" if context else ""
+        errs = report.errors
+        msg = (f"program verification failed{where}: "
+               f"{len(errs)} error(s)\n" +
+               "\n".join(d.render() for d in errs))
+        super().__init__(msg)

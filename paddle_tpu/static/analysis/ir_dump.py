@@ -1,0 +1,102 @@
+"""Textual IR dump of a captured static ``Program``.
+
+Reference: pir::Program::Print / the `print_ir` hooks pass managers use
+around every pass (pir/include/pass/pass_manager.h EnableIRPrinting) —
+diagnostics are only actionable when the IR they point into is readable.
+The dump names every vid, shows feed/const provenance, static attrs, and
+best-effort result avals from the InferMeta propagation, so a
+``PTL008 op#3`` report can be read directly against ``Program.dump()``.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from .verify import GRAD_OP, propagate_avals
+
+__all__ = ["dump_program"]
+
+_MAX_ATTR_CHARS = 60
+
+
+def _fmt_aval(aval) -> str:
+    if aval is None:
+        return "?"
+    shape, dtype = aval
+    return f"{np.dtype(dtype).name}[{'x'.join(map(str, shape))}]"
+
+
+def _fmt_attr_value(v) -> str:
+    s = repr(v)
+    return s if len(s) <= _MAX_ATTR_CHARS else s[:_MAX_ATTR_CHARS - 3] + "..."
+
+
+def _fmt_attrs(static_items) -> str:
+    try:
+        items = [(k, v) for k, v in static_items]
+    except (TypeError, ValueError):
+        # malformed attrs (the verifier reports them; the dump must
+        # still render so the report is readable against it)
+        return f" {{{static_items!r}}}"
+    if not items:
+        return ""
+    body = ", ".join(f"{k}={_fmt_attr_value(v)}" for k, v in items)
+    return f" {{{body}}}"
+
+
+def dump_program(program, *, annotate: bool = True) -> str:
+    """Render the instruction list as readable IR text.
+
+    ``annotate=False`` skips the eval_shape-based aval propagation (cheap
+    dump for very large programs); vids then print without types."""
+    avals: Dict[int, tuple] = propagate_avals(program) if annotate else {}
+
+    def ty(vid) -> str:
+        # ": <aval>" suffix, empty when annotation is off
+        return f" : {_fmt_aval(avals.get(vid))}" if annotate else ""
+
+    n_grad = sum(1 for i in program._insts if i[0] == GRAD_OP)
+    head = (f"Program({len(program._insts)} ops, "
+            f"{len(program._placeholders)} feeds, "
+            f"{len(program._consts)} consts"
+            + (f", {n_grad} grad section(s)" if n_grad else "") + ")")
+    lines = [head]
+
+    for name, vid, shape, dtype in program._placeholders:
+        declared = tuple(shape)
+        lines.append(f"  %{vid} = feed \"{name}\"{ty(vid)}"
+                     f"  # declared {declared}, dtype={dtype}")
+    for vid in sorted(program._consts):
+        lines.append(f"  %{vid} = const{ty(vid)}")
+
+    for idx, inst in enumerate(program._insts):
+        try:
+            prim_name, in_vids, static_items, out_vids = inst
+        except (TypeError, ValueError):
+            lines.append(f"  op#{idx}: <malformed instruction {inst!r}>")
+            continue
+        outs = ", ".join(f"%{v}" for v in out_vids) or "()"
+        if prim_name == GRAD_OP:
+            loss = f"%{in_vids[0]}" if in_vids else "?"
+            wrt = ", ".join(f"%{v}" for v in in_vids[1:])
+            lines.append(
+                f"  op#{idx}: {outs} = __gradients__(loss={loss}; "
+                f"wrt=[{wrt}]){_fmt_attrs(static_items)}")
+            continue
+        ins = ", ".join(f"%{v}" for v in in_vids)
+        if annotate:
+            restype = " : " + (", ".join(_fmt_aval(avals.get(v))
+                                         for v in out_vids) or "()")
+        else:
+            restype = ""
+        lines.append(f"  op#{idx}: {outs} = {prim_name}({ins})"
+                     f"{_fmt_attrs(static_items)}{restype}")
+
+    if getattr(program, "_fetch_vids", None):
+        lines.append("  fetch: " + ", ".join(
+            f"%{v}" for v in program._fetch_vids))
+    if getattr(program, "_remat_checkpoints", None):
+        lines.append("  remat checkpoints: " + ", ".join(
+            f"%{v}" for v in program._remat_checkpoints))
+    return "\n".join(lines)

@@ -1,0 +1,247 @@
+"""Lint framework over the captured static ``Program``.
+
+Reference: the inference analysis pipeline's read-only passes
+(paddle/fluid/inference/analysis/ — each AnalysisPass inspects the graph
+and annotates it before any rewrite runs). Lints here are *advisory*:
+the program is structurally valid (run ``verify.verify_program`` first
+for that) but contains something a rewrite pass could fix or a user
+should know about — dead ops, unused feeds, redundant cast/transpose
+chains, CSE candidates, silent fp64->fp32 demotion, non-jittable
+primitives in a jit-replayed program.
+
+Each lint is a registered function ``fn(ctx) -> iterable[finding]``
+keyed by a ``PTL1xx`` code; ``run_lints`` assembles one shared
+:class:`LintContext` (consumer map, best-effort avals, fetch/feed vids)
+and funnels every finding into a :class:`DiagnosticReport`.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ...core import dispatch
+from .diagnostics import DiagnosticReport, Severity
+from .verify import GRAD_OP, propagate_avals
+
+__all__ = ["LintContext", "run_lints", "register_lint", "LINTS"]
+
+# prims whose value depends on RNG/state: never CSE/DCE candidates
+_EFFECTFUL_MARKERS = ("rand", "uniform", "normal", "dropout", "bernoulli",
+                      "poisson", "multinomial", "exponential", "seed",
+                      "print", "py_func", "barrier")
+
+
+def _effectful(prim_name: str) -> bool:
+    low = prim_name.lower()
+    return any(m in low for m in _EFFECTFUL_MARKERS)
+
+
+def _attrs_dict(static_items) -> Dict:
+    """Static attrs as a dict, {} when malformed (the verifier reports
+    malformed attrs; lints must keep walking)."""
+    try:
+        return dict(static_items)
+    except (TypeError, ValueError):
+        return {}
+
+
+class LintContext:
+    """Shared read-only view of one program, built once per run."""
+
+    def __init__(self, program, fetch_vids: Optional[Iterable[int]] = None):
+        self.program = program
+        self.insts: List[tuple] = list(program._insts)
+        self.avals = propagate_avals(program)
+        self.feed_vids: Dict[int, str] = {
+            vid: name for name, vid in program._feed_names.items()}
+        if fetch_vids is None:
+            fetch_vids = getattr(program, "_fetch_vids", ()) or ()
+        self.fetch_vids: Set[int] = set(fetch_vids)
+        self.producer: Dict[int, int] = {}
+        self.consumers: Dict[int, List[int]] = {}
+        for idx, (_n, in_vids, _s, out_vids) in enumerate(self.insts):
+            for v in in_vids:
+                self.consumers.setdefault(v, []).append(idx)
+            for v in out_vids:
+                self.producer.setdefault(v, idx)
+
+    def dtype_of(self, vid) -> Optional[np.dtype]:
+        aval = self.avals.get(vid)
+        return None if aval is None else np.dtype(aval[1])
+
+
+LINTS: List[Tuple[str, Callable]] = []
+
+
+def register_lint(code: str):
+    """Register ``fn(ctx) -> iterable[(message, op_index, hint)]``."""
+
+    def deco(fn):
+        LINTS.append((code, fn))
+        return fn
+
+    return deco
+
+
+def run_lints(program, fetch=None, *,
+              codes: Optional[Iterable[str]] = None) -> DiagnosticReport:
+    """Run every registered lint (or the subset in ``codes``).
+
+    ``fetch`` takes Tensors or vids and enables the liveness-based lints;
+    without it (and without a recorded ``_fetch_vids``) dead-op/unused-feed
+    findings are skipped rather than guessed."""
+    fetch_vids = None
+    if fetch is not None:
+        fetch_vids = [v if isinstance(v, int) else program.vid_of(v)
+                      for v in fetch]
+    ctx = LintContext(program, fetch_vids)
+    only = set(codes) if codes is not None else None
+    report = DiagnosticReport()
+    for code, fn in LINTS:
+        if only is not None and code not in only:
+            continue
+        for message, op_index, hint in fn(ctx):
+            report.add(code, Severity.WARNING, message,
+                       op_index=op_index, hint=hint)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# built-in lints
+# ---------------------------------------------------------------------------
+@register_lint("PTL101")
+def _dead_ops(ctx: LintContext):
+    """Ops whose outputs never (transitively) reach a fetch target."""
+    if not ctx.fetch_vids:
+        return
+    live: Set[int] = set(ctx.fetch_vids)
+    kept: Set[int] = set()
+    for idx in range(len(ctx.insts) - 1, -1, -1):
+        prim_name, in_vids, _s, out_vids = ctx.insts[idx]
+        if any(v in live for v in out_vids) or _effectful(prim_name) \
+                or prim_name == GRAD_OP:
+            kept.add(idx)
+            live.update(in_vids)
+    for idx, (prim_name, _i, _s, out_vids) in enumerate(ctx.insts):
+        if idx not in kept:
+            yield (f"{prim_name!r} (outs {sorted(out_vids)}) never reaches "
+                   f"a fetch target", idx,
+                   "run the dead_code_elimination pass, or fetch the value")
+
+
+@register_lint("PTL102")
+def _unused_feeds(ctx: LintContext):
+    for vid, name in sorted(ctx.feed_vids.items()):
+        if not ctx.consumers.get(vid) and vid not in ctx.fetch_vids:
+            yield (f"feed {name!r} (%{vid}) is declared but never consumed",
+                   None,
+                   "drop the static.data declaration or stop requiring the "
+                   "feed at Executor.run")
+
+
+@register_lint("PTL103")
+def _redundant_casts(ctx: LintContext):
+    for idx, (prim_name, in_vids, static_items, out_vids) in \
+            enumerate(ctx.insts):
+        if prim_name != "cast_p" or not in_vids:
+            continue
+        src = ctx.dtype_of(in_vids[0])
+        dst = ctx.dtype_of(out_vids[0]) if out_vids else None
+        if src is not None and dst is not None and src == dst:
+            yield (f"cast of %{in_vids[0]} to {dst.name} is a no-op "
+                   f"(operand is already {src.name})", idx,
+                   "delete the cast; it costs a copy outside fusion")
+            continue
+        prod = ctx.producer.get(in_vids[0])
+        if prod is not None and ctx.insts[prod][0] == "cast_p":
+            inner_src = ctx.dtype_of(ctx.insts[prod][1][0])
+            src_s = inner_src.name if inner_src is not None else "?"
+            dst_s = dst.name if dst is not None else "?"
+            yield (f"cast chain %{ctx.insts[prod][1][0]} -> %{in_vids[0]} "
+                   f"-> %{out_vids[0] if out_vids else '?'} "
+                   f"({src_s} -> ... -> {dst_s})", idx,
+                   "collapse to a single cast from the original dtype "
+                   "(beware: a narrowing intermediate changes numerics)")
+
+
+@register_lint("PTL104")
+def _redundant_transposes(ctx: LintContext):
+    for idx, (prim_name, in_vids, static_items, out_vids) in \
+            enumerate(ctx.insts):
+        if prim_name != "transpose_p" or not in_vids:
+            continue
+        perm = _attrs_dict(static_items).get("perm")
+        if perm is not None and list(perm) == sorted(range(len(perm))):
+            yield (f"transpose of %{in_vids[0]} with identity perm "
+                   f"{tuple(perm)}", idx, "delete the transpose")
+            continue
+        prod = ctx.producer.get(in_vids[0])
+        if prod is None or ctx.insts[prod][0] != "transpose_p":
+            continue
+        inner = _attrs_dict(ctx.insts[prod][2]).get("perm")
+        if inner is None or perm is None or len(inner) != len(perm):
+            continue
+        composed = [inner[p] for p in perm]
+        if composed == sorted(range(len(composed))):
+            yield (f"transpose chain op#{prod} -> op#{idx} composes to the "
+                   f"identity permutation", idx,
+                   "delete both transposes (the chain is a no-op)")
+
+
+@register_lint("PTL105")
+def _cse_candidates(ctx: LintContext):
+    seen: Dict[tuple, int] = {}
+    for idx, (prim_name, in_vids, static_items, _o) in enumerate(ctx.insts):
+        if prim_name == GRAD_OP or not in_vids or _effectful(prim_name):
+            continue
+        try:
+            key = (prim_name, tuple(in_vids), tuple(static_items))
+            hash(key)
+        except TypeError:
+            continue
+        first = seen.setdefault(key, idx)
+        if first != idx:
+            yield (f"{prim_name!r} over vids {tuple(in_vids)} recomputes "
+                   f"op#{first} with identical operands and attrs", idx,
+                   "reuse op#%d's outputs (classic CSE); XLA dedups inside "
+                   "one jit but not across cache entries" % first)
+
+
+@register_lint("PTL106")
+def _silent_fp64_demotion(ctx: LintContext):
+    for idx, (prim_name, in_vids, static_items, out_vids) in \
+            enumerate(ctx.insts):
+        if prim_name == GRAD_OP:
+            continue
+        if prim_name == "cast_p":
+            # an explicit cast to float32 is a *requested* demotion, not a
+            # silent one
+            target = _attrs_dict(static_items).get("dtype")
+            if target is not None and np.dtype(target) == np.dtype(
+                    "float32"):
+                continue
+        in_dts = [ctx.dtype_of(v) for v in in_vids]
+        out_dts = [ctx.dtype_of(v) for v in out_vids]
+        if not in_dts or not out_dts:
+            continue
+        if any(dt == np.dtype("float64") for dt in in_dts if dt is not None) \
+                and all(dt == np.dtype("float32")
+                        for dt in out_dts if dt is not None) \
+                and any(dt is not None for dt in out_dts):
+            yield (f"{prim_name!r} consumes float64 but emits float32 — "
+                   f"double precision is silently lost", idx,
+                   "the op's forward narrows internally; cast the operand "
+                   "to float32 explicitly if the demotion is intended, or "
+                   "keep the math in float64")
+
+
+@register_lint("PTL107")
+def _non_jittable_under_jit(ctx: LintContext):
+    for idx, (prim_name, _i, _s, _o) in enumerate(ctx.insts):
+        prim = dispatch.PRIMITIVES.get(prim_name)
+        if prim is not None and not prim.jittable:
+            yield (f"{prim_name!r} is marked non-jittable but Executor.run "
+                   f"replays the whole program under jax.jit", idx,
+                   "host callbacks/impure ops must go through "
+                   "jax.pure_callback (or run eagerly outside the program)")

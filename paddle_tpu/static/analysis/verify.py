@@ -1,0 +1,376 @@
+"""Structural verifier for the captured static ``Program``.
+
+Reference: the PIR verifier that ``pir::PassManager`` runs between passes
+(pir/include/pass/pass_manager.h — EnableIRPrinting/verify hooks, op
+``VerifySig``/``VerifyRegion``). The captured Program here is a flat
+SSA-ish instruction list ``(prim, in_vids, static_attrs, out_vids)``
+(static/program.py), so verification is a single forward walk plus an
+InferMeta audit that re-runs shape inference (``dispatch.eval_shape``,
+the InferMetaInterface analog) and checks the recorded result avals
+still match — the check that catches a rewrite pass emitting a
+shape-inconsistent instruction *before* it dies as an opaque error deep
+inside the jitted replay.
+
+Checked invariants (codes in diagnostics.CODES):
+
+- PTL001 every primitive name resolves in ``dispatch.PRIMITIVES``
+  (``__gradients__`` is the one structural pseudo-op);
+- PTL002 every input vid is defined before use (feed, const, or an
+  earlier instruction's output);
+- PTL003/PTL004 out_vids are fresh (no redefinition) and were actually
+  allocated by this program's vid counter;
+- PTL005 feed placeholder vids never overlap the constant pool;
+- PTL006 static attrs are hashable (the executable cache keys on them);
+- PTL007 ``__gradients__`` is well-formed: >= 2 operands (loss + wrts),
+  an int ``fwd_len`` attr no larger than its own position, and one
+  output per wrt operand;
+- PTL008/PTL009/PTL010 the InferMeta audit.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...core import dispatch
+from .diagnostics import DiagnosticReport, Severity
+
+__all__ = [
+    "verify_program", "check_program", "recorded_avals", "propagate_avals",
+    "GRAD_OP",
+]
+
+GRAD_OP = "__gradients__"
+
+Aval = Tuple[Tuple[int, ...], np.dtype]
+
+
+def _aval_of(obj) -> Optional[Aval]:
+    if isinstance(obj, jax.ShapeDtypeStruct):
+        return tuple(obj.shape), np.dtype(obj.dtype)
+    try:
+        a = np.asarray(obj)
+    except Exception:
+        return None
+    return tuple(a.shape), a.dtype
+
+
+def recorded_avals(program) -> Dict[int, Aval]:
+    """vid -> (shape, dtype) as recorded at capture time.
+
+    Capture pins every produced value (placeholder specs, const arrays,
+    eval_shape outputs) in ``_keepalive`` and maps it through
+    ``_vid_by_obj``; deserialized programs only carry consts and the
+    placeholder decls, so the map is best-effort — the audit compares
+    only where a record exists."""
+    from ...core.dtype import convert_dtype
+
+    out: Dict[int, Aval] = {}
+    for _name, vid, shape, dtype in program._placeholders:
+        # same capture rule as Program.add_placeholder: dynamic dims -> 1
+        cap = tuple(1 if s in (None, -1) else int(s) for s in shape)
+        try:
+            out[vid] = (cap, np.dtype(convert_dtype(dtype)))
+        except TypeError:
+            pass
+    vid_by_obj = getattr(program, "_vid_by_obj", {})
+    for obj in getattr(program, "_keepalive", ()):
+        vid = vid_by_obj.get(id(obj))
+        if vid is None:
+            continue
+        aval = _aval_of(obj)
+        if aval is not None:
+            out[vid] = aval
+    for vid, const in program._consts.items():
+        aval = _aval_of(const)
+        if aval is not None:
+            out.setdefault(vid, aval)
+    return out
+
+
+def _sds(aval: Aval) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(aval[0], aval[1])
+
+
+@functools.lru_cache(maxsize=8192)
+def _cached_eval_shape(prim_name: str, in_avals: Tuple[Aval, ...],
+                       static_items) -> Tuple[Optional[Aval], ...]:
+    """Shape-inference cache: PassManager(verify=True) re-audits a mostly
+    unchanged program after every pass, so keying on (op, operand avals,
+    attrs) turns the repeated jax tracing into dict hits — the same
+    signature the executable cache (dispatch._jitted_forward) keys on."""
+    outs = dispatch.eval_shape(
+        prim_name, [_sds(a) for a in in_avals], dict(static_items))
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    return tuple(_aval_of(o) for o in outs)
+
+
+def _infer_out_avals(prim_name, in_avals, static_items):
+    try:
+        return _cached_eval_shape(prim_name, tuple(in_avals), static_items)
+    except TypeError:
+        # unhashable attrs (separately reported as PTL006): trace uncached
+        outs = dispatch.eval_shape(
+            prim_name, [_sds(a) for a in in_avals], dict(static_items))
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        return tuple(_aval_of(o) for o in outs)
+
+
+def _fmt(aval: Optional[Aval]) -> str:
+    if aval is None:
+        return "?"
+    shape, dtype = aval
+    return f"{np.dtype(dtype).name}[{','.join(map(str, shape))}]"
+
+
+def propagate_avals(program) -> Dict[int, Aval]:
+    """Best-effort vid -> aval map: recorded avals seeded with consts and
+    placeholders, then pushed through ``eval_shape`` per instruction.
+    Never raises — lints and the IR dump use this for annotation."""
+    env = dict(recorded_avals(program))
+    for prim_name, in_vids, static_items, out_vids in program._insts:
+        if all(v in env for v in out_vids):
+            continue
+        if prim_name == GRAD_OP:
+            for v, w in zip(out_vids, in_vids[1:]):
+                if w in env:
+                    env.setdefault(v, env[w])
+            continue
+        if prim_name not in dispatch.PRIMITIVES or \
+                not all(v in env for v in in_vids):
+            continue
+        try:
+            outs = _infer_out_avals(prim_name, [env[v] for v in in_vids],
+                                    static_items)
+        except Exception:
+            continue
+        for v, aval in zip(out_vids, outs):
+            if aval is not None:
+                env.setdefault(v, aval)
+    return env
+
+
+def verify_program(program, *, infer_meta: bool = True) -> DiagnosticReport:
+    """Walk the instruction list once and report every violated invariant.
+
+    Returns a :class:`DiagnosticReport`; ``report.ok`` is False iff the
+    program is structurally broken. Pure read-only — safe to call on any
+    program at any time (the PassManager calls it between passes)."""
+    report = DiagnosticReport()
+    E = Severity.ERROR
+
+    consts = program._consts
+    feed_vids = set(program._feed_names.values())
+    next_vid = getattr(program, "_next_vid", None)
+
+    overlap = feed_vids & set(consts)
+    if overlap:
+        report.add(
+            "PTL005", E,
+            f"feed placeholder vids {sorted(overlap)} are also bound in the "
+            f"constant pool; replay would shadow the feed",
+            hint="a pass (e.g. constant_folding) must never fold a "
+                 "placeholder; rebuild the program or drop the const "
+                 "binding")
+
+    defined = set(consts) | feed_vids
+    meta_env: Dict[int, Aval] = {}
+    recorded = {}
+    if infer_meta:
+        recorded = recorded_avals(program)
+        meta_env = {v: recorded[v] for v in defined if v in recorded}
+
+    for idx, inst in enumerate(program._insts):
+        try:
+            prim_name, in_vids, static_items, out_vids = inst
+        except (TypeError, ValueError):
+            report.add(
+                "PTL001", E,
+                f"malformed instruction record {inst!r} (expected "
+                f"(prim, in_vids, static_attrs, out_vids))", op_index=idx)
+            continue
+
+        known_prim = prim_name == GRAD_OP or prim_name in dispatch.PRIMITIVES
+        if not known_prim:
+            report.add(
+                "PTL001", E,
+                f"unknown primitive {prim_name!r}", op_index=idx,
+                hint="register it via ops._helpers.defprim / "
+                     "dispatch.register_primitive before building or "
+                     "loading the program")
+        elif prim_name != GRAD_OP \
+                and dispatch.PRIMITIVES[prim_name].forward is None:
+            known_prim = False  # backward-only prim: nothing to replay
+            report.add(
+                "PTL001", E,
+                f"primitive {prim_name!r} is a backward-only registration "
+                f"(forward=None) and cannot appear as a program "
+                f"instruction", op_index=idx)
+
+        try:
+            hash(tuple(static_items))
+        except TypeError:
+            report.add(
+                "PTL006", E,
+                f"static attrs {static_items!r} of {prim_name!r} are "
+                f"unhashable", op_index=idx,
+                hint="convert lists/dicts/arrays in attrs to tuples — the "
+                     "per-signature executable cache keys on them")
+
+        operands_ok = True
+        for v in in_vids:
+            if v not in defined:
+                operands_ok = False
+                never = (next_vid is not None and v >= next_vid)
+                kind = ("was never allocated by this program" if never
+                        else "is used before its definition")
+                report.add(
+                    "PTL002", E,
+                    f"input vid %{v} of {prim_name!r} {kind}", op_index=idx,
+                    hint="a rewrite pass dropped or reordered the producing "
+                         "instruction; run PassManager(verify=True) to "
+                         "catch the offending pass")
+
+        if prim_name == GRAD_OP:
+            try:
+                attrs = dict(static_items)
+            except (TypeError, ValueError):
+                attrs = {}
+            fwd_len = attrs.get("fwd_len")
+            if len(in_vids) < 2:
+                report.add(
+                    "PTL007", E,
+                    f"__gradients__ needs (loss, wrt...) operands, got "
+                    f"{len(in_vids)}", op_index=idx)
+            elif len(out_vids) != len(in_vids) - 1:
+                report.add(
+                    "PTL007", E,
+                    f"__gradients__ emits {len(out_vids)} grads for "
+                    f"{len(in_vids) - 1} wrt operands", op_index=idx)
+            if not isinstance(fwd_len, int) or fwd_len < 0:
+                # NOTE: fwd_len > idx is legal — rewrite passes shrink the
+                # list and the replay uses the instruction's own position
+                # (see Executor._compile), so only type/sign are invariant
+                report.add(
+                    "PTL007", E,
+                    f"__gradients__ needs a non-negative int 'fwd_len' "
+                    f"attr (got {fwd_len!r})", op_index=idx)
+            if not operands_ok:
+                report.add(
+                    "PTL007", E,
+                    "__gradients__ placed before its forward slice: the "
+                    "loss/wrt operands are not yet defined at this point",
+                    op_index=idx,
+                    hint="record_gradients appends the grad section after "
+                         "the forward; a pass that reorders instructions "
+                         "must keep the grad section behind its operands")
+
+        seen_here = set()
+        for v in out_vids:
+            if next_vid is not None and v >= next_vid:
+                report.add(
+                    "PTL004", E,
+                    f"out vid %{v} of {prim_name!r} was never allocated "
+                    f"(next_vid={next_vid})", op_index=idx,
+                    hint="allocate result ids with Program._new_vid() — "
+                         "foreign ids break clone() and serialization")
+            if v in defined or v in seen_here:
+                report.add(
+                    "PTL003", E,
+                    f"out vid %{v} of {prim_name!r} is already defined "
+                    f"(SSA violation)", op_index=idx,
+                    hint="each vid has exactly one producer; a fusion pass "
+                         "must reuse the *consumer's* out vid and delete "
+                         "the producer")
+            seen_here.add(v)
+        defined.update(out_vids)
+
+        if infer_meta and known_prim and operands_ok:
+            _audit_infer_meta(report, idx, prim_name, in_vids, static_items,
+                              out_vids, meta_env, recorded)
+
+    return report
+
+
+def _audit_infer_meta(report, idx, prim_name, in_vids, static_items,
+                      out_vids, meta_env: Dict[int, Aval],
+                      recorded: Dict[int, Aval]):
+    """Re-run shape inference for one instruction and reconcile with the
+    capture-time record (the InferMeta/VerifySig audit)."""
+    E = Severity.ERROR
+
+    def seed_from_record(vids):
+        for v in vids:
+            if v in recorded:
+                meta_env[v] = recorded[v]
+
+    if not all(v in meta_env for v in in_vids):
+        # an upstream audit failure already reported; keep walking with
+        # whatever the capture recorded so one bad op yields one error
+        seed_from_record(out_vids)
+        return
+
+    if prim_name == GRAD_OP:
+        for v, w in zip(out_vids, in_vids[1:]):
+            meta_env[v] = meta_env[w]
+            if v in recorded and recorded[v] != meta_env[v]:
+                report.add(
+                    "PTL008", E,
+                    f"grad of %{w} recorded as {_fmt(recorded[v])} but the "
+                    f"wrt value is {_fmt(meta_env[w])}", op_index=idx)
+        return
+
+    try:
+        outs = _infer_out_avals(prim_name,
+                                [meta_env[v] for v in in_vids],
+                                static_items)
+    except Exception as exc:
+        report.add(
+            "PTL010", E,
+            f"shape inference failed for {prim_name!r}"
+            f"({', '.join(_fmt(meta_env[v]) for v in in_vids)}): "
+            f"{type(exc).__name__}: {exc}", op_index=idx,
+            hint="operand shapes/dtypes or static attrs are inconsistent "
+                 "with the primitive's forward")
+        seed_from_record(out_vids)
+        return
+
+    if len(outs) != len(out_vids):
+        report.add(
+            "PTL010", E,
+            f"{prim_name!r} infers {len(outs)} outputs but the instruction "
+            f"records {len(out_vids)} out vids", op_index=idx)
+        seed_from_record(out_vids)
+        return
+
+    for v, inferred in zip(out_vids, outs):
+        if inferred is None:  # non-array output leaf: keep the record
+            if v in recorded:
+                meta_env[v] = recorded[v]
+            continue
+        meta_env[v] = inferred
+        rec = recorded.get(v)
+        if rec is None or inferred is None:
+            continue
+        if rec[0] != inferred[0]:
+            report.add(
+                "PTL008", E,
+                f"out vid %{v} of {prim_name!r} recorded as {_fmt(rec)} but "
+                f"eval_shape infers {_fmt(inferred)}", op_index=idx,
+                hint="a pass swapped/rewired out_vids or changed operands "
+                     "without re-running shape inference")
+        elif np.dtype(rec[1]) != np.dtype(inferred[1]):
+            report.add(
+                "PTL009", E,
+                f"out vid %{v} of {prim_name!r} recorded as {_fmt(rec)} but "
+                f"eval_shape infers {_fmt(inferred)}", op_index=idx)
+
+
+def check_program(program, *, infer_meta: bool = True,
+                  context: Optional[str] = None) -> DiagnosticReport:
+    """verify_program + raise :class:`ProgramVerificationError` on errors."""
+    report = verify_program(program, infer_meta=infer_meta)
+    report.raise_if_errors(context=context)
+    return report

@@ -160,12 +160,19 @@ class Program:
     def num_ops(self) -> int:
         return len(self._insts)
 
+    def dump(self, annotate: bool = True) -> str:
+        """Textual IR (reference: pir::Program::Print) — named vids with
+        feed/const provenance, static attrs, and inferred result avals.
+        Diagnostics from static.analysis cite ``op#N`` indices that read
+        directly against this dump."""
+        from .analysis.ir_dump import dump_program
+
+        return dump_program(self, annotate=annotate)
+
     def __repr__(self):
-        lines = [f"Program({len(self._insts)} ops, "
-                 f"{len(self._placeholders)} feeds)"]
-        for name, in_vids, static, out_vids in self._insts:
-            lines.append(f"  %{out_vids} = {name}(%{in_vids})")
-        return "\n".join(lines)
+        # annotate=False: repr must stay cheap (no eval_shape tracing) —
+        # incidental reprs in logs/debuggers can hit huge programs
+        return self.dump(annotate=False)
 
 
 def _build_loss_fn(program: Program, fwd_len: int, loss_vid: int,
@@ -311,9 +318,15 @@ class Executor:
         )
         feed_items = sorted(feed.items())
         feed_names = tuple(k for k, _ in feed_items)
-        missing = {n for n, _, _, _ in program._placeholders} - set(feed_names)
+        declared = {n for n, _, _, _ in program._placeholders}
+        missing = declared - set(feed_names)
         if missing:
             raise ValueError(f"missing feeds: {sorted(missing)}")
+        unknown = set(feed_names) - declared
+        if unknown:
+            raise ValueError(
+                f"unknown feed names {sorted(unknown)}: the program "
+                f"declares placeholders {sorted(declared) or '(none)'}")
         arrays = [np.asarray(v._value if isinstance(v, Tensor) else v)
                   for _, v in feed_items]
         key = (feed_names,

@@ -8,6 +8,9 @@ virtual mesh for distributed/sharding tests
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# pass pipelines in CI run bracketed by the Program verifier
+# (distributed.passes.PassManager(verify=None) reads this flag)
+os.environ.setdefault("PADDLE_TPU_PASS_VERIFY", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -30,6 +33,35 @@ def _seed():
 
     paddle.seed(2024)
     np.random.seed(2024)
+    yield
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _registry_lint():
+    """Run the tools/lint_registry.py checks once per session so
+    primitive-registry and ``__all__`` drift fails tier-1 instead of
+    surfacing in production. Runs in-process against the registry this
+    very session imported (and costs ms, not a fresh interpreter).
+    Skippable: set PADDLE_TPU_SKIP_REGISTRY_LINT=1 (e.g. for focused
+    debugging of a half-registered op)."""
+    if os.environ.get("PADDLE_TPU_SKIP_REGISTRY_LINT", "").lower() \
+            in ("1", "true", "yes"):
+        yield
+        return
+    import importlib.util
+
+    import paddle_tpu  # noqa: F401 — populate registry + sys.modules
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          os.pardir, "tools", "lint_registry.py")
+    spec = importlib.util.spec_from_file_location("_lint_registry", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    problems = mod.check_primitives() + mod.check_all_exports()
+    if problems:
+        pytest.fail(
+            "tools/lint_registry.py checks found registry violations:\n"
+            + "\n".join(f"  - {p}" for p in problems), pytrace=False)
     yield
 
 

@@ -1,0 +1,493 @@
+"""Program IR verifier + lint/diagnostics subsystem
+(paddle_tpu.static.analysis).
+
+Strategy: mutation testing — capture a healthy program, hand-corrupt it
+the way a buggy rewrite pass would (dangling vid, swapped out_vids,
+bogus attr, misplaced grad section), and assert the verifier reports
+each corruption with the right PTL code. Reference: the pir verifier
+pir::PassManager runs between passes plus the inference analysis
+pipeline's read-only lints.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.core import dispatch
+from paddle_tpu.distributed.passes import PassManager, new_pass
+from paddle_tpu.static.analysis import (
+    CODES, Diagnostic, DiagnosticReport, ProgramVerificationError, Severity,
+    run_lints, verify_program,
+)
+
+
+def _train_program(L=3, B=4, D=8):
+    """matmul/tanh stack + loss + grad section — the shape every
+    mutation test corrupts a copy of."""
+    rng = np.random.RandomState(0)
+    ws = [rng.randn(D, D).astype("float32") * 0.1 for _ in range(L)]
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [B, D], "float32")
+        h = x
+        w_ts = []
+        for w in ws:
+            wt = paddle.to_tensor(w, stop_gradient=False)
+            w_ts.append(wt)
+            h = paddle.tanh(paddle.matmul(h, wt))
+        loss = (h * h).mean()
+        grads = static.gradients([loss], w_ts)
+    feed = {"x": rng.randn(B, D).astype("float32")}
+    return prog, feed, loss, grads
+
+
+def _corrupt(prog):
+    """Deep-ish copy so a mutation never leaks into sibling tests."""
+    p = prog.clone()
+    p._insts = [tuple(i) for i in prog._insts]
+    return p
+
+
+class TestVerifierCleanPrograms:
+    def test_captured_train_program_verifies_clean(self):
+        prog, _feed, _loss, _grads = _train_program()
+        report = verify_program(prog)
+        assert report.ok, report.render()
+        assert len(report) == 0
+
+    def test_inference_style_program_verifies_clean(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            y = paddle.nn.functional.relu(
+                paddle.matmul(x, paddle.to_tensor(
+                    np.ones((8, 2), "float32"))))
+            _out = y.sum()
+        assert verify_program(prog).ok
+
+    def test_normalized_loaded_program_verifies_clean(self, tmp_path):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4], "float32")
+            y = (x * 2.0).sum()
+        pruned = static.normalize_program(prog, [x], [y])
+        path = str(tmp_path / "m")
+        static.save(pruned, path)
+        loaded, _feeds, _fetch = static.load_inference_model(path)
+        report = verify_program(loaded)
+        assert report.ok, report.render()
+
+
+class TestVerifierMutations:
+    """Each hand-seeded corruption must be caught with the right code —
+    the zero-false-negative acceptance gate."""
+
+    def test_dangling_input_vid(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        name, in_vids, st, outs = bad._insts[2]
+        bad._insts[2] = (name, (99999,) + in_vids[1:], st, outs)
+        report = verify_program(bad)
+        assert not report.ok
+        assert "PTL002" in report.codes(), report.render()
+
+    def test_use_before_def(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        # op#0 consumes a vid only defined by the last forward op
+        later_out = bad._insts[4][3][0]
+        name, in_vids, st, outs = bad._insts[0]
+        bad._insts[0] = (name, (in_vids[0], later_out), st, outs)
+        report = verify_program(bad)
+        assert "PTL002" in report.codes(), report.render()
+
+    def test_duplicate_out_vid(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        # op#1 redefines op#0's output — SSA violation
+        name, in_vids, st, _outs = bad._insts[1]
+        bad._insts[1] = (name, in_vids, st, bad._insts[0][3])
+        report = verify_program(bad)
+        assert "PTL003" in report.codes(), report.render()
+
+    def test_never_allocated_out_vid_is_dangling(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        name, in_vids, st, _outs = bad._insts[0]
+        bad._insts[0] = (name, in_vids, st, (123456,))
+        report = verify_program(bad)
+        assert "PTL004" in report.codes(), report.render()
+
+    def test_swapped_out_vids_caught_by_infermeta_audit(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        # swap the out vids of a matmul ([B,D]) and the reduce_mean
+        # (scalar): structurally still SSA, only the audit can see it
+        idx_mm = next(i for i, inst in enumerate(bad._insts)
+                      if inst[0] == "matmul")
+        idx_rm = next(i for i, inst in enumerate(bad._insts)
+                      if inst[0] == "reduce_mean")
+        mm, rm = bad._insts[idx_mm], bad._insts[idx_rm]
+        bad._insts[idx_mm] = (mm[0], mm[1], mm[2], rm[3])
+        bad._insts[idx_rm] = (rm[0], rm[1], rm[2], mm[3])
+        report = verify_program(bad)
+        assert not report.ok
+        assert report.codes() & {"PTL008", "PTL002", "PTL010"}, \
+            report.render()
+        assert "PTL008" in report.codes(), report.render()
+
+    def test_bogus_static_attr_value(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        idx = next(i for i, inst in enumerate(bad._insts)
+                   if inst[0] == "matmul")
+        name, in_vids, _st, outs = bad._insts[idx]
+        bad._insts[idx] = (name, in_vids,
+                           (("transpose_x", "sideways"),), outs)
+        report = verify_program(bad)
+        assert "PTL010" in report.codes(), report.render()
+
+    def test_unhashable_static_attr(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        idx = next(i for i, inst in enumerate(bad._insts)
+                   if inst[0] == "matmul")
+        name, in_vids, _st, outs = bad._insts[idx]
+        bad._insts[idx] = (name, in_vids,
+                           (("transpose_x", [np.zeros(2)]),), outs)
+        report = verify_program(bad)
+        assert "PTL006" in report.codes(), report.render()
+
+    def test_unknown_primitive(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        name, in_vids, st, outs = bad._insts[0]
+        bad._insts[0] = ("totally_made_up_op", in_vids, st, outs)
+        report = verify_program(bad)
+        assert "PTL001" in report.codes(), report.render()
+
+    def test_feed_const_overlap(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        feed_vid = next(iter(bad._feed_names.values()))
+        bad._consts[feed_vid] = np.zeros((4, 8), "float32")
+        report = verify_program(bad)
+        assert "PTL005" in report.codes(), report.render()
+
+    def test_misplaced_gradients_section(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        gidx = next(i for i, inst in enumerate(bad._insts)
+                    if inst[0] == "__gradients__")
+        ginst = bad._insts.pop(gidx)
+        bad._insts.insert(0, ginst)  # grad section before its forward
+        report = verify_program(bad)
+        assert "PTL007" in report.codes(), report.render()
+
+    def test_gradients_arity_mismatch(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        gidx = next(i for i, inst in enumerate(bad._insts)
+                    if inst[0] == "__gradients__")
+        name, in_vids, st, outs = bad._insts[gidx]
+        bad._insts[gidx] = (name, in_vids, st, outs[:-1])  # drop one grad
+        report = verify_program(bad)
+        assert "PTL007" in report.codes(), report.render()
+
+    def test_gradients_missing_fwd_len(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        gidx = next(i for i, inst in enumerate(bad._insts)
+                    if inst[0] == "__gradients__")
+        name, in_vids, _st, outs = bad._insts[gidx]
+        bad._insts[gidx] = (name, in_vids, (), outs)
+        report = verify_program(bad)
+        assert "PTL007" in report.codes(), report.render()
+
+    def test_clean_program_is_still_clean_after_all_that(self):
+        # the mutations above must never have leaked into the original
+        prog, *_ = _train_program()
+        assert verify_program(prog).ok
+
+
+class TestPassManagerVerify:
+    def _pipeline_programs(self):
+        prog, feed, loss, grads = _train_program()
+        fetch = [loss] + list(grads)
+        return prog, feed, fetch
+
+    def test_all_four_passes_green_under_verify(self):
+        # constant-folding fodder: a const-input instruction in the list
+        prog, feed, fetch = self._pipeline_programs()
+        a = prog._new_vid()
+        prog._consts[a] = np.ones((8, 8), "float32")
+        b = prog._new_vid()
+        prog._consts[b] = np.ones((8, 8), "float32")
+        c = prog._new_vid()
+        prog._insts.insert(0, ("add", (a, b), (), (c,)))
+
+        exe = static.Executor()
+        before = exe.run(prog, feed=feed, fetch_list=fetch)
+        hs = []  # checkpoint targets: every tanh output vid
+        for inst in prog._insts:
+            if inst[0] == "tanh":
+                hs.append(inst[3][0])
+        pm = PassManager([
+            new_pass("constant_folding"),
+            new_pass("fuse_elewise_add_act"),
+            new_pass("dead_code_elimination", {"fetch": fetch}),
+            new_pass("auto_parallel_recompute",
+                     {"checkpoints": hs[:1]}),
+        ], verify=True)
+        pm.apply(prog, None)
+        report = verify_program(prog)
+        assert report.ok, report.render()
+        after = exe.run(prog, feed=feed, fetch_list=fetch)
+        for x, y in zip(before, after):
+            np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
+
+    def test_verify_attaches_failing_pass_name(self):
+        class _CorruptingPass:
+            name = "evil_rewrite"
+
+            def apply(self, mains, startups, context=None):
+                mains._insts[0] = ("totally_made_up_op",) \
+                    + tuple(mains._insts[0][1:])
+                return mains, startups
+
+        prog, _feed, _fetch = self._pipeline_programs()
+        pm = PassManager([_CorruptingPass()], verify=True)
+        with pytest.raises(ProgramVerificationError,
+                           match="evil_rewrite") as ei:
+            pm.apply(prog, None)
+        assert "PTL001" in ei.value.report.codes()
+
+    def test_startup_program_also_verified(self):
+        class _CorruptStartupPass:
+            name = "evil_startup_rewrite"
+
+            def apply(self, mains, startups, context=None):
+                startups._insts[0] = ("totally_made_up_op",) \
+                    + tuple(startups._insts[0][1:])
+                return mains, startups
+
+        main, _feed, _fetch = self._pipeline_programs()
+        startup, _f2, _f3 = self._pipeline_programs()
+        pm = PassManager([_CorruptStartupPass()], verify=True)
+        with pytest.raises(ProgramVerificationError,
+                           match="evil_startup_rewrite"):
+            pm.apply(main, startup)
+
+    def test_verify_off_lets_corruption_through(self):
+        class _CorruptingPass:
+            name = "evil_rewrite"
+
+            def apply(self, mains, startups, context=None):
+                mains._insts[0] = ("totally_made_up_op",) \
+                    + tuple(mains._insts[0][1:])
+                return mains, startups
+
+        prog, _feed, _fetch = self._pipeline_programs()
+        PassManager([_CorruptingPass()], verify=False).apply(prog, None)
+        assert not verify_program(prog).ok
+
+    def test_env_flag_enables_verification(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_PASS_VERIFY", "1")
+        assert PassManager([])._verify is True
+        monkeypatch.setenv("PADDLE_TPU_PASS_VERIFY", "0")
+        assert PassManager([])._verify is False
+        assert PassManager([], verify=True)._verify is True
+
+
+class TestLints:
+    def test_dead_op_and_unused_feed(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            _u = static.data("unused_in", [2], "float32")
+            live = (x * 2.0).sum()
+            _dead = paddle.nn.functional.relu(x + 5.0)
+        report = run_lints(prog, fetch=[live])
+        assert "PTL101" in report.codes(), report.render()
+        assert "PTL102" in report.codes(), report.render()
+        msgs = " ".join(d.message for d in report)
+        assert "unused_in" in msgs
+
+    def test_dead_ops_skipped_without_fetch_info(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            _dead = x * 3.0
+        report = run_lints(prog)
+        assert "PTL101" not in report.codes()
+
+    def test_redundant_cast_chain_and_noop_cast(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            y = paddle.cast(paddle.cast(x, "float16"), "float32")
+            out = y.sum()
+        report = run_lints(prog, fetch=[out])
+        assert "PTL103" in report.codes(), report.render()
+
+    def test_redundant_transpose_chain(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            y = paddle.transpose(paddle.transpose(x, [1, 0]), [1, 0])
+            out = y.sum()
+        report = run_lints(prog, fetch=[out])
+        assert "PTL104" in report.codes(), report.render()
+
+    def test_cse_candidate(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            w = paddle.to_tensor(np.ones((8, 8), "float32"))
+            a = paddle.matmul(x, w)
+            b = paddle.matmul(x, w)  # identical operands + attrs
+            out = (a + b).sum()
+        report = run_lints(prog, fetch=[out])
+        assert "PTL105" in report.codes(), report.render()
+
+    def test_fp64_demotion(self):
+        # a primitive whose forward internally downcasts (the f32-softmax
+        # pattern, e.g. nn/functional/attention.py) silently narrows a
+        # float64 operand — the demotion the lint exists for
+        name = "__demoting_prim__"
+        dispatch.register_primitive(name, lambda x: x.astype("float32"))
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [4], "float64")
+            v = prog._new_vid()
+            prog._insts.append((name, (prog._feed_names["x"],), (), (v,)))
+            report = run_lints(prog)
+            assert "PTL106" in report.codes(), report.render()
+        finally:
+            del dispatch.PRIMITIVES[name]
+
+    def test_explicit_fp32_cast_not_flagged_as_demotion(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float64")
+            y = paddle.cast(x, "float32")
+            _out = y.sum()
+        report = run_lints(prog)
+        assert "PTL106" not in report.codes(), report.render()
+
+    def test_non_jittable_primitive_flagged(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        # graft a non-jittable primitive into the list
+        nonjit = next(n for n, p in dispatch.PRIMITIVES.items()
+                      if not p.jittable)
+        name, in_vids, _st, outs = bad._insts[0]
+        bad._insts[0] = (nonjit, in_vids, (), outs)
+        report = run_lints(bad)
+        assert "PTL107" in report.codes(), report.render()
+
+    def test_clean_program_has_no_warnings(self):
+        prog, _feed, loss, grads = _train_program()
+        report = run_lints(prog, fetch=[loss] + list(grads))
+        assert not report.warnings, report.render()
+
+
+class TestDiagnosticsPlumbing:
+    def test_unregistered_code_rejected(self):
+        with pytest.raises(ValueError, match="unregistered"):
+            Diagnostic("PTL999", Severity.ERROR, "nope")
+
+    def test_report_render_and_filters(self):
+        r = DiagnosticReport()
+        r.add("PTL001", Severity.ERROR, "bad op", op_index=3, hint="fix it")
+        r.add("PTL101", Severity.WARNING, "dead")
+        assert not r.ok
+        assert len(r.errors) == 1 and len(r.warnings) == 1
+        text = r.render("header")
+        assert "header" in text and "op#3" in text and "fix it" in text
+        assert r.by_code("PTL001")[0].message == "bad op"
+
+    def test_every_emitted_code_is_documented(self):
+        for code in CODES:
+            assert code.startswith("PTL") and len(code) == 6
+
+
+class TestDumpAndRepr:
+    def test_dump_names_feeds_attrs_and_types(self):
+        prog, *_ = _train_program()
+        text = prog.dump()
+        assert "feed \"x\"" in text
+        assert "matmul" in text
+        assert "transpose_x" in text          # static attrs visible
+        assert "float32[4x8]" in text         # inferred avals visible
+        assert "__gradients__" in text
+        assert "consts" in text
+
+    def test_repr_delegates_to_dump(self):
+        # repr stays cheap: the un-annotated dump (no eval_shape tracing)
+        prog, *_ = _train_program()
+        assert repr(prog) == prog.dump(annotate=False)
+        assert "feed \"x\"" in repr(prog)
+
+    def test_dump_survives_corruption(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        bad._insts[0] = ("totally_made_up_op",) + tuple(bad._insts[0][1:])
+        text = bad.dump()  # must not raise on a broken program
+        assert "totally_made_up_op" in text
+
+    def test_repr_survives_malformed_attrs(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        name, in_vids, _st, outs = bad._insts[0]
+        bad._insts[0] = (name, in_vids, (1, 2), outs)  # non-(k, v) attrs
+        assert name in repr(bad)
+        assert name in bad.dump()
+
+
+class TestExecutorFeedValidation:
+    def test_unknown_feed_rejected_with_placeholder_list(self):
+        prog, feed, loss, _grads = _train_program()
+        exe = static.Executor()
+        feed = dict(feed, bogus=np.zeros(3, "float32"))
+        with pytest.raises(ValueError, match="bogus") as ei:
+            exe.run(prog, feed=feed, fetch_list=[loss])
+        assert "'x'" in str(ei.value)  # declared placeholders are listed
+
+    def test_missing_feed_still_rejected(self):
+        prog, _feed, loss, _grads = _train_program()
+        with pytest.raises(ValueError, match="missing feeds"):
+            static.Executor().run(prog, feed={}, fetch_list=[loss])
+
+
+class TestRegistryLintTool:
+    def test_current_registry_is_clean(self):
+        import importlib.util
+        import os as _os
+
+        path = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                             _os.pardir, "tools", "lint_registry.py")
+        spec = importlib.util.spec_from_file_location("lint_registry", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.check_primitives() == []
+        assert mod.check_all_exports() == []
+
+    def test_save_without_vjp_is_flagged(self):
+        import importlib.util
+        import os as _os
+
+        path = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                             _os.pardir, "tools", "lint_registry.py")
+        spec = importlib.util.spec_from_file_location("lint_registry2", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        name = "__lint_test_bad_prim__"
+        dispatch.register_primitive(
+            name, lambda x: x, save=lambda ins, outs: ins)
+        try:
+            problems = mod.check_primitives()
+            assert any(name in p and "save" in p for p in problems)
+        finally:
+            del dispatch.PRIMITIVES[name]

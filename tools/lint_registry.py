@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Codebase-level registry lint: primitive registry + ``__all__`` audit.
+
+The static-program analysis layer (paddle_tpu/static/analysis) checks
+captured programs; this script applies the same discipline to the code
+that *defines* the ops. It verifies, over the fully-imported package:
+
+1. every ``dispatch.PRIMITIVES`` entry has a callable ``forward``
+   (backward-only registrations — ``pylayer::*``, ``recompute::replay``
+   — with a callable ``vjp`` are the one sanctioned exception);
+2. grad wiring is mutually consistent: ``save`` without a ``vjp`` is
+   dead weight (the fallback path saves inputs itself), and ``vjp``/
+   ``save`` must be callables whose signatures can accept the engine's
+   calling convention (``vjp(grads_out, saved, **static)``,
+   ``save(arrays_in, outs)``);
+3. every name in each imported ``paddle_tpu`` module's ``__all__``
+   actually resolves on that module.
+
+Exits non-zero listing every violation — wired into the test session via
+a session-scoped fixture in tests/conftest.py (skippable with
+``PADDLE_TPU_SKIP_REGISTRY_LINT=1``), so registry drift fails tier-1
+instead of surfacing as an AttributeError in production.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _can_take_two(capacity) -> bool:
+    """capacity is dispatch.positional_capacity's (min, max|None)."""
+    if capacity is None:
+        return True  # opaque signature: give the benefit of the doubt
+    _min, _max = capacity
+    if _min is None:
+        return True
+    return _max is None or _max >= 2
+
+
+def check_primitives() -> List[str]:
+    from paddle_tpu.core import dispatch
+
+    problems = []
+    for name, prim in sorted(dispatch.PRIMITIVES.items()):
+        meta = dispatch.primitive_metadata(name)
+        if prim.forward is None:
+            # sanctioned backward-only registrations (pylayer::*,
+            # recompute::replay) carry the op through the eager tape and
+            # exist solely for their custom vjp — the vjp must be there
+            if callable(prim.vjp):
+                continue
+            problems.append(
+                f"primitive {name!r}: forward is None and there is no "
+                f"callable vjp (backward-only registrations must provide "
+                f"one; everything else must provide a forward)")
+            continue
+        if not callable(prim.forward):
+            problems.append(
+                f"primitive {name!r}: forward is not callable "
+                f"({type(prim.forward).__name__})")
+        if prim.vjp is not None and not callable(prim.vjp):
+            problems.append(f"primitive {name!r}: vjp is not callable")
+        if prim.save is not None and not callable(prim.save):
+            problems.append(f"primitive {name!r}: save is not callable")
+        if prim.save is not None and prim.vjp is None:
+            problems.append(
+                f"primitive {name!r}: has save= but no vjp — the generic "
+                f"jax.vjp fallback ignores save and rematerializes from "
+                f"inputs, so the save hook is dead weight (add the vjp or "
+                f"drop the save)")
+        if callable(prim.vjp) and not _can_take_two(meta["vjp_capacity"]):
+            problems.append(
+                f"primitive {name!r}: vjp cannot accept "
+                f"(grads_out, saved) — dispatch.call_vjp passes two "
+                f"positionals")
+        if callable(prim.save) and not _can_take_two(meta["save_capacity"]):
+            problems.append(
+                f"primitive {name!r}: save cannot accept "
+                f"(arrays_in, outs) — the engine passes two "
+                f"positionals at forward time")
+    return problems
+
+
+def check_all_exports() -> List[str]:
+    problems = []
+    for mod_name in sorted(sys.modules):
+        if not (mod_name == "paddle_tpu" or
+                mod_name.startswith("paddle_tpu.")):
+            continue
+        mod = sys.modules[mod_name]
+        if mod is None:
+            continue
+        exported = getattr(mod, "__all__", None)
+        if not exported:
+            continue
+        for sym in exported:
+            if not isinstance(sym, str):
+                problems.append(
+                    f"{mod_name}.__all__ contains a non-string entry "
+                    f"{sym!r}")
+            elif not hasattr(mod, sym):
+                problems.append(
+                    f"{mod_name}.__all__ exports {sym!r} but the module "
+                    f"has no such attribute")
+    return problems
+
+
+def main(argv=None) -> int:
+    import paddle_tpu  # noqa: F401 — populates the registry + sys.modules
+    from paddle_tpu.core import dispatch
+
+    problems = check_primitives() + check_all_exports()
+    n_mods = sum(1 for m in sys.modules
+                 if m == "paddle_tpu" or m.startswith("paddle_tpu."))
+    if problems:
+        print(f"lint_registry: {len(problems)} violation(s) over "
+              f"{len(dispatch.PRIMITIVES)} primitives / {n_mods} modules:",
+              file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print(f"lint_registry: OK ({len(dispatch.PRIMITIVES)} primitives, "
+          f"{n_mods} modules audited)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
