@@ -53,6 +53,34 @@ def _emit(metric, value, unit, mfu):
     }), flush=True)
 
 
+def _emit_metrics_block():
+    """One JSON line with the observability roll-up (compile count, cache
+    hit rate, retraces) printed next to the metric line of each config.
+    Requires --metrics (which enables paddle_tpu.observability)."""
+    import paddle_tpu.observability as obs
+
+    if not obs.enabled():
+        return
+    mets = obs.dump()["metrics"]
+
+    def tot(name):
+        return sum(s.get("value", s.get("count", 0))
+                   for s in mets.get(name, {}).get("series", []))
+
+    hits, misses = tot("dispatch.cache_hits"), tot("dispatch.cache_misses")
+    print(json.dumps({"metrics": {
+        "dispatch_calls": tot("dispatch.calls"),
+        "jit_cache_hits": hits,
+        "jit_cache_misses": misses,
+        "cache_hit_rate": round(hits / (hits + misses), 4)
+        if hits + misses else None,
+        "retraces": tot("dispatch.retraces"),
+        "to_static_compiles": tot("jit.compiles"),
+        "executor_compiles": tot("executor.compiles"),
+        "executor_replays": tot("executor.replays"),
+    }}), flush=True)
+
+
 def _profile_one_step(step_fn, *args):
     import paddle_tpu.profiler as profiler
 
@@ -618,6 +646,8 @@ def _run_isolated(config: str, args) -> int:
         cmd += ["--steps", str(args.steps)]
     if args.profile and config == "llama":
         cmd += ["--profile"]
+    if args.metrics:
+        cmd += ["--metrics"]
     proc = subprocess.run(cmd)
     if proc.returncode != 0:
         print(f"bench config {config!r} FAILED rc={proc.returncode}",
@@ -631,6 +661,9 @@ def main():
                     choices=["llama", "resnet", "moe", "bert", "sdxl",
                              "decode", "all"])
     ap.add_argument("--profile", action="store_true")
+    ap.add_argument("--metrics", action="store_true",
+                    help="enable paddle_tpu.observability and append a "
+                         "metrics JSON line per config")
     ap.add_argument("--steps", type=int, default=None)
     args = ap.parse_args()
 
@@ -661,6 +694,11 @@ def main():
     steps = args.steps or (20 if on_tpu else 3)
     warmup = 3 if on_tpu else 1
 
+    if args.metrics:
+        import paddle_tpu.observability as obs
+
+        obs.enable()
+
     if args.config == "resnet":
         bench_resnet(on_tpu, steps, warmup, peak_flops)
     elif args.config == "moe":
@@ -673,6 +711,9 @@ def main():
         bench_decode(on_tpu, steps, warmup, peak_flops)
     elif args.config == "llama":
         bench_llama(on_tpu, steps, warmup, peak_flops, profile=args.profile)
+
+    if args.metrics:
+        _emit_metrics_block()
 
 
 if __name__ == "__main__":

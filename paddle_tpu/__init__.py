@@ -80,7 +80,8 @@ import sys as _sys
 
 def __getattr__(name):
     # heavyweight subpackages loaded on demand
-    if name in ("distributed", "vision", "profiler", "hapi", "callbacks",
+    if name in ("distributed", "vision", "profiler", "observability",
+                "hapi", "callbacks",
                 "fft", "signal", "distribution", "geometric", "quantization",
                 "text", "audio", "dataset", "hub", "sysconfig", "linalg",
                 "regularizer", "decomposition", "onnx", "utils", "reader"):

@@ -21,6 +21,37 @@ import jax
 import jax.numpy as jnp
 
 from . import flags
+from .. import observability as _obs
+
+# hot-path gate: two attribute loads when disabled (see observability._gate)
+_obs_state = _obs.state
+
+_M_CALLS = _obs.counter(
+    "dispatch.calls",
+    "primitive dispatches by op and mode (eager | traced | capture)")
+_M_CACHE_HITS = _obs.counter(
+    "dispatch.cache_hits",
+    "_jitted_forward executable-cache hits (op + static-args already seen)")
+_M_CACHE_MISSES = _obs.counter(
+    "dispatch.cache_misses",
+    "_jitted_forward executable-cache misses, by cause")
+_M_RETRACES = _obs.counter(
+    "dispatch.retraces",
+    "jax trace executions of a cached per-op executable, by cause "
+    "(new_static_args = first trace after a cache miss; new_avals = "
+    "jax.jit re-traced an existing executable for a new input signature)")
+_M_VJP_CALLS = _obs.counter(
+    "dispatch.vjp_calls", "backward dispatches by op and path "
+    "(custom vjp vs jax.vjp rematerialising fallback)")
+
+# (op, static_key) signatures already dispatched — backs the hit/miss
+# split without paying lru_cache.cache_info() namedtuple allocation per
+# call. Telemetry only: LRU evictions are invisible to it, so growth is
+# capped at 2x the executable cache — past the cap, fresh keys keep
+# counting as misses (the truthful direction after evictions begin).
+_JIT_KEYS_CAP = 16384
+_jit_keys_seen: set = set()
+_obs.add_reset_hook(_jit_keys_seen.clear)
 
 
 class Primitive:
@@ -82,8 +113,21 @@ def _jitted_forward(name: str, static_items):
     + the autotune cache (phi/kernels/autotune/)."""
     prim = PRIMITIVES[name]
     static = dict(static_items)
-    fn = lambda *arrays: prim.forward(*arrays, **static)
-    return jax.jit(fn) if prim.jittable else fn
+    if not prim.jittable:
+        return lambda *arrays: prim.forward(*arrays, **static)
+    n_traces = [0]
+
+    def fn(*arrays):
+        # body runs at TRACE time only (jax.jit caches the jaxpr), so this
+        # counts retraces: the first trace follows the static-args cache
+        # miss, every later one means jax saw a new input-aval signature
+        if _obs_state.on:
+            n_traces[0] += 1
+            _M_RETRACES.inc(op=name, cause="new_static_args"
+                            if n_traces[0] == 1 else "new_avals")
+        return prim.forward(*arrays, **static)
+
+    return jax.jit(fn)
 
 
 def _check_nan_inf(name: str, outs):
@@ -133,11 +177,26 @@ def call_primitive(name: str, arrays: Sequence[Any], static: Dict[str, Any]):
     if _capture_program is not None and any(
         isinstance(a, jax.ShapeDtypeStruct) for a in arrays
     ):
+        if _obs_state.on:
+            _M_CALLS.inc(op=name, mode="capture")
         outs = _capture_program.record(name, arrays, static)
         return outs if isinstance(outs, tuple) else (outs,)
     prim = PRIMITIVES[name]
+    on = _obs_state.on
+    if on:
+        _M_CALLS.inc(op=name, mode="traced" if any(
+            isinstance(a, jax.core.Tracer) for a in arrays) else "eager")
     if flags.get_flag("eager_op_jit") and prim.jittable:
-        fn = _jitted_forward(name, _hashable(static))
+        static_key = _hashable(static)
+        if on:
+            sig = (name, static_key)
+            if sig in _jit_keys_seen:
+                _M_CACHE_HITS.inc(op=name)
+            else:
+                if len(_jit_keys_seen) < _JIT_KEYS_CAP:
+                    _jit_keys_seen.add(sig)
+                _M_CACHE_MISSES.inc(op=name, cause="new_static_args")
+        fn = _jitted_forward(name, static_key)
         outs = fn(*arrays)
     else:
         outs = prim.forward(*arrays, **static)
@@ -171,6 +230,10 @@ def call_vjp(name: str, grads_out, saved, static: Dict[str, Any]):
     """Run a primitive's backward. grads_out: tuple aligned with outputs
     (zeros filled in by the engine for unused outputs)."""
     prim = PRIMITIVES[name]
+    if _obs_state.on:
+        _M_VJP_CALLS.inc(op=name,
+                         path="custom" if prim.vjp is not None
+                         else "fallback")
     if prim.vjp is not None:
         grads = prim.vjp(grads_out, saved, **static)
     else:

@@ -161,6 +161,14 @@ define_flag("check_embedding_bounds", True,
             "eager-mode embedding id range check (one blocking "
             "device->host sync per call; disable in eager inner loops "
             "where throughput matters — jit paths never pay it)")
+define_flag("observability", False,
+            "record runtime metrics/events at the instrumented hot paths "
+            "(dispatch, Executor, PassManager, jit) — see "
+            "paddle_tpu.observability; also enabled by "
+            "PADDLE_TPU_METRICS_DUMP=<path>")
+define_flag("observability_max_events", 4096,
+            "ring-buffer capacity of the observability structured-event "
+            "log (oldest events drop first)")
 define_flag("use_pallas_flash_attention", True,
             "use the Pallas flash-attention kernel on TPU backends")
 define_flag("use_pallas_rms_norm", True,

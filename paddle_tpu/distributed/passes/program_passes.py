@@ -77,7 +77,9 @@ class _ProgramPass:
             else [main_programs]
         for prog in progs:
             self._apply_one(prog, context)
-            prog._cache.clear()
+            # re-fingerprint, don't clear: replays compiled against an
+            # identical structure (e.g. this pass was a no-op) stay valid
+            prog._invalidate()
         return main_programs, startup_programs
 
     def _apply_one(self, prog, context):

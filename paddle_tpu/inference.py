@@ -213,13 +213,18 @@ class Predictor:
         feed_names = tuple(self._input_names)
         donate = (self._config.memory_optim_enabled()
                   and jax.default_backend() != "cpu")
-        key = ("__infer__", tuple((a.shape, str(a.dtype)) for a in arrays),
+        # the fingerprint keeps this correct now that rewrite passes
+        # re-fingerprint instead of clearing prog._cache
+        key = ("__infer__", prog.fingerprint(),
+               tuple((a.shape, str(a.dtype)) for a in arrays),
                self._fetch_vids, donate)
         fn = prog._cache.get(key)
         if fn is None:
             fn = Executor._compile(prog, feed_names, self._fetch_vids,
                                    donate=donate)
-            prog._cache[key] = fn
+        else:
+            prog._cache.pop(key)  # LRU refresh vs Executor.run eviction
+        prog._cache[key] = fn
         outs = fn(*arrays)
         return [np.asarray(o) for o in outs]
 

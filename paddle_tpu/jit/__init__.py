@@ -21,15 +21,27 @@ from __future__ import annotations
 import functools
 import inspect
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability as _obs
 from ..core import generator
 from ..core.tensor import Parameter, Tensor
 from .trace_state import in_tracing, tracing_scope
+
+_M_JIT_COMPILES = _obs.counter(
+    "jit.compiles", "to_static compiles (new input-signature cache entry)")
+_M_JIT_HITS = _obs.counter(
+    "jit.cache_hits", "to_static calls served by an existing entry")
+_M_JIT_COMPILE_SECONDS = _obs.histogram(
+    "jit.compile_seconds",
+    "wall time of a to_static entry's first run (trace + XLA compile)")
+_M_JIT_FALLBACKS = _obs.counter(
+    "jit.fallbacks", "to_static signatures that fell back to eager")
 
 __all__ = ["to_static", "not_to_static", "ignore_module", "save", "load",
            "enable_to_static", "TracedLayer"]
@@ -290,10 +302,16 @@ class StaticFunction:
         key = (template, _aval_key(arrays), self._mode_key(layers),
                tuple(id(o) for o in optimizers))
         entry = self._cache.get(key)
+        fn_label = getattr(self._fn, "__name__", "?")
         if entry is None:
+            if _obs.state.on:
+                _M_JIT_COMPILES.inc(fn=fn_label)
             entry = self._compile(template, arrays, layers, optimizers, args, kwargs)
             self._cache[key] = entry
+        elif _obs.state.on:
+            _M_JIT_HITS.inc(fn=fn_label)
         if entry.fallback:
+            # counted once at the transition below, not per call
             return self._fn(*args, **kwargs)
         # runtime invocation
         state = [s.get() for s in entry.slots]
@@ -304,6 +322,8 @@ class StaticFunction:
             [o._step_count + 1 for o in entry.optimizers], jnp.float32
         ) if entry.optimizers else jnp.zeros((0,), jnp.float32)
         rng = generator.next_key("local_seed")
+        first_run = not entry.ran_ok  # first run pays jax trace + XLA compile
+        t0 = time.perf_counter()
         try:
             out_arrays, new_state = entry.jitted(state, arrays, rng, lr_vals,
                                                  steps)
@@ -330,8 +350,15 @@ class StaticFunction:
                 "execution for this input signature. Pass full_graph=True "
                 "to make this an error.")
             entry.fallback = True
+            if _obs.state.on:
+                _M_JIT_FALLBACKS.inc(fn=fn_label)
             return self._fn(*args, **kwargs)
         entry.ran_ok = True
+        if first_run and _obs.state.on:
+            dt = time.perf_counter() - t0
+            _M_JIT_COMPILE_SECONDS.observe(dt, fn=fn_label)
+            _obs.emit("jit.compile", fn=fn_label, seconds=dt,
+                      n_inputs=len(arrays), n_state=len(entry.slots))
         for s, v in zip(entry.slots, new_state):
             s.set(v)
         # replay python-side step-count increments observed at trace time

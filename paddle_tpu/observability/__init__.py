@@ -1,0 +1,112 @@
+"""paddle_tpu.observability — runtime metrics + structured span events.
+
+The runtime counterpart of the PR-1 static diagnostics layer: where
+``static.analysis`` tells you what is *wrong* with a program,
+observability tells you where *time and recompiles go* at runtime. Three
+hot layers are instrumented with it out of the box:
+
+- ``core/dispatch.py`` — per-primitive call counts (eager vs traced vs
+  capture), ``_jitted_forward`` executable-cache hits/misses, and
+  retrace causes (new static-args vs new input avals);
+- ``static/program.py`` Executor — compile events carrying the program
+  fingerprint, feed signature and compile wall time, replay counts,
+  cache invalidations and recompiles saved by fingerprint keying;
+- ``distributed/passes`` PassManager — per-pass wall time, op-count
+  delta, verifier runs and diagnostic counts.
+
+Usage::
+
+    import paddle_tpu.observability as obs
+    obs.enable()                  # or FLAGS_observability=1 in the env
+    ...run workload...
+    print(obs.summary())          # human table
+    obs.dump("metrics.json")      # JSON; render with tools/metrics_report.py
+
+Gating: recording at the instrumentation sites is OFF by default and
+costs two attribute loads per dispatch when disabled. It turns on via
+``enable()``, the ``FLAGS_observability`` env/flag (core/flags.py), or
+automatically when ``PADDLE_TPU_METRICS_DUMP=<path>`` is set — that env
+var also registers an atexit hook writing the dump to ``<path>``.
+Metric objects themselves always record when called directly; the gate
+belongs to the hot-path instrumentation, not the registry.
+
+Spans reuse ``profiler.RecordEvent``/host-tracer machinery, so compile
+and pass events land in the same Chrome-trace timeline as user spans
+and XLA device ops.
+
+Claiming metric names: every name is ``subsystem.noun_verb``; claim your
+subsystem prefix in ``observability.metrics.CLAIMED_SUBSYSTEMS`` (the
+``PTLxxx``-code convention applied to metrics). ``tools/lint_registry.py``
+audits the registry once per test session.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+
+from ._gate import state
+from .metrics import (CLAIMED_SUBSYSTEMS, Counter, Gauge, Histogram,
+                      MetricsRegistry, NAME_RE, registry)
+from .events import Event, emit, events, span
+from .report import dump, dump_dict, render_report, summary
+
+__all__ = [
+    "state", "enabled", "enable", "disable", "reset",
+    "registry", "counter", "gauge", "histogram",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "Event", "emit", "events", "span",
+    "dump", "dump_dict", "render_report", "summary",
+    "CLAIMED_SUBSYSTEMS", "NAME_RE",
+]
+
+counter = registry.counter
+gauge = registry.gauge
+histogram = registry.histogram
+
+
+def enabled() -> bool:
+    return state.on
+
+
+def enable():
+    """Turn on metric/event recording at the instrumentation sites."""
+    state.on = True
+
+
+def disable():
+    state.on = False
+
+
+_reset_hooks = []
+
+
+def add_reset_hook(fn):
+    """Register a callable run by :func:`reset` — instrumented modules
+    use it to clear private bookkeeping (e.g. dispatch's seen-key set)."""
+    _reset_hooks.append(fn)
+
+
+def reset():
+    """Zero all metric series, drop buffered events, run reset hooks."""
+    registry.reset()
+    from .events import clear as _clear_events
+
+    _clear_events()
+    for fn in _reset_hooks:
+        fn()
+
+
+def _init_from_env():
+    from ..core import flags
+
+    try:
+        if flags.get_flag("observability"):
+            state.on = True
+    except KeyError:
+        pass
+    if os.environ.get("PADDLE_TPU_METRICS_DUMP"):
+        state.on = True
+        atexit.register(dump)
+
+
+_init_from_env()

@@ -1,0 +1,18 @@
+"""The one-boolean hot-path gate.
+
+Instrumented modules read ``state.on`` (two attribute loads, no call)
+before touching any metric, so a disabled build adds nanoseconds to the
+dispatch fast path. Kept in its own leaf module so ``events``/``report``
+and ``observability/__init__`` can share it without import cycles.
+"""
+from __future__ import annotations
+
+
+class _State:
+    __slots__ = ("on",)
+
+    def __init__(self):
+        self.on = False
+
+
+state = _State()

@@ -1,0 +1,110 @@
+"""Metrics dump (JSON) + human summary rendering.
+
+``dump()`` serializes the whole registry plus the event ring buffer into
+one JSON document; ``render_report()`` turns that document (live or
+re-loaded from disk — ``tools/metrics_report.py``) into the human table,
+so the dump round-trips by construction.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from . import _gate
+from .events import events as _list_events
+from .metrics import registry
+
+DUMP_ENV = "PADDLE_TPU_METRICS_DUMP"
+DUMP_VERSION = 1
+
+
+def dump_dict() -> Dict[str, Any]:
+    return {
+        "version": DUMP_VERSION,
+        "generated_unix": time.time(),
+        "enabled": _gate.state.on,
+        "metrics": registry.to_dict(),
+        "events": [e.to_dict() for e in _list_events()],
+    }
+
+
+def dump(path: Optional[str] = None) -> Dict[str, Any]:
+    """Serialize all metrics + events; write JSON to ``path`` (or the
+    ``PADDLE_TPU_METRICS_DUMP`` env path) when one is given. Always
+    returns the dump dict."""
+    d = dump_dict()
+    path = path or os.environ.get(DUMP_ENV)
+    if path:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(d, f, indent=1, default=str)
+        os.replace(tmp, path)
+    return d
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_secs(s: float) -> str:
+    return f"{s * 1e3:.3f}ms" if s < 1.0 else f"{s:.3f}s"
+
+
+def render_report(d: Dict[str, Any], max_events: int = 20) -> str:
+    """Human table over a dump dict (live or loaded from a JSON file)."""
+    metrics = d.get("metrics", {}) if isinstance(d, dict) else None
+    if not isinstance(metrics, dict):
+        raise ValueError("not a metrics dump: no 'metrics' mapping")
+    counters, gauges, hists = [], [], []
+    for name in sorted(metrics):
+        m = metrics[name]
+        kind = m.get("kind")
+        for s in m.get("series", []):
+            row_name = name + _fmt_labels(s.get("labels", {}))
+            if kind == "counter":
+                counters.append((row_name, s["value"]))
+            elif kind == "gauge":
+                gauges.append((row_name, s["value"]))
+            elif kind == "histogram":
+                cnt = s.get("count", 0)
+                avg = s.get("sum", 0.0) / cnt if cnt else 0.0
+                hists.append((row_name, cnt, s.get("sum", 0.0), avg,
+                              s.get("max", 0.0)))
+    lines: List[str] = []
+    width = 64
+    if counters:
+        lines += ["Counters", "-" * (width + 14)]
+        lines += [f"{n[:width]:<{width}}{v:>14}" for n, v in counters]
+    if gauges:
+        lines += ["", "Gauges", "-" * (width + 14)]
+        lines += [f"{n[:width]:<{width}}{str(v):>14}" for n, v in gauges]
+    if hists:
+        header = (f"{'Histogram':<{width}}{'Count':>8}{'Total':>12}"
+                  f"{'Avg':>12}{'Max':>12}")
+        lines += ["", header, "-" * len(header)]
+        lines += [f"{n[:width]:<{width}}{c:>8}{_fmt_secs(t):>12}"
+                  f"{_fmt_secs(a):>12}{_fmt_secs(mx):>12}"
+                  for n, c, t, a, mx in hists]
+    evs = d.get("events", [])
+    if evs:
+        lines += ["", f"Events (last {min(max_events, len(evs))} of "
+                      f"{len(evs)})", "-" * (width + 14)]
+        for e in evs[-max_events:]:
+            e = dict(e)
+            ts, kind = e.pop("ts", 0.0), e.pop("kind", "?")
+            fields = " ".join(f"{k}={v}" for k, v in e.items())
+            lines.append(f"{time.strftime('%H:%M:%S', time.localtime(ts))} "
+                         f"{kind}: {fields}")
+    if not lines:
+        lines = ["(no metrics recorded)"]
+    return "\n".join(lines)
+
+
+def summary(max_events: int = 20) -> str:
+    """Human-readable table over the live registry."""
+    return render_report(dump_dict(), max_events=max_events)

@@ -12,8 +12,8 @@ from .statistic import (collect_device_statistic, device_summary_table,
                         op_class, statistic_from_trace, summary_table)
 
 __all__ = [
-    "Profiler", "ProfilerState", "ProfilerTarget", "SummaryView",
-    "TracerEventType", "RecordEvent", "make_scheduler",
+    "Profiler", "ProfilerState", "ProfilerTarget", "SortedKeys",
+    "SummaryView", "TracerEventType", "RecordEvent", "make_scheduler",
     "export_chrome_tracing", "export_protobuf", "load_profiler_result",
     "in_profiler_mode", "get_profiler", "collect_device_statistic",
     "device_summary_table", "op_class", "statistic_from_trace",

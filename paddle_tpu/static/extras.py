@@ -432,7 +432,10 @@ def program_from_payload(payload):
 
 def deserialize_persistables(program, data, executor=None):
     state = pickle.loads(data)
-    program._consts.update({
+    # update_consts (not a bare dict update): bumps the consts version in
+    # the replay-cache fingerprint, so executables that baked the old
+    # weight values in are never served again
+    program.update_consts({
         int(k.split("_", 1)[1]): jnp.asarray(v) for k, v in state.items()
     })
     return program
@@ -486,10 +489,10 @@ def load_program_state(model_path, var_list=None):
 
 
 def set_program_state(program, state_dict):
-    program._consts.update({
+    program.update_consts({
         int(k.split("_", 1)[1]): jnp.asarray(v)
         for k, v in state_dict.items() if k.startswith("var_")
-    })
+    })  # versioned rebind — same reason as deserialize_persistables
 
 
 # ---------------------------------------------------------------------------

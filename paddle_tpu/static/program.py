@@ -15,14 +15,40 @@ pd_op_to_kernel_pass + PirInterpreter pipeline collapses into XLA.
 from __future__ import annotations
 
 import functools
+import hashlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+from .. import observability as _obs
 from ..core import dispatch
 from ..core.dtype import convert_dtype
 from ..core.tensor import Tensor
+
+_obs_state = _obs.state
+
+_M_RUNS = _obs.counter("executor.runs", "Executor.run invocations")
+_M_COMPILES = _obs.counter(
+    "executor.compiles",
+    "fresh replay compiles (new program-fingerprint + feed signature)")
+_M_REPLAYS = _obs.counter(
+    "executor.replays", "Executor.run served from the compiled-replay cache")
+_M_COMPILE_SECONDS = _obs.histogram(
+    "executor.compile_seconds",
+    "wall time of a replay compile (jax trace + XLA compile + first run)")
+_M_INVALIDATIONS = _obs.counter(
+    "executor.cache_invalidations",
+    "program mutations (recorded op / grad section / rewrite pass) that "
+    "changed the compiled-replay cache fingerprint")
+_M_RECOMPILES_SAVED = _obs.counter(
+    "executor.recompiles_saved",
+    "cache hits on an entry compiled before the program's latest "
+    "mutation — recompiles the old clear-on-any-change policy would "
+    "have paid (e.g. a rewrite pass that turned out to be a no-op)")
+
+#: compiled-replay entries kept per program; oldest evicted first
+_REPLAY_CACHE_CAP = 64
 
 __all__ = ["Program", "program_guard", "data", "Executor",
            "default_main_program", "default_startup_program"]
@@ -40,6 +66,9 @@ class Program:
         self._keepalive: List[Any] = []  # pins captured objects: id() reuse
         self._feed_names: Dict[str, int] = {}
         self._cache: Dict[Any, Any] = {}
+        self._mutations = 0
+        self._consts_version = 0
+        self._fingerprint: Optional[str] = None
 
     # -- recording -------------------------------------------------------
     def _new_vid(self) -> int:
@@ -92,8 +121,47 @@ class Program:
                                               key=lambda kv: kv[0])),
              tuple(out_vids))
         )
-        self._cache.clear()  # program changed; invalidate compiled replays
+        self._invalidate()  # program changed; re-fingerprint compiled replays
         return outs
+
+    def _invalidate(self):
+        """Mark the program mutated. Compiled replays stay in ``_cache``
+        keyed by the fingerprint of the state they were compiled against
+        (Executor._compile snapshots that state), so a mutation that
+        round-trips back to a previous structure — a no-op rewrite pass,
+        or alternating pass pipelines — replays instead of recompiling."""
+        self._fingerprint = None
+        self._mutations += 1
+        if _obs_state.on:
+            _M_INVALIDATIONS.inc()
+
+    def update_consts(self, mapping: Dict[int, Any]):
+        """Rebind const VALUES under existing vids (parameter reload —
+        deserialize_persistables / set_program_state). Bumps the consts
+        version folded into the fingerprint, so compiled replays that
+        baked the old values in can never be served again."""
+        self._consts.update(mapping)
+        self._consts_version += 1
+        self._invalidate()
+
+    def fingerprint(self) -> str:
+        """Content hash of the program structure (instructions, feeds,
+        const bindings + their reload version, recompute checkpoints) —
+        the compiled-replay cache key component; recomputed lazily after
+        mutations. Const values are versioned, not hashed: rebind them
+        through :meth:`update_consts`, never by poking ``_consts``."""
+        if self._fingerprint is None:
+            h = hashlib.blake2b(digest_size=12)
+            for name, vid, shape, dtype in self._placeholders:
+                h.update(f"P|{name}|{vid}|{shape}|{dtype}".encode())
+            h.update(repr((sorted(self._consts),
+                           self._consts_version)).encode())
+            for inst in self._insts:
+                h.update(repr(inst).encode())
+            h.update(repr(tuple(
+                getattr(self, "_remat_checkpoints", ()) or ())).encode())
+            self._fingerprint = h.hexdigest()
+        return self._fingerprint
 
     def vid_of(self, t: Tensor) -> int:
         vid = self._vid_by_obj.get(id(t._value))
@@ -130,7 +198,7 @@ class Program:
         self._insts.append(
             ("__gradients__", (loss_vid,) + wrt_vids,
              (("fwd_len", fwd_len),), tuple(grad_vids)))
-        self._cache.clear()
+        self._invalidate()
         return outs
 
     # -- parity surface --------------------------------------------------
@@ -150,6 +218,9 @@ class Program:
         p._keepalive = list(self._keepalive)
         p._feed_names = dict(self._feed_names)
         p._cache = {}
+        p._mutations = 0
+        p._consts_version = self._consts_version
+        p._fingerprint = self._fingerprint
         if hasattr(self, "_remat_checkpoints"):
             p._remat_checkpoints = self._remat_checkpoints
         if hasattr(self, "_fetch_vids"):
@@ -251,6 +322,20 @@ def _replay_gradients(program: Program, fwd_len: int, loss_vid: int,
     return tuple(grads)
 
 
+class _ReplaySnapshot:
+    """Frozen copy of exactly what Executor._compile's replay closure and
+    _build_loss_fn read from a Program."""
+
+    __slots__ = ("_insts", "_consts", "_feed_names", "_remat_checkpoints")
+
+    def __init__(self, program: Program):
+        self._insts = list(program._insts)
+        self._consts = dict(program._consts)
+        self._feed_names = dict(program._feed_names)
+        self._remat_checkpoints = tuple(
+            getattr(program, "_remat_checkpoints", ()) or ())
+
+
 _default_main = Program()
 _default_startup = Program()
 _guard_stack: List[Program] = []
@@ -329,13 +414,42 @@ class Executor:
                 f"declares placeholders {sorted(declared) or '(none)'}")
         arrays = [np.asarray(v._value if isinstance(v, Tensor) else v)
                   for _, v in feed_items]
-        key = (feed_names,
-               tuple((a.shape, str(a.dtype)) for a in arrays), fetch_vids)
-        fn = program._cache.get(key)
-        if fn is None:
-            fn = self._compile(program, feed_names, fetch_vids)
-            program._cache[key] = fn
-        outs = fn(*arrays)
+        if _obs_state.on:
+            _M_RUNS.inc()
+        feed_sig = tuple((a.shape, str(a.dtype)) for a in arrays)
+        # keyed by program CONTENT, not clear-on-change: switching between
+        # programs, or a rewrite pipeline that lands back on a structure
+        # already compiled, replays instead of recompiling
+        key = (program.fingerprint(), feed_names, feed_sig, fetch_vids)
+        entry = program._cache.get(key)
+        if entry is None:
+            with _obs.span("Executor.compile",
+                           histogram=_M_COMPILE_SECONDS) as sp:
+                fn = self._compile(program, feed_names, fetch_vids)
+                outs = fn(*arrays)  # first call: jax trace + XLA compile
+            program._cache[key] = (fn, program._mutations)
+            while len(program._cache) > _REPLAY_CACHE_CAP:
+                program._cache.pop(next(iter(program._cache)))
+            if _obs_state.on:
+                _M_COMPILES.inc()
+                _obs.emit(
+                    "executor.compile", fingerprint=key[0],
+                    feed=[f"{n}:{list(s)}:{d}"
+                          for n, (s, d) in zip(feed_names, feed_sig)],
+                    num_ops=program.num_ops, num_fetch=len(fetch_vids),
+                    seconds=sp.seconds)
+        else:
+            # LRU refresh: eviction pops from the front, so a hit moves
+            # its entry to the back (a steady working set slightly over
+            # the cap would otherwise evict every entry just before use)
+            program._cache.pop(key)
+            program._cache[key] = entry
+            fn, born = entry
+            if _obs_state.on:
+                _M_REPLAYS.inc()
+                if born < program._mutations:
+                    _M_RECOMPILES_SAVED.inc()
+            outs = fn(*arrays)
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor._from_value(o) for o in outs]
@@ -393,6 +507,13 @@ class Executor:
     @staticmethod
     def _compile(program: Program, feed_names, fetch_vids,
                  donate: bool = False):
+        # snapshot: the replay closure reads the program at call time, and
+        # cache entries now outlive mutations (fingerprint keying), so the
+        # compiled executable must close over the structure it was
+        # compiled against, not whatever the program becomes later. Only
+        # the four fields replay()/_build_loss_fn() read are copied — a
+        # full clone() would pin _keepalive/_vid_by_obj per cache entry.
+        program = _ReplaySnapshot(program)
         name_to_vid = program._feed_names
 
         def replay(*feed_arrays):

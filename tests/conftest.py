@@ -57,7 +57,8 @@ def _registry_lint():
     spec = importlib.util.spec_from_file_location("_lint_registry", script)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    problems = mod.check_primitives() + mod.check_all_exports()
+    problems = (mod.check_primitives() + mod.check_all_exports()
+                + mod.check_metric_registry())
     if problems:
         pytest.fail(
             "tools/lint_registry.py checks found registry violations:\n"

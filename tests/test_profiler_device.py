@@ -310,3 +310,71 @@ class TestDeviceStatistics:
         assert op_class(top.name) in {
             "fusion", "matmul", "convolution", "custom-call (pallas)"}, \
             f"top device op is {top.name}"
+
+
+class TestProfilerEdgeCases:
+    """Empty traces and nested/unbalanced span closing (PR-2 satellites)."""
+
+    def test_summary_table_on_empty_trace(self):
+        from paddle_tpu.profiler import summary_table
+
+        table = summary_table([])
+        assert "Name" in table and "Calls" in table  # header renders
+
+    def test_statistic_from_trace_on_empty_trace(self, tmp_path):
+        from paddle_tpu.profiler import statistic_from_trace
+
+        path = tmp_path / "empty_trace.json"
+        path.write_text(json.dumps({"traceEvents": [],
+                                    "displayTimeUnit": "ms"}))
+        assert statistic_from_trace(str(path)) == {}
+        # bare-list export shape is accepted too
+        path.write_text("[]")
+        assert statistic_from_trace(str(path)) == {}
+
+    def test_nested_spans_close_in_order(self):
+        from paddle_tpu.profiler.host_tracer import get_host_tracer
+
+        tracer = get_host_tracer()
+        tracer.start()
+        outer = RecordEvent("outer")
+        outer.begin()
+        inner = RecordEvent("inner")
+        inner.begin()
+        inner.end()
+        outer.end()
+        (root,) = tracer.stop()
+        assert root.name == "outer"
+        (child,) = root.children
+        assert child.name == "inner"
+        assert child.children == []
+        # the child closed before (or with) its parent, inside its window
+        assert root.start_ns <= child.start_ns
+        assert child.end_ns <= root.end_ns
+
+    def test_unbalanced_close_does_not_corrupt_stack(self):
+        """Closing the OUTER span while the inner is still open (the
+        exception-path shape) must close the over-open inner span and
+        leave the tracer stack reusable."""
+        from paddle_tpu.profiler.host_tracer import get_host_tracer
+
+        tracer = get_host_tracer()
+        tracer.start()
+        outer = RecordEvent("outer_unbalanced")
+        outer.begin()
+        inner = RecordEvent("inner_leaked")
+        inner.begin()
+        outer.end()  # inner never explicitly ended
+        with RecordEvent("after"):
+            pass
+        roots = tracer.stop()
+        names = [r.name for r in roots]
+        assert names == ["outer_unbalanced", "after"]
+        (leaked,) = roots[0].children
+        assert leaked.name == "inner_leaked"
+
+    def test_sorted_keys_exported(self):
+        from paddle_tpu.profiler import SortedKeys
+
+        assert "SortedKeys" in profiler.__all__
+        assert SortedKeys.CPUTotal == 0 and SortedKeys.GPUMin == 7

@@ -14,7 +14,12 @@ that *defines* the ops. It verifies, over the fully-imported package:
    calling convention (``vjp(grads_out, saved, **static)``,
    ``save(arrays_in, outs)``);
 3. every name in each imported ``paddle_tpu`` module's ``__all__``
-   actually resolves on that module.
+   actually resolves on that module;
+4. every metric registered at import time in the observability registry
+   is unique, documented, matches the ``subsystem.noun_verb`` naming
+   scheme, and its subsystem prefix is claimed in
+   ``observability.metrics.CLAIMED_SUBSYSTEMS`` (the metric analog of
+   the ``PTLxxx`` diagnostic-code claiming convention).
 
 Exits non-zero listing every violation — wired into the test session via
 a session-scoped fixture in tests/conftest.py (skippable with
@@ -112,22 +117,62 @@ def check_all_exports() -> List[str]:
     return problems
 
 
+def check_metric_registry() -> List[str]:
+    from paddle_tpu import observability
+    from paddle_tpu.observability.metrics import (CLAIMED_SUBSYSTEMS,
+                                                  NAME_RE)
+
+    problems = []
+    # the registry is define-or-get, so a reused name silently SHARES one
+    # series family; uniqueness is audited via definition sites instead —
+    # a name claimed from two different modules is an accidental collision
+    for name, sites in sorted(observability.registry
+                              .definition_sites().items()):
+        if len(sites) > 1:
+            problems.append(
+                f"metric {name!r}: defined from {len(sites)} different "
+                f"modules ({', '.join(sites)}) — metric names are claimed "
+                f"per subsystem; pick a name under your own prefix")
+    for m in observability.registry:
+        if not NAME_RE.match(m.name):
+            problems.append(
+                f"metric {m.name!r}: does not match the "
+                f"'subsystem.noun_verb' naming scheme ({NAME_RE.pattern})")
+            continue
+        subsystem = m.name.split(".", 1)[0]
+        if subsystem not in CLAIMED_SUBSYSTEMS:
+            problems.append(
+                f"metric {m.name!r}: subsystem {subsystem!r} is not "
+                f"claimed in observability.metrics.CLAIMED_SUBSYSTEMS — "
+                f"claim the prefix next to your first metric (the PTLxxx "
+                f"code-claiming convention)")
+        if not m.doc:
+            problems.append(
+                f"metric {m.name!r}: registered without a doc string")
+    return problems
+
+
 def main(argv=None) -> int:
     import paddle_tpu  # noqa: F401 — populates the registry + sys.modules
     from paddle_tpu.core import dispatch
 
-    problems = check_primitives() + check_all_exports()
+    problems = (check_primitives() + check_all_exports()
+                + check_metric_registry())
     n_mods = sum(1 for m in sys.modules
                  if m == "paddle_tpu" or m.startswith("paddle_tpu."))
+    from paddle_tpu import observability
+
     if problems:
         print(f"lint_registry: {len(problems)} violation(s) over "
-              f"{len(dispatch.PRIMITIVES)} primitives / {n_mods} modules:",
+              f"{len(dispatch.PRIMITIVES)} primitives / {n_mods} modules / "
+              f"{len(observability.registry)} metrics:",
               file=sys.stderr)
         for p in problems:
             print(f"  - {p}", file=sys.stderr)
         return 1
     print(f"lint_registry: OK ({len(dispatch.PRIMITIVES)} primitives, "
-          f"{n_mods} modules audited)")
+          f"{n_mods} modules, {len(observability.registry)} metrics "
+          f"audited)")
     return 0
 
 

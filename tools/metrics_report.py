@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Render a paddle_tpu.observability metrics dump as a human report.
+
+Usage:
+    python tools/metrics_report.py metrics.json [--events N]
+
+The input is the JSON written by ``paddle_tpu.observability.dump(path)``
+or by running any workload with ``PADDLE_TPU_METRICS_DUMP=metrics.json``
+in the environment. Rendering goes through the same
+``observability.report.render_report`` the in-process ``summary()``
+uses, so the dump round-trips by construction. Exits non-zero on a file
+that is not a metrics dump.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dump", help="JSON file written by observability.dump()")
+    ap.add_argument("--events", type=int, default=20,
+                    help="how many trailing events to show (default 20)")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.dump) as f:
+            d = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"metrics_report: cannot read {args.dump!r}: {e}",
+              file=sys.stderr)
+        return 1
+
+    from paddle_tpu.observability.report import render_report
+
+    try:
+        report = render_report(d, max_events=args.events)
+    except ValueError as e:
+        print(f"metrics_report: {args.dump!r}: {e}", file=sys.stderr)
+        return 1
+    generated = d.get("generated_unix")
+    if generated:
+        import time
+
+        print(f"metrics dump v{d.get('version', '?')} generated "
+              f"{time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(generated))}"
+              f" (enabled={d.get('enabled')})\n")
+    print(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
