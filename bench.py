@@ -43,8 +43,22 @@ import numpy as np
 
 A100_MFU_BAR = 0.40
 
+# set by main() so _emit can attribute the measured MFU to the config
+# in the train.mfu gauge (the roll-up + flight recorder read it back)
+_BENCH_CONFIG = "bench"
+
 
 def _emit(metric, value, unit, mfu):
+    import paddle_tpu.observability as obs
+
+    if obs.enabled():
+        # the bench's measured MFU is the authoritative figure for this
+        # config: publish it through the metrics layer so the roll-up
+        # line and any flight dump carry it
+        obs.registry.get("train.mfu").set(round(float(mfu), 5),
+                                          name=_BENCH_CONFIG)
+        obs.emit("bench.result", config=_BENCH_CONFIG, unit=unit,
+                 value=round(float(value), 1), mfu=round(float(mfu), 4))
     print(json.dumps({
         "metric": metric,
         "value": round(float(value), 1),
@@ -54,18 +68,30 @@ def _emit(metric, value, unit, mfu):
 
 
 def _emit_metrics_block():
-    """One JSON line with the observability roll-up (compile count, cache
-    hit rate, retraces) printed next to the metric line of each config.
-    Requires --metrics (which enables paddle_tpu.observability)."""
+    """One JSON line with the observability roll-up (compile counts and
+    wall time, cache hit rate, retraces, measured MFU, HBM watermark)
+    printed next to the metric line of each config. Requires --metrics
+    (which enables paddle_tpu.observability)."""
     import paddle_tpu.observability as obs
 
     if not obs.enabled():
         return
+    obs.sample_device_memory()
     mets = obs.dump()["metrics"]
 
+    def series(name):
+        return mets.get(name, {}).get("series", [])
+
     def tot(name):
-        return sum(s.get("value", s.get("count", 0))
-                   for s in mets.get(name, {}).get("series", []))
+        return sum(s.get("value", s.get("count", 0)) for s in series(name))
+
+    def hist_sum(name):
+        return sum(s.get("sum", 0.0) for s in series(name))
+
+    def gauge_max(name):
+        vals = [s.get("value") for s in series(name)
+                if isinstance(s.get("value"), (int, float))]
+        return max(vals) if vals else None
 
     hits, misses = tot("dispatch.cache_hits"), tot("dispatch.cache_misses")
     print(json.dumps({"metrics": {
@@ -78,6 +104,14 @@ def _emit_metrics_block():
         "to_static_compiles": tot("jit.compiles"),
         "executor_compiles": tot("executor.compiles"),
         "executor_replays": tot("executor.replays"),
+        # ROADMAP open item: compile wall time in BENCH records
+        "executor_compile_seconds": round(hist_sum("executor.compile_seconds"), 3),
+        "jit_compile_seconds": round(hist_sum("jit.compile_seconds"), 3),
+        # step-telemetry roll-ups (observability.runtime)
+        "train_steps": tot("train.steps"),
+        "step_seconds_total": round(hist_sum("train.step_seconds"), 3),
+        "mfu": gauge_max("train.mfu"),
+        "hbm_watermark_bytes": gauge_max("device.hbm_watermark_bytes"),
     }}), flush=True)
 
 
@@ -144,9 +178,20 @@ def bench_llama(on_tpu, steps, warmup, peak_flops, profile=False):
         loss = train_step(ids, labels)
     float(loss)  # full sync (block_until_ready is a no-op when tunneled)
 
-    t0 = time.perf_counter()
+    # per-step spans feed train.step_seconds + the flight recorder; steps
+    # dispatch async so individual numbers skew dispatch-cheap/last-step-
+    # heavy — the authoritative MFU comes from the synced window below
+    import paddle_tpu.observability as obs
+    timer = (obs.StepTimer("llama", items_per_step=batch * seq,
+                           unit="tokens", sample_memory_every=0)
+             if obs.enabled() else None)  # keep the no-metrics timed
+    t0 = time.perf_counter()              # window identical to the seed
     for _ in range(steps):
-        loss = train_step(ids, labels)
+        if timer is None:
+            loss = train_step(ids, labels)
+        else:
+            with timer.region():
+                loss = train_step(ids, labels)
     float(loss)
     dt = time.perf_counter() - t0
 
@@ -693,6 +738,9 @@ def main():
     peak_flops = 197e12 if on_tpu else 1e12  # v5e bf16 peak
     steps = args.steps or (20 if on_tpu else 3)
     warmup = 3 if on_tpu else 1
+
+    global _BENCH_CONFIG
+    _BENCH_CONFIG = args.config
 
     if args.metrics:
         import paddle_tpu.observability as obs

@@ -169,6 +169,9 @@ define_flag("observability", False,
 define_flag("observability_max_events", 4096,
             "ring-buffer capacity of the observability structured-event "
             "log (oldest events drop first)")
+define_flag("observability_flight_events", 512,
+            "ring-buffer capacity of the flight recorder (last-N runtime "
+            "events serialized to PADDLE_TPU_FLIGHT_DIR on crash/timeout)")
 define_flag("use_pallas_flash_attention", True,
             "use the Pallas flash-attention kernel on TPU backends")
 define_flag("use_pallas_rms_norm", True,

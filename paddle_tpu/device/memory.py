@@ -18,11 +18,28 @@ def _device(device_id: Optional[int] = None):
 
 
 def memory_stats(device_id: Optional[int] = None) -> Dict[str, int]:
-    d = _device(device_id)
+    """Live allocator stats of one device; ``{}`` (never an exception)
+    when the platform reports none — CPU PJRT returns None, and a
+    missing/odd device_id must not crash telemetry samplers."""
     try:
+        d = _device(device_id)
         return dict(d.memory_stats() or {})
     except Exception:
         return {}
+
+
+def live_array_bytes() -> int:
+    """Sum of bytes held by live jax arrays in this process — the
+    backend-independent fallback for platforms whose PJRT client reports
+    no allocator stats (CPU). An under-count of true allocator usage
+    (no fragmentation, no runtime scratch) but moves with the workload."""
+    try:
+        import jax
+
+        return int(sum(int(getattr(a, "nbytes", 0))
+                       for a in jax.live_arrays()))
+    except Exception:
+        return 0
 
 
 def memory_allocated(device_id: Optional[int] = None) -> int:
@@ -68,8 +85,13 @@ def compiled_memory_stats(jitted_fn, *args) -> Dict[str, int]:
     analog of peeking allocator stats after a run, and the measurement
     the recompute pass is judged by (reference: the memory estimates in
     auto_parallel/static/cost_model used by auto_parallel_recompute)."""
-    compiled = jitted_fn.lower(*args).compile()
-    ma = compiled.memory_analysis()
+    try:
+        compiled = jitted_fn.lower(*args).compile()
+        ma = compiled.memory_analysis()
+    except Exception:
+        # telemetry surface: a backend without memory analysis (or a fn
+        # that won't lower at these args) yields {}, never an exception
+        return {}
     if ma is None:
         return {}
     return {

@@ -24,12 +24,102 @@ from typing import List, Optional
 import jax
 import jax.numpy as jnp
 
+from ... import observability as _obs
 from ...core.tensor import Tensor
 from ..auto_parallel.placement import Partial, Replicate, Shard
 from .group import (  # noqa: F401
     Group, destroy_process_group, get_backend, get_group, is_initialized,
     new_group,
 )
+
+# -- per-mesh collective telemetry (ROADMAP open item) -----------------------
+# Every series is labeled by op + group: the group label is the mesh axis
+# name when the Group wraps one (fleet tp/dp/pp axes), else "g<id>", else
+# "world" — so a dump separates tp-axis allgather traffic from dp-axis
+# allreduce traffic. tools/lint_registry.py rejects unlabeled series.
+_obs_state = _obs.state
+_M_COMM_CALLS = _obs.counter(
+    "comm.collective_calls",
+    "host-level collective API invocations, by op and group")
+_M_COMM_BYTES = _obs.counter(
+    "comm.collective_bytes",
+    "input payload bytes moved through collectives, by op and group")
+_M_COMM_SECONDS = _obs.histogram(
+    "comm.collective_seconds",
+    "host wall seconds inside a collective call (eager ops include the "
+    "device work; in-trace ops only the capture cost), by op and group")
+
+
+def _group_label(group) -> str:
+    if group is None:
+        return "world"
+    axis = getattr(group, "axis_name", None)
+    return axis if axis else f"g{group.id}"
+
+
+def _payload_bytes(obj) -> int:
+    """Byte size of a collective's input payload: Tensors (eager or
+    tracer — avals still carry shape/dtype) and lists thereof; 0 for
+    anything unsized."""
+    try:
+        if isinstance(obj, (list, tuple)):
+            return sum(_payload_bytes(t) for t in obj)
+        v = obj._value if isinstance(obj, Tensor) else obj
+        aval = getattr(v, "aval", v)
+        import numpy as _np
+
+        return int(_np.prod(aval.shape)) * _np.dtype(aval.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def _record_collective(op: str, group, nbytes: int, seconds: float):
+    labels = {"op": op, "group": _group_label(group)}
+    _M_COMM_CALLS.inc(**labels)
+    if nbytes:
+        _M_COMM_BYTES.inc(nbytes, **labels)
+    _M_COMM_SECONDS.observe(seconds, **labels)
+    _obs.emit("comm.collective", seconds=seconds, bytes=nbytes, **labels)
+
+
+def _instrumented(op: str, payload_arg: int = 0):
+    """Wrap a collective so that, with observability on, each call records
+    calls/bytes/seconds labeled op+group. ``payload_arg`` indexes the
+    positional argument whose bytes count as the payload. Disabled path:
+    one attribute load and a truth test."""
+    def deco(fn):
+        import functools
+        import inspect
+        import time as _time
+
+        try:
+            payload_name = list(inspect.signature(fn).parameters)[payload_arg]
+        except Exception:
+            payload_name = None
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _obs_state.on:
+                return fn(*args, **kwargs)
+            group = kwargs.get("group")
+            if group is None:
+                for a in args:
+                    if isinstance(a, Group):
+                        group = a
+                        break
+            payload = (args[payload_arg] if len(args) > payload_arg
+                       else kwargs.get(payload_name))
+            nbytes = _payload_bytes(payload)
+            t0 = _time.perf_counter()
+            out = fn(*args, **kwargs)
+            _record_collective(op, group, nbytes,
+                               _time.perf_counter() - t0)
+            return out
+
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    return deco
 
 __all__ = [
     "all_reduce", "all_gather", "all_gather_object", "all_to_all",
@@ -87,6 +177,7 @@ def _process_allgather(value):
     return np.asarray(multihost_utils.process_allgather(np.asarray(value)))
 
 
+@_instrumented("all_reduce")
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
                sync_op=True):
     """paddle.distributed.all_reduce parity (communication/all_reduce.py).
@@ -129,6 +220,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     return tensor
 
 
+@_instrumented("all_gather", payload_arg=1)
 def all_gather(tensor_list: List[Tensor], tensor: Tensor,
                group: Optional[Group] = None, sync_op=True):
     """paddle.distributed.all_gather parity: fills tensor_list with each
@@ -161,6 +253,7 @@ def all_gather(tensor_list: List[Tensor], tensor: Tensor,
     return tensor_list
 
 
+@_instrumented("all_gather_object", payload_arg=1)
 def all_gather_object(object_list: List, obj, group=None):
     if _live_world() > 1:
         object_list.clear()
@@ -171,6 +264,7 @@ def all_gather_object(object_list: List, obj, group=None):
     return object_list
 
 
+@_instrumented("reduce_scatter", payload_arg=1)
 def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
                    group=None, sync_op=True):
     """Multi-process: all_reduce the concatenated input, keep this rank's
@@ -184,7 +278,10 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
     world = _live_world()
     if world > 1:
         reduced = Tensor._from_value(src._value)
-        all_reduce(reduced, op=op)
+        # bypass all_reduce's instrumentation: this call is the transport
+        # of the reduce_scatter already recorded by our own wrapper, not a
+        # second user-visible collective
+        all_reduce.__wrapped__(reduced, op=op)
         me = jax.process_index()
         n = tensor._value.shape[0]
         tensor._replace_value(reduced._value[me * n:(me + 1) * n])
@@ -193,6 +290,7 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
     return tensor
 
 
+@_instrumented("all_to_all", payload_arg=1)
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     """out[j] on rank r = rank j's in[r]. Multi-process: gather every
     rank's input stack, pick this rank's column. Single-process world:
@@ -214,6 +312,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     return out_tensor_list
 
 
+@_instrumented("all_to_all_single", payload_arg=1)
 def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
                       in_split_sizes=None, group=None, sync_op=True):
     world = _live_world()
@@ -235,6 +334,7 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
     return out_tensor
 
 
+@_instrumented("broadcast")
 def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
     if _is_tracer(tensor) or tensor._dist_attr is not None:
         return tensor
@@ -265,6 +365,7 @@ def _object_allgather(obj):
             for r in range(gathered.shape[0])]
 
 
+@_instrumented("broadcast_object_list")
 def broadcast_object_list(object_list, src=0, group=None):
     if _live_world() > 1:
         objs = _object_allgather(list(object_list))[src]
@@ -272,11 +373,13 @@ def broadcast_object_list(object_list, src=0, group=None):
     return object_list
 
 
+@_instrumented("reduce")
 def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None,
            sync_op=True):
-    return all_reduce(tensor, op, group)
+    return all_reduce.__wrapped__(tensor, op, group)
 
 
+@_instrumented("scatter", payload_arg=1)
 def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
     world = _live_world()
     if world > 1:
@@ -292,6 +395,7 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return tensor
 
 
+@_instrumented("gather")
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     world = _live_world()
     if world > 1:
@@ -340,6 +444,7 @@ def wait(tensor, group=None, use_calc_stream=True):
         jax.block_until_ready(tensor._value)
 
 
+@_instrumented("barrier")
 def barrier(group=None):
     from .. import env
 

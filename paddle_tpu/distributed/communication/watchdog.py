@@ -18,9 +18,30 @@ import time
 import warnings
 from typing import Dict, Optional
 
+from ... import observability as _obs
 from ...core import flags
 
 __all__ = ["CommTask", "CommTaskManager", "get_comm_task_manager"]
+
+# overdue tasks surface as metrics (not just log lines): comm.task_overdue
+# is the alert series an operator watches, comm.task_seconds the latency
+# distribution of every registered wait. An overdue task also writes the
+# flight-recorder post-mortem — a watchdog timeout IS the multi-chip
+# "job died" moment the recorder exists for.
+_M_TASKS = _obs.counter(
+    "comm.tasks_started",
+    "communication/coordination waits registered with the watchdog, by "
+    "task name")
+_M_TASK_SECONDS = _obs.histogram(
+    "comm.task_seconds",
+    "wall seconds a registered comm task stayed in flight, by task name")
+_M_TASK_OVERDUE = _obs.counter(
+    "comm.task_overdue",
+    "watchdog detections of a task outliving its timeout (each task "
+    "counts once), by task name")
+_M_SCANS = _obs.counter(
+    "comm.watchdog_scans",
+    "watchdog scan-loop passes over the in-flight task table")
 
 
 class CommTask:
@@ -64,6 +85,8 @@ class CommTaskManager:
             self._seq += 1
             tid = self._seq
             self._tasks[tid] = task
+        if _obs.state.on:
+            _M_TASKS.inc(name=name)
         self._ensure_thread()
         return tid
 
@@ -72,6 +95,8 @@ class CommTaskManager:
             task = self._tasks.pop(tid, None)
             if task is not None:
                 task.done = True
+        if task is not None and _obs.state.on:
+            _M_TASK_SECONDS.observe(task.elapsed_s(), name=task.name)
 
     def task(self, name: str, timeout_s: Optional[float] = None):
         """Context manager form: with manager.task('barrier'): ..."""
@@ -99,6 +124,8 @@ class CommTaskManager:
         while not self._stop.wait(self._scan_interval_s):
             with self._lock:
                 tasks = list(self._tasks.values())
+            if _obs.state.on:
+                _M_SCANS.inc()
             if not tasks:
                 continue
             for t in tasks:
@@ -109,7 +136,22 @@ class CommTaskManager:
                            f"(timeout {t.timeout_s:.0f}s) — probable "
                            f"distributed hang")
                     self._overdue_log.append(msg)
+                    # warn before flipping the metric: pollers treat
+                    # comm.task_overdue as "the alert already happened"
                     warnings.warn(msg)
+                    if _obs.state.on:
+                        _obs.emit("comm.task_overdue", name=t.name,
+                                  elapsed_s=round(t.elapsed_s(), 3),
+                                  timeout_s=t.timeout_s)
+                        # inc before the dump so the post-mortem's metric
+                        # snapshot shows the overdue counter that fired it
+                        _M_TASK_OVERDUE.inc(name=t.name)
+                        # the post-mortem moment: a distributed wait blew
+                        # its deadline, dump the flight ring while the
+                        # process is still alive to write it
+                        _obs.flight.recorder.dump(
+                            "watchdog_timeout",
+                            TimeoutError(msg))
 
     def overdue_tasks(self):
         with self._lock:

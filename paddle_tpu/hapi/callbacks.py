@@ -14,7 +14,8 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "VisualDL", "config_callbacks"]
+           "EarlyStopping", "VisualDL", "MetricsCallback",
+           "config_callbacks"]
 
 
 class Callback:
@@ -38,6 +39,7 @@ class Callback:
     def on_epoch_end(self, epoch, logs=None): ...
     def on_train_batch_begin(self, step, logs=None): ...
     def on_train_batch_end(self, step, logs=None): ...
+    def on_train_abort(self, exc=None): ...
     def on_eval_batch_begin(self, step, logs=None): ...
     def on_eval_batch_end(self, step, logs=None): ...
     def on_predict_batch_begin(self, step, logs=None): ...
@@ -186,6 +188,71 @@ class EarlyStopping(Callback):
             if self.verbose:
                 print(f"EarlyStopping: stop, best {self.monitor}="
                       f"{self.best_value:.5f}")
+
+
+class MetricsCallback(Callback):
+    """Streams ``Model.fit`` step telemetry through
+    ``paddle_tpu.observability``: every train batch is bracketed by a
+    :class:`~paddle_tpu.observability.StepTimer` region, recording
+    ``train.step_seconds``, ``train.items_per_second`` (samples/sec from
+    the loop's ``batch_size`` log) and — when ``flops_per_step`` is
+    given — ``train.mfu``; device memory gauges are sampled every
+    ``sample_memory_every`` steps. No-op while observability is
+    disabled, so it is safe to leave in production callback lists.
+
+    ``flops_per_step`` can be a number or a zero-arg callable evaluated
+    lazily at train begin (e.g. ``lambda:
+    obs.measure_step_flops(step_fn, *sample_batch)``).
+    """
+
+    def __init__(self, name="fit", flops_per_step=None, peak_flops=None,
+                 sample_memory_every=16, unit="samples"):
+        super().__init__()
+        self._name = name
+        self._flops = flops_per_step
+        self._peak = peak_flops
+        self._every = sample_memory_every
+        self._unit = unit
+        self._timer = None
+
+    def on_train_begin(self, logs=None):
+        import paddle_tpu.observability as obs
+
+        if not obs.enabled():
+            # stay a true no-op: in particular don't evaluate a
+            # flops_per_step callable (it may XLA-compile the step fn)
+            self._timer = None
+            return
+        flops = self._flops() if callable(self._flops) else self._flops
+        self._timer = obs.StepTimer(
+            self._name, flops_per_step=flops, peak_flops=self._peak,
+            unit=self._unit, sample_memory_every=self._every)
+
+    def on_train_batch_begin(self, step, logs=None):
+        if self._timer is not None:
+            self._timer.begin()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._timer is not None:
+            self._timer.end(items=(logs or {}).get("batch_size") or None)
+
+    def on_train_abort(self, exc=None):
+        # fit died between batch-begin and batch-end: close the open
+        # region as failed so the span stack stays balanced and the
+        # step_exception flight dump is written even when the caller
+        # catches the exception (no excepthook fires then)
+        if self._timer is not None:
+            self._timer.end(failed=True)
+        self._timer = None
+
+    def on_train_end(self, logs=None):
+        import paddle_tpu.observability as obs
+
+        if self._timer is not None:
+            self._timer.abandon()  # batch-end never came for an open step
+            if obs.enabled():
+                obs.sample_device_memory()
+        self._timer = None
 
 
 class VisualDL(Callback):

@@ -146,6 +146,16 @@ class Model:
             save_dir=save_dir, metrics=[m.name() for m in self._metrics])
         self.stop_training = False
         cbks.on_train_begin()
+        try:
+            self._fit_loop(loader, eval_loader, cbks, epochs, eval_freq,
+                           num_iters, accumulate_grad_batches)
+        except BaseException as e:
+            cbks.on_train_abort(e)
+            raise
+        cbks.on_train_end()
+
+    def _fit_loop(self, loader, eval_loader, cbks, epochs, eval_freq,
+                  num_iters, accumulate_grad_batches):
         for epoch in range(epochs):
             for m in self._metrics:
                 m.reset()
@@ -180,7 +190,6 @@ class Model:
                 self._run_eval(eval_loader, cbks)
             if self.stop_training:
                 break
-        cbks.on_train_end()
 
     def _run_eval(self, loader, cbks):
         for m in self._metrics:

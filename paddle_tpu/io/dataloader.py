@@ -17,8 +17,34 @@ from typing import Any, Callable, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from .. import observability as _obs
 from ..core import generator
 from ..core.tensor import Tensor
+
+# -- prefetch-ring telemetry (ROADMAP open item) ----------------------------
+# queue_depth is sampled at every consumer pop (how many batches were
+# ready = how far ahead the producers run); wait_seconds is the time the
+# training loop spent blocked on input — the "is the step loop
+# input-bound?" gauge. Labeled by ring: python (thread prefetcher),
+# native (csrc ring), mp (worker processes).
+_obs_state = _obs.state
+_M_QUEUE_DEPTH = _obs.gauge(
+    "io.queue_depth",
+    "prefetched batches ready at the last consumer pop, by ring "
+    "(python | native | mp)")
+_M_WAIT_SECONDS = _obs.histogram(
+    "io.wait_seconds",
+    "wall seconds the consumer blocked waiting for the next batch, by "
+    "ring (python | native | mp)")
+_M_BATCHES = _obs.counter(
+    "io.batches_delivered",
+    "batches handed to the training loop, by ring (python | native | mp)")
+
+
+def _record_pop(ring: str, depth: int, waited: float):
+    _M_QUEUE_DEPTH.set(depth, ring=ring)
+    _M_WAIT_SECONDS.observe(waited, ring=ring)
+    _M_BATCHES.inc(ring=ring)
 
 
 class Dataset:
@@ -370,16 +396,26 @@ class _PrefetchIter:
         return self
 
     def __next__(self):
+        import time as _time
+
+        rec = _obs_state.on  # latch: obs toggled mid-pop must not record
+        t0 = _time.perf_counter() if rec else 0.0
         if self.nq is not None:
             import pickle
 
             item = self.nq.pop()
             if item is None or item[:1] == b"D":
                 raise StopIteration
+            if rec:
+                _record_pop("native", len(self.nq),
+                            _time.perf_counter() - t0)
             return _tensorize(pickle.loads(item[1:]))
         item = self.q.get()
         if item is self.done:
             raise StopIteration
+        if rec:
+            _record_pop("python", self.q.qsize(),
+                        _time.perf_counter() - t0)
         return item
 
     def __del__(self):
@@ -447,11 +483,14 @@ class _MultiprocessIter:
 
     def __next__(self):
         import queue as _q
+        import time as _time
 
         if self._next_seq >= self._sent and self._exhausted:
             if not self.persistent:
                 self._shutdown()
             raise StopIteration
+        rec = _obs_state.on  # latch: obs toggled mid-pop must not record
+        t0 = _time.perf_counter() if rec else 0.0
         stalled = 0.0
         while self._next_seq not in self._pending:
             try:
@@ -481,6 +520,11 @@ class _MultiprocessIter:
             self._pending[seq] = batch
         batch = self._pending.pop(self._next_seq)
         self._next_seq += 1
+        if rec:
+            # depth = out-of-order arrivals already reassembled and
+            # waiting, i.e. how far ahead the worker pool runs
+            _record_pop("mp", len(self._pending),
+                        _time.perf_counter() - t0)
         self._fill()
         return _tensorize(batch)
 

@@ -48,15 +48,22 @@ from ._gate import state
 from .metrics import (CLAIMED_SUBSYSTEMS, Counter, Gauge, Histogram,
                       MetricsRegistry, NAME_RE, registry)
 from .events import Event, emit, events, span
-from .report import dump, dump_dict, render_report, summary
+from .report import (dump, dump_dict, render_flight, render_report,
+                     summary)
+from . import flight
+from .flight import FlightRecorder
+from .runtime import (StepTimer, default_peak_flops, measure_step_flops,
+                      sample_device_memory, step_region)
 
 __all__ = [
     "state", "enabled", "enable", "disable", "reset",
     "registry", "counter", "gauge", "histogram",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "Event", "emit", "events", "span",
-    "dump", "dump_dict", "render_report", "summary",
+    "dump", "dump_dict", "render_report", "render_flight", "summary",
     "CLAIMED_SUBSYSTEMS", "NAME_RE",
+    "flight", "FlightRecorder", "StepTimer", "step_region",
+    "sample_device_memory", "measure_step_flops", "default_peak_flops",
 ]
 
 counter = registry.counter
@@ -71,6 +78,11 @@ def enabled() -> bool:
 def enable():
     """Turn on metric/event recording at the instrumentation sites."""
     state.on = True
+    # arm the crash-dump hook too (idempotent): it still no-ops at fire
+    # time unless PADDLE_TPU_FLIGHT_DIR is set, but a process that
+    # enables observability after import must not lose the headline
+    # unhandled-exception dump
+    flight.install_excepthook()
 
 
 def disable():
@@ -87,11 +99,15 @@ def add_reset_hook(fn):
 
 
 def reset():
-    """Zero all metric series, drop buffered events, run reset hooks."""
+    """Zero all metric series, drop buffered events (both rings), run
+    reset hooks."""
     registry.reset()
     from .events import clear as _clear_events
+    from .runtime import _clear_watermarks
 
     _clear_events()
+    flight.recorder.clear()
+    _clear_watermarks()
     for fn in _reset_hooks:
         fn()
 
@@ -107,6 +123,11 @@ def _init_from_env():
     if os.environ.get("PADDLE_TPU_METRICS_DUMP"):
         state.on = True
         atexit.register(dump)
+    if os.environ.get(flight.FLIGHT_DIR_ENV):
+        # a configured crash-dump dir implies recording (same convention
+        # as PADDLE_TPU_METRICS_DUMP) and arms the excepthook
+        state.on = True
+        flight.install_excepthook()
 
 
 _init_from_env()

@@ -16,7 +16,7 @@ import collections
 import time
 from typing import Any, Dict, List, Optional
 
-from . import _gate
+from . import _gate, flight
 from .metrics import Histogram
 
 #: ring-buffer capacity; read once from core.flags at first use so the
@@ -52,10 +52,16 @@ class Event:
 
 
 def emit(kind: str, **fields):
-    """Record a structured event (no-op while observability is off)."""
+    """Record a structured event (no-op while observability is off).
+
+    The event lands in two rings: the large export buffer read by
+    ``observability.dump()`` and the smaller flight-recorder ring that
+    survives into crash dumps (see ``observability.flight``)."""
     if not _gate.state.on:
         return
-    _buffer().append(Event(kind, fields))
+    ev = Event(kind, fields)
+    _buffer().append(ev)
+    flight.recorder.record(kind, fields, ts=ev.ts)
 
 
 def events(kind: Optional[str] = None) -> List[Event]:

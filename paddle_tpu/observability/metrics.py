@@ -35,6 +35,10 @@ CLAIMED_SUBSYSTEMS = {
     "jit",         # jit/__init__.py — to_static compile cache
     "bench",       # bench.py — benchmark-side metrics
     "profiler",    # profiler/ — tracer self-metrics
+    "train",       # observability/runtime.py — step seconds/throughput/MFU
+    "device",      # observability/runtime.py — HBM gauges (device/memory.py)
+    "comm",        # distributed/communication — collectives + watchdog
+    "io",          # io/dataloader.py — prefetch queue depth / wait time
     "test",        # scratch names registered by the test suite
 }
 

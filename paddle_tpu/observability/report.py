@@ -3,14 +3,21 @@
 ``dump()`` serializes the whole registry plus the event ring buffer into
 one JSON document; ``render_report()`` turns that document (live or
 re-loaded from disk — ``tools/metrics_report.py``) into the human table,
-so the dump round-trips by construction.
+so the dump round-trips by construction. ``render_flight()`` does the
+same for flight-recorder crash dumps (``observability.flight``).
+
+Rows are grouped by subsystem (the ``<subsystem>.`` metric-name prefix),
+value columns are unit-aware (``*_seconds`` renders ms/s, ``*_bytes``
+renders KiB/MiB/GiB, everything else raw), and ``top=N`` keeps only the
+N largest series per metric — the shape a human scans when a dump has
+hundreds of labeled series.
 """
 from __future__ import annotations
 
 import json
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from . import _gate
 from .events import events as _list_events
@@ -55,56 +62,191 @@ def _fmt_secs(s: float) -> str:
     return f"{s * 1e3:.3f}ms" if s < 1.0 else f"{s:.3f}s"
 
 
-def render_report(d: Dict[str, Any], max_events: int = 20) -> str:
-    """Human table over a dump dict (live or loaded from a JSON file)."""
+def _fmt_bytes(b: float) -> str:
+    b = float(b)
+    for unit, div in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if abs(b) >= div:
+            return f"{b / div:.2f}{unit}"
+    return f"{b:.0f}B"
+
+
+def _fmt_raw(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _value_formatter(name: str):
+    """Unit from the metric-name suffix (the `noun_verb` convention makes
+    `_seconds` / `_bytes` the unit authority)."""
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf.endswith("seconds"):
+        return _fmt_secs
+    if leaf.endswith("bytes"):
+        return _fmt_bytes
+    return _fmt_raw
+
+
+_WIDTH = 64
+
+
+def _trim(rows: List, top: Optional[int]) -> Tuple[List, int]:
+    """Keep the ``top`` largest rows (rows pre-sorted desc); returns
+    (kept, dropped_count)."""
+    if top is None or top <= 0 or len(rows) <= top:
+        return rows, 0
+    return rows[:top], len(rows) - top
+
+
+def render_report(d: Dict[str, Any], max_events: int = 20,
+                  top: Optional[int] = None) -> str:
+    """Human table over a dump dict (live or loaded from a JSON file),
+    grouped by metric subsystem. ``top`` keeps only the N largest series
+    per metric (by count/value)."""
     metrics = d.get("metrics", {}) if isinstance(d, dict) else None
     if not isinstance(metrics, dict):
         raise ValueError("not a metrics dump: no 'metrics' mapping")
-    counters, gauges, hists = [], [], []
+
+    # subsystem -> list of (kind, row...) preserving per-metric blocks
+    groups: Dict[str, Dict[str, List]] = {}
     for name in sorted(metrics):
         m = metrics[name]
         kind = m.get("kind")
-        for s in m.get("series", []):
-            row_name = name + _fmt_labels(s.get("labels", {}))
-            if kind == "counter":
-                counters.append((row_name, s["value"]))
-            elif kind == "gauge":
-                gauges.append((row_name, s["value"]))
-            elif kind == "histogram":
+        sub = name.split(".", 1)[0]
+        g = groups.setdefault(sub, {"counter": [], "gauge": [],
+                                    "histogram": []})
+        fmt = _value_formatter(name)
+        series = m.get("series", [])
+        if kind == "counter":
+            rows = sorted(
+                ((name + _fmt_labels(s.get("labels", {})),
+                  s.get("value", 0)) for s in series),
+                key=lambda r: -_as_num(r[1]))
+            rows, dropped = _trim(rows, top)
+            g["counter"] += [(n, fmt(v)) for n, v in rows]
+            if dropped:
+                g["counter"].append((f"  ... {dropped} more series", ""))
+        elif kind == "gauge":
+            rows = sorted(
+                ((name + _fmt_labels(s.get("labels", {})),
+                  s.get("value")) for s in series),
+                key=lambda r: -_as_num(r[1]))
+            rows, dropped = _trim(rows, top)
+            g["gauge"] += [(n, fmt(v) if v is not None else "-")
+                           for n, v in rows]
+            if dropped:
+                g["gauge"].append((f"  ... {dropped} more series", ""))
+        elif kind == "histogram":
+            rows = []
+            for s in series:
                 cnt = s.get("count", 0)
-                avg = s.get("sum", 0.0) / cnt if cnt else 0.0
-                hists.append((row_name, cnt, s.get("sum", 0.0), avg,
-                              s.get("max", 0.0)))
+                total = s.get("sum", 0.0) or 0.0
+                avg = total / cnt if cnt else 0.0
+                rows.append((name + _fmt_labels(s.get("labels", {})),
+                             cnt, total, avg, s.get("max", 0.0) or 0.0))
+            rows.sort(key=lambda r: -_as_num(r[1]))
+            rows, dropped = _trim(rows, top)
+            g["histogram"] += [
+                (n, str(c), fmt(t), fmt(a), fmt(mx))
+                for n, c, t, a, mx in rows]
+            if dropped:
+                g["histogram"].append(
+                    (f"  ... {dropped} more series", "", "", "", ""))
+
     lines: List[str] = []
-    width = 64
-    if counters:
-        lines += ["Counters", "-" * (width + 14)]
-        lines += [f"{n[:width]:<{width}}{v:>14}" for n, v in counters]
-    if gauges:
-        lines += ["", "Gauges", "-" * (width + 14)]
-        lines += [f"{n[:width]:<{width}}{str(v):>14}" for n, v in gauges]
-    if hists:
-        header = (f"{'Histogram':<{width}}{'Count':>8}{'Total':>12}"
-                  f"{'Avg':>12}{'Max':>12}")
-        lines += ["", header, "-" * len(header)]
-        lines += [f"{n[:width]:<{width}}{c:>8}{_fmt_secs(t):>12}"
-                  f"{_fmt_secs(a):>12}{_fmt_secs(mx):>12}"
-                  for n, c, t, a, mx in hists]
-    evs = d.get("events", [])
-    if evs:
-        lines += ["", f"Events (last {min(max_events, len(evs))} of "
-                      f"{len(evs)})", "-" * (width + 14)]
-        for e in evs[-max_events:]:
-            e = dict(e)
-            ts, kind = e.pop("ts", 0.0), e.pop("kind", "?")
-            fields = " ".join(f"{k}={v}" for k, v in e.items())
-            lines.append(f"{time.strftime('%H:%M:%S', time.localtime(ts))} "
-                         f"{kind}: {fields}")
+    for sub in sorted(groups):
+        g = groups[sub]
+        if not (g["counter"] or g["gauge"] or g["histogram"]):
+            continue
+        if lines:
+            lines.append("")
+        lines.append(f"=== {sub} ===")
+        if g["counter"]:
+            lines += ["Counters", "-" * (_WIDTH + 14)]
+            lines += [f"{n[:_WIDTH]:<{_WIDTH}}{v:>14}"
+                      for n, v in g["counter"]]
+        if g["gauge"]:
+            if g["counter"]:
+                lines.append("")
+            lines += ["Gauges", "-" * (_WIDTH + 14)]
+            lines += [f"{n[:_WIDTH]:<{_WIDTH}}{v:>14}"
+                      for n, v in g["gauge"]]
+        if g["histogram"]:
+            if g["counter"] or g["gauge"]:
+                lines.append("")
+            header = (f"{'Histogram':<{_WIDTH}}{'Count':>8}{'Total':>12}"
+                      f"{'Avg':>12}{'Max':>12}")
+            lines += [header, "-" * len(header)]
+            lines += [f"{n[:_WIDTH]:<{_WIDTH}}{c:>8}{t:>12}{a:>12}{mx:>12}"
+                      for n, c, t, a, mx in g["histogram"]]
+    lines_events = _render_events(d.get("events", []), max_events)
+    if lines_events:
+        if lines:
+            lines.append("")
+        lines += lines_events
     if not lines:
         lines = ["(no metrics recorded)"]
     return "\n".join(lines)
 
 
-def summary(max_events: int = 20) -> str:
+def _as_num(v) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _render_events(evs: List[Dict[str, Any]], max_events: int) -> List[str]:
+    if not evs or max_events <= 0:
+        return []
+    lines = [f"Events (last {min(max_events, len(evs))} of {len(evs)})",
+             "-" * (_WIDTH + 14)]
+    for e in evs[-max_events:]:
+        e = dict(e)
+        ts, kind = e.pop("ts", 0.0), e.pop("kind", "?")
+        fields = " ".join(f"{k}={v}" for k, v in e.items())
+        lines.append(f"{time.strftime('%H:%M:%S', time.localtime(_as_num(ts)))} "
+                     f"{kind}: {fields}")
+    return lines
+
+
+def render_flight(d: Dict[str, Any], max_events: int = 50,
+                  top: Optional[int] = None) -> str:
+    """Human rendering of a flight-recorder crash dump
+    (``observability.flight.FlightRecorder.dump``): the post-mortem
+    header (reason, pid, exception), the last-N event trail, then the
+    metrics snapshot through the normal grouped renderer."""
+    from .flight import FLIGHT_DUMP_KIND
+
+    if not isinstance(d, dict) or d.get("kind") != FLIGHT_DUMP_KIND:
+        raise ValueError("not a flight-recorder dump: kind != "
+                         f"{FLIGHT_DUMP_KIND!r}")
+    lines = [f"FLIGHT RECORDER DUMP — reason: {d.get('reason', '?')}",
+             f"pid {d.get('pid', '?')}  generated "
+             + time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(_as_num(d.get("generated_unix",
+                                                          0))))]
+    mem = d.get("device_memory")
+    if mem:
+        lines.append(
+            "device memory: "
+            f"in_use={_fmt_bytes(mem.get('bytes_in_use', 0))} "
+            f"watermark={_fmt_bytes(mem.get('watermark_bytes', 0))} "
+            f"limit={_fmt_bytes(mem.get('bytes_limit', 0))}")
+    exc = d.get("exception")
+    if exc:
+        lines += ["", f"exception: {exc.get('type')}: {exc.get('message')}"]
+        tb = exc.get("traceback") or []
+        lines += [ln.rstrip("\n") for ln in tb]
+    lines.append("")
+    ev_lines = _render_events(d.get("events", []), max_events)
+    lines += ev_lines if ev_lines else ["(empty event ring)"]
+    if d.get("metrics"):
+        lines += ["", render_report({"metrics": d["metrics"]},
+                                    max_events=0, top=top)]
+    return "\n".join(lines)
+
+
+def summary(max_events: int = 20, top: Optional[int] = None) -> str:
     """Human-readable table over the live registry."""
-    return render_report(dump_dict(), max_events=max_events)
+    return render_report(dump_dict(), max_events=max_events, top=top)

@@ -336,3 +336,12 @@ class TestBenchMetricsSmoke:
         assert metrics["to_static_compiles"] >= 1
         assert metrics["jit_cache_misses"] >= 1
         assert 0.0 <= metrics["cache_hit_rate"] <= 1.0
+        # step-telemetry roll-ups (observability.runtime): the bench
+        # publishes its measured MFU through train.mfu, brackets each
+        # timed step with StepTimer, and samples the HBM gauges
+        assert metrics["jit_compile_seconds"] > 0
+        assert metrics["train_steps"] >= 1
+        assert metrics["step_seconds_total"] > 0
+        assert 0.0 < metrics["mfu"] <= 1.0
+        assert metrics["hbm_watermark_bytes"] > 0
+        assert "executor_compile_seconds" in metrics

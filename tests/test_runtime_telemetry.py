@@ -1,0 +1,456 @@
+"""Tests: step-level training telemetry (observability.runtime), device
+memory gauges + CPU fallbacks, per-mesh collective counters, watchdog
+metrics, dataloader queue gauges, and the flight recorder."""
+import importlib.util
+import json
+import os
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as obs
+from paddle_tpu.device import memory as dev_mem
+
+REPO_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"_{name}", os.path.join(REPO_ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def obs_on():
+    obs.reset()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+@pytest.fixture
+def flight_dir(tmp_path, monkeypatch):
+    d = tmp_path / "flight"
+    monkeypatch.setenv(obs.flight.FLIGHT_DIR_ENV, str(d))
+    yield d
+
+
+class TestStepRegion:
+    def test_records_seconds_items_and_mfu(self, obs_on):
+        with obs.step_region("probe", step=0, items=1000, unit="tokens",
+                             flops=5e9, peak_flops=1e12) as r:
+            time.sleep(0.005)
+        g = obs.registry.get
+        assert g("train.step_seconds").stats(name="probe")["count"] == 1
+        assert g("train.steps").value(name="probe") == 1
+        ips = g("train.items_per_second").value(name="probe", unit="tokens")
+        assert ips == pytest.approx(1000 / r.seconds)
+        mfu = g("train.mfu").value(name="probe")
+        assert mfu == pytest.approx(5e9 / r.seconds / 1e12, rel=1e-3)
+        assert 0 < mfu < 1
+        (ev,) = obs.events("train.step")
+        assert ev.fields["name"] == "probe"
+        assert ev.fields["step"] == 0
+        assert ev.fields["mfu"] == pytest.approx(mfu, rel=1e-3)
+        assert ev.fields["tokens_per_second"] > 0
+
+    def test_extra_fields_ride_the_event(self, obs_on):
+        with obs.step_region("probe", epoch=3, shard="dp0"):
+            pass
+        (ev,) = obs.events("train.step")
+        assert ev.fields["epoch"] == 3 and ev.fields["shard"] == "dp0"
+
+    def test_disabled_is_allocation_free(self):
+        obs.reset()
+        obs.disable()
+        with obs.step_region("probe", items=10, flops=1e9):
+            pass
+        assert obs.registry.get("train.step_seconds").to_dict()["series"] == []
+        assert obs.events() == []
+        assert obs.flight.recorder.snapshot() == []
+
+    def test_step_timer_counts_and_samples_memory(self, obs_on):
+        t = obs.StepTimer("loop", items_per_step=64, unit="samples",
+                          flops_per_step=1e6, peak_flops=1e12,
+                          sample_memory_every=2)
+        for _ in range(4):
+            with t.region():
+                pass
+        assert t.count == 4
+        g = obs.registry.get
+        assert g("train.steps").value(name="loop") == 4
+        # steps 0 and 2 sampled memory
+        assert g("device.hbm_bytes_in_use").value(device="0") is not None
+        steps = [e.fields["step"] for e in obs.events("train.step")]
+        assert steps == [0, 1, 2, 3]
+
+    def test_step_timer_begin_end_split_form(self, obs_on):
+        t = obs.StepTimer("cbk", unit="samples", sample_memory_every=0)
+        t.begin()
+        t.end(items=32)
+        assert obs.registry.get("train.steps").value(name="cbk") == 1
+        assert obs.registry.get("train.items_per_second").value(
+            name="cbk", unit="samples") > 0
+        t.end()  # end without begin is a no-op, not an error
+
+    def test_measure_step_flops_from_cost_analysis(self, obs_on):
+        import jax.numpy as jnp
+
+        def f(a, b):
+            return a @ b
+
+        x = jnp.ones((64, 64), jnp.float32)
+        flops = obs.measure_step_flops(f, x, x)
+        # 2*M*N*K = 524288; cost analysis reports the post-fusion figure
+        assert flops > 0
+
+    def test_measure_step_flops_never_raises(self, obs_on):
+        assert obs.measure_step_flops(lambda: None) == 0
+
+
+class TestDeviceMemory:
+    def test_memory_stats_well_formed_on_cpu(self):
+        s = dev_mem.memory_stats()
+        assert isinstance(s, dict)
+        # bogus device ids and exotic platforms must degrade to {}
+        assert dev_mem.memory_stats(device_id=9999) == {}
+        assert isinstance(dev_mem.memory_allocated(), int)
+        assert isinstance(dev_mem.max_memory_allocated(), int)
+
+    def test_compiled_memory_stats_well_formed_on_cpu(self):
+        import jax
+        import jax.numpy as jnp
+
+        fn = jax.jit(lambda a: (a * 2.0).sum())
+        s = dev_mem.compiled_memory_stats(fn, jnp.ones((8, 8), jnp.float32))
+        assert isinstance(s, dict)
+        for k, v in s.items():
+            assert k.endswith("_in_bytes") and isinstance(v, int)
+
+    def test_compiled_memory_stats_never_raises(self):
+        assert dev_mem.compiled_memory_stats(object()) == {}
+
+    def test_live_array_bytes_tracks_allocations(self):
+        import jax.numpy as jnp
+
+        base = dev_mem.live_array_bytes()
+        keep = jnp.ones((256, 256), jnp.float32)  # 256 KiB
+        assert dev_mem.live_array_bytes() >= base + keep.nbytes
+
+    def test_sample_sets_gauges_and_watermark_is_monotone(self, obs_on):
+        import jax.numpy as jnp
+
+        keep = jnp.ones((128, 128), jnp.float32)
+        s1 = obs.sample_device_memory()
+        assert s1["bytes_in_use"] > 0  # CPU fallback: live-array scan
+        del keep
+        s2 = obs.sample_device_memory()
+        assert s2["watermark_bytes"] >= s1["watermark_bytes"] - 0
+        assert s2["watermark_bytes"] >= s2["bytes_in_use"]
+        g = obs.registry.get
+        assert g("device.hbm_bytes_in_use").value(device="0") == \
+            s2["bytes_in_use"]
+        assert g("device.hbm_watermark_bytes").value(device="0") == \
+            s2["watermark_bytes"]
+
+
+class TestFlightRecorder:
+    def test_exception_in_step_region_dumps_trail(self, obs_on, flight_dir):
+        # a few healthy steps + a collective first, so the dump carries
+        # the trailing context the post-mortem needs
+        for i in range(3):
+            with obs.step_region("train", step=i, items=8):
+                pass
+        import paddle_tpu.distributed as dist
+
+        dist.all_reduce(paddle.ones([2, 2]))
+        with pytest.raises(ValueError, match="induced"):
+            with obs.step_region("train", step=3, items=8):
+                raise ValueError("induced failure")
+        (f,) = os.listdir(flight_dir)
+        d = json.loads((flight_dir / f).read_text())
+        assert d["kind"] == "flight_dump"
+        assert d["reason"] == "step_exception"
+        assert d["exception"]["type"] == "ValueError"
+        assert "induced failure" in d["exception"]["message"]
+        kinds = [e["kind"] for e in d["events"]]
+        assert kinds.count("train.step") == 3
+        assert "comm.collective" in kinds
+        assert kinds[-1] == "train.step_failed"
+        assert d["metrics"]["train.steps"]["series"]
+        assert "device_memory" in d
+
+    def test_ring_is_bounded(self, obs_on, flight_dir):
+        cap = obs.flight.recorder._buffer().maxlen
+        for i in range(cap + 50):
+            obs.emit("test.flood_probe", i=i)
+        trail = obs.flight.recorder.snapshot()
+        assert len(trail) == cap
+        assert trail[-1]["i"] == cap + 49
+
+    def test_dump_without_dir_is_none(self, obs_on, monkeypatch):
+        monkeypatch.delenv(obs.flight.FLIGHT_DIR_ENV, raising=False)
+        assert obs.flight.recorder.dump("manual") is None
+
+    def test_excepthook_install_is_idempotent(self):
+        prev = sys.excepthook
+        try:
+            obs.flight.install_excepthook()
+            hook1 = sys.excepthook
+            obs.flight.install_excepthook()
+            assert sys.excepthook is hook1
+        finally:
+            sys.excepthook = prev
+
+    def test_render_and_cli_report(self, obs_on, flight_dir):
+        with obs.step_region("train", step=0, items=4):
+            pass
+        obs.flight.recorder.dump("manual_probe")
+        (f,) = os.listdir(flight_dir)
+        path = str(flight_dir / f)
+        rendered = obs.render_flight(json.loads(open(path).read()))
+        assert "FLIGHT RECORDER DUMP" in rendered
+        assert "manual_probe" in rendered
+        assert "train.step" in rendered
+        report = _load_tool("metrics_report")
+        assert report.main([path]) == 0
+        with pytest.raises(ValueError, match="flight"):
+            obs.render_flight({"kind": "other"})
+
+
+class TestWatchdogMetrics:
+    def test_overdue_task_emits_metrics_and_flight_dump(self, obs_on,
+                                                        flight_dir):
+        from paddle_tpu.distributed.communication.watchdog import (
+            CommTaskManager)
+
+        m = CommTaskManager(scan_interval_s=0.02)
+        try:
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                tid = m.start_task("probe_rendezvous", timeout_s=0.01)
+                deadline = time.time() + 5.0
+                # the flight dump is the scan's LAST overdue action, so
+                # once the file exists the warning/metrics all landed too
+                while not (os.path.isdir(flight_dir)
+                           and os.listdir(flight_dir)) \
+                        and time.time() < deadline:
+                    time.sleep(0.02)
+                m.end_task(tid)
+            g = obs.registry.get
+            assert g("comm.task_overdue").value(name="probe_rendezvous") == 1
+            assert g("comm.tasks_started").value(name="probe_rendezvous") == 1
+            assert g("comm.task_seconds").stats(
+                name="probe_rendezvous")["count"] == 1
+            assert g("comm.watchdog_scans").total() >= 1
+            assert any("probe_rendezvous" in str(x.message) for x in w)
+            (ev,) = obs.events("comm.task_overdue")
+            assert ev.fields["name"] == "probe_rendezvous"
+            assert ev.fields["timeout_s"] == 0.01
+            dumps = os.listdir(flight_dir)
+            assert len(dumps) == 1
+            d = json.loads((flight_dir / dumps[0]).read_text())
+            assert d["reason"] == "watchdog_timeout"
+            assert d["exception"]["type"] == "TimeoutError"
+            assert any(e["kind"] == "comm.task_overdue" for e in d["events"])
+        finally:
+            m.shutdown()
+
+    def test_clean_task_records_seconds_only(self, obs_on):
+        from paddle_tpu.distributed.communication.watchdog import (
+            CommTaskManager)
+
+        m = CommTaskManager(scan_interval_s=10.0)
+        try:
+            with m.task("probe_clean", timeout_s=60.0):
+                pass
+            g = obs.registry.get
+            assert g("comm.task_seconds").stats(name="probe_clean")["count"] == 1
+            assert g("comm.task_overdue").value(name="probe_clean") == 0
+        finally:
+            m.shutdown()
+
+
+class TestCollectiveTelemetry:
+    def test_all_reduce_labeled_by_op_and_group(self, obs_on):
+        import paddle_tpu.distributed as dist
+
+        t = paddle.ones([4, 4])  # 64 bytes fp32
+        dist.all_reduce(t)
+        g = obs.registry.get
+        assert g("comm.collective_calls").value(
+            op="all_reduce", group="world") == 1
+        assert g("comm.collective_bytes").value(
+            op="all_reduce", group="world") == 64
+        assert g("comm.collective_seconds").stats(
+            op="all_reduce", group="world")["count"] == 1
+        (ev,) = obs.events("comm.collective")
+        assert ev.fields["op"] == "all_reduce"
+        assert ev.fields["bytes"] == 64
+
+    def test_all_gather_counts_payload(self, obs_on):
+        import paddle_tpu.distributed as dist
+
+        out = []
+        dist.all_gather(out, paddle.ones([2, 2]))
+        assert obs.registry.get("comm.collective_bytes").value(
+            op="all_gather", group="world") == 16
+
+    def test_axis_group_label(self, obs_on):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.communication.group import Group
+
+        g = Group(0, 7, [0, 1], axis_name="tp")
+        dist.all_reduce(paddle.ones([2]), group=g)
+        assert obs.registry.get("comm.collective_calls").value(
+            op="all_reduce", group="tp") == 1
+
+    def test_disabled_records_nothing(self):
+        obs.reset()
+        obs.disable()
+        import paddle_tpu.distributed as dist
+
+        dist.all_reduce(paddle.ones([2]))
+        assert obs.registry.get("comm.collective_calls").total() == 0
+
+    def test_lint_rejects_unlabeled_collective_series(self, obs_on):
+        lint = _load_tool("lint_registry")
+        assert lint.check_metric_registry() == []
+        obs.registry.get("comm.collective_calls").inc()  # no labels
+        problems = lint.check_metric_registry()
+        assert any("comm.collective_calls" in p and "group" in p
+                   for p in problems)
+        obs.reset()
+        assert lint.check_metric_registry() == []
+
+
+class TestDataloaderGauges:
+    def test_thread_prefetch_ring_records_depth_and_wait(self, obs_on):
+        from paddle_tpu.io import DataLoader
+
+        class Ds:
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                return np.full((3,), i, np.float32)
+
+        # a custom collate_fn forces the Python-queue prefetch ring
+        loader = DataLoader(Ds(), batch_size=3, num_workers=1,
+                            collate_fn=lambda b: np.stack(b))
+        batches = list(loader)
+        assert len(batches) == 4
+        g = obs.registry.get
+        assert g("io.batches_delivered").value(ring="python") == 4
+        assert g("io.wait_seconds").stats(ring="python")["count"] == 4
+        assert g("io.queue_depth").value(ring="python") is not None
+
+    def test_disabled_records_nothing(self):
+        obs.reset()
+        obs.disable()
+        from paddle_tpu.io import DataLoader
+
+        class Ds:
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return np.zeros((2,), np.float32)
+
+        list(DataLoader(Ds(), batch_size=2, num_workers=1,
+                        collate_fn=lambda b: np.stack(b)))
+        assert obs.registry.get("io.batches_delivered").total() == 0
+
+
+class TestMetricsCallback:
+    def test_fit_records_step_telemetry(self, obs_on):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.hapi.callbacks import MetricsCallback
+        from paddle_tpu.io import TensorDataset
+
+        net = nn.Linear(4, 2)
+        model = paddle.Model(net)
+        model.prepare(opt.SGD(learning_rate=0.1,
+                              parameters=net.parameters()),
+                      paddle.nn.MSELoss())
+        x = paddle.to_tensor(np.random.rand(8, 4).astype("float32"))
+        y = paddle.to_tensor(np.random.rand(8, 2).astype("float32"))
+        cb = MetricsCallback(name="fit_probe", flops_per_step=1e6,
+                             peak_flops=1e12, sample_memory_every=1)
+        model.fit(TensorDataset([x, y]), batch_size=4, epochs=1,
+                  verbose=0, callbacks=[cb])
+        g = obs.registry.get
+        assert g("train.steps").value(name="fit_probe") == 2
+        assert g("train.items_per_second").value(
+            name="fit_probe", unit="samples") > 0
+        assert g("train.mfu").value(name="fit_probe") > 0
+        assert g("device.hbm_bytes_in_use").value(device="0") is not None
+        steps = [e.fields["step"] for e in obs.events("train.step")]
+        assert steps == [0, 1]
+
+    def test_noop_when_disabled(self):
+        obs.reset()
+        obs.disable()
+        from paddle_tpu.hapi.callbacks import MetricsCallback
+
+        cb = MetricsCallback()
+        cb.on_train_begin()
+        cb.on_train_batch_begin(0)
+        cb.on_train_batch_end(0, {"batch_size": 4})
+        cb.on_train_end()
+        assert obs.registry.get("train.steps").total() == 0
+
+
+class TestGroupedReport:
+    def _dump_with_activity(self):
+        obs.registry.get("train.step_seconds").observe(0.01, name="t")
+        obs.registry.get("comm.collective_bytes").inc(
+            4096, op="all_reduce", group="tp")
+        return obs.dump_dict()
+
+    def test_grouped_by_subsystem(self, obs_on):
+        out = obs.render_report(self._dump_with_activity())
+        assert "=== train ===" in out
+        assert "=== comm ===" in out
+        # subsystems appear once each, rows under their own header
+        assert out.index("=== comm ===") < out.index("=== train ===")
+
+    def test_byte_metrics_render_byte_units(self, obs_on):
+        out = obs.render_report(self._dump_with_activity())
+        line = [ln for ln in out.splitlines()
+                if "comm.collective_bytes" in ln][0]
+        assert "KiB" in line and "ms" not in line
+
+    def test_histogram_empty_label_series_renders(self, obs_on):
+        h = obs.histogram("test.bare_seconds", "scratch")
+        h.observe(0.5)  # no labels at all
+        out = obs.render_report(obs.dump_dict())
+        (line,) = [ln for ln in out.splitlines() if "test.bare_seconds" in ln]
+        assert "{" not in line  # bare name, no stray label braces
+        assert "500.000ms" in line
+
+    def test_top_n_trims_series(self, obs_on):
+        c = obs.counter("test.top_probe", "scratch")
+        for i in range(6):
+            c.inc(i + 1, k=str(i))
+        out = obs.render_report(obs.dump_dict(), top=2)
+        lines = [ln for ln in out.splitlines() if "test.top_probe" in ln]
+        assert len(lines) == 2
+        assert "{k=5}" in lines[0] and "{k=4}" in lines[1]  # largest first
+        assert "4 more series" in out
+
+    def test_cli_top_flag(self, obs_on, tmp_path):
+        self._dump_with_activity()
+        p = tmp_path / "m.json"
+        obs.dump(str(p))
+        report = _load_tool("metrics_report")
+        assert report.main([str(p), "--top", "3"]) == 0

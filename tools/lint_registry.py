@@ -117,8 +117,21 @@ def check_all_exports() -> List[str]:
     return problems
 
 
+#: comm.collective_* series MUST carry these labels — an unlabeled
+#: collective metric cannot be attributed to a mesh axis, which defeats
+#: the per-mesh accounting the subsystem exists for.
+COLLECTIVE_REQUIRED_LABELS = ("group", "op")
+
+
 def check_metric_registry() -> List[str]:
     from paddle_tpu import observability
+    # the runtime-telemetry modules register their metrics at import;
+    # pull them in explicitly so the audit always covers the train./
+    # device./comm./io. subsystems even when the workload under test
+    # never touched them
+    import paddle_tpu.distributed.communication.watchdog  # noqa: F401
+    import paddle_tpu.io.dataloader  # noqa: F401
+    import paddle_tpu.observability.runtime  # noqa: F401
     from paddle_tpu.observability.metrics import (CLAIMED_SUBSYSTEMS,
                                                   NAME_RE)
 
@@ -149,6 +162,16 @@ def check_metric_registry() -> List[str]:
         if not m.doc:
             problems.append(
                 f"metric {m.name!r}: registered without a doc string")
+        if m.name.startswith("comm.collective"):
+            for labels in m.labelsets():
+                missing = [k for k in COLLECTIVE_REQUIRED_LABELS
+                           if k not in labels]
+                if missing:
+                    problems.append(
+                        f"metric {m.name!r}: series {labels!r} is missing "
+                        f"required label(s) {missing} — collective metrics "
+                        f"must be attributable to a mesh axis (label every "
+                        f"record with op= and group=)")
     return problems
 
 

@@ -2,14 +2,18 @@
 """Render a paddle_tpu.observability metrics dump as a human report.
 
 Usage:
-    python tools/metrics_report.py metrics.json [--events N]
+    python tools/metrics_report.py metrics.json [--events N] [--top N]
+    python tools/metrics_report.py flight-1234-1.json   # flight dumps too
 
-The input is the JSON written by ``paddle_tpu.observability.dump(path)``
-or by running any workload with ``PADDLE_TPU_METRICS_DUMP=metrics.json``
-in the environment. Rendering goes through the same
-``observability.report.render_report`` the in-process ``summary()``
-uses, so the dump round-trips by construction. Exits non-zero on a file
-that is not a metrics dump.
+Input is either the JSON written by ``paddle_tpu.observability.dump(path)``
+(or any workload run with ``PADDLE_TPU_METRICS_DUMP=metrics.json``), or a
+flight-recorder crash dump written to ``PADDLE_TPU_FLIGHT_DIR`` — the
+kind is auto-detected. Metric rows come out grouped by subsystem
+(``dispatch``, ``executor``, ``train``, ``comm``, ``io``, ...); ``--top``
+keeps only the N largest series per metric. Rendering goes through the
+same ``observability.report`` code the in-process ``summary()`` uses, so
+dumps round-trip by construction. Exits non-zero on a file that is
+neither kind of dump.
 """
 from __future__ import annotations
 
@@ -27,9 +31,13 @@ if _REPO_ROOT not in sys.path:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("dump", help="JSON file written by observability.dump()")
-    ap.add_argument("--events", type=int, default=20,
-                    help="how many trailing events to show (default 20)")
+    ap.add_argument("dump", help="JSON written by observability.dump() or "
+                                 "a flight-recorder crash dump")
+    ap.add_argument("--events", type=int, default=None,
+                    help="how many trailing events to show (default 20 for "
+                         "metrics dumps, the full ring for flight dumps)")
+    ap.add_argument("--top", type=int, default=None,
+                    help="show only the N largest series per metric")
     args = ap.parse_args(argv)
 
     try:
@@ -40,10 +48,18 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
 
-    from paddle_tpu.observability.report import render_report
+    from paddle_tpu.observability.flight import FLIGHT_DUMP_KIND
+    from paddle_tpu.observability.report import render_flight, render_report
 
     try:
-        report = render_report(d, max_events=args.events)
+        if isinstance(d, dict) and d.get("kind") == FLIGHT_DUMP_KIND:
+            n_events = (len(d.get("events") or []) if args.events is None
+                        else args.events)
+            print(render_flight(d, max_events=n_events, top=args.top))
+            return 0
+        report = render_report(
+            d, max_events=20 if args.events is None else args.events,
+            top=args.top)
     except ValueError as e:
         print(f"metrics_report: {args.dump!r}: {e}", file=sys.stderr)
         return 1
