@@ -112,6 +112,15 @@ def _emit_metrics_block():
         "step_seconds_total": round(hist_sum("train.step_seconds"), 3),
         "mfu": gauge_max("train.mfu"),
         "hbm_watermark_bytes": gauge_max("device.hbm_watermark_bytes"),
+        # elastic recovery roll-ups (distributed/elastic.py; nonzero only
+        # for runs that actually restarted/resumed)
+        "elastic_restarts": tot("elastic.restarts"),
+        "elastic_peer_deaths": tot("elastic.peer_deaths"),
+        "elastic_steps_lost": tot("elastic.steps_lost"),
+        "elastic_rerendezvous_seconds":
+            round(hist_sum("elastic.rerendezvous_seconds"), 3),
+        "elastic_checkpoint_restore_seconds":
+            round(hist_sum("elastic.checkpoint_restore_seconds"), 3),
     }}), flush=True)
 
 

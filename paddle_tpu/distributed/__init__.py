@@ -36,6 +36,7 @@ from . import sharding
 from . import passes  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model
 from . import elastic
+from . import elastic_train
 from .store import InMemoryStore, Store, TCPStore, create_store
 from .env import get_store
 from .launch_utils import spawn, launch

@@ -343,8 +343,6 @@ _DIM_MATCH_OK = {
 # concat lowers to arity-specialized names (concat_2, concat_3, ...)
 _DIM_MATCH_PREFIXES = ("concat_",)
 
-_warned_prims = set()
-
 
 def _broadcastable(in_shape, out_shape) -> bool:
     """numpy-style: in aligns to out's trailing dims with 1s expanding."""
@@ -408,6 +406,12 @@ def complete_placements(prog, mesh: ProcessMesh,
     reference's completion)."""
     env = env or _shape_env(prog)
     specs: Dict[int, DistTensorSpec] = dict(seeds)
+    # conservative-fallback warnings are scoped to THIS derivation: a
+    # later plan for a different model hitting the same unmapped prim
+    # must report it again, not degrade silently because some earlier
+    # model in the process already warned (one warning per prim per
+    # completion, not per process)
+    warned_prims = set()
 
     def spec_of(vid: int) -> DistTensorSpec:
         s = specs.get(vid)
@@ -468,8 +472,8 @@ def complete_placements(prog, mesh: ProcessMesh,
                 known = (name in _DIM_MATCH_OK
                          or name.startswith(_DIM_MATCH_PREFIXES)
                          or rule_name is not None)
-                if not known and name not in _warned_prims:
-                    _warned_prims.add(name)
+                if not known and name not in warned_prims:
+                    warned_prims.add(name)
                     warnings.warn(
                         f"placement completion: no SPMD rule for prim "
                         f"'{name}'; propagating by dim correspondence "

@@ -122,13 +122,31 @@ _PENDING_SAVES: Dict[str, AsyncSaveHandle] = {}
 
 
 def wait_async_save(path: Optional[str] = None):
-    """Block until pending async saves (for ``path``, or all) finish."""
+    """Block until pending async saves (for ``path``, or all) finish.
+    Re-raises the writer's exception — the explicit-wait API must not
+    swallow a broken checkpoint."""
     targets = ([os.path.abspath(path)] if path is not None
                else list(_PENDING_SAVES))
     for key in targets:
         h = _PENDING_SAVES.pop(key, None)
         if h is not None:
             h.wait()
+
+
+def _join_pending(path: str) -> Optional[BaseException]:
+    """Join an in-flight async save for ``path`` and RETURN its failure
+    instead of raising. The auto-join sites (a later save or load on the
+    same path) must attribute an old writer's exception to the old save
+    — re-raising it bare from inside the NEW call blames the wrong
+    operation and, worse, kills the retry save before it runs."""
+    h = _PENDING_SAVES.pop(os.path.abspath(path), None)
+    if h is None:
+        return None
+    try:
+        h.wait()
+    except BaseException as e:
+        return e
+    return None
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
@@ -145,8 +163,17 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     should pass a fresh ``unique_id`` per attempt so a straggler host's
     stale fragments are rejected at merge instead of mixed in."""
     # a second save into a directory with an in-flight async writer must
-    # not interleave files from two attempts
-    wait_async_save(path)
+    # not interleave files from two attempts. If that EARLIER writer
+    # failed, report it with its own attribution and let THIS save run —
+    # it is the retry (elastic resume depends on the retry path working).
+    prev_exc = _join_pending(path)
+    if prev_exc is not None:
+        import warnings
+
+        warnings.warn(
+            f"an earlier async save_state_dict to {path!r} failed with "
+            f"{prev_exc!r}; proceeding with this save as the retry",
+            RuntimeWarning, stacklevel=2)
     os.makedirs(path, exist_ok=True)
     host = jax.process_index()
     # save-attempt id binds fragments together: load refuses to merge
@@ -348,7 +375,15 @@ def load_state_dict(state_dict: Dict[str, Any], path: str, process_group=None,
     """Fill ``state_dict``'s tensors from checkpoint, resharding to each
     tensor's CURRENT layout shard-wise: only the saved shards that
     overlap this host's placement are read (load_state_dict.py:394)."""
-    wait_async_save(path)  # a half-flushed async save must not be read
+    # a half-flushed async save must not be read; if that writer FAILED,
+    # refuse the load with the failure attributed to the earlier save
+    # (reading whatever files it left behind would be data corruption)
+    prev_exc = _join_pending(path)
+    if prev_exc is not None:
+        raise RuntimeError(
+            f"cannot load checkpoint at {path!r}: the earlier async "
+            f"save_state_dict to this path failed ({prev_exc!r}), so "
+            f"the on-disk state is incomplete") from prev_exc
     meta = _merge_meta(path)
     if meta.get("format", 1) < 2:
         return _load_state_dict_v1(state_dict, path, meta)

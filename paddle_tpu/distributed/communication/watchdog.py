@@ -151,7 +151,10 @@ class CommTaskManager:
                         # process is still alive to write it
                         _obs.flight.recorder.dump(
                             "watchdog_timeout",
-                            TimeoutError(msg))
+                            TimeoutError(msg),
+                            context={"task": t.name,
+                                     "elapsed_s": round(t.elapsed_s(), 3),
+                                     "timeout_s": t.timeout_s})
 
     def overdue_tasks(self):
         with self._lock:

@@ -14,12 +14,40 @@ in-process peer repair is attempted.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from .. import observability as _obs
 from .store import Store
 
-__all__ = ["ElasticStatus", "ElasticManager"]
+__all__ = ["ElasticStatus", "ElasticManager", "PeerMonitor"]
+
+# The elastic. subsystem (claimed in observability.metrics
+# CLAIMED_SUBSYSTEMS): the recovery-cost counters the ROADMAP item-1
+# acceptance reads back out of bench.py --metrics / obs.dump().
+M_RESTARTS = _obs.counter(
+    "elastic.restarts",
+    "worker rejoins at a bumped generation, by trigger reason "
+    "(relaunch = coordinated whole-world restart)")
+M_PEER_DEATHS = _obs.counter(
+    "elastic.peer_deaths",
+    "stale-heartbeat peer deaths this worker detected, by peer node id")
+M_RERENDEZVOUS_SECONDS = _obs.histogram(
+    "elastic.rerendezvous_seconds",
+    "wall seconds a rejoining worker spent re-forming the world "
+    "(store rendezvous + jax.distributed bring-up) after a restart")
+M_STEPS_LOST = _obs.counter(
+    "elastic.steps_lost",
+    "training steps that had run past the restored checkpoint and were "
+    "re-executed after an elastic resume")
+M_RESTORE_SECONDS = _obs.histogram(
+    "elastic.checkpoint_restore_seconds",
+    "wall seconds an elastic resume spent loading the latest checkpoint")
+M_SAVE_SECONDS = _obs.histogram(
+    "elastic.checkpoint_save_seconds",
+    "wall seconds each periodic elastic checkpoint spent from async "
+    "kickoff to durable (writer joined)")
 
 
 class ElasticStatus:
@@ -124,6 +152,40 @@ class ElasticManager:
                 alive.append(m)
         return alive
 
+    def dead_members(self) -> List[str]:
+        """Members that DID register and heartbeat at least once but whose
+        heartbeat is now staler than dead_after_s — the positive death
+        signal (a member with no heartbeat key yet is merely *joining*,
+        not dead)."""
+        now = time.time()
+        dead = []
+        for m in self._read_members():
+            try:
+                ts = float(self.store.get(self._node_key(m), timeout_s=0))
+            except Exception:
+                continue
+            if now - ts > self.dead_after_s:
+                dead.append(m)
+        return dead
+
+    # -- generation (reference: the launcher's coordinated-restart
+    # counter; surfaced here so elastic clients and unit tests share one
+    # implementation with CollectiveController) -------------------------
+    def _generation_key(self):
+        return f"elastic/{self.job_id}/generation"
+
+    def generation(self) -> int:
+        """Current re-rendezvous generation (0 until the first restart)."""
+        try:
+            return int(self.store.get(self._generation_key(), timeout_s=0))
+        except Exception:
+            return 0
+
+    def bump_generation(self) -> int:
+        """Atomically advance the generation — every member observing a
+        value above its own must drop its world and re-rendezvous."""
+        return self.store.add(self._generation_key(), 1)
+
     # -- scale decisions (reference: manager.py watch loop) --------------
     def check_scale(self) -> str:
         """One watch-loop tick: HOLD below min, RESTART on membership
@@ -194,3 +256,82 @@ class ElasticManager:
         finally:
             stop.set()
             beater.join(timeout=2)
+
+
+class PeerMonitor:
+    """In-worker heartbeat + peer-death watch over an ElasticManager.
+
+    Two jobs, one daemon thread:
+    - keep THIS worker's heartbeat fresh while the training loop blocks
+      in compiled steps / collectives;
+    - watch every expected peer's heartbeat and fire ``on_death(peer)``
+      the moment one goes stale — the survivor's escape hatch out of a
+      collective that will never complete (the dead peer can't join it).
+
+    A peer is reported dead when its store heartbeat is staler than the
+    manager's ``dead_after_s``, or when the store itself has been
+    unreachable for that long (the store server rides a worker process,
+    so losing it IS a peer death). ``on_death`` runs on the monitor
+    thread: it must be async-signal-ish safe — dump state and exit, don't
+    try to repair the world in-process (recovery is relaunch+resume,
+    reference §5.3).
+    """
+
+    def __init__(self, manager: ElasticManager, expected: List[str],
+                 on_death: Callable[[str], None],
+                 poll_interval_s: float = 0.5):
+        self.manager = manager
+        self.expected = [str(p) for p in expected
+                         if str(p) != manager.node_id]
+        self.on_death = on_death
+        self.poll_interval_s = poll_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._fired = False
+        # local receive clock per peer: covers both a stale stored
+        # timestamp AND an unreadable store (peer clock skew can't fake
+        # liveness, store loss can't mask death)
+        self._last_seen = {p: time.time() for p in self.expected}
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="ptpu-elastic-peer-monitor",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _loop(self):
+        mgr = self.manager
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                mgr.heartbeat()
+            except Exception:
+                pass  # store loss shows up through the read side below
+            now = time.time()
+            dead = None
+            for peer in self.expected:
+                try:
+                    ts = float(mgr.store.get(mgr._node_key(peer),
+                                             timeout_s=0))
+                except Exception:
+                    ts = None
+                if ts is not None and now - ts <= mgr.dead_after_s:
+                    self._last_seen[peer] = now
+                    continue
+                if now - self._last_seen[peer] > mgr.dead_after_s:
+                    dead = peer
+                    break
+            if dead is not None and not self._fired:
+                self._fired = True
+                M_PEER_DEATHS.inc(peer=dead)
+                try:
+                    self.on_death(dead)
+                finally:
+                    return

@@ -1,0 +1,428 @@
+"""Elastic multi-process SPMD training loop.
+
+This is the seam ROADMAP item 1 names: ``fleet.launch`` spawns N real
+OS processes, each process ``jax.distributed``-initializes into ONE
+global mesh (on CPU rigs the ``--xla_force_host_platform_device_count``
+trick gives every process a slice of virtual devices, so CI proves the
+cross-process path without chips), and the compiled train step runs
+SHARDED across process boundaries — the gradient psum crosses hosts
+inside the jitted program.
+
+Robustness model (reference §5.3 — recovery is relaunch + resume, no
+in-process peer repair):
+
+- every worker heartbeats through the LAUNCHER-hosted elastic store and
+  watches its peers (:class:`~.elastic.PeerMonitor`);
+- when a peer dies, each survivor writes a flight-recorder post-mortem
+  (reason ``peer_death``) and exits with
+  :data:`~.launch_utils.ELASTIC_PEER_EXIT`; the dead worker's controller
+  bumps the shared generation and every node relaunches;
+- the rejoined world re-rendezvouses (keys are generation-namespaced),
+  re-forms the mesh, restores the latest *complete* async checkpoint
+  (:class:`CheckpointManager` only advances its ``LATEST`` pointer after
+  every host's writer joined), replays the few steps past it, and the
+  loss curve continues as if nothing happened;
+- fault injection for drills and tests: ``PADDLE_TPU_CHAOS_KILL_RANK``/
+  ``_STEP``/``_GEN`` (or ``tools/chaos_launch.py``) SIGKILLs a chosen
+  worker after a chosen step — an honest ungraceful death, no atexit.
+
+Recovery cost is telemetry, not folklore: ``elastic.restarts``,
+``elastic.rerendezvous_seconds``, ``elastic.steps_lost`` and
+``elastic.checkpoint_restore_seconds`` land in the same registry the
+``bench.py --metrics`` roll-up and ``obs.dump()`` read.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .. import observability as _obs
+from .elastic import (ElasticManager, PeerMonitor, M_RESTARTS,
+                      M_RERENDEZVOUS_SECONDS, M_RESTORE_SECONDS,
+                      M_SAVE_SECONDS, M_STEPS_LOST)
+from .launch_utils import ELASTIC_PEER_EXIT
+
+__all__ = [
+    "global_mesh", "shard_batch", "replicate", "chaos_config",
+    "maybe_chaos_kill", "CheckpointManager", "run_elastic",
+    "ElasticRunResult",
+]
+
+
+# -- global mesh + cross-process array construction ----------------------
+
+def global_mesh(axis_name: str = "dp",
+                devices: Optional[List] = None) -> Mesh:
+    """One 1-D mesh over EVERY device in the job — all processes' devices,
+    in ``jax.devices()`` order (identical on every process), so the same
+    jitted program addresses the whole world."""
+    devs = list(jax.devices()) if devices is None else list(devices)
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def _build_global(mesh: Mesh, array, spec: PartitionSpec):
+    arr = np.asarray(array)
+    sharding = NamedSharding(mesh, spec)
+    idx_map = sharding.addressable_devices_indices_map(arr.shape)
+    pieces = [jax.device_put(arr[idx], d) for d, idx in idx_map.items()]
+    return jax.make_array_from_single_device_arrays(
+        arr.shape, sharding, pieces)
+
+
+def shard_batch(mesh: Mesh, array, axis_name: str = "dp"):
+    """Host-local batch -> batch-dim-sharded global ``jax.Array``.
+
+    Every process passes the same logical global batch (deterministic
+    per-step data generation keeps them identical); only the rows this
+    process's devices own are actually read and device_put."""
+    return _build_global(mesh, array, PartitionSpec(axis_name))
+
+
+def replicate(mesh: Mesh, array):
+    """Host value -> fully-replicated global ``jax.Array`` (parameters)."""
+    return _build_global(mesh, array, PartitionSpec())
+
+
+# -- fault injection -----------------------------------------------------
+
+def chaos_config() -> Optional[Tuple[int, int, int]]:
+    """(kill_rank, kill_step, kill_generation) from the environment, or
+    None when fault injection is off."""
+    r = os.environ.get("PADDLE_TPU_CHAOS_KILL_RANK")
+    s = os.environ.get("PADDLE_TPU_CHAOS_KILL_STEP")
+    if r is None or s is None:
+        return None
+    g = int(os.environ.get("PADDLE_TPU_CHAOS_KILL_GEN", "0"))
+    return int(r), int(s), g
+
+
+def maybe_chaos_kill(step: int, rank: int, generation: int):
+    """SIGKILL this process if fault injection selects (rank, step, gen).
+
+    SIGKILL, not sys.exit: the point of the drill is an UNGRACEFUL death
+    — no atexit, no store deregistration, no flushed buffers — so the
+    peers must find out the hard way (stale heartbeat)."""
+    cfg = chaos_config()
+    if cfg is None:
+        return
+    kr, ks, kg = cfg
+    if rank == kr and step == ks and generation == kg:
+        print(f"paddle_tpu chaos: SIGKILL rank {rank} after step {step} "
+              f"(generation {generation})", file=sys.stderr, flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+# -- checkpoint schedule -------------------------------------------------
+
+class CheckpointManager:
+    """Periodic async checkpoints with a crash-consistent LATEST pointer.
+
+    Each save point kicks ``save_state_dict(async_save=True)`` into a
+    per-step directory; the PREVIOUS save is joined first, and only once
+    every host has acked its writer's success does rank 0 atomically
+    advance the ``LATEST`` file. A worker killed mid-save therefore
+    leaves a half-written step directory that LATEST never points at —
+    resume always lands on a checkpoint whose every fragment is durable.
+
+    ``PROGRESS`` (rank 0, every step) records how far training actually
+    got, so a resume can report ``elastic.steps_lost`` — the re-executed
+    steps between the restored checkpoint and the crash.
+    """
+
+    def __init__(self, ckpt_dir: str, generation: int = 0,
+                 world: int = 1, rank: int = 0, store=None,
+                 job_id: str = "default", ack_timeout_s: float = 30.0):
+        self.dir = ckpt_dir
+        self.generation = generation
+        self.world = world
+        self.rank = rank
+        self.store = store
+        self.job_id = job_id
+        self.ack_timeout_s = ack_timeout_s
+        self._pending: Optional[Tuple[int, Any, float]] = None
+        os.makedirs(ckpt_dir, exist_ok=True)
+
+    # -- paths ----------------------------------------------------------
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.dir, f"step_{step:08d}")
+
+    def _latest_path(self) -> str:
+        return os.path.join(self.dir, "LATEST")
+
+    def _progress_path(self) -> str:
+        return os.path.join(self.dir, "PROGRESS")
+
+    def _write_atomic(self, path: str, text: str):
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+
+    # -- progress --------------------------------------------------------
+    def write_progress(self, step: int):
+        if self.rank == 0:
+            self._write_atomic(self._progress_path(), str(step))
+
+    def progress(self) -> Optional[int]:
+        try:
+            with open(self._progress_path()) as f:
+                return int(f.read().strip())
+        except Exception:
+            return None
+
+    # -- save ------------------------------------------------------------
+    def save(self, state: Dict[str, Any], step: int):
+        """Finalize the previous async save, then kick this one."""
+        from . import checkpoint as ckpt
+
+        self._finalize_pending()
+        handle = ckpt.save_state_dict(
+            state, self.step_dir(step), async_save=True,
+            unique_id=f"g{self.generation}-s{step}")
+        self._pending = (step, handle, time.time())
+
+    def _ack_key(self, step: int) -> str:
+        return (f"elastic/{self.job_id}/ckpt_ok/"
+                f"g{self.generation}/s{step}")
+
+    def _finalize_pending(self):
+        if self._pending is None:
+            return
+        step, handle, t0 = self._pending
+        self._pending = None
+        try:
+            handle.wait()
+        except BaseException as e:
+            # this host's fragment is broken: never ack, LATEST stays on
+            # the previous complete checkpoint and the NEXT save retries
+            warnings.warn(
+                f"elastic checkpoint for step {step} failed on rank "
+                f"{self.rank} ({e!r}); LATEST stays behind and the next "
+                f"save point retries", RuntimeWarning)
+            return
+        M_SAVE_SECONDS.observe(time.time() - t0)
+        if self.world <= 1 or self.store is None:
+            if self.rank == 0:
+                self._write_atomic(self._latest_path(), str(step))
+            return
+        try:
+            self.store.add(self._ack_key(step), 1)
+            if self.rank == 0:
+                deadline = time.time() + self.ack_timeout_s
+                while time.time() < deadline:
+                    if int(self.store.get(self._ack_key(step),
+                                          timeout_s=0)) >= self.world:
+                        self._write_atomic(self._latest_path(), str(step))
+                        return
+                    time.sleep(0.05)
+                warnings.warn(
+                    f"elastic checkpoint step {step}: not every host "
+                    f"acked within {self.ack_timeout_s}s; LATEST not "
+                    f"advanced", RuntimeWarning)
+        except Exception as e:
+            warnings.warn(
+                f"elastic checkpoint step {step}: ack store unreachable "
+                f"({e!r}); LATEST not advanced", RuntimeWarning)
+
+    def finalize(self):
+        """Join the last in-flight save (end of training)."""
+        self._finalize_pending()
+
+    # -- restore ---------------------------------------------------------
+    def latest(self) -> Optional[int]:
+        try:
+            with open(self._latest_path()) as f:
+                return int(f.read().strip())
+        except Exception:
+            return None
+
+    def restore(self, state: Dict[str, Any]) -> Optional[int]:
+        """Load the latest complete checkpoint into ``state`` (in place,
+        resharding to each tensor's current layout). Returns the restored
+        step, or None when there is nothing to restore."""
+        from . import checkpoint as ckpt
+
+        step = self.latest()
+        if step is None:
+            return None
+        with M_RESTORE_SECONDS.time():
+            ckpt.load_state_dict(state, self.step_dir(step))
+        return step
+
+
+# -- the elastic run loop ------------------------------------------------
+
+class ElasticRunResult:
+    """What one worker's run produced (this generation)."""
+
+    __slots__ = ("losses", "start_step", "generation", "resumed_from",
+                 "rank", "world")
+
+    def __init__(self, losses, start_step, generation, resumed_from,
+                 rank, world):
+        self.losses = losses
+        self.start_step = start_step
+        self.generation = generation
+        self.resumed_from = resumed_from
+        self.rank = rank
+        self.world = world
+
+
+def _elastic_store():
+    """The store elastic liveness rides on: the launcher-hosted store
+    when we were launched (survives any worker's death), else the trainer
+    rendezvous store, else an in-process store (solo run)."""
+    addr = os.environ.get("PADDLE_ELASTIC_MASTER")
+    if addr:
+        try:
+            from .. import native
+
+            if native.is_available():
+                from .store import TCPStore
+
+                host, port = addr.rsplit(":", 1)
+                return TCPStore(host, int(port), is_master=False)
+        except Exception:
+            pass
+    from .env import get_store
+
+    s = get_store()
+    if s is not None:
+        return s
+    from .store import InMemoryStore
+
+    return InMemoryStore()
+
+
+def run_elastic(build_state: Callable[[Mesh], Dict[str, Any]],
+                train_step: Callable[[Dict[str, Any], int, Mesh], Any],
+                num_steps: int, *,
+                ckpt_dir: Optional[str] = None,
+                ckpt_every: int = 1,
+                on_step: Optional[Callable[[int, float], None]] = None,
+                axis_name: str = "dp",
+                monitor_poll_s: float = 0.25) -> ElasticRunResult:
+    """Run ``train_step`` under elastic supervision (see module doc).
+
+    ``build_state(mesh)`` returns the state dict of global-array Tensors
+    (built fresh every generation — restore fills it from the latest
+    checkpoint). ``train_step(state, step, mesh)`` runs one compiled step
+    and returns the (replicated) loss; it mutates ``state`` in place.
+    ``on_step(step, loss)`` is the caller's logging hook (rank-gate it
+    yourself). Returns this generation's :class:`ElasticRunResult`; on a
+    peer death the process EXITS with ``ELASTIC_PEER_EXIT`` instead of
+    returning — the launcher owns the relaunch.
+    """
+    from .env import barrier, get_rank, get_world_size, init_parallel_env
+
+    generation = int(os.environ.get("PADDLE_RESTART_GEN", "0"))
+    if _obs.flight.recorder.dump_dir():
+        _obs.enable()   # launched with --flight_dir: arm the recorder
+
+    t_rdv = time.time()
+    init_parallel_env()
+    rank, world = get_rank(), get_world_size()
+    if generation > 0:
+        M_RERENDEZVOUS_SECONDS.observe(time.time() - t_rdv)
+        M_RESTARTS.inc(reason="relaunch")
+
+    mesh = global_mesh(axis_name)
+    dead_after = float(os.environ.get("PADDLE_TPU_ELASTIC_DEAD_AFTER",
+                                      "10"))
+    job_id = os.environ.get("PADDLE_ELASTIC_JOB_ID", "default")
+    estore = _elastic_store()
+    mgr = ElasticManager(estore, node_id=str(rank),
+                         np_range=f"1:{max(world, 1)}", job_id=job_id,
+                         dead_after_s=dead_after)
+    mgr.register()
+
+    state = build_state(mesh)
+    ckpt = None
+    resumed_from = None
+    start_step = 0
+    if ckpt_dir is not None:
+        ckpt = CheckpointManager(ckpt_dir, generation=generation,
+                                 world=world, rank=rank, store=estore,
+                                 job_id=job_id)
+        resumed_from = ckpt.restore(state)
+        if resumed_from is not None:
+            start_step = resumed_from + 1
+            lost = max(0, (ckpt.progress() or resumed_from)
+                       - resumed_from)
+            if lost:
+                M_STEPS_LOST.inc(lost)
+            _obs.flight.recorder.record(
+                "elastic", {"event": "rejoin", "rank": rank,
+                            "generation": generation,
+                            "resumed_step": resumed_from,
+                            "steps_lost": lost})
+            _obs.flight.recorder.dump(
+                _obs.flight.REASON_REJOIN,
+                context={"rank": rank, "generation": generation,
+                         "resumed_step": resumed_from,
+                         "steps_lost": lost})
+
+    # everyone is registered, restored and heartbeating before any
+    # monitor may call a quiet peer dead
+    barrier()
+
+    monitor = None
+    progress_box = {"step": start_step - 1}
+    if world > 1:
+        def _on_death(peer):
+            _obs.flight.recorder.record(
+                "elastic", {"event": "peer_death", "peer": peer,
+                            "rank": rank, "generation": generation,
+                            "step": progress_box["step"]})
+            path = _obs.flight.recorder.dump(
+                _obs.flight.REASON_PEER_DEATH,
+                context={"peer": peer, "rank": rank,
+                         "generation": generation,
+                         "step": progress_box["step"]})
+            print(f"paddle_tpu elastic: rank {rank} detected death of "
+                  f"peer {peer} at step {progress_box['step']} "
+                  f"(generation {generation})"
+                  + (f"; flight dump {path}" if path else ""),
+                  file=sys.stderr, flush=True)
+            # the main thread may be wedged inside a collective the dead
+            # peer can never join: a hard exit is the only reliable way
+            # out, and the launcher turns it into a coordinated restart
+            os._exit(ELASTIC_PEER_EXIT)
+
+        monitor = PeerMonitor(mgr, [str(r) for r in range(world)],
+                              _on_death, poll_interval_s=monitor_poll_s)
+        monitor.start()
+
+    losses: List[Tuple[int, float]] = []
+    try:
+        for step in range(start_step, num_steps):
+            loss = float(train_step(state, step, mesh))
+            losses.append((step, loss))
+            progress_box["step"] = step
+            if ckpt is not None:
+                ckpt.write_progress(step)
+            if on_step is not None:
+                on_step(step, loss)
+            maybe_chaos_kill(step, rank, generation)
+            if ckpt is not None and (step + 1) % ckpt_every == 0:
+                ckpt.save(state, step)
+        if ckpt is not None:
+            ckpt.finalize()
+        barrier()   # nobody stops heartbeating while a peer still trains
+    finally:
+        if monitor is not None:
+            monitor.stop()
+        try:
+            mgr.deregister()
+        except Exception:
+            pass
+    return ElasticRunResult(losses, start_step, generation, resumed_from,
+                            rank, world)

@@ -401,3 +401,13 @@ from .data_generator import (  # noqa: E402,F401
 
 __all__ += ["Fleet", "UtilBase", "Role", "fleet",
             "MultiSlotStringDataGenerator"]
+
+# fleet.launch — the reference's `python -m paddle.distributed.launch`
+# surfaced programmatically: N real worker processes, one global mesh,
+# elastic relaunch + checkpoint resume (ROADMAP item 1). The training
+# loop that survives a worker death lives in distributed.elastic_train.
+from ..launch_utils import launch  # noqa: E402,F401
+from .. import elastic_train  # noqa: E402,F401
+from ..elastic_train import run_elastic  # noqa: E402,F401
+
+__all__ += ["launch", "run_elastic", "elastic_train"]

@@ -6,6 +6,7 @@ Reference: python/paddle/distributed/launch/__main__.py + main.py
 all local chips (mesh-addressed), so per-chip fan-out args are no-ops.
 """
 import argparse
+import os
 import sys
 
 from ..launch_utils import launch
@@ -21,6 +22,17 @@ def main():
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--max_restarts", type=int, default=0)
     p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--flight_dir", type=str, default=None,
+                   help="arm the flight recorder in every worker: "
+                        "post-mortem JSON dumps (peer_death / rejoin / "
+                        "crash) land in this directory")
+    p.add_argument("--chaos_kill_rank", type=int, default=None,
+                   help="fault injection: the worker with this global "
+                        "rank SIGKILLs itself ...")
+    p.add_argument("--chaos_kill_step", type=int, default=None,
+                   help="... after completing this training step "
+                        "(requires a run_elastic training loop; see "
+                        "tools/chaos_launch.py)")
     p.add_argument("--devices", "--gpus", type=str, default=None,
                    help="accepted for parity; chips are mesh-addressed")
     p.add_argument("--nproc_per_node", type=int, default=None,
@@ -28,6 +40,12 @@ def main():
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     a = p.parse_args()
+
+    if a.chaos_kill_rank is not None and a.chaos_kill_step is not None:
+        # workers inherit the controller env; elastic_train reads these
+        os.environ["PADDLE_TPU_CHAOS_KILL_RANK"] = str(a.chaos_kill_rank)
+        os.environ["PADDLE_TPU_CHAOS_KILL_STEP"] = str(a.chaos_kill_step)
+        os.environ.setdefault("PADDLE_TPU_CHAOS_KILL_GEN", "0")
 
     if ":" in a.nnodes:
         # elastic mode: supervise relaunches within the np range.
@@ -50,14 +68,15 @@ def main():
             rank = rank_map.get(str(a.node_rank), a.node_rank)
             return launch(a.training_script, a.training_script_args,
                           len(rank_map), rank, inner_master, a.log_dir,
-                          a.max_restarts, a.job_id)
+                          a.max_restarts, a.job_id, a.flight_dir)
 
         status = mgr.watch(launcher_fn)
         sys.exit(0 if status == "completed" else 1)
 
     sys.exit(
         launch(a.training_script, a.training_script_args, int(a.nnodes),
-               a.node_rank, a.master, a.log_dir, a.max_restarts, a.job_id)
+               a.node_rank, a.master, a.log_dir, a.max_restarts, a.job_id,
+               a.flight_dir)
     )
 
 

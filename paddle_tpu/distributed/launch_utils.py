@@ -22,6 +22,18 @@ import sys
 import time
 from typing import Callable, List, Optional
 
+#: exit code a worker uses to say "I am healthy but a PEER died — restart
+#: me at the next generation". The controller seeing this code does NOT
+#: bump the generation itself: the dead worker's own controller does
+#: (its worker exited with a real failure code), so one incident makes
+#: exactly one bump no matter how many survivors bail out.
+ELASTIC_PEER_EXIT = 23
+
+#: how long a controller whose worker exited with ELASTIC_PEER_EXIT waits
+#: for the failed peer's controller to bump the shared generation before
+#: concluding that controller died too and bumping on its own behalf.
+PEER_BUMP_WAIT_S = 15.0
+
 
 def spawn(func: Callable, args=(), nprocs: int = 1, join: bool = True,
           daemon: bool = False, **options):
@@ -100,7 +112,8 @@ class CollectiveController:
     def __init__(self, training_script: str, args: List[str],
                  nnodes: int = 1, node_rank: int = 0,
                  master: Optional[str] = None, log_dir: str = "log",
-                 max_restarts: int = 0, job_id: str = "default"):
+                 max_restarts: int = 0, job_id: str = "default",
+                 flight_dir: Optional[str] = None):
         self.training_script = training_script
         self.args = list(args)
         self.nnodes = nnodes
@@ -109,6 +122,7 @@ class CollectiveController:
         self.log_dir = log_dir
         self.max_restarts = max_restarts
         self.job_id = job_id
+        self.flight_dir = flight_dir
         self._store = None
 
     # -- rendezvous (reference: controllers/master.py) -------------------
@@ -140,6 +154,17 @@ class CollectiveController:
             # the reference's separate launcher-KV vs trainer-TCPStore
             host, port = self.master.rsplit(":", 1)
             env_vars["PADDLE_MASTER"] = f"{host}:{int(port) + 2}"
+            # elastic heartbeats ride the LAUNCHER's store (hosted by the
+            # node-0 controller, which outlives any worker): a rank-0
+            # worker death must not take the liveness record down with it
+            env_vars["PADDLE_ELASTIC_MASTER"] = self.master
+            env_vars["PADDLE_ELASTIC_JOB_ID"] = self.job_id
+        if self.flight_dir:
+            # arm the PR-5 flight recorder in every worker: the env var
+            # turns the observability gate on at import, so each worker
+            # carries the event ring from step 0 and can dump on a peer
+            # death without any code in the training script
+            env_vars["PADDLE_TPU_FLIGHT_DIR"] = self.flight_dir
         os.makedirs(self.log_dir, exist_ok=True)
         cmd = [sys.executable, self.training_script] + self.args
         log = os.path.join(self.log_dir, f"workerlog.{self.node_rank}")
@@ -194,7 +219,21 @@ class CollectiveController:
                 self._finalize(rc)
                 return rc
             time.sleep(1)
-            if self._store is not None:
+            if rc == ELASTIC_PEER_EXIT and self._store is not None:
+                # our worker is a SURVIVOR that bailed out of a dead
+                # world: the failed peer's controller owns the generation
+                # bump. Wait for it (one incident = one bump); only if
+                # that controller vanished too do we bump ourselves.
+                deadline = time.time() + PEER_BUMP_WAIT_S
+                while time.time() < deadline:
+                    peer_gen = self._peer_generation()
+                    if peer_gen > generation:
+                        break
+                    time.sleep(0.2)
+                else:
+                    self._store.add(self._gen_key(), 1)
+                generation = self._peer_generation()
+            elif self._store is not None:
                 # tell every other node to restart at the next generation
                 generation = self._store.add(self._gen_key(), 1)
             else:
@@ -227,9 +266,13 @@ class CollectiveController:
 def launch(training_script: str, args: List[str], nnodes: int = 1,
            node_rank: int = 0, master: Optional[str] = None,
            log_dir: str = "log", max_restarts: int = 0,
-           job_id: str = "default"):
-    """Programmatic launcher (CLI in paddle_tpu/distributed/launch/__main__.py)."""
+           job_id: str = "default", flight_dir: Optional[str] = None):
+    """Programmatic launcher (CLI in paddle_tpu/distributed/launch/__main__.py).
+
+    ``flight_dir`` arms the flight recorder in every spawned worker
+    (sets ``PADDLE_TPU_FLIGHT_DIR``): on a peer death, watchdog timeout
+    or crash, each worker writes a post-mortem JSON there."""
     return CollectiveController(
         training_script, args, nnodes, node_rank, master, log_dir,
-        max_restarts, job_id,
+        max_restarts, job_id, flight_dir,
     ).run()

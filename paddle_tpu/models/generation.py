@@ -533,6 +533,15 @@ def generate(model, input_ids, max_new_tokens: int = 32,
                                top_k=top_k, top_p=top_p,
                                eos_token_id=eos_token_id, seed=seed,
                                block_size=block_size)
+    if min_length > 0 and eos_token_id is None:
+        # the beam/paged branches above already reject min_length loudly;
+        # on the greedy/sampling path it works by masking eos, so with no
+        # eos it would be a silent no-op — refuse instead (the module's
+        # no-silently-ignored-arguments posture)
+        raise ValueError(
+            "generate: min_length works by masking the eos token for the "
+            "first min_length new tokens; it has no effect with "
+            "eos_token_id=None — refusing to silently ignore it")
     p, fwd = _decode_family(model)
     if pads_np is not None and any("moe" in lp for lp in p["layers"]):
         raise NotImplementedError(

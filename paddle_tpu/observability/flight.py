@@ -32,6 +32,18 @@ FLIGHT_DIR_ENV = "PADDLE_TPU_FLIGHT_DIR"
 FLIGHT_DUMP_KIND = "flight_dump"
 FLIGHT_VERSION = 1
 
+#: well-known dump reasons. Free-form strings are accepted, but the
+#: elastic-training reasons are named so the launcher, the renderer
+#: (observability.report.render_flight / tools/metrics_report.py) and
+#: tests agree on the spelling:
+#: - ``peer_death``: a surviving worker detected a dead peer via the
+#:   elastic heartbeat and is about to exit for the coordinated restart;
+#: - ``rejoin``: a worker came back at a bumped generation and resumed
+#:   from checkpoint (dumped right after the restore so the trail shows
+#:   what recovery cost).
+REASON_PEER_DEATH = "peer_death"
+REASON_REJOIN = "rejoin"
+
 #: ring capacity; read once from core.flags at first record so the flag
 #: can be set before any event lands (same pattern as events._buffer).
 _CAPACITY_FLAG = "observability_flight_events"
@@ -79,7 +91,8 @@ class FlightRecorder:
     def dump_dir(self) -> Optional[str]:
         return os.environ.get(FLIGHT_DIR_ENV) or None
 
-    def dump_dict(self, reason: str, exc: Optional[BaseException] = None
+    def dump_dict(self, reason: str, exc: Optional[BaseException] = None,
+                  context: Optional[Dict[str, Any]] = None
                   ) -> Dict[str, Any]:
         from .metrics import registry
 
@@ -93,6 +106,10 @@ class FlightRecorder:
             "events": self.snapshot(),
             "metrics": registry.to_dict(),
         }
+        if context:
+            # who/where fields the dumping site knows but the recorder
+            # doesn't (worker rank, elastic generation, dead peer, step)
+            d["context"] = dict(context)
         if exc is not None:
             d["exception"] = {
                 "type": type(exc).__name__,
@@ -109,7 +126,8 @@ class FlightRecorder:
         return d
 
     def dump(self, reason: str, exc: Optional[BaseException] = None,
-             path: Optional[str] = None) -> Optional[str]:
+             path: Optional[str] = None,
+             context: Optional[Dict[str, Any]] = None) -> Optional[str]:
         """Write the post-mortem JSON; returns the path, or None when no
         target directory is configured. Must never raise — it runs from
         excepthooks and watchdog threads."""
@@ -123,7 +141,7 @@ class FlightRecorder:
                     self._dump_seq += 1
                     path = os.path.join(
                         d, f"flight-{os.getpid()}-{self._dump_seq}.json")
-                doc = self.dump_dict(reason, exc)
+                doc = self.dump_dict(reason, exc, context=context)
                 tmp = f"{path}.tmp.{os.getpid()}"
                 with open(tmp, "w") as f:
                     json.dump(doc, f, indent=1, default=str)

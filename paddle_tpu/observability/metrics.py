@@ -39,6 +39,8 @@ CLAIMED_SUBSYSTEMS = {
     "device",      # observability/runtime.py — HBM gauges (device/memory.py)
     "comm",        # distributed/communication — collectives + watchdog
     "io",          # io/dataloader.py — prefetch queue depth / wait time
+    "elastic",     # distributed/elastic.py — restarts, re-rendezvous,
+                   # peer deaths, checkpoint-restore cost (ROADMAP item 1)
     "test",        # scratch names registered by the test suite
 }
 

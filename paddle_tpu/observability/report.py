@@ -221,11 +221,27 @@ def render_flight(d: Dict[str, Any], max_events: int = 50,
     if not isinstance(d, dict) or d.get("kind") != FLIGHT_DUMP_KIND:
         raise ValueError("not a flight-recorder dump: kind != "
                          f"{FLIGHT_DUMP_KIND!r}")
-    lines = [f"FLIGHT RECORDER DUMP — reason: {d.get('reason', '?')}",
+    reason = d.get("reason", "?")
+    lines = [f"FLIGHT RECORDER DUMP — reason: {reason}",
              f"pid {d.get('pid', '?')}  generated "
              + time.strftime("%Y-%m-%d %H:%M:%S",
                              time.localtime(_as_num(d.get("generated_unix",
                                                           0))))]
+    ctx = d.get("context")
+    if ctx:
+        lines.append("context: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(ctx.items())))
+    # elastic-training post-mortems get a one-line interpretation so an
+    # operator triaging a directory of per-worker dumps reads the story
+    # without knowing the reason vocabulary
+    if reason == "peer_death":
+        lines.append(
+            "(a peer worker's elastic heartbeat went stale; this worker "
+            "dumped and exited for the coordinated restart)")
+    elif reason == "rejoin":
+        lines.append(
+            "(this worker re-rendezvoused at a new generation and "
+            "resumed from the latest checkpoint)")
     mem = d.get("device_memory")
     if mem:
         lines.append(

@@ -1,0 +1,103 @@
+"""Elastic SPMD training worker for test_elastic_recovery.py.
+
+Launched by the REAL launcher (``python -m paddle_tpu.distributed.launch``)
+as N processes that jax.distributed-initialize into ONE global mesh; the
+jitted train step is sharded across process boundaries (batch split over
+``dp``, parameters replicated, gradient psum crossing hosts). With
+world=1 (no launcher) the same script is the uninterrupted reference run
+— the mesh just covers this process's virtual devices.
+
+Training is a deterministic linear regression: the batch for step i is a
+pure function of i, so the loss at step i depends only on the parameters
+entering it — which is exactly what makes the kill-and-resume loss-curve
+continuation comparable against the reference run.
+
+Config via env (set by the test):
+  PTPU_ELASTIC_STEPS     total steps (default 8)
+  PTPU_ELASTIC_CKPT      checkpoint dir (optional; ckpt_every=1)
+  PTPU_ELASTIC_LOSS_LOG  rank-0 appends "<gen> <step> <loss>" lines
+"""
+import os
+import sys
+
+os.environ["PADDLE_USE_JAX_COORDINATOR"] = "1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import elastic_train as et
+
+STEPS = int(os.environ.get("PTPU_ELASTIC_STEPS", "8"))
+CKPT_DIR = os.environ.get("PTPU_ELASTIC_CKPT") or None
+LOSS_LOG = os.environ.get("PTPU_ELASTIC_LOSS_LOG") or None
+
+GLOBAL_BATCH = 8
+FEATURES = 4
+LR = 0.2
+W_TRUE = (np.arange(FEATURES, dtype=np.float32).reshape(FEATURES, 1)
+          / FEATURES)
+
+
+def _batch(step):
+    """Step's global batch — identical on every process by construction."""
+    rng = np.random.RandomState(1000 + step)
+    x = rng.rand(GLOBAL_BATCH, FEATURES).astype(np.float32)
+    y = (x @ W_TRUE + 0.5).astype(np.float32)
+    return x, y
+
+
+def build_state(mesh):
+    return {
+        "w": Tensor._from_value(
+            et.replicate(mesh, np.zeros((FEATURES, 1), np.float32))),
+        "b": Tensor._from_value(
+            et.replicate(mesh, np.zeros((1,), np.float32))),
+    }
+
+
+@jax.jit
+def _compiled_step(w, b, x, y):
+    def loss_fn(w, b):
+        return jnp.mean((x @ w + b - y) ** 2)
+
+    loss, (gw, gb) = jax.value_and_grad(loss_fn, argnums=(0, 1))(w, b)
+    return loss, w - LR * gw, b - LR * gb
+
+
+def train_step(state, step, mesh):
+    x, y = _batch(step)
+    loss, w2, b2 = _compiled_step(state["w"]._value, state["b"]._value,
+                                  et.shard_batch(mesh, x),
+                                  et.shard_batch(mesh, y))
+    state["w"]._replace_value(w2)
+    state["b"]._replace_value(b2)
+    return loss
+
+
+def on_step(step, loss):
+    from paddle_tpu.distributed.env import get_rank
+
+    if LOSS_LOG and get_rank() == 0:
+        gen = os.environ.get("PADDLE_RESTART_GEN", "0")
+        with open(LOSS_LOG, "a") as f:
+            f.write(f"{gen} {step} {loss:.10f}\n")
+
+
+def main():
+    result = et.run_elastic(build_state, train_step, STEPS,
+                            ckpt_dir=CKPT_DIR, ckpt_every=1,
+                            on_step=on_step)
+    print(f"ELASTIC WORKER rank={result.rank} world={result.world} "
+          f"gen={result.generation} start={result.start_step} "
+          f"resumed_from={result.resumed_from} "
+          f"ran={len(result.losses)} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

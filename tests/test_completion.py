@@ -284,8 +284,6 @@ class TestFallbackWarning:
     def test_unmapped_structural_prim_warns_once(self):
         import warnings
 
-        from paddle_tpu.distributed.auto_parallel import completion as C
-
         class KronNet(paddle.nn.Layer):
             def __init__(self):
                 super().__init__()
@@ -298,13 +296,24 @@ class TestFallbackWarning:
 
         paddle.seed(0)
         mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
-        C._warned_prims.discard("kron_p")
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
             derive_shard_plan(KronNet(), [((4, 8), "float32")], mesh)
         msgs = [str(x.message) for x in w
                 if "placement completion" in str(x.message)]
         assert msgs and "kron_p" in msgs[0], msgs
+
+        # the warned set is scoped PER complete_placements call
+        # (ADVICE round-5): a second plan derivation on another model
+        # hitting the same unmapped prim must report its own fallback,
+        # not inherit the first derivation's suppression
+        with warnings.catch_warnings(record=True) as w2:
+            warnings.simplefilter("always")
+            derive_shard_plan(KronNet(), [((4, 8), "float32")], mesh)
+        msgs2 = [str(x.message) for x in w2
+                 if "placement completion" in str(x.message)]
+        assert msgs2 and "kron_p" in msgs2[0], \
+            f"second derivation lost its fallback warning: {msgs2}"
 
     def test_known_structural_prims_do_not_warn(self):
         """The curated dim-correspondence set (reductions, slices, sdpa,

@@ -1,6 +1,8 @@
 """Distributed tests on the 8-device virtual CPU mesh (reference pattern:
 test/auto_parallel/ + test/collective/ run on local devices;
 here the mesh axes stand in for process groups)."""
+import time
+
 import numpy as np
 import pytest
 
@@ -346,6 +348,72 @@ class TestShardWiseCheckpoint:
         handle = ckpt.save_state_dict({"w": t}, path, async_save=True)
         with pytest.raises(OSError, match="injected"):
             handle.wait()
+
+    def test_failed_async_save_does_not_poison_the_retry(self, tmp_path,
+                                                         monkeypatch):
+        """Error-attribution fix (ADVICE round-5): a failed, never-awaited
+        async save used to re-raise from inside the NEXT save on the same
+        path, killing the retry. Now the retry save runs (the earlier
+        failure is reported as a warning naming the earlier save) and
+        produces a loadable checkpoint — the path elastic resume depends
+        on."""
+        import warnings as _warnings
+
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        mesh = dist.ProcessMesh(np.arange(8), ["x"])
+        w = _r(8, 8)
+        t = dist.shard_tensor(w.copy(), mesh, [dist.Shard(0)])
+        path = str(tmp_path / "ckpt_retry")
+
+        real_save = ckpt.np.save
+        boom = {"armed": True}
+
+        def _flaky(*a, **kw):
+            if boom["armed"]:
+                raise OSError("disk full (injected)")
+            return real_save(*a, **kw)
+
+        monkeypatch.setattr(ckpt.np, "save", _flaky)
+        h1 = ckpt.save_state_dict({"w": t}, path, async_save=True)
+        # never await h1 — let the writer fail in the background
+        while h1._thread is not None and h1._thread.is_alive():
+            time.sleep(0.01)
+        boom["armed"] = False
+
+        # the RETRY save must execute and succeed, with the earlier
+        # failure surfaced as a warning attributed to the earlier save
+        with pytest.warns(RuntimeWarning, match="earlier async"):
+            h2 = ckpt.save_state_dict({"w": t}, path, async_save=True)
+        h2.wait()
+        out = {"w": dist.shard_tensor(np.zeros((8, 8), "float32"), mesh,
+                                      [dist.Shard(0)])}
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")   # the clean load must not warn
+            dist.checkpoint.load_state_dict(out, path)
+        np.testing.assert_allclose(out["w"].numpy(), w)
+
+    def test_failed_async_save_blocks_load_with_attribution(
+            self, tmp_path, monkeypatch):
+        """A load auto-joining a FAILED writer must refuse with the
+        failure attributed to the earlier save (reading half-written
+        files would be corruption, not degraded service)."""
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        mesh = dist.ProcessMesh(np.arange(8), ["x"])
+        t = dist.shard_tensor(_r(8, 8), mesh, [dist.Shard(0)])
+        path = str(tmp_path / "ckpt_loadfail")
+
+        def _boom(*a, **kw):
+            raise OSError("disk full (injected)")
+
+        monkeypatch.setattr(ckpt.np, "save", _boom)
+        ckpt.save_state_dict({"w": t}, path, async_save=True)
+        out = {"w": dist.shard_tensor(np.zeros((8, 8), "float32"), mesh,
+                                      [dist.Shard(0)])}
+        with pytest.raises(RuntimeError,
+                           match="earlier async save_state_dict"):
+            dist.checkpoint.load_state_dict(out, path)
 
     def test_peak_host_memory_stays_shard_sized(self, tmp_path):
         """Shard-wise load must assemble per-PIECE buffers, never the

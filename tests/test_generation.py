@@ -664,6 +664,16 @@ class TestGenerationKnobs:
             model.generate(paddle.to_tensor(ids), max_new_tokens=2,
                            length_penalty=1.0)
 
+    def test_min_length_without_eos_rejected(self):
+        """min_length works by masking eos; with eos_token_id=None it was
+        a silent no-op — the module's no-silently-ignored-arguments
+        posture demands a ValueError instead (ADVICE round-5)."""
+        model = _model()
+        ids = np.array([[1, 2, 3]], dtype="int64")
+        with pytest.raises(ValueError, match="min_length"):
+            model.generate(paddle.to_tensor(ids), max_new_tokens=2,
+                           min_length=2, eos_token_id=None)
+
 
 class TestErnieMoeGeneration:
     """The MoE family decodes through the same cached scan: per-step

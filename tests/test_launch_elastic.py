@@ -241,6 +241,83 @@ class TestElasticManager:
         m3.deregister()
         assert m1.check_scale() == ElasticStatus.HOLD  # back below min
 
+    def test_dead_members_is_the_positive_death_signal(self):
+        """dead_members lists only members that registered AND went
+        stale — a joining node with no heartbeat yet is not 'dead'."""
+        from paddle_tpu.distributed.elastic import ElasticManager
+
+        store = self._store()
+        m1 = ElasticManager(store, "a", np_range="1:2", dead_after_s=0.5)
+        m2 = ElasticManager(store, "b", np_range="1:2", dead_after_s=0.5)
+        m1.register()
+        m2.register()
+        assert m1.dead_members() == []
+        time.sleep(0.8)
+        m1.heartbeat()          # only a stays fresh; b goes stale
+        assert m1.dead_members() == ["b"]
+        assert m1.alive_members() == ["a"]
+
+    def test_generation_bump_on_rerendezvous(self):
+        """The shared generation counter: every member reads 0 until a
+        restart bumps it atomically; concurrent bumps from several
+        members never lose an increment (each incident advances the
+        world exactly as many times as it was bumped)."""
+        from paddle_tpu.distributed.elastic import ElasticManager
+
+        store = self._store()
+        m1 = ElasticManager(store, "a", np_range="1:2")
+        m2 = ElasticManager(store, "b", np_range="1:2")
+        assert m1.generation() == 0 and m2.generation() == 0
+        assert m1.bump_generation() == 1
+        # every member observes the new generation (re-rendezvous signal)
+        assert m2.generation() == 1
+        got = []
+        threads = [threading.Thread(
+            target=lambda m=m: got.append(m.bump_generation()))
+            for m in (m1, m2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert sorted(got) == [2, 3]      # atomic: no lost bump
+        assert m1.generation() == 3
+
+    def test_peer_monitor_fires_on_stale_heartbeat(self):
+        """PeerMonitor keeps OUR heartbeat fresh while watching peers,
+        and fires on_death exactly once when a peer goes stale."""
+        from paddle_tpu.distributed.elastic import (
+            ElasticManager, PeerMonitor,
+        )
+
+        store = self._store()
+        alive = ElasticManager(store, "0", np_range="1:2",
+                               dead_after_s=0.6)
+        victim = ElasticManager(store, "1", np_range="1:2",
+                                dead_after_s=0.6)
+        alive.register()
+        victim.register()
+        deaths = []
+        mon = PeerMonitor(alive, ["0", "1"], deaths.append,
+                          poll_interval_s=0.1)
+        assert mon.expected == ["1"]      # never watches itself
+        mon.start()
+        try:
+            # victim heartbeats for a while: no death call
+            for _ in range(4):
+                victim.heartbeat()
+                time.sleep(0.1)
+            assert deaths == []
+            # victim stops heartbeating -> death fires within ~dead_after
+            deadline = time.time() + 5
+            while not deaths and time.time() < deadline:
+                time.sleep(0.05)
+            assert deaths == ["1"]
+            # our own heartbeat stayed fresh the whole time (the monitor
+            # beats for us while the main thread is 'training')
+            assert "0" in alive.alive_members()
+        finally:
+            mon.stop()
+
     def test_watch_relaunches_until_success(self):
         from paddle_tpu.distributed.elastic import (
             ElasticManager, ElasticStatus,

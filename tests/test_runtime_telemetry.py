@@ -238,11 +238,20 @@ class TestWatchdogMetrics:
                 warnings.simplefilter("always")
                 tid = m.start_task("probe_rendezvous", timeout_s=0.01)
                 deadline = time.time() + 5.0
+
+                # only COMPLETED dumps count: the writer lands a
+                # .tmp.<pid> first and os.replace's it into place, so a
+                # raw listdir can race the rename
+                def _dumps():
+                    if not os.path.isdir(flight_dir):
+                        return []
+                    return [f for f in os.listdir(flight_dir)
+                            if f.startswith("flight-")
+                            and f.endswith(".json")]
+
                 # the flight dump is the scan's LAST overdue action, so
                 # once the file exists the warning/metrics all landed too
-                while not (os.path.isdir(flight_dir)
-                           and os.listdir(flight_dir)) \
-                        and time.time() < deadline:
+                while not _dumps() and time.time() < deadline:
                     time.sleep(0.02)
                 m.end_task(tid)
             g = obs.registry.get
@@ -255,7 +264,7 @@ class TestWatchdogMetrics:
             (ev,) = obs.events("comm.task_overdue")
             assert ev.fields["name"] == "probe_rendezvous"
             assert ev.fields["timeout_s"] == 0.01
-            dumps = os.listdir(flight_dir)
+            dumps = _dumps()
             assert len(dumps) == 1
             d = json.loads((flight_dir / dumps[0]).read_text())
             assert d["reason"] == "watchdog_timeout"

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Fault-injection launcher: run an elastic training job on localhost
+and SIGKILL a chosen worker at a chosen step, then verify recovery.
+
+The drill the elastic layer exists for, as one command::
+
+    python tools/chaos_launch.py train.py \\
+        --nnodes 2 --kill_rank 1 --kill_step 5 \\
+        --flight_dir /tmp/flight -- --your-script-args
+
+spawns ``--nnodes`` real `paddle_tpu.distributed.launch` controllers on
+localhost (the CI device trick: each worker gets
+``--xla_force_host_platform_device_count`` virtual CPU devices, so the
+global mesh spans processes without chips). The worker whose global rank
+is ``--kill_rank`` SIGKILLs itself after completing step ``--kill_step``
+(fault injection rides ``PADDLE_TPU_CHAOS_KILL_*``, read by
+``distributed.elastic_train``). Survivors detect the death by stale
+heartbeat, dump flight-recorder post-mortems (reason ``peer_death``)
+into ``--flight_dir``, and exit for the coordinated restart; the rejoined
+world resumes from the latest complete checkpoint and dumps ``rejoin``.
+
+Afterwards the tool prints each node's exit code and a one-line summary
+of every flight dump it finds (render them fully with
+``python tools/metrics_report.py <flight_dir>``).
+
+The training script must drive its loop through
+``paddle_tpu.distributed.elastic_train.run_elastic`` (or honor the same
+chaos/checkpoint conventions) for the kill point and the resume to mean
+anything.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _free_port_block(span: int = 8) -> int:
+    """Base port with `span` consecutive free ports (launcher store,
+    jax coordinator, trainer store ride base, +1..+3)."""
+    for _ in range(64):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+        s.close()
+        if base + span >= 65535:
+            continue
+        ok = True
+        for off in range(1, span):
+            t = socket.socket()
+            try:
+                t.bind(("127.0.0.1", base + off))
+            except OSError:
+                ok = False
+            finally:
+                t.close()
+            if not ok:
+                break
+        if ok:
+            return base
+    raise RuntimeError("no free port block found")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("training_script")
+    ap.add_argument("--nnodes", type=int, default=2)
+    ap.add_argument("--kill_rank", type=int, default=1,
+                    help="global worker rank to SIGKILL")
+    ap.add_argument("--kill_step", type=int, default=2,
+                    help="step after which the victim dies")
+    ap.add_argument("--kill_gen", type=int, default=0,
+                    help="only kill at this restart generation "
+                         "(default 0: the first incarnation)")
+    ap.add_argument("--devices_per_proc", type=int, default=2,
+                    help="virtual CPU devices per worker "
+                         "(xla_force_host_platform_device_count)")
+    ap.add_argument("--max_restarts", type=int, default=3)
+    ap.add_argument("--log_dir", type=str, default="chaos_log")
+    ap.add_argument("--flight_dir", type=str, default=None,
+                    help="flight-recorder dump directory "
+                         "(default: <log_dir>/flight)")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("script_args", nargs=argparse.REMAINDER,
+                    help="args after -- go to the training script")
+    args = ap.parse_args(argv)
+
+    flight_dir = args.flight_dir or os.path.join(args.log_dir, "flight")
+    os.makedirs(args.log_dir, exist_ok=True)
+    port = _free_port_block()
+    master = f"127.0.0.1:{port}"
+    script_args = [a for a in args.script_args if a != "--"]
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (_REPO_ROOT + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                        f"{args.devices_per_proc}")
+
+    procs = []
+    for rank in range(args.nnodes):
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nnodes", str(args.nnodes), "--node_rank", str(rank),
+               "--master", master, "--log_dir", args.log_dir,
+               "--max_restarts", str(args.max_restarts),
+               "--flight_dir", flight_dir,
+               "--chaos_kill_rank", str(args.kill_rank),
+               "--chaos_kill_step", str(args.kill_step),
+               args.training_script] + script_args
+        node_env = dict(env)
+        node_env["PADDLE_TPU_CHAOS_KILL_GEN"] = str(args.kill_gen)
+        procs.append(subprocess.Popen(cmd, env=node_env))
+
+    rcs = []
+    try:
+        for p in procs:
+            rcs.append(p.wait(timeout=args.timeout))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        print("chaos_launch: TIMED OUT — job did not finish; see "
+              f"{args.log_dir}/workerlog.*", file=sys.stderr)
+        return 2
+
+    print(f"chaos_launch: node exit codes {rcs}")
+    dumps = sorted(glob.glob(os.path.join(flight_dir, "flight-*.json")))
+    for path in dumps:
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        ctx = d.get("context") or {}
+        ctx_s = " ".join(f"{k}={v}" for k, v in sorted(ctx.items()))
+        print(f"  {os.path.basename(path)}: reason={d.get('reason')} "
+              f"{ctx_s}")
+    if dumps:
+        print(f"chaos_launch: render dumps with: python "
+              f"tools/metrics_report.py {flight_dir}")
+    if any(rcs):
+        print("chaos_launch: FAILED — a node exited non-zero after "
+              "exhausting restarts", file=sys.stderr)
+        return 1
+    reasons = set()
+    for path in dumps:
+        try:
+            with open(path) as f:
+                reasons.add(json.load(f).get("reason"))
+        except (OSError, json.JSONDecodeError):
+            pass
+    if "peer_death" in reasons and "rejoin" in reasons:
+        print("chaos_launch: OK — worker killed, peers dumped, world "
+              "re-formed and resumed from checkpoint")
+    else:
+        print("chaos_launch: job finished clean but expected "
+              f"peer_death+rejoin dumps, saw {sorted(reasons)} — did the "
+              "kill point fire before the job ended?", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

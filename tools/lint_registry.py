@@ -122,6 +122,15 @@ def check_all_exports() -> List[str]:
 #: the per-mesh accounting the subsystem exists for.
 COLLECTIVE_REQUIRED_LABELS = ("group", "op")
 
+#: same discipline for the elastic recovery series: a restart that can't
+#: say WHY, or a peer death that can't say WHO, is an alert nobody can
+#: act on. Keys are metric names, values the labels every recorded
+#: series must carry.
+ELASTIC_REQUIRED_LABELS = {
+    "elastic.restarts": ("reason",),
+    "elastic.peer_deaths": ("peer",),
+}
+
 
 def check_metric_registry() -> List[str]:
     from paddle_tpu import observability
@@ -130,6 +139,7 @@ def check_metric_registry() -> List[str]:
     # device./comm./io. subsystems even when the workload under test
     # never touched them
     import paddle_tpu.distributed.communication.watchdog  # noqa: F401
+    import paddle_tpu.distributed.elastic  # noqa: F401
     import paddle_tpu.io.dataloader  # noqa: F401
     import paddle_tpu.observability.runtime  # noqa: F401
     from paddle_tpu.observability.metrics import (CLAIMED_SUBSYSTEMS,
@@ -172,6 +182,16 @@ def check_metric_registry() -> List[str]:
                         f"required label(s) {missing} — collective metrics "
                         f"must be attributable to a mesh axis (label every "
                         f"record with op= and group=)")
+        required = ELASTIC_REQUIRED_LABELS.get(m.name)
+        if required:
+            for labels in m.labelsets():
+                missing = [k for k in required if k not in labels]
+                if missing:
+                    problems.append(
+                        f"metric {m.name!r}: series {labels!r} is missing "
+                        f"required label(s) {missing} — elastic recovery "
+                        f"series must attribute the incident (who died / "
+                        f"why the restart)")
     return problems
 
 

@@ -4,16 +4,22 @@
 Usage:
     python tools/metrics_report.py metrics.json [--events N] [--top N]
     python tools/metrics_report.py flight-1234-1.json   # flight dumps too
+    python tools/metrics_report.py /tmp/flight_dir      # a whole incident
 
 Input is either the JSON written by ``paddle_tpu.observability.dump(path)``
 (or any workload run with ``PADDLE_TPU_METRICS_DUMP=metrics.json``), or a
 flight-recorder crash dump written to ``PADDLE_TPU_FLIGHT_DIR`` — the
 kind is auto-detected. Metric rows come out grouped by subsystem
-(``dispatch``, ``executor``, ``train``, ``comm``, ``io``, ...); ``--top``
-keeps only the N largest series per metric. Rendering goes through the
-same ``observability.report`` code the in-process ``summary()`` uses, so
-dumps round-trip by construction. Exits non-zero on a file that is
-neither kind of dump.
+(``dispatch``, ``executor``, ``train``, ``comm``, ``elastic``, ...);
+``--top`` keeps only the N largest series per metric. Rendering goes
+through the same ``observability.report`` code the in-process
+``summary()`` uses, so dumps round-trip by construction.
+
+Passing a DIRECTORY renders every ``flight-*.json`` in it — the shape an
+elastic incident leaves behind (each surviving worker dumps
+``peer_death`` when it detects the kill; each rejoined worker dumps
+``rejoin`` after resuming from checkpoint), prefixed by a one-line
+per-dump index. Exits non-zero on a file that is neither kind of dump.
 """
 from __future__ import annotations
 
@@ -29,16 +35,61 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 
+def _render_flight_dir(dirname: str, events, top) -> int:
+    """Render every flight dump in an incident directory, newest last,
+    with a one-line index first so the story (peer_death ... rejoin)
+    reads before the detail."""
+    import glob
+
+    from paddle_tpu.observability.report import render_flight
+
+    paths = sorted(glob.glob(os.path.join(dirname, "flight-*.json")))
+    if not paths:
+        print(f"metrics_report: no flight-*.json dumps in {dirname!r}",
+              file=sys.stderr)
+        return 1
+    docs = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                docs.append((path, json.load(f)))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"metrics_report: skipping {path!r}: {e}",
+                  file=sys.stderr)
+    docs.sort(key=lambda pd: pd[1].get("generated_unix", 0))
+    print(f"{len(docs)} flight dump(s) in {dirname}:")
+    for path, d in docs:
+        ctx = d.get("context") or {}
+        ctx_s = " ".join(f"{k}={v}" for k, v in sorted(ctx.items()))
+        print(f"  {os.path.basename(path)}  reason={d.get('reason')}  "
+              f"pid={d.get('pid')}  {ctx_s}")
+    for path, d in docs:
+        print("\n" + "=" * 72)
+        print(os.path.basename(path))
+        print("=" * 72)
+        n_events = (len(d.get("events") or []) if events is None
+                    else events)
+        try:
+            print(render_flight(d, max_events=n_events, top=top))
+        except ValueError as e:
+            print(f"metrics_report: {path!r}: {e}", file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("dump", help="JSON written by observability.dump() or "
-                                 "a flight-recorder crash dump")
+    ap.add_argument("dump", help="JSON written by observability.dump(), a "
+                                 "flight-recorder crash dump, or a "
+                                 "directory of flight dumps")
     ap.add_argument("--events", type=int, default=None,
                     help="how many trailing events to show (default 20 for "
                          "metrics dumps, the full ring for flight dumps)")
     ap.add_argument("--top", type=int, default=None,
                     help="show only the N largest series per metric")
     args = ap.parse_args(argv)
+
+    if os.path.isdir(args.dump):
+        return _render_flight_dir(args.dump, args.events, args.top)
 
     try:
         with open(args.dump) as f:
