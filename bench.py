@@ -121,6 +121,12 @@ def _emit_metrics_block():
             round(hist_sum("elastic.rerendezvous_seconds"), 3),
         "elastic_checkpoint_restore_seconds":
             round(hist_sum("elastic.checkpoint_restore_seconds"), 3),
+        # fleet telemetry roll-ups (observability/fleet.py; nonzero only
+        # for multi-process runs shipping snapshots / aggregating skew)
+        "fleet_ranks_reporting": gauge_max("fleet.ranks_reporting"),
+        "fleet_step_skew_seconds": gauge_max("fleet.step_skew_seconds"),
+        "fleet_stragglers_detected": tot("fleet.stragglers_detected"),
+        "fleet_ship_failures": tot("fleet.ship_failures"),
     }}), flush=True)
 
 

@@ -52,8 +52,8 @@ from .launch_utils import ELASTIC_PEER_EXIT
 
 __all__ = [
     "global_mesh", "shard_batch", "replicate", "chaos_config",
-    "maybe_chaos_kill", "CheckpointManager", "run_elastic",
-    "ElasticRunResult",
+    "maybe_chaos_kill", "chaos_slow_config", "maybe_chaos_slow",
+    "CheckpointManager", "run_elastic", "ElasticRunResult",
 ]
 
 
@@ -118,6 +118,26 @@ def maybe_chaos_kill(step: int, rank: int, generation: int):
         print(f"paddle_tpu chaos: SIGKILL rank {rank} after step {step} "
               f"(generation {generation})", file=sys.stderr, flush=True)
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+def chaos_slow_config() -> Optional[Tuple[int, float]]:
+    """(slow_rank, extra_seconds_per_step) from the environment, or None
+    when slow-rank injection is off."""
+    r = os.environ.get("PADDLE_TPU_CHAOS_SLOW_RANK")
+    s = os.environ.get("PADDLE_TPU_CHAOS_SLOW_SECONDS")
+    if r is None or s is None:
+        return None
+    return int(r), float(s)
+
+
+def maybe_chaos_slow(step: int, rank: int):
+    """Straggler injection: sleep inside the bracketed step region on
+    the chosen rank — emulates slow host-side work (input pipeline, a
+    contended host) so fleet-telemetry drills (tools/chaos_launch.py
+    --slow_rank) have a rank to attribute."""
+    cfg = chaos_slow_config()
+    if cfg is not None and rank == cfg[0]:
+        time.sleep(cfg[1])
 
 
 # -- checkpoint schedule -------------------------------------------------
@@ -344,6 +364,21 @@ def run_elastic(build_state: Callable[[Mesh], Dict[str, Any]],
                          dead_after_s=dead_after)
     mgr.register()
 
+    # fleet telemetry (observability.fleet): launched with --fleet_dir,
+    # every worker ships registry/event snapshots over the SAME
+    # launcher-hosted store the heartbeats ride, after a clock handshake
+    # with the launcher-side aggregator. Shipping never raises — a dead
+    # store costs fleet.ship_failures, not the training run.
+    reporter = None
+    if os.environ.get(_obs.fleet.FLEET_ENV):
+        _obs.enable()
+        reporter = _obs.fleet.FleetReporter(
+            estore, rank, world, generation=generation, job_id=job_id,
+            interval_s=float(os.environ.get(
+                _obs.fleet.FLEET_INTERVAL_ENV, "1.0") or 1.0))
+        reporter.handshake()
+        reporter.start()
+
     state = build_state(mesh)
     ckpt = None
     resumed_from = None
@@ -404,7 +439,15 @@ def run_elastic(build_state: Callable[[Mesh], Dict[str, Any]],
     losses: List[Tuple[int, float]] = []
     try:
         for step in range(start_step, num_steps):
-            loss = float(train_step(state, step, mesh))
+            # step_region records train.step_seconds + the train.step
+            # event (rank/generation fields ride into flight dumps and
+            # the fleet merged timeline); chaos slow sits INSIDE the
+            # region so an injected straggler shows in the telemetry it
+            # is meant to exercise
+            with _obs.step_region("elastic_train", step=step,
+                                  rank=rank, generation=generation):
+                maybe_chaos_slow(step, rank)
+                loss = float(train_step(state, step, mesh))
             losses.append((step, loss))
             progress_box["step"] = step
             if ckpt is not None:
@@ -418,6 +461,8 @@ def run_elastic(build_state: Callable[[Mesh], Dict[str, Any]],
             ckpt.finalize()
         barrier()   # nobody stops heartbeating while a peer still trains
     finally:
+        if reporter is not None:
+            reporter.close()   # ships the final (complete) snapshot
         if monitor is not None:
             monitor.stop()
         try:

@@ -26,6 +26,17 @@ def main():
                    help="arm the flight recorder in every worker: "
                         "post-mortem JSON dumps (peer_death / rejoin / "
                         "crash) land in this directory")
+    p.add_argument("--fleet_dir", type=str, default=None,
+                   help="fleet telemetry: workers ship metric/event "
+                        "snapshots over the launcher store; node 0 "
+                        "aggregates them (counters summed, gauges "
+                        "rank-labeled, straggler detection) into "
+                        "fleet_metrics.json + a merged clock-aligned "
+                        "fleet_trace.json in this directory")
+    p.add_argument("--metrics_dump", type=str, default=None,
+                   help="base PADDLE_TPU_METRICS_DUMP path; each worker "
+                        "writes <base>.rank<N>.json (an inherited env "
+                        "path is rewritten the same way)")
     p.add_argument("--chaos_kill_rank", type=int, default=None,
                    help="fault injection: the worker with this global "
                         "rank SIGKILLs itself ...")
@@ -33,6 +44,13 @@ def main():
                    help="... after completing this training step "
                         "(requires a run_elastic training loop; see "
                         "tools/chaos_launch.py)")
+    p.add_argument("--chaos_slow_rank", type=int, default=None,
+                   help="straggler injection: this worker rank sleeps "
+                        "--chaos_slow_seconds inside every step region "
+                        "(fleet-telemetry drill)")
+    p.add_argument("--chaos_slow_seconds", type=float, default=None,
+                   help="extra host-side seconds per step for the slow "
+                        "rank")
     p.add_argument("--devices", "--gpus", type=str, default=None,
                    help="accepted for parity; chips are mesh-addressed")
     p.add_argument("--nproc_per_node", type=int, default=None,
@@ -46,6 +64,10 @@ def main():
         os.environ["PADDLE_TPU_CHAOS_KILL_RANK"] = str(a.chaos_kill_rank)
         os.environ["PADDLE_TPU_CHAOS_KILL_STEP"] = str(a.chaos_kill_step)
         os.environ.setdefault("PADDLE_TPU_CHAOS_KILL_GEN", "0")
+    if a.chaos_slow_rank is not None and a.chaos_slow_seconds is not None:
+        os.environ["PADDLE_TPU_CHAOS_SLOW_RANK"] = str(a.chaos_slow_rank)
+        os.environ["PADDLE_TPU_CHAOS_SLOW_SECONDS"] = \
+            str(a.chaos_slow_seconds)
 
     if ":" in a.nnodes:
         # elastic mode: supervise relaunches within the np range.
@@ -68,7 +90,8 @@ def main():
             rank = rank_map.get(str(a.node_rank), a.node_rank)
             return launch(a.training_script, a.training_script_args,
                           len(rank_map), rank, inner_master, a.log_dir,
-                          a.max_restarts, a.job_id, a.flight_dir)
+                          a.max_restarts, a.job_id, a.flight_dir,
+                          a.fleet_dir, a.metrics_dump)
 
         status = mgr.watch(launcher_fn)
         sys.exit(0 if status == "completed" else 1)
@@ -76,7 +99,7 @@ def main():
     sys.exit(
         launch(a.training_script, a.training_script_args, int(a.nnodes),
                a.node_rank, a.master, a.log_dir, a.max_restarts, a.job_id,
-               a.flight_dir)
+               a.flight_dir, a.fleet_dir, a.metrics_dump)
     )
 
 

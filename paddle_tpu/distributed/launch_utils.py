@@ -113,7 +113,9 @@ class CollectiveController:
                  nnodes: int = 1, node_rank: int = 0,
                  master: Optional[str] = None, log_dir: str = "log",
                  max_restarts: int = 0, job_id: str = "default",
-                 flight_dir: Optional[str] = None):
+                 flight_dir: Optional[str] = None,
+                 fleet_dir: Optional[str] = None,
+                 metrics_dump: Optional[str] = None):
         self.training_script = training_script
         self.args = list(args)
         self.nnodes = nnodes
@@ -123,7 +125,10 @@ class CollectiveController:
         self.max_restarts = max_restarts
         self.job_id = job_id
         self.flight_dir = flight_dir
+        self.fleet_dir = fleet_dir
+        self.metrics_dump = metrics_dump
         self._store = None
+        self._aggregator = None
 
     # -- rendezvous (reference: controllers/master.py) -------------------
     def _rendezvous(self):
@@ -165,6 +170,23 @@ class CollectiveController:
             # carries the event ring from step 0 and can dump on a peer
             # death without any code in the training script
             env_vars["PADDLE_TPU_FLIGHT_DIR"] = self.flight_dir
+        # per-rank metrics dump: all workers inherit ONE
+        # PADDLE_TPU_METRICS_DUMP path and their atexit dumps would
+        # clobber each other — rewrite it to metrics.rank<N>.json
+        # (mirrors the --flight_dir plumbing above; flight dumps embed
+        # the pid in the filename so they never collided)
+        metrics_dump = self.metrics_dump \
+            or os.environ.get("PADDLE_TPU_METRICS_DUMP")
+        if metrics_dump:
+            from ..observability.fleet import rank_dump_path
+
+            env_vars["PADDLE_TPU_METRICS_DUMP"] = rank_dump_path(
+                metrics_dump, self.node_rank)
+        if self.fleet_dir:
+            # fleet telemetry: every worker ships registry/event
+            # snapshots over the launcher-hosted elastic store; the
+            # node-0 controller aggregates them into fleet_dir
+            env_vars["PADDLE_TPU_FLEET"] = "1"
         os.makedirs(self.log_dir, exist_ok=True)
         cmd = [sys.executable, self.training_script] + self.args
         log = os.path.join(self.log_dir, f"workerlog.{self.node_rank}")
@@ -190,6 +212,17 @@ class CollectiveController:
         by generation (PADDLE_RESTART_GEN) so a restarted world can never
         satisfy barriers from the previous incarnation."""
         self._rendezvous()
+        if self.fleet_dir and self.node_rank == 0 \
+                and self._store is not None:
+            # the launcher-anchored telemetry plane: this controller
+            # hosts the store every worker ships snapshots through, so
+            # the fleet view survives any worker's death
+            from ..observability.fleet import FleetAggregator
+
+            self._aggregator = FleetAggregator(
+                self._store, self.nnodes, job_id=self.job_id,
+                out_dir=self.fleet_dir)
+            self._aggregator.start()
         pod = self._build_pod()
         container = pod.containers[0]
         generation = self._peer_generation()
@@ -260,19 +293,40 @@ class CollectiveController:
         except Exception:
             pass  # best-effort: a vanished master must not fail the job
         finally:
+            if self._aggregator is not None:
+                # after the done-key handshake: every node's controller
+                # saw its worker exit, so every worker's final snapshot
+                # is already in the store when this last poll runs
+                try:
+                    self._aggregator.stop()
+                except Exception:
+                    pass
+                self._aggregator = None
             self._store.close()
 
 
 def launch(training_script: str, args: List[str], nnodes: int = 1,
            node_rank: int = 0, master: Optional[str] = None,
            log_dir: str = "log", max_restarts: int = 0,
-           job_id: str = "default", flight_dir: Optional[str] = None):
+           job_id: str = "default", flight_dir: Optional[str] = None,
+           fleet_dir: Optional[str] = None,
+           metrics_dump: Optional[str] = None):
     """Programmatic launcher (CLI in paddle_tpu/distributed/launch/__main__.py).
 
     ``flight_dir`` arms the flight recorder in every spawned worker
     (sets ``PADDLE_TPU_FLIGHT_DIR``): on a peer death, watchdog timeout
-    or crash, each worker writes a post-mortem JSON there."""
+    or crash, each worker writes a post-mortem JSON there.
+
+    ``fleet_dir`` turns on fleet telemetry: workers ship metric/event
+    snapshots through the launcher-hosted store and the node-0
+    controller aggregates them into ``fleet_dir/fleet_metrics.json``
+    (counters summed, gauges rank-labeled, step-skew/straggler
+    detection) plus a merged clock-aligned ``fleet_trace.json``.
+
+    ``metrics_dump`` (or an inherited ``PADDLE_TPU_METRICS_DUMP``) is
+    rewritten per rank as ``<base>.rank<N>.json`` so workers never
+    clobber one dump path."""
     return CollectiveController(
         training_script, args, nnodes, node_rank, master, log_dir,
-        max_restarts, job_id, flight_dir,
+        max_restarts, job_id, flight_dir, fleet_dir, metrics_dump,
     ).run()

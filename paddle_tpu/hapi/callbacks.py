@@ -235,6 +235,12 @@ class MetricsCallback(Callback):
     def on_train_batch_end(self, step, logs=None):
         if self._timer is not None:
             self._timer.end(items=(logs or {}).get("batch_size") or None)
+            # fleet telemetry: ship this worker's snapshot at the step
+            # boundary (rate-limited; a no-op without an active
+            # FleetReporter and never raises into the fit loop)
+            from paddle_tpu.observability import fleet as _fleet
+
+            _fleet.maybe_ship()
 
     def on_train_abort(self, exc=None):
         # fit died between batch-begin and batch-end: close the open
@@ -252,6 +258,8 @@ class MetricsCallback(Callback):
             self._timer.abandon()  # batch-end never came for an open step
             if obs.enabled():
                 obs.sample_device_memory()
+                # push one fresh snapshot carrying the end-of-train state
+                obs.fleet.maybe_ship(min_interval_s=0.0)
         self._timer = None
 
 

@@ -52,6 +52,8 @@ from .report import (dump, dump_dict, render_flight, render_report,
                      summary)
 from . import flight
 from .flight import FlightRecorder
+from . import fleet
+from .fleet import FleetAggregator, FleetReporter
 from .runtime import (StepTimer, default_peak_flops, measure_step_flops,
                       sample_device_memory, step_region)
 
@@ -62,7 +64,8 @@ __all__ = [
     "Event", "emit", "events", "span",
     "dump", "dump_dict", "render_report", "render_flight", "summary",
     "CLAIMED_SUBSYSTEMS", "NAME_RE",
-    "flight", "FlightRecorder", "StepTimer", "step_region",
+    "flight", "FlightRecorder", "fleet", "FleetAggregator",
+    "FleetReporter", "StepTimer", "step_region",
     "sample_device_memory", "measure_step_flops", "default_peak_flops",
 ]
 

@@ -1,0 +1,948 @@
+"""Fleet telemetry plane: cross-rank metric aggregation over the elastic store.
+
+PR 2/5 observability is strictly per-process: each rank owns its metrics
+registry, event ring and flight recorder, and a multi-process incident
+leaves N disconnected dumps. This module is the MegaScale-style fleet
+view on top of them, anchored at the LAUNCHER (whose node-0 controller
+already hosts the elastic rendezvous ``Store`` and outlives any worker):
+
+- **shipping** — each worker's :class:`FleetReporter` periodically (and
+  at exit) publishes a compact :func:`snapshot_dict` of its registry and
+  recent events to ``fleet/<job>/snap/<rank>``, tagged with rank,
+  generation and a clock-offset estimate from a store-ping handshake at
+  rendezvous (:meth:`FleetReporter.handshake`). Shipping must never take
+  down training: every store op is bounded-retry/except and failures
+  only increment ``fleet.ship_failures``.
+- **aggregation** — the launcher-side :class:`FleetAggregator` merges
+  snapshots into one fleet view (:func:`merge_metrics`: counters summed
+  across ranks, gauges kept per-rank under a ``rank`` label, histograms
+  merged bucket-wise) exposed as ``fleet.*`` metrics and one JSON dump,
+  plus a merged Chrome-trace timeline (:func:`write_merged_trace`) where
+  each rank is a process lane with clock-aligned spans.
+- **straggler detection** — the aggregator watches the per-rank
+  ``train.step_seconds`` spread between polls; a rank whose recent mean
+  exceeds ``straggler_ratio`` x the median of its peers for
+  ``straggler_polls`` consecutive polls is flagged: a structured
+  ``fleet.straggler`` event is recorded, ``fleet.stragglers_detected``
+  increments, and a store flag (``fleet/<job>/flight_request/<rank>``)
+  asks the offending worker to write a PR 5 flight dump (reason
+  ``straggler``) — so the drill shows *who* was slow before the loss
+  curve shows *that* something was.
+
+  Caveat for tightly-coupled SPMD: a per-step collective equalizes wall
+  step times across ranks (the straggler slows everyone), so the spread
+  only attributes blame when per-rank *local* work dominates the
+  bracketed region — structure ``obs.step_region()`` around host-side
+  work (input pipeline, per-rank compute) for attribution, exactly the
+  reason MegaScale times per-phase, not per-step.
+
+``tools/metrics_report.py --fleet <dir>`` renders a directory of
+per-rank metric dumps + flight dumps + the aggregated fleet dump as one
+incident (:func:`load_incident_dir` / :func:`render_incident`).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import flight
+from .events import events as _list_events
+from .metrics import registry
+
+__all__ = [
+    "FLEET_ENV", "FLEET_INTERVAL_ENV", "FLEET_POLL_ENV",
+    "SNAPSHOT_KIND", "FLEET_DUMP_KIND",
+    "snapshot_dict", "merge_metrics", "merged_trace_events",
+    "write_merged_trace", "merge_chrome_trace_files", "rank_dump_path",
+    "FleetReporter", "FleetAggregator", "active_reporter", "maybe_ship",
+    "load_incident_dir", "render_incident",
+]
+
+#: set to "1" in every worker by the launcher when --fleet_dir is given:
+#: run_elastic builds a FleetReporter on the elastic store.
+FLEET_ENV = "PADDLE_TPU_FLEET"
+#: worker snapshot publish period, seconds (default 1.0).
+FLEET_INTERVAL_ENV = "PADDLE_TPU_FLEET_INTERVAL"
+#: aggregator poll period, seconds (default 0.5).
+FLEET_POLL_ENV = "PADDLE_TPU_FLEET_POLL"
+#: straggler threshold: recent mean > ratio x peer median (default 2.0).
+STRAGGLER_RATIO_ENV = "PADDLE_TPU_FLEET_STRAGGLER_RATIO"
+#: consecutive over-threshold polls before a straggler fires (default 2).
+STRAGGLER_POLLS_ENV = "PADDLE_TPU_FLEET_STRAGGLER_POLLS"
+#: clock handshake wait for the aggregator's pong, seconds (default 3).
+HANDSHAKE_TIMEOUT_ENV = "PADDLE_TPU_FLEET_HANDSHAKE_TIMEOUT"
+
+SNAPSHOT_KIND = "fleet_snapshot"
+FLEET_DUMP_KIND = "fleet_dump"
+FLEET_VERSION = 1
+
+# -- the fleet. subsystem (claimed in metrics.CLAIMED_SUBSYSTEMS).
+# Label discipline (audited by tools/lint_registry.py): per-rank series
+# carry rank=, failure counters carry reason=, fleet-level gauges carry
+# job= — a fleet gauge with NO labels cannot be attributed and is a lint
+# error.
+M_SHIP_FAILURES = registry.counter(
+    "fleet.ship_failures",
+    "worker snapshot publishes that failed after bounded retries, by "
+    "exception class (shipping never raises into the train loop)")
+M_SNAPSHOTS_SHIPPED = registry.counter(
+    "fleet.snapshots_shipped",
+    "telemetry snapshots this worker published to the fleet store, "
+    "by rank")
+M_CLOCK_OFFSET = registry.gauge(
+    "fleet.clock_offset_seconds",
+    "this rank's clock minus the aggregator's clock, estimated by the "
+    "store-ping handshake at rendezvous, by rank")
+M_RANKS_REPORTING = registry.gauge(
+    "fleet.ranks_reporting",
+    "ranks whose snapshot the aggregator has seen (< world size means a "
+    "missing/late rank — the aggregator never blocks on one), by job")
+M_SNAPSHOTS_RECEIVED = registry.counter(
+    "fleet.snapshots_received",
+    "fresh worker snapshots the aggregator ingested, by rank")
+M_STEP_SKEW = registry.gauge(
+    "fleet.step_skew_seconds",
+    "spread of per-rank recent mean train.step_seconds (slowest minus "
+    "fastest) over the last aggregator poll window, by job")
+M_SLOWEST_RANK = registry.gauge(
+    "fleet.slowest_rank",
+    "rank with the largest recent mean step wall time, by job")
+M_RANK_STEP_SECONDS = registry.gauge(
+    "fleet.rank_step_seconds",
+    "recent mean train.step_seconds of one rank (delta between the "
+    "aggregator's last two polls of its snapshot), by rank")
+M_STRAGGLERS = registry.counter(
+    "fleet.stragglers_detected",
+    "persistent stragglers the aggregator flagged (flight dump "
+    "requested from the offending worker via the store flag), by rank")
+
+
+def _key(job_id: str, *parts: str) -> str:
+    return "/".join(("fleet", job_id) + parts)
+
+
+def _as_float(v, default: float = 0.0) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def rank_dump_path(path: str, rank: int) -> str:
+    """Per-rank metrics dump path: ``metrics.json`` -> ``metrics.rank<N>.json``.
+
+    The launcher rewrites ``PADDLE_TPU_METRICS_DUMP`` through this for
+    every worker so N ranks sharing one inherited path never clobber
+    each other's atexit dump."""
+    root, ext = os.path.splitext(path)
+    if ext.lower() == ".json":
+        return f"{root}.rank{rank}{ext}"
+    return f"{path}.rank{rank}"
+
+
+#: filename shape the per-rank rewrite produces; --fleet mode globs it.
+RANK_DUMP_RE = re.compile(r"\.rank(\d+)\.json$")
+
+
+# -- snapshots -----------------------------------------------------------
+
+def snapshot_dict(rank: int, world: int, *, generation: int = 0,
+                  seq: int = 0, clock_offset: Optional[float] = None,
+                  reg=None, events: Optional[List[Dict[str, Any]]] = None,
+                  max_events: int = 256,
+                  final: bool = False) -> Dict[str, Any]:
+    """One worker's shippable telemetry snapshot: the (whole) metrics
+    registry plus the last ``max_events`` structured events, tagged with
+    identity and the handshake clock offset."""
+    if reg is None:
+        reg = registry
+    if events is None:
+        events = [e.to_dict() for e in _list_events()[-max_events:]]
+    else:
+        events = list(events)[-max_events:]
+    return {
+        "kind": SNAPSHOT_KIND,
+        "version": FLEET_VERSION,
+        "rank": int(rank),
+        "world": int(world),
+        "generation": int(generation),
+        "seq": int(seq),
+        "pid": os.getpid(),
+        "host": socket.gethostname(),
+        "published_unix": time.time(),
+        "clock_offset": clock_offset,
+        "final": bool(final),
+        "metrics": reg.to_dict(),
+        "events": events,
+    }
+
+
+# -- cross-rank merge semantics ------------------------------------------
+
+def _series_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _merge_series(out: Dict[str, Any], kind: str, series: List[Dict],
+                  rank: Optional[int]) -> None:
+    """Fold one metric's series list into the accumulator ``out``
+    (``_series`` keyed by canonical labels). ``rank`` labels gauges;
+    None means the series is already fleet-level (aggregator-own)."""
+    for s in series:
+        labels = dict(s.get("labels", {}))
+        if kind == "gauge":
+            if rank is not None:
+                labels["rank"] = str(rank)
+            out[_series_key(labels)] = {"labels": labels,
+                                        "value": s.get("value")}
+        elif kind == "counter":
+            key = _series_key(labels)
+            cur = out.get(key)
+            if cur is None:
+                out[key] = {"labels": labels, "value": s.get("value", 0)}
+            else:
+                cur["value"] = cur["value"] + s.get("value", 0)
+        elif kind == "histogram":
+            key = _series_key(labels)
+            cur = out.get(key)
+            cnt = s.get("count", 0)
+            if cur is None:
+                out[key] = {
+                    "labels": labels, "count": cnt,
+                    "sum": s.get("sum", 0.0),
+                    "min": s.get("min", 0.0), "max": s.get("max", 0.0),
+                    "bounds": list(s.get("bounds", [])),
+                    "bucket_counts": list(s.get("bucket_counts", [])),
+                }
+                continue
+            if cnt:
+                cur["min"] = (min(cur["min"], s.get("min", 0.0))
+                              if cur["count"] else s.get("min", 0.0))
+                cur["max"] = max(cur["max"], s.get("max", 0.0))
+            cur["count"] += cnt
+            cur["sum"] += s.get("sum", 0.0)
+            if cur["bounds"] == list(s.get("bounds", [])) \
+                    and len(cur["bucket_counts"]) \
+                    == len(s.get("bucket_counts", [])):
+                cur["bucket_counts"] = [
+                    a + b for a, b in zip(cur["bucket_counts"],
+                                          s.get("bucket_counts", []))]
+            else:
+                # incompatible bucket layouts: keep count/sum/min/max,
+                # drop the per-bucket detail rather than fabricate it
+                cur["bounds"], cur["bucket_counts"] = [], []
+
+
+def merge_metrics(snapshots: Dict[int, Dict[str, Any]],
+                  own: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Merge per-rank metric dicts into one fleet view.
+
+    ``snapshots`` maps rank -> any dict with a ``metrics`` mapping (a
+    fleet snapshot or an ``obs.dump()`` document). Semantics: counters
+    are SUMMED across ranks by identical label set, gauges are kept
+    per-rank under an added ``rank`` label, histograms are merged
+    (count/sum/min/max always; bucket counts element-wise when the
+    bucket layouts agree). ``own`` (the aggregator's local registry
+    dump) is folded in as fleet-level series without rank labeling.
+    Returns the same shape ``registry.to_dict()`` produces, so
+    ``report.render_report({"metrics": merged})`` renders it."""
+    acc: Dict[str, Dict[str, Any]] = {}
+
+    def fold(mets: Dict[str, Any], rank: Optional[int]):
+        for name, m in mets.items():
+            kind = m.get("kind")
+            slot = acc.setdefault(name, {"kind": kind,
+                                         "doc": m.get("doc", ""),
+                                         "_series": {}})
+            if slot["kind"] != kind:
+                continue  # cross-rank kind conflict: first kind wins
+            _merge_series(slot["_series"], kind, m.get("series", []), rank)
+
+    for rank in sorted(snapshots):
+        fold(snapshots[rank].get("metrics", {}), rank)
+    if own:
+        fold(own, None)
+
+    merged: Dict[str, Any] = {}
+    for name in sorted(acc):
+        slot = acc[name]
+        series = [slot["_series"][k] for k in sorted(slot["_series"])]
+        if not series:
+            continue
+        merged[name] = {"kind": slot["kind"], "doc": slot["doc"],
+                        "series": series}
+    return merged
+
+
+# -- merged Chrome-trace timeline ----------------------------------------
+
+def merged_trace_events(snapshots: Dict[int, Dict[str, Any]]
+                        ) -> List[Dict[str, Any]]:
+    """Chrome-trace events over every rank's shipped event ring: one
+    process lane per rank (pid = rank), spans for events that carry a
+    ``seconds`` duration (``train.step``, compiles, passes), instants
+    otherwise — timestamps shifted by each rank's handshake clock offset
+    so lanes line up on the aggregator's clock."""
+    traces: List[Dict[str, Any]] = []
+    for rank in sorted(snapshots):
+        snap = snapshots[rank]
+        off = _as_float(snap.get("clock_offset"), 0.0)
+        traces.append({"ph": "M", "name": "process_name", "pid": rank,
+                       "tid": 0,
+                       "args": {"name": f"rank {rank} "
+                                        f"(pid {snap.get('pid', '?')} on "
+                                        f"{snap.get('host', '?')})"}})
+        traces.append({"ph": "M", "name": "process_sort_index",
+                       "pid": rank, "tid": 0,
+                       "args": {"sort_index": rank}})
+        for e in snap.get("events", []):
+            ts = _as_float(e.get("ts")) - off
+            name = str(e.get("kind", "event"))
+            args = {k: v for k, v in e.items() if k != "ts"}
+            dur = e.get("seconds")
+            if isinstance(dur, (int, float)) and dur > 0:
+                # the event timestamp marks the END of the measured
+                # region (span/step_region record on exit)
+                traces.append({"name": name, "ph": "X", "cat": "fleet",
+                               "ts": (ts - dur) * 1e6, "dur": dur * 1e6,
+                               "pid": rank, "tid": 0, "args": args})
+            else:
+                traces.append({"name": name, "ph": "i", "s": "t",
+                               "cat": "fleet", "ts": ts * 1e6,
+                               "pid": rank, "tid": 0, "args": args})
+    return traces
+
+
+def _write_json_atomic(path: str, doc: Dict[str, Any]) -> str:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def write_merged_trace(snapshots: Dict[int, Dict[str, Any]],
+                       path: str) -> str:
+    """Write the merged fleet timeline as one chrome-trace JSON."""
+    return _write_json_atomic(
+        path, {"traceEvents": merged_trace_events(snapshots),
+               "displayTimeUnit": "ms"})
+
+
+def merge_chrome_trace_files(paths_by_rank: Dict[int, str],
+                             offsets: Optional[Dict[int, float]] = None,
+                             path: Optional[str] = None) -> Dict[str, Any]:
+    """Merge per-rank ``profiler.export_chrome_tracing`` files into one
+    timeline: each rank's events move to pid = rank (a process lane) and
+    shift by that rank's clock offset (seconds).
+
+    Only meaningful when the input traces share a wall-clock timebase —
+    the host tracer's ``perf_counter`` spans from different processes do
+    NOT; the snapshot-based :func:`write_merged_trace` is the primary
+    cross-rank timeline and this is the escape hatch for wall-clock
+    trace sources."""
+    offsets = offsets or {}
+    merged: List[Dict[str, Any]] = []
+    for rank in sorted(paths_by_rank):
+        with open(paths_by_rank[rank]) as f:
+            doc = json.load(f)
+        off_us = _as_float(offsets.get(rank)) * 1e6
+        merged.append({"ph": "M", "name": "process_name", "pid": rank,
+                       "tid": 0, "args": {"name": f"rank {rank}"}})
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = rank
+            if "ts" in ev:
+                ev["ts"] = _as_float(ev["ts"]) - off_us
+            merged.append(ev)
+    out = {"traceEvents": merged, "displayTimeUnit": "ms"}
+    if path:
+        _write_json_atomic(path, out)
+    return out
+
+
+# -- worker side: the reporter -------------------------------------------
+
+_active: Optional["FleetReporter"] = None
+
+
+def active_reporter() -> Optional["FleetReporter"]:
+    """The process's running FleetReporter (None when fleet telemetry is
+    off) — hapi's MetricsCallback ships through it at step boundaries."""
+    return _active
+
+
+def maybe_ship(min_interval_s: Optional[float] = None):
+    """Rate-limited publish through the active reporter; a no-op without
+    one and never raises (safe on any step boundary)."""
+    r = _active
+    if r is not None:
+        r.maybe_ship(min_interval_s)
+
+
+class FleetReporter:
+    """Ships this worker's telemetry snapshots over the elastic store.
+
+    A daemon thread publishes every ``interval_s`` and polls the
+    aggregator's flight-request flag; ``maybe_ship`` lets step
+    boundaries (hapi ``MetricsCallback``) publish opportunistically
+    between tick marks. Every store operation is wrapped: a dead or
+    wedged store costs ``fleet.ship_failures`` increments, never an
+    exception in the training process.
+    """
+
+    def __init__(self, store, rank: int, world: int, *,
+                 generation: int = 0, job_id: str = "default",
+                 interval_s: float = 1.0, max_events: int = 256,
+                 max_retries: int = 2):
+        self.store = store
+        self.rank = int(rank)
+        self.world = int(world)
+        self.generation = int(generation)
+        self.job_id = job_id
+        self.interval_s = max(0.05, float(interval_s))
+        self.max_events = max_events
+        self.max_retries = max(1, int(max_retries))
+        self.clock_offset: Optional[float] = None
+        self._seq = 0
+        self._last_pub = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._lock = threading.Lock()
+
+    def _k(self, *parts: str) -> str:
+        return _key(self.job_id, *parts)
+
+    # -- clock handshake -------------------------------------------------
+    def handshake(self, timeout_s: Optional[float] = None,
+                  poll_s: float = 0.05) -> Optional[float]:
+        """Store-ping clock sync: write a ping carrying the local send
+        time; the aggregator's poll loop answers with its receive time;
+        ``offset = local_midpoint - aggregator_time`` (positive = this
+        rank's clock runs ahead). Returns None — and snapshots stay
+        unaligned (offset 0) — when nobody answers in time, e.g. a run
+        without a launcher-side aggregator."""
+        if timeout_s is None:
+            timeout_s = _as_float(
+                os.environ.get(HANDSHAKE_TIMEOUT_ENV), 3.0)
+        self._seq += 1
+        token = f"{os.getpid()}-{self._seq}"
+        t0 = time.time()
+        try:
+            self.store.set(self._k("ping", str(self.rank)),
+                           f"{token} {t0}")
+        except Exception as e:
+            M_SHIP_FAILURES.inc(reason=type(e).__name__)
+            return None
+        deadline = t0 + timeout_s
+        while time.time() < deadline:
+            try:
+                raw = self.store.get(self._k("pong", str(self.rank)),
+                                     timeout_s=0).decode()
+                got, agg_t = raw.split()
+                if got == token:
+                    t1 = time.time()
+                    offset = (t0 + (t1 - t0) / 2) - float(agg_t)
+                    self.clock_offset = offset
+                    M_CLOCK_OFFSET.set(round(offset, 6),
+                                       rank=str(self.rank))
+                    return offset
+            except Exception:
+                pass
+            time.sleep(poll_s)
+        return None
+
+    # -- publishing ------------------------------------------------------
+    def publish(self, final: bool = False) -> bool:
+        """Serialize and ship one snapshot. Bounded retry; returns False
+        (and counts ``fleet.ship_failures``) instead of ever raising."""
+        with self._lock:
+            self._seq += 1
+            try:
+                payload = json.dumps(snapshot_dict(
+                    self.rank, self.world, generation=self.generation,
+                    seq=self._seq, clock_offset=self.clock_offset,
+                    max_events=self.max_events, final=final),
+                    default=str)
+            except Exception as e:
+                M_SHIP_FAILURES.inc(reason=type(e).__name__)
+                return False
+            err = "unknown"
+            for _ in range(self.max_retries):
+                try:
+                    self.store.set(self._k("snap", str(self.rank)),
+                                   payload)
+                    self._last_pub = time.time()
+                    M_SNAPSHOTS_SHIPPED.inc(rank=str(self.rank))
+                    return True
+                except Exception as e:
+                    err = type(e).__name__
+            M_SHIP_FAILURES.inc(reason=err)
+            return False
+
+    def maybe_ship(self, min_interval_s: Optional[float] = None):
+        """Publish if at least ``min_interval_s`` (default: the periodic
+        interval) passed since the last successful publish."""
+        iv = self.interval_s if min_interval_s is None else min_interval_s
+        if time.time() - self._last_pub >= iv:
+            self.publish()
+            self.check_flight_request()
+
+    def check_flight_request(self):
+        """Honor an aggregator-raised flight flag: dump the PR 5 flight
+        ring with the flagged reason, then clear the flag (one dump per
+        request)."""
+        try:
+            raw = self.store.get(self._k("flight_request",
+                                         str(self.rank)),
+                                 timeout_s=0).decode()
+        except Exception:
+            return
+        if not raw:
+            return
+        try:
+            self.store.set(self._k("flight_request", str(self.rank)), "")
+        except Exception as e:
+            M_SHIP_FAILURES.inc(reason=type(e).__name__)
+        reason = raw.split()[0]
+        path = flight.recorder.dump(
+            reason, context={"rank": self.rank,
+                             "generation": self.generation,
+                             "requested_by": "fleet_aggregator",
+                             "request": raw})
+        if path:
+            print(f"paddle_tpu fleet: rank {self.rank} wrote requested "
+                  f"flight dump {path} ({raw})", file=sys.stderr,
+                  flush=True)
+
+    # -- lifecycle -------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self.publish()
+            self.check_flight_request()
+
+    def start(self):
+        """Start periodic shipping and become the process's active
+        reporter."""
+        global _active
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="ptpu-fleet-reporter",
+                daemon=True)
+            self._thread.start()
+        _active = self
+
+    def close(self):
+        """Stop the thread and publish the final snapshot (idempotent)."""
+        global _active
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        self.publish(final=True)
+        if _active is self:
+            _active = None
+
+
+# -- launcher side: the aggregator ---------------------------------------
+
+def _median(vals: List[float]) -> float:
+    vals = sorted(vals)
+    n = len(vals)
+    if not n:
+        return 0.0
+    mid = n // 2
+    return vals[mid] if n % 2 else (vals[mid - 1] + vals[mid]) / 2
+
+
+def _step_totals(mets: Dict[str, Any]) -> Tuple[int, float]:
+    """(count, sum) of train.step_seconds across all its label sets."""
+    cnt, tot = 0, 0.0
+    for s in mets.get("train.step_seconds", {}).get("series", []):
+        cnt += s.get("count", 0)
+        tot += s.get("sum", 0.0)
+    return cnt, tot
+
+
+class FleetAggregator:
+    """Launcher-anchored fleet view over the elastic store.
+
+    ``poll()`` (driven by a daemon thread between ``start``/``stop``, or
+    called directly in tests) reads every rank's latest snapshot
+    *without blocking on missing ones* (a late rank just keeps
+    ``fleet.ranks_reporting`` below the world size), answers clock
+    pings, updates the skew gauges, and runs straggler detection on the
+    per-rank step-time deltas. ``stop()``/``finalize()`` write the
+    aggregated ``fleet_metrics.json`` and the merged
+    ``fleet_trace.json`` under ``out_dir``.
+    """
+
+    def __init__(self, store, world: int, *, job_id: str = "default",
+                 out_dir: Optional[str] = None,
+                 poll_interval_s: Optional[float] = None,
+                 straggler_ratio: Optional[float] = None,
+                 straggler_polls: Optional[int] = None,
+                 min_step_seconds: float = 0.001):
+        self.store = store
+        self.world = int(world)
+        self.job_id = job_id
+        self.out_dir = out_dir
+        self.poll_interval_s = poll_interval_s if poll_interval_s \
+            else _as_float(os.environ.get(FLEET_POLL_ENV), 0.5)
+        self.straggler_ratio = straggler_ratio if straggler_ratio \
+            else _as_float(os.environ.get(STRAGGLER_RATIO_ENV), 2.0)
+        self.straggler_polls = int(straggler_polls if straggler_polls
+                                   else int(os.environ.get(
+                                       STRAGGLER_POLLS_ENV, "2") or 2))
+        self.min_step_seconds = min_step_seconds
+        self.snapshots: Dict[int, Dict[str, Any]] = {}
+        self.events: List[Dict[str, Any]] = []
+        self._prev_step_totals: Dict[int, Tuple[int, float]] = {}
+        self._recent_mean: Dict[int, float] = {}
+        self._consec: Dict[int, int] = {}
+        self._flagged: set = set()
+        self._answered_pings: Dict[int, str] = {}
+        self._polls = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _k(self, *parts: str) -> str:
+        return _key(self.job_id, *parts)
+
+    # -- one poll tick ---------------------------------------------------
+    def _answer_pings(self):
+        for rank in range(self.world):
+            try:
+                raw = self.store.get(self._k("ping", str(rank)),
+                                     timeout_s=0).decode()
+                token = raw.split()[0]
+            except Exception:
+                continue
+            if self._answered_pings.get(rank) == token:
+                continue
+            try:
+                self.store.set(self._k("pong", str(rank)),
+                               f"{token} {time.time()}")
+                self._answered_pings[rank] = token
+            except Exception:
+                pass
+
+    def poll(self) -> Dict[int, Dict[str, Any]]:
+        """Ingest every rank's current snapshot; never blocks on a
+        missing or late rank."""
+        self._answer_pings()
+        for rank in range(self.world):
+            try:
+                raw = self.store.get(self._k("snap", str(rank)),
+                                     timeout_s=0)
+                snap = json.loads(raw)
+            except Exception:
+                continue
+            prev = self.snapshots.get(rank)
+            if prev is None or (snap.get("seq"), snap.get("generation")) \
+                    != (prev.get("seq"), prev.get("generation")):
+                M_SNAPSHOTS_RECEIVED.inc(rank=str(rank))
+            self.snapshots[rank] = snap
+        M_RANKS_REPORTING.set(len(self.snapshots), job=self.job_id)
+        self._polls += 1
+        self._update_skew()
+        return dict(self.snapshots)
+
+    def ranks_reporting(self) -> List[int]:
+        return sorted(self.snapshots)
+
+    # -- skew + straggler detection --------------------------------------
+    def _update_skew(self):
+        for rank, snap in self.snapshots.items():
+            cnt, tot = _step_totals(snap.get("metrics", {}))
+            pcnt, ptot = self._prev_step_totals.get(rank, (0, 0.0))
+            if cnt > pcnt:
+                mean = (tot - ptot) / (cnt - pcnt)
+                self._recent_mean[rank] = mean
+                M_RANK_STEP_SECONDS.set(round(mean, 6), rank=str(rank))
+            self._prev_step_totals[rank] = (cnt, tot)
+        means = self._recent_mean
+        if len(means) < 2:
+            return
+        slowest = max(means, key=means.get)
+        skew = means[slowest] - min(means.values())
+        M_STEP_SKEW.set(round(skew, 6), job=self.job_id)
+        M_SLOWEST_RANK.set(slowest, job=self.job_id)
+        self._detect_stragglers(means)
+
+    def _detect_stragglers(self, means: Dict[int, float]):
+        for rank, mean in means.items():
+            peers = [m for r, m in means.items() if r != rank]
+            med = _median(peers)
+            over = (med >= self.min_step_seconds
+                    and mean > self.straggler_ratio * med)
+            if not over:
+                self._consec[rank] = 0
+                self._flagged.discard(rank)
+                continue
+            self._consec[rank] = self._consec.get(rank, 0) + 1
+            if self._consec[rank] >= self.straggler_polls \
+                    and rank not in self._flagged:
+                self._flagged.add(rank)
+                self._fire_straggler(rank, mean, med)
+
+    def _fire_straggler(self, rank: int, mean: float, med: float):
+        ratio = mean / med if med else float("inf")
+        M_STRAGGLERS.inc(rank=str(rank))
+        self.events.append({
+            "ts": time.time(), "kind": "fleet.straggler", "rank": rank,
+            "mean_step_seconds": round(mean, 6),
+            "peer_median_seconds": round(med, 6),
+            "ratio": round(ratio, 3), "polls": self._consec[rank],
+        })
+        print(f"paddle_tpu fleet: straggler detected — rank {rank} "
+              f"recent step mean {mean * 1e3:.1f}ms is {ratio:.1f}x the "
+              f"peer median {med * 1e3:.1f}ms "
+              f"({self._consec[rank]} consecutive polls); requesting a "
+              f"flight dump from it", file=sys.stderr, flush=True)
+        try:
+            self.store.set(
+                self._k("flight_request", str(rank)),
+                f"{flight.REASON_STRAGGLER} ratio={ratio:.2f} "
+                f"mean_step_seconds={mean:.4f}")
+        except Exception:
+            pass
+
+    # -- outputs ---------------------------------------------------------
+    def merged_metrics(self) -> Dict[str, Any]:
+        own = {name: m for name, m in registry.to_dict().items()
+               if name.startswith("fleet.") and m.get("series")}
+        return merge_metrics(self.snapshots, own=own)
+
+    def dump_dict(self) -> Dict[str, Any]:
+        means = self._recent_mean
+        return {
+            "kind": FLEET_DUMP_KIND,
+            "version": FLEET_VERSION,
+            "generated_unix": time.time(),
+            "job_id": self.job_id,
+            "world": self.world,
+            "polls": self._polls,
+            "ranks_reporting": self.ranks_reporting(),
+            "clock_offsets": {str(r): s.get("clock_offset")
+                              for r, s in self.snapshots.items()},
+            "snapshot_meta": {
+                str(r): {k: s.get(k) for k in
+                         ("seq", "pid", "host", "generation",
+                          "published_unix", "final")}
+                for r, s in self.snapshots.items()},
+            "recent_step_seconds": {str(r): round(v, 6)
+                                    for r, v in means.items()},
+            "step_skew_seconds": (round(max(means.values())
+                                        - min(means.values()), 6)
+                                  if len(means) >= 2 else None),
+            "slowest_rank": (max(means, key=means.get)
+                             if means else None),
+            "stragglers": sorted(self._flagged),
+            "metrics": self.merged_metrics(),
+            "events": list(self.events),
+        }
+
+    def finalize(self) -> Dict[str, str]:
+        """One last poll, then write the aggregated dump + merged trace
+        under ``out_dir`` (no-op paths when out_dir is unset)."""
+        try:
+            self.poll()
+        except Exception:
+            pass
+        paths: Dict[str, str] = {}
+        if self.out_dir:
+            try:
+                os.makedirs(self.out_dir, exist_ok=True)
+                paths["metrics"] = _write_json_atomic(
+                    os.path.join(self.out_dir, "fleet_metrics.json"),
+                    self.dump_dict())
+                paths["trace"] = write_merged_trace(
+                    self.snapshots,
+                    os.path.join(self.out_dir, "fleet_trace.json"))
+            except Exception as e:
+                print(f"paddle_tpu fleet: failed writing fleet outputs "
+                      f"under {self.out_dir!r}: {e!r}", file=sys.stderr)
+        return paths
+
+    # -- lifecycle -------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll()
+            except Exception:
+                pass
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="ptpu-fleet-aggregator",
+                daemon=True)
+            self._thread.start()
+
+    def stop(self) -> Dict[str, str]:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        return self.finalize()
+
+
+# -- incident-directory rendering (tools/metrics_report.py --fleet) ------
+
+def load_incident_dir(dirname: str) -> Dict[str, Any]:
+    """Collect one fleet incident from a directory: per-rank metric
+    dumps (``*.rank<N>.json``, the launcher's rewrite shape), flight
+    dumps (``flight-*.json``) and the aggregated fleet dump (any JSON
+    whose ``kind`` is ``fleet_dump``)."""
+    rank_dumps: Dict[int, Dict[str, Any]] = {}
+    fleet_doc: Optional[Dict[str, Any]] = None
+    flights: List[Tuple[str, Dict[str, Any]]] = []
+    for path in sorted(glob.glob(os.path.join(dirname, "*.json"))):
+        base = os.path.basename(path)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        kind = doc.get("kind")
+        m = RANK_DUMP_RE.search(base)
+        if kind == FLEET_DUMP_KIND:
+            if fleet_doc is None or doc.get("generated_unix", 0) \
+                    > fleet_doc.get("generated_unix", 0):
+                fleet_doc = doc
+        elif kind == flight.FLIGHT_DUMP_KIND:
+            flights.append((path, doc))
+        elif m and "metrics" in doc:
+            rank_dumps[int(m.group(1))] = doc
+    flights.sort(key=lambda pd: pd[1].get("generated_unix", 0))
+    return {"dir": dirname, "rank_dumps": rank_dumps,
+            "fleet": fleet_doc, "flights": flights}
+
+
+def render_incident(inc: Dict[str, Any], max_events: int = 40,
+                    top: Optional[int] = None) -> str:
+    """One human report over a whole multi-process incident: skew
+    summary, per-rank gauge table (rank-labeled merged metrics),
+    clock-aligned cross-rank event interleaving, and the flight-dump
+    index."""
+    from .report import render_report
+
+    lines: List[str] = [f"FLEET INCIDENT — {inc['dir']}"]
+    ranks = sorted(inc["rank_dumps"])
+    fdoc = inc.get("fleet") or {}
+    head = (f"rank metric dumps: {ranks if ranks else 'none'}   "
+            f"flight dumps: {len(inc['flights'])}")
+    if fdoc:
+        head += (f"   world={fdoc.get('world')} "
+                 f"reporting={fdoc.get('ranks_reporting')}")
+    lines.append(head)
+    offsets = {int(r): _as_float(v) for r, v in
+               (fdoc.get("clock_offsets") or {}).items()}
+
+    # -- skew summary ----------------------------------------------------
+    rows = []
+    for r in ranks:
+        cnt, tot = _step_totals(inc["rank_dumps"][r].get("metrics", {}))
+        mx = max((s.get("max", 0.0) for s in
+                  inc["rank_dumps"][r].get("metrics", {})
+                  .get("train.step_seconds", {}).get("series", [])),
+                 default=0.0)
+        rows.append((r, cnt, tot / cnt if cnt else 0.0, mx,
+                     offsets.get(r)))
+    if rows:
+        lines += ["", "Per-rank step summary",
+                  f"{'rank':>4}{'steps':>8}{'avg_ms':>10}{'max_ms':>10}"
+                  f"{'clock_offset_ms':>17}"]
+        for r, cnt, avg, mx, off in rows:
+            lines.append(
+                f"{r:>4}{cnt:>8}{avg * 1e3:>10.2f}{mx * 1e3:>10.2f}"
+                + (f"{off * 1e3:>17.3f}" if off is not None
+                   else f"{'-':>17}"))
+    if fdoc:
+        skew = fdoc.get("step_skew_seconds")
+        if skew is not None:
+            lines.append(f"step skew {skew * 1e3:.2f}ms, slowest rank "
+                         f"{fdoc.get('slowest_rank')}, recent means "
+                         + " ".join(
+                             f"r{r}={v * 1e3:.1f}ms" for r, v in sorted(
+                                 (fdoc.get("recent_step_seconds")
+                                  or {}).items())))
+        for e in fdoc.get("events", []):
+            if e.get("kind") == "fleet.straggler":
+                lines.append(
+                    f"STRAGGLER rank {e.get('rank')}: recent step mean "
+                    f"{_as_float(e.get('mean_step_seconds')) * 1e3:.1f}ms"
+                    f" = {e.get('ratio')}x peer median over "
+                    f"{e.get('polls')} polls")
+
+    # -- merged per-rank metric view ------------------------------------
+    # the per-rank atexit dumps are the COMPLETE final registries (the
+    # aggregator's snapshots may trail them); merge those and fold in
+    # the launcher-side fleet.* series from the aggregated dump
+    if inc["rank_dumps"]:
+        own = {name: m for name, m in (fdoc.get("metrics") or {}).items()
+               if name.startswith("fleet.")}
+        merged = merge_metrics(
+            {r: d for r, d in inc["rank_dumps"].items()}, own=own)
+    else:
+        merged = fdoc.get("metrics") or {}
+    if merged:
+        lines += ["", render_report({"metrics": merged}, max_events=0,
+                                    top=top)]
+
+    # -- clock-aligned cross-rank interleaving ---------------------------
+    evs: List[Tuple[float, str, Dict[str, Any]]] = []
+    for r in ranks:
+        off = offsets.get(r, 0.0)
+        for e in inc["rank_dumps"][r].get("events", []):
+            evs.append((_as_float(e.get("ts")) - off, f"r{r}", e))
+    covered = set(ranks)
+    for _, fd in inc["flights"]:
+        # a rank that died without an atexit metrics dump still left its
+        # flight ring — use it so the interleaving covers every rank
+        ctx = fd.get("context") or {}
+        r = ctx.get("rank")
+        if r in covered:
+            continue
+        off = offsets.get(r, 0.0) if isinstance(r, int) else 0.0
+        for e in fd.get("events", []):
+            evs.append((_as_float(e.get("ts")) - off,
+                        f"r{r if r is not None else '?'}*", e))
+    if evs and max_events > 0:
+        evs.sort(key=lambda t: t[0])
+        shown = evs[-max_events:]
+        lines += ["", f"Cross-rank events (clock-aligned, last "
+                      f"{len(shown)} of {len(evs)}; * = from a flight "
+                      f"dump)", "-" * 78]
+        for ts, tag, e in shown:
+            fields = " ".join(f"{k}={v}" for k, v in e.items()
+                              if k not in ("ts", "kind"))
+            lines.append(
+                f"{time.strftime('%H:%M:%S', time.localtime(ts))} "
+                f"[{tag:>4}] {e.get('kind', '?')}: {fields}")
+
+    # -- flight dump index ----------------------------------------------
+    if inc["flights"]:
+        lines += ["", "Flight dumps (render each with "
+                      "tools/metrics_report.py <file>):"]
+        for path, fd in inc["flights"]:
+            ctx = fd.get("context") or {}
+            ctx_s = " ".join(f"{k}={v}" for k, v in sorted(ctx.items()))
+            lines.append(f"  {os.path.basename(path)}  "
+                         f"reason={fd.get('reason')}  "
+                         f"pid={fd.get('pid')}  {ctx_s}")
+    return "\n".join(lines)

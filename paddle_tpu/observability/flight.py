@@ -41,8 +41,12 @@ FLIGHT_VERSION = 1
 #: - ``rejoin``: a worker came back at a bumped generation and resumed
 #:   from checkpoint (dumped right after the restore so the trail shows
 #:   what recovery cost).
+#: - ``straggler``: the fleet aggregator flagged this rank as a
+#:   persistent straggler and requested a post-mortem via the store
+#:   flag (observability/fleet.py FleetAggregator).
 REASON_PEER_DEATH = "peer_death"
 REASON_REJOIN = "rejoin"
+REASON_STRAGGLER = "straggler"
 
 #: ring capacity; read once from core.flags at first record so the flag
 #: can be set before any event lands (same pattern as events._buffer).

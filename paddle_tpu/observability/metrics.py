@@ -41,6 +41,8 @@ CLAIMED_SUBSYSTEMS = {
     "io",          # io/dataloader.py — prefetch queue depth / wait time
     "elastic",     # distributed/elastic.py — restarts, re-rendezvous,
                    # peer deaths, checkpoint-restore cost (ROADMAP item 1)
+    "fleet",       # observability/fleet.py — cross-rank snapshot
+                   # shipping/aggregation, step skew, stragglers
     "test",        # scratch names registered by the test suite
 }
 

@@ -242,6 +242,12 @@ def render_flight(d: Dict[str, Any], max_events: int = 50,
         lines.append(
             "(this worker re-rendezvoused at a new generation and "
             "resumed from the latest checkpoint)")
+    elif reason == "straggler":
+        lines.append(
+            "(the fleet aggregator flagged this rank as a persistent "
+            "straggler — its recent step times exceeded the peer median "
+            "threshold — and requested this post-mortem via the store "
+            "flag)")
     mem = d.get("device_memory")
     if mem:
         lines.append(

@@ -13,12 +13,22 @@ entering it — which is exactly what makes the kill-and-resume loss-curve
 continuation comparable against the reference run.
 
 Config via env (set by the test):
-  PTPU_ELASTIC_STEPS     total steps (default 8)
-  PTPU_ELASTIC_CKPT      checkpoint dir (optional; ckpt_every=1)
-  PTPU_ELASTIC_LOSS_LOG  rank-0 appends "<gen> <step> <loss>" lines
+  PTPU_ELASTIC_STEPS       total steps (default 8)
+  PTPU_ELASTIC_CKPT        checkpoint dir (optional; ckpt_every=1)
+  PTPU_ELASTIC_LOSS_LOG    rank-0 appends "<gen> <step> <loss>" lines
+  PTPU_ELASTIC_LOCAL       "1": rank-LOCAL numpy train step (no
+                           cross-process collective) — steps are
+                           UNCOUPLED across ranks, which is what lets a
+                           fleet-telemetry straggler drill attribute a
+                           slow rank by its own step times (a per-step
+                           collective would equalize wall times)
+  PTPU_ELASTIC_STEP_SLEEP  baseline host seconds per local step (paces
+                           every rank so the aggregator sees concurrent
+                           progress; the chaos slow env adds skew)
 """
 import os
 import sys
+import time
 
 os.environ["PADDLE_USE_JAX_COORDINATOR"] = "1"
 
@@ -35,6 +45,8 @@ from paddle_tpu.distributed import elastic_train as et
 STEPS = int(os.environ.get("PTPU_ELASTIC_STEPS", "8"))
 CKPT_DIR = os.environ.get("PTPU_ELASTIC_CKPT") or None
 LOSS_LOG = os.environ.get("PTPU_ELASTIC_LOSS_LOG") or None
+LOCAL = os.environ.get("PTPU_ELASTIC_LOCAL") == "1"
+STEP_SLEEP = float(os.environ.get("PTPU_ELASTIC_STEP_SLEEP", "0") or 0)
 
 GLOBAL_BATCH = 8
 FEATURES = 4
@@ -79,6 +91,24 @@ def train_step(state, step, mesh):
     return loss
 
 
+def build_state_local(mesh):
+    return {"w": np.zeros((FEATURES, 1), np.float32),
+            "b": np.zeros((1,), np.float32)}
+
+
+def train_step_local(state, step, mesh):
+    """Rank-local numpy SGD step — no cross-process collective, so each
+    rank's step wall time is its own (straggler drills)."""
+    if STEP_SLEEP:
+        time.sleep(STEP_SLEEP)
+    x, y = _batch(step)
+    err = x @ state["w"] + state["b"] - y
+    loss = float((err ** 2).mean())
+    state["w"] -= LR * (2.0 * x.T @ err / len(x))
+    state["b"] -= LR * (2.0 * err.mean(axis=0))
+    return loss
+
+
 def on_step(step, loss):
     from paddle_tpu.distributed.env import get_rank
 
@@ -89,7 +119,9 @@ def on_step(step, loss):
 
 
 def main():
-    result = et.run_elastic(build_state, train_step, STEPS,
+    build, step_fn = ((build_state_local, train_step_local) if LOCAL
+                      else (build_state, train_step))
+    result = et.run_elastic(build, step_fn, STEPS,
                             ckpt_dir=CKPT_DIR, ckpt_every=1,
                             on_step=on_step)
     print(f"ELASTIC WORKER rank={result.rank} world={result.world} "

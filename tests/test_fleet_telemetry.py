@@ -1,0 +1,609 @@
+"""Fleet telemetry plane (paddle_tpu.observability.fleet).
+
+Unit layer: cross-rank merge semantics (counters summed, gauges
+rank-labeled, histograms merged), the store-ping clock handshake and
+clock-aligned trace merge, aggregator resilience to a missing/late rank,
+the straggler-detection threshold, ship-failure robustness (a dead store
+must never take down training), the launcher's per-rank metrics-dump
+path rewrite, and the ``tools/metrics_report.py --fleet`` incident
+renderer — all against the in-process ``InMemoryStore``.
+
+End-to-end layer (native TCPStore): a REAL 2-process ``fleet.launch``
+run with fleet telemetry on and one artificially slowed rank produces
+per-rank metric dumps with no path collision, a launcher-side aggregated
+``fleet_metrics.json`` (counters summed, gauges rank-labeled, skew
+columns), a merged clock-aligned ``fleet_trace.json`` with both ranks'
+step spans, a straggler event naming the slow rank, and a flight dump
+from that rank — the ISSUE 8 acceptance drill.
+"""
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import paddle_tpu.native as native
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import fleet
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.distributed.store import InMemoryStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "elastic_train_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+def _mk_registry(counter_n=0, gauge_v=None, step_times=()):
+    reg = MetricsRegistry()
+    if counter_n:
+        reg.counter("test.calls", "calls").inc(counter_n, op="matmul")
+    if gauge_v is not None:
+        reg.gauge("test.depth", "queue depth").set(gauge_v)
+    if step_times:
+        h = reg.histogram("train.step_seconds", "steps")
+        for t in step_times:
+            h.observe(t, name="train")
+    return reg
+
+
+def _snap(rank, world=2, reg=None, events=None, seq=1, offset=None):
+    return fleet.snapshot_dict(rank, world, reg=reg or MetricsRegistry(),
+                               events=events or [], seq=seq,
+                               clock_offset=offset)
+
+
+def _publish(store, snap, job="j"):
+    store.set(f"fleet/{job}/snap/{snap['rank']}",
+              json.dumps(snap, default=str))
+
+
+class DyingStore(InMemoryStore):
+    """Works for the first ``die_after`` operations, then every store op
+    raises — the 'launcher store crashed mid-run' double."""
+
+    def __init__(self, die_after):
+        super().__init__()
+        self.ops = 0
+        self.die_after = die_after
+
+    def _tick(self):
+        self.ops += 1
+        if self.ops > self.die_after:
+            raise RuntimeError("store died")
+
+    def set(self, key, value):
+        self._tick()
+        return super().set(key, value)
+
+    def get(self, key, timeout_s=None):
+        self._tick()
+        return super().get(key, timeout_s=timeout_s)
+
+    def add(self, key, delta=1):
+        self._tick()
+        return super().add(key, delta)
+
+
+# ---------------------------------------------------------------------------
+# cross-rank merge semantics
+
+class TestMergeSemantics:
+    def test_counters_summed_across_ranks(self):
+        snaps = {0: _snap(0, reg=_mk_registry(counter_n=3)),
+                 1: _snap(1, reg=_mk_registry(counter_n=5))}
+        merged = fleet.merge_metrics(snaps)
+        series = merged["test.calls"]["series"]
+        assert len(series) == 1
+        assert series[0]["value"] == 8
+        assert series[0]["labels"] == {"op": "matmul"}  # no rank label
+
+    def test_gauges_kept_per_rank_under_rank_label(self):
+        snaps = {0: _snap(0, reg=_mk_registry(gauge_v=4)),
+                 1: _snap(1, reg=_mk_registry(gauge_v=9))}
+        merged = fleet.merge_metrics(snaps)
+        by_rank = {s["labels"]["rank"]: s["value"]
+                   for s in merged["test.depth"]["series"]}
+        assert by_rank == {"0": 4, "1": 9}
+
+    def test_histograms_merged_bucketwise(self):
+        snaps = {0: _snap(0, reg=_mk_registry(step_times=[0.1, 0.2])),
+                 1: _snap(1, reg=_mk_registry(step_times=[0.4]))}
+        merged = fleet.merge_metrics(snaps)
+        s = merged["train.step_seconds"]["series"][0]
+        assert s["count"] == 3
+        assert s["sum"] == pytest.approx(0.7)
+        assert s["min"] == pytest.approx(0.1)
+        assert s["max"] == pytest.approx(0.4)
+        assert sum(s["bucket_counts"]) == 3  # bucket detail survived
+
+    def test_histogram_bucket_mismatch_degrades_gracefully(self):
+        r0, r1 = MetricsRegistry(), MetricsRegistry()
+        r0.histogram("test.lat_seconds", "d",
+                     buckets=(0.1, 1.0)).observe(0.05)
+        r1.histogram("test.lat_seconds", "d",
+                     buckets=(0.5, 5.0)).observe(2.0)
+        merged = fleet.merge_metrics({0: _snap(0, reg=r0),
+                                      1: _snap(1, reg=r1)})
+        s = merged["test.lat_seconds"]["series"][0]
+        assert s["count"] == 2 and s["sum"] == pytest.approx(2.05)
+        assert s["bucket_counts"] == []  # incompatible layouts dropped
+
+    def test_aggregator_own_series_fold_in_without_rank_label(self):
+        own = {"fleet.ranks_reporting": {
+            "kind": "gauge", "doc": "d",
+            "series": [{"labels": {"job": "j"}, "value": 2}]}}
+        merged = fleet.merge_metrics({0: _snap(0)}, own=own)
+        s = merged["fleet.ranks_reporting"]["series"][0]
+        assert s["labels"] == {"job": "j"}  # fleet-level, not per-rank
+
+
+# ---------------------------------------------------------------------------
+# clock handshake + aligned trace
+
+class TestClockAlignment:
+    def test_store_ping_handshake_roundtrip(self):
+        store = InMemoryStore()
+        agg = fleet.FleetAggregator(store, 1, job_id="hs")
+        rep = fleet.FleetReporter(store, 0, 1, job_id="hs")
+        got = {}
+        t = threading.Thread(
+            target=lambda: got.update(off=rep.handshake(timeout_s=5)))
+        t.start()
+        deadline = time.time() + 5
+        while t.is_alive() and time.time() < deadline:
+            agg.poll()
+            time.sleep(0.02)
+        t.join(timeout=1)
+        # same machine, same clock: the estimated offset is ~0 but real
+        assert got["off"] is not None
+        assert abs(got["off"]) < 0.5
+        assert rep.clock_offset == got["off"]
+
+    def test_handshake_without_aggregator_times_out_to_none(self):
+        rep = fleet.FleetReporter(InMemoryStore(), 0, 1, job_id="hs2")
+        assert rep.handshake(timeout_s=0.1, poll_s=0.02) is None
+        assert rep.clock_offset is None
+
+    def test_merged_trace_aligns_ranks_by_clock_offset(self):
+        # rank 1's clock runs 5s ahead; the same physical moment must
+        # land at the same trace timestamp in both lanes
+        ev0 = [{"ts": 1000.0, "kind": "train.step", "seconds": 0.5}]
+        ev1 = [{"ts": 1005.0, "kind": "train.step", "seconds": 0.5}]
+        snaps = {0: _snap(0, events=ev0, offset=0.0),
+                 1: _snap(1, events=ev1, offset=5.0)}
+        spans = [e for e in fleet.merged_trace_events(snaps)
+                 if e.get("ph") == "X"]
+        assert {e["pid"] for e in spans} == {0, 1}
+        assert spans[0]["ts"] == pytest.approx(spans[1]["ts"])
+        assert spans[0]["dur"] == pytest.approx(0.5e6)
+        names = {e["name"] for e in spans}
+        assert names == {"train.step"}
+
+    def test_instant_events_and_process_lanes(self, tmp_path):
+        snaps = {0: _snap(0, events=[{"ts": 10.0, "kind": "compile"}])}
+        path = fleet.write_merged_trace(snaps, str(tmp_path / "t.json"))
+        doc = json.load(open(path))
+        phs = {e["ph"] for e in doc["traceEvents"]}
+        assert "M" in phs and "i" in phs
+        meta = [e for e in doc["traceEvents"]
+                if e.get("name") == "process_name"]
+        assert "rank 0" in meta[0]["args"]["name"]
+
+
+# ---------------------------------------------------------------------------
+# aggregator: missing ranks + stragglers
+
+class TestAggregator:
+    def test_missing_rank_reports_partial_instead_of_hanging(self):
+        store = InMemoryStore()
+        agg = fleet.FleetAggregator(store, 3, job_id="part")
+        _publish(store, _snap(0, world=3), job="part")
+        _publish(store, _snap(1, world=3), job="part")
+        t0 = time.time()
+        snaps = agg.poll()
+        assert time.time() - t0 < 2.0  # non-blocking reads
+        assert sorted(snaps) == [0, 1]
+        assert fleet.M_RANKS_REPORTING.value(job="part") == 2
+        assert agg.dump_dict()["ranks_reporting"] == [0, 1]
+
+    def _poll_with_steps(self, store, agg, hists, seq, job):
+        for r, h in hists.items():
+            reg = MetricsRegistry()
+            # re-observe the cumulative history into a fresh registry
+            for t in h:
+                reg.histogram("train.step_seconds", "d").observe(
+                    t, name="train")
+            _publish(store, _snap(r, reg=reg, seq=seq), job=job)
+        agg.poll()
+
+    def test_straggler_fires_after_persistent_threshold(self):
+        store = InMemoryStore()
+        agg = fleet.FleetAggregator(store, 2, job_id="strag",
+                                    straggler_ratio=2.0,
+                                    straggler_polls=2)
+        before = fleet.M_STRAGGLERS.value(rank="1")
+        hist = {0: [], 1: []}
+        # poll 1: rank 1 runs 6x slower — over threshold but not yet
+        # persistent
+        hist[0] += [0.05] * 5
+        hist[1] += [0.30] * 5
+        self._poll_with_steps(store, agg, hist, 1, "strag")
+        assert agg.events == []
+        # poll 2: still slow — fires exactly once
+        hist[0] += [0.05] * 5
+        hist[1] += [0.30] * 5
+        self._poll_with_steps(store, agg, hist, 2, "strag")
+        assert [e["kind"] for e in agg.events] == ["fleet.straggler"]
+        ev = agg.events[0]
+        assert ev["rank"] == 1
+        assert ev["ratio"] == pytest.approx(6.0, rel=0.01)
+        assert fleet.M_STRAGGLERS.value(rank="1") == before + 1
+        # the store flag asks rank 1 for a flight dump
+        flag = store.get("fleet/strag/flight_request/1",
+                         timeout_s=0).decode()
+        assert flag.startswith("straggler")
+        # poll 3: still slow — latched, no re-fire
+        hist[0] += [0.05] * 5
+        hist[1] += [0.30] * 5
+        self._poll_with_steps(store, agg, hist, 3, "strag")
+        assert len(agg.events) == 1
+        d = agg.dump_dict()
+        assert d["slowest_rank"] == 1
+        assert d["step_skew_seconds"] == pytest.approx(0.25, rel=0.05)
+        assert d["stragglers"] == [1]
+
+    def test_below_threshold_spread_is_not_a_straggler(self):
+        store = InMemoryStore()
+        agg = fleet.FleetAggregator(store, 2, job_id="nostrag",
+                                    straggler_ratio=2.0,
+                                    straggler_polls=2)
+        hist = {0: [], 1: []}
+        for seq in (1, 2, 3):
+            hist[0] += [0.10] * 5
+            hist[1] += [0.15] * 5   # 1.5x < the 2.0 threshold
+            self._poll_with_steps(store, agg, hist, seq, "nostrag")
+        assert agg.events == []
+        with pytest.raises(Exception):
+            store.get("fleet/nostrag/flight_request/1", timeout_s=0)
+        # skew is still measured even when nobody is flagged
+        assert agg.dump_dict()["step_skew_seconds"] == pytest.approx(
+            0.05, rel=0.05)
+
+    def test_straggler_flag_makes_worker_dump_flight(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        obs.enable()
+        try:
+            store = InMemoryStore()
+            rep = fleet.FleetReporter(store, 1, 2, job_id="ff")
+            store.set("fleet/ff/flight_request/1",
+                      "straggler ratio=6.00 mean_step_seconds=0.3000")
+            rep.check_flight_request()
+            dumps = glob.glob(str(tmp_path / "flight-*.json"))
+            assert len(dumps) == 1
+            d = json.load(open(dumps[0]))
+            assert d["reason"] == "straggler"
+            assert d["context"]["rank"] == 1
+            assert d["context"]["requested_by"] == "fleet_aggregator"
+            # flag cleared: a second check is a no-op
+            rep.check_flight_request()
+            assert len(glob.glob(str(tmp_path / "flight-*.json"))) == 1
+        finally:
+            obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# shipping robustness: a dead store must never take down training
+
+class TestShipRobustness:
+    def test_publish_to_dead_store_counts_failure_and_never_raises(self):
+        before = fleet.M_SHIP_FAILURES.total()
+        rep = fleet.FleetReporter(DyingStore(0), 0, 2, job_id="dead")
+        assert rep.publish() is False          # no exception escaped
+        rep.maybe_ship(min_interval_s=0.0)     # ditto on the step path
+        assert fleet.M_SHIP_FAILURES.total() >= before + 2
+
+    def test_store_death_midrun_does_not_kill_training(self, monkeypatch):
+        """The satellite regression: the elastic store dies while the
+        fleet reporter is shipping mid-run; run_elastic still finishes
+        every step and only fleet.ship_failures records the loss."""
+        from paddle_tpu.distributed import elastic_train as et
+
+        store = DyingStore(die_after=10)
+        monkeypatch.setattr(et, "_elastic_store", lambda: store)
+        monkeypatch.setenv(fleet.FLEET_ENV, "1")
+        monkeypatch.setenv(fleet.FLEET_INTERVAL_ENV, "0.01")
+        monkeypatch.setenv(fleet.HANDSHAKE_TIMEOUT_ENV, "0.05")
+        before = fleet.M_SHIP_FAILURES.total()
+
+        def build_state(mesh):
+            return {"w": 0.0}
+
+        def train_step(state, step, mesh):
+            time.sleep(0.04)
+            state["w"] += 1.0
+            return float(step)
+
+        try:
+            result = et.run_elastic(build_state, train_step, 8)
+        finally:
+            obs.disable()
+        assert len(result.losses) == 8
+        assert store.ops > store.die_after  # the store DID die mid-run
+        assert fleet.M_SHIP_FAILURES.total() > before
+
+
+# ---------------------------------------------------------------------------
+# launcher plumbing: per-rank dump rewrite + fleet env
+
+class TestLauncherPlumbing:
+    def test_rank_dump_path_shapes(self):
+        assert fleet.rank_dump_path("metrics.json", 0) \
+            == "metrics.rank0.json"
+        assert fleet.rank_dump_path("/a/b/m.json", 3) == "/a/b/m.rank3.json"
+        assert fleet.rank_dump_path("dump", 2) == "dump.rank2"
+
+    def test_build_pod_rewrites_inherited_dump_path_per_rank(
+            self, tmp_path, monkeypatch):
+        from paddle_tpu.distributed.launch_utils import \
+            CollectiveController
+
+        monkeypatch.setenv("PADDLE_TPU_METRICS_DUMP",
+                           str(tmp_path / "metrics.json"))
+        ctl = CollectiveController(
+            "train.py", [], nnodes=2, node_rank=1,
+            log_dir=str(tmp_path / "log"),
+            fleet_dir=str(tmp_path / "fleet"))
+        pod = ctl._build_pod()
+        env = pod.containers[0].env_vars
+        assert env["PADDLE_TPU_METRICS_DUMP"] \
+            == str(tmp_path / "metrics.rank1.json")
+        assert env["PADDLE_TPU_FLEET"] == "1"
+
+    def test_build_pod_explicit_metrics_dump_wins(self, tmp_path,
+                                                  monkeypatch):
+        from paddle_tpu.distributed.launch_utils import \
+            CollectiveController
+
+        monkeypatch.setenv("PADDLE_TPU_METRICS_DUMP", "inherited.json")
+        ctl = CollectiveController(
+            "train.py", [], nnodes=2, node_rank=0,
+            log_dir=str(tmp_path / "log"),
+            metrics_dump=str(tmp_path / "explicit.json"))
+        env = ctl._build_pod().containers[0].env_vars
+        assert env["PADDLE_TPU_METRICS_DUMP"] \
+            == str(tmp_path / "explicit.rank0.json")
+        assert "PADDLE_TPU_FLEET" not in env  # no fleet_dir, no shipping
+
+
+# ---------------------------------------------------------------------------
+# the --fleet incident renderer
+
+class TestFleetReportMode:
+    def _build_incident(self, tmp_path):
+        # per-rank atexit metric dumps (the launcher rewrite shape)
+        t0 = time.time()
+        for rank, step_s in ((0, 0.05), (1, 0.30)):
+            reg = _mk_registry(counter_n=4 + rank,
+                               step_times=[step_s] * 10)
+            doc = {"version": 1, "generated_unix": t0,
+                   "metrics": reg.to_dict(),
+                   "events": [{"ts": t0 + i * step_s,
+                               "kind": "train.step",
+                               "seconds": step_s, "step": i}
+                              for i in range(10)]}
+            with open(tmp_path / f"metrics.rank{rank}.json", "w") as f:
+                json.dump(doc, f)
+        # the launcher's aggregated dump + merged trace
+        store = InMemoryStore()
+        agg = fleet.FleetAggregator(store, 2, job_id="rep",
+                                    out_dir=str(tmp_path),
+                                    straggler_ratio=2.0,
+                                    straggler_polls=2)
+        hist = {0: [], 1: []}
+        for seq in (1, 2):
+            hist[0] += [0.05] * 5
+            hist[1] += [0.30] * 5
+            for r in (0, 1):
+                reg = MetricsRegistry()
+                h = reg.histogram("train.step_seconds", "d")
+                for t in hist[r]:
+                    h.observe(t, name="train")
+                _publish(store, _snap(r, reg=reg, seq=seq), job="rep")
+            agg.poll()
+        agg.finalize()
+        # a flight dump from the flagged rank
+        from paddle_tpu.observability.flight import FlightRecorder
+
+        FlightRecorder().dump(
+            "straggler", path=str(tmp_path / "flight-77-1.json"),
+            context={"rank": 1, "requested_by": "fleet_aggregator"})
+
+    def test_fleet_mode_renders_one_incident(self, tmp_path, capsys):
+        import importlib.util
+
+        self._build_incident(tmp_path)
+        script = os.path.join(REPO, "tools", "metrics_report.py")
+        spec = importlib.util.spec_from_file_location("_mr_fleet", script)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rc = mod.main(["--fleet", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "FLEET INCIDENT" in out
+        assert "Per-rank step summary" in out
+        assert "STRAGGLER rank 1" in out
+        assert "slowest rank 1" in out
+        # merged metric table: counters summed, gauges per rank
+        assert "test.calls{op=matmul}" in out
+        # cross-rank interleaving with rank tags
+        assert "[  r0]" in out and "[  r1]" in out
+        # flight dump index
+        assert "flight-77-1.json" in out and "reason=straggler" in out
+
+    def test_fleet_mode_empty_dir_fails(self, tmp_path, capsys):
+        import importlib.util
+
+        script = os.path.join(REPO, "tools", "metrics_report.py")
+        spec = importlib.util.spec_from_file_location("_mr_fleet2", script)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main(["--fleet", str(tmp_path)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: real 2-process launch with a slowed rank
+
+def _free_port_block(span=8):
+    for _ in range(64):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+        s.close()
+        if base + span >= 65535:
+            continue
+        ok = True
+        for off in range(1, span):
+            t = socket.socket()
+            try:
+                t.bind(("127.0.0.1", base + off))
+            except OSError:
+                ok = False
+            finally:
+                t.close()
+            if not ok:
+                break
+        if ok:
+            return base
+    raise RuntimeError("no free port block found")
+
+
+@pytest.mark.skipif(not native.is_available(),
+                    reason="native TCPStore not built")
+class TestFleetLaunchE2E:
+    STEPS = 12
+
+    def test_two_rank_run_aggregates_and_names_the_straggler(
+            self, tmp_path):
+        """The acceptance drill: 2 launcher-spawned workers with fleet
+        telemetry on; rank 1 carries injected host-side slowness. The
+        launcher must leave per-rank metric dumps (no collision), one
+        aggregated fleet dump (counters summed, gauges rank-labeled,
+        skew columns), one merged clock-aligned trace with both ranks'
+        step spans, a straggler event naming rank 1, and a flight dump
+        FROM rank 1 with reason ``straggler``."""
+        port = _free_port_block()
+        log_dir = str(tmp_path / "logs")
+        fleet_dir = str(tmp_path / "fleet")
+        flight_dir = str(tmp_path / "flight")
+        metrics_base = str(tmp_path / "metrics.json")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env.update({
+            "PTPU_ELASTIC_STEPS": str(self.STEPS),
+            "PTPU_ELASTIC_LOCAL": "1",
+            "PTPU_ELASTIC_STEP_SLEEP": "0.05",
+            "PADDLE_TPU_CHAOS_SLOW_RANK": "1",
+            "PADDLE_TPU_CHAOS_SLOW_SECONDS": "0.35",
+            "PADDLE_TPU_METRICS_DUMP": metrics_base,
+            "PADDLE_TPU_FLEET_INTERVAL": "0.2",
+            "PADDLE_TPU_FLEET_POLL": "0.25",
+            "PADDLE_TPU_FLEET_STRAGGLER_POLLS": "2",
+        })
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "2", "--node_rank", str(rank),
+             "--master", f"127.0.0.1:{port}", "--log_dir", log_dir,
+             "--fleet_dir", fleet_dir, "--flight_dir", flight_dir,
+             WORKER],
+            env=env, cwd=str(tmp_path), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True) for rank in range(2)]
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                for q in procs:
+                    q.communicate()
+                raise
+            outs.append(out)
+        logs = ""
+        for rank in range(2):
+            lp = os.path.join(log_dir, f"workerlog.{rank}")
+            if os.path.exists(lp):
+                logs += f"\n--- workerlog.{rank} ---\n" + open(lp).read()
+        rcs = [p.returncode for p in procs]
+        assert rcs == [0, 0], f"rcs={rcs}\nouts={outs}\n{logs[-6000:]}"
+
+        # --- per-rank metric dumps, no path collision -------------------
+        rank_dumps = {}
+        for rank in range(2):
+            path = str(tmp_path / f"metrics.rank{rank}.json")
+            assert os.path.exists(path), \
+                f"missing {path}; dir={os.listdir(tmp_path)}\n{logs[-3000:]}"
+            rank_dumps[rank] = json.load(open(path))
+        for rank, d in rank_dumps.items():
+            cnt = sum(s["count"] for s in
+                      d["metrics"]["train.step_seconds"]["series"])
+            assert cnt == self.STEPS, (rank, cnt)
+
+        # --- launcher-side aggregated fleet dump ------------------------
+        fdoc = json.load(open(os.path.join(fleet_dir,
+                                           "fleet_metrics.json")))
+        assert fdoc["kind"] == "fleet_dump"
+        assert fdoc["ranks_reporting"] == [0, 1]
+        merged = fdoc["metrics"]
+        steps_total = sum(s["value"]
+                          for s in merged["train.steps"]["series"])
+        assert steps_total == 2 * self.STEPS      # counters summed
+        offs = {s["labels"]["rank"] for s in
+                merged["fleet.clock_offset_seconds"]["series"]}
+        assert offs == {"0", "1"}                 # gauges rank-labeled
+        merged_steps = sum(s["count"] for s in
+                           merged["train.step_seconds"]["series"])
+        assert merged_steps == 2 * self.STEPS     # histograms merged
+
+        # --- skew + straggler attribution -------------------------------
+        assert fdoc["slowest_rank"] == 1, fdoc["recent_step_seconds"]
+        assert fdoc["step_skew_seconds"] > 0.15, fdoc
+        stragglers = [e for e in fdoc["events"]
+                      if e["kind"] == "fleet.straggler"]
+        assert stragglers and stragglers[0]["rank"] == 1, fdoc["events"]
+        strag_series = merged["fleet.stragglers_detected"]["series"]
+        assert any(s["labels"].get("rank") == "1" and s["value"] >= 1
+                   for s in strag_series), strag_series
+
+        # --- merged clock-aligned trace: both ranks' step spans ---------
+        trace = json.load(open(os.path.join(fleet_dir,
+                                            "fleet_trace.json")))
+        spans = [e for e in trace["traceEvents"]
+                 if e.get("ph") == "X" and e.get("name") == "train.step"]
+        assert {e["pid"] for e in spans} == {0, 1}
+        lanes = [e for e in trace["traceEvents"]
+                 if e.get("name") == "process_name"]
+        assert {e["pid"] for e in lanes} == {0, 1}
+
+        # --- the flagged rank wrote its requested flight dump -----------
+        strag_dumps = []
+        for path in glob.glob(os.path.join(flight_dir, "flight-*.json")):
+            d = json.load(open(path))
+            if d.get("reason") == "straggler":
+                strag_dumps.append(d)
+        assert strag_dumps, \
+            f"no straggler flight dump in {flight_dir}: " \
+            f"{os.listdir(flight_dir) if os.path.isdir(flight_dir) else 'missing'}" \
+            f"\n{logs[-3000:]}"
+        assert strag_dumps[0]["context"]["rank"] == 1
+        assert strag_dumps[0]["context"]["requested_by"] \
+            == "fleet_aggregator"

@@ -78,9 +78,22 @@ def main(argv=None) -> int:
     ap.add_argument("training_script")
     ap.add_argument("--nnodes", type=int, default=2)
     ap.add_argument("--kill_rank", type=int, default=1,
-                    help="global worker rank to SIGKILL")
+                    help="global worker rank to SIGKILL (-1: no kill — "
+                         "e.g. a pure straggler drill)")
     ap.add_argument("--kill_step", type=int, default=2,
                     help="step after which the victim dies")
+    ap.add_argument("--slow_rank", type=int, default=None,
+                    help="straggler injection: this rank sleeps "
+                         "--slow_seconds inside every step region; with "
+                         "--fleet_dir the aggregator must name it")
+    ap.add_argument("--slow_seconds", type=float, default=0.25,
+                    help="extra host-side seconds per step for the "
+                         "slow rank")
+    ap.add_argument("--fleet_dir", type=str, default=None,
+                    help="enable fleet telemetry: aggregated "
+                         "fleet_metrics.json + merged fleet_trace.json "
+                         "land here (default: <log_dir>/fleet when "
+                         "--slow_rank is given)")
     ap.add_argument("--kill_gen", type=int, default=0,
                     help="only kill at this restart generation "
                          "(default 0: the first incarnation)")
@@ -93,15 +106,29 @@ def main(argv=None) -> int:
                     help="flight-recorder dump directory "
                          "(default: <log_dir>/flight)")
     ap.add_argument("--timeout", type=float, default=600.0)
-    ap.add_argument("script_args", nargs=argparse.REMAINDER,
+    ap.add_argument("script_args", nargs="*",
                     help="args after -- go to the training script")
+    # split on a literal "--" ourselves: argparse.REMAINDER would
+    # swallow every option that happens to follow the script path (the
+    # documented `chaos_launch.py train.py --nnodes 2` form silently
+    # misparsed into all-defaults)
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if "--" in argv:
+        split = argv.index("--")
+        argv, passthrough = argv[:split], argv[split + 1:]
+    else:
+        passthrough = []
     args = ap.parse_args(argv)
+    args.script_args = list(args.script_args) + passthrough
 
     flight_dir = args.flight_dir or os.path.join(args.log_dir, "flight")
+    fleet_dir = args.fleet_dir or (
+        os.path.join(args.log_dir, "fleet")
+        if args.slow_rank is not None else None)
     os.makedirs(args.log_dir, exist_ok=True)
     port = _free_port_block()
     master = f"127.0.0.1:{port}"
-    script_args = [a for a in args.script_args if a != "--"]
+    script_args = list(args.script_args)
 
     env = dict(os.environ)
     env["PYTHONPATH"] = (_REPO_ROOT + os.pathsep
@@ -116,10 +143,16 @@ def main(argv=None) -> int:
                "--nnodes", str(args.nnodes), "--node_rank", str(rank),
                "--master", master, "--log_dir", args.log_dir,
                "--max_restarts", str(args.max_restarts),
-               "--flight_dir", flight_dir,
-               "--chaos_kill_rank", str(args.kill_rank),
-               "--chaos_kill_step", str(args.kill_step),
-               args.training_script] + script_args
+               "--flight_dir", flight_dir]
+        if args.kill_rank >= 0:
+            cmd += ["--chaos_kill_rank", str(args.kill_rank),
+                    "--chaos_kill_step", str(args.kill_step)]
+        if args.slow_rank is not None:
+            cmd += ["--chaos_slow_rank", str(args.slow_rank),
+                    "--chaos_slow_seconds", str(args.slow_seconds)]
+        if fleet_dir:
+            cmd += ["--fleet_dir", fleet_dir]
+        cmd += [args.training_script] + script_args
         node_env = dict(env)
         node_env["PADDLE_TPU_CHAOS_KILL_GEN"] = str(args.kill_gen)
         procs.append(subprocess.Popen(cmd, env=node_env))
@@ -150,6 +183,26 @@ def main(argv=None) -> int:
     if dumps:
         print(f"chaos_launch: render dumps with: python "
               f"tools/metrics_report.py {flight_dir}")
+    if fleet_dir:
+        fpath = os.path.join(fleet_dir, "fleet_metrics.json")
+        try:
+            with open(fpath) as f:
+                fdoc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            fdoc = None
+        if fdoc:
+            skew = fdoc.get("step_skew_seconds")
+            print(f"chaos_launch: fleet view — ranks reporting "
+                  f"{fdoc.get('ranks_reporting')}, step skew "
+                  f"{skew if skew is None else round(skew, 4)}s, "
+                  f"slowest rank {fdoc.get('slowest_rank')}")
+            for e in fdoc.get("events", []):
+                if e.get("kind") == "fleet.straggler":
+                    print(f"chaos_launch: STRAGGLER rank {e.get('rank')}"
+                          f" — mean step {e.get('mean_step_seconds')}s ="
+                          f" {e.get('ratio')}x peer median")
+            print(f"chaos_launch: render the incident with: python "
+                  f"tools/metrics_report.py --fleet {fleet_dir}")
     if any(rcs):
         print("chaos_launch: FAILED — a node exited non-zero after "
               "exhausting restarts", file=sys.stderr)
@@ -161,6 +214,13 @@ def main(argv=None) -> int:
                 reasons.add(json.load(f).get("reason"))
         except (OSError, json.JSONDecodeError):
             pass
+    if args.kill_rank < 0:
+        if args.slow_rank is not None and "straggler" in reasons:
+            print("chaos_launch: OK — straggler drill: the slow rank "
+                  "was named and dumped its flight ring on request")
+        else:
+            print("chaos_launch: job finished clean")
+        return 0
     if "peer_death" in reasons and "rejoin" in reasons:
         print("chaos_launch: OK — worker killed, peers dumped, world "
               "re-formed and resumed from checkpoint")

@@ -131,6 +131,23 @@ ELASTIC_REQUIRED_LABELS = {
     "elastic.peer_deaths": ("peer",),
 }
 
+#: fleet-telemetry label discipline (observability/fleet.py): per-rank
+#: series must say WHICH rank, ship failures must say WHY. Additionally
+#: no ``fleet.`` GAUGE may record an unlabeled series at all — an
+#: unattributable fleet gauge (no rank, no job) is exactly the
+#: single-process myopia the subsystem exists to end.
+FLEET_REQUIRED_LABELS = {
+    "fleet.clock_offset_seconds": ("rank",),
+    "fleet.snapshots_shipped": ("rank",),
+    "fleet.snapshots_received": ("rank",),
+    "fleet.rank_step_seconds": ("rank",),
+    "fleet.stragglers_detected": ("rank",),
+    "fleet.ship_failures": ("reason",),
+    "fleet.ranks_reporting": ("job",),
+    "fleet.step_skew_seconds": ("job",),
+    "fleet.slowest_rank": ("job",),
+}
+
 
 def check_metric_registry() -> List[str]:
     from paddle_tpu import observability
@@ -141,6 +158,7 @@ def check_metric_registry() -> List[str]:
     import paddle_tpu.distributed.communication.watchdog  # noqa: F401
     import paddle_tpu.distributed.elastic  # noqa: F401
     import paddle_tpu.io.dataloader  # noqa: F401
+    import paddle_tpu.observability.fleet  # noqa: F401
     import paddle_tpu.observability.runtime  # noqa: F401
     from paddle_tpu.observability.metrics import (CLAIMED_SUBSYSTEMS,
                                                   NAME_RE)
@@ -192,6 +210,20 @@ def check_metric_registry() -> List[str]:
                         f"required label(s) {missing} — elastic recovery "
                         f"series must attribute the incident (who died / "
                         f"why the restart)")
+        if m.name.startswith("fleet."):
+            required = FLEET_REQUIRED_LABELS.get(m.name, ())
+            for labels in m.labelsets():
+                missing = [k for k in required if k not in labels]
+                if missing:
+                    problems.append(
+                        f"metric {m.name!r}: series {labels!r} is missing "
+                        f"required label(s) {missing} — fleet series must "
+                        f"attribute the rank (or the reason/job)")
+                if m.kind == "gauge" and not labels:
+                    problems.append(
+                        f"metric {m.name!r}: recorded an UNLABELED gauge "
+                        f"series — every fleet gauge must carry at least "
+                        f"a rank= or job= label")
     return problems
 
 

@@ -5,6 +5,7 @@ Usage:
     python tools/metrics_report.py metrics.json [--events N] [--top N]
     python tools/metrics_report.py flight-1234-1.json   # flight dumps too
     python tools/metrics_report.py /tmp/flight_dir      # a whole incident
+    python tools/metrics_report.py --fleet /tmp/fleet   # cross-rank view
 
 Input is either the JSON written by ``paddle_tpu.observability.dump(path)``
 (or any workload run with ``PADDLE_TPU_METRICS_DUMP=metrics.json``), or a
@@ -20,6 +21,14 @@ elastic incident leaves behind (each surviving worker dumps
 ``peer_death`` when it detects the kill; each rejoined worker dumps
 ``rejoin`` after resuming from checkpoint), prefixed by a one-line
 per-dump index. Exits non-zero on a file that is neither kind of dump.
+
+``--fleet <dir>`` renders a MULTI-PROCESS incident as one report: the
+per-rank metric dumps the launcher writes (``metrics.rank<N>.json``),
+flight dumps, and the launcher-side aggregated ``fleet_metrics.json``
+become a per-rank step/skew summary, a merged metric table (counters
+summed, gauges rank-labeled), the clock-aligned cross-rank event
+interleaving and the flight-dump index
+(``observability.fleet.render_incident``).
 """
 from __future__ import annotations
 
@@ -76,6 +85,23 @@ def _render_flight_dir(dirname: str, events, top) -> int:
     return 0
 
 
+def _render_fleet_dir(dirname: str, events, top) -> int:
+    """Render a fleet-telemetry incident directory (per-rank metric
+    dumps + flight dumps + the aggregated fleet dump) as one report."""
+    from paddle_tpu.observability.fleet import (load_incident_dir,
+                                                render_incident)
+
+    inc = load_incident_dir(dirname)
+    if not inc["rank_dumps"] and inc["fleet"] is None \
+            and not inc["flights"]:
+        print(f"metrics_report: no per-rank dumps, fleet dump or flight "
+              f"dumps in {dirname!r}", file=sys.stderr)
+        return 1
+    print(render_incident(inc, max_events=40 if events is None else events,
+                          top=top))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("dump", help="JSON written by observability.dump(), a "
@@ -86,7 +112,19 @@ def main(argv=None) -> int:
                          "metrics dumps, the full ring for flight dumps)")
     ap.add_argument("--top", type=int, default=None,
                     help="show only the N largest series per metric")
+    ap.add_argument("--fleet", action="store_true",
+                    help="treat the path as a fleet incident directory: "
+                         "per-rank metric dumps + flight dumps + the "
+                         "launcher's fleet_metrics.json rendered as one "
+                         "cross-rank report")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        if not os.path.isdir(args.dump):
+            print(f"metrics_report: --fleet needs a directory, got "
+                  f"{args.dump!r}", file=sys.stderr)
+            return 1
+        return _render_fleet_dir(args.dump, args.events, args.top)
 
     if os.path.isdir(args.dump):
         return _render_flight_dir(args.dump, args.events, args.top)
