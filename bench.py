@@ -127,6 +127,12 @@ def _emit_metrics_block():
         "fleet_step_skew_seconds": gauge_max("fleet.step_skew_seconds"),
         "fleet_stragglers_detected": tot("fleet.stragglers_detected"),
         "fleet_ship_failures": tot("fleet.ship_failures"),
+        # lint->rewrite roll-ups (static/analysis/rewrite.py; nonzero
+        # when the optimize exercise / PADDLE_TPU_OPTIMIZE ran)
+        "opt_findings_fixed": tot("opt.findings_fixed"),
+        "opt_ops_removed": tot("opt.ops_removed"),
+        "opt_fixedpoint_iterations": gauge_max("opt.fixedpoint_iterations"),
+        "opt_rewrite_seconds": round(hist_sum("opt.rewrite_seconds"), 3),
     }}), flush=True)
 
 
@@ -227,6 +233,90 @@ def bench_llama(on_tpu, steps, warmup, peak_flops, profile=False):
             print(json.dumps({"profile_trace": path}), flush=True)
         except Exception as e:  # profiling must never cost the metric
             print(json.dumps({"profile_error": str(e)[:200]}), flush=True)
+
+
+def capture_llama_train_program(config=None, batch=4, seq=128,
+                                with_grads=True):
+    """The bench llama model captured as a static ``Program``: forward +
+    CE loss (+ the grad section when ``with_grads``), with ids/labels as
+    feed placeholders. The program the lint->rewrite equivalence
+    harness (tests/test_rewrite_passes.py) and the ``--metrics``
+    optimize exercise below both run against — one definition, so
+    "clean on the bench llama train program" means THIS program.
+
+    Returns ``(prog, feed, fetch)`` where fetch is ``[loss] + grads``
+    (or ``[logits]`` without grads — the inference-export slice, where
+    the loss ops are dead code by construction)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    if config is None:
+        config = LlamaConfig.tiny()
+        # the unfused lm_head+CE path: the export slice below needs
+        # materialized logits, and the loss section as separate ops
+        config.fused_lm_head_ce = False
+    model = LlamaForCausalLM(config)
+    params = [p for p in model.parameters() if not p.stop_gradient]
+    rng = np.random.RandomState(0)
+    ids_np = rng.randint(0, config.vocab_size, (batch, seq)).astype("int64")
+    labels_np = np.roll(ids_np, -1, axis=1)
+    prog = static.Program()
+    with static.program_guard(prog):
+        ids = static.data("ids", [batch, seq], "int64")
+        labels = static.data("labels", [batch, seq], "int64")
+        loss, logits = model(ids, labels=labels)
+        if with_grads:
+            grads = static.gradients([loss], params)
+            fetch = [loss] + list(grads)
+        else:
+            fetch = [logits]
+    feed = {"ids": ids_np, "labels": labels_np}
+    return prog, feed, fetch
+
+
+def bench_optimize(on_tpu):
+    """Exercise the lint->rewrite loop on the bench llama program and
+    print one JSON line with what it fixed (the ``opt.`` counters land
+    in the --metrics roll-up). Two views of the SAME capture:
+
+    - train view (fetch loss+grads): expected CLEAN — zero
+      PTL101/102/103/104/105 findings after optimize_program, and the
+      fetch outputs must replay bit-exactly;
+    - inference-export view (fetch logits only): the CE-loss ops are
+      dead and the labels feed is unused by construction — the
+      findings_fixed counts the roll-up reports come from real work.
+
+    Geometry-independent (op-level, not shape-level), so it runs the
+    tiny config everywhere — on TPU the flagship timing above must not
+    pay a second full-size capture."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static.analysis import (REWRITE_CODES,
+                                            optimize_program, run_lints)
+
+    exe = static.Executor()
+
+    prog, feed, fetch = capture_llama_train_program()
+    before = exe.run(prog, feed=feed, fetch_list=fetch)
+    res_train = optimize_program(prog, fetch=fetch)
+    report = run_lints(prog, fetch=fetch, codes=REWRITE_CODES)
+    after = exe.run(prog, feed=feed, fetch_list=fetch)
+    bitexact = all(np.array_equal(b, a) for b, a in zip(before, after))
+
+    eprog, efeed, efetch = capture_llama_train_program(with_grads=False)
+    ops_before = eprog.num_ops
+    res_export = optimize_program(eprog, fetch=efetch)
+    print(json.dumps({"optimize": {
+        "train_findings_remaining": len(report),
+        "train_findings_fixed": res_train.total_fixed,
+        "train_fetch_bitexact": bitexact,
+        "export_findings_fixed": res_export.total_fixed,
+        "export_ops_removed": ops_before - eprog.num_ops,
+        "export_feeds_pruned": res_export.pruned_feeds,
+        "fixedpoint_iterations": max(res_train.iterations,
+                                     res_export.iterations),
+    }}), flush=True)
 
 
 def bench_resnet(on_tpu, steps, warmup, peak_flops):
@@ -774,6 +864,11 @@ def main():
         bench_decode(on_tpu, steps, warmup, peak_flops)
     elif args.config == "llama":
         bench_llama(on_tpu, steps, warmup, peak_flops, profile=args.profile)
+        if args.metrics:
+            # after the timed window: prove the lint->rewrite loop on
+            # the bench llama program so the opt. counters land in the
+            # roll-up below
+            bench_optimize(on_tpu)
 
     if args.metrics:
         _emit_metrics_block()

@@ -172,6 +172,12 @@ define_flag("observability_max_events", 4096,
 define_flag("observability_flight_events", 512,
             "ring-buffer capacity of the flight recorder (last-N runtime "
             "events serialized to PADDLE_TPU_FLIGHT_DIR on crash/timeout)")
+define_flag("optimize_programs", False,
+            "run the lint->rewrite optimization pipeline "
+            "(static.analysis.optimize_program: CSE, cast/transpose-chain "
+            "collapse, dead-op and unused-feed pruning) on a cached clone "
+            "of every Program before Executor.run compiles it; also "
+            "enabled by PADDLE_TPU_OPTIMIZE=1")
 define_flag("use_pallas_flash_attention", True,
             "use the Pallas flash-attention kernel on TPU backends")
 define_flag("use_pallas_rms_norm", True,

@@ -1,0 +1,357 @@
+"""Lint-fix rewrite passes: every pass fixes exactly one PTL lint code.
+
+Reference: the inference analysis pipeline's paired analyze/rewrite
+passes (paddle/fluid/inference/analysis/) — a read-only pass annotates,
+a rewrite pass consumes the annotation. Here the contract is tighter
+and self-checking: each pass
+
+1. runs the lint it claims (``static/analysis/lint.py``, same code,
+   same shared helpers — the PTL101 pass and lint both call
+   ``liveness.live_op_indices``, so they cannot disagree),
+2. applies the fix for each finding (skipping findings whose fix would
+   delete a *protected* value — a fetch target or recompute
+   checkpoint),
+3. re-lints and REFUSES to report success if anything fixable remains.
+
+All passes are registered in ``_PASS_REGISTRY`` and run green under
+``PassManager(verify=True)``; each records its wall time into
+``opt.rewrite_seconds{name}`` and its eliminated findings into
+``opt.findings_fixed{code}`` (metrics defined in
+``static/analysis/rewrite.py``, which also hosts the fixed-point
+driver ``optimize_program``).
+
+Value-id surgery: deleting an instruction remaps its out vids to the
+surviving equivalent value in every later instruction (and in
+``_fetch_vids``/``_remat_checkpoints``), so the program stays SSA and
+the verifier stays green between passes.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from .program_passes import _ProgramPass, Inst
+
+__all__ = [
+    "LintFixPass", "CastChainCollapsePass", "TransposeChainPass",
+    "CSEPass", "PruneDeadOpsPass", "PruneUnusedFeedsPass",
+]
+
+
+class LintFixPass(_ProgramPass):
+    """Base: lint -> fix-per-finding -> re-lint-to-zero loop."""
+
+    #: the PTL code this pass fixes (audited by tools/lint_registry.py)
+    code: str = ""
+    _MAX_ROUNDS = 32
+
+    def _fetch_vids(self, prog, context) -> Tuple[int, ...]:
+        fetch = self.attrs.get("fetch")
+        if not fetch and context is not None:
+            fetch = context.get_attr("fetch_vids")
+        if fetch:
+            return tuple(self._vid(prog, t) for t in fetch)
+        return tuple(getattr(prog, "_fetch_vids", ()) or ())
+
+    def _protected(self, prog, fetch_vids) -> Set[int]:
+        prot = set(fetch_vids)
+        prot.update(getattr(prog, "_remat_checkpoints", ()) or ())
+        return prot
+
+    def _fix_round(self, prog, fetch_vids, protected) -> Tuple[int, int]:
+        """Apply one round of fixes; returns (n_fixed, n_skipped)."""
+        raise NotImplementedError
+
+    def _apply_one(self, prog, context):
+        from ...static.analysis.lint import run_lints
+        from ...static.analysis.rewrite import (_M_FIXED,
+                                                _M_REWRITE_SECONDS)
+        from ... import observability as _obs
+
+        t0 = time.perf_counter()
+        fetch_vids = self._fetch_vids(prog, context)
+        protected = self._protected(prog, fetch_vids)
+        total = 0
+        skipped = 0
+        for _ in range(self._MAX_ROUNDS):
+            fixed, skipped = self._fix_round(prog, fetch_vids, protected)
+            if fixed == 0:
+                break
+            total += fixed
+            prog._invalidate()
+        report = run_lints(prog, fetch=fetch_vids, codes=[self.code])
+        if len(report) > skipped:
+            raise RuntimeError(
+                f"pass {self.name!r} finished but {len(report)} "
+                f"{self.code} finding(s) remain fixable (only {skipped} "
+                f"were skipped as protected):\n" + report.render())
+        if context is not None:
+            fixed_by_code = context.attrs.setdefault("findings_fixed", {})
+            fixed_by_code[self.code] = fixed_by_code.get(self.code, 0) \
+                + total
+        if _obs.state.on:
+            if total:
+                _M_FIXED.inc(total, code=self.code)
+            _M_REWRITE_SECONDS.observe(time.perf_counter() - t0,
+                                       name=self.name)
+            _obs.emit("opt.pass_fixed", name=self.name, code=self.code,
+                      fixed=total, skipped=skipped,
+                      seconds=time.perf_counter() - t0)
+
+    # -- shared instruction surgery --------------------------------------
+    @staticmethod
+    def _rewrite(prog, *, deletions: Optional[Dict[int, Dict[int, int]]]
+                 = None,
+                 replacements: Optional[Dict[int, Inst]] = None):
+        """One forward walk applying per-op plans.
+
+        ``deletions[idx]`` maps the deleted op's out vids to surviving
+        equivalent vids; every later use (and the program's recorded
+        fetch/checkpoint vids) is remapped. ``replacements[idx]``
+        swaps in a new instruction (its in_vids are remapped too, so
+        plans may reference pre-walk vids)."""
+        deletions = deletions or {}
+        replacements = replacements or {}
+        remap: Dict[int, int] = {}
+        new_insts: List[Inst] = []
+        for idx, inst in enumerate(prog._insts):
+            if idx in replacements:
+                inst = replacements[idx]
+            prim, in_vids, static_items, out_vids = inst
+            in_vids = tuple(remap.get(v, v) for v in in_vids)
+            if idx in deletions:
+                for o, r in deletions[idx].items():
+                    remap[o] = remap.get(r, r)
+                continue
+            new_insts.append((prim, in_vids, static_items, out_vids))
+        prog._insts = new_insts
+        if remap:
+            if getattr(prog, "_fetch_vids", None):
+                prog._fetch_vids = tuple(
+                    remap.get(v, v) for v in prog._fetch_vids)
+            if getattr(prog, "_remat_checkpoints", None):
+                prog._remat_checkpoints = tuple(
+                    remap.get(v, v) for v in prog._remat_checkpoints)
+
+
+class CastChainCollapsePass(LintFixPass):
+    """PTL103: delete no-op casts; collapse lossless cast chains to a
+    single cast from the original dtype. Chains with a narrowing
+    intermediate are numerics-changing and never touched (the lint
+    reports those as PTL108, not PTL103)."""
+
+    code = "PTL103"
+
+    def __init__(self, attrs=None):
+        super().__init__("collapse_redundant_casts", attrs)
+
+    def _fix_round(self, prog, fetch_vids, protected):
+        from ...static.analysis.lint import (LintContext, _cast_chain,
+                                             lossless_cast)
+
+        ctx = LintContext(prog, fetch_vids)
+        deletions: Dict[int, Dict[int, int]] = {}
+        replacements: Dict[int, Inst] = {}
+        fixed = skipped = 0
+        for idx, (prim, in_vids, static_items, out_vids) in \
+                enumerate(ctx.insts):
+            if prim != "cast_p" or not in_vids or not out_vids:
+                continue
+            src = ctx.dtype_of(in_vids[0])
+            dst = ctx.dtype_of(out_vids[0])
+            if src is not None and dst is not None and src == dst:
+                if out_vids[0] in protected:
+                    skipped += 1
+                    continue
+                deletions[idx] = {out_vids[0]: in_vids[0]}
+                fixed += 1
+                continue
+            chain = _cast_chain(ctx, idx)
+            if chain is None:
+                continue
+            orig_vid, orig, mid, _dst = chain
+            prod = ctx.producer[in_vids[0]]
+            if prod in deletions or prod in replacements:
+                continue  # producer changed this round; retry next round
+            if lossless_cast(orig, mid):
+                replacements[idx] = (prim, (orig_vid,), static_items,
+                                     out_vids)
+                fixed += 1
+        if fixed:
+            self._rewrite(prog, deletions=deletions,
+                          replacements=replacements)
+        return fixed, skipped
+
+
+class TransposeChainPass(LintFixPass):
+    """PTL104: delete identity transposes; cancel chains composing to
+    the identity; rewrite any other transpose-of-transpose chain as ONE
+    transpose of the original operand with the composed permutation."""
+
+    code = "PTL104"
+
+    def __init__(self, attrs=None):
+        super().__init__("cancel_redundant_transposes", attrs)
+
+    def _fix_round(self, prog, fetch_vids, protected):
+        from ...static.analysis.lint import LintContext, _attrs_dict
+
+        ctx = LintContext(prog, fetch_vids)
+        deletions: Dict[int, Dict[int, int]] = {}
+        replacements: Dict[int, Inst] = {}
+        fixed = skipped = 0
+        for idx, (prim, in_vids, static_items, out_vids) in \
+                enumerate(ctx.insts):
+            if prim != "transpose_p" or not in_vids or not out_vids:
+                continue
+            perm = _attrs_dict(static_items).get("perm")
+            if perm is not None and list(perm) == sorted(range(len(perm))):
+                if out_vids[0] in protected:
+                    skipped += 1
+                    continue
+                deletions[idx] = {out_vids[0]: in_vids[0]}
+                fixed += 1
+                continue
+            prod = ctx.producer.get(in_vids[0])
+            if prod is None or ctx.insts[prod][0] != "transpose_p":
+                continue
+            if prod in deletions or prod in replacements:
+                continue  # producer changed this round; retry next round
+            inner = _attrs_dict(ctx.insts[prod][2]).get("perm")
+            if inner is None or perm is None or len(inner) != len(perm):
+                continue
+            composed = [inner[p] for p in perm]
+            inner_in = ctx.insts[prod][1][0]
+            if composed == sorted(range(len(composed))):
+                if out_vids[0] in protected:
+                    skipped += 1
+                    continue
+                deletions[idx] = {out_vids[0]: inner_in}
+            else:
+                replacements[idx] = (prim, (inner_in,),
+                                     (("perm", tuple(composed)),),
+                                     out_vids)
+            fixed += 1
+        if fixed:
+            self._rewrite(prog, deletions=deletions,
+                          replacements=replacements)
+        return fixed, skipped
+
+
+class CSEPass(LintFixPass):
+    """PTL105: classic common-subexpression elimination — an op whose
+    (prim, operands, attrs) key matches an earlier op reuses that op's
+    outputs and disappears. Effectful ops, the grad section and
+    unhashable-attr ops are never candidates (same skips as the lint).
+    Value-equal operands are recognized *through* this round's own
+    remaps, so cascades (dup-of-dup) resolve in one sweep."""
+
+    code = "PTL105"
+
+    def __init__(self, attrs=None):
+        super().__init__("common_subexpression_elimination", attrs)
+
+    def _fix_round(self, prog, fetch_vids, protected):
+        from ...static.analysis.liveness import is_effectful
+        from ...static.analysis.verify import GRAD_OP
+
+        seen: Dict[tuple, Tuple[int, ...]] = {}
+        remap: Dict[int, int] = {}
+        new_insts: List[Inst] = []
+        fixed = skipped = 0
+        for prim, in_vids, static_items, out_vids in prog._insts:
+            in_vids = tuple(remap.get(v, v) for v in in_vids)
+            eligible = (prim != GRAD_OP and in_vids
+                        and not is_effectful(prim))
+            if eligible:
+                key = (prim, in_vids, static_items)
+                try:
+                    hash(key)
+                except TypeError:
+                    key = None  # unhashable attrs: not a candidate
+                if key is not None:
+                    first_outs = seen.get(key)
+                    if first_outs is not None:
+                        if set(out_vids) & protected:
+                            skipped += 1
+                        else:
+                            for o, r in zip(out_vids, first_outs):
+                                remap[o] = r
+                            fixed += 1
+                            continue
+                    else:
+                        seen[key] = out_vids
+            new_insts.append((prim, in_vids, static_items, out_vids))
+        if fixed:
+            prog._insts = new_insts
+            if getattr(prog, "_fetch_vids", None):
+                prog._fetch_vids = tuple(
+                    remap.get(v, v) for v in prog._fetch_vids)
+            if getattr(prog, "_remat_checkpoints", None):
+                prog._remat_checkpoints = tuple(
+                    remap.get(v, v) for v in prog._remat_checkpoints)
+        return fixed, skipped
+
+
+class PruneDeadOpsPass(LintFixPass):
+    """PTL101: drop ops that never (transitively) reach a fetch target.
+    Reachability is the SHARED ``liveness.live_op_indices`` sweep — the
+    exact set the PTL101 lint reports, so post-pass re-lint is zero by
+    construction. A no-op without fetch targets (like the lint, which
+    refuses to guess)."""
+
+    code = "PTL101"
+
+    def __init__(self, attrs=None):
+        super().__init__("prune_dead_ops", attrs)
+
+    def _fix_round(self, prog, fetch_vids, protected):
+        from ...static.analysis.liveness import live_op_indices
+
+        if not fetch_vids:
+            return 0, 0
+        # liveness roots at every PROTECTED vid (fetch targets plus
+        # recompute checkpoints), so a checkpoint producer is never
+        # deleted out from under _remat_checkpoints. Ops the fetch-only
+        # lint calls dead but protection keeps are the skipped set
+        # (fetch ⊆ protected, so kept_lint ⊆ kept; deleting
+        # protected-dead ops cannot change the fetch-liveness of kept
+        # ops — a removed op never feeds a kept one).
+        kept = live_op_indices(prog._insts, protected)
+        kept_lint = live_op_indices(prog._insts, fetch_vids)
+        skipped = len(kept) - len(kept_lint)
+        dead = len(prog._insts) - len(kept)
+        if dead == 0:
+            return 0, skipped
+        prog._insts = [inst for idx, inst in enumerate(prog._insts)
+                       if idx in kept]
+        return dead, skipped
+
+
+class PruneUnusedFeedsPass(LintFixPass):
+    """PTL102: drop feed placeholders nothing consumes. Pruned names are
+    recorded on ``program._pruned_feed_names`` so ``Executor.run``
+    keeps ACCEPTING (and ignoring) feeds callers still pass for them —
+    pruning relaxes the feed contract, it must never break it."""
+
+    code = "PTL102"
+
+    def __init__(self, attrs=None):
+        super().__init__("prune_unused_feeds", attrs)
+
+    def _fix_round(self, prog, fetch_vids, protected):
+        consumed: Set[int] = set()
+        for _prim, in_vids, _static, _outs in prog._insts:
+            consumed.update(in_vids)
+        unused = [(name, vid) for name, vid in prog._feed_names.items()
+                  if vid not in consumed and vid not in protected]
+        if not unused:
+            return 0, 0
+        drop = {name for name, _vid in unused}
+        prog._placeholders = [ph for ph in prog._placeholders
+                              if ph[0] not in drop]
+        prog._feed_names = {n: v for n, v in prog._feed_names.items()
+                            if n not in drop}
+        pruned = set(getattr(prog, "_pruned_feed_names", ()) or ())
+        prog._pruned_feed_names = pruned | drop
+        return len(unused), 0

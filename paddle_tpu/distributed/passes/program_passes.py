@@ -124,26 +124,29 @@ class ConstantFoldingPass(_ProgramPass):
 class DeadCodeEliminationPass(_ProgramPass):
     """Reference: inference/analysis ir_graph_clean_pass / DCE. Keeps ops
     whose outputs (transitively) reach the fetch vids given in attrs
-    ``fetch`` (Tensors or vids) or context attr "fetch_vids"."""
+    ``fetch`` (Tensors or vids) or context attr "fetch_vids".
+
+    Reachability is the SHARED sweep in ``static/analysis/liveness.py``
+    — the same one the PTL101 dead-op lint reports against, so this
+    pass and the lint can never disagree about what is dead (the sweep
+    also keeps effectful ops and the grad section, which this pass
+    previously would have dropped)."""
 
     def __init__(self, attrs=None):
         super().__init__("dead_code_elimination", attrs)
 
     def _apply_one(self, prog, context):
+        from ...static.analysis.liveness import live_op_indices
+
         fetch = self.attrs.get("fetch")
         if fetch is None and context is not None:
             fetch = context.get_attr("fetch_vids")
         if not fetch:
             return
-        live: Set[int] = {self._vid(prog, t) for t in fetch}
-        kept: List[Inst] = []
-        for inst in reversed(prog._insts):
-            prim_name, in_vids, _static, out_vids = inst
-            if any(v in live for v in out_vids):
-                kept.append(inst)
-                live.update(in_vids)
-        kept.reverse()
-        prog._insts = kept
+        live = {self._vid(prog, t) for t in fetch}
+        kept = live_op_indices(prog._insts, live)
+        prog._insts = [inst for idx, inst in enumerate(prog._insts)
+                       if idx in kept]
 
 
 class FuseAddActPass(_ProgramPass):

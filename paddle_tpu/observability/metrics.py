@@ -43,6 +43,9 @@ CLAIMED_SUBSYSTEMS = {
                    # peer deaths, checkpoint-restore cost (ROADMAP item 1)
     "fleet",       # observability/fleet.py — cross-rank snapshot
                    # shipping/aggregation, step skew, stragglers
+    "opt",         # static/analysis/rewrite.py — lint->rewrite driver:
+                   # findings fixed/remaining by code, per-pass rewrite
+                   # seconds, fixed-point iterations
     "test",        # scratch names registered by the test suite
 }
 

@@ -161,6 +161,10 @@ def render_report(d: Dict[str, Any], max_events: int = 20,
         if lines:
             lines.append("")
         lines.append(f"=== {sub} ===")
+        if sub == "opt":
+            opt_lines = render_opt_table(metrics)
+            if opt_lines:
+                lines += opt_lines + [""]
         if g["counter"]:
             lines += ["Counters", "-" * (_WIDTH + 14)]
             lines += [f"{n[:_WIDTH]:<{_WIDTH}}{v:>14}"
@@ -194,6 +198,34 @@ def _as_num(v) -> float:
         return float(v)
     except (TypeError, ValueError):
         return 0.0
+
+
+def render_opt_table(metrics: Dict[str, Any]) -> List[str]:
+    """Per-code fixed/remaining table for the lint->rewrite driver
+    (``opt.findings_fixed`` / ``opt.findings_remaining``), rendered next
+    to the per-pass timing view inside the ``opt`` subsystem section —
+    the at-a-glance answer to "what did optimize_program actually fix,
+    and what is still outstanding"."""
+    def by_code(name):
+        out = {}
+        for s in (metrics.get(name) or {}).get("series", []):
+            code = (s.get("labels") or {}).get("code")
+            if code is not None:
+                out[code] = s.get("value", 0)
+        return out
+
+    fixed = by_code("opt.findings_fixed")
+    remaining = by_code("opt.findings_remaining")
+    if not fixed and not remaining:
+        return []
+    header = f"{'code':<10}{'fixed':>10}{'remaining':>12}"
+    lines = ["lint -> rewrite, findings by code", header,
+             "-" * len(header)]
+    for code in sorted(set(fixed) | set(remaining)):
+        rem = remaining.get(code)
+        lines.append(f"{code:<10}{fixed.get(code, 0):>10}"
+                     f"{'-' if rem is None else rem:>12}")
+    return lines
 
 
 def _render_events(evs: List[Dict[str, Any]], max_events: int) -> List[str]:

@@ -39,7 +39,16 @@ from .diagnostics import (  # noqa: F401
     CODES, Diagnostic, DiagnosticReport, ProgramVerificationError, Severity,
 )
 from .ir_dump import dump_program  # noqa: F401
-from .lint import LintContext, register_lint, run_lints  # noqa: F401
+from .lint import (  # noqa: F401
+    LintContext, lossless_cast, register_lint, run_lints,
+)
+from .liveness import is_effectful, live_op_indices  # noqa: F401
+from .rewrite import (  # noqa: F401
+    DEFAULT_PIPELINE, OptimizeResult, REWRITE_CODES, optimize_program,
+)
+from .sharding_lint import (  # noqa: F401
+    SHARDING_LINT_CODES, lint_fleet_trace, run_placement_lints,
+)
 from .verify import (  # noqa: F401
     check_program, propagate_avals, recorded_avals, verify_program,
 )
@@ -48,4 +57,8 @@ __all__ = [
     "CODES", "Diagnostic", "DiagnosticReport", "ProgramVerificationError",
     "Severity", "dump_program", "LintContext", "register_lint", "run_lints",
     "check_program", "propagate_avals", "recorded_avals", "verify_program",
+    "lossless_cast", "is_effectful", "live_op_indices",
+    "DEFAULT_PIPELINE", "OptimizeResult", "REWRITE_CODES",
+    "optimize_program",
+    "SHARDING_LINT_CODES", "lint_fleet_trace", "run_placement_lints",
 ]

@@ -51,11 +51,21 @@ CODES = {
     # lints (warnings/notes)
     "PTL101": "dead op: outputs never reach a fetch target",
     "PTL102": "unused feed: placeholder is never consumed",
-    "PTL103": "redundant cast (no-op cast or collapsible cast chain)",
-    "PTL104": "redundant transpose chain (permutations cancel out)",
+    "PTL103": "redundant cast (no-op cast or losslessly collapsible chain)",
+    "PTL104": "redundant transpose chain (cancels out or composes to one)",
     "PTL105": "common-subexpression candidate (identical op computed twice)",
     "PTL106": "silent float64 -> float32 demotion",
     "PTL107": "non-jittable primitive inside a jit-replayed program",
+    "PTL108": "cast chain with a narrowing intermediate (numerics-changing, "
+              "NOT redundant — informational only)",
+    # sharding-aware lints (PTL2xx) — layout/placement findings feeding
+    # the auto-parallel planner (lint.py + sharding_lint.py)
+    "PTL201": "float32 operand on a bfloat16 compute hot path (mixed-dtype "
+              "GEMM upcasts to the fp32 rate)",
+    "PTL202": "placement mismatch forces an avoidable collective (reshard/"
+              "allgather a consistent plan would not need)",
+    "PTL203": "collective serializes against compute in the merged fleet "
+              "trace (no overlap with any compute span on that rank)",
 }
 
 

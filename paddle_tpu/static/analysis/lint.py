@@ -22,19 +22,12 @@ import numpy as np
 
 from ...core import dispatch
 from .diagnostics import DiagnosticReport, Severity
+from .liveness import is_effectful as _effectful
+from .liveness import live_op_indices
 from .verify import GRAD_OP, propagate_avals
 
-__all__ = ["LintContext", "run_lints", "register_lint", "LINTS"]
-
-# prims whose value depends on RNG/state: never CSE/DCE candidates
-_EFFECTFUL_MARKERS = ("rand", "uniform", "normal", "dropout", "bernoulli",
-                      "poisson", "multinomial", "exponential", "seed",
-                      "print", "py_func", "barrier")
-
-
-def _effectful(prim_name: str) -> bool:
-    low = prim_name.lower()
-    return any(m in low for m in _EFFECTFUL_MARKERS)
+__all__ = ["LintContext", "run_lints", "register_lint", "LINTS",
+           "lossless_cast"]
 
 
 def _attrs_dict(static_items) -> Dict:
@@ -71,14 +64,14 @@ class LintContext:
         return None if aval is None else np.dtype(aval[1])
 
 
-LINTS: List[Tuple[str, Callable]] = []
+LINTS: List[Tuple[str, Severity, Callable]] = []
 
 
-def register_lint(code: str):
+def register_lint(code: str, severity: Severity = Severity.WARNING):
     """Register ``fn(ctx) -> iterable[(message, op_index, hint)]``."""
 
     def deco(fn):
-        LINTS.append((code, fn))
+        LINTS.append((code, severity, fn))
         return fn
 
     return deco
@@ -98,11 +91,11 @@ def run_lints(program, fetch=None, *,
     ctx = LintContext(program, fetch_vids)
     only = set(codes) if codes is not None else None
     report = DiagnosticReport()
-    for code, fn in LINTS:
+    for code, severity, fn in LINTS:
         if only is not None and code not in only:
             continue
         for message, op_index, hint in fn(ctx):
-            report.add(code, Severity.WARNING, message,
+            report.add(code, severity, message,
                        op_index=op_index, hint=hint)
     return report
 
@@ -112,17 +105,14 @@ def run_lints(program, fetch=None, *,
 # ---------------------------------------------------------------------------
 @register_lint("PTL101")
 def _dead_ops(ctx: LintContext):
-    """Ops whose outputs never (transitively) reach a fetch target."""
+    """Ops whose outputs never (transitively) reach a fetch target.
+
+    Reachability comes from the SHARED sweep in liveness.py — the same
+    one the dead-code rewrite passes delete against, so this lint and
+    those passes agree on deadness by construction."""
     if not ctx.fetch_vids:
         return
-    live: Set[int] = set(ctx.fetch_vids)
-    kept: Set[int] = set()
-    for idx in range(len(ctx.insts) - 1, -1, -1):
-        prim_name, in_vids, _s, out_vids = ctx.insts[idx]
-        if any(v in live for v in out_vids) or _effectful(prim_name) \
-                or prim_name == GRAD_OP:
-            kept.add(idx)
-            live.update(in_vids)
+    kept = live_op_indices(ctx.insts, ctx.fetch_vids)
     for idx, (prim_name, _i, _s, out_vids) in enumerate(ctx.insts):
         if idx not in kept:
             yield (f"{prim_name!r} (outs {sorted(out_vids)}) never reaches "
@@ -140,8 +130,67 @@ def _unused_feeds(ctx: LintContext):
                    "feed at Executor.run")
 
 
+def lossless_cast(src, mid) -> bool:
+    """True when casting ``src`` -> ``mid`` preserves every value, i.e.
+    a ``src -> mid -> dst`` chain computes the same result as a single
+    ``src -> dst`` cast. int -> float is decided by mantissa coverage
+    BEFORE consulting numpy's table: ``can_cast(int64, float64,
+    'safe')`` is True there even though float64 only holds integers up
+    to 2**53 exactly. The finfo/iinfo fallbacks cover the ml_dtypes
+    extension floats (bfloat16, fp8) numpy's table does not know.
+    Unknown pairs read as lossy — a wrong False only suppresses a
+    rewrite, never changes numerics."""
+    src, mid = np.dtype(src), np.dtype(mid)
+    if src == mid:
+        return True
+    if src.kind in "iu" and mid.kind in "fc":
+        try:  # exact iff the float mantissa covers every int value
+            value_bits = 8 * src.itemsize - (1 if src.kind == "i" else 0)
+            return np.finfo(mid).nmant + 1 >= value_bits
+        except (TypeError, ValueError):
+            return False
+    try:
+        if np.can_cast(src, mid, casting="safe"):
+            return True
+    except TypeError:
+        pass
+    try:  # float -> float beyond numpy's table (bfloat16 et al.)
+        fs, fm = np.finfo(src), np.finfo(mid)
+        return (fm.nmant >= fs.nmant and fm.maxexp >= fs.maxexp
+                and fm.minexp <= fs.minexp)
+    except (TypeError, ValueError):
+        pass
+    try:  # int -> int
+        is_, im = np.iinfo(src), np.iinfo(mid)
+        return im.min <= is_.min and im.max >= is_.max
+    except (TypeError, ValueError):
+        return False
+
+
+def _cast_chain(ctx: LintContext, idx: int):
+    """(orig_vid, orig_dtype, mid_dtype, dst_dtype) when op#idx is the
+    outer cast of a cast-of-cast chain with known dtypes, else None."""
+    prim_name, in_vids, _static, out_vids = ctx.insts[idx]
+    if prim_name != "cast_p" or not in_vids or not out_vids:
+        return None
+    prod = ctx.producer.get(in_vids[0])
+    if prod is None or ctx.insts[prod][0] != "cast_p" \
+            or not ctx.insts[prod][1]:
+        return None
+    orig_vid = ctx.insts[prod][1][0]
+    orig = ctx.dtype_of(orig_vid)
+    mid = ctx.dtype_of(in_vids[0])
+    dst = ctx.dtype_of(out_vids[0])
+    if orig is None or mid is None or dst is None:
+        return None
+    return orig_vid, orig, mid, dst
+
+
 @register_lint("PTL103")
 def _redundant_casts(ctx: LintContext):
+    """No-op casts and LOSSLESSLY collapsible cast chains. A chain whose
+    intermediate narrows the dtype is NOT redundant (collapsing it
+    changes numerics) — those are reported separately as PTL108."""
     for idx, (prim_name, in_vids, static_items, out_vids) in \
             enumerate(ctx.insts):
         if prim_name != "cast_p" or not in_vids:
@@ -153,16 +202,34 @@ def _redundant_casts(ctx: LintContext):
                    f"(operand is already {src.name})", idx,
                    "delete the cast; it costs a copy outside fusion")
             continue
-        prod = ctx.producer.get(in_vids[0])
-        if prod is not None and ctx.insts[prod][0] == "cast_p":
-            inner_src = ctx.dtype_of(ctx.insts[prod][1][0])
-            src_s = inner_src.name if inner_src is not None else "?"
-            dst_s = dst.name if dst is not None else "?"
-            yield (f"cast chain %{ctx.insts[prod][1][0]} -> %{in_vids[0]} "
-                   f"-> %{out_vids[0] if out_vids else '?'} "
-                   f"({src_s} -> ... -> {dst_s})", idx,
-                   "collapse to a single cast from the original dtype "
-                   "(beware: a narrowing intermediate changes numerics)")
+        chain = _cast_chain(ctx, idx)
+        if chain is None:
+            continue
+        orig_vid, orig, mid, dst_d = chain
+        if lossless_cast(orig, mid):
+            yield (f"cast chain %{orig_vid} -> %{in_vids[0]} "
+                   f"-> %{out_vids[0]} ({orig.name} -> {mid.name} -> "
+                   f"{dst_d.name}; intermediate is lossless)", idx,
+                   "collapse to a single cast from the original dtype")
+
+
+@register_lint("PTL108", Severity.NOTE)
+def _narrowing_cast_chains(ctx: LintContext):
+    """Cast chains whose intermediate NARROWS the dtype: the round trip
+    changes numerics (that may well be intended — e.g. a precision
+    fence), so unlike PTL103 this is a note, never a rewrite target."""
+    for idx in range(len(ctx.insts)):
+        chain = _cast_chain(ctx, idx)
+        if chain is None:
+            continue
+        orig_vid, orig, mid, dst = chain
+        if not lossless_cast(orig, mid):
+            yield (f"cast chain %{orig_vid} ({orig.name}) -> {mid.name} "
+                   f"-> {dst.name} narrows through {mid.name}: the "
+                   f"intermediate changes numerics, the chain is not "
+                   f"redundant", idx,
+                   "nothing to collapse — if the precision fence is "
+                   "unintended, cast once from the source dtype")
 
 
 @register_lint("PTL104")
@@ -187,6 +254,13 @@ def _redundant_transposes(ctx: LintContext):
             yield (f"transpose chain op#{prod} -> op#{idx} composes to the "
                    f"identity permutation", idx,
                    "delete both transposes (the chain is a no-op)")
+        else:
+            # two data movements where one suffices: any transpose chain
+            # composes to a SINGLE transpose with the composed perm
+            yield (f"transpose chain op#{prod} -> op#{idx} composes to a "
+                   f"single transpose with perm {tuple(composed)}", idx,
+                   "replace the pair with one transpose of the original "
+                   "operand using the composed permutation")
 
 
 @register_lint("PTL105")
@@ -245,3 +319,67 @@ def _non_jittable_under_jit(ctx: LintContext):
                    f"replays the whole program under jax.jit", idx,
                    "host callbacks/impure ops must go through "
                    "jax.pure_callback (or run eagerly outside the program)")
+
+
+# compute-bound prims where operand dtype decides which MXU path the
+# compiler picks — a single fp32 operand upcasts the whole contraction
+_HEAVY_MARKERS = ("matmul", "linear", "conv", "sdpa", "attention",
+                  "einsum", "bmm", "addmm")
+
+
+def _heavy(prim_name: str) -> bool:
+    low = prim_name.lower()
+    return any(m in low for m in _HEAVY_MARKERS)
+
+
+@register_lint("PTL201")
+def _fp32_on_bf16_hot_path(ctx: LintContext):
+    """A compute-bound op runs in float32 while (some of) its data is
+    bfloat16-precision anyway: type promotion at capture inserts an
+    upcast ``cast_p`` when a bf16 tensor meets an fp32 one, so the GEMM
+    pays the fp32 MXU rate for operands that never carried more than
+    bf16 precision. The first sharding-aware lint family (PTL2xx) —
+    dtype is part of the layout the auto-parallel planner schedules
+    around. Fix direction: demote the fp32 side (usually a weight left
+    out of ``model.bfloat16()``), not the compute."""
+    low_prec = (np.dtype("bfloat16"), np.dtype("float16"))
+    f32 = np.dtype("float32")
+    for idx, (prim_name, in_vids, _static, _out_vids) in \
+            enumerate(ctx.insts):
+        if not _heavy(prim_name) or len(in_vids) < 2:
+            continue
+        dts = [(v, ctx.dtype_of(v)) for v in in_vids]
+        if not any(d == f32 for _v, d in dts):
+            continue
+        # mixed direct operands (possible on hand-built programs)
+        bf = [v for v, d in dts if d in low_prec]
+        # fp32 operands that are upcasts of low-precision data (the
+        # shape API-captured programs take: promotion casts first)
+        upcast = []
+        for v, d in dts:
+            if d != f32:
+                continue
+            prod = ctx.producer.get(v)
+            if prod is None or ctx.insts[prod][0] != "cast_p" \
+                    or not ctx.insts[prod][1]:
+                continue
+            src = ctx.dtype_of(ctx.insts[prod][1][0])
+            if src in low_prec:
+                upcast.append((v, ctx.insts[prod][1][0], src))
+        if upcast:
+            v, src_v, src = upcast[0]
+            yield (f"{prim_name!r} computes in float32 but operand %{v} "
+                   f"is an upcast of {src.name} %{src_v} — the op runs "
+                   f"at the fp32 rate on a {src.name} hot path", idx,
+                   "demote the float32 side to match (e.g. the weight "
+                   "missed by model.bfloat16()); the data never carried "
+                   "fp32 precision, only the throughput cost remains")
+        elif bf:
+            fp = [v for v, d in dts if d == f32]
+            yield (f"{prim_name!r} mixes {', '.join(f'%{v}' for v in bf)} "
+                   f"(low precision) with float32 operands "
+                   f"({', '.join(f'%{v}' for v in fp)}) — promotion "
+                   f"upcasts the whole op to float32", idx,
+                   "cast the float32 operand(s) down (or keep the path "
+                   "float32 intentionally); the mixed GEMM runs at the "
+                   "fp32 rate, not the bf16 rate")

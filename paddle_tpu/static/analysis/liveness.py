@@ -1,0 +1,56 @@
+"""Shared liveness analysis over the flat instruction list.
+
+ONE implementation of backward reachability, used by BOTH the PTL101
+dead-op lint (lint.py) and the rewrite passes that delete code
+(``dead_code_elimination`` / ``prune_dead_ops`` in
+distributed/passes/) — so the lint and the pass can never disagree
+about what is dead. Before this module each side reimplemented the
+sweep and a divergence (e.g. one treating effectful ops as roots and
+the other not) would have made the lint report ops the pass refuses to
+delete, or worse, the pass delete ops the lint considers live.
+
+Liveness roots, in both directions of the loop:
+
+- any op producing a value in ``live_vids`` (the fetch targets);
+- effectful ops (RNG/state/IO — their *execution* is the point, the
+  outputs reaching a fetch is not required);
+- the ``__gradients__`` pseudo-op (its replay drives the backward; its
+  operands must stay live even when only the grads are fetched).
+"""
+from __future__ import annotations
+
+from typing import Iterable, Sequence, Set
+
+from .verify import GRAD_OP
+
+__all__ = ["EFFECTFUL_MARKERS", "is_effectful", "live_op_indices"]
+
+#: prims whose value depends on RNG/state or that perform IO: never
+#: DCE/CSE candidates — substrings matched case-insensitively.
+EFFECTFUL_MARKERS = ("rand", "uniform", "normal", "dropout", "bernoulli",
+                     "poisson", "multinomial", "exponential", "seed",
+                     "print", "py_func", "barrier")
+
+
+def is_effectful(prim_name: str) -> bool:
+    low = prim_name.lower()
+    return any(m in low for m in EFFECTFUL_MARKERS)
+
+
+def live_op_indices(insts: Sequence[tuple],
+                    live_vids: Iterable[int]) -> Set[int]:
+    """Indices of instructions that are live w.r.t. ``live_vids``.
+
+    Single backward sweep: an op is kept when any of its outputs is
+    live (feeds a later live op or a fetch target), when it is
+    effectful, or when it is the ``__gradients__`` section; kept ops
+    propagate liveness to their inputs."""
+    live: Set[int] = set(live_vids)
+    kept: Set[int] = set()
+    for idx in range(len(insts) - 1, -1, -1):
+        prim_name, in_vids, _static, out_vids = insts[idx]
+        if any(v in live for v in out_vids) or is_effectful(prim_name) \
+                or prim_name == GRAD_OP:
+            kept.add(idx)
+            live.update(in_vids)
+    return kept

@@ -1,0 +1,189 @@
+"""Lint-to-rewrite driver: iterate the lint-fix pass pipeline to a
+fixed point and measure the benefit.
+
+``run_lints`` *detects* dead ops, unused feeds, redundant cast/
+transpose chains and CSE candidates (PTL101/102/103/104/105);
+the lint-fix rewrite passes in ``distributed/passes/lint_fix_passes.py``
+*fix* them, each structured as "run the lint, apply the fix per
+finding, re-lint to confirm zero findings". :func:`optimize_program`
+closes the loop: it drives the whole pipeline through
+``PassManager`` (so the verifier brackets every pass and the
+``passes.pass_op_delta``/wall-time series keep recording) and repeats
+until an iteration changes nothing — one pass's rewrite is the next
+pass's fodder (a collapsed cast chain leaves a dead inner cast for
+DCE; a deduped subexpression leaves an unused feed for the pruner).
+
+Measurement rides the ``opt.`` metric subsystem (claimed in
+``observability.metrics.CLAIMED_SUBSYSTEMS``):
+
+- ``opt.findings_fixed{code}``   — lint findings eliminated, by code;
+- ``opt.findings_remaining{code}`` — findings the final re-lint still
+  reports (protected fetch targets, refused narrowing chains);
+- ``opt.rewrite_seconds{name}``  — per-pass wall time inside the fix
+  loop (recorded by each pass);
+- ``opt.fixedpoint_iterations``  — pipeline repetitions until
+  quiescence;
+- ``opt.runs`` / ``opt.ops_removed`` — driver-level totals.
+
+So pass scheduling can be argued from data (`tools/metrics_report.py`
+renders the per-code fixed/remaining table; ``bench.py --metrics``
+rolls the totals into the bench record) instead of assumed.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from ... import observability as _obs
+from .diagnostics import DiagnosticReport
+from .lint import run_lints
+
+__all__ = ["optimize_program", "OptimizeResult", "REWRITE_CODES",
+           "DEFAULT_PIPELINE", "OPTIMIZE_ENV_FLAG"]
+
+#: env switch for the Executor.run pre-compile hook (see
+#: static/program.py); FLAGS_optimize_programs is the flag twin.
+OPTIMIZE_ENV_FLAG = "PADDLE_TPU_OPTIMIZE"
+
+#: the lint codes the rewrite pipeline fixes — the "zero findings after
+#: optimize_program" acceptance set.
+REWRITE_CODES = ("PTL101", "PTL102", "PTL103", "PTL104", "PTL105")
+
+#: pass order: structure rewrites first (they strand dead producers),
+#: then dead-op pruning, then feed pruning (a feed may only become
+#: unused once the ops consuming it are gone).
+DEFAULT_PIPELINE = (
+    "collapse_redundant_casts",
+    "cancel_redundant_transposes",
+    "common_subexpression_elimination",
+    "prune_dead_ops",
+    "prune_unused_feeds",
+)
+
+_M_RUNS = _obs.counter(
+    "opt.runs", "optimize_program invocations")
+_M_FIXED = _obs.counter(
+    "opt.findings_fixed",
+    "lint findings eliminated by the rewrite pipeline, by PTL code")
+_M_REMAINING = _obs.gauge(
+    "opt.findings_remaining",
+    "lint findings the final re-lint still reports after the pipeline "
+    "reached its fixed point, by PTL code")
+_M_REWRITE_SECONDS = _obs.histogram(
+    "opt.rewrite_seconds",
+    "wall time of one lint-fix pass application (lint + fix + re-lint), "
+    "by pass name")
+_M_ITERATIONS = _obs.gauge(
+    "opt.fixedpoint_iterations",
+    "pipeline repetitions until an iteration changed nothing, for the "
+    "last optimize_program call")
+_M_OPS_REMOVED = _obs.counter(
+    "opt.ops_removed",
+    "program instructions removed across all optimize_program calls")
+
+
+@dataclass
+class OptimizeResult:
+    """What one :func:`optimize_program` call did."""
+
+    iterations: int = 0
+    ops_before: int = 0
+    ops_after: int = 0
+    findings_fixed: Dict[str, int] = field(default_factory=dict)
+    pruned_feeds: List[str] = field(default_factory=list)
+    remaining: Optional[DiagnosticReport] = None
+
+    @property
+    def ops_removed(self) -> int:
+        return self.ops_before - self.ops_after
+
+    @property
+    def total_fixed(self) -> int:
+        return sum(self.findings_fixed.values())
+
+    def render(self) -> str:
+        per_code = ", ".join(f"{c}={n}"
+                             for c, n in sorted(self.findings_fixed.items()))
+        return (f"optimize_program: {self.total_fixed} finding(s) fixed "
+                f"({per_code or 'none'}), ops {self.ops_before} -> "
+                f"{self.ops_after}, {self.iterations} iteration(s), "
+                f"{len(self.remaining or [])} finding(s) remaining")
+
+
+def _resolve_fetch(program, fetch) -> tuple:
+    vids = []
+    for t in fetch:
+        vids.append(t if isinstance(t, int) else program.vid_of(t))
+    return tuple(vids)
+
+
+def optimize_program(program, fetch: Optional[Iterable] = None, *,
+                     passes: Optional[Sequence[str]] = None,
+                     max_iterations: int = 8,
+                     verify: Optional[bool] = None) -> OptimizeResult:
+    """Run the lint-fix pipeline over ``program`` until quiescence.
+
+    ``fetch`` (Tensors or vids) names the values that must survive —
+    the same liveness roots ``run_lints`` uses; without it (and without
+    a recorded ``_fetch_vids``) the call refuses rather than guessing
+    which outputs matter. Mutates ``program`` in place; the Executor
+    hook optimizes a cached *clone* instead (static/program.py).
+
+    ``verify=None`` inherits ``PADDLE_TPU_PASS_VERIFY`` via
+    ``PassManager`` — every pass runs bracketed by the Program verifier
+    in test/CI runs."""
+    from ...distributed.passes import PassManager, new_pass
+
+    if fetch is not None:
+        fetch_vids = _resolve_fetch(program, fetch)
+    else:
+        fetch_vids = tuple(getattr(program, "_fetch_vids", ()) or ())
+    if not fetch_vids:
+        raise ValueError(
+            "optimize_program needs fetch targets (pass fetch=... or "
+            "record program._fetch_vids): liveness-based rewrites must "
+            "know which values survive")
+
+    on = _obs.state.on
+    if on:
+        _M_RUNS.inc()
+    result = OptimizeResult(ops_before=program.num_ops)
+    names = list(passes or DEFAULT_PIPELINE)
+    t0 = time.perf_counter()
+    feed_names_before = set(program._feed_names)
+
+    while result.iterations < max_iterations:
+        result.iterations += 1
+        fp_before = program.fingerprint()
+        pm = PassManager(
+            [new_pass(n, {"fetch": list(fetch_vids)}) for n in names],
+            verify=verify)
+        pm.apply(program, None)
+        for code, n in (pm.context.get_attr("findings_fixed")
+                        or {}).items():
+            result.findings_fixed[code] = \
+                result.findings_fixed.get(code, 0) + n
+        if program.fingerprint() == fp_before:
+            break
+
+    result.ops_after = program.num_ops
+    result.pruned_feeds = sorted(
+        feed_names_before - set(program._feed_names))
+    result.remaining = run_lints(program, fetch=fetch_vids,
+                                 codes=REWRITE_CODES)
+    if on:
+        _M_ITERATIONS.set(result.iterations)
+        if result.ops_removed > 0:
+            _M_OPS_REMOVED.inc(result.ops_removed)
+        for code in REWRITE_CODES:
+            _M_REMAINING.set(len(result.remaining.by_code(code)),
+                             code=code)
+        _obs.emit("opt.program_optimized",
+                  seconds=time.perf_counter() - t0,
+                  iterations=result.iterations,
+                  findings_fixed=result.total_fixed,
+                  ops_before=result.ops_before,
+                  ops_after=result.ops_after,
+                  remaining=len(result.remaining))
+    return result

@@ -1,0 +1,254 @@
+"""Sharding-aware lints (``PTL2xx``): layout findings that feed the
+auto-parallel planner and the fleet-telemetry plane.
+
+Three lints, three inputs:
+
+- PTL201 (lint.py, runs under plain ``run_lints``): fp32 operands on a
+  bf16 compute hot path — dtype is part of layout, no mesh needed.
+- PTL202 (:func:`run_placement_lints`): a program plus a placement plan
+  (``vid -> DistTensorSpec``, either given or derived via
+  ``auto_parallel.completion.complete_placements``) — flags operand
+  placements that force a collective a consistent plan avoids:
+  mismatched contracting-dim sharding on matmuls, conflicting shard
+  axes on elementwise operands, Partial values consumed by
+  non-reducing ops.
+- PTL203 (:func:`lint_fleet_trace`): the PR 8 merged fleet timeline
+  (``fleet_trace.json`` — one process lane per rank, spans for events
+  carrying a duration) — flags collective spans that do not overlap
+  any compute span on their rank, i.e. collectives the schedule
+  serializes against compute instead of hiding behind it. The
+  straggler detector's per-rank ``train.step_seconds`` spread is the
+  runtime confirmation that the exposed latency is real.
+
+All three funnel into the same :class:`DiagnosticReport` type as the
+program lints, so codes/severities/rendering are uniform.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .diagnostics import DiagnosticReport, Severity
+
+__all__ = ["run_placement_lints", "lint_fleet_trace",
+           "SHARDING_LINT_CODES"]
+
+#: codes this module can emit — audited by tools/lint_registry.py the
+#: same way lint.LINTS codes are (every code claimed in CODES, every
+#: code exercised by at least one test).
+SHARDING_LINT_CODES = ("PTL202", "PTL203")
+
+# prims that REDUCE their input — a Partial operand feeding one of
+# these folds into the reduction instead of forcing an allreduce first
+_REDUCING_MARKERS = ("reduce", "sum", "mean", "norm", "softmax", "logsumexp")
+
+_MATMUL_PRIMS = ("matmul", "linear_nobias_p", "linear_p", "bmm")
+
+# binary ops whose operand dims ARE aligned 1:1 — only for these does
+# "same dim sharded on different axes" mean a forced reshard. Anything
+# else (conv, einsum, gather, concat) relates operand dims semantically
+# and must not be judged by pairwise dim alignment.
+_ELEMENTWISE_NAMES = frozenset({
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "pow", "elementwise_pow", "mod", "remainder", "floor_divide",
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_xor",
+    "atan2", "hypot", "fmax", "fmin",
+})
+
+
+def _elementwise(prim_name: str) -> bool:
+    return prim_name.removesuffix("_p") in _ELEMENTWISE_NAMES
+
+
+def _shard_axes(spec, tensor_dim: int) -> List[int]:
+    """Mesh axes on which ``tensor_dim`` of ``spec`` is sharded."""
+    return [a for a, p in enumerate(spec.placements)
+            if p.is_shard(tensor_dim)]
+
+
+def _partial_axes(spec) -> List[int]:
+    return [a for a, p in enumerate(spec.placements) if p.is_partial()]
+
+
+def run_placement_lints(prog, mesh=None, placements=None,
+                        seeds=None) -> DiagnosticReport:
+    """PTL202 over one program + placement plan.
+
+    ``placements`` is a ``vid -> DistTensorSpec`` table; when omitted it
+    is derived with ``complete_placements(prog, mesh, seeds or {})``
+    (``mesh`` is then required)."""
+    report = DiagnosticReport()
+    if placements is None:
+        if mesh is None:
+            raise ValueError(
+                "run_placement_lints needs either a placements table or "
+                "a mesh to derive one from")
+        from ...distributed.auto_parallel.completion import \
+            complete_placements
+
+        placements = complete_placements(prog, mesh, dict(seeds or {}))
+
+    for idx, (prim_name, in_vids, static_items, _out_vids) in \
+            enumerate(prog._insts):
+        specs = [(v, placements.get(v)) for v in in_vids]
+        try:
+            attrs = dict(static_items)
+        except (TypeError, ValueError):
+            attrs = {}
+
+        # Partial consumed by a non-reducing op: the pending psum must
+        # materialize RIGHT HERE — an allreduce a reduction-aware
+        # placement (or deferring the consumer) avoids
+        if not any(m in prim_name.lower() for m in _REDUCING_MARKERS):
+            for v, s in specs:
+                if s is not None and _partial_axes(s):
+                    report.add(
+                        "PTL202", Severity.WARNING,
+                        f"{prim_name!r} consumes %{v} while it is still "
+                        f"partial on mesh axes {_partial_axes(s)} — forces "
+                        f"an allreduce before this op", op_index=idx,
+                        hint="let a reducing consumer absorb the partial "
+                             "sum, or re-place the producer so its output "
+                             "is sharded instead of partial")
+
+        if prim_name in _MATMUL_PRIMS and len(in_vids) >= 2:
+            x, w = specs[0][1], specs[1][1]
+            if x is not None and w is not None and x.ndim >= 1 \
+                    and w.ndim >= 1:
+                # contracting dims, honoring the matmul prim's
+                # transpose_x/transpose_y static attrs
+                tx = bool(attrs.get("transpose_x", False))
+                ty = bool(attrs.get("transpose_y", False))
+                x_c = x.ndim - 2 if (tx and x.ndim >= 2) else x.ndim - 1
+                if w.ndim >= 2:
+                    w_c = w.ndim - 1 if ty else w.ndim - 2
+                else:
+                    w_c = 0
+                ax_x = set(_shard_axes(x, x_c))
+                ax_w = set(_shard_axes(w, w_c))
+                if ax_x != ax_w:
+                    report.add(
+                        "PTL202", Severity.WARNING,
+                        f"{prim_name!r}: contracting dims are sharded "
+                        f"inconsistently (%{in_vids[0]} dim {x_c} on mesh "
+                        f"axes {sorted(ax_x)}, %{in_vids[1]} dim {w_c} on "
+                        f"{sorted(ax_w)}) — the partitioner must allgather "
+                        f"or reshard one operand before the contraction",
+                        op_index=idx,
+                        hint="shard both contracting dims on the same mesh "
+                             "axis (classic row/column-parallel pairing); "
+                             "the psum then happens once, after the GEMM")
+            continue
+
+        # elementwise family ONLY: same-shape operands whose shard
+        # layouts conflict (same tensor dim on different axes, or one
+        # mesh axis sharding different dims) force a reshard of one
+        if not _elementwise(prim_name):
+            continue
+        known = [(v, s) for v, s in specs
+                 if s is not None and v not in prog._consts]
+        for i in range(len(known)):
+            for j in range(i + 1, len(known)):
+                (va, sa), (vb, sb) = known[i], known[j]
+                if sa.shape != sb.shape or sa.ndim == 0:
+                    continue
+                conflict = None
+                for d in range(sa.ndim):
+                    axa, axb = _shard_axes(sa, d), _shard_axes(sb, d)
+                    if axa and axb and set(axa) != set(axb):
+                        conflict = (f"dim {d} sharded on mesh axes "
+                                    f"{axa} vs {axb}")
+                        break
+                if conflict is None:
+                    ma = {a: d for d in range(sa.ndim)
+                          for a in _shard_axes(sa, d)}
+                    mb = {a: d for d in range(sb.ndim)
+                          for a in _shard_axes(sb, d)}
+                    for a in set(ma) & set(mb):
+                        if ma[a] != mb[a]:
+                            conflict = (f"mesh axis {a} shards dim "
+                                        f"{ma[a]} vs dim {mb[a]}")
+                            break
+                if conflict:
+                    report.add(
+                        "PTL202", Severity.WARNING,
+                        f"{prim_name!r}: operands %{va} and %{vb} have "
+                        f"conflicting layouts ({conflict}) — one must be "
+                        f"resharded (all-to-all/allgather) before the op",
+                        op_index=idx,
+                        hint="re-place one producer so the layouts agree; "
+                             "an aligned plan makes this op collective-free")
+    return report
+
+
+def _trace_events(trace) -> List[Dict[str, Any]]:
+    if isinstance(trace, dict):
+        return list(trace.get("traceEvents", []))
+    return list(trace or [])
+
+
+def _is_comm(name: str) -> bool:
+    return name.startswith("comm.")
+
+
+#: whole-step envelope spans (``obs.step_region``): they CONTAIN every
+#: in-step collective, so they only serve as the compute baseline when
+#: no finer-grained compute spans exist on the lane — otherwise every
+#: collective would trivially "overlap compute" and the lint would
+#: never fire on a real fleet trace.
+_ENVELOPE_NAMES = ("train.step", "train.epoch")
+
+
+def _is_envelope(name: str) -> bool:
+    return name in _ENVELOPE_NAMES
+
+
+def lint_fleet_trace(trace, *, min_seconds: float = 0.0
+                     ) -> DiagnosticReport:
+    """PTL203 over a merged fleet Chrome trace (dict with
+    ``traceEvents`` or a bare event list).
+
+    A collective span (name prefixed ``comm.``) on a rank lane that
+    overlaps NO compute span is exposed latency: the schedule runs the
+    collective serially instead of hiding it behind compute. Compute
+    spans are the lane's non-collective spans — preferring spans finer
+    than the whole-step ``train.step`` envelope when any exist (an
+    envelope contains every in-step collective, so against it only
+    BETWEEN-step collectives can be caught). Ranks with no compute
+    spans at all are skipped — that is missing data, not a finding."""
+    report = DiagnosticReport()
+    spans: Dict[Any, List[tuple]] = {}
+    for e in _trace_events(trace):
+        if e.get("ph") != "X":
+            continue
+        dur = float(e.get("dur") or 0.0)
+        if dur <= 0:
+            continue
+        ts = float(e.get("ts") or 0.0)
+        spans.setdefault(e.get("pid"), []).append(
+            (str(e.get("name", "")), ts, ts + dur))
+    for rank in sorted(spans, key=str):
+        comm = [s for s in spans[rank] if _is_comm(s[0])]
+        non_comm = [s for s in spans[rank] if not _is_comm(s[0])]
+        compute = [s for s in non_comm if not _is_envelope(s[0])] \
+            or non_comm
+        if not comm or not compute:
+            continue  # nothing to attribute on this lane
+        for name, t0, t1 in comm:
+            if (t1 - t0) / 1e6 < min_seconds:
+                continue
+            if any(min(t1, c1) - max(t0, c0) > 0
+                   for _n, c0, c1 in compute):
+                continue
+            report.add(
+                "PTL203", Severity.WARNING,
+                f"rank {rank}: collective {name!r} "
+                f"({(t1 - t0) / 1e3:.2f} ms at ts={t0 / 1e3:.2f} ms) "
+                f"overlaps no compute span — it serializes against "
+                f"compute",
+                hint="overlap the collective with compute (async "
+                     "dispatch, gradient-bucket pipelining, 1F1B-style "
+                     "interleaving); the straggler detector's "
+                     "train.step_seconds spread confirms the exposed "
+                     "latency at runtime")
+    return report

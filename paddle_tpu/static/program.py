@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -47,8 +48,16 @@ _M_RECOMPILES_SAVED = _obs.counter(
     "mutation — recompiles the old clear-on-any-change policy would "
     "have paid (e.g. a rewrite pass that turned out to be a no-op)")
 
+_M_OPTIMIZED = _obs.counter(
+    "executor.programs_optimized",
+    "optimized-clone builds triggered by the Executor.run pre-compile "
+    "hook (PADDLE_TPU_OPTIMIZE / FLAGS_optimize_programs)")
+
 #: compiled-replay entries kept per program; oldest evicted first
 _REPLAY_CACHE_CAP = 64
+
+#: optimized clones kept per program (keyed by fingerprint + fetch set)
+_OPT_CLONE_CAP = 8
 
 __all__ = ["Program", "program_guard", "data", "Executor",
            "default_main_program", "default_startup_program"]
@@ -225,6 +234,8 @@ class Program:
             p._remat_checkpoints = self._remat_checkpoints
         if hasattr(self, "_fetch_vids"):
             p._fetch_vids = self._fetch_vids
+        if hasattr(self, "_pruned_feed_names"):
+            p._pruned_feed_names = set(self._pruned_feed_names)
         return p
 
     @property
@@ -383,6 +394,49 @@ def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
     return t
 
 
+def _optimize_enabled() -> bool:
+    """The Executor pre-compile optimization gate: the
+    ``PADDLE_TPU_OPTIMIZE`` env var wins, else ``FLAGS_optimize_programs``
+    (core/flags.py)."""
+    env = os.environ.get("PADDLE_TPU_OPTIMIZE")
+    if env is not None:
+        return env.lower() not in ("0", "", "false", "off")
+    from ..core import flags
+
+    return bool(flags.get_flag("optimize_programs"))
+
+
+def _optimized_clone(program: Program, fetch_vids) -> Program:
+    """Optimized clone of ``program`` for one (structure, fetch-set)
+    pair, cached on the original program.
+
+    The ORIGINAL program is never mutated: liveness-based rewrites are
+    only valid for the fetch set they ran against, and the next run may
+    fetch different values — so each fetch set optimizes its own clone
+    (whose compiled replays live in the clone's own ``_cache``)."""
+    from .analysis.rewrite import optimize_program
+
+    cache = program.__dict__.setdefault("_opt_clones", {})
+    key = (program.fingerprint(), tuple(fetch_vids))
+    clone = cache.get(key)
+    if clone is None:
+        clone = program.clone()
+        clone._fetch_vids = tuple(fetch_vids)
+        optimize_program(clone, fetch=fetch_vids)
+        cache[key] = clone
+        while len(cache) > _OPT_CLONE_CAP:
+            cache.pop(next(iter(cache)))
+        if _obs_state.on:
+            _M_OPTIMIZED.inc()
+    else:
+        # LRU refresh (same policy as the replay cache below): a steady
+        # working set slightly over the cap must not re-optimize and
+        # recompile every entry just before use
+        cache.pop(key)
+        cache[key] = clone
+    return clone
+
+
 class Executor:
     """Reference: paddle.static.Executor (executor.py:1199) — replays the
     captured instruction list as one jitted XLA program per feed
@@ -401,6 +455,17 @@ class Executor:
             program.vid_of(t) if isinstance(t, Tensor) else int(t)
             for t in fetch_list
         )
+        if fetch_vids and _optimize_enabled():
+            # swap in the lint->rewrite-optimized clone for this fetch
+            # set; vids are stable across clone(), so fetch_vids and
+            # feed names keep resolving
+            program = _optimized_clone(program, fetch_vids)
+        pruned = getattr(program, "_pruned_feed_names", ()) or ()
+        if pruned:
+            # feeds the optimizer pruned stay ACCEPTED (and ignored):
+            # pruning relaxes the feed contract, it must not break
+            # callers still passing the old dict
+            feed = {k: v for k, v in feed.items() if k not in pruned}
         feed_items = sorted(feed.items())
         feed_names = tuple(k for k, _ in feed_items)
         declared = {n for n, _, _, _ in program._placeholders}

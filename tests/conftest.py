@@ -58,7 +58,8 @@ def _registry_lint():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     problems = (mod.check_primitives() + mod.check_all_exports()
-                + mod.check_metric_registry())
+                + mod.check_metric_registry()
+                + mod.check_diagnostic_registry())
     if problems:
         pytest.fail(
             "tools/lint_registry.py checks found registry violations:\n"

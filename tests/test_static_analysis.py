@@ -136,6 +136,18 @@ class TestVerifierMutations:
             report.render()
         assert "PTL008" in report.codes(), report.render()
 
+    def test_dtype_divergence_caught_by_infermeta_audit(self):
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        # swap a tanh for a cast-to-f16: same shape, narrower dtype than
+        # the recorded aval — only the dtype half of the audit sees it
+        idx = next(i for i, inst in enumerate(bad._insts)
+                   if inst[0] == "tanh")
+        name, in_vids, _st, outs = bad._insts[idx]
+        bad._insts[idx] = ("cast_p", in_vids, (("dtype", "float16"),), outs)
+        report = verify_program(bad)
+        assert "PTL009" in report.codes(), report.render()
+
     def test_bogus_static_attr_value(self):
         prog, *_ = _train_program()
         bad = _corrupt(prog)
@@ -321,14 +333,47 @@ class TestLints:
         report = run_lints(prog)
         assert "PTL101" not in report.codes()
 
-    def test_redundant_cast_chain_and_noop_cast(self):
+    def test_noop_cast_flagged(self):
+        # paddle.cast short-circuits same-dtype casts at the API, so a
+        # no-op cast in the list is the residue of a rewrite pass —
+        # hand-seed one the way a cast-chain collapse would leave it
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            _out = (x * 2.0).sum()
+        v = prog._new_vid()
+        prog._insts.append(("cast_p", (prog._feed_names["x"],),
+                            (("dtype", "float32"),), (v,)))
+        report = run_lints(prog)
+        assert "PTL103" in report.codes(), report.render()
+        assert "no-op" in report.by_code("PTL103")[0].message
+
+    def test_lossless_cast_chain_flagged_as_redundant(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float16")
+            # f16 -> f32 -> f64: the intermediate widens, the chain is
+            # exactly one cast's worth of work
+            y = paddle.cast(paddle.cast(x, "float32"), "float64")
+            out = y.sum()
+        report = run_lints(prog, fetch=[out])
+        assert "PTL103" in report.codes(), report.render()
+        assert "PTL108" not in report.codes(), report.render()
+
+    def test_narrowing_cast_chain_is_ptl108_not_ptl103(self):
+        # f32 -> f16 -> f32 round-trips through a NARROWER dtype: the
+        # chain changes numerics and must not be reported as redundant
         prog = static.Program()
         with static.program_guard(prog):
             x = static.data("x", [4], "float32")
             y = paddle.cast(paddle.cast(x, "float16"), "float32")
             out = y.sum()
         report = run_lints(prog, fetch=[out])
-        assert "PTL103" in report.codes(), report.render()
+        assert "PTL103" not in report.codes(), report.render()
+        ptl108 = report.by_code("PTL108")
+        assert ptl108, report.render()
+        from paddle_tpu.static.analysis import Severity as _Sev
+        assert all(d.severity == _Sev.NOTE for d in ptl108)
 
     def test_redundant_transpose_chain(self):
         prog = static.Program()
@@ -349,6 +394,82 @@ class TestLints:
             out = (a + b).sum()
         report = run_lints(prog, fetch=[out])
         assert "PTL105" in report.codes(), report.render()
+
+    def test_three_transpose_chain_every_link_flagged(self):
+        # t3(t2(t1(x))) with 3-cycle perms: both (t1,t2) and (t2,t3)
+        # are chains composing to a single NON-identity transpose and
+        # must be reported (composition, not just cancellation)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 3, 4], "float32")
+            y = paddle.transpose(
+                paddle.transpose(paddle.transpose(x, [1, 2, 0]),
+                                 [1, 2, 0]), [1, 2, 0])
+            out = y.sum()
+        report = run_lints(prog, fetch=[out])
+        findings = report.by_code("PTL104")
+        assert len(findings) == 2, report.render()
+        msgs = " ".join(d.message for d in findings)
+        assert "single transpose" in msgs
+
+    def test_composed_transpose_chain_flagged(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 3, 4], "float32")
+            y = paddle.transpose(paddle.transpose(x, [1, 2, 0]), [2, 1, 0])
+            out = y.sum()
+        report = run_lints(prog, fetch=[out])
+        findings = report.by_code("PTL104")
+        assert findings, report.render()
+        assert "single transpose" in findings[0].message
+
+    def test_cse_skips_unhashable_attrs(self):
+        # identical dup ops whose static attrs are unhashable must be
+        # SKIPPED (reported separately as PTL006 by the verifier), not
+        # crash the lint or be offered as CSE candidates
+        prog, *_ = _train_program()
+        bad = _corrupt(prog)
+        name, in_vids, _st, outs = bad._insts[0]
+        unhashable = (("w", [np.zeros(2)]),)
+        bad._insts[0] = (name, in_vids, unhashable, outs)
+        free = bad._next_vid
+        bad._next_vid += 1
+        bad._insts.insert(1, (name, in_vids, unhashable, (free,)))
+        report = run_lints(bad)
+        assert "PTL105" not in report.codes(), report.render()
+
+    def test_fp64_demotion_with_partially_known_output_dtypes(self):
+        # one output dtype unknown, the known one float32: the lint must
+        # still fire off the known record (and not crash on the None)
+        name = "__demoting_two_out_prim__"
+        dispatch.register_primitive(
+            name, lambda x: (x.astype("float32"), x.astype("float32")))
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [4], "float64")
+            v1, v2 = prog._new_vid(), prog._new_vid()
+            prog._insts.append((name, (prog._feed_names["x"],), (),
+                                (v1, v2)))
+            report = run_lints(prog)
+            assert "PTL106" in report.codes(), report.render()
+        finally:
+            del dispatch.PRIMITIVES[name]
+
+    def test_run_lints_codes_subset_filtering(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            _u = static.data("unused_in", [2], "float32")
+            live = (x * 2.0).sum()
+            _dead = paddle.nn.functional.relu(x + 5.0)
+        full = run_lints(prog, fetch=[live])
+        assert {"PTL101", "PTL102"} <= full.codes()
+        only_dead = run_lints(prog, fetch=[live], codes=["PTL101"])
+        assert only_dead.codes() == {"PTL101"}
+        only_feeds = run_lints(prog, fetch=[live], codes=["PTL102"])
+        assert only_feeds.codes() == {"PTL102"}
+        assert run_lints(prog, fetch=[live], codes=[]).codes() == set()
 
     def test_fp64_demotion(self):
         # a primitive whose forward internally downcasts (the f32-softmax

@@ -19,7 +19,13 @@ that *defines* the ops. It verifies, over the fully-imported package:
    is unique, documented, matches the ``subsystem.noun_verb`` naming
    scheme, and its subsystem prefix is claimed in
    ``observability.metrics.CLAIMED_SUBSYSTEMS`` (the metric analog of
-   the ``PTLxxx`` diagnostic-code claiming convention).
+   the ``PTLxxx`` diagnostic-code claiming convention);
+5. the diagnostic-code registry is closed both ways: every registered
+   lint (``lint.LINTS``, the sharding lints) and every lint-fix rewrite
+   pass claims a code documented in ``diagnostics.CODES``, and every
+   documented ``PTLxxx`` code is exercised by at least one test under
+   ``tests/`` — a code nothing can trigger (or nothing proves
+   triggerable) is registry rot either way.
 
 Exits non-zero listing every violation — wired into the test session via
 a session-scoped fixture in tests/conftest.py (skippable with
@@ -131,6 +137,16 @@ ELASTIC_REQUIRED_LABELS = {
     "elastic.peer_deaths": ("peer",),
 }
 
+#: lint->rewrite driver label discipline (static/analysis/rewrite.py):
+#: a fixed/remaining count that can't say WHICH code, or a rewrite
+#: timing that can't say WHICH pass, defeats the measured-benefit
+#: scheduling the opt. subsystem exists for.
+OPT_REQUIRED_LABELS = {
+    "opt.findings_fixed": ("code",),
+    "opt.findings_remaining": ("code",),
+    "opt.rewrite_seconds": ("name",),
+}
+
 #: fleet-telemetry label discipline (observability/fleet.py): per-rank
 #: series must say WHICH rank, ship failures must say WHY. Additionally
 #: no ``fleet.`` GAUGE may record an unlabeled series at all — an
@@ -147,6 +163,17 @@ FLEET_REQUIRED_LABELS = {
     "fleet.step_skew_seconds": ("job",),
     "fleet.slowest_rank": ("job",),
 }
+
+#: one audit loop serves every per-subsystem required-labels table —
+#: add the next subsystem as a row here, not as another copied loop
+REQUIRED_LABEL_TABLES = (
+    (ELASTIC_REQUIRED_LABELS, "elastic recovery series must attribute "
+                              "the incident (who died / why the restart)"),
+    (OPT_REQUIRED_LABELS, "opt. series must attribute the PTL code / "
+                          "rewrite pass"),
+    (FLEET_REQUIRED_LABELS, "fleet series must attribute the rank (or "
+                            "the reason/job)"),
+)
 
 
 def check_metric_registry() -> List[str]:
@@ -200,30 +227,77 @@ def check_metric_registry() -> List[str]:
                         f"required label(s) {missing} — collective metrics "
                         f"must be attributable to a mesh axis (label every "
                         f"record with op= and group=)")
-        required = ELASTIC_REQUIRED_LABELS.get(m.name)
-        if required:
+        for table, why in REQUIRED_LABEL_TABLES:
+            required = table.get(m.name)
+            if not required:
+                continue
             for labels in m.labelsets():
                 missing = [k for k in required if k not in labels]
                 if missing:
                     problems.append(
                         f"metric {m.name!r}: series {labels!r} is missing "
-                        f"required label(s) {missing} — elastic recovery "
-                        f"series must attribute the incident (who died / "
-                        f"why the restart)")
-        if m.name.startswith("fleet."):
-            required = FLEET_REQUIRED_LABELS.get(m.name, ())
+                        f"required label(s) {missing} — {why}")
+        if m.name.startswith("fleet.") and m.kind == "gauge":
             for labels in m.labelsets():
-                missing = [k for k in required if k not in labels]
-                if missing:
-                    problems.append(
-                        f"metric {m.name!r}: series {labels!r} is missing "
-                        f"required label(s) {missing} — fleet series must "
-                        f"attribute the rank (or the reason/job)")
-                if m.kind == "gauge" and not labels:
+                if not labels:
                     problems.append(
                         f"metric {m.name!r}: recorded an UNLABELED gauge "
                         f"series — every fleet gauge must carry at least "
                         f"a rank= or job= label")
+    return problems
+
+
+def check_diagnostic_registry() -> List[str]:
+    """The PTLxxx registry, closed both ways: every lint and lint-fix
+    pass claims a documented code; every documented code is exercised
+    by at least one test (string-presence scan over ``tests/``)."""
+    from paddle_tpu.distributed import passes as passes_mod
+    from paddle_tpu.distributed.passes.lint_fix_passes import LintFixPass
+    from paddle_tpu.static.analysis import diagnostics, sharding_lint
+    from paddle_tpu.static.analysis import lint as lint_mod
+
+    problems = []
+    for code, _severity, fn in lint_mod.LINTS:
+        if code not in diagnostics.CODES:
+            problems.append(
+                f"lint {fn.__name__!r}: emits code {code!r} which is not "
+                f"documented in diagnostics.CODES — claim the code next "
+                f"to the registration")
+    for code in sharding_lint.SHARDING_LINT_CODES:
+        if code not in diagnostics.CODES:
+            problems.append(
+                f"sharding lint code {code!r} is not documented in "
+                f"diagnostics.CODES")
+    for name, cls in sorted(passes_mod._PASS_REGISTRY.items()):
+        if isinstance(cls, type) and issubclass(cls, LintFixPass):
+            code = getattr(cls, "code", "")
+            if not code:
+                problems.append(
+                    f"rewrite pass {name!r}: LintFixPass subclass with no "
+                    f"claimed code — a lint-fix pass must name the PTL "
+                    f"code it fixes")
+            elif code not in diagnostics.CODES:
+                problems.append(
+                    f"rewrite pass {name!r}: claims code {code!r} which "
+                    f"is not documented in diagnostics.CODES")
+
+    tests_dir = os.path.join(_REPO_ROOT, "tests")
+    corpus = []
+    try:
+        for fn_ in sorted(os.listdir(tests_dir)):
+            if fn_.endswith(".py"):
+                with open(os.path.join(tests_dir, fn_),
+                          errors="replace") as f:
+                    corpus.append(f.read())
+    except OSError as e:
+        return problems + [f"cannot scan tests/ for PTL codes: {e}"]
+    corpus = "\n".join(corpus)
+    for code in sorted(diagnostics.CODES):
+        if code not in corpus:
+            problems.append(
+                f"diagnostic code {code!r} has no test that references "
+                f"it — every documented PTLxxx code needs at least one "
+                f"test triggering (or asserting the absence of) it")
     return problems
 
 
@@ -232,7 +306,7 @@ def main(argv=None) -> int:
     from paddle_tpu.core import dispatch
 
     problems = (check_primitives() + check_all_exports()
-                + check_metric_registry())
+                + check_metric_registry() + check_diagnostic_registry())
     n_mods = sum(1 for m in sys.modules
                  if m == "paddle_tpu" or m.startswith("paddle_tpu."))
     from paddle_tpu import observability

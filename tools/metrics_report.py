@@ -99,6 +99,22 @@ def _render_fleet_dir(dirname: str, events, top) -> int:
         return 1
     print(render_incident(inc, max_events=40 if events is None else events,
                           top=top))
+    trace_path = os.path.join(dirname, "fleet_trace.json")
+    if os.path.exists(trace_path):
+        # cross-rank trace lint: collectives the schedule serializes
+        # against compute (PTL203) read straight off the merged timeline
+        from paddle_tpu.static.analysis import lint_fleet_trace
+
+        try:
+            with open(trace_path) as f:
+                report = lint_fleet_trace(json.load(f))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"metrics_report: cannot lint {trace_path!r}: {e}",
+                  file=sys.stderr)
+        else:
+            print()
+            print(report.render(
+                f"fleet trace lint ({os.path.basename(trace_path)}):"))
     return 0
 
 
