@@ -93,6 +93,38 @@ def _emit_metrics_block():
                 if isinstance(s.get("value"), (int, float))]
         return max(vals) if vals else None
 
+    def hist_quantile(name, q):
+        """Quantile estimate from merged histogram bucket counts
+        (linear interpolation inside the crossing bucket). The load
+        generator reports exact sample quantiles too; this is the
+        registry-side figure so the roll-up works from a dump alone."""
+        ss = series(name)
+        if not ss:
+            return None
+        bounds = ss[0].get("bounds")
+        if not bounds:
+            return None
+        counts = [0] * (len(bounds) + 1)
+        total = 0
+        for s in ss:
+            for i, c in enumerate(s.get("bucket_counts", [])):
+                counts[i] += c
+                total += c
+        if not total:
+            return None
+        target = q * total
+        cum = 0
+        lo = 0.0
+        for i, c in enumerate(counts):
+            hi = bounds[i] if i < len(bounds) else max(
+                s.get("max", bounds[-1]) for s in ss)
+            if cum + c >= target and c:
+                frac = (target - cum) / c
+                return round(lo + frac * (hi - lo), 6)
+            cum += c
+            lo = hi
+        return round(lo, 6)
+
     hits, misses = tot("dispatch.cache_hits"), tot("dispatch.cache_misses")
     print(json.dumps({"metrics": {
         "dispatch_calls": tot("dispatch.calls"),
@@ -133,6 +165,12 @@ def _emit_metrics_block():
         "opt_ops_removed": tot("opt.ops_removed"),
         "opt_fixedpoint_iterations": gauge_max("opt.fixedpoint_iterations"),
         "opt_rewrite_seconds": round(hist_sum("opt.rewrite_seconds"), 3),
+        # serving-engine roll-ups (paddle_tpu/serve; populated by the
+        # `serve` config / tools/serve_load.py load runs)
+        "serve_ttft_p50": hist_quantile("serve.ttft_seconds", 0.50),
+        "serve_ttft_p99": hist_quantile("serve.ttft_seconds", 0.99),
+        "serve_tokens_per_sec": gauge_max("serve.tokens_per_sec"),
+        "serve_preemptions": tot("serve.preemptions"),
     }}), flush=True)
 
 
@@ -779,6 +817,76 @@ def bench_decode(on_tpu, steps, warmup, peak_flops):
     }), flush=True)
 
 
+def bench_serve(on_tpu, steps, warmup, peak_flops):
+    """Continuous-batching serving engine under Poisson load
+    (paddle_tpu/serve): N requests with mixed prompt/output lengths
+    arrive at a live ServeEngine; the BENCH record is aggregate
+    tokens/sec with p50/p99 TTFT (queue wait included) in the metric
+    text and, under --metrics, in the serve_* roll-up keys.
+
+    The engine exists for its scheduling semantics (admission FIFO,
+    youngest-first preemption, one persistent compiled decode step —
+    see serve/engine.py); per-token throughput still trails the dense
+    single-jit scan the `decode` config measures, because each engine
+    step is a host round-trip. vs_baseline is the fraction of the
+    dense decode path's per-token budget achieved at the same batch
+    width (engine tokens/sec / dense-scan tokens/sec), measured here.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.serve import ServeEngine, run_load
+    from paddle_tpu.serve.load import default_serving_setup, warm_engine
+
+    paddle.seed(0)
+    # shared with tools/serve_load.py — ONE serving shape for the BENCH
+    # record and the CLI
+    config, sp = default_serving_setup(on_tpu)
+    slots, blocks, bs, msl = (sp["slots"], sp["num_blocks"],
+                              sp["block_size"], sp["max_seq_len"])
+    rate, n_req = sp["rate"], sp["requests"]
+    plen, mnew = sp["prompt_len"], sp["max_new"]
+
+    model = LlamaForCausalLM(config)
+    n_params = model.num_parameters()
+    if on_tpu:
+        model.bfloat16()
+    model.eval()
+
+    engine = ServeEngine(model, max_slots=slots, block_size=bs,
+                         num_blocks=blocks, max_seq_len=msl,
+                         name="bench")
+    warm_engine(engine)     # decode step + every prefill bucket
+    res = run_load(engine, rate=rate, n_requests=n_req,
+                   prompt_len=plen, max_new=mnew, seed=0)
+
+    # dense-scan reference at the same batch width: the engine's bar
+    dense_ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(
+            1, config.vocab_size, (slots, plen[1])).astype("int64"))
+    n_new = mnew[1]
+    out = model.generate(dense_ids, max_new_tokens=n_new)   # compile
+    np.asarray(out._value)
+    t0 = time.perf_counter()
+    out = model.generate(dense_ids, max_new_tokens=n_new)
+    np.asarray(out._value)
+    dense_tok_s = slots * n_new / (time.perf_counter() - t0)
+    frac = res.tokens_per_sec / dense_tok_s if dense_tok_s else 0.0
+
+    print(json.dumps({
+        "metric": f"llama-{n_params / 1e6:.0f}M continuous-batching "
+                  f"serve tokens/sec ({n_req} Poisson reqs @ "
+                  f"{rate:.0f}/s, {slots} slots, {blocks}x{bs} KV "
+                  f"blocks; TTFT p50 {res.ttft_p50 * 1e3:.1f} ms / "
+                  f"p99 {res.ttft_p99 * 1e3:.1f} ms, "
+                  f"{res.preemptions} preemptions; vs_baseline is "
+                  f"engine throughput / dense-scan throughput at the "
+                  f"same batch width, {dense_tok_s:.0f} tok/s)",
+        "value": round(float(res.tokens_per_sec), 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(float(frac), 3),
+    }), flush=True)
+
+
 def _run_isolated(config: str, args) -> int:
     """Run one bench config in its own subprocess.
 
@@ -809,7 +917,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="all",
                     choices=["llama", "resnet", "moe", "bert", "sdxl",
-                             "decode", "all"])
+                             "decode", "serve", "all"])
     ap.add_argument("--profile", action="store_true")
     ap.add_argument("--metrics", action="store_true",
                     help="enable paddle_tpu.observability and append a "
@@ -822,7 +930,7 @@ def main():
         # parses the final JSON line as the headline metric
         rcs = [_run_isolated(c, args)
                for c in ("resnet", "bert", "sdxl", "moe", "decode",
-                         "llama")]
+                         "serve", "llama")]
         raise SystemExit(sum(1 for rc in rcs if rc != 0))
 
     import jax
@@ -862,6 +970,8 @@ def main():
         bench_sdxl_unet(on_tpu, steps, warmup, peak_flops)
     elif args.config == "decode":
         bench_decode(on_tpu, steps, warmup, peak_flops)
+    elif args.config == "serve":
+        bench_serve(on_tpu, steps, warmup, peak_flops)
     elif args.config == "llama":
         bench_llama(on_tpu, steps, warmup, peak_flops, profile=args.profile)
         if args.metrics:

@@ -461,7 +461,8 @@ def generate(model, input_ids, max_new_tokens: int = 32,
              top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None, seed: int = 0,
              pad_token_id: Optional[int] = None, paged: bool = False,
-             block_size: int = 64, num_beams: int = 1,
+             block_size: int = 64, num_blocks: Optional[int] = None,
+             num_beams: int = 1,
              length_penalty: float = 0.0, repetition_penalty: float = 1.0,
              min_length: int = 0):
     """Decode ``max_new_tokens`` from a Llama- or GPT-family causal
@@ -473,6 +474,12 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     row decodes at its own logical positions). ``paged=True`` decodes
     over a paged/block KV cache via the serving ``block_mha_p`` program
     (Llama and GPT families; composes with ragged prompts).
+    ``num_blocks`` caps the paged pool size: the call FAILS LOUDLY
+    (``ValueError`` naming required vs available blocks) when the
+    batch's KV working set cannot fit, instead of clamping the block
+    table and silently gathering another row's cache — the
+    ``serve.BlockPool`` exhaustion contract applied to the library
+    call (``None`` sizes the pool exactly to the batch).
     ``num_beams > 1``: beam search (reference surface:
     nn.BeamSearchDecoder / ecosystem generate), ranked by sum logprob /
     len**``length_penalty`` (0.0 = no length normalization).
@@ -505,6 +512,12 @@ def generate(model, input_ids, max_new_tokens: int = 32,
             "generate: length_penalty ranks beam-search hypotheses; it "
             "has no effect with num_beams=1 — refusing to silently "
             "ignore it")
+    if num_blocks is not None and not paged:
+        # checked BEFORE the beam early-return so num_beams>1 cannot
+        # silently swallow a num_blocks the caller thought was in force
+        raise ValueError(
+            "generate: num_blocks sizes the paged KV pool; it has no "
+            "effect without paged=True — refusing to silently ignore it")
     if num_beams > 1:
         if do_sample:
             raise ValueError(
@@ -532,7 +545,8 @@ def generate(model, input_ids, max_new_tokens: int = 32,
                                do_sample=do_sample, temperature=temperature,
                                top_k=top_k, top_p=top_p,
                                eos_token_id=eos_token_id, seed=seed,
-                               block_size=block_size)
+                               block_size=block_size,
+                               num_blocks=num_blocks)
     if min_length > 0 and eos_token_id is None:
         # the beam/paged branches above already reject min_length loudly;
         # on the greedy/sampling path it works by masking eos, so with no
@@ -909,9 +923,35 @@ def generate_speculative(model, draft_model, input_ids,
     return Tensor._from_value(out)
 
 
+def _paged_block_tables(b, s_max, block_size, num_blocks=None):
+    """Disjoint row-major block allocation for a ``generate`` batch:
+    row ``r`` owns blocks ``[r*blocks_per_seq, (r+1)*blocks_per_seq)``.
+
+    Raises a CLEAR error when a caller-capped pool (``num_blocks``)
+    cannot hold the batch's KV working set — the previous behavior was
+    an out-of-range block id silently clamped by the gather, reading
+    ANOTHER row's cache (ISSUE 14 satellite; regression-tested)."""
+    blocks_per_seq = -(-s_max // block_size)
+    needed = b * blocks_per_seq
+    if num_blocks is not None and int(num_blocks) < needed:
+        raise ValueError(
+            f"generate(paged=True): KV block pool exhausted before "
+            f"decode could start — the batch needs {needed} blocks "
+            f"({b} rows x {blocks_per_seq} blocks of {block_size} "
+            f"tokens for prompt+max_new_tokens={s_max}) but "
+            f"num_blocks={int(num_blocks)}. Grow the pool, shrink the "
+            f"batch/max_new_tokens, or serve the requests through "
+            f"paddle_tpu.serve.ServeEngine, which queues and preempts "
+            f"instead of failing")
+    total = needed if num_blocks is None else int(num_blocks)
+    tables = (np.arange(needed, dtype=np.int32)
+              .reshape(b, blocks_per_seq))
+    return tables, total
+
+
 def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
                     temperature, top_k, top_p, eos_token_id, seed,
-                    block_size):
+                    block_size, num_blocks=None):
     """Paged/block-KV-cache decode (Llama and GPT families): the
     prefill packs each row's REAL tokens left-aligned into a varlen
     batch and one ``block_mha_p`` call per layer writes them straight
@@ -951,12 +991,10 @@ def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
     eos = -1 if eos_token_id is None else int(eos_token_id)
     s_max = t0 + max_new_tokens
     static_cfg, arrays, cache = _prep_decode(model, p, t0, max_new_tokens)
-    blocks_per_seq = -(-s_max // block_size)
-    nb = b * blocks_per_seq
-    # disjoint row-major block allocation: row b owns blocks
-    # [b*blocks_per_seq, (b+1)*blocks_per_seq)
-    tables_np = (np.arange(nb, dtype=np.int32)
-                 .reshape(b, blocks_per_seq))
+    # loud pool-exhaustion contract (see _paged_block_tables): a capped
+    # pool that cannot hold the batch fails HERE, not as a clamped
+    # cross-row gather mid-decode
+    tables_np, nb = _paged_block_tables(b, s_max, block_size, num_blocks)
 
     def _run(arrs, ids, pads, key):
         p = {**arrs, **static_cfg}
@@ -1084,7 +1122,7 @@ def _generate_paged(model, ids, pads_np, *, max_new_tokens, do_sample,
     ragged = pads_np is not None
     sig = ("paged", b, t0, max_new_tokens, do_sample, float(temperature),
            int(top_k), float(top_p), eos, ragged, int(block_size),
-           str(dtype), L)
+           int(nb), str(dtype), L)
     fn = cache.get(sig)
     if fn is None:
         fn = jax.jit(_run, static_argnums=() if ragged else (2,))

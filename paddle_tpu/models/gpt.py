@@ -140,11 +140,13 @@ class GPTForCausalLM(nn.Layer):
                  top_k: int = 0, top_p: float = 1.0,
                  eos_token_id=None, seed: int = 0, pad_token_id=None,
                  paged: bool = False, block_size: int = 64,
+                 num_blocks=None,
                  num_beams: int = 1, length_penalty: float = 0.0,
                  repetition_penalty: float = 1.0, min_length: int = 0):
         """KV-cache incremental decoding — one jitted lax.scan over a
         dense cache (models/generation.py, same driver as Llama);
-        ``pad_token_id`` enables left-padded ragged prompts."""
+        ``pad_token_id`` enables left-padded ragged prompts;
+        ``paged=True``/``num_blocks`` as in the Llama family."""
         from .generation import generate as _generate
 
         return _generate(self, input_ids, max_new_tokens=max_new_tokens,
@@ -152,7 +154,8 @@ class GPTForCausalLM(nn.Layer):
                          top_k=top_k, top_p=top_p,
                          eos_token_id=eos_token_id, seed=seed,
                          pad_token_id=pad_token_id, paged=paged,
-                         block_size=block_size, num_beams=num_beams,
+                         block_size=block_size, num_blocks=num_blocks,
+                         num_beams=num_beams,
                          length_penalty=length_penalty,
                          repetition_penalty=repetition_penalty,
                          min_length=min_length)

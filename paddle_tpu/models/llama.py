@@ -299,13 +299,15 @@ class LlamaForCausalLM(nn.Layer):
                  top_k: int = 0, top_p: float = 1.0,
                  eos_token_id=None, seed: int = 0, pad_token_id=None,
                  paged: bool = False, block_size: int = 64,
+                 num_blocks=None,
                  num_beams: int = 1, length_penalty: float = 0.0,
                  repetition_penalty: float = 1.0, min_length: int = 0):
         """KV-cache incremental decoding: the whole loop is one jitted
         lax.scan (models/generation.py). Greedy by default; sampling
         via do_sample + temperature/top_k/top_p; ``pad_token_id``
         enables left-padded ragged prompts; ``paged=True`` decodes over
-        the serving block/paged KV cache. Returns
+        the serving block/paged KV cache (``num_blocks`` caps the pool
+        and fails loudly on exhaustion). Returns
         [B, prompt + max_new_tokens] including the prompt."""
         from .generation import generate as _generate
 
@@ -314,7 +316,8 @@ class LlamaForCausalLM(nn.Layer):
                          top_k=top_k, top_p=top_p,
                          eos_token_id=eos_token_id, seed=seed,
                          pad_token_id=pad_token_id, paged=paged,
-                         block_size=block_size, num_beams=num_beams,
+                         block_size=block_size, num_blocks=num_blocks,
+                         num_beams=num_beams,
                          length_penalty=length_penalty,
                          repetition_penalty=repetition_penalty,
                          min_length=min_length)

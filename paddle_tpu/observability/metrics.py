@@ -46,6 +46,9 @@ CLAIMED_SUBSYSTEMS = {
     "opt",         # static/analysis/rewrite.py — lint->rewrite driver:
                    # findings fixed/remaining by code, per-pass rewrite
                    # seconds, fixed-point iterations
+    "serve",       # serve/engine.py — continuous-batching server: queue
+                   # depth, TTFT, tokens/sec, preemptions, pool
+                   # occupancy, batch fill, decode/prefill traces
     "test",        # scratch names registered by the test suite
 }
 

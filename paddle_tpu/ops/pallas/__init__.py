@@ -7,4 +7,5 @@ Where the reference hand-writes CUDA, here the hot ops are Pallas kernels
 tiled for MXU/VMEM; every kernel has an interpret-mode path so the numerics
 are testable on the XLA-CPU virtual backend.
 """
-from . import flash_attention, flash_attention_varlen, rms_norm  # noqa: F401
+from . import (flash_attention, flash_attention_varlen,  # noqa: F401
+               paged_attention, rms_norm)

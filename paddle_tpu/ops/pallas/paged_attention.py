@@ -1,0 +1,241 @@
+"""Decode-specialized paged-attention Pallas kernel.
+
+The serving decode step reads a PAGED KV cache: each sequence's context
+lives in fixed-size pages scattered across a shared pool, addressed
+through a per-sequence block table (the PagedAttention / vLLM layout;
+reference surface: incubate/nn/functional/block_multihead_attention.py,
+whose jnp gather program is the semantics oracle here).
+
+Why a decode-shape-specialized kernel: the official generic Pallas
+``paged_attention`` is built for long contexts — a multi-stage pipeline
+of per-compute-block async copies whose fixed overhead dominates at
+serving shapes (tools/paged_kernel_probe.py MEASURED: 1350 us/step at
+B=8/NH=16/DH=128 with 2 pages/seq vs a ~200 us dense per-layer decode
+budget). At short context the problem is overhead, not reuse, so this
+kernel strips the machinery down to the decode case:
+
+- ONE query token per sequence (q ``[B, NH, DH]``), no q-block grid
+  axis and no query-side masking;
+- grid ``(B, pages_per_seq)`` — each program consumes one whole page
+  for ALL heads of one sequence, with the online-softmax running state
+  (m, l, acc) carried in VMEM scratch across the page axis;
+- the block table and sequence lengths ride in SMEM via scalar
+  prefetch (``pltpu.PrefetchScalarGridSpec``), so the page index map
+  resolves logical page ``i`` of sequence ``b`` to its physical pool
+  page before the kernel body runs — the gather IS the DMA schedule,
+  no gathered copy of K/V ever materializes;
+- GQA folds into the head axis: q heads are grouped by kv head
+  (``[KVH, G, DH]``) and each page is fetched ONCE per sequence, never
+  repeated per q head;
+- length masking is fused: pages past a sequence's length are clamped
+  to its last valid page by the index map (no out-of-bounds fetch) and
+  their lanes masked out of the softmax, so ragged batches cost the
+  masked lanes only.
+
+Layouts match jax's kernel convention: ``k_pages``/``v_pages`` are
+``[KVH, total_pages, page_size, DH]`` (the serve engine stores its pool
+this way; ``_bmha_fwd``'s ``[nb, kvh, bs, dh]`` transposes into it).
+
+CPU CI runs :func:`paged_attention_decode_reference` — the same masked
+softmax as a plain jnp gather program — or the kernel itself under
+``interpret=True`` (tests/test_paged_attention_kernel.py pins kernel ==
+reference == the block_mha gather path).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    "paged_attention_decode",
+    "paged_attention_decode_reference",
+    "paged_attention_decode_kernel",
+]
+
+
+def _check_shapes(q, k_pages, v_pages, lengths, block_tables):
+    if q.ndim != 3:
+        raise ValueError(f"q must be [B, NH, DH], got {q.shape}")
+    if k_pages.ndim != 4 or v_pages.shape != k_pages.shape:
+        raise ValueError(
+            f"k_pages/v_pages must both be [KVH, pages, page_size, DH], "
+            f"got {k_pages.shape} / {v_pages.shape}")
+    b, nh, dh = q.shape
+    kvh = k_pages.shape[0]
+    if k_pages.shape[-1] != dh:
+        raise ValueError(
+            f"head_dim mismatch: q has {dh}, k_pages has "
+            f"{k_pages.shape[-1]}")
+    if nh % kvh:
+        raise ValueError(
+            f"num q heads ({nh}) must be a multiple of kv heads ({kvh})")
+    if lengths.shape != (b,):
+        raise ValueError(
+            f"lengths must be [B]={b}, got {lengths.shape}")
+    if block_tables.ndim != 2 or block_tables.shape[0] != b:
+        raise ValueError(
+            f"block_tables must be [B, pages_per_seq], got "
+            f"{block_tables.shape}")
+
+
+def paged_attention_decode_reference(q, k_pages, v_pages, lengths,
+                                     block_tables, *, sm_scale=None):
+    """jnp gather reference: the masked-softmax program the kernel must
+    match (one q token per row, GQA by repeat, -inf beyond ``lengths``).
+
+    This is the CPU-CI code path AND the equivalence oracle promoted
+    from tools/paged_kernel_probe.py. fp32 softmax, output in q.dtype.
+    """
+    _check_shapes(q, k_pages, v_pages, lengths, block_tables)
+    b, nh, dh = q.shape
+    kvh, _, page, _ = k_pages.shape
+    pps = block_tables.shape[1]
+    s_pad = pps * page
+    scale = dh ** -0.5 if sm_scale is None else sm_scale
+    # [KVH, B, PPS, PAGE, DH] -> [B, S_pad, KVH, DH]
+    k_rows = k_pages[:, block_tables].transpose(1, 2, 3, 0, 4).reshape(
+        b, s_pad, kvh, dh)
+    v_rows = v_pages[:, block_tables].transpose(1, 2, 3, 0, 4).reshape(
+        b, s_pad, kvh, dh)
+    if kvh != nh:
+        k_rows = jnp.repeat(k_rows, nh // kvh, axis=2)
+        v_rows = jnp.repeat(v_rows, nh // kvh, axis=2)
+    scores = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                        k_rows.astype(jnp.float32)) * scale
+    valid = jnp.arange(s_pad)[None, :] < lengths[:, None]
+    scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # a zero-length row is fully masked -> NaN; serve engines carry such
+    # rows for inactive slots, so return 0 instead (matches the kernel)
+    probs = jnp.where(valid[:, None, :], probs, 0.0)
+    return jnp.einsum("bhs,bshd->bhd", probs,
+                      v_rows.astype(jnp.float32)).astype(q.dtype)
+
+
+def _decode_kernel_body(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
+                        m_scr, l_scr, acc_scr, *, kvh, group, page, scale):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b]
+    dh = q_ref.shape[-1]
+    # q heads grouped by kv head: head h = kv_head * group + g
+    q = q_ref[0].astype(jnp.float32).reshape(kvh, group, dh)
+    k = k_ref[:, 0].astype(jnp.float32)        # [KVH, PAGE, DH]
+    v = v_ref[:, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale     # [KVH, G, PAGE]
+    pos = i * page + jax.lax.broadcasted_iota(
+        jnp.int32, (kvh, group, page), 2)
+    in_len = pos < length
+    s = jnp.where(in_len, s, -jnp.inf)
+
+    m_prev = m_scr[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    p = jnp.where(in_len, jnp.exp(s - m_new[..., None]), 0.0)
+    # m_prev is -inf until the first valid lane; exp(-inf - -inf) trap
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_new), 0.0)
+    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1)
+    acc_scr[:] = acc_scr[:] * alpha[..., None] + jax.lax.dot_general(
+        p, v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)             # [KVH, G, DH]
+    m_scr[:] = m_new
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _emit():
+        l = l_scr[:][..., None]
+        out = jnp.where(l > 0.0, acc_scr[:] / jnp.where(l > 0.0, l, 1.0),
+                        0.0)
+        o_ref[0] = out.reshape(kvh * group, dh).astype(o_ref.dtype)
+
+
+def paged_attention_decode_kernel(q, k_pages, v_pages, lengths,
+                                  block_tables, *, sm_scale=None,
+                                  interpret=False):
+    """The Pallas kernel proper (TPU; ``interpret=True`` on CPU)."""
+    _check_shapes(q, k_pages, v_pages, lengths, block_tables)
+    b, nh, dh = q.shape
+    kvh, _npages, page, _ = k_pages.shape
+    pps = block_tables.shape[1]
+    group = nh // kvh
+    scale = dh ** -0.5 if sm_scale is None else sm_scale
+    lengths = lengths.astype(jnp.int32)
+    block_tables = block_tables.astype(jnp.int32)
+
+    def page_map(bi, i, len_ref, tbl_ref):
+        # clamp fully-masked trailing pages to the row's last valid page
+        # so no out-of-range pool page is ever fetched; their lanes are
+        # masked out of the softmax by `in_len` anyway
+        valid_pages = jax.lax.div(len_ref[bi] + (page - 1),
+                                  jnp.int32(page))
+        pi = jnp.minimum(i, jnp.maximum(valid_pages - 1, 0))
+        return (0, tbl_ref[bi, pi], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, pps),
+        in_specs=[
+            pl.BlockSpec((1, nh, dh), lambda bi, i, *_: (bi, 0, 0)),
+            pl.BlockSpec((kvh, 1, page, dh), page_map),
+            pl.BlockSpec((kvh, 1, page, dh), page_map),
+        ],
+        out_specs=pl.BlockSpec((1, nh, dh), lambda bi, i, *_: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kvh, group), jnp.float32),
+            pltpu.VMEM((kvh, group), jnp.float32),
+            pltpu.VMEM((kvh, group, dh), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel_body, kvh=kvh, group=group,
+                               page=page, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nh, dh), q.dtype),
+        interpret=interpret,
+    )(lengths, block_tables, q, k_pages, v_pages)
+
+
+def paged_attention_decode(q, k_pages, v_pages, lengths, block_tables, *,
+                           sm_scale=None, backend="auto"):
+    """Paged-attention for ONE decode step.
+
+    Args:
+      q: ``[B, NH, DH]`` — one query token per sequence. With GQA, q
+        heads are grouped by kv head (head ``h`` reads kv head
+        ``h // (NH // KVH)``, the standard repeat layout).
+      k_pages / v_pages: ``[KVH, total_pages, page_size, DH]`` pool.
+      lengths: ``[B]`` int32 — valid context length per sequence
+        (including the just-written token). Length 0 rows (inactive
+        serving slots) return zeros instead of NaN.
+      block_tables: ``[B, pages_per_seq]`` int32 physical page ids.
+      backend: ``"auto"`` (kernel on TPU, jnp reference elsewhere),
+        ``"kernel"``, ``"reference"``, or ``"interpret"`` (kernel under
+        the Pallas interpreter — the CPU-CI equivalence path).
+
+    Returns ``[B, NH, DH]`` in q.dtype.
+    """
+    if backend == "auto":
+        backend = ("kernel" if jax.default_backend() == "tpu"
+                   else "reference")
+    if backend == "reference":
+        return paged_attention_decode_reference(
+            q, k_pages, v_pages, lengths, block_tables, sm_scale=sm_scale)
+    if backend in ("kernel", "interpret"):
+        return paged_attention_decode_kernel(
+            q, k_pages, v_pages, lengths, block_tables, sm_scale=sm_scale,
+            interpret=(backend == "interpret"))
+    raise ValueError(
+        f"paged_attention_decode: unknown backend {backend!r} "
+        f"(use 'auto', 'kernel', 'reference' or 'interpret')")

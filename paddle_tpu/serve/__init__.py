@@ -1,0 +1,17 @@
+"""paddle_tpu.serve — continuous-batching LLM serving engine.
+
+The serving layer ROADMAP item 1 asks for: a request scheduler that
+admits, continuously batches, preempts and retires concurrent decode
+streams over the paged KV block pool, driven by one persistent compiled
+decode step and the decode-specialized paged-attention kernel
+(``ops/pallas/paged_attention.py``). See ``engine.py`` for the
+admission/eviction contract, ``load.py`` for the Poisson load
+generator behind ``tools/serve_load.py``, and the README "Serving"
+section for a worked example.
+"""
+from .engine import Request, ServeEngine
+from .load import LoadResult, run_load
+from .pool import BlockPool, PoolExhaustedError
+
+__all__ = ["ServeEngine", "Request", "BlockPool", "PoolExhaustedError",
+           "run_load", "LoadResult"]

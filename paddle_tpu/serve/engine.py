@@ -1,0 +1,706 @@
+"""Continuous-batching serving engine over the paged KV block pool.
+
+The PagedAttention/vLLM (Kwon et al., 2023) + Orca iteration-level
+scheduling (Yu et al., 2022) design, adapted to this repo's single-jit
+decode architecture: ``models/generation.py`` gives you ONE batched
+``generate`` call; this engine gives you a *server* — concurrent
+streams that arrive, decode and finish independently while sharing one
+fixed-shape compiled decode step and one paged KV pool.
+
+Scheduling policy (the contract the tests pin):
+
+- **Admission: FIFO.** ``submit()`` validates loudly (a request whose
+  ``prompt + max_new_tokens`` exceeds ``max_seq_len``, or whose KV
+  working set can never fit the pool, raises ``ValueError`` at submit
+  time — it could never run) and appends to the queue. Each ``step()``
+  admits from the queue head into free decode slots while the pool has
+  blocks for the prompt; the head blocks the line (no skip-ahead), so
+  admission order is completion-independent.
+- **Continuous batching.** A finished stream frees its slot and blocks
+  at the step it finishes; the next queued request prefills into that
+  slot on the following ``step()`` while the other streams keep
+  decoding — there is no batch barrier.
+- **Eviction (preemption): youngest-first.** When a growing stream
+  needs a KV block and the pool is empty, the most recently admitted
+  active stream is evicted (a stream that is itself the youngest
+  self-preempts): its blocks return to the pool and the request is
+  re-queued at the FRONT with its generated tokens intact (on
+  re-admission it re-prefills prompt+generated — vLLM's recompute
+  strategy). The oldest stream is never a victim, so it always runs
+  to completion and the engine cannot livelock.
+- **One persistent compiled decode step.** Slot state (tokens, lengths,
+  block tables, active mask, temperatures) rides as jit *data* at fixed
+  ``[max_slots, ...]`` shapes, so admission/finish/preemption churn
+  never retraces: ``serve.decode_traces`` stays at 1 for the life of
+  the engine (the e2e test asserts exactly that). Prefill compiles once
+  per power-of-two length bucket.
+
+Attention reads the pool through
+``ops/pallas/paged_attention.paged_attention_decode`` — the decode-
+specialized Pallas kernel on TPU, its jnp gather reference on CPU — and
+the per-layer norm/FFN math is imported from ``models/generation.py``'s
+shared helpers, so engine streams and ``generate()`` cannot drift.
+
+Telemetry: the ``serve.`` metric subsystem (claimed in
+``observability.metrics.CLAIMED_SUBSYSTEMS``, label discipline audited
+by ``tools/lint_registry.py``): queue depth, TTFT, tokens/sec,
+preemptions, pool occupancy, batch fill ratio, per-step timings.
+"""
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional
+
+import numpy as np
+
+from .. import observability as obs
+from ..core.tensor import Tensor
+from ..models import generation as _gen
+from .pool import BlockPool, PoolExhaustedError
+
+__all__ = ["ServeEngine", "Request", "PoolExhaustedError"]
+
+# --- serve. metric subsystem (prefix claimed in CLAIMED_SUBSYSTEMS) ----
+_M_QUEUE_DEPTH = obs.gauge(
+    "serve.queue_depth", "requests waiting for a decode slot")
+_M_POOL_OCCUPANCY = obs.gauge(
+    "serve.pool_occupancy", "fraction of KV pool blocks allocated")
+_M_BATCH_FILL = obs.gauge(
+    "serve.batch_fill", "active streams / max_slots at the last step")
+_M_TOKENS_PER_SEC = obs.gauge(
+    "serve.tokens_per_sec", "aggregate generated tokens/sec over run()")
+_M_ADMITTED = obs.counter(
+    "serve.requests_admitted", "requests scheduled into a decode slot "
+    "(re-admissions after preemption count again)")
+_M_FINISHED = obs.counter(
+    "serve.requests_finished", "requests completed, by reason "
+    "(eos / max_new_tokens)")
+_M_REJECTED = obs.counter(
+    "serve.requests_rejected", "submissions refused at validation, by "
+    "reason")
+_M_PREEMPTIONS = obs.counter(
+    "serve.preemptions", "streams evicted mid-decode, by reason")
+_M_STALLS = obs.counter(
+    "serve.admission_stalls", "scheduler passes where the queue head "
+    "could not be admitted, by reason")
+_M_TOKENS = obs.counter(
+    "serve.tokens_generated", "tokens emitted across all streams")
+_M_DECODE_STEPS = obs.counter(
+    "serve.decode_steps", "batched decode steps executed")
+_M_DECODE_TRACES = obs.counter(
+    "serve.decode_traces", "times the persistent decode step was "
+    "traced — slot churn must keep this at 1 per engine")
+_M_PREFILL_TRACES = obs.counter(
+    "serve.prefill_traces", "prefill compiles, by length bucket")
+_M_TTFT = obs.histogram(
+    "serve.ttft_seconds", "submit -> first generated token wall time "
+    "(queue wait included)")
+_M_REQUEST_SECONDS = obs.histogram(
+    "serve.request_seconds", "submit -> finish wall time per request")
+_M_DECODE_SECONDS = obs.histogram(
+    "serve.decode_step_seconds", "wall time of one batched decode step")
+_M_PREFILL_SECONDS = obs.histogram(
+    "serve.prefill_seconds", "wall time of one prefill call")
+
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+
+
+@dataclass
+class Request:
+    """One stream: prompt in, tokens out, scheduling state in between."""
+
+    id: int
+    prompt: np.ndarray                     # [t0] int32
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    temperature: float = 0.0               # 0.0 = greedy
+    submit_time: float = 0.0
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    finish_reason: Optional[str] = None
+    state: str = QUEUED
+    ids: List[int] = field(default_factory=list)   # prompt + generated
+    blocks: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    admit_seq: int = -1                    # recency rank for eviction
+    preemptions: int = 0
+    warmup: bool = False                   # excluded from TTFT telemetry
+
+    @property
+    def n_prompt(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.ids) - self.n_prompt
+
+    @property
+    def output_ids(self) -> List[int]:
+        """Generated tokens only (prompt excluded)."""
+        return self.ids[self.n_prompt:]
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
+
+class ServeEngine:
+    """Continuous-batching server over a paged KV pool (module docstring
+    has the admission/eviction contract). Llama and GPT families.
+
+    Usage::
+
+        eng = ServeEngine(model, max_slots=4, block_size=32,
+                          num_blocks=64, max_seq_len=256)
+        r1 = eng.submit(prompt_ids, max_new_tokens=32, eos_token_id=2)
+        r2 = eng.submit(other_ids, max_new_tokens=64)
+        eng.run()                      # or step() from your own loop
+        print(r1.output_ids, r1.ttft)
+    """
+
+    def __init__(self, model, *, max_slots: int = 4, block_size: int = 32,
+                 num_blocks: int = 64, max_seq_len: int = 256,
+                 seed: int = 0, name: str = "default",
+                 attention_backend: str = "auto"):
+        import jax
+
+        if not hasattr(model, "llama") and not hasattr(model, "gpt"):
+            raise NotImplementedError(
+                "ServeEngine supports the Llama and GPT families (the "
+                "paged-decode surface); MoE models decode on the dense "
+                f"path — got {type(model).__name__}")
+        self._is_llama = hasattr(model, "llama")
+        p, _fwd = _gen._decode_family(model)
+        max_pos = p.get("max_positions")
+        if max_pos is not None and max_seq_len > max_pos:
+            raise ValueError(
+                f"max_seq_len ({max_seq_len}) exceeds the model's "
+                f"learned position table (max_position_embeddings="
+                f"{max_pos})")
+        if max_slots < 1:
+            raise ValueError(
+                f"max_slots must be >= 1, got {max_slots} — with no "
+                f"decode slot nothing can ever be admitted and every "
+                f"driver loop would spin forever")
+        self.name = str(name)
+        self.max_slots = int(max_slots)
+        self.block_size = int(block_size)
+        self.max_seq_len = int(max_seq_len)
+        self.max_blocks_per_seq = -(-self.max_seq_len // self.block_size)
+        self.pool = BlockPool(num_blocks, block_size)
+        self._backend = attention_backend
+
+        self._static = {k: v for k, v in p.items()
+                        if not hasattr(v, "dtype")
+                        and not isinstance(v, list)}
+        self._arrays = {k: v for k, v in p.items() if k not in self._static}
+        self._nh, self._nkv = p["nh"], p["nkv"]
+        self._dh, self._L = p["dh"], len(p["layers"])
+        self._dtype = p["embed"].dtype
+        import jax.numpy as jnp
+
+        self._caches = [
+            (jnp.zeros((self._nkv, self.pool.num_blocks, self.block_size,
+                        self._dh), self._dtype),
+             jnp.zeros((self._nkv, self.pool.num_blocks, self.block_size,
+                        self._dh), self._dtype))
+            for _ in range(self._L)]
+
+        # host-side slot state (jit DATA — shapes never change)
+        self._slots: List[Optional[Request]] = [None] * self.max_slots
+        self._tables = np.zeros(
+            (self.max_slots, self.max_blocks_per_seq), np.int32)
+        self._lens = np.zeros(self.max_slots, np.int32)
+        self._tokens = np.zeros(self.max_slots, np.int32)
+        self._temps = np.zeros(self.max_slots, np.float32)
+
+        self.queue: Deque[Request] = collections.deque()
+        self.finished: List[Request] = []
+        self.decode_traces = 0
+        self.prefill_traces = 0
+        self._next_id = 0
+        self._admit_counter = 0
+        self._key = jax.random.PRNGKey(seed)
+        self._rng = np.random.default_rng(seed)
+        # the caches are DONATED (argument 1 after the bound self):
+        # the engine replaces self._caches with the returned pool every
+        # call, so in-place aliasing is safe — and without it every
+        # decode tick would COPY the entire pool (≈1 GB/token at the
+        # 10-layer/96x128-block bf16 serving shape)
+        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._prefill_fn = jax.jit(self._prefill_impl,
+                                   donate_argnums=(1,))
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None,
+               temperature: float = 0.0,
+               warmup: bool = False) -> Request:
+        """Validate and enqueue one stream (FIFO). Raises ``ValueError``
+        for requests that could NEVER run — too long for
+        ``max_seq_len``, or a KV working set larger than the whole pool
+        — instead of failing later with a corrupted gather. A request
+        that merely has to WAIT for blocks is queued, not refused.
+        ``warmup`` marks a compile-warming request whose TTFT (which
+        bills the XLA compile, not serving latency) must stay out of
+        the ``serve.ttft_seconds`` histogram."""
+        if isinstance(prompt, Tensor):
+            prompt = np.asarray(prompt._value)
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            _M_REJECTED.inc(engine=self.name, reason="empty_prompt")
+            raise ValueError("submit: prompt is empty")
+        if max_new_tokens < 1:
+            _M_REJECTED.inc(engine=self.name, reason="bad_max_new_tokens")
+            raise ValueError(
+                f"submit: max_new_tokens must be >= 1, got "
+                f"{max_new_tokens}")
+        total = prompt.size + int(max_new_tokens)
+        if total > self.max_seq_len:
+            _M_REJECTED.inc(engine=self.name, reason="too_long")
+            raise ValueError(
+                f"submit: prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) = {total} exceeds the engine's "
+                f"max_seq_len ({self.max_seq_len})")
+        # the last generated token is emitted but never written back,
+        # so the KV working set is total - 1 positions
+        need = self.pool.blocks_for_tokens(total - 1)
+        if need > self.pool.num_blocks:
+            _M_REJECTED.inc(engine=self.name, reason="pool_too_small")
+            raise ValueError(
+                f"submit: request needs {need} KV blocks "
+                f"(block_size={self.block_size}) but the whole pool is "
+                f"{self.pool.num_blocks} — it can never be admitted")
+        req = Request(
+            id=self._next_id, prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            eos_token_id=(None if eos_token_id is None
+                          else int(eos_token_id)),
+            temperature=float(temperature),
+            submit_time=time.perf_counter(),
+            ids=[int(t) for t in prompt], warmup=bool(warmup))
+        self._next_id += 1
+        self.queue.append(req)
+        _M_QUEUE_DEPTH.set(len(self.queue), engine=self.name)
+        return req
+
+    # -- engine loop -------------------------------------------------------
+    @property
+    def n_active(self) -> int:
+        """Streams currently holding a decode slot."""
+        return sum(1 for r in self._slots if r is not None)
+
+    @property
+    def has_work(self) -> bool:
+        """True while anything is queued or decoding."""
+        return bool(self.queue) or any(r is not None for r in self._slots)
+
+    def step(self) -> int:
+        """One scheduler iteration: admit from the queue into free
+        slots (prefill), then run ONE batched decode step for every
+        active stream, retiring the ones that finish. Returns the
+        number of streams that were active this step."""
+        self._admit()
+        n_active = self.n_active
+        if n_active:
+            self._decode_once()
+        _M_QUEUE_DEPTH.set(len(self.queue), engine=self.name)
+        _M_POOL_OCCUPANCY.set(round(self.pool.occupancy, 4),
+                              engine=self.name)
+        _M_BATCH_FILL.set(round(n_active / self.max_slots, 4),
+                          engine=self.name)
+        return n_active
+
+    def run(self, max_steps: int = 100_000) -> List[Request]:
+        """Drive :meth:`step` until queue and slots drain; returns the
+        finished requests. Sets ``serve.tokens_per_sec`` over the run."""
+        t0 = time.perf_counter()
+        tok0 = sum(r.n_generated for r in self.finished)
+        steps = 0
+        while self.has_work:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"run(): exceeded max_steps={max_steps} with "
+                    f"{len(self.queue)} queued and "
+                    f"{sum(1 for r in self._slots if r)} active — "
+                    f"scheduler is not making progress")
+        dt = time.perf_counter() - t0
+        n_tok = sum(r.n_generated for r in self.finished) - tok0
+        if dt > 0 and n_tok:
+            _M_TOKENS_PER_SEC.set(round(n_tok / dt, 2), engine=self.name)
+        return self.finished
+
+    # -- scheduling --------------------------------------------------------
+    def _free_slot(self) -> Optional[int]:
+        for i, r in enumerate(self._slots):
+            if r is None:
+                return i
+        return None
+
+    def _admit(self):
+        """FIFO admission from the queue head into free slots."""
+        while self.queue:
+            slot = self._free_slot()
+            if slot is None:
+                _M_STALLS.inc(engine=self.name, reason="no_free_slot")
+                return
+            req = self.queue[0]
+            # resumed streams re-prefill prompt+generated minus the
+            # pending last token; fresh streams prefill the prompt.
+            # COPY either way: _prefill appends the first sampled token
+            # to req.ids, and an aliased list would inflate the slot
+            # length by one (skipping a cache slot + shifting rope)
+            prefill_ids = list(req.ids[:-1] if req.n_generated > 0
+                               else req.ids)
+            need = self.pool.blocks_for_tokens(len(prefill_ids))
+            if need > self.pool.free_blocks:
+                # head-of-line blocking is the FIFO contract: later
+                # (smaller) requests do NOT jump a starving head
+                _M_STALLS.inc(engine=self.name, reason="no_free_blocks")
+                return
+            self.queue.popleft()
+            req.blocks = self.pool.alloc(need)
+            req.slot = slot
+            req.state = RUNNING
+            req.admit_seq = self._admit_counter
+            self._admit_counter += 1
+            self._slots[slot] = req
+            row = np.zeros(self.max_blocks_per_seq, np.int32)
+            row[:len(req.blocks)] = req.blocks
+            self._tables[slot] = row
+            self._prefill(req, prefill_ids)
+            _M_ADMITTED.inc(engine=self.name)
+            if req.state is FINISHED:
+                continue        # eos / max_new hit on the first token
+            self._lens[slot] = len(prefill_ids)
+            self._tokens[slot] = req.ids[-1]
+            self._temps[slot] = req.temperature
+
+    def _prefill(self, req: Request, prefill_ids: List[int]):
+        import jax.numpy as jnp
+
+        n = len(prefill_ids)
+        bucket = max(8, 1 << (n - 1).bit_length())   # pow2 length buckets
+        bucket = min(bucket, self.max_seq_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = prefill_ids
+        with _M_PREFILL_SECONDS.time(engine=self.name):
+            self._caches, logits = self._prefill_fn(
+                self._arrays, self._caches, jnp.asarray(padded),
+                jnp.int32(n), jnp.asarray(self._tables[req.slot]))
+        if req.n_generated == 0:
+            # fresh stream: its FIRST token comes from the prefill
+            # logits (this is the TTFT moment); resumed streams already
+            # hold their pending token, the logits are discarded
+            tok = self._sample_host(np.asarray(logits), req.temperature)
+            now = time.perf_counter()
+            req.first_token_time = now
+            if not req.warmup:
+                _M_TTFT.observe(now - req.submit_time, engine=self.name)
+            self._append_token(req, tok)
+
+    def _sample_host(self, logits: np.ndarray, temperature: float) -> int:
+        """First-token sampling (host-side; decode steps sample on
+        device). Greedy at temperature 0."""
+        if temperature <= 0.0:
+            return int(np.argmax(logits))
+        z = logits.astype(np.float64) / max(temperature, 1e-6)
+        z -= z.max()
+        prob = np.exp(z)
+        prob /= prob.sum()
+        return int(self._rng.choice(logits.shape[0], p=prob))
+
+    def _append_token(self, req: Request, tok: int):
+        req.ids.append(int(tok))
+        _M_TOKENS.inc(engine=self.name)
+        if req.eos_token_id is not None and tok == req.eos_token_id:
+            self._finish(req, "eos")
+        elif req.n_generated >= req.max_new_tokens:
+            self._finish(req, "max_new_tokens")
+
+    def _finish(self, req: Request, reason: str):
+        self.pool.free(req.blocks)
+        req.blocks = []
+        if req.slot is not None:
+            self._clear_slot(req.slot)
+        req.slot = None
+        req.state = FINISHED
+        req.finish_reason = reason
+        req.finish_time = time.perf_counter()
+        self.finished.append(req)
+        _M_FINISHED.inc(engine=self.name, reason=reason)
+        _M_REQUEST_SECONDS.observe(req.finish_time - req.submit_time,
+                                   engine=self.name)
+
+    def _clear_slot(self, slot: int):
+        self._slots[slot] = None
+        self._tables[slot] = 0
+        self._lens[slot] = 0
+        self._tokens[slot] = 0
+        self._temps[slot] = 0.0
+
+    def _preempt_youngest(self) -> Request:
+        """Evict the most recently admitted active stream; its blocks
+        return to the pool and the request goes back to the FRONT of
+        the queue (re-prefill of prompt+generated on re-admission).
+        The OLDEST stream is therefore never a victim and always runs
+        to completion — the no-livelock guarantee."""
+        victims = [r for r in self._slots if r is not None]
+        victim = max(victims, key=lambda r: r.admit_seq)
+        self.pool.free(victim.blocks)
+        victim.blocks = []
+        self._clear_slot(victim.slot)
+        victim.slot = None
+        victim.state = QUEUED
+        victim.preemptions += 1
+        self.queue.appendleft(victim)
+        _M_PREEMPTIONS.inc(engine=self.name, reason="pool_exhausted")
+        return victim
+
+    def _ensure_blocks(self):
+        """Every active stream needs the block its next token writes
+        into; allocate at block boundaries, evicting youngest-first
+        when the pool runs dry (a stream that is ITSELF the youngest
+        self-preempts back to the queue rather than evicting an older
+        one)."""
+        for req in sorted((r for r in self._slots if r is not None),
+                          key=lambda r: r.admit_seq):
+            if req.slot is None:
+                continue          # evicted by an older stream this pass
+            bi = int(self._lens[req.slot]) // self.block_size
+            while bi >= len(req.blocks):
+                try:
+                    new = self.pool.alloc(1)
+                except PoolExhaustedError:
+                    if self._preempt_youngest() is req:
+                        break     # req went back to the queue itself
+                    continue
+                req.blocks.extend(new)
+                self._tables[req.slot, len(req.blocks) - 1] = new[0]
+
+    def _decode_once(self):
+        import jax
+        import jax.numpy as jnp
+
+        self._ensure_blocks()
+        active_np = np.array([r is not None for r in self._slots], bool)
+        if not active_np.any():
+            return                # everyone was preempted away
+        self._key, sub = jax.random.split(self._key)
+        with _M_DECODE_SECONDS.time(engine=self.name):
+            nxt, self._caches = self._decode_fn(
+                self._arrays, self._caches, jnp.asarray(self._tokens),
+                jnp.asarray(self._lens), jnp.asarray(active_np),
+                jnp.asarray(self._tables), jnp.asarray(self._temps), sub)
+            nxt = np.asarray(nxt)
+        _M_DECODE_STEPS.inc(engine=self.name)
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            self._lens[slot] += 1
+            self._append_token(req, int(nxt[slot]))
+            if req.state is not FINISHED:
+                self._tokens[slot] = req.ids[-1]
+
+    # -- compiled steps ----------------------------------------------------
+    def _scatter_kv(self, kc, vc, k_new, v_new, safe_slot):
+        """Write per-row K/V ([rows, kvh, dh]) into the pool at flat
+        slot ids (out-of-range ids drop — that is how inactive slots
+        and pad rows are fenced off the pool)."""
+        nb, bs = self.pool.num_blocks, self.block_size
+        kvh, dh = self._nkv, self._dh
+        kc_f = kc.reshape(kvh, nb * bs, dh)
+        vc_f = vc.reshape(kvh, nb * bs, dh)
+        kc_f = kc_f.at[:, safe_slot, :].set(
+            k_new.transpose(1, 0, 2), mode="drop")
+        vc_f = vc_f.at[:, safe_slot, :].set(
+            v_new.transpose(1, 0, 2), mode="drop")
+        return (kc_f.reshape(kvh, nb, bs, dh),
+                vc_f.reshape(kvh, nb, bs, dh))
+
+    def _rope_rows(self, pos):
+        """cos/sin rows at per-row positions ``pos`` — computed ONCE
+        per compiled call and reused by every layer (the tables are
+        position-only; rebuilding them per layer would stage L
+        identical table subgraphs per trace)."""
+        import jax.numpy as jnp
+
+        from ..incubate.nn.functional import _rope_tables
+
+        cos_full, sin_full = _rope_tables(
+            self.max_seq_len, self._dh, self._static["theta"], True,
+            jnp.float32)
+        return (jnp.take(cos_full, pos, axis=0)[:, None, :],
+                jnp.take(sin_full, pos, axis=0)[:, None, :])
+
+    def _rope(self, q, k, cos, sin):
+        """Rotate q/k ([rows, heads, dh]) by precomputed cos/sin rows
+        (Llama families only)."""
+        import jax.numpy as jnp
+
+        from ..incubate.nn.functional._rope_common import rotate_half
+
+        q = (q.astype(jnp.float32) * cos
+             + rotate_half(q.astype(jnp.float32), True) * sin)
+        k = (k.astype(jnp.float32) * cos
+             + rotate_half(k.astype(jnp.float32), True) * sin)
+        return q.astype(self._dtype), k.astype(self._dtype)
+
+    def _stack_layers(self, p, x, rope, caches, safe_slot, attn):
+        """ONE transformer stack for BOTH compiled steps: family
+        norm/projection, rope, K/V scatter into the pool, attention
+        via the provided closure, residual + FFN, final norm. ``x`` is
+        [rows, H]; ``attn(q, k, v, kc, vc) -> [rows, nh*dh]`` is the
+        only thing decode and prefill legitimately differ in (paged
+        pool attention vs in-prompt causal softmax), so it is the only
+        thing they provide. Returns (normed hidden [rows, H],
+        new caches)."""
+        rows = x.shape[0]
+        nh, kvh, dh = self._nh, self._nkv, self._dh
+        dtype = self._dtype
+
+        new_caches = []
+        for lp, (kc, vc) in zip(p["layers"], caches):
+            if self._is_llama:
+                h = _gen._rms(x, lp["ln1"], p["eps"], dtype)
+                q = (h @ lp["wq"]).reshape(rows, nh, dh)
+                k = (h @ lp["wk"]).reshape(rows, kvh, dh)
+                v = (h @ lp["wv"]).reshape(rows, kvh, dh)
+                q, k = self._rope(q, k, *rope)
+            else:
+                h = _gen._ln(x, lp["ln1_w"], lp["ln1_b"], p["eps"],
+                             dtype)
+                qkv = (h @ lp["wqkv"] + lp["bqkv"]).reshape(
+                    rows, 3, nh, dh)
+                q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            kc, vc = self._scatter_kv(kc, vc, k, v, safe_slot)
+            new_caches.append((kc, vc))
+            ctx = attn(q, k, v, kc, vc)
+            if self._is_llama:
+                x = x + ctx.astype(dtype) @ lp["wo"]
+                x = x + _gen._llama_ffn(
+                    _gen._rms(x, lp["ln2"], p["eps"], dtype), lp, dtype)
+            else:
+                x = x + ctx.astype(dtype) @ lp["wo"] + lp["bo"]
+                x = x + _gen._gpt_ffn(
+                    _gen._ln(x, lp["ln2_w"], lp["ln2_b"], p["eps"],
+                             dtype), lp, dtype)
+        if self._is_llama:
+            return _gen._rms(x, p["norm"], p["eps"], dtype), new_caches
+        return (_gen._ln(x, p["normf_w"], p["normf_b"], p["eps"], dtype),
+                new_caches)
+
+    def _decode_impl(self, arrays, caches, tokens, lens, active, tables,
+                     temps, key):
+        """ONE batched decode tick over every slot: write each active
+        stream's pending token into its KV block, attend through the
+        block tables (decode-specialized paged attention), project,
+        sample. Shapes are fixed at [max_slots, ...]; slot churn is
+        data, so this traces exactly once per engine (asserted via
+        ``serve.decode_traces``). The caches are DONATED: the pool
+        updates in place instead of being copied per token."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.pallas.paged_attention import paged_attention_decode
+
+        # executes at TRACE time only — the flatness counter the e2e
+        # continuous-batching test pins at 1
+        self.decode_traces += 1
+        _M_DECODE_TRACES.inc(engine=self.name)
+
+        p = {**arrays, **self._static}
+        b = self.max_slots
+        nh = self._nh
+        nb, bs = self.pool.num_blocks, self.block_size
+
+        x = jnp.take(p["embed"], tokens, axis=0)          # [B, H]
+        pos = lens.astype(jnp.int32)
+        rope = None
+        if self._is_llama:
+            rope = self._rope_rows(pos)
+        else:
+            x = x + jnp.take(p["wpe"], pos, axis=0)
+        lengths = jnp.where(active, pos + 1, 0)
+        bi = jnp.clip(pos // bs, 0, self.max_blocks_per_seq - 1)
+        phys = jnp.take_along_axis(tables, bi[:, None], axis=1)[:, 0]
+        slot = phys * bs + pos % bs
+        safe_slot = jnp.where(active, slot, nb * bs)      # OOB drops
+
+        def attn(q, _k, _v, kc, vc):
+            return paged_attention_decode(
+                q, kc, vc, lengths, tables,
+                backend=self._backend).reshape(b, nh * self._dh)
+
+        out, new_caches = self._stack_layers(p, x, rope, caches,
+                                             safe_slot, attn)
+        logits = _gen._head_logits(p, out).astype(jnp.float32)   # [B, V]
+
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        sampled = jax.random.categorical(
+            key, scaled, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(temps > 0.0, sampled, greedy)
+        return nxt, new_caches
+
+    def _prefill_impl(self, arrays, caches, ids, n, table_row):
+        """Prompt prefill for ONE stream: causal self-attention over
+        the (bucket-padded) prompt, K/V scattered into this stream's
+        pool blocks (donated — updated in place), last real token's
+        logits returned. Compiles once per power-of-two length bucket
+        (``serve.prefill_traces``)."""
+        import jax
+        import jax.numpy as jnp
+
+        self.prefill_traces += 1
+        _M_PREFILL_TRACES.inc(engine=self.name,
+                              bucket=int(ids.shape[1]))
+
+        p = {**arrays, **self._static}
+        tp = ids.shape[1]
+        nh, kvh, dh = self._nh, self._nkv, self._dh
+        nb, bs = self.pool.num_blocks, self.block_size
+        group = nh // kvh
+
+        positions = jnp.arange(tp, dtype=jnp.int32)
+        valid = positions < n                              # [Tp]
+        x = jnp.take(p["embed"], ids, axis=0)[0]           # [Tp, H]
+        rope = None
+        if self._is_llama:
+            rope = self._rope_rows(positions)
+        else:
+            x = x + jnp.take(p["wpe"], positions, axis=0)
+        # causal within the prompt; pad rows see themselves only (their
+        # K/V never reach the pool and their logits are never read)
+        causal = (positions[None, :] <= positions[:, None]) \
+            & valid[None, :]                               # [Tq, Tk]
+
+        bi = jnp.clip(positions // bs, 0, self.max_blocks_per_seq - 1)
+        slot = jnp.take(table_row, bi) * bs + positions % bs
+        safe_slot = jnp.where(valid, slot, nb * bs)
+
+        def attn(q, k, v, _kc, _vc):
+            k_rep = jnp.repeat(k, group, axis=1) if group > 1 else k
+            v_rep = jnp.repeat(v, group, axis=1) if group > 1 else v
+            scores = jnp.einsum(
+                "qhd,khd->hqk", q.astype(jnp.float32),
+                k_rep.astype(jnp.float32)) * (dh ** -0.5)
+            scores = jnp.where(causal[None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            return jnp.einsum(
+                "hqk,khd->qhd", probs,
+                v_rep.astype(jnp.float32)).reshape(tp, nh * dh)
+
+        out, new_caches = self._stack_layers(p, x, rope, caches,
+                                             safe_slot, attn)
+        h_last = jnp.take(out, n - 1, axis=0)              # [H]
+        logits = _gen._head_logits(p, h_last[None, :])[0]
+        return new_caches, logits.astype(jnp.float32)

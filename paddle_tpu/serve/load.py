@@ -1,0 +1,202 @@
+"""Synthetic heavy-traffic load generator for the serving engine.
+
+Poisson arrivals (exponential inter-arrival gaps at ``rate`` req/s) of
+requests with mixed prompt/output lengths, submitted against a live
+:class:`~paddle_tpu.serve.engine.ServeEngine` in wall-clock time while
+the engine loop keeps stepping — so queueing, continuous batching and
+preemption all happen under realistic contention, and TTFT includes
+real queue wait.
+
+``tools/serve_load.py`` is the CLI; ``bench.py --config serve`` runs
+the same generator for the BENCH record (p50/p99 TTFT + aggregate
+tokens/sec land in the ``--metrics`` roll-up via the ``serve.``
+registry series this run populates).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List
+
+import numpy as np
+
+from . import engine as _engine_mod
+from .engine import ServeEngine
+
+__all__ = ["run_load", "LoadResult", "default_serving_setup",
+           "warm_engine"]
+
+
+def default_serving_setup(on_tpu: bool):
+    """ONE source for the model config + engine/load defaults shared by
+    ``bench.py --config serve`` and ``tools/serve_load.py`` — tuning
+    the serving shape here keeps the BENCH record and the CLI it
+    claims parity with in sync."""
+    from ..models import LlamaConfig
+
+    if on_tpu:
+        config = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=10, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048)
+        params = dict(rate=30.0, requests=48, slots=8, num_blocks=96,
+                      block_size=128, max_seq_len=1024,
+                      prompt_len=(32, 128), max_new=(16, 64))
+    else:
+        config = LlamaConfig.tiny()
+        params = dict(rate=300.0, requests=16, slots=3, num_blocks=24,
+                      block_size=8, max_seq_len=48,
+                      prompt_len=(4, 12), max_new=(4, 8))
+    return config, params
+
+
+def warm_engine(engine: ServeEngine, max_prompt_len=None):
+    """Compile the decode step and EVERY reachable prefill bucket
+    outside the measured window. Prefill compiles once per pow2 length
+    bucket; a bucket first hit mid-load would bill a full XLA compile
+    to that request's TTFT — turning the p99 BENCH record into a
+    compiler benchmark (a ~50x p99/p50 ratio was the symptom)."""
+    vocab = int(engine._arrays["embed"].shape[0])
+    # the longest ADMISSIBLE prompt: max_new >= 1 bounds it at
+    # max_seq_len - 1, and its n-token working set must fit the pool.
+    # Warming at every pow2 below that cap plus the cap itself covers
+    # every value the (monotone) bucket function can take — including
+    # the max_seq_len-capped TOP bucket, which a pow2-only sweep
+    # misses whenever the cap lands on a power of two.
+    cap = min(engine.max_seq_len - 1,
+              engine.pool.num_blocks * engine.block_size)
+    if max_prompt_len is not None:
+        cap = min(cap, int(max_prompt_len))
+    lens, b = [], 8
+    while b < cap:
+        lens.append(b)              # a len-b prompt fills bucket b exactly
+        b *= 2
+    lens.append(cap)                # the final (possibly capped) bucket
+    for n in dict.fromkeys(lens):
+        if n < 1:
+            continue
+        req = engine.submit(np.arange(n) % (vocab - 1) + 1,
+                            max_new_tokens=1, warmup=True)
+        engine.run()
+        if req.state != "FINISHED":   # pragma: no cover — engine contract
+            raise RuntimeError("warm-up request did not finish")
+
+
+@dataclass
+class LoadResult:
+    """Aggregate outcome of one load run (seconds / tokens units)."""
+
+    n_requests: int
+    wall_seconds: float
+    ttft_p50: float
+    ttft_p99: float
+    ttft_mean: float
+    tokens_per_sec: float
+    total_tokens: int
+    preemptions: int
+    engine_steps: int
+    rejected: int = 0
+    requests: List = field(default_factory=list, repr=False)
+
+    def to_dict(self) -> dict:
+        return {
+            "n_requests": self.n_requests,
+            "wall_seconds": round(self.wall_seconds, 4),
+            "ttft_p50_seconds": round(self.ttft_p50, 5),
+            "ttft_p99_seconds": round(self.ttft_p99, 5),
+            "ttft_mean_seconds": round(self.ttft_mean, 5),
+            "tokens_per_sec": round(self.tokens_per_sec, 2),
+            "total_tokens": self.total_tokens,
+            "preemptions": self.preemptions,
+            "engine_steps": self.engine_steps,
+            "rejected": self.rejected,
+        }
+
+
+def run_load(engine: ServeEngine, *, rate: float = 50.0,
+             n_requests: int = 32, prompt_len=(4, 24),
+             max_new=(4, 24), vocab_size: int | None = None,
+             eos_token_id=None, temperature: float = 0.0,
+             seed: int = 0, max_steps: int = 1_000_000) -> LoadResult:
+    """Drive ``engine`` with Poisson traffic and return latency stats.
+
+    Arrival times are pre-drawn (cumsum of Exp(1/rate) gaps) and each
+    request is submitted the first time the wall clock passes its
+    arrival; between arrivals the engine keeps stepping whatever is
+    admitted. Prompt and output lengths are uniform over the given
+    inclusive ranges. Returns exact (sample-based) p50/p99 TTFT —
+    the ``serve.ttft_seconds`` histogram the engine records carries
+    the same data in bucketed form for the metrics roll-up.
+    """
+    if vocab_size is None:
+        vocab_size = int(engine._arrays["embed"].shape[0])
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_requests))
+    prompts = [rng.integers(1, vocab_size,
+                            size=rng.integers(prompt_len[0],
+                                              prompt_len[1] + 1))
+               for _ in range(n_requests)]
+    news = rng.integers(max_new[0], max_new[1] + 1, size=n_requests)
+
+    submitted: List = []
+    rejected = 0
+    steps = 0
+    steps0 = _metric_total("serve.decode_steps")
+    preempt0 = _metric_total("serve.preemptions")
+    start = time.perf_counter()
+    i = 0
+    while i < n_requests or engine.has_work:
+        now = time.perf_counter() - start
+        while i < n_requests and arrivals[i] <= now:
+            try:
+                submitted.append(engine.submit(
+                    prompts[i], max_new_tokens=int(news[i]),
+                    eos_token_id=eos_token_id, temperature=temperature))
+            except ValueError:
+                # never-runnable under THIS engine's limits (a
+                # deliberately tiny --num_blocks pool, a max_seq_len
+                # shorter than the draw range): a real front door
+                # returns 4xx and keeps serving — count it, keep going
+                rejected += 1
+            i += 1
+        if engine.has_work:
+            engine.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"run_load: exceeded max_steps={max_steps} with "
+                    f"{len(engine.queue)} queued and {engine.n_active} "
+                    f"active — the engine is not making progress")
+        elif i < n_requests:
+            time.sleep(min(max(arrivals[i] - now, 0.0), 0.005))
+    wall = time.perf_counter() - start
+
+    ttfts = np.array([r.ttft for r in submitted
+                      if r.ttft is not None], np.float64)
+    total_tokens = int(sum(r.n_generated for r in submitted))
+    tps = total_tokens / wall if wall > 0 else 0.0
+    _engine_mod._M_TOKENS_PER_SEC.set(round(tps, 2), engine=engine.name)
+
+    def pct(q):
+        return float(np.percentile(ttfts, q)) if ttfts.size else 0.0
+
+    return LoadResult(
+        n_requests=n_requests,
+        wall_seconds=wall,
+        ttft_p50=pct(50),
+        ttft_p99=pct(99),
+        ttft_mean=float(ttfts.mean()) if ttfts.size else 0.0,
+        tokens_per_sec=tps,
+        total_tokens=total_tokens,
+        preemptions=_metric_total("serve.preemptions") - preempt0,
+        engine_steps=_metric_total("serve.decode_steps") - steps0,
+        rejected=rejected,
+        requests=submitted,
+    )
+
+
+def _metric_total(name: str) -> int:
+    from .. import observability as obs
+
+    m = obs.registry.get(name)
+    return int(m.total()) if m is not None else 0

@@ -1,0 +1,94 @@
+"""Block pool allocator for the paged KV cache.
+
+The physical pool itself is a pair of device arrays per layer
+(``[KVH, num_blocks, block_size, DH]``, the paged-attention kernel
+layout); THIS object owns only the block-id bookkeeping: a LIFO
+free-list of physical block ids handed to sequences as their context
+grows and recycled the moment a stream finishes or is preempted.
+
+Exhaustion is LOUD by contract: :meth:`alloc` raises
+:class:`PoolExhaustedError` instead of handing out an out-of-range id —
+the silent failure mode this replaces was a clipped out-of-bounds
+gather that reads another sequence's KV block (ISSUE 14 satellite; the
+serving engine catches the error and queues/preempts instead).
+"""
+from __future__ import annotations
+
+from typing import List
+
+__all__ = ["BlockPool", "PoolExhaustedError"]
+
+
+class PoolExhaustedError(RuntimeError):
+    """No free KV-cache blocks remain in the pool.
+
+    Raised by :meth:`BlockPool.alloc`; the serving engine reacts by
+    queueing the admission (or preempting the youngest stream), a bare
+    ``generate(paged=True)`` caller by failing loudly instead of
+    gathering out of bounds.
+    """
+
+
+class BlockPool:
+    """Free-list allocator over ``num_blocks`` physical KV blocks."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks <= 0:
+            raise ValueError(f"num_blocks must be > 0, got {num_blocks}")
+        if block_size <= 0:
+            raise ValueError(f"block_size must be > 0, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # LIFO: recently-freed blocks are re-issued first (their pages
+        # are the likeliest to still be VMEM/cache warm on re-prefill)
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of the pool currently allocated (0.0 .. 1.0)."""
+        return self.used_blocks / self.num_blocks
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` cache positions."""
+        return -(-int(n_tokens) // self.block_size)
+
+    def alloc(self, n: int = 1) -> List[int]:
+        """Hand out ``n`` physical block ids, or raise — atomically:
+        either all ``n`` are granted or none are taken."""
+        if n <= 0:
+            return []
+        if n > len(self._free):
+            raise PoolExhaustedError(
+                f"KV block pool exhausted: requested {n} block(s) but "
+                f"only {len(self._free)} of {self.num_blocks} are free "
+                f"({self.used_blocks} in use, block_size="
+                f"{self.block_size}). Finish or preempt a stream, or "
+                f"size the pool for the working set.")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        """Return block ids to the pool (double-free is a hard error —
+        including a duplicate id WITHIN one call, which would put the
+        same physical block on the free list twice and hand it to two
+        streams)."""
+        free_set = set(self._free)
+        for b in blocks:
+            if not 0 <= b < self.num_blocks:
+                raise ValueError(
+                    f"free(): block id {b} is outside the pool "
+                    f"[0, {self.num_blocks})")
+            if b in free_set:
+                raise ValueError(
+                    f"free(): block id {b} is already free — double "
+                    f"free corrupts the allocator")
+            free_set.add(b)
+        self._free.extend(blocks)

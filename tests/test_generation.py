@@ -389,6 +389,91 @@ class TestPagedDecode:
         np.testing.assert_array_equal(pr, dr)
 
 
+class TestPagedBlockBoundaries:
+    """ISSUE 14 satellite: paged == dense exactly at block-boundary
+    prompt lengths (the off-by-one surface: a prompt that underfills,
+    exactly fills, or just overflows its first block), for aligned AND
+    ragged batches, plus the loud-failure contracts (pool exhaustion,
+    unsupported combos)."""
+
+    BLOCK = 4
+
+    @pytest.mark.parametrize("t0", [BLOCK - 1, BLOCK, BLOCK + 1])
+    def test_boundary_prompt_lengths_match_dense(self, t0):
+        model = _model()
+        ids = np.random.RandomState(20 + t0).randint(
+            1, 97, (2, t0)).astype("int64")
+        dense = model.generate(paddle.to_tensor(ids),
+                               max_new_tokens=6).numpy()
+        paged = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                               paged=True, block_size=self.BLOCK).numpy()
+        np.testing.assert_array_equal(paged, dense)
+
+    def test_boundary_ragged_batches_match_dense(self):
+        """Left-padded rows whose REAL lengths straddle the block
+        boundary: one batch carrying block-1, block and block+1 real
+        tokens (every boundary case in a single compile)."""
+        model = _model()
+        pad = 0
+        t0 = self.BLOCK + 1
+        rng = np.random.RandomState(30)
+        rows = []
+        for ln in range(self.BLOCK - 1, t0 + 1):
+            real = rng.randint(1, 97, (ln,)).astype("int64")
+            rows.append(np.concatenate(
+                [np.full(t0 - ln, pad, "int64"), real]))
+        batch = np.stack(rows)
+        dense = model.generate(paddle.to_tensor(batch), max_new_tokens=5,
+                               pad_token_id=pad).numpy()
+        paged = model.generate(paddle.to_tensor(batch), max_new_tokens=5,
+                               pad_token_id=pad, paged=True,
+                               block_size=self.BLOCK).numpy()
+        np.testing.assert_array_equal(paged, dense)
+
+    def test_pool_exhaustion_raises_clear_error(self):
+        """Regression (ISSUE 14 satellite): a pool too small for the
+        batch's KV working set must fail LOUDLY naming required vs
+        available blocks — the silent alternative was a clamped block
+        table gathering another row's cache."""
+        model = _model()
+        ids = np.random.RandomState(40).randint(
+            1, 97, (2, 6)).astype("int64")
+        # needs ceil((6+5)/4)=3 blocks x 2 rows = 6
+        with pytest.raises(ValueError, match="exhausted") as ei:
+            model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                           paged=True, block_size=4, num_blocks=5)
+        assert "6 blocks" in str(ei.value)
+        assert "num_blocks=5" in str(ei.value)
+        # an exactly-sized pool decodes identically to dense
+        dense = model.generate(paddle.to_tensor(ids),
+                               max_new_tokens=5).numpy()
+        got = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                             paged=True, block_size=4,
+                             num_blocks=6).numpy()
+        np.testing.assert_array_equal(got, dense)
+
+    def test_unsupported_combos_rejected_loudly(self):
+        model = _model()
+        ids = paddle.to_tensor(np.random.RandomState(41).randint(
+            1, 97, (1, 5)).astype("int64"))
+        # paged + beam search: dense-only (clear error, not silence)
+        with pytest.raises(NotImplementedError, match="dense"):
+            model.generate(ids, max_new_tokens=4, paged=True,
+                           num_beams=2)
+        # num_blocks without paged: refusing to silently ignore it —
+        # including on the beam-search branch (the check must fire
+        # BEFORE the num_beams early return)
+        with pytest.raises(ValueError, match="paged=True"):
+            model.generate(ids, max_new_tokens=4, num_blocks=8)
+        with pytest.raises(ValueError, match="paged=True"):
+            model.generate(ids, max_new_tokens=4, num_beams=2,
+                           num_blocks=8)
+        # paged + repetition_penalty/min_length: dense-only knobs
+        with pytest.raises(NotImplementedError, match="dense"):
+            model.generate(ids, max_new_tokens=4, paged=True,
+                           repetition_penalty=1.5)
+
+
 class TestGptRaggedPrompts:
     """The ragged path must also hold for learned-position models: the
     wpe row is the LOGICAL position (absolute minus pad run)."""

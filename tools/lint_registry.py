@@ -164,6 +164,26 @@ FLEET_REQUIRED_LABELS = {
     "fleet.slowest_rank": ("job",),
 }
 
+#: serving-engine label discipline (serve/engine.py): every series must
+#: say WHICH engine (multi-replica serving merges registries through the
+#: fleet plane, and an unattributable server metric is useless there);
+#: finish/reject/preempt/stall series must additionally carry the WHY.
+SERVE_REQUIRED_LABELS = {
+    "serve.requests_finished": ("engine", "reason"),
+    "serve.requests_rejected": ("engine", "reason"),
+    "serve.preemptions": ("engine", "reason"),
+    "serve.admission_stalls": ("engine", "reason"),
+    "serve.requests_admitted": ("engine",),
+    "serve.tokens_generated": ("engine",),
+    "serve.decode_steps": ("engine",),
+    "serve.decode_traces": ("engine",),
+    "serve.prefill_traces": ("engine",),
+    "serve.ttft_seconds": ("engine",),
+    "serve.request_seconds": ("engine",),
+    "serve.decode_step_seconds": ("engine",),
+    "serve.prefill_seconds": ("engine",),
+}
+
 #: one audit loop serves every per-subsystem required-labels table —
 #: add the next subsystem as a row here, not as another copied loop
 REQUIRED_LABEL_TABLES = (
@@ -173,7 +193,18 @@ REQUIRED_LABEL_TABLES = (
                           "rewrite pass"),
     (FLEET_REQUIRED_LABELS, "fleet series must attribute the rank (or "
                             "the reason/job)"),
+    (SERVE_REQUIRED_LABELS, "serve series must attribute the engine "
+                            "(and the reason where one applies)"),
 )
+
+#: gauge-prefix discipline: no gauge under these prefixes may record an
+#: UNLABELED series — a fleet gauge without rank/job, or a serve gauge
+#: without engine=, cannot be attributed once registries merge.
+NO_UNLABELED_GAUGE_PREFIXES = {
+    "fleet.": "every fleet gauge must carry at least a rank= or job= "
+              "label",
+    "serve.": "every serve gauge must carry at least an engine= label",
+}
 
 
 def check_metric_registry() -> List[str]:
@@ -187,6 +218,7 @@ def check_metric_registry() -> List[str]:
     import paddle_tpu.io.dataloader  # noqa: F401
     import paddle_tpu.observability.fleet  # noqa: F401
     import paddle_tpu.observability.runtime  # noqa: F401
+    import paddle_tpu.serve  # noqa: F401
     from paddle_tpu.observability.metrics import (CLAIMED_SUBSYSTEMS,
                                                   NAME_RE)
 
@@ -237,13 +269,15 @@ def check_metric_registry() -> List[str]:
                     problems.append(
                         f"metric {m.name!r}: series {labels!r} is missing "
                         f"required label(s) {missing} — {why}")
-        if m.name.startswith("fleet.") and m.kind == "gauge":
-            for labels in m.labelsets():
-                if not labels:
-                    problems.append(
-                        f"metric {m.name!r}: recorded an UNLABELED gauge "
-                        f"series — every fleet gauge must carry at least "
-                        f"a rank= or job= label")
+        if m.kind == "gauge":
+            for prefix, why in NO_UNLABELED_GAUGE_PREFIXES.items():
+                if not m.name.startswith(prefix):
+                    continue
+                for labels in m.labelsets():
+                    if not labels:
+                        problems.append(
+                            f"metric {m.name!r}: recorded an UNLABELED "
+                            f"gauge series — {why}")
     return problems
 
 

@@ -1,25 +1,56 @@
-"""Probe: jax's TPU Pallas paged_attention kernel vs this repo's jnp
+"""Probe: paged-attention decode kernels vs this repo's jnp
 block-gather decode attention — equivalence + carry-chained speed at
-645M serving shapes. Decides whether the serving decode step can ride
-the kernel (tools/paged_decode_probe.py measured the jnp gather
-program at ~10x the dense scan).
+645M serving shapes. Three contenders over the same pool:
 
-MEASURED (v5e, 2026-07-31, B=8/NH=16/DH=128, 256-slot pool): kernel
-matches the masked-softmax reference (max err 1e-3, bf16 scale) and
-runs 1350 us/step vs 2155 for the jnp gather — 1.6x faster, but still
-~6x the dense scan's ENTIRE per-layer decode budget (~200 us incl.
-matmuls) at this context length. Conclusion: at 645M/short-context
-serving shapes, paged attention (even the official Pallas kernel) is
-overhead-bound; the paged path's value is cache MEMORY semantics
-(pad-free pooling, no per-sequence S_max allocation), and the dense
-single-jit scan remains the throughput path the decode bench measures.
+1. jax's official TPU Pallas ``paged_attention`` (generic long-context
+   kernel: per-compute-block async-copy pipeline);
+2. the jnp gather reference (what ``block_mha_p`` decode does);
+3. THIS repo's decode-specialized kernel
+   (``paddle_tpu/ops/pallas/paged_attention.py``): grid
+   ``(batch, pages)``, whole page per program for all heads, block
+   tables/lengths in SMEM via scalar prefetch, online-softmax scratch
+   in VMEM, fused length masking — the short-context overhead the
+   official kernel pays is exactly what it strips.
+
+MEASURED (v5e, 2026-07-31, B=8/NH=16/DH=128, 256-slot pool; official
+kernel vs gather): official kernel matches the masked-softmax
+reference (max err 1e-3, bf16 scale) and runs 1350 us/step vs 2155
+for the jnp gather — 1.6x faster, but still ~6x the dense scan's
+ENTIRE per-layer decode budget (~200 us incl. matmuls) at this
+context length, because its multi-compute-block pipeline is
+overhead-bound at 2 pages/seq.
+
+MEASURED (CPU interpret, 2026-08-04, decode-specialized kernel): the
+new kernel is numerically equivalent to the masked-softmax reference
+(max abs err < 2e-3 at bf16 scale, bit-level vs the fp32 reference in
+f32 — pinned by tests/test_paged_attention_kernel.py, which is this
+probe's equivalence check promoted to pytest). TPU wall-clock: rerun
+this probe on a v5e to refresh the numbers; the decode kernel issues
+one fused pass per (sequence, page) with zero gathered K/V
+materialization, eliminating both the gather's HBM round-trip (path 2)
+and the per-compute-block pipeline overhead (path 1) that dominate at
+short context.
+
+Equivalence runs on every backend (CPU uses interpret mode for the
+decode kernel and skips the official kernel, which has no interpret
+path); timing loops run on TPU only.
 """
+import os
+import sys
 import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from paddle_tpu.ops.pallas.paged_attention import (  # noqa: E402
+    paged_attention_decode_kernel, paged_attention_decode_reference)
+
+ON_TPU = jax.default_backend() == "tpu"
 
 B, NH, KVH, DH = 8, 16, 16, 128
 PAGE = 128
@@ -38,33 +69,51 @@ page_indices = jnp.asarray(
     np.arange(NPAGES, dtype=np.int32).reshape(B, PAGES_PER_SEQ))
 
 
-def kernel(q, kp, vp, lens, idx):
+def official_kernel(q, kp, vp, lens, idx):
+    from jax.experimental.pallas.ops.tpu.paged_attention import \
+        paged_attention
+
     return paged_attention(q, kp, vp, lens, idx,
                            pages_per_compute_block=PAGES_PER_SEQ)
 
 
+def decode_kernel(q, kp, vp, lens, idx):
+    return paged_attention_decode_kernel(q, kp, vp, lens, idx,
+                                         interpret=not ON_TPU)
+
+
 def reference(q, kp, vp, lens, idx):
-    # gather each row's pages -> [B, S_pad, KVH, DH], masked softmax
-    s_pad = PAGES_PER_SEQ * PAGE
-    k_rows = kp[:, idx].transpose(1, 2, 3, 0, 4).reshape(
-        B, s_pad, KVH, DH)
-    v_rows = vp[:, idx].transpose(1, 2, 3, 0, 4).reshape(
-        B, s_pad, KVH, DH)
-    scores = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
-                        k_rows.astype(jnp.float32))
-    valid = jnp.arange(s_pad)[None, :] < lens[:, None]
-    scores = jnp.where(valid[:, None, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhs,bshd->bhd", probs,
-                      v_rows.astype(jnp.float32)).astype(q.dtype)
+    # the masked-softmax oracle == block_mha_p's decode gather
+    return paged_attention_decode_reference(q, kp, vp, lens, idx)
 
 
-out_k = jax.jit(kernel)(q, k_pages, v_pages, lengths, page_indices)
+def reference_unscaled(q, kp, vp, lens, idx):
+    # jax's official paged_attention applies NO sm scale (the caller
+    # pre-scales q) — compare it against the same unscaled softmax
+    return paged_attention_decode_reference(q, kp, vp, lens, idx,
+                                            sm_scale=1.0)
+
+
+def _err(a, b):
+    return np.max(np.abs(np.asarray(a, np.float32)
+                         - np.asarray(b, np.float32)))
+
+
 out_r = jax.jit(reference)(q, k_pages, v_pages, lengths, page_indices)
-err = np.max(np.abs(np.asarray(out_k, np.float32)
-                    - np.asarray(out_r, np.float32)))
-print(f"kernel-vs-reference max abs err: {err:.4f} (bf16 scale)")
-assert err < 0.05, "kernel output diverges from masked-softmax reference"
+out_d = jax.jit(decode_kernel)(q, k_pages, v_pages, lengths, page_indices)
+err_d = _err(out_d, out_r)
+print(f"decode-kernel-vs-reference max abs err: {err_d:.4f} (bf16 scale)")
+assert err_d < 0.05, \
+    "decode kernel output diverges from masked-softmax reference"
+if ON_TPU:
+    out_k = jax.jit(official_kernel)(q, k_pages, v_pages, lengths,
+                                     page_indices)
+    out_ru = jax.jit(reference_unscaled)(q, k_pages, v_pages, lengths,
+                                         page_indices)
+    err_k = _err(out_k, out_ru)
+    print(f"official-kernel-vs-reference max abs err: {err_k:.4f}")
+    assert err_k < 0.05, \
+        "official kernel output diverges from masked-softmax reference"
 
 
 def bench(fn):
@@ -84,7 +133,13 @@ def bench(fn):
     return (time.perf_counter() - t0) / STEPS
 
 
-t_k = bench(kernel)
-t_r = bench(reference)
-print(f"pallas paged_attention: {t_k*1e6:.0f} us/step")
-print(f"jnp gather reference:   {t_r*1e6:.0f} us/step")
+if ON_TPU:
+    t_k = bench(official_kernel)
+    t_r = bench(reference)
+    t_d = bench(decode_kernel)
+    print(f"official pallas paged_attention: {t_k*1e6:.0f} us/step")
+    print(f"jnp gather reference:            {t_r*1e6:.0f} us/step")
+    print(f"decode-specialized kernel:       {t_d*1e6:.0f} us/step")
+else:
+    print("no TPU attached: equivalence verified (interpret mode); "
+          "timing loops skipped")

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Synthetic heavy-traffic load test against a local ServeEngine.
+
+Builds a Llama model, stands up a continuous-batching
+``paddle_tpu.serve.ServeEngine`` and drives it with Poisson arrivals of
+mixed prompt/output lengths (``paddle_tpu/serve/load.py``), then prints
+one JSON line with exact sample-based p50/p99 TTFT (queue wait
+included), aggregate tokens/sec, preemption and step counts.
+
+Run::
+
+    python tools/serve_load.py --rate 300 --requests 32
+    python tools/serve_load.py --metrics    # + observability roll-up
+                                            # (same keys as bench.py)
+
+``bench.py --config serve --metrics`` produces the canonical BENCH
+record with the same generator; this CLI is the knob-turning surface
+(rate sweeps, pool-pressure experiments via --num_blocks, sampled
+streams via --temperature).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Poisson load test against a local ServeEngine")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="mean arrival rate, requests/sec "
+                         "(default: 300 CPU / 30 TPU)")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="number of requests (default: 16 CPU / 48 TPU)")
+    ap.add_argument("--slots", type=int, default=None,
+                    help="decode slots (continuous-batching width)")
+    ap.add_argument("--num_blocks", type=int, default=None,
+                    help="KV pool size in blocks (small values force "
+                         "queueing + preemption)")
+    ap.add_argument("--block_size", type=int, default=None)
+    ap.add_argument("--max_seq_len", type=int, default=None)
+    ap.add_argument("--prompt_len", type=int, nargs=2, default=None,
+                    metavar=("LO", "HI"))
+    ap.add_argument("--max_new", type=int, nargs=2, default=None,
+                    metavar=("LO", "HI"))
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="0 = greedy; >0 samples every stream")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--metrics", action="store_true",
+                    help="enable observability and print the serve_* "
+                         "roll-up keys (bench.py --metrics parity)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.serve import ServeEngine, run_load
+    from paddle_tpu.serve.load import default_serving_setup, warm_engine
+
+    if args.metrics:
+        import paddle_tpu.observability as obs
+
+        obs.enable()
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    paddle.seed(0)
+    # defaults shared with bench.py --config serve (ONE serving shape)
+    config, defaults = default_serving_setup(on_tpu)
+
+    def pick(cli_value, key):
+        # explicit `is None` check: `--rate 0` must reach the engine
+        # (and fail its own validation) rather than silently running
+        # the default load
+        return defaults[key] if cli_value is None else cli_value
+
+    rate = pick(args.rate, "rate")
+    n_req = pick(args.requests, "requests")
+    slots = pick(args.slots, "slots")
+    num_blocks = pick(args.num_blocks, "num_blocks")
+    block_size = pick(args.block_size, "block_size")
+    max_seq_len = pick(args.max_seq_len, "max_seq_len")
+    plen = tuple(pick(args.prompt_len, "prompt_len"))
+    mnew = tuple(pick(args.max_new, "max_new"))
+    if rate <= 0:
+        ap.error(f"--rate must be > 0 requests/sec, got {rate}")
+
+    model = LlamaForCausalLM(config)
+    if on_tpu:
+        model.bfloat16()
+    model.eval()
+    engine = ServeEngine(model, max_slots=slots, block_size=block_size,
+                         num_blocks=num_blocks, max_seq_len=max_seq_len,
+                         name="serve_load")
+    warm_engine(engine)     # decode step + every prefill bucket
+
+    res = run_load(engine, rate=rate, n_requests=n_req, prompt_len=plen,
+                   max_new=mnew, temperature=args.temperature,
+                   seed=args.seed)
+    record = {"load": res.to_dict()}
+    record["load"].update(
+        rate_rps=rate, slots=slots, num_blocks=num_blocks,
+        block_size=block_size, decode_traces=engine.decode_traces,
+        prefill_traces=engine.prefill_traces,
+        pool_blocks_leaked=engine.pool.used_blocks)
+    print(json.dumps(record), flush=True)
+    if args.metrics:
+        from bench import _emit_metrics_block
+
+        _emit_metrics_block()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
