@@ -165,6 +165,14 @@ def _emit_metrics_block():
         "opt_ops_removed": tot("opt.ops_removed"),
         "opt_fixedpoint_iterations": gauge_max("opt.fixedpoint_iterations"),
         "opt_rewrite_seconds": round(hist_sum("opt.rewrite_seconds"), 3),
+        "opt_passes_skipped": tot("opt.passes_skipped"),
+        # static cost-model roll-ups (static/analysis/cost.py+memory.py;
+        # populated by the llama optimize exercise under --metrics)
+        "cost_predicted_flops": gauge_max("cost.predicted_flops"),
+        "cost_model_flops_error_pct":
+            gauge_max("cost.model_flops_error_pct"),
+        "cost_predicted_peak_hbm_bytes":
+            gauge_max("cost.predicted_peak_hbm_bytes"),
         # serving-engine roll-ups (paddle_tpu/serve; populated by the
         # `serve` config / tools/serve_load.py load runs)
         "serve_ttft_p50": hist_quantile("serve.ttft_seconds", 0.50),
@@ -354,6 +362,67 @@ def bench_optimize(on_tpu):
         "export_feeds_pruned": res_export.pruned_feeds,
         "fixedpoint_iterations": max(res_train.iterations,
                                      res_export.iterations),
+        # benefit-ordered scheduling: skips across both views. The
+        # clean train view records ZERO (a fully-quiescent sweep is
+        # not a scheduling decision); the export view's working
+        # iterations run only the passes with findings and skip the
+        # rest — that is where the nonzero count comes from.
+        "passes_skipped": res_train.total_skipped
+                          + res_export.total_skipped,
+    }}), flush=True)
+    bench_cost_model()
+
+
+def bench_cost_model():
+    """Validate the static cost model against ground truth on the bench
+    llama train program and print one JSON line (the ``cost.`` gauges
+    land in the --metrics roll-up):
+
+    - FLOPs: analytical ``program_cost`` vs XLA's compiled cost
+      analysis of the SAME replay (``measure_program_flops``) —
+      ``check_cost_model`` files PTL302 past 10%;
+    - peak HBM: liveness estimate vs the ``device.hbm_watermark_bytes``
+      delta bracketing the FIRST run of a fresh capture (earlier
+      in-process allocations are subtracted out via the pre-run
+      in-use baseline; on TPU the allocator watermark can still carry
+      an earlier config's peak, making the measured side an upper
+      bound there — the tight assertion lives in
+      tests/test_cost_analysis.py)."""
+    import paddle_tpu.observability as obs
+    import paddle_tpu.static as static
+    from paddle_tpu.static.analysis import (check_cost_model,
+                                            estimate_peak_memory,
+                                            measure_program_flops,
+                                            program_cost)
+    from paddle_tpu.static.analysis.cost import (M_MEASURED_PEAK,
+                                                 M_PREDICTED_PEAK)
+
+    before = obs.sample_device_memory()["bytes_in_use"]
+    prog, feed, fetch = capture_llama_train_program()
+    pc = program_cost(prog, fetch)
+    measured_flops = measure_program_flops(prog, feed, fetch)
+    drift = check_cost_model(pc.flops, measured_flops,
+                             tolerance_pct=10, name="llama")
+
+    est = estimate_peak_memory(prog, fetch)
+    outs = static.Executor().run(prog, feed=feed, fetch_list=fetch,
+                                 return_numpy=False)
+    after = obs.sample_device_memory()
+    measured_peak = max(after["watermark_bytes"] - before, 0)
+    del outs
+    if obs.enabled():
+        M_PREDICTED_PEAK.set(int(est.peak_bytes), name="llama")
+        M_MEASURED_PEAK.set(int(measured_peak), name="llama")
+    err = (abs(pc.flops - measured_flops) / measured_flops * 100
+           if measured_flops else None)
+    print(json.dumps({"cost_model": {
+        "predicted_flops": pc.flops,
+        "measured_flops": measured_flops,
+        "flops_error_pct": round(err, 2) if err is not None else None,
+        "flops_drift_ptl302": len(drift),
+        "predicted_peak_hbm_bytes": int(est.peak_bytes),
+        "measured_peak_hbm_bytes": int(measured_peak),
+        "peak_op_index": est.peak_op_index,
     }}), flush=True)
 
 

@@ -52,13 +52,25 @@ on all five BASELINE model families (tests/test_completion.py).
 """
 from __future__ import annotations
 
+import os
 import warnings
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .placement import Placement, ProcessMesh, Replicate, Shard
 from .spmd_rules import DistTensorSpec, get_spmd_rule
 
-__all__ = ["complete_placements", "derive_shard_plan"]
+__all__ = ["complete_placements", "derive_shard_plan",
+           "apply_replacement_suggestions", "REPLACEMENT_ENV_FLAG"]
+
+#: env switch: feed PTL202 placement findings back into completion as
+#: re-placement seeds (the lint->plan loop — findings become plan
+#: adjustments instead of dying as warnings).
+REPLACEMENT_ENV_FLAG = "PADDLE_TPU_REPLACEMENT"
+
+
+def _replacement_enabled() -> bool:
+    env = os.environ.get(REPLACEMENT_ENV_FLAG)
+    return env is not None and env.lower() not in ("0", "", "false", "off")
 
 
 # ops whose weight operand (2nd input, const) does x @ W with W [in, out]
@@ -399,12 +411,79 @@ def _map_through(spec, out_shape, mesh) -> List[Placement]:
 def complete_placements(prog, mesh: ProcessMesh,
                         seeds: Dict[int, DistTensorSpec],
                         env: Optional[Dict[int, object]] = None,
+                        replacement: Optional[bool] = None,
                         ) -> Dict[int, DistTensorSpec]:
     """Forward-propagate the SPMD rules over the captured program from
     ``seeds`` (vid -> spec); returns the completed vid -> spec table.
     Seeded specs are never overridden (user annotations win, like the
-    reference's completion)."""
+    reference's completion).
+
+    ``replacement`` (default: the ``PADDLE_TPU_REPLACEMENT`` env flag)
+    closes the placement-lint loop: the completed plan is linted with
+    ``run_placement_lints`` (PTL202), each finding's machine-readable
+    ``suggestion`` payload is applied as a re-placement seed, and the
+    program re-completes — kept only when the re-lint confirms FEWER
+    forced collectives (see :func:`apply_replacement_suggestions`)."""
     env = env or _shape_env(prog)
+    specs = _complete_once(prog, mesh, seeds, env)
+    if _replacement_enabled() if replacement is None else replacement:
+        specs = apply_replacement_suggestions(prog, mesh, seeds, env,
+                                              specs)
+    return specs
+
+
+def apply_replacement_suggestions(prog, mesh: ProcessMesh,
+                                  seeds: Dict[int, DistTensorSpec],
+                                  env: Dict[int, object],
+                                  specs: Dict[int, DistTensorSpec],
+                                  max_rounds: int = 4,
+                                  ) -> Dict[int, DistTensorSpec]:
+    """Feed PTL202 findings back into completion as re-placement seeds.
+
+    Each round: lint the completed plan, apply every finding's
+    ``suggestion`` payload (built by ``static/analysis/sharding_lint``,
+    applied through the SHARED ``apply_placement_suggestion`` helper)
+    as a seed override, re-complete, re-lint — and KEEP the new plan
+    only when the finding count strictly drops (re-placement is a perf
+    adjustment; a suggestion that does not reduce forced collectives is
+    discarded, so the hook can never make a plan worse by its own
+    measure). Placements stay a cost choice, never a correctness one —
+    GSPMD executes any plan bit-identically, which the dense-oracle
+    test pins."""
+    from ...static.analysis.sharding_lint import (
+        apply_placement_suggestion, run_placement_lints)
+
+    seeds = dict(seeds)
+    report = run_placement_lints(prog, placements=specs)
+    for _round in range(max_rounds):
+        suggestions = [d.suggestion for d in report.by_code("PTL202")
+                       if d.suggestion]
+        if not suggestions:
+            break
+        applied = 0
+        for s in suggestions:
+            vid = s.get("vid")
+            base = seeds.get(vid, specs.get(vid))
+            if vid is None or base is None:
+                continue
+            new_spec = apply_placement_suggestion(base, s)
+            if new_spec.placements != list(base.placements):
+                seeds[vid] = new_spec
+                applied += 1
+        if not applied:
+            break
+        new_specs = _complete_once(prog, mesh, seeds, env)
+        new_report = run_placement_lints(prog, placements=new_specs)
+        if len(new_report) >= len(report):
+            break  # no measured benefit: keep the original plan
+        specs, report = new_specs, new_report
+    return specs
+
+
+def _complete_once(prog, mesh: ProcessMesh,
+                   seeds: Dict[int, DistTensorSpec],
+                   env: Dict[int, object],
+                   ) -> Dict[int, DistTensorSpec]:
     specs: Dict[int, DistTensorSpec] = dict(seeds)
     # conservative-fallback warnings are scoped to THIS derivation: a
     # later plan for a different model hitting the same unmapped prim

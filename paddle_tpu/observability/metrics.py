@@ -45,7 +45,11 @@ CLAIMED_SUBSYSTEMS = {
                    # shipping/aggregation, step skew, stragglers
     "opt",         # static/analysis/rewrite.py — lint->rewrite driver:
                    # findings fixed/remaining by code, per-pass rewrite
-                   # seconds, fixed-point iterations
+                   # seconds, fixed-point iterations, passes skipped
+    "cost",        # static/analysis/cost.py + memory.py — analytical
+                   # FLOPs/bytes model and liveness peak-HBM estimator:
+                   # predicted-vs-measured gauges, model error, OOM
+                   # predictions
     "serve",       # serve/engine.py — continuous-batching server: queue
                    # depth, TTFT, tokens/sec, preemptions, pool
                    # occupancy, batch fill, decode/prefill traces

@@ -165,6 +165,10 @@ def render_report(d: Dict[str, Any], max_events: int = 20,
             opt_lines = render_opt_table(metrics)
             if opt_lines:
                 lines += opt_lines + [""]
+        if sub == "cost":
+            cost_lines = render_cost_table(metrics)
+            if cost_lines:
+                lines += cost_lines + [""]
         if g["counter"]:
             lines += ["Counters", "-" * (_WIDTH + 14)]
             lines += [f"{n[:_WIDTH]:<{_WIDTH}}{v:>14}"
@@ -225,6 +229,45 @@ def render_opt_table(metrics: Dict[str, Any]) -> List[str]:
         rem = remaining.get(code)
         lines.append(f"{code:<10}{fixed.get(code, 0):>10}"
                      f"{'-' if rem is None else rem:>12}")
+    return lines
+
+
+def render_cost_table(metrics: Dict[str, Any]) -> List[str]:
+    """Predicted-vs-measured FLOPs/HBM table for the static cost model
+    (``cost.predicted_*`` vs ``cost.measured_*`` gauges, by program
+    name), rendered inside the ``cost`` subsystem section next to the
+    ``opt`` per-code view — the at-a-glance answer to "is the cost
+    model still telling the truth" (PTL302 fires when it is not)."""
+    def by_name(metric_name):
+        out = {}
+        for s in (metrics.get(metric_name) or {}).get("series", []):
+            name = (s.get("labels") or {}).get("name")
+            if name is not None:
+                out[name] = s.get("value")
+        return out
+
+    pred_f = by_name("cost.predicted_flops")
+    meas_f = by_name("cost.measured_flops")
+    err = by_name("cost.model_flops_error_pct")
+    pred_m = by_name("cost.predicted_peak_hbm_bytes")
+    meas_m = by_name("cost.measured_peak_hbm_bytes")
+    names = sorted(set(pred_f) | set(pred_m))
+    if not names:
+        return []
+
+    def fmt(v, f=_fmt_raw):
+        return "-" if v is None else f(v)
+
+    header = (f"{'program':<16}{'pred flops':>14}{'xla flops':>14}"
+              f"{'err%':>8}{'pred peak':>12}{'measured':>12}")
+    lines = ["cost model, predicted vs measured", header,
+             "-" * len(header)]
+    for n in names:
+        lines.append(
+            f"{n[:16]:<16}{fmt(pred_f.get(n)):>14}"
+            f"{fmt(meas_f.get(n)):>14}{fmt(err.get(n)):>8}"
+            f"{fmt(pred_m.get(n), _fmt_bytes):>12}"
+            f"{fmt(meas_m.get(n), _fmt_bytes):>12}")
     return lines
 
 

@@ -35,10 +35,18 @@ the primitive registry itself.
 """
 from __future__ import annotations
 
+from .cost import (  # noqa: F401
+    COST_ANALYSIS_CODES, OpCost, ProgramCost, check_cost_model,
+    measure_program_flops, op_cost, program_cost, register_op_cost,
+)
 from .diagnostics import (  # noqa: F401
     CODES, Diagnostic, DiagnosticReport, ProgramVerificationError, Severity,
 )
 from .ir_dump import dump_program  # noqa: F401
+from .memory import (  # noqa: F401
+    MemoryEstimate, device_memory_budget, estimate_peak_memory,
+    lint_memory_budget,
+)
 from .lint import (  # noqa: F401
     LintContext, lossless_cast, register_lint, run_lints,
 )
@@ -47,7 +55,8 @@ from .rewrite import (  # noqa: F401
     DEFAULT_PIPELINE, OptimizeResult, REWRITE_CODES, optimize_program,
 )
 from .sharding_lint import (  # noqa: F401
-    SHARDING_LINT_CODES, lint_fleet_trace, run_placement_lints,
+    SHARDING_LINT_CODES, apply_placement_suggestion, lint_fleet_trace,
+    run_placement_lints,
 )
 from .verify import (  # noqa: F401
     check_program, propagate_avals, recorded_avals, verify_program,
@@ -61,4 +70,9 @@ __all__ = [
     "DEFAULT_PIPELINE", "OptimizeResult", "REWRITE_CODES",
     "optimize_program",
     "SHARDING_LINT_CODES", "lint_fleet_trace", "run_placement_lints",
+    "apply_placement_suggestion",
+    "COST_ANALYSIS_CODES", "OpCost", "ProgramCost", "check_cost_model",
+    "measure_program_flops", "op_cost", "program_cost", "register_op_cost",
+    "MemoryEstimate", "device_memory_budget", "estimate_peak_memory",
+    "lint_memory_budget",
 ]

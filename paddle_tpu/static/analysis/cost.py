@@ -1,0 +1,492 @@
+"""Analytical per-op cost model over the captured static ``Program``.
+
+Reference: the reference stacks a cost model on its IR for pass
+scheduling and placement (auto_parallel/static/cost/ — ``CommOpCost``/
+``CompOpCost`` per op, ``CostEstimator`` walking the program); here the
+same split of labor lands on the flat instruction list: a per-prim
+FLOPs/bytes registry (:func:`op_cost`, keyed by operand/result avals
+from ``verify.propagate_avals``) and a program walker
+(:func:`program_cost`) that restricts to the ops live w.r.t. the fetch
+set — dead ops cost nothing because XLA DCEs them before they execute.
+
+Two ground truths keep the model honest, both already measured by the
+repo:
+
+- FLOPs: ``observability.runtime.measure_step_flops`` (XLA's compiled
+  cost analysis — the post-fusion count the hardware executes).
+  :func:`check_cost_model` compares and files **PTL302** when the
+  analytical estimate drifts beyond tolerance — the cost-model-rot
+  alarm.
+- Peak HBM: the PR 5 ``device.hbm_watermark_bytes`` gauge, against the
+  liveness-interval estimator in ``memory.py`` (which files PTL301).
+
+The ``__gradients__`` pseudo-op is modeled as ``3x`` the FLOPs of the
+forward sub-replay live w.r.t. the loss: the Executor replays the
+gradient section as ``jax.grad`` of a fresh forward trace (one more
+forward) plus the backward (~2x forward — each matmul's VJP is two
+matmuls of equal cost). Measured on the bench llama train program the
+whole-program count then lands within a few percent of XLA's
+(fwd + 3x fwd = 4.0x; XLA reports 4.03x).
+
+Everything here is static — no compile, no device. The one consumer
+that pays a compile is :func:`measure_program_flops`, the validation
+helper that runs XLA's cost analysis on a compiled replay of the same
+program so predicted and measured count the SAME executable.
+
+Metrics ride the claimed ``cost.`` subsystem
+(``observability.metrics.CLAIMED_SUBSYSTEMS``): predicted/measured
+FLOPs and peak-HBM gauges (by program ``name``), the model-error
+gauge PTL302 reads, and the estimate wall-time histogram.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from ... import observability as _obs
+from .diagnostics import DiagnosticReport, Severity
+from .liveness import live_op_indices
+from .verify import GRAD_OP, propagate_avals
+
+__all__ = [
+    "OpCost", "ProgramCost", "op_cost", "register_op_cost",
+    "program_cost", "measure_program_flops", "check_cost_model",
+    "executed_op_indices", "COST_ANALYSIS_CODES",
+]
+
+#: the diagnostic codes the cost/memory analysis layer can file —
+#: audited by tools/lint_registry.py the same way lint.LINTS and the
+#: sharding-lint codes are (documented in diagnostics.CODES, exercised
+#: by at least one test).
+COST_ANALYSIS_CODES = ("PTL301", "PTL302", "PTL303")
+
+M_PREDICTED_FLOPS = _obs.gauge(
+    "cost.predicted_flops",
+    "analytical per-op cost-model FLOPs of a program replay, by program "
+    "name")
+M_MEASURED_FLOPS = _obs.gauge(
+    "cost.measured_flops",
+    "XLA compiled-cost-analysis FLOPs of the same program replay, by "
+    "program name (the ground truth cost.predicted_flops is validated "
+    "against)")
+M_FLOPS_ERROR = _obs.gauge(
+    "cost.model_flops_error_pct",
+    "percent error of the analytical FLOPs model vs XLA's compiled "
+    "cost analysis, by program name (PTL302 fires when it exceeds "
+    "tolerance)")
+M_PREDICTED_PEAK = _obs.gauge(
+    "cost.predicted_peak_hbm_bytes",
+    "liveness-interval peak-memory estimate of a program replay, by "
+    "program name (memory.estimate_peak_memory)")
+M_MEASURED_PEAK = _obs.gauge(
+    "cost.measured_peak_hbm_bytes",
+    "device.hbm_watermark_bytes observed when the predicted-vs-measured "
+    "comparison ran, by program name (copied next to the prediction so "
+    "one dump renders the whole table)")
+M_ESTIMATE_SECONDS = _obs.histogram(
+    "cost.estimate_seconds",
+    "wall time of one static cost/memory estimate, by analysis kind")
+M_PREDICTED_OOM = _obs.counter(
+    "cost.predicted_oom",
+    "PTL301 firings: programs whose peak-memory estimate exceeded the "
+    "device budget before compile, by program name")
+
+
+@dataclass(frozen=True)
+class OpCost:
+    """Cost of one instruction: arithmetic + memory traffic + footprint."""
+
+    flops: int = 0
+    bytes_read: int = 0
+    bytes_written: int = 0
+
+    @property
+    def bytes_total(self) -> int:
+        return self.bytes_read + self.bytes_written
+
+
+@dataclass
+class ProgramCost:
+    """Aggregate of one program replay (live ops only)."""
+
+    flops: int = 0
+    bytes_read: int = 0
+    bytes_written: int = 0
+    by_op: List[OpCost] = field(default_factory=list)
+    flops_by_prim: Dict[str, int] = field(default_factory=dict)
+    live_ops: int = 0
+    unknown_avals: int = 0
+
+    def render(self) -> str:
+        top = sorted(self.flops_by_prim.items(), key=lambda kv: -kv[1])[:8]
+        per = ", ".join(f"{k}={v:,}" for k, v in top)
+        return (f"program cost: {self.flops:,} flops over {self.live_ops} "
+                f"live op(s), {self.bytes_read:,}B read / "
+                f"{self.bytes_written:,}B written ({per})")
+
+
+Aval = Tuple[Tuple[int, ...], np.dtype]
+
+
+def _numel(aval: Optional[Aval]) -> int:
+    if aval is None:
+        return 0
+    return int(np.prod(aval[0])) if aval[0] else 1
+
+
+def _nbytes(aval: Optional[Aval]) -> int:
+    if aval is None:
+        return 0
+    return _numel(aval) * np.dtype(aval[1]).itemsize
+
+
+# ---------------------------------------------------------------------------
+# per-prim FLOPs registry
+# ---------------------------------------------------------------------------
+
+# fn(in_avals, out_avals, attrs) -> flops. Registered exactly, then
+# matched by family marker, then the elementwise default (one flop per
+# output element) — the same fallback ladder utils/flops.py uses for
+# the eager per-op registry.
+_FLOPS_FNS: Dict[str, Callable] = {}
+
+
+def register_op_cost(*prim_names: str):
+    """Register an exact-FLOPs function for one or more prims."""
+
+    def deco(fn):
+        for name in prim_names:
+            _FLOPS_FNS[name] = fn
+        return fn
+
+    return deco
+
+
+def _contracting_dim(shape: Tuple[int, ...], transposed: bool) -> int:
+    if len(shape) >= 2 and transposed:
+        return shape[-2]
+    return shape[-1] if shape else 1
+
+
+@register_op_cost("matmul", "matmul_p", "matmul_v2", "bmm",
+                  "linear_nobias_p")
+def _matmul_flops(in_avals, out_avals, attrs):
+    # 2 * (output elements) * K: exact for any batched/broadcast matmul
+    x = in_avals[0]
+    if x is None or out_avals[0] is None:
+        return 0
+    k = _contracting_dim(x[0], bool(attrs.get("transpose_x")
+                                    or attrs.get("trans_x")))
+    return 2 * _numel(out_avals[0]) * k
+
+
+@register_op_cost("linear_p")
+def _linear_flops(in_avals, out_avals, attrs):
+    # x @ W + b: the bias add is one flop per output element
+    return _matmul_flops(in_avals, out_avals, attrs) \
+        + _numel(out_avals[0])
+
+
+@register_op_cost("fused_linear_ce_p")
+def _fused_linear_ce_flops(in_avals, out_avals, attrs):
+    # hidden @ vocab-head GEMM + softmax-CE over the logits it never
+    # materializes: 2*rows*H*V for the GEMM, ~5 flops per logit for CE
+    x, w = (in_avals + [None, None])[:2]
+    if x is None or w is None:
+        return 0
+    rows = _numel(x) // max(x[0][-1], 1)
+    h = x[0][-1]
+    v = w[0][-1] if len(w[0]) >= 2 else 1
+    return 2 * rows * h * v + 5 * rows * v
+
+
+@register_op_cost("conv_p", "conv_transpose_p")
+def _conv_flops(in_avals, out_avals, attrs):
+    # 2 * out_elements * (C_in/groups) * prod(kernel): implicit GEMM
+    x, w = (in_avals + [None, None])[:2]
+    if x is None or w is None or out_avals[0] is None:
+        return 0
+    wshape = w[0]
+    if len(wshape) < 3:
+        return 2 * _numel(out_avals[0]) * _numel(w)
+    cin_g = wshape[1]
+    kernel = int(np.prod(wshape[2:]))
+    return 2 * _numel(out_avals[0]) * cin_g * kernel
+
+
+@register_op_cost("sdpa_p", "sdpa_mask_p")
+def _sdpa_flops(in_avals, out_avals, attrs):
+    # q [B,S,H,D] (capture layout): scores + context are 2x 2*B*H*S*Skv*D,
+    # softmax ~5 flops per score element
+    q, k = (in_avals + [None, None])[:2]
+    if q is None or k is None:
+        return 0
+    d = q[0][-1] if q[0] else 1
+    s_kv = k[0][1] if len(k[0]) >= 2 else 1
+    nq = _numel(q)
+    return 4 * nq * s_kv + 5 * (nq // max(d, 1)) * s_kv
+
+
+@register_op_cost("rms_norm_p", "layer_norm_p", "group_norm_p",
+                  "instance_norm_p", "batch_norm_train_p",
+                  "batch_norm_infer_p")
+def _norm_flops(in_avals, out_avals, attrs):
+    return 4 * _numel(in_avals[0] if in_avals else None)
+
+
+@register_op_cost("softmax_p", "log_softmax_p", "hard_ce_p", "soft_ce_p",
+                  "swiglu_p")
+def _softmaxish_flops(in_avals, out_avals, attrs):
+    # ~5 flops per input element (max/sub/exp/sum/div; swiglu is
+    # sigmoid+2 muls) — matches XLA's count within a few percent
+    return 5 * _numel(in_avals[0] if in_avals else None)
+
+
+@register_op_cost("fused_rope_p")
+def _rope_flops(in_avals, out_avals, attrs):
+    # rotate-half: 2 muls + 1 add per element, on q and k (first two
+    # operands); XLA counts 3.5/element with the sign flip folded in
+    n = sum(_numel(a) for a in in_avals[:2])
+    return (7 * n) // 2
+
+
+@register_op_cost("moe_idx_ffn_p")
+def _moe_flops(in_avals, out_avals, attrs):
+    # routed 2-GEMM FFN on the gathered tokens: 2 * tokens*topk * 2*H*I
+    x = in_avals[0] if in_avals else None
+    banks = [a for a in in_avals[1:] if a is not None and len(a[0]) >= 3]
+    if x is None or not banks:
+        return 0
+    h = x[0][-1]
+    rows = _numel(x) // max(h, 1)
+    inter = banks[0][0][-1]
+    top_k = int(attrs.get("top_k", attrs.get("k", 1)) or 1)
+    return 2 * rows * top_k * (2 * h * inter)
+
+
+@register_op_cost("embedding_p", "gather_p", "gather_nd_p",
+                  "take_along_axis_p", "one_hot_p")
+def _gather_flops(in_avals, out_avals, attrs):
+    return 0  # pure data movement; bytes carry the cost
+
+
+#: prims that move/re-view data without arithmetic — zero FLOPs, the
+#: bytes columns carry their cost.
+_MOVEMENT_PRIMS = frozenset({
+    "reshape_p", "transpose_p", "flatten_p", "squeeze_p", "unsqueeze_p",
+    "slice_p", "getitem_p", "setitem_p", "split_p", "stack_p", "tile_p",
+    "broadcast_to_p", "pad_p", "where_p", "tril", "triu",
+})
+_MOVEMENT_PREFIXES = ("concat_",)
+
+
+def op_cost(prim_name: str, in_avals: Iterable[Optional[Aval]],
+            out_avals: Iterable[Optional[Aval]],
+            attrs: Optional[dict] = None) -> OpCost:
+    """Analytical cost of one instruction from its operand/result avals.
+
+    FLOPs resolve through the registry, then the movement set (0), then
+    the elementwise default (one flop per output element — right for
+    add/mul/compare, and a rounding error for anything the registry
+    does not know, since unknown prims are by construction not the
+    compute-dominant ones). Bytes are exact: operand reads + result
+    writes at aval itemsize."""
+    in_avals = list(in_avals)
+    out_avals = list(out_avals)
+    attrs = attrs or {}
+    fn = _FLOPS_FNS.get(prim_name)
+    if fn is not None:
+        flops = int(fn(in_avals, out_avals, attrs))
+    elif prim_name in _MOVEMENT_PRIMS \
+            or prim_name.startswith(_MOVEMENT_PREFIXES):
+        flops = 0
+    elif prim_name.startswith("reduce_"):
+        flops = _numel(in_avals[0] if in_avals else None)
+    else:
+        flops = sum(_numel(a) for a in out_avals)
+    return OpCost(flops=flops,
+                  bytes_read=sum(_nbytes(a) for a in in_avals),
+                  bytes_written=sum(_nbytes(a) for a in out_avals))
+
+
+# backward FLOPs multiplier for the __gradients__ sub-replay: one more
+# forward (jax.grad re-traces the loss) + ~2x forward for the backward
+_GRAD_FLOPS_MULTIPLIER = 3
+
+
+def executed_op_indices(insts, fetch_vids) -> set:
+    """Ops XLA actually EXECUTES for this fetch set: the shared
+    liveness sweep WITHOUT the unconditional ``__gradients__`` pin —
+    a rewrite must keep an unfetched grad section (a later caller may
+    fetch the grads), but XLA DCEs it out of the compiled executable,
+    so cost and memory estimates must not charge for it."""
+    return live_op_indices(insts, fetch_vids, pin_grads=False)
+
+
+def _resolve_fetch_vids(program, fetch) -> Tuple[int, ...]:
+    if fetch is not None:
+        return tuple(t if isinstance(t, int) else program.vid_of(t)
+                     for t in fetch)
+    return tuple(getattr(program, "_fetch_vids", ()) or ())
+
+
+def _shard_divisor(spec) -> int:
+    """How many ways a value's BYTES split across the mesh under
+    ``spec`` (product of mesh-axis sizes carrying a Shard) — per-chip
+    footprints divide by this. Partial axes deliberately do NOT count:
+    a pending-reduce value occupies its full shape on every chip."""
+    if spec is None:
+        return 1
+    div = 1
+    for axis, p in enumerate(spec.placements):
+        if p.is_shard():
+            div *= int(spec.mesh.shape[axis])
+    return max(div, 1)
+
+
+def _compute_divisor(spec) -> int:
+    """How many ways the COMPUTE producing a value splits: Shard axes
+    (each chip produces a slice) times Partial axes (each chip did
+    1/n of the contraction — the row-parallel matmul the PTL202 lint
+    recommends has a Partial output but 8x-split FLOPs)."""
+    if spec is None:
+        return 1
+    div = 1
+    for axis, p in enumerate(spec.placements):
+        if p.is_shard() or p.is_partial():
+            div *= int(spec.mesh.shape[axis])
+    return max(div, 1)
+
+
+def program_cost(program, fetch=None, *, placements=None,
+                 avals: Optional[Dict[int, Aval]] = None) -> ProgramCost:
+    """Walk the program once and sum per-op costs over the LIVE ops.
+
+    ``fetch`` (Tensors or vids; falls back to a recorded
+    ``_fetch_vids``) roots the liveness sweep — without any roots every
+    op counts, the conservative read. ``placements`` (vid ->
+    DistTensorSpec) makes the estimate per-chip: each value's bytes
+    divide by its shard count (Partial values occupy full shape on
+    every chip), and each op's FLOPs divide by its output's COMPUTE
+    split — Shard axes plus Partial axes, so a row-parallel matmul
+    whose output is Partial still counts as contraction-split."""
+    with _obs.span("cost.program_cost", histogram=M_ESTIMATE_SECONDS,
+                   hist_labels={"kind": "flops"}):
+        return _program_cost(program, fetch, placements, avals)
+
+
+def _program_cost(program, fetch, placements, avals) -> ProgramCost:
+    avals = avals if avals is not None else propagate_avals(program)
+    placements = placements or {}
+    fetch_vids = _resolve_fetch_vids(program, fetch)
+    insts = list(program._insts)
+    kept = executed_op_indices(insts, fetch_vids) if fetch_vids \
+        else set(range(len(insts)))
+
+    result = ProgramCost()
+
+    def aval_of(v):
+        a = avals.get(v)
+        if a is None:
+            result.unknown_avals += 1
+        return a
+
+    def sharded_nbytes(v):
+        return _nbytes(avals.get(v)) // _shard_divisor(placements.get(v))
+
+    fwd_flops_live_to: Dict[int, int] = {}  # op idx -> flops (live ops)
+    for idx, (prim_name, in_vids, static_items, out_vids) in \
+            enumerate(insts):
+        if idx not in kept:
+            result.by_op.append(OpCost())
+            continue
+        if prim_name == GRAD_OP:
+            # jax.grad of the forward sub-replay live w.r.t. the loss:
+            # the ops before this instruction that feed in_vids[0]
+            loss_vid = in_vids[0] if in_vids else None
+            sub = live_op_indices(insts[:idx], (loss_vid,)) \
+                if loss_vid is not None else set()
+            fwd = sum(fwd_flops_live_to.get(i, 0) for i in sub)
+            flops = _GRAD_FLOPS_MULTIPLIER * fwd
+            read = sum(sharded_nbytes(v) for v in in_vids)
+            written = sum(sharded_nbytes(v) for v in out_vids)
+            c = OpCost(flops=flops, bytes_read=read, bytes_written=written)
+        else:
+            try:
+                attrs = dict(static_items)
+            except (TypeError, ValueError):
+                attrs = {}
+            c = op_cost(prim_name, [aval_of(v) for v in in_vids],
+                        [aval_of(v) for v in out_vids], attrs)
+            if placements:
+                out_div = max((_compute_divisor(placements.get(v))
+                               for v in out_vids), default=1)
+                c = OpCost(
+                    flops=c.flops // out_div,
+                    bytes_read=sum(sharded_nbytes(v) for v in in_vids),
+                    bytes_written=sum(sharded_nbytes(v)
+                                      for v in out_vids))
+            # recorded AFTER the shard division: the backward is
+            # partitioned like the forward, so the grad multiplier
+            # must scale the per-chip count, not the global one
+            fwd_flops_live_to[idx] = c.flops
+        result.by_op.append(c)
+        result.flops += c.flops
+        result.bytes_read += c.bytes_read
+        result.bytes_written += c.bytes_written
+        result.flops_by_prim[prim_name] = \
+            result.flops_by_prim.get(prim_name, 0) + c.flops
+        result.live_ops += 1
+    return result
+
+
+def measure_program_flops(program, feed: Dict[str, np.ndarray],
+                          fetch) -> int:
+    """XLA compiled-cost-analysis FLOPs of THIS program's replay — the
+    ground truth :func:`check_cost_model` compares the static estimate
+    against. Pays one compile (of the same executable ``Executor.run``
+    would build for this feed signature). Returns 0 when the backend
+    reports no cost analysis."""
+    from ...observability.runtime import measure_step_flops
+    from ..program import Executor
+
+    fetch_vids = _resolve_fetch_vids(program, fetch)
+    feed_items = sorted(feed.items())
+    feed_names = tuple(k for k, _ in feed_items)
+    arrays = [np.asarray(v) for _, v in feed_items]
+    fn = Executor._compile(program, feed_names, fetch_vids)
+    return measure_step_flops(fn, *arrays)
+
+
+def check_cost_model(predicted_flops: int, measured_flops: int, *,
+                     tolerance_pct: float = 25.0,
+                     name: str = "program") -> DiagnosticReport:
+    """File **PTL302** when the analytical FLOPs estimate drifts more
+    than ``tolerance_pct`` from XLA's compiled cost analysis — the
+    canary that catches cost-model rot (a new prim family the registry
+    does not know, a changed lowering) before scheduling and placement
+    decisions silently degrade. Records the error in
+    ``cost.model_flops_error_pct``; a measured count of 0 (backend
+    without cost analysis) is skipped, not flagged."""
+    report = DiagnosticReport()
+    if measured_flops <= 0:
+        return report
+    err_pct = abs(predicted_flops - measured_flops) / measured_flops * 100
+    if _obs.state.on:
+        M_PREDICTED_FLOPS.set(int(predicted_flops), name=name)
+        M_MEASURED_FLOPS.set(int(measured_flops), name=name)
+        M_FLOPS_ERROR.set(round(err_pct, 2), name=name)
+    if err_pct > tolerance_pct:
+        report.add(
+            "PTL302", Severity.WARNING,
+            f"cost model drift on {name!r}: analytical estimate "
+            f"{predicted_flops:,} flops vs compiled cost analysis "
+            f"{measured_flops:,} ({err_pct:.1f}% > {tolerance_pct:.0f}% "
+            f"tolerance)",
+            hint="the per-op registry in static/analysis/cost.py no "
+                 "longer models what XLA executes — register/fix the "
+                 "drifting prim family (cost.model_flops_error_pct "
+                 "tracks the error per program)")
+    return report

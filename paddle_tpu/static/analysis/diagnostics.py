@@ -12,6 +12,10 @@ Code namespace (``PTLxxx``):
   malformed and replay is undefined behaviour.
 - ``PTL1xx`` — lint findings (`lint.py`): the program is valid but
   suspicious (dead code, redundant ops, silent dtype demotion, ...).
+- ``PTL2xx`` — sharding-aware lints (`lint.py`/`sharding_lint.py`):
+  layout/placement findings feeding the auto-parallel planner.
+- ``PTL3xx`` — cost/memory analysis (`cost.py`/`memory.py`/
+  `rewrite.py`): predicted OOM, cost-model drift, no-benefit passes.
 """
 from __future__ import annotations
 
@@ -66,6 +70,14 @@ CODES = {
               "allgather a consistent plan would not need)",
     "PTL203": "collective serializes against compute in the merged fleet "
               "trace (no overlap with any compute span on that rank)",
+    # cost/memory-analysis diagnostics (PTL3xx) — the static cost model
+    # and liveness peak-memory estimator (cost.py + memory.py)
+    "PTL301": "predicted OOM before compile: liveness peak-memory estimate "
+              "exceeds the device budget",
+    "PTL302": "cost-model drift: analytical FLOPs estimate diverges from "
+              "XLA's compiled cost analysis beyond tolerance",
+    "PTL303": "no-benefit pass: a rewrite pass was scheduled out because "
+              "the pre-pass lint found nothing it could fix",
 }
 
 
@@ -74,13 +86,18 @@ class Diagnostic:
     """One finding: coded, located, and actionable.
 
     ``op_index`` is the instruction index in ``Program._insts`` (None for
-    program-level findings like feed/const overlap)."""
+    program-level findings like feed/const overlap). ``suggestion`` is an
+    optional machine-readable fix payload — a plain JSON-able dict so
+    automated consumers (the PADDLE_TPU_REPLACEMENT re-placement hook in
+    auto_parallel/completion.py reads PTL202 payloads) act on structure
+    instead of parsing the rendered message."""
 
     code: str
     severity: Severity
     message: str
     op_index: Optional[int] = None
     hint: Optional[str] = None
+    suggestion: Optional[dict] = None
 
     def __post_init__(self):
         if self.code not in CODES:
@@ -103,9 +120,10 @@ class DiagnosticReport:
 
     diagnostics: List[Diagnostic] = field(default_factory=list)
 
-    def add(self, code, severity, message, op_index=None, hint=None):
+    def add(self, code, severity, message, op_index=None, hint=None,
+            suggestion=None):
         self.diagnostics.append(
-            Diagnostic(code, severity, message, op_index, hint))
+            Diagnostic(code, severity, message, op_index, hint, suggestion))
 
     def extend(self, other: "DiagnosticReport"):
         self.diagnostics.extend(other.diagnostics)

@@ -38,19 +38,33 @@ def is_effectful(prim_name: str) -> bool:
 
 
 def live_op_indices(insts: Sequence[tuple],
-                    live_vids: Iterable[int]) -> Set[int]:
+                    live_vids: Iterable[int], *,
+                    pin_grads: bool = True) -> Set[int]:
     """Indices of instructions that are live w.r.t. ``live_vids``.
 
     Single backward sweep: an op is kept when any of its outputs is
     live (feeds a later live op or a fetch target), when it is
     effectful, or when it is the ``__gradients__`` section; kept ops
-    propagate liveness to their inputs."""
+    propagate liveness to their inputs.
+
+    ``pin_grads=True`` (the rewrite/lint view) keeps ``__gradients__``
+    unconditionally — deleting it is never safe for a rewrite because
+    a later caller may fetch the grads. ``pin_grads=False`` (the
+    cost/memory view, ``cost.executed_op_indices``) keeps it only when
+    its outputs are live — what XLA actually executes, since an
+    unfetched grad section is DCE'd out of the compiled replay. ONE
+    sweep serves both so the two views can never diverge on anything
+    but that single, named difference."""
     live: Set[int] = set(live_vids)
     kept: Set[int] = set()
     for idx in range(len(insts) - 1, -1, -1):
         prim_name, in_vids, _static, out_vids = insts[idx]
-        if any(v in live for v in out_vids) or is_effectful(prim_name) \
-                or prim_name == GRAD_OP:
-            kept.add(idx)
-            live.update(in_vids)
+        if prim_name == GRAD_OP:
+            if not pin_grads and not any(v in live for v in out_vids):
+                continue
+        elif not any(v in live for v in out_vids) \
+                and not is_effectful(prim_name):
+            continue
+        kept.add(idx)
+        live.update(in_vids)
     return kept

@@ -1,0 +1,246 @@
+"""Liveness-interval peak-memory estimator over the captured Program.
+
+ROADMAP item 5 (full-depth 8B) starts with *knowing peak HBM before
+compile* — today the repo only learns it after the fact from the PR 5
+``device.hbm_watermark_bytes`` gauge. This module predicts it
+statically: value footprints come from the same best-effort aval map
+the lints use (``verify.propagate_avals``), live intervals from the
+SHARED liveness sweep (``liveness.live_op_indices`` — the same roots
+the dead-op lint and the DCE passes agree on), and the walk replays
+the allocator's life: consts (parameters) resident for the whole
+program, feeds resident from entry, each live op's outputs allocated
+before its operands can die, operands freed after their LAST live use,
+fetch targets never freed.
+
+The ``__gradients__`` pseudo-op models jax.grad's residual policy: the
+outputs of every forward op live w.r.t. the loss are HELD until the
+gradient instruction (activations saved for the backward), and the
+gradient outputs allocate there — without this the estimate misses the
+term that actually decides whether a training step fits.
+
+Sharding-aware: pass ``placements`` (vid -> DistTensorSpec, e.g. from
+``auto_parallel.completion.complete_placements``) and every footprint
+divides by its shard count — the estimate becomes per-chip, which is
+the number the PTL301 budget check compares against the device limit.
+
+**PTL301** (:func:`lint_memory_budget`) is the predicted-OOM-before-
+compile diagnostic: peak estimate vs device budget (explicit argument,
+``PADDLE_TPU_HBM_LIMIT_BYTES`` env, or the PJRT allocator's
+``bytes_limit``), fired from ``Executor.run`` on the pre-compile path
+— a loud answer *seconds* before XLA would spend minutes compiling a
+program that cannot fit.
+
+Validation: ``tests/test_cost_analysis.py`` pins the estimator against
+a step-by-step allocation simulator on the seeded generated programs
+(exact agreement) and against the measured watermark on the bench
+llama train program (tolerance band).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ... import observability as _obs
+from .cost import (M_ESTIMATE_SECONDS, M_PREDICTED_OOM, M_PREDICTED_PEAK,
+                   _nbytes, _resolve_fetch_vids, _shard_divisor,
+                   executed_op_indices)
+from .diagnostics import DiagnosticReport, Severity
+from .liveness import live_op_indices
+from .verify import GRAD_OP, propagate_avals
+
+__all__ = ["MemoryEstimate", "estimate_peak_memory", "lint_memory_budget",
+           "device_memory_budget", "HBM_LIMIT_ENV", "OOM_CHECK_ENV"]
+
+#: explicit per-chip memory budget override (bytes) for the PTL301
+#: check — wins over the PJRT allocator's reported bytes_limit.
+HBM_LIMIT_ENV = "PADDLE_TPU_HBM_LIMIT_BYTES"
+
+#: Executor.run pre-compile behavior when the estimate exceeds the
+#: budget: "warn" (default — loud diagnostic + metric, compile
+#: proceeds), "raise" (refuse before compile), "off".
+OOM_CHECK_ENV = "PADDLE_TPU_OOM_CHECK"
+
+
+@dataclass
+class MemoryEstimate:
+    """Peak + breakdown of one program replay's resident memory."""
+
+    peak_bytes: int = 0
+    peak_op_index: Optional[int] = None
+    const_bytes: int = 0
+    feed_bytes: int = 0
+    fetch_bytes: int = 0
+    #: resident bytes after each instruction (dead ops repeat the
+    #: previous value) — the allocation timeline a test can replay
+    timeline: List[int] = field(default_factory=list)
+    unknown_vids: int = 0
+
+    def render(self) -> str:
+        at = f" at op#{self.peak_op_index}" if self.peak_op_index \
+            is not None else ""
+        return (f"peak {self.peak_bytes:,}B{at} (consts "
+                f"{self.const_bytes:,}B + feeds {self.feed_bytes:,}B "
+                f"resident; fetch {self.fetch_bytes:,}B held at exit; "
+                f"{self.unknown_vids} vid(s) without avals)")
+
+
+def estimate_peak_memory(program, fetch=None, *, placements=None,
+                         avals=None) -> MemoryEstimate:
+    """Liveness-interval peak-memory estimate of one replay.
+
+    ``fetch`` (Tensors or vids; falls back to the recorded
+    ``_fetch_vids``) roots liveness; without roots every op is treated
+    as live (conservative). ``placements`` divides each value's
+    footprint by its shard count for a per-chip estimate."""
+    with _obs.span("cost.estimate_peak_memory",
+                   histogram=M_ESTIMATE_SECONDS,
+                   hist_labels={"kind": "memory"}):
+        return _estimate(program, fetch, placements, avals)
+
+
+def _estimate(program, fetch, placements, avals) -> MemoryEstimate:
+    avals = avals if avals is not None else propagate_avals(program)
+    placements = placements or {}
+    fetch_vids = set(_resolve_fetch_vids(program, fetch))
+    insts = list(program._insts)
+    kept = executed_op_indices(insts, fetch_vids) if fetch_vids \
+        else set(range(len(insts)))
+
+    est = MemoryEstimate()
+    _bytes_cache: Dict[int, int] = {}
+
+    def bytes_of(vid) -> int:
+        b = _bytes_cache.get(vid)
+        if b is None:
+            a = avals.get(vid)
+            if a is None:
+                est.unknown_vids += 1  # memoized: counted once per vid
+                b = 0
+            else:
+                b = _nbytes(a) // _shard_divisor(placements.get(vid))
+            _bytes_cache[vid] = b
+        return b
+
+    const_vids = set(program._consts)
+    feed_vids = set(program._feed_names.values())
+    est.const_bytes = sum(bytes_of(v) for v in const_vids)
+    est.feed_bytes = sum(bytes_of(v) for v in feed_vids)
+
+    # last live use per vid: seeded at the defining op (an output never
+    # consumed dies where it is produced), extended by every consuming
+    # op, and extended to the grad instruction for backward residuals.
+    # Fetch targets, consts and feeds never enter the expiry map.
+    last_use: Dict[int, int] = {}
+    for idx in kept:
+        for v in insts[idx][3]:
+            last_use.setdefault(v, idx)
+        for v in insts[idx][1]:
+            last_use[v] = max(last_use.get(v, idx), idx)
+    for g in (i for i in kept if insts[i][0] == GRAD_OP):
+        # residuals: outputs of forward ops live w.r.t. the loss are
+        # saved for the backward — hold them until the grad instruction
+        loss_vid = insts[g][1][0] if insts[g][1] else None
+        if loss_vid is None:
+            continue
+        for i in live_op_indices(insts[:g], (loss_vid,)):
+            for v in insts[i][3]:
+                last_use[v] = max(last_use.get(v, g), g)
+    expiry: Dict[int, list] = {}
+    for v, idx in last_use.items():
+        if v not in fetch_vids and v not in const_vids \
+                and v not in feed_vids:
+            expiry.setdefault(idx, []).append(v)
+
+    resident = est.const_bytes + est.feed_bytes
+    live_bytes: Dict[int, int] = {}  # non-const/feed values currently held
+    peak, peak_idx = resident, None
+    for idx, (prim_name, in_vids, _static, out_vids) in enumerate(insts):
+        if idx not in kept:
+            est.timeline.append(resident)
+            continue
+        # outputs allocate while operands are still held (both buffers
+        # exist during the op's execution)
+        for v in out_vids:
+            if v not in live_bytes and v not in const_vids \
+                    and v not in feed_vids:
+                b = bytes_of(v)
+                live_bytes[v] = b
+                resident += b
+        if resident > peak:
+            peak, peak_idx = resident, idx
+        # everything whose last live use is this op dies now — operand,
+        # never-consumed output, or a backward residual expiring at the
+        # grad instruction without being one of its operands
+        for v in expiry.get(idx, ()):
+            if v in live_bytes:
+                resident -= live_bytes.pop(v)
+        est.timeline.append(resident)
+
+    est.peak_bytes = peak
+    est.peak_op_index = peak_idx
+    est.fetch_bytes = sum(bytes_of(v) for v in fetch_vids)
+    return est
+
+
+def device_memory_budget() -> int:
+    """Per-chip memory budget for the PTL301 check: the
+    ``PADDLE_TPU_HBM_LIMIT_BYTES`` override when set, else the PJRT
+    allocator's reported ``bytes_limit`` (0 on platforms that report
+    none — CPU — which disables the check)."""
+    env = os.environ.get(HBM_LIMIT_ENV)
+    if env:
+        try:
+            return int(float(env))
+        except ValueError:
+            pass
+    try:
+        from ...device import memory as dev_mem
+
+        return int(dev_mem.memory_stats().get("bytes_limit", 0))
+    except Exception:
+        return 0
+
+
+def lint_memory_budget(program, fetch=None, *, limit_bytes=None,
+                       placements=None, name: str = "program",
+                       estimate: Optional[MemoryEstimate] = None
+                       ) -> DiagnosticReport:
+    """**PTL301**: predicted OOM before compile.
+
+    Compares the liveness peak estimate against ``limit_bytes``
+    (default: :func:`device_memory_budget`); a limit of 0 means no
+    budget is known and the report comes back empty. Records the
+    prediction in ``cost.predicted_peak_hbm_bytes`` and counts firings
+    in ``cost.predicted_oom``."""
+    report = DiagnosticReport()
+    limit = device_memory_budget() if limit_bytes is None \
+        else int(limit_bytes)
+    if limit <= 0:
+        return report
+    est = estimate if estimate is not None else \
+        estimate_peak_memory(program, fetch, placements=placements)
+    if _obs.state.on:
+        M_PREDICTED_PEAK.set(int(est.peak_bytes), name=name)
+    if est.peak_bytes > limit:
+        if _obs.state.on:
+            M_PREDICTED_OOM.inc(name=name)
+            _obs.emit("cost.predicted_oom", name=name,
+                      peak_bytes=int(est.peak_bytes), limit_bytes=limit,
+                      peak_op_index=est.peak_op_index)
+        report.add(
+            "PTL301", Severity.ERROR,
+            f"predicted peak memory {est.peak_bytes:,}B exceeds the "
+            f"device budget {limit:,}B "
+            f"({est.peak_bytes / limit:.2f}x) — this program is "
+            f"expected to OOM before XLA even finishes compiling it",
+            op_index=est.peak_op_index,
+            hint="shrink the batch/sequence, shard more ways (pass the "
+                 "placement plan for a per-chip estimate), enable "
+                 "recompute checkpoints, or raise "
+                 f"{HBM_LIMIT_ENV} if the budget is wrong; set "
+                 f"{OOM_CHECK_ENV}=off to silence the pre-compile "
+                 "check")
+    return report

@@ -28,6 +28,19 @@ Measurement rides the ``opt.`` metric subsystem (claimed in
 So pass scheduling can be argued from data (`tools/metrics_report.py`
 renders the per-code fixed/remaining table; ``bench.py --metrics``
 rolls the totals into the bench record) instead of assumed.
+
+Scheduling IS argued from data now: each fixed-point iteration starts
+with ONE lint sweep over the rewrite codes, passes whose code has zero
+findings are **skipped** (cost-gated — no lint-fix pass pays its
+lint+fix+re-lint wall time to discover it has nothing to do), and the
+remaining passes run in **benefit order**: predicted benefit (the
+iteration's finding count for the pass's code) divided by observed
+cost (the pass's historical mean ``opt.rewrite_seconds`` from the
+metrics registry, when recorded). Skips land in
+``opt.passes_skipped{name}`` and in the **PTL303** no-benefit report
+on the returned :class:`OptimizeResult`; the bit-exact equivalence
+harness (tests/test_rewrite_passes.py + test_cost_analysis.py) pins
+that re-ordering and skipping never change fetch outputs.
 """
 from __future__ import annotations
 
@@ -36,7 +49,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence
 
 from ... import observability as _obs
-from .diagnostics import DiagnosticReport
+from .diagnostics import DiagnosticReport, Severity
 from .lint import run_lints
 
 __all__ = ["optimize_program", "OptimizeResult", "REWRITE_CODES",
@@ -81,6 +94,10 @@ _M_ITERATIONS = _obs.gauge(
 _M_OPS_REMOVED = _obs.counter(
     "opt.ops_removed",
     "program instructions removed across all optimize_program calls")
+_M_PASSES_SKIPPED = _obs.counter(
+    "opt.passes_skipped",
+    "lint-fix passes the benefit-ordered scheduler skipped because the "
+    "pre-iteration lint sweep found nothing for their code, by pass name")
 
 
 @dataclass
@@ -93,6 +110,13 @@ class OptimizeResult:
     findings_fixed: Dict[str, int] = field(default_factory=dict)
     pruned_feeds: List[str] = field(default_factory=list)
     remaining: Optional[DiagnosticReport] = None
+    #: pass name -> iterations the scheduler skipped it (pre-iteration
+    #: lint sweep found nothing for its code)
+    passes_skipped: Dict[str, int] = field(default_factory=dict)
+    #: PTL303 no-benefit report: passes that never ran across the call
+    no_benefit: Optional[DiagnosticReport] = None
+    #: the order passes actually ran in, per iteration (benefit-ordered)
+    schedule: List[List[str]] = field(default_factory=list)
 
     @property
     def ops_removed(self) -> int:
@@ -102,12 +126,17 @@ class OptimizeResult:
     def total_fixed(self) -> int:
         return sum(self.findings_fixed.values())
 
+    @property
+    def total_skipped(self) -> int:
+        return sum(self.passes_skipped.values())
+
     def render(self) -> str:
         per_code = ", ".join(f"{c}={n}"
                              for c, n in sorted(self.findings_fixed.items()))
         return (f"optimize_program: {self.total_fixed} finding(s) fixed "
                 f"({per_code or 'none'}), ops {self.ops_before} -> "
                 f"{self.ops_after}, {self.iterations} iteration(s), "
+                f"{self.total_skipped} pass-skip(s), "
                 f"{len(self.remaining or [])} finding(s) remaining")
 
 
@@ -118,10 +147,46 @@ def _resolve_fetch(program, fetch) -> tuple:
     return tuple(vids)
 
 
+def _pass_code(name: str) -> str:
+    """The PTL code a registered lint-fix pass claims ('' for passes
+    outside the lint-fix family — those are never cost-gated)."""
+    from ...distributed.passes import _PASS_REGISTRY
+
+    return getattr(_PASS_REGISTRY.get(name), "code", "") or ""
+
+
+def _iteration_schedule(names: Sequence[str],
+                        counts: Dict[str, int]) -> tuple:
+    """(runnable_in_benefit_order, skipped) for one iteration.
+
+    Benefit = the lint sweep's finding count for the pass's code (every
+    finding is one fixable rewrite); cost = the pass's observed mean
+    wall time from ``opt.rewrite_seconds`` (the measured-benefit data
+    PR 11 started recording — a process that has run the pipeline
+    before schedules from its own history, a fresh one falls back to a
+    uniform prior and the order degrades to most-findings-first).
+    Passes without a claimed code are never gated. Ties keep the static
+    pipeline order (the sort is stable on the original index)."""
+    runnable, skipped = [], []
+    for i, n in enumerate(names):
+        code = _pass_code(n)
+        if code and counts.get(code, 0) <= 0:
+            skipped.append(n)
+            continue
+        findings = counts.get(code, 1) if code else 1
+        stats = _M_REWRITE_SECONDS.stats(name=n)
+        observed = stats["avg"] if stats["count"] else 0.0
+        score = findings / max(observed, 1e-4)
+        runnable.append((-score, i, n))
+    runnable.sort()
+    return [n for _s, _i, n in runnable], skipped
+
+
 def optimize_program(program, fetch: Optional[Iterable] = None, *,
                      passes: Optional[Sequence[str]] = None,
                      max_iterations: int = 8,
-                     verify: Optional[bool] = None) -> OptimizeResult:
+                     verify: Optional[bool] = None,
+                     schedule: bool = True) -> OptimizeResult:
     """Run the lint-fix pipeline over ``program`` until quiescence.
 
     ``fetch`` (Tensors or vids) names the values that must survive —
@@ -129,6 +194,15 @@ def optimize_program(program, fetch: Optional[Iterable] = None, *,
     a recorded ``_fetch_vids``) the call refuses rather than guessing
     which outputs matter. Mutates ``program`` in place; the Executor
     hook optimizes a cached *clone* instead (static/program.py).
+
+    ``schedule=True`` (default) cost-gates and benefit-orders each
+    iteration from one shared lint sweep: zero-finding passes are
+    skipped (``opt.passes_skipped``, PTL303 on the result), the rest
+    run ordered by findings-per-observed-second. ``schedule=False``
+    restores the static ``DEFAULT_PIPELINE`` order (every pass, every
+    iteration). Both converge to the same fixed point — each pass is
+    an independent re-lint-to-zero fix — so scheduling changes cost,
+    never results (pinned by the bit-exact equivalence harness).
 
     ``verify=None`` inherits ``PADDLE_TPU_PASS_VERIFY`` via
     ``PassManager`` — every pass runs bracketed by the Program verifier
@@ -150,16 +224,34 @@ def optimize_program(program, fetch: Optional[Iterable] = None, *,
         _M_RUNS.inc()
     result = OptimizeResult(ops_before=program.num_ops)
     names = list(passes or DEFAULT_PIPELINE)
+    sweep_codes = sorted({_pass_code(n) for n in names if _pass_code(n)})
+    ran: set = set()
     t0 = time.perf_counter()
     feed_names_before = set(program._feed_names)
 
     while result.iterations < max_iterations:
         result.iterations += 1
+        if schedule and sweep_codes:
+            sweep = run_lints(program, fetch=fetch_vids,
+                              codes=sweep_codes)
+            counts = {c: len(sweep.by_code(c)) for c in sweep_codes}
+            to_run, skipped = _iteration_schedule(names, counts)
+            if not to_run:
+                break  # quiescent: nothing any pass could fix
+            for n in skipped:
+                result.passes_skipped[n] = \
+                    result.passes_skipped.get(n, 0) + 1
+                if on:
+                    _M_PASSES_SKIPPED.inc(name=n)
+        else:
+            to_run = names
         fp_before = program.fingerprint()
         pm = PassManager(
-            [new_pass(n, {"fetch": list(fetch_vids)}) for n in names],
+            [new_pass(n, {"fetch": list(fetch_vids)}) for n in to_run],
             verify=verify)
         pm.apply(program, None)
+        ran.update(to_run)
+        result.schedule.append(list(to_run))
         for code, n in (pm.context.get_attr("findings_fixed")
                         or {}).items():
             result.findings_fixed[code] = \
@@ -167,6 +259,17 @@ def optimize_program(program, fetch: Optional[Iterable] = None, *,
         if program.fingerprint() == fp_before:
             break
 
+    result.no_benefit = DiagnosticReport()
+    for n in names:
+        if n not in ran:
+            result.no_benefit.add(
+                "PTL303", Severity.NOTE,
+                f"pass {n!r} never ran: the lint sweep found no "
+                f"{_pass_code(n) or 'matching'} finding in any "
+                f"iteration — zero predicted benefit, zero wall time "
+                f"spent",
+                hint="expected on already-clean programs; if the pass "
+                     "should have fired, check the lint it pairs with")
     result.ops_after = program.num_ops
     result.pruned_feeds = sorted(
         feed_names_before - set(program._feed_names))
@@ -185,5 +288,6 @@ def optimize_program(program, fetch: Optional[Iterable] = None, *,
                   findings_fixed=result.total_fixed,
                   ops_before=result.ops_before,
                   ops_after=result.ops_after,
-                  remaining=len(result.remaining))
+                  remaining=len(result.remaining),
+                  passes_skipped=result.total_skipped)
     return result

@@ -30,7 +30,7 @@ from typing import Any, Dict, List, Optional
 from .diagnostics import DiagnosticReport, Severity
 
 __all__ = ["run_placement_lints", "lint_fleet_trace",
-           "SHARDING_LINT_CODES"]
+           "apply_placement_suggestion", "SHARDING_LINT_CODES"]
 
 #: codes this module can emit — audited by tools/lint_registry.py the
 #: same way lint.LINTS codes are (every code claimed in CODES, every
@@ -68,6 +68,57 @@ def _shard_axes(spec, tensor_dim: int) -> List[int]:
 
 def _partial_axes(spec) -> List[int]:
     return [a for a, p in enumerate(spec.placements) if p.is_partial()]
+
+
+def _suggest(kind: str, op_index: int, vid: int, dim: Optional[int],
+             mesh_axis: Optional[int], placement: str) -> Dict[str, Any]:
+    """Machine-readable PTL202 re-placement payload — the interface the
+    ``PADDLE_TPU_REPLACEMENT`` hook in auto_parallel/completion.py
+    consumes. Plain JSON-able values only: ``placement`` is "shard"
+    (put ``dim`` of ``vid`` on ``mesh_axis``) or "replicate" (clear the
+    conflicting shard of ``dim`` — dim None clears everything,
+    including Partial)."""
+    return {"kind": kind, "op_index": op_index, "vid": vid, "dim": dim,
+            "mesh_axis": mesh_axis, "placement": placement}
+
+
+def _align_suggestion(kind, idx, vid, spec, dim, target_axes
+                      ) -> Dict[str, Any]:
+    """Suggest re-placing ``dim`` of ``vid`` onto ``target_axes`` (the
+    other operand's layout): shard when a target axis exists and the
+    dim divides it, else replicate the dim."""
+    axes = sorted(target_axes)
+    if axes and spec.shape[dim] % int(spec.mesh.shape[axes[0]]) == 0:
+        return _suggest(kind, idx, vid, dim, axes[0], "shard")
+    return _suggest(kind, idx, vid, dim, None, "replicate")
+
+
+def apply_placement_suggestion(spec, suggestion):
+    """Return a NEW DistTensorSpec with one PTL202 ``suggestion``
+    payload applied to ``spec`` (shared by tests and the completion
+    hook, so "what applying a suggestion means" has one definition).
+
+    "shard": clear every axis currently sharding ``dim`` (and any
+    Partial), then put ``Shard(dim)`` on ``mesh_axis`` — axes sharding
+    OTHER dims are untouched unless ``mesh_axis`` collides, in which
+    case the suggestion wins. "replicate": clear shards of ``dim``
+    (``dim`` None clears every shard and Partial)."""
+    from ...distributed.auto_parallel.placement import Replicate, Shard
+    from ...distributed.auto_parallel.spmd_rules import DistTensorSpec
+
+    dim = suggestion.get("dim")
+    placements = list(spec.placements)
+    for axis, p in enumerate(placements):
+        if p.is_partial():
+            placements[axis] = Replicate()
+        elif dim is None and p.is_shard():
+            placements[axis] = Replicate()
+        elif dim is not None and p.is_shard(dim):
+            placements[axis] = Replicate()
+    if suggestion.get("placement") == "shard" and dim is not None \
+            and suggestion.get("mesh_axis") is not None:
+        placements[int(suggestion["mesh_axis"])] = Shard(int(dim))
+    return DistTensorSpec(list(spec.shape), spec.mesh, placements)
 
 
 def run_placement_lints(prog, mesh=None, placements=None,
@@ -109,7 +160,9 @@ def run_placement_lints(prog, mesh=None, placements=None,
                         f"an allreduce before this op", op_index=idx,
                         hint="let a reducing consumer absorb the partial "
                              "sum, or re-place the producer so its output "
-                             "is sharded instead of partial")
+                             "is sharded instead of partial",
+                        suggestion=_suggest("partial_consumed", idx, v,
+                                            None, None, "replicate"))
 
         if prim_name in _MATMUL_PRIMS and len(in_vids) >= 2:
             x, w = specs[0][1], specs[1][1]
@@ -137,7 +190,13 @@ def run_placement_lints(prog, mesh=None, placements=None,
                         op_index=idx,
                         hint="shard both contracting dims on the same mesh "
                              "axis (classic row/column-parallel pairing); "
-                             "the psum then happens once, after the GEMM")
+                             "the psum then happens once, after the GEMM",
+                        # align the weight side to the activation side:
+                        # activation layouts are usually pinned by the
+                        # surrounding plan, weight placements are free
+                        suggestion=_align_suggestion(
+                            "matmul_contracting", idx, in_vids[1], w,
+                            w_c, ax_x))
             continue
 
         # elementwise family ONLY: same-shape operands whose shard
@@ -153,23 +212,28 @@ def run_placement_lints(prog, mesh=None, placements=None,
                 if sa.shape != sb.shape or sa.ndim == 0:
                     continue
                 conflict = None
+                cdim = 0  # the dim of %vb the suggestion re-places
                 for d in range(sa.ndim):
                     axa, axb = _shard_axes(sa, d), _shard_axes(sb, d)
                     if axa and axb and set(axa) != set(axb):
                         conflict = (f"dim {d} sharded on mesh axes "
                                     f"{axa} vs {axb}")
+                        cdim = d
                         break
                 if conflict is None:
                     ma = {a: d for d in range(sa.ndim)
                           for a in _shard_axes(sa, d)}
                     mb = {a: d for d in range(sb.ndim)
                           for a in _shard_axes(sb, d)}
-                    for a in set(ma) & set(mb):
+                    for a in sorted(set(ma) & set(mb)):
                         if ma[a] != mb[a]:
                             conflict = (f"mesh axis {a} shards dim "
                                         f"{ma[a]} vs dim {mb[a]}")
+                            cdim = mb[a]
                             break
                 if conflict:
+                    # align the later operand to the earlier one (the
+                    # earlier producer's layout is upstream context)
                     report.add(
                         "PTL202", Severity.WARNING,
                         f"{prim_name!r}: operands %{va} and %{vb} have "
@@ -177,7 +241,10 @@ def run_placement_lints(prog, mesh=None, placements=None,
                         f"resharded (all-to-all/allgather) before the op",
                         op_index=idx,
                         hint="re-place one producer so the layouts agree; "
-                             "an aligned plan makes this op collective-free")
+                             "an aligned plan makes this op collective-free",
+                        suggestion=_align_suggestion(
+                            "elementwise_conflict", idx, vb, sb, cdim,
+                            _shard_axes(sa, cdim)))
     return report
 
 

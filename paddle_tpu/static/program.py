@@ -53,6 +53,11 @@ _M_OPTIMIZED = _obs.counter(
     "optimized-clone builds triggered by the Executor.run pre-compile "
     "hook (PADDLE_TPU_OPTIMIZE / FLAGS_optimize_programs)")
 
+_M_OOM_CHECKS = _obs.counter(
+    "executor.oom_checks",
+    "pre-compile PTL301 memory-budget checks run on the compile-miss "
+    "path (a known device budget + PADDLE_TPU_OOM_CHECK not off)")
+
 #: compiled-replay entries kept per program; oldest evicted first
 _REPLAY_CACHE_CAP = 64
 
@@ -437,6 +442,59 @@ def _optimized_clone(program: Program, fetch_vids) -> Program:
     return clone
 
 
+def _oom_check_mode() -> str:
+    """PTL301 pre-compile behavior: "warn" (default), "raise", "off"."""
+    from .analysis.memory import OOM_CHECK_ENV
+
+    mode = os.environ.get(OOM_CHECK_ENV, "warn").lower()
+    return mode if mode in ("warn", "raise", "off") else "warn"
+
+
+def _precompile_memory_check(program: Program, fetch_vids) -> None:
+    """PTL301: predict peak memory BEFORE paying the compile.
+
+    Runs on the compile-miss path only, and only when a device budget
+    is known (``PADDLE_TPU_HBM_LIMIT_BYTES`` or the PJRT allocator's
+    bytes_limit — 0 on CPU, so CI runs skip it for free). A predicted
+    OOM is a loud ``warnings.warn`` carrying the rendered diagnostic
+    (mode "raise" refuses instead): minutes of XLA compile time and a
+    mid-compile device OOM are both worse than a false positive from a
+    ~25%-accurate estimate.
+
+    The replay Executor.run compiles here is single-device (feeds are
+    host arrays; GSPMD sharding rides the dist.shard_tensor/jit paths,
+    not this one), so the UNSHARDED estimate is the right comparison
+    against the per-chip budget. A future sharded-executor path can
+    attach its plan as ``program._placements`` (vid -> DistTensorSpec)
+    and the estimate becomes per-chip automatically."""
+    mode = _oom_check_mode()
+    if mode == "off":
+        return
+    from .analysis.memory import device_memory_budget, lint_memory_budget
+
+    limit = device_memory_budget()
+    if limit <= 0:
+        return
+    if _obs_state.on:
+        _M_OOM_CHECKS.inc()
+    report = lint_memory_budget(program, fetch_vids, limit_bytes=limit,
+                                placements=getattr(program, "_placements",
+                                                   None),
+                                name="executor")
+    if not report.diagnostics:
+        return
+    if mode == "raise":
+        from .analysis.diagnostics import ProgramVerificationError
+
+        raise ProgramVerificationError(report,
+                                       context="Executor.run pre-compile")
+    import warnings
+
+    warnings.warn(report.render("predicted OOM (PTL301) — compiling "
+                                "anyway, set PADDLE_TPU_OOM_CHECK=raise "
+                                "to refuse:"), stacklevel=3)
+
+
 class Executor:
     """Reference: paddle.static.Executor (executor.py:1199) — replays the
     captured instruction list as one jitted XLA program per feed
@@ -488,6 +546,9 @@ class Executor:
         key = (program.fingerprint(), feed_names, feed_sig, fetch_vids)
         entry = program._cache.get(key)
         if entry is None:
+            # predicted-OOM check rides the compile-miss path: the
+            # estimate costs ms, the compile it can veto costs minutes
+            _precompile_memory_check(program, fetch_vids)
             with _obs.span("Executor.compile",
                            histogram=_M_COMPILE_SECONDS) as sp:
                 fn = self._compile(program, feed_names, fetch_vids)

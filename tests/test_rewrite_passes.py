@@ -414,6 +414,29 @@ class TestExecutorOptimizeHook:
             _optimized_clone(prog, (v,))
             assert _optimized_clone(prog, (vids[0],)) is first
 
+    def test_clone_cache_bounded_at_cap(self, monkeypatch):
+        # the per-(fingerprint, fetch-set) clone cache must never grow
+        # past _OPT_CLONE_CAP no matter how many program variants run —
+        # same LRU-refresh eviction policy as the compiled-replay cache
+        from paddle_tpu.static.program import (_OPT_CLONE_CAP,
+                                               _optimized_clone)
+
+        monkeypatch.setenv("PADDLE_TPU_OPTIMIZE", "1")
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            outs = [(x * float(i + 2)).sum()
+                    for i in range(3 * _OPT_CLONE_CAP)]
+        cache = None
+        for t in outs:
+            _optimized_clone(prog, (prog.vid_of(t),))
+            cache = prog.__dict__["_opt_clones"]
+            assert len(cache) <= _OPT_CLONE_CAP
+        # the oldest fetch sets were evicted, the newest survive
+        survivors = {k[1] for k in cache}
+        assert (prog.vid_of(outs[-1]),) in survivors
+        assert (prog.vid_of(outs[0]),) not in survivors
+
     def test_flag_twin_enables_too(self, monkeypatch):
         monkeypatch.delenv("PADDLE_TPU_OPTIMIZE", raising=False)
         paddle.set_flags({"optimize_programs": True})

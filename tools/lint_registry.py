@@ -145,6 +145,21 @@ OPT_REQUIRED_LABELS = {
     "opt.findings_fixed": ("code",),
     "opt.findings_remaining": ("code",),
     "opt.rewrite_seconds": ("name",),
+    "opt.passes_skipped": ("name",),
+}
+
+#: cost/memory-analysis label discipline (static/analysis/cost.py +
+#: memory.py): every predicted/measured series must say WHICH program
+#: it describes — a predicted-vs-measured table with unattributable
+#: rows cannot catch cost-model rot per workload.
+COST_REQUIRED_LABELS = {
+    "cost.predicted_flops": ("name",),
+    "cost.measured_flops": ("name",),
+    "cost.model_flops_error_pct": ("name",),
+    "cost.predicted_peak_hbm_bytes": ("name",),
+    "cost.measured_peak_hbm_bytes": ("name",),
+    "cost.predicted_oom": ("name",),
+    "cost.estimate_seconds": ("kind",),
 }
 
 #: fleet-telemetry label discipline (observability/fleet.py): per-rank
@@ -191,6 +206,8 @@ REQUIRED_LABEL_TABLES = (
                               "the incident (who died / why the restart)"),
     (OPT_REQUIRED_LABELS, "opt. series must attribute the PTL code / "
                           "rewrite pass"),
+    (COST_REQUIRED_LABELS, "cost. series must attribute the program "
+                           "(or the analysis kind)"),
     (FLEET_REQUIRED_LABELS, "fleet series must attribute the rank (or "
                             "the reason/job)"),
     (SERVE_REQUIRED_LABELS, "serve series must attribute the engine "
@@ -204,6 +221,8 @@ NO_UNLABELED_GAUGE_PREFIXES = {
     "fleet.": "every fleet gauge must carry at least a rank= or job= "
               "label",
     "serve.": "every serve gauge must carry at least an engine= label",
+    "cost.": "every cost gauge must carry at least a name= label (the "
+             "program the prediction describes)",
 }
 
 
@@ -287,6 +306,7 @@ def check_diagnostic_registry() -> List[str]:
     by at least one test (string-presence scan over ``tests/``)."""
     from paddle_tpu.distributed import passes as passes_mod
     from paddle_tpu.distributed.passes.lint_fix_passes import LintFixPass
+    from paddle_tpu.static.analysis import cost as cost_mod
     from paddle_tpu.static.analysis import diagnostics, sharding_lint
     from paddle_tpu.static.analysis import lint as lint_mod
 
@@ -301,6 +321,11 @@ def check_diagnostic_registry() -> List[str]:
         if code not in diagnostics.CODES:
             problems.append(
                 f"sharding lint code {code!r} is not documented in "
+                f"diagnostics.CODES")
+    for code in cost_mod.COST_ANALYSIS_CODES:
+        if code not in diagnostics.CODES:
+            problems.append(
+                f"cost-analysis code {code!r} is not documented in "
                 f"diagnostics.CODES")
     for name, cls in sorted(passes_mod._PASS_REGISTRY.items()):
         if isinstance(cls, type) and issubclass(cls, LintFixPass):

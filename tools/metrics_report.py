@@ -14,7 +14,10 @@ kind is auto-detected. Metric rows come out grouped by subsystem
 (``dispatch``, ``executor``, ``train``, ``comm``, ``elastic``, ...);
 ``--top`` keeps only the N largest series per metric. Rendering goes
 through the same ``observability.report`` code the in-process
-``summary()`` uses, so dumps round-trip by construction.
+``summary()`` uses, so dumps round-trip by construction. The ``opt``
+section leads with the lint->rewrite per-code fixed/remaining table,
+and the ``cost`` section with the static cost model's
+predicted-vs-measured FLOPs/peak-HBM table (``render_cost_table``).
 
 Passing a DIRECTORY renders every ``flight-*.json`` in it — the shape an
 elastic incident leaves behind (each surviving worker dumps
