@@ -173,6 +173,13 @@ def _emit_metrics_block():
             gauge_max("cost.model_flops_error_pct"),
         "cost_predicted_peak_hbm_bytes":
             gauge_max("cost.predicted_peak_hbm_bytes"),
+        # predicted-step-time roll-ups (static/analysis/comm_cost.py;
+        # the PTL304 drift check publishes the error gauge)
+        "cost_predicted_step_seconds":
+            gauge_max("cost.predicted_step_seconds"),
+        "cost_model_step_error_pct":
+            gauge_max("cost.model_step_error_pct"),
+        "comm_predicted_bytes": gauge_max("cost.comm_predicted_bytes"),
         # serving-engine roll-ups (paddle_tpu/serve; populated by the
         # `serve` config / tools/serve_load.py load runs)
         "serve_ttft_p50": hist_quantile("serve.ttft_seconds", 0.50),
@@ -387,13 +394,24 @@ def bench_cost_model():
       in-use baseline; on TPU the allocator watermark can still carry
       an earlier config's peak, making the measured side an upper
       bound there — the tight assertion lives in
-      tests/test_cost_analysis.py)."""
+      tests/test_cost_analysis.py);
+    - step time: predicted ``max(compute, memory) + comm`` vs the
+      measured replay wall time of the same capture —
+      ``check_step_time_model`` files PTL304 past a generous factor-of-
+      ten bound (single-chip CPU replay; the tight bound belongs on a
+      calibrated TPU run via tools/comm_calibrate.py). With >=2
+      devices the capture is also priced under a derived 2-way plan so
+      the per-collective ``cost.comm_predicted_*`` table populates."""
+    import jax
+
     import paddle_tpu.observability as obs
     import paddle_tpu.static as static
     from paddle_tpu.static.analysis import (check_cost_model,
+                                            check_step_time_model,
                                             estimate_peak_memory,
                                             measure_program_flops,
                                             program_cost)
+    from paddle_tpu.static.analysis.comm_cost import record_comm_cost
     from paddle_tpu.static.analysis.cost import (M_MEASURED_PEAK,
                                                  M_PREDICTED_PEAK)
 
@@ -405,14 +423,55 @@ def bench_cost_model():
                              tolerance_pct=10, name="llama")
 
     est = estimate_peak_memory(prog, fetch)
+    exe = static.Executor()
     outs = static.Executor().run(prog, feed=feed, fetch_list=fetch,
                                  return_numpy=False)
     after = obs.sample_device_memory()
     measured_peak = max(after["watermark_bytes"] - before, 0)
     del outs
+    # Replay wall time of the compiled capture = the measured side of
+    # the step-time model (warm run first so compile time stays out).
+    exe.run(prog, feed=feed, fetch_list=fetch, return_numpy=False)
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        outs = exe.run(prog, feed=feed, fetch_list=fetch,
+                       return_numpy=False)
+    jax.block_until_ready(outs)
+    measured_step = (time.perf_counter() - t0) / reps
+    step_drift = check_step_time_model(pc.predicted_step_seconds,
+                                       measured_step,
+                                       tolerance_pct=900, name="llama")
     if obs.enabled():
         M_PREDICTED_PEAK.set(int(est.peak_bytes), name="llama")
         M_MEASURED_PEAK.set(int(measured_peak), name="llama")
+
+    comm_bytes = 0
+    if len(jax.devices()) >= 2:
+        from paddle_tpu.distributed.auto_parallel import (
+            DistTensorSpec, ProcessMesh, Shard, complete_placements)
+
+        mesh = ProcessMesh([0, 1], dim_names=["mp"])
+        # Seed the largest even-sized 2-D placeholder column-parallel so
+        # the derived plan actually communicates (unseeded completion
+        # replicates everything and prices zero comm).
+        seeds = {}
+        best = None
+        for _name, vid, shape, _dtype in prog._placeholders:
+            if len(shape) >= 2 and shape[-1] % 2 == 0:
+                size = int(np.prod(shape))
+                if best is None or size > best[0]:
+                    best = (size, vid, shape)
+        if best is not None:
+            _, vid, shape = best
+            pl = [Shard(len(shape) - 1)]
+            seeds[vid] = DistTensorSpec(shape, mesh, pl)
+        specs = complete_placements(prog, mesh, seeds)
+        pc_sharded = program_cost(prog, fetch, placements=specs)
+        if pc_sharded.comm is not None:
+            record_comm_cost(pc_sharded.comm, "llama")
+            comm_bytes = pc_sharded.comm.total_bytes
+
     err = (abs(pc.flops - measured_flops) / measured_flops * 100
            if measured_flops else None)
     print(json.dumps({"cost_model": {
@@ -423,6 +482,10 @@ def bench_cost_model():
         "predicted_peak_hbm_bytes": int(est.peak_bytes),
         "measured_peak_hbm_bytes": int(measured_peak),
         "peak_op_index": est.peak_op_index,
+        "predicted_step_seconds": round(pc.predicted_step_seconds, 6),
+        "measured_step_seconds": round(measured_step, 6),
+        "step_drift_ptl304": len(step_drift),
+        "comm_predicted_bytes_2way": comm_bytes,
     }}), flush=True)
 
 

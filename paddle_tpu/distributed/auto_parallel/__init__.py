@@ -1,5 +1,8 @@
 """Auto-parallel namespace (reference: python/paddle/distributed/auto_parallel/)."""
-from .placement import Partial, Placement, ProcessMesh, Replicate, Shard
+from .placement import (
+    Partial, Placement, ProcessMesh, Replicate, Shard,
+    dp_mp_mesh_candidates,
+)
 from .api import (
     ShardDataloader, ShardingStage1, ShardingStage2, ShardingStage3,
     dtensor_from_fn, reshard, shard_dataloader, shard_layer, shard_optimizer,
@@ -11,4 +14,7 @@ from .strategy import Strategy
 from . import spmd_rules
 from .spmd_rules import DistTensorSpec, get_spmd_rule, register_spmd_rule
 from . import completion
-from .completion import complete_placements, derive_shard_plan
+from .completion import (
+    PlanSearchResult, ScoredPlan, complete_placements, derive_shard_plan,
+    search_shard_plans,
+)

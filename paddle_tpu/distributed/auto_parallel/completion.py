@@ -54,13 +54,15 @@ from __future__ import annotations
 
 import os
 import warnings
+from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .placement import Placement, ProcessMesh, Replicate, Shard
 from .spmd_rules import DistTensorSpec, get_spmd_rule
 
 __all__ = ["complete_placements", "derive_shard_plan",
-           "apply_replacement_suggestions", "REPLACEMENT_ENV_FLAG"]
+           "apply_replacement_suggestions", "search_shard_plans",
+           "ScoredPlan", "PlanSearchResult", "REPLACEMENT_ENV_FLAG"]
 
 #: env switch: feed PTL202 placement findings back into completion as
 #: re-placement seeds (the lint->plan loop — findings become plan
@@ -432,52 +434,210 @@ def complete_placements(prog, mesh: ProcessMesh,
     return specs
 
 
+def _avals_from_env(prog, env: Dict[int, object]) -> Dict[int, tuple]:
+    """cost-model avals (shape, dtype) from the eval_shape env — so the
+    scoring walks below reuse the shapes completion already computed
+    instead of re-running shape inference per candidate plan. The env
+    skips ``__gradients__``, so grad outputs take their weight's aval
+    (a gradient is shaped like its parameter — the same fill
+    ``verify.propagate_avals`` does)."""
+    import numpy as np
+
+    avals = {}
+    for vid, s in env.items():
+        try:
+            avals[vid] = (tuple(s.shape), np.dtype(s.dtype))
+        except TypeError:
+            continue  # extended dtypes (PRNG keys): unknown to the model
+    for name, in_vids, _static, out_vids in prog._insts:
+        if name == "__gradients__":
+            for v, w in zip(out_vids, in_vids[1:]):
+                if w in avals:
+                    avals.setdefault(v, avals[w])
+    return avals
+
+
+def _plan_score(prog, specs: Dict[int, DistTensorSpec],
+                avals: Dict[int, tuple], params=None) -> tuple:
+    """(PTL202 finding count, predicted step seconds) for one completed
+    plan — the lexicographic objective of the replacement loop and the
+    search: first never regress the lint's own measure (forced
+    collectives), then break ties by the comm-aware step-time model
+    (the ISSUE-16 deterministic tiebreak; the old loop kept whichever
+    equal-count candidate came first)."""
+    from ...static.analysis.cost import program_cost
+    from ...static.analysis.sharding_lint import run_placement_lints
+
+    findings = len(run_placement_lints(prog, placements=specs))
+    step = program_cost(prog, placements=specs, avals=avals,
+                        params=params).predicted_step_seconds
+    return findings, step
+
+
 def apply_replacement_suggestions(prog, mesh: ProcessMesh,
                                   seeds: Dict[int, DistTensorSpec],
                                   env: Dict[int, object],
                                   specs: Dict[int, DistTensorSpec],
                                   max_rounds: int = 4,
                                   ) -> Dict[int, DistTensorSpec]:
-    """Feed PTL202 findings back into completion as re-placement seeds.
+    """Feed PTL202 findings back into completion as re-placement seeds,
+    ranked by PREDICTED STEP TIME.
 
-    Each round: lint the completed plan, apply every finding's
-    ``suggestion`` payload (built by ``static/analysis/sharding_lint``,
-    applied through the SHARED ``apply_placement_suggestion`` helper)
-    as a seed override, re-complete, re-lint — and KEEP the new plan
-    only when the finding count strictly drops (re-placement is a perf
-    adjustment; a suggestion that does not reduce forced collectives is
-    discarded, so the hook can never make a plan worse by its own
-    measure). Placements stay a cost choice, never a correctness one —
-    GSPMD executes any plan bit-identically, which the dense-oracle
-    test pins."""
+    Each round: lint the completed plan, build one candidate per
+    finding's ``suggestion`` payload (applied through the SHARED
+    ``apply_placement_suggestion`` helper) plus the all-suggestions-at-
+    once candidate, re-complete each, and score every candidate with
+    ``(finding count, predicted step seconds)`` — the step time from
+    ``cost.program_cost`` under the comm model
+    (``static/analysis/comm_cost.py``). The best candidate is kept only
+    when its score is strictly lower than the current plan's, so the
+    hook can never return a plan the lint scores WORSE than the derived
+    one (the oracle test pins this), and two candidates that tie on
+    finding count resolve deterministically by predicted comm volume
+    instead of keeping whichever came first. Placements stay a cost
+    choice, never a correctness one — GSPMD executes any plan
+    bit-identically, which the dense-oracle test pins."""
     from ...static.analysis.sharding_lint import (
         apply_placement_suggestion, run_placement_lints)
 
     seeds = dict(seeds)
-    report = run_placement_lints(prog, placements=specs)
+    avals = _avals_from_env(prog, env)
+    score = _plan_score(prog, specs, avals)
     for _round in range(max_rounds):
+        report = run_placement_lints(prog, placements=specs)
         suggestions = [d.suggestion for d in report.by_code("PTL202")
                        if d.suggestion]
         if not suggestions:
             break
-        applied = 0
-        for s in suggestions:
-            vid = s.get("vid")
-            base = seeds.get(vid, specs.get(vid))
-            if vid is None or base is None:
+
+        def seeded(suggs) -> Optional[Dict[int, DistTensorSpec]]:
+            out, applied = dict(seeds), 0
+            for s in suggs:
+                vid = s.get("vid")
+                base = out.get(vid, specs.get(vid))
+                if vid is None or base is None:
+                    continue
+                new_spec = apply_placement_suggestion(base, s)
+                if new_spec.placements != list(base.placements):
+                    out[vid] = new_spec
+                    applied += 1
+            return out if applied else None
+
+        candidates = [seeded(suggestions)] \
+            + [seeded([s]) for s in suggestions]
+        best = None
+        for cand_seeds in candidates:
+            if cand_seeds is None:
                 continue
-            new_spec = apply_placement_suggestion(base, s)
-            if new_spec.placements != list(base.placements):
-                seeds[vid] = new_spec
-                applied += 1
-        if not applied:
-            break
-        new_specs = _complete_once(prog, mesh, seeds, env)
-        new_report = run_placement_lints(prog, placements=new_specs)
-        if len(new_report) >= len(report):
-            break  # no measured benefit: keep the original plan
-        specs, report = new_specs, new_report
+            cand_specs = _complete_once(prog, mesh, cand_seeds, env)
+            cand_score = _plan_score(prog, cand_specs, avals)
+            if best is None or cand_score < best[0]:
+                best = (cand_score, cand_seeds, cand_specs)
+        if best is None or best[0] >= score:
+            break  # no predicted benefit: keep the current plan
+        score, seeds, specs = best
     return specs
+
+
+@dataclass
+class ScoredPlan:
+    """One candidate of :func:`search_shard_plans`, priced."""
+
+    label: str
+    mesh: ProcessMesh
+    specs: Dict[int, DistTensorSpec] = field(repr=False)
+    predicted_step_seconds: float = 0.0
+    compute_seconds: float = 0.0
+    comm_seconds: float = 0.0
+    findings: int = 0   # PTL202 forced-collective count of the plan
+
+
+@dataclass
+class PlanSearchResult:
+    """Ranked outcome of one auto-sharding search: plans by predicted
+    step time (fastest first) plus the PTL305 report when a candidate
+    beats the baseline (first candidate passed in)."""
+
+    ranked: List[ScoredPlan] = field(default_factory=list)
+    baseline: Optional[ScoredPlan] = None
+    report: Optional[object] = None  # DiagnosticReport
+
+    @property
+    def best(self) -> Optional[ScoredPlan]:
+        return self.ranked[0] if self.ranked else None
+
+    def render(self) -> str:
+        lines = ["auto-sharding search, plans by predicted step time"]
+        for p in self.ranked:
+            tag = " <- baseline" if self.baseline is not None \
+                and p.label == self.baseline.label else ""
+            lines.append(
+                f"  {p.label:<16} {p.predicted_step_seconds * 1e3:9.3f}ms "
+                f"(comm {p.comm_seconds * 1e3:.3f}ms, "
+                f"{p.findings} finding(s)){tag}")
+        return "\n".join(lines)
+
+
+def search_shard_plans(prog, candidates, *, fetch=None, params=None
+                       ) -> PlanSearchResult:
+    """Rank candidate (label, mesh, seeds) shard plans by PREDICTED
+    STEP TIME — the auto-sharding search the comm cost model makes
+    possible.
+
+    Each candidate is completed (``complete_placements``, with the
+    ``PADDLE_TPU_REPLACEMENT`` refinement loop per its usual gate) and
+    priced with ``cost.program_cost(placements=...)``: per-chip compute
+    and HBM seconds plus the alpha-beta price of every collective the
+    plan implies. The FIRST candidate is the baseline (the derived or
+    incumbent plan); when the search finds a plan predicted strictly
+    faster, the result carries a **PTL305** NOTE — informational by
+    design: the search proposes, the caller decides (a predicted win on
+    an uncalibrated model is a lead, not an order).
+
+    Use ``placement.dp_mp_mesh_candidates(n)`` to enumerate dp x mp
+    geometry splits as the candidate list."""
+    from ...static.analysis.cost import program_cost
+    from ...static.analysis.diagnostics import DiagnosticReport, Severity
+    from ...static.analysis.sharding_lint import run_placement_lints
+
+    env = _shape_env(prog)
+    result = PlanSearchResult(report=DiagnosticReport())
+    scored: List[ScoredPlan] = []
+    for label, mesh, seeds in candidates:
+        specs = complete_placements(prog, mesh, dict(seeds or {}),
+                                    env=env)
+        pc = program_cost(prog, fetch=fetch, placements=specs,
+                          avals=_avals_from_env(prog, env),
+                          params=params)
+        scored.append(ScoredPlan(
+            label=label, mesh=mesh, specs=specs,
+            predicted_step_seconds=pc.predicted_step_seconds,
+            compute_seconds=pc.compute_seconds,
+            comm_seconds=pc.comm_seconds,
+            findings=len(run_placement_lints(prog, placements=specs))))
+    if not scored:
+        return result
+    result.baseline = scored[0]
+    # stable sort: ties keep candidate order, so the baseline wins a tie
+    result.ranked = sorted(
+        scored, key=lambda p: p.predicted_step_seconds)
+    best = result.ranked[0]
+    base = result.baseline
+    if best.label != base.label and \
+            best.predicted_step_seconds < base.predicted_step_seconds:
+        saving = base.predicted_step_seconds - best.predicted_step_seconds
+        result.report.add(
+            "PTL305", Severity.NOTE,
+            f"auto-sharding search: plan {best.label!r} is predicted "
+            f"{saving * 1e3:.3f}ms/step faster than the baseline "
+            f"{base.label!r} ({best.predicted_step_seconds * 1e3:.3f}ms "
+            f"vs {base.predicted_step_seconds * 1e3:.3f}ms, comm "
+            f"{best.comm_seconds * 1e3:.3f}ms vs "
+            f"{base.comm_seconds * 1e3:.3f}ms)",
+            hint="informational: adopt the plan by re-deriving with its "
+                 "mesh/seeds, and validate the prediction against "
+                 "train.step_seconds (PTL304 guards the model itself)")
+    return result
 
 
 def _complete_once(prog, mesh: ProcessMesh,

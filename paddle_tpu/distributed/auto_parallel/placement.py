@@ -178,6 +178,28 @@ def auto_mesh(*dim_sizes, dim_names=None) -> ProcessMesh:
     return ProcessMesh(ids, dim_names)
 
 
+def dp_mp_mesh_candidates(n_devices: int, dp_axis: str = "dp",
+                          mp_axis: str = "mp"):
+    """Every ``dp x mp`` factorization of ``n_devices`` as a
+    ``(label, ProcessMesh)`` list — the geometry grid the predicted-
+    step-time search (``completion.search_shard_plans``) ranks. Ordered
+    dp-major (pure data-parallel first, pure model-parallel last), so
+    a caller treating the first entry as the baseline compares the
+    search's pick against the dp-only default."""
+    n = int(n_devices)
+    if n < 1:
+        raise ValueError(f"need at least one device, got {n_devices}")
+    out = []
+    for dp in range(n, 0, -1):
+        if n % dp:
+            continue
+        mp = n // dp
+        ids = np.arange(n).reshape(dp, mp)
+        out.append((f"{dp_axis}{dp}x{mp_axis}{mp}",
+                    ProcessMesh(ids, [dp_axis, mp_axis])))
+    return out
+
+
 def placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
                        ndim: int) -> PartitionSpec:
     """[Shard(0), Replicate()] over mesh dims → PartitionSpec per TENSOR dim.

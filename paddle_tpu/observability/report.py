@@ -169,6 +169,9 @@ def render_report(d: Dict[str, Any], max_events: int = 20,
             cost_lines = render_cost_table(metrics)
             if cost_lines:
                 lines += cost_lines + [""]
+            comm_lines = render_comm_table(metrics)
+            if comm_lines:
+                lines += comm_lines + [""]
         if g["counter"]:
             lines += ["Counters", "-" * (_WIDTH + 14)]
             lines += [f"{n[:_WIDTH]:<{_WIDTH}}{v:>14}"
@@ -251,23 +254,74 @@ def render_cost_table(metrics: Dict[str, Any]) -> List[str]:
     err = by_name("cost.model_flops_error_pct")
     pred_m = by_name("cost.predicted_peak_hbm_bytes")
     meas_m = by_name("cost.measured_peak_hbm_bytes")
+    pred_s = by_name("cost.predicted_step_seconds")
+    meas_s = by_name("cost.measured_step_seconds")
+    err_s = by_name("cost.model_step_error_pct")
     names = sorted(set(pred_f) | set(pred_m))
-    if not names:
+    step_names = sorted(set(pred_s) | set(meas_s))
+    if not names and not step_names:
         return []
 
     def fmt(v, f=_fmt_raw):
         return "-" if v is None else f(v)
 
-    header = (f"{'program':<16}{'pred flops':>14}{'xla flops':>14}"
-              f"{'err%':>8}{'pred peak':>12}{'measured':>12}")
-    lines = ["cost model, predicted vs measured", header,
+    lines: List[str] = []
+    if names:
+        header = (f"{'program':<16}{'pred flops':>14}{'xla flops':>14}"
+                  f"{'err%':>8}{'pred peak':>12}{'measured':>12}")
+        lines += ["cost model, predicted vs measured", header,
+                  "-" * len(header)]
+        for n in names:
+            lines.append(
+                f"{n[:16]:<16}{fmt(pred_f.get(n)):>14}"
+                f"{fmt(meas_f.get(n)):>14}{fmt(err.get(n)):>8}"
+                f"{fmt(pred_m.get(n), _fmt_bytes):>12}"
+                f"{fmt(meas_m.get(n), _fmt_bytes):>12}")
+    if step_names:
+        header2 = (f"{'program':<16}{'pred step':>14}{'measured':>14}"
+                   f"{'err%':>8}")
+        if lines:
+            lines.append("")
+        lines += ["step-time model, predicted vs measured "
+                  "(PTL304 guards the drift)", header2,
+                  "-" * len(header2)]
+        for n in step_names:
+            lines.append(
+                f"{n[:16]:<16}{fmt(pred_s.get(n), _fmt_secs):>14}"
+                f"{fmt(meas_s.get(n), _fmt_secs):>14}"
+                f"{fmt(err_s.get(n)):>8}")
+    return lines
+
+
+def render_comm_table(metrics: Dict[str, Any]) -> List[str]:
+    """Per-collective predicted comm-cost table
+    (``cost.comm_predicted_bytes``/``_seconds``, by program name +
+    collective kind) rendered next to the cost table — the analytical
+    decomposition of the step-time model's comm term, so "why is this
+    placement predicted slower" reads straight off the dump."""
+    rows: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for metric, col in (("cost.comm_predicted_bytes", "bytes"),
+                        ("cost.comm_predicted_seconds", "seconds")):
+        for s in (metrics.get(metric) or {}).get("series", []):
+            labels = s.get("labels") or {}
+            name, kind = labels.get("name"), labels.get("kind")
+            if name is None or kind is None:
+                continue
+            rows.setdefault((name, kind), {})[col] = s.get("value")
+    if not rows:
+        return []
+    header = (f"{'program':<16}{'collective':<16}{'wire bytes':>14}"
+              f"{'seconds':>12}")
+    lines = ["predicted comm cost, by collective kind", header,
              "-" * len(header)]
-    for n in names:
+    for (name, kind) in sorted(rows, key=lambda k: (
+            k[0], k[1] == "all", k[1])):  # per-kind rows, then the roll-up
+        r = rows[(name, kind)]
+        b, sec = r.get("bytes"), r.get("seconds")
         lines.append(
-            f"{n[:16]:<16}{fmt(pred_f.get(n)):>14}"
-            f"{fmt(meas_f.get(n)):>14}{fmt(err.get(n)):>8}"
-            f"{fmt(pred_m.get(n), _fmt_bytes):>12}"
-            f"{fmt(meas_m.get(n), _fmt_bytes):>12}")
+            f"{name[:16]:<16}{kind:<16}"
+            f"{'-' if b is None else _fmt_bytes(b):>14}"
+            f"{'-' if sec is None else _fmt_secs(float(sec)):>12}")
     return lines
 
 

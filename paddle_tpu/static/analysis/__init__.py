@@ -35,9 +35,15 @@ the primitive registry itself.
 """
 from __future__ import annotations
 
+from .comm_cost import (  # noqa: F401
+    COLLECTIVE_KINDS, Collective, CommCostResult, CommModelParams,
+    calibrate_comm_model, collective_cost, derive_collectives,
+    program_comm_cost, resolve_comm_params,
+)
 from .cost import (  # noqa: F401
     COST_ANALYSIS_CODES, OpCost, ProgramCost, check_cost_model,
-    measure_program_flops, op_cost, program_cost, register_op_cost,
+    check_step_time_model, measure_program_flops, op_cost, program_cost,
+    register_op_cost,
 )
 from .diagnostics import (  # noqa: F401
     CODES, Diagnostic, DiagnosticReport, ProgramVerificationError, Severity,
@@ -72,7 +78,11 @@ __all__ = [
     "SHARDING_LINT_CODES", "lint_fleet_trace", "run_placement_lints",
     "apply_placement_suggestion",
     "COST_ANALYSIS_CODES", "OpCost", "ProgramCost", "check_cost_model",
-    "measure_program_flops", "op_cost", "program_cost", "register_op_cost",
+    "check_step_time_model", "measure_program_flops", "op_cost",
+    "program_cost", "register_op_cost",
     "MemoryEstimate", "device_memory_budget", "estimate_peak_memory",
     "lint_memory_budget",
+    "COLLECTIVE_KINDS", "Collective", "CommCostResult", "CommModelParams",
+    "calibrate_comm_model", "collective_cost", "derive_collectives",
+    "program_comm_cost", "resolve_comm_params",
 ]

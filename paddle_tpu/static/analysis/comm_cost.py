@@ -1,0 +1,566 @@
+"""Analytical communication cost model over a placement table.
+
+Reference: the reference's auto_parallel cost layer prices collectives
+analytically (auto_parallel/static/cost/comm_op_cost.py —
+``AllreduceSumOpCost``/``IdentityOpCost`` with alpha/beta ring terms)
+and the planner searches shard plans against that model. Here the same
+layer lands on the flat ``Program`` instruction list + the
+``DistTensorSpec`` placement tables the completion pass derives:
+
+1. **Per-collective price** (:func:`collective_cost`): standard ring
+   formulas over a mesh group of ``n`` chips, with the payload defined
+   as the FULL (unsharded) logical tensor bytes:
+
+   - all-reduce       wire = 2(n-1)/n * payload,  n-1 + n-1 hops
+   - all-gather       wire =  (n-1)/n * payload,  n-1 hops
+   - reduce-scatter   wire =  (n-1)/n * payload,  n-1 hops
+   - all-to-all       wire = (n-1)/n^2 * payload, n-1 hops
+   - broadcast        wire =            payload,  n-1 hops
+   - p2p              wire =            payload,  1 hop
+
+   ``seconds = wire / link_bandwidth + hops * link_latency`` — the
+   alpha-beta model every collective paper and the reference's
+   CommOpCost use. ``n <= 1`` prices to zero (single-chip groups are
+   free by construction, same as the runtime collectives).
+
+2. **Which collectives a placement implies**
+   (:func:`derive_collectives`): walk the instruction list the way
+   ``sharding_lint.run_placement_lints`` does, but price BOTH the
+   legitimate collectives a consistent plan needs (matching
+   contracting-dim shards -> one psum per GEMM; data-parallel gradient
+   all-reduce at the ``__gradients__`` boundary) AND the avoidable ones
+   the PTL202 lint flags (contracting mismatch -> all-gather, layout
+   conflict -> resharding all-to-all, Partial consumed early ->
+   materializing all-reduce). The contracting-dim definition is shared
+   with the lint (``sharding_lint.matmul_contracting_dims``) so the
+   model can never price a different collective than the lint flags.
+
+3. **Calibration** (:func:`calibrate_comm_model`): least-squares
+   alpha-beta fit from the PR 5 ``comm.collective_calls/_bytes/
+   _seconds`` telemetry in a metrics dump — measured wall time per
+   (op, group) series regressed on calls (latency term) and bytes
+   (bandwidth term). ``PADDLE_TPU_COMM_PARAMS`` (inline JSON or a JSON
+   file path, written by ``tools/comm_calibrate.py``) feeds the fitted
+   parameters back into :func:`resolve_comm_params`, where
+   ``program_cost`` picks them up.
+
+``cost.program_cost(prog, placements=..., mesh=...)`` composes this
+with the PR 15 compute/bytes model into a full predicted step time
+``max(compute_seconds, memory_seconds) + comm_seconds`` — the number
+the auto-sharding search in ``auto_parallel/completion.py`` ranks
+plans by, and that PTL304 validates against measured
+``train.step_seconds``.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ... import observability as _obs
+from .cost import Aval, _nbytes, _resolve_fetch_vids, executed_op_indices
+from .sharding_lint import _MATMUL_PRIMS, _REDUCING_MARKERS, _elementwise, \
+    _partial_axes, _shard_axes, matmul_contracting_dims
+from .verify import GRAD_OP, propagate_avals
+
+__all__ = [
+    "CommModelParams", "Collective", "CommCostResult", "COMM_PARAMS_ENV",
+    "collective_cost", "derive_collectives", "program_comm_cost",
+    "resolve_comm_params", "calibrate_comm_model", "COLLECTIVE_KINDS",
+]
+
+#: env feed for calibrated parameters: inline JSON or a path to a JSON
+#: file holding {"link_bytes_per_second": ..., ...} — written by
+#: tools/comm_calibrate.py, read by resolve_comm_params() and therefore
+#: by every program_cost/search call that does not pass params=.
+COMM_PARAMS_ENV = "PADDLE_TPU_COMM_PARAMS"
+
+#: collective kinds the model prices (the ``kind`` vocabulary of
+#: :class:`Collective` and the per-kind tables in CommCostResult).
+COLLECTIVE_KINDS = ("all_reduce", "all_gather", "reduce_scatter",
+                    "all_to_all", "broadcast", "p2p")
+
+M_COMM_PREDICTED_BYTES = _obs.gauge(
+    "cost.comm_predicted_bytes",
+    "analytical per-chip wire bytes the placement table implies for a "
+    "program replay, by program name and collective kind")
+M_COMM_PREDICTED_SECONDS = _obs.gauge(
+    "cost.comm_predicted_seconds",
+    "analytical communication seconds (ring alpha-beta model) the "
+    "placement table implies for a program replay, by program name and "
+    "collective kind")
+
+
+@dataclass(frozen=True)
+class CommModelParams:
+    """Alpha-beta machine model for the step-time prediction.
+
+    Defaults are v5e-shaped nominal figures (1 ICI link ~45 GB/s on
+    the 2D torus -> ~9e10 effective with both directions; ~1 us per
+    hop; HBM ~819 GB/s; peak FLOPs from the same ladder MFU uses) —
+    honest enough for RANKING placements out of the box, and
+    :func:`calibrate_comm_model` replaces them with measured fits."""
+
+    link_bytes_per_second: float = 9e10
+    link_latency_seconds: float = 1e-6
+    flops_per_second: float = 0.0   # 0 -> default_peak_flops() ladder
+    hbm_bytes_per_second: float = 8.1e11
+
+    def resolved_flops_per_second(self) -> float:
+        if self.flops_per_second > 0:
+            return self.flops_per_second
+        from ...observability.runtime import default_peak_flops
+
+        return float(default_peak_flops())
+
+    def to_dict(self) -> Dict[str, float]:
+        return {
+            "link_bytes_per_second": self.link_bytes_per_second,
+            "link_latency_seconds": self.link_latency_seconds,
+            "flops_per_second": self.flops_per_second,
+            "hbm_bytes_per_second": self.hbm_bytes_per_second,
+        }
+
+
+def resolve_comm_params(params: Optional[CommModelParams] = None
+                        ) -> CommModelParams:
+    """``params`` if given, else the ``PADDLE_TPU_COMM_PARAMS`` env
+    override (inline JSON or a JSON file path — unknown keys ignored,
+    so a dump written by a newer tool still loads), else defaults."""
+    if params is not None:
+        return params
+    env = os.environ.get(COMM_PARAMS_ENV)
+    if not env:
+        return CommModelParams()
+    try:
+        if env.lstrip().startswith("{"):
+            d = json.loads(env)
+        else:
+            with open(env) as f:
+                d = json.load(f)
+        fields_ = CommModelParams().to_dict()
+        return CommModelParams(**{k: float(v) for k, v in d.items()
+                                  if k in fields_})
+    except (OSError, ValueError, TypeError):
+        return CommModelParams()
+
+
+# ring wire-traffic fraction of the full payload, and hop count, by kind
+def _ring_terms(kind: str, n: int) -> Tuple[float, int]:
+    if kind == "all_reduce":
+        return 2.0 * (n - 1) / n, 2 * (n - 1)
+    if kind in ("all_gather", "reduce_scatter"):
+        return (n - 1) / n, n - 1
+    if kind == "all_to_all":
+        return (n - 1) / (n * n), n - 1
+    if kind == "broadcast":
+        return 1.0, n - 1
+    if kind == "p2p":
+        return 1.0, 1
+    raise ValueError(f"unknown collective kind {kind!r} "
+                     f"(known: {COLLECTIVE_KINDS})")
+
+
+def collective_cost(kind: str, payload_bytes: int, group_size: int,
+                    params: Optional[CommModelParams] = None
+                    ) -> Tuple[int, float]:
+    """(per-chip wire bytes, seconds) of one collective over a group of
+    ``group_size`` chips, ``payload_bytes`` being the FULL unsharded
+    logical tensor (ring formulas in the module docstring). A group of
+    one chip is free — XLA elides the collective entirely."""
+    n = int(group_size)
+    if n <= 1 or payload_bytes <= 0:
+        return 0, 0.0
+    params = resolve_comm_params(params)
+    frac, hops = _ring_terms(kind, n)
+    wire = int(payload_bytes * frac)
+    seconds = wire / params.link_bytes_per_second \
+        + hops * params.link_latency_seconds
+    return wire, seconds
+
+
+@dataclass(frozen=True)
+class Collective:
+    """One collective a placement table implies for one instruction."""
+
+    kind: str                  # one of COLLECTIVE_KINDS
+    op_index: int              # instruction that forces it
+    vid: int                   # the value moved/reduced
+    payload_bytes: int         # full logical tensor bytes
+    group_size: int            # chips in the group (mesh-axes product)
+    mesh_axes: Tuple[int, ...] # mesh axes the group spans
+    reason: str                # human-readable why
+    wire_bytes: int = 0        # per-chip ring traffic (priced)
+    seconds: float = 0.0       # alpha-beta model seconds (priced)
+
+
+@dataclass
+class CommCostResult:
+    """All collectives one (program, placements) pair implies, priced."""
+
+    collectives: List[Collective] = field(default_factory=list)
+    bytes_by_kind: Dict[str, int] = field(default_factory=dict)
+    seconds_by_kind: Dict[str, float] = field(default_factory=dict)
+    seconds_by_op_index: Dict[int, float] = field(default_factory=dict)
+    total_bytes: int = 0
+    total_seconds: float = 0.0
+    params: CommModelParams = field(default_factory=CommModelParams)
+
+    def render(self) -> str:
+        per = ", ".join(
+            f"{k}={self.bytes_by_kind[k]:,}B/"
+            f"{self.seconds_by_kind[k] * 1e6:.1f}us"
+            for k in sorted(self.bytes_by_kind))
+        return (f"comm cost: {len(self.collectives)} collective(s), "
+                f"{self.total_bytes:,} wire B, "
+                f"{self.total_seconds * 1e6:.1f}us ({per or 'none'})")
+
+
+def _group(spec, axes: Iterable[int]) -> Tuple[Tuple[int, ...], int]:
+    axes = tuple(sorted(set(axes)))
+    n = 1
+    for a in axes:
+        n *= int(spec.mesh.shape[a])
+    return axes, n
+
+
+def _shard_divisor_excluding(spec, excluded: Iterable[int]) -> int:
+    """Bytes divisor counting Shard axes OUTSIDE ``excluded`` — the
+    per-chip size of a value whose ``excluded`` axes the collective is
+    about to traverse (those axes' sharding IS the payload split the
+    ring formula already accounts for)."""
+    if spec is None:
+        return 1
+    excluded = set(excluded)
+    div = 1
+    for axis, p in enumerate(spec.placements):
+        if p.is_shard() and axis not in excluded:
+            div *= int(spec.mesh.shape[axis])
+    return max(div, 1)
+
+
+def derive_collectives(prog, placements: Dict[int, Any],
+                       fetch=None,
+                       avals: Optional[Dict[int, Aval]] = None
+                       ) -> List[Collective]:
+    """The collectives ``placements`` implies for ``prog``'s live ops —
+    unpriced (:func:`program_comm_cost` adds wire bytes + seconds).
+
+    Walks the instruction list exactly like
+    ``sharding_lint.run_placement_lints`` (same matmul prim set, same
+    shared contracting-dim helper, same elementwise family, same
+    reducing-consumer markers) and emits:
+
+    - matching contracting-dim shards on a matmul -> ONE combine of the
+      output over those axes: ``reduce_scatter`` when the output spec
+      keeps a Shard on a contracting mesh axis, ``all_reduce``
+      otherwise; skipped while the output spec still says Partial there
+      (the psum is deferred until a non-reducing consumer forces it —
+      priced by the Partial walk below, at the consumer);
+    - MISmatched contracting shards -> ``all_gather`` of each operand
+      whose extra axes the partitioner must unshard (the avoidable
+      collective PTL202 flags);
+    - conflicting elementwise layouts -> resharding ``all_to_all`` of
+      the later operand (PTL202's other family);
+    - a Partial value consumed by a non-reducing op -> materializing
+      ``all_reduce`` over its partial axes, charged ONCE per value (it
+      materializes once, then every later consumer reads the result);
+    - the ``__gradients__`` boundary -> data-parallel gradient
+      ``all_reduce`` of each grad output over the mesh axes that shard
+      a data placeholder but not the grad itself.
+    """
+    avals = avals if avals is not None else propagate_avals(prog)
+    insts = list(prog._insts)
+    fetch_vids = _resolve_fetch_vids(prog, fetch)
+    kept = executed_op_indices(insts, fetch_vids) if fetch_vids \
+        else set(range(len(insts)))
+
+    # mesh axes that shard any data placeholder = the dp-like axes whose
+    # per-chip grads differ and need the gradient psum
+    data_axes: set = set()
+    for _name, vid, _shape, _dtype in prog._placeholders:
+        s = placements.get(vid)
+        if s is not None:
+            for axis, p in enumerate(s.placements):
+                if p.is_shard():
+                    data_axes.add(axis)
+
+    out: List[Collective] = []
+    materialized: set = set()  # vids whose Partial psum is already charged
+
+    def payload(vid, spec, traversed_axes) -> int:
+        return _nbytes(avals.get(vid)) \
+            // _shard_divisor_excluding(spec, traversed_axes)
+
+    for idx, (prim_name, in_vids, static_items, out_vids) in \
+            enumerate(insts):
+        if idx not in kept:
+            continue
+        try:
+            attrs = dict(static_items)
+        except (TypeError, ValueError):
+            attrs = {}
+
+        if prim_name == GRAD_OP:
+            for gv in out_vids:
+                gs = placements.get(gv)
+                axes = sorted(
+                    a for a in data_axes
+                    if gs is None or not (gs.placements[a].is_shard()
+                                          or gs.placements[a].is_partial()))
+                if not axes:
+                    continue
+                ref = gs if gs is not None else next(
+                    iter(placements.values()), None)
+                if ref is None:
+                    continue
+                gaxes, n = _group(ref, axes)
+                if n <= 1:
+                    continue
+                out.append(Collective(
+                    "all_reduce", idx, gv,
+                    payload(gv, gs, gaxes), n, gaxes,
+                    "data-parallel gradient all-reduce (grad replicated "
+                    "on a mesh axis that shards the data)"))
+            continue
+
+        # Partial consumed by a non-reducing op: the pending psum
+        # materializes here (charged once per value)
+        if not any(m in prim_name.lower() for m in _REDUCING_MARKERS):
+            for v in in_vids:
+                s = placements.get(v)
+                if s is None or v in materialized:
+                    continue
+                paxes = _partial_axes(s)
+                if not paxes:
+                    continue
+                materialized.add(v)
+                gaxes, n = _group(s, paxes)
+                out.append(Collective(
+                    "all_reduce", idx, v, payload(v, s, gaxes), n, gaxes,
+                    "Partial value materialized by a non-reducing "
+                    "consumer"))
+
+        if prim_name in _MATMUL_PRIMS and len(in_vids) >= 2:
+            x = placements.get(in_vids[0])
+            w = placements.get(in_vids[1])
+            if x is not None and w is not None and x.ndim >= 1 \
+                    and w.ndim >= 1:
+                x_c, w_c = matmul_contracting_dims(attrs, x.ndim, w.ndim)
+                ax_x = set(_shard_axes(x, x_c))
+                ax_w = set(_shard_axes(w, w_c))
+                shared = ax_x & ax_w
+                if shared and out_vids:
+                    ov = out_vids[0]
+                    os_ = placements.get(ov)
+                    partial_there = os_ is not None and any(
+                        a in shared for a in _partial_axes(os_))
+                    if not partial_there:
+                        gaxes, n = _group(x, shared)
+                        shard_there = os_ is not None and any(
+                            os_.placements[a].is_shard() for a in shared)
+                        kind = "reduce_scatter" if shard_there \
+                            else "all_reduce"
+                        out.append(Collective(
+                            kind, idx, ov, payload(ov, os_, gaxes), n,
+                            gaxes,
+                            "contraction split over the mesh: one psum "
+                            "combines the per-chip partial GEMMs"))
+                for vid_o, spec_o, extra in (
+                        (in_vids[0], x, ax_x - ax_w),
+                        (in_vids[1], w, ax_w - ax_x)):
+                    if not extra:
+                        continue
+                    gaxes, n = _group(spec_o, extra)
+                    if n <= 1:
+                        continue
+                    out.append(Collective(
+                        "all_gather", idx, vid_o,
+                        payload(vid_o, spec_o, gaxes), n, gaxes,
+                        "contracting dim sharded on one operand only: "
+                        "the partitioner must allgather it before the "
+                        "contraction (PTL202)"))
+            continue
+
+        if not _elementwise(prim_name):
+            continue
+        known = [(v, placements.get(v)) for v in in_vids
+                 if placements.get(v) is not None
+                 and v not in prog._consts]
+        for i in range(len(known)):
+            for j in range(i + 1, len(known)):
+                (_va, sa), (vb, sb) = known[i], known[j]
+                if sa.shape != sb.shape or sa.ndim == 0:
+                    continue
+                conflict_axes: set = set()
+                for d in range(sa.ndim):
+                    axa, axb = _shard_axes(sa, d), _shard_axes(sb, d)
+                    if axa and axb and set(axa) != set(axb):
+                        conflict_axes = set(axa) | set(axb)
+                        break
+                if not conflict_axes:
+                    ma = {a: d for d in range(sa.ndim)
+                          for a in _shard_axes(sa, d)}
+                    mb = {a: d for d in range(sb.ndim)
+                          for a in _shard_axes(sb, d)}
+                    for a in sorted(set(ma) & set(mb)):
+                        if ma[a] != mb[a]:
+                            conflict_axes = {a}
+                            break
+                if conflict_axes:
+                    gaxes, n = _group(sb, conflict_axes)
+                    if n > 1:
+                        out.append(Collective(
+                            "all_to_all", idx, vb,
+                            payload(vb, sb, gaxes), n, gaxes,
+                            "conflicting elementwise layouts: one "
+                            "operand resharded before the op (PTL202)"))
+                    break  # one reshard fixes this operand pair set
+    return out
+
+
+def program_comm_cost(prog, placements: Dict[int, Any], *,
+                      fetch=None,
+                      avals: Optional[Dict[int, Aval]] = None,
+                      params: Optional[CommModelParams] = None
+                      ) -> CommCostResult:
+    """Derive + price every collective ``placements`` implies for the
+    live ops of ``prog``: the comm half of the predicted step time
+    ``cost.program_cost`` returns."""
+    params = resolve_comm_params(params)
+    result = CommCostResult(params=params)
+    for c in derive_collectives(prog, placements, fetch=fetch,
+                                avals=avals):
+        wire, seconds = collective_cost(
+            c.kind, c.payload_bytes, c.group_size, params)
+        c = replace(c, wire_bytes=wire, seconds=seconds)
+        result.collectives.append(c)
+        result.bytes_by_kind[c.kind] = \
+            result.bytes_by_kind.get(c.kind, 0) + wire
+        result.seconds_by_kind[c.kind] = \
+            result.seconds_by_kind.get(c.kind, 0.0) + seconds
+        result.seconds_by_op_index[c.op_index] = \
+            result.seconds_by_op_index.get(c.op_index, 0.0) + seconds
+        result.total_bytes += wire
+        result.total_seconds += seconds
+    return result
+
+
+def record_comm_cost(result: CommCostResult, name: str) -> None:
+    """Publish a CommCostResult to the ``cost.comm_predicted_*`` gauges
+    (by program name + collective kind, with an ``all`` roll-up kind),
+    where the report tables and the bench roll-up read them."""
+    if not _obs.state.on:
+        return
+    for kind in result.bytes_by_kind:
+        M_COMM_PREDICTED_BYTES.set(int(result.bytes_by_kind[kind]),
+                                   name=name, kind=kind)
+        M_COMM_PREDICTED_SECONDS.set(
+            round(result.seconds_by_kind[kind], 9), name=name, kind=kind)
+    M_COMM_PREDICTED_BYTES.set(int(result.total_bytes), name=name,
+                               kind="all")
+    M_COMM_PREDICTED_SECONDS.set(round(result.total_seconds, 9),
+                                 name=name, kind="all")
+
+
+# ---------------------------------------------------------------------------
+# calibration from telemetry
+# ---------------------------------------------------------------------------
+
+def _metric_series(metrics: Dict[str, Any], name: str) -> List[dict]:
+    m = metrics.get(name) or {}
+    return list(m.get("series") or [])
+
+
+def calibrate_comm_model(metrics: Dict[str, Any],
+                         base: Optional[CommModelParams] = None
+                         ) -> CommModelParams:
+    """Alpha-beta fit from ``comm.collective_*`` telemetry in a metrics
+    dump (the dict ``observability.dump()`` writes, or its inner
+    ``metrics`` mapping).
+
+    Per (op, group) series the runtime recorded ``calls`` invocations
+    moving ``bytes`` payload in ``seconds`` total wall time; least
+    squares over the series solves ``seconds = alpha * calls +
+    bytes / beta`` — alpha lands in ``link_latency_seconds`` (per-call
+    launch+hop latency) and beta in ``link_bytes_per_second``
+    (effective achieved bandwidth, ring factor absorbed, which is
+    exactly what the predictor wants since it prices wire bytes with
+    the same ring fractions the runtime paid). Degenerate inputs
+    (no series, zero bytes, singular normal equations) keep the
+    ``base`` / default parameters for the missing term rather than
+    inventing one. Fits are clamped non-negative."""
+    if "metrics" in metrics and isinstance(metrics.get("metrics"), dict):
+        metrics = metrics["metrics"]
+    base = base or CommModelParams()
+
+    calls_by = {tuple(sorted((s.get("labels") or {}).items())):
+                float(s.get("value", 0))
+                for s in _metric_series(metrics, "comm.collective_calls")}
+    bytes_by = {tuple(sorted((s.get("labels") or {}).items())):
+                float(s.get("value", 0))
+                for s in _metric_series(metrics, "comm.collective_bytes")}
+    pts: List[Tuple[float, float, float]] = []   # (calls, bytes, seconds)
+    for s in _metric_series(metrics, "comm.collective_seconds"):
+        key = tuple(sorted((s.get("labels") or {}).items()))
+        secs = float(s.get("sum", 0.0) or 0.0)
+        c = calls_by.get(key, float(s.get("count", 0) or 0))
+        b = bytes_by.get(key, 0.0)
+        if c > 0 and secs > 0:
+            pts.append((c, b, secs))
+    if not pts:
+        return base
+
+    # normal equations for seconds = alpha*calls + gamma*bytes
+    scc = sum(c * c for c, _b, _s in pts)
+    sbb = sum(b * b for _c, b, _s in pts)
+    scb = sum(c * b for c, b, _s in pts)
+    scs = sum(c * s for c, _b, s in pts)
+    sbs = sum(b * s for _c, b, s in pts)
+    det = scc * sbb - scb * scb
+    alpha = gamma = None
+    if sbb > 0 and abs(det) > 1e-12 * max(scc * sbb, 1.0):
+        alpha = (scs * sbb - sbs * scb) / det
+        gamma = (scc * sbs - scb * scs) / det
+    if gamma is None or gamma <= 0:
+        # bandwidth-only fallback: all measured seconds charged to bytes
+        total_b = sum(b for _c, b, _s in pts)
+        total_s = sum(s for _c, _b, s in pts)
+        gamma = total_s / total_b if total_b > 0 else None
+        alpha = None
+    if alpha is None or alpha < 0:
+        # latency fallback: residual seconds per call after the
+        # bandwidth term (non-negative by clamping)
+        total_c = sum(c for c, _b, _s in pts)
+        resid = sum(s - (gamma or 0.0) * b for _c, b, s in pts)
+        alpha = max(resid / total_c, 0.0) if total_c > 0 else \
+            base.link_latency_seconds
+    return CommModelParams(
+        link_bytes_per_second=(1.0 / gamma) if gamma and gamma > 0
+        else base.link_bytes_per_second,
+        link_latency_seconds=alpha,
+        flops_per_second=base.flops_per_second,
+        hbm_bytes_per_second=base.hbm_bytes_per_second)
+
+
+def calibrate_step_time_model(metrics: Dict[str, Any],
+                              predicted_flops: float,
+                              base: Optional[CommModelParams] = None
+                              ) -> CommModelParams:
+    """Extend :func:`calibrate_comm_model` with a compute-rate fit:
+    achieved ``flops_per_second = predicted_flops / mean
+    train.step_seconds`` from the same dump — the single-program
+    calibration the CPU-bound test suite needs (XLA:CPU achieves a few
+    GF/s, nowhere near any nominal peak), and a no-op when the dump has
+    no step timings."""
+    params = calibrate_comm_model(metrics, base=base)
+    m = metrics.get("metrics") if isinstance(metrics.get("metrics"), dict) \
+        else metrics
+    for s in _metric_series(m or {}, "train.step_seconds"):
+        cnt = float(s.get("count", 0) or 0)
+        tot = float(s.get("sum", 0.0) or 0.0)
+        if cnt > 0 and tot > 0 and predicted_flops > 0:
+            return replace(params,
+                           flops_per_second=predicted_flops / (tot / cnt))
+    return params

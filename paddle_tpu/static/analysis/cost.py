@@ -53,14 +53,16 @@ from .verify import GRAD_OP, propagate_avals
 __all__ = [
     "OpCost", "ProgramCost", "op_cost", "register_op_cost",
     "program_cost", "measure_program_flops", "check_cost_model",
-    "executed_op_indices", "COST_ANALYSIS_CODES",
+    "check_step_time_model", "executed_op_indices",
+    "COST_ANALYSIS_CODES",
 ]
 
 #: the diagnostic codes the cost/memory analysis layer can file —
 #: audited by tools/lint_registry.py the same way lint.LINTS and the
 #: sharding-lint codes are (documented in diagnostics.CODES, exercised
-#: by at least one test).
-COST_ANALYSIS_CODES = ("PTL301", "PTL302", "PTL303")
+#: by at least one test). PTL304/305 belong to the step-time model +
+#: auto-sharding search (comm_cost.py + auto_parallel/completion.py).
+COST_ANALYSIS_CODES = ("PTL301", "PTL302", "PTL303", "PTL304", "PTL305")
 
 M_PREDICTED_FLOPS = _obs.gauge(
     "cost.predicted_flops",
@@ -85,6 +87,21 @@ M_MEASURED_PEAK = _obs.gauge(
     "device.hbm_watermark_bytes observed when the predicted-vs-measured "
     "comparison ran, by program name (copied next to the prediction so "
     "one dump renders the whole table)")
+M_PREDICTED_STEP = _obs.gauge(
+    "cost.predicted_step_seconds",
+    "predicted step time max(compute, memory) + comm of a program "
+    "replay under its placement table, by program name (the number the "
+    "auto-sharding search ranks plans by)")
+M_MEASURED_STEP = _obs.gauge(
+    "cost.measured_step_seconds",
+    "mean measured train.step_seconds observed when the predicted-vs-"
+    "measured step-time comparison ran, by program name (copied next "
+    "to the prediction so one dump renders the whole table)")
+M_STEP_ERROR = _obs.gauge(
+    "cost.model_step_error_pct",
+    "percent error of the predicted step time vs measured "
+    "train.step_seconds, by program name (PTL304 fires when it exceeds "
+    "tolerance)")
 M_ESTIMATE_SECONDS = _obs.histogram(
     "cost.estimate_seconds",
     "wall time of one static cost/memory estimate, by analysis kind")
@@ -118,13 +135,29 @@ class ProgramCost:
     flops_by_prim: Dict[str, int] = field(default_factory=dict)
     live_ops: int = 0
     unknown_avals: int = 0
+    #: step-time decomposition (comm_cost.CommModelParams machine
+    #: model): per-chip FLOPs / achieved rate, per-chip HBM traffic /
+    #: bandwidth, the alpha-beta comm model over the placement table,
+    #: and the roofline-style composite the auto-sharding search ranks
+    #: plans by. comm holds the full CommCostResult (None when no
+    #: placements were given — a single-chip replay has no comm).
+    compute_seconds: float = 0.0
+    memory_seconds: float = 0.0
+    comm_seconds: float = 0.0
+    predicted_step_seconds: float = 0.0
+    seconds_by_op: List[float] = field(default_factory=list)
+    comm: Optional[object] = None  # comm_cost.CommCostResult
 
     def render(self) -> str:
         top = sorted(self.flops_by_prim.items(), key=lambda kv: -kv[1])[:8]
         per = ", ".join(f"{k}={v:,}" for k, v in top)
         return (f"program cost: {self.flops:,} flops over {self.live_ops} "
                 f"live op(s), {self.bytes_read:,}B read / "
-                f"{self.bytes_written:,}B written ({per})")
+                f"{self.bytes_written:,}B written, predicted step "
+                f"{self.predicted_step_seconds * 1e3:.3f}ms "
+                f"(compute {self.compute_seconds * 1e3:.3f} / memory "
+                f"{self.memory_seconds * 1e3:.3f} / comm "
+                f"{self.comm_seconds * 1e3:.3f}) ({per})")
 
 
 Aval = Tuple[Tuple[int, ...], np.dtype]
@@ -360,8 +393,9 @@ def _compute_divisor(spec) -> int:
     return max(div, 1)
 
 
-def program_cost(program, fetch=None, *, placements=None,
-                 avals: Optional[Dict[int, Aval]] = None) -> ProgramCost:
+def program_cost(program, fetch=None, *, placements=None, mesh=None,
+                 avals: Optional[Dict[int, Aval]] = None,
+                 params=None) -> ProgramCost:
     """Walk the program once and sum per-op costs over the LIVE ops.
 
     ``fetch`` (Tensors or vids; falls back to a recorded
@@ -371,10 +405,51 @@ def program_cost(program, fetch=None, *, placements=None,
     divide by its shard count (Partial values occupy full shape on
     every chip), and each op's FLOPs divide by its output's COMPUTE
     split — Shard axes plus Partial axes, so a row-parallel matmul
-    whose output is Partial still counts as contraction-split."""
+    whose output is Partial still counts as contraction-split.
+    ``mesh`` alone (a ProcessMesh, no placements) derives the table
+    via ``auto_parallel.completion.complete_placements`` first.
+
+    The result also carries the PREDICTED STEP TIME under the
+    ``comm_cost.CommModelParams`` machine model (``params``, default
+    ``resolve_comm_params()`` — calibrated via
+    ``PADDLE_TPU_COMM_PARAMS``): ``max(compute_seconds,
+    memory_seconds) + comm_seconds``, where the comm term prices every
+    collective the placement table implies (ring alpha-beta model,
+    ``comm_cost.program_comm_cost``). Without placements the comm term
+    is zero — a single-chip replay has no collectives."""
     with _obs.span("cost.program_cost", histogram=M_ESTIMATE_SECONDS,
                    hist_labels={"kind": "flops"}):
-        return _program_cost(program, fetch, placements, avals)
+        from .comm_cost import program_comm_cost, resolve_comm_params
+
+        if placements is None and mesh is not None:
+            from ...distributed.auto_parallel.completion import \
+                complete_placements
+
+            placements = complete_placements(program, mesh, {})
+        avals = avals if avals is not None else propagate_avals(program)
+        result = _program_cost(program, fetch, placements, avals)
+        params = resolve_comm_params(params)
+        flops_rate = params.resolved_flops_per_second()
+        result.compute_seconds = result.flops / flops_rate
+        result.memory_seconds = (result.bytes_read
+                                 + result.bytes_written) \
+            / params.hbm_bytes_per_second
+        comm_by_op: Dict[int, float] = {}
+        if placements:
+            result.comm = program_comm_cost(
+                program, placements, fetch=fetch, avals=avals,
+                params=params)
+            result.comm_seconds = result.comm.total_seconds
+            comm_by_op = result.comm.seconds_by_op_index
+        result.predicted_step_seconds = \
+            max(result.compute_seconds, result.memory_seconds) \
+            + result.comm_seconds
+        result.seconds_by_op = [
+            max(c.flops / flops_rate,
+                c.bytes_total / params.hbm_bytes_per_second)
+            + comm_by_op.get(i, 0.0)
+            for i, c in enumerate(result.by_op)]
+        return result
 
 
 def _program_cost(program, fetch, placements, avals) -> ProgramCost:
@@ -460,33 +535,80 @@ def measure_program_flops(program, feed: Dict[str, np.ndarray],
     return measure_step_flops(fn, *arrays)
 
 
-def check_cost_model(predicted_flops: int, measured_flops: int, *,
+#: per-code wiring for the drift check: (predicted gauge, measured
+#: gauge, error gauge, unit rendered in the message, hint). PTL302 is
+#: the FLOPs model vs XLA's compiled count; PTL304 is the step-time
+#: model (compute + comm) vs measured train.step_seconds.
+_DRIFT_CHECKS = {
+    "PTL302": (M_PREDICTED_FLOPS, M_MEASURED_FLOPS, M_FLOPS_ERROR,
+               "flops", "compiled cost analysis",
+               "the per-op registry in static/analysis/cost.py no "
+               "longer models what XLA executes — register/fix the "
+               "drifting prim family (cost.model_flops_error_pct "
+               "tracks the error per program)"),
+    "PTL304": (M_PREDICTED_STEP, M_MEASURED_STEP, M_STEP_ERROR,
+               "seconds", "measured train.step_seconds",
+               "the step-time model (compute rate, HBM bandwidth or "
+               "the comm alpha-beta fit) no longer matches what the "
+               "hardware runs — recalibrate with "
+               "tools/comm_calibrate.py or fix the drifting term "
+               "(cost.model_step_error_pct tracks the error per "
+               "program)"),
+}
+
+
+def check_cost_model(predicted: float, measured: float, *,
                      tolerance_pct: float = 25.0,
-                     name: str = "program") -> DiagnosticReport:
-    """File **PTL302** when the analytical FLOPs estimate drifts more
-    than ``tolerance_pct`` from XLA's compiled cost analysis — the
-    canary that catches cost-model rot (a new prim family the registry
-    does not know, a changed lowering) before scheduling and placement
-    decisions silently degrade. Records the error in
-    ``cost.model_flops_error_pct``; a measured count of 0 (backend
-    without cost analysis) is skipped, not flagged."""
+                     name: str = "program",
+                     code: str = "PTL302") -> DiagnosticReport:
+    """File ``code`` (**PTL302** FLOPs drift by default, **PTL304**
+    step-time drift via :func:`check_step_time_model`) when the
+    analytical estimate drifts more than ``tolerance_pct`` from its
+    measured ground truth — the canary that catches cost-model rot (a
+    new prim family the registry does not know, a changed lowering, a
+    stale bandwidth calibration) before scheduling and placement
+    decisions silently degrade. Both drift checks share THIS one
+    implementation; only the gauges and the message differ. Records
+    predicted/measured/error in the code's ``cost.*`` gauges; a
+    measured value of 0 (backend without cost analysis, no step
+    timings) is skipped, not flagged."""
+    try:
+        pred_g, meas_g, err_g, unit, truth, hint = _DRIFT_CHECKS[code]
+    except KeyError:
+        raise ValueError(
+            f"check_cost_model knows {sorted(_DRIFT_CHECKS)}, "
+            f"not {code!r}")
     report = DiagnosticReport()
-    if measured_flops <= 0:
+    if measured <= 0:
         return report
-    err_pct = abs(predicted_flops - measured_flops) / measured_flops * 100
+    err_pct = abs(predicted - measured) / measured * 100
     if _obs.state.on:
-        M_PREDICTED_FLOPS.set(int(predicted_flops), name=name)
-        M_MEASURED_FLOPS.set(int(measured_flops), name=name)
-        M_FLOPS_ERROR.set(round(err_pct, 2), name=name)
+        cast = int if unit == "flops" else float
+        pred_g.set(cast(predicted), name=name)
+        meas_g.set(cast(measured), name=name)
+        err_g.set(round(err_pct, 2), name=name)
     if err_pct > tolerance_pct:
+        fmt = (lambda v: f"{v:,.0f}") if unit == "flops" \
+            else (lambda v: f"{v:.6f}")
         report.add(
-            "PTL302", Severity.WARNING,
+            code, Severity.WARNING,
             f"cost model drift on {name!r}: analytical estimate "
-            f"{predicted_flops:,} flops vs compiled cost analysis "
-            f"{measured_flops:,} ({err_pct:.1f}% > {tolerance_pct:.0f}% "
-            f"tolerance)",
-            hint="the per-op registry in static/analysis/cost.py no "
-                 "longer models what XLA executes — register/fix the "
-                 "drifting prim family (cost.model_flops_error_pct "
-                 "tracks the error per program)")
+            f"{fmt(predicted)} {unit} vs {truth} {fmt(measured)} "
+            f"({err_pct:.1f}% > {tolerance_pct:.0f}% tolerance)",
+            hint=hint)
     return report
+
+
+def check_step_time_model(predicted_seconds: float,
+                          measured_seconds: float, *,
+                          tolerance_pct: float = 50.0,
+                          name: str = "program") -> DiagnosticReport:
+    """**PTL304**: the step-time twin of the PTL302 FLOPs check —
+    predicted ``max(compute, memory) + comm`` vs the measured
+    ``train.step_seconds`` mean. Same implementation
+    (:func:`check_cost_model`), different code/gauges. The default
+    tolerance is looser than PTL302's: wall time carries dispatch and
+    allocator noise a FLOPs count does not."""
+    return check_cost_model(predicted_seconds, measured_seconds,
+                            tolerance_pct=tolerance_pct, name=name,
+                            code="PTL304")

@@ -78,6 +78,12 @@ CODES = {
               "XLA's compiled cost analysis beyond tolerance",
     "PTL303": "no-benefit pass: a rewrite pass was scheduled out because "
               "the pre-pass lint found nothing it could fix",
+    "PTL304": "step-time model drift: predicted step time (compute + "
+              "comm model) diverges from measured train.step_seconds "
+              "beyond tolerance",
+    "PTL305": "auto-sharding search found a placement predicted strictly "
+              "faster than the derived plan (informational: the derived "
+              "plan is not comm-optimal)",
 }
 
 

@@ -155,18 +155,57 @@ def _pass_code(name: str) -> str:
     return getattr(_PASS_REGISTRY.get(name), "code", "") or ""
 
 
-def _iteration_schedule(names: Sequence[str],
-                        counts: Dict[str, int]) -> tuple:
+def _benefit_weights(program, fetch_vids, sweep,
+                     codes: Sequence[str], placements) -> Dict[str, float]:
+    """code -> multiplicative benefit weight in [1, 2] from the static
+    cost model: 1 + (the predicted per-op seconds of the code's
+    findings) / (the program's total predicted seconds). A code whose
+    findings sit on the expensive ops (a dead matmul, a redundant
+    transpose of a sharded activation whose reshard the comm model
+    prices) outweighs one whose findings are cheap casts — while the
+    bounded range keeps finding COUNT the dominant term, so the
+    established most-findings-first ordering only changes where counts
+    tie. Priced WITHOUT liveness roots on purpose: a rewrite finding's
+    worth is the static price of the op it removes or simplifies, and
+    the dead ops PTL101 targets are exactly the ones a fetch-rooted
+    sweep would zero out. Empty on any model failure: scheduling must
+    never be the thing that breaks optimize_program."""
+    from .cost import program_cost
+
+    try:
+        pc = program_cost(program, placements=placements)
+    except Exception:
+        return {}
+    per_op = pc.seconds_by_op
+    total = sum(per_op)
+    if total <= 0:
+        return {}
+    weights: Dict[str, float] = {}
+    for c in codes:
+        secs = sum(per_op[d.op_index] for d in sweep.by_code(c)
+                   if d.op_index is not None
+                   and 0 <= d.op_index < len(per_op))
+        weights[c] = 1.0 + min(secs / total, 1.0)
+    return weights
+
+
+def _iteration_schedule(names: Sequence[str], counts: Dict[str, int],
+                        weights: Optional[Dict[str, float]] = None
+                        ) -> tuple:
     """(runnable_in_benefit_order, skipped) for one iteration.
 
     Benefit = the lint sweep's finding count for the pass's code (every
-    finding is one fixable rewrite); cost = the pass's observed mean
-    wall time from ``opt.rewrite_seconds`` (the measured-benefit data
-    PR 11 started recording — a process that has run the pipeline
-    before schedules from its own history, a fresh one falls back to a
-    uniform prior and the order degrades to most-findings-first).
-    Passes without a claimed code are never gated. Ties keep the static
-    pipeline order (the sort is stable on the original index)."""
+    finding is one fixable rewrite), scaled by the cost-model weight
+    from :func:`_benefit_weights` (expensive-op findings first when
+    counts tie — comm-aware when a placement table is given); cost =
+    the pass's observed mean wall time from ``opt.rewrite_seconds``
+    (the measured-benefit data PR 11 started recording — a process that
+    has run the pipeline before schedules from its own history, a fresh
+    one falls back to a uniform prior and the order degrades to
+    most-findings-first). Passes without a claimed code are never
+    gated. Ties keep the static pipeline order (the sort is stable on
+    the original index)."""
+    weights = weights or {}
     runnable, skipped = [], []
     for i, n in enumerate(names):
         code = _pass_code(n)
@@ -176,7 +215,7 @@ def _iteration_schedule(names: Sequence[str],
         findings = counts.get(code, 1) if code else 1
         stats = _M_REWRITE_SECONDS.stats(name=n)
         observed = stats["avg"] if stats["count"] else 0.0
-        score = findings / max(observed, 1e-4)
+        score = findings * weights.get(code, 1.0) / max(observed, 1e-4)
         runnable.append((-score, i, n))
     runnable.sort()
     return [n for _s, _i, n in runnable], skipped
@@ -186,7 +225,8 @@ def optimize_program(program, fetch: Optional[Iterable] = None, *,
                      passes: Optional[Sequence[str]] = None,
                      max_iterations: int = 8,
                      verify: Optional[bool] = None,
-                     schedule: bool = True) -> OptimizeResult:
+                     schedule: bool = True,
+                     placements=None) -> OptimizeResult:
     """Run the lint-fix pipeline over ``program`` until quiescence.
 
     ``fetch`` (Tensors or vids) names the values that must survive —
@@ -198,11 +238,15 @@ def optimize_program(program, fetch: Optional[Iterable] = None, *,
     ``schedule=True`` (default) cost-gates and benefit-orders each
     iteration from one shared lint sweep: zero-finding passes are
     skipped (``opt.passes_skipped``, PTL303 on the result), the rest
-    run ordered by findings-per-observed-second. ``schedule=False``
-    restores the static ``DEFAULT_PIPELINE`` order (every pass, every
-    iteration). Both converge to the same fixed point — each pass is
-    an independent re-lint-to-zero fix — so scheduling changes cost,
-    never results (pinned by the bit-exact equivalence harness).
+    run ordered by findings-per-observed-second, with each code's
+    findings weighted by their predicted per-op seconds from the static
+    cost model (``placements`` makes the weight COMM-aware: a finding
+    sitting on an op whose placement forces a collective carries that
+    collective's alpha-beta price too). ``schedule=False`` restores the
+    static ``DEFAULT_PIPELINE`` order (every pass, every iteration).
+    Both converge to the same fixed point — each pass is an independent
+    re-lint-to-zero fix — so scheduling changes cost, never results
+    (pinned by the bit-exact equivalence harness).
 
     ``verify=None`` inherits ``PADDLE_TPU_PASS_VERIFY`` via
     ``PassManager`` — every pass runs bracketed by the Program verifier
@@ -235,7 +279,9 @@ def optimize_program(program, fetch: Optional[Iterable] = None, *,
             sweep = run_lints(program, fetch=fetch_vids,
                               codes=sweep_codes)
             counts = {c: len(sweep.by_code(c)) for c in sweep_codes}
-            to_run, skipped = _iteration_schedule(names, counts)
+            weights = _benefit_weights(program, fetch_vids, sweep,
+                                       sweep_codes, placements)
+            to_run, skipped = _iteration_schedule(names, counts, weights)
             if not to_run:
                 break  # quiescent: nothing any pass could fix
             for n in skipped:

@@ -30,7 +30,8 @@ from typing import Any, Dict, List, Optional
 from .diagnostics import DiagnosticReport, Severity
 
 __all__ = ["run_placement_lints", "lint_fleet_trace",
-           "apply_placement_suggestion", "SHARDING_LINT_CODES"]
+           "apply_placement_suggestion", "matmul_contracting_dims",
+           "SHARDING_LINT_CODES"]
 
 #: codes this module can emit — audited by tools/lint_registry.py the
 #: same way lint.LINTS codes are (every code claimed in CODES, every
@@ -68,6 +69,24 @@ def _shard_axes(spec, tensor_dim: int) -> List[int]:
 
 def _partial_axes(spec) -> List[int]:
     return [a for a, p in enumerate(spec.placements) if p.is_partial()]
+
+
+def matmul_contracting_dims(attrs: Dict[str, Any], x_ndim: int,
+                            w_ndim: int) -> tuple:
+    """(x_contracting_dim, w_contracting_dim) for a matmul-family prim,
+    honoring its ``transpose_x``/``transpose_y`` static attrs — the ONE
+    definition shared by the PTL202 lint and the comm cost model
+    (``static/analysis/comm_cost.py``), so "which dims contract" can
+    never diverge between the lint that flags a mismatch and the model
+    that prices the collective it forces."""
+    tx = bool(attrs.get("transpose_x", False))
+    ty = bool(attrs.get("transpose_y", False))
+    x_c = x_ndim - 2 if (tx and x_ndim >= 2) else x_ndim - 1
+    if w_ndim >= 2:
+        w_c = w_ndim - 1 if ty else w_ndim - 2
+    else:
+        w_c = 0
+    return x_c, w_c
 
 
 def _suggest(kind: str, op_index: int, vid: int, dim: Optional[int],
@@ -170,13 +189,7 @@ def run_placement_lints(prog, mesh=None, placements=None,
                     and w.ndim >= 1:
                 # contracting dims, honoring the matmul prim's
                 # transpose_x/transpose_y static attrs
-                tx = bool(attrs.get("transpose_x", False))
-                ty = bool(attrs.get("transpose_y", False))
-                x_c = x.ndim - 2 if (tx and x.ndim >= 2) else x.ndim - 1
-                if w.ndim >= 2:
-                    w_c = w.ndim - 1 if ty else w.ndim - 2
-                else:
-                    w_c = 0
+                x_c, w_c = matmul_contracting_dims(attrs, x.ndim, w.ndim)
                 ax_x = set(_shard_axes(x, x_c))
                 ax_w = set(_shard_axes(w, w_c))
                 if ax_x != ax_w:

@@ -821,4 +821,5 @@ class TestCostReporting:
         from paddle_tpu.static.analysis import CODES
 
         assert set(COST_ANALYSIS_CODES) <= set(CODES)
-        assert COST_ANALYSIS_CODES == ("PTL301", "PTL302", "PTL303")
+        assert COST_ANALYSIS_CODES == ("PTL301", "PTL302", "PTL303",
+                                       "PTL304", "PTL305")

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Fit the analytical comm model to a measured metrics dump.
+
+Usage:
+    python tools/comm_calibrate.py metrics.json [-o comm_params.json]
+    python tools/comm_calibrate.py metrics.json --predicted-flops 1.2e12
+    export PADDLE_TPU_COMM_PARAMS=comm_params.json   # picked up by program_cost
+
+Input is a JSON metrics dump written by ``paddle_tpu.observability.dump``
+(or any run with ``PADDLE_TPU_METRICS_DUMP=metrics.json``) that contains
+the PR 5 comm telemetry counters — ``comm.collective_calls`` /
+``comm.collective_bytes`` / ``comm.collective_seconds``, labeled by
+``op=`` and ``group=``. The alpha-beta fit
+(``calibrate_comm_model``) turns those into ``link_latency_seconds`` and
+``link_bytes_per_second``; with ``--predicted-flops`` (the
+``program_cost(...).flops`` of the program the dump came from) the
+``train.step_seconds`` histogram additionally pins
+``flops_per_second`` (``calibrate_step_time_model``), so the whole
+predicted-step-time model is fitted, not just the comm term.
+
+The fitted parameters are written as JSON in exactly the shape
+``PADDLE_TPU_COMM_PARAMS`` accepts — point the env var at the output
+file (or paste the JSON inline) and every subsequent ``program_cost`` /
+``search_shard_plans`` call prices collectives with the measured
+machine constants instead of the built-in defaults. Exits non-zero if
+the dump cannot be read; a dump with no comm series still produces the
+(default) parameters, with a warning on stderr, so the tool is safe to
+wire into pipelines that sometimes run single-chip.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dump", help="JSON metrics dump containing "
+                                 "comm.collective_* series")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write fitted params JSON here (default: stdout)")
+    ap.add_argument("--predicted-flops", type=float, default=None,
+                    help="model-predicted FLOPs of the program the dump "
+                         "came from; with train.step_seconds in the dump "
+                         "this also fits flops_per_second")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.dump) as f:
+            dump = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"comm_calibrate: cannot read {args.dump!r}: {e}",
+              file=sys.stderr)
+        return 1
+
+    from paddle_tpu.static.analysis.comm_cost import (
+        CommModelParams, calibrate_comm_model, calibrate_step_time_model)
+
+    metrics = dump.get("metrics", dump) if isinstance(dump, dict) else {}
+    if not (metrics.get("comm.collective_seconds") or {}).get("series"):
+        print("comm_calibrate: dump has no comm.collective_seconds series; "
+              "emitting default link parameters", file=sys.stderr)
+
+    if args.predicted_flops is not None:
+        params = calibrate_step_time_model(dump, args.predicted_flops)
+    else:
+        params = calibrate_comm_model(dump)
+
+    defaults = CommModelParams()
+    doc = params.to_dict()
+    text = json.dumps(doc, indent=2, sort_keys=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+        print(f"comm_calibrate: wrote {args.output}  "
+              f"(export PADDLE_TPU_COMM_PARAMS={args.output})",
+              file=sys.stderr)
+    else:
+        print(text)
+    for key, fitted, base in (
+            ("link_bytes_per_second", params.link_bytes_per_second,
+             defaults.link_bytes_per_second),
+            ("link_latency_seconds", params.link_latency_seconds,
+             defaults.link_latency_seconds)):
+        if fitted != base:
+            print(f"comm_calibrate: {key}: {base:.3g} -> {fitted:.3g}",
+                  file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -160,6 +160,14 @@ COST_REQUIRED_LABELS = {
     "cost.measured_peak_hbm_bytes": ("name",),
     "cost.predicted_oom": ("name",),
     "cost.estimate_seconds": ("kind",),
+    # step-time model + comm cost (static/analysis/comm_cost.py): the
+    # comm series additionally say WHICH collective kind, so the
+    # per-collective table in observability/report.py can render
+    "cost.predicted_step_seconds": ("name",),
+    "cost.measured_step_seconds": ("name",),
+    "cost.model_step_error_pct": ("name",),
+    "cost.comm_predicted_bytes": ("kind", "name"),
+    "cost.comm_predicted_seconds": ("kind", "name"),
 }
 
 #: fleet-telemetry label discipline (observability/fleet.py): per-rank

@@ -17,7 +17,10 @@ through the same ``observability.report`` code the in-process
 ``summary()`` uses, so dumps round-trip by construction. The ``opt``
 section leads with the lint->rewrite per-code fixed/remaining table,
 and the ``cost`` section with the static cost model's
-predicted-vs-measured FLOPs/peak-HBM table (``render_cost_table``).
+predicted-vs-measured FLOPs/peak-HBM and step-time tables
+(``render_cost_table``) plus the per-collective predicted comm-cost
+table (``render_comm_table``) — wire bytes and seconds per collective
+kind, the decomposition behind ``cost.predicted_step_seconds``.
 
 Passing a DIRECTORY renders every ``flight-*.json`` in it — the shape an
 elastic incident leaves behind (each surviving worker dumps
