@@ -93,12 +93,18 @@ def _emit_metrics_block():
                 if isinstance(s.get("value"), (int, float))]
         return max(vals) if vals else None
 
-    def hist_quantile(name, q):
+    def hist_quantile(name, q, labels=None):
         """Quantile estimate from merged histogram bucket counts
         (linear interpolation inside the crossing bucket). The load
         generator reports exact sample quantiles too; this is the
-        registry-side figure so the roll-up works from a dump alone."""
+        registry-side figure so the roll-up works from a dump alone.
+        ``labels`` restricts the merge to series carrying those label
+        values (e.g. one lifecycle phase of trace.phase_seconds)."""
         ss = series(name)
+        if labels:
+            ss = [s for s in ss
+                  if all((s.get("labels") or {}).get(k) == v
+                         for k, v in labels.items())]
         if not ss:
             return None
         bounds = ss[0].get("bounds")
@@ -186,6 +192,16 @@ def _emit_metrics_block():
         "serve_ttft_p99": hist_quantile("serve.ttft_seconds", 0.99),
         "serve_tokens_per_sec": gauge_max("serve.tokens_per_sec"),
         "serve_preemptions": tot("serve.preemptions"),
+        # request-lifecycle tracing roll-ups (observability/tracing.py +
+        # slo.py; populated when the serve config runs its traced pass)
+        "serve_queue_seconds_p99":
+            hist_quantile("trace.phase_seconds", 0.99,
+                          labels={"phase": "queue"}),
+        "serve_prefill_seconds_p99":
+            hist_quantile("trace.phase_seconds", 0.99,
+                          labels={"phase": "prefill"}),
+        "serve_decode_gap_seconds": gauge_max("trace.decode_gap_seconds"),
+        "trace_slo_breaches": tot("trace.slo_breaches"),
     }}), flush=True)
 
 
@@ -1017,6 +1033,39 @@ def bench_serve(on_tpu, steps, warmup, peak_flops):
         "unit": "tokens/sec/chip",
         "vs_baseline": round(float(frac), 3),
     }), flush=True)
+
+    # tracing-overhead guard: the identical load replayed with request-
+    # lifecycle tracing ON (same seed -> same arrivals/prompts) must hold
+    # tokens/sec within the PTL402 budget — a tracer that costs real
+    # throughput is a tracer nobody leaves enabled. This pass also
+    # populates the trace.* series behind the serve_queue_seconds_p99 /
+    # serve_prefill_seconds_p99 / serve_decode_gap_seconds roll-up keys.
+    from paddle_tpu.observability.tracing import check_tracing_overhead
+
+    traced = ServeEngine(model, max_slots=slots, block_size=bs,
+                         num_blocks=blocks, max_seq_len=msl,
+                         name="bench_traced", trace=True)
+    warm_engine(traced)
+    res_tr = run_load(traced, rate=rate, n_requests=n_req,
+                      prompt_len=plen, max_new=mnew, seed=0)
+    guard = check_tracing_overhead(
+        res_tr.tokens_per_sec, res.tokens_per_sec, tolerance_pct=3.0,
+        engine="bench_traced")
+    overhead = (100.0 * (res.tokens_per_sec - res_tr.tokens_per_sec)
+                / res.tokens_per_sec) if res.tokens_per_sec else 0.0
+    print(json.dumps({
+        "metric": f"serve tracing overhead pct (traced replay "
+                  f"{res_tr.tokens_per_sec:.0f} tok/s vs untraced "
+                  f"{res.tokens_per_sec:.0f} tok/s; PTL402 above 3%; "
+                  f"vs_baseline is traced/untraced throughput)",
+        "value": round(float(overhead), 2),
+        "unit": "pct",
+        "vs_baseline": round(float(res_tr.tokens_per_sec
+                                   / res.tokens_per_sec), 3)
+        if res.tokens_per_sec else 0.0,
+    }), flush=True)
+    for d in guard:
+        print(json.dumps({"diagnostic": d.render()}), flush=True)
 
 
 def _run_isolated(config: str, args) -> int:

@@ -54,8 +54,14 @@ from . import flight
 from .flight import FlightRecorder
 from . import fleet
 from .fleet import FleetAggregator, FleetReporter
-from .runtime import (StepTimer, default_peak_flops, measure_step_flops,
-                      sample_device_memory, step_region)
+from .runtime import (FakeClock, StepTimer, default_peak_flops,
+                      measure_step_flops, sample_device_memory,
+                      step_region)
+from . import slo
+from .slo import SloMonitor, SloRule
+from . import tracing
+from .tracing import (RequestTrace, ServeTracer, Span, TailExemplars,
+                      check_tracing_overhead, validate_trace)
 
 __all__ = [
     "state", "enabled", "enable", "disable", "reset",
@@ -65,8 +71,11 @@ __all__ = [
     "dump", "dump_dict", "render_report", "render_flight", "summary",
     "CLAIMED_SUBSYSTEMS", "NAME_RE",
     "flight", "FlightRecorder", "fleet", "FleetAggregator",
-    "FleetReporter", "StepTimer", "step_region",
+    "FleetReporter", "StepTimer", "step_region", "FakeClock",
     "sample_device_memory", "measure_step_flops", "default_peak_flops",
+    "slo", "SloMonitor", "SloRule",
+    "tracing", "Span", "RequestTrace", "ServeTracer", "TailExemplars",
+    "check_tracing_overhead", "validate_trace",
 ]
 
 counter = registry.counter

@@ -44,9 +44,13 @@ FLIGHT_VERSION = 1
 #: - ``straggler``: the fleet aggregator flagged this rank as a
 #:   persistent straggler and requested a post-mortem via the store
 #:   flag (observability/fleet.py FleetAggregator).
+#: - ``slo_breach``: a serving SLO rule left its bound
+#:   (observability/slo.py SloMonitor); the dump context carries the
+#:   rule, the offending value and the tail-exemplar span trees.
 REASON_PEER_DEATH = "peer_death"
 REASON_REJOIN = "rejoin"
 REASON_STRAGGLER = "straggler"
+REASON_SLO_BREACH = "slo_breach"
 
 #: ring capacity; read once from core.flags at first record so the flag
 #: can be set before any event lands (same pattern as events._buffer).

@@ -53,6 +53,9 @@ CLAIMED_SUBSYSTEMS = {
     "serve",       # serve/engine.py — continuous-batching server: queue
                    # depth, TTFT, tokens/sec, preemptions, pool
                    # occupancy, batch fill, decode/prefill traces
+    "trace",       # observability/tracing.py + slo.py — request-scoped
+                   # span tracing: per-phase seconds, tail exemplars,
+                   # decode-gap accounting, SLO breaches, overhead guard
     "test",        # scratch names registered by the test suite
 }
 

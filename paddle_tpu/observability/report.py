@@ -358,8 +358,11 @@ def render_flight(d: Dict[str, Any], max_events: int = 50,
                                                           0))))]
     ctx = d.get("context")
     if ctx:
+        # the exemplars payload (slo_breach dumps) is a span-tree bundle,
+        # not a scalar — rendered as its own block below the header
         lines.append("context: " + "  ".join(
-            f"{k}={v}" for k, v in sorted(ctx.items())))
+            f"{k}={v}" for k, v in sorted(ctx.items())
+            if k != "exemplars"))
     # elastic-training post-mortems get a one-line interpretation so an
     # operator triaging a directory of per-worker dumps reads the story
     # without knowing the reason vocabulary
@@ -377,6 +380,21 @@ def render_flight(d: Dict[str, Any], max_events: int = 50,
             "straggler — its recent step times exceeded the peer median "
             "threshold — and requested this post-mortem via the store "
             "flag)")
+    elif reason == "slo_breach":
+        lines.append(
+            "(a serving SLO rule latched out of bounds — the context "
+            "names the rule/value/threshold, and the tail exemplars "
+            "below carry the span trees of the worst requests behind "
+            "the breached percentile)")
+        ex = (ctx or {}).get("exemplars")
+        if ex:
+            from .tracing import TailExemplars
+
+            t = TailExemplars(ex.get("n", 4),
+                              engine=(ctx or {}).get("engine", "?"))
+            t.worst_ttft = list(ex.get("worst_ttft") or [])
+            t.worst_latency = list(ex.get("worst_latency") or [])
+            lines += ["", t.render()]
     mem = d.get("device_memory")
     if mem:
         lines.append(

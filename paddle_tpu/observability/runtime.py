@@ -33,6 +33,41 @@ from .metrics import registry
 
 PEAK_FLOPS_ENV = "PADDLE_TPU_PEAK_FLOPS"
 
+
+class FakeClock:
+    """Deterministic injectable clock for timing-sensitive tests.
+
+    Serves BOTH clock protocols in the codebase: calling it (or
+    ``.time()``) returns the current fake time — the callable protocol
+    ``ServeEngine(clock=...)``, ``StepTimer(clock=...)`` and
+    ``step_region(clock=...)`` take — and ``.sleep(dt)`` advances it,
+    the object protocol ``serve.load.run_load(clock=...)`` takes.
+
+    ``tick`` advances the clock by a fixed amount on every read, so a
+    code path that reads the clock twice always measures a positive,
+    exactly reproducible duration — the deflaking device for the
+    load-generator and step-telemetry tests that used to assert on real
+    ``time.sleep`` under CI load."""
+
+    __slots__ = ("now", "tick")
+
+    def __init__(self, start: float = 0.0, tick: float = 0.0):
+        self.now = float(start)
+        self.tick = float(tick)
+
+    def time(self) -> float:
+        t = self.now
+        self.now += self.tick
+        return t
+
+    __call__ = time
+
+    def sleep(self, dt: float):
+        self.now += max(float(dt), 0.0)
+
+    def advance(self, dt: float):
+        self.now += float(dt)
+
 M_STEP_SECONDS = registry.histogram(
     "train.step_seconds",
     "wall seconds per training step bracketed by obs.step_region()")
@@ -145,11 +180,12 @@ class _StepRegion:
 
     __slots__ = ("name", "step", "items", "unit", "flops", "peak_flops",
                  "sample_memory", "fields", "_rec", "_t0", "seconds",
-                 "mfu", "items_per_second")
+                 "mfu", "items_per_second", "_clock")
 
     def __init__(self, name: str, step: Optional[int], items: Optional[int],
                  unit: str, flops: Optional[int], peak_flops: Optional[float],
-                 sample_memory: bool, fields: Dict[str, Any]):
+                 sample_memory: bool, fields: Dict[str, Any],
+                 clock=None):
         self.name = name
         self.step = step
         self.items = items
@@ -162,13 +198,14 @@ class _StepRegion:
         self.seconds = 0.0
         self.mfu: Optional[float] = None
         self.items_per_second: Optional[float] = None
+        self._clock = clock if clock is not None else time.perf_counter
 
     def __enter__(self):
         from ..profiler.utils import RecordEvent
 
         self._rec = RecordEvent(f"{self.name}.step")
         self._rec.begin()
-        self._t0 = time.perf_counter()
+        self._t0 = self._clock()
         return self
 
     def abandon(self):
@@ -181,7 +218,7 @@ class _StepRegion:
             self._rec = None
 
     def __exit__(self, exc_type, exc, tb):
-        self.seconds = max(time.perf_counter() - self._t0, 1e-12)
+        self.seconds = max(self._clock() - self._t0, 1e-12)
         if self._rec is not None:
             self._rec.end()
             self._rec = None
@@ -242,7 +279,7 @@ def step_region(name: str = "train", *, step: Optional[int] = None,
                 items: Optional[int] = None, unit: str = "items",
                 flops: Optional[int] = None,
                 peak_flops: Optional[float] = None,
-                sample_memory: bool = False, **fields):
+                sample_memory: bool = False, clock=None, **fields):
     """Context manager bracketing ONE training step.
 
     ``items`` is the tokens/samples consumed this step (drives
@@ -260,7 +297,7 @@ def step_region(name: str = "train", *, step: Optional[int] = None,
     if not _gate.state.on:
         return _DISABLED_REGION
     return _StepRegion(name, step, items, unit, flops, peak_flops,
-                       sample_memory, fields)
+                       sample_memory, fields, clock=clock)
 
 
 class StepTimer:
@@ -275,12 +312,13 @@ class StepTimer:
                  flops_per_step: Optional[int] = None,
                  items_per_step: Optional[int] = None, unit: str = "items",
                  peak_flops: Optional[float] = None,
-                 sample_memory_every: int = 16):
+                 sample_memory_every: int = 16, clock=None):
         self.name = name
         self.flops_per_step = flops_per_step
         self.items_per_step = items_per_step
         self.unit = unit
         self.peak_flops = peak_flops
+        self.clock = clock        # injectable (FakeClock) for determinism
         self.sample_memory_every = max(0, int(sample_memory_every))
         self.count = 0
         self.last: Optional[_StepRegion] = None
@@ -298,7 +336,8 @@ class StepTimer:
             self.name, step=self.count,
             items=self.items_per_step if items is None else items,
             unit=self.unit, flops=self.flops_per_step,
-            peak_flops=self.peak_flops, sample_memory=sample, **fields)
+            peak_flops=self.peak_flops, sample_memory=sample,
+            clock=self.clock, **fields)
         self.count += 1
         self.last = r
         return r

@@ -45,6 +45,17 @@ Telemetry: the ``serve.`` metric subsystem (claimed in
 ``observability.metrics.CLAIMED_SUBSYSTEMS``, label discipline audited
 by ``tools/lint_registry.py``): queue depth, TTFT, tokens/sec,
 preemptions, pool occupancy, batch fill ratio, per-step timings.
+
+Per-request attribution rides on top of the aggregates:
+``ServeEngine(trace=True)`` (or ``PADDLE_TPU_TRACE=1``) attaches an
+``observability.tracing.ServeTracer`` whose host-side hooks — called
+only from the scheduler path, never inside a compiled step, so
+``serve.decode_traces`` stays at 1 — grow a span tree on every request
+(queue -> prefill -> decode -> preempt -> resume -> recompute).
+``ServeEngine(slo=[...])`` (or ``PADDLE_TPU_SLO``) adds an
+``observability.slo.SloMonitor`` evaluated at every step boundary.
+Both, plus all request timestamps, read the injectable ``clock``
+(default ``time.perf_counter``) so load tests can run on a fake clock.
 """
 from __future__ import annotations
 
@@ -129,6 +140,9 @@ class Request:
     admit_seq: int = -1                    # recency rank for eviction
     preemptions: int = 0
     warmup: bool = False                   # excluded from TTFT telemetry
+    # span tree (observability.tracing.RequestTrace) when the engine
+    # runs with tracing enabled; None otherwise
+    trace: Optional[object] = field(default=None, repr=False)
 
     @property
     def n_prompt(self) -> int:
@@ -167,7 +181,15 @@ class ServeEngine:
     def __init__(self, model, *, max_slots: int = 4, block_size: int = 32,
                  num_blocks: int = 64, max_seq_len: int = 256,
                  seed: int = 0, name: str = "default",
-                 attention_backend: str = "auto"):
+                 attention_backend: str = "auto", clock=None,
+                 trace=None, slo=None):
+        """``clock`` is a zero-arg callable returning seconds (default
+        ``time.perf_counter``) — every request timestamp, tracer span
+        and SLO window reads it, so tests inject a fake. ``trace`` is
+        True/False, a ready ``ServeTracer``, or None to read
+        ``PADDLE_TPU_TRACE``. ``slo`` is a rule list (``SloRule``/
+        dicts/JSON), a ready ``SloMonitor``, or None to read
+        ``PADDLE_TPU_SLO``."""
         import jax
 
         if not hasattr(model, "llama") and not hasattr(model, "gpt"):
@@ -192,6 +214,7 @@ class ServeEngine:
         self.max_slots = int(max_slots)
         self.block_size = int(block_size)
         self.max_seq_len = int(max_seq_len)
+        self._clock = clock if clock is not None else time.perf_counter
         self.max_blocks_per_seq = -(-self.max_seq_len // self.block_size)
         self.pool = BlockPool(num_blocks, block_size)
         self._backend = attention_backend
@@ -226,6 +249,9 @@ class ServeEngine:
         self.prefill_traces = 0
         self._next_id = 0
         self._admit_counter = 0
+        # lifetime totals the step-boundary SLO evaluation differences
+        self._n_tokens = 0
+        self._n_preempts = 0
         self._key = jax.random.PRNGKey(seed)
         self._rng = np.random.default_rng(seed)
         # the caches are DONATED (argument 1 after the bound self):
@@ -236,6 +262,32 @@ class ServeEngine:
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1,))
         self._prefill_fn = jax.jit(self._prefill_impl,
                                    donate_argnums=(1,))
+
+        # request-lifecycle tracing + SLO guardrails (both host-side
+        # scheduler-path bookkeeping; the compiled steps never see them)
+        from ..observability import slo as _slo_mod
+        from ..observability import tracing as _tracing_mod
+
+        if trace is None:
+            trace = _tracing_mod.trace_enabled_from_env()
+        if isinstance(trace, _tracing_mod.ServeTracer):
+            self.tracer: Optional[_tracing_mod.ServeTracer] = trace
+        elif trace:
+            self.tracer = _tracing_mod.ServeTracer(
+                self.name, self._clock, max_slots=self.max_slots)
+        else:
+            self.tracer = None
+        if slo is None:
+            slo = _slo_mod.rules_from_env() or None
+        if isinstance(slo, _slo_mod.SloMonitor):
+            self.slo: Optional[_slo_mod.SloMonitor] = slo
+        elif slo:
+            self.slo = _slo_mod.SloMonitor(
+                slo, engine=self.name, clock=self._clock,
+                exemplars=(self.tracer.exemplars if self.tracer
+                           else None))
+        else:
+            self.slo = None
 
     # -- submission --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -283,10 +335,12 @@ class ServeEngine:
             eos_token_id=(None if eos_token_id is None
                           else int(eos_token_id)),
             temperature=float(temperature),
-            submit_time=time.perf_counter(),
+            submit_time=self._clock(),
             ids=[int(t) for t in prompt], warmup=bool(warmup))
         self._next_id += 1
         self.queue.append(req)
+        if self.tracer is not None and not req.warmup:
+            self.tracer.on_submit(req)
         _M_QUEUE_DEPTH.set(len(self.queue), engine=self.name)
         return req
 
@@ -306,6 +360,9 @@ class ServeEngine:
         slots (prefill), then run ONE batched decode step for every
         active stream, retiring the ones that finish. Returns the
         number of streams that were active this step."""
+        serving_real_work = self.slo is not None and any(
+            not r.warmup for r in self._live_requests())
+        tok0, pre0 = self._n_tokens, self._n_preempts
         self._admit()
         n_active = self.n_active
         if n_active:
@@ -315,12 +372,26 @@ class ServeEngine:
                               engine=self.name)
         _M_BATCH_FILL.set(round(n_active / self.max_slots, 4),
                           engine=self.name)
+        if serving_real_work:
+            # step-boundary SLO evaluation — skipped while the only
+            # work is compile-warming (whose throughput/TTFT would
+            # bill XLA, not serving)
+            self.slo.on_step(tokens=self._n_tokens - tok0,
+                             preemptions=self._n_preempts - pre0,
+                             now=self._clock())
         return n_active
+
+    def _live_requests(self):
+        for r in self.queue:
+            yield r
+        for r in self._slots:
+            if r is not None:
+                yield r
 
     def run(self, max_steps: int = 100_000) -> List[Request]:
         """Drive :meth:`step` until queue and slots drain; returns the
         finished requests. Sets ``serve.tokens_per_sec`` over the run."""
-        t0 = time.perf_counter()
+        t0 = self._clock()
         tok0 = sum(r.n_generated for r in self.finished)
         steps = 0
         while self.has_work:
@@ -332,7 +403,7 @@ class ServeEngine:
                     f"{len(self.queue)} queued and "
                     f"{sum(1 for r in self._slots if r)} active — "
                     f"scheduler is not making progress")
-        dt = time.perf_counter() - t0
+        dt = self._clock() - t0
         n_tok = sum(r.n_generated for r in self.finished) - tok0
         if dt > 0 and n_tok:
             _M_TOKENS_PER_SEC.set(round(n_tok / dt, 2), engine=self.name)
@@ -376,6 +447,9 @@ class ServeEngine:
             row = np.zeros(self.max_blocks_per_seq, np.int32)
             row[:len(req.blocks)] = req.blocks
             self._tables[slot] = row
+            if self.tracer is not None:
+                self.tracer.on_admit(req, slot,
+                                     resumed=req.n_generated > 0)
             self._prefill(req, prefill_ids)
             _M_ADMITTED.inc(engine=self.name)
             if req.state is FINISHED:
@@ -390,6 +464,8 @@ class ServeEngine:
         n = len(prefill_ids)
         bucket = max(8, 1 << (n - 1).bit_length())   # pow2 length buckets
         bucket = min(bucket, self.max_seq_len)
+        if self.tracer is not None:
+            self.tracer.on_prefill(req, bucket=bucket, tokens=n)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = prefill_ids
         with _M_PREFILL_SECONDS.time(engine=self.name):
@@ -401,11 +477,17 @@ class ServeEngine:
             # logits (this is the TTFT moment); resumed streams already
             # hold their pending token, the logits are discarded
             tok = self._sample_host(np.asarray(logits), req.temperature)
-            now = time.perf_counter()
+            now = self._clock()
             req.first_token_time = now
             if not req.warmup:
                 _M_TTFT.observe(now - req.submit_time, engine=self.name)
+                if self.slo is not None:
+                    self.slo.observe_ttft(now - req.submit_time, now=now)
+            if self.tracer is not None:
+                self.tracer.on_first_token(req, now)
             self._append_token(req, tok)
+        if self.tracer is not None and req.state is not FINISHED:
+            self.tracer.on_decode_begin(req)
 
     def _sample_host(self, logits: np.ndarray, temperature: float) -> int:
         """First-token sampling (host-side; decode steps sample on
@@ -420,6 +502,7 @@ class ServeEngine:
 
     def _append_token(self, req: Request, tok: int):
         req.ids.append(int(tok))
+        self._n_tokens += 1
         _M_TOKENS.inc(engine=self.name)
         if req.eos_token_id is not None and tok == req.eos_token_id:
             self._finish(req, "eos")
@@ -434,11 +517,13 @@ class ServeEngine:
         req.slot = None
         req.state = FINISHED
         req.finish_reason = reason
-        req.finish_time = time.perf_counter()
+        req.finish_time = self._clock()
         self.finished.append(req)
         _M_FINISHED.inc(engine=self.name, reason=reason)
         _M_REQUEST_SECONDS.observe(req.finish_time - req.submit_time,
                                    engine=self.name)
+        if self.tracer is not None:
+            self.tracer.on_finish(req)
 
     def _clear_slot(self, slot: int):
         self._slots[slot] = None
@@ -461,8 +546,11 @@ class ServeEngine:
         victim.slot = None
         victim.state = QUEUED
         victim.preemptions += 1
+        self._n_preempts += 1
         self.queue.appendleft(victim)
         _M_PREEMPTIONS.inc(engine=self.name, reason="pool_exhausted")
+        if self.tracer is not None:
+            self.tracer.on_preempt(victim)
         return victim
 
     def _ensure_blocks(self):
@@ -495,12 +583,14 @@ class ServeEngine:
         if not active_np.any():
             return                # everyone was preempted away
         self._key, sub = jax.random.split(self._key)
+        t0 = self._clock()
         with _M_DECODE_SECONDS.time(engine=self.name):
             nxt, self._caches = self._decode_fn(
                 self._arrays, self._caches, jnp.asarray(self._tokens),
                 jnp.asarray(self._lens), jnp.asarray(active_np),
                 jnp.asarray(self._tables), jnp.asarray(self._temps), sub)
             nxt = np.asarray(nxt)
+        t1 = self._clock()
         _M_DECODE_STEPS.inc(engine=self.name)
         for slot, req in enumerate(self._slots):
             if req is None:
@@ -509,6 +599,13 @@ class ServeEngine:
             self._append_token(req, int(nxt[slot]))
             if req.state is not FINISHED:
                 self._tokens[slot] = req.ids[-1]
+        if self.tracer is not None:
+            # active_after = runnable slots LEFT BEHIND by this step —
+            # the gap to the next step only counts as host-side stall
+            # (PTL404) when someone was still waiting to decode
+            self.tracer.on_decode_step(t0, t1,
+                                       active_after=self.n_active,
+                                       queued=len(self.queue))
 
     # -- compiled steps ----------------------------------------------------
     def _scatter_kv(self, kc, vc, k_new, v_new, safe_slot):

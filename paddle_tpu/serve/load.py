@@ -27,6 +27,15 @@ __all__ = ["run_load", "LoadResult", "default_serving_setup",
            "warm_engine"]
 
 
+class _WallClock:
+    """The default ``run_load`` clock: real wall time. Tests swap in
+    ``observability.FakeClock`` (same ``time()``/``sleep()`` surface)
+    so Poisson timing assertions stop depending on host scheduling."""
+
+    sleep = staticmethod(time.sleep)
+    time = staticmethod(time.perf_counter)
+
+
 def default_serving_setup(on_tpu: bool):
     """ONE source for the model config + engine/load defaults shared by
     ``bench.py --config serve`` and ``tools/serve_load.py`` — tuning
@@ -117,7 +126,8 @@ def run_load(engine: ServeEngine, *, rate: float = 50.0,
              n_requests: int = 32, prompt_len=(4, 24),
              max_new=(4, 24), vocab_size: int | None = None,
              eos_token_id=None, temperature: float = 0.0,
-             seed: int = 0, max_steps: int = 1_000_000) -> LoadResult:
+             seed: int = 0, max_steps: int = 1_000_000,
+             clock=None) -> LoadResult:
     """Drive ``engine`` with Poisson traffic and return latency stats.
 
     Arrival times are pre-drawn (cumsum of Exp(1/rate) gaps) and each
@@ -127,7 +137,13 @@ def run_load(engine: ServeEngine, *, rate: float = 50.0,
     inclusive ranges. Returns exact (sample-based) p50/p99 TTFT —
     the ``serve.ttft_seconds`` histogram the engine records carries
     the same data in bucketed form for the metrics roll-up.
+
+    ``clock`` is an object with ``time() -> seconds`` and
+    ``sleep(seconds)`` (default: real wall clock). Deterministic runs
+    pass an ``observability.FakeClock`` — ideally the same instance
+    the engine was built with, so arrivals and TTFTs share a timeline.
     """
+    clk = clock if clock is not None else _WallClock()
     if vocab_size is None:
         vocab_size = int(engine._arrays["embed"].shape[0])
     rng = np.random.default_rng(seed)
@@ -143,10 +159,10 @@ def run_load(engine: ServeEngine, *, rate: float = 50.0,
     steps = 0
     steps0 = _metric_total("serve.decode_steps")
     preempt0 = _metric_total("serve.preemptions")
-    start = time.perf_counter()
+    start = clk.time()
     i = 0
     while i < n_requests or engine.has_work:
-        now = time.perf_counter() - start
+        now = clk.time() - start
         while i < n_requests and arrivals[i] <= now:
             try:
                 submitted.append(engine.submit(
@@ -168,8 +184,8 @@ def run_load(engine: ServeEngine, *, rate: float = 50.0,
                     f"{len(engine.queue)} queued and {engine.n_active} "
                     f"active — the engine is not making progress")
         elif i < n_requests:
-            time.sleep(min(max(arrivals[i] - now, 0.0), 0.005))
-    wall = time.perf_counter() - start
+            clk.sleep(min(max(arrivals[i] - now, 0.0), 0.005))
+    wall = clk.time() - start
 
     ttfts = np.array([r.ttft for r in submitted
                       if r.ttft is not None], np.float64)

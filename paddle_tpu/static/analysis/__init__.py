@@ -60,6 +60,9 @@ from .liveness import is_effectful, live_op_indices  # noqa: F401
 from .rewrite import (  # noqa: F401
     DEFAULT_PIPELINE, OptimizeResult, REWRITE_CODES, optimize_program,
 )
+from .serve_trace_lint import (  # noqa: F401
+    SERVE_TRACE_LINT_CODES, lint_serve_trace,
+)
 from .sharding_lint import (  # noqa: F401
     SHARDING_LINT_CODES, apply_placement_suggestion, lint_fleet_trace,
     run_placement_lints,
@@ -77,6 +80,7 @@ __all__ = [
     "optimize_program",
     "SHARDING_LINT_CODES", "lint_fleet_trace", "run_placement_lints",
     "apply_placement_suggestion",
+    "SERVE_TRACE_LINT_CODES", "lint_serve_trace",
     "COST_ANALYSIS_CODES", "OpCost", "ProgramCost", "check_cost_model",
     "check_step_time_model", "measure_program_flops", "op_cost",
     "program_cost", "register_op_cost",

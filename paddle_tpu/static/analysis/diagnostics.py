@@ -16,6 +16,10 @@ Code namespace (``PTLxxx``):
   layout/placement findings feeding the auto-parallel planner.
 - ``PTL3xx`` — cost/memory analysis (`cost.py`/`memory.py`/
   `rewrite.py`): predicted OOM, cost-model drift, no-benefit passes.
+- ``PTL4xx`` — serving observability (`observability/slo.py`,
+  `observability/tracing.py`, `serve_trace_lint.py`): SLO breaches,
+  tracing overhead, malformed span trees, decode-burst gaps,
+  preemption thrash.
 """
 from __future__ import annotations
 
@@ -84,6 +88,23 @@ CODES = {
     "PTL305": "auto-sharding search found a placement predicted strictly "
               "faster than the derived plan (informational: the derived "
               "plan is not comm-optimal)",
+    # serving-observability diagnostics (PTL4xx) — request-lifecycle
+    # tracing + SLO guardrails (observability/slo.py + tracing.py +
+    # serve_trace_lint.py)
+    "PTL401": "SLO breach: a declarative rolling-window serving rule "
+              "(p99 TTFT / tokens-per-sec floor / pool-exhaustion rate) "
+              "left its bound",
+    "PTL402": "tracing overhead exceeded: tokens/sec with request "
+              "tracing enabled fell more than the tolerance below the "
+              "untraced run",
+    "PTL403": "span-tree malformed: a request's lifecycle spans are "
+              "unclosed, out of order, or escape the request envelope",
+    "PTL404": "decode-burst gap: the engine sat host-side between decode "
+              "steps while slots were runnable (fused multi-token decode "
+              "would close the gap)",
+    "PTL405": "preemption thrash: the same request was preempted and "
+              "recomputed too many times (pool sizing / admission "
+              "pressure)",
 }
 
 

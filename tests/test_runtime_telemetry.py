@@ -45,16 +45,21 @@ def flight_dir(tmp_path, monkeypatch):
 
 class TestStepRegion:
     def test_records_seconds_items_and_mfu(self, obs_on):
+        # deterministic clock instead of time.sleep: under CI load a
+        # real 5 ms sleep can stretch arbitrarily, skewing mfu/ips
+        clk = obs.FakeClock(start=100.0)
         with obs.step_region("probe", step=0, items=1000, unit="tokens",
-                             flops=5e9, peak_flops=1e12) as r:
-            time.sleep(0.005)
+                             flops=5e9, peak_flops=1e12,
+                             clock=clk) as r:
+            clk.advance(0.01)
+        assert r.seconds == pytest.approx(0.01)
         g = obs.registry.get
         assert g("train.step_seconds").stats(name="probe")["count"] == 1
         assert g("train.steps").value(name="probe") == 1
         ips = g("train.items_per_second").value(name="probe", unit="tokens")
-        assert ips == pytest.approx(1000 / r.seconds)
+        assert ips == pytest.approx(1000 / 0.01)
         mfu = g("train.mfu").value(name="probe")
-        assert mfu == pytest.approx(5e9 / r.seconds / 1e12, rel=1e-3)
+        assert mfu == pytest.approx(5e9 / 0.01 / 1e12, rel=1e-3)
         assert 0 < mfu < 1
         (ev,) = obs.events("train.step")
         assert ev.fields["name"] == "probe"

@@ -265,10 +265,16 @@ class TestEosAndSampling:
 class TestLoadGenerator:
     def test_poisson_load_reports_latency_stats(self):
         model = _model()
+        # ONE FakeClock drives both the engine timestamps and the load
+        # generator's arrival schedule: every timing figure below is
+        # deterministic (the tick guarantees two reads never coincide),
+        # so this test cannot flake under host-scheduling jitter
+        clk = obs.FakeClock(tick=1e-4)
         eng = ServeEngine(model, max_slots=3, block_size=4,
-                          num_blocks=32, max_seq_len=40, name="loadgen")
+                          num_blocks=32, max_seq_len=40, name="loadgen",
+                          clock=clk)
         res = run_load(eng, rate=500.0, n_requests=6, prompt_len=(3, 8),
-                       max_new=(3, 6), seed=0)
+                       max_new=(3, 6), seed=0, clock=clk)
         assert res.n_requests == 6
         assert res.total_tokens == sum(r.n_generated for r in res.requests)
         assert 0 < res.ttft_p50 <= res.ttft_p99
@@ -281,3 +287,141 @@ class TestLoadGenerator:
         # every stream matches its solo decode even under load
         for r in res.requests:
             assert r.output_ids == _solo(model, r.prompt, r.n_generated)
+
+
+class TestRequestTracing:
+    """ISSUE 17 gates: per-request span trees attribute TTFT/latency to
+    named lifecycle phases (~100% by construction — transitions share
+    timestamps), preemption cost shows up as preempt/resume/recompute
+    spans, tracing never perturbs the decoded tokens or retraces the
+    decode step, and SLO breaches leave a flight dump carrying the tail
+    exemplars."""
+
+    def test_preemption_attribution_under_pool_pressure(self):
+        model = _model()
+        rng = np.random.RandomState(1)
+        clk = obs.FakeClock(tick=1e-4)
+        # the PR-14 pool-pressure scenario, now traced: the pool is too
+        # small for both streams' working sets, so the youngest must be
+        # evicted and pay a recompute prefill on resume
+        eng = ServeEngine(model, max_slots=2, block_size=4,
+                          num_blocks=7, max_seq_len=28, name="tr_press",
+                          clock=clk, trace=True)
+        plans = [(rng.randint(1, 97, n), k)
+                 for n, k in [(10, 8), (9, 7), (5, 6)]]
+        reqs = [eng.submit(p, max_new_tokens=k) for p, k in plans]
+        eng.run(max_steps=2000)
+        # tracing is an observer: solo equivalence and the one-trace
+        # invariant hold exactly as they do untraced
+        for r, (p, k) in zip(reqs, plans):
+            assert r.output_ids == _solo(model, p, k), \
+                f"stream {r.id} diverged with tracing enabled"
+        assert eng.decode_traces == 1
+        assert obs.registry.get("serve.decode_traces").value(
+            engine="tr_press") == 1
+
+        docs = {d["id"]: d for d in eng.tracer.requests}
+        assert set(docs) == {r.id for r in reqs}
+        preempted = [r for r in reqs if r.preemptions > 0]
+        assert preempted, "scenario must actually preempt"
+        for r in reqs:
+            d = docs[r.id]
+            assert not d.get("malformed")
+            # leaf phases tile submit->finish exactly: the breakdown
+            # sums to the request's latency and TTFT is fully
+            # attributed to named phases
+            assert sum(d["breakdown"].values()) == \
+                pytest.approx(d["latency_seconds"], rel=1e-6)
+            assert d["latency_attributed_pct"] == pytest.approx(100.0)
+            assert d["ttft_attributed_pct"] == pytest.approx(100.0)
+            assert sum(d["ttft_breakdown"].values()) == \
+                pytest.approx(d["ttft_seconds"], rel=1e-6)
+        for r in preempted:
+            d = docs[r.id]
+            # every preemption episode bills all three phases
+            assert {"preempt", "resume", "recompute"} <= \
+                set(d["breakdown"]), d["breakdown"]
+            spans = [c["name"] for c in d["spans"]["children"]]
+            i = spans.index("preempt")
+            assert spans[i:i + 3] == ["preempt", "resume", "recompute"]
+            assert d["preemptions"] == r.preemptions
+        # phase histograms recorded under the engine+phase labels
+        assert obs.registry.get("trace.phase_seconds").stats(
+            engine="tr_press", phase="recompute")["count"] > 0
+        assert obs.registry.get("trace.spans_recorded").value(
+            engine="tr_press", phase="preempt") > 0
+
+    def test_poisson_drill_slo_breach_with_exemplars(self, tmp_path,
+                                                     monkeypatch):
+        """The ISSUE 17 acceptance drill: Poisson load over a pool under
+        pressure, tracing + SLO rules on — worst-case TTFT >= 90%
+        attributed, the slo_breach flight dump fires with exemplars
+        attached, decode still traces once."""
+        import json
+
+        monkeypatch.setenv(obs.flight.FLIGHT_DIR_ENV,
+                           str(tmp_path / "flight"))
+        model = _model()
+        clk = obs.FakeClock(tick=1e-4)
+        rules = [dict(name="ttft", kind="ttft_p99", threshold=1e-3,
+                      window_seconds=1e9),
+                 dict(name="pool", kind="pool_exhaustion_rate",
+                      threshold=0.01, window_seconds=1e9)]
+        eng = ServeEngine(model, max_slots=2, block_size=4,
+                          num_blocks=7, max_seq_len=28, name="drill",
+                          clock=clk, trace=True, slo=rules)
+        res = run_load(eng, rate=400.0, n_requests=8,
+                       prompt_len=(8, 10), max_new=(5, 8), seed=2,
+                       clock=clk)
+        assert res.preemptions > 0, "drill must run under pool pressure"
+        assert eng.decode_traces == 1
+
+        # every worst-case exemplar attributes >= 90% of its TTFT and
+        # latency to named phases (exactly 100% here — the FakeClock
+        # tree is contiguous by construction)
+        ex = eng.tracer.exemplars
+        assert ex.worst_ttft and ex.worst_latency
+        for d in ex.worst_ttft:
+            assert d["ttft_attributed_pct"] >= 90.0
+        for d in ex.worst_latency:
+            assert d["latency_attributed_pct"] >= 90.0
+
+        # the TTFT rule must have latched (threshold 1 ms, FakeClock
+        # queue waits are far larger) and dumped a post-mortem with the
+        # exemplars riding along
+        assert any(b["rule"] == "ttft" for b in eng.slo.breaches)
+        assert obs.registry.get("trace.slo_breaches").value(
+            engine="drill", rule="ttft") == 1
+        assert any(d.code == "PTL401" for d in eng.slo.report)
+        dumps = sorted((tmp_path / "flight").glob("flight-*.json"))
+        assert dumps, "slo_breach flight dump did not fire"
+        docs = [json.loads(p.read_text()) for p in dumps]
+        breach_docs = [d for d in docs if d["reason"] == "slo_breach"]
+        assert breach_docs
+        ctx = breach_docs[0]["context"]
+        assert ctx["rule"] in {"ttft", "pool"}
+        assert ctx["exemplars"]["worst_ttft"], \
+            "exemplar span trees must ride the breach dump"
+        # the dump renders with the interpretation + exemplar block
+        text = obs.render_flight(breach_docs[0])
+        assert "slo_breach" in text and "tail exemplars" in text
+
+    def test_tracing_disabled_by_default_and_env_gated(self, monkeypatch):
+        model = _model()
+        monkeypatch.delenv("PADDLE_TPU_TRACE", raising=False)
+        monkeypatch.delenv("PADDLE_TPU_SLO", raising=False)
+        eng = ServeEngine(model, max_slots=1, block_size=4,
+                          num_blocks=8, max_seq_len=16, name="notrace")
+        assert eng.tracer is None and eng.slo is None
+        monkeypatch.setenv("PADDLE_TPU_TRACE", "1")
+        monkeypatch.setenv(
+            "PADDLE_TPU_SLO",
+            '[{"name": "t", "kind": "ttft_p99", "threshold": 5.0}]')
+        eng2 = ServeEngine(model, max_slots=1, block_size=4,
+                           num_blocks=8, max_seq_len=16, name="envtrace")
+        assert eng2.tracer is not None
+        assert eng2.slo is not None and eng2.slo.rules[0].name == "t"
+        r = eng2.submit(np.arange(1, 5), max_new_tokens=2)
+        eng2.run()
+        assert r.trace is not None and r.trace.finished
+        assert eng2.tracer.n_traced == 1

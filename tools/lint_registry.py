@@ -207,6 +207,22 @@ SERVE_REQUIRED_LABELS = {
     "serve.prefill_seconds": ("engine",),
 }
 
+#: request-tracing / SLO label discipline (observability/tracing.py +
+#: slo.py): per-phase series must say WHICH phase, breaches WHICH rule,
+#: malformed-tree findings WHICH reason, exemplar retention WHICH kind —
+#: and everything says WHICH engine, same as the serve. subsystem it
+#: instruments.
+TRACE_REQUIRED_LABELS = {
+    "trace.requests_traced": ("engine",),
+    "trace.spans_recorded": ("engine", "phase"),
+    "trace.phase_seconds": ("engine", "phase"),
+    "trace.decode_gap_seconds": ("engine",),
+    "trace.exemplars_kept": ("engine", "kind"),
+    "trace.spans_malformed": ("engine", "reason"),
+    "trace.overhead_pct": ("engine",),
+    "trace.slo_breaches": ("engine", "rule"),
+}
+
 #: one audit loop serves every per-subsystem required-labels table —
 #: add the next subsystem as a row here, not as another copied loop
 REQUIRED_LABEL_TABLES = (
@@ -220,6 +236,9 @@ REQUIRED_LABEL_TABLES = (
                             "the reason/job)"),
     (SERVE_REQUIRED_LABELS, "serve series must attribute the engine "
                             "(and the reason where one applies)"),
+    (TRACE_REQUIRED_LABELS, "trace series must attribute the engine "
+                            "(and the phase/rule/reason/kind where one "
+                            "applies)"),
 )
 
 #: gauge-prefix discipline: no gauge under these prefixes may record an
@@ -231,6 +250,8 @@ NO_UNLABELED_GAUGE_PREFIXES = {
     "serve.": "every serve gauge must carry at least an engine= label",
     "cost.": "every cost gauge must carry at least a name= label (the "
              "program the prediction describes)",
+    "trace.": "every trace gauge must carry at least an engine= label "
+              "(serve-trace series merge through the fleet plane too)",
 }
 
 
@@ -245,6 +266,8 @@ def check_metric_registry() -> List[str]:
     import paddle_tpu.io.dataloader  # noqa: F401
     import paddle_tpu.observability.fleet  # noqa: F401
     import paddle_tpu.observability.runtime  # noqa: F401
+    import paddle_tpu.observability.slo  # noqa: F401
+    import paddle_tpu.observability.tracing  # noqa: F401
     import paddle_tpu.serve  # noqa: F401
     from paddle_tpu.observability.metrics import (CLAIMED_SUBSYSTEMS,
                                                   NAME_RE)
@@ -314,8 +337,11 @@ def check_diagnostic_registry() -> List[str]:
     by at least one test (string-presence scan over ``tests/``)."""
     from paddle_tpu.distributed import passes as passes_mod
     from paddle_tpu.distributed.passes.lint_fix_passes import LintFixPass
+    from paddle_tpu.observability import slo as slo_mod
+    from paddle_tpu.observability import tracing as tracing_mod
     from paddle_tpu.static.analysis import cost as cost_mod
-    from paddle_tpu.static.analysis import diagnostics, sharding_lint
+    from paddle_tpu.static.analysis import diagnostics, serve_trace_lint
+    from paddle_tpu.static.analysis import sharding_lint
     from paddle_tpu.static.analysis import lint as lint_mod
 
     problems = []
@@ -335,6 +361,15 @@ def check_diagnostic_registry() -> List[str]:
             problems.append(
                 f"cost-analysis code {code!r} is not documented in "
                 f"diagnostics.CODES")
+    for claimed_by, codes in (
+            ("serve_trace_lint", serve_trace_lint.SERVE_TRACE_LINT_CODES),
+            ("observability.tracing", tracing_mod.TRACE_CODES),
+            ("observability.slo", slo_mod.SLO_CODES)):
+        for code in codes:
+            if code not in diagnostics.CODES:
+                problems.append(
+                    f"{claimed_by} code {code!r} is not documented in "
+                    f"diagnostics.CODES")
     for name, cls in sorted(passes_mod._PASS_REGISTRY.items()):
         if isinstance(cls, type) and issubclass(cls, LintFixPass):
             code = getattr(cls, "code", "")

@@ -6,6 +6,7 @@ Usage:
     python tools/metrics_report.py flight-1234-1.json   # flight dumps too
     python tools/metrics_report.py /tmp/flight_dir      # a whole incident
     python tools/metrics_report.py --fleet /tmp/fleet   # cross-rank view
+    python tools/metrics_report.py --serve-trace /tmp/serve_trace
 
 Input is either the JSON written by ``paddle_tpu.observability.dump(path)``
 (or any workload run with ``PADDLE_TPU_METRICS_DUMP=metrics.json``), or a
@@ -35,6 +36,12 @@ become a per-rank step/skew summary, a merged metric table (counters
 summed, gauges rank-labeled), the clock-aligned cross-rank event
 interleaving and the flight-dump index
 (``observability.fleet.render_incident``).
+
+``--serve-trace <dir-or-file>`` renders the request-lifecycle trace a
+``ServeTracer`` writes (``tools/serve_load.py --trace-out DIR``):
+header, per-phase p50/p99 latency-attribution table, tail exemplars —
+then runs the serve-trace lint (PTL404 decode-burst gaps, PTL405
+preemption thrash), the serving analog of the ``--fleet`` PTL203 lint.
 """
 from __future__ import annotations
 
@@ -124,6 +131,33 @@ def _render_fleet_dir(dirname: str, events, top) -> int:
     return 0
 
 
+def _render_serve_trace(path: str) -> int:
+    """Render one serve_trace dump (a ``serve_requests.json`` file or
+    the ``--trace-out`` directory holding it) + the PTL404/PTL405 lint."""
+    from paddle_tpu.observability.tracing import render_serve_trace
+    from paddle_tpu.static.analysis import lint_serve_trace
+
+    if os.path.isdir(path):
+        path = os.path.join(path, "serve_requests.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"metrics_report: cannot read {path!r}: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        print(render_serve_trace(doc))
+        report = lint_serve_trace(doc)
+    except ValueError as e:
+        print(f"metrics_report: {path!r}: {e}", file=sys.stderr)
+        return 1
+    print()
+    print(report.render(
+        f"serve trace lint ({os.path.basename(path)}):"))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("dump", help="JSON written by observability.dump(), a "
@@ -139,7 +173,15 @@ def main(argv=None) -> int:
                          "per-rank metric dumps + flight dumps + the "
                          "launcher's fleet_metrics.json rendered as one "
                          "cross-rank report")
+    ap.add_argument("--serve-trace", action="store_true",
+                    help="treat the path as a ServeTracer dump "
+                         "(serve_requests.json or the --trace-out dir): "
+                         "per-phase breakdown + tail exemplars + the "
+                         "PTL404/PTL405 serve-trace lint")
     args = ap.parse_args(argv)
+
+    if args.serve_trace:
+        return _render_serve_trace(args.dump)
 
     if args.fleet:
         if not os.path.isdir(args.dump):

@@ -12,6 +12,21 @@ Run::
     python tools/serve_load.py --rate 300 --requests 32
     python tools/serve_load.py --metrics    # + observability roll-up
                                             # (same keys as bench.py)
+    python tools/serve_load.py --trace-out /tmp/serve_trace \
+        --slo '[{"name":"ttft","kind":"ttft_p99","threshold":0.2}]'
+
+``--trace-out DIR`` runs the engine with request-lifecycle tracing and
+writes three artifacts into DIR: ``serve_requests.json`` (the
+``serve_trace`` dump — per-request span trees, per-phase breakdowns,
+decode-step records, tail exemplars; render with
+``tools/metrics_report.py --serve-trace DIR``), ``serve_chrome.json``
+(one lane per decode slot in ``chrome://tracing`` format, mergeable
+into a fleet timeline by ``fleet.merge_chrome_trace_files``) and
+``tail_report.txt`` (the worst-TTFT / worst-latency exemplar
+breakdowns as text). ``--slo`` attaches SLO rules (inline JSON or a
+rules-file path, same syntax as ``PADDLE_TPU_SLO``); breaches print
+and, when ``PADDLE_TPU_FLIGHT_DIR`` is set, dump flight recorders
+with the exemplars attached.
 
 ``bench.py --config serve --metrics`` produces the canonical BENCH
 record with the same generator; this CLI is the knob-turning surface
@@ -55,6 +70,14 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics", action="store_true",
                     help="enable observability and print the serve_* "
                          "roll-up keys (bench.py --metrics parity)")
+    ap.add_argument("--trace-out", default=None, metavar="DIR",
+                    help="trace every request and write "
+                         "serve_requests.json + serve_chrome.json + "
+                         "tail_report.txt into DIR")
+    ap.add_argument("--slo", default=None, metavar="RULES",
+                    help="SLO rules: inline JSON list or a JSON file "
+                         "path (PADDLE_TPU_SLO syntax); breaches print "
+                         "after the run")
     args = ap.parse_args(argv)
 
     import jax
@@ -64,7 +87,7 @@ def main(argv=None) -> int:
     from paddle_tpu.serve import ServeEngine, run_load
     from paddle_tpu.serve.load import default_serving_setup, warm_engine
 
-    if args.metrics:
+    if args.metrics or args.trace_out or args.slo:
         import paddle_tpu.observability as obs
 
         obs.enable()
@@ -97,7 +120,9 @@ def main(argv=None) -> int:
     model.eval()
     engine = ServeEngine(model, max_slots=slots, block_size=block_size,
                          num_blocks=num_blocks, max_seq_len=max_seq_len,
-                         name="serve_load")
+                         name="serve_load",
+                         trace=bool(args.trace_out) or None,
+                         slo=args.slo)
     warm_engine(engine)     # decode step + every prefill bucket
 
     res = run_load(engine, rate=rate, n_requests=n_req, prompt_len=plen,
@@ -109,6 +134,23 @@ def main(argv=None) -> int:
         block_size=block_size, decode_traces=engine.decode_traces,
         prefill_traces=engine.prefill_traces,
         pool_blocks_leaked=engine.pool.used_blocks)
+    if engine.slo is not None:
+        record["load"]["slo_breaches"] = list(engine.slo.breaches)
+    if args.trace_out:
+        out = args.trace_out
+        os.makedirs(out, exist_ok=True)
+        tracer = engine.tracer
+        paths = {
+            "requests": tracer.dump(
+                os.path.join(out, "serve_requests.json")),
+            "chrome": tracer.write_chrome_trace(
+                os.path.join(out, "serve_chrome.json")),
+        }
+        tail = os.path.join(out, "tail_report.txt")
+        with open(tail, "w") as f:
+            f.write(tracer.exemplars.render() + "\n")
+        paths["tail"] = tail
+        record["trace_out"] = paths
     print(json.dumps(record), flush=True)
     if args.metrics:
         from bench import _emit_metrics_block
