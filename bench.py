@@ -202,6 +202,11 @@ def _emit_metrics_block():
                           labels={"phase": "prefill"}),
         "serve_decode_gap_seconds": gauge_max("trace.decode_gap_seconds"),
         "trace_slo_breaches": tot("trace.slo_breaches"),
+        # op-level execution-profiler roll-ups (observability/opprof.py;
+        # populated when --opprof runs the profiled replay)
+        "opprof_steps_profiled": tot("opprof.steps_profiled"),
+        "opprof_attributed_pct": gauge_max("opprof.attributed_pct"),
+        "opprof_overhead_pct": gauge_max("opprof.overhead_pct"),
     }}), flush=True)
 
 
@@ -503,6 +508,89 @@ def bench_cost_model():
         "step_drift_ptl304": len(step_drift),
         "comm_predicted_bytes_2way": comm_bytes,
     }}), flush=True)
+
+
+def bench_opprof():
+    """Measure the cost of measuring: run the op-level execution
+    profiler (observability/opprof.py) over the bench llama train
+    program and append a BENCH line with the amortized profiling
+    overhead pct and the top-3 op step-share — so the price of
+    observing is itself a tracked number (the ``--profile`` analog for
+    the per-op timeline).
+
+    The eager per-op-blocking replay is inherently slower than the
+    fused jit step; what the budget pacer promises is the AMORTIZED
+    rate: one profiled step per pacing interval, jit steps in between.
+    That amortized steps/sec is what ``check_opprof_overhead`` holds
+    against the 5% PTL503 budget here."""
+    import jax
+
+    import paddle_tpu.static as static
+    from paddle_tpu.observability import opprof
+
+    prog, feed, fetch = capture_llama_train_program()
+    exe = static.Executor()
+    # warm the jit path so compile stays out of both sides
+    exe.run(prog, feed=feed, fetch_list=fetch, return_numpy=False)
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        outs = exe.run(prog, feed=feed, fetch_list=fetch,
+                       return_numpy=False)
+    jax.block_until_ready(outs)
+    t_jit = (time.perf_counter() - t0) / reps
+
+    budget_pct = opprof.DEFAULT_BUDGET_PCT
+    prof = opprof.OpProfiler(name="llama", budget_pct=budget_pct)
+    feed_items = sorted(feed.items())
+    feed_names = tuple(k for k, _ in feed_items)
+    arrays = [np.asarray(v) for _, v in feed_items]
+    fetch_vids = [prog.vid_of(t) for t in fetch]
+    t0 = time.perf_counter()
+    _, profile = prof.run_program(prog, feed_names, arrays, fetch_vids)
+    t_prof = time.perf_counter() - t0
+
+    # amortized steps/sec at the pacer's rate: one profiled step
+    # (cost t_prof, replacing a jit step) per idle window long enough
+    # to keep its share under the budget
+    idle = t_prof * (100.0 - budget_pct) / budget_pct
+    sps_off = 1.0 / t_jit if t_jit > 0 else 0.0
+    sps_on = (idle / t_jit + 1.0) / (idle + t_prof) \
+        if t_jit > 0 and (idle + t_prof) > 0 else 0.0
+    guard = opprof.check_opprof_overhead(sps_on, sps_off,
+                                         tolerance_pct=budget_pct,
+                                         name="llama")
+    overhead = (100.0 * (sps_off - sps_on) / sps_off) if sps_off else 0.0
+
+    rows = sorted(profile.rows or [],
+                  key=lambda r: -float(r["measured_seconds"]))
+    top3 = [{"prim": r["prim"], "op": r["index"],
+             "share_pct": r["share_pct"]} for r in rows[:3]]
+    lint = opprof.lint_op_profile(profile)
+    print(json.dumps({"opprof": {
+        "profiled_step_seconds": round(profile.step_seconds, 6),
+        "jit_step_seconds": round(t_jit, 6),
+        "attributed_pct": round(profile.attributed_pct, 3),
+        "top3_op_step_share": top3,
+        "ptl501_hot_op_drift": len(lint.by_code("PTL501")),
+        "ptl502_attribution_shortfall": len(lint.by_code("PTL502")),
+        "ptl503_overhead": len(guard),
+    }}), flush=True)
+    top3_s = ", ".join(f"{t['prim']}={t['share_pct']:.1f}%"
+                       for t in top3)
+    print(json.dumps({
+        "metric": f"opprof overhead pct (amortized at the "
+                  f"{budget_pct:.0f}% budget pacer: profiled step "
+                  f"{t_prof * 1e3:.1f} ms vs jit step "
+                  f"{t_jit * 1e3:.1f} ms; PTL503 above "
+                  f"{budget_pct:.0f}%; top-3 op step-share {top3_s}; "
+                  f"vs_baseline is profiled/unprofiled steps-per-sec)",
+        "value": round(float(overhead), 3),
+        "unit": "pct",
+        "vs_baseline": round(sps_on / sps_off, 4) if sps_off else 0.0,
+    }), flush=True)
+    for d in guard:
+        print(json.dumps({"diagnostic": d.render()}), flush=True)
 
 
 def bench_resnet(on_tpu, steps, warmup, peak_flops):
@@ -1085,6 +1173,8 @@ def _run_isolated(config: str, args) -> int:
         cmd += ["--steps", str(args.steps)]
     if args.profile and config == "llama":
         cmd += ["--profile"]
+    if args.opprof and config == "llama":
+        cmd += ["--opprof"]
     if args.metrics:
         cmd += ["--metrics"]
     proc = subprocess.run(cmd)
@@ -1100,6 +1190,11 @@ def main():
                     choices=["llama", "resnet", "moe", "bert", "sdxl",
                              "decode", "serve", "all"])
     ap.add_argument("--profile", action="store_true")
+    ap.add_argument("--opprof", action="store_true",
+                    help="run the op-level execution profiler over the "
+                         "bench llama train program and append a BENCH "
+                         "line with the amortized profiling overhead "
+                         "pct and top-3 op step-share")
     ap.add_argument("--metrics", action="store_true",
                     help="enable paddle_tpu.observability and append a "
                          "metrics JSON line per config")
@@ -1160,6 +1255,10 @@ def main():
             # the bench llama program so the opt. counters land in the
             # roll-up below
             bench_optimize(on_tpu)
+        if args.opprof:
+            # also after the timed window: the profiled replay must
+            # never tax the headline tokens/sec measurement
+            bench_opprof()
 
     if args.metrics:
         _emit_metrics_block()

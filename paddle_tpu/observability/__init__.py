@@ -62,6 +62,13 @@ from .slo import SloMonitor, SloRule
 from . import tracing
 from .tracing import (RequestTrace, ServeTracer, Span, TailExemplars,
                       check_tracing_overhead, validate_trace)
+from . import chrome
+from . import opprof
+from .opprof import (OpCalibration, OpProfile, OpProfiler, OpSpan,
+                     attribute_profile, calibrate_op_costs,
+                     check_opprof_overhead, lint_op_profile,
+                     load_op_calibration, render_op_profile,
+                     resolve_op_calibration, save_op_calibration)
 
 __all__ = [
     "state", "enabled", "enable", "disable", "reset",
@@ -76,6 +83,11 @@ __all__ = [
     "slo", "SloMonitor", "SloRule",
     "tracing", "Span", "RequestTrace", "ServeTracer", "TailExemplars",
     "check_tracing_overhead", "validate_trace",
+    "chrome", "opprof", "OpSpan", "OpProfile", "OpProfiler",
+    "OpCalibration", "attribute_profile", "calibrate_op_costs",
+    "save_op_calibration", "load_op_calibration",
+    "resolve_op_calibration", "lint_op_profile", "check_opprof_overhead",
+    "render_op_profile",
 ]
 
 counter = registry.counter

@@ -56,6 +56,10 @@ CLAIMED_SUBSYSTEMS = {
     "trace",       # observability/tracing.py + slo.py — request-scoped
                    # span tracing: per-phase seconds, tail exemplars,
                    # decode-gap accounting, SLO breaches, overhead guard
+    "opprof",      # observability/opprof.py — op-level execution
+                   # profiler: per-op measured seconds, attribution
+                   # coverage, measured/predicted drift, pacer skips,
+                   # profiling overhead guard
     "test",        # scratch names registered by the test suite
 }
 

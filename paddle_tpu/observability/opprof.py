@@ -1,0 +1,894 @@
+"""Per-op execution profiler: measured-time attribution for Program replay.
+
+The static-analysis plane predicts per-op cost (PR 15's FLOPs/bytes
+model, PR 16's comm model and predicted step time); until now the
+observability plane only *measured* at whole-step granularity
+(``train.step_seconds``, ``train.mfu``) — so PTL302/PTL304 drift alarms
+could say "the model is off" but never WHICH op is off. This module is
+the measurement half of that loop: an env-gated
+(``PADDLE_TPU_OPPROF``) op-level profiling mode that replays a captured
+``Program`` op by op, bracketing every instruction with an
+injectable-clock timer and blocking on device results
+(``jax.block_until_ready``) so timings are honest under async dispatch.
+
+Span discipline: consecutive op spans SHARE boundaries (one clock read
+per boundary, the ``tracing.RequestTrace`` transition rule), and the
+feed-bind / fetch-gather phases get pseudo-spans of their own — so the
+spans tile ``[step_start, step_end]`` exactly by construction and
+attribution is loss-free. A profile whose spans do NOT tile its step
+(a truncated dump, an outer step measurement, a profiler bug) is
+exactly what PTL502 exists to catch.
+
+Three consumers close the predicted-vs-measured loop:
+
+- **Attribution** (:func:`attribute_profile`): joins the measured
+  timeline against ``static/analysis/cost.py`` per-op FLOPs/bytes to
+  produce, per op, achieved FLOP/s and bytes/s, roofline position
+  against :func:`~paddle_tpu.observability.runtime.default_peak_flops`,
+  and the measured/predicted drift ratio the PTL501 hot-op lint reads.
+- **Calibration** (:func:`calibrate_op_costs`): per-op-class correction
+  factors (measured seconds / predicted seconds per prim, plus a
+  whole-program FLOPs factor against XLA's compiled count), persisted
+  to JSON (:func:`save_op_calibration`) and consumed by
+  ``cost.program_cost`` via the ``PADDLE_TPU_OP_CALIBRATION`` env (the
+  ``PADDLE_TPU_COMM_PARAMS`` convention) — so PTL302/PTL304 drift
+  tightens from measurement instead of hand-tuning.
+- **Chrome-trace export**: the per-op timeline rides the shared
+  ``observability.chrome`` exporter, so it is
+  ``fleet.merge_chrome_trace_files``-compatible (multi-rank training
+  steps render per-rank op lanes next to PR 17's serve lanes), with
+  ``RecordEvent`` spans from the legacy ``paddle_tpu/profiler`` package
+  mirrored into the same timeline: each profiled op is bracketed in a
+  ``RecordEvent`` (so an active legacy host tracer sees the ops), and
+  collected host spans can be handed back to
+  :meth:`OpProfiler.chrome_trace_events` as an extra lane.
+
+Cost control: an op-by-op replay with per-op blocking is far slower
+than the fused jit step, so the Executor hook SAMPLES. With
+``PADDLE_TPU_OPPROF_STRIDE=N`` every Nth run is profiled; by default
+(budget pacing) the profiler waits after each profiled step until
+enough unprofiled wall time has passed that the amortized overhead
+stays under ``PADDLE_TPU_OPPROF_BUDGET_PCT`` (default 5%).
+:func:`check_opprof_overhead` is the guard on that promise — the
+PTL402 analog, filing **PTL503** when the measured steps/sec budget is
+exceeded (``bench.py --opprof`` runs it).
+
+Diagnostics this module emits: PTL501 (hot-op drift), PTL502
+(attribution shortfall), PTL503 (profiling overhead exceeded) — see
+:data:`OPPROF_CODES`, audited by ``tools/lint_registry.py``.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from . import chrome
+from .metrics import registry
+from .runtime import default_peak_flops
+
+__all__ = [
+    "OpSpan", "OpProfile", "OpProfiler", "OpCalibration",
+    "attribute_profile", "calibrate_op_costs", "save_op_calibration",
+    "load_op_calibration", "resolve_op_calibration", "lint_op_profile",
+    "check_opprof_overhead", "render_op_profile",
+    "opprof_enabled_from_env", "active_session", "session",
+    "reset_session", "OPPROF_ENV", "OPPROF_STRIDE_ENV",
+    "OPPROF_BUDGET_ENV", "OP_CALIBRATION_ENV", "OPPROF_CODES",
+]
+
+OPPROF_ENV = "PADDLE_TPU_OPPROF"
+OPPROF_STRIDE_ENV = "PADDLE_TPU_OPPROF_STRIDE"
+OPPROF_BUDGET_ENV = "PADDLE_TPU_OPPROF_BUDGET_PCT"
+#: inline JSON or a file path, the PADDLE_TPU_COMM_PARAMS convention
+OP_CALIBRATION_ENV = "PADDLE_TPU_OP_CALIBRATION"
+
+#: diagnostic codes this module emits (documented in
+#: static/analysis/diagnostics.py:CODES; audited by tools/lint_registry.py)
+OPPROF_CODES = ("PTL501", "PTL502", "PTL503")
+
+#: default amortized-overhead budget (percent of steps/sec) the pacer
+#: targets and PTL503 enforces
+DEFAULT_BUDGET_PCT = 5.0
+
+#: the __gradients__ pseudo-op (static/analysis/verify.GRAD_OP) — the
+#: one instruction the profiled interpreter replays via jax.grad of the
+#: forward sub-replay, timed as a single named span
+_GRAD_OP = "__gradients__"
+
+#: pseudo-span names for the non-op phases that complete the step tiling
+_FEED_SPAN = "__feed__"
+_FETCH_SPAN = "__fetch__"
+
+# --- opprof. metric subsystem (prefix claimed in CLAIMED_SUBSYSTEMS) ---
+M_STEPS_PROFILED = registry.counter(
+    "opprof.steps_profiled",
+    "Program replays executed under the op-by-op profiled interpreter, "
+    "by profile name")
+M_STEPS_SKIPPED = registry.counter(
+    "opprof.steps_skipped",
+    "Executor runs the opprof pacer let ride the fused jit path while "
+    "profiling was enabled (stride/budget sampling), by profile name")
+M_OP_SECONDS = registry.histogram(
+    "opprof.op_seconds",
+    "measured wall seconds per profiled step attributed to one "
+    "primitive class, by profile name and prim — the per-op truth the "
+    "cost-model calibration fits against")
+M_STEP_SECONDS = registry.histogram(
+    "opprof.step_seconds",
+    "wall seconds of one profiled (eager, per-op-blocking) step, by "
+    "profile name — NOT comparable to train.step_seconds of the fused "
+    "jit step; the pacer amortizes the difference")
+M_ATTRIBUTED = registry.gauge(
+    "opprof.attributed_pct",
+    "percent of the last profiled step's wall time covered by named op "
+    "spans, by profile name (PTL502 fires when it falls below the "
+    "attribution floor)")
+M_OVERHEAD = registry.gauge(
+    "opprof.overhead_pct",
+    "steps/sec cost of profiling: 100*(off-on)/off at the pacer's "
+    "sampling rate, by profile name (PTL503 above tolerance — the "
+    "PTL402 analog for the training plane)")
+M_DRIFT = registry.gauge(
+    "opprof.drift_ratio",
+    "measured/predicted seconds per primitive class from the last "
+    "attributed profile, by profile name and prim (the per-op "
+    "decomposition of PTL302/PTL304 whole-program drift)")
+
+
+def opprof_enabled_from_env() -> bool:
+    """True when ``PADDLE_TPU_OPPROF`` opts Executor.run into op-level
+    profiling."""
+    return os.environ.get(OPPROF_ENV, "").strip().lower() not in (
+        "", "0", "false", "no", "off")
+
+
+# ---------------------------------------------------------------------------
+# profile data model
+# ---------------------------------------------------------------------------
+
+@dataclass
+class OpSpan:
+    """One timed instruction (or pseudo-phase) of a profiled replay.
+
+    ``index`` is the instruction index in ``Program._insts`` (None for
+    the ``__feed__``/``__fetch__`` pseudo-phases). Consecutive spans
+    share boundaries — ``end`` of op N is ``start`` of op N+1."""
+
+    index: Optional[int]
+    prim: str
+    start: float
+    end: float
+
+    @property
+    def seconds(self) -> float:
+        return max(self.end - self.start, 0.0)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"index": self.index, "prim": self.prim,
+                "start": round(self.start, 9), "end": round(self.end, 9),
+                "seconds": round(self.seconds, 9)}
+
+
+@dataclass
+class OpProfile:
+    """The measured timeline of ONE profiled step."""
+
+    name: str
+    step_start: float
+    step_end: float
+    spans: List[OpSpan] = field(default_factory=list)
+    fingerprint: Optional[str] = None
+    #: attribution join output (attribute_profile): one row per op span
+    rows: Optional[List[Dict[str, Any]]] = None
+    #: the cost model's whole-step prediction, copied at join time
+    predicted_step_seconds: Optional[float] = None
+
+    @property
+    def step_seconds(self) -> float:
+        return max(self.step_end - self.step_start, 0.0)
+
+    @property
+    def attributed_seconds(self) -> float:
+        return sum(s.seconds for s in self.spans)
+
+    @property
+    def attributed_pct(self) -> float:
+        step = self.step_seconds
+        if step <= 0:
+            return 100.0
+        return 100.0 * min(self.attributed_seconds / step, 1.0)
+
+    def seconds_by_prim(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for s in self.spans:
+            out[s.prim] = out.get(s.prim, 0.0) + s.seconds
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "fingerprint": self.fingerprint,
+            "step_start": round(self.step_start, 9),
+            "step_end": round(self.step_end, 9),
+            "step_seconds": round(self.step_seconds, 9),
+            "attributed_pct": round(self.attributed_pct, 3),
+            "spans": [s.to_dict() for s in self.spans],
+        }
+        if self.rows is not None:
+            d["rows"] = [dict(r) for r in self.rows]
+        if self.predicted_step_seconds is not None:
+            d["predicted_step_seconds"] = round(
+                self.predicted_step_seconds, 9)
+        return d
+
+
+# ---------------------------------------------------------------------------
+# the profiler
+# ---------------------------------------------------------------------------
+
+class _Pacer:
+    """Decides which Executor runs pay for a profiled (eager) step.
+
+    Stride mode (``stride=N``): every Nth run. Budget mode (default):
+    after a profiled step costing ``C`` wall seconds, skip until the
+    wall time since then satisfies ``idle * budget/(100-budget) >= C``
+    — i.e. the amortized overhead of the NEXT profile stays within the
+    budget. The first run always profiles."""
+
+    __slots__ = ("stride", "budget_frac", "runs", "last_cost", "last_end")
+
+    def __init__(self, stride: Optional[int], budget_pct: float):
+        self.stride = stride
+        budget_pct = min(max(float(budget_pct), 0.1), 99.0)
+        self.budget_frac = budget_pct / (100.0 - budget_pct)
+        self.runs = 0
+        self.last_cost: Optional[float] = None
+        self.last_end = 0.0
+
+    def should_profile(self, now: float) -> bool:
+        self.runs += 1
+        if self.stride:
+            return (self.runs - 1) % self.stride == 0
+        if self.last_cost is None:
+            return True
+        return (now - self.last_end) * self.budget_frac >= self.last_cost
+
+    def profiled(self, cost_seconds: float, end: float):
+        self.last_cost = max(cost_seconds, 0.0)
+        self.last_end = end
+
+
+def _env_stride() -> Optional[int]:
+    raw = os.environ.get(OPPROF_STRIDE_ENV, "").strip().lower()
+    if not raw or raw == "auto":
+        return None
+    try:
+        return max(int(raw), 1)
+    except ValueError:
+        return None
+
+
+def _env_budget_pct() -> float:
+    try:
+        return float(os.environ.get(OPPROF_BUDGET_ENV, ""))
+    except ValueError:
+        return DEFAULT_BUDGET_PCT
+
+
+class OpProfiler:
+    """Op-level execution profiler for captured ``Program`` replays.
+
+    ``clock`` is injectable (``FakeClock``) for deterministic tests;
+    the default is ``time.perf_counter`` — the same clock the legacy
+    host tracer's ``perf_counter_ns`` ticks on, so mirrored
+    ``RecordEvent`` spans line up in the merged chrome timeline.
+    Retention is bounded: the last ``max_profiles`` profiles ride a
+    ring; everything else is exported (metrics, dumps) as it happens.
+    """
+
+    def __init__(self, *, name: str = "program", clock=None,
+                 stride: Optional[int] = None,
+                 budget_pct: Optional[float] = None,
+                 max_profiles: int = 16, attribute: bool = True):
+        self.name = str(name)
+        self.clock = clock if clock is not None else time.perf_counter
+        stride = _env_stride() if stride is None else max(int(stride), 1)
+        budget = _env_budget_pct() if budget_pct is None else budget_pct
+        self.pacer = _Pacer(stride, budget)
+        self.attribute = attribute
+        self.profiles: collections.deque = collections.deque(
+            maxlen=max(1, int(max_profiles)))
+        self.last: Optional[OpProfile] = None
+        self.steps_profiled = 0
+        self._cost_cache: Dict[Any, Any] = {}
+
+    # -- profiled interpreter ---------------------------------------------
+    def run_program(self, program, feed_names, feed_arrays, fetch_vids,
+                    *, name: Optional[str] = None):
+        """Eager op-by-op replay of ``program`` mirroring
+        ``Executor._compile``'s jit closure, with every instruction
+        timed (shared-boundary spans) and blocked on
+        (``jax.block_until_ready``) so async dispatch cannot smear one
+        op's time into the next. Returns ``(fetch_values, OpProfile)``.
+
+        Each op is also bracketed in a legacy ``profiler.RecordEvent``
+        — free when the host tracer is disabled, and when a
+        ``profiler.Profiler`` window is recording, the op spans land in
+        ITS chrome export too (the mirror the reference host tracer
+        keeps between its tracer layers)."""
+        import jax
+
+        from ..core import dispatch
+        from ..profiler.host_tracer import TracerEventType
+        from ..profiler.utils import RecordEvent
+        from ..static.program import _ReplaySnapshot, _replay_gradients
+
+        name = name or self.name
+        snap = program if isinstance(program, _ReplaySnapshot) \
+            else _ReplaySnapshot(program)
+        clock = self.clock
+        spans: List[OpSpan] = []
+        rec_step = RecordEvent("opprof.step",
+                               TracerEventType.ProfileStep)
+        rec_step.begin()
+        try:
+            t = step_start = clock()
+            env: Dict[int, Any] = dict(snap._consts)
+            for n, a in zip(feed_names, feed_arrays):
+                env[snap._feed_names[n]] = a
+            t2 = clock()
+            spans.append(OpSpan(None, _FEED_SPAN, t, t2))
+            t = t2
+            for idx, (prim_name, in_vids, static_items, out_vids) in \
+                    enumerate(snap._insts):
+                rec = RecordEvent(prim_name, TracerEventType.Operator)
+                rec.begin()
+                try:
+                    if prim_name == _GRAD_OP:
+                        grads = _replay_gradients(
+                            snap, idx, in_vids[0], in_vids[1:], env)
+                        jax.block_until_ready(grads)
+                        for v, g in zip(out_vids, grads):
+                            env[v] = g
+                    else:
+                        prim = dispatch.PRIMITIVES[prim_name]
+                        outs = prim.forward(*[env[v] for v in in_vids],
+                                            **dict(static_items))
+                        outs = outs if isinstance(outs, tuple) \
+                            else (outs,)
+                        jax.block_until_ready(outs)
+                        for v, o in zip(out_vids, outs):
+                            env[v] = o
+                finally:
+                    rec.end()
+                t2 = clock()
+                spans.append(OpSpan(idx, prim_name, t, t2))
+                t = t2
+            fetch = [env[v] for v in fetch_vids]
+            jax.block_until_ready(fetch)
+            t2 = clock()
+            spans.append(OpSpan(None, _FETCH_SPAN, t, t2))
+            step_end = t2
+        finally:
+            rec_step.end()
+
+        profile = OpProfile(
+            name=name, step_start=step_start, step_end=step_end,
+            spans=spans,
+            fingerprint=program.fingerprint()
+            if hasattr(program, "fingerprint") else None)
+        M_STEPS_PROFILED.inc(name=name)
+        M_STEP_SECONDS.observe(profile.step_seconds, name=name)
+        for prim, sec in profile.seconds_by_prim().items():
+            M_OP_SECONDS.observe(sec, name=name, prim=prim)
+        M_ATTRIBUTED.set(round(profile.attributed_pct, 2), name=name)
+        if self.attribute:
+            self._attribute(program, fetch_vids, profile)
+        self.profiles.append(profile)
+        self.last = profile
+        self.steps_profiled += 1
+        return fetch, profile
+
+    def _attribute(self, program, fetch_vids, profile: OpProfile):
+        """Join the measured timeline against the static cost model —
+        best-effort: a program the cost model cannot walk still gets a
+        valid (rows-less) profile."""
+        try:
+            cost = self._program_cost(program, fetch_vids)
+        except Exception:
+            return
+        if cost is not None:
+            attribute_profile(profile, cost)
+
+    def _program_cost(self, program, fetch_vids):
+        from ..static.analysis.cost import program_cost
+
+        if not hasattr(program, "fingerprint"):
+            return None
+        key = (program.fingerprint(), tuple(fetch_vids))
+        cost = self._cost_cache.get(key)
+        if cost is None:
+            cost = program_cost(program, fetch_vids or None)
+            self._cost_cache[key] = cost
+            while len(self._cost_cache) > 8:
+                self._cost_cache.pop(next(iter(self._cost_cache)))
+        return cost
+
+    # -- Executor.run sampling hook ---------------------------------------
+    def maybe_profiled_run(self, program, feed_names, feed_arrays,
+                           fetch_vids, *, name: Optional[str] = None):
+        """The Executor.run entry point: profile this run (returning the
+        fetch values) or return None — caller falls through to the
+        fused jit path. Pacing (stride or overhead budget) decides."""
+        if not self.pacer.should_profile(self.clock()):
+            M_STEPS_SKIPPED.inc(name=name or self.name)
+            return None
+        t0 = self.clock()
+        outs, _profile = self.run_program(program, feed_names,
+                                          feed_arrays, fetch_vids,
+                                          name=name)
+        t1 = self.clock()
+        # the pacer amortizes the FULL profiled-run cost, attribution
+        # join included — that is the wall time the jit path did not get
+        self.pacer.profiled(t1 - t0, t1)
+        return outs
+
+    # -- exports -----------------------------------------------------------
+    def chrome_trace_events(self, pid: int = 0, host_events=None
+                            ) -> List[Dict[str, Any]]:
+        """Chrome ``traceEvents`` through the shared
+        ``observability.chrome`` exporter: tid 0 carries the per-op
+        spans of every retained profile, tid 1 (when ``host_events`` —
+        legacy ``profiler`` HostEvent roots — are handed in) mirrors
+        the ``RecordEvent`` span tree into the same timeline.
+        ``fleet.merge_chrome_trace_files`` re-maps pid per rank."""
+        evs = [chrome.process_name_event(pid, f"opprof:{self.name}"),
+               chrome.thread_name_event(pid, 0, "program ops")]
+        for step_i, profile in enumerate(self.profiles):
+            for s in profile.spans:
+                args: Dict[str, Any] = {"step": step_i}
+                if s.index is not None:
+                    args["op"] = s.index
+                evs.append(chrome.complete_event(
+                    s.prim, s.start, s.end, cat="opprof", pid=pid,
+                    tid=0, args=args))
+        if host_events:
+            from ..profiler.host_tracer import flatten_events
+
+            evs.append(chrome.thread_name_event(
+                pid, 1, "host spans (profiler.RecordEvent)"))
+            for ev in flatten_events(list(host_events)):
+                evs.append(chrome.complete_event(
+                    ev.name, ev.start_ns / 1e9, ev.end_ns / 1e9,
+                    cat=ev.type, pid=pid, tid=1,
+                    args={"thread": ev.thread_id}))
+        return evs
+
+    def chrome_trace_dict(self, pid: int = 0, host_events=None
+                          ) -> Dict[str, Any]:
+        return chrome.trace_dict(
+            self.chrome_trace_events(pid, host_events=host_events))
+
+    def write_chrome_trace(self, path: str, pid: int = 0,
+                           host_events=None) -> str:
+        return chrome.write_chrome_trace(
+            path, self.chrome_trace_dict(pid, host_events=host_events))
+
+    def dump_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": "opprof",
+            "version": 1,
+            "name": self.name,
+            "steps_profiled": self.steps_profiled,
+            "profiles": [p.to_dict() for p in self.profiles],
+        }
+
+    def dump(self, path: str) -> str:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.dump_dict(), f, indent=1, default=str)
+        os.replace(tmp, path)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# process session (the Executor.run gate)
+# ---------------------------------------------------------------------------
+
+_session: Optional[OpProfiler] = None
+
+
+def session(**kwargs) -> OpProfiler:
+    """Get-or-create the process-wide profiler the Executor hook uses.
+    Keyword args only apply on creation."""
+    global _session
+    if _session is None:
+        _session = OpProfiler(**kwargs)
+    return _session
+
+
+def active_session() -> Optional[OpProfiler]:
+    """The installed session, else a fresh one when ``PADDLE_TPU_OPPROF``
+    is set, else None — the one check Executor.run pays per run."""
+    if _session is not None:
+        return _session
+    if opprof_enabled_from_env():
+        return session(name="executor")
+    return None
+
+
+def reset_session():
+    """Drop the process profiler (tests; also re-reads env on next use)."""
+    global _session
+    _session = None
+
+
+# ---------------------------------------------------------------------------
+# attribution: join measured spans with the static cost model
+# ---------------------------------------------------------------------------
+
+def attribute_profile(profile: OpProfile, cost, *,
+                      peak_flops: Optional[float] = None
+                      ) -> List[Dict[str, Any]]:
+    """Join ``profile``'s measured spans against a
+    ``cost.ProgramCost`` (aligned by instruction index) to produce, per
+    op: achieved FLOP/s and bytes/s, roofline position against
+    ``default_peak_flops``, and the measured/predicted drift ratio.
+    Fills ``profile.rows``/``profile.predicted_step_seconds`` and
+    publishes the per-prim ``opprof.drift_ratio`` gauges."""
+    peak = peak_flops if peak_flops else default_peak_flops()
+    step = profile.step_seconds
+    by_op = list(getattr(cost, "by_op", ()) or ())
+    sec_by_op = list(getattr(cost, "seconds_by_op", ()) or ())
+    rows: List[Dict[str, Any]] = []
+    meas_by_prim: Dict[str, float] = {}
+    pred_by_prim: Dict[str, float] = {}
+    for s in profile.spans:
+        if s.index is None:
+            continue
+        c = by_op[s.index] if s.index < len(by_op) else None
+        flops = int(getattr(c, "flops", 0) or 0)
+        nbytes = int(getattr(c, "bytes_total", 0) or 0)
+        pred = float(sec_by_op[s.index]) \
+            if s.index < len(sec_by_op) else 0.0
+        meas = s.seconds
+        achieved_flops = flops / meas if meas > 0 else 0.0
+        rows.append({
+            "index": s.index,
+            "prim": s.prim,
+            "measured_seconds": round(meas, 9),
+            "predicted_seconds": round(pred, 9),
+            "flops": flops,
+            "bytes": nbytes,
+            "achieved_flops_per_sec": round(achieved_flops, 3),
+            "achieved_bytes_per_sec": round(
+                nbytes / meas if meas > 0 else 0.0, 3),
+            "roofline_pct": round(100.0 * achieved_flops / peak, 8),
+            "drift_ratio": round(meas / pred, 6) if pred > 0 else None,
+            "share_pct": round(100.0 * meas / step, 3)
+            if step > 0 else 0.0,
+        })
+        meas_by_prim[s.prim] = meas_by_prim.get(s.prim, 0.0) + meas
+        pred_by_prim[s.prim] = pred_by_prim.get(s.prim, 0.0) + pred
+    profile.rows = rows
+    pred_step = getattr(cost, "predicted_step_seconds", None)
+    if pred_step:
+        profile.predicted_step_seconds = float(pred_step)
+    for prim, meas in meas_by_prim.items():
+        pred = pred_by_prim.get(prim, 0.0)
+        if pred > 0:
+            M_DRIFT.set(round(meas / pred, 4), name=profile.name,
+                        prim=prim)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# calibration: correction factors program_cost consumes
+# ---------------------------------------------------------------------------
+
+@dataclass
+class OpCalibration:
+    """Per-op-class correction factors fitted from a measured profile.
+
+    ``factors`` maps a prim name to ``measured_seconds /
+    predicted_seconds`` over the profile's ops of that class — applied
+    multiplicatively to the cost model's per-op time base.
+    ``flops_factor`` is the whole-program ``measured_flops /
+    predicted_flops`` ratio against XLA's compiled cost analysis (1.0
+    when no measured count was supplied). Unknown keys in a loaded dict
+    are ignored (forward compatibility, the CommModelParams rule)."""
+
+    factors: Dict[str, float] = field(default_factory=dict)
+    flops_factor: float = 1.0
+    source: Dict[str, Any] = field(default_factory=dict)
+
+    def factor(self, prim: str, default: float = 1.0) -> float:
+        return float(self.factors.get(prim, default))
+
+    def is_identity(self) -> bool:
+        return not self.factors and self.flops_factor == 1.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": "op_calibration",
+            "version": 1,
+            "flops_factor": round(float(self.flops_factor), 9),
+            "factors": {k: round(float(v), 9)
+                        for k, v in sorted(self.factors.items())},
+            "source": dict(self.source),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "OpCalibration":
+        factors = {str(k): float(v)
+                   for k, v in (d.get("factors") or {}).items()
+                   if float(v) > 0}
+        try:
+            flops_factor = float(d.get("flops_factor", 1.0))
+        except (TypeError, ValueError):
+            flops_factor = 1.0
+        if not flops_factor > 0:
+            flops_factor = 1.0
+        return cls(factors=factors, flops_factor=flops_factor,
+                   source=dict(d.get("source") or {}))
+
+
+def calibrate_op_costs(profile: OpProfile, cost, *,
+                       measured_flops: Optional[int] = None
+                       ) -> OpCalibration:
+    """Fit per-op-class correction factors from one measured profile.
+
+    Per prim class: ``factor = sum(measured seconds) / sum(predicted
+    base seconds)`` over the profiled ops of that class (classes the
+    model predicts zero time for keep the identity factor). With
+    ``measured_flops`` (XLA's compiled count for the same replay,
+    ``cost.measure_program_flops``) the whole-program FLOPs ratio is
+    fitted too, so the calibrated ``program_cost`` tightens PTL302 as
+    well as PTL304."""
+    sec_by_op = list(getattr(cost, "seconds_by_op", ()) or ())
+    meas_by_prim: Dict[str, float] = {}
+    pred_by_prim: Dict[str, float] = {}
+    for s in profile.spans:
+        if s.index is None:
+            continue
+        pred = float(sec_by_op[s.index]) \
+            if s.index < len(sec_by_op) else 0.0
+        meas_by_prim[s.prim] = meas_by_prim.get(s.prim, 0.0) + s.seconds
+        pred_by_prim[s.prim] = pred_by_prim.get(s.prim, 0.0) + pred
+    factors = {
+        prim: meas / pred_by_prim[prim]
+        for prim, meas in meas_by_prim.items()
+        if pred_by_prim.get(prim, 0.0) > 0 and meas > 0
+    }
+    flops_factor = 1.0
+    model_flops = int(getattr(cost, "flops", 0) or 0)
+    if measured_flops and model_flops > 0:
+        flops_factor = float(measured_flops) / model_flops
+    return OpCalibration(
+        factors=factors, flops_factor=flops_factor,
+        source={"name": profile.name,
+                "fingerprint": profile.fingerprint,
+                "step_seconds": round(profile.step_seconds, 9),
+                "ops": sum(1 for s in profile.spans
+                           if s.index is not None)})
+
+
+def save_op_calibration(cal: OpCalibration, path: str) -> str:
+    """Persist a calibration to JSON (atomic; the file
+    ``PADDLE_TPU_OP_CALIBRATION`` points at)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(cal.to_dict(), f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def load_op_calibration(path: str) -> OpCalibration:
+    with open(path) as f:
+        return OpCalibration.from_dict(json.load(f))
+
+
+def resolve_op_calibration(value=None) -> Optional[OpCalibration]:
+    """Resolve a ``program_cost(op_calibration=...)`` argument: an
+    :class:`OpCalibration` passes through, a dict/JSON-string/path is
+    parsed, and None consults ``PADDLE_TPU_OP_CALIBRATION`` (inline
+    JSON if it starts with ``{``, else a file path — the
+    ``PADDLE_TPU_COMM_PARAMS`` convention). Returns None (identity —
+    the exact uncalibrated behavior) when nothing usable is found;
+    never raises on a malformed source."""
+    if isinstance(value, OpCalibration):
+        return value
+    if isinstance(value, dict):
+        try:
+            return OpCalibration.from_dict(value)
+        except Exception:
+            return None
+    raw = value if isinstance(value, str) \
+        else os.environ.get(OP_CALIBRATION_ENV, "")
+    raw = raw.strip()
+    if not raw:
+        return None
+    try:
+        if raw.startswith("{"):
+            return OpCalibration.from_dict(json.loads(raw))
+        return load_op_calibration(raw)
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# lints (PTL501/PTL502) + overhead guard (PTL503)
+# ---------------------------------------------------------------------------
+
+def _profile_doc(profile) -> Dict[str, Any]:
+    return profile.to_dict() if isinstance(profile, OpProfile) \
+        else dict(profile)
+
+
+def lint_op_profile(profile, *, drift_tolerance_pct: float = 200.0,
+                    hot_share_pct: float = 10.0,
+                    attribution_floor_pct: float = 95.0):
+    """Lint one profile (an :class:`OpProfile` or its ``to_dict()``/
+    JSON form):
+
+    - **PTL501** hot-op drift: an op holding at least ``hot_share_pct``
+      of the step whose measured time diverges from the cost model's
+      prediction by more than ``drift_tolerance_pct`` — the per-op
+      decomposition of a PTL302/PTL304 whole-program alarm, naming the
+      op to fix (suggestion payload carries prim/measured/predicted).
+    - **PTL502** attribution shortfall: the spans fail to tile the step
+      (named-span coverage below ``attribution_floor_pct``) — the
+      profile cannot be trusted to attribute the step it claims to
+      measure."""
+    from ..static.analysis.diagnostics import (DiagnosticReport,
+                                               Severity)
+
+    doc = _profile_doc(profile)
+    report = DiagnosticReport()
+    name = doc.get("name", "program")
+    step = float(doc.get("step_seconds") or 0.0)
+    attributed = doc.get("attributed_pct")
+    if attributed is not None and attributed < attribution_floor_pct:
+        unattributed_ms = step * (100.0 - attributed) / 100.0 * 1e3
+        report.add(
+            "PTL502", Severity.WARNING,
+            f"profile {name!r}: op spans cover only {attributed:.1f}% "
+            f"of the {step * 1e3:.2f} ms step "
+            f"({unattributed_ms:.2f} ms unattributed, floor "
+            f"{attribution_floor_pct:.0f}%)",
+            hint="the profiled interpreter tiles the step by "
+                 "construction (shared span boundaries) — a shortfall "
+                 "means a truncated dump, an outer step measurement, "
+                 "or a profiler bug; do not calibrate from this "
+                 "profile",
+            suggestion={"attributed_pct": attributed,
+                        "floor_pct": attribution_floor_pct})
+    for row in doc.get("rows") or ():
+        pred = float(row.get("predicted_seconds") or 0.0)
+        share = float(row.get("share_pct") or 0.0)
+        if pred <= 0 or share < hot_share_pct:
+            continue
+        meas = float(row.get("measured_seconds") or 0.0)
+        err_pct = abs(meas - pred) / pred * 100.0
+        if err_pct > drift_tolerance_pct:
+            report.add(
+                "PTL501", Severity.WARNING,
+                f"hot op drift in {name!r}: {row.get('prim')} "
+                f"({share:.1f}% of step) measured "
+                f"{meas * 1e3:.3f} ms vs predicted "
+                f"{pred * 1e3:.3f} ms ({err_pct:.0f}% > "
+                f"{drift_tolerance_pct:.0f}% tolerance)",
+                op_index=row.get("index"),
+                hint="this op class, not the whole model, is what "
+                     "drifted — fix its cost-registry entry or refit "
+                     "with calibrate_op_costs (the factor lands on "
+                     "exactly this prim)",
+                suggestion={"prim": row.get("prim"),
+                            "measured_seconds": meas,
+                            "predicted_seconds": pred,
+                            "drift_ratio": row.get("drift_ratio")})
+    return report
+
+
+def check_opprof_overhead(steps_per_sec_on: float,
+                          steps_per_sec_off: float, *,
+                          tolerance_pct: float = DEFAULT_BUDGET_PCT,
+                          name: str = "program"):
+    """The profiling-cost guard (PTL402's training-plane analog):
+    steps/sec with op profiling enabled — at the pacer's sampling rate
+    — must stay within ``tolerance_pct`` of profiling off. Publishes
+    ``opprof.overhead_pct`` and files **PTL503** when the budget is
+    exceeded (``bench.py --opprof`` runs this; a profiler that taxes
+    the training loop is a profiler nobody leaves enabled)."""
+    from ..static.analysis.diagnostics import (DiagnosticReport,
+                                               Severity)
+
+    report = DiagnosticReport()
+    if steps_per_sec_off <= 0:
+        return report
+    overhead = 100.0 * (steps_per_sec_off - steps_per_sec_on) \
+        / steps_per_sec_off
+    M_OVERHEAD.set(round(overhead, 3), name=name)
+    if overhead > tolerance_pct:
+        report.add(
+            "PTL503", Severity.WARNING,
+            f"op-profiling overhead {overhead:.2f}% exceeds the "
+            f"{tolerance_pct:.1f}% budget ({steps_per_sec_on:.3f} "
+            f"steps/s profiled vs {steps_per_sec_off:.3f} unprofiled)",
+            hint="the eager per-op-blocking replay is inherently "
+                 "slower than the fused jit step — the pacer exists "
+                 "to amortize it; raise PADDLE_TPU_OPPROF_STRIDE (or "
+                 "lower PADDLE_TPU_OPPROF_BUDGET_PCT) so fewer steps "
+                 "pay the eager price",
+            suggestion={"overhead_pct": round(overhead, 3),
+                        "tolerance_pct": tolerance_pct})
+    return report
+
+
+# ---------------------------------------------------------------------------
+# rendering (tools/metrics_report.py --opprof)
+# ---------------------------------------------------------------------------
+
+def render_op_profile(doc: Dict[str, Any], *, top: int = 10) -> str:
+    """Human report for one ``opprof`` dump (``OpProfiler.dump_dict()``
+    JSON): header, then the top-K ops table of the LAST retained
+    profile — measured ms, predicted ms, drift, roofline %, and the
+    cumulative step share that says how much of the step the table
+    explains."""
+    if doc.get("kind") != "opprof":
+        raise ValueError(f"not an opprof dump (kind={doc.get('kind')!r})")
+    profiles = doc.get("profiles") or []
+    lines = [f"op profile (name={doc.get('name')}): "
+             f"{doc.get('steps_profiled', len(profiles))} step(s) "
+             f"profiled, {len(profiles)} retained"]
+    if not profiles:
+        return "\n".join(lines + ["no profiled steps retained"])
+    p = profiles[-1]
+    pred = p.get("predicted_step_seconds")
+    lines.append(
+        f"last step: {float(p.get('step_seconds') or 0) * 1e3:.3f} ms "
+        f"measured"
+        + (f" vs {float(pred) * 1e3:.3f} ms predicted" if pred else "")
+        + f", {p.get('attributed_pct')}% attributed "
+        f"({len(p.get('spans') or [])} span(s))")
+    rows = p.get("rows")
+    if not rows:
+        # un-joined profile (no cost model): aggregate spans by prim
+        agg: Dict[str, float] = {}
+        for s in p.get("spans") or ():
+            agg[s["prim"]] = agg.get(s["prim"], 0.0) + s["seconds"]
+        step = float(p.get("step_seconds") or 0.0)
+        rows = [{"prim": prim, "index": None, "measured_seconds": sec,
+                 "predicted_seconds": 0.0, "drift_ratio": None,
+                 "roofline_pct": 0.0,
+                 "share_pct": 100.0 * sec / step if step > 0 else 0.0}
+                for prim, sec in agg.items()]
+    rows = sorted(rows, key=lambda r: -float(r["measured_seconds"]))
+    table = [("op", "prim", "meas ms", "pred ms", "drift", "roofline",
+              "share", "cum")]
+    cum = 0.0
+    for r in rows[:max(top, 1)]:
+        cum += float(r.get("share_pct") or 0.0)
+        drift = r.get("drift_ratio")
+        table.append((
+            "-" if r.get("index") is None else f"#{r['index']}",
+            str(r.get("prim")),
+            f"{float(r['measured_seconds']) * 1e3:.3f}",
+            f"{float(r.get('predicted_seconds') or 0) * 1e3:.3f}",
+            "-" if drift is None else f"{float(drift):.2f}x",
+            f"{float(r.get('roofline_pct') or 0):.2f}%",
+            f"{float(r.get('share_pct') or 0):.1f}%",
+            f"{cum:.1f}%"))
+    widths = [max(len(t[i]) for t in table) for i in range(len(table[0]))]
+    lines.append("")
+    lines.extend(
+        "  ".join(col.ljust(w) if i <= 1 else col.rjust(w)
+                  for i, (col, w) in enumerate(zip(t, widths)))
+        for t in table)
+    if len(rows) > top:
+        lines.append(f"  ... {len(rows) - top} more op(s)")
+    return "\n".join(lines)

@@ -51,6 +51,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from . import chrome
 from .metrics import registry
 
 __all__ = [
@@ -430,8 +431,11 @@ class ServeTracer:
     def chrome_trace_events(self, pid: int = 0) -> List[Dict[str, Any]]:
         """Chrome ``traceEvents``: one lane (tid) per decode slot, a
         queue/preempt wait lane, and an engine lane of batched decode
-        steps — ``fleet.merge_chrome_trace_files`` compatible (ts/dur in
-        microseconds; pid re-mapped per rank at merge time)."""
+        steps — built on the shared ``observability.chrome`` exporter,
+        so the ts/dur µs conventions and lane metadata stay
+        ``fleet.merge_chrome_trace_files`` compatible (pid re-mapped
+        per rank at merge time) without drifting from the op profiler's
+        timeline."""
         max_lane = self.max_slots
         evs: List[Dict[str, Any]] = []
         for doc in self.requests:
@@ -440,44 +444,30 @@ class ServeTracer:
                     continue
                 lane = self._lane(c)
                 max_lane = max(max_lane, lane)
-                evs.append({
-                    "name": c["name"], "ph": "X", "cat": "serve",
-                    "pid": pid, "tid": lane,
-                    "ts": c["start"] * 1e6,
-                    "dur": (c["end"] - c["start"]) * 1e6,
-                    "args": {"request": doc["id"],
-                             **(c.get("attrs") or {})}})
+                evs.append(chrome.complete_event(
+                    c["name"], c["start"], c["end"], cat="serve",
+                    pid=pid, tid=lane,
+                    args={"request": doc["id"], **(c.get("attrs") or {})}))
         engine_lane = max_lane + 1
         for s in self.decode_steps:
-            evs.append({
-                "name": "decode_step", "ph": "X", "cat": "serve",
-                "pid": pid, "tid": engine_lane,
-                "ts": s["start"] * 1e6,
-                "dur": (s["end"] - s["start"]) * 1e6,
-                "args": {"active": s["active"], "queued": s["queued"]}})
-        meta = [{"ph": "M", "pid": pid, "name": "process_name",
-                 "args": {"name": f"serve:{self.engine}"}},
-                {"ph": "M", "pid": pid, "tid": 0, "name": "thread_name",
-                 "args": {"name": "queue/preempt wait"}},
-                {"ph": "M", "pid": pid, "tid": engine_lane,
-                 "name": "thread_name",
-                 "args": {"name": "engine (decode steps)"}}]
+            evs.append(chrome.complete_event(
+                "decode_step", s["start"], s["end"], cat="serve",
+                pid=pid, tid=engine_lane,
+                args={"active": s["active"], "queued": s["queued"]}))
+        meta = [chrome.process_name_event(pid, f"serve:{self.engine}"),
+                chrome.thread_name_event(pid, 0, "queue/preempt wait"),
+                chrome.thread_name_event(pid, engine_lane,
+                                         "engine (decode steps)")]
         for lane in range(1, engine_lane):
-            meta.append({"ph": "M", "pid": pid, "tid": lane,
-                         "name": "thread_name",
-                         "args": {"name": f"slot {lane - 1}"}})
+            meta.append(chrome.thread_name_event(pid, lane,
+                                                 f"slot {lane - 1}"))
         return meta + evs
 
     def chrome_trace_dict(self, pid: int = 0) -> Dict[str, Any]:
-        return {"traceEvents": self.chrome_trace_events(pid),
-                "displayTimeUnit": "ms"}
+        return chrome.trace_dict(self.chrome_trace_events(pid))
 
     def write_chrome_trace(self, path: str, pid: int = 0) -> str:
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(self.chrome_trace_dict(pid), f)
-        os.replace(tmp, path)
-        return path
+        return chrome.write_chrome_trace(path, self.chrome_trace_dict(pid))
 
     def dump_dict(self) -> Dict[str, Any]:
         return {

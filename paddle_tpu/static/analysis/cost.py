@@ -395,7 +395,7 @@ def _compute_divisor(spec) -> int:
 
 def program_cost(program, fetch=None, *, placements=None, mesh=None,
                  avals: Optional[Dict[int, Aval]] = None,
-                 params=None) -> ProgramCost:
+                 params=None, op_calibration=None) -> ProgramCost:
     """Walk the program once and sum per-op costs over the LIVE ops.
 
     ``fetch`` (Tensors or vids; falls back to a recorded
@@ -416,7 +416,18 @@ def program_cost(program, fetch=None, *, placements=None, mesh=None,
     memory_seconds) + comm_seconds``, where the comm term prices every
     collective the placement table implies (ring alpha-beta model,
     ``comm_cost.program_comm_cost``). Without placements the comm term
-    is zero — a single-chip replay has no collectives."""
+    is zero — a single-chip replay has no collectives.
+
+    ``op_calibration`` (an ``opprof.OpCalibration``, a dict/JSON/path,
+    or None to consult ``PADDLE_TPU_OP_CALIBRATION``) applies
+    measured correction factors from the op-level execution profiler:
+    the whole-program FLOPs ratio scales ``flops``/``flops_by_prim``
+    (tightening PTL302 drift), and per-prim time factors scale
+    ``seconds_by_op`` — with factors fitted, the calibrated
+    ``predicted_step_seconds`` is their sum, since the factors absorb
+    the compute/memory overlap model (tightening PTL304). With no
+    calibration resolvable the result is bit-identical to the
+    uncalibrated model."""
     with _obs.span("cost.program_cost", histogram=M_ESTIMATE_SECONDS,
                    hist_labels={"kind": "flops"}):
         from .comm_cost import program_comm_cost, resolve_comm_params
@@ -449,7 +460,44 @@ def program_cost(program, fetch=None, *, placements=None, mesh=None,
                 c.bytes_total / params.hbm_bytes_per_second)
             + comm_by_op.get(i, 0.0)
             for i, c in enumerate(result.by_op)]
+        cal = _resolve_op_calibration(op_calibration)
+        if cal is not None and not cal.is_identity():
+            if cal.flops_factor != 1.0:
+                result.flops = int(round(result.flops
+                                         * cal.flops_factor))
+                result.flops_by_prim = {
+                    k: int(round(v * cal.flops_factor))
+                    for k, v in result.flops_by_prim.items()}
+                result.compute_seconds = result.flops / flops_rate
+                result.predicted_step_seconds = \
+                    max(result.compute_seconds, result.memory_seconds) \
+                    + result.comm_seconds
+            if cal.factors:
+                prims = [inst[0] for inst in program._insts]
+                result.seconds_by_op = [
+                    cal.factor(prims[i])
+                    * max(c.flops / flops_rate,
+                          c.bytes_total / params.hbm_bytes_per_second)
+                    + comm_by_op.get(i, 0.0)
+                    for i, c in enumerate(result.by_op)]
+                # the measured factors already price the overlap the
+                # max(compute, memory) model guesses at — the
+                # calibrated step prediction is the attributed sum
+                result.predicted_step_seconds = \
+                    sum(result.seconds_by_op)
         return result
+
+
+def _resolve_op_calibration(value):
+    """Lazy bridge to ``observability.opprof.resolve_op_calibration``
+    (None -> env -> identity); never raises — cost analysis must not
+    fail because a calibration file is malformed."""
+    try:
+        from ...observability.opprof import resolve_op_calibration
+
+        return resolve_op_calibration(value)
+    except Exception:
+        return None
 
 
 def _program_cost(program, fetch, placements, avals) -> ProgramCost:

@@ -20,6 +20,9 @@ Code namespace (``PTLxxx``):
   `observability/tracing.py`, `serve_trace_lint.py`): SLO breaches,
   tracing overhead, malformed span trees, decode-burst gaps,
   preemption thrash.
+- ``PTL5xx`` — execution profiling (`observability/opprof.py`): per-op
+  measured-vs-predicted drift, attribution shortfall, profiling
+  overhead — the measured half of the PTL3xx cost model.
 """
 from __future__ import annotations
 
@@ -105,6 +108,17 @@ CODES = {
     "PTL405": "preemption thrash: the same request was preempted and "
               "recomputed too many times (pool sizing / admission "
               "pressure)",
+    # execution-profiling diagnostics (PTL5xx) — the op-level profiler
+    # that closes the predicted-vs-measured loop (observability/opprof.py)
+    "PTL501": "hot-op drift: a profiled op's measured time diverges from "
+              "the cost model's per-op prediction beyond tolerance (the "
+              "per-op decomposition of PTL302/PTL304)",
+    "PTL502": "attribution shortfall: the op profiler's spans fail to "
+              "tile the measured step (unattributed step time above "
+              "threshold — the profile cannot be trusted)",
+    "PTL503": "profiling overhead exceeded: steps/sec with op profiling "
+              "enabled fell more than the budget below the unprofiled "
+              "run (the PTL402 analog for the training plane)",
 }
 
 

@@ -23,6 +23,7 @@ import jax
 import numpy as np
 
 from .. import observability as _obs
+from ..observability import opprof as _opprof
 from ..core import dispatch
 from ..core.dtype import convert_dtype
 from ..core.tensor import Tensor
@@ -539,6 +540,17 @@ class Executor:
                   for _, v in feed_items]
         if _obs_state.on:
             _M_RUNS.inc()
+        prof = _opprof.active_session()
+        if prof is not None:
+            # op-level profiling (PADDLE_TPU_OPPROF): the pacer decides
+            # whether THIS run pays for the eager per-op-timed replay;
+            # when it declines (None) we fall through to the jit path
+            prof_outs = prof.maybe_profiled_run(program, feed_names,
+                                                arrays, fetch_vids)
+            if prof_outs is not None:
+                if return_numpy:
+                    return [np.asarray(o) for o in prof_outs]
+                return [Tensor._from_value(o) for o in prof_outs]
         feed_sig = tuple((a.shape, str(a.dtype)) for a in arrays)
         # keyed by program CONTENT, not clear-on-change: switching between
         # programs, or a rewrite pipeline that lands back on a structure

@@ -1,0 +1,603 @@
+"""Op-level execution profiler (observability/opprof.py): the measured
+half of the static cost model.
+
+Layers under test:
+
+- span tiling on FakeClock: shared boundaries + feed/fetch pseudo-spans
+  mean the spans tile ``[step_start, step_end]`` EXACTLY — attribution
+  is 100% by construction and the PTL502 lint is clean;
+- solo equivalence: an Executor.run with profiling enabled returns
+  bit-identical fetch values to profiling off (the eager op-by-op
+  replay computes the same function as the fused jit replay);
+- pacing: stride mode profiles every Nth run deterministically; budget
+  mode amortizes the profiled-step cost against unprofiled wall time;
+- the PTL5xx diagnostics: PTL501 hot-op drift, PTL502 attribution
+  shortfall on a synthesized gappy profile, PTL503 overhead-budget
+  trip (``check_opprof_overhead``) — all deterministic;
+- calibration: ``calibrate_op_costs`` round-trips through JSON, the
+  ``PADDLE_TPU_OP_CALIBRATION`` env resolves it, the uncalibrated
+  ``program_cost`` stays bit-identical, and applying the calibration
+  STRICTLY reduces the whole-program PTL302 FLOPs drift and the
+  step-time error on the bench llama train program (the acceptance
+  criterion);
+- exports: chrome trace through the shared ``observability.chrome``
+  emitter (µs conventions, lane metadata,
+  ``fleet.merge_chrome_trace_files`` compatible), legacy
+  ``profiler.RecordEvent`` mirroring, and the
+  ``tools/metrics_report.py --opprof`` rendering path.
+"""
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as obs
+import paddle_tpu.static as static
+from paddle_tpu.observability import FakeClock, opprof
+from paddle_tpu.observability.opprof import (
+    OpCalibration, OpProfile, OpProfiler, OpSpan, calibrate_op_costs,
+    check_opprof_overhead, lint_op_profile, load_op_calibration,
+    render_op_profile, resolve_op_calibration, save_op_calibration,
+)
+from paddle_tpu.static.analysis import (check_cost_model,
+                                        measure_program_flops,
+                                        program_cost)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO_ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_session(monkeypatch):
+    """Each test gets a clean process profiler and no opprof env."""
+    for var in (opprof.OPPROF_ENV, opprof.OPPROF_STRIDE_ENV,
+                opprof.OPPROF_BUDGET_ENV, opprof.OP_CALIBRATION_ENV):
+        monkeypatch.delenv(var, raising=False)
+    opprof.reset_session()
+    yield
+    opprof.reset_session()
+
+
+def _small_program():
+    """matmul -> add -> relu with one feed; returns (prog, feed dict,
+    fetch tensor)."""
+    paddle.seed(0)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+        w = paddle.to_tensor(
+            np.random.RandomState(0).rand(8, 8).astype("float32"))
+        z = paddle.nn.functional.relu(paddle.matmul(x, w) + 1.0)
+    feed = {"x": np.random.RandomState(1).rand(4, 8).astype("float32")}
+    return prog, feed, z
+
+
+def _profile_program(prog, feed, fetch, **kwargs):
+    feed_items = sorted(feed.items())
+    names = tuple(k for k, _ in feed_items)
+    arrays = [np.asarray(v) for _, v in feed_items]
+    vids = [prog.vid_of(t) for t in fetch]
+    prof = OpProfiler(**kwargs)
+    outs, profile = prof.run_program(prog, names, arrays, vids)
+    return prof, outs, profile
+
+
+class TestSpanTiling:
+    """Spans tile the step exactly, by construction — on ANY clock,
+    including a FakeClock whose every read ticks."""
+
+    def test_spans_tile_the_step_exactly(self):
+        prog, feed, z = _small_program()
+        clk = FakeClock(100.0, 0.25)
+        _prof, _outs, p = _profile_program(
+            prog, feed, [z], name="tile", clock=clk, stride=1)
+        # shared boundaries: end of span i IS start of span i+1
+        for a, b in zip(p.spans, p.spans[1:]):
+            assert a.end == b.start
+        assert p.spans[0].start == p.step_start
+        assert p.spans[-1].end == p.step_end
+        assert p.attributed_pct == 100.0
+        assert p.attributed_seconds == p.step_seconds
+
+    def test_pseudo_spans_bracket_the_ops(self):
+        prog, feed, z = _small_program()
+        _prof, _outs, p = _profile_program(
+            prog, feed, [z], clock=FakeClock(0.0, 0.5), stride=1)
+        assert p.spans[0].prim == "__feed__"
+        assert p.spans[-1].prim == "__fetch__"
+        op_spans = [s for s in p.spans if s.index is not None]
+        assert [s.prim for s in op_spans] == \
+            [inst[0] for inst in prog._insts]
+        assert [s.index for s in op_spans] == \
+            list(range(len(prog._insts)))
+
+    def test_tiling_profile_is_ptl502_clean(self):
+        prog, feed, z = _small_program()
+        _prof, _outs, p = _profile_program(
+            prog, feed, [z], clock=FakeClock(0.0, 0.125), stride=1)
+        assert "PTL502" not in lint_op_profile(p).codes()
+
+
+class TestSoloEquivalence:
+    """Profiling on must not change what Executor.run returns — same
+    function, bit for bit."""
+
+    def test_profiled_run_bit_identical_forward(self, monkeypatch):
+        prog, feed, z = _small_program()
+        exe = static.Executor()
+        ref = exe.run(prog, feed=feed, fetch_list=[z])
+        monkeypatch.setenv(opprof.OPPROF_ENV, "1")
+        monkeypatch.setenv(opprof.OPPROF_STRIDE_ENV, "1")
+        opprof.reset_session()
+        got = exe.run(prog, feed=feed, fetch_list=[z])
+        sess = opprof.active_session()
+        assert sess is not None and sess.steps_profiled == 1
+        assert np.array_equal(got[0], ref[0])
+        assert got[0].dtype == ref[0].dtype
+
+    def test_profiled_run_bit_identical_with_grads(self, monkeypatch):
+        paddle.seed(0)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            w = paddle.to_tensor(
+                np.random.RandomState(0).rand(8, 4).astype("float32"),
+                stop_gradient=False)
+            loss = paddle.sum(paddle.matmul(x, w))
+            (gw,) = static.gradients([loss], [w])
+        feed = {"x": np.random.RandomState(1).rand(4, 8)
+                .astype("float32")}
+        exe = static.Executor()
+        ref = exe.run(prog, feed=feed, fetch_list=[loss, gw])
+        monkeypatch.setenv(opprof.OPPROF_ENV, "1")
+        monkeypatch.setenv(opprof.OPPROF_STRIDE_ENV, "1")
+        opprof.reset_session()
+        got = exe.run(prog, feed=feed, fetch_list=[loss, gw])
+        assert opprof.active_session().steps_profiled == 1
+        for g, r in zip(got, ref):
+            assert np.array_equal(g, r)
+
+    def test_disabled_env_means_no_session(self):
+        assert opprof.active_session() is None
+        prog, feed, z = _small_program()
+        exe = static.Executor()
+        exe.run(prog, feed=feed, fetch_list=[z])
+        assert opprof.active_session() is None
+
+
+class TestPacing:
+    def test_stride_profiles_every_nth_run(self, monkeypatch):
+        prog, feed, z = _small_program()
+        exe = static.Executor()
+        monkeypatch.setenv(opprof.OPPROF_ENV, "1")
+        monkeypatch.setenv(opprof.OPPROF_STRIDE_ENV, "3")
+        opprof.reset_session()
+        ref = None
+        for _ in range(7):
+            out = exe.run(prog, feed=feed, fetch_list=[z])
+            if ref is None:
+                ref = out
+            assert np.array_equal(out[0], ref[0])
+        sess = opprof.active_session()
+        assert sess.pacer.runs == 7
+        assert sess.steps_profiled == 3  # runs 1, 4, 7
+
+    def test_budget_pacer_amortizes_profile_cost(self):
+        # FakeClock: every read ticks 1s, so a profiled step "costs"
+        # real fake time; at a 50% budget the pacer must wait about one
+        # profile-cost of idle time before profiling again
+        clk = FakeClock(0.0, 1.0)
+        prog, feed, z = _small_program()
+        feed_items = sorted(feed.items())
+        names = tuple(k for k, _ in feed_items)
+        arrays = [np.asarray(v) for _, v in feed_items]
+        vids = [prog.vid_of(z)]
+        prof = OpProfiler(name="budget", clock=clk, budget_pct=50.0,
+                          attribute=False)
+        assert prof.maybe_profiled_run(prog, names, arrays, vids) \
+            is not None  # first call always profiles
+        cost = prof.pacer.last_cost
+        assert cost > 0
+        # immediately after: not enough idle time banked -> skip
+        assert prof.maybe_profiled_run(prog, names, arrays, vids) is None
+        clk.advance(cost * 3)  # bank idle time past the 50% threshold
+        assert prof.maybe_profiled_run(prog, names, arrays, vids) \
+            is not None
+        assert prof.steps_profiled == 2
+
+    def test_skipped_runs_fall_through_to_jit(self, monkeypatch):
+        prog, feed, z = _small_program()
+        exe = static.Executor()
+        ref = exe.run(prog, feed=feed, fetch_list=[z])
+        monkeypatch.setenv(opprof.OPPROF_ENV, "1")
+        monkeypatch.setenv(opprof.OPPROF_STRIDE_ENV, "100")
+        opprof.reset_session()
+        for _ in range(3):
+            out = exe.run(prog, feed=feed, fetch_list=[z])
+            assert np.array_equal(out[0], ref[0])
+        sess = opprof.active_session()
+        assert sess.steps_profiled == 1
+        assert sess.pacer.runs == 3
+
+
+class TestOverheadGate:
+    """check_opprof_overhead — PTL402's analog, deterministic."""
+
+    def test_over_budget_trips_ptl503(self):
+        report = check_opprof_overhead(90.0, 100.0, tolerance_pct=5.0,
+                                       name="gate")
+        assert [d.code for d in report] == ["PTL503"]
+        d = report.by_code("PTL503")[0]
+        assert d.suggestion["overhead_pct"] == 10.0
+        assert d.suggestion["tolerance_pct"] == 5.0
+
+    def test_within_budget_is_clean(self):
+        assert len(check_opprof_overhead(96.0, 100.0,
+                                         tolerance_pct=5.0)) == 0
+
+    def test_zero_baseline_is_not_judged(self):
+        assert len(check_opprof_overhead(10.0, 0.0)) == 0
+
+    def test_overhead_gauge_is_published(self):
+        check_opprof_overhead(95.0, 100.0, name="gauge_check")
+        val = obs.registry.get("opprof.overhead_pct").value(
+            name="gauge_check")
+        assert val == 5.0
+
+
+class TestLints:
+    def test_gappy_profile_files_ptl502(self):
+        # a profile with externally-measured (wider) step bounds: the
+        # spans no longer tile the step — exactly what PTL502 catches
+        spans = [OpSpan(0, "matmul", 1.0, 2.0)]
+        p = OpProfile(name="gappy", step_start=0.0, step_end=10.0,
+                      spans=spans)
+        report = lint_op_profile(p)
+        assert "PTL502" in report.codes()
+        assert p.attributed_pct == 10.0
+
+    def test_ptl502_works_on_dumped_json_form(self):
+        p = OpProfile(name="doc", step_start=0.0, step_end=4.0,
+                      spans=[OpSpan(0, "add", 0.0, 1.0)])
+        doc = json.loads(json.dumps(p.to_dict()))
+        assert "PTL502" in lint_op_profile(doc).codes()
+
+    def test_hot_drifting_op_files_ptl501_with_payload(self):
+        doc = {
+            "name": "drift", "step_seconds": 1.0,
+            "attributed_pct": 100.0,
+            "rows": [
+                # hot (50% share) and 10x off predicted -> PTL501
+                {"index": 3, "prim": "matmul", "measured_seconds": 0.5,
+                 "predicted_seconds": 0.05, "drift_ratio": 10.0,
+                 "share_pct": 50.0},
+                # cold op, same drift: stays quiet
+                {"index": 4, "prim": "add", "measured_seconds": 0.01,
+                 "predicted_seconds": 0.001, "drift_ratio": 10.0,
+                 "share_pct": 1.0},
+            ],
+        }
+        report = lint_op_profile(doc, drift_tolerance_pct=200.0,
+                                 hot_share_pct=10.0)
+        found = report.by_code("PTL501")
+        assert len(found) == 1
+        assert found[0].op_index == 3
+        assert found[0].suggestion["prim"] == "matmul"
+
+    def test_all_opprof_codes_are_documented(self):
+        from paddle_tpu.static.analysis.diagnostics import CODES
+
+        # PTL501 PTL502 PTL503: claimed by opprof, documented in CODES
+        for code in opprof.OPPROF_CODES:
+            assert code in CODES
+
+
+class TestAttribution:
+    def test_rows_join_measured_against_cost_model(self):
+        prog, feed, z = _small_program()
+        prof, _outs, p = _profile_program(
+            prog, feed, [z], name="join", clock=FakeClock(0.0, 0.001),
+            stride=1)
+        assert p.rows is not None
+        assert len(p.rows) == len(prog._insts)
+        cost = program_cost(prog, [prog.vid_of(z)])
+        for row in p.rows:
+            c = cost.by_op[row["index"]]
+            assert row["flops"] == c.flops
+            assert row["measured_seconds"] > 0
+            if row["measured_seconds"] > 0 and c.flops:
+                assert row["achieved_flops_per_sec"] == pytest.approx(
+                    c.flops / row["measured_seconds"], rel=1e-6)
+                assert row["roofline_pct"] > 0
+        assert p.predicted_step_seconds == pytest.approx(
+            cost.predicted_step_seconds)
+
+    def test_llama_train_attribution_floor(self):
+        """Acceptance: >= 95% of measured step time attributed to named
+        ops on the bench llama train program (real clock)."""
+        bench = _load_bench()
+        prog, feed, fetch = bench.capture_llama_train_program(
+            batch=2, seq=16)
+        prof, _outs, p = _profile_program(prog, feed, fetch,
+                                          name="llama", stride=1)
+        assert p.attributed_pct >= 95.0
+        op_seconds = sum(s.seconds for s in p.spans
+                         if s.index is not None)
+        assert p.step_seconds > 0
+        assert op_seconds / p.step_seconds >= 0.95
+        assert "PTL502" not in lint_op_profile(p).codes()
+        # the grad section is one named span, joined at its cost index
+        grads = [s for s in p.spans if s.prim == "__gradients__"]
+        assert len(grads) == 1
+        assert any(r["prim"] == "__gradients__" for r in p.rows)
+
+
+class TestCalibration:
+    def test_round_trips_through_json(self, tmp_path):
+        cal = OpCalibration(factors={"matmul": 2.5, "add": 0.5},
+                            flops_factor=1.25,
+                            source={"name": "rt"})
+        path = str(tmp_path / "cal.json")
+        save_op_calibration(cal, path)
+        back = load_op_calibration(path)
+        assert back.factors == cal.factors
+        assert back.flops_factor == cal.flops_factor
+        assert back.source == cal.source
+
+    def test_resolve_inline_json_file_and_env(self, tmp_path,
+                                              monkeypatch):
+        cal = OpCalibration(factors={"relu": 3.0})
+        path = str(tmp_path / "cal.json")
+        save_op_calibration(cal, path)
+        assert resolve_op_calibration(path).factors == {"relu": 3.0}
+        inline = json.dumps(cal.to_dict())
+        assert resolve_op_calibration(inline).factors == {"relu": 3.0}
+        monkeypatch.setenv(opprof.OP_CALIBRATION_ENV, path)
+        assert resolve_op_calibration().factors == {"relu": 3.0}
+
+    def test_resolve_is_forgiving(self, tmp_path):
+        assert resolve_op_calibration() is None
+        assert resolve_op_calibration("/nonexistent/cal.json") is None
+        assert resolve_op_calibration("{not json") is None
+        # unknown keys ignored, never raised on
+        got = resolve_op_calibration(json.dumps(
+            {"factors": {"add": 2.0}, "future_field": [1, 2]}))
+        assert got.factors == {"add": 2.0}
+
+    def test_uncalibrated_program_cost_is_unchanged(self):
+        prog, _feed, z = _small_program()
+        fv = [prog.vid_of(z)]
+        a = program_cost(prog, fv)
+        b = program_cost(prog, fv, op_calibration=None)
+        assert a.flops == b.flops
+        assert a.seconds_by_op == b.seconds_by_op
+        assert a.predicted_step_seconds == b.predicted_step_seconds
+
+    def test_calibration_reduces_ptl302_flops_drift_on_llama(self):
+        """Acceptance: applying calibrate_op_costs strictly reduces the
+        whole-program PTL302 FLOPs drift vs the uncalibrated model."""
+        bench = _load_bench()
+        prog, feed, fetch = bench.capture_llama_train_program(
+            batch=2, seq=16)
+        fv = [prog.vid_of(t) for t in fetch]
+        base = program_cost(prog, fv)
+        measured = measure_program_flops(prog, feed, fetch)
+        assert measured > 0
+        err_uncal = abs(base.flops - measured) / measured
+        assert err_uncal > 0  # the analytical model is never exact
+
+        prof, _outs, p = _profile_program(
+            prog, feed, fetch, name="cal",
+            clock=FakeClock(0.0, 0.001), stride=1)
+        cal = calibrate_op_costs(p, base, measured_flops=measured)
+        calibrated = program_cost(prog, fv, op_calibration=cal)
+        err_cal = abs(calibrated.flops - measured) / measured
+        assert err_cal < err_uncal  # STRICT reduction
+        # and tight enough that PTL302 goes quiet at 1%
+        assert len(check_cost_model(calibrated.flops, measured,
+                                    tolerance_pct=1.0,
+                                    name="llama_cal")) == 0
+
+    def test_calibration_reduces_step_time_drift_on_llama(self):
+        """The PTL304 side: per-prim time factors fitted from a real
+        measured profile pull predicted_step_seconds toward the
+        measured step."""
+        bench = _load_bench()
+        prog, feed, fetch = bench.capture_llama_train_program(
+            batch=2, seq=16)
+        fv = [prog.vid_of(t) for t in fetch]
+        base = program_cost(prog, fv)
+        # real clock: the factors must price REAL per-op seconds
+        prof, _outs, p = _profile_program(prog, feed, fetch,
+                                          name="steptime", stride=1)
+        measured_step = sum(s.seconds for s in p.spans
+                            if s.index is not None)
+        assert measured_step > 0
+        err_uncal = abs(base.predicted_step_seconds - measured_step) \
+            / measured_step
+        cal = calibrate_op_costs(p, base)
+        calibrated = program_cost(prog, fv, op_calibration=cal)
+        err_cal = abs(calibrated.predicted_step_seconds
+                      - measured_step) / measured_step
+        assert err_cal < err_uncal
+        assert err_cal < 0.01  # fitted and evaluated on one profile
+
+    def test_calibration_round_trip_survives_the_env_path(
+            self, tmp_path, monkeypatch):
+        prog, feed, z = _small_program()
+        fv = [prog.vid_of(z)]
+        base = program_cost(prog, fv)
+        _prof, _outs, p = _profile_program(
+            prog, feed, [z], clock=FakeClock(0.0, 0.001), stride=1)
+        cal = calibrate_op_costs(p, base)
+        path = str(tmp_path / "cal.json")
+        save_op_calibration(cal, path)
+        direct = program_cost(prog, fv, op_calibration=cal)
+        monkeypatch.setenv(opprof.OP_CALIBRATION_ENV, path)
+        via_env = program_cost(prog, fv)
+        assert via_env.seconds_by_op == pytest.approx(
+            direct.seconds_by_op)
+        assert via_env.predicted_step_seconds == pytest.approx(
+            direct.predicted_step_seconds)
+
+
+class TestChromeExport:
+    def test_events_speak_the_shared_dialect(self):
+        prog, feed, z = _small_program()
+        prof, _outs, p = _profile_program(
+            prog, feed, [z], name="chrome",
+            clock=FakeClock(10.0, 0.5), stride=1)
+        evs = prof.chrome_trace_events(pid=7)
+        metas = [e for e in evs if e["ph"] == "M"]
+        assert {"process_name", "thread_name"} <= \
+            {m["name"] for m in metas}
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert len(xs) == len(p.spans)
+        first_op = next(e for e in xs if "op" in e["args"])
+        span = next(s for s in p.spans if s.index is not None)
+        assert first_op["ts"] == pytest.approx(span.start * 1e6)
+        assert first_op["dur"] == pytest.approx(span.seconds * 1e6)
+        assert all(e["pid"] == 7 for e in xs)
+
+    def test_merges_per_rank_with_the_fleet_tool(self, tmp_path):
+        from paddle_tpu.observability.fleet import \
+            merge_chrome_trace_files
+
+        prog, feed, z = _small_program()
+        paths = {}
+        for rank in (0, 1):
+            prof, _outs, _p = _profile_program(
+                prog, feed, [z], name=f"rank{rank}",
+                clock=FakeClock(0.0, 0.25), stride=1)
+            paths[rank] = prof.write_chrome_trace(
+                str(tmp_path / f"opprof.rank{rank}.json"))
+        merged = merge_chrome_trace_files(paths)
+        pids = {e.get("pid") for e in merged["traceEvents"]
+                if e.get("ph") == "X"}
+        assert pids == {0, 1}  # pid re-mapped to the rank lane
+
+    def test_record_event_spans_mirror_into_the_timeline(self):
+        from paddle_tpu.profiler.host_tracer import get_host_tracer
+
+        prog, feed, z = _small_program()
+        tracer = get_host_tracer()
+        tracer.start()
+        try:
+            prof, _outs, _p = _profile_program(
+                prog, feed, [z], name="mirror",
+                clock=FakeClock(0.0, 0.1), stride=1)
+        finally:
+            roots = tracer.stop()
+        # the profiled step bracketed every op in RecordEvents the
+        # legacy tracer collected ...
+        names = {e.name for e in roots} | {
+            c.name for r in roots for c in r.children}
+        assert "opprof.step" in names
+        assert {inst[0] for inst in prog._insts} <= names
+        # ... and those host spans mirror back into the opprof chrome
+        # timeline as their own lane
+        evs = prof.chrome_trace_events(host_events=roots)
+        host_lane = [e for e in evs
+                     if e.get("ph") == "X" and e.get("tid") == 1]
+        assert any(e["name"] == "opprof.step" for e in host_lane)
+        assert all(e["dur"] >= 0 for e in host_lane)
+
+    def test_write_is_a_valid_enveloped_doc(self, tmp_path):
+        prog, feed, z = _small_program()
+        prof, _outs, _p = _profile_program(
+            prog, feed, [z], clock=FakeClock(0.0, 0.5), stride=1)
+        path = prof.write_chrome_trace(str(tmp_path / "t.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["displayTimeUnit"] == "ms"
+        assert doc["traceEvents"]
+
+
+class TestRenderAndReport:
+    def test_render_top_k_table(self):
+        prog, feed, z = _small_program()
+        prof, _outs, _p = _profile_program(
+            prog, feed, [z], name="render",
+            clock=FakeClock(0.0, 0.5), stride=1)
+        out = render_op_profile(prof.dump_dict(), top=2)
+        assert "op profile (name=render)" in out
+        assert "attributed" in out
+        assert "matmul" in out and "cum" in out
+        assert "more op(s)" in out  # 3 ops, top=2
+
+    def test_render_rejects_wrong_kind(self):
+        with pytest.raises(ValueError):
+            render_op_profile({"kind": "serve_trace"})
+
+    def test_metrics_report_cli_renders_and_lints(self, tmp_path,
+                                                  capsys):
+        import sys
+
+        sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+        try:
+            import metrics_report
+        finally:
+            sys.path.pop(0)
+        prog, feed, z = _small_program()
+        prof, _outs, _p = _profile_program(
+            prog, feed, [z], name="cli",
+            clock=FakeClock(0.0, 0.25), stride=1)
+        path = str(tmp_path / "opprof.json")
+        prof.dump(path)
+        rc = metrics_report.main(["--opprof", path])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "op profile (name=cli)" in out
+        assert "op profile lint" in out
+
+    def test_dump_round_trips(self, tmp_path):
+        prog, feed, z = _small_program()
+        prof, _outs, p = _profile_program(
+            prog, feed, [z], clock=FakeClock(0.0, 0.5), stride=1)
+        path = str(tmp_path / "d.json")
+        prof.dump(path)
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["kind"] == "opprof"
+        assert doc["steps_profiled"] == 1
+        assert doc["profiles"][0]["attributed_pct"] == 100.0
+        assert len(doc["profiles"][0]["spans"]) == len(p.spans)
+
+
+class TestMetrics:
+    def test_profiled_step_publishes_the_opprof_series(self):
+        prog, feed, z = _small_program()
+        _profile_program(prog, feed, [z], name="mtest",
+                         clock=FakeClock(0.0, 0.5), stride=1)
+        assert obs.registry.get("opprof.steps_profiled").value(
+            name="mtest") == 1
+        assert obs.registry.get("opprof.attributed_pct").value(
+            name="mtest") == 100.0
+        hist = obs.registry.get("opprof.op_seconds")
+        prims = {ls.get("prim") for ls in hist.labelsets()}
+        assert "matmul" in prims
+
+    def test_skipped_runs_count(self, monkeypatch):
+        prog, feed, z = _small_program()
+        exe = static.Executor()
+        monkeypatch.setenv(opprof.OPPROF_ENV, "1")
+        monkeypatch.setenv(opprof.OPPROF_STRIDE_ENV, "5")
+        opprof.reset_session()
+        before = obs.registry.get("opprof.steps_skipped").value(
+            name="executor") or 0
+        for _ in range(3):
+            exe.run(prog, feed=feed, fetch_list=[z])
+        after = obs.registry.get("opprof.steps_skipped").value(
+            name="executor")
+        assert after - before == 2

@@ -223,6 +223,20 @@ TRACE_REQUIRED_LABELS = {
     "trace.slo_breaches": ("engine", "rule"),
 }
 
+#: op-profiler label discipline (observability/opprof.py): every series
+#: attributes the profile name (which program was measured), and the
+#: per-op series say WHICH primitive class — the join key the
+#: cost-model calibration fits against.
+OPPROF_REQUIRED_LABELS = {
+    "opprof.steps_profiled": ("name",),
+    "opprof.steps_skipped": ("name",),
+    "opprof.op_seconds": ("name", "prim"),
+    "opprof.step_seconds": ("name",),
+    "opprof.attributed_pct": ("name",),
+    "opprof.overhead_pct": ("name",),
+    "opprof.drift_ratio": ("name", "prim"),
+}
+
 #: one audit loop serves every per-subsystem required-labels table —
 #: add the next subsystem as a row here, not as another copied loop
 REQUIRED_LABEL_TABLES = (
@@ -239,6 +253,8 @@ REQUIRED_LABEL_TABLES = (
     (TRACE_REQUIRED_LABELS, "trace series must attribute the engine "
                             "(and the phase/rule/reason/kind where one "
                             "applies)"),
+    (OPPROF_REQUIRED_LABELS, "opprof series must attribute the profile "
+                             "name (and the prim for per-op series)"),
 )
 
 #: gauge-prefix discipline: no gauge under these prefixes may record an
@@ -252,6 +268,8 @@ NO_UNLABELED_GAUGE_PREFIXES = {
              "program the prediction describes)",
     "trace.": "every trace gauge must carry at least an engine= label "
               "(serve-trace series merge through the fleet plane too)",
+    "opprof.": "every opprof gauge must carry at least a name= label "
+               "(the profile the measurement attributes)",
 }
 
 
@@ -265,6 +283,7 @@ def check_metric_registry() -> List[str]:
     import paddle_tpu.distributed.elastic  # noqa: F401
     import paddle_tpu.io.dataloader  # noqa: F401
     import paddle_tpu.observability.fleet  # noqa: F401
+    import paddle_tpu.observability.opprof  # noqa: F401
     import paddle_tpu.observability.runtime  # noqa: F401
     import paddle_tpu.observability.slo  # noqa: F401
     import paddle_tpu.observability.tracing  # noqa: F401
@@ -337,6 +356,7 @@ def check_diagnostic_registry() -> List[str]:
     by at least one test (string-presence scan over ``tests/``)."""
     from paddle_tpu.distributed import passes as passes_mod
     from paddle_tpu.distributed.passes.lint_fix_passes import LintFixPass
+    from paddle_tpu.observability import opprof as opprof_mod
     from paddle_tpu.observability import slo as slo_mod
     from paddle_tpu.observability import tracing as tracing_mod
     from paddle_tpu.static.analysis import cost as cost_mod
@@ -364,7 +384,8 @@ def check_diagnostic_registry() -> List[str]:
     for claimed_by, codes in (
             ("serve_trace_lint", serve_trace_lint.SERVE_TRACE_LINT_CODES),
             ("observability.tracing", tracing_mod.TRACE_CODES),
-            ("observability.slo", slo_mod.SLO_CODES)):
+            ("observability.slo", slo_mod.SLO_CODES),
+            ("observability.opprof", opprof_mod.OPPROF_CODES)):
         for code in codes:
             if code not in diagnostics.CODES:
                 problems.append(

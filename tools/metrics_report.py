@@ -7,6 +7,7 @@ Usage:
     python tools/metrics_report.py /tmp/flight_dir      # a whole incident
     python tools/metrics_report.py --fleet /tmp/fleet   # cross-rank view
     python tools/metrics_report.py --serve-trace /tmp/serve_trace
+    python tools/metrics_report.py --opprof /tmp/opprof.json
 
 Input is either the JSON written by ``paddle_tpu.observability.dump(path)``
 (or any workload run with ``PADDLE_TPU_METRICS_DUMP=metrics.json``), or a
@@ -42,6 +43,14 @@ interleaving and the flight-dump index
 header, per-phase p50/p99 latency-attribution table, tail exemplars —
 then runs the serve-trace lint (PTL404 decode-burst gaps, PTL405
 preemption thrash), the serving analog of the ``--fleet`` PTL203 lint.
+
+``--opprof <file>`` renders an op-level execution-profile dump
+(``OpProfiler.dump()`` JSON): the top-K ops table of the last profiled
+step — measured ms, predicted ms, measured/predicted drift, roofline %
+against the device peak, and cumulative step share — then runs the
+op-profile lint inline (PTL501 hot-op drift, PTL502 attribution
+shortfall), the training-plane analog of ``--serve-trace``. ``--top``
+bounds the table.
 """
 from __future__ import annotations
 
@@ -158,6 +167,35 @@ def _render_serve_trace(path: str) -> int:
     return 0
 
 
+def _render_opprof(path: str, top) -> int:
+    """Render one op-profile dump (``OpProfiler.dump()`` JSON) + the
+    PTL501/PTL502 lint over every retained profile."""
+    from paddle_tpu.observability.opprof import (lint_op_profile,
+                                                 render_op_profile)
+
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"metrics_report: cannot read {path!r}: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        print(render_op_profile(doc, top=10 if top is None else top))
+    except ValueError as e:
+        print(f"metrics_report: {path!r}: {e}", file=sys.stderr)
+        return 1
+    from paddle_tpu.static.analysis.diagnostics import DiagnosticReport
+
+    report = DiagnosticReport()
+    for p in doc.get("profiles") or ():
+        report.extend(lint_op_profile(p))
+    print()
+    print(report.render(
+        f"op profile lint ({os.path.basename(path)}):"))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("dump", help="JSON written by observability.dump(), a "
@@ -178,7 +216,16 @@ def main(argv=None) -> int:
                          "(serve_requests.json or the --trace-out dir): "
                          "per-phase breakdown + tail exemplars + the "
                          "PTL404/PTL405 serve-trace lint")
+    ap.add_argument("--opprof", action="store_true",
+                    help="treat the path as an op-profile dump "
+                         "(OpProfiler.dump() JSON): top-K ops table "
+                         "(measured/predicted ms, drift, roofline %%, "
+                         "cumulative step share) + the PTL501/PTL502 "
+                         "op-profile lint")
     args = ap.parse_args(argv)
+
+    if args.opprof:
+        return _render_opprof(args.dump, args.top)
 
     if args.serve_trace:
         return _render_serve_trace(args.dump)
