@@ -192,6 +192,18 @@ def _emit_metrics_block():
         "serve_ttft_p99": hist_quantile("serve.ttft_seconds", 0.99),
         "serve_tokens_per_sec": gauge_max("serve.tokens_per_sec"),
         "serve_preemptions": tot("serve.preemptions"),
+        # prefix-cache + fused-burst roll-ups (serve/engine.py PR 19):
+        # hit rate over admissions, physical blocks NOT re-prefilled,
+        # and scheduler host round-trips amortized per generated token
+        # (1.0 = the classic one-dispatch-per-token loop; 1/N at
+        # steady-state burst N)
+        "serve_prefix_hit_rate": round(
+            tot("serve.prefix_hits") / tot("serve.requests_admitted"), 4)
+        if tot("serve.requests_admitted") else None,
+        "serve_blocks_saved": tot("serve.prefix_blocks_shared"),
+        "serve_host_roundtrips_per_token": round(
+            tot("serve.host_roundtrips") / tot("serve.tokens_generated"),
+            4) if tot("serve.tokens_generated") else None,
         # request-lifecycle tracing roll-ups (observability/tracing.py +
         # slo.py; populated when the serve config runs its traced pass)
         "serve_queue_seconds_p99":
@@ -1154,6 +1166,79 @@ def bench_serve(on_tpu, steps, warmup, peak_flops):
     }), flush=True)
     for d in guard:
         print(json.dumps({"diagnostic": d.render()}), flush=True)
+
+    # fused-decode-burst comparison: the IDENTICAL Poisson load (same
+    # seed -> same arrivals/prompts/output lengths) run one-dispatch-
+    # per-token (burst=1) and as 8-step fused scans (burst=8). The
+    # solo-equivalence suite pins the token streams byte-identical;
+    # this record measures what the fusion buys: scheduler host
+    # round-trips per generated token and aggregate tokens/sec. A
+    # longer fixed generation (32 tokens) keeps the pow2 burst
+    # schedule's tail (8+8+8+4+2+1) from dominating the ratio.
+    bp = dict(sp)
+    bp["max_new"] = (32, 32)
+    if not on_tpu:      # default 24x8 pool can't seat 3x(12+32)-token
+        bp.update(block_size=16, num_blocks=30, max_seq_len=80)
+    burst_res = {}
+    for nburst in (1, 8):
+        eng = ServeEngine(model, max_slots=bp["slots"],
+                          block_size=bp["block_size"],
+                          num_blocks=bp["num_blocks"],
+                          max_seq_len=bp["max_seq_len"],
+                          name=f"bench_burst{nburst}",
+                          decode_burst=nburst)
+        warm_engine(eng)
+        burst_res[nburst] = run_load(
+            eng, rate=rate, n_requests=n_req, prompt_len=bp["prompt_len"],
+            max_new=bp["max_new"], seed=0)
+    r1, r8 = burst_res[1], burst_res[8]
+    print(json.dumps({
+        "metric": f"serve fused-decode host round-trips per token, "
+                  f"burst=8 vs burst=1 at equal load ({n_req} reqs x "
+                  f"{bp['max_new'][1]} tokens: {r8.host_roundtrips} vs "
+                  f"{r1.host_roundtrips} dispatches for "
+                  f"{r8.total_tokens} tokens each, "
+                  f"{r1.host_roundtrips / max(r8.host_roundtrips, 1):.1f}x "
+                  f"fewer; {r8.tokens_per_sec:.0f} vs "
+                  f"{r1.tokens_per_sec:.0f} tok/s; vs_baseline is "
+                  f"burst=8 / burst=1 throughput)",
+        "value": round(r8.host_roundtrips / max(r8.total_tokens, 1), 4),
+        "unit": "roundtrips/token",
+        "vs_baseline": round(r8.tokens_per_sec / r1.tokens_per_sec, 3)
+        if r1.tokens_per_sec else 0.0,
+    }), flush=True)
+
+    # prefix-cache comparison: the shared-system-prompt workload (a
+    # 3-block synthetic prefix on 70% of requests) against a cold
+    # engine and a prefix-cache one. blocks_saved counts physical KV
+    # blocks mounted from the cache instead of re-prefilled;
+    # prefill_tokens is what each engine actually computed.
+    shared_tok = 3 * bs
+    pref_res = {}
+    for on in (False, True):
+        eng = ServeEngine(model, max_slots=slots, block_size=bs,
+                          num_blocks=blocks, max_seq_len=msl,
+                          name=f"bench_prefix_{'warm' if on else 'cold'}",
+                          prefix_cache=on or None)
+        warm_engine(eng)
+        pref_res[on] = run_load(
+            eng, rate=rate, n_requests=n_req, prompt_len=plen,
+            max_new=(mnew[0], mnew[0]), seed=0,
+            shared_prefix_tokens=shared_tok, shared_prefix_frac=0.7)
+    cold, warm = pref_res[False], pref_res[True]
+    print(json.dumps({
+        "metric": f"serve prefix-cache blocks saved under a shared "
+                  f"{shared_tok}-token system prompt (70% of {n_req} "
+                  f"reqs; {warm.prefix_hits} hits, prefill "
+                  f"{warm.prefill_tokens} vs {cold.prefill_tokens} "
+                  f"cold tokens; {warm.tokens_per_sec:.0f} vs "
+                  f"{cold.tokens_per_sec:.0f} tok/s; vs_baseline is "
+                  f"warm/cold prefilled tokens — lower is better)",
+        "value": warm.prefix_blocks_shared,
+        "unit": "blocks",
+        "vs_baseline": round(warm.prefill_tokens / cold.prefill_tokens, 3)
+        if cold.prefill_tokens else 0.0,
+    }), flush=True)
 
 
 def _run_isolated(config: str, args) -> int:

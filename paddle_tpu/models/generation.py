@@ -421,6 +421,24 @@ def _sample_token(logits, key, *, do_sample, temperature, top_k, top_p):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _sample_slot_tokens(logits, temps, key):
+    """Per-row mixed greedy/sampled decode for the serving engine:
+    logits [B, V] and per-slot temperatures [B] (0.0 = greedy for that
+    row) -> token ids [B]. Rows sample and argmax in one fused graph so
+    a batch mixing greedy and sampled streams stays a single trace —
+    this is the in-scan sampling step of the fused decode burst too,
+    so it must remain shape-stable and key-pure."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(
+        key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
 def _prep_decode(model, p, t0, max_new_tokens):
     """Shared decode-path setup (ONE copy for the greedy/beam/paged
     drivers): validate the learned-position table can hold the target

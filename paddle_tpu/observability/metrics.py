@@ -52,7 +52,10 @@ CLAIMED_SUBSYSTEMS = {
                    # predictions
     "serve",       # serve/engine.py — continuous-batching server: queue
                    # depth, TTFT, tokens/sec, preemptions, pool
-                   # occupancy, batch fill, decode/prefill traces
+                   # occupancy, batch fill, decode/prefill traces;
+                   # prefix-cache sharing (prefix_hits,
+                   # prefix_blocks_shared, cow_copies) and fused decode
+                   # bursts (burst_tokens, host_roundtrips)
     "trace",       # observability/tracing.py + slo.py — request-scoped
                    # span tracing: per-phase seconds, tail exemplars,
                    # decode-gap accounting, SLO breaches, overhead guard

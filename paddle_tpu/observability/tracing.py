@@ -373,11 +373,16 @@ class ServeTracer:
         self.exemplars.offer(doc)
 
     def on_decode_step(self, start: float, end: float,
-                       active_after: int, queued: int):
-        """One batched decode step on the engine lane. The gap between
-        the previous step's end and this start, while the previous step
-        left runnable slots behind, is host-side scheduler time the chip
-        sat idle — the fused-decode opportunity PTL404 lints."""
+                       active_after: int, queued: int,
+                       tokens: int = 1):
+        """One batched decode dispatch on the engine lane — a single
+        step, or a fused burst of ``tokens`` in-scan steps when the
+        engine runs with ``decode_burst > 1`` (one host round-trip
+        either way, which is exactly the point). The gap between the
+        previous dispatch's end and this start, while the previous one
+        left runnable slots behind, is host-side scheduler time the
+        chip sat idle — the fused-decode opportunity PTL404 lints;
+        bursts shrink the number of such gaps ~N x."""
         if self._last_step_end is not None and self._last_step_active > 0:
             gap = start - self._last_step_end
             if gap > 0:
@@ -388,7 +393,8 @@ class ServeTracer:
         self._last_step_active = int(active_after)
         self.decode_steps.append(
             {"start": round(start, 9), "end": round(end, 9),
-             "active": int(active_after), "queued": int(queued)})
+             "active": int(active_after), "queued": int(queued),
+             "tokens": int(tokens)})
 
     # -- per-request doc ---------------------------------------------------
     def _request_doc(self, req) -> Dict[str, Any]:
@@ -453,7 +459,8 @@ class ServeTracer:
             evs.append(chrome.complete_event(
                 "decode_step", s["start"], s["end"], cat="serve",
                 pid=pid, tid=engine_lane,
-                args={"active": s["active"], "queued": s["queued"]}))
+                args={"active": s["active"], "queued": s["queued"],
+                      "tokens": s.get("tokens", 1)}))
         meta = [chrome.process_name_event(pid, f"serve:{self.engine}"),
                 chrome.thread_name_event(pid, 0, "queue/preempt wait"),
                 chrome.thread_name_event(pid, engine_lane,

@@ -12,6 +12,7 @@ section for a worked example.
 from .engine import Request, ServeEngine
 from .load import LoadResult, run_load
 from .pool import BlockPool, PoolExhaustedError
+from .prefix import PrefixCache
 
 __all__ = ["ServeEngine", "Request", "BlockPool", "PoolExhaustedError",
-           "run_load", "LoadResult"]
+           "PrefixCache", "run_load", "LoadResult"]

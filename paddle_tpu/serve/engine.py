@@ -60,6 +60,7 @@ Both, plus all request timestamps, read the injectable ``clock``
 from __future__ import annotations
 
 import collections
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Deque, List, Optional
@@ -70,6 +71,7 @@ from .. import observability as obs
 from ..core.tensor import Tensor
 from ..models import generation as _gen
 from .pool import BlockPool, PoolExhaustedError
+from .prefix import PrefixCache
 
 __all__ = ["ServeEngine", "Request", "PoolExhaustedError"]
 
@@ -114,6 +116,22 @@ _M_DECODE_SECONDS = obs.histogram(
     "serve.decode_step_seconds", "wall time of one batched decode step")
 _M_PREFILL_SECONDS = obs.histogram(
     "serve.prefill_seconds", "wall time of one prefill call")
+_M_PREFIX_HITS = obs.counter(
+    "serve.prefix_hits", "admissions that mounted shared KV blocks "
+    "from the prefix cache")
+_M_PREFIX_BLOCKS = obs.counter(
+    "serve.prefix_blocks_shared", "full KV blocks mounted read-only "
+    "from the prefix cache at admission — prefill was skipped for "
+    "those tokens")
+_M_COW = obs.counter(
+    "serve.cow_copies", "copy-on-write block duplications where a "
+    "stream diverged inside a shared prefix block")
+_M_BURST_TOKENS = obs.counter(
+    "serve.burst_tokens", "tokens generated inside fused multi-step "
+    "decode bursts (the on-chip lax.scan path)")
+_M_HOST_RT = obs.counter(
+    "serve.host_roundtrips", "host->device decode dispatches — one "
+    "per burst, so decode_burst=N cuts this ~N x per token")
 
 QUEUED = "QUEUED"
 RUNNING = "RUNNING"
@@ -140,6 +158,15 @@ class Request:
     admit_seq: int = -1                    # recency rank for eviction
     preemptions: int = 0
     warmup: bool = False                   # excluded from TTFT telemetry
+    # prefix-cache bookkeeping: trie registration cursor, how many full
+    # blocks of ids are already covered by the trie, and sharing stats
+    # (blocks mounted from the cache at the LAST admission; tokens this
+    # request actually prefilled across all admissions — suffix-only
+    # when the cache hit)
+    prefix_node: Optional[object] = field(default=None, repr=False)
+    registered_upto: int = 0
+    shared_blocks: int = 0
+    prefilled_tokens: int = 0
     # span tree (observability.tracing.RequestTrace) when the engine
     # runs with tracing enabled; None otherwise
     trace: Optional[object] = field(default=None, repr=False)
@@ -182,14 +209,20 @@ class ServeEngine:
                  num_blocks: int = 64, max_seq_len: int = 256,
                  seed: int = 0, name: str = "default",
                  attention_backend: str = "auto", clock=None,
-                 trace=None, slo=None):
+                 trace=None, slo=None, prefix_cache=None,
+                 decode_burst=None):
         """``clock`` is a zero-arg callable returning seconds (default
         ``time.perf_counter``) — every request timestamp, tracer span
         and SLO window reads it, so tests inject a fake. ``trace`` is
         True/False, a ready ``ServeTracer``, or None to read
         ``PADDLE_TPU_TRACE``. ``slo`` is a rule list (``SloRule``/
         dicts/JSON), a ready ``SloMonitor``, or None to read
-        ``PADDLE_TPU_SLO``."""
+        ``PADDLE_TPU_SLO``. ``prefix_cache`` is True/False or None to
+        read ``PADDLE_TPU_PREFIX_CACHE`` (cross-request KV block
+        sharing — see ``serve/prefix.py``). ``decode_burst`` is the
+        max number of decode steps fused into one on-chip ``lax.scan``
+        dispatch (None reads ``PADDLE_TPU_DECODE_BURST``, default 1 =
+        the PR-14 one-roundtrip-per-token loop)."""
         import jax
 
         if not hasattr(model, "llama") and not hasattr(model, "gpt"):
@@ -242,6 +275,28 @@ class ServeEngine:
         self._lens = np.zeros(self.max_slots, np.int32)
         self._tokens = np.zeros(self.max_slots, np.int32)
         self._temps = np.zeros(self.max_slots, np.float32)
+        # per-slot eos ids (-1 = none) ride into the fused burst so eos
+        # latching can happen inside the scan
+        self._eos = np.full(self.max_slots, -1, np.int32)
+
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "PADDLE_TPU_PREFIX_CACHE", "").strip().lower() in (
+                    "1", "true", "yes", "on")
+        self._prefix: Optional[PrefixCache] = (
+            PrefixCache(self.block_size) if prefix_cache else None)
+        if decode_burst is None:
+            decode_burst = int(
+                os.environ.get("PADDLE_TPU_DECODE_BURST", "").strip()
+                or 1)
+        if int(decode_burst) < 1:
+            raise ValueError(
+                f"decode_burst must be >= 1, got {decode_burst}")
+        self.decode_burst = int(decode_burst)
+        # pow2 burst lengths actually dispatched — each is one compiled
+        # scan, so serve.decode_traces == len(burst_lens_used) in burst
+        # mode (the bounded-trace contract the tests pin)
+        self.burst_lens_used: set = set()
 
         self.queue: Deque[Request] = collections.deque()
         self.finished: List[Request] = []
@@ -262,6 +317,15 @@ class ServeEngine:
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1,))
         self._prefill_fn = jax.jit(self._prefill_impl,
                                    donate_argnums=(1,))
+        # prefix-cache companions: suffix prefill (attends through the
+        # block table so suffix tokens see the shared resident prefix)
+        # and the copy-on-write block duplication; fused decode burst
+        # (n static -> one trace per pow2 burst length)
+        self._suffix_prefill_fn = jax.jit(self._suffix_prefill_impl,
+                                          donate_argnums=(1,))
+        self._cow_fn = jax.jit(self._cow_impl, donate_argnums=(0,))
+        self._burst_fn = jax.jit(self._burst_impl, static_argnums=(0,),
+                                 donate_argnums=(2,))
 
         # request-lifecycle tracing + SLO guardrails (both host-side
         # scheduler-path bookkeeping; the compiled steps never see them)
@@ -366,7 +430,10 @@ class ServeEngine:
         self._admit()
         n_active = self.n_active
         if n_active:
-            self._decode_once()
+            if self.decode_burst > 1:
+                self._decode_burst_once()
+            else:
+                self._decode_once()
         _M_QUEUE_DEPTH.set(len(self.queue), engine=self.name)
         _M_POOL_OCCUPANCY.set(round(self.pool.occupancy, 4),
                               engine=self.name)
@@ -417,7 +484,16 @@ class ServeEngine:
         return None
 
     def _admit(self):
-        """FIFO admission from the queue head into free slots."""
+        """FIFO admission from the queue head into free slots. With the
+        prefix cache on, the queue head's prompt is longest-prefix
+        matched against resident full blocks first: matched blocks are
+        acquired (refcount +1) and mounted directly into the block
+        table, and prefill runs only on the unshared suffix — the TTFT
+        win. A prompt whose EVERY full block matches still recomputes
+        its last token (the logits source) into a copy-on-write
+        duplicate of the final matched block, so no stream ever writes
+        KV that another stream reads."""
+        bs = self.block_size
         while self.queue:
             slot = self._free_slot()
             if slot is None:
@@ -431,14 +507,50 @@ class ServeEngine:
             # length by one (skipping a cache slot + shifting rope)
             prefill_ids = list(req.ids[:-1] if req.n_generated > 0
                                else req.ids)
-            need = self.pool.blocks_for_tokens(len(prefill_ids))
-            if need > self.pool.free_blocks:
+            n_pre = len(prefill_ids)
+            matched: List[int] = []
+            cow = False
+            if self._prefix is not None:
+                matched = self._prefix.match(prefill_ids)
+                # a full-prompt match (every token in matched full
+                # blocks) must still produce the last token's logits:
+                # recompute it into a CoW copy of the last block
+                cow = bool(matched) and len(matched) * bs >= n_pre
+            read_only = matched[:-1] if cow else matched
+            if read_only:
+                self.pool.acquire(read_only)
+                self._prefix.note_acquired(read_only)
+            need = self.pool.blocks_for_tokens(n_pre) - len(read_only)
+            evictable = (self._prefix.evictable_blocks
+                         if self._prefix is not None else 0)
+            if need > self.pool.free_blocks + evictable:
                 # head-of-line blocking is the FIFO contract: later
-                # (smaller) requests do NOT jump a starving head
+                # (smaller) requests do NOT jump a starving head. Put
+                # the acquired prefix references back (registered
+                # blocks park in the cached state, still matchable)
+                if read_only:
+                    self._prefix.note_cached(
+                        self.pool.release(read_only, retain=read_only))
                 _M_STALLS.inc(engine=self.name, reason="no_free_blocks")
                 return
             self.queue.popleft()
-            req.blocks = self.pool.alloc(need)
+            fresh = self._alloc_blocks(need)
+            req.blocks = list(read_only) + fresh
+            req.shared_blocks = len(read_only)
+            if cow:
+                # fresh[0] sits at the divergence position: duplicate
+                # the shared block's K/V so the recomputed last token
+                # writes into private pages
+                self._caches = self._cow_fn(
+                    self._caches, np.int32(matched[-1]),
+                    np.int32(fresh[0]))
+                _M_COW.inc(engine=self.name)
+            if read_only or cow:
+                _M_PREFIX_HITS.inc(engine=self.name)
+                _M_PREFIX_BLOCKS.inc(len(read_only), engine=self.name)
+            if self._prefix is not None:
+                req.prefix_node = self._prefix.node_for(prefill_ids)
+                req.registered_upto = len(matched)
             req.slot = slot
             req.state = RUNNING
             req.admit_seq = self._admit_counter
@@ -450,28 +562,58 @@ class ServeEngine:
             if self.tracer is not None:
                 self.tracer.on_admit(req, slot,
                                      resumed=req.n_generated > 0)
-            self._prefill(req, prefill_ids)
+            # shared tokens are resident KV the suffix attends to but
+            # never recomputes; under CoW the suffix is the last token
+            start = (n_pre - 1) if cow else len(read_only) * bs
+            self._prefill(req, prefill_ids, start=start)
             _M_ADMITTED.inc(engine=self.name)
             if req.state is FINISHED:
                 continue        # eos / max_new hit on the first token
-            self._lens[slot] = len(prefill_ids)
+            self._lens[slot] = n_pre
             self._tokens[slot] = req.ids[-1]
             self._temps[slot] = req.temperature
+            self._eos[slot] = (-1 if req.eos_token_id is None
+                               else req.eos_token_id)
 
-    def _prefill(self, req: Request, prefill_ids: List[int]):
+    def _alloc_blocks(self, n: int) -> List[int]:
+        """Pool alloc with prefix-cache eviction backing it: when the
+        free list runs short, reclaim LRU refcount-0 cached blocks
+        first (their KV is resident only speculatively); referenced
+        blocks are never touched. Raises ``PoolExhaustedError`` when
+        even eviction cannot cover ``n``."""
+        if self._prefix is not None and n > self.pool.free_blocks:
+            self._prefix.evict(self.pool, n - self.pool.free_blocks)
+        return self.pool.alloc(n)
+
+    def _prefill(self, req: Request, prefill_ids: List[int],
+                 start: int = 0):
+        """Prefill this stream's KV. ``start`` tokens are already
+        resident (mounted from the prefix cache), so only the suffix
+        ``prefill_ids[start:]`` is computed — through the block table,
+        where each suffix row attends to the shared prefix it never
+        recomputed. ``start == 0`` is the cold path (in-prompt causal
+        attention, the PR-14 kernel)."""
         import jax.numpy as jnp
 
-        n = len(prefill_ids)
+        suffix = prefill_ids[start:]
+        n = len(suffix)
         bucket = max(8, 1 << (n - 1).bit_length())   # pow2 length buckets
         bucket = min(bucket, self.max_seq_len)
         if self.tracer is not None:
             self.tracer.on_prefill(req, bucket=bucket, tokens=n)
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = prefill_ids
+        padded[0, :n] = suffix
+        req.prefilled_tokens += n
         with _M_PREFILL_SECONDS.time(engine=self.name):
-            self._caches, logits = self._prefill_fn(
-                self._arrays, self._caches, jnp.asarray(padded),
-                jnp.int32(n), jnp.asarray(self._tables[req.slot]))
+            if start == 0:
+                self._caches, logits = self._prefill_fn(
+                    self._arrays, self._caches, jnp.asarray(padded),
+                    jnp.int32(n), jnp.asarray(self._tables[req.slot]))
+            else:
+                self._caches, logits = self._suffix_prefill_fn(
+                    self._arrays, self._caches, jnp.asarray(padded),
+                    jnp.int32(n), jnp.int32(start),
+                    jnp.asarray(self._tables[req.slot]))
         if req.n_generated == 0:
             # fresh stream: its FIRST token comes from the prefill
             # logits (this is the TTFT moment); resumed streams already
@@ -486,6 +628,10 @@ class ServeEngine:
             if self.tracer is not None:
                 self.tracer.on_first_token(req, now)
             self._append_token(req, tok)
+        else:
+            # resumed streams append nothing here; their just-refilled
+            # full blocks still need trie registration
+            self._register_full_blocks(req)
         if self.tracer is not None and req.state is not FINISHED:
             self.tracer.on_decode_begin(req)
 
@@ -500,24 +646,61 @@ class ServeEngine:
         prob /= prob.sum()
         return int(self._rng.choice(logits.shape[0], p=prob))
 
-    def _append_token(self, req: Request, tok: int):
+    def _register_full_blocks(self, req: Request):
+        """Register every newly-FULL block of this stream in the prefix
+        trie so later prompts can share it. Written positions are
+        ``len(ids) - 1`` (the pending last token is emitted but not yet
+        written); a chunk another stream registered first wins and this
+        stream's block simply stays private."""
+        if self._prefix is None or req.prefix_node is None:
+            return
+        bs = self.block_size
+        full = (len(req.ids) - 1) // bs
+        while req.registered_upto < full:
+            b = req.registered_upto
+            req.prefix_node = self._prefix.register(
+                req.prefix_node, req.ids[b * bs:(b + 1) * bs],
+                req.blocks[b])
+            req.registered_upto += 1
+
+    def _release_blocks(self, req: Request):
+        """Drop this stream's references. Trie-registered blocks whose
+        refcount hits 0 are RETAINED in the pool's cached state (their
+        KV stays matchable — this is what makes preemption recompute
+        and repeat system prompts nearly free); everything else returns
+        to the free list."""
+        if self._prefix is not None:
+            retain = [b for b in req.blocks
+                      if self._prefix.is_registered(b)]
+            self._prefix.note_cached(
+                self.pool.release(req.blocks, retain=retain))
+        else:
+            self.pool.free(req.blocks)
+        req.blocks = []
+
+    def _append_token(self, req: Request, tok: int,
+                      now: Optional[float] = None):
+        """``now`` carries the in-scan step-boundary timestamp when the
+        token was produced inside a fused burst (interpolated between
+        the burst's host dispatch and return); None = read the clock."""
         req.ids.append(int(tok))
         self._n_tokens += 1
         _M_TOKENS.inc(engine=self.name)
+        self._register_full_blocks(req)
         if req.eos_token_id is not None and tok == req.eos_token_id:
-            self._finish(req, "eos")
+            self._finish(req, "eos", now=now)
         elif req.n_generated >= req.max_new_tokens:
-            self._finish(req, "max_new_tokens")
+            self._finish(req, "max_new_tokens", now=now)
 
-    def _finish(self, req: Request, reason: str):
-        self.pool.free(req.blocks)
-        req.blocks = []
+    def _finish(self, req: Request, reason: str,
+                now: Optional[float] = None):
+        self._release_blocks(req)
         if req.slot is not None:
             self._clear_slot(req.slot)
         req.slot = None
         req.state = FINISHED
         req.finish_reason = reason
-        req.finish_time = self._clock()
+        req.finish_time = self._clock() if now is None else now
         self.finished.append(req)
         _M_FINISHED.inc(engine=self.name, reason=reason)
         _M_REQUEST_SECONDS.observe(req.finish_time - req.submit_time,
@@ -531,6 +714,7 @@ class ServeEngine:
         self._lens[slot] = 0
         self._tokens[slot] = 0
         self._temps[slot] = 0.0
+        self._eos[slot] = -1
 
     def _preempt_youngest(self) -> Request:
         """Evict the most recently admitted active stream; its blocks
@@ -540,8 +724,7 @@ class ServeEngine:
         to completion — the no-livelock guarantee."""
         victims = [r for r in self._slots if r is not None]
         victim = max(victims, key=lambda r: r.admit_seq)
-        self.pool.free(victim.blocks)
-        victim.blocks = []
+        self._release_blocks(victim)
         self._clear_slot(victim.slot)
         victim.slot = None
         victim.state = QUEUED
@@ -553,21 +736,34 @@ class ServeEngine:
             self.tracer.on_preempt(victim)
         return victim
 
-    def _ensure_blocks(self):
+    def _ensure_blocks(self, lookahead: int = 1):
         """Every active stream needs the block its next token writes
         into; allocate at block boundaries, evicting youngest-first
         when the pool runs dry (a stream that is ITSELF the youngest
         self-preempts back to the queue rather than evicting an older
-        one)."""
+        one).
+
+        ``lookahead > 1`` (the fused-burst path) pre-allocates enough
+        blocks for the next ``lookahead`` tokens so a stream one token
+        shy of a block edge doesn't collapse the whole batch's burst to
+        one step. Only the MUST-HAVE block (the one the very next token
+        writes into) is worth preempting for — when the pool can't fund
+        the extra lookahead blocks the burst just shrinks via
+        ``_pick_burst_len``'s capacity term."""
         for req in sorted((r for r in self._slots if r is not None),
                           key=lambda r: r.admit_seq):
             if req.slot is None:
                 continue          # evicted by an older stream this pass
+            la = max(1, min(lookahead,
+                            req.max_new_tokens - req.n_generated))
             bi = int(self._lens[req.slot]) // self.block_size
-            while bi >= len(req.blocks):
+            target = (int(self._lens[req.slot]) + la - 1) // self.block_size
+            while target >= len(req.blocks):
                 try:
-                    new = self.pool.alloc(1)
+                    new = self._alloc_blocks(1)
                 except PoolExhaustedError:
+                    if len(req.blocks) > bi:
+                        break     # next token covered; burst shrinks
                     if self._preempt_youngest() is req:
                         break     # req went back to the queue itself
                     continue
@@ -592,6 +788,7 @@ class ServeEngine:
             nxt = np.asarray(nxt)
         t1 = self._clock()
         _M_DECODE_STEPS.inc(engine=self.name)
+        _M_HOST_RT.inc(engine=self.name)
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue
@@ -606,6 +803,96 @@ class ServeEngine:
             self.tracer.on_decode_step(t0, t1,
                                        active_after=self.n_active,
                                        queued=len(self.queue))
+
+    def _pick_burst_len(self) -> int:
+        """Adaptive burst length: never cross a block boundary (the
+        scheduler allocates blocks host-side) or any stream's
+        max-length mid-burst, then round DOWN to a power of two so the
+        number of compiled scans stays bounded at one per pow2 bucket
+        (``serve.decode_traces == len(burst_lens_used)``)."""
+        n = self.decode_burst
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            cap = len(req.blocks) * self.block_size - int(
+                self._lens[slot])
+            n = min(n, cap, req.max_new_tokens - req.n_generated)
+        n = max(1, n)
+        return 1 << (n.bit_length() - 1)
+
+    def _decode_burst_once(self):
+        """One scheduler pass's worth of decode as a fused burst: N
+        decode ticks execute as ONE compiled ``lax.scan`` dispatch that
+        never leaves the chip (sampling, eos latching and length
+        advance all in-scan), then the host replays the emitted token
+        matrix through the normal finish/registration bookkeeping.
+        Per-token timestamps are the in-scan step boundaries
+        (interpolated across the dispatch window, indexed by the
+        per-slot emit counts carried out of the scan) — NOT the
+        burst-end host time, so TTFT/latency attribution matches the
+        unbursted engine to within one step."""
+        import jax
+        import jax.numpy as jnp
+
+        self._ensure_blocks(lookahead=self.decode_burst)
+        active_np = np.array([r is not None for r in self._slots], bool)
+        if not active_np.any():
+            return                # everyone was preempted away
+        n = self._pick_burst_len()
+        self.burst_lens_used.add(n)
+        # pre-split the SAME per-step key schedule the unbursted loop
+        # draws, so burst=N and burst=1 sample identical streams
+        subs = []
+        for _ in range(n):
+            self._key, sub = jax.random.split(self._key)
+            subs.append(sub)
+        t0 = self._clock()
+        with _M_DECODE_SECONDS.time(engine=self.name):
+            ys, emitted, self._caches = self._burst_fn(
+                n, self._arrays, self._caches,
+                jnp.asarray(self._tokens), jnp.asarray(self._lens),
+                jnp.asarray(active_np), jnp.asarray(self._tables),
+                jnp.asarray(self._temps), jnp.asarray(self._eos),
+                jnp.stack(subs))
+            ys = np.asarray(ys)
+            emitted = np.asarray(emitted)
+        t1 = self._clock()
+        _M_DECODE_STEPS.inc(n, engine=self.name)
+        _M_HOST_RT.inc(engine=self.name)
+        per_step = (t1 - t0) / n
+        n_emitted = 0
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            for j in range(int(emitted[slot])):
+                self._lens[slot] += 1
+                n_emitted += 1
+                self._append_token(req, int(ys[j, slot]),
+                                   now=t0 + per_step * (j + 1))
+                if req.state is FINISHED:
+                    break
+            if req.state is not FINISHED:
+                self._tokens[slot] = req.ids[-1]
+        _M_BURST_TOKENS.inc(n_emitted, engine=self.name)
+        if self.tracer is not None:
+            self.tracer.on_decode_step(t0, t1,
+                                       active_after=self.n_active,
+                                       queued=len(self.queue),
+                                       tokens=n)
+
+    def warm_burst(self, n: int):
+        """Compile the ``n``-step fused burst against idle slot state
+        (every row inactive: KV writes fence off the pool, outputs are
+        discarded) so serving traffic never pays the XLA compile."""
+        import jax
+        import jax.numpy as jnp
+
+        keys = jax.random.split(jax.random.PRNGKey(0), n)
+        _, _, self._caches = self._burst_fn(
+            int(n), self._arrays, self._caches,
+            jnp.asarray(self._tokens), jnp.asarray(self._lens),
+            jnp.zeros(self.max_slots, bool), jnp.asarray(self._tables),
+            jnp.asarray(self._temps), jnp.asarray(self._eos), keys)
 
     # -- compiled steps ----------------------------------------------------
     def _scatter_kv(self, kc, vc, k_new, v_new, safe_slot):
@@ -713,8 +1000,20 @@ class ServeEngine:
         # continuous-batching test pins at 1
         self.decode_traces += 1
         _M_DECODE_TRACES.inc(engine=self.name)
+        return self._decode_core(caches, tokens, lens, active, tables,
+                                 temps, key, arrays=arrays)
 
-        p = {**arrays, **self._static}
+    def _decode_core(self, caches, tokens, lens, active, tables, temps,
+                     key, *, arrays=None, p=None):
+        """The decode-tick math, shared VERBATIM by the single-step jit
+        and every tick of the fused burst scan — op-for-op identity is
+        what makes burst=N token-for-token equal to burst=1."""
+        import jax.numpy as jnp
+
+        from ..ops.pallas.paged_attention import paged_attention_decode
+
+        if p is None:
+            p = {**arrays, **self._static}
         b = self.max_slots
         nh = self._nh
         nb, bs = self.pool.num_blocks, self.block_size
@@ -740,13 +1039,47 @@ class ServeEngine:
         out, new_caches = self._stack_layers(p, x, rope, caches,
                                              safe_slot, attn)
         logits = _gen._head_logits(p, out).astype(jnp.float32)   # [B, V]
-
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-        sampled = jax.random.categorical(
-            key, scaled, axis=-1).astype(jnp.int32)
-        nxt = jnp.where(temps > 0.0, sampled, greedy)
+        nxt = _gen._sample_slot_tokens(logits, temps, key)
         return nxt, new_caches
+
+    def _burst_impl(self, n, arrays, caches, tokens, lens, active,
+                    tables, temps, eos_arr, keys):
+        """``n`` decode ticks as ONE ``lax.scan`` that never leaves the
+        chip: each tick runs the SAME ``_decode_core`` as the
+        single-step path (per-token sampling included, with the same
+        pre-split key schedule), then latches eos in-carry — a finished
+        row keeps scanning but its state freezes: length stops
+        advancing, its KV writes fence off the pool via the active
+        mask, and its later sampled tokens are garbage the host never
+        consumes. Per-slot emit counts ride out of the scan so the host
+        can place every token (and the eos finish) at its true in-scan
+        step boundary. ``n`` is STATIC: one trace per pow2 burst
+        length, counted by ``serve.decode_traces``."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        self.decode_traces += 1
+        _M_DECODE_TRACES.inc(engine=self.name)
+
+        p = {**arrays, **self._static}
+
+        def tick(carry, key):
+            tokens, lens, active, emitted, caches = carry
+            nxt, caches = self._decode_core(
+                caches, tokens, lens, active, tables, temps, key, p=p)
+            hit = active & (eos_arr >= 0) & (nxt == eos_arr)
+            carry = (jnp.where(active, nxt, tokens),
+                     jnp.where(active, lens + 1, lens),
+                     active & ~hit,
+                     emitted + active.astype(jnp.int32),
+                     caches)
+            return carry, nxt
+
+        emitted0 = jnp.zeros(self.max_slots, jnp.int32)
+        (_, _, _, emitted, caches), ys = lax.scan(
+            tick, (tokens, lens, active, emitted0, caches), keys,
+            length=n)
+        return ys, emitted, caches
 
     def _prefill_impl(self, arrays, caches, ids, n, table_row):
         """Prompt prefill for ONE stream: causal self-attention over
@@ -801,3 +1134,64 @@ class ServeEngine:
         h_last = jnp.take(out, n - 1, axis=0)              # [H]
         logits = _gen._head_logits(p, h_last[None, :])[0]
         return new_caches, logits.astype(jnp.float32)
+
+    def _suffix_prefill_impl(self, arrays, caches, ids, n, start,
+                             table_row):
+        """Prefill of the UNSHARED suffix only, for a stream whose
+        first ``start`` tokens were mounted from the prefix cache:
+        suffix K/V scatters into this stream's own blocks at absolute
+        positions ``start + i``, then each suffix row attends THROUGH
+        the block table (per-row lengths ``start + i + 1``) so it sees
+        the shared resident prefix it never recomputed plus the
+        just-written suffix rows — scatter precedes attention per
+        layer, exactly as in decode. ``start`` is jit data, so this
+        compiles once per pow2 suffix bucket."""
+        import jax.numpy as jnp
+
+        from ..ops.pallas.paged_attention import paged_attention_decode
+
+        self.prefill_traces += 1
+        _M_PREFILL_TRACES.inc(engine=self.name,
+                              bucket=int(ids.shape[1]))
+
+        p = {**arrays, **self._static}
+        tp = ids.shape[1]
+        nh, dh = self._nh, self._dh
+        nb, bs = self.pool.num_blocks, self.block_size
+
+        offs = jnp.arange(tp, dtype=jnp.int32)
+        positions = start + offs                           # absolute
+        valid = offs < n
+        x = jnp.take(p["embed"], ids, axis=0)[0]           # [Tp, H]
+        rope = None
+        if self._is_llama:
+            rope = self._rope_rows(positions)
+        else:
+            x = x + jnp.take(p["wpe"], positions, axis=0)
+
+        bi = jnp.clip(positions // bs, 0, self.max_blocks_per_seq - 1)
+        slot = jnp.take(table_row, bi) * bs + positions % bs
+        safe_slot = jnp.where(valid, slot, nb * bs)
+        lengths = jnp.where(valid, positions + 1, 0)       # causal
+        tables_rep = jnp.broadcast_to(
+            table_row[None, :], (tp, table_row.shape[0]))
+
+        def attn(q, _k, _v, kc, vc):
+            return paged_attention_decode(
+                q, kc, vc, lengths, tables_rep,
+                backend=self._backend).reshape(tp, nh * dh)
+
+        out, new_caches = self._stack_layers(p, x, rope, caches,
+                                             safe_slot, attn)
+        h_last = jnp.take(out, n - 1, axis=0)              # [H]
+        logits = _gen._head_logits(p, h_last[None, :])[0]
+        return new_caches, logits.astype(jnp.float32)
+
+    def _cow_impl(self, caches, src, dst):
+        """Copy-on-write: duplicate one physical block's K/V across
+        every layer into a private block, so a stream can diverge
+        inside a shared prefix block without mutating KV that other
+        streams are reading. src/dst are jit data — one trace ever."""
+        return [(kc.at[:, dst].set(kc[:, src]),
+                 vc.at[:, dst].set(vc[:, src]))
+                for kc, vc in caches]

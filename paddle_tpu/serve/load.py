@@ -89,6 +89,31 @@ def warm_engine(engine: ServeEngine, max_prompt_len=None):
         engine.run()
         if req.state != "FINISHED":   # pragma: no cover — engine contract
             raise RuntimeError("warm-up request did not finish")
+    if engine._prefix is not None:
+        # suffix-prefill buckets: a prompt that shares its first block
+        # with a resident one prefills only the suffix, which buckets
+        # through the SAME pow2 function — warm each reachable bucket
+        # by re-using one warm block and varying the suffix length
+        bs = engine.block_size
+        base = np.arange(bs) % (vocab - 1) + 1
+        engine.submit(base, max_new_tokens=1, warmup=True)
+        engine.run()
+        for n in dict.fromkeys(min(s, cap - bs) for s in lens):
+            if n < 1:
+                continue
+            suffix = (np.arange(n) + n) % (vocab - 1) + 1
+            engine.submit(np.concatenate([base, suffix]),
+                          max_new_tokens=1, warmup=True)
+            engine.run()
+        # drop the warm-up registrations so the measured run's
+        # prefix_hits/blocks_shared reflect the WORKLOAD, not warm-up
+        engine._prefix.reset(engine.pool)
+    if engine.decode_burst > 1:
+        # one compiled scan per pow2 burst length the adapter can pick
+        n = 1
+        while n <= engine.decode_burst:
+            engine.warm_burst(n)
+            n *= 2
 
 
 @dataclass
@@ -105,6 +130,17 @@ class LoadResult:
     preemptions: int
     engine_steps: int
     rejected: int = 0
+    # prefix-cache + fused-burst accounting (this run's deltas):
+    # blocks_saved == prefix_blocks_shared — every shared block is one
+    # physical block NOT duplicated and block_size prefill tokens NOT
+    # recomputed; prefill_tokens is what the engine actually prefilled
+    # (compare against a cold-cache run to see the reduction)
+    prefix_hits: int = 0
+    prefix_blocks_shared: int = 0
+    cow_copies: int = 0
+    prefill_tokens: int = 0
+    host_roundtrips: int = 0
+    burst_tokens: int = 0
     requests: List = field(default_factory=list, repr=False)
 
     def to_dict(self) -> dict:
@@ -119,6 +155,13 @@ class LoadResult:
             "preemptions": self.preemptions,
             "engine_steps": self.engine_steps,
             "rejected": self.rejected,
+            "prefix_hits": self.prefix_hits,
+            "prefix_blocks_shared": self.prefix_blocks_shared,
+            "blocks_saved": self.prefix_blocks_shared,
+            "cow_copies": self.cow_copies,
+            "prefill_tokens": self.prefill_tokens,
+            "host_roundtrips": self.host_roundtrips,
+            "burst_tokens": self.burst_tokens,
         }
 
 
@@ -127,7 +170,8 @@ def run_load(engine: ServeEngine, *, rate: float = 50.0,
              max_new=(4, 24), vocab_size: int | None = None,
              eos_token_id=None, temperature: float = 0.0,
              seed: int = 0, max_steps: int = 1_000_000,
-             clock=None) -> LoadResult:
+             clock=None, shared_prefix_tokens: int = 0,
+             shared_prefix_frac: float = 0.0) -> LoadResult:
     """Drive ``engine`` with Poisson traffic and return latency stats.
 
     Arrival times are pre-drawn (cumsum of Exp(1/rate) gaps) and each
@@ -142,6 +186,14 @@ def run_load(engine: ServeEngine, *, rate: float = 50.0,
     ``sleep(seconds)`` (default: real wall clock). Deterministic runs
     pass an ``observability.FakeClock`` — ideally the same instance
     the engine was built with, so arrivals and TTFTs share a timeline.
+
+    ``shared_prefix_tokens``/``shared_prefix_frac`` model the
+    shared-system-prompt workload: a fraction of requests prepend ONE
+    synthetic ``shared_prefix_tokens``-long prefix (drawn once per run)
+    to their random prompt. Against a prefix-cache engine, every such
+    request after the first mounts the prefix's full blocks instead of
+    re-prefilling them — the result's ``prefix_blocks_shared`` /
+    ``prefill_tokens`` quantify the saving.
     """
     clk = clock if clock is not None else _WallClock()
     if vocab_size is None:
@@ -153,12 +205,21 @@ def run_load(engine: ServeEngine, *, rate: float = 50.0,
                                               prompt_len[1] + 1))
                for _ in range(n_requests)]
     news = rng.integers(max_new[0], max_new[1] + 1, size=n_requests)
+    if shared_prefix_tokens > 0 and shared_prefix_frac > 0.0:
+        prefix = rng.integers(1, vocab_size, size=int(shared_prefix_tokens))
+        mask = rng.random(n_requests) < shared_prefix_frac
+        prompts = [np.concatenate([prefix, p]) if m else p
+                   for p, m in zip(prompts, mask)]
 
     submitted: List = []
     rejected = 0
     steps = 0
     steps0 = _metric_total("serve.decode_steps")
     preempt0 = _metric_total("serve.preemptions")
+    base = {name: _metric_total(name) for name in (
+        "serve.prefix_hits", "serve.prefix_blocks_shared",
+        "serve.cow_copies", "serve.host_roundtrips",
+        "serve.burst_tokens")}
     start = clk.time()
     i = 0
     while i < n_requests or engine.has_work:
@@ -207,6 +268,18 @@ def run_load(engine: ServeEngine, *, rate: float = 50.0,
         preemptions=_metric_total("serve.preemptions") - preempt0,
         engine_steps=_metric_total("serve.decode_steps") - steps0,
         rejected=rejected,
+        prefix_hits=_metric_total("serve.prefix_hits") - base[
+            "serve.prefix_hits"],
+        prefix_blocks_shared=_metric_total(
+            "serve.prefix_blocks_shared") - base[
+                "serve.prefix_blocks_shared"],
+        cow_copies=_metric_total("serve.cow_copies") - base[
+            "serve.cow_copies"],
+        prefill_tokens=int(sum(r.prefilled_tokens for r in submitted)),
+        host_roundtrips=_metric_total("serve.host_roundtrips") - base[
+            "serve.host_roundtrips"],
+        burst_tokens=_metric_total("serve.burst_tokens") - base[
+            "serve.burst_tokens"],
         requests=submitted,
     )
 

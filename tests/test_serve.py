@@ -75,6 +75,82 @@ class TestBlockPool:
             [1, 1, 2, 2, 3]
 
 
+class TestRefcountPool:
+    """PR 19: the pool refcounts blocks so streams can SHARE resident
+    KV (prefix cache). Three states: free, referenced (refcount >= 1),
+    cached (refcount 0 but retained for prefix reuse, evictable)."""
+
+    def test_acquire_release_refcounting(self):
+        pool = BlockPool(8, 16)
+        a = pool.alloc(2)
+        assert all(pool.refcount(b) == 1 for b in a)
+        pool.acquire(a)                  # a second stream mounts them
+        assert all(pool.refcount(b) == 2 for b in a)
+        assert pool.release(a) == []     # first stream finishes
+        assert pool.used_blocks == 2     # still referenced by stream 2
+        cached = pool.release(a, retain=a)   # last ref -> prefix cache
+        assert sorted(cached) == sorted(a)
+        assert pool.used_blocks == 0 and pool.cached_blocks == 2
+        assert all(pool.is_cached(b) for b in a)
+
+    def test_release_without_retain_frees(self):
+        pool = BlockPool(4, 16)
+        a = pool.alloc(3)
+        assert pool.release(a) == []
+        assert pool.free_blocks == 4 and pool.cached_blocks == 0
+
+    def test_refcount_underflow_is_double_free(self):
+        pool = BlockPool(4, 16)
+        a = pool.alloc(1)
+        pool.acquire(a)
+        # duplicate ids WITHIN one release must pre-validate against
+        # the refcount: 3 releases of a refcount-2 block is underflow
+        # and the call must not partially apply
+        with pytest.raises(ValueError, match="underflow"):
+            pool.release(a * 3)
+        assert pool.refcount(a[0]) == 2
+        pool.release(a * 2)              # exactly the refcount is fine
+        assert pool.free_blocks == 4
+        with pytest.raises(ValueError, match="already free"):
+            pool.release(a)
+
+    def test_acquiring_a_free_block_rejected(self):
+        pool = BlockPool(4, 16)
+        a = pool.alloc(1)
+        pool.release(a)
+        with pytest.raises(ValueError, match="unallocated"):
+            pool.acquire(a)
+        with pytest.raises(ValueError, match="outside the pool"):
+            pool.acquire([99])
+
+    def test_cached_blocks_revive_and_eviction_respects_refs(self):
+        pool = BlockPool(4, 16)
+        a = pool.alloc(2)
+        pool.release(a, retain=a)        # both -> cached
+        pool.acquire(a[:1])              # prefix hit revives one
+        assert pool.refcount(a[0]) == 1 and not pool.is_cached(a[0])
+        # eviction NEVER reclaims a referenced block
+        with pytest.raises(ValueError, match="refcount-0"):
+            pool.reclaim(a[:1])
+        pool.reclaim(a[1:])              # the still-cached one may go
+        assert pool.free_blocks == 3 and pool.cached_blocks == 0
+        pool.release(a[:1])
+        assert pool.free_blocks == 4
+
+    def test_alloc_never_hands_out_cached_blocks_implicitly(self):
+        # cached blocks hold reusable KV: alloc() draws from the free
+        # list only and reports the cached count in the exhaustion
+        # error — RECLAIMING them is the eviction policy's call
+        pool = BlockPool(4, 16)
+        a = pool.alloc(4)
+        pool.release(a, retain=a)
+        assert pool.free_blocks == 0 and pool.cached_blocks == 4
+        with pytest.raises(PoolExhaustedError, match="cached"):
+            pool.alloc(1)
+        pool.reclaim(a[:2])
+        assert pool.alloc(2)
+
+
 class TestSubmitValidation:
     def test_request_longer_than_max_seq_len_rejected(self):
         eng = ServeEngine(_model(), max_slots=2, block_size=4,
@@ -260,6 +336,242 @@ class TestEosAndSampling:
             outs.append(r.output_ids)
         assert outs[0] == outs[1], \
             "same engine seed must reproduce the sampled stream"
+
+
+class TestPrefixCacheServing:
+    """PR 19 tentpole (a): admission matches the longest resident
+    block-aligned prefix, mounts those KV blocks read-only and
+    prefills ONLY the suffix — token streams must stay byte-identical
+    to a cold cache (and to solo generate())."""
+
+    def test_shared_system_prompt_streams_match_solo(self):
+        model = _model()
+        rng = np.random.RandomState(11)
+        sysp = rng.randint(1, 97, 12)     # 3 full blocks at bs=4
+        eng = ServeEngine(model, max_slots=3, block_size=4,
+                          num_blocks=40, max_seq_len=40, name="pfx",
+                          prefix_cache=True)
+        plans = [(np.concatenate([sysp, rng.randint(1, 97, n)]), k)
+                 for n, k in [(5, 6), (3, 7), (7, 5)]]
+        reqs = [eng.submit(p, max_new_tokens=k) for p, k in plans]
+        eng.run(max_steps=2000)
+        for r, (p, k) in zip(reqs, plans):
+            assert r.output_ids == _solo(model, p, k), \
+                f"stream {r.id} diverged under prefix sharing"
+        # streams 2-3 each mounted the 3 system-prompt blocks
+        assert obs.registry.get("serve.prefix_hits").value(
+            engine="pfx") == 2
+        assert obs.registry.get("serve.prefix_blocks_shared").value(
+            engine="pfx") == 6
+        # ... and prefilled only their suffixes (the TTFT win)
+        assert sum(r.prefilled_tokens for r in reqs) == \
+            sum(len(p) for p, _ in plans) - 6 * 4
+        # at rest every reference is dropped; shared blocks stay
+        # CACHED (evictable), nothing leaks as used
+        assert eng.pool.used_blocks == 0
+        assert eng.pool.cached_blocks > 0
+        assert eng._prefix.evictable_blocks == eng.pool.cached_blocks
+
+    def test_block_aligned_full_match_cows_not_corrupts(self):
+        model = _model()
+        rng = np.random.RandomState(12)
+        p = rng.randint(1, 97, 8)         # exactly 2 blocks at bs=4
+        eng = ServeEngine(model, max_slots=2, block_size=4,
+                          num_blocks=24, max_seq_len=32, name="cow",
+                          prefix_cache=True)
+        r1 = eng.submit(p, max_new_tokens=6)
+        eng.run(max_steps=500)
+        # identical prompt, block-aligned: the last matched block is
+        # copy-on-write'd (its KV slot 8 belongs to the new stream's
+        # first generated position) — r2 must still match r1/solo
+        # (mid-prefix divergence is the property drill's job)
+        r2 = eng.submit(p.copy(), max_new_tokens=6)
+        eng.run(max_steps=500)
+        assert r1.output_ids == r2.output_ids == _solo(model, p, 6)
+        assert obs.registry.get("serve.cow_copies").value(
+            engine="cow") == 1
+        assert r2.prefilled_tokens == 1   # logits source token only
+        assert eng.pool.used_blocks == 0
+
+    def test_random_prefix_structure_identical_to_cold_cache(self):
+        # property drill: prompts assembled from a small chunk pool so
+        # arbitrary shared-prefix structure arises; the warm engine
+        # must reproduce the cold engine token-for-token
+        model = _model()
+        rng = np.random.RandomState(13)
+        chunks = [rng.randint(1, 97, 4) for _ in range(3)]
+        prompts, news = [], []
+        for _ in range(6):
+            parts = [chunks[i]
+                     for i in rng.randint(0, 3, rng.randint(1, 4))]
+            parts.append(rng.randint(1, 97, rng.randint(1, 6)))
+            prompts.append(np.concatenate(parts))
+            news.append(int(rng.randint(3, 7)))
+        outs = {}
+        for on in (False, True):
+            eng = ServeEngine(model, max_slots=3, block_size=4,
+                              num_blocks=48, max_seq_len=40,
+                              name=f"prop{int(on)}",
+                              prefix_cache=on or None)
+            reqs = [eng.submit(p, max_new_tokens=k)
+                    for p, k in zip(prompts, news)]
+            eng.run(max_steps=3000)
+            outs[on] = [r.output_ids for r in reqs]
+        assert outs[True] == outs[False], \
+            "prefix sharing must never change a token"
+        assert obs.registry.get("serve.prefix_hits").value(
+            engine="prop1") > 0
+
+    def test_eviction_under_pressure_admits_and_stays_correct(self):
+        # a pool too small to cache every finished stream's blocks:
+        # admission must evict refcount-0 cached blocks (never
+        # referenced ones) and every stream still matches solo
+        model = _model()
+        rng = np.random.RandomState(14)
+        eng = ServeEngine(model, max_slots=2, block_size=4,
+                          num_blocks=8, max_seq_len=24, name="evict",
+                          prefix_cache=True)
+        for i in range(3):
+            p = rng.randint(1, 97, 8)
+            r = eng.submit(p, max_new_tokens=5)
+            eng.run(max_steps=500)
+            assert r.output_ids == _solo(model, p, 5)
+        assert eng.pool.used_blocks == 0
+        # the cache stayed within the pool and stayed consistent
+        assert eng.pool.cached_blocks <= 8
+        assert eng._prefix.evictable_blocks == eng.pool.cached_blocks
+
+
+class TestDecodeBursts:
+    """PR 19 tentpole (b): decode_burst=N runs N decode ticks as ONE
+    compiled lax.scan dispatch (in-scan sampling, eos latch, length
+    advance). The bar is the same solo-equivalence gate, plus a
+    bounded compile budget: one trace per pow2 burst bucket."""
+
+    def test_burst_streams_match_solo_one_trace_per_bucket(self):
+        model = _model()
+        rng = np.random.RandomState(15)
+        eng = ServeEngine(model, max_slots=3, block_size=4,
+                          num_blocks=48, max_seq_len=40, name="burst",
+                          decode_burst=8)
+        plans = [(rng.randint(1, 97, n), k) for n, k in
+                 [(7, 9), (3, 12), (11, 6)]]
+        reqs = [eng.submit(p, max_new_tokens=k) for p, k in plans]
+        eng.run(max_steps=2000)
+        for r, (p, k) in zip(reqs, plans):
+            assert r.output_ids == _solo(model, p, k), \
+                f"stream {r.id} diverged under fused bursts"
+        # compile budget: exactly one scan per distinct pow2 burst
+        # length the adaptive scheduler actually picked
+        assert eng.decode_traces == len(eng.burst_lens_used)
+        assert eng.burst_lens_used <= {1, 2, 4, 8}
+        # the point of the fusion: far fewer host round-trips than
+        # generated tokens (burst=1 pays one per token)
+        rts = obs.registry.get("serve.host_roundtrips").value(
+            engine="burst")
+        toks = sum(r.n_generated for r in reqs)
+        assert 0 < rts < toks
+        assert obs.registry.get("serve.burst_tokens").value(
+            engine="burst") == toks - len(reqs)  # first tokens: prefill
+
+    def test_burst_under_pool_pressure_preempts_and_matches_solo(self):
+        model = _model()
+        rng = np.random.RandomState(1)
+        # the PR-14 preemption scenario, now at burst=8: lookahead
+        # allocation must degrade to shorter bursts (not preempt) when
+        # the pool can't fund the full window, and preemption itself
+        # must replay through the same solo-equivalent recompute path
+        eng = ServeEngine(model, max_slots=2, block_size=4,
+                          num_blocks=7, max_seq_len=28,
+                          name="burst_press", decode_burst=8)
+        plans = [(rng.randint(1, 97, n), k)
+                 for n, k in [(10, 8), (9, 7), (5, 6)]]
+        reqs = [eng.submit(p, max_new_tokens=k) for p, k in plans]
+        eng.run(max_steps=2000)
+        for r, (p, k) in zip(reqs, plans):
+            assert r.output_ids == _solo(model, p, k), \
+                f"stream {r.id} diverged after {r.preemptions} preemptions"
+        assert obs.registry.get("serve.preemptions").value(
+            engine="burst_press", reason="pool_exhausted") > 0
+        assert reqs[0].preemptions == 0
+        assert eng.pool.used_blocks == 0
+
+    def test_prefix_cache_and_bursts_compose(self):
+        model = _model()
+        rng = np.random.RandomState(17)
+        sysp = rng.randint(1, 97, 8)
+        eng = ServeEngine(model, max_slots=3, block_size=4,
+                          num_blocks=48, max_seq_len=40, name="combo",
+                          prefix_cache=True, decode_burst=4)
+        plans = [(np.concatenate([sysp, rng.randint(1, 97, n)]), k)
+                 for n, k in [(5, 8), (3, 9)]]
+        reqs = [eng.submit(p, max_new_tokens=k) for p, k in plans]
+        eng.run(max_steps=2000)
+        for r, (p, k) in zip(reqs, plans):
+            assert r.output_ids == _solo(model, p, k), \
+                f"stream {r.id} diverged with prefix+burst combined"
+        assert obs.registry.get("serve.prefix_hits").value(
+            engine="combo") == 1
+        assert obs.registry.get("serve.host_roundtrips").value(
+            engine="combo") < sum(r.n_generated for r in reqs)
+        assert eng.pool.used_blocks == 0
+
+    def test_sampled_streams_identical_across_burst_lengths(self):
+        # the burst path pre-splits the SAME per-step key schedule the
+        # unbursted loop draws, so sampling composes with fusion
+        model = _model()
+        rng = np.random.RandomState(18)
+        prompts = [rng.randint(1, 97, 6)]
+        outs = {}
+        for nb in (1, 2):
+            eng = ServeEngine(model, max_slots=2, block_size=4,
+                              num_blocks=24, max_seq_len=32, seed=11,
+                              name=f"sburst{nb}", decode_burst=nb)
+            reqs = [eng.submit(p, max_new_tokens=6, temperature=0.8)
+                    for p in prompts]
+            eng.run(max_steps=500)
+            outs[nb] = [r.output_ids for r in reqs]
+        assert outs[1] == outs[2], \
+            "burst length must not change sampled streams"
+
+    def test_burst_ttft_attribution_on_fakeclock(self):
+        # satellite 3: TTFT attribution under bursts. The first token
+        # comes from the prefill dispatch in BOTH engines and the
+        # FakeClock read sequence up to it is identical, so burst TTFT
+        # == unbursted TTFT exactly (well within the one-step bar). A
+        # stream finishing mid-burst gets the interpolated IN-SCAN
+        # step-boundary timestamp, not the burst-end host time.
+        model = _model()
+        rng = np.random.RandomState(16)
+        p = rng.randint(1, 97, 6)
+        solo = _solo(model, p, 9)
+        # an eos that first fires on a mid-burst decode tick
+        eos = next(t for i, t in enumerate(solo)
+                   if 1 <= i <= 6 and solo.index(t) == i)
+        runs = {}
+        for nb in (1, 8):
+            clk = obs.FakeClock(tick=1e-4)
+            eng = ServeEngine(model, max_slots=1, block_size=4,
+                              num_blocks=16, max_seq_len=32,
+                              name=f"bttft{nb}", decode_burst=nb,
+                              clock=clk, trace=True)
+            r = eng.submit(p, max_new_tokens=9, eos_token_id=int(eos))
+            eng.run(max_steps=200)
+            assert r.finish_reason == "eos"
+            runs[nb] = (r, eng)
+        r1, rb = runs[1][0], runs[8][0]
+        assert rb.output_ids == r1.output_ids
+        assert rb.ttft == pytest.approx(r1.ttft)
+        # the finishing token's timestamp sits at its in-scan step
+        # boundary strictly INSIDE the fused dispatch window
+        eng8 = runs[8][1]
+        burst = [s for s in eng8.tracer.decode_steps
+                 if s["tokens"] > 1][-1]
+        n_decode = len(rb.output_ids) - 1   # first token was prefill
+        per = (burst["end"] - burst["start"]) / burst["tokens"]
+        assert burst["start"] < rb.finish_time < burst["end"]
+        assert rb.finish_time == pytest.approx(
+            burst["start"] + per * n_decode)
 
 
 class TestLoadGenerator:

@@ -205,6 +205,11 @@ SERVE_REQUIRED_LABELS = {
     "serve.request_seconds": ("engine",),
     "serve.decode_step_seconds": ("engine",),
     "serve.prefill_seconds": ("engine",),
+    "serve.prefix_hits": ("engine",),
+    "serve.prefix_blocks_shared": ("engine",),
+    "serve.cow_copies": ("engine",),
+    "serve.burst_tokens": ("engine",),
+    "serve.host_roundtrips": ("engine",),
 }
 
 #: request-tracing / SLO label discipline (observability/tracing.py +

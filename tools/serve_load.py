@@ -67,6 +67,22 @@ def main(argv=None) -> int:
     ap.add_argument("--temperature", type=float, default=0.0,
                     help="0 = greedy; >0 samples every stream")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="enable cross-request KV prefix sharing "
+                         "(PADDLE_TPU_PREFIX_CACHE)")
+    ap.add_argument("--decode-burst", type=int, default=1,
+                    help="fuse up to N decode steps into one on-chip "
+                         "scan dispatch (PADDLE_TPU_DECODE_BURST; "
+                         "default 1 = one round-trip per token)")
+    ap.add_argument("--shared-prefix-tokens", type=int, default=0,
+                    metavar="N",
+                    help="prepend one synthetic N-token system prompt "
+                         "to a fraction of requests (the prefix-cache "
+                         "workload); report blocks-saved in the record")
+    ap.add_argument("--shared-prefix-frac", type=float, default=0.0,
+                    metavar="P",
+                    help="fraction of requests sharing the synthetic "
+                         "system prompt (0.0 .. 1.0)")
     ap.add_argument("--metrics", action="store_true",
                     help="enable observability and print the serve_* "
                          "roll-up keys (bench.py --metrics parity)")
@@ -118,22 +134,33 @@ def main(argv=None) -> int:
     if on_tpu:
         model.bfloat16()
     model.eval()
+    if args.shared_prefix_frac and not 0.0 <= args.shared_prefix_frac <= 1.0:
+        ap.error(f"--shared-prefix-frac must be in [0, 1], got "
+                 f"{args.shared_prefix_frac}")
     engine = ServeEngine(model, max_slots=slots, block_size=block_size,
                          num_blocks=num_blocks, max_seq_len=max_seq_len,
                          name="serve_load",
                          trace=bool(args.trace_out) or None,
-                         slo=args.slo)
-    warm_engine(engine)     # decode step + every prefill bucket
+                         slo=args.slo,
+                         prefix_cache=args.prefix_cache or None,
+                         decode_burst=args.decode_burst)
+    warm_engine(engine)     # decode + burst scans + every prefill bucket
 
     res = run_load(engine, rate=rate, n_requests=n_req, prompt_len=plen,
                    max_new=mnew, temperature=args.temperature,
-                   seed=args.seed)
+                   seed=args.seed,
+                   shared_prefix_tokens=args.shared_prefix_tokens,
+                   shared_prefix_frac=args.shared_prefix_frac)
     record = {"load": res.to_dict()}
     record["load"].update(
         rate_rps=rate, slots=slots, num_blocks=num_blocks,
         block_size=block_size, decode_traces=engine.decode_traces,
         prefill_traces=engine.prefill_traces,
-        pool_blocks_leaked=engine.pool.used_blocks)
+        pool_blocks_leaked=engine.pool.used_blocks,
+        prefix_cache=bool(args.prefix_cache),
+        decode_burst=args.decode_burst,
+        shared_prefix_tokens=args.shared_prefix_tokens,
+        shared_prefix_frac=args.shared_prefix_frac)
     if engine.slo is not None:
         record["load"]["slo_breaches"] = list(engine.slo.breaches)
     if args.trace_out:
