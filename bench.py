@@ -37,6 +37,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -219,6 +220,10 @@ def _emit_metrics_block():
         "opprof_steps_profiled": tot("opprof.steps_profiled"),
         "opprof_attributed_pct": gauge_max("opprof.attributed_pct"),
         "opprof_overhead_pct": gauge_max("opprof.overhead_pct"),
+        # continuous-health roll-ups (observability/health.py +
+        # timeseries.py; populated when PADDLE_TPU_HEALTH is set)
+        "health_alerts": tot("health.alerts"),
+        "ts_points_recorded": tot("ts.points_recorded"),
     }}), flush=True)
 
 
@@ -1262,7 +1267,8 @@ def _run_isolated(config: str, args) -> int:
         cmd += ["--opprof"]
     if args.metrics:
         cmd += ["--metrics"]
-    proc = subprocess.run(cmd)
+    env = dict(os.environ, PADDLE_TPU_BENCH_CHILD="1")
+    proc = subprocess.run(cmd, env=env)
     if proc.returncode != 0:
         print(f"bench config {config!r} FAILED rc={proc.returncode}",
               file=sys.stderr, flush=True)
@@ -1347,6 +1353,35 @@ def main():
 
     if args.metrics:
         _emit_metrics_block()
+        _maybe_run_bench_compare()
+
+
+def _maybe_run_bench_compare():
+    """Non-fatal regression check over the two newest BENCH_r*.json
+    records (tools/bench_compare.py). Skipped inside the per-config
+    subprocesses of --config all (the parent already isolated us) so
+    the comparison prints once per invocation, and never changes the
+    bench exit code — CI gates by running the tool directly."""
+    if os.environ.get("PADDLE_TPU_BENCH_CHILD"):
+        return
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import bench_compare
+
+        records = bench_compare.latest_bench_records(
+            os.path.dirname(os.path.abspath(__file__)))
+        if len(records) < 2:
+            return
+        rows = bench_compare.compare_docs(
+            bench_compare._load(records[-2]),
+            bench_compare._load(records[-1]))
+        print(f"bench_compare ({os.path.basename(records[-2])} -> "
+              f"{os.path.basename(records[-1])}):", flush=True)
+        print(bench_compare.render_rows(
+            rows, bench_compare.DEFAULT_NOISE_PCT), flush=True)
+    except Exception as e:  # telemetry must never fail the bench
+        print(f"bench_compare skipped: {e}", file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":

@@ -185,3 +185,8 @@ define_flag("use_pallas_rms_norm", True,
 define_flag("pallas_force_interpret", False,
             "run Pallas kernels in interpret mode on non-TPU backends "
             "(testing only — the interpreter is orders slower than XLA)")
+define_flag("observability_ts_points", 512,
+            "ring-buffer capacity per metric time-series (points kept by "
+            "observability/timeseries.SeriesRecorder; oldest samples drop "
+            "first — bounds health-monitoring memory no matter how long "
+            "the job runs)")

@@ -53,6 +53,7 @@ from .launch_utils import ELASTIC_PEER_EXIT
 __all__ = [
     "global_mesh", "shard_batch", "replicate", "chaos_config",
     "maybe_chaos_kill", "chaos_slow_config", "maybe_chaos_slow",
+    "chaos_creep_config", "maybe_chaos_creep",
     "CheckpointManager", "run_elastic", "ElasticRunResult",
 ]
 
@@ -138,6 +139,31 @@ def maybe_chaos_slow(step: int, rank: int):
     cfg = chaos_slow_config()
     if cfg is not None and rank == cfg[0]:
         time.sleep(cfg[1])
+
+
+def chaos_creep_config() -> Optional[Tuple[int, float, float]]:
+    """(creep_rank, pct_per_step, base_seconds) from the environment,
+    or None when creeping-slowdown injection is off."""
+    r = os.environ.get("PADDLE_TPU_CHAOS_CREEP_RANK")
+    p = os.environ.get("PADDLE_TPU_CHAOS_CREEP_PCT")
+    if r is None or p is None:
+        return None
+    b = float(os.environ.get("PADDLE_TPU_CHAOS_CREEP_BASE", "0.05"))
+    return int(r), float(p), b
+
+
+def maybe_chaos_creep(step: int, rank: int):
+    """Creeping-slowdown injection: unlike the constant straggler
+    above, the chosen rank gets ``pct`` percent of ``base`` seconds
+    SLOWER each step (``sleep = base * pct/100 * step``) — a gradual
+    degradation (thermal throttling, a filling disk, a leaking input
+    pipeline) that a constant threshold never trips but the health
+    monitor's PTL601 drift detector must (tools/chaos_launch.py
+    --creep_rank)."""
+    cfg = chaos_creep_config()
+    if cfg is not None and rank == cfg[0]:
+        _, pct, base = cfg
+        time.sleep(base * (pct / 100.0) * step)
 
 
 # -- checkpoint schedule -------------------------------------------------
@@ -447,6 +473,7 @@ def run_elastic(build_state: Callable[[Mesh], Dict[str, Any]],
             with _obs.step_region("elastic_train", step=step,
                                   rank=rank, generation=generation):
                 maybe_chaos_slow(step, rank)
+                maybe_chaos_creep(step, rank)
                 loss = float(train_step(state, step, mesh))
             losses.append((step, loss))
             progress_box["step"] = step

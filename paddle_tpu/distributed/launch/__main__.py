@@ -51,6 +51,15 @@ def main():
     p.add_argument("--chaos_slow_seconds", type=float, default=None,
                    help="extra host-side seconds per step for the slow "
                         "rank")
+    p.add_argument("--chaos_creep_rank", type=int, default=None,
+                   help="creeping-slowdown injection: this worker rank "
+                        "gets --chaos_creep_pct percent of the base "
+                        "sleep SLOWER each step (health-monitor drill; "
+                        "see tools/chaos_launch.py --creep_rank)")
+    p.add_argument("--chaos_creep_pct", type=float, default=None,
+                   help="per-step slowdown growth, percent of the base "
+                        "sleep (PADDLE_TPU_CHAOS_CREEP_BASE, default "
+                        "0.05s)")
     p.add_argument("--devices", "--gpus", type=str, default=None,
                    help="accepted for parity; chips are mesh-addressed")
     p.add_argument("--nproc_per_node", type=int, default=None,
@@ -68,6 +77,10 @@ def main():
         os.environ["PADDLE_TPU_CHAOS_SLOW_RANK"] = str(a.chaos_slow_rank)
         os.environ["PADDLE_TPU_CHAOS_SLOW_SECONDS"] = \
             str(a.chaos_slow_seconds)
+    if a.chaos_creep_rank is not None and a.chaos_creep_pct is not None:
+        os.environ["PADDLE_TPU_CHAOS_CREEP_RANK"] = \
+            str(a.chaos_creep_rank)
+        os.environ["PADDLE_TPU_CHAOS_CREEP_PCT"] = str(a.chaos_creep_pct)
 
     if ":" in a.nnodes:
         # elastic mode: supervise relaunches within the np range.

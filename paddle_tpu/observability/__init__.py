@@ -48,7 +48,8 @@ from ._gate import state
 from .metrics import (CLAIMED_SUBSYSTEMS, Counter, Gauge, Histogram,
                       MetricsRegistry, NAME_RE, registry)
 from .events import Event, emit, events, span
-from .report import (dump, dump_dict, render_flight, render_report,
+from .report import (dump, dump_dict, render_flight, render_health,
+                     render_report, render_trend_table, sparkline,
                      summary)
 from . import flight
 from .flight import FlightRecorder
@@ -59,6 +60,10 @@ from .runtime import (FakeClock, StepTimer, default_peak_flops,
                       step_region)
 from . import slo
 from .slo import SloMonitor, SloRule
+from . import timeseries
+from .timeseries import SeriesRecorder, merge_timeseries
+from . import health
+from .health import HealthMonitor, HealthRule
 from . import tracing
 from .tracing import (RequestTrace, ServeTracer, Span, TailExemplars,
                       check_tracing_overhead, validate_trace)
@@ -76,11 +81,14 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "Event", "emit", "events", "span",
     "dump", "dump_dict", "render_report", "render_flight", "summary",
+    "render_health", "render_trend_table", "sparkline",
     "CLAIMED_SUBSYSTEMS", "NAME_RE",
     "flight", "FlightRecorder", "fleet", "FleetAggregator",
     "FleetReporter", "StepTimer", "step_region", "FakeClock",
     "sample_device_memory", "measure_step_flops", "default_peak_flops",
     "slo", "SloMonitor", "SloRule",
+    "timeseries", "SeriesRecorder", "merge_timeseries",
+    "health", "HealthMonitor", "HealthRule",
     "tracing", "Span", "RequestTrace", "ServeTracer", "TailExemplars",
     "check_tracing_overhead", "validate_trace",
     "chrome", "opprof", "OpSpan", "OpProfile", "OpProfiler",
@@ -132,6 +140,7 @@ def reset():
     _clear_events()
     flight.recorder.clear()
     _clear_watermarks()
+    health._reset_active()
     for fn in _reset_hooks:
         fn()
 
@@ -152,6 +161,10 @@ def _init_from_env():
         # as PADDLE_TPU_METRICS_DUMP) and arms the excepthook
         state.on = True
         flight.install_excepthook()
+    if health.monitor_from_env() is not None:
+        # PADDLE_TPU_HEALTH implies recording: detectors read the
+        # registry, which only fills while the gate is on
+        state.on = True
 
 
 _init_from_env()

@@ -55,6 +55,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import flight
 from .events import events as _list_events
 from .metrics import registry
+from .timeseries import merge_timeseries
 
 __all__ = [
     "FLEET_ENV", "FLEET_INTERVAL_ENV", "FLEET_POLL_ENV",
@@ -167,6 +168,9 @@ def snapshot_dict(rank: int, world: int, *, generation: int = 0,
         events = [e.to_dict() for e in _list_events()[-max_events:]]
     else:
         events = list(events)[-max_events:]
+    from . import health
+    mon = health.active_monitor()
+    timeseries = mon.recorder.to_dict() if mon is not None else None
     return {
         "kind": SNAPSHOT_KIND,
         "version": FLEET_VERSION,
@@ -181,6 +185,7 @@ def snapshot_dict(rank: int, world: int, *, generation: int = 0,
         "final": bool(final),
         "metrics": reg.to_dict(),
         "events": events,
+        "timeseries": timeseries,
     }
 
 
@@ -752,6 +757,7 @@ class FleetAggregator:
             "stragglers": sorted(self._flagged),
             "metrics": self.merged_metrics(),
             "events": list(self.events),
+            "timeseries": merge_timeseries(list(self.snapshots.values())),
         }
 
     def finalize(self) -> Dict[str, str]:

@@ -47,10 +47,15 @@ FLIGHT_VERSION = 1
 #: - ``slo_breach``: a serving SLO rule left its bound
 #:   (observability/slo.py SloMonitor); the dump context carries the
 #:   rule, the offending value and the tail-exemplar span trees.
+#: - ``health_alert``: a continuous-health detector fired
+#:   (observability/health.py HealthMonitor); the dump context carries
+#:   the rule, its PTL6xx code, and the offending series window so the
+#:   post-mortem shows the drift/leak trajectory, not just the trip.
 REASON_PEER_DEATH = "peer_death"
 REASON_REJOIN = "rejoin"
 REASON_STRAGGLER = "straggler"
 REASON_SLO_BREACH = "slo_breach"
+REASON_HEALTH_ALERT = "health_alert"
 
 #: ring capacity; read once from core.flags at first record so the flag
 #: can be set before any event lands (same pattern as events._buffer).

@@ -63,6 +63,12 @@ CLAIMED_SUBSYSTEMS = {
                    # profiler: per-op measured seconds, attribution
                    # coverage, measured/predicted drift, pacer skips,
                    # profiling overhead guard
+    "ts",          # observability/timeseries.py — metric time-series
+                   # recorder self-metrics (points recorded, series
+                   # evicted)
+    "health",      # observability/health.py — continuous-health
+                   # detectors: latched alerts by rule/series,
+                   # detector evaluations
     "test",        # scratch names registered by the test suite
 }
 

@@ -28,13 +28,22 @@ DUMP_VERSION = 1
 
 
 def dump_dict() -> Dict[str, Any]:
-    return {
+    d = {
         "version": DUMP_VERSION,
         "generated_unix": time.time(),
         "enabled": _gate.state.on,
         "metrics": registry.to_dict(),
         "events": [e.to_dict() for e in _list_events()],
     }
+    from . import health as _health
+
+    mon = _health.active_monitor()
+    if mon is not None:
+        # only when health monitoring is on — an unmonitored process
+        # dumps byte-identical documents (solo equivalence)
+        d["timeseries"] = mon.recorder.to_dict()
+        d["health_alerts"] = list(mon.alerts)
+    return d
 
 
 def dump(path: Optional[str] = None) -> Dict[str, Any]:
@@ -325,6 +334,124 @@ def render_comm_table(metrics: Dict[str, Any]) -> List[str]:
     return lines
 
 
+#: eight-level block ramp for unicode sparklines.
+SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[float], width: int = 32) -> str:
+    """Render a numeric series as a unicode sparkline (empty string for
+    an empty series). Series longer than ``width`` are mean-downsampled
+    so the whole window fits one glance."""
+    vals = [_as_num(v) for v in values]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        n = len(vals)
+        vals = [sum(vals[i * n // width:(i + 1) * n // width])
+                / max(1, (i + 1) * n // width - i * n // width)
+                for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return SPARK_CHARS[0] * len(vals)
+    scale = (len(SPARK_CHARS) - 1) / (hi - lo)
+    return "".join(SPARK_CHARS[int((v - lo) * scale)] for v in vals)
+
+
+def render_trend_table(series: Dict[str, List],
+                       title: str = "Time-series") -> List[str]:
+    """Trend table over ``{name: [[t, v], ...]}`` windows: point count,
+    first/last values, total change, and the sparkline."""
+    if not series:
+        return []
+    header = (f"{title:<40}{'Pts':>6}{'First':>12}{'Last':>12}"
+              f"{'Change':>9}  Trend")
+    lines = [header, "-" * len(header)]
+    for name in sorted(series):
+        points = series[name] or []
+        vals = [p[1] for p in points if isinstance(p, (list, tuple))
+                and len(p) == 2]
+        if not vals:
+            continue
+        # quantile series ("train.step_seconds.p90") format like their
+        # parent metric
+        fmt = _value_formatter(name.rsplit(".p", 1)[0]
+                               if name.rpartition(".p")[2].isdigit()
+                               else name)
+        first, last = _as_num(vals[0]), _as_num(vals[-1])
+        change = (f"{100.0 * (last - first) / abs(first):+.1f}%"
+                  if first else "-")
+        lines.append(f"{name[:40]:<40}{len(vals):>6}"
+                     f"{fmt(first):>12}{fmt(last):>12}{change:>9}  "
+                     f"{sparkline(vals)}")
+    return lines
+
+
+def render_health(d: Dict[str, Any]) -> str:
+    """Health view of a dump: the recorded time-series as trend tables
+    plus any alerts. Accepts a metrics dump carrying ``timeseries``
+    (``PADDLE_TPU_HEALTH`` runs), a ``health_alert`` flight dump (the
+    offending window rides the context), or a fleet dump whose
+    ``timeseries`` holds per-rank lanes."""
+    from .flight import FLIGHT_DUMP_KIND
+
+    lines: List[str] = []
+    if isinstance(d, dict) and d.get("kind") == FLIGHT_DUMP_KIND:
+        ctx = d.get("context") or {}
+        lines.append(f"HEALTH ALERT — rule {ctx.get('rule', '?')!r} "
+                     f"({ctx.get('code', '?')}) on series "
+                     f"{ctx.get('series', '?')!r}")
+        detail = " ".join(
+            f"{k}={v}" for k, v in sorted(ctx.items())
+            if k not in ("window", "rule", "code", "series"))
+        if detail:
+            lines.append(detail)
+        window = ctx.get("window") or []
+        if window:
+            lines += [""] + render_trend_table(
+                {str(ctx.get("series", "series")): window},
+                title="Offending window")
+        return "\n".join(lines) if lines else "(no health context)"
+
+    ts = (d or {}).get("timeseries") or {}
+    series = ts.get("series")
+    if series is not None:                       # per-process dump
+        lines += render_trend_table(series)
+    else:                                        # fleet dump: rank lanes
+        lanes_by_name = {
+            f"{name} [rank {rank}]": points
+            for name, doc in ts.items()
+            for rank, points in sorted((doc.get("lanes") or {}).items())
+        }
+        lines += render_trend_table(lanes_by_name,
+                                    title="Time-series (per-rank lanes)")
+    alerts = (d or {}).get("health_alerts") or []
+    if alerts:
+        if lines:
+            lines.append("")
+        lines.append(f"Alerts ({len(alerts)})")
+        lines.append("-" * (_WIDTH + 14))
+        for a in alerts:
+            lines.append(
+                f"{a.get('code', '?')} {a.get('rule', '?')} on "
+                f"{a.get('series', '?')}: "
+                + " ".join(f"{k}={v}" for k, v in sorted(a.items())
+                           if k not in ("code", "rule", "series")))
+    ham = (d or {}).get("metrics", {}).get("health.alerts", {})
+    rows = ham.get("series") or []
+    if rows:
+        if lines:
+            lines.append("")
+        lines.append("health.alerts")
+        lines.append("-" * (_WIDTH + 14))
+        for s in rows:
+            lines.append(f"{_fmt_labels(s.get('labels', {})):<{_WIDTH}}"
+                         f"{s.get('value', 0):>14}")
+    if not lines:
+        return ("(no time-series recorded — set PADDLE_TPU_HEALTH=1 "
+                "or install a HealthMonitor)")
+    return "\n".join(lines)
+
+
 def _render_events(evs: List[Dict[str, Any]], max_events: int) -> List[str]:
     if not evs or max_events <= 0:
         return []
@@ -358,11 +485,12 @@ def render_flight(d: Dict[str, Any], max_events: int = 50,
                                                           0))))]
     ctx = d.get("context")
     if ctx:
-        # the exemplars payload (slo_breach dumps) is a span-tree bundle,
-        # not a scalar — rendered as its own block below the header
+        # the exemplars payload (slo_breach dumps) is a span-tree bundle
+        # and the window payload (health_alert dumps) is a point list,
+        # not scalars — both render as their own blocks below the header
         lines.append("context: " + "  ".join(
             f"{k}={v}" for k, v in sorted(ctx.items())
-            if k != "exemplars"))
+            if k not in ("exemplars", "window")))
     # elastic-training post-mortems get a one-line interpretation so an
     # operator triaging a directory of per-worker dumps reads the story
     # without knowing the reason vocabulary
@@ -395,6 +523,16 @@ def render_flight(d: Dict[str, Any], max_events: int = 50,
             t.worst_ttft = list(ex.get("worst_ttft") or [])
             t.worst_latency = list(ex.get("worst_latency") or [])
             lines += ["", t.render()]
+    elif reason == "health_alert":
+        lines.append(
+            "(a continuous-health detector latched — the context names "
+            "the rule/series/code and the offending series window below "
+            "shows the drift/leak trajectory that tripped it)")
+        window = (ctx or {}).get("window")
+        if window:
+            lines += [""] + render_trend_table(
+                {str((ctx or {}).get("series", "series")): window},
+                title="Offending window")
     mem = d.get("device_memory")
     if mem:
         lines.append(

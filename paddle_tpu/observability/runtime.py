@@ -249,6 +249,8 @@ class _StepRegion:
         emit("train.step", **ev)
         if self.sample_memory:
             sample_device_memory()
+        from . import health
+        health.maybe_on_step(self._clock())
         return False
 
 

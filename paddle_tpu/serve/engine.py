@@ -446,6 +446,7 @@ class ServeEngine:
             self.slo.on_step(tokens=self._n_tokens - tok0,
                              preemptions=self._n_preempts - pre0,
                              now=self._clock())
+        obs.health.maybe_on_step(self._clock())
         return n_active
 
     def _live_requests(self):

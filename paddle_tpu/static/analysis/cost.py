@@ -393,6 +393,44 @@ def _compute_divisor(spec) -> int:
     return max(div, 1)
 
 
+#: matmul-family prims whose contraction can split across a mesh axis.
+_CONTRACTION_PRIMS = frozenset(
+    ("matmul", "linear_nobias_p", "linear_p", "bmm",
+     "matmul_p", "bmm_p"))
+
+
+def _contraction_divisor(prim_name, attrs, in_specs, out_specs) -> int:
+    """Extra per-chip compute credit for contraction splits whose
+    COMPLETED output replicates: both matmul operands shard their
+    contracting dims on a mesh axis, so each chip does 1/n of the
+    multiply-adds — but once the psum materializes the output as
+    Replicate (the ``contract8`` bench geometry), ``_compute_divisor``
+    sees nothing to divide and the plan reads n-times pessimistic.
+    Mesh axes the output DOES count (Shard or Partial there) are
+    skipped: those already divide via ``_compute_divisor``, and
+    crediting them twice would halve row-parallel plans again."""
+    if prim_name not in _CONTRACTION_PRIMS or len(in_specs) < 2:
+        return 1
+    x, w = in_specs[0], in_specs[1]
+    if x is None or w is None:
+        return 1
+    from .sharding_lint import matmul_contracting_dims
+
+    x_c, w_c = matmul_contracting_dims(attrs, x.ndim, w.ndim)
+    div = 1
+    for axis, px in enumerate(x.placements):
+        pw = w.placements[axis] if axis < len(w.placements) else None
+        if pw is None or not (px.is_shard(x_c) and pw.is_shard(w_c)):
+            continue
+        if any(o is not None and axis < len(o.placements)
+               and (o.placements[axis].is_shard()
+                    or o.placements[axis].is_partial())
+               for o in out_specs):
+            continue
+        div *= int(x.mesh.shape[axis])
+    return max(div, 1)
+
+
 def program_cost(program, fetch=None, *, placements=None, mesh=None,
                  avals: Optional[Dict[int, Aval]] = None,
                  params=None, op_calibration=None) -> ProgramCost:
@@ -544,8 +582,12 @@ def _program_cost(program, fetch, placements, avals) -> ProgramCost:
             c = op_cost(prim_name, [aval_of(v) for v in in_vids],
                         [aval_of(v) for v in out_vids], attrs)
             if placements:
-                out_div = max((_compute_divisor(placements.get(v))
-                               for v in out_vids), default=1)
+                out_specs = [placements.get(v) for v in out_vids]
+                out_div = max((_compute_divisor(s) for s in out_specs),
+                              default=1)
+                out_div *= _contraction_divisor(
+                    prim_name, attrs,
+                    [placements.get(v) for v in in_vids], out_specs)
                 c = OpCost(
                     flops=c.flops // out_div,
                     bytes_read=sum(sharded_nbytes(v) for v in in_vids),

@@ -23,6 +23,9 @@ Code namespace (``PTLxxx``):
 - ``PTL5xx`` — execution profiling (`observability/opprof.py`): per-op
   measured-vs-predicted drift, attribution shortfall, profiling
   overhead — the measured half of the PTL3xx cost model.
+- ``PTL6xx`` — continuous health monitoring (`observability/health.py`,
+  `tools/bench_compare.py`): time-series anomaly detectors (perf drift,
+  resource leaks, throughput degradation) and BENCH regression gating.
 """
 from __future__ import annotations
 
@@ -119,6 +122,26 @@ CODES = {
     "PTL503": "profiling overhead exceeded: steps/sec with op profiling "
               "enabled fell more than the budget below the unprofiled "
               "run (the PTL402 analog for the training plane)",
+    # continuous-health diagnostics (PTL6xx) — detectors evaluated over
+    # metric time-series (observability/health.py) plus the BENCH
+    # record comparator (tools/bench_compare.py)
+    "PTL601": "perf drift: a step-time series drifted beyond the "
+              "z-score/relative-change gate against its own baseline "
+              "window (the continuous form of PTL302 — no model needed, "
+              "the job is compared against its younger self)",
+    "PTL602": "resource leak: a watermark/occupancy series grows "
+              "monotonically across the observation window (HBM "
+              "watermark, KV-pool occupancy, host-side ring sizes) — "
+              "the job will eventually OOM or thrash",
+    "PTL603": "throughput degradation: a rate series (tokens/sec, or a "
+              "failure counter's rate-of-change) left its healthy band "
+              "— serving slowdown or elastic/fleet instability",
+    "PTL604": "detector input malformed: a health rule's series is "
+              "missing, non-numeric, or non-finite — the detector "
+              "cannot evaluate and says so instead of staying silent",
+    "PTL605": "regression vs baseline: a benchmark config's headline "
+              "metric moved beyond the noise band against the previous "
+              "BENCH record (tools/bench_compare.py CI gate)",
 }
 
 

@@ -210,6 +210,52 @@ class TestProgramCost:
         assert dense_mem.peak_bytes - mem.peak_bytes == \
             (4 * 8 * 4 + 8 * 8 * 4) * 3 // 4
 
+    def test_contraction_split_replicated_output_divides_compute(self):
+        # a BOTH-sides contraction split whose completed output
+        # REPLICATES the split axis (contract8 geometry): the psum
+        # already happened upstream of the placement table, so each
+        # chip only ever multiplied its 1/N slice of the inner
+        # dimension — per-chip FLOPs must divide by the mesh axis
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [16, 64], "float32")
+            w = paddle.to_tensor(np.ones((64, 32), "float32"))
+            out = paddle.matmul(x, w).sum()
+        mesh = ProcessMesh([0, 1, 2, 3], dim_names=["mp"])
+        xv, wv = prog._feed_names["x"], prog.vid_of(w)
+        mm_out = prog._insts[0][3][0]
+        placements = {
+            xv: DistTensorSpec([16, 64], mesh, [Shard(1)]),
+            wv: DistTensorSpec([64, 32], mesh, [Shard(0)]),
+            mm_out: DistTensorSpec([16, 32], mesh, [Replicate()]),
+        }
+        dense = program_cost(prog, [out])
+        sharded = program_cost(prog, [out], placements=placements)
+        assert sharded.flops_by_prim["matmul"] == \
+            dense.flops_by_prim["matmul"] // 4
+
+    def test_one_sided_contraction_shard_keeps_full_compute(self):
+        # only x shards the contracting dim; w is replicated, so the
+        # partitioner all-gathers x and every chip runs the full
+        # matmul — no contraction credit
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [16, 64], "float32")
+            w = paddle.to_tensor(np.ones((64, 32), "float32"))
+            out = paddle.matmul(x, w).sum()
+        mesh = ProcessMesh([0, 1, 2, 3], dim_names=["mp"])
+        xv, wv = prog._feed_names["x"], prog.vid_of(w)
+        mm_out = prog._insts[0][3][0]
+        placements = {
+            xv: DistTensorSpec([16, 64], mesh, [Shard(1)]),
+            wv: DistTensorSpec([64, 32], mesh, [Replicate()]),
+            mm_out: DistTensorSpec([16, 32], mesh, [Replicate()]),
+        }
+        dense = program_cost(prog, [out])
+        sharded = program_cost(prog, [out], placements=placements)
+        assert sharded.flops_by_prim["matmul"] == \
+            dense.flops_by_prim["matmul"]
+
     def test_sharded_placements_divide_the_footprint(self):
         prog = static.Program()
         with static.program_guard(prog):

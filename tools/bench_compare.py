@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""Compare two BENCH records and gate CI on perf regressions (PTL605).
+
+The repo's BENCH_r*.json records (and any ``python bench.py`` stdout)
+carry one machine-readable line per benchmark config::
+
+    {"metric": "resnet50 25M ... images/sec/chip (...)", "value": 330.2,
+     "unit": "images/sec/chip", "vs_baseline": 1.05}
+
+This tool extracts those lines from a *baseline* and a *current*
+record, matches configs by the metric's leading word (``resnet50``,
+``bert-base``, ``sdxl-unet``, ...), derives the goodness direction from
+the unit (``ms/step`` lower-is-better, ``*/sec*`` higher-is-better),
+and compares the per-config delta against a noise band. A config whose
+headline metric moved beyond the band *in the bad direction* files a
+PTL605 diagnostic and the process exits nonzero — turning the
+flat-since-r03 BENCH trajectory into an enforced gate instead of a
+directory of unread JSON.
+
+Usage:
+    python tools/bench_compare.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_compare.py --noise-pct 3 old.json new.json
+    python tools/bench_compare.py --latest      # two newest BENCH_r*.json
+    python tools/bench_compare.py --json ...    # machine-readable output
+
+Exit codes: 0 = clean (including a missing/empty baseline — a first
+record has nothing to regress against), 1 = at least one regression,
+2 = usage/input error.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+#: default noise band, percent: smaller moves are run-to-run jitter.
+DEFAULT_NOISE_PCT = 5.0
+
+_METRIC_LINE_RE = re.compile(r'^\{"metric":.*\}\s*$', re.MULTILINE)
+
+
+def _direction(unit: str) -> str:
+    """'higher' or 'lower' is better, from the unit string."""
+    u = (unit or "").lower()
+    if "/sec" in u or "per_sec" in u or "mfu" in u:
+        return "higher"
+    return "lower"  # ms/step, seconds, bytes, ...
+
+
+def extract_results(doc) -> Dict[str, Dict[str, Any]]:
+    """Per-config benchmark results from a BENCH record.
+
+    Accepts a BENCH_r*.json dict (metric lines ride the ``tail`` text),
+    raw ``bench.py`` stdout text, or an already-extracted list of
+    ``{"metric", "value", "unit"}`` dicts. Returns ``{config: row}``
+    keyed by the metric string's first word; a config appearing twice
+    keeps the LAST line (reruns supersede)."""
+    rows: List[Dict[str, Any]] = []
+    if isinstance(doc, dict) and "metric" in doc:
+        rows = [doc]
+    elif isinstance(doc, list):
+        rows = [r for r in doc if isinstance(r, dict) and "metric" in r]
+    else:
+        text = doc.get("tail", "") if isinstance(doc, dict) else str(doc)
+        for line in _METRIC_LINE_RE.findall(text):
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(d, dict) and "metric" in d and "value" in d:
+                rows.append(d)
+    out: Dict[str, Dict[str, Any]] = {}
+    for r in rows:
+        name = str(r.get("metric", "")).split()
+        if not name:
+            continue
+        try:
+            value = float(r["value"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        out[name[0]] = {"metric": r["metric"], "value": value,
+                        "unit": str(r.get("unit", ""))}
+    return out
+
+
+def compare_docs(baseline, current, *,
+                 noise_pct: float = DEFAULT_NOISE_PCT
+                 ) -> List[Dict[str, Any]]:
+    """Per-config comparison rows, sorted worst-first.
+
+    Each row: ``config``, ``unit``, ``direction``, ``baseline``,
+    ``current``, ``delta_pct`` (sign: positive = metric went up),
+    ``status`` in {"ok", "regressed", "improved", "new", "dropped"}.
+    ``delta_pct`` is None for new/dropped configs."""
+    base = extract_results(baseline)
+    cur = extract_results(current)
+    out: List[Dict[str, Any]] = []
+    for config in sorted(set(base) | set(cur)):
+        b, c = base.get(config), cur.get(config)
+        row: Dict[str, Any] = {
+            "config": config,
+            "unit": (c or b or {}).get("unit", ""),
+            "direction": _direction((c or b or {}).get("unit", "")),
+            "baseline": b["value"] if b else None,
+            "current": c["value"] if c else None,
+            "delta_pct": None,
+        }
+        if b is None:
+            row["status"] = "new"
+        elif c is None:
+            row["status"] = "dropped"
+        elif b["value"] == 0:
+            row["status"] = "ok"  # nothing sane to divide by
+        else:
+            delta = 100.0 * (c["value"] - b["value"]) / abs(b["value"])
+            row["delta_pct"] = round(delta, 3)
+            bad = -delta if row["direction"] == "higher" else delta
+            good = -bad
+            if bad > noise_pct:
+                row["status"] = "regressed"
+            elif good > noise_pct:
+                row["status"] = "improved"
+            else:
+                row["status"] = "ok"
+        out.append(row)
+
+    def worst_key(r):
+        if r["status"] != "regressed" or r["delta_pct"] is None:
+            return 0.0
+        return -(abs(r["delta_pct"]))
+
+    out.sort(key=lambda r: (worst_key(r), r["config"]))
+    return out
+
+
+def regression_report(rows: List[Dict[str, Any]], *,
+                      baseline_name: str = "baseline",
+                      current_name: str = "current",
+                      noise_pct: float = DEFAULT_NOISE_PCT):
+    """A DiagnosticReport carrying one PTL605 per regressed config."""
+    from paddle_tpu.static.analysis.diagnostics import (DiagnosticReport,
+                                                        Severity)
+
+    report = DiagnosticReport()
+    for r in rows:
+        if r["status"] != "regressed":
+            continue
+        worse = (f"{r['delta_pct']:+.2f}%"
+                 if r["delta_pct"] is not None else "?")
+        report.add(
+            "PTL605", Severity.WARNING,
+            f"BENCH regression: {r['config']} {r['unit']} moved {worse} "
+            f"({r['baseline']:g} -> {r['current']:g}, "
+            f"{r['direction']}-is-better, noise band "
+            f"{noise_pct:g}%) from {baseline_name} to {current_name}",
+            hint="rerun the config to rule out machine noise, then "
+                 "bisect the commits between the two BENCH records",
+            suggestion={"config": r["config"], "unit": r["unit"],
+                        "baseline": r["baseline"],
+                        "current": r["current"],
+                        "delta_pct": r["delta_pct"],
+                        "noise_pct": noise_pct})
+    return report
+
+
+def _load(path: str):
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        return text  # raw bench.py stdout: metric lines extracted as-is
+
+
+def latest_bench_records(root: str = _REPO_ROOT) -> List[str]:
+    """The BENCH_r*.json paths in record order (r01, r02, ...)."""
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+
+
+def render_rows(rows: List[Dict[str, Any]],
+                noise_pct: float) -> str:
+    header = (f"{'Config':<14}{'Baseline':>12}{'Current':>12}"
+              f"{'Delta':>10}{'Better':>8}  Status")
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        delta = (f"{r['delta_pct']:+.2f}%" if r["delta_pct"] is not None
+                 else "-")
+        fmt_v = lambda v: f"{v:g}" if v is not None else "-"
+        lines.append(f"{r['config'][:14]:<14}{fmt_v(r['baseline']):>12}"
+                     f"{fmt_v(r['current']):>12}{delta:>10}"
+                     f"{r['direction']:>8}  {r['status']}")
+    n_reg = sum(1 for r in rows if r["status"] == "regressed")
+    lines.append(f"{n_reg} regression(s) beyond the {noise_pct:g}% "
+                 f"noise band")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("baseline", nargs="?",
+                    help="baseline BENCH record (JSON or raw bench.py "
+                         "stdout)")
+    ap.add_argument("current", nargs="?",
+                    help="current BENCH record to gate")
+    ap.add_argument("--latest", action="store_true",
+                    help="compare the two newest BENCH_r*.json in the "
+                         "repo root")
+    ap.add_argument("--noise-pct", type=float, default=DEFAULT_NOISE_PCT,
+                    help="ignore moves within this band (default "
+                         f"{DEFAULT_NOISE_PCT:g}%%)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison as one JSON document")
+    args = ap.parse_args(argv)
+
+    if args.latest:
+        records = latest_bench_records()
+        if len(records) < 2:
+            print("bench_compare: fewer than two BENCH_r*.json records "
+                  "— nothing to compare (not a failure)")
+            return 0
+        base_path, cur_path = records[-2], records[-1]
+    elif args.baseline and args.current:
+        base_path, cur_path = args.baseline, args.current
+    else:
+        ap.print_usage(sys.stderr)
+        print("bench_compare: need BASELINE and CURRENT (or --latest)",
+              file=sys.stderr)
+        return 2
+
+    if not os.path.exists(cur_path):
+        print(f"bench_compare: current record {cur_path!r} missing",
+              file=sys.stderr)
+        return 2
+    if not os.path.exists(base_path):
+        # a first record has nothing to regress against — note and pass
+        print(f"bench_compare: baseline {base_path!r} missing — "
+              f"nothing to compare (not a failure)")
+        return 0
+
+    try:
+        rows = compare_docs(_load(base_path), _load(cur_path),
+                            noise_pct=args.noise_pct)
+    except (OSError, ValueError) as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+    if not rows:
+        print(f"bench_compare: no benchmark metric lines found in "
+              f"{base_path!r}/{cur_path!r}", file=sys.stderr)
+        return 2
+
+    report = regression_report(
+        rows, baseline_name=os.path.basename(base_path),
+        current_name=os.path.basename(cur_path),
+        noise_pct=args.noise_pct)
+    regressed = len(report) > 0
+
+    if args.json:
+        print(json.dumps({
+            "baseline": base_path, "current": cur_path,
+            "noise_pct": args.noise_pct, "rows": rows,
+            "regressed": regressed,
+            "diagnostics": [
+                {"code": d.code, "severity": str(d.severity),
+                 "message": d.message, "suggestion": d.suggestion}
+                for d in report],
+        }, indent=1))
+    else:
+        print(f"bench_compare: {os.path.basename(base_path)} -> "
+              f"{os.path.basename(cur_path)}")
+        print(render_rows(rows, args.noise_pct))
+        if regressed:
+            print()
+            print(report.render("bench_compare:"))
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,15 @@ def main(argv=None) -> int:
     ap.add_argument("--slow_seconds", type=float, default=0.25,
                     help="extra host-side seconds per step for the "
                          "slow rank")
+    ap.add_argument("--creep_rank", type=int, default=None,
+                    help="creeping-slowdown drill: this rank gets "
+                         "--creep_pct percent slower EACH step (gradual "
+                         "degradation a constant threshold never trips); "
+                         "workers run with PADDLE_TPU_HEALTH=1 and the "
+                         "drill asserts the PTL601 drift detector fired")
+    ap.add_argument("--creep_pct", type=float, default=25.0,
+                    help="per-step slowdown growth, percent of the base "
+                         "sleep (PADDLE_TPU_CHAOS_CREEP_BASE, 0.05s)")
     ap.add_argument("--fleet_dir", type=str, default=None,
                     help="enable fleet telemetry: aggregated "
                          "fleet_metrics.json + merged fleet_trace.json "
@@ -124,7 +133,8 @@ def main(argv=None) -> int:
     flight_dir = args.flight_dir or os.path.join(args.log_dir, "flight")
     fleet_dir = args.fleet_dir or (
         os.path.join(args.log_dir, "fleet")
-        if args.slow_rank is not None else None)
+        if args.slow_rank is not None or args.creep_rank is not None
+        else None)
     os.makedirs(args.log_dir, exist_ok=True)
     port = _free_port_block()
     master = f"127.0.0.1:{port}"
@@ -136,6 +146,11 @@ def main(argv=None) -> int:
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
                         f"{args.devices_per_proc}")
+    if args.creep_rank is not None:
+        # the creep drill exists to exercise the health monitor: the
+        # gradual slowdown must trip the PTL601 drift detector, and
+        # detectors only run where PADDLE_TPU_HEALTH installs them
+        env["PADDLE_TPU_HEALTH"] = "1"
 
     procs = []
     for rank in range(args.nnodes):
@@ -150,6 +165,9 @@ def main(argv=None) -> int:
         if args.slow_rank is not None:
             cmd += ["--chaos_slow_rank", str(args.slow_rank),
                     "--chaos_slow_seconds", str(args.slow_seconds)]
+        if args.creep_rank is not None:
+            cmd += ["--chaos_creep_rank", str(args.creep_rank),
+                    "--chaos_creep_pct", str(args.creep_pct)]
         if fleet_dir:
             cmd += ["--fleet_dir", fleet_dir]
         cmd += [args.training_script] + script_args
@@ -214,6 +232,41 @@ def main(argv=None) -> int:
                 reasons.add(json.load(f).get("reason"))
         except (OSError, json.JSONDecodeError):
             pass
+    if args.creep_rank is not None:
+        # health-drill verdict: the creeping slowdown must have tripped
+        # the drift detector — a PTL601 health_alert flight dump whose
+        # context carries the offending series window, and a nonzero
+        # health.alerts counter in the dumping worker's registry
+        alert_codes, windowed, alerts_total = set(), 0, 0
+        for path in dumps:
+            try:
+                with open(path) as f:
+                    d = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if d.get("reason") != "health_alert":
+                continue
+            ctx = d.get("context") or {}
+            alert_codes.add(ctx.get("code"))
+            if ctx.get("window"):
+                windowed += 1
+            for s in (d.get("metrics", {}).get("health.alerts", {})
+                      .get("series", [])):
+                alerts_total += int(s.get("value", 0))
+        if ("PTL601" in alert_codes and windowed and alerts_total
+                and args.kill_rank < 0):
+            print("chaos_launch: OK — creep drill: the gradual "
+                  f"slowdown tripped PTL601 (health.alerts="
+                  f"{alerts_total}, {windowed} windowed "
+                  f"health_alert dump(s))")
+            return 0
+        if args.kill_rank < 0:
+            print("chaos_launch: FAILED — creep drill expected a "
+                  "PTL601 health_alert dump with a series window and "
+                  f"health.alerts > 0; saw codes={sorted(alert_codes)} "
+                  f"windowed={windowed} alerts={alerts_total}",
+                  file=sys.stderr)
+            return 1
     if args.kill_rank < 0:
         if args.slow_rank is not None and "straggler" in reasons:
             print("chaos_launch: OK — straggler drill: the slow rank "

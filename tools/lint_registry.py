@@ -242,6 +242,12 @@ OPPROF_REQUIRED_LABELS = {
     "opprof.drift_ratio": ("name", "prim"),
 }
 
+HEALTH_REQUIRED_LABELS = {
+    "health.alerts": ("rule", "series"),
+    "health.evaluations": ("rule",),
+    "ts.points_recorded": ("series",),
+}
+
 #: one audit loop serves every per-subsystem required-labels table —
 #: add the next subsystem as a row here, not as another copied loop
 REQUIRED_LABEL_TABLES = (
@@ -260,6 +266,8 @@ REQUIRED_LABEL_TABLES = (
                             "applies)"),
     (OPPROF_REQUIRED_LABELS, "opprof series must attribute the profile "
                              "name (and the prim for per-op series)"),
+    (HEALTH_REQUIRED_LABELS, "health/ts series must attribute the "
+                             "detector rule and/or the recorded series"),
 )
 
 #: gauge-prefix discipline: no gauge under these prefixes may record an
@@ -275,6 +283,9 @@ NO_UNLABELED_GAUGE_PREFIXES = {
               "(serve-trace series merge through the fleet plane too)",
     "opprof.": "every opprof gauge must carry at least a name= label "
                "(the profile the measurement attributes)",
+    "health.": "every health gauge must carry at least a rule= or "
+               "series= label (an unlabeled health series cannot be "
+               "attributed to a detector once registries merge)",
 }
 
 
@@ -288,9 +299,11 @@ def check_metric_registry() -> List[str]:
     import paddle_tpu.distributed.elastic  # noqa: F401
     import paddle_tpu.io.dataloader  # noqa: F401
     import paddle_tpu.observability.fleet  # noqa: F401
+    import paddle_tpu.observability.health  # noqa: F401
     import paddle_tpu.observability.opprof  # noqa: F401
     import paddle_tpu.observability.runtime  # noqa: F401
     import paddle_tpu.observability.slo  # noqa: F401
+    import paddle_tpu.observability.timeseries  # noqa: F401
     import paddle_tpu.observability.tracing  # noqa: F401
     import paddle_tpu.serve  # noqa: F401
     from paddle_tpu.observability.metrics import (CLAIMED_SUBSYSTEMS,
@@ -361,6 +374,7 @@ def check_diagnostic_registry() -> List[str]:
     by at least one test (string-presence scan over ``tests/``)."""
     from paddle_tpu.distributed import passes as passes_mod
     from paddle_tpu.distributed.passes.lint_fix_passes import LintFixPass
+    from paddle_tpu.observability import health as health_mod
     from paddle_tpu.observability import opprof as opprof_mod
     from paddle_tpu.observability import slo as slo_mod
     from paddle_tpu.observability import tracing as tracing_mod
@@ -390,7 +404,8 @@ def check_diagnostic_registry() -> List[str]:
             ("serve_trace_lint", serve_trace_lint.SERVE_TRACE_LINT_CODES),
             ("observability.tracing", tracing_mod.TRACE_CODES),
             ("observability.slo", slo_mod.SLO_CODES),
-            ("observability.opprof", opprof_mod.OPPROF_CODES)):
+            ("observability.opprof", opprof_mod.OPPROF_CODES),
+            ("observability.health", health_mod.HEALTH_CODES)):
         for code in codes:
             if code not in diagnostics.CODES:
                 problems.append(

@@ -8,6 +8,7 @@ Usage:
     python tools/metrics_report.py --fleet /tmp/fleet   # cross-rank view
     python tools/metrics_report.py --serve-trace /tmp/serve_trace
     python tools/metrics_report.py --opprof /tmp/opprof.json
+    python tools/metrics_report.py --health metrics.json  # trend tables
 
 Input is either the JSON written by ``paddle_tpu.observability.dump(path)``
 (or any workload run with ``PADDLE_TPU_METRICS_DUMP=metrics.json``), or a
@@ -196,6 +197,53 @@ def _render_opprof(path: str, top) -> int:
     return 0
 
 
+def _render_health(path: str) -> int:
+    """Render the health view of a dump: recorded time-series trend
+    tables + sparklines, alerts, and the latched ``health.alerts``
+    counts. Accepts a metrics dump from a ``PADDLE_TPU_HEALTH`` run, a
+    ``health_alert`` flight dump (or a directory of flight dumps, the
+    health ones selected), or a fleet_metrics.json with per-rank
+    lanes."""
+    from paddle_tpu.observability.flight import FLIGHT_DUMP_KIND
+    from paddle_tpu.observability.report import render_health
+
+    paths = [path]
+    if os.path.isdir(path):
+        import glob
+
+        fleet_dump = os.path.join(path, "fleet_metrics.json")
+        paths = sorted(glob.glob(os.path.join(path, "flight-*.json")))
+        if os.path.exists(fleet_dump):
+            paths.insert(0, fleet_dump)
+        if not paths:
+            print(f"metrics_report: no flight-*.json or "
+                  f"fleet_metrics.json in {path!r}", file=sys.stderr)
+            return 1
+    shown = 0
+    for p in paths:
+        try:
+            with open(p) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"metrics_report: cannot read {p!r}: {e}",
+                  file=sys.stderr)
+            return 1
+        if (isinstance(d, dict) and d.get("kind") == FLIGHT_DUMP_KIND
+                and d.get("reason") != "health_alert"):
+            continue  # directory mode: only health dumps are relevant
+        if shown:
+            print("\n" + "=" * 72)
+        if len(paths) > 1:
+            print(f"{os.path.basename(p)}:")
+        print(render_health(d))
+        shown += 1
+    if not shown:
+        print(f"metrics_report: no health_alert dumps under {path!r}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("dump", help="JSON written by observability.dump(), a "
@@ -222,7 +270,17 @@ def main(argv=None) -> int:
                          "(measured/predicted ms, drift, roofline %%, "
                          "cumulative step share) + the PTL501/PTL502 "
                          "op-profile lint")
+    ap.add_argument("--health", action="store_true",
+                    help="health view: recorded metric time-series as "
+                         "trend tables + sparklines, fired alerts and "
+                         "latched health.alerts counts (metrics dump "
+                         "from a PADDLE_TPU_HEALTH run, a health_alert "
+                         "flight dump/directory, or fleet_metrics.json "
+                         "per-rank lanes)")
     args = ap.parse_args(argv)
+
+    if args.health:
+        return _render_health(args.dump)
 
     if args.opprof:
         return _render_opprof(args.dump, args.top)
